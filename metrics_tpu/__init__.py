@@ -1,0 +1,30 @@
+"""metrics_tpu — TPU-native metrics framework (JAX/XLA/Pallas).
+
+A from-scratch, tpu-first reimplementation of the capabilities of the reference
+TorchMetrics library (see SURVEY.md): ~90 stateful module metrics + functional
+counterparts over 10 domains, built on one abstraction — a ``Metric`` whose state is a
+pytree of ``jax.Array``s, whose ``update``/``compute`` are pure jittable functions, and
+whose distributed sync lowers to XLA collectives (psum/pmean/pmax/pmin/all_gather) over
+named mesh axes instead of gather-then-reduce.
+"""
+
+import logging as __logging
+
+__version__ = "0.1.0"
+
+_logger = __logging.getLogger("metrics_tpu")
+_logger.addHandler(__logging.StreamHandler())
+_logger.setLevel(__logging.INFO)
+
+from metrics_tpu.aggregation import CatMetric, MaxMetric, MeanMetric, MinMetric, SumMetric  # noqa: E402
+from metrics_tpu.metric import CompositionalMetric, Metric  # noqa: E402
+
+__all__ = [
+    "CatMetric",
+    "CompositionalMetric",
+    "MaxMetric",
+    "MeanMetric",
+    "Metric",
+    "MinMetric",
+    "SumMetric",
+]

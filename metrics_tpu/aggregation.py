@@ -1,0 +1,169 @@
+"""Streaming scalar aggregators.
+
+Reference parity: src/torchmetrics/aggregation.py — BaseAggregator :24, MaxMetric :95,
+MinMetric :156, SumMetric :217, CatMetric :276, MeanMetric :336. ``nan_strategy``
+(error/warn/ignore/float-impute) preserved; the masking is implemented with
+``jnp.where`` (trace-safe) instead of boolean filtering, per SURVEY §7.1's static-shape
+constraint — except for 'error'/'warn', which need a host-side value check and therefore
+no-op inside jit (same escape as ``validate_args=False``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.checks import _value_check_possible
+from metrics_tpu.utils.data import dim_zero_cat
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+class BaseAggregator(Metric):
+    """Base class for aggregators (reference aggregation.py:24-92)."""
+
+    is_differentiable = None
+    higher_is_better = None
+    full_state_update = False
+    _neutral: float = 0.0  # value NaNs map to under nan_strategy='ignore'
+
+    def __init__(
+        self,
+        fn: Union[Callable, str],
+        default_value: Union[Array, List],
+        nan_strategy: Union[str, float] = "error",
+        state_name: str = "value",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        allowed_nan_strategy = ("error", "warn", "ignore")
+        if nan_strategy not in allowed_nan_strategy and not isinstance(nan_strategy, float):
+            raise ValueError(
+                f"Arg `nan_strategy` should either be a float or one of {allowed_nan_strategy} but got {nan_strategy}."
+            )
+        self.nan_strategy = nan_strategy
+        self.add_state(state_name, default=default_value, dist_reduce_fx=fn)
+        self.state_name = state_name
+
+    def _cast_and_nan_check_input(self, x: Union[float, Array], weight: Optional[Union[float, Array]] = None) -> tuple:
+        """Cast to float and handle NaNs per ``nan_strategy``.
+
+        Returns (x, weight) with NaNs replaced (ignore → neutral handled by caller via
+        the returned nan mask inside x==nan_to_num semantics).
+        """
+        x = jnp.asarray(x, dtype=jnp.float32)
+        if weight is not None:
+            weight = jnp.asarray(weight, dtype=jnp.float32)
+            weight = jnp.broadcast_to(weight, x.shape)
+
+        nans = jnp.isnan(x)
+        anynan = jnp.any(nans)
+        if self.nan_strategy in ("error", "warn"):
+            if _value_check_possible(x) and bool(anynan):
+                if self.nan_strategy == "error":
+                    raise RuntimeError("Encountered `nan` values in tensor")
+                rank_zero_warn("Encountered `nan` values in tensor. Will be removed.", UserWarning)
+                x = x[~nans]
+                if weight is not None:
+                    weight = weight[~nans]
+        elif self.nan_strategy == "ignore":
+            # trace-safe: replace NaNs with the op's neutral element and zero their
+            # weight instead of boolean filtering (static shapes — SURVEY §7.1)
+            if weight is None:
+                weight = jnp.ones_like(x)
+            weight = jnp.where(nans, 0.0, weight)
+            x = jnp.where(nans, jnp.asarray(self._neutral, dtype=x.dtype), x)
+        else:  # float imputation
+            x = jnp.where(nans, jnp.asarray(self.nan_strategy, dtype=x.dtype), x)
+
+        if weight is None:
+            weight = jnp.ones_like(x)
+        return x.reshape(-1), weight.reshape(-1)
+
+    def update(self, value: Union[float, Array]) -> None:
+        pass
+
+    def compute(self) -> Array:
+        return getattr(self, self.state_name)
+
+
+class MaxMetric(BaseAggregator):
+    """Running max (reference aggregation.py:95)."""
+
+    full_state_update = True
+    _neutral = -float("inf")
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("max", jnp.asarray(-jnp.inf, dtype=jnp.float32), nan_strategy, state_name="max_value", **kwargs)
+
+    def update(self, value: Union[float, Array]) -> None:
+        value, _ = self._cast_and_nan_check_input(value)
+        if value.size:  # make sure tensor not empty
+            self.max_value = jnp.maximum(self.max_value, jnp.max(value))
+
+
+class MinMetric(BaseAggregator):
+    """Running min (reference aggregation.py:156)."""
+
+    full_state_update = True
+    _neutral = float("inf")
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("min", jnp.asarray(jnp.inf, dtype=jnp.float32), nan_strategy, state_name="min_value", **kwargs)
+
+    def update(self, value: Union[float, Array]) -> None:
+        value, _ = self._cast_and_nan_check_input(value)
+        if value.size:
+            self.min_value = jnp.minimum(self.min_value, jnp.min(value))
+
+
+class SumMetric(BaseAggregator):
+    """Running sum (reference aggregation.py:217)."""
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("sum", jnp.zeros((), dtype=jnp.float32), nan_strategy, state_name="sum_value", **kwargs)
+
+    def update(self, value: Union[float, Array]) -> None:
+        value, _ = self._cast_and_nan_check_input(value)
+        if value.size:
+            self.sum_value = self.sum_value + jnp.sum(value)
+
+
+class CatMetric(BaseAggregator):
+    """Concatenate all seen values (reference aggregation.py:276)."""
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("cat", [], nan_strategy, **kwargs)
+
+    def update(self, value: Union[float, Array]) -> None:
+        value, weight = self._cast_and_nan_check_input(value)
+        if self.nan_strategy == "ignore" and _value_check_possible(value):
+            value = value[weight != 0]
+        if value.size:
+            self.value.append(value)
+
+    def compute(self) -> Array:
+        if isinstance(self.value, list) and self.value:
+            return dim_zero_cat(self.value)
+        return self.value
+
+
+class MeanMetric(BaseAggregator):
+    """Weighted running mean: ``value``+``weight`` sum states (reference aggregation.py:336)."""
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("sum", jnp.zeros((), dtype=jnp.float32), nan_strategy, state_name="mean_value", **kwargs)
+        self.add_state("weight", default=jnp.zeros((), dtype=jnp.float32), dist_reduce_fx="sum")
+
+    def update(self, value: Union[float, Array], weight: Union[float, Array] = 1.0) -> None:
+        value, weight = self._cast_and_nan_check_input(value, weight)
+        if value.size == 0:
+            return
+        self.mean_value = self.mean_value + jnp.sum(value * weight)
+        self.weight = self.weight + jnp.sum(weight)
+
+    def compute(self) -> Array:
+        return self.mean_value / self.weight

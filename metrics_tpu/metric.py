@@ -1,0 +1,853 @@
+"""Core ``Metric`` runtime — stateful shell over a pure-functional, jittable core.
+
+Reference parity: src/torchmetrics/metric.py (class Metric :43, add_state :162-230,
+forward dual path :233-363, _sync_dist :365-395, sync/unsync/sync_context :428-521,
+_wrap_update/_wrap_compute :397-426/:523-551, reset/clone :566-585, serialization
+:587-596/:681-719, operator overloads :762-871, CompositionalMetric :878-978).
+
+TPU-native redesign (SURVEY §7.1):
+
+- State is a pytree of immutable ``jax.Array``s (fixed-shape states) and Python lists of
+  arrays (ragged "cat" states). "Mutation" is attribute rebinding — so the reference's
+  cache/restore gymnastics in ``forward`` reduce to holding references (free).
+- Every metric exposes a **pure functional API** — ``init_state() / update_state(state,
+  *args) / compute_from(state, axis_name=...) / merge_states(a, b)`` — that can be closed
+  over by a user's ``pjit``/``shard_map`` training step, fusing metric accumulation into
+  the compiled step graph. ``axis_name`` triggers XLA collectives (``psum`` et al.) for
+  the sync instead of the reference's gather-then-reduce.
+- The stateful shell (``update()/compute()/forward()/reset()``) keeps drop-in ergonomics
+  for eval loops, with host-level multi-process sync via ``gather_all_tensors``.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+from abc import ABC, abstractmethod
+from contextlib import contextmanager
+from copy import deepcopy
+from typing import Any, Callable, Dict, Generator, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.parallel.sync import reduce_in_trace
+from metrics_tpu.utils.data import (
+    _flatten,
+    _squeeze_if_scalar,
+    apply_to_collection,
+    dim_zero_cat,
+    dim_zero_max,
+    dim_zero_mean,
+    dim_zero_min,
+    dim_zero_sum,
+)
+from metrics_tpu.utils.distributed import distributed_available, gather_all_tensors
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+from metrics_tpu.utils.prints import rank_zero_warn
+
+_REDUCTION_FNS: Dict[str, Callable] = {
+    "sum": dim_zero_sum,
+    "mean": dim_zero_mean,
+    "cat": dim_zero_cat,
+    "min": dim_zero_min,
+    "max": dim_zero_max,
+}
+
+StateValue = Union[Array, List[Array]]
+
+
+class Metric(ABC):
+    """Base class for all metrics.
+
+    Kwargs (reference metric.py:82-144): ``compute_on_cpu``, ``dist_sync_on_step``,
+    ``process_group``, ``dist_sync_fn``, ``distributed_available_fn``,
+    ``sync_on_compute``. TPU extension: ``axis_name`` — default mesh axis (or tuple of
+    axes) that the functional ``compute_from`` syncs over when called inside a trace.
+    """
+
+    __jit_ignored_attributes__: Sequence[str] = ()  # kept for API parity
+
+    is_differentiable: Optional[bool] = None
+    higher_is_better: Optional[bool] = None
+    full_state_update: Optional[bool] = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        self._device = None
+
+        self.compute_on_cpu = kwargs.pop("compute_on_cpu", False)
+        if not isinstance(self.compute_on_cpu, bool):
+            raise ValueError(f"Expected keyword argument `compute_on_cpu` to be a `bool` but got {self.compute_on_cpu}")
+
+        self.dist_sync_on_step = kwargs.pop("dist_sync_on_step", False)
+        if not isinstance(self.dist_sync_on_step, bool):
+            raise ValueError(f"Expected keyword argument `dist_sync_on_step` to be a `bool` but got {self.dist_sync_on_step}")
+
+        self.process_group = kwargs.pop("process_group", None)
+
+        self.dist_sync_fn = kwargs.pop("dist_sync_fn", None)
+        if self.dist_sync_fn is not None and not callable(self.dist_sync_fn):
+            raise ValueError(f"Expected keyword argument `dist_sync_fn` to be an callable function but got {self.dist_sync_fn}")
+
+        self.distributed_available_fn = kwargs.pop("distributed_available_fn", None) or distributed_available
+
+        self.sync_on_compute = kwargs.pop("sync_on_compute", True)
+        if not isinstance(self.sync_on_compute, bool):
+            raise ValueError(f"Expected keyword argument `sync_on_compute` to be a `bool` but got {self.sync_on_compute}")
+
+        # TPU extension: default mesh axis for in-trace sync in the functional API.
+        self.axis_name = kwargs.pop("axis_name", None)
+
+        if kwargs:
+            kwargs_ = [f"`{a}`" for a in sorted(kwargs)]
+            raise ValueError(f"Unexpected keyword arguments: {', '.join(kwargs_)}")
+
+        # state management
+        self._defaults: Dict[str, StateValue] = {}
+        self._persistent: Dict[str, bool] = {}
+        self._reductions: Dict[str, Union[str, Callable, None]] = {}
+
+        self._update_count = 0
+        self._computed: Any = None
+        self._to_sync = self.sync_on_compute
+        self._should_unsync = True
+        self._enable_grad = False
+
+        self._cache: Optional[Dict[str, StateValue]] = None
+        self._is_synced = False
+
+        self._update_called = False
+        self._forward_cache: Any = None
+
+        # wrap update/compute on the instance (reference metric.py:92-93)
+        self.update: Callable = self._wrap_update(self.update)  # type: ignore[method-assign]
+        self.compute: Callable = self._wrap_compute(self.compute)  # type: ignore[method-assign]
+
+    # ------------------------------------------------------------------ state registry
+
+    def add_state(
+        self,
+        name: str,
+        default: StateValue,
+        dist_reduce_fx: Optional[Union[str, Callable]] = None,
+        persistent: bool = False,
+    ) -> None:
+        """Register a metric state (reference metric.py:162-230).
+
+        ``default`` must be an array (fixed-shape state) or an empty list (ragged "cat"
+        state). ``dist_reduce_fx`` ∈ {'sum','mean','cat','min','max', callable, None}.
+        """
+        if not isinstance(default, (jax.Array, np.ndarray, list)) or (isinstance(default, list) and default):
+            raise ValueError("state variable must be a tensor or any empty list (where you can append tensors)")
+        if isinstance(dist_reduce_fx, str):
+            if dist_reduce_fx not in _REDUCTION_FNS:
+                raise ValueError("`dist_reduce_fx` must be callable or one of ['mean', 'sum', 'cat', 'min', 'max', None]")
+        elif not (callable(dist_reduce_fx) or dist_reduce_fx is None):
+            raise ValueError("`dist_reduce_fx` must be callable or one of ['mean', 'sum', 'cat', 'min', 'max', None]")
+        if name in ("_defaults", "_persistent", "_reductions", "update", "compute"):
+            raise ValueError(f"The name `{name}` is reserved and cannot be used for a metric state")
+
+        if not isinstance(default, list):
+            default = jnp.asarray(default)
+
+        setattr(self, name, [] if isinstance(default, list) else default)
+        self._defaults[name] = deepcopy(default)
+        self._persistent[name] = persistent
+        self._reductions[name] = dist_reduce_fx
+
+    # ------------------------------------------------------------------ update/compute (stateful shell)
+
+    @abstractmethod
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Override to update metric state from a batch."""
+
+    @abstractmethod
+    def compute(self) -> Any:
+        """Override to compute the final value from accumulated state."""
+
+    def _wrap_update(self, update: Callable) -> Callable:
+        @functools.wraps(update)
+        def wrapped_func(*args: Any, **kwargs: Any) -> None:
+            self._computed = None
+            self._update_count += 1
+            self._update_called = True
+            if self._is_synced:
+                raise MetricsTPUUserError(
+                    "The Metric has already been synced. HINT: call `unsync()` before modifying the state."
+                )
+            update(*args, **kwargs)
+            if self.compute_on_cpu:
+                self._move_list_states_to_cpu()
+
+        return wrapped_func
+
+    def _move_list_states_to_cpu(self) -> None:
+        """Move list states to host memory (reference metric.py:421-426)."""
+        cpu = jax.devices("cpu")[0]
+        for key in self._defaults:
+            current = getattr(self, key)
+            if isinstance(current, list):
+                setattr(self, key, [jax.device_put(c, cpu) for c in current])
+
+    def _wrap_compute(self, compute: Callable) -> Callable:
+        @functools.wraps(compute)
+        def wrapped_func(*args: Any, **kwargs: Any) -> Any:
+            if not self._update_called:
+                rank_zero_warn(
+                    f"The ``compute`` method of metric {self.__class__.__name__} was called before the ``update`` method"
+                    " which may lead to errors, as metric states have not yet been updated.",
+                    UserWarning,
+                )
+            if self._computed is not None:
+                return self._computed
+
+            with self.sync_context(
+                dist_sync_fn=self.dist_sync_fn,
+                process_group=self.process_group,
+                should_sync=self._to_sync,
+                should_unsync=self._should_unsync,
+            ):
+                value = compute(*args, **kwargs)
+                self._computed = _squeeze_if_scalar(value)
+            return self._computed
+
+        return wrapped_func
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        """Accumulate global state AND return the metric value on this batch.
+
+        Reference metric.py:233-252; the reduced path is the default because state is an
+        immutable pytree here (snapshot = holding references).
+        """
+        if self._is_synced:
+            raise MetricsTPUUserError("The Metric shouldn't be synced when performing ``forward``.")
+        if self.full_state_update or self.full_state_update is None or self.dist_sync_on_step:
+            return self._forward_full_state_update(*args, **kwargs)
+        return self._forward_reduce_state_update(*args, **kwargs)
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        return self.forward(*args, **kwargs)
+
+    def _forward_full_state_update(self, *args: Any, **kwargs: Any) -> Any:
+        """2×-update path (reference metric.py:254-295)."""
+        self.update(*args, **kwargs)
+        _update_count = self._update_count
+        self._to_sync = self.dist_sync_on_step
+        cache = {attr: getattr(self, attr) for attr in self._defaults}
+        cache = {k: list(v) if isinstance(v, list) else v for k, v in cache.items()}
+        self._should_unsync = False
+        # reset to default values and compute batch-only value
+        self.reset()
+        self.update(*args, **kwargs)
+        batch_val = self.compute()
+        # restore context
+        for attr, val in cache.items():
+            setattr(self, attr, val)
+        self._update_count = _update_count
+        self._should_unsync = True
+        self._to_sync = self.sync_on_compute
+        self._computed = None
+        self._forward_cache = batch_val
+        return batch_val
+
+    def _forward_reduce_state_update(self, *args: Any, **kwargs: Any) -> Any:
+        """1×-update path + associative state merge (reference metric.py:297-363)."""
+        global_state = {attr: getattr(self, attr) for attr in self._defaults}
+        global_state = {k: list(v) if isinstance(v, list) else v for k, v in global_state.items()}
+        _update_count = self._update_count
+        self.reset()
+
+        self._to_sync = self.dist_sync_on_step
+        self._should_unsync = False
+
+        self.update(*args, **kwargs)
+        batch_val = self.compute()
+
+        self._update_count = _update_count + 1
+        self._reduce_states(global_state)
+
+        self._should_unsync = True
+        self._to_sync = self.sync_on_compute
+        self._computed = None
+        self._forward_cache = batch_val
+        return batch_val
+
+    def _reduce_states(self, incoming_state: Dict[str, StateValue]) -> None:
+        """Merge an incoming (global) state into the current (batch) state.
+
+        Reference metric.py:336-363. sum: add; mean: running mean by update count;
+        max/min: elementwise; cat: list concat; None: stack.
+        """
+        for attr in self._defaults:
+            local_state = getattr(self, attr)
+            global_state = incoming_state[attr]
+            reduce_fn = self._reductions[attr]
+            if reduce_fn == "sum":
+                reduced = global_state + local_state
+            elif reduce_fn == "mean":
+                reduced = ((self._update_count - 1) * global_state + local_state) / self._update_count
+            elif reduce_fn == "max":
+                reduced = jnp.maximum(global_state, local_state)
+            elif reduce_fn == "min":
+                reduced = jnp.minimum(global_state, local_state)
+            elif reduce_fn == "cat":
+                reduced = global_state + local_state  # list concat
+            elif reduce_fn is None and isinstance(global_state, jax.Array):
+                reduced = jnp.stack([global_state, local_state])
+            elif reduce_fn is None and isinstance(global_state, list):
+                reduced = _flatten([global_state, local_state])
+            else:
+                fn = _REDUCTION_FNS.get(reduce_fn, reduce_fn) if isinstance(reduce_fn, str) else reduce_fn
+                reduced = fn(jnp.stack([jnp.asarray(global_state), jnp.asarray(local_state)]))
+            setattr(self, attr, reduced)
+
+    # ------------------------------------------------------------------ distributed sync (host level)
+
+    def _sync_dist(self, dist_sync_fn: Callable = gather_all_tensors, process_group: Optional[Any] = None) -> None:
+        """Gather + reduce every registered state (reference metric.py:365-395)."""
+        input_dict = {attr: getattr(self, attr) for attr in self._reductions}
+
+        for attr, reduction_fn in self._reductions.items():
+            # pre-concatenate metric states that are lists to reduce number of all-gathers
+            if isinstance(input_dict[attr], list) and len(input_dict[attr]) >= 1:
+                input_dict[attr] = [dim_zero_cat(input_dict[attr])]
+
+        output_dict = apply_to_collection(
+            input_dict,
+            jax.Array,
+            dist_sync_fn,
+            group=process_group or self.process_group,
+        )
+
+        for attr, reduction_fn in self._reductions.items():
+            # pre-processing ops (stack or flatten for inputs)
+            if isinstance(output_dict[attr], list) and len(output_dict[attr]) == 0:
+                setattr(self, attr, [])
+                continue
+
+            if isinstance(output_dict[attr][0], jax.Array):
+                output_dict[attr] = jnp.stack(output_dict[attr])
+            elif isinstance(output_dict[attr][0], list):
+                output_dict[attr] = _flatten(output_dict[attr])
+
+            fn = _REDUCTION_FNS.get(reduction_fn, reduction_fn) if isinstance(reduction_fn, str) else reduction_fn
+            if not (callable(fn) or fn is None):
+                raise TypeError("reduction_fn must be callable or None")
+            reduced = fn(output_dict[attr]) if fn is not None else output_dict[attr]
+            if isinstance(getattr(self, attr), list) and isinstance(reduced, jax.Array):
+                reduced = [reduced]
+            setattr(self, attr, reduced)
+
+    def sync(
+        self,
+        dist_sync_fn: Optional[Callable] = None,
+        process_group: Optional[Any] = None,
+        should_sync: bool = True,
+        distributed_available: Optional[Callable] = None,
+    ) -> None:
+        """Sync state across processes; caches the local state (reference metric.py:428-465)."""
+        if self._is_synced and should_sync:
+            raise MetricsTPUUserError("The Metric has already been synced.")
+
+        if distributed_available is None and self.distributed_available_fn is not None:
+            distributed_available = self.distributed_available_fn
+
+        is_distributed = distributed_available() if callable(distributed_available) else None
+        if not should_sync or not is_distributed:
+            return
+
+        if dist_sync_fn is None:
+            dist_sync_fn = gather_all_tensors
+
+        # cache prior to syncing
+        self._cache = {attr: getattr(self, attr) for attr in self._defaults}
+        self._cache = {k: list(v) if isinstance(v, list) else v for k, v in self._cache.items()}
+
+        # sync
+        self._sync_dist(dist_sync_fn, process_group=process_group)
+        self._is_synced = True
+
+    def unsync(self, should_unsync: bool = True) -> None:
+        """Restore cached local state (reference metric.py:467-487)."""
+        if not should_unsync:
+            return
+        if not self._is_synced:
+            raise MetricsTPUUserError("The Metric has already been un-synced.")
+        if self._cache is None:
+            raise MetricsTPUUserError("The internal cache should exist to unsync the Metric.")
+
+        for attr, val in self._cache.items():
+            setattr(self, attr, val)
+        self._is_synced = False
+        self._cache = None
+
+    @contextmanager
+    def sync_context(
+        self,
+        dist_sync_fn: Optional[Callable] = None,
+        process_group: Optional[Any] = None,
+        should_sync: bool = True,
+        should_unsync: bool = True,
+        distributed_available: Optional[Callable] = None,
+    ) -> Generator[None, None, None]:
+        """Sync on enter, unsync on exit (reference metric.py:489-521)."""
+        self.sync(
+            dist_sync_fn=dist_sync_fn,
+            process_group=process_group,
+            should_sync=should_sync,
+            distributed_available=distributed_available,
+        )
+        yield
+        self.unsync(should_unsync=self._is_synced and should_unsync)
+
+    # ------------------------------------------------------------------ pure functional API (TPU-first)
+
+    def _raw_update(self) -> Callable:
+        """The unwrapped user ``update``."""
+        return type(self).update.__get__(self)
+
+    def _raw_compute(self) -> Callable:
+        return type(self).compute.__get__(self)
+
+    def init_state(self) -> Dict[str, Any]:
+        """Default state as a pytree (fixed states as arrays; ``_update_count`` included)."""
+        state: Dict[str, Any] = {}
+        for name, default in self._defaults.items():
+            state[name] = [] if isinstance(default, list) else jnp.asarray(default)
+        state["_update_count"] = jnp.zeros((), dtype=jnp.int32)
+        return state
+
+    def _swap_in(self, state: Dict[str, Any]) -> Dict[str, Any]:
+        snapshot: Dict[str, Any] = {name: getattr(self, name) for name in self._defaults}
+        snapshot["_update_count"] = self._update_count
+        for name in self._defaults:
+            setattr(self, name, state[name])
+        self._update_count = state.get("_update_count", 0)
+        return snapshot
+
+    def _swap_out(self, snapshot: Dict[str, Any]) -> Dict[str, Any]:
+        state: Dict[str, Any] = {name: getattr(self, name) for name in self._defaults}
+        state["_update_count"] = self._update_count
+        for name in self._defaults:
+            setattr(self, name, snapshot[name])
+        self._update_count = snapshot["_update_count"]
+        return state
+
+    def update_state(self, state: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        """Pure: ``(state, batch) -> state``. Safe to call inside jit/shard_map/pjit."""
+        snapshot = self._swap_in(state)
+        try:
+            self._raw_update()(*args, **kwargs)
+            self._update_count = self._update_count + 1
+        finally:
+            new_state = self._swap_out(snapshot)
+        return new_state
+
+    def compute_from(self, state: Dict[str, Any], axis_name: Optional[Any] = None) -> Any:
+        """Pure: final value from a state pytree; ``axis_name`` syncs via XLA collectives."""
+        axis_name = axis_name if axis_name is not None else self.axis_name
+        if axis_name is not None:
+            state = self.sync_state(state, axis_name)
+        snapshot = self._swap_in(state)
+        try:
+            value = self._raw_compute()()
+            return _squeeze_if_scalar(value)
+        finally:
+            self._swap_out(snapshot)
+
+    def sync_state(self, state: Dict[str, Any], axis_name: Any) -> Dict[str, Any]:
+        """In-trace sync: per-state XLA collective over ``axis_name`` mesh axes."""
+        synced = dict(state)
+        for name, reduction in self._reductions.items():
+            val = state[name]
+            if isinstance(val, list):
+                if not val:
+                    synced[name] = val
+                else:
+                    synced[name] = [reduce_in_trace(dim_zero_cat(val), "cat", axis_name)]
+            else:
+                synced[name] = reduce_in_trace(val, reduction, axis_name)
+        return synced
+
+    def merge_states(self, state_a: Dict[str, Any], state_b: Dict[str, Any]) -> Dict[str, Any]:
+        """Associatively merge two state pytrees (pure analogue of ``_reduce_states``)."""
+        merged: Dict[str, Any] = {}
+        count_a = state_a.get("_update_count", 0)
+        count_b = state_b.get("_update_count", 0)
+        total = count_a + count_b
+        for name, reduction in self._reductions.items():
+            a, b = state_a[name], state_b[name]
+            if reduction == "sum":
+                merged[name] = a + b
+            elif reduction == "mean":
+                merged[name] = (count_a * a + count_b * b) / jnp.maximum(total, 1)
+            elif reduction == "max":
+                merged[name] = jnp.maximum(a, b)
+            elif reduction == "min":
+                merged[name] = jnp.minimum(a, b)
+            elif reduction == "cat" or reduction is None:
+                merged[name] = list(a) + list(b) if isinstance(a, list) else jnp.concatenate([a, b], axis=0)
+            else:
+                fn = reduction
+                merged[name] = fn(jnp.stack([a, b]))
+        merged["_update_count"] = total
+        return merged
+
+    # ------------------------------------------------------------------ reset / clone / device
+
+    def reset(self) -> None:
+        """Reset states to defaults (reference metric.py:566-580)."""
+        self._update_count = 0
+        self._update_called = False
+        self._computed = None
+
+        for attr, default in self._defaults.items():
+            if isinstance(default, list):
+                setattr(self, attr, [])
+            else:
+                setattr(self, attr, jnp.asarray(default))
+
+        # reset internal sync state
+        self._cache = None
+        self._is_synced = False
+
+    def clone(self) -> "Metric":
+        """Deep copy of the metric (reference metric.py:582-585)."""
+        return deepcopy(self)
+
+    def to_device(self, device: Any) -> "Metric":
+        """Move all states (and defaults) to ``device`` (reference ``_apply``)."""
+        self._device = device
+        for attr in self._defaults:
+            current = getattr(self, attr)
+            if isinstance(current, list):
+                setattr(self, attr, [jax.device_put(c, device) for c in current])
+            else:
+                setattr(self, attr, jax.device_put(current, device))
+        self._defaults = {
+            k: ([jax.device_put(vv, device) for vv in v] if isinstance(v, list) else jax.device_put(v, device))
+            for k, v in self._defaults.items()
+        }
+        return self
+
+    @property
+    def device(self) -> Any:
+        if self._device is not None:
+            return self._device
+        for attr in self._defaults:
+            val = getattr(self, attr)
+            if isinstance(val, jax.Array):
+                try:
+                    return next(iter(val.devices()))
+                except Exception:
+                    return None
+        return None
+
+    def set_dtype(self, dst_type: Any) -> "Metric":
+        """Convert floating-point states to ``dst_type`` (reference metric.py:664-674)."""
+
+        def _convert(x: Array) -> Array:
+            return x.astype(dst_type) if jnp.issubdtype(x.dtype, jnp.floating) else x
+
+        for attr in self._defaults:
+            current = getattr(self, attr)
+            if isinstance(current, list):
+                setattr(self, attr, [_convert(c) for c in current])
+            else:
+                setattr(self, attr, _convert(current))
+        self._defaults = {
+            k: ([_convert(vv) for vv in v] if isinstance(v, list) else _convert(v)) for k, v in self._defaults.items()
+        }
+        return self
+
+    # ------------------------------------------------------------------ persistence / serialization
+
+    def persistent(self, mode: bool = False) -> None:
+        """Set persistence of all states (reference metric.py:676-679)."""
+        for key in self._persistent:
+            self._persistent[key] = mode
+
+    def state_dict(self, destination: Optional[Dict] = None, prefix: str = "") -> Dict[str, Any]:
+        """Persistent states as a flat dict of numpy arrays (orbax-friendly pytree).
+
+        Reference metric.py:681-700 — only states registered ``persistent=True`` are
+        included, matching ``nn.Module.state_dict`` semantics.
+        """
+        destination = {} if destination is None else destination
+        for key in self._defaults:
+            if not self._persistent[key]:
+                continue
+            current = getattr(self, key)
+            if isinstance(current, list):
+                destination[prefix + key] = [np.asarray(c) for c in current]
+            else:
+                destination[prefix + key] = np.asarray(current)
+        return destination
+
+    def load_state_dict(self, state_dict: Dict[str, Any], prefix: str = "", strict: bool = True) -> None:
+        """Inverse of :meth:`state_dict` (reference metric.py:702-719)."""
+        for key in self._defaults:
+            name = prefix + key
+            if name in state_dict:
+                val = state_dict[name]
+                if isinstance(val, list):
+                    setattr(self, key, [jnp.asarray(v) for v in val])
+                else:
+                    setattr(self, key, jnp.asarray(val))
+            elif strict and self._persistent[key]:
+                raise KeyError(f"Missing key {name} in state_dict")
+
+    def __getstate__(self) -> Dict[str, Any]:
+        """Drop instance-wrapped fns for pickling (reference metric.py:587-591)."""
+        return {k: v for k, v in self.__dict__.items() if k not in ("update", "compute")}
+
+    def __setstate__(self, state: Dict[str, Any]) -> None:
+        self.__dict__.update(state)
+        self.update = self._wrap_update(type(self).update.__get__(self))
+        self.compute = self._wrap_compute(type(self).compute.__get__(self))
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        if name in ("higher_is_better", "is_differentiable", "full_state_update"):
+            raise RuntimeError(f"Can't change const `{name}`.")
+        super().__setattr__(name, value)
+
+    # ------------------------------------------------------------------ misc protocol
+
+    def _filter_kwargs(self, **kwargs: Any) -> Dict[str, Any]:
+        """Filter kwargs so they match the (unwrapped) update signature (metric.py:721-741)."""
+        _params = (inspect.Parameter.VAR_POSITIONAL, inspect.Parameter.VAR_KEYWORD)
+        _sign_params = self._update_signature.parameters
+        filtered_kwargs = {
+            k: v for k, v in kwargs.items() if (k in _sign_params and _sign_params[k].kind not in _params)
+        }
+        exists_var_keyword = any(v.kind == inspect.Parameter.VAR_KEYWORD for v in _sign_params.values())
+        if exists_var_keyword:
+            filtered_kwargs = kwargs
+        return filtered_kwargs
+
+    @property
+    def _update_signature(self) -> inspect.Signature:
+        return inspect.signature(type(self).update)
+
+    @property
+    def metric_state(self) -> Dict[str, StateValue]:
+        """Current value of all registered states."""
+        return {attr: getattr(self, attr) for attr in self._defaults}
+
+    @property
+    def update_called(self) -> bool:
+        return self._update_called
+
+    @property
+    def update_count(self) -> int:
+        return self._update_count
+
+    def __hash__(self) -> int:
+        hash_vals: List[Any] = [self.__class__.__name__]
+        for key in self._defaults:
+            val = getattr(self, key)
+            if isinstance(val, list):
+                # the list object id distinguishes instances even when both are empty
+                hash_vals.append(id(val))
+                hash_vals.extend(id(v) for v in val)
+            else:
+                hash_vals.append(id(val))
+        return hash(tuple(hash_vals))
+
+    def __repr__(self) -> str:
+        return f"{self.__class__.__name__}()"
+
+    def type(self, dst_type: Any) -> "Metric":  # noqa: A003 - parity with reference no-op
+        """No-op (reference metric.py:644-662: precision management is explicit)."""
+        return self
+
+    def float(self) -> "Metric":
+        return self
+
+    def double(self) -> "Metric":
+        return self
+
+    def half(self) -> "Metric":
+        return self
+
+    # ------------------------------------------------------------------ operator overloads → CompositionalMetric
+
+    def __add__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.add, self, other)
+
+    def __radd__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.add, other, self)
+
+    def __sub__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.subtract, self, other)
+
+    def __rsub__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.subtract, other, self)
+
+    def __mul__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.multiply, self, other)
+
+    def __rmul__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.multiply, other, self)
+
+    def __truediv__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.true_divide, self, other)
+
+    def __rtruediv__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.true_divide, other, self)
+
+    def __floordiv__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.floor_divide, self, other)
+
+    def __rfloordiv__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.floor_divide, other, self)
+
+    def __mod__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.mod, self, other)
+
+    def __rmod__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.mod, other, self)
+
+    def __pow__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.power, self, other)
+
+    def __rpow__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.power, other, self)
+
+    def __matmul__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.matmul, self, other)
+
+    def __rmatmul__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.matmul, other, self)
+
+    def __and__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.bitwise_and, self, other)
+
+    def __rand__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.bitwise_and, other, self)
+
+    def __or__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.bitwise_or, self, other)
+
+    def __ror__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.bitwise_or, other, self)
+
+    def __xor__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.bitwise_xor, self, other)
+
+    def __rxor__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.bitwise_xor, other, self)
+
+    def __lt__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.less, self, other)
+
+    def __le__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.less_equal, self, other)
+
+    def __gt__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.greater, self, other)
+
+    def __ge__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.greater_equal, self, other)
+
+    def __eq__(self, other: Any) -> "CompositionalMetric":  # type: ignore[override]
+        return CompositionalMetric(jnp.equal, self, other)
+
+    def __ne__(self, other: Any) -> "CompositionalMetric":  # type: ignore[override]
+        return CompositionalMetric(jnp.not_equal, self, other)
+
+    def __abs__(self) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.abs, self, None)
+
+    def __neg__(self) -> "CompositionalMetric":
+        return CompositionalMetric(_neg, self, None)
+
+    def __pos__(self) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.abs, self, None)
+
+    def __inv__(self) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.bitwise_not, self, None)
+
+    __invert__ = __inv__
+
+    def __getitem__(self, idx: Any) -> "CompositionalMetric":
+        return CompositionalMetric(lambda x: x[idx], self, None)
+
+    def __getnewargs__(self) -> tuple:
+        return ()
+
+
+def _neg(x: Array) -> Array:
+    return -jnp.abs(x)
+
+
+class CompositionalMetric(Metric):
+    """Lazy composition of metrics via an elementwise operator.
+
+    Reference: metric.py:878-978. ``update``/``compute``/``reset``/``persistent``
+    recurse into child metrics; its own ``_sync_dist`` is a no-op (children sync
+    themselves inside their own ``compute``).
+    """
+
+    def __init__(self, operator: Callable, metric_a: Union[Metric, float, Array, None], metric_b: Union[Metric, float, Array, None]) -> None:
+        super().__init__()
+        self.op = operator
+        self.metric_a = jnp.asarray(metric_a) if isinstance(metric_a, (int, float, np.ndarray)) and metric_a is not None and not isinstance(metric_a, bool) else metric_a
+        self.metric_b = jnp.asarray(metric_b) if isinstance(metric_b, (int, float, np.ndarray)) and metric_b is not None and not isinstance(metric_b, bool) else metric_b
+
+    def _sync_dist(self, dist_sync_fn: Optional[Callable] = None, process_group: Optional[Any] = None) -> None:
+        pass  # No syncing required: children sync themselves.
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        if isinstance(self.metric_a, Metric):
+            self.metric_a.update(*args, **self.metric_a._filter_kwargs(**kwargs))
+        if isinstance(self.metric_b, Metric):
+            self.metric_b.update(*args, **self.metric_b._filter_kwargs(**kwargs))
+
+    def compute(self) -> Any:
+        # also some parsing for kwargs?
+        val_a = self.metric_a.compute() if isinstance(self.metric_a, Metric) else self.metric_a
+        val_b = self.metric_b.compute() if isinstance(self.metric_b, Metric) else self.metric_b
+        if val_b is None:
+            return self.op(val_a)
+        return self.op(val_a, val_b)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        val_a = (
+            self.metric_a(*args, **self.metric_a._filter_kwargs(**kwargs)) if isinstance(self.metric_a, Metric) else self.metric_a
+        )
+        val_b = (
+            self.metric_b(*args, **self.metric_b._filter_kwargs(**kwargs)) if isinstance(self.metric_b, Metric) else self.metric_b
+        )
+        if val_a is None:
+            self._forward_cache = None
+            return self._forward_cache
+        if val_b is None:
+            if isinstance(self.metric_b, Metric):
+                self._forward_cache = None
+                return self._forward_cache
+            self._forward_cache = self.op(val_a)
+            return self._forward_cache
+        self._forward_cache = self.op(val_a, val_b)
+        return self._forward_cache
+
+    def reset(self) -> None:
+        if isinstance(self.metric_a, Metric):
+            self.metric_a.reset()
+        if isinstance(self.metric_b, Metric):
+            self.metric_b.reset()
+
+    def persistent(self, mode: bool = False) -> None:
+        if isinstance(self.metric_a, Metric):
+            self.metric_a.persistent(mode=mode)
+        if isinstance(self.metric_b, Metric):
+            self.metric_b.persistent(mode=mode)
+
+    def __repr__(self) -> str:
+        _op_metrics = f"(\n  {self.op.__name__ if hasattr(self.op, '__name__') else self.op}(\n    {self.metric_a!r},\n    {self.metric_b!r}\n  )\n)"
+        return self.__class__.__name__ + _op_metrics
+
+    def _wrap_compute(self, compute: Callable) -> Callable:
+        return compute

@@ -1,0 +1,10 @@
+"""Distributed / mesh-parallel machinery (SURVEY §2.3, §2.6).
+
+The reference's parallelism surface is data parallelism: replica-local accumulation +
+collective merge at compute. Here that maps onto ``jax.sharding.Mesh`` axes; metric
+updates run inside ``shard_map``/``pjit`` and sync with XLA collectives over ICI/DCN.
+"""
+
+from metrics_tpu.parallel.sync import in_trace, reduce_in_trace
+
+__all__ = ["in_trace", "reduce_in_trace"]

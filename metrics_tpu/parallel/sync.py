@@ -1,0 +1,61 @@
+"""Mesh-aware state synchronisation — the TPU-native distributed backend.
+
+Reference parity: src/torchmetrics/metric.py:365-395 (``_sync_dist``) +
+src/torchmetrics/utilities/distributed.py:99-148 (``gather_all_tensors``). The reference
+has exactly one collective (all_gather) and reduces the gathered stack in Python.
+
+TPU-native redesign (SURVEY §2.3/§5.8): reducible states never gather — ``sum/mean/max/
+min`` lower directly to ``lax.psum/pmax/pmin`` over named mesh axes (strictly less ICI
+traffic than gather-then-reduce: O(state) vs O(world·state)). Only ``cat``/``None``
+states all_gather. Three execution contexts, one API:
+
+- **in-trace** (inside ``shard_map``/``pjit`` over a Mesh): ``sync_state(state, specs,
+  axis_name='dp')`` emits XLA collectives; this is how metric state fuses into a
+  training step.
+- **host, single-controller**: states computed from globally-sharded arrays are already
+  global — sync is the identity.
+- **host, multi-controller**: falls back to process-level gather
+  (:func:`metrics_tpu.utils.distributed.gather_all_tensors`) + reduction, mirroring the
+  reference protocol (incl. ragged pad-to-max).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import Array
+
+AxisName = Union[str, Tuple[str, ...]]
+
+# Reduction registry: maps dist_reduce_fx names to (in-trace collective, host-side stack reduce)
+_TRACE_REDUCERS: Dict[str, Callable[[Array, AxisName], Array]] = {
+    "sum": lambda x, ax: lax.psum(x, ax),
+    "mean": lambda x, ax: lax.pmean(x, ax),
+    "max": lambda x, ax: lax.pmax(x, ax),
+    "min": lambda x, ax: lax.pmin(x, ax),
+}
+
+
+def reduce_in_trace(x: Array, reduce_fx: Optional[str], axis_name: AxisName) -> Array:
+    """Apply one state reduction as an XLA collective over ``axis_name``.
+
+    ``cat``/``None`` → ``all_gather`` (tiled for cat: shards concatenate along dim 0,
+    matching the reference's dim-0 cat of the gathered list).
+    """
+    if reduce_fx in _TRACE_REDUCERS:
+        return _TRACE_REDUCERS[reduce_fx](x, axis_name)
+    if reduce_fx == "cat":
+        return lax.all_gather(x, axis_name, axis=0, tiled=True)
+    if reduce_fx is None:
+        return lax.all_gather(x, axis_name, axis=0)  # stack: (world, ...)
+    if callable(reduce_fx):
+        gathered = lax.all_gather(x, axis_name, axis=0)
+        return reduce_fx(gathered)
+    raise ValueError(f"Unsupported dist_reduce_fx inside trace: {reduce_fx!r}")
+
+
+def in_trace(x: Any) -> bool:
+    return isinstance(x, jax.core.Tracer)

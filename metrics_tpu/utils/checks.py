@@ -1,0 +1,273 @@
+"""Input validation helpers.
+
+Reference parity: src/torchmetrics/utilities/checks.py (751 LoC). The reference's checks
+freely branch on tensor *values* (e.g. "preds must be in [0,1]"). Under XLA that is only
+possible on concrete (non-traced) arrays, so every value-dependent check here goes through
+:func:`_value_check_possible` and silently no-ops when the input is a tracer — the exact
+analogue of the reference's ``validate_args=False`` escape hatch, applied automatically
+inside jit. Shape/dtype checks are trace-safe and always run.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.data import _flatten, select_topk, to_onehot
+from metrics_tpu.utils.enums import DataType
+
+
+def is_tracer(x: Any) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def _value_check_possible(*arrays: Any) -> bool:
+    """True if all inputs are concrete (value-dependent validation may run)."""
+    return not any(is_tracer(a) for a in arrays)
+
+
+def _check_same_shape(preds: Array, target: Array) -> None:
+    """Raise if shapes differ (trace-safe; shapes are static under XLA)."""
+    if preds.shape != target.shape:
+        raise RuntimeError(
+            f"Predictions and targets are expected to have the same shape, but got {preds.shape} and {target.shape}."
+        )
+
+
+def _basic_input_validation(preds: Array, target: Array, threshold: float, ignore_index: Optional[int]) -> None:
+    """Basic cross-metric validation (reference checks.py:26-60)."""
+    if _value_check_possible(target) and jnp.issubdtype(target.dtype, jnp.floating):
+        raise ValueError("The `target` has to be an integer tensor.")
+
+    if _value_check_possible(target):
+        unique_values = jnp.unique(target)
+        if ignore_index is None:
+            check = jnp.any((unique_values != 0) & (unique_values != 1) & (unique_values < 0))
+        else:
+            check = jnp.any((unique_values != 0) & (unique_values != 1) & (unique_values != ignore_index) & (unique_values < 0))
+        if bool(check):
+            raise ValueError("The `target` has to be a non-negative tensor.")
+
+    preds_float = jnp.issubdtype(preds.dtype, jnp.floating)
+    if _value_check_possible(preds) and not preds_float and bool(jnp.any((preds != 0) & (preds != 1))):
+        raise ValueError("If `preds` are integers, they have to be 0s and 1s.")
+
+    if not 0 < threshold < 1:
+        raise ValueError(f"The `threshold` should be a float in the (0,1) interval, got {threshold}")
+
+
+def _check_shape_and_type_consistency(preds: Array, target: Array) -> Tuple[DataType, int]:
+    """Classify the input pair as BINARY / MULTICLASS / MULTILABEL / MULTIDIM_MULTICLASS.
+
+    Reference: checks.py:63-120. Shape-only logic → fully trace-safe.
+    """
+    preds_float = jnp.issubdtype(preds.dtype, jnp.floating)
+
+    if preds.ndim == target.ndim:
+        if preds.shape != target.shape:
+            raise ValueError("The `preds` and `target` should have the same shape, got different shapes.")
+        if preds_float and _value_check_possible(target) and int(jnp.max(target, initial=0)) > 1:
+            raise ValueError("If `preds` and `target` are of shape (N, ...) and `preds` are floats, `target` should be binary.")
+        if preds.ndim == 1:
+            case = DataType.BINARY
+        else:
+            case = DataType.MULTILABEL if preds_float else DataType.MULTIDIM_MULTICLASS
+        implied_classes = preds.shape[1] if preds.ndim > 1 else 1
+    elif preds.ndim == target.ndim + 1:
+        if not preds_float:
+            raise ValueError("If `preds` have one dimension more than `target`, `preds` should be a float tensor.")
+        if preds.shape[2:] != target.shape[1:]:
+            raise ValueError("If `preds` have one dimension more than `target`, the shape must be (N, C, ...).")
+        implied_classes = preds.shape[1]
+        case = DataType.MULTICLASS if preds.ndim == 2 else DataType.MULTIDIM_MULTICLASS
+    else:
+        raise ValueError("Either `preds` and `target` both should have the (same) shape (N, ...), or `target` (N, ...) and `preds` (N, C, ...).")
+    return case, implied_classes
+
+
+def _check_classification_inputs(
+    preds: Array,
+    target: Array,
+    threshold: float,
+    num_classes: Optional[int],
+    multiclass: Optional[bool],
+    top_k: Optional[int],
+    ignore_index: Optional[int] = None,
+) -> DataType:
+    """Full legacy-input validation (reference checks.py:123-…, abbreviated to the
+    shape/type machine; value checks only run on concrete arrays)."""
+    _basic_input_validation(preds, target, threshold, ignore_index)
+    case, implied_classes = _check_shape_and_type_consistency(preds, target)
+    if num_classes is not None and case != DataType.BINARY and num_classes != implied_classes and preds.ndim != target.ndim:
+        raise ValueError(f"num_classes={num_classes} does not match implied classes {implied_classes}")
+    if top_k is not None and case not in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS) and not (
+        case == DataType.MULTILABEL and top_k == 1
+    ):
+        if top_k != 1:
+            raise ValueError("You can only use `top_k` with multiclass inputs.")
+    return case
+
+
+def _input_squeeze(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Remove excess (size-1 trailing batch) dimensions (reference checks.py)."""
+    if preds.shape[0] == 1:
+        preds = preds.reshape(1, -1) if preds.ndim > 1 and preds.shape[1] > 1 else preds.reshape(1, -1)
+        target = target.reshape(1, -1)
+    else:
+        preds, target = preds.squeeze(), target.squeeze()
+    return preds, target
+
+
+def _input_format_classification(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, DataType]:
+    """Legacy formatter: any valid input pair → ``(N, C)``/``(N, C, X)`` binary tensors.
+
+    Reference: checks.py ``_input_format_classification``. Used by the legacy-style
+    metrics (e.g. Dice). Returns int arrays of 0/1 plus the detected mode.
+    """
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if preds.ndim == 0:
+        preds = preds.reshape(1)
+    if target.ndim == 0:
+        target = target.reshape(1)
+    case = _check_classification_inputs(
+        preds, target, threshold=threshold, num_classes=num_classes, multiclass=multiclass, top_k=top_k,
+        ignore_index=ignore_index,
+    )
+    preds_float = jnp.issubdtype(preds.dtype, jnp.floating)
+    top_k = top_k if top_k else 1
+
+    if case in (DataType.BINARY, DataType.MULTILABEL) and not top_k > 1:
+        if preds_float:
+            # logits → probs
+            if _value_check_possible(preds) and bool(jnp.any((preds < 0) | (preds > 1))):
+                preds = jax.nn.sigmoid(preds)
+            preds = (preds >= threshold).astype(jnp.int32)
+        else:
+            preds = preds.astype(jnp.int32)
+        preds = preds.reshape(preds.shape[0], -1)
+        target = target.reshape(target.shape[0], -1).astype(jnp.int32)
+        if multiclass:
+            target = to_onehot(target.reshape(-1), 2).reshape(target.shape[0] * target.shape[1], 2) if case == DataType.BINARY else target
+    elif case == DataType.MULTICLASS or (case == DataType.MULTIDIM_MULTICLASS) or top_k > 1:
+        nc = num_classes
+        if nc is None:
+            if preds.ndim == target.ndim + 1:
+                nc = preds.shape[1]
+            else:
+                if not _value_check_possible(preds, target):
+                    raise ValueError("num_classes must be given explicitly inside jit")
+                nc = int(max(int(jnp.max(preds, initial=0)), int(jnp.max(target, initial=0)))) + 1
+        if preds.ndim == target.ndim + 1:  # probs/logits
+            axes = (0, 1) + tuple(range(2, preds.ndim))
+            preds = select_topk(preds, top_k, dim=1)
+        else:
+            preds = to_onehot(preds.astype(jnp.int32), nc)
+        target = to_onehot(target.astype(jnp.int32), nc)
+        preds = preds.reshape(preds.shape[0], preds.shape[1], -1).reshape(preds.shape[0], -1) if preds.ndim > 2 and case != DataType.MULTIDIM_MULTICLASS else preds
+        # flatten extra dims into (N, C, X) → (N*X, C)
+        if preds.ndim > 2:
+            preds = jnp.moveaxis(preds, 1, -1).reshape(-1, nc)
+            target = jnp.moveaxis(target, 1, -1).reshape(-1, nc)
+        preds = preds.reshape(-1, nc).astype(jnp.int32)
+        target = target.reshape(-1, nc).astype(jnp.int32)
+    else:
+        raise ValueError(f"Unsupported input case {case}")
+    return preds, target, case
+
+
+def _check_retrieval_shape(indexes: Array, preds: Array, target: Array) -> None:
+    if indexes.shape != preds.shape or target.shape != preds.shape:
+        raise IndexError("`indexes`, `preds` and `target` must be of the same shape")
+
+
+def _check_retrieval_inputs(
+    indexes: Array,
+    preds: Array,
+    target: Array,
+    allow_non_binary_target: bool = False,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Array]:
+    """Check and format retrieval inputs (reference checks.py _check_retrieval_inputs)."""
+    if indexes.shape == () or preds.shape == () or target.shape == ():
+        raise ValueError("`indexes`, `preds` and `target` must be non-empty and non-scalar tensors")
+    _check_retrieval_shape(indexes, preds, target)
+    if not jnp.issubdtype(indexes.dtype, jnp.integer):
+        raise ValueError("`indexes` must be a tensor of long integers")
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        raise ValueError("`preds` must be a tensor of floats")
+    if not jnp.issubdtype(target.dtype, jnp.integer) and not jnp.issubdtype(target.dtype, jnp.bool_):
+        raise ValueError("`target` must be a tensor of booleans or integers")
+    if ignore_index is not None and _value_check_possible(target):
+        valid = target != ignore_index
+        indexes, preds, target = indexes[valid], preds[valid], target[valid]
+    if not allow_non_binary_target and _value_check_possible(target) and bool(jnp.any((jnp.asarray(target) > 1) | (jnp.asarray(target) < 0))):
+        raise ValueError("`target` must contain `binary` values")
+    return indexes.reshape(-1).astype(jnp.int64), preds.reshape(-1).astype(jnp.float32), target.reshape(-1)
+
+
+def _allclose_recursive(res1: Any, res2: Any, atol: float = 1e-8) -> bool:
+    """Recursive allclose over nested list/tuple/dict of arrays (reference checks.py)."""
+    if isinstance(res1, (list, tuple)):
+        return all(_allclose_recursive(r1, r2, atol) for r1, r2 in zip(res1, res2))
+    if isinstance(res1, dict):
+        return all(_allclose_recursive(res1[k], res2[k], atol) for k in res1)
+    return bool(jnp.allclose(jnp.asarray(res1), jnp.asarray(res2), atol=atol))
+
+
+def check_forward_full_state_property(
+    metric_class,
+    init_args: Optional[dict] = None,
+    input_args: Optional[dict] = None,
+    num_update_to_compare: Sequence[int] = (10, 100, 1000),
+    reps: int = 5,
+) -> None:
+    """Time full-state vs reduced-state ``forward`` (reference checks.py:626-714)."""
+    import time
+
+    init_args = init_args or {}
+    input_args = input_args or {}
+
+    class FullState(metric_class):
+        full_state_update = True
+
+    class PartState(metric_class):
+        full_state_update = False
+
+    fullstate = FullState(**init_args)
+    partstate = PartState(**init_args)
+
+    equal = True
+    for _ in range(max(num_update_to_compare)):
+        out1 = fullstate(**input_args)
+        out2 = partstate(**input_args)
+        equal = equal and _allclose_recursive(out1, out2)
+    res1 = fullstate.compute()
+    res2 = partstate.compute()
+    equal = equal and _allclose_recursive(res1, res2)
+    mean_full, mean_part = [], []
+    for metric in (FullState, PartState):
+        out = mean_full if metric is FullState else mean_part
+        for num in num_update_to_compare:
+            m = metric(**init_args)
+            start = time.perf_counter()
+            for _ in range(reps):
+                for _ in range(num):
+                    m(**input_args)
+                m.reset()
+            out.append((time.perf_counter() - start) / reps)
+    faster = sum(mean_part) < sum(mean_full)
+    print(f"Output equal: {equal}; partial-state faster: {faster}")
+    if equal and faster:
+        print(f"Recommended: set `full_state_update=False` on {metric_class.__name__}")

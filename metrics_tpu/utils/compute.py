@@ -1,0 +1,87 @@
+"""Numerically-safe compute helpers.
+
+Reference parity: src/torchmetrics/utilities/compute.py (``_safe_matmul`` :22,
+``_safe_xlogy`` :32, ``_safe_divide`` :47, trapezoidal ``auc`` :84,103).
+
+TPU notes: matmuls route to the MXU; on TPU bf16 inputs are upcast to f32 for
+accumulation rather than the reference's fp16→fp32 dance. All helpers are jittable
+(no data-dependent Python control flow).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+
+def _safe_matmul(x: Array, y: Array) -> Array:
+    """Matmul that upcasts half-precision inputs so accumulation happens in f32."""
+    if x.dtype in (jnp.float16, jnp.bfloat16) or y.dtype in (jnp.float16, jnp.bfloat16):
+        return (x.astype(jnp.float32) @ y.astype(jnp.float32)).astype(x.dtype)
+    return x @ y
+
+
+def _safe_xlogy(x: Array, y: Array) -> Array:
+    """``x * log(y)`` that is 0 when ``x == 0`` (even if y==0 → log = -inf)."""
+    res = x * jnp.log(y)
+    return jnp.where(x == 0.0, jnp.zeros((), dtype=res.dtype), res)
+
+
+def _safe_divide(num: Array, denom: Array, zero_division: float = 0.0) -> Array:
+    """Element-wise division that returns ``zero_division`` where ``denom == 0``.
+
+    Mirrors reference semantics (denominator replaced before dividing so no NaN/Inf is
+    ever produced — important under jit where NaNs propagate silently).
+    """
+    num = num if jnp.issubdtype(jnp.asarray(num).dtype, jnp.floating) else jnp.asarray(num, dtype=jnp.float32)
+    denom = denom if jnp.issubdtype(jnp.asarray(denom).dtype, jnp.floating) else jnp.asarray(denom, dtype=jnp.float32)
+    zero = jnp.asarray(denom) == 0
+    res = num / jnp.where(zero, jnp.ones((), dtype=jnp.asarray(denom).dtype), denom)
+    return jnp.where(zero, jnp.asarray(zero_division, dtype=res.dtype), res)
+
+
+def _adjust_weights_safe_divide(
+    score: Array, average: Optional[str], multilabel: bool, tp: Array, fp: Array, fn: Array
+) -> Array:
+    """Weighted / macro / none averaging of per-class scores (reference: compute.py)."""
+    if average is None or average == "none":
+        return score
+    if average == "weighted":
+        weights = tp + fn
+    else:
+        weights = jnp.ones_like(score)
+        if not multilabel:
+            weights = jnp.where(tp + fp + fn == 0, jnp.zeros_like(weights), weights)
+    weights = weights.astype(jnp.float32)
+    return jnp.sum(_safe_divide(weights, jnp.sum(weights, axis=-1, keepdims=True)) * score, axis=-1)
+
+
+def _auc_compute_without_check(x: Array, y: Array, direction: float, axis: int = -1) -> Array:
+    """Trapezoidal area under the curve; ``direction`` flips sign for descending x."""
+    dx = jnp.diff(x, axis=axis)
+    y_avg = (jnp.take(y, jnp.arange(1, y.shape[axis]), axis=axis) + jnp.take(y, jnp.arange(0, y.shape[axis] - 1), axis=axis)) / 2.0
+    return jnp.sum(dx * y_avg, axis=axis) * direction
+
+
+def _auc_compute(x: Array, y: Array, reorder: bool = False) -> Array:
+    if reorder:
+        order = jnp.argsort(x)
+        x = x[order]
+        y = y[order]
+    # Direction is data-dependent; resolve it with jnp.where so the fn stays jittable.
+    dx = jnp.diff(x)
+    direction = jnp.where(jnp.all(dx <= 0), -1.0, 1.0)
+    return _auc_compute_without_check(x, y, direction)
+
+
+def auc(x: Array, y: Array, reorder: bool = False) -> Array:
+    """Area under the curve via the trapezoidal rule (reference compute.py:84)."""
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    if x.ndim != 1 or y.ndim != 1:
+        raise ValueError(f"Expected 1-d x and y, got {x.ndim}-d and {y.ndim}-d")
+    if x.shape[0] != y.shape[0]:
+        raise ValueError("x and y must have the same length")
+    return _auc_compute(x, y, reorder=reorder)

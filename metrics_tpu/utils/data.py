@@ -1,0 +1,174 @@
+"""Data manipulation utilities.
+
+Reference parity: src/torchmetrics/utilities/data.py — ``dim_zero_{cat,sum,mean,max,min}``
+(:24-50), ``_flatten``/``_flatten_dict``, ``to_onehot``, ``select_topk``, ``to_categorical``,
+``apply_to_collection`` (:148-195), ``_squeeze_if_scalar``, ``_bincount`` (:206-228, with its
+XLA/deterministic fallback — natively fine here: ``jnp.bincount(length=n)`` is static-shape),
+``_flexible_bincount``, ``allclose``.
+
+TPU notes: ``_bincount`` additionally offers a one-hot matmul path that maps the histogram
+onto the MXU — useful when counting into few buckets from large inputs.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Mapping, Sequence
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+METRIC_EPS = 1e-6
+
+
+def dim_zero_cat(x: Union[Array, List[Array]]) -> Array:
+    """Concatenate a (list of) array(s) along dim 0."""
+    if isinstance(x, (jax.Array, np.ndarray)):
+        return jnp.asarray(x)
+    if not x:  # empty list
+        raise ValueError("No samples to concatenate")
+    x = [jnp.atleast_1d(jnp.asarray(y)) for y in x]
+    return jnp.concatenate(x, axis=0)
+
+
+def dim_zero_sum(x: Array) -> Array:
+    return jnp.sum(x, axis=0)
+
+
+def dim_zero_mean(x: Array) -> Array:
+    return jnp.mean(x, axis=0)
+
+
+def dim_zero_max(x: Array) -> Array:
+    return jnp.max(x, axis=0)
+
+
+def dim_zero_min(x: Array) -> Array:
+    return jnp.min(x, axis=0)
+
+
+def _flatten(x: Sequence) -> list:
+    """Flatten list of lists into a single list."""
+    return [item for sublist in x for item in sublist]
+
+
+def _flatten_dict(x: Dict) -> Tuple[Dict, bool]:
+    """Flatten dict-of-dicts one level; returns (flat, was_flattened)."""
+    new_dict = {}
+    duplicates = False
+    for key, value in x.items():
+        if isinstance(value, dict):
+            for k, v in value.items():
+                if k in new_dict:
+                    duplicates = True
+                new_dict[k] = v
+        else:
+            if key in new_dict:
+                duplicates = True
+            new_dict[key] = value
+    return new_dict, duplicates
+
+
+def to_onehot(label_tensor: Array, num_classes: int) -> Array:
+    """Convert dense label tensor ``(N, ...)`` → one-hot ``(N, C, ...)``.
+
+    Reference: data.py ``to_onehot``. Static-shape friendly: `num_classes` must be a
+    Python int (XLA constraint, same as the reference's explicit arg).
+    """
+    onehot = jax.nn.one_hot(label_tensor, num_classes, dtype=jnp.int64 if label_tensor.dtype == jnp.int64 else jnp.int32)
+    # one_hot appends the class dim last; reference puts it at dim 1.
+    return jnp.moveaxis(onehot, -1, 1)
+
+
+def select_topk(prob_tensor: Array, topk: int = 1, dim: int = 1) -> Array:
+    """Binary mask of the top-k entries along ``dim`` (reference data.py select_topk)."""
+    if topk == 1:  # cheap argmax path
+        idx = jnp.argmax(prob_tensor, axis=dim, keepdims=True)
+        mask = jnp.zeros_like(prob_tensor, dtype=jnp.int32)
+        return jnp.put_along_axis(mask, idx, 1, axis=dim, inplace=False)
+    _, idx = jax.lax.top_k(jnp.moveaxis(prob_tensor, dim, -1), topk)
+    mask = jnp.zeros(jnp.moveaxis(prob_tensor, dim, -1).shape, dtype=jnp.int32)
+    mask = jnp.put_along_axis(mask, idx, 1, axis=-1, inplace=False)
+    return jnp.moveaxis(mask, -1, dim)
+
+
+def to_categorical(x: Array, argmax_dim: int = 1) -> Array:
+    """Probabilities/logits → dense labels via argmax (reference data.py to_categorical)."""
+    return jnp.argmax(x, axis=argmax_dim)
+
+
+def apply_to_collection(
+    data: Any,
+    dtype: Union[type, tuple],
+    function: Callable,
+    *args: Any,
+    wrong_dtype: Optional[Union[type, tuple]] = None,
+    **kwargs: Any,
+) -> Any:
+    """Recursively apply ``function`` to all elements of type ``dtype``.
+
+    Reference: data.py:148-195. Supports Mapping, NamedTuple, Sequence.
+    """
+    elem_type = type(data)
+    if isinstance(data, dtype) and (wrong_dtype is None or not isinstance(data, wrong_dtype)):
+        return function(data, *args, **kwargs)
+    if isinstance(data, Mapping):
+        return elem_type({k: apply_to_collection(v, dtype, function, *args, wrong_dtype=wrong_dtype, **kwargs) for k, v in data.items()})
+    if isinstance(data, tuple) and hasattr(data, "_fields"):  # namedtuple
+        return elem_type(*(apply_to_collection(d, dtype, function, *args, wrong_dtype=wrong_dtype, **kwargs) for d in data))
+    if isinstance(data, Sequence) and not isinstance(data, str):
+        return elem_type([apply_to_collection(d, dtype, function, *args, wrong_dtype=wrong_dtype, **kwargs) for d in data])
+    return data
+
+
+def _squeeze_scalar_element_tensor(x: Array) -> Array:
+    return x.squeeze() if x.size == 1 else x
+
+
+def _squeeze_if_scalar(data: Any) -> Any:
+    return apply_to_collection(data, jax.Array, _squeeze_scalar_element_tensor)
+
+
+def _bincount(x: Array, minlength: int) -> Array:
+    """Count occurrences of each value in ``x`` (ints in [0, minlength)).
+
+    Reference: data.py:206-228 — there, a fallback loop exists because
+    ``torch.bincount`` is non-deterministic on CUDA and unsupported on XLA.
+    Here ``jnp.bincount(length=n)`` is static-shape, deterministic and natively
+    lowered by XLA (scatter-add), so no fallback is needed.
+    """
+    return jnp.bincount(x.reshape(-1), length=minlength)
+
+
+def _bincount_matmul(x: Array, minlength: int) -> Array:
+    """One-hot × ones matmul histogram — rides the MXU for large x, few buckets."""
+    oh = jax.nn.one_hot(x.reshape(-1), minlength, dtype=jnp.float32)
+    return jnp.sum(oh, axis=0).astype(jnp.int32)
+
+
+def _flexible_bincount(x: Array) -> Array:
+    """Bincount over the *unique* values of ``x`` (reference _flexible_bincount).
+
+    Data-dependent output shape → host-side only (used by retrieval compute, which is
+    host-orchestrated over list states, like the reference).
+    """
+    x = x - jnp.min(x)
+    unique_x = jnp.unique(x)
+    counts = _bincount(x, minlength=int(jnp.max(x)) + 1)
+    return counts[unique_x]
+
+
+def allclose(t1: Array, t2: Array, atol: float = 1e-8, rtol: float = 1e-5) -> bool:
+    """dtype-robust allclose (reference data.py allclose)."""
+    t1 = jnp.asarray(t1)
+    t2 = jnp.asarray(t2)
+    if t1.dtype != t2.dtype:
+        t2 = t2.astype(t1.dtype)
+    return bool(jnp.allclose(t1, t2, atol=atol, rtol=rtol))
+
+
+def _cumsum(x: Array, axis: int = 0) -> Array:
+    """Deterministic cumsum (reference works around CUDA nondeterminism; XLA is fine)."""
+    return jnp.cumsum(x, axis=axis)

@@ -1,0 +1,103 @@
+"""Reductions + cross-process gather.
+
+Reference parity: src/torchmetrics/utilities/distributed.py — ``reduce`` (:22),
+``class_reduce`` (:44), ``gather_all_tensors`` (:93-148, incl. the pad-to-max protocol
+for uneven shapes at :136-148).
+
+TPU-native redesign (SURVEY §2.3): the reference's one collective (all_gather over
+torch.distributed, reduce afterwards in Python) becomes, in order of preference:
+
+1. *No collective at all* — in single-controller JAX, an update running on a globally
+   sharded ``jax.Array`` already produces the global state (XLA inserts the psum).
+2. ``jax.lax.psum/pmax/pmin/all_gather`` over a named mesh axis, when metric update/
+   compute run *inside* ``shard_map`` (see :mod:`metrics_tpu.parallel.sync`).
+3. Host-level gather across processes for multi-controller jobs — implemented here with
+   the same pad-to-max + trim protocol as the reference for ragged states.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.utils.compute import _safe_divide
+
+
+def reduce(x: Array, reduction: Optional[str]) -> Array:
+    """Reduce a tensor: 'elementwise_mean' | 'sum' | 'none'/None (reference :22)."""
+    if reduction == "elementwise_mean":
+        return jnp.mean(x)
+    if reduction == "sum":
+        return jnp.sum(x)
+    if reduction is None or reduction == "none":
+        return x
+    raise ValueError("Reduction parameter unknown.")
+
+
+def class_reduce(num: Array, denom: Array, weights: Array, class_reduction: str = "none") -> Array:
+    """Per-class fraction reduction: micro/macro/weighted/none with NaN→0 guard.
+
+    Reference: distributed.py:44-90.
+    """
+    valid_reduction = ("micro", "macro", "weighted", "none", None)
+    fraction = _safe_divide(jnp.sum(num), jnp.sum(denom)) if class_reduction == "micro" else _safe_divide(num, denom)
+
+    if class_reduction == "micro":
+        return fraction
+    if class_reduction == "macro":
+        return jnp.mean(fraction)
+    if class_reduction == "weighted":
+        return jnp.sum(fraction * _safe_divide(weights, jnp.sum(weights)))
+    if class_reduction == "none" or class_reduction is None:
+        return fraction
+    raise ValueError(f"Reduction parameter {class_reduction} unknown. Choose between one of these: {valid_reduction}")
+
+
+def distributed_available() -> bool:
+    """Multi-controller JAX job? (reference: torch.distributed.is_initialized)."""
+    try:
+        return jax.process_count() > 1
+    except Exception:
+        return False
+
+
+def gather_all_tensors(result: Array, group: Optional[Any] = None) -> List[Array]:
+    """Gather a tensor from every process into a list (reference :93-148).
+
+    Cross-process host-level gather for multi-controller JAX. Handles uneven first-dim
+    shapes with the reference's pad-to-max + trim protocol. On a single process this is
+    a cheap identity wrap.
+    """
+    if not distributed_available():
+        return [jnp.asarray(result)]
+
+    from jax.experimental import multihost_utils
+
+    result = jnp.asarray(result)
+    world = jax.process_count()
+    # gather shapes first (same protocol as reference :126-142)
+    local_shape = np.asarray(result.shape, dtype=np.int64) if result.ndim else np.zeros((0,), np.int64)
+    all_shapes = multihost_utils.process_allgather(local_shape)  # (world, ndim)
+    all_shapes = [tuple(int(d) for d in s) for s in np.asarray(all_shapes)]
+    if all(s == all_shapes[0] for s in all_shapes):
+        gathered = multihost_utils.process_allgather(result)  # (world, ...)
+        return [jnp.asarray(gathered[i]) for i in range(world)]
+    # uneven: pad to max along every dim, gather, trim
+    max_shape = tuple(max(s[d] for s in all_shapes) for d in range(len(all_shapes[0])))
+    pad = [(0, m - s) for m, s in zip(max_shape, result.shape)]
+    padded = jnp.pad(result, pad)
+    gathered = multihost_utils.process_allgather(padded)
+    out = []
+    for i in range(world):
+        slices = tuple(slice(0, d) for d in all_shapes[i])
+        out.append(jnp.asarray(gathered[i])[slices])
+    return out
+
+
+def default_dist_sync_fn(result: Array, group: Optional[Any] = None) -> List[Array]:
+    """The default ``dist_sync_fn`` used by :class:`metrics_tpu.Metric`."""
+    return gather_all_tensors(result, group)

@@ -1,0 +1,69 @@
+"""String enums used across the package.
+
+Reference parity: src/torchmetrics/utilities/enums.py (EnumStr base :18, DataType :48,
+AverageMethod :61, MDMCAverageMethod :79). Behaviour preserved: case-insensitive
+``from_str`` lookup with '-'/'_' normalisation.
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+from typing import Optional
+
+
+class EnumStr(str, Enum):
+    """Base class: case-insensitive string enum."""
+
+    @classmethod
+    def from_str(cls, value: str) -> Optional["EnumStr"]:
+        try:
+            return cls[value.replace("-", "_").upper()]
+        except KeyError:
+            return None
+
+    def __eq__(self, other: object) -> bool:
+        if isinstance(other, str):
+            return self.value.lower() == other.lower()
+        return super().__eq__(other)
+
+    def __hash__(self) -> int:
+        return hash(self.value.lower())
+
+
+class DataType(EnumStr):
+    """Classification input type."""
+
+    BINARY = "binary"
+    MULTILABEL = "multi-label"
+    MULTICLASS = "multi-class"
+    MULTIDIM_MULTICLASS = "multi-dim multi-class"
+
+
+class AverageMethod(EnumStr):
+    MICRO = "micro"
+    MACRO = "macro"
+    WEIGHTED = "weighted"
+    NONE = None  # type: ignore[assignment]
+    SAMPLES = "samples"
+
+
+class MDMCAverageMethod(EnumStr):
+    GLOBAL = "global"
+    SAMPLEWISE = "samplewise"
+
+
+class ClassificationTask(EnumStr):
+    """Task kind used by the task-dispatch façades."""
+
+    BINARY = "binary"
+    MULTICLASS = "multiclass"
+    MULTILABEL = "multilabel"
+
+    @classmethod
+    def from_str_or_raise(cls, value: str) -> "ClassificationTask":
+        task = cls.from_str(value)
+        if task is None:
+            raise ValueError(
+                f"Invalid Classification: expected one of ['binary', 'multiclass', 'multilabel'] but got {value}"
+            )
+        return task  # type: ignore[return-value]

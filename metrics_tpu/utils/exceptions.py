@@ -1,0 +1,12 @@
+"""Exception types.
+
+Reference parity: src/torchmetrics/utilities/exceptions.py (TorchMetricsUserError).
+"""
+
+
+class MetricsTPUUserError(Exception):
+    """Error raised for misuse of the metrics API."""
+
+
+# Alias with a generic name used across the package.
+UserError = MetricsTPUUserError

@@ -1,0 +1,38 @@
+"""Optional-dependency availability flags.
+
+Reference parity: src/torchmetrics/utilities/imports.py:20-45. Anything not baked into
+the image is gated here and the dependent metric raises a clear error at construction.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+
+
+def _package_available(name: str) -> bool:
+    try:
+        return importlib.util.find_spec(name) is not None
+    except (ImportError, ModuleNotFoundError, ValueError):
+        return False
+
+
+_TRANSFORMERS_AVAILABLE = _package_available("transformers")
+_SCIPY_AVAILABLE = _package_available("scipy")
+_NLTK_AVAILABLE = _package_available("nltk")
+_JIWER_AVAILABLE = _package_available("jiwer")
+_ROUGE_SCORE_AVAILABLE = _package_available("rouge_score")
+_BERTSCORE_AVAILABLE = _TRANSFORMERS_AVAILABLE
+_SACREBLEU_AVAILABLE = _package_available("sacrebleu")
+_REGEX_AVAILABLE = _package_available("regex")
+_PESQ_AVAILABLE = _package_available("pesq")
+_PYSTOI_AVAILABLE = _package_available("pystoi")
+_FAST_BSS_EVAL_AVAILABLE = _package_available("fast_bss_eval")
+_PYCOCOTOOLS_AVAILABLE = _package_available("pycocotools")
+_TORCHVISION_AVAILABLE = _package_available("torchvision")
+_TORCH_FIDELITY_AVAILABLE = _package_available("torch_fidelity")
+_LPIPS_AVAILABLE = _package_available("lpips")
+_TQDM_AVAILABLE = _package_available("tqdm")
+_MATPLOTLIB_AVAILABLE = _package_available("matplotlib")
+_SKLEARN_AVAILABLE = _package_available("sklearn")
+_FLAX_AVAILABLE = _package_available("flax")
+_TORCH_AVAILABLE = _package_available("torch")

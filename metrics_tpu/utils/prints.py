@@ -1,0 +1,68 @@
+"""Rank-zero-only printing/warning helpers.
+
+Reference parity: src/torchmetrics/utilities/prints.py:22-49 (``rank_zero_only`` keyed on
+the ``LOCAL_RANK`` env var). TPU-native version keys on ``jax.process_index()`` — the
+multi-controller JAX equivalent of a distributed rank — falling back to the env var when
+JAX is not yet initialised.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import warnings
+from functools import partial, wraps
+from typing import Any, Callable
+
+log = logging.getLogger("metrics_tpu")
+
+
+def _rank() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return int(os.environ.get("LOCAL_RANK", 0))
+
+
+def rank_zero_only(fn: Callable) -> Callable:
+    """Run ``fn`` only on process 0 of a multi-process JAX job."""
+
+    @wraps(fn)
+    def wrapped_fn(*args: Any, **kwargs: Any) -> Any:
+        if _rank() == 0:
+            return fn(*args, **kwargs)
+        return None
+
+    return wrapped_fn
+
+
+@rank_zero_only
+def _warn(message: str, *args: Any, **kwargs: Any) -> None:
+    warnings.warn(message, *args, **kwargs)
+
+
+@rank_zero_only
+def _info(message: str, **kwargs: Any) -> None:
+    log.info(message, **kwargs)
+
+
+@rank_zero_only
+def _debug(message: str, **kwargs: Any) -> None:
+    log.debug(message, **kwargs)
+
+
+rank_zero_warn = _warn
+rank_zero_info = _info
+rank_zero_debug = _debug
+
+
+def rank_zero_warn_once(message: str) -> None:
+    _seen = _warn_once_registry
+    if message not in _seen:
+        _seen.add(message)
+        rank_zero_warn(message)
+
+
+_warn_once_registry: set = set()

@@ -1,0 +1,108 @@
+"""Aggregator tests — port of tests/unittests/bases/test_aggregation.py."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import CatMetric, MaxMetric, MeanMetric, MinMetric, SumMetric
+
+
+def compare_mean(values, weights):
+    return np.average(values, weights=weights)
+
+
+def compare_sum(values, weights):
+    return np.sum(values)
+
+
+def compare_min(values, weights):
+    return np.min(values)
+
+
+def compare_max(values, weights):
+    return np.max(values)
+
+
+@pytest.mark.parametrize(
+    "metric_class, compare_fn",
+    [(MinMetric, compare_min), (MaxMetric, compare_max), (SumMetric, compare_sum), (MeanMetric, compare_mean)],
+)
+@pytest.mark.parametrize("case", ["single_scalar", "tensor", "weighted"])
+def test_aggregation(metric_class, compare_fn, case):
+    rng = np.random.default_rng(7)
+    if case == "single_scalar":
+        values = rng.normal(size=(10,)).astype(np.float32)
+        weights = np.ones_like(values)
+        feed = [(float(v), 1.0) for v in values]
+    elif case == "tensor":
+        values = rng.normal(size=(10, 5)).astype(np.float32)
+        weights = np.ones_like(values)
+        feed = [(jnp.asarray(v), jnp.ones(5)) for v in values]
+    else:
+        values = rng.normal(size=(10, 5)).astype(np.float32)
+        weights = rng.uniform(0.5, 2.0, size=(10, 5)).astype(np.float32)
+        feed = [(jnp.asarray(v), jnp.asarray(w)) for v, w in zip(values, weights)]
+
+    metric = metric_class()
+    for v, w in feed:
+        if metric_class is MeanMetric:
+            metric.update(v, w)
+        else:
+            metric.update(v)
+    result = metric.compute()
+    np.testing.assert_allclose(np.asarray(result), compare_fn(values.flatten(), weights.flatten()), rtol=1e-5)
+
+
+def test_cat_metric():
+    m = CatMetric()
+    m.update(jnp.asarray([1.0, 2.0]))
+    m.update(jnp.asarray([3.0]))
+    np.testing.assert_allclose(np.asarray(m.compute()), [1, 2, 3])
+
+
+@pytest.mark.parametrize("nan_strategy", ["error", "warn"])
+def test_nan_error(nan_strategy):
+    metric = MeanMetric(nan_strategy=nan_strategy)
+    if nan_strategy == "error":
+        with pytest.raises(RuntimeError, match="Encountered `nan` values in tensor"):
+            metric.update(jnp.asarray([1.0, float("nan")]))
+    else:
+        with pytest.warns(UserWarning, match="Encountered `nan` values in tensor"):
+            metric.update(jnp.asarray([1.0, float("nan")]))
+        np.testing.assert_allclose(np.asarray(metric.compute()), 1.0)
+
+
+@pytest.mark.parametrize(
+    "metric_class, expected",
+    [
+        (MinMetric, 1.0),
+        (MaxMetric, 5.0),
+        (SumMetric, 6.0),
+        (MeanMetric, 3.0),
+    ],
+)
+def test_nan_ignore(metric_class, expected):
+    metric = metric_class(nan_strategy="ignore")
+    metric.update(jnp.asarray([1.0, float("nan"), 5.0]))
+    np.testing.assert_allclose(np.asarray(metric.compute()), expected)
+
+
+@pytest.mark.parametrize(
+    "metric_class, expected",
+    [
+        (MinMetric, 1.0),
+        (MaxMetric, 5.0),
+        (SumMetric, 8.0),
+        (MeanMetric, 8 / 3),
+    ],
+)
+def test_nan_impute(metric_class, expected):
+    metric = metric_class(nan_strategy=2.0)
+    metric.update(jnp.asarray([1.0, float("nan"), 5.0]))
+    np.testing.assert_allclose(np.asarray(metric.compute()), expected, rtol=1e-6)
+
+
+def test_mean_metric_broadcast_weight():
+    metric = MeanMetric()
+    metric.update(jnp.asarray([1.0, 3.0]), 1.0)
+    np.testing.assert_allclose(np.asarray(metric.compute()), 2.0)

@@ -1,0 +1,138 @@
+"""Metric arithmetic tests — port of tests/unittests/bases/test_composition.py (548 LoC)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import Metric
+from metrics_tpu.metric import CompositionalMetric
+
+
+class DummyMetric(Metric):
+    full_state_update = True
+
+    def __init__(self, val_to_return) -> None:
+        super().__init__()
+        self.add_state("_num_updates", jnp.asarray(0), dist_reduce_fx="sum")
+        self._val_to_return = val_to_return
+
+    def update(self, *args, **kwargs) -> None:
+        self._num_updates = self._num_updates + 1
+
+    def compute(self):
+        return jnp.asarray(self._val_to_return)
+
+
+@pytest.mark.parametrize("second_operand, expected_result", [(2, 4), (2.0, 4.0), (jnp.asarray(2), 4)])
+def test_metrics_add(second_operand, expected_result):
+    first_metric = DummyMetric(2)
+    final_add = first_metric + second_operand
+    final_radd = second_operand + first_metric
+    assert isinstance(final_add, CompositionalMetric)
+    assert isinstance(final_radd, CompositionalMetric)
+    final_add.update()
+    final_radd.update()
+    np.testing.assert_allclose(np.asarray(final_add.compute()), expected_result)
+    np.testing.assert_allclose(np.asarray(final_radd.compute()), expected_result)
+
+
+@pytest.mark.parametrize("second_operand, expected_result", [(2, 1), (2.0, 1.0)])
+def test_metrics_div(second_operand, expected_result):
+    first_metric = DummyMetric(2)
+    final_div = first_metric / second_operand
+    final_rdiv = second_operand / first_metric
+    final_div.update()
+    np.testing.assert_allclose(np.asarray(final_div.compute()), expected_result)
+    np.testing.assert_allclose(np.asarray(final_rdiv.compute()), expected_result)
+
+
+def test_metrics_sub():
+    first_metric = DummyMetric(3)
+    second_metric = DummyMetric(1)
+    final_sub = first_metric - second_metric
+    final_sub.update()
+    assert float(final_sub.compute()) == 2
+
+
+def test_metrics_mul():
+    first_metric = DummyMetric(3)
+    final = first_metric * 4
+    final.update()
+    assert float(final.compute()) == 12
+
+
+@pytest.mark.parametrize("second_operand, expected_result", [(2, 1), (2.0, 1.0)])
+def test_metrics_mod(second_operand, expected_result):
+    first_metric = DummyMetric(5)
+    final_mod = first_metric % second_operand
+    final_mod.update()
+    np.testing.assert_allclose(np.asarray(final_mod.compute()), expected_result)
+
+
+def test_metrics_pow():
+    first_metric = DummyMetric(2)
+    final = first_metric**3
+    final.update()
+    assert float(final.compute()) == 8
+
+
+def test_metrics_floordiv():
+    first_metric = DummyMetric(5)
+    final = first_metric // 2
+    final.update()
+    assert float(final.compute()) == 2
+
+
+def test_metrics_comparison_ops():
+    first_metric = DummyMetric(2)
+    assert bool((first_metric > 1).compute())
+    assert bool((first_metric >= 2).compute())
+    assert bool((first_metric < 3).compute())
+    assert bool((first_metric <= 2).compute())
+    assert bool((first_metric == 2).compute())
+    assert bool((first_metric != 3).compute())
+
+
+def test_metrics_abs_neg():
+    first_metric = DummyMetric(-2)
+    assert float(abs(first_metric).compute()) == 2
+    assert float((-first_metric).compute()) == -2
+
+
+def test_metrics_getitem():
+    first_metric = DummyMetric([1.0, 2.0, 3.0])
+    final = first_metric[1]
+    final.update()
+    assert float(final.compute()) == 2
+
+
+def test_metrics_chained_composition():
+    m1 = DummyMetric(2)
+    m2 = DummyMetric(3)
+    final = (m1 + m2) * 2
+    final.update()
+    assert float(final.compute()) == 10
+
+
+def test_compositional_reset():
+    m = DummyMetric(2)
+    final = m + 1
+    final.update()
+    assert int(m._num_updates) == 1
+    final.reset()
+    assert int(m._num_updates) == 0
+
+
+def test_compositional_forward():
+    m1 = DummyMetric(2)
+    m2 = DummyMetric(3)
+    final = m1 + m2
+    val = final()
+    assert float(np.asarray(val)) == 5.0
+
+
+def test_metrics_matmul():
+    first_metric = DummyMetric([1.0, 2.0, 3.0])
+    final = first_metric @ jnp.asarray([1.0, 1.0, 1.0])
+    final.update()
+    assert float(final.compute()) == 6.0

