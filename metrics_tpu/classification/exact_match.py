@@ -1,0 +1,134 @@
+"""Exact match module metrics (reference src/torchmetrics/classification/exact_match.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.classification.exact_match import _exact_match_reduce
+from metrics_tpu.functional.classification.stat_scores import (
+    _ignore_mask,
+    _multiclass_stat_scores_arg_validation,
+    _multiclass_stat_scores_format,
+    _multiclass_stat_scores_tensor_validation,
+    _multilabel_stat_scores_arg_validation,
+    _multilabel_stat_scores_format,
+    _multilabel_stat_scores_tensor_validation,
+)
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.data import dim_zero_cat
+from metrics_tpu.utils.enums import ClassificationTask
+
+
+class _AbstractExactMatch(Metric):
+    def _create_state(self, multidim_average: str) -> None:
+        if multidim_average == "samplewise":
+            self.add_state("correct", [], dist_reduce_fx="cat")
+            self.add_state("total", jnp.zeros((), dtype=jnp.float32), dist_reduce_fx="sum")
+        else:
+            self.add_state("correct", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+            self.add_state("total", jnp.zeros((), dtype=jnp.float32), dist_reduce_fx="sum")
+
+    def _update_state(self, correct: Array, total: Array) -> None:
+        if isinstance(self.correct, list):
+            self.correct.append(correct)
+        else:
+            self.correct = self.correct + jnp.sum(correct)
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        correct = dim_zero_cat(self.correct) if isinstance(self.correct, list) else self.correct
+        return _exact_match_reduce(correct, self.total, self.multidim_average)
+
+
+class MulticlassExactMatch(_AbstractExactMatch):
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(
+        self,
+        num_classes: int,
+        multidim_average: str = "global",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _multiclass_stat_scores_arg_validation(num_classes, top_k=1, average=None, multidim_average=multidim_average, ignore_index=ignore_index)
+        self.num_classes = num_classes
+        self.multidim_average = multidim_average
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self._create_state(multidim_average)
+
+    def update(self, preds: Array, target: Array) -> None:
+        if self.validate_args:
+            _multiclass_stat_scores_tensor_validation(preds, target, self.num_classes, self.multidim_average, self.ignore_index)
+        preds, target = _multiclass_stat_scores_format(preds, target, top_k=1)
+        mask = _ignore_mask(target, self.ignore_index)
+        correct = jnp.all(jnp.where(mask, preds == target, True), axis=1).astype(jnp.int32)
+        self._update_state(correct, jnp.asarray(correct.shape[0], dtype=jnp.float32))
+
+
+class MultilabelExactMatch(_AbstractExactMatch):
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(
+        self,
+        num_labels: int,
+        threshold: float = 0.5,
+        multidim_average: str = "global",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _multilabel_stat_scores_arg_validation(num_labels, threshold, average=None, multidim_average=multidim_average, ignore_index=ignore_index)
+        self.num_labels = num_labels
+        self.threshold = threshold
+        self.multidim_average = multidim_average
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self._create_state(multidim_average)
+
+    def update(self, preds: Array, target: Array) -> None:
+        if self.validate_args:
+            _multilabel_stat_scores_tensor_validation(preds, target, self.num_labels, self.multidim_average, self.ignore_index)
+        squeeze_x = jnp.asarray(preds).ndim == 2
+        preds, target, mask = _multilabel_stat_scores_format(preds, target, self.num_labels, self.threshold, self.ignore_index)
+        correct = jnp.all(jnp.where(mask, preds == target, True), axis=1).astype(jnp.int32)
+        if squeeze_x:
+            correct = correct.squeeze(-1)
+        self._update_state(correct, jnp.asarray(correct.size, dtype=jnp.float32))
+
+
+class ExactMatch:
+    """Task façade (reference exact_match.py)."""
+
+    def __new__(  # type: ignore[misc]
+        cls,
+        task: str,
+        threshold: float = 0.5,
+        num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None,
+        multidim_average: str = "global",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> Metric:
+        task = ClassificationTask.from_str_or_raise(task)
+        kwargs.update({"multidim_average": multidim_average, "ignore_index": ignore_index, "validate_args": validate_args})
+        if task == ClassificationTask.MULTICLASS:
+            assert isinstance(num_classes, int)
+            return MulticlassExactMatch(num_classes, **kwargs)
+        if task == ClassificationTask.MULTILABEL:
+            assert isinstance(num_labels, int)
+            return MultilabelExactMatch(num_labels, threshold, **kwargs)
+        raise ValueError(f"Expected argument `task` to either be 'multiclass' or 'multilabel' but got {task}")

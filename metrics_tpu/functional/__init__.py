@@ -1,0 +1,1 @@
+"""Functional metrics layer (SURVEY §2.5 L3, reference src/torchmetrics/functional/)."""

@@ -1,0 +1,61 @@
+"""Cohen's kappa functionals.
+
+Reference parity: src/torchmetrics/functional/classification/cohen_kappa.py
+(``_cohen_kappa_reduce`` with optional linear/quadratic weighting).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.classification.confusion_matrix import (
+    binary_confusion_matrix,
+    multiclass_confusion_matrix,
+)
+
+
+def _cohen_kappa_reduce(confmat: Array, weights: Optional[str] = None) -> Array:
+    """Reference cohen_kappa.py ``_cohen_kappa_reduce``."""
+    confmat = confmat.astype(jnp.float32)
+    n_classes = confmat.shape[0]
+    sum0 = jnp.sum(confmat, axis=0, keepdims=True)
+    sum1 = jnp.sum(confmat, axis=1, keepdims=True)
+    expected = sum1 @ sum0 / jnp.sum(sum0)
+
+    if weights is None:
+        w_mat = jnp.ones((n_classes, n_classes), dtype=jnp.float32) - jnp.eye(n_classes, dtype=jnp.float32)
+    elif weights in ("linear", "quadratic"):
+        w_mat = jnp.arange(n_classes, dtype=jnp.float32)
+        w_mat = jnp.abs(w_mat[:, None] - w_mat[None, :])
+        if weights == "quadratic":
+            w_mat = w_mat**2
+    else:
+        raise ValueError(f"Received `weights` for which no implementation exists: {weights}")
+
+    k = jnp.sum(w_mat * confmat) / jnp.sum(w_mat * expected)
+    return 1 - k
+
+
+def binary_cohen_kappa(preds, target, threshold=0.5, weights=None, ignore_index=None, validate_args=True) -> Array:
+    confmat = binary_confusion_matrix(preds, target, threshold, ignore_index, normalize=None, validate_args=validate_args)
+    return _cohen_kappa_reduce(confmat, weights)
+
+
+def multiclass_cohen_kappa(preds, target, num_classes, weights=None, ignore_index=None, validate_args=True) -> Array:
+    confmat = multiclass_confusion_matrix(preds, target, num_classes, ignore_index, normalize=None, validate_args=validate_args)
+    return _cohen_kappa_reduce(confmat, weights)
+
+
+def cohen_kappa(
+    preds, target, task, threshold=0.5, num_classes=None, weights=None, ignore_index=None, validate_args=True,
+) -> Array:
+    task = str(task).lower()
+    if task == "binary":
+        return binary_cohen_kappa(preds, target, threshold, weights, ignore_index, validate_args)
+    if task == "multiclass":
+        assert isinstance(num_classes, int)
+        return multiclass_cohen_kappa(preds, target, num_classes, weights, ignore_index, validate_args)
+    raise ValueError(f"Expected argument `task` to either be 'binary' or 'multiclass' but got {task}")

@@ -1,0 +1,161 @@
+"""Confusion-matrix functionals.
+
+Reference parity: src/torchmetrics/functional/classification/confusion_matrix.py
+(binary/multiclass/multilabel + ``_confusion_matrix_reduce`` normalisation).
+
+TPU notes: the multiclass count uses ``jnp.bincount(target*C + preds, length=C*C)``
+(static-shape scatter-add; deterministic on XLA — the reference needed a fallback loop
+for this, data.py:206-228). ``ignore_index`` routes ignored pairs to an overflow bucket
+that is dropped, instead of boolean filtering.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.classification.stat_scores import (
+    _binary_stat_scores_format,
+    _binary_stat_scores_tensor_validation,
+    _ignore_mask,
+    _multiclass_stat_scores_format,
+    _multiclass_stat_scores_tensor_validation,
+    _multilabel_stat_scores_format,
+    _multilabel_stat_scores_tensor_validation,
+)
+from metrics_tpu.utils.compute import _safe_divide
+
+
+def _confusion_matrix_reduce(confmat: Array, normalize: Optional[str] = None) -> Array:
+    """Normalise over true/pred/all (reference confusion_matrix.py:~40)."""
+    allowed_normalize = ("true", "pred", "all", "none", None)
+    if normalize not in allowed_normalize:
+        raise ValueError(f"Argument `normalize` needs to one of the following: {allowed_normalize}")
+    if normalize is not None and normalize != "none":
+        confmat = confmat.astype(jnp.float32)
+        if normalize == "true":
+            confmat = _safe_divide(confmat, jnp.sum(confmat, axis=-1, keepdims=True))
+        elif normalize == "pred":
+            confmat = _safe_divide(confmat, jnp.sum(confmat, axis=-2, keepdims=True))
+        elif normalize == "all":
+            confmat = _safe_divide(confmat, jnp.sum(confmat, axis=(-2, -1), keepdims=True))
+    return confmat
+
+
+def _binary_confusion_matrix_arg_validation(
+    threshold: float = 0.5, ignore_index: Optional[int] = None, normalize: Optional[str] = None
+) -> None:
+    if not (isinstance(threshold, float) and (0 <= threshold <= 1)):
+        raise ValueError(f"Expected argument `threshold` to be a float in the [0,1] range, but got {threshold}.")
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+    allowed_normalize = ("true", "pred", "all", "none", None)
+    if normalize not in allowed_normalize:
+        raise ValueError(f"Expected argument `normalize` to be one of {allowed_normalize}, but got {normalize}")
+
+
+def _binary_confusion_matrix_update(preds: Array, target: Array, mask: Array) -> Array:
+    """[[tn, fp], [fn, tp]] — 2×2 counts via the masked products."""
+    m = mask.astype(jnp.int32)
+    tp = jnp.sum(preds * target * m)
+    fp = jnp.sum(preds * (1 - target) * m)
+    fn = jnp.sum((1 - preds) * target * m)
+    tn = jnp.sum((1 - preds) * (1 - target) * m)
+    return jnp.stack([jnp.stack([tn, fp]), jnp.stack([fn, tp])])
+
+
+def binary_confusion_matrix(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    normalize: Optional[str] = None,
+    validate_args: bool = True,
+) -> Array:
+    if validate_args:
+        _binary_confusion_matrix_arg_validation(threshold, ignore_index, normalize)
+        _binary_stat_scores_tensor_validation(preds, target, "global", ignore_index)
+    preds, target, mask = _binary_stat_scores_format(preds, target, threshold, ignore_index)
+    confmat = _binary_confusion_matrix_update(preds, target, mask)
+    return _confusion_matrix_reduce(confmat, normalize)
+
+
+def _multiclass_confusion_matrix_update(
+    preds: Array, target: Array, num_classes: int, ignore_index: Optional[int] = None
+) -> Array:
+    """(C, C) counts, rows = true class (reference confusion_matrix.py multiclass update)."""
+    mask = _ignore_mask(target, ignore_index)
+    t = jnp.where(mask, target, 0).astype(jnp.int32)
+    p = preds.astype(jnp.int32)
+    # ignored pairs go to an overflow bucket (index C*C) that is trimmed after counting
+    unique_mapping = jnp.where(mask.reshape(-1), (t * num_classes + p).reshape(-1), num_classes * num_classes)
+    bins = jnp.bincount(unique_mapping, length=num_classes * num_classes + 1)[: num_classes * num_classes]
+    return bins.reshape(num_classes, num_classes)
+
+
+def multiclass_confusion_matrix(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    ignore_index: Optional[int] = None,
+    normalize: Optional[str] = None,
+    validate_args: bool = True,
+) -> Array:
+    if validate_args:
+        _multiclass_stat_scores_tensor_validation(preds, target, num_classes, "global", ignore_index)
+    preds, target = _multiclass_stat_scores_format(preds, target, top_k=1)
+    confmat = _multiclass_confusion_matrix_update(preds, target, num_classes, ignore_index)
+    return _confusion_matrix_reduce(confmat, normalize)
+
+
+def _multilabel_confusion_matrix_update(preds: Array, target: Array, mask: Array, num_labels: int) -> Array:
+    """(C, 2, 2) per-label counts."""
+    m = mask.astype(jnp.int32)
+    sum_axes = (0, 2)
+    tp = jnp.sum(preds * target * m, axis=sum_axes)
+    fp = jnp.sum(preds * (1 - target) * m, axis=sum_axes)
+    fn = jnp.sum((1 - preds) * target * m, axis=sum_axes)
+    tn = jnp.sum((1 - preds) * (1 - target) * m, axis=sum_axes)
+    return jnp.stack([tn, fp, fn, tp], axis=-1).reshape(num_labels, 2, 2)
+
+
+def multilabel_confusion_matrix(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    normalize: Optional[str] = None,
+    validate_args: bool = True,
+) -> Array:
+    if validate_args:
+        _multilabel_stat_scores_tensor_validation(preds, target, num_labels, "global", ignore_index)
+    preds, target, mask = _multilabel_stat_scores_format(preds, target, num_labels, threshold, ignore_index)
+    confmat = _multilabel_confusion_matrix_update(preds, target, mask, num_labels)
+    return _confusion_matrix_reduce(confmat, normalize)
+
+
+def confusion_matrix(
+    preds: Array,
+    target: Array,
+    task: str,
+    threshold: float = 0.5,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    normalize: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    task = str(task).lower()
+    if task == "binary":
+        return binary_confusion_matrix(preds, target, threshold, ignore_index, normalize, validate_args)
+    if task == "multiclass":
+        assert isinstance(num_classes, int)
+        return multiclass_confusion_matrix(preds, target, num_classes, ignore_index, normalize, validate_args)
+    if task == "multilabel":
+        assert isinstance(num_labels, int)
+        return multilabel_confusion_matrix(preds, target, num_labels, threshold, ignore_index, normalize, validate_args)
+    raise ValueError(f"Expected argument `task` to either be 'binary', 'multiclass' or 'multilabel' but got {task}")

@@ -1,0 +1,90 @@
+"""Exact match functionals.
+
+Reference parity: src/torchmetrics/functional/classification/exact_match.py
+(multiclass + multilabel variants; a sample scores 1 iff every position is correct).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.classification.stat_scores import (
+    _ignore_mask,
+    _multiclass_stat_scores_arg_validation,
+    _multiclass_stat_scores_format,
+    _multiclass_stat_scores_tensor_validation,
+    _multilabel_stat_scores_arg_validation,
+    _multilabel_stat_scores_format,
+    _multilabel_stat_scores_tensor_validation,
+)
+from metrics_tpu.utils.compute import _safe_divide
+
+
+def _exact_match_reduce(correct: Array, total: Array, multidim_average: str) -> Array:
+    if multidim_average == "global":
+        return _safe_divide(jnp.sum(correct), total)
+    return correct.astype(jnp.float32)
+
+
+def multiclass_exact_match(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    if validate_args:
+        _multiclass_stat_scores_arg_validation(num_classes, top_k=1, average=None, multidim_average=multidim_average, ignore_index=ignore_index)
+        _multiclass_stat_scores_tensor_validation(preds, target, num_classes, multidim_average, ignore_index)
+    preds, target = _multiclass_stat_scores_format(preds, target, top_k=1)
+    mask = _ignore_mask(target, ignore_index)
+    # ignored positions count as matching (they don't break exactness)
+    correct = jnp.all(jnp.where(mask, preds == target, True), axis=1).astype(jnp.int32)
+    total = jnp.asarray(correct.shape[0], dtype=jnp.float32)
+    return _exact_match_reduce(correct, total, multidim_average)
+
+
+def multilabel_exact_match(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    threshold: float = 0.5,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    if validate_args:
+        _multilabel_stat_scores_arg_validation(num_labels, threshold, average=None, multidim_average=multidim_average, ignore_index=ignore_index)
+        _multilabel_stat_scores_tensor_validation(preds, target, num_labels, multidim_average, ignore_index)
+    squeeze_x = jnp.asarray(preds).ndim == 2
+    preds, target, mask = _multilabel_stat_scores_format(preds, target, num_labels, threshold, ignore_index)
+    correct = jnp.all(jnp.where(mask, preds == target, True), axis=1).astype(jnp.int32)  # (N, X)
+    if squeeze_x:
+        correct = correct.squeeze(-1)  # 2-d input has no extra dims
+    total = jnp.asarray(correct.size, dtype=jnp.float32)
+    return _exact_match_reduce(correct, total, multidim_average)
+
+
+def exact_match(
+    preds: Array,
+    target: Array,
+    task: str,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    threshold: float = 0.5,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    task = str(task).lower()
+    if task == "multiclass":
+        assert num_classes is not None
+        return multiclass_exact_match(preds, target, num_classes, multidim_average, ignore_index, validate_args)
+    if task == "multilabel":
+        assert num_labels is not None
+        return multilabel_exact_match(preds, target, num_labels, threshold, multidim_average, ignore_index, validate_args)
+    raise ValueError(f"Expected argument `task` to either be 'multiclass' or 'multilabel' but got {task}")

@@ -1,0 +1,97 @@
+"""F-beta / F1 functionals.
+
+Reference parity: src/torchmetrics/functional/classification/f_beta.py
+(``_fbeta_reduce`` + binary/multiclass/multilabel × fbeta/f1 + task façades).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.classification._pipeline import binary_pipeline, multiclass_pipeline, multilabel_pipeline
+from metrics_tpu.utils.compute import _adjust_weights_safe_divide, _safe_divide
+
+
+def _fbeta_reduce(
+    tp: Array,
+    fp: Array,
+    tn: Array,
+    fn: Array,
+    beta: float,
+    average: Optional[str],
+    multidim_average: str = "global",
+    multilabel: bool = False,
+) -> Array:
+    beta2 = beta**2
+    if average == "binary":
+        return _safe_divide((1 + beta2) * tp, (1 + beta2) * tp + beta2 * fn + fp)
+    if average == "micro":
+        axis = 0 if multidim_average == "global" else 1
+        tp = jnp.sum(tp, axis=axis)
+        fn = jnp.sum(fn, axis=axis)
+        fp = jnp.sum(fp, axis=axis)
+        return _safe_divide((1 + beta2) * tp, (1 + beta2) * tp + beta2 * fn + fp)
+    score = _safe_divide((1 + beta2) * tp, (1 + beta2) * tp + beta2 * fn + fp)
+    return _adjust_weights_safe_divide(score, average, multilabel, tp, fp, fn)
+
+
+def _validate_beta(beta: float) -> None:
+    if not (isinstance(beta, float) and beta > 0):
+        raise ValueError(f"Expected argument `beta` to be a float larger than 0, but got {beta}.")
+
+
+def binary_fbeta_score(preds, target, beta, threshold=0.5, multidim_average="global", ignore_index=None, validate_args=True) -> Array:
+    if validate_args:
+        _validate_beta(beta)
+    tp, fp, tn, fn = binary_pipeline(preds, target, threshold, multidim_average, ignore_index, validate_args)
+    return _fbeta_reduce(tp, fp, tn, fn, beta, average="binary", multidim_average=multidim_average)
+
+
+def multiclass_fbeta_score(preds, target, beta, num_classes, average="macro", top_k=1, multidim_average="global", ignore_index=None, validate_args=True) -> Array:
+    if validate_args:
+        _validate_beta(beta)
+    tp, fp, tn, fn = multiclass_pipeline(preds, target, num_classes, average, top_k, multidim_average, ignore_index, validate_args)
+    return _fbeta_reduce(tp, fp, tn, fn, beta, average=average, multidim_average=multidim_average)
+
+
+def multilabel_fbeta_score(preds, target, beta, num_labels, threshold=0.5, average="macro", multidim_average="global", ignore_index=None, validate_args=True) -> Array:
+    if validate_args:
+        _validate_beta(beta)
+    tp, fp, tn, fn = multilabel_pipeline(preds, target, num_labels, threshold, average, multidim_average, ignore_index, validate_args)
+    return _fbeta_reduce(tp, fp, tn, fn, beta, average=average, multidim_average=multidim_average, multilabel=True)
+
+
+def binary_f1_score(preds, target, threshold=0.5, multidim_average="global", ignore_index=None, validate_args=True) -> Array:
+    return binary_fbeta_score(preds, target, 1.0, threshold, multidim_average, ignore_index, validate_args)
+
+
+def multiclass_f1_score(preds, target, num_classes, average="macro", top_k=1, multidim_average="global", ignore_index=None, validate_args=True) -> Array:
+    return multiclass_fbeta_score(preds, target, 1.0, num_classes, average, top_k, multidim_average, ignore_index, validate_args)
+
+
+def multilabel_f1_score(preds, target, num_labels, threshold=0.5, average="macro", multidim_average="global", ignore_index=None, validate_args=True) -> Array:
+    return multilabel_fbeta_score(preds, target, 1.0, num_labels, threshold, average, multidim_average, ignore_index, validate_args)
+
+
+def fbeta_score(
+    preds, target, task, beta=1.0, threshold=0.5, num_classes=None, num_labels=None, average="micro",
+    multidim_average="global", top_k=1, ignore_index=None, validate_args=True,
+) -> Array:
+    task = str(task).lower()
+    if task == "binary":
+        return binary_fbeta_score(preds, target, beta, threshold, multidim_average, ignore_index, validate_args)
+    if task == "multiclass":
+        return multiclass_fbeta_score(preds, target, beta, num_classes, average, top_k, multidim_average, ignore_index, validate_args)
+    if task == "multilabel":
+        return multilabel_fbeta_score(preds, target, beta, num_labels, threshold, average, multidim_average, ignore_index, validate_args)
+    raise ValueError(f"Expected argument `task` to either be 'binary', 'multiclass' or 'multilabel' but got {task}")
+
+
+def f1_score(
+    preds, target, task, threshold=0.5, num_classes=None, num_labels=None, average="micro",
+    multidim_average="global", top_k=1, ignore_index=None, validate_args=True,
+) -> Array:
+    return fbeta_score(preds, target, task, 1.0, threshold, num_classes, num_labels, average, multidim_average, top_k, ignore_index, validate_args)

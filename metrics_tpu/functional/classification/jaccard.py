@@ -1,0 +1,87 @@
+"""Jaccard index (IoU) functionals.
+
+Reference parity: src/torchmetrics/functional/classification/jaccard.py
+(``_jaccard_index_reduce`` over a confusion matrix).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.classification.confusion_matrix import (
+    binary_confusion_matrix,
+    multiclass_confusion_matrix,
+    multilabel_confusion_matrix,
+)
+from metrics_tpu.utils.compute import _safe_divide
+
+
+def _jaccard_index_reduce(confmat: Array, average: Optional[str], ignore_index: Optional[int] = None) -> Array:
+    """Reference jaccard.py ``_jaccard_index_reduce``."""
+    allowed_average = ("binary", "micro", "macro", "weighted", "none", None)
+    if average not in allowed_average:
+        raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
+    confmat = confmat.astype(jnp.float32)
+    if average == "binary":
+        return confmat[1, 1] / (confmat[0, 1] + confmat[1, 0] + confmat[1, 1])
+
+    ignore_index_cond = ignore_index is not None and 0 <= ignore_index < confmat.shape[0]
+    multilabel = confmat.ndim == 3
+    if multilabel:
+        num = confmat[:, 1, 1]
+        denom = confmat[:, 1, 1] + confmat[:, 0, 1] + confmat[:, 1, 0]
+    else:
+        num = jnp.diag(confmat)
+        denom = jnp.sum(confmat, axis=0) + jnp.sum(confmat, axis=1) - jnp.diag(confmat)
+
+    if average == "micro":
+        num = jnp.sum(num)
+        denom = jnp.sum(denom)
+
+    jaccard = _safe_divide(num, denom)
+
+    if average is None or average == "none" or average == "micro":
+        return jaccard
+    if average == "weighted":
+        weights = confmat[:, 1, 1] + confmat[:, 1, 0] if multilabel else jnp.sum(confmat, axis=1)
+    else:
+        weights = jnp.ones_like(jaccard)
+        if ignore_index_cond:
+            weights = weights.at[ignore_index].set(0.0)
+        if not multilabel:
+            weights = jnp.where(denom == 0, 0.0, weights)
+    return jnp.sum(jaccard * _safe_divide(weights, jnp.sum(weights)))
+
+
+def binary_jaccard_index(preds, target, threshold=0.5, ignore_index=None, validate_args=True) -> Array:
+    confmat = binary_confusion_matrix(preds, target, threshold, ignore_index, normalize=None, validate_args=validate_args)
+    return _jaccard_index_reduce(confmat, average="binary")
+
+
+def multiclass_jaccard_index(preds, target, num_classes, average="macro", ignore_index=None, validate_args=True) -> Array:
+    confmat = multiclass_confusion_matrix(preds, target, num_classes, ignore_index, normalize=None, validate_args=validate_args)
+    return _jaccard_index_reduce(confmat, average=average, ignore_index=ignore_index)
+
+
+def multilabel_jaccard_index(preds, target, num_labels, threshold=0.5, average="macro", ignore_index=None, validate_args=True) -> Array:
+    confmat = multilabel_confusion_matrix(preds, target, num_labels, threshold, ignore_index, normalize=None, validate_args=validate_args)
+    return _jaccard_index_reduce(confmat, average=average)
+
+
+def jaccard_index(
+    preds, target, task, threshold=0.5, num_classes=None, num_labels=None, average="macro",
+    ignore_index=None, validate_args=True,
+) -> Array:
+    task = str(task).lower()
+    if task == "binary":
+        return binary_jaccard_index(preds, target, threshold, ignore_index, validate_args)
+    if task == "multiclass":
+        assert isinstance(num_classes, int)
+        return multiclass_jaccard_index(preds, target, num_classes, average, ignore_index, validate_args)
+    if task == "multilabel":
+        assert isinstance(num_labels, int)
+        return multilabel_jaccard_index(preds, target, num_labels, threshold, average, ignore_index, validate_args)
+    raise ValueError(f"Expected argument `task` to either be 'binary', 'multiclass' or 'multilabel' but got {task}")

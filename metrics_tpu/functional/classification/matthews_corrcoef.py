@@ -1,0 +1,77 @@
+"""Matthews correlation coefficient functionals.
+
+Reference parity: src/torchmetrics/functional/classification/matthews_corrcoef.py
+(``_matthews_corrcoef_reduce`` — generalised R_k statistic over the confusion matrix).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.classification.confusion_matrix import (
+    binary_confusion_matrix,
+    multiclass_confusion_matrix,
+    multilabel_confusion_matrix,
+)
+
+
+def _matthews_corrcoef_reduce(confmat: Array) -> Array:
+    """Reference matthews_corrcoef.py ``_matthews_corrcoef_reduce``."""
+    # convert multilabel into binary by summing the per-label 2x2 matrices
+    if confmat.ndim == 3:  # multilabel
+        confmat = jnp.sum(confmat, axis=0)
+
+    if confmat.shape == (2, 2):
+        tn = confmat[0, 0].astype(jnp.float32)
+        fp = confmat[0, 1].astype(jnp.float32)
+        fn = confmat[1, 0].astype(jnp.float32)
+        tp = confmat[1, 1].astype(jnp.float32)
+        numerator = tp * tn - fp * fn
+        denom = jnp.sqrt((tp + fp) * (tp + fn) * (tn + fp) * (tn + fn))
+        return jnp.where(denom == 0, 0.0, numerator / jnp.where(denom == 0, 1.0, denom))
+
+    confmat = confmat.astype(jnp.float32)
+    tk = jnp.sum(confmat, axis=-1)  # number of true occurrences per class
+    pk = jnp.sum(confmat, axis=-2)  # number of predicted occurrences per class
+    c = jnp.trace(confmat)
+    s = jnp.sum(confmat)
+
+    cov_ytyp = c * s - jnp.sum(tk * pk)
+    cov_ypyp = s**2 - jnp.sum(pk * pk)
+    cov_ytyt = s**2 - jnp.sum(tk * tk)
+
+    denom = cov_ypyp * cov_ytyt
+    return jnp.where(denom == 0, 0.0, cov_ytyp / jnp.sqrt(jnp.where(denom == 0, 1.0, denom)))
+
+
+def binary_matthews_corrcoef(preds, target, threshold=0.5, ignore_index=None, validate_args=True) -> Array:
+    confmat = binary_confusion_matrix(preds, target, threshold, ignore_index, normalize=None, validate_args=validate_args)
+    return _matthews_corrcoef_reduce(confmat)
+
+
+def multiclass_matthews_corrcoef(preds, target, num_classes, ignore_index=None, validate_args=True) -> Array:
+    confmat = multiclass_confusion_matrix(preds, target, num_classes, ignore_index, normalize=None, validate_args=validate_args)
+    return _matthews_corrcoef_reduce(confmat)
+
+
+def multilabel_matthews_corrcoef(preds, target, num_labels, threshold=0.5, ignore_index=None, validate_args=True) -> Array:
+    confmat = multilabel_confusion_matrix(preds, target, num_labels, threshold, ignore_index, normalize=None, validate_args=validate_args)
+    return _matthews_corrcoef_reduce(confmat)
+
+
+def matthews_corrcoef(
+    preds, target, task, threshold=0.5, num_classes=None, num_labels=None, ignore_index=None, validate_args=True,
+) -> Array:
+    task = str(task).lower()
+    if task == "binary":
+        return binary_matthews_corrcoef(preds, target, threshold, ignore_index, validate_args)
+    if task == "multiclass":
+        assert isinstance(num_classes, int)
+        return multiclass_matthews_corrcoef(preds, target, num_classes, ignore_index, validate_args)
+    if task == "multilabel":
+        assert isinstance(num_labels, int)
+        return multilabel_matthews_corrcoef(preds, target, num_labels, threshold, ignore_index, validate_args)
+    raise ValueError(f"Expected argument `task` to either be 'binary', 'multiclass' or 'multilabel' but got {task}")

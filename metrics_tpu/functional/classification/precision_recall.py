@@ -1,0 +1,95 @@
+"""Precision / Recall functionals.
+
+Reference parity: src/torchmetrics/functional/classification/precision_recall.py
+(``_precision_recall_reduce`` + 6 entry points + 2 task façades).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.classification._pipeline import binary_pipeline, multiclass_pipeline, multilabel_pipeline
+from metrics_tpu.utils.compute import _adjust_weights_safe_divide, _safe_divide
+
+
+def _precision_recall_reduce(
+    stat: str,
+    tp: Array,
+    fp: Array,
+    tn: Array,
+    fn: Array,
+    average: Optional[str],
+    multidim_average: str = "global",
+    multilabel: bool = False,
+) -> Array:
+    different_stat = fp if stat == "precision" else fn  # this is what differs between the two scores
+    if average == "binary":
+        return _safe_divide(tp, tp + different_stat)
+    if average == "micro":
+        axis = 0 if multidim_average == "global" else 1
+        tp = jnp.sum(tp, axis=axis)
+        different_stat = jnp.sum(different_stat, axis=axis)
+        return _safe_divide(tp, tp + different_stat)
+    score = _safe_divide(tp, tp + different_stat)
+    return _adjust_weights_safe_divide(score, average, multilabel, tp, fp, fn)
+
+
+def binary_precision(preds, target, threshold=0.5, multidim_average="global", ignore_index=None, validate_args=True) -> Array:
+    tp, fp, tn, fn = binary_pipeline(preds, target, threshold, multidim_average, ignore_index, validate_args)
+    return _precision_recall_reduce("precision", tp, fp, tn, fn, average="binary", multidim_average=multidim_average)
+
+
+def multiclass_precision(preds, target, num_classes, average="macro", top_k=1, multidim_average="global", ignore_index=None, validate_args=True) -> Array:
+    tp, fp, tn, fn = multiclass_pipeline(preds, target, num_classes, average, top_k, multidim_average, ignore_index, validate_args)
+    return _precision_recall_reduce("precision", tp, fp, tn, fn, average=average, multidim_average=multidim_average)
+
+
+def multilabel_precision(preds, target, num_labels, threshold=0.5, average="macro", multidim_average="global", ignore_index=None, validate_args=True) -> Array:
+    tp, fp, tn, fn = multilabel_pipeline(preds, target, num_labels, threshold, average, multidim_average, ignore_index, validate_args)
+    return _precision_recall_reduce("precision", tp, fp, tn, fn, average=average, multidim_average=multidim_average, multilabel=True)
+
+
+def binary_recall(preds, target, threshold=0.5, multidim_average="global", ignore_index=None, validate_args=True) -> Array:
+    tp, fp, tn, fn = binary_pipeline(preds, target, threshold, multidim_average, ignore_index, validate_args)
+    return _precision_recall_reduce("recall", tp, fp, tn, fn, average="binary", multidim_average=multidim_average)
+
+
+def multiclass_recall(preds, target, num_classes, average="macro", top_k=1, multidim_average="global", ignore_index=None, validate_args=True) -> Array:
+    tp, fp, tn, fn = multiclass_pipeline(preds, target, num_classes, average, top_k, multidim_average, ignore_index, validate_args)
+    return _precision_recall_reduce("recall", tp, fp, tn, fn, average=average, multidim_average=multidim_average)
+
+
+def multilabel_recall(preds, target, num_labels, threshold=0.5, average="macro", multidim_average="global", ignore_index=None, validate_args=True) -> Array:
+    tp, fp, tn, fn = multilabel_pipeline(preds, target, num_labels, threshold, average, multidim_average, ignore_index, validate_args)
+    return _precision_recall_reduce("recall", tp, fp, tn, fn, average=average, multidim_average=multidim_average, multilabel=True)
+
+
+def precision(
+    preds, target, task, threshold=0.5, num_classes=None, num_labels=None, average="micro",
+    multidim_average="global", top_k=1, ignore_index=None, validate_args=True,
+) -> Array:
+    task = str(task).lower()
+    if task == "binary":
+        return binary_precision(preds, target, threshold, multidim_average, ignore_index, validate_args)
+    if task == "multiclass":
+        return multiclass_precision(preds, target, num_classes, average, top_k, multidim_average, ignore_index, validate_args)
+    if task == "multilabel":
+        return multilabel_precision(preds, target, num_labels, threshold, average, multidim_average, ignore_index, validate_args)
+    raise ValueError(f"Expected argument `task` to either be 'binary', 'multiclass' or 'multilabel' but got {task}")
+
+
+def recall(
+    preds, target, task, threshold=0.5, num_classes=None, num_labels=None, average="micro",
+    multidim_average="global", top_k=1, ignore_index=None, validate_args=True,
+) -> Array:
+    task = str(task).lower()
+    if task == "binary":
+        return binary_recall(preds, target, threshold, multidim_average, ignore_index, validate_args)
+    if task == "multiclass":
+        return multiclass_recall(preds, target, num_classes, average, top_k, multidim_average, ignore_index, validate_args)
+    if task == "multilabel":
+        return multilabel_recall(preds, target, num_labels, threshold, average, multidim_average, ignore_index, validate_args)
+    raise ValueError(f"Expected argument `task` to either be 'binary', 'multiclass' or 'multilabel' but got {task}")

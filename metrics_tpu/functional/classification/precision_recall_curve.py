@@ -1,0 +1,457 @@
+"""Precision-recall curve functionals — the two state regimes.
+
+Reference parity: src/torchmetrics/functional/classification/precision_recall_curve.py —
+``_binary_clf_curve`` (:27), ``_adjust_threshold_arg`` (:79), format/update/compute for
+binary/multiclass/multilabel, incl. the **binned** branch (:184-201) that replaces
+O(N)-sample storage with a constant-memory ``(T, 2, 2)`` confusion state.
+
+TPU-first notes: the binned update is a ``(T, M) @ (M,)`` comparison-matmul that rides
+the MXU; binned mode is the jit/shard_map-native path (static shapes). Exact mode
+(``thresholds=None``) keeps ragged value lists and computes on host via sort+cumsum —
+same as the reference's design split.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.classification.stat_scores import _ignore_mask, _sigmoid_if_logits, _softmax_if_logits
+from metrics_tpu.utils.checks import _check_same_shape, _value_check_possible
+from metrics_tpu.utils.compute import _safe_divide
+from metrics_tpu.utils.data import _bincount, _cumsum
+
+Thresholds = Optional[Union[int, List[float], Array]]
+
+
+def _binary_clf_curve(
+    preds: Array,
+    target: Array,
+    sample_weights: Optional[Array] = None,
+    pos_label: int = 1,
+) -> Tuple[Array, Array, Array]:
+    """fps/tps/thresholds by descending-score cumsum (reference :27-76).
+
+    Host-side (exact mode): tied prediction scores are collapsed to a single
+    threshold point (keeping the last cumsum value per distinct score), matching the
+    reference/sklearn ``_binary_clf_curve``. Data-dependent output length — exact mode
+    never runs inside jit.
+    """
+    if sample_weights is not None and not isinstance(sample_weights, Array):
+        sample_weights = jnp.asarray(sample_weights, dtype=jnp.float32)
+    order = jnp.argsort(preds)[::-1]
+    preds = preds[order]
+    target = target[order]
+    weight = sample_weights[order] if sample_weights is not None else jnp.ones_like(preds, dtype=jnp.float32)
+
+    target = (target == pos_label).astype(jnp.float32)
+    tps = _cumsum(target * weight, axis=0)
+    fps = _cumsum((1 - target) * weight, axis=0)
+
+    # collapse runs of equal scores: keep the cumulative count at the end of each run
+    distinct_idx = jnp.nonzero(jnp.diff(preds))[0]
+    threshold_idxs = jnp.concatenate([distinct_idx, jnp.asarray([preds.shape[0] - 1])])
+    return fps[threshold_idxs], tps[threshold_idxs], preds[threshold_idxs]
+
+
+def _adjust_threshold_arg(thresholds: Thresholds = None) -> Optional[Array]:
+    """Normalise the thresholds argument (reference :79-90)."""
+    if isinstance(thresholds, int):
+        thresholds = jnp.linspace(0, 1, thresholds, dtype=jnp.float32)
+    if isinstance(thresholds, (list, tuple)):
+        thresholds = jnp.asarray(thresholds, dtype=jnp.float32)
+    return thresholds
+
+
+
+
+def _exact_mode_filter(preds, target, thresholds, ignore_index, mask):
+    """Apply the ignore_index filter for exact mode, or raise inside jit.
+
+    Exact mode's filtering is data-dependent; running it under a tracer would
+    silently count ignored samples as negatives, so it is an explicit error —
+    the binned mode (``thresholds=...``) is the jit-native path.
+    """
+    if thresholds is None and ignore_index is not None:
+        if not _value_check_possible(mask):
+            raise RuntimeError(
+                "Exact-mode (thresholds=None) curve metrics with `ignore_index` cannot run"
+                " inside jit: the filter is data-dependent. Pass `thresholds` to use the"
+                " binned, jit-native mode instead."
+            )
+        return preds[mask], target[mask]
+    return preds, target
+
+
+def _binary_precision_recall_curve_arg_validation(
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+) -> None:
+    if thresholds is not None and not isinstance(thresholds, (list, int, jax.Array)) and not hasattr(thresholds, "__len__"):
+        raise ValueError(
+            "Expected argument `thresholds` to either be an integer, list of floats or tensor of floats,"
+            f" but got {thresholds}"
+        )
+    if isinstance(thresholds, int) and thresholds < 2:
+        raise ValueError(f"If argument `thresholds` is an integer, expected it to be larger than 1, but got {thresholds}")
+    if isinstance(thresholds, list) and not all(isinstance(t, float) and 0 <= t <= 1 for t in thresholds):
+        raise ValueError(
+            f"If argument `thresholds` is a list, expected all elements to be floats in the [0,1] range, but got {thresholds}"
+        )
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+
+
+def _binary_precision_recall_curve_tensor_validation(
+    preds: Array, target: Array, ignore_index: Optional[int] = None
+) -> None:
+    _check_same_shape(preds, target)
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        raise ValueError("Expected argument `preds` to be an floating tensor, but got tensor with dtype"
+                         f" {preds.dtype}")
+    if jnp.issubdtype(target.dtype, jnp.floating):
+        raise ValueError("Expected argument `target` to be an int or bool tensor, but got tensor with dtype"
+                         f" {target.dtype}")
+    if _value_check_possible(target):
+        unique_values = set(jnp.unique(target).tolist())
+        allowed = {0, 1} if ignore_index is None else {0, 1, ignore_index}
+        if not unique_values.issubset(allowed):
+            raise RuntimeError(
+                f"Detected the following values in `target`: {sorted(unique_values)} but expected only"
+                f" the following values {sorted(allowed)}."
+            )
+
+
+def _binary_precision_recall_curve_format(
+    preds: Array,
+    target: Array,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Optional[Array], Array]:
+    """Flatten + sigmoid-if-logits; returns (preds, target, thresholds, weight-mask).
+
+    Divergence from the reference (:150-…): ``ignore_index`` yields a 0/1 weight mask
+    instead of filtering, so the binned path stays static-shape.
+    """
+    preds = jnp.asarray(preds).reshape(-1)
+    target = jnp.asarray(target).reshape(-1)
+    mask = _ignore_mask(target, ignore_index).reshape(-1)
+    target = jnp.where(mask, target, 0)
+    preds = _sigmoid_if_logits(preds)
+    thresholds = _adjust_threshold_arg(thresholds)
+    return preds, target, thresholds, mask
+
+
+def _binary_precision_recall_curve_update(
+    preds: Array,
+    target: Array,
+    thresholds: Optional[Array],
+    mask: Optional[Array] = None,
+) -> Union[Array, Tuple[Array, Array]]:
+    """Binned: (T,2,2) state via comparison-matmul (reference :184-201)."""
+    if thresholds is None:
+        return preds, target
+    len_t = thresholds.shape[0]
+    w = mask.astype(jnp.float32) if mask is not None else jnp.ones_like(preds)
+    t = target.astype(jnp.float32) * w
+    # (T, M) boolean comparison, then two (T,M)@(M,) matvecs -> MXU
+    preds_t = (preds[None, :] >= thresholds[:, None]).astype(jnp.float32) * w[None, :]
+    tp = preds_t @ t
+    fp = preds_t @ (w - t)
+    pos = jnp.sum(t)
+    neg = jnp.sum(w) - pos
+    fn = pos - tp
+    tn = neg - fp
+    confmat = jnp.stack([jnp.stack([tn, fp], axis=-1), jnp.stack([fn, tp], axis=-1)], axis=-2)
+    return confmat.astype(jnp.int32).reshape(len_t, 2, 2)
+
+
+def _binary_precision_recall_curve_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    thresholds: Optional[Array],
+    pos_label: int = 1,
+) -> Tuple[Array, Array, Array]:
+    """Reference :204-248."""
+    if isinstance(state, Array) and thresholds is not None:
+        tps = state[:, 1, 1]
+        fps = state[:, 0, 1]
+        fns = state[:, 1, 0]
+        precision = _safe_divide(tps, tps + fps)
+        recall = _safe_divide(tps, tps + fns)
+        precision = jnp.concatenate([precision, jnp.ones(1, dtype=precision.dtype)])
+        recall = jnp.concatenate([recall, jnp.zeros(1, dtype=recall.dtype)])
+        return precision, recall, thresholds
+
+    preds, target = state
+    fps, tps, thresh = _binary_clf_curve(preds, target, pos_label=pos_label)
+    precision = _safe_divide(tps, tps + fps)
+    recall = _safe_divide(tps, tps[-1])
+
+    # stop when full recall attained and reverse the outputs so recall is non-increasing
+    last_ind = jnp.argmax(tps >= tps[-1])
+    sl = slice(0, int(last_ind) + 1)
+    precision = jnp.concatenate([precision[sl][::-1], jnp.ones(1, dtype=precision.dtype)])
+    recall = jnp.concatenate([recall[sl][::-1], jnp.zeros(1, dtype=recall.dtype)])
+    thresh = thresh[sl][::-1]
+    return precision, recall, thresh
+
+
+def binary_precision_recall_curve(
+    preds: Array,
+    target: Array,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array, Array]:
+    if validate_args:
+        _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, thresholds, mask = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
+    if thresholds is None and ignore_index is not None:
+        preds, target = _exact_mode_filter(preds, target, thresholds, ignore_index, mask)
+        mask = None
+    state = _binary_precision_recall_curve_update(preds, target, thresholds, mask)
+    return _binary_precision_recall_curve_compute(state, thresholds)
+
+
+# --------------------------------------------------------------------------- multiclass
+
+
+def _multiclass_precision_recall_curve_arg_validation(
+    num_classes: int,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+) -> None:
+    if not isinstance(num_classes, int) or num_classes < 2:
+        raise ValueError(f"Expected argument `num_classes` to be an integer larger than 1, but got {num_classes}")
+    _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+
+
+def _multiclass_precision_recall_curve_tensor_validation(
+    preds: Array, target: Array, num_classes: int, ignore_index: Optional[int] = None
+) -> None:
+    if preds.ndim != target.ndim + 1:
+        raise ValueError("Expected `preds` to have one more dimension than `target` but got {} and {}".format(preds.ndim, target.ndim))
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        raise ValueError(f"Expected `preds` to be a float tensor, but got {preds.dtype}")
+    if jnp.issubdtype(target.dtype, jnp.floating):
+        raise ValueError(f"Expected argument `target` to be an int or bool tensor, but got {target.dtype}")
+    if preds.shape[1] != num_classes:
+        raise ValueError(f"Expected `preds.shape[1]={preds.shape[1]}` to be equal to the number of classes")
+    if preds.shape[0] != target.shape[0] or preds.shape[2:] != target.shape[1:]:
+        raise ValueError("Expected the shape of `preds` should be (N, C, ...) and the shape of `target` should be (N, ...).")
+    if _value_check_possible(target):
+        num_unique = int(jnp.max(target, initial=0)) + 1
+        check = num_unique > (num_classes if ignore_index is None else num_classes + 1)
+        if check:
+            raise RuntimeError("Detected more unique values in `target` than `num_classes`.")
+
+
+def _multiclass_precision_recall_curve_format(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Optional[Array], Array]:
+    preds = jnp.moveaxis(jnp.asarray(preds), 1, -1).reshape(-1, num_classes)
+    target = jnp.asarray(target).reshape(-1)
+    mask = _ignore_mask(target, ignore_index)
+    target = jnp.where(mask, target, 0)
+    preds = _softmax_if_logits(preds, axis=-1)
+    thresholds = _adjust_threshold_arg(thresholds)
+    return preds, target, thresholds, mask
+
+
+def _multiclass_precision_recall_curve_update(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    thresholds: Optional[Array],
+    mask: Optional[Array] = None,
+) -> Union[Array, Tuple[Array, Array]]:
+    """Binned: (T, C, 2, 2) one-vs-rest state."""
+    if thresholds is None:
+        return preds, target
+    len_t = thresholds.shape[0]
+    w = mask.astype(jnp.float32) if mask is not None else jnp.ones_like(target, dtype=jnp.float32)
+    oh_target = jax.nn.one_hot(target, num_classes, dtype=jnp.float32) * w[:, None]  # (M, C)
+    preds_t = (preds[None, :, :] >= thresholds[:, None, None]).astype(jnp.float32) * w[None, :, None]  # (T, M, C)
+    tp = jnp.einsum("tmc,mc->tc", preds_t, oh_target)
+    fp = jnp.einsum("tmc,mc->tc", preds_t, w[:, None] - oh_target)
+    pos = jnp.sum(oh_target, axis=0)  # (C,)
+    total = jnp.sum(w)
+    fn = pos[None, :] - tp
+    tn = (total - pos)[None, :] - fp
+    confmat = jnp.stack([jnp.stack([tn, fp], axis=-1), jnp.stack([fn, tp], axis=-1)], axis=-2)
+    return confmat.astype(jnp.int32).reshape(len_t, num_classes, 2, 2)
+
+
+def _multiclass_precision_recall_curve_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    num_classes: int,
+    thresholds: Optional[Array],
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    if isinstance(state, Array) and thresholds is not None:
+        tps = state[:, :, 1, 1]
+        fps = state[:, :, 0, 1]
+        fns = state[:, :, 1, 0]
+        precision = _safe_divide(tps, tps + fps)
+        recall = _safe_divide(tps, tps + fns)
+        precision = jnp.concatenate([precision, jnp.ones((1, num_classes), dtype=precision.dtype)], axis=0).T
+        recall = jnp.concatenate([recall, jnp.zeros((1, num_classes), dtype=recall.dtype)], axis=0).T
+        return precision, recall, thresholds
+
+    preds, target = state
+    precision_list, recall_list, thresh_list = [], [], []
+    for i in range(num_classes):
+        res = _binary_precision_recall_curve_compute((preds[:, i], (target == i).astype(jnp.int32)), thresholds=None, pos_label=1)
+        precision_list.append(res[0])
+        recall_list.append(res[1])
+        thresh_list.append(res[2])
+    return precision_list, recall_list, thresh_list
+
+
+def multiclass_precision_recall_curve(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    if validate_args:
+        _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index)
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, thresholds, mask = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index
+    )
+    if thresholds is None and ignore_index is not None:
+        preds, target = _exact_mode_filter(preds, target, thresholds, ignore_index, mask)
+        mask = None
+    state = _multiclass_precision_recall_curve_update(preds, target, num_classes, thresholds, mask)
+    return _multiclass_precision_recall_curve_compute(state, num_classes, thresholds)
+
+
+# --------------------------------------------------------------------------- multilabel
+
+
+def _multilabel_precision_recall_curve_arg_validation(
+    num_labels: int,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+) -> None:
+    _multiclass_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+
+
+def _multilabel_precision_recall_curve_tensor_validation(
+    preds: Array, target: Array, num_labels: int, ignore_index: Optional[int] = None
+) -> None:
+    _check_same_shape(preds, target)
+    if preds.shape[1] != num_labels:
+        raise ValueError("Expected `preds.shape[1]` to be equal to the number of labels")
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        raise ValueError(f"Expected `preds` to be a float tensor, but got {preds.dtype}")
+
+
+def _multilabel_precision_recall_curve_format(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Optional[Array], Array]:
+    preds = jnp.moveaxis(jnp.asarray(preds), 1, -1).reshape(-1, num_labels)
+    target = jnp.moveaxis(jnp.asarray(target), 1, -1).reshape(-1, num_labels)
+    mask = _ignore_mask(target, ignore_index)
+    target = jnp.where(mask, target, 0)
+    preds = _sigmoid_if_logits(preds)
+    thresholds = _adjust_threshold_arg(thresholds)
+    return preds, target, thresholds, mask
+
+
+def _multilabel_precision_recall_curve_update(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    thresholds: Optional[Array],
+    mask: Optional[Array] = None,
+) -> Union[Array, Tuple[Array, Array, Array]]:
+    if thresholds is None:
+        return preds, target, (mask if mask is not None else jnp.ones_like(target, dtype=jnp.bool_))
+    len_t = thresholds.shape[0]
+    w = mask.astype(jnp.float32) if mask is not None else jnp.ones_like(preds)
+    t = target.astype(jnp.float32) * w  # (M, C)
+    preds_t = (preds[None, :, :] >= thresholds[:, None, None]).astype(jnp.float32) * w[None, :, :]  # (T, M, C)
+    tp = jnp.einsum("tmc,mc->tc", preds_t, t)
+    fp = jnp.einsum("tmc,mc->tc", preds_t, w - t)
+    pos = jnp.sum(t, axis=0)
+    total = jnp.sum(w, axis=0)
+    fn = pos[None, :] - tp
+    tn = (total - pos)[None, :] - fp
+    confmat = jnp.stack([jnp.stack([tn, fp], axis=-1), jnp.stack([fn, tp], axis=-1)], axis=-2)
+    return confmat.astype(jnp.int32).reshape(len_t, num_labels, 2, 2)
+
+
+def _multilabel_precision_recall_curve_compute(
+    state,
+    num_labels: int,
+    thresholds: Optional[Array],
+    ignore_index: Optional[int] = None,
+):
+    if isinstance(state, Array) and thresholds is not None:
+        return _multiclass_precision_recall_curve_compute(state, num_labels, thresholds)
+    preds, target, mask = state
+    precision_list, recall_list, thresh_list = [], [], []
+    for i in range(num_labels):
+        p, t, m = preds[:, i], target[:, i], mask[:, i]
+        if _value_check_possible(m):
+            p, t = p[m], t[m]
+        res = _binary_precision_recall_curve_compute((p, t), thresholds=None, pos_label=1)
+        precision_list.append(res[0])
+        recall_list.append(res[1])
+        thresh_list.append(res[2])
+    return precision_list, recall_list, thresh_list
+
+
+def multilabel_precision_recall_curve(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    if validate_args:
+        _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, thresholds, mask = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    state = _multilabel_precision_recall_curve_update(preds, target, num_labels, thresholds, mask)
+    return _multilabel_precision_recall_curve_compute(state, num_labels, thresholds, ignore_index)
+
+
+def precision_recall_curve(
+    preds: Array,
+    target: Array,
+    task: str,
+    thresholds: Thresholds = None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    task = str(task).lower()
+    if task == "binary":
+        return binary_precision_recall_curve(preds, target, thresholds, ignore_index, validate_args)
+    if task == "multiclass":
+        assert isinstance(num_classes, int)
+        return multiclass_precision_recall_curve(preds, target, num_classes, thresholds, ignore_index, validate_args)
+    if task == "multilabel":
+        assert isinstance(num_labels, int)
+        return multilabel_precision_recall_curve(preds, target, num_labels, thresholds, ignore_index, validate_args)
+    raise ValueError(f"Expected argument `task` to either be 'binary', 'multiclass' or 'multilabel' but got {task}")

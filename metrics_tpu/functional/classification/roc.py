@@ -1,0 +1,184 @@
+"""ROC curve functionals.
+
+Reference parity: src/torchmetrics/functional/classification/roc.py
+(binary/multiclass/multilabel ``_*_roc_compute`` reusing the PRC state pipeline).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.classification.precision_recall_curve import (
+    Thresholds,
+    _exact_mode_filter,
+    _binary_clf_curve,
+    _binary_precision_recall_curve_arg_validation,
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_tensor_validation,
+    _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_arg_validation,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_tensor_validation,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_arg_validation,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_tensor_validation,
+    _multilabel_precision_recall_curve_update,
+)
+from metrics_tpu.utils.checks import _value_check_possible
+from metrics_tpu.utils.compute import _safe_divide
+
+
+def _binary_roc_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    thresholds: Optional[Array],
+    pos_label: int = 1,
+) -> Tuple[Array, Array, Array]:
+    """Reference roc.py ``_binary_roc_compute``."""
+    if isinstance(state, Array) and thresholds is not None:
+        tps = state[:, 1, 1]
+        fps = state[:, 0, 1]
+        fns = state[:, 1, 0]
+        tns = state[:, 0, 0]
+        tpr = _safe_divide(tps, tps + fns)[::-1]
+        fpr = _safe_divide(fps, fps + tns)[::-1]
+        thres = thresholds[::-1]
+        return fpr, tpr, thres
+
+    preds, target = state
+    fps, tps, thres = _binary_clf_curve(preds, target, pos_label=pos_label)
+    # add an extra threshold so the curve starts at (0, 0)
+    tps = jnp.concatenate([jnp.zeros(1, dtype=tps.dtype), tps])
+    fps = jnp.concatenate([jnp.zeros(1, dtype=fps.dtype), fps])
+    thres = jnp.concatenate([(thres[:1] + 1.0), thres])
+    fpr = _safe_divide(fps, fps[-1])
+    tpr = _safe_divide(tps, tps[-1])
+    return fpr, tpr, thres
+
+
+def binary_roc(
+    preds: Array,
+    target: Array,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array, Array]:
+    if validate_args:
+        _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, thresholds, mask = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
+    if thresholds is None and ignore_index is not None:
+        preds, target = _exact_mode_filter(preds, target, thresholds, ignore_index, mask)
+        mask = None
+    state = _binary_precision_recall_curve_update(preds, target, thresholds, mask)
+    return _binary_roc_compute(state, thresholds)
+
+
+def _multiclass_roc_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    num_classes: int,
+    thresholds: Optional[Array],
+):
+    if isinstance(state, Array) and thresholds is not None:
+        tps = state[:, :, 1, 1]
+        fps = state[:, :, 0, 1]
+        fns = state[:, :, 1, 0]
+        tns = state[:, :, 0, 0]
+        tpr = _safe_divide(tps, tps + fns)[::-1].T
+        fpr = _safe_divide(fps, fps + tns)[::-1].T
+        thres = thresholds[::-1]
+        return fpr, tpr, thres
+
+    preds, target = state
+    fpr_list, tpr_list, thres_list = [], [], []
+    for i in range(num_classes):
+        res = _binary_roc_compute((preds[:, i], (target == i).astype(jnp.int32)), thresholds=None, pos_label=1)
+        fpr_list.append(res[0])
+        tpr_list.append(res[1])
+        thres_list.append(res[2])
+    return fpr_list, tpr_list, thres_list
+
+
+def multiclass_roc(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    if validate_args:
+        _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index)
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, thresholds, mask = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index
+    )
+    if thresholds is None and ignore_index is not None:
+        preds, target = _exact_mode_filter(preds, target, thresholds, ignore_index, mask)
+        mask = None
+    state = _multiclass_precision_recall_curve_update(preds, target, num_classes, thresholds, mask)
+    return _multiclass_roc_compute(state, num_classes, thresholds)
+
+
+def _multilabel_roc_compute(
+    state,
+    num_labels: int,
+    thresholds: Optional[Array],
+    ignore_index: Optional[int] = None,
+):
+    if isinstance(state, Array) and thresholds is not None:
+        return _multiclass_roc_compute(state, num_labels, thresholds)
+    preds, target, mask = state
+    fpr_list, tpr_list, thres_list = [], [], []
+    for i in range(num_labels):
+        p, t, m = preds[:, i], target[:, i], mask[:, i]
+        if _value_check_possible(m):
+            p, t = p[m], t[m]
+        res = _binary_roc_compute((p, t), thresholds=None, pos_label=1)
+        fpr_list.append(res[0])
+        tpr_list.append(res[1])
+        thres_list.append(res[2])
+    return fpr_list, tpr_list, thres_list
+
+
+def multilabel_roc(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    if validate_args:
+        _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, thresholds, mask = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    state = _multilabel_precision_recall_curve_update(preds, target, num_labels, thresholds, mask)
+    return _multilabel_roc_compute(state, num_labels, thresholds, ignore_index)
+
+
+def roc(
+    preds: Array,
+    target: Array,
+    task: str,
+    thresholds: Thresholds = None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    task = str(task).lower()
+    if task == "binary":
+        return binary_roc(preds, target, thresholds, ignore_index, validate_args)
+    if task == "multiclass":
+        assert isinstance(num_classes, int)
+        return multiclass_roc(preds, target, num_classes, thresholds, ignore_index, validate_args)
+    if task == "multilabel":
+        assert isinstance(num_labels, int)
+        return multilabel_roc(preds, target, num_labels, thresholds, ignore_index, validate_args)
+    raise ValueError(f"Expected argument `task` to either be 'binary', 'multiclass' or 'multilabel' but got {task}")

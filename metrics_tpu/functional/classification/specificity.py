@@ -1,0 +1,63 @@
+"""Specificity functionals.
+
+Reference parity: src/torchmetrics/functional/classification/specificity.py.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.classification._pipeline import binary_pipeline, multiclass_pipeline, multilabel_pipeline
+from metrics_tpu.utils.compute import _adjust_weights_safe_divide, _safe_divide
+
+
+def _specificity_reduce(
+    tp: Array,
+    fp: Array,
+    tn: Array,
+    fn: Array,
+    average: Optional[str],
+    multidim_average: str = "global",
+    multilabel: bool = False,
+) -> Array:
+    if average == "binary":
+        return _safe_divide(tn, tn + fp)
+    if average == "micro":
+        axis = 0 if multidim_average == "global" else 1
+        tn = jnp.sum(tn, axis=axis)
+        fp = jnp.sum(fp, axis=axis)
+        return _safe_divide(tn, tn + fp)
+    specificity_score = _safe_divide(tn, tn + fp)
+    return _adjust_weights_safe_divide(specificity_score, average, multilabel, tp, fp, fn)
+
+
+def binary_specificity(preds, target, threshold=0.5, multidim_average="global", ignore_index=None, validate_args=True) -> Array:
+    tp, fp, tn, fn = binary_pipeline(preds, target, threshold, multidim_average, ignore_index, validate_args)
+    return _specificity_reduce(tp, fp, tn, fn, average="binary", multidim_average=multidim_average)
+
+
+def multiclass_specificity(preds, target, num_classes, average="macro", top_k=1, multidim_average="global", ignore_index=None, validate_args=True) -> Array:
+    tp, fp, tn, fn = multiclass_pipeline(preds, target, num_classes, average, top_k, multidim_average, ignore_index, validate_args)
+    return _specificity_reduce(tp, fp, tn, fn, average=average, multidim_average=multidim_average)
+
+
+def multilabel_specificity(preds, target, num_labels, threshold=0.5, average="macro", multidim_average="global", ignore_index=None, validate_args=True) -> Array:
+    tp, fp, tn, fn = multilabel_pipeline(preds, target, num_labels, threshold, average, multidim_average, ignore_index, validate_args)
+    return _specificity_reduce(tp, fp, tn, fn, average=average, multidim_average=multidim_average, multilabel=True)
+
+
+def specificity(
+    preds, target, task, threshold=0.5, num_classes=None, num_labels=None, average="micro",
+    multidim_average="global", top_k=1, ignore_index=None, validate_args=True,
+) -> Array:
+    task = str(task).lower()
+    if task == "binary":
+        return binary_specificity(preds, target, threshold, multidim_average, ignore_index, validate_args)
+    if task == "multiclass":
+        return multiclass_specificity(preds, target, num_classes, average, top_k, multidim_average, ignore_index, validate_args)
+    if task == "multilabel":
+        return multilabel_specificity(preds, target, num_labels, threshold, average, multidim_average, ignore_index, validate_args)
+    raise ValueError(f"Expected argument `task` to either be 'binary', 'multiclass' or 'multilabel' but got {task}")

@@ -1,0 +1,496 @@
+"""Stat-scores (tp/fp/tn/fn) functional pipeline — the foundation of the
+classification package.
+
+Reference parity: src/torchmetrics/functional/classification/stat_scores.py — the
+5-stage decomposition ``_<task>_stat_scores_{arg_validation, tensor_validation, format,
+update, compute}`` (binary :25-138, multiclass :212-440, multilabel :552-693).
+
+TPU-first redesign (SURVEY §7.1):
+
+- ``ignore_index`` is a **0-weight mask**, not boolean filtering (static shapes).
+- Per-class counting is one-hot arithmetic (rides the MXU), not index scatter.
+- Logit auto-detection ("apply sigmoid if preds outside [0,1]") uses ``lax.cond`` on a
+  traced predicate so it stays value-exact *and* jittable.
+- Value-dependent validation only runs on concrete arrays (auto ``validate_args=False``
+  inside jit).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax, Array
+
+from metrics_tpu.utils.checks import _check_same_shape, _value_check_possible
+from metrics_tpu.utils.compute import _safe_divide
+from metrics_tpu.utils.data import select_topk
+
+# --------------------------------------------------------------------------- helpers
+
+
+def _sigmoid_if_logits(preds: Array) -> Array:
+    """Apply sigmoid iff any value is outside [0,1] (value-exact, trace-safe)."""
+    if _value_check_possible(preds):
+        if bool(jnp.any((preds < 0) | (preds > 1))):
+            return jax.nn.sigmoid(preds)
+        return preds
+    return lax.cond(jnp.any((preds < 0) | (preds > 1)), jax.nn.sigmoid, lambda x: x, preds)
+
+
+def _softmax_if_logits(preds: Array, axis: int = 1) -> Array:
+    """Apply softmax iff preds don't already sum to 1 along ``axis``."""
+    if _value_check_possible(preds):
+        if not bool(jnp.allclose(jnp.sum(preds, axis=axis), 1.0, atol=1e-4)):
+            return jax.nn.softmax(preds, axis=axis)
+        return preds
+    return lax.cond(
+        jnp.allclose(jnp.sum(preds, axis=axis), 1.0, atol=1e-4), lambda x: x, lambda x: jax.nn.softmax(x, axis=axis), preds
+    )
+
+
+def _ignore_mask(target: Array, ignore_index: Optional[int]) -> Array:
+    """Boolean weight mask that zeroes out ignored positions."""
+    if ignore_index is None:
+        return jnp.ones_like(target, dtype=jnp.bool_)
+    return target != ignore_index
+
+
+# --------------------------------------------------------------------------- binary
+
+
+def _binary_stat_scores_arg_validation(
+    threshold: float = 0.5,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+) -> None:
+    """Reference stat_scores.py:25-45."""
+    if not (isinstance(threshold, float) and (0 <= threshold <= 1)):
+        raise ValueError(f"Expected argument `threshold` to be a float in the [0,1] range, but got {threshold}.")
+    allowed_multidim_average = ("global", "samplewise")
+    if multidim_average not in allowed_multidim_average:
+        raise ValueError(
+            f"Expected argument `multidim_average` to be one of {allowed_multidim_average}, but got {multidim_average}"
+        )
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+
+
+def _binary_stat_scores_tensor_validation(
+    preds: Array,
+    target: Array,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+) -> None:
+    """Reference stat_scores.py:47-86."""
+    _check_same_shape(preds, target)
+    if jnp.issubdtype(target.dtype, jnp.floating):
+        raise ValueError("Expected argument `target` to be an int or bool tensor, but got a float tensor.")
+    if _value_check_possible(target):
+        unique_values = set(jnp.unique(target).tolist())
+        allowed = {0, 1} if ignore_index is None else {0, 1, ignore_index}
+        if not unique_values.issubset(allowed):
+            raise RuntimeError(
+                f"Detected the following values in `target`: {sorted(unique_values)} but expected only"
+                f" the following values {sorted(allowed)}."
+            )
+    if jnp.issubdtype(preds.dtype, jnp.floating):
+        pass  # probs/logits — resolved in format
+    elif _value_check_possible(preds):
+        unique_values = set(jnp.unique(preds).tolist())
+        if not unique_values.issubset({0, 1}):
+            raise RuntimeError(
+                f"Detected the following values in `preds`: {sorted(unique_values)} but expected only"
+                " the following values [0,1] since preds is a label tensor."
+            )
+    if multidim_average != "global" and preds.ndim < 2:
+        raise ValueError("Expected input to be at least 2D when multidim_average is set to `samplewise`")
+
+
+def _binary_stat_scores_format(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Array]:
+    """→ flattened ``(N, X)`` 0/1 preds & target + weight mask (reference :88-115).
+
+    Divergence from reference (by design): instead of filtering ``ignore_index``
+    positions out, returns a 0/1 ``mask`` with the same shape — static-shape safe.
+    """
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if jnp.issubdtype(preds.dtype, jnp.floating):
+        preds = _sigmoid_if_logits(preds)
+        preds = (preds > threshold).astype(jnp.int32)
+    else:
+        preds = preds.astype(jnp.int32)
+    mask = _ignore_mask(target, ignore_index)
+    target = jnp.where(mask, target, 0).astype(jnp.int32)
+    preds = jnp.where(mask, preds, 0)
+
+    preds = preds.reshape(preds.shape[0], -1)
+    target = target.reshape(target.shape[0], -1)
+    mask = mask.reshape(mask.shape[0], -1)
+    return preds, target, mask
+
+
+def _binary_stat_scores_update(
+    preds: Array,
+    target: Array,
+    mask: Array,
+    multidim_average: str = "global",
+) -> Tuple[Array, Array, Array, Array]:
+    """Count tp/fp/tn/fn, masked (reference :117-129)."""
+    m = mask.astype(jnp.int32)
+    axis = None if multidim_average == "global" else 1
+    tp = jnp.sum((preds * target) * m, axis=axis)
+    fn = jnp.sum(((1 - preds) * target) * m, axis=axis)
+    fp = jnp.sum((preds * (1 - target)) * m, axis=axis)
+    tn = jnp.sum(((1 - preds) * (1 - target)) * m, axis=axis)
+    return tp, fp, tn, fn
+
+
+def _binary_stat_scores_compute(
+    tp: Array, fp: Array, tn: Array, fn: Array, multidim_average: str = "global"
+) -> Array:
+    """Stack to [tp, fp, tn, fn, support] (reference :131-138)."""
+    return jnp.stack([tp, fp, tn, fn, tp + fn], axis=0 if multidim_average == "global" else 1)
+
+
+def binary_stat_scores(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Compute tp/fp/tn/fn for binary tasks (reference stat_scores.py:141-209)."""
+    if validate_args:
+        _binary_stat_scores_arg_validation(threshold, multidim_average, ignore_index)
+        _binary_stat_scores_tensor_validation(preds, target, multidim_average, ignore_index)
+    preds, target, mask = _binary_stat_scores_format(preds, target, threshold, ignore_index)
+    tp, fp, tn, fn = _binary_stat_scores_update(preds, target, mask, multidim_average)
+    return _binary_stat_scores_compute(tp, fp, tn, fn, multidim_average)
+
+
+# --------------------------------------------------------------------------- multiclass
+
+
+def _multiclass_stat_scores_arg_validation(
+    num_classes: int,
+    top_k: int = 1,
+    average: Optional[str] = "macro",
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+) -> None:
+    """Reference stat_scores.py:212-245."""
+    if not isinstance(num_classes, int) or num_classes < 2:
+        raise ValueError(f"Expected argument `num_classes` to be an integer larger than 1, but got {num_classes}")
+    if not isinstance(top_k, int) or top_k < 1:
+        raise ValueError(f"Expected argument `top_k` to be an integer larger than or equal to 1, but got {top_k}")
+    if top_k > num_classes:
+        raise ValueError(
+            f"Expected argument `top_k` to be smaller or equal to `num_classes` but got {top_k} and {num_classes}"
+        )
+    allowed_average = ("micro", "macro", "weighted", "none", None)
+    if average not in allowed_average:
+        raise ValueError(f"Expected argument `average` to be one of {allowed_average}, but got {average}")
+    allowed_multidim_average = ("global", "samplewise")
+    if multidim_average not in allowed_multidim_average:
+        raise ValueError(
+            f"Expected argument `multidim_average` to be one of {allowed_multidim_average}, but got {multidim_average}"
+        )
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+
+
+def _multiclass_stat_scores_tensor_validation(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+) -> None:
+    """Reference stat_scores.py:247-316."""
+    if preds.ndim == target.ndim + 1:
+        if not jnp.issubdtype(preds.dtype, jnp.floating):
+            raise ValueError("If `preds` have one dimension more than `target`, `preds` should be a float tensor.")
+        if preds.shape[1] != num_classes:
+            raise ValueError("If `preds` have one dimension more than `target`, `preds.shape[1]` should be"
+                             " equal to number of classes.")
+        if preds.shape[2:] != target.shape[1:]:
+            raise ValueError("If `preds` have one dimension more than `target`, the shape of `preds` should be"
+                             " (N, C, ...), and the shape of `target` should be (N, ...).")
+        if multidim_average != "global" and preds.ndim < 3:
+            raise ValueError("If `preds` have one dimension more than `target`, the shape of `preds` should "
+                             "at least be of shape (N, C, ...) when multidim_average is set to `samplewise`")
+    elif preds.ndim == target.ndim:
+        if preds.shape != target.shape:
+            raise ValueError("The `preds` and `target` should have the same shape,"
+                             f" got `preds` with shape={preds.shape} and `target` with shape={target.shape}.")
+        if multidim_average != "global" and preds.ndim < 2:
+            raise ValueError("When `preds` and `target` have the same shape, the shape should be (N, ...) with at"
+                             " least 2 dimensions when multidim_average is set to `samplewise`")
+        if jnp.issubdtype(preds.dtype, jnp.floating):
+            raise ValueError("If `preds` and `target` have the same shape, `preds` should be an int tensor.")
+    else:
+        raise ValueError("Either `preds` and `target` both should have the (same) shape (N, ...), or `target` should be"
+                         " (N, ...) and `preds` should be (N, C, ...).")
+
+    if _value_check_possible(target):
+        num_unique = int(jnp.max(target, initial=0)) + 1
+        check = num_unique > (num_classes if ignore_index is None else num_classes + 1)
+        if (ignore_index is None and int(jnp.min(target)) < 0) or check:
+            raise RuntimeError(f"Detected more unique values in `target` than `num_classes`. Expected only up to"
+                               f" {num_classes} but found up to {num_unique}.")
+    if _value_check_possible(preds) and not jnp.issubdtype(preds.dtype, jnp.floating):
+        if int(jnp.max(preds, initial=0)) + 1 > num_classes:
+            raise RuntimeError("Detected more unique values in `preds` than `num_classes`.")
+
+
+def _multiclass_stat_scores_format(
+    preds: Array,
+    target: Array,
+    top_k: int = 1,
+) -> Tuple[Array, Array]:
+    """Flatten extra dims → preds ``(N, C, X)`` probs (or ``(N, X)`` labels), target ``(N, X)``.
+
+    Reference stat_scores.py:318-334.
+    """
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if jnp.issubdtype(preds.dtype, jnp.floating):
+        if top_k == 1:
+            preds = jnp.argmax(preds, axis=1)
+            preds = preds.reshape(preds.shape[0], -1)
+        else:
+            preds = preds.reshape(preds.shape[0], preds.shape[1], -1)
+    else:
+        preds = preds.reshape(preds.shape[0], -1)
+    target = target.reshape(target.shape[0], -1)
+    return preds, target
+
+
+def _multiclass_stat_scores_update(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    top_k: int = 1,
+    average: Optional[str] = "macro",
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Array, Array]:
+    """Per-class tp/fp/tn/fn via one-hot arithmetic (MXU-friendly).
+
+    Reference stat_scores.py:336-410 computes a confusion matrix by bincount; the
+    one-hot formulation here lowers to batched matmul/reduction and needs no scatter.
+    Output shapes: global → ``(C,)``; samplewise → ``(N, C)``.
+    """
+    mask = _ignore_mask(target, ignore_index)
+    target_ = jnp.where(mask, target, 0).astype(jnp.int32)
+    m = mask.astype(jnp.float32)
+
+    oh_target = jax.nn.one_hot(target_, num_classes, dtype=jnp.float32) * m[..., None]  # (N, X, C)
+
+    if preds.ndim == 3:  # (N, C, X) probs with top_k > 1
+        topk_mask = select_topk(preds, top_k, dim=1)  # (N, C, X)
+        oh_preds = jnp.moveaxis(topk_mask, 1, -1).astype(jnp.float32) * m[..., None]  # (N, X, C)
+    else:
+        oh_preds = jax.nn.one_hot(preds.astype(jnp.int32), num_classes, dtype=jnp.float32) * m[..., None]
+
+    sum_axes = (0, 1) if multidim_average == "global" else (1,)
+    tp = jnp.sum(oh_preds * oh_target, axis=sum_axes)
+    fp = jnp.sum(oh_preds * (1.0 - oh_target), axis=sum_axes)
+    fn = jnp.sum((1.0 - oh_preds) * oh_target, axis=sum_axes)
+    # tn must only count non-ignored positions: scale by mask
+    tn = jnp.sum((1.0 - oh_preds) * (1.0 - oh_target) * m[..., None], axis=sum_axes)
+    return tp.astype(jnp.int32), fp.astype(jnp.int32), tn.astype(jnp.int32), fn.astype(jnp.int32)
+
+
+def _multiclass_stat_scores_compute(
+    tp: Array, fp: Array, tn: Array, fn: Array, average: Optional[str] = "macro", multidim_average: str = "global"
+) -> Array:
+    """Reference stat_scores.py:412-437."""
+    res = jnp.stack([tp, fp, tn, fn, tp + fn], axis=-1)
+    if average == "micro":
+        return jnp.sum(res, axis=-2)
+    if average in ("macro", "weighted"):
+        return res  # averaging happens in the derived metric formulas
+    return res
+
+
+def multiclass_stat_scores(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    average: Optional[str] = "macro",
+    top_k: int = 1,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Compute tp/fp/tn/fn for multiclass tasks (reference stat_scores.py:440-530)."""
+    if validate_args:
+        _multiclass_stat_scores_arg_validation(num_classes, top_k, average, multidim_average, ignore_index)
+        _multiclass_stat_scores_tensor_validation(preds, target, num_classes, multidim_average, ignore_index)
+    preds, target = _multiclass_stat_scores_format(preds, target, top_k)
+    tp, fp, tn, fn = _multiclass_stat_scores_update(
+        preds, target, num_classes, top_k, average, multidim_average, ignore_index
+    )
+    return _multiclass_stat_scores_compute(tp, fp, tn, fn, average, multidim_average)
+
+
+# --------------------------------------------------------------------------- multilabel
+
+
+def _multilabel_stat_scores_arg_validation(
+    num_labels: int,
+    threshold: float = 0.5,
+    average: Optional[str] = "macro",
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+) -> None:
+    """Reference stat_scores.py:552-581."""
+    if not isinstance(num_labels, int) or num_labels < 2:
+        raise ValueError(f"Expected argument `num_labels` to be an integer larger than 1, but got {num_labels}")
+    if not (isinstance(threshold, float) and (0 <= threshold <= 1)):
+        raise ValueError(f"Expected argument `threshold` to be a float, but got {threshold}.")
+    allowed_average = ("micro", "macro", "weighted", "none", None)
+    if average not in allowed_average:
+        raise ValueError(f"Expected argument `average` to be one of {allowed_average}, but got {average}")
+    allowed_multidim_average = ("global", "samplewise")
+    if multidim_average not in allowed_multidim_average:
+        raise ValueError(
+            f"Expected argument `multidim_average` to be one of {allowed_multidim_average}, but got {multidim_average}"
+        )
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+
+
+def _multilabel_stat_scores_tensor_validation(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+) -> None:
+    """Reference stat_scores.py:583-630."""
+    _check_same_shape(preds, target)
+    if preds.shape[1] != num_labels:
+        raise ValueError(
+            "Expected both `target.shape[1]` and `preds.shape[1]` to be equal to the number of labels"
+        )
+    if jnp.issubdtype(target.dtype, jnp.floating):
+        raise ValueError("Expected argument `target` to be an int or bool tensor, but got a float tensor.")
+    if _value_check_possible(target):
+        unique_values = set(jnp.unique(target).tolist())
+        allowed = {0, 1} if ignore_index is None else {0, 1, ignore_index}
+        if not unique_values.issubset(allowed):
+            raise RuntimeError(
+                f"Detected the following values in `target`: {sorted(unique_values)} but expected only"
+                f" the following values {sorted(allowed)}."
+            )
+    if multidim_average != "global" and preds.ndim < 3:
+        raise ValueError("Expected input to be at least 3D when multidim_average is set to `samplewise`")
+
+
+def _multilabel_stat_scores_format(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Array]:
+    """→ ``(N, C, X)`` 0/1 preds & target + mask (reference stat_scores.py:632-654)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if jnp.issubdtype(preds.dtype, jnp.floating):
+        preds = _sigmoid_if_logits(preds)
+        preds = (preds > threshold).astype(jnp.int32)
+    else:
+        preds = preds.astype(jnp.int32)
+    mask = _ignore_mask(target, ignore_index)
+    target = jnp.where(mask, target, 0).astype(jnp.int32)
+    preds = jnp.where(mask, preds, 0)
+    preds = preds.reshape(preds.shape[0], preds.shape[1], -1)
+    target = target.reshape(target.shape[0], target.shape[1], -1)
+    mask = mask.reshape(mask.shape[0], mask.shape[1], -1)
+    return preds, target, mask
+
+
+def _multilabel_stat_scores_update(
+    preds: Array,
+    target: Array,
+    mask: Array,
+    multidim_average: str = "global",
+) -> Tuple[Array, Array, Array, Array]:
+    """Reference stat_scores.py:656-666. Output: global → ``(C,)``; samplewise → ``(N, C)``."""
+    m = mask.astype(jnp.int32)
+    sum_axes = (0, 2) if multidim_average == "global" else (2,)
+    tp = jnp.sum((preds * target) * m, axis=sum_axes)
+    fn = jnp.sum(((1 - preds) * target) * m, axis=sum_axes)
+    fp = jnp.sum((preds * (1 - target)) * m, axis=sum_axes)
+    tn = jnp.sum(((1 - preds) * (1 - target)) * m, axis=sum_axes)
+    return tp, fp, tn, fn
+
+
+def _multilabel_stat_scores_compute(
+    tp: Array, fp: Array, tn: Array, fn: Array, average: Optional[str] = "macro", multidim_average: str = "global"
+) -> Array:
+    """Reference stat_scores.py:668-690."""
+    res = jnp.stack([tp, fp, tn, fn, tp + fn], axis=-1)
+    if average == "micro":
+        return jnp.sum(res, axis=-2)
+    return res
+
+
+def multilabel_stat_scores(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    threshold: float = 0.5,
+    average: Optional[str] = "macro",
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Compute tp/fp/tn/fn for multilabel tasks (reference stat_scores.py:693-780)."""
+    if validate_args:
+        _multilabel_stat_scores_arg_validation(num_labels, threshold, average, multidim_average, ignore_index)
+        _multilabel_stat_scores_tensor_validation(preds, target, num_labels, multidim_average, ignore_index)
+    preds, target, mask = _multilabel_stat_scores_format(preds, target, num_labels, threshold, ignore_index)
+    tp, fp, tn, fn = _multilabel_stat_scores_update(preds, target, mask, multidim_average)
+    return _multilabel_stat_scores_compute(tp, fp, tn, fn, average, multidim_average)
+
+
+def stat_scores(
+    preds: Array,
+    target: Array,
+    task: str,
+    threshold: float = 0.5,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    average: Optional[str] = "micro",
+    multidim_average: str = "global",
+    top_k: int = 1,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Task-dispatch façade (reference stat_scores.py:783-…)."""
+    task = str(task).lower()
+    if task == "binary":
+        return binary_stat_scores(preds, target, threshold, multidim_average, ignore_index, validate_args)
+    if task == "multiclass":
+        assert isinstance(num_classes, int)
+        return multiclass_stat_scores(
+            preds, target, num_classes, average, top_k, multidim_average, ignore_index, validate_args
+        )
+    if task == "multilabel":
+        assert isinstance(num_labels, int)
+        return multilabel_stat_scores(
+            preds, target, num_labels, threshold, average, multidim_average, ignore_index, validate_args
+        )
+    raise ValueError(f"Expected argument `task` to either be 'binary', 'multiclass' or 'multilabel' but got {task}")

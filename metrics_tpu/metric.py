@@ -73,6 +73,9 @@ class Metric(ABC):
     is_differentiable: Optional[bool] = None
     higher_is_better: Optional[bool] = None
     full_state_update: Optional[bool] = False
+    # True when compute() cannot run inside a trace (data-dependent shapes) — e.g.
+    # exact-mode curve metrics; sync still works in-trace, compute happens on host.
+    _host_compute: bool = False
 
     def __init__(self, **kwargs: Any) -> None:
         self._device = None

@@ -1,0 +1,29 @@
+"""sklearn-based reference implementations shared by the classification tests.
+
+Mirrors the reference-comparison philosophy of tests/unittests/classification/*:
+every metric is checked against an independent sklearn implementation on the same data.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from tests.helpers.testers import THRESHOLD
+
+
+def binarize(preds: np.ndarray, threshold: float = THRESHOLD) -> np.ndarray:
+    """probs/logits/labels → 0/1 labels, mirroring the library's format step."""
+    preds = np.asarray(preds)
+    if np.issubdtype(preds.dtype, np.floating):
+        if (preds < 0).any() or (preds > 1).any():  # logits
+            preds = 1 / (1 + np.exp(-preds))
+        return (preds > threshold).astype(np.int32)
+    return preds.astype(np.int32)
+
+
+def mc_labels(preds: np.ndarray) -> np.ndarray:
+    """multiclass probs (N, C, ...) → labels (N, ...)."""
+    preds = np.asarray(preds)
+    if np.issubdtype(preds.dtype, np.floating):
+        return preds.argmax(axis=1)
+    return preds

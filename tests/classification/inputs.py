@@ -1,0 +1,68 @@
+"""Deterministic classification input fixtures.
+
+Port of tests/unittests/classification/inputs.py: parametrized suites over
+{labels, probs, logits} × {single-dim, multi-dim}, seeded at import.
+"""
+
+from collections import namedtuple
+
+import numpy as np
+
+from tests.helpers import seed_all
+from tests.helpers.testers import BATCH_SIZE, EXTRA_DIM, NUM_BATCHES, NUM_CLASSES
+
+seed_all(1)
+
+Input = namedtuple("Input", ["preds", "target"])
+
+_rng = np.random.default_rng(42)
+
+_binary_labels = Input(
+    preds=_rng.integers(0, 2, size=(NUM_BATCHES, BATCH_SIZE)).astype(np.int32),
+    target=_rng.integers(0, 2, size=(NUM_BATCHES, BATCH_SIZE)).astype(np.int32),
+)
+_binary_probs = Input(
+    preds=_rng.uniform(size=(NUM_BATCHES, BATCH_SIZE)).astype(np.float32),
+    target=_rng.integers(0, 2, size=(NUM_BATCHES, BATCH_SIZE)).astype(np.int32),
+)
+_binary_logits = Input(
+    preds=_rng.normal(size=(NUM_BATCHES, BATCH_SIZE)).astype(np.float32),
+    target=_rng.integers(0, 2, size=(NUM_BATCHES, BATCH_SIZE)).astype(np.int32),
+)
+_binary_multidim_probs = Input(
+    preds=_rng.uniform(size=(NUM_BATCHES, BATCH_SIZE, EXTRA_DIM)).astype(np.float32),
+    target=_rng.integers(0, 2, size=(NUM_BATCHES, BATCH_SIZE, EXTRA_DIM)).astype(np.int32),
+)
+
+_multiclass_labels = Input(
+    preds=_rng.integers(0, NUM_CLASSES, size=(NUM_BATCHES, BATCH_SIZE)).astype(np.int32),
+    target=_rng.integers(0, NUM_CLASSES, size=(NUM_BATCHES, BATCH_SIZE)).astype(np.int32),
+)
+def _make_softmax(shape):
+    x = _rng.normal(size=shape).astype(np.float32)
+    e = np.exp(x - x.max(axis=-1, keepdims=True))
+    return e / e.sum(axis=-1, keepdims=True)
+
+
+# per-batch slices have the (N, C, ...) layout metrics expect
+_multiclass_probs = Input(
+    preds=_make_softmax((NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)),
+    target=_rng.integers(0, NUM_CLASSES, size=(NUM_BATCHES, BATCH_SIZE)).astype(np.int32),
+)
+_multiclass_logits = Input(
+    preds=_rng.normal(size=(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)).astype(np.float32),
+    target=_rng.integers(0, NUM_CLASSES, size=(NUM_BATCHES, BATCH_SIZE)).astype(np.int32),
+)
+_multiclass_multidim_probs = Input(
+    preds=np.moveaxis(_make_softmax((NUM_BATCHES, BATCH_SIZE, EXTRA_DIM, NUM_CLASSES)), -1, 2),
+    target=_rng.integers(0, NUM_CLASSES, size=(NUM_BATCHES, BATCH_SIZE, EXTRA_DIM)).astype(np.int32),
+)
+
+_multilabel_probs = Input(
+    preds=_rng.uniform(size=(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)).astype(np.float32),
+    target=_rng.integers(0, 2, size=(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)).astype(np.int32),
+)
+_multilabel_logits = Input(
+    preds=_rng.normal(size=(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)).astype(np.float32),
+    target=_rng.integers(0, 2, size=(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)).astype(np.int32),
+)

@@ -1,0 +1,134 @@
+"""Accuracy tests vs sklearn (port of tests/unittests/classification/test_accuracy.py)."""
+
+import numpy as np
+import pytest
+from sklearn.metrics import accuracy_score as sk_accuracy_score
+from sklearn.metrics import recall_score as sk_recall_score
+
+from metrics_tpu.classification import BinaryAccuracy, MulticlassAccuracy, MultilabelAccuracy
+from metrics_tpu.functional.classification import binary_accuracy, multiclass_accuracy, multilabel_accuracy
+from tests.classification._refs import binarize, mc_labels
+from tests.classification.inputs import (
+    _binary_labels,
+    _binary_logits,
+    _binary_probs,
+    _multiclass_logits,
+    _multiclass_probs,
+    _multilabel_probs,
+)
+from tests.helpers.testers import NUM_CLASSES, MetricTester
+
+
+def _sk_binary_accuracy(preds, target):
+    return sk_accuracy_score(target.flatten(), binarize(preds).flatten())
+
+
+def _sk_multiclass_accuracy(average):
+    def fn(preds, target):
+        labels = mc_labels(preds).flatten()
+        target = target.flatten()
+        if average == "micro":
+            return sk_accuracy_score(target, labels)
+        return sk_recall_score(target, labels, average=average, labels=list(range(NUM_CLASSES)), zero_division=0)
+
+    return fn
+
+
+def _sk_multilabel_accuracy_micro(preds, target):
+    p = binarize(preds).flatten()
+    return sk_accuracy_score(target.flatten(), p)
+
+
+@pytest.mark.parametrize("inputs", [_binary_labels, _binary_probs, _binary_logits])
+class TestBinaryAccuracy(MetricTester):
+    atol = 1e-6
+
+    def test_binary_accuracy(self, inputs):
+        self.run_class_metric_test(
+            preds=inputs.preds,
+            target=inputs.target,
+            metric_class=BinaryAccuracy,
+            reference_metric=_sk_binary_accuracy,
+        )
+
+    def test_binary_accuracy_functional(self, inputs):
+        self.run_functional_metric_test(
+            preds=inputs.preds,
+            target=inputs.target,
+            metric_functional=binary_accuracy,
+            reference_metric=_sk_binary_accuracy,
+        )
+
+    def test_binary_accuracy_half(self, inputs):
+        self.run_precision_test_cpu(inputs.preds, inputs.target, BinaryAccuracy, binary_accuracy)
+
+
+@pytest.mark.parametrize("inputs", [_multiclass_probs, _multiclass_logits])
+@pytest.mark.parametrize("average", ["micro", "macro", "weighted"])
+class TestMulticlassAccuracy(MetricTester):
+    atol = 1e-6
+
+    def test_multiclass_accuracy(self, inputs, average):
+        self.run_class_metric_test(
+            preds=inputs.preds,
+            target=inputs.target,
+            metric_class=MulticlassAccuracy,
+            reference_metric=_sk_multiclass_accuracy(average),
+            metric_args={"num_classes": NUM_CLASSES, "average": average},
+        )
+
+    def test_multiclass_accuracy_functional(self, inputs, average):
+        self.run_functional_metric_test(
+            preds=inputs.preds,
+            target=inputs.target,
+            metric_functional=multiclass_accuracy,
+            reference_metric=_sk_multiclass_accuracy(average),
+            metric_args={"num_classes": NUM_CLASSES, "average": average},
+        )
+
+
+class TestMultilabelAccuracy(MetricTester):
+    atol = 1e-6
+
+    def test_multilabel_accuracy_micro(self):
+        self.run_class_metric_test(
+            preds=_multilabel_probs.preds,
+            target=_multilabel_probs.target,
+            metric_class=MultilabelAccuracy,
+            reference_metric=_sk_multilabel_accuracy_micro,
+            metric_args={"num_labels": NUM_CLASSES, "average": "micro"},
+        )
+
+    def test_multilabel_accuracy_functional(self):
+        self.run_functional_metric_test(
+            preds=_multilabel_probs.preds,
+            target=_multilabel_probs.target,
+            metric_functional=multilabel_accuracy,
+            reference_metric=_sk_multilabel_accuracy_micro,
+            metric_args={"num_labels": NUM_CLASSES, "average": "micro"},
+        )
+
+
+def test_multiclass_accuracy_ignore_index():
+    rng = np.random.default_rng(3)
+    logits = rng.normal(size=(100, NUM_CLASSES)).astype(np.float32)
+    target = rng.integers(0, NUM_CLASSES, 100)
+    target[:10] = -1
+    import jax.numpy as jnp
+
+    res = multiclass_accuracy(jnp.asarray(logits), jnp.asarray(target), NUM_CLASSES, average="micro", ignore_index=-1)
+    keep = target != -1
+    expected = sk_accuracy_score(target[keep], logits.argmax(1)[keep])
+    np.testing.assert_allclose(np.asarray(res), expected, atol=1e-6)
+
+
+def test_multiclass_accuracy_top_k():
+    rng = np.random.default_rng(4)
+    logits = rng.normal(size=(200, NUM_CLASSES)).astype(np.float32)
+    target = rng.integers(0, NUM_CLASSES, 200)
+    import jax.numpy as jnp
+    from sklearn.metrics import top_k_accuracy_score
+
+    res = multiclass_accuracy(jnp.asarray(logits), jnp.asarray(target), NUM_CLASSES, average="micro", top_k=2)
+    expected = top_k_accuracy_score(target, logits, k=2, labels=list(range(NUM_CLASSES)))
+    np.testing.assert_allclose(np.asarray(res), expected, atol=1e-6)

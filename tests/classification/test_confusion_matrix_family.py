@@ -1,0 +1,221 @@
+"""ConfusionMatrix / CohenKappa / MatthewsCorrCoef / JaccardIndex / ExactMatch tests
+vs sklearn (port of the corresponding tests/unittests/classification/test_*.py files)."""
+
+import numpy as np
+import pytest
+from sklearn.metrics import cohen_kappa_score as sk_cohen_kappa
+from sklearn.metrics import confusion_matrix as sk_confusion_matrix
+from sklearn.metrics import jaccard_score as sk_jaccard
+from sklearn.metrics import matthews_corrcoef as sk_matthews
+from sklearn.metrics import multilabel_confusion_matrix as sk_multilabel_confusion_matrix
+
+from metrics_tpu.classification import (
+    BinaryCohenKappa,
+    BinaryConfusionMatrix,
+    BinaryJaccardIndex,
+    BinaryMatthewsCorrCoef,
+    MulticlassCohenKappa,
+    MulticlassConfusionMatrix,
+    MulticlassExactMatch,
+    MulticlassJaccardIndex,
+    MulticlassMatthewsCorrCoef,
+    MultilabelConfusionMatrix,
+    MultilabelExactMatch,
+    MultilabelJaccardIndex,
+)
+from metrics_tpu.functional.classification import (
+    binary_cohen_kappa,
+    binary_confusion_matrix,
+    multiclass_cohen_kappa,
+    multiclass_confusion_matrix,
+    multiclass_exact_match,
+    multilabel_confusion_matrix,
+    multilabel_exact_match,
+)
+from tests.classification._refs import binarize, mc_labels
+from tests.classification.inputs import _binary_probs, _multiclass_logits, _multilabel_probs
+from tests.helpers.testers import NUM_CLASSES, MetricTester
+
+
+def _sk_binary_cm(preds, target):
+    return sk_confusion_matrix(target.flatten(), binarize(preds).flatten(), labels=[0, 1])
+
+
+def _sk_multiclass_cm(preds, target):
+    return sk_confusion_matrix(target.flatten(), mc_labels(preds).flatten(), labels=list(range(NUM_CLASSES)))
+
+
+def _sk_multilabel_cm(preds, target):
+    return sk_multilabel_confusion_matrix(target.reshape(-1, NUM_CLASSES), binarize(preds).reshape(-1, NUM_CLASSES))
+
+
+def _sk_binary_kappa(preds, target):
+    return sk_cohen_kappa(target.flatten(), binarize(preds).flatten())
+
+
+def _sk_multiclass_kappa(preds, target):
+    return sk_cohen_kappa(target.flatten(), mc_labels(preds).flatten())
+
+
+def _sk_binary_mcc(preds, target):
+    return sk_matthews(target.flatten(), binarize(preds).flatten())
+
+
+def _sk_multiclass_mcc(preds, target):
+    return sk_matthews(target.flatten(), mc_labels(preds).flatten())
+
+
+def _sk_binary_jaccard(preds, target):
+    return sk_jaccard(target.flatten(), binarize(preds).flatten())
+
+
+def _sk_multiclass_jaccard(preds, target):
+    return sk_jaccard(target.flatten(), mc_labels(preds).flatten(), average="macro", labels=list(range(NUM_CLASSES)))
+
+
+def _sk_multiclass_em(preds, target):
+    return (mc_labels(preds).reshape(target.shape) == target).all(-1).mean() if target.ndim > 1 else (
+        mc_labels(preds).flatten() == target.flatten()
+    ).mean()
+
+
+class TestConfusionMatrix(MetricTester):
+    atol = 1e-8
+
+    def test_binary(self):
+        self.run_class_metric_test(
+            preds=_binary_probs.preds, target=_binary_probs.target,
+            metric_class=BinaryConfusionMatrix, reference_metric=_sk_binary_cm,
+        )
+        self.run_functional_metric_test(
+            preds=_binary_probs.preds, target=_binary_probs.target,
+            metric_functional=binary_confusion_matrix, reference_metric=_sk_binary_cm,
+        )
+
+    def test_multiclass(self):
+        self.run_class_metric_test(
+            preds=_multiclass_logits.preds, target=_multiclass_logits.target,
+            metric_class=MulticlassConfusionMatrix, reference_metric=_sk_multiclass_cm,
+            metric_args={"num_classes": NUM_CLASSES},
+        )
+        self.run_functional_metric_test(
+            preds=_multiclass_logits.preds, target=_multiclass_logits.target,
+            metric_functional=multiclass_confusion_matrix, reference_metric=_sk_multiclass_cm,
+            metric_args={"num_classes": NUM_CLASSES},
+        )
+
+    def test_multilabel(self):
+        self.run_class_metric_test(
+            preds=_multilabel_probs.preds, target=_multilabel_probs.target,
+            metric_class=MultilabelConfusionMatrix, reference_metric=_sk_multilabel_cm,
+            metric_args={"num_labels": NUM_CLASSES},
+        )
+
+    @pytest.mark.parametrize("normalize", ["true", "pred", "all", None])
+    def test_multiclass_normalize(self, normalize):
+        import jax.numpy as jnp
+
+        preds = _multiclass_logits.preds[0]
+        target = _multiclass_logits.target[0]
+        res = multiclass_confusion_matrix(jnp.asarray(preds), jnp.asarray(target), NUM_CLASSES, normalize=normalize)
+        expected = sk_confusion_matrix(
+            target.flatten(), mc_labels(preds).flatten(), labels=list(range(NUM_CLASSES)),
+            normalize=normalize,
+        )
+        np.testing.assert_allclose(np.asarray(res), expected, atol=1e-6)
+
+
+class TestCohenKappa(MetricTester):
+    atol = 1e-6
+
+    def test_binary(self):
+        self.run_class_metric_test(
+            preds=_binary_probs.preds, target=_binary_probs.target,
+            metric_class=BinaryCohenKappa, reference_metric=_sk_binary_kappa,
+        )
+
+    def test_multiclass(self):
+        self.run_class_metric_test(
+            preds=_multiclass_logits.preds, target=_multiclass_logits.target,
+            metric_class=MulticlassCohenKappa, reference_metric=_sk_multiclass_kappa,
+            metric_args={"num_classes": NUM_CLASSES},
+        )
+
+    @pytest.mark.parametrize("weights", ["linear", "quadratic"])
+    def test_multiclass_weighted(self, weights):
+        import jax.numpy as jnp
+
+        preds = _multiclass_logits.preds[0]
+        target = _multiclass_logits.target[0]
+        res = multiclass_cohen_kappa(jnp.asarray(preds), jnp.asarray(target), NUM_CLASSES, weights=weights)
+        expected = sk_cohen_kappa(target.flatten(), mc_labels(preds).flatten(), weights=weights)
+        np.testing.assert_allclose(np.asarray(res), expected, atol=1e-6)
+
+
+class TestMatthewsCorrCoef(MetricTester):
+    atol = 1e-6
+
+    def test_binary(self):
+        self.run_class_metric_test(
+            preds=_binary_probs.preds, target=_binary_probs.target,
+            metric_class=BinaryMatthewsCorrCoef, reference_metric=_sk_binary_mcc,
+        )
+
+    def test_multiclass(self):
+        self.run_class_metric_test(
+            preds=_multiclass_logits.preds, target=_multiclass_logits.target,
+            metric_class=MulticlassMatthewsCorrCoef, reference_metric=_sk_multiclass_mcc,
+            metric_args={"num_classes": NUM_CLASSES},
+        )
+
+
+class TestJaccardIndex(MetricTester):
+    atol = 1e-6
+
+    def test_binary(self):
+        self.run_class_metric_test(
+            preds=_binary_probs.preds, target=_binary_probs.target,
+            metric_class=BinaryJaccardIndex, reference_metric=_sk_binary_jaccard,
+        )
+
+    def test_multiclass(self):
+        self.run_class_metric_test(
+            preds=_multiclass_logits.preds, target=_multiclass_logits.target,
+            metric_class=MulticlassJaccardIndex, reference_metric=_sk_multiclass_jaccard,
+            metric_args={"num_classes": NUM_CLASSES, "average": "macro"},
+        )
+
+    def test_multilabel_micro(self):
+        def ref(preds, target):
+            return sk_jaccard(
+                target.reshape(-1, NUM_CLASSES), binarize(preds).reshape(-1, NUM_CLASSES), average="micro"
+            )
+
+        self.run_class_metric_test(
+            preds=_multilabel_probs.preds, target=_multilabel_probs.target,
+            metric_class=MultilabelJaccardIndex, reference_metric=ref,
+            metric_args={"num_labels": NUM_CLASSES, "average": "micro"},
+        )
+
+
+class TestExactMatch(MetricTester):
+    atol = 1e-6
+
+    def test_multiclass(self):
+        self.run_class_metric_test(
+            preds=_multiclass_logits.preds, target=_multiclass_logits.target,
+            metric_class=MulticlassExactMatch, reference_metric=_sk_multiclass_em,
+            metric_args={"num_classes": NUM_CLASSES},
+        )
+
+    def test_multilabel(self):
+        def ref(preds, target):
+            p = binarize(preds).reshape(-1, NUM_CLASSES)
+            t = target.reshape(-1, NUM_CLASSES)
+            return (p == t).all(-1).mean()
+
+        self.run_class_metric_test(
+            preds=_multilabel_probs.preds, target=_multilabel_probs.target,
+            metric_class=MultilabelExactMatch, reference_metric=ref,
+            metric_args={"num_labels": NUM_CLASSES},
+        )

@@ -1,0 +1,187 @@
+"""PRC / ROC / AUROC / AveragePrecision tests vs sklearn (port of
+tests/unittests/classification/{test_precision_recall_curve, test_roc, test_auroc,
+test_average_precision}.py). Covers both exact (list-state) and binned (confmat-state)
+regimes."""
+
+import numpy as np
+import pytest
+from sklearn.metrics import average_precision_score as sk_ap
+from sklearn.metrics import precision_recall_curve as sk_prc
+from sklearn.metrics import roc_auc_score as sk_auroc
+from sklearn.metrics import roc_curve as sk_roc
+
+from metrics_tpu.classification import (
+    BinaryAUROC,
+    BinaryAveragePrecision,
+    BinaryPrecisionRecallCurve,
+    BinaryROC,
+    MulticlassAUROC,
+    MulticlassAveragePrecision,
+    MultilabelAUROC,
+)
+from metrics_tpu.functional.classification import (
+    binary_auroc,
+    binary_average_precision,
+    binary_precision_recall_curve,
+    binary_roc,
+    multiclass_auroc,
+    multiclass_average_precision,
+    multilabel_auroc,
+)
+from tests.classification.inputs import _binary_probs, _multiclass_probs, _multilabel_probs
+from tests.helpers.testers import NUM_CLASSES, MetricTester
+
+
+def _softmax(x):
+    e = np.exp(x - x.max(-1, keepdims=True))
+    return e / e.sum(-1, keepdims=True)
+
+
+def _sk_binary_auroc(preds, target):
+    return sk_auroc(target.flatten(), preds.flatten())
+
+
+def _sk_binary_ap(preds, target):
+    return sk_ap(target.flatten(), preds.flatten())
+
+
+def _sk_multiclass_auroc(average):
+    def fn(preds, target):
+        p = np.moveaxis(preds, 1, -1).reshape(-1, NUM_CLASSES)
+        return sk_auroc(target.flatten(), p, multi_class="ovr", average=average, labels=list(range(NUM_CLASSES)))
+
+    return fn
+
+
+class TestBinaryCurves(MetricTester):
+    atol = 1e-5
+
+    def test_binary_auroc_exact(self):
+        self.run_class_metric_test(
+            preds=_binary_probs.preds, target=_binary_probs.target,
+            metric_class=BinaryAUROC, reference_metric=_sk_binary_auroc,
+        )
+        self.run_functional_metric_test(
+            preds=_binary_probs.preds, target=_binary_probs.target,
+            metric_functional=binary_auroc, reference_metric=_sk_binary_auroc,
+        )
+
+    def test_binary_auroc_binned_close(self):
+        """Binned AUROC converges to exact as T grows."""
+        import jax.numpy as jnp
+
+        preds = np.concatenate([p for p in _binary_probs.preds])
+        target = np.concatenate([t for t in _binary_probs.target])
+        exact = sk_auroc(target, preds)
+        binned = binary_auroc(jnp.asarray(preds), jnp.asarray(target), thresholds=500)
+        assert abs(float(binned) - exact) < 5e-3
+
+    def test_binary_auroc_binned_sharded(self):
+        """Binned AUROC state syncs exactly across the device mesh."""
+        self.run_class_metric_test(
+            preds=_binary_probs.preds, target=_binary_probs.target,
+            metric_class=BinaryAUROC,
+            reference_metric=lambda p, t: float(
+                __import__("jax").numpy.asarray(
+                    binary_auroc(
+                        __import__("jax").numpy.asarray(p.flatten()),
+                        __import__("jax").numpy.asarray(t.flatten()),
+                        thresholds=100,
+                    )
+                )
+            ),
+            metric_args={"thresholds": 100},
+            check_batch=False,
+            atol=1e-5,
+        )
+
+    def test_binary_ap(self):
+        self.run_class_metric_test(
+            preds=_binary_probs.preds, target=_binary_probs.target,
+            metric_class=BinaryAveragePrecision, reference_metric=_sk_binary_ap,
+        )
+        self.run_functional_metric_test(
+            preds=_binary_probs.preds, target=_binary_probs.target,
+            metric_functional=binary_average_precision, reference_metric=_sk_binary_ap,
+        )
+
+    def test_binary_roc_exact_matches_sklearn(self):
+        import jax.numpy as jnp
+
+        preds = _binary_probs.preds[0]
+        target = _binary_probs.target[0]
+        fpr, tpr, thr = binary_roc(jnp.asarray(preds), jnp.asarray(target))
+        sk_fpr, sk_tpr, sk_thr = sk_roc(target, preds, drop_intermediate=False)
+        np.testing.assert_allclose(np.asarray(fpr), sk_fpr, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(tpr), sk_tpr, atol=1e-6)
+
+    def test_binary_prc_exact_matches_sklearn(self):
+        """sklearn ≥1.x keeps the full curve; the reference trims at full recall
+        (precision_recall_curve.py:27-76) — compare on the common prefix."""
+        import jax.numpy as jnp
+
+        preds = _binary_probs.preds[0]
+        target = _binary_probs.target[0]
+        prec, rec, thr = binary_precision_recall_curve(jnp.asarray(preds), jnp.asarray(target))
+        skp, skr, skt = sk_prc(target, preds)
+        n = len(prec) - 1  # points before the appended (1, 0) endpoint
+        offset = len(skp) - 1 - n  # sklearn keeps extra points past full recall
+        np.testing.assert_allclose(np.asarray(prec)[:-1], skp[offset:-1], atol=1e-6)
+        np.testing.assert_allclose(np.asarray(rec)[:-1], skr[offset:-1], atol=1e-6)
+        assert float(prec[-1]) == 1.0 and float(rec[-1]) == 0.0
+
+    def test_binary_prc_module_exact(self):
+        import jax.numpy as jnp
+
+        m = BinaryPrecisionRecallCurve()
+        for i in range(4):
+            m.update(jnp.asarray(_binary_probs.preds[i]), jnp.asarray(_binary_probs.target[i]))
+        prec, rec, thr = m.compute()
+        all_p = np.concatenate(list(_binary_probs.preds[:4]))
+        all_t = np.concatenate(list(_binary_probs.target[:4]))
+        skp, skr, _ = sk_prc(all_t, all_p)
+        np.testing.assert_allclose(np.asarray(prec), skp, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(rec), skr, atol=1e-6)
+
+
+@pytest.mark.parametrize("average", ["macro", "weighted"])
+class TestMulticlassAUROC(MetricTester):
+    atol = 2e-5
+
+    def test_multiclass_auroc(self, average):
+        self.run_class_metric_test(
+            preds=_multiclass_probs.preds, target=_multiclass_probs.target,
+            metric_class=MulticlassAUROC, reference_metric=_sk_multiclass_auroc(average),
+            metric_args={"num_classes": NUM_CLASSES, "average": average},
+        )
+
+    def test_multiclass_ap(self, average):
+        def ref(preds, target):
+            p = np.moveaxis(preds, 1, -1).reshape(-1, NUM_CLASSES)
+            t = target.flatten()
+            scores = [sk_ap((t == i).astype(int), p[:, i]) for i in range(NUM_CLASSES)]
+            if average == "macro":
+                return np.mean(scores)
+            w = np.bincount(t, minlength=NUM_CLASSES).astype(float)
+            return float(np.sum(np.array(scores) * w / w.sum()))
+
+        self.run_class_metric_test(
+            preds=_multiclass_probs.preds, target=_multiclass_probs.target,
+            metric_class=MulticlassAveragePrecision, reference_metric=ref,
+            metric_args={"num_classes": NUM_CLASSES, "average": average},
+        )
+
+
+class TestMultilabelAUROC(MetricTester):
+    atol = 2e-5
+
+    @pytest.mark.parametrize("average", ["micro", "macro"])
+    def test_multilabel_auroc(self, average):
+        def ref(preds, target):
+            return sk_auroc(target.reshape(-1, NUM_CLASSES), preds.reshape(-1, NUM_CLASSES), average=average)
+
+        self.run_class_metric_test(
+            preds=_multilabel_probs.preds, target=_multilabel_probs.target,
+            metric_class=MultilabelAUROC, reference_metric=ref,
+            metric_args={"num_labels": NUM_CLASSES, "average": average},
+        )

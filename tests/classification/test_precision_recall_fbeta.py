@@ -1,0 +1,161 @@
+"""Precision/Recall/F-beta/Specificity/Hamming tests vs sklearn.
+
+Port of tests/unittests/classification/{test_precision_recall, test_f_beta,
+test_specificity, test_hamming_distance}.py.
+"""
+
+import numpy as np
+import pytest
+from sklearn.metrics import fbeta_score as sk_fbeta
+from sklearn.metrics import hamming_loss as sk_hamming_loss
+from sklearn.metrics import precision_score as sk_precision
+from sklearn.metrics import recall_score as sk_recall
+
+from metrics_tpu.classification import (
+    BinaryF1Score,
+    BinaryFBetaScore,
+    BinaryHammingDistance,
+    BinaryPrecision,
+    BinaryRecall,
+    BinarySpecificity,
+    MulticlassF1Score,
+    MulticlassPrecision,
+    MulticlassRecall,
+    MultilabelF1Score,
+    MultilabelPrecision,
+    MultilabelRecall,
+)
+from metrics_tpu.functional.classification import (
+    binary_f1_score,
+    binary_fbeta_score,
+    binary_hamming_distance,
+    binary_precision,
+    binary_recall,
+    binary_specificity,
+    multiclass_f1_score,
+    multiclass_precision,
+    multiclass_recall,
+    multilabel_f1_score,
+    multilabel_precision,
+    multilabel_recall,
+)
+from tests.classification._refs import binarize, mc_labels
+from tests.classification.inputs import _binary_probs, _multiclass_logits, _multilabel_logits
+from tests.helpers.testers import NUM_CLASSES, MetricTester
+
+
+def _sk_bin(sk_fn):
+    def fn(preds, target, **kw):
+        return sk_fn(target.flatten(), binarize(preds).flatten(), **kw)
+
+    return fn
+
+
+def _sk_mc(sk_fn, average, **extra):
+    def fn(preds, target):
+        return sk_fn(
+            target.flatten(), mc_labels(preds).flatten(), average=average,
+            labels=list(range(NUM_CLASSES)), zero_division=0, **extra,
+        )
+
+    return fn
+
+
+def _sk_ml(sk_fn, average, **extra):
+    def fn(preds, target):
+        return sk_fn(
+            target.reshape(-1, NUM_CLASSES), binarize(preds).reshape(-1, NUM_CLASSES),
+            average=average, zero_division=0, **extra,
+        )
+
+    return fn
+
+
+def _sk_binary_specificity(preds, target):
+    from sklearn.metrics import confusion_matrix
+
+    tn, fp, fn, tp = confusion_matrix(target.flatten(), binarize(preds).flatten(), labels=[0, 1]).ravel()
+    return tn / (tn + fp) if (tn + fp) else 0.0
+
+
+class TestBinaryFamily(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize(
+        "metric_class, metric_fn, sk_ref",
+        [
+            (BinaryPrecision, binary_precision, _sk_bin(sk_precision)),
+            (BinaryRecall, binary_recall, _sk_bin(sk_recall)),
+            (BinaryF1Score, binary_f1_score, _sk_bin(lambda t, p: sk_fbeta(t, p, beta=1.0))),
+            (BinaryHammingDistance, binary_hamming_distance, _sk_bin(sk_hamming_loss)),
+            (BinarySpecificity, binary_specificity, _sk_binary_specificity),
+        ],
+    )
+    def test_binary_class_and_functional(self, metric_class, metric_fn, sk_ref):
+        self.run_class_metric_test(
+            preds=_binary_probs.preds, target=_binary_probs.target,
+            metric_class=metric_class, reference_metric=sk_ref,
+        )
+        self.run_functional_metric_test(
+            preds=_binary_probs.preds, target=_binary_probs.target,
+            metric_functional=metric_fn, reference_metric=sk_ref,
+        )
+
+    def test_binary_fbeta2(self):
+        ref = _sk_bin(lambda t, p: sk_fbeta(t, p, beta=2.0))
+        self.run_class_metric_test(
+            preds=_binary_probs.preds, target=_binary_probs.target,
+            metric_class=BinaryFBetaScore, reference_metric=ref, metric_args={"beta": 2.0},
+        )
+
+
+@pytest.mark.parametrize("average", ["micro", "macro", "weighted", None])
+class TestMulticlassFamily(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize(
+        "metric_class, metric_fn, sk_fn",
+        [
+            (MulticlassPrecision, multiclass_precision, sk_precision),
+            (MulticlassRecall, multiclass_recall, sk_recall),
+            (MulticlassF1Score, multiclass_f1_score, lambda t, p, **kw: sk_fbeta(t, p, beta=1.0, **kw)),
+        ],
+    )
+    def test_multiclass_class_and_functional(self, metric_class, metric_fn, sk_fn, average):
+        ref = _sk_mc(sk_fn, average)
+        self.run_class_metric_test(
+            preds=_multiclass_logits.preds, target=_multiclass_logits.target,
+            metric_class=metric_class, reference_metric=ref,
+            metric_args={"num_classes": NUM_CLASSES, "average": average},
+        )
+        self.run_functional_metric_test(
+            preds=_multiclass_logits.preds, target=_multiclass_logits.target,
+            metric_functional=metric_fn, reference_metric=ref,
+            metric_args={"num_classes": NUM_CLASSES, "average": average},
+        )
+
+
+@pytest.mark.parametrize("average", ["micro", "macro", "weighted", None])
+class TestMultilabelFamily(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize(
+        "metric_class, metric_fn, sk_fn",
+        [
+            (MultilabelPrecision, multilabel_precision, sk_precision),
+            (MultilabelRecall, multilabel_recall, sk_recall),
+            (MultilabelF1Score, multilabel_f1_score, lambda t, p, **kw: sk_fbeta(t, p, beta=1.0, **kw)),
+        ],
+    )
+    def test_multilabel_class_and_functional(self, metric_class, metric_fn, sk_fn, average):
+        ref = _sk_ml(sk_fn, average)
+        self.run_class_metric_test(
+            preds=_multilabel_logits.preds, target=_multilabel_logits.target,
+            metric_class=metric_class, reference_metric=ref,
+            metric_args={"num_labels": NUM_CLASSES, "average": average},
+        )
+        self.run_functional_metric_test(
+            preds=_multilabel_logits.preds, target=_multilabel_logits.target,
+            metric_functional=metric_fn, reference_metric=ref,
+            metric_args={"num_labels": NUM_CLASSES, "average": average},
+        )

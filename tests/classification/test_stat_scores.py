@@ -1,0 +1,114 @@
+"""StatScores tests vs sklearn (port of tests/unittests/classification/test_stat_scores.py)."""
+
+import numpy as np
+import pytest
+from sklearn.metrics import confusion_matrix as sk_confusion_matrix
+from sklearn.metrics import multilabel_confusion_matrix as sk_multilabel_confusion_matrix
+
+from metrics_tpu.classification import BinaryStatScores, MulticlassStatScores, MultilabelStatScores, StatScores
+from metrics_tpu.functional.classification import binary_stat_scores, multiclass_stat_scores, multilabel_stat_scores
+from tests.classification._refs import binarize, mc_labels
+from tests.classification.inputs import _binary_probs, _multiclass_logits, _multilabel_probs
+from tests.helpers.testers import NUM_CLASSES, MetricTester
+
+
+def _sk_binary_stat_scores(preds, target):
+    p = binarize(preds).flatten()
+    t = target.flatten()
+    tn, fp, fn, tp = sk_confusion_matrix(t, p, labels=[0, 1]).ravel()
+    return np.array([tp, fp, tn, fn, tp + fn])
+
+
+def _sk_multiclass_stat_scores_none(preds, target):
+    labels = mc_labels(preds).flatten()
+    t = target.flatten()
+    cm = sk_multilabel_confusion_matrix(t, labels, labels=list(range(NUM_CLASSES)))
+    tn, fp, fn, tp = cm[:, 0, 0], cm[:, 0, 1], cm[:, 1, 0], cm[:, 1, 1]
+    return np.stack([tp, fp, tn, fn, tp + fn], axis=-1)
+
+
+def _sk_multilabel_stat_scores_none(preds, target):
+    p = binarize(preds).reshape(-1, NUM_CLASSES)
+    t = target.reshape(-1, NUM_CLASSES)
+    cm = sk_multilabel_confusion_matrix(t, p)
+    tn, fp, fn, tp = cm[:, 0, 0], cm[:, 0, 1], cm[:, 1, 0], cm[:, 1, 1]
+    return np.stack([tp, fp, tn, fn, tp + fn], axis=-1)
+
+
+class TestBinaryStatScores(MetricTester):
+    atol = 1e-8
+
+    def test_binary_stat_scores(self):
+        self.run_class_metric_test(
+            preds=_binary_probs.preds,
+            target=_binary_probs.target,
+            metric_class=BinaryStatScores,
+            reference_metric=_sk_binary_stat_scores,
+        )
+
+    def test_binary_stat_scores_functional(self):
+        self.run_functional_metric_test(
+            preds=_binary_probs.preds,
+            target=_binary_probs.target,
+            metric_functional=binary_stat_scores,
+            reference_metric=_sk_binary_stat_scores,
+        )
+
+
+class TestMulticlassStatScores(MetricTester):
+    atol = 1e-8
+
+    def test_multiclass_stat_scores_none(self):
+        self.run_class_metric_test(
+            preds=_multiclass_logits.preds,
+            target=_multiclass_logits.target,
+            metric_class=MulticlassStatScores,
+            reference_metric=_sk_multiclass_stat_scores_none,
+            metric_args={"num_classes": NUM_CLASSES, "average": None},
+        )
+
+    def test_multiclass_stat_scores_functional(self):
+        self.run_functional_metric_test(
+            preds=_multiclass_logits.preds,
+            target=_multiclass_logits.target,
+            metric_functional=multiclass_stat_scores,
+            reference_metric=_sk_multiclass_stat_scores_none,
+            metric_args={"num_classes": NUM_CLASSES, "average": None},
+        )
+
+
+class TestMultilabelStatScores(MetricTester):
+    atol = 1e-8
+
+    def test_multilabel_stat_scores_none(self):
+        self.run_class_metric_test(
+            preds=_multilabel_probs.preds,
+            target=_multilabel_probs.target,
+            metric_class=MultilabelStatScores,
+            reference_metric=_sk_multilabel_stat_scores_none,
+            metric_args={"num_labels": NUM_CLASSES, "average": None},
+        )
+
+
+def test_stat_scores_facade_dispatch():
+    assert isinstance(StatScores(task="binary"), BinaryStatScores)
+    assert isinstance(StatScores(task="multiclass", num_classes=3), MulticlassStatScores)
+    assert isinstance(StatScores(task="multilabel", num_labels=3), MultilabelStatScores)
+    with pytest.raises(ValueError):
+        StatScores(task="bogus")
+
+
+def test_samplewise_multidim():
+    """multidim_average='samplewise' returns per-sample stats via list (cat) states."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(5)
+    preds = rng.integers(0, 2, size=(4, 10))
+    target = rng.integers(0, 2, size=(4, 10))
+    m = BinaryStatScores(multidim_average="samplewise")
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    res = np.asarray(m.compute())
+    assert res.shape == (4, 5)
+    for i in range(4):
+        tn, fp, fn, tp = sk_confusion_matrix(target[i], preds[i], labels=[0, 1]).ravel()
+        np.testing.assert_array_equal(res[i], [tp, fp, tn, fn, tp + fn])
