@@ -17,14 +17,28 @@ _logger.addHandler(__logging.StreamHandler())
 _logger.setLevel(__logging.INFO)
 
 from metrics_tpu.aggregation import CatMetric, MaxMetric, MeanMetric, MinMetric, SumMetric  # noqa: E402
+from metrics_tpu.collections import MetricCollection  # noqa: E402
 from metrics_tpu.metric import CompositionalMetric, Metric  # noqa: E402
+from metrics_tpu.wrappers import (  # noqa: E402
+    BootStrapper,
+    ClasswiseWrapper,
+    MetricTracker,
+    MinMaxMetric,
+    MultioutputWrapper,
+)
 
 __all__ = [
+    "BootStrapper",
     "CatMetric",
+    "ClasswiseWrapper",
     "CompositionalMetric",
     "MaxMetric",
     "MeanMetric",
     "Metric",
+    "MetricCollection",
+    "MetricTracker",
+    "MinMaxMetric",
     "MinMetric",
+    "MultioutputWrapper",
     "SumMetric",
 ]

@@ -1,0 +1,353 @@
+"""MetricCollection — dict-of-metrics with shared-state compute groups.
+
+Reference parity: src/torchmetrics/collections.py (class :28, forward :167,
+update :177-202, compute-group machinery :204-282, compute :284).
+
+Compute groups (reference docs claim 2x-3x update-cost reduction,
+docs/source/pages/overview.rst:318-327): metrics whose updates produce identical
+states (e.g. MulticlassPrecision/Recall/F1 over the same stat-scores) are detected
+after the first update by pairwise state comparison; thereafter only the group leader
+updates and members alias its state. With immutable jax.Arrays, aliasing is rebinding
+attributes to the same arrays — the deepcopy escape hatch in ``items()`` etc. keeps
+the reference's copy-on-read semantics.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from copy import deepcopy
+from typing import Any, Dict, Hashable, Iterable, Iterator, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.data import _flatten_dict, allclose
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+class MetricCollection:
+    """Dict of metrics with a single update/forward/compute/reset (reference :28)."""
+
+    _modules: "OrderedDict[str, Metric]"
+
+    def __init__(
+        self,
+        metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]],
+        *additional_metrics: Metric,
+        prefix: Optional[str] = None,
+        postfix: Optional[str] = None,
+        compute_groups: Union[bool, List[List[str]]] = True,
+    ) -> None:
+        self._modules = OrderedDict()
+        self.prefix = self._check_arg(prefix, "prefix")
+        self.postfix = self._check_arg(postfix, "postfix")
+        self._enable_compute_groups = compute_groups
+        self._groups_checked: bool = False
+        self._state_is_copy: bool = False
+        self._groups: Dict[int, List[str]] = {}
+
+        self.add_metrics(metrics, *additional_metrics)
+
+    @staticmethod
+    def _check_arg(arg: Optional[str], name: str) -> Optional[str]:
+        if arg is None or isinstance(arg, str):
+            return arg
+        raise ValueError(f"Expected input `{name}` to be a string, but got {type(arg)}")
+
+    # ------------------------------------------------------------------ construction
+
+    def add_metrics(
+        self, metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]], *additional_metrics: Metric
+    ) -> None:
+        """Add metrics (reference collections.py ``add_metrics``)."""
+        if isinstance(metrics, Metric):
+            metrics = [metrics]
+        if isinstance(metrics, Sequence) and not isinstance(metrics, dict):
+            metrics = list(metrics)
+            remain: list = []
+            for m in additional_metrics:
+                (metrics if isinstance(m, Metric) else remain).append(m)
+            if remain:
+                # reference collections.py:331-332: warn and ignore non-Metric extras
+                rank_zero_warn(
+                    f"You have passed extra arguments {remain} which are not `Metric` so they will be ignored.",
+                    UserWarning,
+                )
+        elif additional_metrics:
+            raise ValueError(
+                f"You have passed extra arguments {additional_metrics} which are not compatible with first passed"
+                " dictionary."
+            )
+
+        if isinstance(metrics, dict):
+            for name in sorted(metrics.keys()):
+                metric = metrics[name]
+                if not isinstance(metric, (Metric, MetricCollection)):
+                    raise ValueError(
+                        f"Value {metric} belonging to key {name} is not an instance of"
+                        " `metrics_tpu.Metric` or `metrics_tpu.MetricCollection`"
+                    )
+                if isinstance(metric, Metric):
+                    self._modules[name] = metric
+                else:
+                    for k, v in metric.items(keep_base=False):
+                        self._modules[f"{name}_{k}"] = v
+        elif isinstance(metrics, Sequence):
+            for metric in metrics:
+                if not isinstance(metric, (Metric, MetricCollection)):
+                    raise ValueError(
+                        f"Input {metric} to `MetricCollection` is not a instance of"
+                        " `metrics_tpu.Metric` or `metrics_tpu.MetricCollection`"
+                    )
+                if isinstance(metric, Metric):
+                    name = metric.__class__.__name__
+                    if name in self._modules:
+                        raise ValueError(f"Encountered two metrics both named {name}")
+                    self._modules[name] = metric
+                else:
+                    for k, v in metric.items(keep_base=False):
+                        self._modules[k] = v
+        else:
+            raise ValueError("Unknown input to MetricCollection.")
+
+        self._groups_checked = False
+        if self._enable_compute_groups:
+            self._init_compute_groups()
+        else:
+            self._groups = {i: [name] for i, name in enumerate(self._modules)}
+
+    def _init_compute_groups(self) -> None:
+        """Initialise compute groups (reference collections.py:~150)."""
+        if isinstance(self._enable_compute_groups, list):
+            self._groups = {i: v for i, v in enumerate(self._enable_compute_groups)}
+            for v in self._groups.values():
+                for metric in v:
+                    if metric not in self._modules:
+                        raise ValueError(
+                            f"Input {metric} in `compute_groups` argument does not match a metric in the collection."
+                        )
+            self._groups_checked = True
+        else:
+            # Initial state: every metric is its own group; merged after first update
+            self._groups = {i: [name] for i, name in enumerate(self._modules)}
+
+    # ------------------------------------------------------------------ dict protocol
+
+    def keys(self, keep_base: bool = False) -> Iterable[str]:
+        if keep_base:
+            return self._modules.keys()
+        return [self._set_name(k) for k in self._modules.keys()]
+
+    def items(self, keep_base: bool = False, copy_state: bool = True) -> Iterable[Tuple[str, Metric]]:
+        self._compute_groups_create_state_ref(copy_state)
+        if keep_base:
+            return self._modules.items()
+        return [(self._set_name(k), v) for k, v in self._modules.items()]
+
+    def values(self, copy_state: bool = True) -> Iterable[Metric]:
+        self._compute_groups_create_state_ref(copy_state)
+        return self._modules.values()
+
+    def __getitem__(self, key: str, copy_state: bool = True) -> Metric:
+        self._compute_groups_create_state_ref(copy_state)
+        return self._modules[key]
+
+    def __len__(self) -> int:
+        return len(self._modules)
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self.keys())
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._modules or key in list(self.keys())
+
+    def _set_name(self, base: str) -> str:
+        name = base if self.prefix is None else self.prefix + base
+        name = name if self.postfix is None else name + self.postfix
+        return name
+
+    # ------------------------------------------------------------------ metric API
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Update each metric once per compute group (reference :177-202)."""
+        if self._groups_checked:
+            # only update the first member of every group
+            for cg in self._groups.values():
+                m0 = self._modules[cg[0]]
+                m0.update(*args, **m0._filter_kwargs(**kwargs))
+            if self._state_is_copy:
+                # If a copy was made, the aliasing is broken — restore it
+                self._compute_groups_create_state_ref(copy=False)
+                self._state_is_copy = False
+        else:
+            # per-metric update until group structure is known
+            for m in self._modules.values():
+                m.update(*args, **m._filter_kwargs(**kwargs))
+            if self._enable_compute_groups and not isinstance(self._enable_compute_groups, list):
+                self._merge_compute_groups()
+            self._groups_checked = True
+
+    def _merge_compute_groups(self) -> None:
+        """O(n²) pairwise state comparison → merged groups (reference :204-238)."""
+        num_groups = len(self._groups)
+        while True:
+            for cg_idx1, cg_members1 in deepcopy(self._groups).items():
+                for cg_idx2, cg_members2 in deepcopy(self._groups).items():
+                    if cg_idx1 == cg_idx2:
+                        continue
+                    metric1 = self._modules[cg_members1[0]]
+                    metric2 = self._modules[cg_members2[0]]
+                    if self._equal_metric_states(metric1, metric2):
+                        self._groups[cg_idx1].extend(self._groups.pop(cg_idx2))
+                        break
+                else:
+                    continue
+                break
+            else:
+                break
+            if len(self._groups) == num_groups:
+                break
+            num_groups = len(self._groups)
+
+        # Re-index
+        self._groups = {i: v for i, v in enumerate(self._groups.values())}
+
+    @staticmethod
+    def _equal_metric_states(metric1: Metric, metric2: Metric) -> bool:
+        """Shape + allclose comparison of all states (reference :240-263)."""
+        if len(metric1._defaults) == 0 or len(metric2._defaults) == 0:
+            return False
+        if metric1._defaults.keys() != metric2._defaults.keys():
+            return False
+        for key in metric1._defaults:
+            state1 = getattr(metric1, key)
+            state2 = getattr(metric2, key)
+            if type(state1) != type(state2):  # noqa: E721
+                return False
+            if isinstance(state1, jax.Array) and isinstance(state2, jax.Array):
+                if state1.shape != state2.shape or state1.dtype != state2.dtype:
+                    return False
+                if not allclose(state1, state2):
+                    return False
+            elif isinstance(state1, list) and isinstance(state2, list):
+                if len(state1) != len(state2):
+                    return False
+                if not all(
+                    s1.shape == s2.shape and allclose(s1, s2) for s1, s2 in zip(state1, state2)
+                ):
+                    return False
+        return True
+
+    def _compute_groups_create_state_ref(self, copy: bool = False) -> None:
+        """Alias (or deepcopy) leader states onto group members (reference :265-282)."""
+        if self._groups_checked:
+            for cg in self._groups.values():
+                m0 = self._modules[cg[0]]
+                for name in cg[1:]:
+                    mi = self._modules[name]
+                    for state in m0._defaults:
+                        m0_state = getattr(m0, state)
+                        # alias the leader's state (immutable arrays: safe to share)
+                        setattr(mi, state, deepcopy(m0_state) if copy else m0_state)
+                    mi._update_count = deepcopy(m0._update_count) if copy else m0._update_count
+                    mi._update_called = m0._update_called
+                    # the member's compute cache predates the refreshed state
+                    mi._computed = None
+        self._state_is_copy = copy
+
+    def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        """Per-batch value from every metric (reference :167-175)."""
+        res = {k: m(*args, **m._filter_kwargs(**kwargs)) for k, m in self._modules.items()}
+        res, _ = _flatten_dict(res)
+        return {self._set_name(k): v for k, v in res.items()}
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        return self.forward(*args, **kwargs)
+
+    def compute(self) -> Dict[str, Any]:
+        """Compute every metric (group members see the leader's synced state)."""
+        self._compute_groups_create_state_ref()
+        res = {k: m.compute() for k, m in self._modules.items()}
+        res, _ = _flatten_dict(res)
+        return {self._set_name(k): v for k, v in res.items()}
+
+    def reset(self) -> None:
+        for m in self._modules.values():
+            m.reset()
+        if self._enable_compute_groups and not isinstance(self._enable_compute_groups, list):
+            # reset group detection: states are all equal (defaults) again
+            self._groups_checked = False
+            self._init_compute_groups()
+
+    def clone(self, prefix: Optional[str] = None, postfix: Optional[str] = None) -> "MetricCollection":
+        """Deep copy with optionally new prefix/postfix (reference :~380)."""
+        mc = deepcopy(self)
+        if prefix is not None:
+            mc.prefix = self._check_arg(prefix, "prefix")
+        if postfix is not None:
+            mc.postfix = self._check_arg(postfix, "postfix")
+        return mc
+
+    def persistent(self, mode: bool = True) -> None:
+        for m in self._modules.values():
+            m.persistent(mode)
+
+    def state_dict(self) -> Dict[str, Any]:
+        destination: Dict[str, Any] = {}
+        for name, m in self._modules.items():
+            m.state_dict(destination, prefix=f"{name}.")
+        return destination
+
+    def load_state_dict(self, state_dict: Dict[str, Any], strict: bool = True) -> None:
+        for name, m in self._modules.items():
+            m.load_state_dict(state_dict, prefix=f"{name}.", strict=strict)
+
+    def to_device(self, device: Any) -> "MetricCollection":
+        for m in self._modules.values():
+            m.to_device(device)
+        return self
+
+    # ------------------------------------------------------------------ functional API (TPU-first)
+
+    def init_state(self) -> Dict[str, Any]:
+        """Per-group state pytree — structural dedup means one state per group."""
+        if not self._groups_checked and self._enable_compute_groups:
+            # without data we can't value-compare; fall back to per-metric states
+            return {name: m.init_state() for name, m in self._modules.items()}
+        return {cg[0]: self._modules[cg[0]].init_state() for cg in self._groups.values()}
+
+    def update_state(self, state: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        """Pure group-deduped update — jit/shard_map safe."""
+        new_state = {}
+        for name, sub in state.items():
+            m = self._modules[name]
+            new_state[name] = m.update_state(sub, *args, **m._filter_kwargs(**kwargs))
+        return new_state
+
+    def compute_from(self, state: Dict[str, Any], axis_name: Optional[Any] = None) -> Dict[str, Any]:
+        """Pure compute for all metrics from the (group-deduped) state pytree."""
+        leader_of = {}
+        for cg in self._groups.values():
+            for name in cg:
+                leader_of[name] = cg[0] if cg[0] in state else name
+        res = {}
+        for name, m in self._modules.items():
+            sub = state.get(name, state.get(leader_of.get(name, name)))
+            res[name] = m.compute_from(sub, axis_name=axis_name)
+        res, _ = _flatten_dict(res)
+        return {self._set_name(k): v for k, v in res.items()}
+
+    @property
+    def compute_groups(self) -> Dict[int, List[str]]:
+        return self._groups
+
+    def __repr__(self) -> str:
+        repr_str = self.__class__.__name__ + "(\n"
+        for name, m in self._modules.items():
+            repr_str += f"  ({name}): {m!r}\n"
+        if self.prefix:
+            repr_str += f"  prefix={self.prefix}\n"
+        if self.postfix:
+            repr_str += f"  postfix={self.postfix}\n"
+        return repr_str + ")"

@@ -1,0 +1,217 @@
+"""Nominal association statistics: Cramér's V, Pearson's contingency, Tschuprow's T,
+Theil's U.
+
+Reference parity: src/torchmetrics/functional/nominal/{cramers,pearson,tschuprows,
+theils_u}.py — χ²-contingency coefficients over a joint confusion matrix, with the
+reference's bias correction and nan handling.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.nominal.utils import (
+    _compute_bias_corrected_dims,
+    _drop_empty_rows_and_cols,
+    _handle_nan_in_data,
+    _joint_confusion_matrix,
+    _nominal_input_validation,
+    _unable_to_compute_warning,
+)
+from metrics_tpu.utils.checks import _value_check_possible
+
+
+def _chi2_phi2(confmat: Array):
+    """chi-squared statistic and phi2 of a contingency table (shared by all three
+    chi2-based coefficients; reference utils.py _compute_chi_squared)."""
+    cm = confmat.astype(jnp.float32)
+    n = jnp.sum(cm)
+    row = jnp.sum(cm, axis=1, keepdims=True)
+    col = jnp.sum(cm, axis=0, keepdims=True)
+    expected = row @ col / n
+    chi2 = jnp.sum(jnp.where(expected > 0, (cm - expected) ** 2 / jnp.where(expected > 0, expected, 1.0), 0.0))
+    return chi2, chi2 / n, n
+
+
+def _num_classes_of(*arrays: Array) -> int:
+    return int(max(int(jnp.max(a, initial=0)) for a in arrays)) + 1
+
+
+def _format_nominal(preds: Array, target: Array, nan_strategy: str, nan_replace_value: Optional[float]):
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if jnp.issubdtype(preds.dtype, jnp.floating) and preds.ndim > 1:
+        preds = jnp.argmax(preds, axis=1)
+    if jnp.issubdtype(target.dtype, jnp.floating) and target.ndim > 1:
+        target = jnp.argmax(target, axis=1)
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    preds, target = _handle_nan_in_data(preds, target, nan_strategy, nan_replace_value)
+    return preds.astype(jnp.int32), target.astype(jnp.int32)
+
+
+def _cramers_v_compute(confmat: Array, bias_correction: bool) -> Array:
+    """Reference cramers.py ``_cramers_v_compute``."""
+    confmat = _drop_empty_rows_and_cols(confmat)
+    _, phi2, n = _chi2_phi2(confmat)
+    r, k = confmat.shape
+    if bias_correction:
+        phi2 = jnp.maximum(0.0, phi2 - (k - 1) * (r - 1) / (n - 1))
+        r_c, k_c = _compute_bias_corrected_dims(confmat)
+        if _value_check_possible(r_c) and (float(r_c) == 1.0 or float(k_c) == 1.0):
+            _unable_to_compute_warning("Cramer's V")
+            return jnp.asarray(jnp.nan)
+        v = jnp.sqrt(phi2 / jnp.minimum(r_c - 1.0, k_c - 1.0))
+    else:
+        v = jnp.sqrt(phi2 / min(r - 1, k - 1))
+    return jnp.clip(v, 0.0, 1.0)
+
+
+def cramers_v(
+    preds: Array,
+    target: Array,
+    bias_correction: bool = True,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Cramér's V (reference functional/nominal/cramers.py)."""
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    preds, target = _format_nominal(preds, target, nan_strategy, nan_replace_value)
+    nc = _num_classes_of(preds, target)
+    confmat = _joint_confusion_matrix(preds, target, nc, nc)
+    return _cramers_v_compute(confmat, bias_correction)
+
+
+def _pearsons_contingency_coefficient_compute(confmat: Array) -> Array:
+    """Reference pearson.py compute."""
+    confmat = _drop_empty_rows_and_cols(confmat)
+    _, phi2, n = _chi2_phi2(confmat)
+    tschuprow = jnp.sqrt(phi2 / (1 + phi2))
+    return jnp.clip(tschuprow, 0.0, 1.0)
+
+
+def pearsons_contingency_coefficient(
+    preds: Array,
+    target: Array,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Pearson's contingency coefficient (reference functional/nominal/pearson.py)."""
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    preds, target = _format_nominal(preds, target, nan_strategy, nan_replace_value)
+    nc = _num_classes_of(preds, target)
+    confmat = _joint_confusion_matrix(preds, target, nc, nc)
+    return _pearsons_contingency_coefficient_compute(confmat)
+
+
+def _tschuprows_t_compute(confmat: Array, bias_correction: bool) -> Array:
+    """Reference tschuprows.py compute."""
+    confmat = _drop_empty_rows_and_cols(confmat)
+    _, phi2, n = _chi2_phi2(confmat)
+    r, k = confmat.shape
+    if bias_correction:
+        phi2 = jnp.maximum(0.0, phi2 - (k - 1) * (r - 1) / (n - 1))
+        r_c, k_c = _compute_bias_corrected_dims(confmat)
+        if _value_check_possible(r_c) and (float(r_c) == 1.0 or float(k_c) == 1.0):
+            _unable_to_compute_warning("Tschuprow's T")
+            return jnp.asarray(jnp.nan)
+        t = jnp.sqrt(phi2 / jnp.sqrt((r_c - 1.0) * (k_c - 1.0)))
+    else:
+        t = jnp.sqrt(phi2 / jnp.sqrt(jnp.asarray(float((r - 1) * (k - 1)))))
+    return jnp.clip(t, 0.0, 1.0)
+
+
+def tschuprows_t(
+    preds: Array,
+    target: Array,
+    bias_correction: bool = True,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Tschuprow's T (reference functional/nominal/tschuprows.py)."""
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    preds, target = _format_nominal(preds, target, nan_strategy, nan_replace_value)
+    nc = _num_classes_of(preds, target)
+    confmat = _joint_confusion_matrix(preds, target, nc, nc)
+    return _tschuprows_t_compute(confmat, bias_correction)
+
+
+def _theils_u_compute(confmat: Array) -> Array:
+    """U(X|Y): uncertainty coefficient (reference theils_u.py compute)."""
+    confmat = _drop_empty_rows_and_cols(confmat)
+    cm = confmat.astype(jnp.float32)
+    total = jnp.sum(cm)
+
+    # H(X)
+    p_x = jnp.sum(cm, axis=1) / total
+    h_x = -jnp.sum(jnp.where(p_x > 0, p_x * jnp.log(jnp.where(p_x > 0, p_x, 1.0)), 0.0))
+
+    # H(X|Y)
+    p_y = jnp.sum(cm, axis=0, keepdims=True) / total
+    p_xy = cm / total
+    h_xy = -jnp.sum(jnp.where(p_xy > 0, p_xy * jnp.log(jnp.where(p_xy > 0, p_xy / p_y, 1.0)), 0.0))
+
+    if _value_check_possible(h_x) and float(h_x) == 0.0:
+        return jnp.asarray(jnp.nan)
+    return (h_x - h_xy) / h_x
+
+
+def theils_u(
+    preds: Array,
+    target: Array,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Theil's U (reference functional/nominal/theils_u.py)."""
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    preds, target = _format_nominal(preds, target, nan_strategy, nan_replace_value)
+    nc = _num_classes_of(preds, target)
+    confmat = _joint_confusion_matrix(preds, target, nc, nc)
+    return _theils_u_compute(confmat)
+
+
+def _matrix(fn, matrix: Array, **kwargs) -> Array:
+    """Pairwise column-association matrix (reference *_matrix functions)."""
+    num_var = matrix.shape[1]
+    out = jnp.ones((num_var, num_var), dtype=jnp.float32)
+    for i in range(num_var):
+        for j in range(num_var):
+            if i == j:
+                continue
+            val = fn(matrix[:, i], matrix[:, j], **kwargs)
+            out = out.at[i, j].set(val)
+    return out
+
+
+def cramers_v_matrix(matrix: Array, bias_correction: bool = True, nan_strategy: str = "replace", nan_replace_value: Optional[float] = 0.0) -> Array:
+    out = jnp.ones((matrix.shape[1], matrix.shape[1]), dtype=jnp.float32)
+    for i in range(matrix.shape[1]):
+        for j in range(i + 1, matrix.shape[1]):
+            val = cramers_v(matrix[:, i], matrix[:, j], bias_correction, nan_strategy, nan_replace_value)
+            out = out.at[i, j].set(val).at[j, i].set(val)
+    return out
+
+
+def pearsons_contingency_coefficient_matrix(matrix: Array, nan_strategy: str = "replace", nan_replace_value: Optional[float] = 0.0) -> Array:
+    out = jnp.ones((matrix.shape[1], matrix.shape[1]), dtype=jnp.float32)
+    for i in range(matrix.shape[1]):
+        for j in range(i + 1, matrix.shape[1]):
+            val = pearsons_contingency_coefficient(matrix[:, i], matrix[:, j], nan_strategy, nan_replace_value)
+            out = out.at[i, j].set(val).at[j, i].set(val)
+    return out
+
+
+def tschuprows_t_matrix(matrix: Array, bias_correction: bool = True, nan_strategy: str = "replace", nan_replace_value: Optional[float] = 0.0) -> Array:
+    out = jnp.ones((matrix.shape[1], matrix.shape[1]), dtype=jnp.float32)
+    for i in range(matrix.shape[1]):
+        for j in range(i + 1, matrix.shape[1]):
+            val = tschuprows_t(matrix[:, i], matrix[:, j], bias_correction, nan_strategy, nan_replace_value)
+            out = out.at[i, j].set(val).at[j, i].set(val)
+    return out
+
+
+def theils_u_matrix(matrix: Array, nan_strategy: str = "replace", nan_replace_value: Optional[float] = 0.0) -> Array:
+    return _matrix(theils_u, matrix, nan_strategy=nan_strategy, nan_replace_value=nan_replace_value)

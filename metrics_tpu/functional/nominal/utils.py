@@ -1,0 +1,78 @@
+"""Shared helpers for nominal association metrics.
+
+Reference parity: src/torchmetrics/functional/nominal/utils.py — χ² statistic, bias
+corrections, nan handling, confusion-matrix construction for label pairs.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+def _nominal_input_validation(nan_strategy: str, nan_replace_value: Optional[float]) -> None:
+    if nan_strategy not in ["replace", "drop"]:
+        raise ValueError(
+            f"Argument `nan_strategy` is expected to be one of `['replace', 'drop']`, but got {nan_strategy}"
+        )
+    if nan_strategy == "replace" and not isinstance(nan_replace_value, (int, float)):
+        raise ValueError(
+            "Argument `nan_replace` is expected to be of a type `int` or `float` when `nan_strategy = 'replace`, "
+            f"but got {nan_replace_value}"
+        )
+
+
+def _handle_nan_in_data(
+    preds: Array,
+    target: Array,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Tuple[Array, Array]:
+    """Replace or drop NaNs (reference utils.py). Host-side (value-dependent for drop)."""
+    if nan_strategy == "replace":
+        return (
+            jnp.where(jnp.isnan(preds), nan_replace_value, preds),
+            jnp.where(jnp.isnan(target), nan_replace_value, target),
+        )
+    keep = ~(jnp.isnan(preds) | jnp.isnan(target))
+    return preds[keep], target[keep]
+
+
+def _compute_bias_corrected_dims(confmat: Array) -> Tuple[Array, Array]:
+    """Bias-corrected numbers of rows/cols (reference utils.py)."""
+    confmat = confmat.astype(jnp.float32)
+    n = jnp.sum(confmat)
+    r, k = confmat.shape
+    r_corrected = r - (r - 1) ** 2 / (n - 1)
+    k_corrected = k - (k - 1) ** 2 / (n - 1)
+    return jnp.asarray(r_corrected), jnp.asarray(k_corrected)
+
+
+def _drop_empty_rows_and_cols(confmat: Array) -> Array:
+    """Drop all-zero rows/cols (reference utils.py) — host-side, data-dependent shape."""
+    import numpy as np
+
+    cm = np.asarray(confmat)
+    cm = cm[cm.sum(1) != 0][:, cm.sum(0) != 0]
+    return jnp.asarray(cm)
+
+
+def _unable_to_compute_warning(metric: str) -> None:
+    rank_zero_warn(
+        f"Unable to compute {metric} because the data does not allow it. Returning NaN.",
+        UserWarning,
+    )
+
+
+def _joint_confusion_matrix(preds: Array, target: Array, num_classes_preds: int, num_classes_target: int) -> Array:
+    """(Cx, Cy) contingency counts via bincount (XLA scatter-add, deterministic)."""
+    p = preds.reshape(-1).astype(jnp.int32)
+    t = target.reshape(-1).astype(jnp.int32)
+    mapping = p * num_classes_target + t
+    return jnp.bincount(mapping, length=num_classes_preds * num_classes_target).reshape(
+        num_classes_preds, num_classes_target
+    )

@@ -1,0 +1,16 @@
+"""Pairwise similarity functionals (reference src/torchmetrics/functional/pairwise/ —
+functional-only domain, no module classes, SURVEY §2.5)."""
+
+from metrics_tpu.functional.pairwise.similarity import (
+    pairwise_cosine_similarity,
+    pairwise_euclidean_distance,
+    pairwise_linear_similarity,
+    pairwise_manhattan_distance,
+)
+
+__all__ = [
+    "pairwise_cosine_similarity",
+    "pairwise_euclidean_distance",
+    "pairwise_linear_similarity",
+    "pairwise_manhattan_distance",
+]

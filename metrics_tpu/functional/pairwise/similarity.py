@@ -1,0 +1,98 @@
+"""Pairwise similarity / distance matrices.
+
+Reference parity: src/torchmetrics/functional/pairwise/{cosine,euclidean,manhattan,
+linear}.py + helpers.py (``_check_input``, zero-diagonal, reduction).
+
+TPU notes: all four are (N,D)×(M,D) matmul-shaped — they ride the MXU directly; the
+euclidean form uses the ‖x‖²+‖y‖²−2x·y expansion (one matmul) rather than broadcast
+subtraction (O(N·M·D) memory).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.compute import _safe_matmul
+
+
+def _check_input(x: Array, y: Optional[Array] = None, zero_diagonal: Optional[bool] = None) -> Tuple[Array, Array, bool]:
+    """Reference pairwise/helpers.py ``_check_input``."""
+    if x.ndim != 2:
+        raise ValueError(f"Expected argument `x` to be a 2D tensor of shape `[N, d]` but got {x.shape}")
+    if y is not None:
+        if y.ndim != 2 or y.shape[1] != x.shape[1]:
+            raise ValueError(
+                "Expected argument `y` to be a 2D tensor of shape `[M, d]` where"
+                " `d` should be same as the last dimension of `x`"
+            )
+        zero_diagonal = False if zero_diagonal is None else zero_diagonal
+    else:
+        y = x
+        zero_diagonal = True if zero_diagonal is None else zero_diagonal
+    return x.astype(jnp.float32), y.astype(jnp.float32), zero_diagonal
+
+
+def _reduce_distance_matrix(distmat: Array, reduction: Optional[str] = None) -> Array:
+    """Reference pairwise/helpers.py ``_reduce_distance_matrix``."""
+    if reduction == "mean":
+        return jnp.mean(distmat, axis=-1)
+    if reduction == "sum":
+        return jnp.sum(distmat, axis=-1)
+    if reduction is None or reduction == "none":
+        return distmat
+    raise ValueError(f"Expected reduction to be one of `['mean', 'sum', None]` but got {reduction}")
+
+
+def _zero_diag(mat: Array, zero_diagonal: bool) -> Array:
+    if zero_diagonal:
+        n = min(mat.shape[0], mat.shape[1])
+        return mat.at[jnp.arange(n), jnp.arange(n)].set(0.0)
+    return mat
+
+
+def pairwise_cosine_similarity(
+    x: Array, y: Optional[Array] = None, reduction: Optional[str] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    """Cosine similarity matrix (reference pairwise/cosine.py)."""
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    norm_x = x / jnp.clip(jnp.linalg.norm(x, axis=1, keepdims=True), min=1e-12)
+    norm_y = y / jnp.clip(jnp.linalg.norm(y, axis=1, keepdims=True), min=1e-12)
+    distance = _safe_matmul(norm_x, norm_y.T)
+    distance = _zero_diag(distance, zero_diagonal)
+    return _reduce_distance_matrix(distance, reduction)
+
+
+def pairwise_euclidean_distance(
+    x: Array, y: Optional[Array] = None, reduction: Optional[str] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    """Euclidean distance matrix via the one-matmul expansion (reference pairwise/euclidean.py)."""
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    x_norm = jnp.sum(x * x, axis=1, keepdims=True)
+    y_norm = jnp.sum(y * y, axis=1)
+    distance = x_norm + y_norm[None, :] - 2.0 * _safe_matmul(x, y.T)
+    distance = jnp.sqrt(jnp.maximum(distance, 0.0))
+    distance = _zero_diag(distance, zero_diagonal)
+    return _reduce_distance_matrix(distance, reduction)
+
+
+def pairwise_manhattan_distance(
+    x: Array, y: Optional[Array] = None, reduction: Optional[str] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    """Manhattan (L1) distance matrix (reference pairwise/manhattan.py)."""
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    distance = jnp.sum(jnp.abs(x[:, None, :] - y[None, :, :]), axis=-1)
+    distance = _zero_diag(distance, zero_diagonal)
+    return _reduce_distance_matrix(distance, reduction)
+
+
+def pairwise_linear_similarity(
+    x: Array, y: Optional[Array] = None, reduction: Optional[str] = None, zero_diagonal: Optional[bool] = None
+) -> Array:
+    """Linear (dot-product) similarity matrix (reference pairwise/linear.py)."""
+    x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
+    distance = _safe_matmul(x, y.T)
+    distance = _zero_diag(distance, zero_diagonal)
+    return _reduce_distance_matrix(distance, reduction)

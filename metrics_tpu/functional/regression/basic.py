@@ -1,0 +1,143 @@
+"""Error-sum regression functionals: MAE, MSE, MAPE, SMAPE, WMAPE, MSLE, LogCosh.
+
+Reference parity: src/torchmetrics/functional/regression/{mae,mse,mape,symmetric_mape,
+wmape,log_mse,log_cosh}.py — each decomposed into ``_*_update`` (sum-of-errors +
+count) and ``_*_compute`` (safe divide), the canonical two-sum streaming pattern.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.compute import _safe_divide
+
+
+def _mean_absolute_error_update(preds: Array, target: Array) -> Tuple[Array, int]:
+    _check_same_shape(preds, target)
+    preds = preds if jnp.issubdtype(preds.dtype, jnp.floating) else preds.astype(jnp.float32)
+    target = target if jnp.issubdtype(target.dtype, jnp.floating) else target.astype(jnp.float32)
+    sum_abs_error = jnp.sum(jnp.abs(preds - target))
+    return sum_abs_error, target.size
+
+
+def _mean_absolute_error_compute(sum_abs_error: Array, num_obs: Array) -> Array:
+    return sum_abs_error / num_obs
+
+
+def mean_absolute_error(preds: Array, target: Array) -> Array:
+    """MAE (reference functional/regression/mae.py)."""
+    sum_abs_error, num_obs = _mean_absolute_error_update(preds, target)
+    return _mean_absolute_error_compute(sum_abs_error, num_obs)
+
+
+def _mean_squared_error_update(preds: Array, target: Array, num_outputs: int) -> Tuple[Array, int]:
+    _check_same_shape(preds, target)
+    if num_outputs == 1:
+        preds = preds.reshape(-1)
+        target = target.reshape(-1)
+    diff = preds - target
+    sum_squared_error = jnp.sum(diff * diff, axis=0)
+    return sum_squared_error, target.shape[0]
+
+
+def _mean_squared_error_compute(sum_squared_error: Array, num_obs: Array, squared: bool = True) -> Array:
+    res = sum_squared_error / num_obs
+    return res if squared else jnp.sqrt(res)
+
+
+def mean_squared_error(preds: Array, target: Array, squared: bool = True, num_outputs: int = 1) -> Array:
+    """MSE / RMSE (reference functional/regression/mse.py)."""
+    sum_squared_error, num_obs = _mean_squared_error_update(preds, target, num_outputs)
+    return _mean_squared_error_compute(sum_squared_error, num_obs, squared)
+
+
+def _mean_absolute_percentage_error_update(preds: Array, target: Array, epsilon: float = 1.17e-06) -> Tuple[Array, int]:
+    _check_same_shape(preds, target)
+    abs_per_error = jnp.abs(preds - target) / jnp.clip(jnp.abs(target), min=epsilon)
+    return jnp.sum(abs_per_error), target.size
+
+
+def _mean_absolute_percentage_error_compute(sum_abs_per_error: Array, num_obs: Array) -> Array:
+    return sum_abs_per_error / num_obs
+
+
+def mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
+    """MAPE (reference functional/regression/mape.py)."""
+    s, n = _mean_absolute_percentage_error_update(preds, target)
+    return _mean_absolute_percentage_error_compute(s, n)
+
+
+def _symmetric_mean_absolute_percentage_error_update(
+    preds: Array, target: Array, epsilon: float = 1.17e-06
+) -> Tuple[Array, int]:
+    _check_same_shape(preds, target)
+    abs_per_error = jnp.abs(preds - target) / jnp.clip(jnp.abs(target) + jnp.abs(preds), min=epsilon)
+    return 2 * jnp.sum(abs_per_error), target.size
+
+
+def symmetric_mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
+    """SMAPE (reference functional/regression/symmetric_mape.py)."""
+    s, n = _symmetric_mean_absolute_percentage_error_update(preds, target)
+    return s / n
+
+
+def _weighted_mean_absolute_percentage_error_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    _check_same_shape(preds, target)
+    sum_abs_error = jnp.sum(jnp.abs((preds - target).reshape(-1)))
+    sum_scale = jnp.sum(jnp.abs(target.reshape(-1)))
+    return sum_abs_error, sum_scale
+
+
+def _weighted_mean_absolute_percentage_error_compute(sum_abs_error: Array, sum_scale: Array, epsilon: float = 1.17e-06) -> Array:
+    return sum_abs_error / jnp.clip(sum_scale, min=epsilon)
+
+
+def weighted_mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
+    """WMAPE (reference functional/regression/wmape.py)."""
+    s, scale = _weighted_mean_absolute_percentage_error_update(preds, target)
+    return _weighted_mean_absolute_percentage_error_compute(s, scale)
+
+
+def _mean_squared_log_error_update(preds: Array, target: Array) -> Tuple[Array, int]:
+    _check_same_shape(preds, target)
+    sum_squared_log_error = jnp.sum((jnp.log1p(preds) - jnp.log1p(target)) ** 2)
+    return sum_squared_log_error, target.size
+
+
+def mean_squared_log_error(preds: Array, target: Array) -> Array:
+    """MSLE (reference functional/regression/log_mse.py)."""
+    s, n = _mean_squared_log_error_update(preds, target)
+    return s / n
+
+
+def _unsqueeze_tensors(preds: Array, target: Array) -> Tuple[Array, Array]:
+    if preds.ndim == 1:
+        return preds[:, None], target[:, None]
+    return preds, target
+
+
+def _log_cosh_error_update(preds: Array, target: Array, num_outputs: int) -> Tuple[Array, int]:
+    _check_same_shape(preds, target)
+    preds, target = _unsqueeze_tensors(preds, target)
+    diff = preds - target
+    # numerically-stable log(cosh(x)) = x + softplus(-2x) - log(2)
+    sum_log_cosh_error = jnp.sum(diff + jax_softplus(-2.0 * diff) - jnp.log(2.0), axis=0)
+    return sum_log_cosh_error, preds.shape[0]
+
+
+def jax_softplus(x: Array) -> Array:
+    return jnp.logaddexp(x, 0.0)
+
+
+def _log_cosh_error_compute(sum_log_cosh_error: Array, num_obs: Array) -> Array:
+    return jnp.squeeze(sum_log_cosh_error / num_obs)
+
+
+def log_cosh_error(preds: Array, target: Array) -> Array:
+    """LogCosh error (reference functional/regression/log_cosh.py)."""
+    s, n = _log_cosh_error_update(preds, target, num_outputs=1)
+    return _log_cosh_error_compute(s, n)

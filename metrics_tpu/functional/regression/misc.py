@@ -1,0 +1,237 @@
+"""Remaining regression functionals: CosineSimilarity, KLDivergence, TweedieDeviance,
+Kendall, Spearman.
+
+Reference parity: src/torchmetrics/functional/regression/{cosine_similarity,kl_divergence,
+tweedie_deviance,kendall,spearman}.py. Rank correlations (Kendall/Spearman) operate on
+the full concatenated sample (cat states) — sort-based but static-shape at compute.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.compute import _safe_xlogy
+
+
+# --------------------------------------------------------------------------- cosine similarity
+
+
+def _cosine_similarity_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    _check_same_shape(preds, target)
+    if preds.ndim != 2:
+        raise ValueError(f"Expected input to cosine similarity to be 2D tensors of shape `[N,D]`, got {preds.ndim}D")
+    return preds.astype(jnp.float32), target.astype(jnp.float32)
+
+
+def _cosine_similarity_compute(preds: Array, target: Array, reduction: Optional[str] = "sum") -> Array:
+    dot_product = jnp.sum(preds * target, axis=-1)
+    preds_norm = jnp.linalg.norm(preds, axis=-1)
+    target_norm = jnp.linalg.norm(target, axis=-1)
+    similarity = dot_product / (preds_norm * target_norm)
+    reduction_mapping = {
+        "sum": jnp.sum,
+        "mean": jnp.mean,
+        "none": lambda x: x,
+        None: lambda x: x,
+    }
+    return reduction_mapping[reduction](similarity)
+
+
+def cosine_similarity(preds: Array, target: Array, reduction: Optional[str] = "sum") -> Array:
+    """Cosine similarity (reference functional/regression/cosine_similarity.py)."""
+    preds, target = _cosine_similarity_update(preds, target)
+    return _cosine_similarity_compute(preds, target, reduction)
+
+
+# --------------------------------------------------------------------------- kl divergence
+
+
+def _kld_update(p: Array, q: Array, log_prob: bool) -> Tuple[Array, int]:
+    """Reference kl_divergence.py update."""
+    _check_same_shape(p, q)
+    if p.ndim != 2 or q.ndim != 2:
+        raise ValueError(f"Expected both p and q distribution to be 2D but got {p.ndim} and {q.ndim} respectively")
+    total = p.shape[0]
+    if log_prob:
+        measures = jnp.sum(jnp.exp(p) * (p - q), axis=-1)
+    else:
+        p = p / jnp.sum(p, axis=-1, keepdims=True)
+        q = q / jnp.sum(q, axis=-1, keepdims=True)
+        q = jnp.clip(q, min=1.17e-06)
+        measures = jnp.sum(_safe_xlogy(p, p / q), axis=-1)
+    return measures, total
+
+
+def _kld_compute(measures: Array, total: Array, reduction: Optional[str] = "mean") -> Array:
+    if reduction == "sum":
+        return jnp.sum(measures)
+    if reduction == "mean":
+        return jnp.sum(measures) / total
+    if reduction is None or reduction == "none":
+        return measures
+    return measures / total
+
+
+def kl_divergence(p: Array, q: Array, log_prob: bool = False, reduction: Optional[str] = "mean") -> Array:
+    """KL divergence (reference functional/regression/kl_divergence.py)."""
+    measures, total = _kld_update(p, q, log_prob)
+    return _kld_compute(measures, total, reduction)
+
+
+# --------------------------------------------------------------------------- tweedie deviance
+
+
+def _tweedie_deviance_score_update(preds: Array, target: Array, power: float = 0.0) -> Tuple[Array, Array]:
+    """Reference tweedie_deviance.py update — four analytic regimes by ``power``."""
+    _check_same_shape(preds, target)
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+
+    if power == 0:
+        deviance_score = jnp.power(target - preds, 2)
+    elif power == 1:
+        deviance_score = 2 * (_safe_xlogy(target, target / preds) + preds - target)
+    elif power == 2:
+        deviance_score = 2 * (jnp.log(preds / target) + (target / preds) - 1)
+    else:  # power < 0 or 1 < power < 2 or power > 2 — general Tweedie formula
+        target_term = jnp.maximum(target, 0.0) if power < 0 else target
+        deviance_score = 2 * (
+            jnp.power(target_term, 2 - power) / ((1 - power) * (2 - power))
+            - target * jnp.power(preds, 1 - power) / (1 - power)
+            + jnp.power(preds, 2 - power) / (2 - power)
+        )
+    sum_deviance_score = jnp.sum(deviance_score)
+    num_observations = jnp.asarray(target.size, dtype=jnp.float32)
+    return sum_deviance_score, num_observations
+
+
+def _tweedie_deviance_score_compute(sum_deviance_score: Array, num_observations: Array) -> Array:
+    return sum_deviance_score / num_observations
+
+
+def tweedie_deviance_score(preds: Array, target: Array, power: float = 0.0) -> Array:
+    """Tweedie deviance (reference functional/regression/tweedie_deviance.py)."""
+    if 0 < power < 1:
+        raise ValueError(f"Deviance Score is not defined for power={power}.")
+    s, n = _tweedie_deviance_score_update(preds, target, power)
+    return _tweedie_deviance_score_compute(s, n)
+
+
+# --------------------------------------------------------------------------- rank helpers
+
+
+def _rank_data(x: Array) -> Array:
+    """Average-tie ranking (1-based), as scipy.stats.rankdata (reference spearman.py)."""
+    order = jnp.argsort(x)
+    sorted_x = x[order]
+    # average ranks over ties: for each element, rank = mean of positions with equal value
+    # first/last position of each value via searchsorted on the sorted array
+    first = jnp.searchsorted(sorted_x, x, side="left")
+    last = jnp.searchsorted(sorted_x, x, side="right") - 1
+    return (first + last).astype(jnp.float32) / 2.0 + 1.0
+
+
+def _spearman_corrcoef_compute(preds: Array, target: Array, eps: float = 1.17e-06) -> Array:
+    """Rank → Pearson (reference spearman.py compute)."""
+    if preds.ndim == 1:
+        preds = _rank_data(preds)
+        target = _rank_data(target)
+    else:
+        preds = jnp.stack([_rank_data(preds[:, i]) for i in range(preds.shape[1])], axis=-1)
+        target = jnp.stack([_rank_data(target[:, i]) for i in range(target.shape[1])], axis=-1)
+
+    preds_diff = preds - jnp.mean(preds, axis=0)
+    target_diff = target - jnp.mean(target, axis=0)
+
+    cov = jnp.mean(preds_diff * target_diff, axis=0)
+    preds_std = jnp.sqrt(jnp.mean(preds_diff * preds_diff, axis=0))
+    target_std = jnp.sqrt(jnp.mean(target_diff * target_diff, axis=0))
+
+    corrcoef = cov / (preds_std * target_std + eps)
+    return jnp.clip(corrcoef, -1.0, 1.0)
+
+
+def spearman_corrcoef(preds: Array, target: Array) -> Array:
+    """Spearman rank correlation (reference functional/regression/spearman.py)."""
+    _check_same_shape(preds, target)
+    if not jnp.issubdtype(preds.dtype, jnp.floating) or not jnp.issubdtype(target.dtype, jnp.floating):
+        raise TypeError("Expected `preds` and `target` both to be floating point tensors")
+    return _spearman_corrcoef_compute(preds.astype(jnp.float32), target.astype(jnp.float32))
+
+
+def _kendall_tau_compute(preds: Array, target: Array, variant: str = "b") -> Array:
+    """Kendall's tau via O(N²) pairwise sign comparison (reference kendall.py uses an
+    O(N log N) merge-sort count; the pairwise form is a dense (N,N) elementwise grid —
+    XLA-friendly and exact, acceptable for metric-sized N)."""
+    px = preds[:, None] - preds[None, :]
+    py = target[:, None] - target[None, :]
+    sign_prod = jnp.sign(px) * jnp.sign(py)
+    iu = jnp.triu_indices(preds.shape[0], k=1)
+    s = sign_prod[iu]
+    concordant = jnp.sum(s > 0)
+    discordant = jnp.sum(s < 0)
+    n = preds.shape[0]
+    n0 = n * (n - 1) / 2.0
+    tx = jnp.sum(jnp.sign(px)[iu] == 0)  # ties in x
+    ty = jnp.sum(jnp.sign(py)[iu] == 0)
+    txy = jnp.sum((jnp.sign(px)[iu] == 0) & (jnp.sign(py)[iu] == 0))
+    if variant == "a":
+        return (concordant - discordant) / n0
+    if variant == "b":
+        return (concordant - discordant) / jnp.sqrt((n0 - tx) * (n0 - ty))
+    # variant "c": needs the number of distinct values per variable
+    mx = jnp.unique(preds, size=n, fill_value=jnp.inf)
+    my = jnp.unique(target, size=n, fill_value=jnp.inf)
+    m = jnp.minimum(jnp.sum(jnp.isfinite(mx)), jnp.sum(jnp.isfinite(my))).astype(jnp.float32)
+    return 2 * (concordant - discordant) / (n**2 * (m - 1) / m)
+
+
+def _kendall_p_value(tau: Array, n: int, alternative: str) -> Array:
+    """Asymptotic normal-approximation p-value for tau (reference kendall.py
+    ``_calculate_p_value``): z = 3·tau·sqrt(n(n−1)) / sqrt(2(2n+5))."""
+    from jax.scipy.stats import norm
+
+    z = 3 * tau * jnp.sqrt(n * (n - 1.0)) / jnp.sqrt(2.0 * (2 * n + 5.0))
+    if alternative == "two-sided":
+        return 2 * norm.sf(jnp.abs(z))
+    if alternative == "greater":
+        return norm.sf(z)
+    if alternative == "less":
+        return norm.cdf(z)
+    raise ValueError(f"Argument `alternative` is expected to be one of `['two-sided', 'less', 'greater']`, but got {alternative!r}")
+
+
+def kendall_rank_corrcoef(
+    preds: Array,
+    target: Array,
+    variant: str = "b",
+    t_test: bool = False,
+    alternative: Optional[str] = "two-sided",
+) -> Array:
+    """Kendall rank correlation; with ``t_test=True`` returns ``(tau, p_value)``
+    (reference functional/regression/kendall.py:343-416)."""
+    _check_same_shape(preds, target)
+    if variant not in ("a", "b", "c"):
+        raise ValueError(f"Argument `variant` is expected to be one of `['a', 'b', 'c']`, but got {variant!r}")
+    if not isinstance(t_test, bool):
+        raise ValueError(f"Argument `t_test` is expected to be of a type `bool`, but got {t_test!r}")
+    if t_test and alternative not in ("two-sided", "less", "greater"):
+        raise ValueError(
+            f"Argument `alternative` is expected to be one of `['two-sided', 'less', 'greater']`, but got {alternative!r}"
+        )
+    if preds.ndim == 1:
+        tau = _kendall_tau_compute(preds.astype(jnp.float32), target.astype(jnp.float32), variant)
+        n = preds.shape[0]
+    else:
+        tau = jnp.stack(
+            [_kendall_tau_compute(preds[:, i].astype(jnp.float32), target[:, i].astype(jnp.float32), variant) for i in range(preds.shape[1])]
+        )
+        n = preds.shape[0]
+    if t_test:
+        return tau, _kendall_p_value(tau, n, alternative)
+    return tau

@@ -1,0 +1,208 @@
+"""Moment-based regression functionals: Pearson, Concordance, ExplainedVariance, R².
+
+Reference parity: src/torchmetrics/functional/regression/{pearson,concordance,
+explained_variance,r2}.py — all stream second moments (Welford-style for Pearson),
+making the states fixed-shape and psum-mergeable.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+# --------------------------------------------------------------------------- pearson
+
+
+def _pearson_corrcoef_update(
+    preds: Array,
+    target: Array,
+    mean_x: Array,
+    mean_y: Array,
+    var_x: Array,
+    var_y: Array,
+    corr_xy: Array,
+    n_prior: Array,
+    num_outputs: int,
+) -> Tuple[Array, Array, Array, Array, Array, Array]:
+    """Parallel Welford update of means/vars/cov (reference pearson.py:22-69)."""
+    _check_same_shape(preds, target)
+    if num_outputs == 1:
+        preds = preds.reshape(-1)
+        target = target.reshape(-1)
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+
+    n_obs = jnp.asarray(preds.shape[0], dtype=jnp.float32)
+    mx_new = (n_prior * mean_x + jnp.sum(preds, axis=0)) / (n_prior + n_obs)
+    my_new = (n_prior * mean_y + jnp.sum(target, axis=0)) / (n_prior + n_obs)
+    n_total = n_prior + n_obs
+
+    var_x = var_x + jnp.sum((preds - mx_new) * (preds - mean_x), axis=0)
+    var_y = var_y + jnp.sum((target - my_new) * (target - mean_y), axis=0)
+    corr_xy = corr_xy + jnp.sum((preds - mx_new) * (target - mean_y), axis=0)
+
+    return mx_new, my_new, var_x, var_y, corr_xy, n_total
+
+
+def _pearson_corrcoef_compute(var_x: Array, var_y: Array, corr_xy: Array, nb: Array) -> Array:
+    """Reference pearson.py ``_pearson_corrcoef_compute``."""
+    var_x = var_x / (nb - 1)
+    var_y = var_y / (nb - 1)
+    corr_xy = corr_xy / (nb - 1)
+    corrcoef = corr_xy / jnp.sqrt(jnp.clip(var_x * var_y, min=1e-24))
+    return jnp.clip(corrcoef, -1.0, 1.0)
+
+
+def pearson_corrcoef(preds: Array, target: Array) -> Array:
+    """Pearson correlation coefficient (reference functional/regression/pearson.py)."""
+    d = preds.shape[1] if preds.ndim == 2 else 1
+    shape = (d,) if d > 1 else ()
+    zeros = jnp.zeros(shape, dtype=jnp.float32)
+    _, _, var_x, var_y, corr_xy, nb = _pearson_corrcoef_update(
+        preds, target, zeros, zeros, zeros, zeros, zeros, jnp.zeros((), jnp.float32), num_outputs=d
+    )
+    return _pearson_corrcoef_compute(var_x, var_y, corr_xy, nb)
+
+
+# --------------------------------------------------------------------------- concordance
+
+
+def _concordance_corrcoef_compute(
+    mean_x: Array, mean_y: Array, var_x: Array, var_y: Array, corr_xy: Array, nb: Array
+) -> Array:
+    """CCC = 2·cov / (var_x + var_y + (mean_x − mean_y)²) (reference concordance.py)."""
+    var_x = var_x / nb
+    var_y = var_y / nb
+    corr_xy = corr_xy / nb
+    return 2.0 * corr_xy / (var_x + var_y + (mean_x - mean_y) ** 2)
+
+
+def concordance_corrcoef(preds: Array, target: Array) -> Array:
+    """Concordance correlation coefficient (reference functional/regression/concordance.py)."""
+    d = preds.shape[1] if preds.ndim == 2 else 1
+    shape = (d,) if d > 1 else ()
+    zeros = jnp.zeros(shape, dtype=jnp.float32)
+    mean_x, mean_y, var_x, var_y, corr_xy, nb = _pearson_corrcoef_update(
+        preds, target, zeros, zeros, zeros, zeros, zeros, jnp.zeros((), jnp.float32), num_outputs=d
+    )
+    return _concordance_corrcoef_compute(mean_x, mean_y, var_x, var_y, corr_xy, nb)
+
+
+# --------------------------------------------------------------------------- explained variance
+
+
+def _explained_variance_update(preds: Array, target: Array) -> Tuple[int, Array, Array, Array, Array]:
+    """Streaming sums (reference explained_variance.py:~30)."""
+    _check_same_shape(preds, target)
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    num_obs = preds.shape[0]
+    sum_error = jnp.sum(target - preds, axis=0)
+    diff = target - preds
+    sum_squared_error = jnp.sum(diff * diff, axis=0)
+    sum_target = jnp.sum(target, axis=0)
+    sum_squared_target = jnp.sum(target * target, axis=0)
+    return num_obs, sum_error, sum_squared_error, sum_target, sum_squared_target
+
+
+def _explained_variance_compute(
+    num_obs: Array,
+    sum_error: Array,
+    sum_squared_error: Array,
+    sum_target: Array,
+    sum_squared_target: Array,
+    multioutput: str = "uniform_average",
+) -> Array:
+    """Reference explained_variance.py compute."""
+    diff_avg = sum_error / num_obs
+    numerator = sum_squared_error / num_obs - diff_avg * diff_avg
+
+    target_avg = sum_target / num_obs
+    denominator = sum_squared_target / num_obs - target_avg * target_avg
+
+    nonzero_numerator = numerator != 0
+    nonzero_denominator = denominator != 0
+    valid_score = nonzero_numerator & nonzero_denominator
+    output_scores = jnp.ones_like(diff_avg)
+    output_scores = jnp.where(
+        valid_score, 1.0 - numerator / jnp.where(valid_score, denominator, 1.0), output_scores
+    )
+    output_scores = jnp.where(nonzero_numerator & ~nonzero_denominator, jnp.zeros_like(output_scores), output_scores)
+
+    if multioutput == "raw_values":
+        return output_scores
+    if multioutput == "uniform_average":
+        return jnp.mean(output_scores)
+    if multioutput == "variance_weighted":
+        denom_sum = jnp.sum(denominator)
+        return jnp.sum(denominator / denom_sum * output_scores)
+    raise ValueError(
+        "Argument `multioutput` must be either `raw_values`, `uniform_average` or `variance_weighted`"
+    )
+
+
+def explained_variance(preds: Array, target: Array, multioutput: str = "uniform_average") -> Array:
+    """Explained variance (reference functional/regression/explained_variance.py)."""
+    n, se, sse, st, sst = _explained_variance_update(preds, target)
+    return _explained_variance_compute(n, se, sse, st, sst, multioutput)
+
+
+# --------------------------------------------------------------------------- r2
+
+
+def _r2_score_update(preds: Array, target: Array) -> Tuple[Array, Array, Array, int]:
+    """Streaming sums (reference r2.py:~25)."""
+    _check_same_shape(preds, target)
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    sum_obs = jnp.sum(target, axis=0)
+    sum_squared_obs = jnp.sum(target * target, axis=0)
+    residual = jnp.sum((target - preds) ** 2, axis=0)
+    return sum_squared_obs, sum_obs, residual, target.shape[0]
+
+
+def _r2_score_compute(
+    sum_squared_obs: Array,
+    sum_obs: Array,
+    residual: Array,
+    num_obs: Array,
+    adjusted: int = 0,
+    multioutput: str = "uniform_average",
+) -> Array:
+    """Reference r2.py compute (incl. adjusted-R² variant)."""
+    mean_obs = sum_obs / num_obs
+    tss = sum_squared_obs - sum_obs * mean_obs
+    raw_scores = 1 - (residual / jnp.where(tss == 0, jnp.ones_like(tss), tss))
+    raw_scores = jnp.where(tss == 0, jnp.zeros_like(raw_scores), raw_scores)
+
+    if multioutput == "raw_values":
+        r2 = raw_scores
+    elif multioutput == "uniform_average":
+        r2 = jnp.mean(raw_scores)
+    elif multioutput == "variance_weighted":
+        tss_sum = jnp.sum(tss)
+        r2 = jnp.sum(tss / tss_sum * raw_scores)
+    else:
+        raise ValueError(
+            "Argument `multioutput` must be either `raw_values`, `uniform_average` or `variance_weighted`"
+        )
+
+    if adjusted < 0 or not isinstance(adjusted, int):
+        raise ValueError("`adjusted` parameter should be an integer larger or equal to 0.")
+    if adjusted != 0:
+        return 1 - (1 - r2) * (num_obs - 1) / (num_obs - adjusted - 1)
+    return r2
+
+
+def r2_score(preds: Array, target: Array, adjusted: int = 0, multioutput: str = "uniform_average") -> Array:
+    """R² score (reference functional/regression/r2.py)."""
+    sum_squared_obs, sum_obs, residual, num_obs = _r2_score_update(preds, target)
+    if num_obs < 2:
+        raise ValueError("Needs at least two samples to calculate r2 score.")
+    return _r2_score_compute(sum_squared_obs, sum_obs, residual, num_obs, adjusted, multioutput)

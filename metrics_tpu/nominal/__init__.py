@@ -1,0 +1,5 @@
+"""Nominal association module metrics (reference src/torchmetrics/nominal/)."""
+
+from metrics_tpu.nominal.stats import CramersV, PearsonsContingencyCoefficient, TheilsU, TschuprowsT
+
+__all__ = ["CramersV", "PearsonsContingencyCoefficient", "TheilsU", "TschuprowsT"]

@@ -1,0 +1,82 @@
+"""Nominal association module metrics (reference src/torchmetrics/nominal/{cramers,
+pearson,tschuprows,theils_u}.py): joint ``confmat`` sum state + χ²-style compute."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.nominal.stats import (
+    _cramers_v_compute,
+    _format_nominal,
+    _pearsons_contingency_coefficient_compute,
+    _theils_u_compute,
+    _tschuprows_t_compute,
+)
+from metrics_tpu.functional.nominal.utils import _joint_confusion_matrix, _nominal_input_validation
+from metrics_tpu.metric import Metric
+
+
+class _NominalBase(Metric):
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    _host_compute = True  # empty-row/col dropping is data-dependent
+
+    def __init__(
+        self,
+        num_classes: int,
+        nan_strategy: str = "replace",
+        nan_replace_value: Optional[float] = 0.0,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(num_classes, int) or num_classes < 1:
+            raise ValueError("Expected argument `num_classes` to be a positive integer")
+        self.num_classes = num_classes
+        _nominal_input_validation(nan_strategy, nan_replace_value)
+        self.nan_strategy = nan_strategy
+        self.nan_replace_value = nan_replace_value
+        self.add_state("confmat", jnp.zeros((num_classes, num_classes), jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        preds, target = _format_nominal(preds, target, self.nan_strategy, self.nan_replace_value)
+        self.confmat = self.confmat + _joint_confusion_matrix(preds, target, self.num_classes, self.num_classes)
+
+
+class CramersV(_NominalBase):
+    """Cramér's V (reference nominal/cramers.py)."""
+
+    def __init__(self, num_classes: int, bias_correction: bool = True, **kwargs: Any) -> None:
+        super().__init__(num_classes, **kwargs)
+        self.bias_correction = bias_correction
+
+    def compute(self) -> Array:
+        return _cramers_v_compute(self.confmat, self.bias_correction)
+
+
+class PearsonsContingencyCoefficient(_NominalBase):
+    """Pearson's contingency coefficient (reference nominal/pearson.py)."""
+
+    def compute(self) -> Array:
+        return _pearsons_contingency_coefficient_compute(self.confmat)
+
+
+class TschuprowsT(_NominalBase):
+    """Tschuprow's T (reference nominal/tschuprows.py)."""
+
+    def __init__(self, num_classes: int, bias_correction: bool = True, **kwargs: Any) -> None:
+        super().__init__(num_classes, **kwargs)
+        self.bias_correction = bias_correction
+
+    def compute(self) -> Array:
+        return _tschuprows_t_compute(self.confmat, self.bias_correction)
+
+
+class TheilsU(_NominalBase):
+    """Theil's U (reference nominal/theils_u.py)."""
+
+    def compute(self) -> Array:
+        return _theils_u_compute(self.confmat)

@@ -1,0 +1,43 @@
+"""Regression module metrics (SURVEY §2.5, reference src/torchmetrics/regression/)."""
+
+from metrics_tpu.regression.basic import (
+    LogCoshError,
+    MeanAbsoluteError,
+    MeanAbsolutePercentageError,
+    MeanSquaredError,
+    MeanSquaredLogError,
+    SymmetricMeanAbsolutePercentageError,
+    WeightedMeanAbsolutePercentageError,
+)
+from metrics_tpu.regression.misc import (
+    CosineSimilarity,
+    KendallRankCorrCoef,
+    KLDivergence,
+    SpearmanCorrCoef,
+    TweedieDevianceScore,
+)
+from metrics_tpu.regression.moments import (
+    ConcordanceCorrCoef,
+    ExplainedVariance,
+    PearsonCorrCoef,
+    R2Score,
+)
+
+__all__ = [
+    "ConcordanceCorrCoef",
+    "CosineSimilarity",
+    "ExplainedVariance",
+    "KendallRankCorrCoef",
+    "KLDivergence",
+    "LogCoshError",
+    "MeanAbsoluteError",
+    "MeanAbsolutePercentageError",
+    "MeanSquaredError",
+    "MeanSquaredLogError",
+    "PearsonCorrCoef",
+    "R2Score",
+    "SpearmanCorrCoef",
+    "SymmetricMeanAbsolutePercentageError",
+    "TweedieDevianceScore",
+    "WeightedMeanAbsolutePercentageError",
+]

@@ -1,0 +1,174 @@
+"""Remaining regression module metrics (reference src/torchmetrics/regression/
+{cosine_similarity,kl_divergence,tweedie_deviance,kendall,spearman}.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.regression.misc import (
+    _cosine_similarity_compute,
+    _cosine_similarity_update,
+    _kendall_tau_compute,
+    _kld_compute,
+    _kld_update,
+    _spearman_corrcoef_compute,
+    _tweedie_deviance_score_compute,
+    _tweedie_deviance_score_update,
+)
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.data import dim_zero_cat
+
+
+class CosineSimilarity(Metric):
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(self, reduction: str = "sum", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        allowed_reduction = ("sum", "mean", "none", None)
+        if reduction not in allowed_reduction:
+            raise ValueError(f"Expected argument `reduction` to be one of {allowed_reduction} but got {reduction}")
+        self.reduction = reduction
+        self.add_state("preds", [], dist_reduce_fx="cat")
+        self.add_state("target", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        preds, target = _cosine_similarity_update(preds, target)
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def compute(self) -> Array:
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        return _cosine_similarity_compute(preds, target, self.reduction)
+
+
+class KLDivergence(Metric):
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(self, log_prob: bool = False, reduction: Optional[str] = "mean", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(log_prob, bool):
+            raise TypeError(f"Expected argument `log_prob` to be bool but got {log_prob}")
+        self.log_prob = log_prob
+        allowed_reduction = ("mean", "sum", "none", None)
+        if reduction not in allowed_reduction:
+            raise ValueError(f"Expected argument `reduction` to be one of {allowed_reduction} but got {reduction}")
+        self.reduction = reduction
+
+        if self.reduction in ("mean", "sum"):
+            self.add_state("measures", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+        else:
+            self.add_state("measures", [], dist_reduce_fx="cat")
+        self.add_state("total", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+
+    def update(self, p: Array, q: Array) -> None:
+        measures, total = _kld_update(p, q, self.log_prob)
+        if self.reduction is None or self.reduction == "none":
+            self.measures.append(measures)
+        else:
+            self.measures = self.measures + jnp.sum(measures)
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        measures = dim_zero_cat(self.measures) if isinstance(self.measures, list) else self.measures
+        if self.reduction in ("mean",):
+            return measures / self.total
+        if self.reduction == "sum":
+            return measures
+        return measures
+
+
+class TweedieDevianceScore(Metric):
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(self, power: float = 0.0, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if 0 < power < 1:
+            raise ValueError(f"Deviance Score is not defined for power={power}.")
+        self.power = power
+        self.add_state("sum_deviance_score", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("num_observations", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        sum_deviance_score, num_observations = _tweedie_deviance_score_update(preds, target, self.power)
+        self.sum_deviance_score = self.sum_deviance_score + sum_deviance_score
+        self.num_observations = self.num_observations + num_observations
+
+    def compute(self) -> Array:
+        return _tweedie_deviance_score_compute(self.sum_deviance_score, self.num_observations)
+
+
+class SpearmanCorrCoef(Metric):
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    _host_compute = True  # rank transform is sort-based over the full sample
+
+    def __init__(self, num_outputs: int = 1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(num_outputs, int) and num_outputs > 0):
+            raise ValueError("Expected argument `num_outputs` to be an int larger than 0")
+        self.num_outputs = num_outputs
+        self.add_state("preds", [], dist_reduce_fx="cat")
+        self.add_state("target", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        _check_same_shape(preds, target)
+        if not jnp.issubdtype(preds.dtype, jnp.floating) or not jnp.issubdtype(target.dtype, jnp.floating):
+            raise TypeError("Expected `preds` and `target` both to be floating point tensors")
+        self.preds.append(preds.astype(jnp.float32))
+        self.target.append(target.astype(jnp.float32))
+
+    def compute(self) -> Array:
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        return _spearman_corrcoef_compute(preds, target)
+
+
+class KendallRankCorrCoef(Metric):
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = True
+    _host_compute = True
+
+    def __init__(
+        self,
+        variant: str = "b",
+        t_test: bool = False,
+        alternative: Optional[str] = "two-sided",
+        num_outputs: int = 1,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if variant not in ("a", "b", "c"):
+            raise ValueError(f"Argument `variant` is expected to be one of `['a', 'b', 'c']`, but got {variant!r}")
+        if not isinstance(t_test, bool):
+            raise ValueError(f"Argument `t_test` is expected to be of a type `bool`, but got {t_test!r}")
+        self.variant = variant
+        self.t_test = t_test
+        self.alternative = alternative
+        self.num_outputs = num_outputs
+        self.add_state("preds", [], dist_reduce_fx="cat")
+        self.add_state("target", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        _check_same_shape(preds, target)
+        self.preds.append(jnp.asarray(preds, dtype=jnp.float32))
+        self.target.append(jnp.asarray(target, dtype=jnp.float32))
+
+    def compute(self) -> Array:
+        from metrics_tpu.functional.regression.misc import kendall_rank_corrcoef
+
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        return kendall_rank_corrcoef(preds, target, self.variant, self.t_test, self.alternative)

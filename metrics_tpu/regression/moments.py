@@ -1,0 +1,175 @@
+"""Moment-streaming regression module metrics (reference src/torchmetrics/regression/
+{pearson,concordance,explained_variance,r2}.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.regression.moments import (
+    _concordance_corrcoef_compute,
+    _explained_variance_compute,
+    _explained_variance_update,
+    _pearson_corrcoef_compute,
+    _pearson_corrcoef_update,
+    _r2_score_compute,
+    _r2_score_update,
+)
+from metrics_tpu.metric import Metric
+
+
+def _final_aggregation(
+    means_x: Array, means_y: Array, vars_x: Array, vars_y: Array, corrs_xy: Array, nbs: Array
+):
+    """Merge per-device Welford states (reference pearson.py ``_final_aggregation``).
+
+    Used when states arrive stacked over devices (dist_reduce_fx=None-style gather);
+    pairwise parallel-variance merge, associative and jittable via a fori-style fold.
+    """
+    if means_x.ndim == 0 or means_x.shape[0] == 1:
+        return means_x[0] if means_x.ndim else means_x, means_y[0] if means_y.ndim else means_y, \
+            vars_x[0] if vars_x.ndim else vars_x, vars_y[0] if vars_y.ndim else vars_y, \
+            corrs_xy[0] if corrs_xy.ndim else corrs_xy, nbs[0] if nbs.ndim else nbs
+    mx1, my1, vx1, vy1, cxy1, n1 = means_x[0], means_y[0], vars_x[0], vars_y[0], corrs_xy[0], nbs[0]
+    for i in range(1, means_x.shape[0]):
+        mx2, my2, vx2, vy2, cxy2, n2 = means_x[i], means_y[i], vars_x[i], vars_y[i], corrs_xy[i], nbs[i]
+        nb = n1 + n2
+        mean_x = (n1 * mx1 + n2 * mx2) / nb
+        mean_y = (n1 * my1 + n2 * my2) / nb
+        # var_x
+        element_x1 = (n1 + 1) * mean_x - n1 * mx1
+        vx1 = vx1 + (element_x1 - mx1) * (element_x1 - mean_x) - (element_x1 - mean_x) ** 2
+        element_x2 = (n2 + 1) * mean_x - n2 * mx2
+        vx2 = vx2 + (element_x2 - mx2) * (element_x2 - mean_x) - (element_x2 - mean_x) ** 2
+        var_x = vx1 + vx2
+        # var_y
+        element_y1 = (n1 + 1) * mean_y - n1 * my1
+        vy1 = vy1 + (element_y1 - my1) * (element_y1 - mean_y) - (element_y1 - mean_y) ** 2
+        element_y2 = (n2 + 1) * mean_y - n2 * my2
+        vy2 = vy2 + (element_y2 - my2) * (element_y2 - mean_y) - (element_y2 - mean_y) ** 2
+        var_y = vy1 + vy2
+        # corr_xy
+        cxy1 = cxy1 + (element_x1 - mx1) * (element_y1 - mean_y) - (element_x1 - mean_x) * (element_y1 - mean_y)
+        cxy2 = cxy2 + (element_x2 - mx2) * (element_y2 - mean_y) - (element_x2 - mean_x) * (element_y2 - mean_y)
+        corr_xy = cxy1 + cxy2
+
+        mx1, my1, vx1, vy1, cxy1, n1 = mean_x, mean_y, var_x, var_y, corr_xy, nb
+    return mx1, my1, vx1, vy1, cxy1, n1
+
+
+class _PearsonBase(Metric):
+    """Shared Welford state plumbing for Pearson/Concordance."""
+
+    is_differentiable = True
+    full_state_update = True
+
+    def __init__(self, num_outputs: int = 1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(num_outputs, int) and num_outputs > 0):
+            raise ValueError("Expected argument `num_outputs` to be an int larger than 0")
+        self.num_outputs = num_outputs
+        shape = (num_outputs,) if num_outputs > 1 else ()
+        # dist_reduce_fx=None → states gathered (stacked) across replicas, merged in
+        # compute via the parallel-Welford _final_aggregation (reference pearson.py)
+        self.add_state("mean_x", jnp.zeros(shape, jnp.float32), dist_reduce_fx=None)
+        self.add_state("mean_y", jnp.zeros(shape, jnp.float32), dist_reduce_fx=None)
+        self.add_state("var_x", jnp.zeros(shape, jnp.float32), dist_reduce_fx=None)
+        self.add_state("var_y", jnp.zeros(shape, jnp.float32), dist_reduce_fx=None)
+        self.add_state("corr_xy", jnp.zeros(shape, jnp.float32), dist_reduce_fx=None)
+        self.add_state("n_total", jnp.zeros((), jnp.float32), dist_reduce_fx=None)
+
+    def update(self, preds: Array, target: Array) -> None:
+        self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total = _pearson_corrcoef_update(
+            preds, target, self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total,
+            self.num_outputs,
+        )
+
+    def _aggregate(self):
+        if self.mean_x.ndim > (1 if self.num_outputs > 1 else 0):
+            # synced: stacked over replicas → parallel merge
+            return _final_aggregation(self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total)
+        return self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total
+
+
+class PearsonCorrCoef(_PearsonBase):
+    higher_is_better = None
+
+    def compute(self) -> Array:
+        _, _, var_x, var_y, corr_xy, n_total = self._aggregate()
+        return _pearson_corrcoef_compute(var_x, var_y, corr_xy, n_total)
+
+
+class ConcordanceCorrCoef(_PearsonBase):
+    higher_is_better = None
+
+    def compute(self) -> Array:
+        mean_x, mean_y, var_x, var_y, corr_xy, n_total = self._aggregate()
+        return _concordance_corrcoef_compute(mean_x, mean_y, var_x, var_y, corr_xy, n_total)
+
+
+class ExplainedVariance(Metric):
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(self, multioutput: str = "uniform_average", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        allowed_multioutput = ("raw_values", "uniform_average", "variance_weighted")
+        if multioutput not in allowed_multioutput:
+            raise ValueError(f"Invalid input to argument `multioutput`. Choose one of the following: {allowed_multioutput}")
+        self.multioutput = multioutput
+        self.add_state("sum_error", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("sum_squared_error", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("sum_target", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("sum_squared_target", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("num_obs", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        num_obs, sum_error, sum_squared_error, sum_target, sum_squared_target = _explained_variance_update(preds, target)
+        self.num_obs = self.num_obs + num_obs
+        self.sum_error = self.sum_error + sum_error
+        self.sum_squared_error = self.sum_squared_error + sum_squared_error
+        self.sum_target = self.sum_target + sum_target
+        self.sum_squared_target = self.sum_squared_target + sum_squared_target
+
+    def compute(self) -> Array:
+        return _explained_variance_compute(
+            self.num_obs, self.sum_error, self.sum_squared_error, self.sum_target, self.sum_squared_target,
+            self.multioutput,
+        )
+
+
+class R2Score(Metric):
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(self, num_outputs: int = 1, adjusted: int = 0, multioutput: str = "uniform_average", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.num_outputs = num_outputs
+        if adjusted < 0 or not isinstance(adjusted, int):
+            raise ValueError("`adjusted` parameter should be an integer larger or equal to 0.")
+        self.adjusted = adjusted
+        allowed_multioutput = ("raw_values", "uniform_average", "variance_weighted")
+        if multioutput not in allowed_multioutput:
+            raise ValueError(f"Invalid input to argument `multioutput`. Choose one of the following: {allowed_multioutput}")
+        self.multioutput = multioutput
+        shape = (num_outputs,) if num_outputs > 1 else ()
+        self.add_state("sum_squared_error", jnp.zeros(shape, jnp.float32), dist_reduce_fx="sum")
+        self.add_state("sum_error", jnp.zeros(shape, jnp.float32), dist_reduce_fx="sum")
+        self.add_state("residual", jnp.zeros(shape, jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        sum_squared_obs, sum_obs, residual, num_obs = _r2_score_update(preds, target)
+        self.sum_squared_error = self.sum_squared_error + sum_squared_obs
+        self.sum_error = self.sum_error + sum_obs
+        self.residual = self.residual + residual
+        self.total = self.total + num_obs
+
+    def compute(self) -> Array:
+        return _r2_score_compute(
+            self.sum_squared_error, self.sum_error, self.residual, self.total, self.adjusted, self.multioutput
+        )

@@ -1,0 +1,49 @@
+"""ClasswiseWrapper — explode a per-class result tensor into a labelled dict.
+
+Reference parity: src/torchmetrics/wrappers/classwise.py (:21 class, :86-90 compute).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from jax import Array
+
+from metrics_tpu.metric import Metric
+
+
+class ClasswiseWrapper(Metric):
+    full_state_update: Optional[bool] = True
+
+    def __init__(self, metric: Metric, labels: Optional[List[str]] = None, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(metric, Metric):
+            raise ValueError(f"Expected argument `metric` to be an instance of `metrics_tpu.Metric` but got {metric}")
+        if labels is not None and not (isinstance(labels, list) and all(isinstance(lab, str) for lab in labels)):
+            raise ValueError(f"Expected argument `labels` to either be `None` or a list of strings but got {labels}")
+        self.metric = metric
+        self.labels = labels
+
+    def _convert(self, x: Array) -> Dict[str, Array]:
+        name = self.metric.__class__.__name__.lower()
+        if self.labels is None:
+            return {f"{name}_{i}": val for i, val in enumerate(x)}
+        return {f"{name}_{lab}": val for lab, val in zip(self.labels, x)}
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        self.metric.update(*args, **kwargs)
+
+    def compute(self) -> Dict[str, Array]:
+        return self._convert(self.metric.compute())
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        return self._convert(self.metric(*args, **kwargs))
+
+    def reset(self) -> None:
+        self.metric.reset()
+
+    def _wrap_update(self, update):  # keep bookkeeping out of the inner metric's way
+        return update
+
+    def _wrap_compute(self, compute):
+        return compute

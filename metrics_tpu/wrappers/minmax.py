@@ -1,0 +1,57 @@
+"""MinMaxMetric — track the min and max of a base metric's computed value.
+
+Reference parity: src/torchmetrics/wrappers/minmax.py (:23).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Union
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.metric import Metric
+
+
+class MinMaxMetric(Metric):
+    full_state_update: Optional[bool] = True
+
+    min_val: Array
+    max_val: Array
+
+    def __init__(self, base_metric: Metric, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(base_metric, Metric):
+            raise ValueError(
+                f"Expected base metric to be an instance of `metrics_tpu.Metric` but received {base_metric}"
+            )
+        self._base_metric = base_metric
+        self.min_val = jnp.asarray(float("inf"))
+        self.max_val = jnp.asarray(float("-inf"))
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        self._base_metric.update(*args, **kwargs)
+
+    def compute(self) -> Dict[str, Array]:
+        """Current value + running min/max (reference minmax.py compute)."""
+        val = self._base_metric.compute()
+        if not self._is_suitable_val(val):
+            raise RuntimeError(f"Returned value from base metric should be a float or scalar tensor, but got {val}")
+        self.max_val = jnp.where(self.max_val > val, self.max_val, jnp.asarray(val, dtype=jnp.float32))
+        self.min_val = jnp.where(self.min_val < val, self.min_val, jnp.asarray(val, dtype=jnp.float32))
+        return {"raw": jnp.asarray(val), "max": self.max_val, "min": self.min_val}
+
+    def reset(self) -> None:
+        super().reset()
+        self._base_metric.reset()
+        self.min_val = jnp.asarray(float("inf"))
+        self.max_val = jnp.asarray(float("-inf"))
+
+    @staticmethod
+    def _is_suitable_val(val: Union[float, Array]) -> bool:
+        if isinstance(val, (int, float)):
+            return True
+        if isinstance(val, jax.Array):
+            return val.size == 1
+        return False

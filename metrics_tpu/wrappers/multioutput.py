@@ -1,0 +1,89 @@
+"""MultioutputWrapper — apply a metric per output column.
+
+Reference parity: src/torchmetrics/wrappers/multioutput.py (:~46): N clones, one per
+column of ``output_dim``; optional NaN-row removal (host-side, value-dependent).
+"""
+
+from __future__ import annotations
+
+from copy import deepcopy
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.checks import _value_check_possible
+
+
+def _get_nan_indices(*tensors: Array) -> Array:
+    """Rows where any tensor has a NaN (reference multioutput.py)."""
+    if len(tensors) == 0:
+        raise ValueError("Must pass at least one tensor as argument")
+    sentinel = tensors[0]
+    nan_idxs = jnp.zeros(len(sentinel), dtype=jnp.bool_)
+    for tensor in tensors:
+        permuted = tensor.reshape(len(sentinel), -1)
+        nan_idxs = nan_idxs | jnp.any(jnp.isnan(permuted), axis=1)
+    return nan_idxs
+
+
+class MultioutputWrapper(Metric):
+    is_differentiable = False
+
+    def __init__(
+        self,
+        base_metric: Metric,
+        num_outputs: int,
+        output_dim: int = -1,
+        remove_nans: bool = True,
+        squeeze_outputs: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.metrics = [deepcopy(base_metric) for _ in range(num_outputs)]
+        self.output_dim = output_dim
+        self.remove_nans = remove_nans
+        self.squeeze_outputs = squeeze_outputs
+
+    def _get_args_kwargs_by_output(self, *args: Array, **kwargs: Array):
+        """Slice inputs per output column (reference multioutput.py)."""
+        args_kwargs_by_output = []
+        for i in range(len(self.metrics)):
+            selected_args = [jnp.take(arg, jnp.asarray([i]), axis=self.output_dim) for arg in args]
+            selected_kwargs = {k: jnp.take(v, jnp.asarray([i]), axis=self.output_dim) for k, v in kwargs.items()}
+            if self.remove_nans:
+                tensors = selected_args + list(selected_kwargs.values())
+                if tensors and _value_check_possible(*tensors):
+                    nan_idxs = _get_nan_indices(*tensors)
+                    selected_args = [arg[~nan_idxs] for arg in selected_args]
+                    selected_kwargs = {k: v[~nan_idxs] for k, v in selected_kwargs.items()}
+            if self.squeeze_outputs:
+                selected_args = [arg.squeeze(self.output_dim) for arg in selected_args]
+                selected_kwargs = {k: v.squeeze(self.output_dim) for k, v in selected_kwargs.items()}
+            args_kwargs_by_output.append((selected_args, selected_kwargs))
+        return args_kwargs_by_output
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        reshaped_args_kwargs = self._get_args_kwargs_by_output(*args, **kwargs)
+        for metric, (selected_args, selected_kwargs) in zip(self.metrics, reshaped_args_kwargs):
+            metric.update(*selected_args, **selected_kwargs)
+
+    def compute(self) -> Array:
+        return jnp.stack([jnp.asarray(m.compute()) for m in self.metrics], axis=0)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        reshaped_args_kwargs = self._get_args_kwargs_by_output(*args, **kwargs)
+        results = [
+            metric(*selected_args, **selected_kwargs)
+            for metric, (selected_args, selected_kwargs) in zip(self.metrics, reshaped_args_kwargs)
+        ]
+        if any(r is None for r in results):
+            return None
+        return jnp.stack([jnp.asarray(r) for r in results], axis=0)
+
+    def reset(self) -> None:
+        for metric in self.metrics:
+            metric.reset()
+        super().reset()

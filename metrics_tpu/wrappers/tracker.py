@@ -1,0 +1,133 @@
+"""MetricTracker — track a metric (or collection) over epochs/steps.
+
+Reference parity: src/torchmetrics/wrappers/tracker.py (:26 class, increment :117,
+compute_all :137, best_metric :165).
+"""
+
+from __future__ import annotations
+
+from copy import deepcopy
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.collections import MetricCollection
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+class MetricTracker:
+    """List of deep-copied snapshots, one per ``increment()`` (reference tracker.py:26)."""
+
+    def __init__(self, metric: Union[Metric, MetricCollection], maximize: Union[bool, List[bool]] = True) -> None:
+        if not isinstance(metric, (Metric, MetricCollection)):
+            raise TypeError(
+                "Metric arg need to be an instance of a metrics_tpu"
+                f" `Metric` or `MetricCollection` but got {metric}"
+            )
+        self._base_metric = metric
+        if not isinstance(maximize, (bool, list)):
+            raise ValueError("Argument `maximize` should either be a single bool or list of bool")
+        if isinstance(maximize, list) and isinstance(metric, MetricCollection) and len(maximize) != len(metric):
+            raise ValueError("The len of argument `maximize` should match the length of the metric collection")
+        if isinstance(metric, Metric) and not isinstance(maximize, bool):
+            raise ValueError("Argument `maximize` should be a single bool when `metric` is a single Metric")
+        self.maximize = maximize
+        self._increment_called = False
+        self._metrics: List[Union[Metric, MetricCollection]] = []
+
+    @property
+    def n_steps(self) -> int:
+        """Number of tracked metrics (reference: len - 1 for the base)."""
+        return len(self._metrics)
+
+    def increment(self) -> None:
+        """Create a new metric snapshot for the next epoch (reference :117-120)."""
+        self._increment_called = True
+        self._metrics.append(deepcopy(self._base_metric))
+        self._metrics[-1].reset()
+
+    def __len__(self) -> int:
+        return len(self._metrics)
+
+    def __getitem__(self, val: int) -> Union[Metric, MetricCollection]:
+        return self._metrics[val]
+
+    def _check_for_increment(self, method: str) -> None:
+        if not self._increment_called:
+            raise ValueError(f"`{method}` cannot be called before `.increment()` has been called")
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        self._check_for_increment("update")
+        self._metrics[-1].update(*args, **kwargs)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        self._check_for_increment("forward")
+        return self._metrics[-1](*args, **kwargs)
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        return self.forward(*args, **kwargs)
+
+    def compute(self) -> Any:
+        self._check_for_increment("compute")
+        return self._metrics[-1].compute()
+
+    def compute_all(self) -> Any:
+        """Compute all tracked steps (reference :137-154)."""
+        self._check_for_increment("compute_all")
+        res = [metric.compute() for metric in self._metrics]
+        if isinstance(self._base_metric, MetricCollection):
+            keys = res[0].keys()
+            return {k: jnp.stack([jnp.asarray(r[k]) for r in res], axis=0) for k in keys}
+        return jnp.stack([jnp.asarray(r) for r in res], axis=0)
+
+    def reset(self) -> None:
+        """Reset the current metric."""
+        self._metrics[-1].reset()
+
+    def reset_all(self) -> None:
+        for metric in self._metrics:
+            metric.reset()
+
+    def best_metric(
+        self, return_step: bool = False
+    ) -> Union[Any, Tuple[Any, Any]]:
+        """Best value (and optionally its step) over all tracked steps (reference :165-235)."""
+        res = self.compute_all()
+        if isinstance(self._base_metric, Metric):
+            fn = np.argmax if self.maximize else np.argmin
+            try:
+                value = np.asarray(res)
+                idx = int(fn(value))
+                if return_step:
+                    return float(value[idx]), idx
+                return float(value[idx])
+            except (ValueError, TypeError) as error:
+                rank_zero_warn(
+                    f"Encountered the following error when trying to get the best metric: {error}"
+                    "this is probably due to the 'best' not being defined for this metric."
+                    "Returning `None` instead.", UserWarning,
+                )
+                if return_step:
+                    return None, None
+                return None
+        else:
+            maximize = self.maximize if isinstance(self.maximize, list) else len(res) * [self.maximize]
+            value, idx = {}, {}
+            for i, (k, v) in enumerate(res.items()):
+                try:
+                    fn = np.argmax if maximize[i] else np.argmin
+                    out = np.asarray(v)
+                    idx[k] = int(fn(out))
+                    value[k] = float(out[idx[k]])
+                except (ValueError, TypeError) as error:
+                    rank_zero_warn(
+                        f"Encountered the following error when trying to get the best metric for metric {k}:"
+                        f"{error} this is probably due to the 'best' not being defined for this metric."
+                        "Returning `None` instead.", UserWarning,
+                    )
+                    value[k], idx[k] = None, None
+            if return_step:
+                return value, idx
+            return value

@@ -1,0 +1,219 @@
+"""MetricCollection tests — port of tests/unittests/bases/test_collections.py (613 LoC):
+compute-group formation/correctness, prefix/postfix, nested collections, kwargs filtering.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import accuracy_score, f1_score, recall_score
+
+from metrics_tpu import MetricCollection
+from metrics_tpu.classification import (
+    MulticlassAccuracy,
+    MulticlassConfusionMatrix,
+    MulticlassF1Score,
+    MulticlassPrecision,
+    MulticlassRecall,
+)
+from tests.helpers.testers import DummyMetricDiff, DummyMetricSum
+
+NUM_CLASSES = 5
+
+
+def _data(n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    return (
+        jnp.asarray(rng.normal(size=(n, NUM_CLASSES)).astype(np.float32)),
+        jnp.asarray(rng.integers(0, NUM_CLASSES, n)),
+    )
+
+
+def test_metric_collection_basic():
+    preds, target = _data()
+    mc = MetricCollection(
+        [MulticlassAccuracy(NUM_CLASSES, average="micro"), MulticlassF1Score(NUM_CLASSES, average="macro")]
+    )
+    mc.update(preds, target)
+    res = mc.compute()
+    labels = np.asarray(preds).argmax(1)
+    np.testing.assert_allclose(np.asarray(res["MulticlassAccuracy"]), accuracy_score(np.asarray(target), labels), atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(res["MulticlassF1Score"]),
+        f1_score(np.asarray(target), labels, average="macro", labels=list(range(NUM_CLASSES)), zero_division=0),
+        atol=1e-6,
+    )
+
+
+def test_compute_groups_formed():
+    preds, target = _data()
+    mc = MetricCollection(
+        [
+            MulticlassPrecision(NUM_CLASSES, average="macro"),
+            MulticlassRecall(NUM_CLASSES, average="macro"),
+            MulticlassF1Score(NUM_CLASSES, average="macro"),
+            MulticlassConfusionMatrix(NUM_CLASSES),
+        ]
+    )
+    mc.update(preds, target)
+    # precision/recall/f1 share the tp/fp/tn/fn states -> one group; confmat is separate
+    groups = {tuple(sorted(v)) for v in mc.compute_groups.values()}
+    assert ("MulticlassConfusionMatrix",) in groups
+    assert tuple(sorted(["MulticlassPrecision", "MulticlassRecall", "MulticlassF1Score"])) in groups
+
+
+def test_compute_groups_correctness_across_updates():
+    """Grouped collection must equal ungrouped on multi-batch streams."""
+    mc_grouped = MetricCollection(
+        [MulticlassPrecision(NUM_CLASSES, average="macro"), MulticlassRecall(NUM_CLASSES, average="macro")],
+        compute_groups=True,
+    )
+    mc_plain = MetricCollection(
+        [MulticlassPrecision(NUM_CLASSES, average="macro"), MulticlassRecall(NUM_CLASSES, average="macro")],
+        compute_groups=False,
+    )
+    for seed in range(4):
+        preds, target = _data(seed=seed)
+        mc_grouped.update(preds, target)
+        mc_plain.update(preds, target)
+    res_g = mc_grouped.compute()
+    res_p = mc_plain.compute()
+    for k in res_p:
+        np.testing.assert_allclose(np.asarray(res_g[k]), np.asarray(res_p[k]), atol=1e-8)
+
+
+def test_compute_groups_update_count():
+    """After group formation, only the leader's update runs."""
+    preds, target = _data()
+    mc = MetricCollection(
+        [MulticlassPrecision(NUM_CLASSES, average="macro"), MulticlassRecall(NUM_CLASSES, average="macro")]
+    )
+    mc.update(preds, target)  # formation round: everyone updates
+    mc.update(preds, target)  # now only leaders
+    counts = {k: m._update_count for k, m in mc.items(copy_state=False)}
+    assert max(counts.values()) == 2
+    # the member metric was updated only once directly, but aliasing keeps states in sync
+    res = mc.compute()
+    assert set(res.keys()) == {"MulticlassPrecision", "MulticlassRecall"}
+
+
+def test_items_break_aliasing():
+    preds, target = _data()
+    mc = MetricCollection(
+        [MulticlassPrecision(NUM_CLASSES, average="macro"), MulticlassRecall(NUM_CLASSES, average="macro")]
+    )
+    mc.update(preds, target)
+    mc.update(preds, target)
+    items = dict(mc.items())  # copy_state=True default
+    m1, m2 = items["MulticlassPrecision"], items["MulticlassRecall"]
+    assert m1.tp is not m2.tp  # deepcopy broke the aliasing
+    np.testing.assert_allclose(np.asarray(m1.tp), np.asarray(m2.tp))
+
+
+def test_prefix_postfix():
+    preds, target = _data()
+    mc = MetricCollection([MulticlassAccuracy(NUM_CLASSES)], prefix="val_", postfix="_epoch")
+    mc.update(preds, target)
+    res = mc.compute()
+    assert list(res.keys()) == ["val_MulticlassAccuracy_epoch"]
+    clone = mc.clone(prefix="test_")
+    clone.update(preds, target)
+    assert list(clone.compute().keys()) == ["test_MulticlassAccuracy_epoch"]
+
+
+def test_nested_collections():
+    mc_inner = MetricCollection([MulticlassAccuracy(NUM_CLASSES)], prefix="inner_")
+    mc = MetricCollection({"outer": mc_inner})
+    preds, target = _data()
+    mc.update(preds, target)
+    res = mc.compute()
+    assert list(res.keys()) == ["outer_inner_MulticlassAccuracy"]
+
+
+def test_collection_dict_input():
+    preds, target = _data()
+    mc = MetricCollection({"acc": MulticlassAccuracy(NUM_CLASSES, average="micro"), "rec": MulticlassRecall(NUM_CLASSES, average="macro")})
+    mc.update(preds, target)
+    res = mc.compute()
+    assert set(res.keys()) == {"acc", "rec"}
+
+
+def test_collection_filters_kwargs():
+    class A(DummyMetricSum):
+        def update(self, x):
+            self.x = self.x + x
+
+    class B(DummyMetricDiff):
+        def update(self, y):
+            self.x = self.x - y
+
+    mc = MetricCollection([A(), B()], compute_groups=False)
+    mc.update(x=jnp.asarray(2.0), y=jnp.asarray(3.0))
+    res = mc.compute()
+    assert float(res["A"]) == 2.0
+    assert float(res["B"]) == -3.0
+
+
+def test_collection_error_on_wrong_input():
+    with pytest.raises(ValueError, match="is not an instance of"):
+        MetricCollection({"a": 42})
+    with pytest.raises(ValueError, match="Encountered two metrics both named"):
+        MetricCollection([MulticlassAccuracy(3), MulticlassAccuracy(3)])
+
+
+def test_collection_reset_reforms_groups():
+    preds, target = _data()
+    mc = MetricCollection(
+        [MulticlassPrecision(NUM_CLASSES, average="macro"), MulticlassRecall(NUM_CLASSES, average="macro")]
+    )
+    mc.update(preds, target)
+    assert mc._groups_checked
+    mc.reset()
+    assert not mc._groups_checked
+    mc.update(preds, target)
+    res = mc.compute()
+    assert set(res.keys()) == {"MulticlassPrecision", "MulticlassRecall"}
+
+
+def test_collection_forward_returns_batch_values():
+    preds, target = _data()
+    mc = MetricCollection([MulticlassAccuracy(NUM_CLASSES, average="micro")])
+    out = mc(preds, target)
+    labels = np.asarray(preds).argmax(1)
+    np.testing.assert_allclose(np.asarray(out["MulticlassAccuracy"]), accuracy_score(np.asarray(target), labels), atol=1e-6)
+
+
+def test_collection_functional_sharded():
+    """Group-deduped functional path inside shard_map."""
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    mc = MetricCollection(
+        [MulticlassPrecision(NUM_CLASSES, average="macro"), MulticlassRecall(NUM_CLASSES, average="macro")],
+        compute_groups=[["MulticlassPrecision", "MulticlassRecall"]],  # user-specified groups
+    )
+    rng = np.random.default_rng(0)
+    preds = jnp.asarray(rng.normal(size=(8, 16, NUM_CLASSES)).astype(np.float32))
+    target = jnp.asarray(rng.integers(0, NUM_CLASSES, (8, 16)))
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("dp",))
+
+    def step(p, t):
+        state = mc.init_state()
+        state = mc.update_state(state, p[0], t[0])
+        return mc.compute_from(state, axis_name="dp")
+
+    out = jax.jit(jax.shard_map(step, mesh=mesh, in_specs=(P("dp"), P("dp")), out_specs=P()))(preds, target)
+    all_labels = np.asarray(preds).reshape(-1, NUM_CLASSES).argmax(-1)
+    all_t = np.asarray(target).reshape(-1)
+    np.testing.assert_allclose(
+        np.asarray(out["MulticlassPrecision"]),
+        __import__("sklearn.metrics", fromlist=["precision_score"]).precision_score(
+            all_t, all_labels, average="macro", labels=list(range(NUM_CLASSES)), zero_division=0
+        ),
+        atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out["MulticlassRecall"]),
+        recall_score(all_t, all_labels, average="macro", labels=list(range(NUM_CLASSES)), zero_division=0),
+        atol=1e-6,
+    )

@@ -1,0 +1,131 @@
+"""Wrapper tests — port of tests/unittests/wrappers/{test_tracker, test_bootstrapping,
+test_classwise, test_minmax, test_multioutput}.py."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import accuracy_score, mean_squared_error
+
+from metrics_tpu import BootStrapper, ClasswiseWrapper, MeanMetric, MetricCollection, MetricTracker, MinMaxMetric, MultioutputWrapper
+from metrics_tpu.classification import MulticlassAccuracy, MulticlassRecall
+
+NUM_CLASSES = 5
+
+
+def _data(n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    return (
+        jnp.asarray(rng.normal(size=(n, NUM_CLASSES)).astype(np.float32)),
+        jnp.asarray(rng.integers(0, NUM_CLASSES, n)),
+    )
+
+
+class TestTracker:
+    def test_raises_before_increment(self):
+        tracker = MetricTracker(MulticlassAccuracy(NUM_CLASSES, average="micro"))
+        with pytest.raises(ValueError, match="cannot be called before"):
+            tracker.update(*_data())
+
+    def test_tracks_epochs(self):
+        tracker = MetricTracker(MulticlassAccuracy(NUM_CLASSES, average="micro"), maximize=True)
+        vals = []
+        for epoch in range(3):
+            tracker.increment()
+            preds, target = _data(seed=epoch)
+            tracker.update(preds, target)
+            vals.append(accuracy_score(np.asarray(target), np.asarray(preds).argmax(1)))
+        all_res = np.asarray(tracker.compute_all())
+        np.testing.assert_allclose(all_res, vals, atol=1e-6)
+        best, step = tracker.best_metric(return_step=True)
+        assert best == pytest.approx(max(vals), abs=1e-6)
+        assert step == int(np.argmax(vals))
+
+    def test_tracker_with_collection(self):
+        tracker = MetricTracker(
+            MetricCollection([MulticlassAccuracy(NUM_CLASSES, average="micro"), MulticlassRecall(NUM_CLASSES, average="macro")]),
+            maximize=[True, True],
+        )
+        for epoch in range(2):
+            tracker.increment()
+            tracker.update(*_data(seed=epoch))
+        res = tracker.compute_all()
+        assert set(res.keys()) == {"MulticlassAccuracy", "MulticlassRecall"}
+        best, steps = tracker.best_metric(return_step=True)
+        assert set(best.keys()) == {"MulticlassAccuracy", "MulticlassRecall"}
+
+    def test_maximize_validation(self):
+        with pytest.raises(ValueError, match="single bool"):
+            MetricTracker(MulticlassAccuracy(NUM_CLASSES), maximize=[True, False])
+
+
+class TestBootstrapper:
+    def test_bootstrap_output_structure(self):
+        bs = BootStrapper(MulticlassAccuracy(NUM_CLASSES, average="micro"), num_bootstraps=8, quantile=0.95, raw=True, seed=7)
+        for seed in range(3):
+            bs.update(*_data(seed=seed))
+        out = bs.compute()
+        assert set(out.keys()) == {"mean", "std", "quantile", "raw"}
+        assert out["raw"].shape == (8,)
+        # bootstrap mean should be near the exact value
+        preds = np.concatenate([np.asarray(_data(seed=s)[0]) for s in range(3)])
+        target = np.concatenate([np.asarray(_data(seed=s)[1]) for s in range(3)])
+        exact = accuracy_score(target, preds.argmax(1))
+        assert abs(float(out["mean"]) - exact) < 0.1
+
+    def test_bad_sampling_strategy(self):
+        with pytest.raises(ValueError, match="sampling_strategy"):
+            BootStrapper(MulticlassAccuracy(NUM_CLASSES), sampling_strategy="bogus")
+
+
+class TestClasswise:
+    def test_exploded_dict(self):
+        metric = ClasswiseWrapper(MulticlassAccuracy(NUM_CLASSES, average=None))
+        preds, target = _data()
+        metric.update(preds, target)
+        res = metric.compute()
+        assert set(res.keys()) == {f"multiclassaccuracy_{i}" for i in range(NUM_CLASSES)}
+
+    def test_labels(self):
+        labels = ["a", "b", "c", "d", "e"]
+        metric = ClasswiseWrapper(MulticlassAccuracy(NUM_CLASSES, average=None), labels=labels)
+        preds, target = _data()
+        metric.update(preds, target)
+        res = metric.compute()
+        assert set(res.keys()) == {f"multiclassaccuracy_{lab}" for lab in labels}
+
+
+class TestMinMax:
+    def test_tracks_min_max(self):
+        base = MeanMetric()
+        mm = MinMaxMetric(base)
+        mm.update(jnp.asarray(5.0))
+        out1 = mm.compute()
+        mm.update(jnp.asarray(1.0))  # running mean drops to 3
+        out2 = mm.compute()
+        assert float(out1["raw"]) == 5.0
+        assert float(out2["raw"]) == 3.0
+        assert float(out2["max"]) == 5.0
+        assert float(out2["min"]) == 3.0
+
+    def test_raises_on_nonscalar(self):
+        mm = MinMaxMetric(MulticlassAccuracy(NUM_CLASSES, average=None))
+        preds, target = _data()
+        mm.update(preds, target)
+        with pytest.raises(RuntimeError, match="float or scalar tensor"):
+            mm.compute()
+
+
+class TestMultioutput:
+    def test_multioutput_with_mean_metric(self):
+        mo = MultioutputWrapper(MeanMetric(), num_outputs=3)
+        data = jnp.asarray([[1.0, 2.0, 3.0], [3.0, 4.0, 5.0]])
+        mo.update(data)
+        res = np.asarray(mo.compute())
+        np.testing.assert_allclose(res, [2.0, 3.0, 4.0])
+
+    def test_multioutput_remove_nans(self):
+        mo = MultioutputWrapper(MeanMetric(), num_outputs=2)
+        data = jnp.asarray([[1.0, float("nan")], [3.0, 4.0]])
+        mo.update(data)
+        res = np.asarray(mo.compute())
+        np.testing.assert_allclose(res, [2.0, 4.0])

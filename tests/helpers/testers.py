@@ -222,9 +222,13 @@ class MetricTester:
                 state = metric.update_state(state, p_shard[i], t_shard[i])
             return metric.compute_from(state, axis_name="dp")
 
-        has_list_state = any(isinstance(d, list) for d in metric._defaults.values())
+        # cat/None-reduce states all_gather in-trace, whose outputs the vma system
+        # can't statically prove replicated — disable the check for those
+        has_gather_state = any(isinstance(d, list) for d in metric._defaults.values()) or any(
+            r is None or r == "cat" or callable(r) for r in metric._reductions.values()
+        )
         result = jax.jit(
-            jax.shard_map(step, mesh=mesh, in_specs=(P("dp"), P("dp")), out_specs=P(), check_vma=not has_list_state)
+            jax.shard_map(step, mesh=mesh, in_specs=(P("dp"), P("dp")), out_specs=P(), check_vma=not has_gather_state)
         )(preds_stack, target_stack)
         _assert_allclose(result, ref_result, atol=atol)
 

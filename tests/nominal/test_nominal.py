@@ -1,0 +1,72 @@
+"""Nominal metric tests vs scipy-based references (port of tests/unittests/nominal/)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.stats.contingency import association, crosstab
+
+from metrics_tpu.functional.nominal import cramers_v, pearsons_contingency_coefficient, theils_u, tschuprows_t
+from metrics_tpu.nominal import CramersV, PearsonsContingencyCoefficient, TheilsU, TschuprowsT
+
+NUM_CLASSES = 4
+
+
+def _data(seed=0, n=200):
+    rng = np.random.default_rng(seed)
+    preds = rng.integers(0, NUM_CLASSES, n)
+    target = (preds + rng.integers(0, 2, n)) % NUM_CLASSES
+    return preds, target
+
+
+def _scipy_association(preds, target, method):
+    ct = crosstab(preds, target).count
+    return association(ct, method=method, correction=False)
+
+
+@pytest.mark.parametrize(
+    "fn, method",
+    [(cramers_v, "cramer"), (tschuprows_t, "tschuprow"), (pearsons_contingency_coefficient, "pearson")],
+)
+def test_functional_no_bias_correction_vs_scipy(fn, method):
+    preds, target = _data()
+    kwargs = {} if fn is pearsons_contingency_coefficient else {"bias_correction": False}
+    res = fn(jnp.asarray(preds), jnp.asarray(target), **kwargs)
+    expected = _scipy_association(preds, target, method)
+    np.testing.assert_allclose(np.asarray(res), expected, atol=1e-6)
+
+
+@pytest.mark.parametrize(
+    "metric_class, fn, kwargs",
+    [
+        (CramersV, cramers_v, {"bias_correction": True}),
+        (TschuprowsT, tschuprows_t, {"bias_correction": True}),
+        (PearsonsContingencyCoefficient, pearsons_contingency_coefficient, {}),
+        (TheilsU, theils_u, {}),
+    ],
+)
+def test_module_matches_functional(metric_class, fn, kwargs):
+    preds, target = _data(seed=1)
+    extra = {"bias_correction": kwargs["bias_correction"]} if "bias_correction" in kwargs else {}
+    m = metric_class(num_classes=NUM_CLASSES, **extra)
+    m.update(jnp.asarray(preds[:100]), jnp.asarray(target[:100]))
+    m.update(jnp.asarray(preds[100:]), jnp.asarray(target[100:]))
+    res = m.compute()
+    expected = fn(jnp.asarray(preds), jnp.asarray(target), **kwargs)
+    np.testing.assert_allclose(np.asarray(res), np.asarray(expected), atol=1e-6)
+
+
+def test_theils_u_asymmetry():
+    preds, target = _data(seed=2)
+    u_xy = theils_u(jnp.asarray(preds), jnp.asarray(target))
+    u_yx = theils_u(jnp.asarray(target), jnp.asarray(preds))
+    assert 0.0 <= float(u_xy) <= 1.0
+    assert 0.0 <= float(u_yx) <= 1.0
+
+
+def test_nan_strategies():
+    preds = jnp.asarray([0.0, 1.0, float("nan"), 2.0])
+    target = jnp.asarray([0.0, 1.0, 1.0, 2.0])
+    res_replace = cramers_v(preds, target, nan_strategy="replace", nan_replace_value=0.0)
+    res_drop = cramers_v(preds, target, nan_strategy="drop")
+    assert np.isfinite(np.asarray(res_replace)) or np.isnan(np.asarray(res_replace))
+    assert np.isfinite(np.asarray(res_drop)) or np.isnan(np.asarray(res_drop))

@@ -1,0 +1,56 @@
+"""Pairwise functional tests vs sklearn (port of tests/unittests/pairwise/)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics.pairwise import (
+    cosine_similarity as sk_cosine,
+    euclidean_distances as sk_euclidean,
+    linear_kernel as sk_linear,
+    manhattan_distances as sk_manhattan,
+)
+
+from metrics_tpu.functional.pairwise import (
+    pairwise_cosine_similarity,
+    pairwise_euclidean_distance,
+    pairwise_linear_similarity,
+    pairwise_manhattan_distance,
+)
+
+rng = np.random.default_rng(0)
+X = rng.normal(size=(10, 6)).astype(np.float32)
+Y = rng.normal(size=(8, 6)).astype(np.float32)
+
+
+@pytest.mark.parametrize(
+    "tm_fn, sk_fn",
+    [
+        (pairwise_cosine_similarity, sk_cosine),
+        (pairwise_euclidean_distance, sk_euclidean),
+        (pairwise_manhattan_distance, sk_manhattan),
+        (pairwise_linear_similarity, sk_linear),
+    ],
+)
+class TestPairwise:
+    def test_two_inputs(self, tm_fn, sk_fn):
+        res = tm_fn(jnp.asarray(X), jnp.asarray(Y))
+        np.testing.assert_allclose(np.asarray(res), sk_fn(X, Y), atol=1e-5)
+
+    def test_single_input_zero_diagonal(self, tm_fn, sk_fn):
+        res = np.asarray(tm_fn(jnp.asarray(X)))
+        expected = sk_fn(X, X)
+        np.fill_diagonal(expected, 0)
+        np.testing.assert_allclose(res, expected, atol=1e-5)
+
+    @pytest.mark.parametrize("reduction", ["mean", "sum"])
+    def test_reduction(self, tm_fn, sk_fn, reduction):
+        res = np.asarray(tm_fn(jnp.asarray(X), jnp.asarray(Y), reduction=reduction))
+        full = sk_fn(X, Y)
+        expected = full.mean(-1) if reduction == "mean" else full.sum(-1)
+        np.testing.assert_allclose(res, expected, atol=1e-4)
+
+    def test_error_on_wrong_shapes(self, tm_fn, sk_fn):
+        with pytest.raises(ValueError, match="Expected argument `x`"):
+            tm_fn(jnp.ones(10))
+        with pytest.raises(ValueError, match="Expected argument `y`"):
+            tm_fn(jnp.ones((10, 5)), jnp.ones((10, 4)))

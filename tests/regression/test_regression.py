@@ -1,0 +1,194 @@
+"""Regression metric tests vs sklearn/scipy (port of tests/unittests/regression/)."""
+
+import numpy as np
+import pytest
+from scipy.stats import kendalltau, pearsonr, spearmanr
+from sklearn.metrics import (
+    explained_variance_score as sk_ev,
+    mean_absolute_error as sk_mae,
+    mean_absolute_percentage_error as sk_mape,
+    mean_squared_error as sk_mse,
+    mean_squared_log_error as sk_msle,
+    mean_tweedie_deviance as sk_tweedie,
+    r2_score as sk_r2,
+)
+
+from metrics_tpu.functional.regression import (
+    concordance_corrcoef,
+    cosine_similarity,
+    explained_variance,
+    kendall_rank_corrcoef,
+    kl_divergence,
+    log_cosh_error,
+    mean_absolute_error,
+    mean_absolute_percentage_error,
+    mean_squared_error,
+    mean_squared_log_error,
+    pearson_corrcoef,
+    r2_score,
+    spearman_corrcoef,
+    symmetric_mean_absolute_percentage_error,
+    tweedie_deviance_score,
+    weighted_mean_absolute_percentage_error,
+)
+from metrics_tpu.regression import (
+    ConcordanceCorrCoef,
+    CosineSimilarity,
+    ExplainedVariance,
+    KendallRankCorrCoef,
+    KLDivergence,
+    LogCoshError,
+    MeanAbsoluteError,
+    MeanAbsolutePercentageError,
+    MeanSquaredError,
+    MeanSquaredLogError,
+    PearsonCorrCoef,
+    R2Score,
+    SpearmanCorrCoef,
+    SymmetricMeanAbsolutePercentageError,
+    TweedieDevianceScore,
+    WeightedMeanAbsolutePercentageError,
+)
+from tests.helpers.testers import MetricTester
+
+NUM_BATCHES = 16
+
+
+def _inputs(seed=0, positive=False):
+    rng = np.random.default_rng(seed)
+    preds = rng.normal(size=(NUM_BATCHES, 32)).astype(np.float32)
+    target = (preds * 0.7 + rng.normal(size=(NUM_BATCHES, 32)) * 0.5).astype(np.float32)
+    if positive:
+        preds, target = np.abs(preds) + 0.1, np.abs(target) + 0.1
+    return preds, target
+
+
+_preds, _target = _inputs()
+_ppreds, _ptarget = _inputs(positive=True)
+
+
+def _sk_concordance(preds, target):
+    p, t = preds.flatten(), target.flatten()
+    r = pearsonr(p, t)[0]
+    return 2 * r * p.std() * t.std() / (p.var() + t.var() + (p.mean() - t.mean()) ** 2)
+
+
+def _sk_logcosh(preds, target):
+    return np.mean(np.log(np.cosh(preds.flatten() - target.flatten())))
+
+
+def _sk_smape(preds, target):
+    p, t = preds.flatten(), target.flatten()
+    return np.mean(2 * np.abs(p - t) / (np.abs(p) + np.abs(t)))
+
+
+def _sk_wmape(preds, target):
+    p, t = preds.flatten(), target.flatten()
+    return np.sum(np.abs(p - t)) / np.sum(np.abs(t))
+
+
+CASES = [
+    (MeanAbsoluteError, mean_absolute_error, lambda p, t: sk_mae(t.flatten(), p.flatten()), {}, (_preds, _target)),
+    (MeanSquaredError, mean_squared_error, lambda p, t: sk_mse(t.flatten(), p.flatten()), {}, (_preds, _target)),
+    (MeanAbsolutePercentageError, mean_absolute_percentage_error, lambda p, t: sk_mape(t.flatten(), p.flatten()), {}, (_preds, _target)),
+    (MeanSquaredLogError, mean_squared_log_error, lambda p, t: sk_msle(t.flatten(), p.flatten()), {}, (_ppreds, _ptarget)),
+    (ExplainedVariance, explained_variance, lambda p, t: sk_ev(t.flatten(), p.flatten()), {}, (_preds, _target)),
+    (R2Score, r2_score, lambda p, t: sk_r2(t.flatten(), p.flatten()), {}, (_preds, _target)),
+    (PearsonCorrCoef, pearson_corrcoef, lambda p, t: pearsonr(p.flatten(), t.flatten())[0], {}, (_preds, _target)),
+    (ConcordanceCorrCoef, concordance_corrcoef, _sk_concordance, {}, (_preds, _target)),
+    (SpearmanCorrCoef, spearman_corrcoef, lambda p, t: spearmanr(p.flatten(), t.flatten())[0], {}, (_preds, _target)),
+    (KendallRankCorrCoef, kendall_rank_corrcoef, lambda p, t: kendalltau(p.flatten(), t.flatten())[0], {}, (_preds, _target)),
+    (LogCoshError, log_cosh_error, _sk_logcosh, {}, (_preds, _target)),
+    (SymmetricMeanAbsolutePercentageError, symmetric_mean_absolute_percentage_error, _sk_smape, {}, (_preds, _target)),
+    (WeightedMeanAbsolutePercentageError, weighted_mean_absolute_percentage_error, _sk_wmape, {}, (_preds, _target)),
+    (TweedieDevianceScore, tweedie_deviance_score, lambda p, t: sk_tweedie(t.flatten(), p.flatten(), power=1.5), {"power": 1.5}, (_ppreds, _ptarget)),
+]
+
+
+@pytest.mark.parametrize("metric_class, metric_fn, sk_fn, metric_args, data", CASES,
+                         ids=[c[0].__name__ for c in CASES])
+class TestRegressionMetrics(MetricTester):
+    atol = 1e-4
+
+    def test_class(self, metric_class, metric_fn, sk_fn, metric_args, data):
+        preds, target = data
+        self.run_class_metric_test(
+            preds=preds, target=target, metric_class=metric_class, reference_metric=sk_fn,
+            metric_args=metric_args,
+        )
+
+    def test_functional(self, metric_class, metric_fn, sk_fn, metric_args, data):
+        preds, target = data
+        self.run_functional_metric_test(
+            preds=preds, target=target, metric_functional=metric_fn, reference_metric=sk_fn,
+            metric_args=metric_args,
+        )
+
+    def test_differentiability(self, metric_class, metric_fn, sk_fn, metric_args, data):
+        preds, target = data
+        self.run_differentiability_test(preds, target, metric_class, metric_fn, metric_args)
+
+
+def test_cosine_similarity():
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    p = rng.normal(size=(32, 8)).astype(np.float32)
+    t = rng.normal(size=(32, 8)).astype(np.float32)
+    expected = np.mean(np.sum(p * t, -1) / (np.linalg.norm(p, axis=-1) * np.linalg.norm(t, axis=-1)))
+    m = CosineSimilarity(reduction="mean")
+    m.update(jnp.asarray(p[:16]), jnp.asarray(t[:16]))
+    m.update(jnp.asarray(p[16:]), jnp.asarray(t[16:]))
+    np.testing.assert_allclose(np.asarray(m.compute()), expected, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(cosine_similarity(jnp.asarray(p), jnp.asarray(t), "mean")), expected, atol=1e-6)
+
+
+def test_kl_divergence():
+    import jax.numpy as jnp
+    from scipy.stats import entropy
+
+    rng = np.random.default_rng(0)
+    P = np.abs(rng.normal(size=(32, 5))).astype(np.float32) + 0.1
+    Q = np.abs(rng.normal(size=(32, 5))).astype(np.float32) + 0.1
+    Pn = P / P.sum(1, keepdims=True)
+    Qn = Q / Q.sum(1, keepdims=True)
+    expected = entropy(Pn.T, Qn.T).mean()
+    m = KLDivergence()
+    m.update(jnp.asarray(P[:16]), jnp.asarray(Q[:16]))
+    m.update(jnp.asarray(P[16:]), jnp.asarray(Q[16:]))
+    np.testing.assert_allclose(np.asarray(m.compute()), expected, atol=1e-5)
+
+
+def test_rmse_and_multioutput_mse():
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    p = rng.normal(size=(64, 3)).astype(np.float32)
+    t = rng.normal(size=(64, 3)).astype(np.float32)
+    m = MeanSquaredError(squared=False)
+    m.update(jnp.asarray(p), jnp.asarray(t))
+    np.testing.assert_allclose(np.asarray(m.compute()), np.sqrt(sk_mse(t.flatten(), p.flatten())), atol=1e-6)
+    m2 = MeanSquaredError(num_outputs=3)
+    m2.update(jnp.asarray(p), jnp.asarray(t))
+    np.testing.assert_allclose(np.asarray(m2.compute()), ((p - t) ** 2).mean(0), atol=1e-6)
+
+
+def test_pearson_fake_world_merge():
+    """Pearson's None-reduce states merge exactly via parallel Welford aggregation."""
+    import jax.numpy as jnp
+
+    from tests.helpers.testers import _fake_dist_sync_fns
+
+    rng = np.random.default_rng(1)
+    p = rng.normal(size=128).astype(np.float32)
+    t = (p * 0.5 + rng.normal(size=128) * 0.8).astype(np.float32)
+    world = 2
+    metrics = [PearsonCorrCoef() for _ in range(world)]
+    for r, m in enumerate(metrics):
+        m.update(jnp.asarray(p[r::world]), jnp.asarray(t[r::world]))
+    fns = _fake_dist_sync_fns(metrics)
+    for r, m in enumerate(metrics):
+        m.dist_sync_fn = fns(r)
+        m.distributed_available_fn = lambda: True
+    got = float(metrics[0].compute())
+    np.testing.assert_allclose(got, pearsonr(p, t)[0], atol=1e-4)
