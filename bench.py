@@ -1,0 +1,113 @@
+"""Headline benchmark: step-time overhead of fused metric accumulation.
+
+Measures the north-star figure from BASELINE.md: the %-overhead that a
+MetricCollection-equivalent (multiclass Accuracy + F1 + ConfusionMatrix, BASELINE.json
+config #2) adds to a compiled training step when the metric update is fused into the
+step's XLA graph via the pure functional API. The reference's qualitative target is
+<1% overhead; `vs_baseline` is value/1.0 (ratio to that 1% budget — smaller is better).
+
+Methodology (recorded per BASELINE.md): single chip, f32 params / bf16 matmul inputs,
+compile excluded (warmup step), median of `STEPS` timed steps with block_until_ready.
+Prints ONE JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.classification.accuracy import MulticlassAccuracy
+from metrics_tpu.classification.confusion_matrix import MulticlassConfusionMatrix
+from metrics_tpu.classification.f_beta import MulticlassF1Score
+
+BATCH, HIDDEN, CLASSES, LAYERS, STEPS = 1024, 4096, 1000, 8, 30
+
+
+def main() -> None:
+    metrics = {
+        "accuracy": MulticlassAccuracy(CLASSES, average="micro", validate_args=False),
+        "f1": MulticlassF1Score(CLASSES, average="macro", validate_args=False),
+        "confmat": MulticlassConfusionMatrix(CLASSES, validate_args=False),
+    }
+
+    def forward(params, x, y):
+        h = x
+        for w in params["ws"]:
+            h = jnp.tanh(h @ w)
+        logits = h @ params["head"]
+        logp = jax.nn.log_softmax(logits)
+        loss = -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+        return loss, logits
+
+    def bare_step(params, x, y):
+        (loss, logits), grads = jax.value_and_grad(forward, has_aux=True)(params, x, y)
+        params = jax.tree_util.tree_map(lambda p, g: p - 0.01 * g, params, grads)
+        return params, loss, logits
+
+    def metric_step(params, states, x, y):
+        params, loss, logits = bare_step(params, x, y)
+        preds = jnp.argmax(logits, axis=-1)
+        states = {name: m.update_state(states[name], preds, y) for name, m in metrics.items()}
+        return params, states, loss
+
+    key = jax.random.PRNGKey(0)
+    ks = jax.random.split(key, LAYERS + 3)
+    params = {
+        "ws": [jax.random.normal(ks[i], (HIDDEN, HIDDEN), jnp.float32) * 0.02 for i in range(LAYERS)],
+        "head": jax.random.normal(ks[LAYERS], (HIDDEN, CLASSES), jnp.float32) * 0.02,
+    }
+    x = jax.random.normal(ks[LAYERS + 1], (BATCH, HIDDEN), jnp.float32)
+    y = jax.random.randint(ks[LAYERS + 2], (BATCH,), 0, CLASSES)
+    states = {name: m.init_state() for name, m in metrics.items()}
+
+    bare = jax.jit(bare_step, donate_argnums=(0,))
+    fused = jax.jit(metric_step, donate_argnums=(0, 1))
+
+    def run(fn, init_carry, n):
+        # NOTE: on the tunneled TPU backend block_until_ready does not reliably block,
+        # so completion is forced with a scalar host readback (float(loss)). Steps are
+        # chained through the carry, so N steps + one readback = N serialized steps.
+        carry = fn(*init_carry, x, y)
+        float(carry[len(init_carry)])  # sync after compile+warmup
+        t0 = time.perf_counter()
+        for _ in range(n):
+            carry = fn(*carry[: len(init_carry)], x, y)
+        float(carry[len(init_carry)])  # one readback drains the chained queue
+        return (time.perf_counter() - t0) / n, carry
+
+    fresh_params = lambda: jax.tree_util.tree_map(jnp.copy, params)  # noqa: E731
+    fresh_states = lambda: {n: metrics[n].init_state() for n in metrics}  # noqa: E731
+
+    t_bare, _ = run(bare, (fresh_params(),), STEPS)
+    t_fused, carry = run(fused, (fresh_params(), fresh_states()), STEPS)
+
+    # validate the accumulated metric state computes
+    final_states = carry[1]
+    acc = float(metrics["accuracy"].compute_from(final_states["accuracy"]))
+    assert 0.0 <= acc <= 1.0
+
+    overhead_pct = max(0.0, (t_fused - t_bare) / t_bare * 100.0)
+    print(
+        json.dumps(
+            {
+                "metric": "fused Accuracy+F1+ConfusionMatrix metric-update overhead per train step",
+                "value": round(overhead_pct, 3),
+                "unit": "%",
+                "vs_baseline": round(overhead_pct / 1.0, 3),
+            }
+        )
+    )
+    print(
+        f"# bare={t_bare*1e3:.3f} ms/step fused={t_fused*1e3:.3f} ms/step "
+        f"backend={jax.default_backend()} batch={BATCH} hidden={HIDDEN} classes={CLASSES}",
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,48 @@
+"""Shared helpers for per-query retrieval functionals.
+
+Reference parity: src/torchmetrics/functional/retrieval/* (each function operates on the
+documents of a single query). TPU-native notes: every function here is branch-free on
+data (``jnp.where`` instead of ``if target.sum()``), so they are jittable with static
+shapes; ``k`` is a static Python int.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _value_check_possible
+
+
+def _check_retrieval_functional_inputs(
+    preds: Array,
+    target: Array,
+    allow_non_binary_target: bool = False,
+) -> Tuple[Array, Array]:
+    """Validate and flatten one query's (preds, target) pair.
+
+    Reference: utilities/checks.py ``_check_retrieval_functional_inputs``.
+    """
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if preds.shape != target.shape or preds.size == 0:
+        raise ValueError("`preds` and `target` must be non-empty and of the same shape")
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        raise ValueError("`preds` must be a tensor of floats")
+    if not jnp.issubdtype(target.dtype, jnp.integer) and not jnp.issubdtype(target.dtype, jnp.bool_):
+        raise ValueError("`target` must be a tensor of booleans or integers")
+    if not allow_non_binary_target and _value_check_possible(target) and bool(jnp.any((target > 1) | (target < 0))):
+        raise ValueError("`target` must contain `binary` values")
+    return preds.reshape(-1).astype(jnp.float32), target.reshape(-1)
+
+
+def _validate_k(k: Optional[int]) -> None:
+    if k is not None and not (isinstance(k, int) and k > 0):
+        raise ValueError("`k` has to be a positive integer or None")
+
+
+def _target_by_pred_rank(preds: Array, target: Array) -> Array:
+    """Target values reordered by descending prediction score."""
+    return target[jnp.argsort(-preds)]

@@ -1,0 +1,159 @@
+"""Per-query retrieval functionals (branch-free, jittable).
+
+Reference parity (formula sources, one file each in the reference):
+- retrieval_average_precision — functional/retrieval/average_precision.py
+- retrieval_fall_out           — functional/retrieval/fall_out.py
+- retrieval_hit_rate           — functional/retrieval/hit_rate.py
+- retrieval_normalized_dcg     — functional/retrieval/ndcg.py
+- retrieval_precision          — functional/retrieval/precision.py
+- retrieval_precision_recall_curve — functional/retrieval/precision_recall_curve.py
+- retrieval_r_precision        — functional/retrieval/r_precision.py
+- retrieval_recall             — functional/retrieval/recall.py
+- retrieval_reciprocal_rank    — functional/retrieval/reciprocal_rank.py
+
+Each operates on the documents of a SINGLE query; grouping over queries lives in
+``metrics_tpu.retrieval`` which uses a vectorised segment kernel instead of a host loop.
+Empty-positive queries return 0.0 (matching the reference's early-exit), expressed as
+``jnp.where`` so the functions stay traceable.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.retrieval._utils import (
+    _check_retrieval_functional_inputs,
+    _target_by_pred_rank,
+    _validate_k,
+)
+from metrics_tpu.utils.compute import _safe_divide
+
+
+def retrieval_average_precision(preds: Array, target: Array) -> Array:
+    """AP over one query: mean of precision@hit over the hit positions."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    t = _target_by_pred_rank(preds, target).astype(jnp.float32)
+    cum_hits = jnp.cumsum(t)
+    prec_at = cum_hits / jnp.arange(1, t.shape[0] + 1, dtype=jnp.float32)
+    total = t.sum()
+    return jnp.where(total > 0, (prec_at * t).sum() / jnp.maximum(total, 1.0), 0.0)
+
+
+def retrieval_precision(preds: Array, target: Array, k: Optional[int] = None, adaptive_k: bool = False) -> Array:
+    """Precision@k = (# relevant in top-k) / k; ``adaptive_k`` clamps k to the query size."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    if not isinstance(adaptive_k, bool):
+        raise ValueError("`adaptive_k` has to be a boolean")
+    _validate_k(k)
+    n = preds.shape[0]
+    if k is None or (adaptive_k and k > n):
+        k = n
+    t = _target_by_pred_rank(preds, target).astype(jnp.float32)
+    relevant = t[: min(k, n)].sum()
+    return jnp.where(target.sum() > 0, relevant / k, 0.0)
+
+
+def retrieval_recall(preds: Array, target: Array, k: Optional[int] = None) -> Array:
+    """Recall@k = (# relevant in top-k) / (# relevant)."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    _validate_k(k)
+    n = preds.shape[0]
+    k = n if k is None else k
+    t = _target_by_pred_rank(preds, target).astype(jnp.float32)
+    total = target.sum().astype(jnp.float32)
+    relevant = t[: min(k, n)].sum()
+    return jnp.where(total > 0, relevant / jnp.maximum(total, 1.0), 0.0)
+
+
+def retrieval_fall_out(preds: Array, target: Array, k: Optional[int] = None) -> Array:
+    """Fall-out@k = (# NON-relevant in top-k) / (# non-relevant)."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    _validate_k(k)
+    n = preds.shape[0]
+    k = n if k is None else k
+    neg = 1 - _target_by_pred_rank(preds, target).astype(jnp.float32)
+    total_neg = neg.sum()
+    retrieved_neg = neg[: min(k, n)].sum()
+    return jnp.where(total_neg > 0, retrieved_neg / jnp.maximum(total_neg, 1.0), 0.0)
+
+
+def retrieval_hit_rate(preds: Array, target: Array, k: Optional[int] = None) -> Array:
+    """1.0 if any relevant document is in the top-k, else 0.0."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    _validate_k(k)
+    n = preds.shape[0]
+    k = n if k is None else k
+    t = _target_by_pred_rank(preds, target).astype(jnp.float32)
+    return (t[: min(k, n)].sum() > 0).astype(jnp.float32)
+
+
+def retrieval_r_precision(preds: Array, target: Array) -> Array:
+    """Precision at k = (# relevant); branch-free via a rank<R mask."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    t = _target_by_pred_rank(preds, target).astype(jnp.float32)
+    total = target.sum().astype(jnp.float32)
+    ranks = jnp.arange(t.shape[0], dtype=jnp.float32)
+    relevant = (t * (ranks < total)).sum()
+    return jnp.where(total > 0, relevant / jnp.maximum(total, 1.0), 0.0)
+
+
+def retrieval_reciprocal_rank(preds: Array, target: Array) -> Array:
+    """1 / rank of the first relevant document (argmax finds the first True)."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    t = _target_by_pred_rank(preds, target).astype(jnp.float32)
+    first = jnp.argmax(t)  # first occurrence of the max (1.0) — the top-ranked hit
+    return jnp.where(target.sum() > 0, 1.0 / (first.astype(jnp.float32) + 1.0), 0.0)
+
+
+def _dcg(target: Array) -> Array:
+    denom = jnp.log2(jnp.arange(target.shape[-1], dtype=jnp.float32) + 2.0)
+    return (target / denom).sum(axis=-1)
+
+
+def retrieval_normalized_dcg(preds: Array, target: Array, k: Optional[int] = None) -> Array:
+    """nDCG@k with raw-gain DCG (gain = target value, like the reference)."""
+    preds, target = _check_retrieval_functional_inputs(preds, target, allow_non_binary_target=True)
+    _validate_k(k)
+    n = preds.shape[0]
+    k = n if k is None else k
+    target = target.astype(jnp.float32)
+    sorted_target = _target_by_pred_rank(preds, target)[: min(k, n)]
+    ideal_target = jnp.sort(target)[::-1][: min(k, n)]
+    ideal_dcg = _dcg(ideal_target)
+    target_dcg = _dcg(sorted_target)
+    return jnp.where(ideal_dcg > 0, _safe_divide(target_dcg, ideal_dcg), 0.0)
+
+
+def retrieval_precision_recall_curve(
+    preds: Array,
+    target: Array,
+    max_k: Optional[int] = None,
+    adaptive_k: bool = False,
+) -> Tuple[Array, Array, Array]:
+    """(precision@k, recall@k, k) for k in 1..max_k over one query."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    if not isinstance(adaptive_k, bool):
+        raise ValueError("`adaptive_k` has to be a boolean")
+    if max_k is not None and not (isinstance(max_k, int) and max_k > 0):
+        raise ValueError("`max_k` has to be a positive integer or None")
+    n = preds.shape[0]
+    max_k = n if max_k is None else max_k
+
+    if adaptive_k and max_k > n:
+        topk = jnp.concatenate(
+            [jnp.arange(1, n + 1, dtype=jnp.float32), jnp.full((max_k - n,), float(n), dtype=jnp.float32)]
+        )
+    else:
+        topk = jnp.arange(1, max_k + 1, dtype=jnp.float32)
+
+    t = _target_by_pred_rank(preds, target).astype(jnp.float32)[: min(max_k, n)]
+    t = jnp.pad(t, (0, max(0, max_k - t.shape[0])))
+    cum_rel = jnp.cumsum(t)
+    total = target.sum().astype(jnp.float32)
+    has_pos = total > 0
+    recall = jnp.where(has_pos, cum_rel / jnp.maximum(total, 1.0), jnp.zeros(max_k))
+    precision = jnp.where(has_pos, cum_rel / topk, jnp.zeros(max_k))
+    return precision, recall, topk.astype(jnp.int32)

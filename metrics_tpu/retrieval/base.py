@@ -1,0 +1,187 @@
+"""RetrievalMetric base — vectorised group-by-query compute.
+
+Reference parity: src/torchmetrics/retrieval/base.py:25 (``RetrievalMetric`` keeps
+``indexes/preds/target`` list states; compute sorts by index, splits via
+``_flexible_bincount`` and loops queries on host, applying ``empty_target_action``).
+
+TPU-native redesign: NO host loop. One ``lexsort`` by (query, -score) orders every
+document of every query; per-document within-query ranks and cumulative hit counts come
+from cumulative ops; per-query reductions are ``jax.ops.segment_sum/min`` with a static
+``num_segments``. Every retrieval metric is then a closed-form expression over these
+arrays — a single fused XLA program over all queries instead of Q small kernels.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.checks import _check_retrieval_inputs
+from metrics_tpu.utils.data import dim_zero_cat
+
+
+@dataclass
+class GroupedRanks:
+    """All per-document/per-query arrays needed by any ranked-retrieval metric.
+
+    Sorted order is (query ascending, score descending). ``seg`` maps each document to
+    a dense query id in [0, num_queries); ``rank`` is the 0-based position of the
+    document within its query's ranking.
+    """
+
+    seg: Array          # (N,) int32 dense query ids, sorted
+    rank: Array         # (N,) int32 within-query rank by descending score
+    preds: Array        # (N,) float32, sorted
+    target: Array       # (N,) float32, sorted by (query, -score)
+    ideal_target: Array # (N,) float32, sorted by (query, -target) — for nDCG
+    n_per: Array        # (Q,) float32 docs per query
+    pos_per: Array      # (Q,) float32 positive-target total per query (sum of gains)
+    neg_per: Array      # (Q,) float32 count of zero/negative targets per query
+    cum_hits: Array     # (N,) float32 inclusive within-query cumsum of target
+    num_queries: int
+
+    def segment_sum(self, x: Array) -> Array:
+        return jax.ops.segment_sum(x, self.seg, num_segments=self.num_queries)
+
+    def segment_min(self, x: Array) -> Array:
+        return jax.ops.segment_min(x, self.seg, num_segments=self.num_queries)
+
+    def k_mask(self, k: Optional[Array]) -> Array:
+        """(N,) mask selecting documents with rank < k (k per-query or scalar; None = all)."""
+        if k is None:
+            return jnp.ones_like(self.rank, dtype=jnp.float32)
+        k_per_doc = k[self.seg] if getattr(k, "ndim", 0) == 1 else k
+        return (self.rank < k_per_doc).astype(jnp.float32)
+
+
+def group_by_query(indexes: Array, preds: Array, target: Array) -> GroupedRanks:
+    """Build :class:`GroupedRanks` from flat (indexes, preds, target)."""
+    n = preds.shape[0]
+    order = jnp.lexsort((-preds, indexes))
+    idx_s = indexes[order]
+    preds_s = preds[order]
+    tgt_s = target[order].astype(jnp.float32)
+
+    new = jnp.concatenate([jnp.ones((1,), bool), idx_s[1:] != idx_s[:-1]])
+    seg = jnp.cumsum(new.astype(jnp.int32)) - 1
+    num_queries = int(seg[-1]) + 1 if n else 0
+
+    positions = jnp.arange(n, dtype=jnp.int32)
+    seg_start = jax.lax.cummax(jnp.where(new, positions, 0))
+    rank = positions - seg_start
+
+    # within-query inclusive cumsum of target: global cumsum minus the base at the
+    # segment start (cummax trick requires non-negative targets, which retrieval has)
+    pre = jnp.cumsum(tgt_s)
+    excl = pre - tgt_s
+    base = jax.lax.cummax(jnp.where(new, excl, 0.0))
+    cum_hits = pre - base
+
+    ones = jnp.ones((n,), jnp.float32)
+    n_per = jax.ops.segment_sum(ones, seg, num_segments=num_queries)
+    pos_per = jax.ops.segment_sum(tgt_s, seg, num_segments=num_queries)
+    neg_per = jax.ops.segment_sum((tgt_s <= 0).astype(jnp.float32), seg, num_segments=num_queries)
+
+    ideal_order = jnp.lexsort((-target.astype(jnp.float32), indexes))
+    ideal_t = target[ideal_order].astype(jnp.float32)
+
+    return GroupedRanks(
+        seg=seg,
+        rank=rank,
+        preds=preds_s,
+        target=tgt_s,
+        ideal_target=ideal_t,
+        n_per=n_per,
+        pos_per=pos_per,
+        neg_per=neg_per,
+        cum_hits=cum_hits,
+        num_queries=num_queries,
+    )
+
+
+class RetrievalMetric(Metric):
+    """Base for retrieval metrics (reference retrieval/base.py:25).
+
+    Subclasses implement :meth:`_query_values` returning one value per query; this base
+    handles input validation, state, the vectorised grouping, and
+    ``empty_target_action`` semantics (neg/pos/skip/error on queries with no positive —
+    or, for fall-out, no negative — target).
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: Optional[bool] = True
+    full_state_update: bool = False
+
+    allow_non_binary_target: bool = False
+    # which per-query count must be non-zero for the query to be "non-empty"
+    _empty_on: str = "positives"
+
+    indexes: List[Array]
+    preds: List[Array]
+    target: List[Array]
+
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+
+        if empty_target_action not in ("error", "skip", "neg", "pos"):
+            raise ValueError(f"Argument `empty_target_action` received a wrong value `{empty_target_action}`.")
+        self.empty_target_action = empty_target_action
+
+        if ignore_index is not None and not isinstance(ignore_index, int):
+            raise ValueError("Argument `ignore_index` must be an integer or None.")
+        self.ignore_index = ignore_index
+
+        self.add_state("indexes", default=[], dist_reduce_fx="cat")
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+        self.add_state("target", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array, indexes: Array) -> None:
+        if indexes is None:
+            raise ValueError("Argument `indexes` cannot be None")
+        indexes, preds, target = _check_retrieval_inputs(
+            indexes, preds, target, allow_non_binary_target=self.allow_non_binary_target, ignore_index=self.ignore_index
+        )
+        self.indexes.append(indexes)
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def compute(self) -> Array:
+        indexes = dim_zero_cat(self.indexes)
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+
+        groups = group_by_query(indexes, preds, target)
+        values = self._query_values(groups)
+        valid = (groups.pos_per if self._empty_on == "positives" else groups.neg_per) > 0
+
+        if self.empty_target_action == "error":
+            if bool(jnp.any(~valid)):
+                kind = "positive" if self._empty_on == "positives" else "negative"
+                raise ValueError(f"`compute` method was provided with a query with no {kind} target.")
+            mask = jnp.ones_like(valid)
+        elif self.empty_target_action == "pos":
+            values = jnp.where(valid, values, 1.0)
+            mask = jnp.ones_like(valid)
+        elif self.empty_target_action == "neg":
+            values = jnp.where(valid, values, 0.0)
+            mask = jnp.ones_like(valid)
+        else:  # skip
+            mask = valid
+
+        count = mask.sum()
+        total = jnp.where(mask, values, 0.0).sum()
+        return jnp.where(count > 0, total / jnp.maximum(count, 1), 0.0).astype(jnp.float32)
+
+    def _query_values(self, groups: GroupedRanks) -> Array:
+        """Return the metric value for every query as a (Q,) array."""
+        raise NotImplementedError

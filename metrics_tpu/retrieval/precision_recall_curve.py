@@ -1,0 +1,139 @@
+"""RetrievalPrecisionRecallCurve + RetrievalRecallAtFixedPrecision.
+
+Reference parity: src/torchmetrics/retrieval/precision_recall_curve.py (per-query
+precision/recall arrays for k=1..max_k, averaged over queries; empty queries filled per
+``empty_target_action``; ``RetrievalRecallAtFixedPrecision`` post-processes the averaged
+curve via ``_retrieval_recall_at_fixed_precision``).
+
+TPU-native: the per-query curves are built with ONE scatter-add into a dense
+``(num_queries, max_k)`` matrix followed by a cumsum along k — no host loop.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.retrieval.base import RetrievalMetric, group_by_query
+from metrics_tpu.utils.data import dim_zero_cat
+
+
+def _retrieval_recall_at_fixed_precision(
+    precision: Array,
+    recall: Array,
+    top_k: Array,
+    min_precision: float,
+) -> Tuple[Array, Array]:
+    """Max recall (and its k) among points with precision >= min_precision."""
+    p = np.asarray(precision)
+    r = np.asarray(recall)
+    ks = np.asarray(top_k)
+    try:
+        max_recall, best_k = max((rr, kk) for pp, rr, kk in zip(p, r, ks) if pp >= min_precision)
+    except ValueError:
+        max_recall, best_k = 0.0, len(ks)
+    if max_recall == 0.0:
+        best_k = len(ks)
+    return jnp.asarray(max_recall, dtype=jnp.float32), jnp.asarray(best_k, dtype=jnp.int32)
+
+
+class RetrievalPrecisionRecallCurve(RetrievalMetric):
+    """Averaged (over queries) precision@k / recall@k curve for k = 1..max_k."""
+
+    higher_is_better = True
+
+    def __init__(
+        self,
+        max_k: Optional[int] = None,
+        adaptive_k: bool = False,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, **kwargs)
+        if max_k is not None and not (isinstance(max_k, int) and max_k > 0):
+            raise ValueError("`max_k` has to be a positive integer or None")
+        if not isinstance(adaptive_k, bool):
+            raise ValueError("`adaptive_k` has to be a boolean")
+        self.max_k = max_k
+        self.adaptive_k = adaptive_k
+
+    def compute(self) -> Tuple[Array, Array, Array]:
+        indexes = dim_zero_cat(self.indexes)
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+
+        g = group_by_query(indexes, preds, target)
+        max_k = self.max_k if self.max_k is not None else int(jnp.max(g.n_per))
+        q = g.num_queries
+
+        # hits per (query, rank<max_k) cell, then cumulative along k
+        in_k = g.rank < max_k
+        rel = jnp.zeros((q, max_k), jnp.float32).at[g.seg, jnp.minimum(g.rank, max_k - 1)].add(
+            g.target * in_k.astype(jnp.float32)
+        )
+        cum_rel = jnp.cumsum(rel, axis=1)
+
+        ks = jnp.arange(1, max_k + 1, dtype=jnp.float32)[None, :]          # (1, K)
+        if self.adaptive_k:
+            denom_k = jnp.minimum(ks, g.n_per[:, None])                     # (Q, K)
+        else:
+            denom_k = jnp.broadcast_to(ks, (q, max_k))
+
+        valid = g.pos_per > 0
+        precision = jnp.where(valid[:, None], cum_rel / denom_k, 0.0)
+        recall = jnp.where(valid[:, None], cum_rel / jnp.maximum(g.pos_per[:, None], 1.0), 0.0)
+
+        if self.empty_target_action == "error":
+            if bool(jnp.any(~valid)):
+                raise ValueError("`compute` method was provided with a query with no positive target.")
+            mask = jnp.ones_like(valid)
+        elif self.empty_target_action == "pos":
+            precision = jnp.where(valid[:, None], precision, 1.0)
+            recall = jnp.where(valid[:, None], recall, 1.0)
+            mask = jnp.ones_like(valid)
+        elif self.empty_target_action == "neg":
+            mask = jnp.ones_like(valid)   # rows already zeroed
+        else:  # skip
+            mask = valid
+
+        count = jnp.maximum(mask.sum(), 1)
+        maskf = mask.astype(jnp.float32)[:, None]
+        avg_precision = (precision * maskf).sum(axis=0) / count
+        avg_recall = (recall * maskf).sum(axis=0) / count
+        top_k = jnp.arange(1, max_k + 1, dtype=jnp.int32)
+        return avg_precision, avg_recall, top_k
+
+    def _query_values(self, g):  # pragma: no cover - curve metric overrides compute
+        raise NotImplementedError
+
+
+class RetrievalRecallAtFixedPrecision(RetrievalPrecisionRecallCurve):
+    """Highest recall@k whose precision@k clears ``min_precision``."""
+
+    def __init__(
+        self,
+        min_precision: float = 0.0,
+        max_k: Optional[int] = None,
+        adaptive_k: bool = False,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            max_k=max_k,
+            adaptive_k=adaptive_k,
+            empty_target_action=empty_target_action,
+            ignore_index=ignore_index,
+            **kwargs,
+        )
+        if not (isinstance(min_precision, float) and 0.0 <= min_precision <= 1.0):
+            raise ValueError("`min_precision` has to be a positive float between 0 and 1")
+        self.min_precision = min_precision
+
+    def compute(self) -> Tuple[Array, Array]:  # type: ignore[override]
+        precision, recall, top_k = super().compute()
+        return _retrieval_recall_at_fixed_precision(precision, recall, top_k, self.min_precision)

@@ -1,0 +1,177 @@
+"""Module retrieval metrics over the vectorised segment kernel.
+
+Reference parity (one class per file in the reference):
+- RetrievalMAP            — retrieval/average_precision.py
+- RetrievalMRR            — retrieval/reciprocal_rank.py
+- RetrievalPrecision      — retrieval/precision.py
+- RetrievalRecall         — retrieval/recall.py
+- RetrievalFallOut        — retrieval/fall_out.py (empty check on NEGATIVE targets,
+  reference fall_out.py:97-131)
+- RetrievalHitRate        — retrieval/hit_rate.py
+- RetrievalRPrecision     — retrieval/r_precision.py
+- RetrievalNormalizedDCG  — retrieval/ndcg.py (non-binary gains allowed)
+
+Each `_query_values` is a closed-form expression over :class:`GroupedRanks` — one fused
+XLA program for all queries (SURVEY §7.2 step 6: segment-op group-by instead of the
+reference's host split loop).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.retrieval.base import GroupedRanks, RetrievalMetric
+from metrics_tpu.utils.compute import _safe_divide
+
+
+def _validate_k(k: Optional[int]) -> None:
+    if k is not None and not (isinstance(k, int) and k > 0):
+        raise ValueError("`k` has to be a positive integer or None")
+
+
+class RetrievalMAP(RetrievalMetric):
+    """Mean Average Precision over queries."""
+
+    def _query_values(self, g: GroupedRanks) -> Array:
+        prec_at_hit = g.cum_hits / (g.rank.astype(jnp.float32) + 1.0)
+        ap_sum = g.segment_sum(prec_at_hit * g.target)
+        return _safe_divide(ap_sum, g.pos_per)
+
+
+class RetrievalMRR(RetrievalMetric):
+    """Mean Reciprocal Rank over queries."""
+
+    def _query_values(self, g: GroupedRanks) -> Array:
+        n = g.rank.shape[0]
+        first_hit = g.segment_min(jnp.where(g.target > 0, g.rank, n).astype(jnp.int32))
+        return jnp.where(g.pos_per > 0, 1.0 / (first_hit.astype(jnp.float32) + 1.0), 0.0)
+
+
+class RetrievalPrecision(RetrievalMetric):
+    """Precision@k; ``adaptive_k`` clamps k to each query's size."""
+
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        k: Optional[int] = None,
+        adaptive_k: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, **kwargs)
+        _validate_k(k)
+        if not isinstance(adaptive_k, bool):
+            raise ValueError("`adaptive_k` has to be a boolean")
+        self.k = k
+        self.adaptive_k = adaptive_k
+
+    def _query_values(self, g: GroupedRanks) -> Array:
+        if self.k is None:
+            k_eff = g.n_per
+        elif self.adaptive_k:
+            k_eff = jnp.minimum(float(self.k), g.n_per)
+        else:
+            k_eff = jnp.full_like(g.n_per, float(self.k))
+        relevant = g.segment_sum(g.target * g.k_mask(k_eff))
+        return _safe_divide(relevant, k_eff)
+
+
+class RetrievalRecall(RetrievalMetric):
+    """Recall@k."""
+
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        k: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, **kwargs)
+        _validate_k(k)
+        self.k = k
+
+    def _query_values(self, g: GroupedRanks) -> Array:
+        k_eff = g.n_per if self.k is None else jnp.full_like(g.n_per, float(self.k))
+        relevant = g.segment_sum(g.target * g.k_mask(k_eff))
+        return _safe_divide(relevant, g.pos_per)
+
+
+class RetrievalFallOut(RetrievalMetric):
+    """Fall-out@k: retrieved-negative fraction of all negatives; lower is better."""
+
+    higher_is_better = False
+    _empty_on = "negatives"
+
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        k: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, **kwargs)
+        _validate_k(k)
+        self.k = k
+
+    def _query_values(self, g: GroupedRanks) -> Array:
+        k_eff = g.n_per if self.k is None else jnp.full_like(g.n_per, float(self.k))
+        neg = 1.0 - g.target
+        retrieved_neg = g.segment_sum(neg * g.k_mask(k_eff))
+        return _safe_divide(retrieved_neg, g.neg_per)
+
+
+class RetrievalHitRate(RetrievalMetric):
+    """Hit rate@k: 1 if any relevant document in the top-k."""
+
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        k: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, **kwargs)
+        _validate_k(k)
+        self.k = k
+
+    def _query_values(self, g: GroupedRanks) -> Array:
+        k_eff = g.n_per if self.k is None else jnp.full_like(g.n_per, float(self.k))
+        hits = g.segment_sum(g.target * g.k_mask(k_eff))
+        return (hits > 0).astype(jnp.float32)
+
+
+class RetrievalRPrecision(RetrievalMetric):
+    """Precision at k = (# relevant documents of the query)."""
+
+    def _query_values(self, g: GroupedRanks) -> Array:
+        in_top_r = (g.rank.astype(jnp.float32) < g.pos_per[g.seg]).astype(jnp.float32)
+        relevant = g.segment_sum(g.target * in_top_r)
+        return _safe_divide(relevant, g.pos_per)
+
+
+class RetrievalNormalizedDCG(RetrievalMetric):
+    """nDCG@k with raw-gain DCG over possibly non-binary targets."""
+
+    allow_non_binary_target = True
+
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        k: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, **kwargs)
+        _validate_k(k)
+        self.k = k
+
+    def _query_values(self, g: GroupedRanks) -> Array:
+        k_eff = g.n_per if self.k is None else jnp.minimum(float(self.k), g.n_per)
+        mask = g.k_mask(k_eff)
+        discount = 1.0 / jnp.log2(g.rank.astype(jnp.float32) + 2.0)
+        dcg = g.segment_sum(g.target * discount * mask)
+        idcg = g.segment_sum(g.ideal_target * discount * mask)
+        return jnp.where(idcg > 0, _safe_divide(dcg, idcg), 0.0)

@@ -1,0 +1,294 @@
+"""Retrieval metric tests — vs independent numpy per-query references.
+
+Mirrors the reference's test strategy (tests/unittests/retrieval/*): group by query on
+the union of data, compute the per-query metric with a plain-python implementation,
+apply empty_target_action, average.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from metrics_tpu.functional.retrieval import (
+    retrieval_average_precision,
+    retrieval_fall_out,
+    retrieval_hit_rate,
+    retrieval_normalized_dcg,
+    retrieval_precision,
+    retrieval_precision_recall_curve,
+    retrieval_r_precision,
+    retrieval_recall,
+    retrieval_reciprocal_rank,
+)
+from metrics_tpu.retrieval import (
+    RetrievalFallOut,
+    RetrievalHitRate,
+    RetrievalMAP,
+    RetrievalMRR,
+    RetrievalNormalizedDCG,
+    RetrievalPrecision,
+    RetrievalPrecisionRecallCurve,
+    RetrievalRecall,
+    RetrievalRecallAtFixedPrecision,
+    RetrievalRPrecision,
+)
+from tests.helpers.testers import MetricTester
+
+NUM_BATCHES, BATCH_SIZE, NUM_QUERIES = 8, 64, 10
+
+_rng = np.random.RandomState(7)
+PREDS = _rng.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32)
+TARGET = _rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE))
+INDEXES = _rng.randint(0, NUM_QUERIES, (NUM_BATCHES, BATCH_SIZE))
+TARGET_GAINS = _rng.randint(0, 4, (NUM_BATCHES, BATCH_SIZE))  # non-binary for nDCG
+
+
+# ---------------------------------------------------------------- numpy references
+def _np_ap(p, t):
+    order = np.argsort(-p, kind="stable")
+    t = t[order]
+    if t.sum() == 0:
+        return 0.0
+    prec = np.cumsum(t) / np.arange(1, len(t) + 1)
+    return float((prec * t).sum() / t.sum())
+
+
+def _np_rr(p, t):
+    t = t[np.argsort(-p, kind="stable")]
+    pos = np.flatnonzero(t)
+    return 0.0 if len(pos) == 0 else float(1.0 / (pos[0] + 1))
+
+
+def _np_precision(p, t, k=None, adaptive_k=False):
+    n = len(p)
+    if k is None or (adaptive_k and k > n):
+        k = n
+    if t.sum() == 0:
+        return 0.0
+    t_s = t[np.argsort(-p, kind="stable")]
+    return float(t_s[: min(k, n)].sum() / k)
+
+
+def _np_recall(p, t, k=None):
+    n = len(p)
+    k = n if k is None else k
+    if t.sum() == 0:
+        return 0.0
+    t_s = t[np.argsort(-p, kind="stable")]
+    return float(t_s[: min(k, n)].sum() / t.sum())
+
+
+def _np_fall_out(p, t, k=None):
+    n = len(p)
+    k = n if k is None else k
+    neg = 1 - t
+    if neg.sum() == 0:
+        return 0.0
+    neg_s = neg[np.argsort(-p, kind="stable")]
+    return float(neg_s[: min(k, n)].sum() / neg.sum())
+
+
+def _np_hit_rate(p, t, k=None):
+    n = len(p)
+    k = n if k is None else k
+    t_s = t[np.argsort(-p, kind="stable")]
+    return float(t_s[: min(k, n)].sum() > 0)
+
+
+def _np_r_precision(p, t):
+    r = int(t.sum())
+    if r == 0:
+        return 0.0
+    t_s = t[np.argsort(-p, kind="stable")]
+    return float(t_s[:r].sum() / r)
+
+
+def _np_ndcg(p, t, k=None):
+    n = len(p)
+    k = n if k is None else k
+    t = t.astype(float)
+    t_s = t[np.argsort(-p, kind="stable")][: min(k, n)]
+    ideal = np.sort(t)[::-1][: min(k, n)]
+    disc = 1.0 / np.log2(np.arange(len(t_s)) + 2.0)
+    dcg, idcg = (t_s * disc).sum(), (ideal * disc).sum()
+    return 0.0 if idcg == 0 else float(dcg / idcg)
+
+
+def _np_retrieval(per_query_fn, empty="neg", empty_on="positives", **fn_kwargs):
+    """Build a (preds, target, indexes) -> mean-over-queries reference."""
+
+    def ref(preds, target, indexes):
+        preds, target, indexes = preds.reshape(-1), target.reshape(-1), indexes.reshape(-1)
+        res = []
+        for q in np.unique(indexes):
+            sel = indexes == q
+            p, t = preds[sel], target[sel]
+            relevant_count = (1 - t).sum() if empty_on == "negatives" else t.sum()
+            if relevant_count == 0:
+                if empty == "pos":
+                    res.append(1.0)
+                elif empty == "neg":
+                    res.append(0.0)
+                # skip: drop
+            else:
+                res.append(per_query_fn(p, t, **fn_kwargs))
+        return float(np.mean(res)) if res else 0.0
+
+    return ref
+
+
+FUNCTIONAL_CASES = [
+    (retrieval_average_precision, _np_ap, {}),
+    (retrieval_reciprocal_rank, _np_rr, {}),
+    (retrieval_precision, _np_precision, {"k": 3}),
+    (retrieval_precision, _np_precision, {"k": 100, "adaptive_k": True}),
+    (retrieval_recall, _np_recall, {"k": 5}),
+    (retrieval_fall_out, _np_fall_out, {"k": 4}),
+    (retrieval_hit_rate, _np_hit_rate, {"k": 2}),
+    (retrieval_r_precision, _np_r_precision, {}),
+    (retrieval_normalized_dcg, _np_ndcg, {"k": 7}),
+    (retrieval_normalized_dcg, _np_ndcg, {}),
+]
+
+
+@pytest.mark.parametrize("fn,ref,kwargs", FUNCTIONAL_CASES)
+def test_retrieval_functional(fn, ref, kwargs):
+    for i in range(4):
+        p, t = PREDS[i], TARGET[i]
+        if fn is retrieval_normalized_dcg:
+            t = TARGET_GAINS[i]
+        np.testing.assert_allclose(float(fn(p, t, **kwargs)), ref(p, t, **kwargs), atol=1e-6)
+
+
+CLASS_CASES = [
+    (RetrievalMAP, _np_ap, {}, {}),
+    (RetrievalMRR, _np_rr, {}, {}),
+    (RetrievalPrecision, _np_precision, {"k": 3}, {"k": 3}),
+    (RetrievalPrecision, _np_precision, {"k": 100, "adaptive_k": True}, {"k": 100, "adaptive_k": True}),
+    (RetrievalRecall, _np_recall, {"k": 5}, {"k": 5}),
+    (RetrievalHitRate, _np_hit_rate, {"k": 2}, {"k": 2}),
+    (RetrievalRPrecision, _np_r_precision, {}, {}),
+    (RetrievalNormalizedDCG, _np_ndcg, {"k": 7}, {"k": 7}),
+]
+
+
+@pytest.mark.parametrize("cls,per_query,metric_args,fn_kwargs", CLASS_CASES)
+@pytest.mark.parametrize("empty_target_action", ["neg", "pos", "skip"])
+def test_retrieval_class(cls, per_query, metric_args, fn_kwargs, empty_target_action):
+    tester = MetricTester()
+    tester.atol = 1e-5
+    target = TARGET_GAINS if cls is RetrievalNormalizedDCG else TARGET
+    ref = _np_retrieval(per_query, empty=empty_target_action, **fn_kwargs)
+    tester.run_class_metric_test(
+        preds=PREDS,
+        target=target,
+        metric_class=cls,
+        reference_metric=ref,
+        metric_args={**metric_args, "empty_target_action": empty_target_action},
+        check_state_dict=True,
+        check_sharded=False,
+        fragment_kwargs=True,
+        indexes=INDEXES,
+    )
+
+
+def test_retrieval_fall_out_class():
+    """FallOut's empty check is on negatives (reference fall_out.py:118)."""
+    tester = MetricTester()
+    tester.atol = 1e-5
+    ref = _np_retrieval(_np_fall_out, empty="neg", empty_on="negatives", k=4)
+    tester.run_class_metric_test(
+        preds=PREDS,
+        target=TARGET,
+        metric_class=RetrievalFallOut,
+        reference_metric=ref,
+        metric_args={"k": 4},
+        check_sharded=False,
+        fragment_kwargs=True,
+        indexes=INDEXES,
+    )
+
+
+def test_empty_target_error():
+    m = RetrievalMAP(empty_target_action="error")
+    m.update(np.asarray([0.1, 0.2]), np.asarray([0, 0]), indexes=np.asarray([0, 0]))
+    with pytest.raises(ValueError, match="no positive target"):
+        m.compute()
+
+
+def test_ignore_index():
+    m = RetrievalMAP(ignore_index=-1)
+    preds = np.asarray([0.9, 0.1, 0.5, 0.3], dtype=np.float32)
+    target = np.asarray([1, -1, 0, 1])
+    idx = np.asarray([0, 0, 0, 0])
+    m.update(preds, target, indexes=idx)
+    expected = _np_ap(np.asarray([0.9, 0.5, 0.3]), np.asarray([1, 0, 1]))
+    np.testing.assert_allclose(float(m.compute()), expected, atol=1e-6)
+
+
+def test_input_validation():
+    m = RetrievalMAP()
+    with pytest.raises(ValueError, match="cannot be None"):
+        m.update(np.asarray([0.1]), np.asarray([1]), None)
+    with pytest.raises(ValueError, match="binary"):
+        m.update(np.asarray([0.1]), np.asarray([3]), np.asarray([0]))
+    with pytest.raises(ValueError):
+        RetrievalMAP(empty_target_action="bogus")
+    with pytest.raises(ValueError):
+        RetrievalPrecision(k=-1)
+
+
+def test_precision_recall_curve_vs_reference():
+    """Vectorised curve ≡ per-query functional curve averaged on host."""
+    max_k = 6
+    m = RetrievalPrecisionRecallCurve(max_k=max_k)
+    for i in range(NUM_BATCHES):
+        m.update(PREDS[i], TARGET[i], indexes=INDEXES[i])
+    precision, recall, top_k = m.compute()
+
+    preds, target, indexes = PREDS.reshape(-1), TARGET.reshape(-1), INDEXES.reshape(-1)
+    precs, recs = [], []
+    for q in np.unique(indexes):
+        sel = indexes == q
+        p, t = preds[sel], target[sel]
+        if t.sum() == 0:
+            precs.append(np.zeros(max_k))
+            recs.append(np.zeros(max_k))
+            continue
+        order = np.argsort(-p, kind="stable")
+        t_s = t[order][: min(max_k, len(p))].astype(float)
+        t_s = np.pad(t_s, (0, max_k - len(t_s)))
+        cum = np.cumsum(t_s)
+        precs.append(cum / np.arange(1, max_k + 1))
+        recs.append(cum / t.sum())
+    np.testing.assert_allclose(np.asarray(precision), np.mean(precs, axis=0), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(recall), np.mean(recs, axis=0), atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(top_k), np.arange(1, max_k + 1))
+
+
+def test_recall_at_fixed_precision():
+    m = RetrievalRecallAtFixedPrecision(min_precision=0.3, max_k=6)
+    for i in range(NUM_BATCHES):
+        m.update(PREDS[i], TARGET[i], indexes=INDEXES[i])
+    max_recall, best_k = m.compute()
+
+    curve = RetrievalPrecisionRecallCurve(max_k=6)
+    for i in range(NUM_BATCHES):
+        curve.update(PREDS[i], TARGET[i], indexes=INDEXES[i])
+    precision, recall, top_k = (np.asarray(x) for x in curve.compute())
+    candidates = [(r, k) for p, r, k in zip(precision, recall, top_k) if p >= 0.3]
+    exp_recall, exp_k = max(candidates) if candidates else (0.0, len(top_k))
+    np.testing.assert_allclose(float(max_recall), exp_recall, atol=1e-6)
+    assert int(best_k) == int(exp_k)
+
+
+def test_functional_prc_single_query():
+    p, t = PREDS[0][:10], TARGET[0][:10]
+    precision, recall, top_k = retrieval_precision_recall_curve(p, t, max_k=5)
+    order = np.argsort(-p, kind="stable")
+    t_s = t[order][:5].astype(float)
+    cum = np.cumsum(t_s)
+    np.testing.assert_allclose(np.asarray(precision), cum / np.arange(1, 6), atol=1e-6)
+    if t.sum():
+        np.testing.assert_allclose(np.asarray(recall), cum / t.sum(), atol=1e-6)
