@@ -156,8 +156,9 @@ class MetricTester:
         pickled = pickle.dumps(metrics[0])
         metrics[0] = pickle.loads(pickled)
 
+        num_batches = len(preds)
         for rank in range(world_size):
-            for i in range(rank, NUM_BATCHES, world_size):
+            for i in range(rank, num_batches, world_size):
                 extra = (
                     {k: v[i] if isinstance(v, (list, np.ndarray)) and not np.isscalar(v) else v for k, v in kwargs_update.items()}
                     if fragment_kwargs
@@ -182,11 +183,11 @@ class MetricTester:
             m.distributed_available_fn = lambda: True
         result = metrics[0].compute()
 
-        all_preds = np.concatenate([np.asarray(preds[i]).reshape(-1, *np.asarray(preds[i]).shape[1:]) for i in range(NUM_BATCHES)])
-        all_target = np.concatenate([np.asarray(target[i]) for i in range(NUM_BATCHES)])
+        all_preds = np.concatenate([np.asarray(preds[i]).reshape(-1, *np.asarray(preds[i]).shape[1:]) for i in range(num_batches)])
+        all_target = np.concatenate([np.asarray(target[i]) for i in range(num_batches)])
         if fragment_kwargs:
             union_kwargs = {
-                k: (np.concatenate([np.asarray(v[i]) for i in range(NUM_BATCHES)]) if isinstance(v, (list, np.ndarray)) and not np.isscalar(v) else v)
+                k: (np.concatenate([np.asarray(v[i]) for i in range(num_batches)]) if isinstance(v, (list, np.ndarray)) and not np.isscalar(v) else v)
                 for k, v in kwargs_update.items()
             }
         else:
