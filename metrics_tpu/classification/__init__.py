@@ -8,7 +8,13 @@ from metrics_tpu.classification.average_precision import (
     MulticlassAveragePrecision,
     MultilabelAveragePrecision,
 )
+from metrics_tpu.classification.calibration_error import (
+    BinaryCalibrationError,
+    CalibrationError,
+    MulticlassCalibrationError,
+)
 from metrics_tpu.classification.cohen_kappa import BinaryCohenKappa, CohenKappa, MulticlassCohenKappa
+from metrics_tpu.classification.dice import Dice
 from metrics_tpu.classification.confusion_matrix import (
     BinaryConfusionMatrix,
     ConfusionMatrix,
@@ -26,6 +32,7 @@ from metrics_tpu.classification.f_beta import (
     MultilabelF1Score,
     MultilabelFBetaScore,
 )
+from metrics_tpu.classification.hinge import BinaryHingeLoss, HingeLoss, MulticlassHingeLoss
 from metrics_tpu.classification.hamming import (
     BinaryHammingDistance,
     HammingDistance,
@@ -60,7 +67,24 @@ from metrics_tpu.classification.precision_recall_curve import (
     MultilabelPrecisionRecallCurve,
     PrecisionRecallCurve,
 )
+from metrics_tpu.classification.ranking import (
+    MultilabelCoverageError,
+    MultilabelRankingAveragePrecision,
+    MultilabelRankingLoss,
+)
+from metrics_tpu.classification.recall_at_fixed_precision import (
+    BinaryRecallAtFixedPrecision,
+    MulticlassRecallAtFixedPrecision,
+    MultilabelRecallAtFixedPrecision,
+    RecallAtFixedPrecision,
+)
 from metrics_tpu.classification.roc import ROC, BinaryROC, MulticlassROC, MultilabelROC
+from metrics_tpu.classification.specificity_at_sensitivity import (
+    BinarySpecificityAtSensitivity,
+    MulticlassSpecificityAtSensitivity,
+    MultilabelSpecificityAtSensitivity,
+    SpecificityAtSensitivity,
+)
 from metrics_tpu.classification.specificity import (
     BinarySpecificity,
     MulticlassSpecificity,

@@ -1,0 +1,97 @@
+"""Dice module metric (legacy-style API).
+
+Reference parity: src/torchmetrics/classification/dice.py — legacy StatScores-style
+state: fixed-shape sum states for global accumulation (micro → scalars, macro → (C,))
+and cat-list states for samplewise modes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.classification.dice import _dice_compute, _dice_stat_scores_update
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.data import dim_zero_cat
+
+
+class Dice(Metric):
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(
+        self,
+        zero_division: float = 0.0,
+        num_classes: Optional[int] = None,
+        threshold: float = 0.5,
+        average: Optional[str] = "micro",
+        mdmc_average: Optional[str] = "global",
+        ignore_index: Optional[int] = None,
+        top_k: Optional[int] = None,
+        multiclass: Optional[bool] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        allowed_average = ("micro", "macro", "weighted", "samples", "none", None)
+        if average not in allowed_average:
+            raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
+        allowed_mdmc_average = ("global", "samplewise", None)
+        if mdmc_average not in allowed_mdmc_average:
+            raise ValueError(f"The `mdmc_average` has to be one of {allowed_mdmc_average}, got {mdmc_average}.")
+        if average in ("macro", "weighted", "none", None) and (num_classes is None or num_classes < 1):
+            raise ValueError(f"When you set `average` as {average}, you have to provide the number of classes.")
+        if num_classes is not None and ignore_index is not None and not 0 <= ignore_index < num_classes and num_classes > 1:
+            raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {num_classes} classes")
+
+        self.zero_division = zero_division
+        self.num_classes = num_classes
+        self.threshold = threshold
+        self.average = average
+        self.mdmc_average = mdmc_average
+        self.ignore_index = ignore_index
+        self.top_k = top_k
+        self.multiclass = multiclass
+        self.reduce = "macro" if average in ("weighted", "none", None) else average
+
+        # samplewise/samples accumulate per-sample stats → ragged cat states;
+        # global micro/macro accumulate fixed-shape sums
+        if mdmc_average != "samplewise" and self.reduce != "samples":
+            shape = () if self.reduce == "micro" else (num_classes,)
+            default, reduce_fx = jnp.zeros(shape, dtype=jnp.int32), "sum"
+            self.add_state("tp", default, dist_reduce_fx=reduce_fx)
+            self.add_state("fp", default, dist_reduce_fx=reduce_fx)
+            self.add_state("tn", default, dist_reduce_fx=reduce_fx)
+            self.add_state("fn", default, dist_reduce_fx=reduce_fx)
+            self._list_states = False
+        else:
+            self.add_state("tp", [], dist_reduce_fx="cat")
+            self.add_state("fp", [], dist_reduce_fx="cat")
+            self.add_state("tn", [], dist_reduce_fx="cat")
+            self.add_state("fn", [], dist_reduce_fx="cat")
+            self._list_states = True
+
+    def update(self, preds: Array, target: Array) -> None:
+        tp, fp, tn, fn = _dice_stat_scores_update(
+            preds, target, reduce=self.reduce, mdmc_reduce=self.mdmc_average, num_classes=self.num_classes,
+            top_k=self.top_k, threshold=self.threshold, multiclass=self.multiclass, ignore_index=self.ignore_index,
+        )
+        if self._list_states:
+            self.tp.append(jnp.atleast_1d(tp))
+            self.fp.append(jnp.atleast_1d(fp))
+            self.tn.append(jnp.atleast_1d(tn))
+            self.fn.append(jnp.atleast_1d(fn))
+        else:
+            self.tp = self.tp + tp
+            self.fp = self.fp + fp
+            self.tn = self.tn + tn
+            self.fn = self.fn + fn
+
+    def compute(self) -> Array:
+        if self._list_states:
+            tp, fp, fn = dim_zero_cat(self.tp), dim_zero_cat(self.fp), dim_zero_cat(self.fn)
+        else:
+            tp, fp, fn = self.tp, self.fp, self.fn
+        return _dice_compute(tp, fp, fn, self.average, self.mdmc_average, self.zero_division)

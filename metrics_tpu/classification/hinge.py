@@ -1,0 +1,133 @@
+"""Hinge-loss module metrics.
+
+Reference parity: src/torchmetrics/classification/hinge.py
+(BinaryHingeLoss / MulticlassHingeLoss + ``HingeLoss`` façade). Scalar sum states
+(``measures``/``total``) with sum-reduce — psum over the mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.classification.hinge import (
+    _binary_hinge_loss_arg_validation,
+    _binary_hinge_loss_tensor_validation,
+    _binary_hinge_loss_update,
+    _hinge_loss_compute,
+    _multiclass_hinge_loss_arg_validation,
+    _multiclass_hinge_loss_tensor_validation,
+    _multiclass_hinge_loss_update,
+)
+from metrics_tpu.functional.classification.stat_scores import _ignore_mask, _sigmoid_if_logits
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.enums import ClassificationTaskNoMultilabel
+
+
+class BinaryHingeLoss(Metric):
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+
+    measures: Array
+    total: Array
+
+    def __init__(
+        self,
+        squared: bool = False,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _binary_hinge_loss_arg_validation(squared, ignore_index)
+        self.squared = squared
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self.add_state("measures", jnp.zeros((), dtype=jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), dtype=jnp.float32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        if self.validate_args:
+            _binary_hinge_loss_tensor_validation(preds, target, self.ignore_index)
+        preds = jnp.asarray(preds).reshape(-1).astype(jnp.float32)
+        target = jnp.asarray(target).reshape(-1)
+        mask = _ignore_mask(target, self.ignore_index).reshape(-1)
+        target = jnp.where(mask, target, 0)
+        preds = _sigmoid_if_logits(preds)
+        measures, total = _binary_hinge_loss_update(preds, target, self.squared, mask)
+        self.measures = self.measures + measures
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        return _hinge_loss_compute(self.measures, self.total)
+
+
+class MulticlassHingeLoss(Metric):
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+
+    measures: Array
+    total: Array
+
+    def __init__(
+        self,
+        num_classes: int,
+        squared: bool = False,
+        multiclass_mode: str = "crammer-singer",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _multiclass_hinge_loss_arg_validation(num_classes, squared, multiclass_mode, ignore_index)
+        self.num_classes = num_classes
+        self.squared = squared
+        self.multiclass_mode = multiclass_mode
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        shape = () if multiclass_mode == "crammer-singer" else (num_classes,)
+        self.add_state("measures", jnp.zeros(shape, dtype=jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), dtype=jnp.float32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        if self.validate_args:
+            _multiclass_hinge_loss_tensor_validation(preds, target, self.num_classes, self.ignore_index)
+        preds = jnp.moveaxis(jnp.asarray(preds), 1, -1).reshape(-1, self.num_classes).astype(jnp.float32)
+        target = jnp.asarray(target).reshape(-1)
+        mask = _ignore_mask(target, self.ignore_index)
+        target = jnp.where(mask, target, 0)
+        measures, total = _multiclass_hinge_loss_update(preds, target, self.squared, self.multiclass_mode, mask)
+        self.measures = self.measures + measures
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        return _hinge_loss_compute(self.measures, self.total)
+
+
+class HingeLoss:
+    """Task façade (reference hinge.py ``HingeLoss.__new__``)."""
+
+    def __new__(  # type: ignore[misc]
+        cls,
+        task: str,
+        num_classes: Optional[int] = None,
+        squared: bool = False,
+        multiclass_mode: str = "crammer-singer",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> Metric:
+        task = ClassificationTaskNoMultilabel.from_str_or_raise(task)
+        kwargs.update({"ignore_index": ignore_index, "validate_args": validate_args})
+        if task == ClassificationTaskNoMultilabel.BINARY:
+            return BinaryHingeLoss(squared, **kwargs)
+        if task == ClassificationTaskNoMultilabel.MULTICLASS:
+            assert isinstance(num_classes, int)
+            return MulticlassHingeLoss(num_classes, squared, multiclass_mode, **kwargs)
+        raise ValueError(f"Not handled value: {task}")

@@ -188,12 +188,15 @@ class MultilabelPrecisionRecallCurve(Metric):
                 preds, target, self.num_labels, self.thresholds, mask
             )
 
-    def compute(self):
+    def _curve_state(self):
         if self.thresholds is None:
-            state = (dim_zero_cat(self.preds), dim_zero_cat(self.target), dim_zero_cat(self.mask))
-        else:
-            state = self.confmat
-        return _multilabel_precision_recall_curve_compute(state, self.num_labels, self.thresholds, self.ignore_index)
+            return (dim_zero_cat(self.preds), dim_zero_cat(self.target), dim_zero_cat(self.mask))
+        return self.confmat
+
+    def compute(self):
+        return _multilabel_precision_recall_curve_compute(
+            self._curve_state(), self.num_labels, self.thresholds, self.ignore_index
+        )
 
 
 class PrecisionRecallCurve:

@@ -1,0 +1,78 @@
+"""Multilabel ranking module metrics.
+
+Reference parity: src/torchmetrics/classification/ranking.py
+(MultilabelCoverageError / MultilabelRankingAveragePrecision / MultilabelRankingLoss).
+Scalar (measure-sum, sample-count) states with sum-reduce — psum over the mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.classification.ranking import (
+    _multilabel_coverage_error_update,
+    _multilabel_ranking_arg_validation,
+    _multilabel_ranking_average_precision_update,
+    _multilabel_ranking_format,
+    _multilabel_ranking_loss_update,
+    _multilabel_ranking_tensor_validation,
+    _ranking_reduce,
+)
+from metrics_tpu.metric import Metric
+
+
+class _MultilabelRankingMetric(Metric):
+    """Shared shell: format inputs, accumulate (measure, total)."""
+
+    is_differentiable = False
+    full_state_update = False
+
+    measure: Array
+    total: Array
+
+    _update_fn = None  # set by subclasses
+
+    def __init__(
+        self,
+        num_labels: int,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _multilabel_ranking_arg_validation(num_labels, ignore_index)
+        self.num_labels = num_labels
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self.add_state("measure", jnp.zeros((), dtype=jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), dtype=jnp.float32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        if self.validate_args:
+            _multilabel_ranking_tensor_validation(preds, target, self.num_labels, self.ignore_index)
+        preds, target, _ = _multilabel_ranking_format(preds, target, self.num_labels, self.ignore_index)
+        measure, total = type(self)._update_fn(preds, target)
+        self.measure = self.measure + measure
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        return _ranking_reduce(self.measure, self.total)
+
+
+class MultilabelCoverageError(_MultilabelRankingMetric):
+    higher_is_better = False
+    _update_fn = staticmethod(_multilabel_coverage_error_update)
+
+
+class MultilabelRankingAveragePrecision(_MultilabelRankingMetric):
+    higher_is_better = True
+    _update_fn = staticmethod(_multilabel_ranking_average_precision_update)
+
+
+class MultilabelRankingLoss(_MultilabelRankingMetric):
+    higher_is_better = False
+    _update_fn = staticmethod(_multilabel_ranking_loss_update)

@@ -13,7 +13,13 @@ from metrics_tpu.functional.classification.average_precision import (
     multiclass_average_precision,
     multilabel_average_precision,
 )
+from metrics_tpu.functional.classification.calibration_error import (
+    binary_calibration_error,
+    calibration_error,
+    multiclass_calibration_error,
+)
 from metrics_tpu.functional.classification.cohen_kappa import binary_cohen_kappa, cohen_kappa, multiclass_cohen_kappa
+from metrics_tpu.functional.classification.dice import dice
 from metrics_tpu.functional.classification.confusion_matrix import (
     binary_confusion_matrix,
     confusion_matrix,
@@ -31,6 +37,7 @@ from metrics_tpu.functional.classification.f_beta import (
     multilabel_f1_score,
     multilabel_fbeta_score,
 )
+from metrics_tpu.functional.classification.hinge import binary_hinge_loss, hinge_loss, multiclass_hinge_loss
 from metrics_tpu.functional.classification.hamming import (
     binary_hamming_distance,
     hamming_distance,
@@ -64,6 +71,21 @@ from metrics_tpu.functional.classification.precision_recall_curve import (
     multiclass_precision_recall_curve,
     multilabel_precision_recall_curve,
     precision_recall_curve,
+)
+from metrics_tpu.functional.classification.ranking import (
+    multilabel_coverage_error,
+    multilabel_ranking_average_precision,
+    multilabel_ranking_loss,
+)
+from metrics_tpu.functional.classification.recall_at_fixed_precision import (
+    binary_recall_at_fixed_precision,
+    multiclass_recall_at_fixed_precision,
+    multilabel_recall_at_fixed_precision,
+)
+from metrics_tpu.functional.classification.specificity_at_sensitivity import (
+    binary_specificity_at_sensitivity,
+    multiclass_specificity_at_sensitivity,
+    multilabel_specificity_at_sensitivity,
 )
 from metrics_tpu.functional.classification.roc import binary_roc, multiclass_roc, multilabel_roc, roc
 from metrics_tpu.functional.classification.specificity import (

@@ -1,0 +1,183 @@
+"""Top-label calibration error (ECE / MCE / RMSCE) functionals.
+
+Reference parity: src/torchmetrics/functional/classification/calibration_error.py
+(``_binning_bucketize`` :28, ``_ce_compute`` :60, binary :138, multiclass :245).
+
+TPU-first notes: binning is a fixed-shape scatter (``segment_sum`` over ``n_bins``
+buckets) — constant memory and jit-native. The module metric accumulates the per-bin
+sums directly (conf/acc/count per bin), which is mathematically identical to the
+reference's O(N) list states but syncs O(n_bins) scalars via psum instead of an
+all_gather of every sample.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.classification.stat_scores import _ignore_mask, _sigmoid_if_logits, _softmax_if_logits
+from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.compute import _safe_divide
+
+
+def _ce_bucketize(
+    confidences: Array, accuracies: Array, n_bins: int, weights: Optional[Array] = None
+) -> Tuple[Array, Array, Array]:
+    """Per-bin (accuracy-sum, confidence-sum, count) via one-hot segment sums.
+
+    Bucketing matches the reference's ``torch.bucketize(conf, linspace(0,1,n+1)) - 1``
+    (left-open bins ``(b_i, b_{i+1}]``, underflow clipped into bin 0).
+    """
+    bounds = jnp.linspace(0.0, 1.0, n_bins + 1, dtype=confidences.dtype)
+    idx = jnp.clip(jnp.searchsorted(bounds, confidences, side="left") - 1, 0, n_bins - 1)
+    w = weights if weights is not None else jnp.ones_like(confidences)
+    onehot = jax.nn.one_hot(idx, n_bins, dtype=confidences.dtype) * w[:, None]  # (N, B)
+    count_bin = jnp.sum(onehot, axis=0)
+    conf_bin = confidences @ onehot
+    acc_bin = accuracies.astype(confidences.dtype) @ onehot
+    return acc_bin, conf_bin, count_bin
+
+
+def _ce_compute_from_bins(acc_bin: Array, conf_bin: Array, count_bin: Array, norm: str = "l1") -> Array:
+    """Calibration error from per-bin sums (reference ``_ce_compute`` :60-107)."""
+    mean_acc = _safe_divide(acc_bin, count_bin)
+    mean_conf = _safe_divide(conf_bin, count_bin)
+    prop_bin = _safe_divide(count_bin, jnp.sum(count_bin))
+    if norm == "l1":
+        return jnp.sum(jnp.abs(mean_acc - mean_conf) * prop_bin)
+    if norm == "max":
+        return jnp.max(jnp.abs(mean_acc - mean_conf))
+    if norm == "l2":
+        ce = jnp.sum(jnp.square(mean_acc - mean_conf) * prop_bin)
+        return jnp.where(ce > 0, jnp.sqrt(jnp.maximum(ce, 0.0)), 0.0)
+    raise ValueError(f"Norm {norm} is not supported. Please select from l1, l2, or max. ")
+
+
+def _ce_compute(confidences: Array, accuracies: Array, n_bins: int, norm: str = "l1", weights: Optional[Array] = None) -> Array:
+    acc_bin, conf_bin, count_bin = _ce_bucketize(confidences, accuracies, n_bins, weights)
+    return _ce_compute_from_bins(acc_bin, conf_bin, count_bin, norm)
+
+
+def _binary_calibration_error_arg_validation(
+    n_bins: int, norm: str = "l1", ignore_index: Optional[int] = None
+) -> None:
+    if not isinstance(n_bins, int) or n_bins < 1:
+        raise ValueError(f"Expected argument `n_bins` to be an integer larger than 0, but got {n_bins}")
+    if norm not in ("l1", "l2", "max"):
+        raise ValueError(f"Expected argument `norm` to be one of ('l1', 'l2', 'max'), but got {norm}.")
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+
+
+def _binary_calibration_error_tensor_validation(preds: Array, target: Array, ignore_index: Optional[int] = None) -> None:
+    _check_same_shape(preds, target)
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        raise ValueError(
+            "Expected argument `preds` to be floating tensor with probabilities/logits"
+            f" but got tensor with dtype {preds.dtype}"
+        )
+
+
+def _binary_calibration_error_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Binary case: confidence = positive-class probability, accuracy = target label.
+
+    (Reference :133-135 — per-bin empirical positive rate vs mean predicted
+    probability, verified against the reference doctest values.)
+    """
+    return preds, target.astype(preds.dtype)
+
+
+def binary_calibration_error(
+    preds: Array,
+    target: Array,
+    n_bins: int = 15,
+    norm: str = "l1",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Top-label calibration error for binary tasks (reference :138-204)."""
+    if validate_args:
+        _binary_calibration_error_arg_validation(n_bins, norm, ignore_index)
+        _binary_calibration_error_tensor_validation(preds, target, ignore_index)
+    preds = jnp.asarray(preds).reshape(-1)
+    target = jnp.asarray(target).reshape(-1)
+    mask = _ignore_mask(target, ignore_index).reshape(-1).astype(preds.dtype)
+    target = jnp.where(mask.astype(bool), target, 0)
+    preds = _sigmoid_if_logits(preds)
+    confidences, accuracies = _binary_calibration_error_update(preds, target)
+    return _ce_compute(confidences, accuracies, n_bins, norm, weights=mask)
+
+
+def _multiclass_calibration_error_arg_validation(
+    num_classes: int, n_bins: int, norm: str = "l1", ignore_index: Optional[int] = None
+) -> None:
+    if not isinstance(num_classes, int) or num_classes < 2:
+        raise ValueError(f"Expected argument `num_classes` to be an integer larger than 1, but got {num_classes}")
+    _binary_calibration_error_arg_validation(n_bins, norm, ignore_index)
+
+
+def _multiclass_calibration_error_tensor_validation(
+    preds: Array, target: Array, num_classes: int, ignore_index: Optional[int] = None
+) -> None:
+    if preds.ndim != target.ndim + 1:
+        raise ValueError("Expected `preds` to have one more dimension than `target`")
+    if preds.shape[1] != num_classes:
+        raise ValueError(f"Expected `preds.shape[1]={preds.shape[1]}` to equal `num_classes={num_classes}`")
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        raise ValueError(
+            "Expected argument `preds` to be floating tensor with probabilities/logits"
+            f" but got tensor with dtype {preds.dtype}"
+        )
+
+
+def _multiclass_calibration_error_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Top-1 confidence + correctness (reference :237-243)."""
+    confidences = jnp.max(preds, axis=1)
+    predictions = jnp.argmax(preds, axis=1)
+    accuracies = (predictions == target).astype(preds.dtype)
+    return confidences, accuracies
+
+
+def multiclass_calibration_error(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    n_bins: int = 15,
+    norm: str = "l1",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Top-label calibration error for multiclass tasks (reference :245-317)."""
+    if validate_args:
+        _multiclass_calibration_error_arg_validation(num_classes, n_bins, norm, ignore_index)
+        _multiclass_calibration_error_tensor_validation(preds, target, num_classes, ignore_index)
+    preds = jnp.moveaxis(jnp.asarray(preds), 1, -1).reshape(-1, num_classes)
+    target = jnp.asarray(target).reshape(-1)
+    mask = _ignore_mask(target, ignore_index).astype(preds.dtype)
+    target = jnp.where(mask.astype(bool), target, 0)
+    preds = _softmax_if_logits(preds, axis=-1)
+    confidences, accuracies = _multiclass_calibration_error_update(preds, target)
+    return _ce_compute(confidences, accuracies, n_bins, norm, weights=mask)
+
+
+def calibration_error(
+    preds: Array,
+    target: Array,
+    task: str,
+    n_bins: int = 15,
+    norm: str = "l1",
+    num_classes: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Task-dispatch façade (reference :320-…)."""
+    task = str(task).lower()
+    if task == "binary":
+        return binary_calibration_error(preds, target, n_bins, norm, ignore_index, validate_args)
+    if task == "multiclass":
+        assert isinstance(num_classes, int)
+        return multiclass_calibration_error(preds, target, num_classes, n_bins, norm, ignore_index, validate_args)
+    raise ValueError(f"Expected argument `task` to either be 'binary' or 'multiclass' but got {task}")

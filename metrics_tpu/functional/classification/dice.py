@@ -1,0 +1,187 @@
+"""Dice functional (legacy-style API with average/mdmc_average).
+
+Reference parity: src/torchmetrics/functional/classification/dice.py
+(``_dice_compute`` :24-64, ``dice`` :66-…) and the legacy stat-score machinery
+(functional/classification/stat_scores.py ``_stat_scores`` :840, ``_reduce_stat_scores``
+:996-1051).
+
+TPU-first notes: the reference's boolean filtering of absent classes
+(``numerator[~cond]``) is reformulated as -1 "ignore" sentinels flowing into the masked
+reduction — mathematically identical, static shapes under jit.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _input_format_classification
+from metrics_tpu.utils.enums import AverageMethod, DataType, MDMCAverageMethod
+
+
+def _reduce_stat_scores(
+    numerator: Array,
+    denominator: Array,
+    weights: Optional[Array],
+    average: Optional[str],
+    mdmc_average: Optional[str],
+    zero_division: float = 0.0,
+) -> Array:
+    """Masked score reduction (reference stat_scores.py:996-1051).
+
+    denominator == 0 → ``zero_division``; denominator < 0 → class ignored (0 weight
+    when averaging, NaN when ``average=None``).
+    """
+    numerator = numerator.astype(jnp.float32)
+    denominator = denominator.astype(jnp.float32)
+    zero_div_mask = denominator == 0
+    ignore_mask = denominator < 0
+
+    weights = jnp.ones_like(denominator) if weights is None else weights.astype(jnp.float32)
+    numerator = jnp.where(zero_div_mask, zero_division, numerator)
+    denominator = jnp.where(zero_div_mask | ignore_mask, 1.0, denominator)
+    weights = jnp.where(ignore_mask, 0.0, weights)
+
+    if average not in (AverageMethod.MICRO, AverageMethod.NONE, None, "micro", "none"):
+        weights = weights / jnp.sum(weights, axis=-1, keepdims=True)
+
+    scores = weights * (numerator / denominator)
+    scores = jnp.where(jnp.isnan(scores), zero_division, scores)
+
+    if mdmc_average == MDMCAverageMethod.SAMPLEWISE or mdmc_average == "samplewise":
+        scores = jnp.mean(scores, axis=0)
+        ignore_mask = jnp.sum(ignore_mask, axis=0).astype(bool)
+
+    if average in (AverageMethod.NONE, None, "none"):
+        return jnp.where(ignore_mask, jnp.nan, scores)
+    return jnp.sum(scores)
+
+
+def _stat_scores(preds: Array, target: Array, reduce: Optional[str] = "micro") -> Tuple[Array, Array, Array, Array]:
+    """tp/fp/tn/fn over 0/1 matrices ``(N, C)`` or ``(N, C, X)`` (reference :840-884)."""
+    if reduce == "micro":
+        dim = (0, 1) if preds.ndim == 2 else (1, 2)
+    elif reduce == "macro":
+        dim = (0,) if preds.ndim == 2 else (2,)
+    else:  # samples
+        dim = (1,)
+
+    true_pred, false_pred = target == preds, target != preds
+    pos_pred, neg_pred = preds == 1, preds == 0
+
+    tp = jnp.sum(true_pred * pos_pred, axis=dim)
+    fp = jnp.sum(false_pred * pos_pred, axis=dim)
+    tn = jnp.sum(true_pred * neg_pred, axis=dim)
+    fn = jnp.sum(false_pred * neg_pred, axis=dim)
+    return tp.astype(jnp.int32), fp.astype(jnp.int32), tn.astype(jnp.int32), fn.astype(jnp.int32)
+
+
+def _dice_stat_scores_update(
+    preds: Array,
+    target: Array,
+    reduce: Optional[str] = "micro",
+    mdmc_reduce: Optional[str] = None,
+    num_classes: Optional[int] = None,
+    top_k: Optional[int] = 1,
+    threshold: float = 0.5,
+    multiclass: Optional[bool] = None,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Array, Array]:
+    """Legacy ``_stat_scores_update`` (reference :887-…): format, reshape per mdmc mode,
+    count, and mark the ignored class with -1 sentinels."""
+    preds_oh, target_oh, case = _input_format_classification(
+        preds, target, threshold=threshold, top_k=top_k, num_classes=num_classes, multiclass=multiclass,
+        ignore_index=ignore_index,
+    )
+    n_cols = preds_oh.shape[1]
+
+    if ignore_index is not None and not 0 <= ignore_index < n_cols and n_cols > 1:
+        raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {n_cols} classes")
+
+    if case == DataType.MULTIDIM_MULTICLASS and mdmc_reduce == "samplewise":
+        # recover the (N, C, X) layout: the formatter flattened (N, C, ...) → (N*X, C)
+        n = jnp.asarray(target).shape[0]
+        preds_oh = preds_oh.reshape(n, -1, n_cols)
+        target_oh = target_oh.reshape(n, -1, n_cols)
+        preds_oh = jnp.moveaxis(preds_oh, 1, -1)
+        target_oh = jnp.moveaxis(target_oh, 1, -1)
+
+    if ignore_index is not None and n_cols > 1:
+        if reduce == "micro":
+            # drop the class column entirely (no contributions)
+            keep = jnp.arange(n_cols) != ignore_index
+            preds_oh = preds_oh * keep.reshape((1, -1) + (1,) * (preds_oh.ndim - 2))
+            target_oh = target_oh * keep.reshape((1, -1) + (1,) * (target_oh.ndim - 2))
+
+    tp, fp, tn, fn = _stat_scores(preds_oh, target_oh, reduce=reduce)
+
+    if ignore_index is not None and n_cols > 1 and reduce == "macro":
+        # -1 sentinel → downstream masked reduction ignores the class
+        idx = jnp.arange(tp.shape[-1]) == ignore_index
+        tp = jnp.where(idx, -1, tp)
+        fp = jnp.where(idx, -1, fp)
+        tn = jnp.where(idx, -1, tn)
+        fn = jnp.where(idx, -1, fn)
+    return tp, fp, tn, fn
+
+
+def _dice_compute(
+    tp: Array,
+    fp: Array,
+    fn: Array,
+    average: Optional[str],
+    mdmc_average: Optional[str],
+    zero_division: float = 0.0,
+) -> Array:
+    """Dice = 2·tp / (2·tp + fp + fn) with masked class handling (reference :24-64)."""
+    numerator = 2 * tp
+    denominator = 2 * tp + fp + fn
+
+    if average in ("macro", "none", None) and mdmc_average != "samplewise":
+        # absent classes (no tp/fp/fn) are ignored: -1 sentinel instead of boolean filter
+        absent = (tp + fp + fn) == 0
+        numerator = jnp.where(absent, -1, numerator)
+        denominator = jnp.where(absent, -1, denominator)
+
+    return _reduce_stat_scores(
+        numerator=numerator,
+        denominator=denominator,
+        weights=None if average != "weighted" else (tp + fn),
+        average=average,
+        mdmc_average=mdmc_average,
+        zero_division=zero_division,
+    )
+
+
+def dice(
+    preds: Array,
+    target: Array,
+    zero_division: float = 0.0,
+    average: Optional[str] = "micro",
+    mdmc_average: Optional[str] = "global",
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+) -> Array:
+    """Dice score (reference :66-…)."""
+    allowed_average = ("micro", "macro", "weighted", "samples", "none", None)
+    if average not in allowed_average:
+        raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
+    allowed_mdmc_average = ("global", "samplewise", None)
+    if mdmc_average not in allowed_mdmc_average:
+        raise ValueError(f"The `mdmc_average` has to be one of {allowed_mdmc_average}, got {mdmc_average}.")
+    if average in ("macro", "weighted", "none", None) and (num_classes is None or num_classes < 1):
+        raise ValueError(f"When you set `average` as {average}, you have to provide the number of classes.")
+    if num_classes is not None and ignore_index is not None and not 0 <= ignore_index < num_classes and num_classes > 1:
+        raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {num_classes} classes")
+
+    reduce = "macro" if average in ("weighted", "none", None) else average
+    tp, fp, tn, fn = _dice_stat_scores_update(
+        preds, target, reduce=reduce, mdmc_reduce=mdmc_average, num_classes=num_classes,
+        top_k=top_k, threshold=threshold, ignore_index=ignore_index,
+    )
+    return _dice_compute(tp, fp, fn, average, mdmc_average, zero_division)

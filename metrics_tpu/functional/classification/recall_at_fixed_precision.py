@@ -1,0 +1,206 @@
+"""Recall-at-fixed-precision functionals.
+
+Reference parity: src/torchmetrics/functional/classification/recall_at_fixed_precision.py
+(``_recall_at_precision`` :39-57, binary :83, multiclass :189, multilabel :…).
+
+Computed from the precision-recall curve: the highest recall among curve points whose
+precision ≥ ``min_precision``, plus the threshold achieving it (1e6 sentinel when no
+point qualifies). The selection itself is a masked argmax — jit-friendly in binned mode.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.classification.precision_recall_curve import (
+    Thresholds,
+    _binary_precision_recall_curve_arg_validation,
+    _binary_precision_recall_curve_compute,
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_tensor_validation,
+    _binary_precision_recall_curve_update,
+    _exact_mode_filter,
+    _multiclass_precision_recall_curve_arg_validation,
+    _multiclass_precision_recall_curve_compute,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_tensor_validation,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_arg_validation,
+    _multilabel_precision_recall_curve_compute,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_tensor_validation,
+    _multilabel_precision_recall_curve_update,
+)
+
+
+def _recall_at_precision(
+    precision: Array, recall: Array, thresholds: Array, min_precision: float
+) -> Tuple[Array, Array]:
+    """Masked max over curve points with precision ≥ min_precision (reference :39-57).
+
+    The curve's final sentinel point (precision=1, recall=0) has no threshold — it is
+    excluded from the threshold lookup but its (1, 0) value cannot win the recall max
+    anyway unless nothing qualifies, in which case recall=0/threshold=1e6 is returned.
+    """
+    precision = jnp.asarray(precision)
+    recall = jnp.asarray(recall)
+    thresholds = jnp.asarray(thresholds, dtype=jnp.float32)
+    n_t = thresholds.shape[0]
+    precision, recall = precision[:n_t], recall[:n_t]
+    qualify = precision >= min_precision
+    # lexicographic max over (recall, precision, threshold) — parity with the
+    # reference's ``max((r, p, t))`` tuple max, via three masked maxima
+    masked_recall = jnp.where(qualify, recall, -jnp.inf)
+    r_best = jnp.max(masked_recall)
+    p_mask = qualify & (recall == r_best)
+    p_best = jnp.max(jnp.where(p_mask, precision, -jnp.inf))
+    t_mask = p_mask & (precision == p_best)
+    t_best = jnp.max(jnp.where(t_mask, thresholds, -jnp.inf))
+    max_recall = jnp.maximum(r_best, 0.0)
+    max_recall = jnp.where(jnp.isfinite(max_recall), max_recall, 0.0)
+    any_qualify = jnp.any(qualify) & (max_recall > 0.0)
+    best_threshold = jnp.where(any_qualify, t_best, 1e6)
+    return max_recall, best_threshold
+
+
+def _binary_recall_at_fixed_precision_arg_validation(
+    min_precision: float,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+) -> None:
+    _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+    if not isinstance(min_precision, float) or not (0 <= min_precision <= 1):
+        raise ValueError(
+            f"Expected argument `min_precision` to be an float in the [0,1] range, but got {min_precision}"
+        )
+
+
+def _binary_recall_at_fixed_precision_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    thresholds: Optional[Array],
+    min_precision: float,
+    pos_label: int = 1,
+) -> Tuple[Array, Array]:
+    precision, recall, thresholds = _binary_precision_recall_curve_compute(state, thresholds, pos_label)
+    return _recall_at_precision(precision, recall, thresholds, min_precision)
+
+
+def binary_recall_at_fixed_precision(
+    preds: Array,
+    target: Array,
+    min_precision: float,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Highest recall at the given minimum precision for binary tasks (reference :83-150)."""
+    if validate_args:
+        _binary_recall_at_fixed_precision_arg_validation(min_precision, thresholds, ignore_index)
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, thresholds, mask = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
+    if thresholds is None and ignore_index is not None:
+        preds, target = _exact_mode_filter(preds, target, thresholds, ignore_index, mask)
+        mask = None
+    state = _binary_precision_recall_curve_update(preds, target, thresholds, mask)
+    return _binary_recall_at_fixed_precision_compute(state, thresholds, min_precision)
+
+
+def _multiclass_recall_at_fixed_precision_arg_validation(
+    num_classes: int,
+    min_precision: float,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+) -> None:
+    _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index)
+    if not isinstance(min_precision, float) or not (0 <= min_precision <= 1):
+        raise ValueError(
+            f"Expected argument `min_precision` to be an float in the [0,1] range, but got {min_precision}"
+        )
+
+
+def _multiclass_recall_at_fixed_precision_arg_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    num_classes: int,
+    thresholds: Optional[Array],
+    min_precision: float,
+) -> Tuple[Array, Array]:
+    precision, recall, thresholds = _multiclass_precision_recall_curve_compute(state, num_classes, thresholds)
+    if isinstance(precision, Array) and precision.ndim == 2:
+        res = [_recall_at_precision(precision[i], recall[i], thresholds, min_precision) for i in range(num_classes)]
+    else:
+        res = [_recall_at_precision(p, r, t, min_precision) for p, r, t in zip(precision, recall, thresholds)]
+    return jnp.stack([r[0] for r in res]), jnp.stack([r[1] for r in res])
+
+
+def multiclass_recall_at_fixed_precision(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    min_precision: float,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Per-class highest recall at fixed precision (reference :189-…)."""
+    if validate_args:
+        _multiclass_recall_at_fixed_precision_arg_validation(num_classes, min_precision, thresholds, ignore_index)
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, thresholds, mask = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index
+    )
+    if thresholds is None and ignore_index is not None:
+        preds, target = _exact_mode_filter(preds, target, thresholds, ignore_index, mask)
+        mask = None
+    state = _multiclass_precision_recall_curve_update(preds, target, num_classes, thresholds, mask)
+    return _multiclass_recall_at_fixed_precision_arg_compute(state, num_classes, thresholds, min_precision)
+
+
+def _multilabel_recall_at_fixed_precision_arg_validation(
+    num_labels: int,
+    min_precision: float,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+) -> None:
+    _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+    if not isinstance(min_precision, float) or not (0 <= min_precision <= 1):
+        raise ValueError(
+            f"Expected argument `min_precision` to be an float in the [0,1] range, but got {min_precision}"
+        )
+
+
+def _multilabel_recall_at_fixed_precision_arg_compute(
+    state,
+    num_labels: int,
+    thresholds: Optional[Array],
+    ignore_index: Optional[int],
+    min_precision: float,
+) -> Tuple[Array, Array]:
+    precision, recall, thresholds = _multilabel_precision_recall_curve_compute(state, num_labels, thresholds, ignore_index)
+    if isinstance(precision, Array) and precision.ndim == 2:
+        res = [_recall_at_precision(precision[i], recall[i], thresholds, min_precision) for i in range(num_labels)]
+    else:
+        res = [_recall_at_precision(p, r, t, min_precision) for p, r, t in zip(precision, recall, thresholds)]
+    return jnp.stack([r[0] for r in res]), jnp.stack([r[1] for r in res])
+
+
+def multilabel_recall_at_fixed_precision(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    min_precision: float,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Per-label highest recall at fixed precision (reference :…)."""
+    if validate_args:
+        _multilabel_recall_at_fixed_precision_arg_validation(num_labels, min_precision, thresholds, ignore_index)
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, thresholds, mask = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    state = _multilabel_precision_recall_curve_update(preds, target, num_labels, thresholds, mask)
+    return _multilabel_recall_at_fixed_precision_arg_compute(state, num_labels, thresholds, ignore_index, min_precision)

@@ -1,0 +1,212 @@
+"""Specificity-at-sensitivity functionals.
+
+Reference parity: src/torchmetrics/functional/classification/specificity_at_sensitivity.py
+(``_specificity_at_sensitivity`` :46-70, binary :96, multiclass :201, multilabel :316).
+
+Computed from the ROC curve: among points with sensitivity (TPR) ≥ ``min_sensitivity``,
+the maximum specificity (1 - FPR) and its threshold (1e6 sentinel when none qualify).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.classification.precision_recall_curve import (
+    Thresholds,
+    _binary_precision_recall_curve_arg_validation,
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_tensor_validation,
+    _binary_precision_recall_curve_update,
+    _exact_mode_filter,
+    _multiclass_precision_recall_curve_arg_validation,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_tensor_validation,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_arg_validation,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_tensor_validation,
+    _multilabel_precision_recall_curve_update,
+)
+from metrics_tpu.functional.classification.roc import (
+    _binary_roc_compute,
+    _multiclass_roc_compute,
+    _multilabel_roc_compute,
+)
+
+
+def _convert_fpr_to_specificity(fpr: Array) -> Array:
+    return 1 - fpr
+
+
+def _specificity_at_sensitivity(
+    specificity: Array, sensitivity: Array, thresholds: Array, min_sensitivity: float
+) -> Tuple[Array, Array]:
+    """Masked max over ROC points with sensitivity ≥ min_sensitivity (reference :46-70)."""
+    specificity = jnp.asarray(specificity)
+    sensitivity = jnp.asarray(sensitivity)
+    thresholds = jnp.asarray(thresholds, dtype=jnp.float32)
+    n = min(specificity.shape[0], sensitivity.shape[0], thresholds.shape[0])
+    qualify = sensitivity[:n] >= min_sensitivity
+    masked_spec = jnp.where(qualify, specificity[:n], -jnp.inf)
+    best = jnp.argmax(masked_spec)
+    any_qualify = jnp.any(qualify)
+    max_spec = jnp.where(any_qualify, jnp.maximum(masked_spec[best], -jnp.inf), 0.0)
+    max_spec = jnp.where(jnp.isfinite(max_spec), max_spec, 0.0)
+    best_threshold = jnp.where(any_qualify, thresholds[best], 1e6)
+    return max_spec, best_threshold
+
+
+def _binary_specificity_at_sensitivity_arg_validation(
+    min_sensitivity: float,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+) -> None:
+    _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+    if not isinstance(min_sensitivity, float) or not (0 <= min_sensitivity <= 1):
+        raise ValueError(
+            f"Expected argument `min_sensitivity` to be an float in the [0,1] range, but got {min_sensitivity}"
+        )
+
+
+def _binary_specificity_at_sensitivity_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    thresholds: Optional[Array],
+    min_sensitivity: float,
+    pos_label: int = 1,
+) -> Tuple[Array, Array]:
+    fpr, sensitivity, thresholds = _binary_roc_compute(state, thresholds, pos_label)
+    specificity = _convert_fpr_to_specificity(fpr)
+    return _specificity_at_sensitivity(specificity, sensitivity, thresholds, min_sensitivity)
+
+
+def binary_specificity_at_sensitivity(
+    preds: Array,
+    target: Array,
+    min_sensitivity: float,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Highest specificity at the given minimum sensitivity for binary tasks (reference :96-163)."""
+    if validate_args:
+        _binary_specificity_at_sensitivity_arg_validation(min_sensitivity, thresholds, ignore_index)
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, thresholds, mask = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
+    if thresholds is None and ignore_index is not None:
+        preds, target = _exact_mode_filter(preds, target, thresholds, ignore_index, mask)
+        mask = None
+    state = _binary_precision_recall_curve_update(preds, target, thresholds, mask)
+    return _binary_specificity_at_sensitivity_compute(state, thresholds, min_sensitivity)
+
+
+def _multiclass_specificity_at_sensitivity_arg_validation(
+    num_classes: int,
+    min_sensitivity: float,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+) -> None:
+    _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index)
+    if not isinstance(min_sensitivity, float) or not (0 <= min_sensitivity <= 1):
+        raise ValueError(
+            f"Expected argument `min_sensitivity` to be an float in the [0,1] range, but got {min_sensitivity}"
+        )
+
+
+def _multiclass_specificity_at_sensitivity_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    num_classes: int,
+    thresholds: Optional[Array],
+    min_sensitivity: float,
+) -> Tuple[Array, Array]:
+    fpr, sensitivity, thresholds = _multiclass_roc_compute(state, num_classes, thresholds)
+    if isinstance(fpr, Array) and fpr.ndim == 2:
+        res = [
+            _specificity_at_sensitivity(_convert_fpr_to_specificity(fpr[i]), sensitivity[i], thresholds, min_sensitivity)
+            for i in range(num_classes)
+        ]
+    else:
+        res = [
+            _specificity_at_sensitivity(_convert_fpr_to_specificity(f), s, t, min_sensitivity)
+            for f, s, t in zip(fpr, sensitivity, thresholds)
+        ]
+    return jnp.stack([r[0] for r in res]), jnp.stack([r[1] for r in res])
+
+
+def multiclass_specificity_at_sensitivity(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    min_sensitivity: float,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Per-class highest specificity at fixed sensitivity (reference :201-277)."""
+    if validate_args:
+        _multiclass_specificity_at_sensitivity_arg_validation(num_classes, min_sensitivity, thresholds, ignore_index)
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, thresholds, mask = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index
+    )
+    if thresholds is None and ignore_index is not None:
+        preds, target = _exact_mode_filter(preds, target, thresholds, ignore_index, mask)
+        mask = None
+    state = _multiclass_precision_recall_curve_update(preds, target, num_classes, thresholds, mask)
+    return _multiclass_specificity_at_sensitivity_compute(state, num_classes, thresholds, min_sensitivity)
+
+
+def _multilabel_specificity_at_sensitivity_arg_validation(
+    num_labels: int,
+    min_sensitivity: float,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+) -> None:
+    _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+    if not isinstance(min_sensitivity, float) or not (0 <= min_sensitivity <= 1):
+        raise ValueError(
+            f"Expected argument `min_sensitivity` to be an float in the [0,1] range, but got {min_sensitivity}"
+        )
+
+
+def _multilabel_specificity_at_sensitivity_compute(
+    state,
+    num_labels: int,
+    thresholds: Optional[Array],
+    ignore_index: Optional[int],
+    min_sensitivity: float,
+) -> Tuple[Array, Array]:
+    fpr, sensitivity, thresholds = _multilabel_roc_compute(state, num_labels, thresholds, ignore_index)
+    if isinstance(fpr, Array) and fpr.ndim == 2:
+        res = [
+            _specificity_at_sensitivity(_convert_fpr_to_specificity(fpr[i]), sensitivity[i], thresholds, min_sensitivity)
+            for i in range(num_labels)
+        ]
+    else:
+        res = [
+            _specificity_at_sensitivity(_convert_fpr_to_specificity(f), s, t, min_sensitivity)
+            for f, s, t in zip(fpr, sensitivity, thresholds)
+        ]
+    return jnp.stack([r[0] for r in res]), jnp.stack([r[1] for r in res])
+
+
+def multilabel_specificity_at_sensitivity(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    min_sensitivity: float,
+    thresholds: Thresholds = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Per-label highest specificity at fixed sensitivity (reference :316-…)."""
+    if validate_args:
+        _multilabel_specificity_at_sensitivity_arg_validation(num_labels, min_sensitivity, thresholds, ignore_index)
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, thresholds, mask = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    state = _multilabel_precision_recall_curve_update(preds, target, num_labels, thresholds, mask)
+    return _multilabel_specificity_at_sensitivity_compute(state, num_labels, thresholds, ignore_index, min_sensitivity)

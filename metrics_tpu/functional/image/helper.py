@@ -1,0 +1,80 @@
+"""Shared image-kernel helpers: separable gaussian windows + depthwise convs.
+
+Reference parity: src/torchmetrics/functional/image/helper.py (``_gaussian`` :11,
+``_gaussian_kernel_2d`` :29, ``_gaussian_kernel_3d`` :62, reflection pads).
+
+TPU-first notes: the sliding windows lower to ``lax.conv_general_dilated`` with
+``feature_group_count=C`` (depthwise) — XLA maps these onto the MXU as implicit GEMMs.
+Reflection padding is ``jnp.pad(mode="reflect")`` (fused by XLA into the conv input).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+
+def _gaussian(kernel_size: int, sigma: float, dtype=jnp.float32) -> Array:
+    """1D normalized gaussian window, shape ``(1, kernel_size)``."""
+    dist = jnp.arange((1 - kernel_size) / 2, (1 + kernel_size) / 2, 1.0, dtype=dtype)
+    gauss = jnp.exp(-jnp.square(dist / sigma) / 2)
+    return (gauss / jnp.sum(gauss)).reshape(1, -1)
+
+
+def _gaussian_kernel_2d(channel: int, kernel_size: Sequence[int], sigma: Sequence[float], dtype=jnp.float32) -> Array:
+    """Depthwise 2D gaussian kernel, shape ``(C, 1, kh, kw)`` (OIHW)."""
+    kx = _gaussian(kernel_size[0], sigma[0], dtype)
+    ky = _gaussian(kernel_size[1], sigma[1], dtype)
+    kernel = kx.T @ ky  # (kh, kw)
+    return jnp.broadcast_to(kernel, (channel, 1, kernel_size[0], kernel_size[1]))
+
+
+def _gaussian_kernel_3d(channel: int, kernel_size: Sequence[int], sigma: Sequence[float], dtype=jnp.float32) -> Array:
+    """Depthwise 3D gaussian kernel, shape ``(C, 1, kd, kh, kw)``."""
+    kx = _gaussian(kernel_size[0], sigma[0], dtype)
+    ky = _gaussian(kernel_size[1], sigma[1], dtype)
+    kz = _gaussian(kernel_size[2], sigma[2], dtype)
+    kernel_xy = (kx.T @ ky)[:, :, None] * kz.reshape(1, 1, -1)  # (kh, kw, kd) in xy-z order
+    return jnp.broadcast_to(kernel_xy, (channel, 1, *kernel_xy.shape))
+
+
+def _uniform_kernel(channel: int, kernel_size: Sequence[int], dtype=jnp.float32) -> Array:
+    size = tuple(kernel_size)
+    kernel = jnp.ones(size, dtype=dtype) / float(jnp.prod(jnp.asarray(size)))
+    return jnp.broadcast_to(kernel, (channel, 1, *size))
+
+
+def _depthwise_conv(x: Array, kernel: Array) -> Array:
+    """VALID depthwise conv: x ``(N, C, *spatial)``, kernel ``(C, 1, *window)``."""
+    ndim_sp = x.ndim - 2
+    if ndim_sp == 2:
+        dn = ("NCHW", "OIHW", "NCHW")
+    elif ndim_sp == 3:
+        dn = ("NCDHW", "OIDHW", "NCDHW")
+    else:
+        raise ValueError(f"Expected 2 or 3 spatial dims, got {ndim_sp}")
+    return jax.lax.conv_general_dilated(
+        x.astype(kernel.dtype),
+        kernel,
+        window_strides=(1,) * ndim_sp,
+        padding="VALID",
+        dimension_numbers=dn,
+        feature_group_count=x.shape[1],
+    )
+
+
+def _reflection_pad(x: Array, pads: Sequence[int]) -> Array:
+    """Reflection-pad the trailing spatial dims; ``pads`` is per-spatial-dim."""
+    cfg = [(0, 0), (0, 0)] + [(p, p) for p in pads]
+    return jnp.pad(x, cfg, mode="reflect")
+
+
+def _avg_pool(x: Array, window: int = 2) -> Array:
+    """Non-overlapping mean pool over the trailing spatial dims (torch avg_poolNd)."""
+    ndim_sp = x.ndim - 2
+    dims = (1, 1) + (window,) * ndim_sp
+    summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, dims, "VALID")
+    return summed / (window**ndim_sp)

@@ -1,0 +1,73 @@
+"""Peak signal-to-noise ratio functional.
+
+Reference parity: src/torchmetrics/functional/image/psnr.py
+(``_psnr_compute`` :23, ``_psnr_update`` :58, ``peak_signal_noise_ratio`` :90).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.distributed import reduce
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+def _psnr_compute(
+    sum_squared_error: Array,
+    n_obs: Array,
+    data_range: Array,
+    base: float = 10.0,
+    reduction: Optional[str] = "elementwise_mean",
+) -> Array:
+    psnr_base_e = 2 * jnp.log(data_range) - jnp.log(sum_squared_error / n_obs)
+    psnr_vals = psnr_base_e * (10 / jnp.log(base))
+    return reduce(psnr_vals, reduction)
+
+
+def _psnr_update(
+    preds: Array,
+    target: Array,
+    dim: Optional[Union[int, Tuple[int, ...]]] = None,
+) -> Tuple[Array, Array]:
+    if dim is None:
+        sum_squared_error = jnp.sum(jnp.square(preds - target))
+        n_obs = jnp.asarray(target.size, dtype=jnp.float32)
+        return sum_squared_error, n_obs
+
+    diff = preds - target
+    sum_squared_error = jnp.sum(diff * diff, axis=dim)
+    dim_list = [dim] if isinstance(dim, int) else list(dim)
+    if not dim_list:
+        n_obs = jnp.asarray(target.size, dtype=jnp.float32)
+    else:
+        n = 1
+        for d in dim_list:
+            n *= target.shape[d]
+        n_obs = jnp.broadcast_to(jnp.asarray(n, dtype=jnp.float32), sum_squared_error.shape)
+    return sum_squared_error, n_obs
+
+
+def peak_signal_noise_ratio(
+    preds: Array,
+    target: Array,
+    data_range: Optional[float] = None,
+    base: float = 10.0,
+    reduction: Optional[str] = "elementwise_mean",
+    dim: Optional[Union[int, Tuple[int, ...]]] = None,
+) -> Array:
+    """PSNR (reference :90-147)."""
+    if dim is None and reduction != "elementwise_mean":
+        rank_zero_warn(f"The `reduction={reduction}` will not have any effect when `dim` is None.")
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if data_range is None:
+        if dim is not None:
+            raise ValueError("The `data_range` must be given when `dim` is not None.")
+        data_range = jnp.max(target) - jnp.min(target)
+    else:
+        data_range = jnp.asarray(float(data_range))
+    sum_squared_error, n_obs = _psnr_update(preds, target, dim=dim)
+    return _psnr_compute(sum_squared_error, n_obs, data_range, base=base, reduction=reduction)

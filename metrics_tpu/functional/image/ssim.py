@@ -1,0 +1,244 @@
+"""SSIM and multi-scale SSIM functionals.
+
+Reference parity: src/torchmetrics/functional/image/ssim.py
+(``_ssim_update`` :46-179, ``_multiscale_ssim_update`` :310-430).
+
+TPU-first notes: the five sliding-window statistics (μ_p, μ_t, E[p²], E[t²], E[pt]) are
+computed in ONE depthwise convolution over a 5·B-stacked batch (the reference's trick,
+kept because it maps to a single MXU-bound conv), with reflect padding fused by XLA.
+Downsampling between MS-SSIM scales is a reduce_window mean pool.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.image.helper import (
+    _avg_pool,
+    _depthwise_conv,
+    _gaussian_kernel_2d,
+    _gaussian_kernel_3d,
+    _reflection_pad,
+    _uniform_kernel,
+)
+from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.distributed import reduce
+
+
+def _ssim_check_inputs(preds: Array, target: Array) -> Tuple[Array, Array]:
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        preds = preds.astype(jnp.float32)
+    if not jnp.issubdtype(target.dtype, jnp.floating):
+        target = target.astype(jnp.float32)
+    _check_same_shape(preds, target)
+    if preds.ndim not in (4, 5):
+        raise ValueError(f"Expected `preds` and `target` to have BxCxHxW or BxCxDxHxW shape. Got {preds.shape}.")
+    return preds, target
+
+
+def _ssim_update(
+    preds: Array,
+    target: Array,
+    gaussian_kernel: bool = True,
+    sigma: Union[float, Sequence[float]] = 1.5,
+    kernel_size: Union[int, Sequence[int]] = 11,
+    data_range: Optional[float] = None,
+    k1: float = 0.01,
+    k2: float = 0.03,
+    return_full_image: bool = False,
+    return_contrast_sensitivity: bool = False,
+):
+    """Per-image SSIM (reference :46-179)."""
+    is_3d = preds.ndim == 5
+    n_sp = 3 if is_3d else 2
+
+    if not isinstance(sigma, Sequence):
+        sigma = n_sp * [sigma]
+    if not isinstance(kernel_size, Sequence):
+        kernel_size = n_sp * [kernel_size]
+    if len(kernel_size) != n_sp or len(sigma) != n_sp:
+        raise ValueError(
+            f"`kernel_size` has dimension {len(kernel_size)} and `sigma` has dimension {len(sigma)},"
+            f" but expected {n_sp} for {'3d' if is_3d else '2d'} inputs"
+        )
+    if return_full_image and return_contrast_sensitivity:
+        raise ValueError("Arguments `return_full_image` and `return_contrast_sensitivity` are mutually exclusive.")
+    if any(x % 2 == 0 or x <= 0 for x in kernel_size):
+        raise ValueError(f"Expected `kernel_size` to have odd positive number. Got {kernel_size}.")
+    if any(y <= 0 for y in sigma):
+        raise ValueError(f"Expected `sigma` to have positive number. Got {sigma}.")
+
+    if data_range is None:
+        data_range = jnp.maximum(jnp.max(preds) - jnp.min(preds), jnp.max(target) - jnp.min(target))
+
+    c1 = (k1 * data_range) ** 2
+    c2 = (k2 * data_range) ** 2
+    channel = preds.shape[1]
+    dtype = preds.dtype
+
+    if gaussian_kernel:
+        size = [int(3.5 * s + 0.5) * 2 + 1 for s in sigma]
+        kernel = _gaussian_kernel_3d(channel, size, sigma, dtype) if is_3d else _gaussian_kernel_2d(channel, size, sigma, dtype)
+    else:
+        size = list(kernel_size)
+        kernel = _uniform_kernel(channel, size, dtype)
+
+    pads = [(s - 1) // 2 for s in size]
+    preds_p = _reflection_pad(preds, pads)
+    target_p = _reflection_pad(target, pads)
+
+    # one depthwise conv over the 5·B-stacked batch: μp, μt, E[p²], E[t²], E[pt]
+    input_list = jnp.concatenate([preds_p, target_p, preds_p * preds_p, target_p * target_p, preds_p * target_p])
+    outputs = _depthwise_conv(input_list, kernel)
+    b = preds.shape[0]
+    mu_pred, mu_target, e_pp, e_tt, e_pt = (outputs[i * b : (i + 1) * b] for i in range(5))
+
+    mu_pred_sq = jnp.square(mu_pred)
+    mu_target_sq = jnp.square(mu_target)
+    mu_pred_target = mu_pred * mu_target
+
+    sigma_pred_sq = e_pp - mu_pred_sq
+    sigma_target_sq = e_tt - mu_target_sq
+    sigma_pred_target = e_pt - mu_pred_target
+
+    upper = 2 * sigma_pred_target.astype(dtype) + c2
+    lower = (sigma_pred_sq + sigma_target_sq).astype(dtype) + c2
+
+    ssim_idx_full_image = ((2 * mu_pred_target + c1) * upper) / ((mu_pred_sq + mu_target_sq + c1) * lower)
+
+    # interior crop (reference :163-167) — the conv output is already the padded-image
+    # valid region, i.e. the full original size; crop the pad-influenced border
+    sl = tuple(slice(p, d - p) for p, d in zip(pads, ssim_idx_full_image.shape[2:]))
+    ssim_idx = ssim_idx_full_image[(Ellipsis, *sl)]
+
+    if return_contrast_sensitivity:
+        contrast_sensitivity = (upper / lower)[(Ellipsis, *sl)]
+        return ssim_idx.reshape(b, -1).mean(-1), contrast_sensitivity.reshape(b, -1).mean(-1)
+    if return_full_image:
+        return ssim_idx.reshape(b, -1).mean(-1), ssim_idx_full_image
+    return ssim_idx.reshape(b, -1).mean(-1)
+
+
+def _ssim_compute(similarities: Array, reduction: Optional[str] = "elementwise_mean") -> Array:
+    return reduce(similarities, reduction)
+
+
+def structural_similarity_index_measure(
+    preds: Array,
+    target: Array,
+    gaussian_kernel: bool = True,
+    sigma: Union[float, Sequence[float]] = 1.5,
+    kernel_size: Union[int, Sequence[int]] = 11,
+    reduction: Optional[str] = "elementwise_mean",
+    data_range: Optional[float] = None,
+    k1: float = 0.01,
+    k2: float = 0.03,
+    return_full_image: bool = False,
+    return_contrast_sensitivity: bool = False,
+):
+    """SSIM (reference :202-…)."""
+    preds, target = _ssim_check_inputs(preds, target)
+    out = _ssim_update(
+        preds, target, gaussian_kernel, sigma, kernel_size, data_range, k1, k2,
+        return_full_image, return_contrast_sensitivity,
+    )
+    if isinstance(out, tuple):
+        return _ssim_compute(out[0], reduction), out[1]
+    return _ssim_compute(out, reduction)
+
+
+def _get_normalized_sim_and_cs(
+    preds: Array, target: Array, gaussian_kernel, sigma, kernel_size, data_range, k1, k2, normalize=None
+) -> Tuple[Array, Array]:
+    sim, cs = _ssim_update(
+        preds, target, gaussian_kernel, sigma, kernel_size, data_range, k1, k2, return_contrast_sensitivity=True
+    )
+    if normalize == "relu":
+        sim = jnp.maximum(sim, 0.0)
+        cs = jnp.maximum(cs, 0.0)
+    return sim, cs
+
+
+def _multiscale_ssim_update(
+    preds: Array,
+    target: Array,
+    gaussian_kernel: bool = True,
+    sigma: Union[float, Sequence[float]] = 1.5,
+    kernel_size: Union[int, Sequence[int]] = 11,
+    data_range: Optional[float] = None,
+    k1: float = 0.01,
+    k2: float = 0.03,
+    betas: Tuple[float, ...] = (0.0448, 0.2856, 0.3001, 0.2363, 0.1333),
+    normalize: Optional[str] = None,
+) -> Array:
+    """MS-SSIM per image (reference :310-430): cs at every scale, sim at the last."""
+    is_3d = preds.ndim == 5
+    n_sp = 3 if is_3d else 2
+    if not isinstance(kernel_size, Sequence):
+        kernel_size = n_sp * [kernel_size]
+    if not isinstance(sigma, Sequence):
+        sigma = n_sp * [sigma]
+
+    if preds.shape[-1] < 2 ** len(betas) or preds.shape[-2] < 2 ** len(betas):
+        raise ValueError(
+            f"For a given number of `betas` parameters {len(betas)}, the image height and width dimensions must be"
+            f" larger than or equal to {2 ** len(betas)}."
+        )
+    _betas_div = max(1, (len(betas) - 1)) ** 2
+    if preds.shape[-2] // _betas_div <= kernel_size[0] - 1:
+        raise ValueError(
+            f"For a given number of `betas` parameters {len(betas)} and kernel size {kernel_size[0]},"
+            f" the image height must be larger than {(kernel_size[0] - 1) * _betas_div}."
+        )
+    if preds.shape[-1] // _betas_div <= kernel_size[1] - 1:
+        raise ValueError(
+            f"For a given number of `betas` parameters {len(betas)} and kernel size {kernel_size[1]},"
+            f" the image width must be larger than {(kernel_size[1] - 1) * _betas_div}."
+        )
+
+    mcs_list: List[Array] = []
+    sim = None
+    for _ in range(len(betas)):
+        sim, cs = _get_normalized_sim_and_cs(
+            preds, target, gaussian_kernel, sigma, kernel_size, data_range, k1, k2, normalize
+        )
+        mcs_list.append(cs)
+        preds = _avg_pool(preds, 2)
+        target = _avg_pool(target, 2)
+
+    mcs_list[-1] = sim
+    mcs_stack = jnp.stack(mcs_list)
+    if normalize == "simple":
+        mcs_stack = (mcs_stack + 1) / 2
+    betas_arr = jnp.asarray(betas, dtype=mcs_stack.dtype).reshape(-1, 1)
+    return jnp.prod(mcs_stack**betas_arr, axis=0)
+
+
+def multiscale_structural_similarity_index_measure(
+    preds: Array,
+    target: Array,
+    gaussian_kernel: bool = True,
+    sigma: Union[float, Sequence[float]] = 1.5,
+    kernel_size: Union[int, Sequence[int]] = 11,
+    reduction: Optional[str] = "elementwise_mean",
+    data_range: Optional[float] = None,
+    k1: float = 0.01,
+    k2: float = 0.03,
+    betas: Tuple[float, ...] = (0.0448, 0.2856, 0.3001, 0.2363, 0.1333),
+    normalize: Optional[str] = "relu",
+) -> Array:
+    """MS-SSIM (reference :433-…)."""
+    if not isinstance(betas, tuple) or not all(isinstance(b, float) for b in betas):
+        raise ValueError("Argument `betas` is expected to be of a type tuple of floats.")
+    if normalize is not None and normalize not in ("relu", "simple"):
+        raise ValueError("Argument `normalize` to be expected either `None` or one of 'relu' or 'simple'")
+    preds, target = _ssim_check_inputs(preds, target)
+    mcs_per_image = _multiscale_ssim_update(
+        preds, target, gaussian_kernel, sigma, kernel_size, data_range, k1, k2, betas, normalize
+    )
+    return reduce(mcs_per_image, reduction)

@@ -1,0 +1,91 @@
+"""Universal Image Quality Index functional.
+
+Reference parity: src/torchmetrics/functional/image/uqi.py
+(``_uqi_update`` :26, ``_uqi_compute`` :49, ``universal_image_quality_index`` :126).
+Same 5-way stacked depthwise-conv trick as SSIM (UQI = SSIM with c1 = c2 = 0).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.image.helper import _depthwise_conv, _gaussian_kernel_2d, _reflection_pad
+from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.distributed import reduce
+
+
+def _uqi_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if preds.dtype != target.dtype:
+        target = target.astype(preds.dtype)
+    _check_same_shape(preds, target)
+    if preds.ndim != 4:
+        raise ValueError(f"Expected `preds` and `target` to have BxCxHxW shape. Got preds: {preds.shape}.")
+    return preds, target
+
+
+def _uqi_compute(
+    preds: Array,
+    target: Array,
+    kernel_size: Sequence[int] = (11, 11),
+    sigma: Sequence[float] = (1.5, 1.5),
+    reduction: Optional[str] = "elementwise_mean",
+    data_range: Optional[float] = None,
+) -> Array:
+    if len(kernel_size) != 2 or len(sigma) != 2:
+        raise ValueError(
+            "Expected `kernel_size` and `sigma` to have the length of two."
+            f" Got kernel_size: {len(kernel_size)} and sigma: {len(sigma)}."
+        )
+    if any(x % 2 == 0 or x <= 0 for x in kernel_size):
+        raise ValueError(f"Expected `kernel_size` to have odd positive number. Got {kernel_size}.")
+    if any(y <= 0 for y in sigma):
+        raise ValueError(f"Expected `sigma` to have positive number. Got {sigma}.")
+
+    channel = preds.shape[1]
+    dtype = preds.dtype if jnp.issubdtype(preds.dtype, jnp.floating) else jnp.float32
+    preds = preds.astype(dtype)
+    target = target.astype(dtype)
+    kernel = _gaussian_kernel_2d(channel, kernel_size, sigma, dtype)
+    pads = [(k - 1) // 2 for k in kernel_size]
+
+    preds_p = _reflection_pad(preds, pads)
+    target_p = _reflection_pad(target, pads)
+
+    input_list = jnp.concatenate([preds_p, target_p, preds_p * preds_p, target_p * target_p, preds_p * target_p])
+    outputs = _depthwise_conv(input_list, kernel)
+    b = preds.shape[0]
+    mu_pred, mu_target, e_pp, e_tt, e_pt = (outputs[i * b : (i + 1) * b] for i in range(5))
+
+    mu_pred_sq = jnp.square(mu_pred)
+    mu_target_sq = jnp.square(mu_target)
+    mu_pred_target = mu_pred * mu_target
+
+    sigma_pred_sq = e_pp - mu_pred_sq
+    sigma_target_sq = e_tt - mu_target_sq
+    sigma_pred_target = e_pt - mu_pred_target
+
+    upper = 2 * sigma_pred_target
+    lower = sigma_pred_sq + sigma_target_sq
+
+    uqi_idx = ((2 * mu_pred_target) * upper) / ((mu_pred_sq + mu_target_sq) * lower)
+    sl = tuple(slice(p, d - p) for p, d in zip(pads, uqi_idx.shape[2:]))
+    uqi_idx = uqi_idx[(Ellipsis, *sl)]
+    return reduce(uqi_idx, reduction)
+
+
+def universal_image_quality_index(
+    preds: Array,
+    target: Array,
+    kernel_size: Sequence[int] = (11, 11),
+    sigma: Sequence[float] = (1.5, 1.5),
+    reduction: Optional[str] = "elementwise_mean",
+    data_range: Optional[float] = None,
+) -> Array:
+    """UQI (reference :126-…)."""
+    preds, target = _uqi_update(preds, target)
+    return _uqi_compute(preds, target, kernel_size, sigma, reduction, data_range)

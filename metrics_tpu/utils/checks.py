@@ -52,8 +52,8 @@ def _basic_input_validation(preds: Array, target: Array, threshold: float, ignor
             raise ValueError("The `target` has to be a non-negative tensor.")
 
     preds_float = jnp.issubdtype(preds.dtype, jnp.floating)
-    if _value_check_possible(preds) and not preds_float and bool(jnp.any((preds != 0) & (preds != 1))):
-        raise ValueError("If `preds` are integers, they have to be 0s and 1s.")
+    if _value_check_possible(preds) and not preds_float and bool(jnp.any(preds < 0)):
+        raise ValueError("If `preds` are integers, they have to be non-negative.")
 
     if not 0 < threshold < 1:
         raise ValueError(f"The `threshold` should be a float in the (0,1) interval, got {threshold}")
@@ -72,7 +72,7 @@ def _check_shape_and_type_consistency(preds: Array, target: Array) -> Tuple[Data
         if preds_float and _value_check_possible(target) and int(jnp.max(target, initial=0)) > 1:
             raise ValueError("If `preds` and `target` are of shape (N, ...) and `preds` are floats, `target` should be binary.")
         if preds.ndim == 1:
-            case = DataType.BINARY
+            case = DataType.BINARY if preds_float else DataType.MULTICLASS
         else:
             case = DataType.MULTILABEL if preds_float else DataType.MULTIDIM_MULTICLASS
         implied_classes = preds.shape[1] if preds.ndim > 1 else 1

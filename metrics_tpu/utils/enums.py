@@ -67,3 +67,19 @@ class ClassificationTask(EnumStr):
                 f"Invalid Classification: expected one of ['binary', 'multiclass', 'multilabel'] but got {value}"
             )
         return task  # type: ignore[return-value]
+
+
+class ClassificationTaskNoMultilabel(EnumStr):
+    """Tasks for metrics without a multilabel variant (e.g. calibration, hinge)."""
+
+    BINARY = "binary"
+    MULTICLASS = "multiclass"
+
+    @classmethod
+    def from_str_or_raise(cls, value: str) -> "ClassificationTaskNoMultilabel":
+        task = cls.from_str(value)
+        if task is None:
+            raise ValueError(
+                f"Invalid Classification: expected one of ['binary', 'multiclass'] but got {value}"
+            )
+        return task  # type: ignore[return-value]

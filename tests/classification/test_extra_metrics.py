@@ -1,0 +1,416 @@
+"""Tests for calibration error, hinge, ranking, dice, recall@precision, spec@sensitivity.
+
+Reference-comparison philosophy (SURVEY §4.1): sklearn where it implements the metric
+(ranking trio, multiclass crammer-singer hinge, PR/ROC curve selection), plain-numpy
+re-implementations of the published formulas elsewhere.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import (
+    coverage_error as sk_coverage_error,
+    f1_score as sk_f1_score,
+    hinge_loss as sk_hinge_loss,
+    label_ranking_average_precision_score as sk_lrap,
+    label_ranking_loss as sk_lrl,
+    precision_recall_curve as sk_precision_recall_curve,
+    roc_curve as sk_roc_curve,
+)
+
+from metrics_tpu.classification.calibration_error import BinaryCalibrationError, MulticlassCalibrationError
+from metrics_tpu.classification.dice import Dice
+from metrics_tpu.classification.hinge import BinaryHingeLoss, MulticlassHingeLoss
+from metrics_tpu.classification.ranking import (
+    MultilabelCoverageError,
+    MultilabelRankingAveragePrecision,
+    MultilabelRankingLoss,
+)
+from metrics_tpu.classification.recall_at_fixed_precision import (
+    BinaryRecallAtFixedPrecision,
+    MulticlassRecallAtFixedPrecision,
+)
+from metrics_tpu.classification.specificity_at_sensitivity import BinarySpecificityAtSensitivity
+from metrics_tpu.functional.classification.calibration_error import (
+    binary_calibration_error,
+    multiclass_calibration_error,
+)
+from metrics_tpu.functional.classification.dice import dice
+from metrics_tpu.functional.classification.hinge import binary_hinge_loss, multiclass_hinge_loss
+from metrics_tpu.functional.classification.ranking import (
+    multilabel_coverage_error,
+    multilabel_ranking_average_precision,
+    multilabel_ranking_loss,
+)
+from metrics_tpu.functional.classification.recall_at_fixed_precision import (
+    binary_recall_at_fixed_precision,
+    multiclass_recall_at_fixed_precision,
+)
+from metrics_tpu.functional.classification.specificity_at_sensitivity import binary_specificity_at_sensitivity
+from tests.helpers.testers import MetricTester
+
+NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, NUM_LABELS = 8, 64, 5, 4
+_rng = np.random.RandomState(123)
+
+BIN_PROBS = _rng.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32)
+BIN_TARGET = _rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE))
+MC_PROBS = _rng.rand(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES).astype(np.float32)
+MC_PROBS = (MC_PROBS / MC_PROBS.sum(-1, keepdims=True)).astype(np.float32)
+MC_PROBS_NCFIRST = MC_PROBS  # (B, C, N) layout not used; (N, C) per batch below
+MC_TARGET = _rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE))
+ML_PROBS = _rng.rand(NUM_BATCHES, BATCH_SIZE, NUM_LABELS).astype(np.float32)
+ML_TARGET = _rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_LABELS))
+
+
+# --------------------------------------------------------------------- calibration error
+
+
+def _np_ce(conf, acc, n_bins, norm, ddtype=np.float64):
+    conf = np.asarray(conf, dtype=ddtype).reshape(-1)
+    acc = np.asarray(acc, dtype=ddtype).reshape(-1)
+    bounds = np.linspace(0, 1, n_bins + 1)
+    idx = np.clip(np.searchsorted(bounds, conf, side="left") - 1, 0, n_bins - 1)
+    acc_bin = np.zeros(n_bins)
+    conf_bin = np.zeros(n_bins)
+    count = np.zeros(n_bins)
+    np.add.at(count, idx, 1)
+    np.add.at(conf_bin, idx, conf)
+    np.add.at(acc_bin, idx, acc)
+    with np.errstate(invalid="ignore"):
+        mean_acc = np.where(count > 0, acc_bin / np.maximum(count, 1), 0)
+        mean_conf = np.where(count > 0, conf_bin / np.maximum(count, 1), 0)
+    prop = count / count.sum()
+    if norm == "l1":
+        return np.sum(np.abs(mean_acc - mean_conf) * prop)
+    if norm == "max":
+        return np.max(np.abs(mean_acc - mean_conf))
+    ce = np.sum((mean_acc - mean_conf) ** 2 * prop)
+    return np.sqrt(ce) if ce > 0 else 0.0
+
+
+def _np_binary_ce(preds, target, n_bins=15, norm="l1"):
+    return _np_ce(preds, target, n_bins, norm)
+
+
+def _np_multiclass_ce(preds, target, n_bins=15, norm="l1"):
+    preds = preds.reshape(-1, NUM_CLASSES)
+    target = target.reshape(-1)
+    conf = preds.max(-1)
+    acc = (preds.argmax(-1) == target).astype(np.float64)
+    return _np_ce(conf, acc, n_bins, norm)
+
+
+@pytest.mark.parametrize("norm", ["l1", "l2", "max"])
+class TestCalibrationError(MetricTester):
+    atol = 1e-5
+
+    def test_binary_class(self, norm):
+        self.run_class_metric_test(
+            BIN_PROBS,
+            BIN_TARGET,
+            BinaryCalibrationError,
+            partial(_np_binary_ce, norm=norm),
+            metric_args={"n_bins": 15, "norm": norm},
+        )
+
+    def test_binary_functional(self, norm):
+        self.run_functional_metric_test(
+            BIN_PROBS, BIN_TARGET, binary_calibration_error, partial(_np_binary_ce, norm=norm),
+            metric_args={"n_bins": 15, "norm": norm},
+        )
+
+    def test_multiclass_class(self, norm):
+        self.run_class_metric_test(
+            MC_PROBS,
+            MC_TARGET,
+            MulticlassCalibrationError,
+            partial(_np_multiclass_ce, norm=norm),
+            metric_args={"num_classes": NUM_CLASSES, "n_bins": 15, "norm": norm},
+        )
+
+    def test_multiclass_functional(self, norm):
+        self.run_functional_metric_test(
+            MC_PROBS, MC_TARGET, multiclass_calibration_error,
+            partial(_np_multiclass_ce, norm=norm),
+            metric_args={"num_classes": NUM_CLASSES, "n_bins": 15, "norm": norm},
+        )
+
+
+# ----------------------------------------------------------------------------- hinge
+
+
+def _np_binary_hinge(preds, target, squared=False):
+    preds, target = preds.reshape(-1).astype(np.float64), target.reshape(-1)
+    margin = np.where(target == 1, preds, -preds)
+    m = np.clip(1 - margin, 0, None)
+    if squared:
+        m = m**2
+    return m.sum() / len(m)
+
+
+def _np_multiclass_hinge_cs(preds, target, squared=False):
+    """sklearn implements the crammer-singer hinge (on probabilities here)."""
+    preds = preds.reshape(-1, NUM_CLASSES).astype(np.float64)
+    target = target.reshape(-1)
+    if squared:
+        t = np.eye(NUM_CLASSES, dtype=bool)[target]
+        margin = preds[t] - np.max(np.where(t, -np.inf, preds), axis=1)
+        return (np.clip(1 - margin, 0, None) ** 2).mean()
+    return sk_hinge_loss(target, preds, labels=list(range(NUM_CLASSES)))
+
+
+def _np_multiclass_hinge_ova(preds, target, squared=False):
+    preds = preds.reshape(-1, NUM_CLASSES).astype(np.float64)
+    target = target.reshape(-1)
+    t = np.eye(NUM_CLASSES, dtype=bool)[target]
+    margin = np.where(t, preds, -preds)
+    m = np.clip(1 - margin, 0, None)
+    if squared:
+        m = m**2
+    return m.sum(0) / len(target)
+
+
+@pytest.mark.parametrize("squared", [False, True])
+class TestHingeLoss(MetricTester):
+    atol = 1e-5
+
+    def test_binary_class(self, squared):
+        self.run_class_metric_test(
+            BIN_PROBS,
+            BIN_TARGET,
+            BinaryHingeLoss,
+            partial(_np_binary_hinge, squared=squared),
+            metric_args={"squared": squared},
+        )
+
+    def test_binary_functional(self, squared):
+        self.run_functional_metric_test(
+            BIN_PROBS, BIN_TARGET, binary_hinge_loss, partial(_np_binary_hinge, squared=squared),
+            metric_args={"squared": squared},
+        )
+
+    @pytest.mark.parametrize("mode", ["crammer-singer", "one-vs-all"])
+    def test_multiclass_class(self, squared, mode):
+        ref = _np_multiclass_hinge_cs if mode == "crammer-singer" else _np_multiclass_hinge_ova
+        self.run_class_metric_test(
+            MC_PROBS,
+            MC_TARGET,
+            MulticlassHingeLoss,
+            partial(ref, squared=squared),
+            metric_args={"num_classes": NUM_CLASSES, "squared": squared, "multiclass_mode": mode},
+        )
+
+    @pytest.mark.parametrize("mode", ["crammer-singer", "one-vs-all"])
+    def test_multiclass_functional(self, squared, mode):
+        ref = _np_multiclass_hinge_cs if mode == "crammer-singer" else _np_multiclass_hinge_ova
+        self.run_functional_metric_test(
+            MC_PROBS, MC_TARGET, multiclass_hinge_loss, partial(ref, squared=squared),
+            metric_args={"num_classes": NUM_CLASSES, "squared": squared, "multiclass_mode": mode},
+        )
+
+
+# ----------------------------------------------------------------------------- ranking
+
+
+def _np_cov(preds, target):
+    return sk_coverage_error(target.reshape(-1, NUM_LABELS), preds.reshape(-1, NUM_LABELS))
+
+
+def _np_lrap(preds, target):
+    return sk_lrap(target.reshape(-1, NUM_LABELS), preds.reshape(-1, NUM_LABELS))
+
+
+def _np_lrl(preds, target):
+    return sk_lrl(target.reshape(-1, NUM_LABELS), preds.reshape(-1, NUM_LABELS))
+
+
+@pytest.mark.parametrize(
+    ("metric_class", "metric_fn", "ref"),
+    [
+        (MultilabelCoverageError, multilabel_coverage_error, _np_cov),
+        (MultilabelRankingAveragePrecision, multilabel_ranking_average_precision, _np_lrap),
+        (MultilabelRankingLoss, multilabel_ranking_loss, _np_lrl),
+    ],
+)
+class TestRanking(MetricTester):
+    atol = 1e-5
+
+    def test_class(self, metric_class, metric_fn, ref):
+        self.run_class_metric_test(
+            ML_PROBS,
+            ML_TARGET,
+            metric_class,
+            ref,
+            metric_args={"num_labels": NUM_LABELS},
+        )
+
+    def test_functional(self, metric_class, metric_fn, ref):
+        self.run_functional_metric_test(
+            ML_PROBS, ML_TARGET, metric_fn, ref,
+            metric_args={"num_labels": NUM_LABELS},
+        )
+
+
+# ------------------------------------------------------------------------------- dice
+
+
+def _np_dice_micro(preds, target):
+    preds, target = preds.reshape(-1), target.reshape(-1)
+    return sk_f1_score(target, preds, average="micro")
+
+
+def _np_dice_macro(preds, target):
+    preds, target = preds.reshape(-1), target.reshape(-1)
+    return sk_f1_score(target, preds, average="macro", labels=list(range(NUM_CLASSES)))
+
+
+MC_LABEL_PREDS = _rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE))
+
+
+class TestDice(MetricTester):
+    atol = 1e-6
+
+    def test_micro(self):
+        self.run_class_metric_test(
+            MC_LABEL_PREDS, MC_TARGET, Dice, _np_dice_micro, metric_args={"average": "micro"},
+            check_sharded=False,
+        )
+
+    def test_macro(self):
+        # every class appears in every batch with this fixture, so sklearn macro
+        # (which averages over all labels) matches the absent-class-skipping dice
+        self.run_class_metric_test(
+            MC_LABEL_PREDS, MC_TARGET, Dice, _np_dice_macro,
+            metric_args={"average": "macro", "num_classes": NUM_CLASSES},
+            check_sharded=False,
+        )
+
+    def test_functional_micro(self):
+        self.run_functional_metric_test(MC_LABEL_PREDS, MC_TARGET, dice, _np_dice_micro)
+
+    def test_functional_macro(self):
+        self.run_functional_metric_test(
+            MC_LABEL_PREDS, MC_TARGET, dice, _np_dice_macro,
+            metric_args={"average": "macro", "num_classes": NUM_CLASSES},
+        )
+
+    def test_ignore_index(self):
+        res = dice(
+            jnp.asarray(MC_LABEL_PREDS[0]), jnp.asarray(MC_TARGET[0]),
+            average="macro", num_classes=NUM_CLASSES, ignore_index=0,
+        )
+        keep = [c for c in range(NUM_CLASSES) if c != 0]
+        ref = sk_f1_score(MC_TARGET[0], MC_LABEL_PREDS[0], average="macro", labels=keep)
+        np.testing.assert_allclose(np.asarray(res), ref, atol=1e-6)
+
+    def test_samplewise(self):
+        # multidim multiclass, samplewise averaging: mean over per-sample micro dice
+        preds = _rng.randint(0, NUM_CLASSES, (8, 10))
+        target = _rng.randint(0, NUM_CLASSES, (8, 10))
+        res = dice(jnp.asarray(preds), jnp.asarray(target), average="micro", mdmc_average="samplewise")
+        ref = np.mean([sk_f1_score(target[i], preds[i], average="micro") for i in range(8)])
+        np.testing.assert_allclose(np.asarray(res), ref, atol=1e-6)
+
+
+# ---------------------------------------------------- recall@precision / spec@sensitivity
+
+
+def _np_rafp(preds, target, min_precision):
+    p, r, t = sk_precision_recall_curve(target.reshape(-1), preds.reshape(-1))
+    valid = [(rr, pp, tt) for pp, rr, tt in zip(p[:-1], r[:-1], t) if pp >= min_precision]
+    if not valid:
+        return np.array(0.0), np.array(1e6)
+    mr = max(valid)
+    if mr[0] == 0:
+        return np.array(0.0), np.array(1e6)
+    return np.array(mr[0]), np.array(mr[2])
+
+
+def _np_safs(preds, target, min_sensitivity):
+    fpr, tpr, thr = sk_roc_curve(target.reshape(-1), preds.reshape(-1), drop_intermediate=False)
+    spec = 1 - fpr
+    valid = [(sp, tt) for sp, sn, tt in zip(spec[1:], tpr[1:], thr[1:]) if sn >= min_sensitivity]
+    if not valid:
+        return np.array(0.0), np.array(1e6)
+    ms = max(valid)
+    return np.array(ms[0]), np.array(ms[1])
+
+
+@pytest.mark.parametrize("min_precision", [0.3, 0.6, 0.85])
+class TestBinaryRecallAtFixedPrecision(MetricTester):
+    atol = 1e-6
+
+    def test_exact_class(self, min_precision):
+        self.run_class_metric_test(
+            BIN_PROBS,
+            BIN_TARGET,
+            BinaryRecallAtFixedPrecision,
+            partial(_np_rafp, min_precision=min_precision),
+            metric_args={"min_precision": min_precision},
+            check_batch=False,
+        )
+
+    def test_exact_functional(self, min_precision):
+        res = binary_recall_at_fixed_precision(
+            jnp.asarray(BIN_PROBS.reshape(-1)), jnp.asarray(BIN_TARGET.reshape(-1)), min_precision
+        )
+        ref = _np_rafp(BIN_PROBS, BIN_TARGET, min_precision)
+        np.testing.assert_allclose(np.asarray(res[0]), ref[0], atol=1e-6)
+        np.testing.assert_allclose(np.asarray(res[1]), ref[1], atol=1e-6)
+
+    def test_binned_close_to_exact(self, min_precision):
+        """Binned recall must be within one bin's resolution of the exact value."""
+        exact, _ = binary_recall_at_fixed_precision(
+            jnp.asarray(BIN_PROBS.reshape(-1)), jnp.asarray(BIN_TARGET.reshape(-1)), min_precision
+        )
+        binned, _ = binary_recall_at_fixed_precision(
+            jnp.asarray(BIN_PROBS.reshape(-1)), jnp.asarray(BIN_TARGET.reshape(-1)), min_precision, thresholds=500
+        )
+        assert abs(float(exact) - float(binned)) < 0.05
+
+
+def test_multiclass_recall_at_fixed_precision():
+    preds = jnp.asarray(MC_PROBS.reshape(-1, NUM_CLASSES))
+    target = jnp.asarray(MC_TARGET.reshape(-1))
+    rec, thr = multiclass_recall_at_fixed_precision(preds, target, NUM_CLASSES, 0.3)
+    for c in range(NUM_CLASSES):
+        ref = _np_rafp(np.asarray(preds)[:, c], (np.asarray(target) == c).astype(int), 0.3)
+        np.testing.assert_allclose(np.asarray(rec)[c], ref[0], atol=1e-6)
+        np.testing.assert_allclose(np.asarray(thr)[c], ref[1], atol=1e-6)
+
+
+def test_multiclass_recall_at_fixed_precision_class():
+    m = MulticlassRecallAtFixedPrecision(NUM_CLASSES, 0.3)
+    for i in range(NUM_BATCHES):
+        m.update(jnp.asarray(MC_PROBS[i]), jnp.asarray(MC_TARGET[i]))
+    rec, thr = m.compute()
+    for c in range(NUM_CLASSES):
+        ref = _np_rafp(MC_PROBS.reshape(-1, NUM_CLASSES)[:, c], (MC_TARGET.reshape(-1) == c).astype(int), 0.3)
+        np.testing.assert_allclose(np.asarray(rec)[c], ref[0], atol=1e-6)
+
+
+@pytest.mark.parametrize("min_sensitivity", [0.3, 0.6, 0.85])
+class TestBinarySpecificityAtSensitivity(MetricTester):
+    atol = 1e-6
+
+    def test_exact_class(self, min_sensitivity):
+        self.run_class_metric_test(
+            BIN_PROBS,
+            BIN_TARGET,
+            BinarySpecificityAtSensitivity,
+            partial(_np_safs, min_sensitivity=min_sensitivity),
+            metric_args={"min_sensitivity": min_sensitivity},
+            check_batch=False,
+        )
+
+    def test_exact_functional(self, min_sensitivity):
+        res = binary_specificity_at_sensitivity(
+            jnp.asarray(BIN_PROBS.reshape(-1)), jnp.asarray(BIN_TARGET.reshape(-1)), min_sensitivity
+        )
+        ref = _np_safs(BIN_PROBS, BIN_TARGET, min_sensitivity)
+        np.testing.assert_allclose(np.asarray(res[0]), ref[0], atol=1e-6)
+        np.testing.assert_allclose(np.asarray(res[1]), ref[1], atol=1e-6)
