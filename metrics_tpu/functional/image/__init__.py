@@ -1,0 +1,23 @@
+"""Image functional metrics (reference src/torchmetrics/functional/image/)."""
+
+from metrics_tpu.functional.image.d_lambda import spectral_distortion_index
+from metrics_tpu.functional.image.ergas import error_relative_global_dimensionless_synthesis
+from metrics_tpu.functional.image.psnr import peak_signal_noise_ratio
+from metrics_tpu.functional.image.sam import spectral_angle_mapper
+from metrics_tpu.functional.image.ssim import (
+    multiscale_structural_similarity_index_measure,
+    structural_similarity_index_measure,
+)
+from metrics_tpu.functional.image.tv import total_variation
+from metrics_tpu.functional.image.uqi import universal_image_quality_index
+
+__all__ = [
+    "error_relative_global_dimensionless_synthesis",
+    "multiscale_structural_similarity_index_measure",
+    "peak_signal_noise_ratio",
+    "spectral_angle_mapper",
+    "spectral_distortion_index",
+    "structural_similarity_index_measure",
+    "total_variation",
+    "universal_image_quality_index",
+]

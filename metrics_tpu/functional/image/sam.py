@@ -1,0 +1,45 @@
+"""Spectral Angle Mapper functional.
+
+Reference parity: src/torchmetrics/functional/image/sam.py
+(``_sam_update`` :24, ``_sam_compute`` :52, ``spectral_angle_mapper`` :84).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.distributed import reduce
+
+
+def _sam_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if preds.dtype != target.dtype:
+        target = target.astype(preds.dtype)
+    _check_same_shape(preds, target)
+    if preds.ndim != 4:
+        raise ValueError(f"Expected `preds` and `target` to have BxCxHxW shape. Got preds: {preds.shape}.")
+    if preds.shape[1] <= 1:
+        raise ValueError(
+            "Expected channel dimension of `preds` and `target` to be larger than 1."
+            f" Got preds: {preds.shape[1]}."
+        )
+    return preds, target
+
+
+def _sam_compute(preds: Array, target: Array, reduction: Optional[str] = "elementwise_mean") -> Array:
+    dot_product = jnp.sum(preds * target, axis=1)
+    preds_norm = jnp.linalg.norm(preds, axis=1)
+    target_norm = jnp.linalg.norm(target, axis=1)
+    sam_score = jnp.arccos(jnp.clip(dot_product / (preds_norm * target_norm), -1, 1))
+    return reduce(sam_score, reduction)
+
+
+def spectral_angle_mapper(preds: Array, target: Array, reduction: Optional[str] = "elementwise_mean") -> Array:
+    """Per-pixel spectral angle between channel vectors, reduced (reference :84-…)."""
+    preds, target = _sam_update(preds, target)
+    return _sam_compute(preds, target, reduction)

@@ -1,0 +1,31 @@
+"""Image module metrics (SURVEY §2.5, reference src/torchmetrics/image/)."""
+
+from metrics_tpu.image.d_lambda import SpectralDistortionIndex
+from metrics_tpu.image.ergas import ErrorRelativeGlobalDimensionlessSynthesis
+from metrics_tpu.image.fid import FrechetInceptionDistance
+from metrics_tpu.image.inception import InceptionScore
+from metrics_tpu.image.kid import KernelInceptionDistance
+from metrics_tpu.image.lpip import LearnedPerceptualImagePatchSimilarity
+from metrics_tpu.image.psnr import PeakSignalNoiseRatio
+from metrics_tpu.image.sam import SpectralAngleMapper
+from metrics_tpu.image.ssim import (
+    MultiScaleStructuralSimilarityIndexMeasure,
+    StructuralSimilarityIndexMeasure,
+)
+from metrics_tpu.image.tv import TotalVariation
+from metrics_tpu.image.uqi import UniversalImageQualityIndex
+
+__all__ = [
+    "ErrorRelativeGlobalDimensionlessSynthesis",
+    "FrechetInceptionDistance",
+    "InceptionScore",
+    "KernelInceptionDistance",
+    "LearnedPerceptualImagePatchSimilarity",
+    "MultiScaleStructuralSimilarityIndexMeasure",
+    "PeakSignalNoiseRatio",
+    "SpectralAngleMapper",
+    "SpectralDistortionIndex",
+    "StructuralSimilarityIndexMeasure",
+    "TotalVariation",
+    "UniversalImageQualityIndex",
+]

@@ -1,0 +1,48 @@
+"""Spectral Distortion Index (D_lambda) module metric.
+
+Reference parity: src/torchmetrics/image/d_lambda.py (cat-list preds/target states
+:84-85 — the cross-band UQI matrices must be computed over the union of all batches,
+so state stays O(N) exactly like the reference).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from jax import Array
+
+from metrics_tpu.functional.image.d_lambda import (
+    _spectral_distortion_index_compute,
+    _spectral_distortion_index_update,
+)
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.data import dim_zero_cat
+
+
+class SpectralDistortionIndex(Metric):
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    _host_compute = False
+
+    def __init__(self, p: int = 1, reduction: Optional[str] = "elementwise_mean", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(p, int) or p <= 0:
+            raise ValueError(f"Expected `p` to be a positive integer. Got p: {p}.")
+        self.p = p
+        allowed_reduction = ("elementwise_mean", "sum", "none")
+        if reduction not in allowed_reduction:
+            raise ValueError(f"Expected argument `reduction` be one of {allowed_reduction} but got {reduction}")
+        self.reduction = reduction
+        self.add_state("preds", [], dist_reduce_fx="cat")
+        self.add_state("target", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        preds, target = _spectral_distortion_index_update(preds, target)
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def compute(self) -> Array:
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        return _spectral_distortion_index_compute(preds, target, self.p, self.reduction)

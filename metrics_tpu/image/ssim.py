@@ -1,0 +1,155 @@
+"""SSIM / MS-SSIM module metrics.
+
+Reference parity: src/torchmetrics/image/ssim.py (similarity sum state for
+mean/sum reductions :99-103, cat lists for 'none' :101, MS-SSIM :246-250).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.image.ssim import _multiscale_ssim_update, _ssim_check_inputs, _ssim_update
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.data import dim_zero_cat
+
+
+class StructuralSimilarityIndexMeasure(Metric):
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(
+        self,
+        gaussian_kernel: bool = True,
+        sigma: Union[float, Sequence[float]] = 1.5,
+        kernel_size: Union[int, Sequence[int]] = 11,
+        reduction: Optional[str] = "elementwise_mean",
+        data_range: Optional[float] = None,
+        k1: float = 0.01,
+        k2: float = 0.03,
+        return_full_image: bool = False,
+        return_contrast_sensitivity: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        valid_reduction = ("elementwise_mean", "sum", "none", None)
+        if reduction not in valid_reduction:
+            raise ValueError(f"Argument `reduction` must be one of {valid_reduction}, but got {reduction}")
+
+        if reduction in ("elementwise_mean", "sum"):
+            self.add_state("similarity", jnp.zeros(()), dist_reduce_fx="sum")
+        else:
+            self.add_state("similarity", [], dist_reduce_fx="cat")
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+        if return_contrast_sensitivity or return_full_image:
+            self.add_state("image_return", [], dist_reduce_fx="cat")
+
+        self.gaussian_kernel = gaussian_kernel
+        self.sigma = sigma
+        self.kernel_size = kernel_size
+        self.reduction = reduction
+        self.data_range = data_range
+        self.k1 = k1
+        self.k2 = k2
+        self.return_full_image = return_full_image
+        self.return_contrast_sensitivity = return_contrast_sensitivity
+
+    def update(self, preds: Array, target: Array) -> None:
+        preds, target = _ssim_check_inputs(preds, target)
+        similarity_pack = _ssim_update(
+            preds, target, self.gaussian_kernel, self.sigma, self.kernel_size, self.data_range,
+            self.k1, self.k2, self.return_full_image, self.return_contrast_sensitivity,
+        )
+        if isinstance(similarity_pack, tuple):
+            similarity, image = similarity_pack
+            self.image_return.append(image)
+        else:
+            similarity = similarity_pack
+
+        if self.reduction in ("elementwise_mean", "sum"):
+            self.similarity = self.similarity + jnp.sum(similarity)
+        else:
+            self.similarity.append(similarity)
+        self.total = self.total + preds.shape[0]
+
+    def compute(self) -> Union[Array, Tuple[Array, Array]]:
+        if self.reduction == "elementwise_mean":
+            similarity = self.similarity / self.total
+        elif self.reduction == "sum":
+            similarity = self.similarity
+        else:
+            similarity = dim_zero_cat(self.similarity)
+
+        if self.return_contrast_sensitivity or self.return_full_image:
+            return similarity, dim_zero_cat(self.image_return)
+        return similarity
+
+
+class MultiScaleStructuralSimilarityIndexMeasure(Metric):
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(
+        self,
+        gaussian_kernel: bool = True,
+        kernel_size: Union[int, Sequence[int]] = 11,
+        sigma: Union[float, Sequence[float]] = 1.5,
+        reduction: Optional[str] = "elementwise_mean",
+        data_range: Optional[float] = None,
+        k1: float = 0.01,
+        k2: float = 0.03,
+        betas: Tuple[float, ...] = (0.0448, 0.2856, 0.3001, 0.2363, 0.1333),
+        normalize: Optional[str] = "relu",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        valid_reduction = ("elementwise_mean", "sum", "none", None)
+        if reduction not in valid_reduction:
+            raise ValueError(f"Argument `reduction` must be one of {valid_reduction}, but got {reduction}")
+
+        if reduction in ("elementwise_mean", "sum"):
+            self.add_state("similarity", jnp.zeros(()), dist_reduce_fx="sum")
+        else:
+            self.add_state("similarity", [], dist_reduce_fx="cat")
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+        if not (isinstance(kernel_size, (Sequence, int))):
+            raise ValueError("Argument `kernel_size` expected to be an sequence or an int")
+        if not isinstance(betas, tuple) or not all(isinstance(beta, float) for beta in betas):
+            raise ValueError("Argument `betas` is expected to be of a type tuple of floats.")
+        if normalize and normalize not in ("relu", "simple"):
+            raise ValueError("Argument `normalize` to be expected either `None` or one of 'relu' or 'simple'")
+
+        self.gaussian_kernel = gaussian_kernel
+        self.sigma = sigma
+        self.kernel_size = kernel_size
+        self.reduction = reduction
+        self.data_range = data_range
+        self.k1 = k1
+        self.k2 = k2
+        self.betas = betas
+        self.normalize = normalize
+
+    def update(self, preds: Array, target: Array) -> None:
+        preds, target = _ssim_check_inputs(preds, target)
+        similarity = _multiscale_ssim_update(
+            preds, target, self.gaussian_kernel, self.sigma, self.kernel_size, self.data_range,
+            self.k1, self.k2, self.betas, self.normalize,
+        )
+        if self.reduction in ("none", None):
+            self.similarity.append(similarity)
+        else:
+            self.similarity = self.similarity + jnp.sum(similarity)
+        self.total = self.total + preds.shape[0]
+
+    def compute(self) -> Array:
+        if self.reduction == "elementwise_mean":
+            return self.similarity / self.total
+        if self.reduction == "sum":
+            return self.similarity
+        return dim_zero_cat(self.similarity)

@@ -1,0 +1,49 @@
+"""Total variation module metric.
+
+Reference parity: src/torchmetrics/image/tv.py (sum states for mean/sum :71-74,
+cat list for 'none').
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.image.tv import _total_variation_compute, _total_variation_update
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.data import dim_zero_cat
+
+
+class TotalVariation(Metric):
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(self, reduction: Optional[str] = "sum", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if reduction is not None and reduction not in ("sum", "mean", "none"):
+            raise ValueError("Expected argument `reduction` to either be 'sum', 'mean', 'none' or None")
+        self.reduction = reduction
+
+        if self.reduction is None or self.reduction == "none":
+            self.add_state("score", [], dist_reduce_fx="cat")
+        else:
+            self.add_state("score", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("num_elements", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, img: Array) -> None:
+        score, num_elements = _total_variation_update(jnp.asarray(img))
+        if self.reduction is None or self.reduction == "none":
+            self.score.append(score)
+        else:
+            self.score = self.score + jnp.sum(score)
+        self.num_elements = self.num_elements + num_elements
+
+    def compute(self) -> Array:
+        if self.reduction is None or self.reduction == "none":
+            return dim_zero_cat(self.score)
+        if self.reduction == "mean":
+            return self.score / self.num_elements
+        return self.score

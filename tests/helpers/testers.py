@@ -212,8 +212,12 @@ class MetricTester:
         metric = metric_class(**metric_args)
         if metric._host_compute:
             return  # compute() is host-only (data-dependent shapes) — sharded via sync, not in-trace
-        mesh = Mesh(np.array(jax.devices()[:NUM_DEVICES]), ("dp",))
-        k = NUM_BATCHES // NUM_DEVICES
+        num_batches = len(preds)
+        num_devices = NUM_DEVICES if num_batches % NUM_DEVICES == 0 else NUM_PROCESSES
+        if num_batches % num_devices != 0:
+            return
+        mesh = Mesh(np.array(jax.devices()[:num_devices]), ("dp",))
+        k = num_batches // num_devices
         preds_stack = jnp.stack([jnp.asarray(p) for p in preds])
         target_stack = jnp.stack([jnp.asarray(t) for t in target])
 
