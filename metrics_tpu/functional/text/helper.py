@@ -1,0 +1,80 @@
+"""Shared text helpers: corpus validation and a vectorized Levenshtein kernel.
+
+Reference parity: src/torchmetrics/functional/text/helper.py (`_validate_inputs` :298,
+`_edit_distance` :333). TPU-first redesign: the reference's O(n·m) pure-Python DP loop
+is replaced by a wavefront formulation with only ONE Python loop (over the shorter
+sequence) and numpy vector work per row — the within-row insertion dependency
+``dp[j] = min(dp[j-1] + 1, cand[j])`` is solved in closed form as a running prefix-min
+of ``cand[j] - j`` (all insertion costs are 1), i.e. ``np.minimum.accumulate``.
+
+String tokenization itself stays on host (SURVEY §2.5: state is small tensors; the
+algorithms are not worth jitting), but every per-row step is vectorized.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Hashable, List, Sequence, Tuple, Union
+
+import numpy as np
+
+
+def _validate_inputs(
+    reference_corpus: Union[Sequence[str], Sequence[Sequence[str]]],
+    hypothesis_corpus: Union[str, Sequence[str]],
+) -> Tuple[Sequence[Sequence[str]], Sequence[str]]:
+    """Normalize corpora to (Sequence[Sequence[str]], Sequence[str]) and length-check.
+
+    Reference: functional/text/helper.py:298-330.
+    """
+    if isinstance(hypothesis_corpus, str):
+        hypothesis_corpus = [hypothesis_corpus]
+
+    if all(isinstance(ref, str) for ref in reference_corpus):
+        reference_corpus = [reference_corpus] if len(hypothesis_corpus) == 1 else [[ref] for ref in reference_corpus]
+
+    if hypothesis_corpus and all(ref for ref in reference_corpus) and len(reference_corpus) != len(hypothesis_corpus):
+        raise ValueError(f"Corpus has different size {len(reference_corpus)} != {len(hypothesis_corpus)}")
+
+    return reference_corpus, hypothesis_corpus
+
+
+def _tokens_to_ids(*token_seqs: Sequence[Hashable]) -> List[np.ndarray]:
+    """Map arbitrary hashable tokens to a shared int32 id space (host-side)."""
+    vocab: Dict[Hashable, int] = {}
+    out = []
+    for seq in token_seqs:
+        ids = np.empty(len(seq), dtype=np.int32)
+        for i, tok in enumerate(seq):
+            if tok not in vocab:
+                vocab[tok] = len(vocab)
+            ids[i] = vocab[tok]
+        out.append(ids)
+    return out
+
+
+def _edit_distance(prediction_tokens: Sequence[Hashable], reference_tokens: Sequence[Hashable]) -> int:
+    """Levenshtein distance via a vectorized row recurrence.
+
+    Same contract as reference helper.py:333-353; unit costs. Row recurrence:
+    ``cand[j] = min(prev[j] + 1, prev[j-1] + sub_cost[j])`` is elementwise; the
+    remaining within-row term ``dp[j] = min(cand[j], dp[j-1] + 1)`` equals
+    ``j + running_min(cand[k] - k, k <= j)`` and is computed with minimum.accumulate.
+    """
+    pred_ids, ref_ids = _tokens_to_ids(prediction_tokens, reference_tokens)
+    n, m = len(pred_ids), len(ref_ids)
+    if n == 0:
+        return m
+    if m == 0:
+        return n
+    # iterate over the shorter axis to minimize Python-loop iterations
+    if n < m:
+        pred_ids, ref_ids, n, m = ref_ids, pred_ids, m, n
+
+    prev = np.arange(m + 1, dtype=np.int64)
+    offsets = prev  # [0, 1, ..., m] — reused as the prefix-min offset vector
+    for i in range(1, n + 1):
+        sub = prev[:-1] + (ref_ids != pred_ids[i - 1])
+        cand = np.minimum(prev[1:] + 1, sub)
+        cand = np.concatenate(([i], cand))
+        prev = np.minimum.accumulate(cand - offsets) + offsets
+    return int(prev[-1])

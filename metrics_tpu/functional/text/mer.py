@@ -1,0 +1,43 @@
+"""Match error rate (reference src/torchmetrics/functional/text/mer.py)."""
+
+from __future__ import annotations
+
+from typing import List, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.text.helper import _edit_distance
+
+
+def _mer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Tuple[Array, Array]:
+    """Sum edit operations and max(len(ref), len(pred)) (reference mer.py:23-51)."""
+    if isinstance(preds, str):
+        preds = [preds]
+    if isinstance(target, str):
+        target = [target]
+    errors = 0
+    total = 0
+    for pred, tgt in zip(preds, target):
+        pred_tokens = pred.split()
+        tgt_tokens = tgt.split()
+        errors += _edit_distance(pred_tokens, tgt_tokens)
+        total += max(len(tgt_tokens), len(pred_tokens))
+    return jnp.asarray(errors, jnp.float32), jnp.asarray(total, jnp.float32)
+
+
+def _mer_compute(errors: Array, total: Array) -> Array:
+    return errors / total
+
+
+def match_error_rate(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """Match error rate of transcriptions vs references (reference mer.py:66-90).
+
+    Example:
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> match_error_rate(preds=preds, target=target)  # doctest: +SKIP
+        Array(0.44444445, dtype=float32)
+    """
+    errors, total = _mer_update(preds, target)
+    return _mer_compute(errors, total)

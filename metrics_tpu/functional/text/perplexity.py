@@ -1,0 +1,73 @@
+"""Perplexity (reference src/torchmetrics/functional/text/perplexity.py).
+
+Fully jittable kernel: log-softmax + gather + masked sum. ``ignore_index`` is handled
+as a 0-weight mask (SURVEY §7.1: masked-weight reformulation instead of boolean
+filtering) so shapes stay static under jit. The reference materializes the full
+softmax and an O(N²) gather (``probs[:, target].diagonal()``, perplexity.py:95);
+here it is a take_along_axis on the log-softmax — O(N) memory and numerically safer.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+
+def _check_shape_and_type_consistency(preds: Array, target: Array) -> None:
+    """Validate [B, S, V] preds vs [B, S] integer target (reference perplexity.py:24-65)."""
+    if preds.ndim != 3:
+        raise ValueError(
+            "Input tensor `preds` is expected to have 3 dimensions, [batch_size, seq_len, vocab_size],"
+            f" but got {preds.ndim}."
+        )
+    if target.ndim != 2:
+        raise ValueError(
+            f"Input tensor `target` is expected to have 2 dimensions, [batch_size, seq_len], but got {target.ndim}."
+        )
+    if preds.shape[:2] != target.shape:
+        raise ValueError(
+            "Input tensors `preds` and `target` are expected to have equaling first two dimensions,"
+            f" [batch_size, seq_len], but got {preds.shape[:2]} and {target.shape}."
+        )
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        raise TypeError(f"Input tensor `preds` is expected to be of floating point type but got {preds.dtype}.")
+    if not jnp.issubdtype(target.dtype, jnp.integer):
+        raise TypeError(f"Input tensor `target` is expected to be of integer type but got {target.dtype}.")
+
+
+def _perplexity_update(preds: Array, target: Array, ignore_index: Optional[int] = None) -> Tuple[Array, Array]:
+    """Return (-sum log p(target), token count); jit-safe body after host-side checks."""
+    _check_shape_and_type_consistency(preds, target)
+
+    logprobs = jax.nn.log_softmax(preds.reshape(-1, preds.shape[-1]).astype(jnp.float32), axis=-1)
+    target = target.reshape(-1)
+
+    if ignore_index is not None:
+        mask = target != ignore_index
+        target = jnp.where(mask, target, 0)
+    else:
+        mask = jnp.ones_like(target, dtype=bool)
+
+    token_logprobs = jnp.take_along_axis(logprobs, target[:, None], axis=-1)[:, 0]
+    total_log_probs = -jnp.sum(token_logprobs * mask)
+    count = jnp.sum(mask).astype(jnp.float32)
+    return total_log_probs, count
+
+
+def _perplexity_compute(total: Array, count: Array) -> Array:
+    return jnp.exp(total / count)
+
+
+def perplexity(preds: Array, target: Array, ignore_index: Optional[int] = None) -> Array:
+    """Perplexity of a language model's token probabilities (reference perplexity.py:114-139).
+
+    Args:
+        preds: Unnormalized logits for each token, shape ``[batch, seq, vocab]``.
+        target: Ground-truth token ids, shape ``[batch, seq]``.
+        ignore_index: Target class that does not contribute to the score.
+    """
+    total, count = _perplexity_update(preds, target, ignore_index)
+    return _perplexity_compute(total, count)

@@ -1,0 +1,294 @@
+"""ROUGE score (reference src/torchmetrics/functional/text/rouge.py).
+
+ROUGE-N via clipped n-gram overlap, ROUGE-L via LCS, ROUGE-LSum via union-LCS over
+sentence splits — following the official Lin (2004) definitions and the
+google-research ``rouge_score`` package behavior. Per-sentence scores are
+accumulated as ragged "cat" states (means at compute), matching the reference's
+list-state design (text/rouge.py:135).
+"""
+
+from __future__ import annotations
+
+import re
+from collections import Counter
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.utils.imports import _NLTK_AVAILABLE
+from metrics_tpu.utils.prints import rank_zero_warn
+
+ALLOWED_ROUGE_KEYS: Dict[str, Union[int, str]] = {
+    "rouge1": 1,
+    "rouge2": 2,
+    "rouge3": 3,
+    "rouge4": 4,
+    "rouge5": 5,
+    "rouge6": 6,
+    "rouge7": 7,
+    "rouge8": 8,
+    "rouge9": 9,
+    "rougeL": "L",
+    "rougeLsum": "Lsum",
+}
+ALLOWED_ACCUMULATE_VALUES = ("avg", "best")
+
+
+def _split_sentence(x: str) -> Sequence[str]:
+    """Sentence-split for ROUGE-Lsum (nltk punkt when available, regex fallback)."""
+    x = re.sub("<n>", "", x)  # remove pegasus newline char
+    if _NLTK_AVAILABLE:
+        import nltk
+
+        try:
+            return nltk.sent_tokenize(x)
+        except LookupError:
+            rank_zero_warn(
+                "`nltk` punkt data is not available on disk; falling back to a regex sentence splitter for"
+                " ROUGE-Lsum. Scores may differ from the official rouge_score package on text with"
+                " abbreviations — download punkt (`nltk.download('punkt')`) for exact parity.",
+                UserWarning,
+            )
+    return [s for s in re.split(r"(?<=[.!?])\s+", x.strip()) if s]
+
+
+def _compute_metrics(hits_or_lcs: int, pred_len: int, target_len: int) -> Dict[str, float]:
+    """Precision/recall/F1 from a hit count (reference rouge.py:83-98)."""
+    precision = hits_or_lcs / pred_len
+    recall = hits_or_lcs / target_len
+    if precision == recall == 0.0:
+        return {"precision": 0.0, "recall": 0.0, "fmeasure": 0.0}
+    fmeasure = 2 * precision * recall / (precision + recall)
+    return {"precision": precision, "recall": recall, "fmeasure": fmeasure}
+
+
+def _lcs_table(pred_tokens: Sequence[str], target_tokens: Sequence[str]) -> np.ndarray:
+    """Full LCS DP table, vectorized row recurrence where possible."""
+    n, m = len(target_tokens), len(pred_tokens)
+    table = np.zeros((n + 1, m + 1), dtype=np.int64)
+    pred_arr = np.array(pred_tokens, dtype=object)
+    for i in range(1, n + 1):
+        match = pred_arr == target_tokens[i - 1]
+        row = table[i]
+        prev = table[i - 1]
+        # LCS row still has a strict left-to-right dependency through the max —
+        # keep the scalar inner loop but over numpy int64 (no tensor alloc churn).
+        for j in range(1, m + 1):
+            row[j] = prev[j - 1] + 1 if match[j - 1] else max(prev[j], row[j - 1])
+    return table
+
+
+def _lcs(pred_tokens: Sequence[str], target_tokens: Sequence[str]) -> int:
+    return int(_lcs_table(pred_tokens, target_tokens)[-1, -1])
+
+
+def _backtracked_lcs(
+    lcs_table: np.ndarray, pred_tokens: Sequence[str], target_tokens: Sequence[str]
+) -> Sequence[int]:
+    """Indices (into target) of one LCS, via table backtracking (rouge.py:122-144)."""
+    i = len(pred_tokens)
+    j = len(target_tokens)
+    backtracked: List[int] = []
+    while i > 0 and j > 0:
+        if pred_tokens[i - 1] == target_tokens[j - 1]:
+            backtracked.insert(0, j - 1)
+            i -= 1
+            j -= 1
+        elif lcs_table[j][i - 1] > lcs_table[j - 1][i]:
+            i -= 1
+        else:
+            j -= 1
+    return backtracked
+
+
+def _union_lcs(pred_tokens_list: Sequence[Sequence[str]], target_tokens: Sequence[str]) -> Sequence[str]:
+    """Union-LCS of a target sentence against all prediction sentences (rouge.py:147-169)."""
+
+    def lcs_ind(pred_tokens: Sequence[str]) -> Sequence[int]:
+        return _backtracked_lcs(_lcs_table(pred_tokens, target_tokens), pred_tokens, target_tokens)
+
+    indices = sorted(set().union(*(lcs_ind(pred_tokens) for pred_tokens in pred_tokens_list)))
+    return [target_tokens[i] for i in indices]
+
+
+def _normalize_and_tokenize_text(
+    text: str,
+    stemmer: Optional[Any] = None,
+    normalizer: Optional[Callable[[str], str]] = None,
+    tokenizer: Optional[Callable[[str], Sequence[str]]] = None,
+) -> Sequence[str]:
+    """Lowercase-alnum normalization + whitespace split + optional Porter stemming."""
+    text = normalizer(text) if callable(normalizer) else re.sub(r"[^a-z0-9]+", " ", text.lower())
+    tokens = tokenizer(text) if callable(tokenizer) else re.split(r"\s+", text)
+    if stemmer:
+        # Only stem words longer than 3 characters (rouge_score behavior).
+        tokens = [stemmer.stem(x) if len(x) > 3 else x for x in tokens]
+    return [x for x in tokens if (isinstance(x, str) and len(x) > 0)]
+
+
+def _rouge_n_score(pred: Sequence[str], target: Sequence[str], n_gram: int) -> Dict[str, float]:
+    """ROUGE-N from clipped n-gram hits (reference rouge.py:209-231)."""
+
+    def _create_ngrams(tokens: Sequence[str], n: int) -> Counter:
+        return Counter(tuple(tokens[i : i + n]) for i in range(len(tokens) - n + 1))
+
+    pred_ngrams, target_ngrams = _create_ngrams(pred, n_gram), _create_ngrams(target, n_gram)
+    pred_len, target_len = sum(pred_ngrams.values()), sum(target_ngrams.values())
+    if 0 in (pred_len, target_len):
+        return {"precision": 0.0, "recall": 0.0, "fmeasure": 0.0}
+
+    hits = sum((pred_ngrams & target_ngrams).values())
+    return _compute_metrics(hits, max(pred_len, 1), max(target_len, 1))
+
+
+def _rouge_l_score(pred: Sequence[str], target: Sequence[str]) -> Dict[str, float]:
+    """ROUGE-L from the LCS length (reference rouge.py:234-246)."""
+    pred_len, target_len = len(pred), len(target)
+    if 0 in (pred_len, target_len):
+        return {"precision": 0.0, "recall": 0.0, "fmeasure": 0.0}
+    lcs = _lcs(pred, target)
+    return _compute_metrics(lcs, pred_len, target_len)
+
+
+def _rouge_lsum_score(pred: Sequence[Sequence[str]], target: Sequence[Sequence[str]]) -> Dict[str, float]:
+    """ROUGE-LSum from union-LCS over sentence splits (reference rouge.py:249-286)."""
+    pred_len = sum(map(len, pred))
+    target_len = sum(map(len, target))
+    if 0 in (pred_len, target_len):
+        return {"precision": 0.0, "recall": 0.0, "fmeasure": 0.0}
+
+    def _get_token_counts(sentences: Sequence[Sequence[str]]) -> Counter:
+        ngrams: Counter = Counter()
+        for sentence in sentences:
+            ngrams.update(sentence)
+        return ngrams
+
+    pred_tokens_count = _get_token_counts(pred)
+    target_tokens_count = _get_token_counts(target)
+
+    hits = 0
+    for tgt in target:
+        lcs = _union_lcs(pred, tgt)
+        for token in lcs:
+            if pred_tokens_count[token] > 0 and target_tokens_count[token] > 0:
+                hits += 1
+                pred_tokens_count[token] -= 1
+                target_tokens_count[token] -= 1
+
+    return _compute_metrics(hits, pred_len, target_len)
+
+
+def _rouge_score_update(
+    preds: Sequence[str],
+    target: Sequence[Sequence[str]],
+    rouge_keys_values: List[Union[int, str]],
+    accumulate: str,
+    stemmer: Optional[Any] = None,
+    normalizer: Optional[Callable[[str], str]] = None,
+    tokenizer: Optional[Callable[[str], Sequence[str]]] = None,
+) -> Dict[Union[int, str], List[Dict[str, float]]]:
+    """Per-sample scores with multi-reference 'best'/'avg' accumulation (rouge.py:289-400)."""
+    results: Dict[Union[int, str], List[Dict[str, float]]] = {rouge_key: [] for rouge_key in rouge_keys_values}
+
+    for pred_raw, target_raw in zip(preds, target):
+        list_results: List[Dict[Union[int, str], Dict[str, float]]] = []
+        pred = _normalize_and_tokenize_text(pred_raw, stemmer, normalizer, tokenizer)
+        if "Lsum" in rouge_keys_values:
+            pred_lsum = [
+                _normalize_and_tokenize_text(pred_sentence, stemmer, normalizer, tokenizer)
+                for pred_sentence in _split_sentence(pred_raw)
+            ]
+
+        for target_raw_inner in target_raw:
+            tgt = _normalize_and_tokenize_text(target_raw_inner, stemmer, normalizer, tokenizer)
+            if "Lsum" in rouge_keys_values:
+                target_lsum = [
+                    _normalize_and_tokenize_text(tgt_sentence, stemmer, normalizer, tokenizer)
+                    for tgt_sentence in _split_sentence(target_raw_inner)
+                ]
+
+            result_inner: Dict[Union[int, str], Dict[str, float]] = {}
+            for rouge_key in rouge_keys_values:
+                if isinstance(rouge_key, int):
+                    score = _rouge_n_score(pred, tgt, rouge_key)
+                elif rouge_key == "L":
+                    score = _rouge_l_score(pred, tgt)
+                else:  # "Lsum"
+                    score = _rouge_lsum_score(pred_lsum, target_lsum)
+                result_inner[rouge_key] = score
+            list_results.append(result_inner)
+
+        if accumulate == "best":
+            key_curr = rouge_keys_values[0]
+            all_fmeasure = [v[key_curr]["fmeasure"] for v in list_results]
+            highest_idx = int(np.argmax(all_fmeasure))
+            for rouge_key in rouge_keys_values:
+                results[rouge_key].append(list_results[highest_idx][rouge_key])
+        elif accumulate == "avg":
+            for rouge_key in rouge_keys_values:
+                scores = [r[rouge_key] for r in list_results]
+                results[rouge_key].append(
+                    {tp: float(np.mean([s[tp] for s in scores])) for tp in ("precision", "recall", "fmeasure")}
+                )
+
+    return results
+
+
+def _rouge_score_compute(sentence_results: Dict[str, List[Array]]) -> Dict[str, Array]:
+    """Mean over per-sample scores (reference rouge.py:403-417)."""
+    return {rouge_key: jnp.mean(jnp.asarray(scores, jnp.float32)) for rouge_key, scores in sentence_results.items()}
+
+
+def rouge_score(
+    preds: Union[str, Sequence[str]],
+    target: Union[str, Sequence[str], Sequence[Sequence[str]]],
+    accumulate: str = "best",
+    use_stemmer: bool = False,
+    normalizer: Optional[Callable[[str], str]] = None,
+    tokenizer: Optional[Callable[[str], Sequence[str]]] = None,
+    rouge_keys: Union[str, Tuple[str, ...]] = ("rouge1", "rouge2", "rougeL", "rougeLsum"),
+) -> Dict[str, Array]:
+    """ROUGE score for automatic summarization (reference rouge.py:420-526).
+
+    Example:
+        >>> preds = "My name is John"
+        >>> target = "Is your name John"
+        >>> score = rouge_score(preds, target)
+        >>> round(float(score["rouge1_fmeasure"]), 4)
+        0.75
+    """
+    if use_stemmer and not _NLTK_AVAILABLE:
+        raise ModuleNotFoundError("Stemmer requires that `nltk` is installed. Use `pip install nltk`.")
+    stemmer = None
+    if use_stemmer:
+        import nltk
+
+        stemmer = nltk.stem.porter.PorterStemmer()
+
+    if not isinstance(rouge_keys, tuple):
+        rouge_keys = (rouge_keys,)
+    for key in rouge_keys:
+        if key not in ALLOWED_ROUGE_KEYS.keys():
+            raise ValueError(f"Got unknown rouge key {key}. Expected to be one of {list(ALLOWED_ROUGE_KEYS.keys())}")
+    rouge_keys_values = [ALLOWED_ROUGE_KEYS[key] for key in rouge_keys]
+
+    if isinstance(target, list) and all(isinstance(tgt, str) for tgt in target):
+        target = [target] if isinstance(preds, str) else [[tgt] for tgt in target]
+    if isinstance(preds, str):
+        preds = [preds]
+    if isinstance(target, str):
+        target = [[target]]
+
+    sentence_results = _rouge_score_update(
+        preds, target, rouge_keys_values, accumulate=accumulate,
+        stemmer=stemmer, normalizer=normalizer, tokenizer=tokenizer,
+    )
+
+    output: Dict[str, List[float]] = {}
+    for rouge_key, metrics in sentence_results.items():
+        for tp in ("fmeasure", "precision", "recall"):
+            output[f"rouge{rouge_key}_{tp}"] = [metric[tp] for metric in metrics]
+
+    return _rouge_score_compute(output)

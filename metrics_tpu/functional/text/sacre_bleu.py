@@ -1,0 +1,181 @@
+"""SacreBLEU score (reference src/torchmetrics/functional/text/sacre_bleu.py).
+
+Implements the five sacrebleu tokenization schemes ('none', '13a', 'zh', 'intl',
+'char') following the published sacrebleu tokenizer specifications
+(github.com/mjpost/sacrebleu/tree/master/sacrebleu/tokenizers), then reuses the BLEU
+accumulation kernel.
+"""
+
+from __future__ import annotations
+
+import re
+from functools import partial
+from typing import Optional, Sequence, Tuple, Union
+
+from jax import Array
+import jax.numpy as jnp
+
+from metrics_tpu.functional.text.bleu import _bleu_score_compute, _bleu_score_update
+from metrics_tpu.utils.imports import _REGEX_AVAILABLE
+
+AVAILABLE_TOKENIZERS = ("none", "13a", "zh", "intl", "char")
+
+# CJK / fullwidth unicode block boundaries used by the sacrebleu `zh` tokenizer
+_UCODE_RANGES = (
+    ("\u3400", "\u4db5"),  # CJK Unified Ideographs Extension A
+    ("\u4e00", "\u9fa5"),  # CJK Unified Ideographs
+    ("\u9fa6", "\u9fbb"),  # CJK Unified Ideographs, release 4.1
+    ("\uf900", "\ufa2d"),  # CJK Compatibility Ideographs
+    ("\ufa30", "\ufa6a"),  # CJK Compatibility Ideographs, release 3.2
+    ("\ufa70", "\ufad9"),  # CJK Compatibility Ideographs, release 4.1
+    ("\U00020000", "\U0002a6d6"),  # CJK Unified Ideographs Extension B
+    ("\U0002f800", "\U0002fa1d"),  # CJK Compatibility Supplement
+    ("\uff00", "\uffef"),  # full-width ASCII/punctuation, half-width kana, Hangul
+    ("\u2e80", "\u2eff"),  # CJK Radicals Supplement
+    ("\u3000", "\u303f"),  # CJK punctuation
+    ("\u31c0", "\u31ef"),  # CJK strokes
+    ("\u2f00", "\u2fdf"),  # Kangxi radicals
+    ("\u2ff0", "\u2fff"),  # Chinese character structure
+    ("\u3100", "\u312f"),  # phonetic symbols
+    ("\u31a0", "\u31bf"),  # phonetic symbols (Taiwanese/Hakka)
+    ("\ufe10", "\ufe1f"),
+    ("\ufe30", "\ufe4f"),
+    ("\u2600", "\u26ff"),
+    ("\u2700", "\u27bf"),
+    ("\u3200", "\u32ff"),
+    ("\u3300", "\u33ff"),
+)
+
+
+class _SacreBLEUTokenizer:
+    """Tokenizers matching sacrebleu (reference sacre_bleu.py:80-273)."""
+
+    _REGEX = (
+        (re.compile(r"([\{-\~\[-\` -\&\(-\+\:-\@\/])"), r" \1 "),
+        (re.compile(r"([^0-9])([\.,])"), r"\1 \2 "),
+        (re.compile(r"([\.,])([^0-9])"), r" \1 \2"),
+        (re.compile(r"([0-9])(-)"), r"\1 \2 "),
+    )
+
+    if _REGEX_AVAILABLE:
+        import regex
+
+        _INT_REGEX = (
+            (regex.compile(r"(\P{N})(\p{P})"), r"\1 \2 "),
+            (regex.compile(r"(\p{P})(\P{N})"), r" \1 \2"),
+            (regex.compile(r"(\p{S})"), r" \1 "),
+        )
+
+    _TOKENIZE_FN = {
+        "none": "_tokenize_base",
+        "13a": "_tokenize_13a",
+        "zh": "_tokenize_zh",
+        "intl": "_tokenize_international",
+        "char": "_tokenize_char",
+    }
+
+    def __init__(self, tokenize: str, lowercase: bool = False) -> None:
+        self.tokenize_fn = getattr(self, self._TOKENIZE_FN[tokenize])
+        self.lowercase = lowercase
+
+    def __call__(self, line: str) -> Sequence[str]:
+        tokenized_line = self.tokenize_fn(line)
+        return self._lower(tokenized_line, self.lowercase).split()
+
+    @classmethod
+    def tokenize(cls, line: str, tokenize: str, lowercase: bool = False) -> Sequence[str]:
+        tokenize_fn = getattr(cls, cls._TOKENIZE_FN[tokenize])
+        tokenized_line = tokenize_fn(line)
+        return cls._lower(tokenized_line, lowercase).split()
+
+    @classmethod
+    def _tokenize_regex(cls, line: str) -> str:
+        for _re, repl in cls._REGEX:
+            line = _re.sub(repl, line)
+        return " ".join(line.split())
+
+    @staticmethod
+    def _is_chinese_char(uchar: str) -> bool:
+        return any(start <= uchar <= end for start, end in _UCODE_RANGES)
+
+    @classmethod
+    def _tokenize_base(cls, line: str) -> str:
+        return line
+
+    @classmethod
+    def _tokenize_13a(cls, line: str) -> str:
+        """mteval-v13a-equivalent minimal tokenization (WMT standard)."""
+        line = line.replace("<skipped>", "")
+        line = line.replace("-\n", "")
+        line = line.replace("\n", " ")
+
+        if "&" in line:
+            line = line.replace("&quot;", '"')
+            line = line.replace("&amp;", "&")
+            line = line.replace("&lt;", "<")
+            line = line.replace("&gt;", ">")
+
+        return cls._tokenize_regex(line)
+
+    @classmethod
+    def _tokenize_zh(cls, line: str) -> str:
+        """Space-separate CJK chars, then apply the 13a regex pass."""
+        line = line.strip()
+        line_in_chars = ""
+        for char in line:
+            if cls._is_chinese_char(char):
+                line_in_chars += " " + char + " "
+            else:
+                line_in_chars += char
+        return cls._tokenize_regex(line_in_chars)
+
+    @classmethod
+    def _tokenize_international(cls, line: str) -> str:
+        """mteval-v14 international tokenization via unicode-category regexes."""
+        for _re, repl in cls._INT_REGEX:
+            line = _re.sub(repl, line)
+        return " ".join(line.split())
+
+    @classmethod
+    def _tokenize_char(cls, line: str) -> str:
+        return " ".join(char for char in line)
+
+    @staticmethod
+    def _lower(line: str, lowercase: bool) -> str:
+        return line.lower() if lowercase else line
+
+
+def sacre_bleu_score(
+    preds: Sequence[str],
+    target: Sequence[Sequence[str]],
+    n_gram: int = 4,
+    smooth: bool = False,
+    tokenize: str = "13a",
+    lowercase: bool = False,
+    weights: Optional[Sequence[float]] = None,
+) -> Array:
+    """SacreBLEU-compatible BLEU score (reference sacre_bleu.py:276-361).
+
+    Example:
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
+        >>> float(sacre_bleu_score(preds, target))  # doctest: +ELLIPSIS
+        0.7598...
+    """
+    if tokenize not in AVAILABLE_TOKENIZERS:
+        raise ValueError(f"Argument `tokenize` expected to be one of {AVAILABLE_TOKENIZERS} but got {tokenize}.")
+    if len(preds) != len(target):
+        raise ValueError(f"Corpus has different size {len(preds)} != {len(target)}")
+    if tokenize == "intl" and not _REGEX_AVAILABLE:
+        raise ModuleNotFoundError("`'intl'` tokenization requires that `regex` is installed.")
+    if weights is not None and len(weights) != n_gram:
+        raise ValueError(f"List of weights has different weights than `n_gram`: {len(weights)} != {n_gram}")
+    if weights is None:
+        weights = [1.0 / n_gram] * n_gram
+
+    tokenize_fn = partial(_SacreBLEUTokenizer.tokenize, tokenize=tokenize, lowercase=lowercase)
+    numerator, denominator, preds_len, target_len = _bleu_score_update(preds, target, n_gram, tokenize_fn)
+    return _bleu_score_compute(
+        jnp.asarray(preds_len), jnp.asarray(target_len), jnp.asarray(numerator), jnp.asarray(denominator),
+        n_gram, weights, smooth,
+    )

@@ -1,0 +1,26 @@
+"""Word information lost (reference src/torchmetrics/functional/text/wil.py)."""
+
+from __future__ import annotations
+
+from typing import List, Union
+
+from jax import Array
+
+from metrics_tpu.functional.text.wip import _wip_update as _wil_update  # same statistics (wil.py:23-56)
+
+
+def _wil_compute(errors: Array, target_total: Array, preds_total: Array) -> Array:
+    return 1 - ((errors / target_total) * (errors / preds_total))
+
+
+def word_information_lost(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """Word information lost of transcriptions vs references (reference wil.py:59-93).
+
+    Example:
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> word_information_lost(preds, target)  # doctest: +SKIP
+        Array(0.6527778, dtype=float32)
+    """
+    errors, target_total, preds_total = _wil_update(preds, target)
+    return _wil_compute(errors, target_total, preds_total)

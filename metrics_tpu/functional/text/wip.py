@@ -1,0 +1,54 @@
+"""Word information preserved (reference src/torchmetrics/functional/text/wip.py)."""
+
+from __future__ import annotations
+
+from typing import List, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.text.helper import _edit_distance
+
+
+def _wip_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Tuple[Array, Array, Array]:
+    """Accumulate (edit_distance - max_len) = -hits, ref and pred word totals.
+
+    Reference wip.py:22-55; same negated-hit-count trick as WIL — see _wil_update.
+    """
+    if isinstance(preds, str):
+        preds = [preds]
+    if isinstance(target, str):
+        target = [target]
+    errors = 0
+    total = 0
+    target_total = 0
+    preds_total = 0
+    for pred, tgt in zip(preds, target):
+        pred_tokens = pred.split()
+        tgt_tokens = tgt.split()
+        errors += _edit_distance(pred_tokens, tgt_tokens)
+        target_total += len(tgt_tokens)
+        preds_total += len(pred_tokens)
+        total += max(len(tgt_tokens), len(pred_tokens))
+    return (
+        jnp.asarray(errors - total, jnp.float32),
+        jnp.asarray(target_total, jnp.float32),
+        jnp.asarray(preds_total, jnp.float32),
+    )
+
+
+def _wip_compute(errors: Array, target_total: Array, preds_total: Array) -> Array:
+    return (errors / target_total) * (errors / preds_total)
+
+
+def word_information_preserved(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Array:
+    """Word information preserved of transcriptions vs references (reference wip.py:58-92).
+
+    Example:
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> word_information_preserved(preds, target)  # doctest: +SKIP
+        Array(0.3472222, dtype=float32)
+    """
+    errors, target_total, preds_total = _wip_update(preds, target)
+    return _wip_compute(errors, target_total, preds_total)

@@ -1,0 +1,64 @@
+"""BLEU module metric (reference src/torchmetrics/text/bleu.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.text.bleu import _bleu_score_compute, _bleu_score_update, _tokenize_fn
+from metrics_tpu.metric import Metric
+
+
+class BLEUScore(Metric):
+    """BLEU score over a streaming corpus (reference text/bleu.py:28-107).
+
+    States are four psum-able arrays: per-order clipped-match numerators and
+    denominators plus corpus length counters.
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = True
+
+    def __init__(
+        self,
+        n_gram: int = 4,
+        smooth: bool = False,
+        weights: Optional[Sequence[float]] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.n_gram = n_gram
+        self.smooth = smooth
+        if weights is not None and len(weights) != n_gram:
+            raise ValueError(f"List of weights has different weights than `n_gram`: {len(weights)} != {n_gram}")
+        self.weights = weights if weights is not None else [1.0 / n_gram] * n_gram
+
+        self.add_state("preds_len", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("target_len", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("numerator", jnp.zeros(self.n_gram), dist_reduce_fx="sum")
+        self.add_state("denominator", jnp.zeros(self.n_gram), dist_reduce_fx="sum")
+
+    def update(self, preds: Sequence[str], target: Sequence[Sequence[str]]) -> None:
+        preds_ = [preds] if isinstance(preds, str) else preds
+        target_ = [[tgt] if isinstance(tgt, str) else tgt for tgt in target]
+        if len(preds_) != len(target_):
+            raise ValueError(f"Corpus has different size {len(preds_)} != {len(target_)}")
+        numerator, denominator, preds_len, target_len = _bleu_score_update(
+            preds_, target_, self.n_gram, self._tokenizer
+        )
+        self.preds_len = self.preds_len + preds_len
+        self.target_len = self.target_len + target_len
+        self.numerator = self.numerator + numerator
+        self.denominator = self.denominator + denominator
+
+    def compute(self) -> Array:
+        return _bleu_score_compute(
+            self.preds_len, self.target_len, self.numerator, self.denominator, self.n_gram, self.weights, self.smooth
+        )
+
+    @property
+    def _tokenizer(self):
+        return _tokenize_fn

@@ -1,0 +1,32 @@
+"""CharErrorRate module metric (reference src/torchmetrics/text/cer.py)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.text.cer import _cer_compute, _cer_update
+from metrics_tpu.metric import Metric
+
+
+class CharErrorRate(Metric):
+    """Character error rate over a streaming corpus (reference text/cer.py:24-95)."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("errors", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Union[str, List[str]], target: Union[str, List[str]]) -> None:
+        errors, total = _cer_update(preds, target)
+        self.errors = self.errors + errors
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        return _cer_compute(self.errors, self.total)

@@ -1,0 +1,94 @@
+"""CHRFScore module metric (reference src/torchmetrics/text/chrf.py).
+
+State redesign (SURVEY §7.1): the reference registers 4+2 scalar states per n-gram
+order (text/chrf.py:119-130); here each statistic family is a single fixed-shape
+vector state, psum-able in one collective.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.text.chrf import _chrf_score_compute, _chrf_score_update
+from metrics_tpu.metric import Metric
+
+
+class CHRFScore(Metric):
+    """chrF/chrF++ score over a streaming corpus (reference text/chrf.py:46-186)."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = True
+
+    def __init__(
+        self,
+        n_char_order: int = 6,
+        n_word_order: int = 2,
+        beta: float = 2.0,
+        lowercase: bool = False,
+        whitespace: bool = False,
+        return_sentence_level_score: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(n_char_order, int) or n_char_order < 1:
+            raise ValueError("Expected argument `n_char_order` to be an integer greater than or equal to 1.")
+        if not isinstance(n_word_order, int) or n_word_order < 0:
+            raise ValueError("Expected argument `n_word_order` to be an integer greater than or equal to 0.")
+        if beta < 0:
+            raise ValueError("Expected argument `beta` to be greater than 0.")
+        self.n_char_order = n_char_order
+        self.n_word_order = n_word_order
+        self.beta = beta
+        self.lowercase = lowercase
+        self.whitespace = whitespace
+        self.return_sentence_level_score = return_sentence_level_score
+        self.n_order = float(n_char_order + n_word_order)
+
+        self.add_state("total_preds_char_n_grams", jnp.zeros(n_char_order), dist_reduce_fx="sum")
+        self.add_state("total_preds_word_n_grams", jnp.zeros(n_word_order), dist_reduce_fx="sum")
+        self.add_state("total_target_char_n_grams", jnp.zeros(n_char_order), dist_reduce_fx="sum")
+        self.add_state("total_target_word_n_grams", jnp.zeros(n_word_order), dist_reduce_fx="sum")
+        self.add_state("total_matching_char_n_grams", jnp.zeros(n_char_order), dist_reduce_fx="sum")
+        self.add_state("total_matching_word_n_grams", jnp.zeros(n_word_order), dist_reduce_fx="sum")
+        if self.return_sentence_level_score:
+            self.add_state("sentence_chrf_score", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Union[str, Sequence[str]], target: Union[Sequence[str], Sequence[Sequence[str]]]) -> None:
+        (
+            preds_char,
+            preds_word,
+            target_char,
+            target_word,
+            matching_char,
+            matching_word,
+            sentence_scores,
+        ) = _chrf_score_update(
+            preds, target, self.n_char_order, self.n_word_order, self.beta, self.lowercase, self.whitespace
+        )
+        self.total_preds_char_n_grams = self.total_preds_char_n_grams + preds_char
+        self.total_preds_word_n_grams = self.total_preds_word_n_grams + preds_word
+        self.total_target_char_n_grams = self.total_target_char_n_grams + target_char
+        self.total_target_word_n_grams = self.total_target_word_n_grams + target_word
+        self.total_matching_char_n_grams = self.total_matching_char_n_grams + matching_char
+        self.total_matching_word_n_grams = self.total_matching_word_n_grams + matching_word
+        if self.return_sentence_level_score:
+            self.sentence_chrf_score.append(jnp.asarray(sentence_scores, jnp.float32))
+
+    def compute(self) -> Union[Array, Tuple[Array, Array]]:
+        score = _chrf_score_compute(
+            self.total_preds_char_n_grams,
+            self.total_preds_word_n_grams,
+            self.total_target_char_n_grams,
+            self.total_target_word_n_grams,
+            self.total_matching_char_n_grams,
+            self.total_matching_word_n_grams,
+            self.n_order,
+            self.beta,
+        )
+        if self.return_sentence_level_score:
+            return score, jnp.concatenate([jnp.atleast_1d(s) for s in self.sentence_chrf_score])
+        return score

@@ -1,0 +1,65 @@
+"""ExtendedEditDistance module metric (reference src/torchmetrics/text/eed.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.text.eed import _eed_compute, _eed_update
+from metrics_tpu.metric import Metric
+
+
+class ExtendedEditDistance(Metric):
+    """EED over a streaming corpus; sentence scores kept as a ragged "cat" state
+    (reference text/eed.py:24-123)."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(
+        self,
+        language: str = "en",
+        return_sentence_level_score: bool = False,
+        alpha: float = 2.0,
+        rho: float = 0.3,
+        deletion: float = 0.2,
+        insertion: float = 1.0,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if language not in ("en", "ja"):
+            raise ValueError(f"Expected argument `language` to either be `en` or `ja` but got {language}")
+        self.language = language
+        self.return_sentence_level_score = return_sentence_level_score
+        for param_name, param in zip(["alpha", "rho", "deletion", "insertion"], [alpha, rho, deletion, insertion]):
+            if not isinstance(param, float) or param < 0:
+                raise ValueError(f"Parameter `{param_name}` is expected to be a non-negative float.")
+        self.alpha = alpha
+        self.rho = rho
+        self.deletion = deletion
+        self.insertion = insertion
+
+        self.add_state("sentence_eed", [], dist_reduce_fx="cat")
+
+    def update(
+        self,
+        preds: Union[str, Sequence[str]],
+        target: Sequence[Union[str, Sequence[str]]],
+    ) -> None:
+        scores = _eed_update(preds, target, self.language, self.alpha, self.rho, self.deletion, self.insertion)
+        if scores:
+            self.sentence_eed.append(jnp.asarray(scores, jnp.float32))
+
+    def compute(self) -> Union[Array, Tuple[Array, Array]]:
+        if self.sentence_eed:
+            all_scores = jnp.concatenate([jnp.atleast_1d(s) for s in self.sentence_eed])
+            average = _eed_compute(all_scores)
+        else:
+            all_scores = jnp.zeros((0,), jnp.float32)
+            average = jnp.asarray(0.0, jnp.float32)
+        if self.return_sentence_level_score:
+            return average, all_scores
+        return average

@@ -1,0 +1,39 @@
+"""Perplexity module metric (reference src/torchmetrics/text/perplexity.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.text.perplexity import _perplexity_compute, _perplexity_update
+from metrics_tpu.metric import Metric
+
+
+class Perplexity(Metric):
+    """Perplexity of language-model token probabilities (reference text/perplexity.py:23-78).
+
+    Fully jittable update/compute — usable inside a pjit'ed eval step via the
+    functional ``update_state``/``compute_from`` API.
+    """
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(self, ignore_index: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if ignore_index is not None and not isinstance(ignore_index, int):
+            raise ValueError(f"Argument `ignore_index` expected to either be `None` or an `int` but got {ignore_index}")
+        self.ignore_index = ignore_index
+        self.add_state("total_log_probs", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
+        self.add_state("count", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        total_log_probs, count = _perplexity_update(preds, target, self.ignore_index)
+        self.total_log_probs = self.total_log_probs + total_log_probs
+        self.count = self.count + count
+
+    def compute(self) -> Array:
+        return _perplexity_compute(self.total_log_probs, self.count)

@@ -1,0 +1,33 @@
+"""SacreBLEU module metric (reference src/torchmetrics/text/sacre_bleu.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+from metrics_tpu.functional.text.sacre_bleu import AVAILABLE_TOKENIZERS, _SacreBLEUTokenizer
+from metrics_tpu.text.bleu import BLEUScore
+from metrics_tpu.utils.imports import _REGEX_AVAILABLE
+
+
+class SacreBLEUScore(BLEUScore):
+    """BLEU with sacrebleu tokenization (reference text/sacre_bleu.py:29-112)."""
+
+    def __init__(
+        self,
+        n_gram: int = 4,
+        smooth: bool = False,
+        tokenize: str = "13a",
+        lowercase: bool = False,
+        weights: Optional[Sequence[float]] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(n_gram=n_gram, smooth=smooth, weights=weights, **kwargs)
+        if tokenize not in AVAILABLE_TOKENIZERS:
+            raise ValueError(f"Argument `tokenize` expected to be one of {AVAILABLE_TOKENIZERS} but got {tokenize}.")
+        if tokenize == "intl" and not _REGEX_AVAILABLE:
+            raise ModuleNotFoundError("`'intl'` tokenization requires that `regex` is installed.")
+        self.tokenizer = _SacreBLEUTokenizer(tokenize, lowercase)
+
+    @property
+    def _tokenizer(self):
+        return self.tokenizer

@@ -1,0 +1,58 @@
+"""TranslationEditRate module metric (reference src/torchmetrics/text/ter.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.text.ter import _TercomTokenizer, _ter_compute, _ter_update
+from metrics_tpu.metric import Metric
+
+
+class TranslationEditRate(Metric):
+    """TER over a streaming corpus (reference text/ter.py:24-122)."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(
+        self,
+        normalize: bool = False,
+        no_punctuation: bool = False,
+        lowercase: bool = True,
+        asian_support: bool = False,
+        return_sentence_level_score: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(normalize, bool):
+            raise ValueError(f"Expected argument `normalize` to be of type boolean but got {normalize}.")
+        if not isinstance(no_punctuation, bool):
+            raise ValueError(f"Expected argument `no_punctuation` to be of type boolean but got {no_punctuation}.")
+        if not isinstance(lowercase, bool):
+            raise ValueError(f"Expected argument `lowercase` to be of type boolean but got {lowercase}.")
+        if not isinstance(asian_support, bool):
+            raise ValueError(f"Expected argument `asian_support` to be of type boolean but got {asian_support}.")
+        self.tokenizer = _TercomTokenizer(normalize, no_punctuation, lowercase, asian_support)
+        self.return_sentence_level_score = return_sentence_level_score
+
+        self.add_state("total_num_edits", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total_tgt_len", jnp.asarray(0.0), dist_reduce_fx="sum")
+        if self.return_sentence_level_score:
+            self.add_state("sentence_ter", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Union[str, Sequence[str]], target: Sequence[Union[str, Sequence[str]]]) -> None:
+        num_edits, tgt_length, sentence_ter = _ter_update(preds, target, self.tokenizer)
+        self.total_num_edits = self.total_num_edits + num_edits
+        self.total_tgt_len = self.total_tgt_len + tgt_length
+        if self.return_sentence_level_score and sentence_ter:
+            self.sentence_ter.append(jnp.asarray(sentence_ter, jnp.float32))
+
+    def compute(self) -> Union[Array, Tuple[Array, Array]]:
+        score = _ter_compute(self.total_num_edits, self.total_tgt_len)
+        if self.return_sentence_level_score:
+            return score, jnp.concatenate([jnp.atleast_1d(s) for s in self.sentence_ter])
+        return score

@@ -1,0 +1,32 @@
+"""WordErrorRate module metric (reference src/torchmetrics/text/wer.py)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.text.wer import _wer_compute, _wer_update
+from metrics_tpu.metric import Metric
+
+
+class WordErrorRate(Metric):
+    """Word error rate over a streaming corpus (reference text/wer.py:23-92)."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("errors", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Union[str, List[str]], target: Union[str, List[str]]) -> None:
+        errors, total = _wer_update(preds, target)
+        self.errors = self.errors + errors
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        return _wer_compute(self.errors, self.total)

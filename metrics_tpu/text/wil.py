@@ -1,0 +1,34 @@
+"""WordInfoLost module metric (reference src/torchmetrics/text/wil.py)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.text.wil import _wil_compute, _wil_update
+from metrics_tpu.metric import Metric
+
+
+class WordInfoLost(Metric):
+    """Word information lost over a streaming corpus (reference text/wil.py:23-93)."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("errors", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("target_total", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("preds_total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Union[str, List[str]], target: Union[str, List[str]]) -> None:
+        errors, target_total, preds_total = _wil_update(preds, target)
+        self.errors = self.errors + errors
+        self.target_total = self.target_total + target_total
+        self.preds_total = self.preds_total + preds_total
+
+    def compute(self) -> Array:
+        return _wil_compute(self.errors, self.target_total, self.preds_total)

@@ -1,0 +1,34 @@
+"""WordInfoPreserved module metric (reference src/torchmetrics/text/wip.py)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.text.wip import _wip_compute, _wip_update
+from metrics_tpu.metric import Metric
+
+
+class WordInfoPreserved(Metric):
+    """Word information preserved over a streaming corpus (reference text/wip.py:23-93)."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("errors", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("target_total", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("preds_total", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Union[str, List[str]], target: Union[str, List[str]]) -> None:
+        errors, target_total, preds_total = _wip_update(preds, target)
+        self.errors = self.errors + errors
+        self.target_total = self.target_total + target_total
+        self.preds_total = self.preds_total + preds_total
+
+    def compute(self) -> Array:
+        return _wip_compute(self.errors, self.target_total, self.preds_total)

@@ -1,0 +1,125 @@
+"""BLEU / SacreBLEU / CHRF / TER tests against the `sacrebleu` package.
+
+Mirrors tests/unittests/text/test_{bleu,sacre_bleu,chrf,ter}.py: the reference
+implementation is the official sacrebleu package (available in this image).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from metrics_tpu.functional.text import bleu_score, chrf_score, sacre_bleu_score, translation_edit_rate
+from metrics_tpu.text import BLEUScore, CHRFScore, SacreBLEUScore, TranslationEditRate
+
+sacrebleu = pytest.importorskip("sacrebleu")
+from sacrebleu.metrics import BLEU as SBLEU, CHRF as SCHRF, TER as STER  # noqa: E402
+
+PREDS = [
+    "the cat is on the mat",
+    "hello there general kenobi",
+    "foo bar baz qux and more words here",
+    "Completely different sentence, with punctuation!",
+]
+TARGETS = [
+    ["there is a cat on the mat", "a cat is on the mat"],
+    ["hello there general kenobi", "hello there !"],
+    ["foo baz bar qux and some more", "foo bar qux baz now and then"],
+    ["A different sentence altogether.", "Something else entirely, truly."],
+]
+# sacrebleu wants transposed reference streams
+REF_STREAMS = [list(refs) for refs in zip(*TARGETS)]
+
+BATCH_SPLIT = 2  # first/second half for module accumulation tests
+
+
+@pytest.mark.parametrize("tokenize", ["13a", "char", "intl", "none"])
+@pytest.mark.parametrize("lowercase", [False, True])
+def test_sacre_bleu_vs_sacrebleu(tokenize, lowercase):
+    expected = SBLEU(tokenize=tokenize, lowercase=lowercase).corpus_score(PREDS, REF_STREAMS).score / 100
+    result = float(sacre_bleu_score(PREDS, TARGETS, tokenize=tokenize, lowercase=lowercase))
+    assert result == pytest.approx(expected, abs=1e-4)
+
+
+def test_sacre_bleu_smooth():
+    expected = SBLEU(smooth_method="add-k", smooth_value=1).corpus_score(PREDS, REF_STREAMS).score / 100
+    result = float(sacre_bleu_score(PREDS, TARGETS, smooth=True))
+    assert result == pytest.approx(expected, abs=1e-4)
+
+
+def test_bleu_known_value():
+    preds = ["the cat is on the mat"]
+    target = [["there is a cat on the mat", "a cat is on the mat"]]
+    assert float(bleu_score(preds, target)) == pytest.approx(0.7598, abs=1e-4)
+    assert float(bleu_score(["no overlap at all"], [["something else entirely"]])) == 0.0
+
+
+def test_bleu_module_accumulation():
+    metric = BLEUScore()
+    metric.update(PREDS[:BATCH_SPLIT], TARGETS[:BATCH_SPLIT])
+    metric.update(PREDS[BATCH_SPLIT:], TARGETS[BATCH_SPLIT:])
+    assert float(metric.compute()) == pytest.approx(float(bleu_score(PREDS, TARGETS)), abs=1e-6)
+
+
+def test_sacre_bleu_module_accumulation():
+    metric = SacreBLEUScore()
+    metric.update(PREDS[:BATCH_SPLIT], TARGETS[:BATCH_SPLIT])
+    metric.update(PREDS[BATCH_SPLIT:], TARGETS[BATCH_SPLIT:])
+    expected = SBLEU(tokenize="13a").corpus_score(PREDS, REF_STREAMS).score / 100
+    assert float(metric.compute()) == pytest.approx(expected, abs=1e-4)
+
+
+@pytest.mark.parametrize("n_word_order", [0, 2])
+@pytest.mark.parametrize("lowercase", [False, True])
+def test_chrf_vs_sacrebleu(n_word_order, lowercase):
+    expected = SCHRF(word_order=n_word_order, lowercase=lowercase, eps_smoothing=True).corpus_score(PREDS, REF_STREAMS).score / 100
+    result = float(chrf_score(PREDS, TARGETS, n_word_order=n_word_order, lowercase=lowercase))
+    assert result == pytest.approx(expected, abs=1e-4)
+
+
+def test_chrf_module_accumulation():
+    metric = CHRFScore()
+    metric.update(PREDS[:BATCH_SPLIT], TARGETS[:BATCH_SPLIT])
+    metric.update(PREDS[BATCH_SPLIT:], TARGETS[BATCH_SPLIT:])
+    expected = SCHRF(word_order=2, eps_smoothing=True).corpus_score(PREDS, REF_STREAMS).score / 100
+    assert float(metric.compute()) == pytest.approx(expected, abs=1e-4)
+
+
+def test_chrf_sentence_level_scores():
+    score, sentence_scores = chrf_score(PREDS, TARGETS, return_sentence_level_score=True)
+    assert sentence_scores.shape == (len(PREDS),)
+    assert all(0 <= float(s) <= 1 for s in sentence_scores)
+
+
+@pytest.mark.parametrize("normalize", [False, True])
+@pytest.mark.parametrize("lowercase", [True, False])
+def test_ter_vs_sacrebleu(normalize, lowercase):
+    expected = STER(normalized=normalize, case_sensitive=not lowercase).corpus_score(PREDS, REF_STREAMS).score / 100
+    result = float(translation_edit_rate(PREDS, TARGETS, normalize=normalize, lowercase=lowercase))
+    assert result == pytest.approx(expected, abs=1e-4)
+
+
+def test_ter_no_punct_vs_sacrebleu():
+    expected = STER(no_punct=True).corpus_score(PREDS, REF_STREAMS).score / 100
+    result = float(translation_edit_rate(PREDS, TARGETS, no_punctuation=True))
+    assert result == pytest.approx(expected, abs=1e-4)
+
+
+def test_ter_shift_heavy_sentences():
+    """Sentences engineered so the shift search actually fires."""
+    preds = ["b a c d e f", "the end at beginning stands"]
+    targets = [["a b c d e f"], ["at beginning the end stands"]]
+    ref_streams = [list(refs) for refs in zip(*targets)]
+    expected = STER().corpus_score(preds, ref_streams).score / 100
+    result = float(translation_edit_rate(preds, targets))
+    assert result == pytest.approx(expected, abs=1e-4)
+
+
+def test_ter_module_accumulation():
+    metric = TranslationEditRate(return_sentence_level_score=True)
+    metric.update(PREDS[:BATCH_SPLIT], TARGETS[:BATCH_SPLIT])
+    metric.update(PREDS[BATCH_SPLIT:], TARGETS[BATCH_SPLIT:])
+    score, sentence_scores = metric.compute()
+    expected = STER().corpus_score(PREDS, REF_STREAMS).score / 100
+    assert float(score) == pytest.approx(expected, abs=1e-4)
+    assert sentence_scores.shape == (len(PREDS),)

@@ -1,0 +1,126 @@
+"""CER/WER/MER/WIL/WIP tests against an independent DP reference implementation.
+
+Mirrors tests/unittests/text/test_{cer,wer,mer,wil,wip}.py — jiwer is not available
+in this image, so the reference is a plain-Python Wagner–Fischer DP written here
+(the textbook algorithm, independent of the vectorized implementation under test).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from metrics_tpu.functional.text import (
+    char_error_rate,
+    match_error_rate,
+    word_error_rate,
+    word_information_lost,
+    word_information_preserved,
+)
+from metrics_tpu.text import CharErrorRate, MatchErrorRate, WordErrorRate, WordInfoLost, WordInfoPreserved
+
+BATCHES = [
+    (
+        ["this is the prediction", "there is an other sample"],
+        ["this is the reference", "there is another one"],
+    ),
+    (
+        ["hello world", "a b c d", "exact match here"],
+        ["hello duck", "a b e d f", "exact match here"],
+    ),
+    (["", "nonempty"], ["something", "nonempty"]),
+]
+
+
+def _dp_edit(a, b):
+    """Textbook Wagner–Fischer, quadratic python loops (independent reference)."""
+    dp = list(range(len(b) + 1))
+    for i in range(1, len(a) + 1):
+        prev_diag, dp[0] = dp[0], i
+        for j in range(1, len(b) + 1):
+            cur = min(dp[j] + 1, dp[j - 1] + 1, prev_diag + (a[i - 1] != b[j - 1]))
+            prev_diag, dp[j] = dp[j], cur
+    return dp[-1]
+
+
+def _stats(preds, target, tokenize):
+    errors = total = max_total = p_total = 0
+    for p, t in zip(preds, target):
+        pt, tt = tokenize(p), tokenize(t)
+        errors += _dp_edit(pt, tt)
+        total += len(tt)
+        p_total += len(pt)
+        max_total += max(len(pt), len(tt))
+    return errors, total, p_total, max_total
+
+
+def _ref_wer(preds, target):
+    e, t, _, _ = _stats(preds, target, str.split)
+    return e / t
+
+
+def _ref_cer(preds, target):
+    e, t, _, _ = _stats(preds, target, list)
+    return e / t
+
+
+def _ref_mer(preds, target):
+    e, _, _, m = _stats(preds, target, str.split)
+    return e / m
+
+
+def _ref_wil(preds, target):
+    e, t, p, m = _stats(preds, target, str.split)
+    hits = m - e
+    return 1 - (hits / t) * (hits / p)
+
+
+def _ref_wip(preds, target):
+    e, t, p, m = _stats(preds, target, str.split)
+    hits = m - e
+    return (hits / t) * (hits / p)
+
+
+CASES = [
+    (word_error_rate, WordErrorRate, _ref_wer),
+    (char_error_rate, CharErrorRate, _ref_cer),
+    (match_error_rate, MatchErrorRate, _ref_mer),
+    (word_information_lost, WordInfoLost, _ref_wil),
+    (word_information_preserved, WordInfoPreserved, _ref_wip),
+]
+
+
+@pytest.mark.parametrize("functional, module_cls, reference", CASES)
+@pytest.mark.parametrize("preds, target", BATCHES)
+def test_functional_matches_reference(functional, module_cls, reference, preds, target):
+    assert float(functional(preds, target)) == pytest.approx(reference(preds, target), abs=1e-6)
+
+
+@pytest.mark.parametrize("functional, module_cls, reference", CASES)
+def test_module_accumulates_across_batches(functional, module_cls, reference):
+    metric = module_cls()
+    all_preds, all_target = [], []
+    for preds, target in BATCHES:
+        metric.update(preds, target)
+        all_preds += preds
+        all_target += target
+    assert float(metric.compute()) == pytest.approx(reference(all_preds, all_target), abs=1e-6)
+
+
+@pytest.mark.parametrize("functional, module_cls, reference", CASES)
+def test_module_accepts_single_string(functional, module_cls, reference):
+    metric = module_cls()
+    metric.update("hello world", "hello there world")
+    assert float(metric.compute()) == pytest.approx(reference(["hello world"], ["hello there world"]), abs=1e-6)
+
+
+def test_merge_states_associativity():
+    """Functional-state merge gives the same result as sequential accumulation."""
+    m = WordErrorRate()
+    s1 = m.update_state(m.init_state(), *BATCHES[0])
+    s2 = m.update_state(m.init_state(), *BATCHES[1])
+    merged = m.merge_states(s1, s2)
+    combined_preds = BATCHES[0][0] + BATCHES[1][0]
+    combined_target = BATCHES[0][1] + BATCHES[1][1]
+    expected = _ref_wer(combined_preds, combined_target)
+    assert float(m.compute_from(merged)) == pytest.approx(expected, abs=1e-6)

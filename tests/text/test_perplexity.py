@@ -1,0 +1,93 @@
+"""Perplexity tests vs an independent numpy reference + sharded functional path."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from metrics_tpu.functional.text import perplexity
+from metrics_tpu.text import Perplexity
+
+BATCH, SEQ, VOCAB = 4, 8, 10
+
+
+def _ref_perplexity(preds, target, ignore_index=None):
+    """Numpy reference: mean negative log prob of target tokens, exponentiated."""
+    preds = preds.reshape(-1, preds.shape[-1]).astype(np.float64)
+    target = target.reshape(-1)
+    probs = np.exp(preds) / np.exp(preds).sum(-1, keepdims=True)
+    if ignore_index is not None:
+        mask = target != ignore_index
+    else:
+        mask = np.ones_like(target, dtype=bool)
+    picked = probs[np.arange(len(target)), np.where(mask, target, 0)][mask]
+    return float(np.exp(-np.log(picked).mean()))
+
+
+@pytest.mark.parametrize("ignore_index", [None, -100])
+def test_perplexity_functional(ignore_index):
+    rng = np.random.RandomState(0)
+    preds = rng.randn(BATCH, SEQ, VOCAB).astype(np.float32)
+    target = rng.randint(VOCAB, size=(BATCH, SEQ))
+    if ignore_index is not None:
+        target[0, 5:] = ignore_index
+    result = perplexity(jnp.asarray(preds), jnp.asarray(target), ignore_index=ignore_index)
+    assert float(result) == pytest.approx(_ref_perplexity(preds, target, ignore_index), rel=1e-5)
+
+
+def test_perplexity_module_accumulation():
+    rng = np.random.RandomState(1)
+    preds = [rng.randn(BATCH, SEQ, VOCAB).astype(np.float32) for _ in range(3)]
+    target = [rng.randint(VOCAB, size=(BATCH, SEQ)) for _ in range(3)]
+    metric = Perplexity()
+    for p, t in zip(preds, target):
+        metric.update(jnp.asarray(p), jnp.asarray(t))
+    expected = _ref_perplexity(np.concatenate(preds), np.concatenate(target))
+    assert float(metric.compute()) == pytest.approx(expected, rel=1e-5)
+
+
+def test_perplexity_validation():
+    with pytest.raises(ValueError):
+        perplexity(jnp.zeros((2, 3)), jnp.zeros((2, 3), dtype=jnp.int32))
+    with pytest.raises(ValueError):
+        perplexity(jnp.zeros((2, 3, 4)), jnp.zeros((2, 4), dtype=jnp.int32))
+    with pytest.raises(TypeError):
+        perplexity(jnp.zeros((2, 3, 4)), jnp.zeros((2, 3), dtype=jnp.float32))
+
+
+def test_perplexity_sharded_functional_path():
+    """update_state/compute_from inside shard_map over the 8-device mesh."""
+    rng = np.random.RandomState(2)
+    num_devices = 8
+    preds = jnp.asarray(rng.randn(num_devices, BATCH, SEQ, VOCAB).astype(np.float32))
+    target = jnp.asarray(rng.randint(VOCAB, size=(num_devices, BATCH, SEQ)))
+    metric = Perplexity()
+    mesh = Mesh(np.array(jax.devices()[:num_devices]), ("dp",))
+
+    def step(p_shard, t_shard):
+        state = metric.init_state()
+        state = metric.update_state(state, p_shard[0], t_shard[0])
+        return metric.compute_from(state, axis_name="dp")
+
+    result = jax.jit(
+        jax.shard_map(step, mesh=mesh, in_specs=(P("dp"), P("dp")), out_specs=P())
+    )(preds, target)
+    expected = _ref_perplexity(np.asarray(preds).reshape(-1, SEQ, VOCAB), np.asarray(target).reshape(-1, SEQ))
+    assert float(result) == pytest.approx(expected, rel=1e-4)
+
+
+def test_perplexity_jit_compilable():
+    metric = Perplexity(ignore_index=-100)
+    rng = np.random.RandomState(3)
+    preds = jnp.asarray(rng.randn(BATCH, SEQ, VOCAB).astype(np.float32))
+    target = jnp.asarray(rng.randint(VOCAB, size=(BATCH, SEQ)))
+
+    @jax.jit
+    def step(state, p, t):
+        return metric.update_state(state, p, t)
+
+    state = step(metric.init_state(), preds, target)
+    assert float(metric.compute_from(state)) == pytest.approx(_ref_perplexity(np.asarray(preds), np.asarray(target)), rel=1e-5)

@@ -1,0 +1,222 @@
+"""ROUGE (vs rouge_score pkg), SQuAD (vs official-protocol reference), EED tests."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from metrics_tpu.functional.text import extended_edit_distance, rouge_score, squad
+from metrics_tpu.text import ExtendedEditDistance, ROUGEScore, SQuAD
+
+rouge_pkg = pytest.importorskip("rouge_score")
+from rouge_score.rouge_scorer import RougeScorer  # noqa: E402
+
+PREDS = [
+    "My name is John and I live here",
+    "the quick brown fox jumped over the lazy dog",
+    "a perfectly matching sentence",
+]
+TARGETS = [
+    "Is your name John or Jack",
+    "the fast brown fox leaped over a lazy dog",
+    "a perfectly matching sentence",
+]
+
+
+@pytest.mark.parametrize("use_stemmer", [False, True])
+@pytest.mark.parametrize("rouge_key", ["rouge1", "rouge2", "rougeL"])
+def test_rouge_vs_rouge_score_pkg(rouge_key, use_stemmer):
+    scorer = RougeScorer([rouge_key], use_stemmer=use_stemmer)
+    for pred, tgt in zip(PREDS, TARGETS):
+        expected = scorer.score(tgt, pred)[rouge_key]
+        result = rouge_score(pred, tgt, rouge_keys=(rouge_key,), use_stemmer=use_stemmer)
+        assert float(result[f"{rouge_key}_precision"]) == pytest.approx(expected.precision, abs=1e-6)
+        assert float(result[f"{rouge_key}_recall"]) == pytest.approx(expected.recall, abs=1e-6)
+        assert float(result[f"{rouge_key}_fmeasure"]) == pytest.approx(expected.fmeasure, abs=1e-6)
+
+
+def test_rouge_corpus_mean_vs_pkg():
+    scorer = RougeScorer(["rouge1", "rougeL"], use_stemmer=False)
+    expected1 = np.mean([scorer.score(t, p)["rouge1"].fmeasure for p, t in zip(PREDS, TARGETS)])
+    expectedL = np.mean([scorer.score(t, p)["rougeL"].fmeasure for p, t in zip(PREDS, TARGETS)])
+    result = rouge_score(PREDS, TARGETS, rouge_keys=("rouge1", "rougeL"))
+    assert float(result["rouge1_fmeasure"]) == pytest.approx(expected1, abs=1e-6)
+    assert float(result["rougeL_fmeasure"]) == pytest.approx(expectedL, abs=1e-6)
+
+
+def test_rouge_lsum_single_sentence_equals_rouge_l():
+    """For single-sentence inputs union-LCS degenerates to plain LCS."""
+    result = rouge_score(PREDS[0], TARGETS[0], rouge_keys=("rougeL", "rougeLsum"))
+    assert float(result["rougeLsum_fmeasure"]) == pytest.approx(float(result["rougeL_fmeasure"]), abs=1e-6)
+
+
+def test_rouge_multi_reference_best_and_avg():
+    preds = ["My name is John"]
+    targets = [["Is your name John", "My name is definitely John indeed"]]
+    best = rouge_score(preds, targets, accumulate="best", rouge_keys=("rouge1",))
+    avg = rouge_score(preds, targets, accumulate="avg", rouge_keys=("rouge1",))
+    scorer = RougeScorer(["rouge1"], use_stemmer=False)
+    per_ref = [scorer.score(t, preds[0])["rouge1"].fmeasure for t in targets[0]]
+    assert float(best["rouge1_fmeasure"]) == pytest.approx(max(per_ref), abs=1e-6)
+    assert float(avg["rouge1_fmeasure"]) == pytest.approx(np.mean(per_ref), abs=1e-6)
+
+
+def test_rouge_module_accumulation():
+    metric = ROUGEScore(rouge_keys=("rouge1", "rougeL"))
+    for pred, tgt in zip(PREDS, TARGETS):
+        metric.update(pred, tgt)
+    result = metric.compute()
+    functional = rouge_score(PREDS, TARGETS, rouge_keys=("rouge1", "rougeL"))
+    for key in result:
+        assert float(result[key]) == pytest.approx(float(functional[key]), abs=1e-6)
+
+
+# --------------------------------------------------------------------------- SQuAD
+
+
+def _ref_squad(preds, targets):
+    """Independent implementation of the official SQuAD v1.1 protocol."""
+    import collections
+    import re
+    import string
+
+    def norm(s):
+        s = s.lower()
+        s = "".join(ch for ch in s if ch not in set(string.punctuation))
+        s = re.sub(r"\b(a|an|the)\b", " ", s)
+        return " ".join(s.split())
+
+    def f1(p, t):
+        pt, tt = norm(p).split(), norm(t).split()
+        if len(pt) == 0 or len(tt) == 0:
+            return float(pt == tt)
+        common = collections.Counter(pt) & collections.Counter(tt)
+        ns = sum(common.values())
+        if ns == 0:
+            return 0.0
+        prec, rec = ns / len(pt), ns / len(tt)
+        return 2 * prec * rec / (prec + rec)
+
+    em_sum = f1_sum = 0.0
+    for p, t in zip(preds, targets):
+        answers = t["answers"]["text"]
+        em_sum += max(float(norm(p["prediction_text"]) == norm(a)) for a in answers)
+        f1_sum += max(f1(p["prediction_text"], a) for a in answers)
+    n = len(targets)
+    return {"exact_match": 100 * em_sum / n, "f1": 100 * f1_sum / n}
+
+
+SQUAD_PREDS = [
+    {"prediction_text": "1976", "id": "id1"},
+    {"prediction_text": "Santa Clara, California", "id": "id2"},
+    {"prediction_text": "the big apple", "id": "id3"},
+]
+SQUAD_TARGETS = [
+    {"answers": {"answer_start": [97], "text": ["1976"]}, "id": "id1"},
+    {"answers": {"answer_start": [403], "text": ["Santa Clara California", "Santa Clara"]}, "id": "id2"},
+    {"answers": {"answer_start": [0], "text": ["New York City"]}, "id": "id3"},
+]
+
+
+def test_squad_vs_reference_protocol():
+    expected = _ref_squad(SQUAD_PREDS, SQUAD_TARGETS)
+    result = squad(SQUAD_PREDS, SQUAD_TARGETS)
+    assert float(result["exact_match"]) == pytest.approx(expected["exact_match"], abs=1e-4)
+    assert float(result["f1"]) == pytest.approx(expected["f1"], abs=1e-4)
+
+
+def test_squad_module_accumulation():
+    metric = SQuAD()
+    for p, t in zip(SQUAD_PREDS, SQUAD_TARGETS):
+        metric.update([p], [t])
+    result = metric.compute()
+    expected = _ref_squad(SQUAD_PREDS, SQUAD_TARGETS)
+    assert float(result["f1"]) == pytest.approx(expected["f1"], abs=1e-4)
+
+
+def test_squad_input_validation():
+    with pytest.raises(KeyError):
+        squad([{"bad_key": "x", "id": "1"}], SQUAD_TARGETS[:1])
+    with pytest.raises(KeyError):
+        squad(SQUAD_PREDS[:1], [{"id": "1"}])
+
+
+# --------------------------------------------------------------------------- EED
+
+
+def _eed_ref_function(hyp, ref, alpha=2.0, rho=0.3, deletion=0.2, insertion=1.0):
+    """Direct transcription of the published EED recurrence (Stanchev et al. 2019) —
+    quadratic pure-python, independent of the vectorized implementation."""
+    from math import inf
+
+    number_of_visits = [-1] * (len(hyp) + 1)
+    row = [1.0] * (len(hyp) + 1)
+    row[0] = 0.0
+    for w in range(1, len(ref) + 1):
+        next_row = [inf] * (len(hyp) + 1)
+        for i in range(0, len(hyp) + 1):
+            if i > 0:
+                next_row[i] = min(
+                    next_row[i - 1] + deletion,
+                    row[i - 1] + float(hyp[i - 1] != ref[w - 1]),
+                    row[i] + insertion,
+                )
+            else:
+                next_row[i] = row[i] + 1.0
+        min_index = next_row.index(min(next_row))
+        number_of_visits[min_index] += 1
+        if ref[w - 1] == " ":
+            jump = alpha + next_row[min_index]
+            next_row = [min(x, jump) for x in next_row]
+        row = next_row
+    coverage = rho * sum(x if x >= 0 else 1 for x in number_of_visits)
+    return min(1, (row[-1] + coverage) / (float(len(ref)) + coverage))
+
+
+def test_eed_known_value():
+    preds = ["this is the prediction", "here is an other sample"]
+    target = ["this is the reference", "here is another one"]
+    assert float(extended_edit_distance(preds, target)) == pytest.approx(0.3078, abs=1e-4)
+
+
+def test_eed_vectorized_dp_vs_reference_recurrence():
+    """The fixpoint-relaxed DP must be bit-identical to the sequential recurrence —
+    including the argmin-tie-sensitive coverage term — even on adversarial random
+    strings full of exact FP ties."""
+    from metrics_tpu.functional.text.eed import _eed_function
+
+    rng = np.random.RandomState(7)
+    alphabet = list("abcd ")
+    for _ in range(50):
+        hyp = "".join(rng.choice(alphabet, size=rng.randint(0, 25)))
+        ref = "".join(rng.choice(alphabet, size=rng.randint(1, 25)))
+        assert _eed_function(hyp, ref) == pytest.approx(_eed_ref_function(hyp, ref), abs=1e-12)
+
+
+def test_eed_real_text_matches_reference_recurrence_exactly():
+    from metrics_tpu.functional.text.eed import _eed_function, _preprocess_en
+
+    pairs = [
+        ("this is a longer prediction sentence with several words", "this is a longer reference sentence with many words"),
+        ("completely different text", "nothing in common here at all"),
+        ("identical sentences match", "identical sentences match"),
+    ]
+    for hyp, ref in pairs:
+        hyp_p, ref_p = _preprocess_en(hyp), _preprocess_en(ref)
+        assert _eed_function(hyp_p, ref_p) == pytest.approx(_eed_ref_function(hyp_p, ref_p), abs=1e-12)
+
+
+def test_eed_module_accumulation_and_sentence_scores():
+    preds = ["this is the prediction", "here is an other sample"]
+    target = ["this is the reference", "here is another one"]
+    metric = ExtendedEditDistance(return_sentence_level_score=True)
+    metric.update(preds[:1], target[:1])
+    metric.update(preds[1:], target[1:])
+    avg, sentence = metric.compute()
+    assert float(avg) == pytest.approx(float(extended_edit_distance(preds, target)), abs=1e-6)
+    assert sentence.shape == (2,)
+
+
+def test_eed_ja_language():
+    score = extended_edit_distance(["アーロン", "エディー"], ["アーロン", "エディソン"], language="ja")
+    assert 0 <= float(score) <= 1
