@@ -1,0 +1,48 @@
+"""PermutationInvariantTraining module metric (reference src/torchmetrics/audio/pit.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.audio.pit import permutation_invariant_training
+from metrics_tpu.metric import Metric
+
+
+class PermutationInvariantTraining(Metric):
+    """Mean best-permutation metric over samples (reference audio/pit.py:23-95)."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(
+        self,
+        metric_func: Callable,
+        eval_func: str = "max",
+        **kwargs: Any,
+    ) -> None:
+        base_kwargs: dict = {
+            k: kwargs.pop(k)
+            for k in list(kwargs)
+            if k in ("compute_on_cpu", "dist_sync_on_step", "process_group", "dist_sync_fn",
+                     "distributed_available_fn", "sync_on_compute", "axis_name")
+        }
+        super().__init__(**base_kwargs)
+        if eval_func not in ("max", "min"):
+            raise ValueError(f'eval_func can only be "max" or "min" but got {eval_func}')
+        self.metric_func = metric_func
+        self.eval_func = eval_func
+        self.kwargs = kwargs  # remaining kwargs forwarded to metric_func (reference pit.py:78)
+        self.add_state("sum_pit_metric", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        pit_metric = permutation_invariant_training(preds, target, self.metric_func, self.eval_func, **self.kwargs)[0]
+        self.sum_pit_metric = self.sum_pit_metric + jnp.sum(pit_metric)
+        self.total = self.total + pit_metric.size
+
+    def compute(self) -> Array:
+        return self.sum_pit_metric / self.total

@@ -1,0 +1,70 @@
+"""SDR module metrics (reference src/torchmetrics/audio/sdr.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.audio.sdr import (
+    scale_invariant_signal_distortion_ratio,
+    signal_distortion_ratio,
+)
+from metrics_tpu.metric import Metric
+
+
+class SignalDistortionRatio(Metric):
+    """Mean SDR over samples (reference audio/sdr.py:24-112)."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(
+        self,
+        use_cg_iter: Optional[int] = None,
+        filter_length: int = 512,
+        zero_mean: bool = False,
+        load_diag: Optional[float] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.use_cg_iter = use_cg_iter
+        self.filter_length = filter_length
+        self.zero_mean = zero_mean
+        self.load_diag = load_diag
+        self.add_state("sum_sdr", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        sdr_batch = signal_distortion_ratio(
+            preds, target, self.use_cg_iter, self.filter_length, self.zero_mean, self.load_diag
+        )
+        self.sum_sdr = self.sum_sdr + jnp.sum(sdr_batch)
+        self.total = self.total + sdr_batch.size
+
+    def compute(self) -> Array:
+        return self.sum_sdr / self.total
+
+
+class ScaleInvariantSignalDistortionRatio(Metric):
+    """Mean SI-SDR over samples (reference audio/sdr.py:115-171); jittable update."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(self, zero_mean: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.zero_mean = zero_mean
+        self.add_state("sum_si_sdr", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        si_sdr_batch = scale_invariant_signal_distortion_ratio(preds=preds, target=target, zero_mean=self.zero_mean)
+        self.sum_si_sdr = self.sum_si_sdr + jnp.sum(si_sdr_batch)
+        self.total = self.total + si_sdr_batch.size
+
+    def compute(self) -> Array:
+        return self.sum_si_sdr / self.total

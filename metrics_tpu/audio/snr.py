@@ -1,0 +1,54 @@
+"""SNR module metrics (reference src/torchmetrics/audio/snr.py)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.audio.snr import scale_invariant_signal_noise_ratio, signal_noise_ratio
+from metrics_tpu.metric import Metric
+
+
+class SignalNoiseRatio(Metric):
+    """Mean SNR over samples (reference audio/snr.py:22-83); jittable update."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(self, zero_mean: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.zero_mean = zero_mean
+        self.add_state("sum_snr", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        snr_batch = signal_noise_ratio(preds=preds, target=target, zero_mean=self.zero_mean)
+        self.sum_snr = self.sum_snr + jnp.sum(snr_batch)
+        self.total = self.total + snr_batch.size
+
+    def compute(self) -> Array:
+        return self.sum_snr / self.total
+
+
+class ScaleInvariantSignalNoiseRatio(Metric):
+    """Mean SI-SNR over samples (reference audio/snr.py:86-138); jittable update."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_si_snr", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        si_snr_batch = scale_invariant_signal_noise_ratio(preds=preds, target=target)
+        self.sum_si_snr = self.sum_si_snr + jnp.sum(si_snr_batch)
+        self.total = self.total + si_snr_batch.size
+
+    def compute(self) -> Array:
+        return self.sum_si_snr / self.total

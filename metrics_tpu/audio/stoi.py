@@ -1,0 +1,40 @@
+"""STOI module metric (reference src/torchmetrics/audio/stoi.py)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.audio.stoi import short_time_objective_intelligibility
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.imports import _PYSTOI_AVAILABLE
+
+
+class ShortTimeObjectiveIntelligibility(Metric):
+    """Mean STOI over samples (reference audio/stoi.py:22-113); host-side backend."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(self, fs: int, extended: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not _PYSTOI_AVAILABLE:
+            raise ModuleNotFoundError(
+                "ShortTimeObjectiveIntelligibility metric requires that `pystoi` is installed. Either install as"
+                " `pip install torchmetrics[audio]` or `pip install pystoi`."
+            )
+        self.fs = fs
+        self.extended = extended
+        self.add_state("sum_stoi", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        stoi_batch = short_time_objective_intelligibility(preds, target, self.fs, self.extended).reshape(-1)
+        self.sum_stoi = self.sum_stoi + jnp.sum(stoi_batch)
+        self.total = self.total + stoi_batch.size
+
+    def compute(self) -> Array:
+        return self.sum_stoi / self.total

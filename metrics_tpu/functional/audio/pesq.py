@@ -1,0 +1,65 @@
+"""PESQ wrapper (reference src/torchmetrics/functional/audio/pesq.py).
+
+Wraps the external C-backed ``pesq`` package (host callback — the algorithm is a
+standardized ITU-T P.862 implementation, not a tensor kernel). Gated on package
+availability exactly like the reference (pesq.py:22-27).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.imports import _PESQ_AVAILABLE
+
+
+def perceptual_evaluation_speech_quality(
+    preds: Array,
+    target: Array,
+    fs: int,
+    mode: str,
+    keep_same_device: bool = False,
+) -> Array:
+    """PESQ score per sample (reference pesq.py:30-115); host-side computation.
+
+    Args:
+        preds: estimated signal ``(..., time)``
+        target: reference signal ``(..., time)``
+        fs: sampling frequency (8000 or 16000)
+        mode: ``'wb'`` (wide-band) or ``'nb'`` (narrow-band)
+        keep_same_device: return the score on the input device
+
+    Raises:
+        ModuleNotFoundError: if the ``pesq`` package is not installed.
+    """
+    if not _PESQ_AVAILABLE:
+        raise ModuleNotFoundError(
+            "PESQ metric requires that pesq is installed. Either install as `pip install torchmetrics[audio]`"
+            " or `pip install pesq`."
+        )
+    if fs not in (8000, 16000):
+        raise ValueError(f"Expected argument `fs` to either be 8000 or 16000 but got {fs}")
+    if mode not in ("wb", "nb"):
+        raise ValueError(f"Expected argument `mode` to either be 'wb' or 'nb' but got {mode}")
+    _check_same_shape(preds, target)
+
+    import pesq as pesq_backend
+
+    if preds.ndim == 1:
+        pesq_val_np = pesq_backend.pesq(fs, np.asarray(target), np.asarray(preds), mode)
+        pesq_val = jnp.asarray(pesq_val_np, jnp.float32)
+    else:
+        preds_np = np.asarray(preds).reshape(-1, preds.shape[-1])
+        target_np = np.asarray(target).reshape(-1, preds.shape[-1])
+        pesq_val_np = np.empty(preds_np.shape[0])
+        for b in range(preds_np.shape[0]):
+            pesq_val_np[b] = pesq_backend.pesq(fs, target_np[b, :], preds_np[b, :], mode)
+        pesq_val = jnp.asarray(pesq_val_np, jnp.float32).reshape(preds.shape[:-1])
+
+    if keep_same_device:
+        import jax
+
+        pesq_val = jax.device_put(pesq_val, next(iter(preds.devices())))
+    return pesq_val

@@ -1,0 +1,45 @@
+"""SNR / SI-SNR (reference src/torchmetrics/functional/audio/snr.py). Fully jittable."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.functional.audio.sdr import scale_invariant_signal_distortion_ratio
+
+
+def signal_noise_ratio(preds: Array, target: Array, zero_mean: bool = False) -> Array:
+    """Signal-to-noise ratio in dB, per sample over the trailing time axis
+    (reference snr.py:22-62).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> float(signal_noise_ratio(preds, target))  # doctest: +ELLIPSIS
+        16.180...
+    """
+    _check_same_shape(preds, target)
+    eps = jnp.finfo(preds.dtype).eps
+
+    if zero_mean:
+        target = target - jnp.mean(target, axis=-1, keepdims=True)
+        preds = preds - jnp.mean(preds, axis=-1, keepdims=True)
+
+    noise = target - preds
+    snr_value = (jnp.sum(target**2, axis=-1) + eps) / (jnp.sum(noise**2, axis=-1) + eps)
+    return 10 * jnp.log10(snr_value)
+
+
+def scale_invariant_signal_noise_ratio(preds: Array, target: Array) -> Array:
+    """SI-SNR: SI-SDR with zero-mean normalization (reference snr.py:65-87).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> float(scale_invariant_signal_noise_ratio(preds, target))  # doctest: +ELLIPSIS
+        15.091...
+    """
+    return scale_invariant_signal_distortion_ratio(preds=preds, target=target, zero_mean=True)

@@ -1,0 +1,60 @@
+"""STOI wrapper (reference src/torchmetrics/functional/audio/stoi.py).
+
+Wraps the external ``pystoi`` package (host callback). Gated on package
+availability exactly like the reference (stoi.py:22-26).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.imports import _PYSTOI_AVAILABLE
+
+
+def short_time_objective_intelligibility(
+    preds: Array,
+    target: Array,
+    fs: int,
+    extended: bool = False,
+    keep_same_device: bool = False,
+) -> Array:
+    """STOI score per sample (reference stoi.py:29-94); host-side computation.
+
+    Args:
+        preds: estimated signal ``(..., time)``
+        target: reference signal ``(..., time)``
+        fs: sampling frequency in Hz
+        extended: use the extended STOI variant
+        keep_same_device: return the score on the input device
+
+    Raises:
+        ModuleNotFoundError: if the ``pystoi`` package is not installed.
+    """
+    if not _PYSTOI_AVAILABLE:
+        raise ModuleNotFoundError(
+            "ShortTimeObjectiveIntelligibility metric requires that `pystoi` is installed. Either install as"
+            " `pip install torchmetrics[audio]` or `pip install pystoi`."
+        )
+    _check_same_shape(preds, target)
+
+    import pystoi
+
+    if preds.ndim == 1:
+        stoi_val_np = pystoi.stoi(np.asarray(target), np.asarray(preds), fs, extended)
+        stoi_val = jnp.asarray(stoi_val_np, jnp.float32)
+    else:
+        preds_np = np.asarray(preds).reshape(-1, preds.shape[-1])
+        target_np = np.asarray(target).reshape(-1, preds.shape[-1])
+        stoi_val_np = np.empty(preds_np.shape[0])
+        for b in range(preds_np.shape[0]):
+            stoi_val_np[b] = pystoi.stoi(target_np[b, :], preds_np[b, :], fs, extended)
+        stoi_val = jnp.asarray(stoi_val_np, jnp.float32).reshape(preds.shape[:-1])
+
+    if keep_same_device:
+        import jax
+
+        stoi_val = jax.device_put(stoi_val, next(iter(preds.devices())))
+    return stoi_val

@@ -1,0 +1,254 @@
+"""Audio metric tests vs independent numpy/scipy references.
+
+Mirrors tests/unittests/audio/test_{snr,sdr,pit}.py: SNR/SI-SNR against the
+closed-form formulas in float64 numpy; SDR against an independent
+scipy.linalg.toeplitz + solve implementation of the BSS-eval distortion filter;
+PIT against a brute-force permutation search.
+"""
+
+from __future__ import annotations
+
+from itertools import permutations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import scipy.linalg
+
+from metrics_tpu.audio import (
+    PermutationInvariantTraining,
+    ScaleInvariantSignalDistortionRatio,
+    ScaleInvariantSignalNoiseRatio,
+    SignalDistortionRatio,
+    SignalNoiseRatio,
+)
+from metrics_tpu.functional.audio import (
+    permutation_invariant_training,
+    pit_permutate,
+    scale_invariant_signal_distortion_ratio,
+    scale_invariant_signal_noise_ratio,
+    signal_distortion_ratio,
+    signal_noise_ratio,
+)
+
+BATCH, TIME = 4, 500
+
+
+def _ref_snr(preds, target, zero_mean=False):
+    preds, target = preds.astype(np.float64), target.astype(np.float64)
+    if zero_mean:
+        preds = preds - preds.mean(-1, keepdims=True)
+        target = target - target.mean(-1, keepdims=True)
+    noise = target - preds
+    return 10 * np.log10((target**2).sum(-1) / (noise**2).sum(-1))
+
+
+def _ref_si_sdr(preds, target, zero_mean=False):
+    preds, target = preds.astype(np.float64), target.astype(np.float64)
+    if zero_mean:
+        preds = preds - preds.mean(-1, keepdims=True)
+        target = target - target.mean(-1, keepdims=True)
+    alpha = (preds * target).sum(-1, keepdims=True) / (target**2).sum(-1, keepdims=True)
+    proj = alpha * target
+    noise = proj - preds
+    return 10 * np.log10((proj**2).sum(-1) / (noise**2).sum(-1))
+
+
+def _ref_sdr(preds, target, filter_length=512, zero_mean=False):
+    """Independent BSS-eval v4 style distortion-filter SDR via scipy toeplitz+solve."""
+    out = np.empty(preds.shape[:-1])
+    preds2 = preds.reshape(-1, preds.shape[-1]).astype(np.float64)
+    target2 = target.reshape(-1, target.shape[-1]).astype(np.float64)
+    flat = out.reshape(-1)
+    for i in range(preds2.shape[0]):
+        t = target2[i]
+        p = preds2[i]
+        if zero_mean:
+            t = t - t.mean()
+            p = p - p.mean()
+        t = t / max(np.linalg.norm(t), 1e-6)
+        p = p / max(np.linalg.norm(p), 1e-6)
+        n_fft = 2 ** int(np.ceil(np.log2(len(p) + len(t) - 1)))
+        tf = np.fft.rfft(t, n=n_fft)
+        r = np.fft.irfft(np.abs(tf) ** 2, n=n_fft)[:filter_length]
+        b = np.fft.irfft(np.conj(tf) * np.fft.rfft(p, n=n_fft), n=n_fft)[:filter_length]
+        sol = scipy.linalg.solve(scipy.linalg.toeplitz(r), b)
+        coh = float(b @ sol)
+        flat[i] = 10 * np.log10(coh / (1 - coh))
+    return out
+
+
+@pytest.mark.parametrize("zero_mean", [False, True])
+def test_snr_functional(zero_mean):
+    rng = np.random.RandomState(0)
+    target = rng.randn(BATCH, TIME).astype(np.float32)
+    preds = (target + 0.3 * rng.randn(BATCH, TIME)).astype(np.float32)
+    res = signal_noise_ratio(jnp.asarray(preds), jnp.asarray(target), zero_mean=zero_mean)
+    np.testing.assert_allclose(np.asarray(res), _ref_snr(preds, target, zero_mean), rtol=1e-4)
+
+
+def test_si_snr_functional():
+    rng = np.random.RandomState(1)
+    target = rng.randn(BATCH, TIME).astype(np.float32)
+    preds = (target + 0.3 * rng.randn(BATCH, TIME)).astype(np.float32)
+    res = scale_invariant_signal_noise_ratio(jnp.asarray(preds), jnp.asarray(target))
+    np.testing.assert_allclose(np.asarray(res), _ref_si_sdr(preds, target, zero_mean=True), rtol=1e-3)
+
+
+@pytest.mark.parametrize("zero_mean", [False, True])
+def test_si_sdr_functional(zero_mean):
+    rng = np.random.RandomState(2)
+    target = rng.randn(BATCH, TIME).astype(np.float32)
+    preds = (target + 0.3 * rng.randn(BATCH, TIME)).astype(np.float32)
+    res = scale_invariant_signal_distortion_ratio(jnp.asarray(preds), jnp.asarray(target), zero_mean=zero_mean)
+    np.testing.assert_allclose(np.asarray(res), _ref_si_sdr(preds, target, zero_mean), rtol=1e-3)
+
+
+@pytest.mark.parametrize("filter_length", [32, 128])
+def test_sdr_functional(filter_length):
+    rng = np.random.RandomState(3)
+    target = rng.randn(BATCH, TIME).astype(np.float32)
+    preds = (target + 0.1 * rng.randn(BATCH, TIME)).astype(np.float32)
+    res = signal_distortion_ratio(jnp.asarray(preds), jnp.asarray(target), filter_length=filter_length)
+    expected = _ref_sdr(preds, target, filter_length=filter_length)
+    # float32 Toeplitz solve vs float64 reference: allow a loose dB tolerance
+    np.testing.assert_allclose(np.asarray(res), expected, rtol=0.05, atol=0.1)
+
+
+def test_snr_known_value():
+    target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+    preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+    assert float(signal_noise_ratio(preds, target)) == pytest.approx(16.1805, abs=1e-3)
+    assert float(scale_invariant_signal_noise_ratio(preds, target)) == pytest.approx(15.0918, abs=1e-3)
+    assert float(scale_invariant_signal_distortion_ratio(preds, target)) == pytest.approx(18.4030, abs=1e-3)
+
+
+def _ref_pit(preds, target, metric, better="max"):
+    best_metrics, best_perms = [], []
+    spk = preds.shape[1]
+    for b in range(preds.shape[0]):
+        best, best_p = None, None
+        for perm in permutations(range(spk)):
+            val = float(np.mean([metric(preds[b, perm[t]], target[b, t]) for t in range(spk)]))
+            if best is None or (val > best if better == "max" else val < best):
+                best, best_p = val, perm
+        best_metrics.append(best)
+        best_perms.append(list(best_p))
+    return np.asarray(best_metrics), np.asarray(best_perms)
+
+
+@pytest.mark.parametrize("spk", [2, 3])
+@pytest.mark.parametrize("use_lsa", [False, True])
+def test_pit_vs_bruteforce(spk, use_lsa):
+    rng = np.random.RandomState(4)
+    target = rng.randn(3, spk, 100).astype(np.float32)
+    # shuffled noisy targets so the best permutation is nontrivial
+    perm_truth = rng.permutation(spk)
+    preds = (target[:, perm_truth] + 0.1 * rng.randn(3, spk, 100)).astype(np.float32)
+
+    best_metric, best_perm = permutation_invariant_training(
+        jnp.asarray(preds), jnp.asarray(target), scale_invariant_signal_distortion_ratio, "max",
+        use_linear_sum_assignment=use_lsa,
+    )
+    def np_si_sdr(p, t):
+        return _ref_si_sdr(p[None], t[None])[0]
+    exp_metric, exp_perm = _ref_pit(preds, target, np_si_sdr, "max")
+    np.testing.assert_allclose(np.asarray(best_metric), exp_metric, rtol=1e-3)
+    np.testing.assert_array_equal(np.asarray(best_perm), exp_perm)
+
+    # permutate inverts the shuffle
+    restored = pit_permutate(jnp.asarray(preds), best_perm)
+    assert np.asarray(restored).shape == preds.shape
+
+
+def test_pit_jittable():
+    rng = np.random.RandomState(5)
+    target = jnp.asarray(rng.randn(2, 2, 64).astype(np.float32))
+    preds = jnp.asarray(rng.randn(2, 2, 64).astype(np.float32))
+
+    @jax.jit
+    def run(p, t):
+        return permutation_invariant_training(p, t, scale_invariant_signal_distortion_ratio, "max")
+
+    best_metric, best_perm = run(preds, target)
+    ref_metric, _ = permutation_invariant_training(preds, target, scale_invariant_signal_distortion_ratio, "max")
+    np.testing.assert_allclose(np.asarray(best_metric), np.asarray(ref_metric), rtol=1e-5)
+
+
+def test_pit_validation_errors():
+    with pytest.raises(RuntimeError):
+        permutation_invariant_training(
+            jnp.zeros((2, 2, 10)), jnp.zeros((2, 3, 10)), scale_invariant_signal_distortion_ratio
+        )
+    with pytest.raises(ValueError):
+        permutation_invariant_training(
+            jnp.zeros((2, 2, 10)), jnp.zeros((2, 2, 10)), scale_invariant_signal_distortion_ratio, "bad"
+        )
+
+
+MODULE_CASES = [
+    (SignalNoiseRatio, signal_noise_ratio),
+    (ScaleInvariantSignalNoiseRatio, scale_invariant_signal_noise_ratio),
+    (ScaleInvariantSignalDistortionRatio, scale_invariant_signal_distortion_ratio),
+]
+
+
+@pytest.mark.parametrize("module_cls, functional", MODULE_CASES)
+def test_module_mean_accumulation(module_cls, functional):
+    rng = np.random.RandomState(6)
+    batches = [
+        (rng.randn(BATCH, TIME).astype(np.float32), rng.randn(BATCH, TIME).astype(np.float32)) for _ in range(3)
+    ]
+    metric = module_cls()
+    vals = []
+    for p, t in batches:
+        metric.update(jnp.asarray(p), jnp.asarray(t))
+        vals.append(np.asarray(functional(jnp.asarray(p), jnp.asarray(t))))
+    expected = np.concatenate(vals).mean()
+    assert float(metric.compute()) == pytest.approx(float(expected), rel=1e-5)
+
+
+def test_sdr_module():
+    rng = np.random.RandomState(7)
+    target = rng.randn(BATCH, TIME).astype(np.float32)
+    preds = (target + 0.1 * rng.randn(BATCH, TIME)).astype(np.float32)
+    metric = SignalDistortionRatio(filter_length=64)
+    metric.update(jnp.asarray(preds), jnp.asarray(target))
+    expected = _ref_sdr(preds, target, filter_length=64).mean()
+    assert float(metric.compute()) == pytest.approx(float(expected), rel=0.05, abs=0.1)
+
+
+def test_pit_module():
+    rng = np.random.RandomState(8)
+    target = rng.randn(2, 2, 100).astype(np.float32)
+    preds = (target[:, ::-1] + 0.1 * rng.randn(2, 2, 100)).astype(np.float32)
+    metric = PermutationInvariantTraining(scale_invariant_signal_distortion_ratio, "max")
+    metric.update(jnp.asarray(preds), jnp.asarray(target))
+    best_metric, _ = permutation_invariant_training(
+        jnp.asarray(preds), jnp.asarray(target), scale_invariant_signal_distortion_ratio, "max"
+    )
+    assert float(metric.compute()) == pytest.approx(float(jnp.mean(best_metric)), rel=1e-5)
+
+
+def test_snr_sharded_functional_path():
+    """SNR module functional API under shard_map with psum sync."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    rng = np.random.RandomState(9)
+    num_devices = 8
+    target = jnp.asarray(rng.randn(num_devices, BATCH, TIME).astype(np.float32))
+    preds = jnp.asarray(rng.randn(num_devices, BATCH, TIME).astype(np.float32))
+    metric = SignalNoiseRatio()
+    mesh = Mesh(np.array(jax.devices()[:num_devices]), ("dp",))
+
+    def step(p, t):
+        state = metric.init_state()
+        state = metric.update_state(state, p[0], t[0])
+        return metric.compute_from(state, axis_name="dp")
+
+    result = jax.jit(
+        jax.shard_map(step, mesh=mesh, in_specs=(P("dp"), P("dp")), out_specs=P())
+    )(preds, target)
+    expected = _ref_snr(np.asarray(preds).reshape(-1, TIME), np.asarray(target).reshape(-1, TIME)).mean()
+    assert float(result) == pytest.approx(float(expected), rel=1e-4)
