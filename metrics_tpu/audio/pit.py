@@ -8,7 +8,7 @@ import jax.numpy as jnp
 from jax import Array
 
 from metrics_tpu.functional.audio.pit import permutation_invariant_training
-from metrics_tpu.metric import Metric
+from metrics_tpu.metric import BASE_METRIC_KWARGS, Metric
 
 
 class PermutationInvariantTraining(Metric):
@@ -24,12 +24,7 @@ class PermutationInvariantTraining(Metric):
         eval_func: str = "max",
         **kwargs: Any,
     ) -> None:
-        base_kwargs: dict = {
-            k: kwargs.pop(k)
-            for k in list(kwargs)
-            if k in ("compute_on_cpu", "dist_sync_on_step", "process_group", "dist_sync_fn",
-                     "distributed_available_fn", "sync_on_compute", "axis_name")
-        }
+        base_kwargs: dict = {k: kwargs.pop(k) for k in list(kwargs) if k in BASE_METRIC_KWARGS}
         super().__init__(**base_kwargs)
         if eval_func not in ("max", "min"):
             raise ValueError(f'eval_func can only be "max" or "min" but got {eval_func}')

@@ -1,0 +1,5 @@
+"""Detection-domain module metrics (reference src/torchmetrics/detection/)."""
+
+from metrics_tpu.detection.mean_ap import MeanAveragePrecision
+
+__all__ = ["MeanAveragePrecision"]

@@ -11,7 +11,7 @@ opt-in host fallback for large speaker counts where spk! explodes.
 from __future__ import annotations
 
 from itertools import permutations
-from typing import Any, Callable, Tuple
+from typing import Any, Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -19,6 +19,7 @@ import numpy as np
 from jax import Array
 
 from metrics_tpu.utils.imports import _SCIPY_AVAILABLE
+from metrics_tpu.utils.prints import rank_zero_warn
 
 # cache of permutation tables keyed by speaker count (host-side constants)
 _ps_dict: dict = {}
@@ -69,7 +70,7 @@ def permutation_invariant_training(
     target: Array,
     metric_func: Callable,
     eval_func: str = "max",
-    use_linear_sum_assignment: bool = False,
+    use_linear_sum_assignment: Optional[bool] = None,
     **kwargs: Any,
 ) -> Tuple[Array, Array]:
     """PIT: best metric value over speaker permutations (reference pit.py:96-164).
@@ -79,8 +80,12 @@ def permutation_invariant_training(
         target: ``(batch, spk, ...)`` reference signals
         metric_func: batched pairwise metric ``(preds, target, **kwargs) -> (batch,)``
         eval_func: 'max' (higher is better) or 'min'
-        use_linear_sum_assignment: opt into the host-side scipy Hungarian solver
-            (useful when spk! is too large for the exhaustive table)
+        use_linear_sum_assignment: solver choice. ``None`` (default) follows the
+            reference's auto rule (pit.py:156-162): the host-side scipy Hungarian
+            solver for ``spk_num >= 3`` when available outside a trace, else the
+            vectorized exhaustive search. ``True`` forces the Hungarian solver
+            (errors if scipy is missing or inside jit); ``False`` forces the
+            exhaustive ``spk!`` search.
         kwargs: forwarded to ``metric_func``
 
     Example:
@@ -103,22 +108,47 @@ def permutation_invariant_training(
         raise ValueError(f"Inputs must be of shape [batch, spk, ...], got {target.shape} and {preds.shape} instead")
 
     spk_num = target.shape[1]
+    batch_size = target.shape[0]
+    idx = jnp.arange(spk_num)
 
     # metric matrix [batch, target_spk, pred_spk] via a double vmap over speaker axes —
-    # ONE traced metric_func instead of the reference's spk² eager calls
+    # ONE traced metric_func instead of the reference's spk² eager calls. Host-side
+    # metric funcs (e.g. the PESQ/STOI wrappers) cannot run under vmap, so fall back
+    # to the reference's eager pairwise loop for those.
     def pair_metric(t_idx: Array, p_idx: Array) -> Array:
         return metric_func(preds[:, p_idx, ...], target[:, t_idx, ...], **kwargs)
 
-    idx = jnp.arange(spk_num)
-    metric_mtx = jax.vmap(lambda t: jax.vmap(lambda p: pair_metric(t, p))(idx))(idx)
-    # [target_spk, pred_spk, batch] -> [batch, target_spk, pred_spk]
-    metric_mtx = jnp.moveaxis(metric_mtx, -1, 0)
+    try:
+        metric_mtx = jax.vmap(lambda t: jax.vmap(lambda p: pair_metric(t, p))(idx))(idx)
+        # [target_spk, pred_spk, batch] -> [batch, target_spk, pred_spk]
+        metric_mtx = jnp.moveaxis(metric_mtx, -1, 0)
+    except (jax.errors.TracerArrayConversionError, jax.errors.ConcretizationTypeError):
+        rows = [
+            jnp.stack([jnp.asarray(pair_metric(t, p)) for p in range(spk_num)], axis=-1)
+            for t in range(spk_num)
+        ]
+        metric_mtx = jnp.stack(rows, axis=-2).reshape(batch_size, spk_num, spk_num)
 
+    in_trace = isinstance(metric_mtx, jax.core.Tracer)
+    if use_linear_sum_assignment is None:
+        use_linear_sum_assignment = spk_num >= 3 and _SCIPY_AVAILABLE and not in_trace
+        if spk_num >= 3 and not use_linear_sum_assignment:
+            rank_zero_warn(
+                f"For {spk_num} speakers the exhaustive search enumerates {spk_num}! permutations; the scipy"
+                " Hungarian solver is faster but is unavailable"
+                + (" inside jit/vmap traces." if in_trace else " (scipy not installed)."),
+                UserWarning,
+            )
     if use_linear_sum_assignment:
         if not _SCIPY_AVAILABLE:
             raise ModuleNotFoundError(
                 "`use_linear_sum_assignment=True` requires that `scipy` is installed; the exhaustive"
                 f" fallback would enumerate {spk_num}! permutations."
+            )
+        if in_trace:
+            raise ValueError(
+                "`use_linear_sum_assignment=True` runs a host-side scipy solver and cannot be used inside"
+                " jit/shard_map traces; pass `use_linear_sum_assignment=False` there."
             )
         return _find_best_perm_by_linear_sum_assignment(metric_mtx, eval_func)
     return _find_best_perm_by_exhaustive_method(metric_mtx, eval_func)

@@ -90,9 +90,12 @@ def signal_distortion_ratio(
 
     coh = jnp.einsum("...l,...l->...", b, sol)
 
-    # in float32 a perfect reconstruction rounds coh to exactly 1, making the ratio
-    # inf and poisoning any running mean — clamp just below 1 (caps SDR at ~69 dB f32)
-    coh = jnp.minimum(coh, 1 - jnp.finfo(work_dtype).eps)
+    # Keep the result finite for degenerate inputs: a perfect reconstruction rounds
+    # coh to exactly 1 in f32 (ratio -> inf), and an all-zero (silent) target makes
+    # the Toeplitz system singular so solve() returns NaN. Clamp into (eps, 1-eps)
+    # — caps SDR at ~±69 dB f32 instead of poisoning any running mean.
+    eps = jnp.finfo(work_dtype).eps
+    coh = jnp.clip(jnp.nan_to_num(coh, nan=0.0), eps, 1 - eps)
     ratio = coh / (1 - coh)
     val = 10.0 * jnp.log10(ratio)
 

@@ -1,0 +1,5 @@
+"""Functional multimodal metrics (reference src/torchmetrics/functional/multimodal/)."""
+
+from metrics_tpu.functional.multimodal.clip_score import clip_score
+
+__all__ = ["clip_score"]

@@ -1,0 +1,92 @@
+"""CLIPScore (reference src/torchmetrics/functional/multimodal/clip_score.py).
+
+TPU-native: runs a **Flax** CLIP model (``FlaxCLIPModel``); feature extraction and
+the cosine-similarity scoring are jnp ops. A user-supplied (model, processor) pair
+is accepted so local/random-weight models work without network access.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.utils.imports import _TRANSFORMERS_AVAILABLE
+
+_DEFAULT_CLIP_MODEL = "openai/clip-vit-large-patch14"
+
+
+def _get_model_and_processor(model_name_or_path: str = _DEFAULT_CLIP_MODEL) -> Tuple[Any, Any]:
+    """Load a Flax CLIP model + processor (reference clip_score.py:71-86)."""
+    if not _TRANSFORMERS_AVAILABLE:
+        raise ModuleNotFoundError(
+            "`clip_score` metric requires `transformers` package be installed."
+            " Either install with `pip install transformers>=4.0` or `pip install torchmetrics[multimodal]`."
+        )
+    from transformers import CLIPProcessor, FlaxCLIPModel
+
+    model = FlaxCLIPModel.from_pretrained(model_name_or_path)
+    processor = CLIPProcessor.from_pretrained(model_name_or_path)
+    return model, processor
+
+
+def _clip_score_update(
+    images: Union[Array, List[Array]],
+    text: Union[str, List[str]],
+    model: Any,
+    processor: Any,
+) -> Tuple[Array, int]:
+    """Per-sample 100·cos(image emb, text emb) (reference clip_score.py:31-68)."""
+    if not isinstance(images, list):
+        if images.ndim == 3:
+            images = [images]
+        else:
+            images = list(images)
+    else:
+        images = list(images)
+
+    if not all(i.ndim == 3 for i in images):
+        raise ValueError("Expected all images to be 3d but found image that has either more or less")
+
+    if not isinstance(text, list):
+        text = [text]
+
+    if len(text) != len(images):
+        raise ValueError(
+            f"Expected the number of images and text examples to be the same but got {len(images)} and {len(text)}"
+        )
+
+    processed_input = processor(text=text, images=[np.asarray(i) for i in images], return_tensors="np", padding=True)
+
+    img_features = model.get_image_features(jnp.asarray(processed_input["pixel_values"]))
+    img_features = img_features / jnp.linalg.norm(img_features, axis=-1, keepdims=True)
+
+    txt_features = model.get_text_features(
+        jnp.asarray(processed_input["input_ids"]), jnp.asarray(processed_input["attention_mask"])
+    )
+    txt_features = txt_features / jnp.linalg.norm(txt_features, axis=-1, keepdims=True)
+
+    score = 100 * jnp.sum(img_features * txt_features, axis=-1)
+    return score, len(text)
+
+
+def clip_score(
+    images: Union[Array, List[Array]],
+    text: Union[str, List[str]],
+    model_name_or_path: str = _DEFAULT_CLIP_MODEL,
+    model: Optional[Any] = None,
+    processor: Optional[Any] = None,
+) -> Array:
+    """CLIPScore: max(100·cos(E_I, E_C), 0) averaged over samples
+    (reference clip_score.py:92-139). Pass ``model``/``processor`` directly to skip
+    the pretrained download.
+    """
+    if (model is None) != (processor is None):
+        raise ValueError("Arguments `model` and `processor` must be provided together (or both omitted).")
+    if model is None:
+        model, processor = _get_model_and_processor(model_name_or_path)
+    score, _ = _clip_score_update(images, text, model, processor)
+    score = score.mean(0)
+    return jnp.maximum(score, jnp.zeros_like(score))

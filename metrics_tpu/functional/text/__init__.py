@@ -1,5 +1,6 @@
 """Functional text metrics (reference src/torchmetrics/functional/text/)."""
 
+from metrics_tpu.functional.text.bert import bert_score
 from metrics_tpu.functional.text.bleu import bleu_score
 from metrics_tpu.functional.text.cer import char_error_rate
 from metrics_tpu.functional.text.chrf import chrf_score
@@ -15,6 +16,7 @@ from metrics_tpu.functional.text.wil import word_information_lost
 from metrics_tpu.functional.text.wip import word_information_preserved
 
 __all__ = [
+    "bert_score",
     "bleu_score",
     "char_error_rate",
     "chrf_score",

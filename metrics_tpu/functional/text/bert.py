@@ -1,0 +1,287 @@
+"""BERTScore (reference src/torchmetrics/functional/text/bert.py, 426 LoC).
+
+TPU-native redesign: embeddings come from a **Flax** HF transformer (or any
+user-supplied model via ``user_forward_fn``) and the whole scoring pipeline —
+normalization, special-token masking, IDF weighting, the pairwise cosine matching —
+is jittable jnp math over statically padded ``[batch, layers, seq, dim]`` arrays.
+The reference's DataLoader/TextDataset machinery (bert.py:386-401) collapses into a
+padded-batch loop.
+
+Note: the reference sorts each corpus by sentence length independently and returns
+scores in that sorted order (helper_embedding_metric.py:84-110 with
+``sort_according_length=True``, never unsorted) — a known quirk; here scores are
+returned in the ORIGINAL input order, matching the original bert-score package.
+"""
+
+from __future__ import annotations
+
+import csv
+import math
+from collections import Counter, defaultdict
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.utils.imports import _TRANSFORMERS_AVAILABLE
+from metrics_tpu.utils.prints import rank_zero_warn
+
+# Default model recommended in the original implementation.
+_DEFAULT_MODEL = "roberta-large"
+
+
+def _process_attention_mask_for_special_tokens(attention_mask: Array) -> Array:
+    """Zero the [CLS] and [SEP] positions (helper_embedding_metric.py:34-49)."""
+    attention_mask = attention_mask.at[:, 0].set(0)
+    sep_token_position = jnp.argmax(jnp.cumsum(attention_mask - 0.1, axis=-1), axis=-1)
+    attention_mask = attention_mask.at[jnp.arange(attention_mask.shape[0]), sep_token_position].set(0)
+    return attention_mask
+
+
+def _get_tokens_idf(input_ids: np.ndarray, attention_mask: np.ndarray) -> Dict[int, float]:
+    """IDF over the reference corpus: log((N+1)/(df+1)) (helper_embedding_metric.py:230-249)."""
+    num_sentences = len(input_ids)
+    token_counter: Counter = Counter()
+    for ids, mask in zip(input_ids, attention_mask):
+        token_counter.update(set(ids[mask.astype(bool)].tolist()))
+    tokens_idf: Dict[int, float] = defaultdict(lambda: math.log((num_sentences + 1) / 1))
+    tokens_idf.update(
+        {idx: math.log((num_sentences + 1) / (occurrence + 1)) for idx, occurrence in token_counter.items()}
+    )
+    return tokens_idf
+
+
+def _embed(
+    input_ids: np.ndarray,
+    attention_mask: np.ndarray,
+    model: Any,
+    num_layers: Optional[int],
+    all_layers: bool,
+    idf: bool,
+    tokens_idf: Optional[Dict[int, float]],
+    batch_size: int,
+    user_forward_fn: Optional[Callable],
+):
+    """Normalized masked embeddings [N, L, S, D] + per-sentence idf scale [N, S]."""
+    outs = []
+    for start in range(0, len(input_ids), batch_size):
+        ids = jnp.asarray(input_ids[start : start + batch_size])
+        mask = jnp.asarray(attention_mask[start : start + batch_size])
+        if user_forward_fn is not None:
+            if all_layers:
+                raise ValueError("The option `all_layers=True` can be used only with default `transformers` models.")
+            out = jnp.asarray(user_forward_fn(model, {"input_ids": ids, "attention_mask": mask}))
+            if out.shape[:2] != ids.shape:
+                raise ValueError(
+                    "The model output must be a [batch, seq_len, model_dim] tensor aligned with input_ids."
+                )
+            out = out[:, None]  # layer axis
+        else:
+            result = model(input_ids=ids, attention_mask=mask, output_hidden_states=True)
+            hidden = result.hidden_states
+            if all_layers:
+                out = jnp.stack(hidden, axis=1)
+            else:
+                out = jnp.asarray(hidden[num_layers if num_layers is not None else -1])[:, None]
+        outs.append(out)
+    out = jnp.concatenate(outs, axis=0)
+
+    # normalize and zero special/pad tokens
+    out = out / jnp.maximum(jnp.linalg.norm(out, axis=-1, keepdims=True), 1e-12)
+    processed_mask = _process_attention_mask_for_special_tokens(jnp.asarray(attention_mask))
+    out = jnp.einsum("blsd,bs->blsd", out, processed_mask.astype(out.dtype))
+
+    if idf:
+        assert tokens_idf is not None
+        idf_np = np.vectorize(lambda t: tokens_idf[int(t)])(input_ids).astype(np.float32)
+        input_ids_idf = jnp.asarray(idf_np) * processed_mask
+    else:
+        input_ids_idf = processed_mask.astype(out.dtype)
+    input_ids_idf = input_ids_idf / jnp.sum(input_ids_idf, axis=-1, keepdims=True)
+
+    return out, input_ids_idf
+
+
+def _get_precision_recall_f1(
+    preds_embeddings: Array,
+    target_embeddings: Array,
+    preds_idf_scale: Array,
+    target_idf_scale: Array,
+):
+    """Greedy cosine matching (reference bert.py:124-157); jittable."""
+    cos_sim = jnp.einsum("blpd,blrd->blpr", preds_embeddings, target_embeddings)
+    precision = jnp.einsum("bls,bs->bls", jnp.max(cos_sim, axis=3), preds_idf_scale).sum(-1)
+    recall = jnp.einsum("bls,bs->bls", jnp.max(cos_sim, axis=2), target_idf_scale).sum(-1)
+    f1_score = 2 * precision * recall / (precision + recall)
+    f1_score = jnp.nan_to_num(f1_score, nan=0.0)
+    # match original bert-score output layout: [layers, batch] squeezed
+    return (
+        jnp.squeeze(precision.swapaxes(0, 1)),
+        jnp.squeeze(recall.swapaxes(0, 1)),
+        jnp.squeeze(f1_score.swapaxes(0, 1)),
+    )
+
+
+def _load_baseline(baseline_path: Optional[str] = None) -> Optional[np.ndarray]:
+    """Load a local rescale-baseline csv/tsv (bert.py:166-213; no-network variant)."""
+    if baseline_path is None:
+        rank_zero_warn("Baseline was not successfully loaded. No baseline is going to be used.")
+        return None
+    with open(baseline_path) as fname:
+        rows = [[float(item) for item in row] for idx, row in enumerate(csv.reader(fname)) if idx > 0]
+    return np.asarray(rows)[:, 1:]
+
+
+def _rescale_metrics_with_baseline(
+    precision: Array,
+    recall: Array,
+    f1_score: Array,
+    baseline: np.ndarray,
+    num_layers: Optional[int] = None,
+    all_layers: bool = False,
+):
+    if num_layers is None and all_layers is False:
+        num_layers = -1
+    all_metrics = jnp.stack([precision, recall, f1_score], axis=-1)
+    baseline = jnp.asarray(baseline)
+    baseline_scale = baseline[:, None] if all_layers else baseline[num_layers]
+    all_metrics = (all_metrics - baseline_scale) / (1 - baseline_scale)
+    return all_metrics[..., 0], all_metrics[..., 1], all_metrics[..., 2]
+
+
+def _tokenize(text: List[str], tokenizer: Any, max_length: int):
+    enc = tokenizer(text, padding="max_length", truncation=True, max_length=max_length, return_tensors="np")
+    input_ids = np.asarray(enc["input_ids"])
+    attention_mask = np.asarray(enc["attention_mask"])
+    # trim shared padding to the longest sequence in the corpus
+    max_len = int(attention_mask.sum(1).max())
+    return input_ids[:, :max_len], attention_mask[:, :max_len]
+
+
+def bert_score(
+    preds: Union[List[str], Dict[str, Any]],
+    target: Union[List[str], Dict[str, Any]],
+    model_name_or_path: Optional[str] = None,
+    num_layers: Optional[int] = None,
+    all_layers: bool = False,
+    model: Optional[Any] = None,
+    user_tokenizer: Any = None,
+    user_forward_fn: Optional[Callable] = None,
+    verbose: bool = False,
+    idf: bool = False,
+    device: Optional[Any] = None,
+    max_length: int = 512,
+    batch_size: int = 64,
+    num_threads: int = 0,
+    return_hash: bool = False,
+    lang: str = "en",
+    rescale_with_baseline: bool = False,
+    baseline_path: Optional[str] = None,
+    baseline_url: Optional[str] = None,
+) -> Dict[str, Union[List[float], str]]:
+    """BERTScore precision/recall/F1 per sentence pair (reference bert.py:234-426).
+
+    ``model`` may be any Flax HF transformer (or arbitrary object when paired with
+    ``user_forward_fn(model, batch) -> [batch, seq, dim]`` embeddings). Without an
+    explicit model, ``model_name_or_path`` is loaded via ``FlaxAutoModel``.
+    """
+    if len(preds) != len(target):
+        raise ValueError("Number of predicted and reference sententes must be the same!")
+
+    if model is None:
+        if not _TRANSFORMERS_AVAILABLE:
+            raise ModuleNotFoundError(
+                "`bert_score` metric with default models requires `transformers` package be installed."
+            )
+        if model_name_or_path is None:
+            rank_zero_warn(
+                "The argument `model_name_or_path` was not specified while it is required when default"
+                f" `transformers` model are used. It is, therefore, used the default recommended model -"
+                f" {_DEFAULT_MODEL}."
+            )
+        from transformers import AutoTokenizer, FlaxAutoModel
+
+        tokenizer = AutoTokenizer.from_pretrained(model_name_or_path or _DEFAULT_MODEL)
+        model = FlaxAutoModel.from_pretrained(model_name_or_path or _DEFAULT_MODEL)
+    else:
+        tokenizer = user_tokenizer
+
+    try:
+        if num_layers and num_layers > model.config.num_hidden_layers:
+            raise ValueError(
+                f"num_layers={num_layers} is forbidden for {model_name_or_path}."
+                f" Please use num_layers <= {model.config.num_hidden_layers}"
+            )
+    except AttributeError:
+        rank_zero_warn("It was not possible to retrieve the parameter `num_layers` from the model specification.")
+
+    _are_empty_lists = all(isinstance(text, list) and len(text) == 0 for text in (preds, target))
+    _are_valid_lists = all(
+        isinstance(text, list) and len(text) > 0 and isinstance(text[0], str) for text in (preds, target)
+    )
+    _are_valid_tensors = all(
+        isinstance(text, dict) and "input_ids" in text for text in (preds, target)
+    )
+    if _are_empty_lists:
+        rank_zero_warn("Predictions and references are empty.")
+        output_dict: Dict[str, Union[List[float], str]] = {"precision": [0.0], "recall": [0.0], "f1": [0.0]}
+        if return_hash:
+            output_dict.update({"hash": _get_hash(model_name_or_path, num_layers, idf)})
+        return output_dict
+
+    baseline = _load_baseline(baseline_path) if rescale_with_baseline else None
+
+    if _are_valid_lists:
+        if tokenizer is None:
+            raise ValueError(
+                "A `user_tokenizer` must be provided together with a user `model` when passing raw sentence"
+                " lists (tokenized `input_ids`/`attention_mask` dicts need no tokenizer)."
+            )
+        target_ids, target_mask = _tokenize(list(target), tokenizer, max_length)
+        preds_ids, preds_mask = _tokenize(list(preds), tokenizer, max_length)
+    elif _are_valid_tensors:
+        target_ids, target_mask = np.asarray(target["input_ids"]), np.asarray(target["attention_mask"])
+        preds_ids, preds_mask = np.asarray(preds["input_ids"]), np.asarray(preds["attention_mask"])
+    else:
+        raise ValueError("Invalid input provided.")
+
+    tokens_idf = _get_tokens_idf(target_ids, target_mask) if idf else None
+
+    target_emb, target_idf_scale = _embed(
+        target_ids, target_mask, model, num_layers, all_layers, idf, tokens_idf, batch_size, user_forward_fn
+    )
+    preds_emb, preds_idf_scale = _embed(
+        preds_ids, preds_mask, model, num_layers, all_layers, idf, tokens_idf, batch_size, user_forward_fn
+    )
+
+    # pad the sequence axes to a common length so the einsum shapes agree
+    seq = max(preds_emb.shape[2], target_emb.shape[2])
+    def _pad(e, s):
+        pad = [(0, 0)] * e.ndim
+        pad[2] = (0, s - e.shape[2])
+        return jnp.pad(e, pad)
+    def _pad_scale(x, s):
+        return jnp.pad(x, [(0, 0), (0, s - x.shape[1])])
+    preds_emb, target_emb = _pad(preds_emb, seq), _pad(target_emb, seq)
+    preds_idf_scale, target_idf_scale = _pad_scale(preds_idf_scale, seq), _pad_scale(target_idf_scale, seq)
+
+    precision, recall, f1_score = _get_precision_recall_f1(preds_emb, target_emb, preds_idf_scale, target_idf_scale)
+
+    if baseline is not None:
+        precision, recall, f1_score = _rescale_metrics_with_baseline(
+            precision, recall, f1_score, baseline, num_layers, all_layers
+        )
+
+    output_dict = {
+        "precision": np.atleast_1d(np.asarray(precision)).tolist(),
+        "recall": np.atleast_1d(np.asarray(recall)).tolist(),
+        "f1": np.atleast_1d(np.asarray(f1_score)).tolist(),
+    }
+    if return_hash:
+        output_dict.update({"hash": _get_hash(model_name_or_path, num_layers, idf)})
+    return output_dict
+
+
+def _get_hash(model_name_or_path: Optional[str] = None, num_layers: Optional[int] = None, idf: bool = False) -> str:
+    return f"{model_name_or_path}_L{num_layers}{'_idf' if idf else '_no-idf'}"

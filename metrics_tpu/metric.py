@@ -58,6 +58,20 @@ _REDUCTION_FNS: Dict[str, Callable] = {
 
 StateValue = Union[Array, List[Array]]
 
+# kwargs consumed by Metric.__init__ (reference metric.py:82-144 + TPU axis_name
+# extension) — wrappers that split base kwargs from passthrough kwargs key off this.
+BASE_METRIC_KWARGS = frozenset(
+    (
+        "compute_on_cpu",
+        "dist_sync_on_step",
+        "process_group",
+        "dist_sync_fn",
+        "distributed_available_fn",
+        "sync_on_compute",
+        "axis_name",
+    )
+)
+
 
 class Metric(ABC):
     """Base class for all metrics.

@@ -1,0 +1,5 @@
+"""Multimodal-domain module metrics (reference src/torchmetrics/multimodal/)."""
+
+from metrics_tpu.multimodal.clip_score import CLIPScore
+
+__all__ = ["CLIPScore"]

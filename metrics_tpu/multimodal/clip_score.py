@@ -1,0 +1,48 @@
+"""CLIPScore module metric (reference src/torchmetrics/multimodal/clip_score.py)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.multimodal.clip_score import _clip_score_update, _get_model_and_processor
+from metrics_tpu.metric import Metric
+
+
+class CLIPScore(Metric):
+    """Streaming CLIPScore (reference multimodal/clip_score.py:29-116).
+
+    Two psum-able scalar states (score sum + sample count); the CLIP model runs
+    inside ``update``. Pass ``model``/``processor`` to use a local Flax model.
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = True
+
+    def __init__(
+        self,
+        model_name_or_path: str = "openai/clip-vit-large-patch14",
+        model: Optional[Any] = None,
+        processor: Optional[Any] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if (model is None) != (processor is None):
+            raise ValueError("Arguments `model` and `processor` must be provided together (or both omitted).")
+        if model is None:
+            model, processor = _get_model_and_processor(model_name_or_path)
+        self.model = model
+        self.processor = processor
+        self.add_state("score", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
+        self.add_state("n_samples", jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, images: Union[Array, List[Array]], text: Union[str, List[str]]) -> None:
+        score, n_samples = _clip_score_update(images, text, self.model, self.processor)
+        self.score = self.score + jnp.sum(score)
+        self.n_samples = self.n_samples + n_samples
+
+    def compute(self) -> Array:
+        return jnp.maximum(self.score / self.n_samples, jnp.asarray(0.0))

@@ -1,5 +1,6 @@
 """Text-domain module metrics (reference src/torchmetrics/text/)."""
 
+from metrics_tpu.text.bert import BERTScore
 from metrics_tpu.text.bleu import BLEUScore
 from metrics_tpu.text.cer import CharErrorRate
 from metrics_tpu.text.chrf import CHRFScore
@@ -15,6 +16,7 @@ from metrics_tpu.text.wil import WordInfoLost
 from metrics_tpu.text.wip import WordInfoPreserved
 
 __all__ = [
+    "BERTScore",
     "BLEUScore",
     "CharErrorRate",
     "CHRFScore",

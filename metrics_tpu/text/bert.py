@@ -1,0 +1,137 @@
+"""BERTScore module metric (reference src/torchmetrics/text/bert.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.functional.text.bert import _DEFAULT_MODEL, bert_score
+from metrics_tpu.metric import Metric
+from metrics_tpu.utils.imports import _TRANSFORMERS_AVAILABLE
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+def _preprocess(text: List[str], tokenizer: Any, max_length: int):
+    enc = tokenizer(text, padding="max_length", truncation=True, max_length=max_length, return_tensors="np")
+    return np.asarray(enc["input_ids"]), np.asarray(enc["attention_mask"])
+
+
+class BERTScore(Metric):
+    """Streaming BERTScore (reference text/bert.py:42-225).
+
+    Tokenized sentences accumulate as ragged "cat" states; the heavy embedding
+    model runs once at ``compute`` (reference design — BASELINE "large embedding
+    states" scenario accumulates tokens, not embeddings).
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    _host_compute = True
+
+    def __init__(
+        self,
+        model_name_or_path: Optional[str] = None,
+        num_layers: Optional[int] = None,
+        all_layers: bool = False,
+        model: Optional[Any] = None,
+        user_tokenizer: Optional[Any] = None,
+        user_forward_fn: Optional[Callable] = None,
+        verbose: bool = False,
+        idf: bool = False,
+        device: Optional[Any] = None,
+        max_length: int = 512,
+        batch_size: int = 64,
+        num_threads: int = 0,
+        return_hash: bool = False,
+        lang: str = "en",
+        rescale_with_baseline: bool = False,
+        baseline_path: Optional[str] = None,
+        baseline_url: Optional[str] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.model_name_or_path = model_name_or_path or _DEFAULT_MODEL
+        self.num_layers = num_layers
+        self.all_layers = all_layers
+        self.model = model
+        self.user_forward_fn = user_forward_fn
+        self.verbose = verbose
+        self.idf = idf
+        self.embedding_device = device
+        self.max_length = max_length
+        self.batch_size = batch_size
+        self.num_threads = num_threads
+        self.return_hash = return_hash
+        self.lang = lang
+        self.rescale_with_baseline = rescale_with_baseline
+        self.baseline_path = baseline_path
+        self.baseline_url = baseline_url
+
+        if user_tokenizer:
+            self.tokenizer = user_tokenizer
+            self.user_tokenizer = True
+        else:
+            if not _TRANSFORMERS_AVAILABLE:
+                raise ModuleNotFoundError(
+                    "`BERTScore` metric with default tokenizers requires `transformers` package be installed."
+                )
+            if model_name_or_path is None:
+                rank_zero_warn(
+                    "The argument `model_name_or_path` was not specified while it is required when the default"
+                    f" `transformers` model is used. It will use the default recommended model - {_DEFAULT_MODEL}."
+                )
+            from transformers import AutoTokenizer
+
+            self.tokenizer = AutoTokenizer.from_pretrained(self.model_name_or_path)
+            self.user_tokenizer = False
+
+        self.add_state("preds_input_ids", [], dist_reduce_fx="cat")
+        self.add_state("preds_attention_mask", [], dist_reduce_fx="cat")
+        self.add_state("target_input_ids", [], dist_reduce_fx="cat")
+        self.add_state("target_attention_mask", [], dist_reduce_fx="cat")
+
+    def update(self, preds: List[str], target: List[str]) -> None:
+        if len(preds) != len(target):
+            raise ValueError("Number of predicted and reference sententes must be the same!")
+        preds_ids, preds_mask = _preprocess(list(preds), self.tokenizer, self.max_length)
+        target_ids, target_mask = _preprocess(list(target), self.tokenizer, self.max_length)
+        self.preds_input_ids.append(jnp.asarray(preds_ids))
+        self.preds_attention_mask.append(jnp.asarray(preds_mask))
+        self.target_input_ids.append(jnp.asarray(target_ids))
+        self.target_attention_mask.append(jnp.asarray(target_mask))
+
+    @staticmethod
+    def _cat_and_trim(ids_list, mask_list) -> Dict[str, np.ndarray]:
+        """Concatenate accumulated batches and trim shared padding to the longest
+        sequence — avoids running the model/matching at full max_length."""
+        ids = np.concatenate([np.asarray(x) for x in ids_list])
+        mask = np.concatenate([np.asarray(x) for x in mask_list])
+        max_len = max(int(mask.sum(1).max()), 1)
+        return {"input_ids": ids[:, :max_len], "attention_mask": mask[:, :max_len]}
+
+    def compute(self) -> Dict[str, Union[List[float], str]]:
+        return bert_score(
+            preds=self._cat_and_trim(self.preds_input_ids, self.preds_attention_mask),
+            target=self._cat_and_trim(self.target_input_ids, self.target_attention_mask),
+            model_name_or_path=self.model_name_or_path,
+            num_layers=self.num_layers,
+            all_layers=self.all_layers,
+            model=self.model,
+            user_tokenizer=self.tokenizer if self.user_tokenizer else None,
+            user_forward_fn=self.user_forward_fn,
+            verbose=self.verbose,
+            idf=self.idf,
+            device=self.embedding_device,
+            max_length=self.max_length,
+            batch_size=self.batch_size,
+            num_threads=self.num_threads,
+            return_hash=self.return_hash,
+            lang=self.lang,
+            rescale_with_baseline=self.rescale_with_baseline,
+            baseline_path=self.baseline_path,
+            baseline_url=self.baseline_url,
+        )

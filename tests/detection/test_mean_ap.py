@@ -1,0 +1,261 @@
+"""MeanAveragePrecision tests: known-value COCO protocol cases + an independent
+single-threshold AP reference implemented here (pycocotools is not in this image,
+mirroring the reference's non-pycocotools fallback path)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from metrics_tpu.detection import MeanAveragePrecision
+from metrics_tpu.detection.mean_ap import box_convert, box_iou
+
+
+def test_box_convert():
+    xywh = np.array([[10.0, 20.0, 30.0, 40.0]])
+    np.testing.assert_allclose(box_convert(xywh, "xywh"), [[10, 20, 40, 60]])
+    cxcywh = np.array([[25.0, 40.0, 30.0, 40.0]])
+    np.testing.assert_allclose(box_convert(cxcywh, "cxcywh"), [[10, 20, 40, 60]])
+
+
+def test_box_iou():
+    a = np.array([[0.0, 0.0, 10.0, 10.0]])
+    b = np.array([[0.0, 0.0, 10.0, 10.0], [5.0, 5.0, 15.0, 15.0], [20.0, 20.0, 30.0, 30.0]])
+    iou = box_iou(a, b)
+    np.testing.assert_allclose(iou[0], [1.0, 25 / 175, 0.0], atol=1e-9)
+
+
+def _perfect_example():
+    preds = [
+        {
+            "boxes": np.array([[10.0, 10.0, 60.0, 60.0], [100.0, 100.0, 200.0, 220.0]]),
+            "scores": np.array([0.9, 0.8]),
+            "labels": np.array([0, 1]),
+        }
+    ]
+    target = [
+        {
+            "boxes": np.array([[10.0, 10.0, 60.0, 60.0], [100.0, 100.0, 200.0, 220.0]]),
+            "labels": np.array([0, 1]),
+        }
+    ]
+    return preds, target
+
+
+def test_perfect_predictions_give_map_1():
+    metric = MeanAveragePrecision()
+    preds, target = _perfect_example()
+    metric.update(preds, target)
+    res = metric.compute()
+    assert float(res["map"]) == pytest.approx(1.0)
+    assert float(res["map_50"]) == pytest.approx(1.0)
+    assert float(res["map_75"]) == pytest.approx(1.0)
+    assert float(res["mar_100"]) == pytest.approx(1.0)
+    # (medium box 50x50=2500 in [1024,9216]; large box 100x120=12000 > 9216)
+    assert float(res["map_medium"]) == pytest.approx(1.0)
+    assert float(res["map_large"]) == pytest.approx(1.0)
+    assert float(res["map_small"]) == -1.0  # no small boxes -> unset sentinel
+
+
+def test_completely_wrong_predictions_give_map_0():
+    metric = MeanAveragePrecision()
+    preds = [
+        {"boxes": np.array([[0.0, 0.0, 5.0, 5.0]]), "scores": np.array([0.9]), "labels": np.array([0])}
+    ]
+    target = [{"boxes": np.array([[50.0, 50.0, 100.0, 100.0]]), "labels": np.array([0])}]
+    metric.update(preds, target)
+    res = metric.compute()
+    assert float(res["map"]) == pytest.approx(0.0)
+    assert float(res["mar_100"]) == pytest.approx(0.0)
+
+
+def _ref_ap_single_threshold(dets, gts, iou_thr, rec_thresholds):
+    """Independent single-class single-threshold COCO AP: greedy matching on score
+    order + 101-point interpolation. dets: list per image of (box, score); gts:
+    list per image of boxes."""
+    records = []  # (score, is_tp)
+    npig = sum(len(g) for g in gts)
+    for det_img, gt_img in zip(dets, gts):
+        det_sorted = sorted(det_img, key=lambda d: -d[1])
+        matched = set()
+        for box, score in det_sorted:
+            best_iou, best_j = 0.0, -1
+            for j, g in enumerate(gt_img):
+                if j in matched:
+                    continue
+                iou = box_iou(np.asarray([box]), np.asarray([g]))[0, 0]
+                if iou > best_iou:
+                    best_iou, best_j = iou, j
+            if best_j >= 0 and best_iou > iou_thr:
+                matched.add(best_j)
+                records.append((score, True))
+            else:
+                records.append((score, False))
+    records.sort(key=lambda r: -r[0])
+    tps = np.cumsum([r[1] for r in records])
+    fps = np.cumsum([not r[1] for r in records])
+    rc = tps / npig
+    pr = tps / np.maximum(tps + fps, 1e-12)
+    pr = np.maximum.accumulate(pr[::-1])[::-1]
+    prec = np.zeros(len(rec_thresholds))
+    inds = np.searchsorted(rc, rec_thresholds, side="left")
+    valid = inds < len(rc)
+    prec[valid] = pr[inds[valid]]
+    return prec.mean()
+
+
+def test_ap_matches_independent_reference_single_threshold():
+    rng = np.random.RandomState(0)
+    dets, gts, preds, target = [], [], [], []
+    for _ in range(4):
+        n_gt = rng.randint(1, 5)
+        gt_boxes = []
+        det_items = []
+        for _ in range(n_gt):
+            x, y = rng.uniform(0, 200, 2)
+            w, h = rng.uniform(20, 80, 2)
+            gt_boxes.append([x, y, x + w, y + h])
+            # jittered detection
+            if rng.rand() < 0.8:
+                jit = rng.uniform(-10, 10, 4)
+                det_items.append((list(np.asarray(gt_boxes[-1]) + jit), float(rng.uniform(0.3, 1.0))))
+        # false positives
+        for _ in range(rng.randint(0, 3)):
+            x, y = rng.uniform(200, 400, 2)
+            w, h = rng.uniform(10, 50, 2)
+            det_items.append(([x, y, x + w, y + h], float(rng.uniform(0.0, 1.0))))
+        dets.append(det_items)
+        gts.append(gt_boxes)
+        preds.append(
+            {
+                "boxes": np.asarray([d[0] for d in det_items]).reshape(-1, 4),
+                "scores": np.asarray([d[1] for d in det_items]),
+                "labels": np.zeros(len(det_items), dtype=int),
+            }
+        )
+        target.append({"boxes": np.asarray(gt_boxes).reshape(-1, 4), "labels": np.zeros(len(gt_boxes), dtype=int)})
+
+    rec_thresholds = np.linspace(0, 1, 101)
+    metric = MeanAveragePrecision(iou_thresholds=[0.5], rec_thresholds=rec_thresholds.tolist())
+    metric.update(preds, target)
+    res = metric.compute()
+    expected = _ref_ap_single_threshold(dets, gts, 0.5, rec_thresholds)
+    assert float(res["map"]) == pytest.approx(expected, abs=1e-6)
+
+
+def test_half_matching_predictions():
+    """One TP at score .9, one FP at .8 on 2 gts: recall caps at 0.5, precision 1.0
+    up to 0.5 then 0 -> AP = 51/101."""
+    preds = [
+        {
+            "boxes": np.array([[0.0, 0.0, 50.0, 50.0], [200.0, 200.0, 250.0, 250.0]]),
+            "scores": np.array([0.9, 0.8]),
+            "labels": np.array([0, 0]),
+        }
+    ]
+    target = [
+        {
+            "boxes": np.array([[0.0, 0.0, 50.0, 50.0], [100.0, 100.0, 150.0, 150.0]]),
+            "labels": np.array([0, 0]),
+        }
+    ]
+    metric = MeanAveragePrecision()
+    metric.update(preds, target)
+    res = metric.compute()
+    assert float(res["map"]) == pytest.approx(51 / 101, abs=1e-6)
+    assert float(res["mar_100"]) == pytest.approx(0.5)
+
+
+def test_max_detection_thresholds():
+    """With max_det=1 only the highest-scored detection counts."""
+    preds = [
+        {
+            "boxes": np.array([[0.0, 0.0, 50.0, 50.0], [100.0, 100.0, 150.0, 150.0]]),
+            "scores": np.array([0.9, 0.8]),
+            "labels": np.array([0, 0]),
+        }
+    ]
+    target = [
+        {
+            "boxes": np.array([[0.0, 0.0, 50.0, 50.0], [100.0, 100.0, 150.0, 150.0]]),
+            "labels": np.array([0, 0]),
+        }
+    ]
+    metric = MeanAveragePrecision()
+    metric.update(preds, target)
+    res = metric.compute()
+    assert float(res["mar_1"]) == pytest.approx(0.5)
+    assert float(res["mar_100"]) == pytest.approx(1.0)
+
+
+def test_class_metrics():
+    metric = MeanAveragePrecision(class_metrics=True)
+    preds, target = _perfect_example()
+    # class 1 prediction shifted off target -> mAP 0 for that class
+    preds[0]["boxes"] = preds[0]["boxes"].copy()
+    preds[0]["boxes"][1] = [300, 300, 400, 400]
+    metric.update(preds, target)
+    res = metric.compute()
+    per_class = np.asarray(res["map_per_class"])
+    assert per_class.shape == (2,)
+    assert per_class[0] == pytest.approx(1.0)
+    assert per_class[1] == pytest.approx(0.0)
+    np.testing.assert_array_equal(np.asarray(res["classes"]), [0, 1])
+
+
+def test_streaming_updates_match_single_update():
+    rng = np.random.RandomState(1)
+    all_preds, all_target = [], []
+    for _ in range(6):
+        boxes = rng.uniform(0, 100, (3, 2))
+        wh = rng.uniform(10, 60, (3, 2))
+        gt = np.concatenate([boxes, boxes + wh], axis=1)
+        det = gt + rng.uniform(-8, 8, gt.shape)
+        all_preds.append({"boxes": det, "scores": rng.uniform(0, 1, 3), "labels": rng.randint(0, 2, 3)})
+        all_target.append({"boxes": gt, "labels": rng.randint(0, 2, 3)})
+
+    m1 = MeanAveragePrecision()
+    m1.update(all_preds, all_target)
+    m2 = MeanAveragePrecision()
+    for p, t in zip(all_preds, all_target):
+        m2.update([p], [t])
+    r1, r2 = m1.compute(), m2.compute()
+    for k in r1:
+        np.testing.assert_allclose(np.asarray(r1[k]), np.asarray(r2[k]), atol=1e-8)
+
+
+def test_empty_preds_and_targets():
+    metric = MeanAveragePrecision()
+    metric.update(
+        [{"boxes": np.zeros((0, 4)), "scores": np.zeros(0), "labels": np.zeros(0, dtype=int)}],
+        [{"boxes": np.array([[0.0, 0.0, 50.0, 50.0]]), "labels": np.array([0])}],
+    )
+    res = metric.compute()
+    assert float(res["map"]) == pytest.approx(0.0)
+
+    metric2 = MeanAveragePrecision()
+    metric2.update(
+        [{"boxes": np.array([[0.0, 0.0, 50.0, 50.0]]), "scores": np.array([0.5]), "labels": np.array([0])}],
+        [{"boxes": np.zeros((0, 4)), "labels": np.zeros(0, dtype=int)}],
+    )
+    res2 = metric2.compute()
+    # no gts at all -> everything stays at the -1 sentinel
+    assert float(res2["map"]) == -1.0
+
+
+def test_input_validation():
+    metric = MeanAveragePrecision()
+    with pytest.raises(ValueError):
+        metric.update([{"scores": np.zeros(1), "labels": np.zeros(1)}], [{"boxes": np.zeros((1, 4)), "labels": np.zeros(1)}])
+    with pytest.raises(ValueError):
+        MeanAveragePrecision(box_format="bad")
+    with pytest.raises(ValueError):
+        MeanAveragePrecision(iou_type="bad")
+
+
+def test_box_format_xywh():
+    preds = [{"boxes": np.array([[10.0, 10.0, 50.0, 50.0]]), "scores": np.array([0.9]), "labels": np.array([0])}]
+    target = [{"boxes": np.array([[10.0, 10.0, 50.0, 50.0]]), "labels": np.array([0])}]
+    metric = MeanAveragePrecision(box_format="xywh")
+    metric.update(preds, target)
+    assert float(metric.compute()["map"]) == pytest.approx(1.0)

@@ -1,0 +1,94 @@
+"""CLIPScore tests with a tiny random-weight FlaxCLIPModel + stub processor."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+transformers = pytest.importorskip("transformers")
+
+from metrics_tpu.functional.multimodal import clip_score  # noqa: E402
+from metrics_tpu.multimodal import CLIPScore  # noqa: E402
+
+IMG = 32  # tiny image resolution
+
+
+@pytest.fixture(scope="module")
+def tiny_clip():
+    from transformers import CLIPConfig, CLIPTextConfig, CLIPVisionConfig, FlaxCLIPModel
+
+    config = CLIPConfig(
+        text_config=CLIPTextConfig(
+            vocab_size=64, hidden_size=16, intermediate_size=32, num_hidden_layers=2, num_attention_heads=2,
+            max_position_embeddings=16, projection_dim=8,
+        ).to_dict(),
+        vision_config=CLIPVisionConfig(
+            hidden_size=16, intermediate_size=32, num_hidden_layers=2, num_attention_heads=2,
+            image_size=IMG, patch_size=8, projection_dim=8,
+        ).to_dict(),
+        projection_dim=8,
+    )
+    return FlaxCLIPModel(config, seed=0)
+
+
+class _StubProcessor:
+    """Maps captions to token ids and images to normalized pixel tensors."""
+
+    def __call__(self, text=None, images=None, return_tensors="np", padding=True):
+        ids, masks = [], []
+        for caption in text:
+            toks = [49 % 64] + [3 + (hash(w) % 60) for w in caption.split()][:14] + [2]
+            mask = [1] * len(toks) + [0] * (16 - len(toks))
+            toks = toks + [0] * (16 - len(toks))
+            ids.append(toks)
+            masks.append(mask)
+        pixel_values = np.stack([np.asarray(i, dtype=np.float32) / 255.0 for i in images])
+        return {
+            "input_ids": np.asarray(ids),
+            "attention_mask": np.asarray(masks),
+            "pixel_values": pixel_values,
+        }
+
+
+def test_clip_score_functional(tiny_clip):
+    rng = np.random.RandomState(0)
+    img = jnp.asarray(rng.randint(0, 255, (3, IMG, IMG)).astype(np.float32))
+    score = clip_score(img, "a photo of a cat", model=tiny_clip, processor=_StubProcessor())
+    assert score.shape == ()
+    assert float(score) >= 0.0
+
+    # manual expectation: clamp(100 * cos, 0)
+    proc = _StubProcessor()(text=["a photo of a cat"], images=[np.asarray(img)])
+    img_f = np.asarray(tiny_clip.get_image_features(jnp.asarray(proc["pixel_values"])))
+    txt_f = np.asarray(tiny_clip.get_text_features(jnp.asarray(proc["input_ids"]), jnp.asarray(proc["attention_mask"])))
+    cos = float(((img_f / np.linalg.norm(img_f)) @ (txt_f / np.linalg.norm(txt_f)).T).item())
+    assert float(score) == pytest.approx(max(100 * cos, 0.0), abs=1e-3)
+
+
+def test_clip_score_batch_and_validation(tiny_clip):
+    rng = np.random.RandomState(1)
+    imgs = jnp.asarray(rng.randint(0, 255, (2, 3, IMG, IMG)).astype(np.float32))
+    score = clip_score(imgs, ["caption one", "caption two"], model=tiny_clip, processor=_StubProcessor())
+    assert np.isfinite(float(score))
+
+    with pytest.raises(ValueError):
+        clip_score(imgs, ["only one caption"], model=tiny_clip, processor=_StubProcessor())
+    with pytest.raises(ValueError):
+        clip_score(jnp.zeros((2, 3, 4, IMG, IMG)), ["a", "b"], model=tiny_clip, processor=_StubProcessor())
+
+
+def test_clip_score_module(tiny_clip):
+    rng = np.random.RandomState(2)
+    metric = CLIPScore(model=tiny_clip, processor=_StubProcessor())
+    all_scores = []
+    for i in range(2):
+        imgs = jnp.asarray(rng.randint(0, 255, (2, 3, IMG, IMG)).astype(np.float32))
+        texts = [f"caption {i} a", f"caption {i} b"]
+        metric.update(imgs, texts)
+        from metrics_tpu.functional.multimodal.clip_score import _clip_score_update
+
+        s, _ = _clip_score_update(imgs, texts, tiny_clip, _StubProcessor())
+        all_scores.append(np.asarray(s))
+    expected = max(float(np.concatenate(all_scores).mean()), 0.0)
+    assert float(metric.compute()) == pytest.approx(expected, abs=1e-4)

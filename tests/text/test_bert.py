@@ -1,0 +1,145 @@
+"""BERTScore tests with a tiny random-weight FlaxBert model (no network access) —
+expected values computed independently in numpy from the same embeddings."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+transformers = pytest.importorskip("transformers")
+
+from metrics_tpu.functional.text.bert import bert_score  # noqa: E402
+from metrics_tpu.text.bert import BERTScore  # noqa: E402
+
+VOCAB, SEQ, DIM = 50, 12, 16
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    from transformers import BertConfig, FlaxBertModel
+
+    config = BertConfig(
+        vocab_size=VOCAB,
+        hidden_size=DIM,
+        num_hidden_layers=2,
+        num_attention_heads=2,
+        intermediate_size=32,
+        max_position_embeddings=SEQ,
+    )
+    return FlaxBertModel(config, seed=0)
+
+
+class _StubTokenizer:
+    """Whitespace tokenizer with [CLS]=1 / [SEP]=2 / pad=0, hashing words into the vocab."""
+
+    def __call__(self, text, padding=None, truncation=True, max_length=SEQ, return_tensors="np"):
+        ids_batch, mask_batch = [], []
+        for sentence in text:
+            ids = [1] + [3 + (hash(w) % (VOCAB - 3)) for w in sentence.split()][: max_length - 2] + [2]
+            mask = [1] * len(ids) + [0] * (max_length - len(ids))
+            ids = ids + [0] * (max_length - len(ids))
+            ids_batch.append(ids)
+            mask_batch.append(mask)
+        return {"input_ids": np.asarray(ids_batch), "attention_mask": np.asarray(mask_batch)}
+
+
+def _ref_bertscore(pred_emb, pred_mask, tgt_emb, tgt_mask, pred_w=None, tgt_w=None):
+    """Independent numpy implementation of the published BERTScore equations.
+
+    emb: [seq, dim] raw embeddings; mask: [seq] with special tokens already zeroed;
+    w: optional idf weights per token (defaults to uniform over unmasked tokens).
+    """
+    pe = pred_emb / np.linalg.norm(pred_emb, axis=-1, keepdims=True)
+    te = tgt_emb / np.linalg.norm(tgt_emb, axis=-1, keepdims=True)
+    pe = pe * pred_mask[:, None]
+    te = te * tgt_mask[:, None]
+    sim = pe @ te.T
+    pw = pred_w if pred_w is not None else pred_mask.astype(float)
+    tw = tgt_w if tgt_w is not None else tgt_mask.astype(float)
+    pw = pw / pw.sum()
+    tw = tw / tw.sum()
+    precision = (sim.max(axis=1) * pw).sum()
+    recall = (sim.max(axis=0) * tw).sum()
+    f1 = 2 * precision * recall / (precision + recall)
+    return precision, recall, f1
+
+
+def _zero_special(ids, mask):
+    out = mask.astype(float).copy()
+    out[0] = 0  # [CLS]
+    sep = np.argmax(np.cumsum(mask - 0.1))
+    out[sep] = 0  # [SEP]
+    return out
+
+
+def test_bert_score_identical_sentences(tiny_model):
+    tok = _StubTokenizer()
+    preds = ["hello there big world", "general kenobi strikes"]
+    score = bert_score(preds, preds, model=tiny_model, user_tokenizer=tok, num_layers=2)
+    for key in ("precision", "recall", "f1"):
+        for v in score[key]:
+            assert v == pytest.approx(1.0, abs=1e-5)
+
+
+def test_bert_score_vs_numpy_reference(tiny_model):
+    tok = _StubTokenizer()
+    preds = ["the cat sat on the mat", "a dog barks"]
+    target = ["the cat lay on the rug", "a cat meows loudly"]
+    score = bert_score(preds, target, model=tiny_model, user_tokenizer=tok, num_layers=2)
+
+    enc_p = tok(preds)
+    enc_t = tok(target)
+    out_p = np.asarray(
+        tiny_model(input_ids=enc_p["input_ids"], attention_mask=enc_p["attention_mask"], output_hidden_states=True).hidden_states[2]
+    )
+    out_t = np.asarray(
+        tiny_model(input_ids=enc_t["input_ids"], attention_mask=enc_t["attention_mask"], output_hidden_states=True).hidden_states[2]
+    )
+    for i in range(len(preds)):
+        pm = _zero_special(enc_p["input_ids"][i], enc_p["attention_mask"][i])
+        tm = _zero_special(enc_t["input_ids"][i], enc_t["attention_mask"][i])
+        p, r, f1 = _ref_bertscore(out_p[i], pm, out_t[i], tm)
+        assert score["precision"][i] == pytest.approx(float(p), abs=1e-5)
+        assert score["recall"][i] == pytest.approx(float(r), abs=1e-5)
+        assert score["f1"][i] == pytest.approx(float(f1), abs=1e-5)
+
+
+def test_bert_score_idf(tiny_model):
+    tok = _StubTokenizer()
+    preds = ["common words here", "common words there"]
+    target = ["common words here", "rare tokens appear"]
+    score = bert_score(preds, target, model=tiny_model, user_tokenizer=tok, num_layers=2, idf=True)
+    assert len(score["f1"]) == 2
+    assert all(np.isfinite(score["f1"]))
+
+
+def test_bert_score_user_forward_fn(tiny_model):
+    tok = _StubTokenizer()
+
+    def fwd(model, batch):
+        return model(input_ids=batch["input_ids"], attention_mask=batch["attention_mask"]).last_hidden_state
+
+    preds = ["hello there", "general kenobi"]
+    target = ["hello there", "master kenobi"]
+    score = bert_score(preds, target, model=tiny_model, user_tokenizer=tok, user_forward_fn=fwd)
+    assert score["f1"][0] == pytest.approx(1.0, abs=1e-5)
+    assert score["f1"][1] < 1.0
+
+
+def test_bert_score_validation(tiny_model):
+    with pytest.raises(ValueError):
+        bert_score(["a"], ["b", "c"], model=tiny_model, user_tokenizer=_StubTokenizer())
+    with pytest.raises(ValueError):
+        bert_score(["a"], ["b"], model=tiny_model, user_tokenizer=_StubTokenizer(), num_layers=99)
+
+
+def test_bert_score_module_accumulation(tiny_model):
+    tok = _StubTokenizer()
+    preds = ["the cat sat", "a dog barks", "hello there"]
+    target = ["the cat lay", "a cat meows", "hello there"]
+    metric = BERTScore(model=tiny_model, user_tokenizer=tok, num_layers=2, max_length=SEQ)
+    metric.update(preds[:2], target[:2])
+    metric.update(preds[2:], target[2:])
+    result = metric.compute()
+    functional = bert_score(preds, target, model=tiny_model, user_tokenizer=tok, num_layers=2, max_length=SEQ)
+    np.testing.assert_allclose(result["f1"], functional["f1"], atol=1e-5)
