@@ -1,0 +1,48 @@
+"""Guard the driver-facing entry points so they can never silently rot.
+
+Round-1 postmortem: ``dryrun_multichip`` called bare ``jax.devices()`` which initialised
+the TPU plugin and hung the driver's artifact run (MULTICHIP_r01 rc=124). These tests run
+both entry points on the same 8-virtual-CPU-device configuration the driver uses.
+"""
+
+import os
+import sys
+
+import jax
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+import __graft_entry__ as graft  # noqa: E402
+
+
+def test_entry_compiles_and_runs():
+    fn, example_args = graft.entry()
+    loss, states, values = jax.jit(fn)(*example_args)
+    jax.block_until_ready((loss, states, values))
+    assert float(loss) > 0.0
+    assert 0.0 <= float(values["accuracy"]) <= 1.0
+
+
+def test_dryrun_multichip_8_devices():
+    # The driver runs this with XLA_FLAGS=--xla_force_host_platform_device_count=N;
+    # tests/conftest.py sets the same flag, so 8 CPU devices exist here too.
+    graft.dryrun_multichip(8)
+
+
+def test_dryrun_multichip_never_touches_default_backend(monkeypatch):
+    # Bare jax.devices() (no platform argument) initialises the default backend — the
+    # exact round-1 bug. Fail loudly if it creeps back in.
+    real_devices = jax.devices
+
+    def guarded(platform=None):
+        assert platform is not None, "bare jax.devices() call would initialise the TPU plugin"
+        return real_devices(platform)
+
+    monkeypatch.setattr(jax, "devices", guarded)
+    graft.dryrun_multichip(4)
+
+
+def test_cpu_devices_errors_clearly_when_too_few():
+    with pytest.raises(RuntimeError, match="xla_force_host_platform_device_count"):
+        graft._cpu_devices(10_000)
