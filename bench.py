@@ -6,33 +6,88 @@ config #2) adds to a compiled training step when the metric update is fused into
 step's XLA graph via the pure functional API. The reference's qualitative target is
 <1% overhead; `vs_baseline` is value/1.0 (ratio to that 1% budget — smaller is better).
 
-Methodology (recorded per BASELINE.md): single chip, f32 params / bf16 matmul inputs,
-compile excluded (warmup step), median of `STEPS` timed steps with block_until_ready.
-Prints ONE JSON line.
+Methodology (recorded per BASELINE.md): f32 params, compile excluded (warmup step),
+median-free mean of `STEPS` timed steps chained through the donated carry with one
+trailing host readback. Prints ONE JSON line and exits 0 even when degraded.
+
+Robustness (round-2 hardening): TPU backend init on this image can hang indefinitely
+when the tunnel is down — round 1's bench died there with a bare stack trace and no
+artifact. The backend is now probed in a SUBPROCESS with a timeout (an in-process init
+cannot be cancelled), retried with backoff; on failure the benchmark runs on the host
+CPU platform at a reduced size and the JSON records the degradation and the probe error
+instead of crashing.
 """
 
 from __future__ import annotations
 
 import json
-import statistics
+import subprocess
 import sys
 import time
 
-import jax
-import jax.numpy as jnp
+# Probe/retry schedule for the accelerator backend: (attempts, per-attempt timeout s,
+# backoff s between attempts).
+PROBE_ATTEMPTS = 2
+PROBE_TIMEOUT_S = 90
+PROBE_BACKOFF_S = (10,)
 
-from metrics_tpu.classification.accuracy import MulticlassAccuracy
-from metrics_tpu.classification.confusion_matrix import MulticlassConfusionMatrix
-from metrics_tpu.classification.f_beta import MulticlassF1Score
+_PROBE_SNIPPET = (
+    "import jax; d = jax.devices(); "
+    "print(d[0].platform, len(d))"
+)
 
-BATCH, HIDDEN, CLASSES, LAYERS, STEPS = 1024, 4096, 1000, 8, 30
+
+def probe_accelerator() -> tuple[bool, str]:
+    """Check in a killable subprocess whether the default jax backend initialises.
+
+    Returns (ok, detail). Never raises; never blocks longer than the schedule allows.
+    """
+    last = ""
+    for attempt in range(PROBE_ATTEMPTS):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", _PROBE_SNIPPET],
+                capture_output=True,
+                text=True,
+                timeout=PROBE_TIMEOUT_S,
+            )
+            if r.returncode == 0:
+                platform = (r.stdout.split() or ["?"])[0]
+                if platform == "cpu":
+                    # A cpu default backend means there is no accelerator — "probe
+                    # succeeded" must not send the full TPU-sized config to the host.
+                    return False, "default backend is cpu (no accelerator present)"
+                return True, r.stdout.strip()
+            last = (r.stderr.strip().splitlines() or ["rc=%d" % r.returncode])[-1]
+        except subprocess.TimeoutExpired:
+            last = f"backend init did not complete within {PROBE_TIMEOUT_S}s"
+        except Exception as exc:  # noqa: BLE001
+            last = repr(exc)
+        if attempt < PROBE_ATTEMPTS - 1:
+            time.sleep(PROBE_BACKOFF_S[min(attempt, len(PROBE_BACKOFF_S) - 1)])
+    return False, last
 
 
-def main() -> None:
+def run_benchmark(degraded_reason: str | None) -> dict:
+    """Time bare vs metric-fused train steps; returns the result record."""
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu.classification.accuracy import MulticlassAccuracy
+    from metrics_tpu.classification.confusion_matrix import MulticlassConfusionMatrix
+    from metrics_tpu.classification.f_beta import MulticlassF1Score
+
+    on_cpu = degraded_reason is not None
+    if on_cpu:
+        # Reduced problem size: the full TPU config is ~100 GFLOP/step, minutes on host.
+        batch, hidden, classes, layers, steps = 256, 512, 100, 4, 10
+    else:
+        batch, hidden, classes, layers, steps = 1024, 4096, 1000, 8, 30
+
     metrics = {
-        "accuracy": MulticlassAccuracy(CLASSES, average="micro", validate_args=False),
-        "f1": MulticlassF1Score(CLASSES, average="macro", validate_args=False),
-        "confmat": MulticlassConfusionMatrix(CLASSES, validate_args=False),
+        "accuracy": MulticlassAccuracy(classes, average="micro", validate_args=False),
+        "f1": MulticlassF1Score(classes, average="macro", validate_args=False),
+        "confmat": MulticlassConfusionMatrix(classes, validate_args=False),
     }
 
     def forward(params, x, y):
@@ -56,14 +111,13 @@ def main() -> None:
         return params, states, loss
 
     key = jax.random.PRNGKey(0)
-    ks = jax.random.split(key, LAYERS + 3)
+    ks = jax.random.split(key, layers + 3)
     params = {
-        "ws": [jax.random.normal(ks[i], (HIDDEN, HIDDEN), jnp.float32) * 0.02 for i in range(LAYERS)],
-        "head": jax.random.normal(ks[LAYERS], (HIDDEN, CLASSES), jnp.float32) * 0.02,
+        "ws": [jax.random.normal(ks[i], (hidden, hidden), jnp.float32) * 0.02 for i in range(layers)],
+        "head": jax.random.normal(ks[layers], (hidden, classes), jnp.float32) * 0.02,
     }
-    x = jax.random.normal(ks[LAYERS + 1], (BATCH, HIDDEN), jnp.float32)
-    y = jax.random.randint(ks[LAYERS + 2], (BATCH,), 0, CLASSES)
-    states = {name: m.init_state() for name, m in metrics.items()}
+    x = jax.random.normal(ks[layers + 1], (batch, hidden), jnp.float32)
+    y = jax.random.randint(ks[layers + 2], (batch,), 0, classes)
 
     bare = jax.jit(bare_step, donate_argnums=(0,))
     fused = jax.jit(metric_step, donate_argnums=(0, 1))
@@ -83,30 +137,54 @@ def main() -> None:
     fresh_params = lambda: jax.tree_util.tree_map(jnp.copy, params)  # noqa: E731
     fresh_states = lambda: {n: metrics[n].init_state() for n in metrics}  # noqa: E731
 
-    t_bare, _ = run(bare, (fresh_params(),), STEPS)
-    t_fused, carry = run(fused, (fresh_params(), fresh_states()), STEPS)
+    t_bare, _ = run(bare, (fresh_params(),), steps)
+    t_fused, carry = run(fused, (fresh_params(), fresh_states()), steps)
 
     # validate the accumulated metric state computes
-    final_states = carry[1]
-    acc = float(metrics["accuracy"].compute_from(final_states["accuracy"]))
+    acc = float(metrics["accuracy"].compute_from(carry[1]["accuracy"]))
     assert 0.0 <= acc <= 1.0
 
     overhead_pct = max(0.0, (t_fused - t_bare) / t_bare * 100.0)
-    print(
-        json.dumps(
-            {
-                "metric": "fused Accuracy+F1+ConfusionMatrix metric-update overhead per train step",
-                "value": round(overhead_pct, 3),
-                "unit": "%",
-                "vs_baseline": round(overhead_pct / 1.0, 3),
-            }
-        )
-    )
-    print(
-        f"# bare={t_bare*1e3:.3f} ms/step fused={t_fused*1e3:.3f} ms/step "
-        f"backend={jax.default_backend()} batch={BATCH} hidden={HIDDEN} classes={CLASSES}",
-        file=sys.stderr,
-    )
+    record = {
+        "metric": "fused Accuracy+F1+ConfusionMatrix metric-update overhead per train step",
+        "value": round(overhead_pct, 3),
+        "unit": "%",
+        "vs_baseline": round(overhead_pct / 1.0, 3),
+        "overhead_pct": round(overhead_pct, 3),
+        "bare_ms_per_step": round(t_bare * 1e3, 3),
+        "fused_ms_per_step": round(t_fused * 1e3, 3),
+        "backend": jax.default_backend(),
+        "config": {"batch": batch, "hidden": hidden, "classes": classes, "layers": layers, "steps": steps},
+    }
+    if degraded_reason:
+        record["degraded"] = f"accelerator unavailable, ran on host cpu: {degraded_reason}"
+    return record
+
+
+def main() -> None:
+    ok, detail = probe_accelerator()
+    degraded_reason = None if ok else detail
+    if not ok:
+        # Restrict jax to the host platform BEFORE any backend init in this process,
+        # otherwise the first jax op would hang on the same unreachable plugin.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        print(f"# accelerator probe failed ({detail}); falling back to cpu", file=sys.stderr)
+
+    try:
+        record = run_benchmark(degraded_reason)
+    except Exception as exc:  # noqa: BLE001 — artifact over stack trace, always
+        record = {
+            "metric": "fused Accuracy+F1+ConfusionMatrix metric-update overhead per train step",
+            "value": -1.0,
+            "unit": "%",
+            "vs_baseline": -1.0,
+            "error": f"{type(exc).__name__}: {exc}",
+        }
+        if degraded_reason:
+            record["degraded"] = f"accelerator unavailable: {degraded_reason}"
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

@@ -6,19 +6,114 @@ counterparts over 10 domains, built on one abstraction — a ``Metric`` whose st
 pytree of ``jax.Array``s, whose ``update``/``compute`` are pure jittable functions, and
 whose distributed sync lowers to XLA collectives (psum/pmean/pmax/pmin/all_gather) over
 named mesh axes instead of gather-then-reduce.
+
+Top-level export parity with the reference (src/torchmetrics/__init__.py:110-199,
+88 names). Optional-dependency metrics (FID/KID/IS/LPIPS, BERTScore, InfoLM, CLIPScore,
+PESQ, STOI, MeanAveragePrecision) live in their domain subpackages, mirroring the
+reference which also keeps them out of the top-level ``__all__``.
 """
 
 import logging as __logging
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 _logger = __logging.getLogger("metrics_tpu")
 _logger.addHandler(__logging.StreamHandler())
 _logger.setLevel(__logging.INFO)
 
+from metrics_tpu import functional  # noqa: E402
 from metrics_tpu.aggregation import CatMetric, MaxMetric, MeanMetric, MinMetric, SumMetric  # noqa: E402
+from metrics_tpu.audio import (  # noqa: E402
+    PermutationInvariantTraining,
+    ScaleInvariantSignalDistortionRatio,
+    ScaleInvariantSignalNoiseRatio,
+    SignalDistortionRatio,
+    SignalNoiseRatio,
+)
+from metrics_tpu.classification import (  # noqa: E402
+    AUROC,
+    ROC,
+    Accuracy,
+    AveragePrecision,
+    CalibrationError,
+    CohenKappa,
+    ConfusionMatrix,
+    Dice,
+    ExactMatch,
+    F1Score,
+    FBetaScore,
+    HammingDistance,
+    HingeLoss,
+    JaccardIndex,
+    MatthewsCorrCoef,
+    Precision,
+    PrecisionRecallCurve,
+    Recall,
+    Specificity,
+    StatScores,
+)
 from metrics_tpu.collections import MetricCollection  # noqa: E402
+from metrics_tpu.image import (  # noqa: E402
+    ErrorRelativeGlobalDimensionlessSynthesis,
+    MultiScaleStructuralSimilarityIndexMeasure,
+    PeakSignalNoiseRatio,
+    SpectralAngleMapper,
+    SpectralDistortionIndex,
+    StructuralSimilarityIndexMeasure,
+    TotalVariation,
+    UniversalImageQualityIndex,
+)
 from metrics_tpu.metric import CompositionalMetric, Metric  # noqa: E402
+from metrics_tpu.nominal import (  # noqa: E402
+    CramersV,
+    PearsonsContingencyCoefficient,
+    TheilsU,
+    TschuprowsT,
+)
+from metrics_tpu.regression import (  # noqa: E402
+    ConcordanceCorrCoef,
+    CosineSimilarity,
+    ExplainedVariance,
+    KendallRankCorrCoef,
+    KLDivergence,
+    LogCoshError,
+    MeanAbsoluteError,
+    MeanAbsolutePercentageError,
+    MeanSquaredError,
+    MeanSquaredLogError,
+    PearsonCorrCoef,
+    R2Score,
+    SpearmanCorrCoef,
+    SymmetricMeanAbsolutePercentageError,
+    TweedieDevianceScore,
+    WeightedMeanAbsolutePercentageError,
+)
+from metrics_tpu.retrieval import (  # noqa: E402
+    RetrievalFallOut,
+    RetrievalHitRate,
+    RetrievalMAP,
+    RetrievalMRR,
+    RetrievalNormalizedDCG,
+    RetrievalPrecision,
+    RetrievalPrecisionRecallCurve,
+    RetrievalRecall,
+    RetrievalRecallAtFixedPrecision,
+    RetrievalRPrecision,
+)
+from metrics_tpu.text import (  # noqa: E402
+    BLEUScore,
+    CharErrorRate,
+    CHRFScore,
+    ExtendedEditDistance,
+    MatchErrorRate,
+    Perplexity,
+    SacreBLEUScore,
+    SQuAD,
+    TranslationEditRate,
+    WordErrorRate,
+    WordInfoLost,
+    WordInfoPreserved,
+)
 from metrics_tpu.wrappers import (  # noqa: E402
     BootStrapper,
     ClasswiseWrapper,
@@ -28,17 +123,93 @@ from metrics_tpu.wrappers import (  # noqa: E402
 )
 
 __all__ = [
+    "functional",
+    "Accuracy",
+    "AUROC",
+    "AveragePrecision",
+    "BLEUScore",
     "BootStrapper",
+    "CalibrationError",
     "CatMetric",
     "ClasswiseWrapper",
+    "CharErrorRate",
+    "CHRFScore",
     "CompositionalMetric",
+    "ConcordanceCorrCoef",
+    "CohenKappa",
+    "ConfusionMatrix",
+    "CosineSimilarity",
+    "CramersV",
+    "Dice",
+    "TweedieDevianceScore",
+    "ErrorRelativeGlobalDimensionlessSynthesis",
+    "ExactMatch",
+    "ExplainedVariance",
+    "ExtendedEditDistance",
+    "F1Score",
+    "FBetaScore",
+    "HammingDistance",
+    "HingeLoss",
+    "JaccardIndex",
+    "KendallRankCorrCoef",
+    "KLDivergence",
+    "LogCoshError",
+    "MatchErrorRate",
+    "MatthewsCorrCoef",
     "MaxMetric",
+    "MeanAbsoluteError",
+    "MeanAbsolutePercentageError",
     "MeanMetric",
+    "MeanSquaredError",
+    "MeanSquaredLogError",
     "Metric",
     "MetricCollection",
     "MetricTracker",
     "MinMaxMetric",
     "MinMetric",
     "MultioutputWrapper",
+    "MultiScaleStructuralSimilarityIndexMeasure",
+    "PearsonCorrCoef",
+    "PearsonsContingencyCoefficient",
+    "PermutationInvariantTraining",
+    "Perplexity",
+    "Precision",
+    "PrecisionRecallCurve",
+    "PeakSignalNoiseRatio",
+    "R2Score",
+    "Recall",
+    "RetrievalFallOut",
+    "RetrievalHitRate",
+    "RetrievalMAP",
+    "RetrievalMRR",
+    "RetrievalNormalizedDCG",
+    "RetrievalPrecision",
+    "RetrievalRecall",
+    "RetrievalRPrecision",
+    "RetrievalPrecisionRecallCurve",
+    "RetrievalRecallAtFixedPrecision",
+    "ROC",
+    "SacreBLEUScore",
+    "SignalDistortionRatio",
+    "ScaleInvariantSignalDistortionRatio",
+    "ScaleInvariantSignalNoiseRatio",
+    "SignalNoiseRatio",
+    "SpearmanCorrCoef",
+    "Specificity",
+    "SpectralAngleMapper",
+    "SpectralDistortionIndex",
+    "SQuAD",
+    "StructuralSimilarityIndexMeasure",
+    "StatScores",
     "SumMetric",
+    "SymmetricMeanAbsolutePercentageError",
+    "TheilsU",
+    "TotalVariation",
+    "TranslationEditRate",
+    "TschuprowsT",
+    "UniversalImageQualityIndex",
+    "WeightedMeanAbsolutePercentageError",
+    "WordErrorRate",
+    "WordInfoLost",
+    "WordInfoPreserved",
 ]

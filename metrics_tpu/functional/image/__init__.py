@@ -2,6 +2,7 @@
 
 from metrics_tpu.functional.image.d_lambda import spectral_distortion_index
 from metrics_tpu.functional.image.ergas import error_relative_global_dimensionless_synthesis
+from metrics_tpu.functional.image.gradients import image_gradients
 from metrics_tpu.functional.image.psnr import peak_signal_noise_ratio
 from metrics_tpu.functional.image.sam import spectral_angle_mapper
 from metrics_tpu.functional.image.ssim import (
@@ -13,6 +14,7 @@ from metrics_tpu.functional.image.uqi import universal_image_quality_index
 
 __all__ = [
     "error_relative_global_dimensionless_synthesis",
+    "image_gradients",
     "multiscale_structural_similarity_index_measure",
     "peak_signal_noise_ratio",
     "spectral_angle_mapper",

@@ -1,0 +1,45 @@
+"""Image gradients via 1-step finite differences.
+
+TPU-native port of the reference ``image_gradients``
+(src/torchmetrics/functional/image/gradients.py:49): forward differences along H and W
+with a zero last row/column, matching the TF convention where the gradient
+``I(x+1, y) - I(x, y)`` lands at location ``(x, y)``. Pure jnp slicing + pad — XLA fuses
+this into two elementwise subtractions; no gather needed.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+
+def _image_gradients_validate(img: jnp.ndarray) -> None:
+    if not hasattr(img, "ndim"):
+        raise TypeError(f"The `img` expects an array type but got {type(img)}")
+    if img.ndim != 4:
+        raise RuntimeError(f"The `img` expects a 4D tensor but got {img.ndim}D tensor")
+
+
+def _compute_image_gradients(img: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    dy = img[..., 1:, :] - img[..., :-1, :]
+    dx = img[..., :, 1:] - img[..., :, :-1]
+    dy = jnp.pad(dy, ((0, 0), (0, 0), (0, 1), (0, 0)))
+    dx = jnp.pad(dx, ((0, 0), (0, 0), (0, 0), (0, 1)))
+    return dy, dx
+
+
+def image_gradients(img: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Compute per-pixel image gradients ``(dy, dx)`` of an ``(N, C, H, W)`` image.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional.image import image_gradients
+        >>> image = jnp.arange(0, 1 * 1 * 5 * 5, dtype=jnp.float32).reshape(1, 1, 5, 5)
+        >>> dy, dx = image_gradients(image)
+        >>> dy[0, 0, :2, :]
+        Array([[5., 5., 5., 5., 5.],
+               [5., 5., 5., 5., 5.]], dtype=float32)
+    """
+    _image_gradients_validate(img)
+    return _compute_image_gradients(img)

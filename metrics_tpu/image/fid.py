@@ -6,9 +6,10 @@ Reference parity: src/torchmetrics/image/fid.py (``NoTrainInceptionV3`` :41,
 O(d²) covariance, not O(N·d) features).
 
 TPU-native design:
-- ``feature`` accepts a **callable** ``imgs -> (N, d)`` (a jitted JAX model, a host
-  function, or any torch module) — the default integer mode needs ``torch-fidelity``
-  and is import-gated exactly like the reference (:150).
+- ``feature`` accepts an **int tap** (64/192/768/2048 — builds the in-repo flax
+  InceptionV3, ``image/inception_net.py``, replacing the reference's torch-fidelity
+  dependency) or a **callable** ``imgs -> (N, d)`` (a jitted JAX model, a host
+  function, or any torch module).
 - the matrix square root offers two backends: ``"scipy"`` (host, exact — what the
   reference uses) and ``"newton"`` (Newton–Schulz iterations, jittable, runs on TPU
   inside the compute graph; SURVEY §7.2.7).
@@ -26,7 +27,7 @@ from jax import Array
 import jax
 
 from metrics_tpu.metric import Metric
-from metrics_tpu.utils.imports import _SCIPY_AVAILABLE, _TORCH_FIDELITY_AVAILABLE
+from metrics_tpu.utils.imports import _SCIPY_AVAILABLE
 from metrics_tpu.utils.prints import rank_zero_info
 
 
@@ -59,6 +60,14 @@ def _compute_fid(mu1: Array, sigma1: Array, mu2: Array, sigma2: Array, eps: floa
     """d² = |μ1-μ2|² + Tr(Σ1 + Σ2 - 2·sqrt(Σ1·Σ2)) (reference :98-125)."""
     sqrtm = _sqrtm_scipy if sqrtm_backend == "scipy" else sqrtm_newton_schulz
     diff = mu1 - mu2
+    if sqrtm_backend == "newton":
+        # Newton–Schulz oscillates on singular products (rank-deficient covariances,
+        # e.g. fewer samples than feature dims). Regularising unconditionally keeps the
+        # path jittable — no data-dependent branch — and shifts the trace by O(d·√eps)
+        # at most, well below FID's meaningful resolution.
+        offset = jnp.eye(sigma1.shape[0], dtype=mu1.dtype) * eps
+        sigma1 = sigma1 + offset
+        sigma2 = sigma2 + offset
     covmean = sqrtm(sigma1 @ sigma2)
     if sqrtm_backend == "scipy" and not bool(jnp.all(jnp.isfinite(covmean))):
         rank_zero_info(f"FID calculation produces singular product; adding {eps} to diagonal of covariance estimates")
@@ -68,26 +77,29 @@ def _compute_fid(mu1: Array, sigma1: Array, mu2: Array, sigma2: Array, eps: floa
     return diff @ diff + jnp.trace(sigma1) + jnp.trace(sigma2) - 2 * tr_covmean
 
 
-def _resolve_feature_extractor(feature: Union[int, Callable]) -> tuple:
-    """Returns (extract_fn, num_features)."""
-    if isinstance(feature, int):
-        if not _TORCH_FIDELITY_AVAILABLE:
-            raise ModuleNotFoundError(
-                "Integer input to argument `feature` requires `torch-fidelity` installed."
-                " Either install with `pip install torch-fidelity` or pass a callable feature extractor"
-                " returning an (N, d) feature matrix."
-            )
-        valid_int_input = (64, 192, 768, 2048)
-        if feature not in valid_int_input:
-            raise ValueError(
-                f"Integer input to argument `feature` must be one of {valid_int_input}, but got {feature}."
-            )
-        from torch_fidelity.feature_extractor_inceptionv3 import FeatureExtractorInceptionV3  # pragma: no cover
+def _resolve_feature_extractor(feature: Union[int, str, Callable]) -> tuple:
+    """Returns (extract_fn, num_features).
 
-        raise NotImplementedError  # pragma: no cover - torch-fidelity absent in this environment
+    Integer (64/192/768/2048) and string ("logits_unbiased") inputs build the in-repo
+    flax InceptionV3 (``image/inception_net.py``) — the TPU-native replacement for the
+    reference's torch-fidelity ``NoTrainInceptionV3`` (src/torchmetrics/image/fid.py:41).
+    A callable is used as-is and must return an ``(N, d)`` feature matrix.
+    """
+    if isinstance(feature, (int, str)) and not isinstance(feature, bool):
+        from metrics_tpu.image.inception_net import FEATURE_DIMS, InceptionFeatureExtractor
+
+        if feature not in FEATURE_DIMS:
+            valid_int_input = tuple(k for k in FEATURE_DIMS if isinstance(k, int))
+            valid_str_input = tuple(k for k in FEATURE_DIMS if isinstance(k, str))
+            raise ValueError(
+                f"Input to argument `feature` must be one of {valid_int_input} (feature taps)"
+                f" or {valid_str_input} (logit heads), but got {feature!r}."
+            )
+        extractor = InceptionFeatureExtractor(feature)
+        return extractor, extractor.num_features
     if callable(feature):
         return feature, None
-    raise TypeError("Got unknown input to argument `feature`: expected an int or a callable")
+    raise TypeError("Got unknown input to argument `feature`: expected an int, a str or a callable")
 
 
 class FrechetInceptionDistance(Metric):

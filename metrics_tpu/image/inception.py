@@ -32,9 +32,10 @@ class InceptionScore(Metric):
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
-        if isinstance(feature, str):
-            # the reference's default inception logits need torch-fidelity
-            feature = 2048  # routes into the import-gated branch below
+        if isinstance(feature, str) and feature not in ("logits", "logits_unbiased"):
+            raise ValueError(
+                f"Input to argument `feature` must be 'logits'/'logits_unbiased', an int or a callable, got {feature}"
+            )
         self.extractor, _ = _resolve_feature_extractor(feature)
         if not (isinstance(splits, int) and splits > 0):
             raise ValueError("Argument `splits` expected to be integer larger than 0")

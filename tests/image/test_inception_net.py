@@ -1,0 +1,119 @@
+"""Flax InceptionV3 feature extractor tests (VERDICT round-1 item 3).
+
+The reference ships a working out-of-the-box integer-``feature`` path for FID/KID/IS
+via torch-fidelity's InceptionV3 (src/torchmetrics/image/fid.py:41). These tests pin
+the TPU-native replacement: end-to-end integer-feature metrics, every tap's shape,
+offline npz weight round-trips, and determinism across extractor instances.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.image.fid import FrechetInceptionDistance
+from metrics_tpu.image.inception import InceptionScore
+from metrics_tpu.image.inception_net import (
+    FEATURE_DIMS,
+    InceptionFeatureExtractor,
+    init_params,
+    load_params,
+    save_params,
+)
+from metrics_tpu.image.kid import KernelInceptionDistance
+
+
+def _imgs(n, seed=0, size=32):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.integers(0, 255, size=(n, 3, size, size), dtype=np.uint8))
+
+
+@pytest.mark.parametrize("feature", [64, 192, 768, 2048, "logits", "logits_unbiased"])
+def test_extractor_output_shapes(feature):
+    extractor = InceptionFeatureExtractor(feature)
+    out = np.asarray(extractor(_imgs(2)))
+    assert out.shape == (2, FEATURE_DIMS[feature])
+    assert np.all(np.isfinite(out))
+
+
+def test_extractor_deterministic_across_instances():
+    a = InceptionFeatureExtractor(64)
+    b = InceptionFeatureExtractor(64)
+    imgs = _imgs(2, seed=1)
+    np.testing.assert_allclose(np.asarray(a(imgs)), np.asarray(b(imgs)), atol=1e-6)
+
+
+def test_extractor_rejects_bad_feature():
+    with pytest.raises(ValueError, match="feature"):
+        InceptionFeatureExtractor(100)
+
+
+def test_weights_roundtrip(tmp_path):
+    variables = init_params(seed=3)
+    path = str(tmp_path / "inception.npz")
+    save_params(variables, path)
+    reloaded = load_params(path)
+
+    import jax
+
+    leaves_a = jax.tree_util.tree_leaves(variables)
+    leaves_b = jax.tree_util.tree_leaves(reloaded)
+    assert len(leaves_a) == len(leaves_b) > 100  # the full net, not a stub
+    for la, lb in zip(leaves_a, leaves_b):
+        np.testing.assert_allclose(np.asarray(la), np.asarray(lb))
+
+    # a file-loaded extractor produces identical features to the default one when the
+    # file holds the same (seeded) weights
+    from metrics_tpu.image import inception_net
+
+    inception_net._cached_variables.cache_clear()
+    default = InceptionFeatureExtractor(64, seed=3)
+    from_file = InceptionFeatureExtractor(64, weights_path=path)
+    imgs = _imgs(2, seed=2)
+    np.testing.assert_allclose(np.asarray(default(imgs)), np.asarray(from_file(imgs)), atol=1e-6)
+
+
+def test_weights_env_var(tmp_path, monkeypatch):
+    path = str(tmp_path / "env_weights.npz")
+    save_params(init_params(seed=7), path)
+    from metrics_tpu.image import inception_net
+
+    inception_net._cached_variables.cache_clear()
+    monkeypatch.setenv("METRICS_TPU_INCEPTION_WEIGHTS", path)
+    extractor = InceptionFeatureExtractor(64)
+    assert np.asarray(extractor(_imgs(1))).shape == (1, 64)
+    inception_net._cached_variables.cache_clear()
+
+
+def test_missing_weights_file_raises():
+    with pytest.raises(FileNotFoundError):
+        InceptionFeatureExtractor(64, weights_path="/nonexistent/weights.npz")
+
+
+def test_fid_integer_feature_end_to_end():
+    fid = FrechetInceptionDistance(feature=64, sqrtm_backend="newton")
+    fid.update(_imgs(12, seed=0), real=True)
+    fid.update(_imgs(12, seed=1), real=False)
+    val = float(fid.compute())
+    assert np.isfinite(val) and val >= 0.0
+
+    # same distribution on both sides -> FID ~ 0
+    fid2 = FrechetInceptionDistance(feature=64, sqrtm_backend="newton")
+    same = _imgs(12, seed=0)
+    fid2.update(same, real=True)
+    fid2.update(same, real=False)
+    assert abs(float(fid2.compute())) < 1e-1
+
+
+def test_kid_integer_feature_end_to_end():
+    kid = KernelInceptionDistance(feature=64, subset_size=6, subsets=2)
+    kid.update(_imgs(8, seed=0), real=True)
+    kid.update(_imgs(8, seed=1), real=False)
+    mean, std = kid.compute()
+    assert np.isfinite(float(mean)) and np.isfinite(float(std))
+
+
+def test_inception_score_default_feature_end_to_end():
+    inception = InceptionScore(splits=2)
+    inception.update(_imgs(8, seed=0))
+    mean, std = inception.compute()
+    assert np.isfinite(float(mean)) and float(mean) > 0.0
