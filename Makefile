@@ -1,0 +1,24 @@
+.PHONY: test doctest clean env multichip bench
+
+# Test suite on the 8-virtual-device CPU mesh (tests/conftest.py pins the platform).
+test:
+	python -m pytest tests/ -q
+
+# Docstring examples across the package (reference runs --doctest-modules over src/,
+# /root/reference/Makefile:23-31 + pyproject.toml:28-33).
+doctest:
+	JAX_PLATFORMS=cpu python -m pytest --doctest-modules metrics_tpu/ -q --ignore=metrics_tpu/functional/text/bert.py
+
+# Driver-facing artifacts.
+multichip:
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 python -c "import __graft_entry__; __graft_entry__.dryrun_multichip(8); print('multichip OK')"
+
+bench:
+	python bench.py
+
+env:
+	pip install -e ".[test]"
+
+clean:
+	rm -rf build dist *.egg-info .pytest_cache
+	find . -name __pycache__ -type d -exec rm -rf {} +

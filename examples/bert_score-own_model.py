@@ -1,0 +1,62 @@
+"""BERTScore with a user-provided (Flax) model, tokenizer and forward function —
+no pretrained download needed.
+
+TPU-native analogue of the reference examples/bert_score-own_model.py. To run:
+JAX_PLATFORMS=cpu python bert_score-own_model.py
+"""
+
+import zlib
+from pprint import pprint
+
+import numpy as np
+
+from metrics_tpu.functional.text.bert import bert_score
+
+_MODEL_DIM = 16
+_MAX_LEN = 12
+_VOCAB = 50
+
+preds = ["hello there", "general kenobi"]
+target = ["hello there", "master kenobi"]
+
+
+class UserTokenizer:
+    """Must be callable as tokenizer(text, ...) -> {"input_ids", "attention_mask"}."""
+
+    cls_token_id, sep_token_id, pad_token_id = 1, 2, 0
+
+    def __call__(self, text, padding=None, truncation=True, max_length=_MAX_LEN, return_tensors="np"):
+        ids_batch, mask_batch = [], []
+        for sentence in text:
+            # crc32, not hash(): Python salts hash() per process, which would make
+            # the example's scores change between runs
+            words = [3 + (zlib.crc32(w.encode()) % (_VOCAB - 3)) for w in sentence.split()]
+            ids = [self.cls_token_id] + words[: max_length - 2] + [self.sep_token_id]
+            mask = [1] * len(ids) + [0] * (max_length - len(ids))
+            ids_batch.append(ids + [self.pad_token_id] * (max_length - len(ids)))
+            mask_batch.append(mask)
+        return {"input_ids": np.asarray(ids_batch), "attention_mask": np.asarray(mask_batch)}
+
+
+class UserModel:
+    """Any object works as the model — the forward fn below defines how it is called."""
+
+    def __init__(self, seed: int = 0) -> None:
+        rng = np.random.default_rng(seed)
+        self.embeddings = rng.normal(size=(_VOCAB, _MODEL_DIM)).astype(np.float32)
+
+
+def user_forward_fn(model: UserModel, batch: dict) -> np.ndarray:
+    """Must return token embeddings of shape [batch, seq, dim]."""
+    return model.embeddings[batch["input_ids"]]
+
+
+if __name__ == "__main__":
+    score = bert_score(
+        preds,
+        target,
+        model=UserModel(),
+        user_tokenizer=UserTokenizer(),
+        user_forward_fn=user_forward_fn,
+    )
+    pprint(score)

@@ -1,0 +1,34 @@
+"""How preds/target are structured for the MeanAveragePrecision detection metric.
+
+TPU-native analogue of the reference examples/detection_map.py. To run:
+JAX_PLATFORMS=cpu python detection_map.py
+"""
+
+from pprint import pprint
+
+import jax.numpy as jnp
+
+from metrics_tpu.detection.mean_ap import MeanAveragePrecision
+
+# Preds: one dict per image with boxes [N,4] (xmin, ymin, xmax, ymax, absolute
+# coordinates), confidence scores [N], and integer labels [N].
+preds = [
+    {
+        "boxes": jnp.asarray([[258.0, 41.0, 606.0, 285.0]]),
+        "scores": jnp.asarray([0.536]),
+        "labels": jnp.asarray([0], dtype=jnp.int32),
+    }
+]
+
+# Target: one dict per image with ground-truth boxes [M,4] and labels [M].
+target = [
+    {
+        "boxes": jnp.asarray([[214.0, 41.0, 562.0, 285.0]]),
+        "labels": jnp.asarray([0], dtype=jnp.int32),
+    }
+]
+
+if __name__ == "__main__":
+    metric = MeanAveragePrecision()
+    metric.update(preds, target)
+    pprint(metric.compute())

@@ -1,0 +1,62 @@
+"""Examples of Metric.plot() across scalar, per-class and time-series values.
+
+TPU-native analogue of the reference examples/plotting.py. To run:
+JAX_PLATFORMS=cpu python plotting.py <out_dir>
+"""
+
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.classification import MulticlassAccuracy, MulticlassConfusionMatrix
+from metrics_tpu.utils.plot import plot_confusion_matrix
+
+
+def scalar_plot(out_dir: str) -> None:
+    """One accuracy value as a dot with [0, 1] bounds."""
+    metric = MulticlassAccuracy(num_classes=5, average="micro")
+    rng = np.random.default_rng(0)
+    metric.update(jnp.asarray(rng.integers(0, 5, 100)), jnp.asarray(rng.integers(0, 5, 100)))
+    fig, _ = metric.plot()
+    fig.savefig(f"{out_dir}/accuracy_scalar.png")
+
+
+def per_class_plot(out_dir: str) -> None:
+    """Per-class accuracy vector — one dot per class."""
+    metric = MulticlassAccuracy(num_classes=5, average=None)
+    metric.plot_legend_name = "Class"
+    rng = np.random.default_rng(1)
+    metric.update(jnp.asarray(rng.integers(0, 5, 200)), jnp.asarray(rng.integers(0, 5, 200)))
+    fig, _ = metric.plot()
+    fig.savefig(f"{out_dir}/accuracy_per_class.png")
+
+
+def time_series_plot(out_dir: str) -> None:
+    """Accuracy over training steps — pass a list of computed values."""
+    metric = MulticlassAccuracy(num_classes=5, average="micro")
+    rng = np.random.default_rng(2)
+    values = []
+    for _ in range(6):
+        metric.reset()
+        metric.update(jnp.asarray(rng.integers(0, 5, 50)), jnp.asarray(rng.integers(0, 5, 50)))
+        values.append(metric.compute())
+    fig, _ = metric.plot(values)
+    fig.savefig(f"{out_dir}/accuracy_over_time.png")
+
+
+def confusion_matrix_plot(out_dir: str) -> None:
+    metric = MulticlassConfusionMatrix(num_classes=4)
+    rng = np.random.default_rng(3)
+    metric.update(jnp.asarray(rng.integers(0, 4, 300)), jnp.asarray(rng.integers(0, 4, 300)))
+    fig, _ = plot_confusion_matrix(metric.compute())
+    fig.savefig(f"{out_dir}/confusion_matrix.png")
+
+
+if __name__ == "__main__":
+    out = sys.argv[1] if len(sys.argv) > 1 else "."
+    scalar_plot(out)
+    per_class_plot(out)
+    time_series_plot(out)
+    confusion_matrix_plot(out)
+    print(f"wrote 4 figures to {out}/")

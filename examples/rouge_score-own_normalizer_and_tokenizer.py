@@ -1,0 +1,40 @@
+"""ROUGEScore with a user-defined normalizer and tokenizer (e.g. for non-alphabet
+languages).
+
+TPU-native analogue of the reference examples/rouge_score-own_normalizer_and_tokenizer.py.
+To run: JAX_PLATFORMS=cpu python rouge_score-own_normalizer_and_tokenizer.py
+"""
+
+import re
+from pprint import pprint
+from typing import Sequence
+
+from metrics_tpu.text.rouge import ROUGEScore
+
+
+class UserNormalizer:
+    """Normalizes raw text before tokenization; must be str -> str."""
+
+    def __init__(self) -> None:
+        self.pattern = r"[^a-z0-9]+"
+
+    def __call__(self, text: str) -> str:
+        return re.sub(self.pattern, " ", text.lower())
+
+
+class UserTokenizer:
+    """Splits normalized text into tokens; must be str -> Sequence[str]."""
+
+    pattern = r"\s+"
+
+    def __call__(self, text: str) -> Sequence[str]:
+        return re.split(self.pattern, text.strip())
+
+
+if __name__ == "__main__":
+    preds = "My name is John".lower()
+    target = "Is your name John".lower()
+
+    rouge = ROUGEScore(normalizer=UserNormalizer(), tokenizer=UserTokenizer())
+    rouge.update(preds, target)
+    pprint(rouge.compute())

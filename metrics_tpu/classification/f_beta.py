@@ -24,6 +24,8 @@ class BinaryFBetaScore(BinaryStatScores):
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
 
     def __init__(self, beta: float, threshold: float = 0.5, multidim_average: str = "global",
                  ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> None:
@@ -44,6 +46,8 @@ class MulticlassFBetaScore(MulticlassStatScores):
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
 
     def __init__(self, beta: float, num_classes: int, top_k: int = 1, average: Optional[str] = "macro",
                  multidim_average: str = "global", ignore_index: Optional[int] = None,
@@ -65,6 +69,8 @@ class MultilabelFBetaScore(MultilabelStatScores):
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
 
     def __init__(self, beta: float, num_labels: int, threshold: float = 0.5, average: Optional[str] = "macro",
                  multidim_average: str = "global", ignore_index: Optional[int] = None,

@@ -16,6 +16,8 @@ class BinaryHammingDistance(BinaryStatScores):
     is_differentiable = False
     higher_is_better = False
     full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
 
     def compute(self) -> Array:
         tp, fp, tn, fn = self._final_state()
@@ -26,6 +28,8 @@ class MulticlassHammingDistance(MulticlassStatScores):
     is_differentiable = False
     higher_is_better = False
     full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
 
     def compute(self) -> Array:
         tp, fp, tn, fn = self._final_state()
@@ -36,6 +40,8 @@ class MultilabelHammingDistance(MultilabelStatScores):
     is_differentiable = False
     higher_is_better = False
     full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
 
     def compute(self) -> Array:
         tp, fp, tn, fn = self._final_state()

@@ -20,6 +20,8 @@ class BinaryJaccardIndex(BinaryConfusionMatrix):
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
 
     def compute(self) -> Array:
         return _jaccard_index_reduce(self.confmat, average="binary")
@@ -29,6 +31,8 @@ class MulticlassJaccardIndex(MulticlassConfusionMatrix):
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
 
     def __init__(
         self,
@@ -49,6 +53,8 @@ class MultilabelJaccardIndex(MultilabelConfusionMatrix):
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
 
     def __init__(
         self,

@@ -19,6 +19,8 @@ class BinaryPrecision(BinaryStatScores):
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
 
     def compute(self) -> Array:
         tp, fp, tn, fn = self._final_state()
@@ -29,6 +31,8 @@ class MulticlassPrecision(MulticlassStatScores):
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
 
     def compute(self) -> Array:
         tp, fp, tn, fn = self._final_state()
@@ -39,6 +43,8 @@ class MultilabelPrecision(MultilabelStatScores):
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
 
     def compute(self) -> Array:
         tp, fp, tn, fn = self._final_state()
@@ -49,6 +55,8 @@ class BinaryRecall(BinaryStatScores):
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
 
     def compute(self) -> Array:
         tp, fp, tn, fn = self._final_state()
@@ -59,6 +67,8 @@ class MulticlassRecall(MulticlassStatScores):
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
 
     def compute(self) -> Array:
         tp, fp, tn, fn = self._final_state()
@@ -69,6 +79,8 @@ class MultilabelRecall(MultilabelStatScores):
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
 
     def compute(self) -> Array:
         tp, fp, tn, fn = self._final_state()

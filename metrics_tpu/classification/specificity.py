@@ -16,6 +16,8 @@ class BinarySpecificity(BinaryStatScores):
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
 
     def compute(self) -> Array:
         tp, fp, tn, fn = self._final_state()
@@ -26,6 +28,8 @@ class MulticlassSpecificity(MulticlassStatScores):
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
 
     def compute(self) -> Array:
         tp, fp, tn, fn = self._final_state()
@@ -36,6 +40,8 @@ class MultilabelSpecificity(MultilabelStatScores):
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
 
     def compute(self) -> Array:
         tp, fp, tn, fn = self._final_state()

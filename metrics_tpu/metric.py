@@ -90,6 +90,11 @@ class Metric(ABC):
     # True when compute() cannot run inside a trace (data-dependent shapes) — e.g.
     # exact-mode curve metrics; sync still works in-trace, compute happens on host.
     _host_compute: bool = False
+    # Metric.plot() bounds/legend (reference utilities/plot.py:43 consumers); subclasses
+    # with a known value range override these so the optimal value renders on the figure.
+    plot_lower_bound: Optional[float] = None
+    plot_upper_bound: Optional[float] = None
+    plot_legend_name: Optional[str] = None
 
     def __init__(self, **kwargs: Any) -> None:
         self._device = None
@@ -533,6 +538,26 @@ class Metric(ABC):
     def clone(self) -> "Metric":
         """Deep copy of the metric (reference metric.py:582-585)."""
         return deepcopy(self)
+
+    def plot(self, val: Optional[Any] = None, ax: Optional[Any] = None) -> Any:
+        """Plot a single computed value or a list of values as a time series.
+
+        Reference surface: ``Metric.plot`` (metric.py:562-564) backed by
+        ``utilities/plot.py:43``. With ``val=None`` the current ``compute()`` result is
+        plotted. Requires matplotlib; returns ``(fig, ax)``.
+        """
+        from metrics_tpu.utils.plot import plot_single_or_multi_val
+
+        val = val if val is not None else self.compute()
+        return plot_single_or_multi_val(
+            val,
+            ax=ax,
+            higher_is_better=self.higher_is_better,
+            lower_bound=self.plot_lower_bound,
+            upper_bound=self.plot_upper_bound,
+            legend_name=self.plot_legend_name,
+            name=self.__class__.__name__,
+        )
 
     def to_device(self, device: Any) -> "Metric":
         """Move all states (and defaults) to ``device`` (reference ``_apply``)."""

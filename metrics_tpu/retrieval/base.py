@@ -116,6 +116,8 @@ class RetrievalMetric(Metric):
     is_differentiable: bool = False
     higher_is_better: Optional[bool] = True
     full_state_update: bool = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
 
     allow_non_binary_target: bool = False
     # which per-query count must be non-zero for the query to be "non-empty"

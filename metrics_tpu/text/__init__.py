@@ -5,6 +5,7 @@ from metrics_tpu.text.bleu import BLEUScore
 from metrics_tpu.text.cer import CharErrorRate
 from metrics_tpu.text.chrf import CHRFScore
 from metrics_tpu.text.eed import ExtendedEditDistance
+from metrics_tpu.text.infolm import InfoLM
 from metrics_tpu.text.mer import MatchErrorRate
 from metrics_tpu.text.perplexity import Perplexity
 from metrics_tpu.text.rouge import ROUGEScore
@@ -21,6 +22,7 @@ __all__ = [
     "CharErrorRate",
     "CHRFScore",
     "ExtendedEditDistance",
+    "InfoLM",
     "MatchErrorRate",
     "Perplexity",
     "ROUGEScore",

@@ -1,0 +1,126 @@
+"""InfoLM module metric (reference src/torchmetrics/text/infolm.py:37).
+
+Stateful shell over the functional InfoLM (``functional/text/infolm.py``): tokenized
+sentences accumulate as ragged "cat" states (mirroring the reference's four
+``dist_reduce_fx="cat"`` states, infolm.py:148-151) and the masked-LM runs once at
+``compute``. TPU extension over the reference: a Flax masked-LM ``model`` +
+``user_tokenizer`` can be injected directly (like BERTScore) for offline use.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.functional.text.infolm import (
+    _ALLOWED_INFORMATION_MEASURE,
+    _DEFAULT_INFOLM_MODEL,
+    _get_special_tokens_map,
+    _infolm_compute,
+    _infolm_update,
+    _InformationMeasure,
+    _load_tokenizer_and_model,
+)
+from metrics_tpu.metric import Metric
+
+__all__ = ["InfoLM"]
+
+
+class InfoLM(Metric):
+    """Information-measure distance between predicted and reference sentence
+    distributions under an untrained masked language model (Colombo et al., AAAI 2022).
+
+    Args mirror the reference class (text/infolm.py:107-128); ``model`` /
+    ``user_tokenizer`` additionally allow injecting a Flax MLM + tokenizer pair so no
+    pretrained download is needed.
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    _host_compute = True  # string tokenization + chunked model forwards on host
+
+    preds_input_ids: List[Array]
+    preds_attention_mask: List[Array]
+    target_input_ids: List[Array]
+    target_attention_mask: List[Array]
+
+    def __init__(
+        self,
+        model_name_or_path: str = _DEFAULT_INFOLM_MODEL,
+        temperature: float = 0.25,
+        information_measure: str = "kl_divergence",
+        idf: bool = True,
+        alpha: Optional[float] = None,
+        beta: Optional[float] = None,
+        device: Optional[Any] = None,
+        max_length: Optional[int] = None,
+        batch_size: int = 64,
+        num_threads: int = 0,
+        verbose: bool = True,
+        return_sentence_level_score: bool = False,
+        model: Optional[Any] = None,
+        user_tokenizer: Optional[Any] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if (model is None) != (user_tokenizer is None):
+            raise ValueError("Arguments `model` and `user_tokenizer` must be provided together (or both omitted).")
+        if temperature <= 0:
+            raise ValueError(f"Argument `temperature` expected to be a positive float, got {temperature}")
+        self.model_name_or_path = model_name_or_path
+        self.temperature = temperature
+        self.information_measure = information_measure
+        self.idf = idf
+        self.alpha = alpha
+        self.beta = beta
+        self.batch_size = batch_size
+        self.num_threads = num_threads
+        self.verbose = verbose
+        self.return_sentence_level_score = return_sentence_level_score
+
+        if model is None:
+            self.tokenizer, self.model = _load_tokenizer_and_model(model_name_or_path)
+        else:
+            self.tokenizer, self.model = user_tokenizer, model
+        self.information_measure_cls = _InformationMeasure(information_measure, alpha, beta)
+        self.max_length = max_length or self.model.config.max_length
+        self.special_tokens_map = _get_special_tokens_map(self.tokenizer)
+
+        self.add_state("preds_input_ids", [], dist_reduce_fx="cat")
+        self.add_state("preds_attention_mask", [], dist_reduce_fx="cat")
+        self.add_state("target_input_ids", [], dist_reduce_fx="cat")
+        self.add_state("target_attention_mask", [], dist_reduce_fx="cat")
+
+    def update(self, preds: Union[str, Sequence[str]], target: Union[str, Sequence[str]]) -> None:
+        """Tokenize and accumulate preds/target id+mask batches (reference :153-162)."""
+        preds_input_ids, preds_attention_mask, target_input_ids, target_attention_mask = _infolm_update(
+            preds, target, self.tokenizer, self.max_length
+        )
+        self.preds_input_ids.append(jnp.asarray(preds_input_ids))
+        self.preds_attention_mask.append(jnp.asarray(preds_attention_mask))
+        self.target_input_ids.append(jnp.asarray(target_input_ids))
+        self.target_attention_mask.append(jnp.asarray(target_attention_mask))
+
+    @staticmethod
+    def _cat(chunks: List[Array]) -> np.ndarray:
+        return np.concatenate([np.asarray(c) for c in chunks], axis=0)
+
+    def compute(self) -> Union[Array, Tuple[Array, Array]]:
+        """Run the masked-LM over all accumulated sentences and score (reference :164-197)."""
+        scores = _infolm_compute(
+            self.model,
+            (self._cat(self.preds_input_ids), self._cat(self.preds_attention_mask)),
+            (self._cat(self.target_input_ids), self._cat(self.target_attention_mask)),
+            self.temperature,
+            self.idf,
+            self.information_measure_cls,
+            self.special_tokens_map,
+            self.batch_size,
+        )
+        if self.return_sentence_level_score:
+            return scores.mean(), scores
+        return scores.mean()

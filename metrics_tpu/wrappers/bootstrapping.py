@@ -3,12 +3,22 @@
 Reference parity: src/torchmetrics/wrappers/bootstrapping.py (:25 class, :48 init,
 resampling per update :117-134). Each update resamples the batch (poisson weights or
 multinomial indices) once per bootstrap copy.
+
+TPU-native redesign (SURVEY §7.2-4): with ``sampling_strategy="multinomial"`` the
+resample is fixed-shape — an ``(num_bootstraps, batch)`` index matrix — so instead of
+the reference's N deep-copied metrics each dispatching their own update, ONE state
+pytree stacked along a leading bootstrap axis is updated by a single ``jax.vmap`` of
+the pure ``update_state``: one XLA dispatch for all copies, and the whole thing can sit
+inside a jitted train step. Poisson resampling (ragged multiplicities), host-compute
+metrics and ragged "cat" states keep the reference's per-copy loop; if the vmapped
+update turns out untraceable for a given base metric (e.g. ``validate_args=True``
+doing data-dependent Python checks) the instance permanently falls back to the loop.
 """
 
 from __future__ import annotations
 
 from copy import deepcopy
-from typing import Any, Dict, Optional, Sequence, Union
+from typing import Any, Dict, Optional, Union
 
 import jax
 import jax.numpy as jnp
@@ -54,7 +64,6 @@ class BootStrapper(Metric):
             raise ValueError(
                 f"Expected base metric to be an instance of metrics_tpu.Metric but received {base_metric}"
             )
-        self.metrics = [deepcopy(base_metric) for _ in range(num_bootstraps)]
         self.num_bootstraps = num_bootstraps
 
         self.mean = mean
@@ -71,17 +80,68 @@ class BootStrapper(Metric):
         self.sampling_strategy = sampling_strategy
         self._rng = np.random.default_rng(seed)
 
-    def update(self, *args: Any, **kwargs: Any) -> None:
-        """Resample the batch once per bootstrap copy (reference :117-134)."""
+        self.base_metric = base_metric
+        has_list_state = any(isinstance(d, list) for d in base_metric._defaults.values())
+        self._use_vmap = (
+            sampling_strategy == "multinomial"
+            and not getattr(base_metric, "_host_compute", False)
+            and not has_list_state
+        )
+        if self._use_vmap:
+            self.metrics = []  # no copies needed — state carries the bootstrap axis
+            self._stacked_state = self._init_stacked_state()
+        else:
+            self.metrics = [deepcopy(base_metric) for _ in range(num_bootstraps)]
+
+    def _init_stacked_state(self) -> Dict[str, Any]:
+        base = self.base_metric.init_state()
+        return jax.tree.map(lambda x: jnp.broadcast_to(x, (self.num_bootstraps,) + x.shape), base)
+
+    def _vmap_update(self, *args: Any, **kwargs: Any) -> bool:
+        """Single vmapped update over the stacked state. Returns False if untraceable."""
+        size = self._batch_size(args, kwargs)
+        # One (N, size) draw fills row-major, so row i equals the i-th sequential draw
+        # the reference loop would have made — bit-identical resampling streams.
+        indices = jnp.asarray(self._rng.integers(0, size, (self.num_bootstraps, size)))
+
+        def one_copy(state: Dict[str, Any], idx: Array) -> Dict[str, Any]:
+            new_args = apply_to_collection(args, jax.Array, jnp.take, idx, axis=0)
+            new_kwargs = apply_to_collection(kwargs, jax.Array, jnp.take, idx, axis=0)
+            return self.base_metric.update_state(state, *new_args, **new_kwargs)
+
+        try:
+            self._stacked_state = jax.vmap(one_copy)(self._stacked_state, indices)
+        except (TypeError, IndexError):
+            # TypeError covers TracerBoolConversionError/ConcretizationTypeError;
+            # IndexError covers NonConcreteBooleanIndexError (data-dependent boolean
+            # masking). A genuine bug in the base metric's update is NOT masked: the
+            # fallback loop re-runs the same update eagerly and re-raises it there.
+            return False
+        return True
+
+    def _batch_size(self, args: Any, kwargs: Any) -> int:
         args_sizes = apply_to_collection(args, jax.Array, len)
         kwargs_sizes = apply_to_collection(kwargs, jax.Array, len)
         if len(args_sizes) > 0:
-            size = jax.tree.leaves(args_sizes)[0]
-        elif len(kwargs_sizes) > 0:
-            size = jax.tree.leaves(kwargs_sizes)[0]
-        else:
-            raise ValueError("None of the input contained tensors, so could not determine the sampling size")
+            return jax.tree.leaves(args_sizes)[0]
+        if len(kwargs_sizes) > 0:
+            return jax.tree.leaves(kwargs_sizes)[0]
+        raise ValueError("None of the input contained tensors, so could not determine the sampling size")
 
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Resample the batch once per bootstrap copy (reference :117-134)."""
+        if self._use_vmap:
+            if self._vmap_update(*args, **kwargs):
+                return
+            # permanent fallback: materialise the per-copy metrics from the stacked
+            # state accumulated so far, then continue with the reference loop
+            self._use_vmap = False
+            self.metrics = [deepcopy(self.base_metric) for _ in range(self.num_bootstraps)]
+            for i, m in enumerate(self.metrics):
+                m._swap_in(jax.tree.map(lambda x: x[i], self._stacked_state))
+            del self._stacked_state
+
+        size = self._batch_size(args, kwargs)
         for idx in range(self.num_bootstraps):
             sample_idx = _bootstrap_sampler(size, self.sampling_strategy, self._rng)
             if sample_idx.size == 0:
@@ -90,9 +150,44 @@ class BootStrapper(Metric):
             new_kwargs = apply_to_collection(kwargs, jax.Array, jnp.take, sample_idx, axis=0)
             self.metrics[idx].update(*new_args, **new_kwargs)
 
+    def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Array]:
+        """Accumulate globally AND return the batch-only bootstrap statistics.
+
+        Overrides ``Metric.forward``: the generic full-state path caches only
+        registered states (``_defaults``), which would silently drop the wrapper-held
+        ``_stacked_state`` / child-metric states across its reset — so the
+        cache/reset/restore dance is done here over the wrapper's real state.
+        """
+        self.update(*args, **kwargs)
+
+        if self._use_vmap:
+            cache = self._stacked_state
+            self._stacked_state = self._init_stacked_state()
+        else:
+            cache = [m._swap_in(m.init_state()) for m in self.metrics]  # reset, keep snapshot
+
+        try:
+            self.update(*args, **kwargs)
+            self._computed = None
+            batch_value = self.compute()
+        finally:
+            if self._use_vmap:
+                self._stacked_state = cache
+            else:
+                for m, snapshot in zip(self.metrics, cache):
+                    m._swap_in(snapshot)
+                    m._computed = None  # drop the batch-value cache along with the state
+            self._computed = None
+        return batch_value
+
     def compute(self) -> Dict[str, Array]:
         """mean/std/quantile/raw over bootstrap computes (reference :136-…)."""
-        computed_vals = jnp.stack([jnp.asarray(m.compute()) for m in self.metrics], axis=0)
+        if self._use_vmap:
+            computed_vals = jax.vmap(lambda s: jnp.asarray(self.base_metric.compute_from(s)))(
+                self._stacked_state
+            )
+        else:
+            computed_vals = jnp.stack([jnp.asarray(m.compute()) for m in self.metrics], axis=0)
         output_dict = {}
         if self.mean:
             output_dict["mean"] = jnp.mean(computed_vals, axis=0)
@@ -105,6 +200,8 @@ class BootStrapper(Metric):
         return output_dict
 
     def reset(self) -> None:
+        if self._use_vmap:
+            self._stacked_state = self._init_stacked_state()
         for m in self.metrics:
             m.reset()
         super().reset()

@@ -1,0 +1,86 @@
+"""Metric.plot() / utils.plot tests (reference utilities/plot.py:43, metric.py:562)."""
+
+import matplotlib
+
+matplotlib.use("Agg")
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.classification import MulticlassAccuracy, MulticlassConfusionMatrix
+from metrics_tpu.utils.plot import _get_col_row_split, plot_confusion_matrix, plot_single_or_multi_val
+
+
+def _fitted(average="micro"):
+    m = MulticlassAccuracy(num_classes=4, average=average)
+    rng = np.random.default_rng(0)
+    m.update(jnp.asarray(rng.integers(0, 4, 100)), jnp.asarray(rng.integers(0, 4, 100)))
+    return m
+
+
+def test_plot_scalar():
+    fig, ax = _fitted().plot()
+    assert fig is not None and ax is not None
+    assert ax.get_ylabel() == "MulticlassAccuracy"
+
+
+def test_plot_per_class_vector():
+    fig, ax = _fitted(average=None).plot()
+    # one point per class, legend present
+    assert len(ax.get_legend_handles_labels()[0]) == 4
+
+
+def test_plot_time_series():
+    m = _fitted()
+    values = [m.compute() for _ in range(5)]
+    fig, ax = m.plot(values)
+    assert ax.get_xlabel() == "Step"
+
+
+def test_plot_onto_existing_ax():
+    import matplotlib.pyplot as plt
+
+    fig, ax = plt.subplots()
+    out_fig, out_ax = _fitted().plot(ax=ax)
+    assert out_fig is None and out_ax is ax
+
+
+def test_plot_bounds_drawn():
+    m = _fitted()
+    m.plot_lower_bound, m.plot_upper_bound = 0.0, 1.0
+    fig, ax = m.plot()
+    lo, hi = ax.get_ylim()
+    assert lo < 0.0 and hi > 1.0  # padded beyond the bounds
+
+
+def test_plot_confusion_matrix():
+    m = MulticlassConfusionMatrix(num_classes=3)
+    rng = np.random.default_rng(1)
+    m.update(jnp.asarray(rng.integers(0, 3, 60)), jnp.asarray(rng.integers(0, 3, 60)))
+    fig, ax = plot_confusion_matrix(m.compute())
+    assert fig is not None
+
+
+def test_plot_confusion_matrix_multilabel_grid():
+    confmat = np.arange(3 * 2 * 2).reshape(3, 2, 2)
+    fig, axs = plot_confusion_matrix(confmat)
+    assert len(np.ravel(axs)) == 3
+
+
+def test_plot_confusion_matrix_label_mismatch():
+    with pytest.raises(ValueError, match="number of labels"):
+        plot_confusion_matrix(np.eye(3), labels=["a", "b"])
+
+
+@pytest.mark.parametrize("n,expected", [(1, (1, 1)), (4, (2, 2)), (5, (2, 3)), (7, (3, 3)), (9, (3, 3))])
+def test_col_row_split(n, expected):
+    assert _get_col_row_split(n) == expected
+
+
+def test_plot_without_matplotlib(monkeypatch):
+    import metrics_tpu.utils.plot as plot_mod
+
+    monkeypatch.setattr(plot_mod, "_MATPLOTLIB_AVAILABLE", False)
+    with pytest.raises(ModuleNotFoundError, match="matplotlib"):
+        plot_single_or_multi_val(jnp.asarray(1.0))

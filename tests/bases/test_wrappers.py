@@ -76,6 +76,152 @@ class TestBootstrapper:
         with pytest.raises(ValueError, match="sampling_strategy"):
             BootStrapper(MulticlassAccuracy(NUM_CLASSES), sampling_strategy="bogus")
 
+    def test_vmap_path_matches_loop_path(self):
+        """SURVEY §7.2-4 / VERDICT round-1 weak #5: the single vmapped update over
+        stacked states must produce the same outputs as N sequential copies on the
+        same seed (the resampling streams are drawn identically row-major)."""
+        base = lambda: MulticlassAccuracy(NUM_CLASSES, average="micro", validate_args=False)  # noqa: E731
+        fast = BootStrapper(base(), num_bootstraps=6, raw=True, sampling_strategy="multinomial", seed=11)
+        assert fast._use_vmap, "multinomial + tensor states should take the vmapped path"
+        slow = BootStrapper(base(), num_bootstraps=6, raw=True, sampling_strategy="multinomial", seed=11)
+        slow._use_vmap = False
+        from copy import deepcopy
+
+        slow.metrics = [deepcopy(slow.base_metric) for _ in range(slow.num_bootstraps)]
+        for seed in range(3):
+            fast.update(*_data(seed=seed))
+            slow.update(*_data(seed=seed))
+        out_fast, out_slow = fast.compute(), slow.compute()
+        np.testing.assert_allclose(np.asarray(out_fast["raw"]), np.asarray(out_slow["raw"]), atol=1e-7)
+        np.testing.assert_allclose(float(out_fast["mean"]), float(out_slow["mean"]), atol=1e-7)
+
+    def test_vmap_fallback_on_untraceable_update(self):
+        """A base metric whose update does data-dependent Python control flow cannot
+        trace under vmap — the instance must permanently fall back to the per-copy
+        loop and still produce correct results."""
+        from metrics_tpu.metric import Metric
+
+        class HostSum(Metric):
+            full_state_update = False
+
+            def __init__(self):
+                super().__init__()
+                self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+            def update(self, preds, target):
+                if float(jnp.sum(preds)) >= -1e30:  # concretizes a tracer under vmap
+                    self.total = self.total + jnp.sum(preds)
+
+            def compute(self):
+                return self.total
+
+        bs = BootStrapper(HostSum(), num_bootstraps=4, sampling_strategy="multinomial", seed=9)
+        assert bs._use_vmap
+        for seed in range(2):
+            bs.update(*_data(seed=seed))
+        assert not bs._use_vmap  # fell back
+        assert len(bs.metrics) == 4
+        assert np.isfinite(float(bs.compute()["mean"]))
+
+    @pytest.mark.parametrize("strategy", ["multinomial", "poisson"])
+    def test_forward_accumulates_global_state(self, strategy):
+        """forward() must return batch-only stats while the global bootstrap state
+        keeps accumulating — the generic full-state forward dropped wrapper-held
+        state across its reset (round-2 review finding). A sample-counting base
+        metric makes the invariant exact under multinomial resampling (every
+        resample has exactly batch-size elements) and rng-independent."""
+        from metrics_tpu.metric import Metric
+
+        class CountSamples(Metric):
+            full_state_update = False
+
+            def __init__(self):
+                super().__init__()
+                self.add_state("n", jnp.zeros(()), dist_reduce_fx="sum")
+
+            def update(self, preds, target):
+                self.n = self.n + preds.shape[0]
+
+            def compute(self):
+                return self.n
+
+        bs = BootStrapper(CountSamples(), num_bootstraps=4, sampling_strategy=strategy, seed=13)
+        batch = 64
+        for seed in range(3):
+            batch_out = bs.forward(*_data(n=batch, seed=seed))
+            if strategy == "multinomial":
+                assert float(batch_out["mean"]) == batch  # batch-only value
+        if strategy == "multinomial":
+            # global state saw all 3 batches, not just the last one
+            assert float(bs.compute()["mean"]) == 3 * batch
+        else:
+            # poisson resample sizes vary; accumulation still must exceed one batch
+            assert float(bs.compute()["mean"]) > 1.5 * batch
+
+    def test_vmap_fallback_on_boolean_mask_update(self):
+        """Data-dependent boolean masking (the ignore_index pattern) raises
+        NonConcreteBooleanIndexError under vmap — must fall back, not crash."""
+        from metrics_tpu.metric import Metric
+
+        class MaskedSum(Metric):
+            full_state_update = False
+
+            def __init__(self):
+                super().__init__()
+                self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+            def update(self, preds, target):
+                self.total = self.total + jnp.sum(preds[target >= 2])
+
+            def compute(self):
+                return self.total
+
+        bs = BootStrapper(MaskedSum(), num_bootstraps=4, sampling_strategy="multinomial", seed=2)
+        assert bs._use_vmap
+        bs.update(*_data(seed=0))
+        assert not bs._use_vmap
+        assert np.isfinite(float(bs.compute()["mean"]))
+
+    def test_vmap_path_poisson_not_used(self):
+        bs = BootStrapper(MulticlassAccuracy(NUM_CLASSES, average="micro"), sampling_strategy="poisson")
+        assert not bs._use_vmap
+
+    def test_vmap_reset(self):
+        bs = BootStrapper(
+            MulticlassAccuracy(NUM_CLASSES, average="micro", validate_args=False),
+            num_bootstraps=4,
+            sampling_strategy="multinomial",
+            seed=3,
+        )
+        bs.update(*_data(seed=0))
+        bs.reset()
+        bs.update(*_data(seed=1))
+        assert np.isfinite(float(bs.compute()["mean"]))
+
+    def test_vmap_inside_jit_step(self):
+        """The whole point of the redesign: bootstrap update fused into a jitted step."""
+        import jax
+
+        bs = BootStrapper(
+            MulticlassAccuracy(NUM_CLASSES, average="micro", validate_args=False),
+            num_bootstraps=4,
+            sampling_strategy="multinomial",
+            seed=5,
+        )
+        preds, target = _data(seed=0)
+        indices = jnp.asarray(np.random.default_rng(5).integers(0, len(target), (4, len(target))))
+
+        @jax.jit
+        def step(state, preds, target, indices):
+            def one(s, idx):
+                return bs.base_metric.update_state(s, jnp.take(preds, idx, 0), jnp.take(target, idx, 0))
+
+            return jax.vmap(one)(state, indices)
+
+        out = step(bs._init_stacked_state(), preds, target, indices)
+        vals = jax.vmap(lambda s: bs.base_metric.compute_from(s))(out)
+        assert vals.shape == (4,) and np.all(np.isfinite(np.asarray(vals)))
+
 
 class TestClasswise:
     def test_exploded_dict(self):
