@@ -118,6 +118,10 @@ class RetrievalMetric(Metric):
     full_state_update: bool = False
     plot_lower_bound = 0.0
     plot_upper_bound = 1.0
+    # group_by_query materialises a data-dependent query count (int(seg[-1])), and the
+    # empty_target_action="error" branch does a host bool — compute runs on host; the
+    # cat-state sync itself still lowers to in-trace all_gather.
+    _host_compute = True
 
     allow_non_binary_target: bool = False
     # which per-query count must be non-zero for the query to be "non-empty"

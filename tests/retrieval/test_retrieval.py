@@ -187,7 +187,6 @@ def test_retrieval_class(cls, per_query, metric_args, fn_kwargs, empty_target_ac
         reference_metric=ref,
         metric_args={**metric_args, "empty_target_action": empty_target_action},
         check_state_dict=True,
-        check_sharded=False,
         fragment_kwargs=True,
         indexes=INDEXES,
     )
@@ -204,7 +203,6 @@ def test_retrieval_fall_out_class():
         metric_class=RetrievalFallOut,
         reference_metric=ref,
         metric_args={"k": 4},
-        check_sharded=False,
         fragment_kwargs=True,
         indexes=INDEXES,
     )
