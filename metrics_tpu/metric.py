@@ -199,7 +199,10 @@ class Metric(ABC):
                 raise MetricsTPUUserError(
                     "The Metric has already been synced. HINT: call `unsync()` before modifying the state."
                 )
-            update(*args, **kwargs)
+            # named_scope: shows up in jax.profiler traces and XLA HLO metadata, the
+            # tracing hook the reference lacks (SURVEY §5.1).
+            with jax.named_scope(f"{type(self).__name__}.update"):
+                update(*args, **kwargs)
             if self.compute_on_cpu:
                 self._move_list_states_to_cpu()
 
@@ -231,7 +234,8 @@ class Metric(ABC):
                 should_sync=self._to_sync,
                 should_unsync=self._should_unsync,
             ):
-                value = compute(*args, **kwargs)
+                with jax.named_scope(f"{type(self).__name__}.compute"):
+                    value = compute(*args, **kwargs)
                 self._computed = _squeeze_if_scalar(value)
             return self._computed
 
@@ -461,7 +465,8 @@ class Metric(ABC):
         """Pure: ``(state, batch) -> state``. Safe to call inside jit/shard_map/pjit."""
         snapshot = self._swap_in(state)
         try:
-            self._raw_update()(*args, **kwargs)
+            with jax.named_scope(f"{type(self).__name__}.update_state"):
+                self._raw_update()(*args, **kwargs)
             self._update_count = self._update_count + 1
         finally:
             new_state = self._swap_out(snapshot)
@@ -474,13 +479,18 @@ class Metric(ABC):
             state = self.sync_state(state, axis_name)
         snapshot = self._swap_in(state)
         try:
-            value = self._raw_compute()()
+            with jax.named_scope(f"{type(self).__name__}.compute_from"):
+                value = self._raw_compute()()
             return _squeeze_if_scalar(value)
         finally:
             self._swap_out(snapshot)
 
     def sync_state(self, state: Dict[str, Any], axis_name: Any) -> Dict[str, Any]:
         """In-trace sync: per-state XLA collective over ``axis_name`` mesh axes."""
+        with jax.named_scope(f"{type(self).__name__}.sync_state"):
+            return self._sync_state_impl(state, axis_name)
+
+    def _sync_state_impl(self, state: Dict[str, Any], axis_name: Any) -> Dict[str, Any]:
         synced = dict(state)
         for name, reduction in self._reductions.items():
             val = state[name]

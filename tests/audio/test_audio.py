@@ -252,3 +252,21 @@ def test_snr_sharded_functional_path():
     )(preds, target)
     expected = _ref_snr(np.asarray(preds).reshape(-1, TIME), np.asarray(target).reshape(-1, TIME)).mean()
     assert float(result) == pytest.approx(float(expected), rel=1e-4)
+
+
+@pytest.mark.parametrize(
+    "module_cls, functional",
+    [
+        (SignalNoiseRatio, signal_noise_ratio),
+        (ScaleInvariantSignalNoiseRatio, scale_invariant_signal_noise_ratio),
+        (ScaleInvariantSignalDistortionRatio, scale_invariant_signal_distortion_ratio),
+    ],
+)
+def test_differentiability(module_cls, functional):
+    """jax.grad of the SNR family vs central finite differences (gradcheck analogue)."""
+    from tests.helpers.testers import MetricTester
+
+    rng = np.random.RandomState(3)
+    target = rng.randn(2, BATCH, TIME).astype(np.float32)
+    preds = (target + 0.3 * rng.randn(2, BATCH, TIME)).astype(np.float32)
+    MetricTester().run_differentiability_test(preds, target, module_cls, functional)

@@ -1,0 +1,48 @@
+"""Trace-annotation coverage (SURVEY §5.1).
+
+The reference has no profiler integration; the TPU-native equivalent is
+``jax.named_scope`` around update/compute/sync so that ``jax.profiler`` traces and
+XLA HLO metadata attribute time to metric phases. These tests pin that the scopes
+survive into the lowered computation.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.classification.accuracy import MulticlassAccuracy
+
+
+def test_named_scopes_in_lowered_hlo():
+    metric = MulticlassAccuracy(num_classes=4, validate_args=False)
+
+    def step(preds, target):
+        state = metric.init_state()
+        state = metric.update_state(state, preds, target)
+        return metric.compute_from(state)
+
+    preds = jnp.zeros((8,), jnp.int32)
+    target = jnp.zeros((8,), jnp.int32)
+    text = jax.jit(step).lower(preds, target).as_text(debug_info=True)
+    assert "MulticlassAccuracy.update_state" in text
+    assert "MulticlassAccuracy.compute_from" in text
+
+
+def test_named_scope_in_sync_state():
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    metric = MulticlassAccuracy(num_classes=4, validate_args=False)
+    mesh = Mesh(np.array(jax.devices()[:8]), ("dp",))
+
+    def step(preds, target):
+        state = metric.update_state(metric.init_state(), preds[0], target[0])
+        return metric.compute_from(state, axis_name="dp")
+
+    preds = jnp.zeros((8, 8), jnp.int32)
+    target = jnp.zeros((8, 8), jnp.int32)
+    lowered = jax.jit(
+        jax.shard_map(step, mesh=mesh, in_specs=(P("dp"), P("dp")), out_specs=P())
+    ).lower(preds, target)
+    assert "MulticlassAccuracy.sync_state" in lowered.as_text(debug_info=True)

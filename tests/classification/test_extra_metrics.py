@@ -414,3 +414,15 @@ class TestBinarySpecificityAtSensitivity(MetricTester):
         ref = _np_safs(BIN_PROBS, BIN_TARGET, min_sensitivity)
         np.testing.assert_allclose(np.asarray(res[0]), ref[0], atol=1e-6)
         np.testing.assert_allclose(np.asarray(res[1]), ref[1], atol=1e-6)
+
+
+def test_hinge_differentiability():
+    """jax.grad of binary hinge loss vs central finite differences."""
+    from tests.helpers.testers import MetricTester
+
+    rng = np.random.RandomState(5)
+    preds = rng.rand(2, 32).astype(np.float32) * 2 - 1
+    target = rng.randint(0, 2, (2, 32))
+    MetricTester().run_differentiability_test(
+        preds, target, BinaryHingeLoss, binary_hinge_loss, metric_args={"validate_args": False},
+    )

@@ -1,0 +1,79 @@
+"""Worker for the real 2-process ``gather_all_tensors`` test.
+
+Launched as a subprocess by ``tests/bases/test_multiprocess_gather.py`` with::
+
+    python multiproc_worker.py <coordinator_address> <num_processes> <process_id>
+
+Initialises a true multi-controller JAX job over the distributed coordination
+service (the JAX analogue of the reference's gloo process group,
+``tests/unittests/helpers/testers.py:49-61``) and exercises the
+``multihost_utils`` branch of :func:`metrics_tpu.utils.distributed.gather_all_tensors`
+— both the equal-shape fast path and the pad-to-max ragged protocol
+(reference ``src/torchmetrics/utilities/distributed.py:126-148``).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+# Pin to host CPU before any jax import: the worker must never touch an
+# accelerator plugin (same reasoning as __graft_entry__._cpu_devices).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+# The image's sitecustomize may have pre-imported jax with the accelerator platform
+# pinned, in which case the env var above came too late — override via config before
+# any backend is initialised (same workaround as tests/conftest.py).
+jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> None:
+    coordinator, num_processes, process_id = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    assert jax.process_count() == num_processes, jax.process_count()
+
+    from metrics_tpu.utils.distributed import distributed_available, gather_all_tensors
+
+    assert distributed_available(), "2-process job must report distributed_available()"
+
+    # --- equal-shape fast path -------------------------------------------------
+    local = np.full((2, 3), float(process_id + 1), dtype=np.float32)
+    gathered = gather_all_tensors(jax.numpy.asarray(local))
+    assert len(gathered) == num_processes, len(gathered)
+    for rank, piece in enumerate(gathered):
+        np.testing.assert_allclose(np.asarray(piece), np.full((2, 3), float(rank + 1)))
+
+    # --- ragged pad-to-max + trim path ----------------------------------------
+    # process r contributes (r + 1) rows -> shapes differ across processes.
+    rows = process_id + 1
+    ragged = np.arange(rows * 3, dtype=np.float32).reshape(rows, 3) + 100.0 * process_id
+    gathered = gather_all_tensors(jax.numpy.asarray(ragged))
+    assert [g.shape for g in gathered] == [(r + 1, 3) for r in range(num_processes)]
+    for rank, piece in enumerate(gathered):
+        expect = np.arange((rank + 1) * 3, dtype=np.float32).reshape(rank + 1, 3) + 100.0 * rank
+        np.testing.assert_allclose(np.asarray(piece), expect)
+
+    # --- union-of-data invariant through a real Metric ------------------------
+    # Each process updates a MeanMetric on its own shard; after sync the value
+    # must equal the mean over the union of all shards (SURVEY §4.1 invariant).
+    from metrics_tpu.aggregation import MeanMetric
+
+    metric = MeanMetric(dist_sync_fn=gather_all_tensors)
+    metric.update(jax.numpy.asarray(local))
+    synced = float(metric.compute())
+    union = np.mean([np.full((2, 3), float(r + 1)) for r in range(num_processes)])
+    np.testing.assert_allclose(synced, union, atol=1e-6)
+
+    print(f"WORKER_OK rank={process_id}")
+
+
+if __name__ == "__main__":
+    main()

@@ -77,6 +77,10 @@ class TestPSNR(MetricTester):
         res = peak_signal_noise_ratio(jnp.asarray(PREDS[0]), jnp.asarray(TARGET[0]))
         np.testing.assert_allclose(np.asarray(res), _np_psnr(PREDS[0], TARGET[0]), atol=1e-4)
 
+    def test_differentiability(self):
+        self.run_differentiability_test(PREDS, TARGET, PeakSignalNoiseRatio, peak_signal_noise_ratio,
+                                        metric_args={"data_range": 1.0})
+
 
 # ------------------------------------------------------------------------------ ssim
 
@@ -140,6 +144,10 @@ class TestSSIM(MetricTester):
             PREDS, TARGET_SIM, structural_similarity_index_measure, partial(_np_ssim, data_range=1.0),
             metric_args={"data_range": 1.0},
         )
+
+    def test_differentiability(self):
+        self.run_differentiability_test(PREDS, TARGET_SIM, StructuralSimilarityIndexMeasure,
+                                        structural_similarity_index_measure, metric_args={"data_range": 1.0})
 
     def test_ms_ssim_smoke(self):
         """MS-SSIM: identical images → 1, decreasing with distortion.
@@ -211,6 +219,10 @@ class TestUQI(MetricTester):
     def test_functional(self):
         self.run_functional_metric_test(PREDS, TARGET_SIM, universal_image_quality_index, _np_uqi)
 
+    def test_differentiability(self):
+        self.run_differentiability_test(PREDS, TARGET_SIM, UniversalImageQualityIndex,
+                                        universal_image_quality_index)
+
 
 # ---------------------------------------------------------------------- sam / ergas / tv
 
@@ -250,6 +262,9 @@ class TestSAM(MetricTester):
     def test_functional(self):
         self.run_functional_metric_test(PREDS, TARGET_SIM, spectral_angle_mapper, _np_sam)
 
+    def test_differentiability(self):
+        self.run_differentiability_test(PREDS, TARGET_SIM, SpectralAngleMapper, spectral_angle_mapper)
+
 
 class TestERGAS(MetricTester):
     atol = 1e-2  # relative formula amplifies f32 rounding
@@ -259,6 +274,10 @@ class TestERGAS(MetricTester):
 
     def test_functional(self):
         self.run_functional_metric_test(PREDS, TARGET_SIM, error_relative_global_dimensionless_synthesis, _np_ergas)
+
+    def test_differentiability(self):
+        self.run_differentiability_test(PREDS, TARGET_SIM, ErrorRelativeGlobalDimensionlessSynthesis,
+                                        error_relative_global_dimensionless_synthesis)
 
 
 def test_total_variation():

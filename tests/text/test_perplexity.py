@@ -91,3 +91,14 @@ def test_perplexity_jit_compilable():
 
     state = step(metric.init_state(), preds, target)
     assert float(metric.compute_from(state)) == pytest.approx(_ref_perplexity(np.asarray(preds), np.asarray(target)), rel=1e-5)
+
+
+def test_perplexity_differentiability():
+    """jax.grad of perplexity w.r.t. probabilities vs central finite differences."""
+    from tests.helpers.testers import MetricTester
+
+    rng = np.random.RandomState(5)
+    logits = rng.randn(2, 4, 10, 8).astype(np.float32)
+    probs = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    target = rng.randint(0, 8, (2, 4, 10))
+    MetricTester().run_differentiability_test(probs, target, Perplexity, perplexity)
