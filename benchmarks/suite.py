@@ -1,0 +1,211 @@
+"""Benchmark suite for the BASELINE.json tracked configs beyond the headline bench.
+
+One JSON line per config (see benchmarks/README.md for methodology). Runs on the
+default backend when an accelerator is present, otherwise on an 8-device virtual
+CPU mesh (`--backend cpu` forces the latter; relative numbers transfer, absolute
+times are labelled with the backend).
+
+Configs (BASELINE.json "configs"):
+  1. accuracy_single     — multiclass Accuracy, jitted update+compute latency
+  2. collection_mesh     — fused Accuracy+F1+ConfusionMatrix on an 8-way dp mesh:
+                           per-step latency with metric sync in-trace vs without
+  3. detection_map       — MeanAveragePrecision cat-reduce update throughput (host path)
+  4. bert_embedding_states — BERTScore-style ragged token-id cat states: update cost
+                           + embedding/score compute with an injected cheap model
+  5. fid_cov_sync        — FID covariance-sum states (2 x d x d) psum over the mesh
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+parser = argparse.ArgumentParser()
+parser.add_argument("--backend", choices=["cpu", "default"], default="cpu")
+parser.add_argument("--steps", type=int, default=20)
+args = parser.parse_args()
+
+if args.backend == "cpu":
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+if args.backend == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+BACKEND = jax.devices()[0].platform
+STEPS = args.steps
+
+
+def emit(name: str, value_ms: float, unit: str = "ms", **extra) -> None:
+    print(json.dumps({"metric": name, "value": round(value_ms, 4), "unit": unit,
+                      "backend": BACKEND, **extra}))
+
+
+def timed(fn, *run_args, steps=STEPS):
+    fn(*run_args)  # warm-up / compile
+    jax.block_until_ready(fn(*run_args))
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(steps):
+        out = fn(*run_args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / steps * 1e3
+
+
+def bench_accuracy_single() -> None:
+    from metrics_tpu.classification import MulticlassAccuracy
+
+    metric = MulticlassAccuracy(num_classes=5, validate_args=False)
+    rng = np.random.default_rng(0)
+    preds = jnp.asarray(rng.normal(size=(4096, 5)).astype(np.float32))
+    target = jnp.asarray(rng.integers(0, 5, 4096))
+
+    @jax.jit
+    def step(state, p, t):
+        state = metric.update_state(state, p, t)
+        return state, metric.compute_from(state)
+
+    state = metric.init_state()
+    ms = timed(lambda: step(state, preds, target))
+    emit("accuracy_single update+compute", ms, config={"batch": 4096, "classes": 5})
+
+
+def _mesh8():
+    devs = jax.devices()[:8]
+    return Mesh(np.array(devs), ("dp",)) if len(devs) >= 8 else None
+
+
+def bench_collection_mesh() -> None:
+    mesh = _mesh8()
+    if mesh is None:
+        emit("collection_mesh sync latency", -1.0, note="needs 8 devices")
+        return
+    from metrics_tpu.classification import (
+        MulticlassAccuracy, MulticlassConfusionMatrix, MulticlassF1Score,
+    )
+
+    kw = dict(validate_args=False)
+    metrics = {
+        "acc": MulticlassAccuracy(5, average="micro", **kw),
+        "f1": MulticlassF1Score(5, **kw),
+        "cm": MulticlassConfusionMatrix(5, **kw),
+    }
+    rng = np.random.default_rng(1)
+    preds = jnp.asarray(rng.integers(0, 5, (8, 2048)))
+    target = jnp.asarray(rng.integers(0, 5, (8, 2048)))
+
+    def step_with(p, t):
+        vals = {}
+        for name, m in metrics.items():
+            s = m.update_state(m.init_state(), p[0], t[0])
+            vals[name] = m.compute_from(s, axis_name="dp")
+        return vals["acc"], vals["f1"]
+
+    def step_without(p, t):
+        # local update only, no collective sync
+        outs = []
+        for m in metrics.values():
+            s = m.update_state(m.init_state(), p[0], t[0])
+            outs.append(s["tp"].sum() if "tp" in s else s["confmat"].sum())
+        return outs[0], outs[1]
+
+    jit_with = jax.jit(jax.shard_map(step_with, mesh=mesh, in_specs=(P("dp"), P("dp")), out_specs=(P(), P())))
+    jit_without = jax.jit(jax.shard_map(step_without, mesh=mesh, in_specs=(P("dp"), P("dp")),
+                                        out_specs=(P(), P()), check_vma=False))
+    ms_with = timed(lambda: jit_with(preds, target))
+    ms_without = timed(lambda: jit_without(preds, target))
+    emit("collection_mesh fused step (sync in-trace)", ms_with,
+         config={"ranks": 8, "batch_per_rank": 2048})
+    emit("collection_mesh sync latency (with - without)", max(ms_with - ms_without, 0.0),
+         config={"ranks": 8})
+
+
+def bench_detection_map() -> None:
+    from metrics_tpu.detection import MeanAveragePrecision
+
+    rng = np.random.default_rng(2)
+    metric = MeanAveragePrecision()
+
+    def make(n):
+        boxes = rng.uniform(0, 100, (n, 4)).astype(np.float32)
+        boxes[:, 2:] += boxes[:, :2]
+        return boxes
+
+    preds = [{"boxes": jnp.asarray(make(20)), "scores": jnp.asarray(rng.uniform(size=20).astype(np.float32)),
+              "labels": jnp.asarray(rng.integers(0, 3, 20))} for _ in range(8)]
+    target = [{"boxes": jnp.asarray(make(10)), "labels": jnp.asarray(rng.integers(0, 3, 10))} for _ in range(8)]
+
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        metric.update(preds, target)
+    ms_update = (time.perf_counter() - t0) / STEPS * 1e3
+    t0 = time.perf_counter()
+    metric.compute()
+    ms_compute = (time.perf_counter() - t0) * 1e3
+    emit("detection_map update (8 imgs, cat states)", ms_update)
+    emit("detection_map compute", ms_compute, config={"images": 8 * STEPS})
+
+
+def bench_bert_embedding_states() -> None:
+    from metrics_tpu.functional.text.bert import bert_score
+
+    rng = np.random.default_rng(3)
+    vocab, dim, seq, n = 1000, 256, 64, 64
+    table = jnp.asarray(rng.normal(size=(vocab, dim)).astype(np.float32))
+
+    class _Tok:
+        def __call__(self, texts, **kw):
+            ids = np.asarray(rng.integers(1, vocab, (len(texts), seq)))
+            return {"input_ids": ids, "attention_mask": np.ones_like(ids)}
+
+    def fwd(model, batch):
+        return model[jnp.asarray(batch["input_ids"])]
+
+    sents = ["token " * 10] * n
+    kw = dict(model=table, user_tokenizer=_Tok(), user_forward_fn=fwd)
+    bert_score(sents, sents, **kw)  # warm-up: exclude compile time (methodology)
+    t0 = time.perf_counter()
+    res = bert_score(sents, sents, **kw)
+    ms = (time.perf_counter() - t0) * 1e3
+    emit("bert_embedding_states end-to-end score", ms,
+         config={"sentences": n, "seq": seq, "dim": dim, "f1": round(float(np.mean(np.asarray(res["f1"]))), 4)})
+
+
+def bench_fid_cov_sync() -> None:
+    mesh = _mesh8()
+    if mesh is None:
+        emit("fid_cov_sync", -1.0, note="needs 8 devices")
+        return
+    from metrics_tpu.image import FrechetInceptionDistance
+
+    d = 768 if BACKEND == "cpu" else 2048  # keep the CPU mesh run quick
+    metric = FrechetInceptionDistance(feature=lambda x: x, num_features=d)
+
+    def sync_only(state):
+        return metric.sync_state(state, "dp")
+
+    state = metric.init_state()
+    jit_sync = jax.jit(jax.shard_map(sync_only, mesh=mesh, in_specs=(P(),), out_specs=P()))
+    ms = timed(lambda: jit_sync(state))
+    emit("fid_cov_sync psum (2x sum + 2x dxd cov)", ms, config={"feature_dim": d, "ranks": 8})
+
+
+if __name__ == "__main__":
+    bench_accuracy_single()
+    bench_collection_mesh()
+    bench_detection_map()
+    bench_bert_embedding_states()
+    bench_fid_cov_sync()

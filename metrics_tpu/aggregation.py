@@ -91,7 +91,16 @@ class BaseAggregator(Metric):
 
 
 class MaxMetric(BaseAggregator):
-    """Running max (reference aggregation.py:95)."""
+    """Running max (reference aggregation.py:95).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MaxMetric
+        >>> metric = MaxMetric()
+        >>> metric.update(jnp.array([1.0, 3.0, 2.0]))
+        >>> metric.compute()
+        Array(3., dtype=float32)
+    """
 
     full_state_update = True
     _neutral = -float("inf")
@@ -106,7 +115,16 @@ class MaxMetric(BaseAggregator):
 
 
 class MinMetric(BaseAggregator):
-    """Running min (reference aggregation.py:156)."""
+    """Running min (reference aggregation.py:156).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MinMetric
+        >>> metric = MinMetric()
+        >>> metric.update(jnp.array([1.0, 3.0, 2.0]))
+        >>> metric.compute()
+        Array(1., dtype=float32)
+    """
 
     full_state_update = True
     _neutral = float("inf")
@@ -121,7 +139,17 @@ class MinMetric(BaseAggregator):
 
 
 class SumMetric(BaseAggregator):
-    """Running sum (reference aggregation.py:217)."""
+    """Running sum (reference aggregation.py:217).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import SumMetric
+        >>> metric = SumMetric()
+        >>> metric.update(jnp.array([1.0, 3.0, 2.0]))
+        >>> metric.update(4.0)
+        >>> metric.compute()
+        Array(10., dtype=float32)
+    """
 
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
         super().__init__("sum", jnp.zeros((), dtype=jnp.float32), nan_strategy, state_name="sum_value", **kwargs)
@@ -133,7 +161,17 @@ class SumMetric(BaseAggregator):
 
 
 class CatMetric(BaseAggregator):
-    """Concatenate all seen values (reference aggregation.py:276)."""
+    """Concatenate all seen values (reference aggregation.py:276).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import CatMetric
+        >>> metric = CatMetric()
+        >>> metric.update(jnp.array([1.0, 2.0]))
+        >>> metric.update(jnp.array([3.0]))
+        >>> metric.compute()
+        Array([1., 2., 3.], dtype=float32)
+    """
 
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
         super().__init__("cat", [], nan_strategy, **kwargs)
@@ -152,7 +190,17 @@ class CatMetric(BaseAggregator):
 
 
 class MeanMetric(BaseAggregator):
-    """Weighted running mean: ``value``+``weight`` sum states (reference aggregation.py:336)."""
+    """Weighted running mean: ``value``+``weight`` sum states (reference aggregation.py:336).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanMetric
+        >>> metric = MeanMetric()
+        >>> metric.update(jnp.array([1.0, 2.0, 3.0]))
+        >>> metric.update(5.0, weight=3.0)
+        >>> metric.compute()
+        Array(3.5, dtype=float32)
+    """
 
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
         super().__init__("sum", jnp.zeros((), dtype=jnp.float32), nan_strategy, state_name="mean_value", **kwargs)

@@ -17,6 +17,19 @@ from metrics_tpu.utils.enums import ClassificationTask
 
 
 class BinaryAccuracy(BinaryStatScores):
+    """Binary accuracy over tp/fp/tn/fn sum states (reference accuracy.py).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import BinaryAccuracy
+        >>> target = jnp.array([0, 1, 0, 1, 0, 1])
+        >>> preds = jnp.array([0, 0, 1, 1, 0, 1])
+        >>> metric = BinaryAccuracy()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.6666667, dtype=float32)
+    """
+
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
@@ -29,6 +42,26 @@ class BinaryAccuracy(BinaryStatScores):
 
 
 class MulticlassAccuracy(MulticlassStatScores):
+    """Multiclass accuracy with micro/macro/weighted/none averaging.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import MulticlassAccuracy
+        >>> target = jnp.array([2, 1, 0, 1])
+        >>> probs = jnp.array([[0.16, 0.26, 0.58],
+        ...                    [0.22, 0.61, 0.17],
+        ...                    [0.71, 0.09, 0.20],
+        ...                    [0.05, 0.82, 0.13]])
+        >>> metric = MulticlassAccuracy(num_classes=3)
+        >>> metric.update(probs, target)
+        >>> metric.compute()
+        Array(1., dtype=float32)
+        >>> per_class = MulticlassAccuracy(num_classes=3, average=None)
+        >>> per_class.update(probs, target)
+        >>> per_class.compute()
+        Array([1., 1., 1.], dtype=float32)
+    """
+
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
@@ -41,6 +74,19 @@ class MulticlassAccuracy(MulticlassStatScores):
 
 
 class MultilabelAccuracy(MultilabelStatScores):
+    """Multilabel accuracy: per-label threshold at 0.5 by default.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import MultilabelAccuracy
+        >>> target = jnp.array([[0, 1, 0], [1, 0, 1]])
+        >>> preds = jnp.array([[0.11, 0.58, 0.22], [0.84, 0.73, 0.33]])
+        >>> metric = MultilabelAccuracy(num_labels=3)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.6666667, dtype=float32)
+    """
+
     is_differentiable = False
     higher_is_better = True
     full_state_update = False

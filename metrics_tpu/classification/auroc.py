@@ -23,6 +23,19 @@ from metrics_tpu.utils.enums import ClassificationTask
 
 
 class BinaryAUROC(BinaryPrecisionRecallCurve):
+    """Area under the ROC curve; exact (thresholds=None) or binned.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import BinaryAUROC
+        >>> target = jnp.array([0, 1, 0, 1, 0, 1])
+        >>> probs = jnp.array([0.11, 0.22, 0.84, 0.73, 0.33, 0.92])
+        >>> metric = BinaryAUROC(thresholds=None)
+        >>> metric.update(probs, target)
+        >>> metric.compute()
+        Array(0.6666667, dtype=float32)
+    """
+
     is_differentiable = False
     higher_is_better = True
     full_state_update = False

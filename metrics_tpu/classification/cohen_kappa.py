@@ -13,6 +13,19 @@ from metrics_tpu.utils.enums import ClassificationTask
 
 
 class BinaryCohenKappa(BinaryConfusionMatrix):
+    """Cohen's kappa: agreement corrected for chance.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import BinaryCohenKappa
+        >>> target = jnp.array([0, 1, 0, 1, 0, 1])
+        >>> preds = jnp.array([0, 0, 1, 1, 0, 1])
+        >>> metric = BinaryCohenKappa()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.3333333, dtype=float32)
+    """
+
     is_differentiable = False
     higher_is_better = True
     full_state_update = False

@@ -27,6 +27,20 @@ from metrics_tpu.utils.enums import ClassificationTask
 
 
 class BinaryConfusionMatrix(Metric):
+    """2x2 confusion matrix from thresholded probabilities or labels.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import BinaryConfusionMatrix
+        >>> target = jnp.array([0, 1, 0, 1, 0, 1])
+        >>> probs = jnp.array([0.11, 0.22, 0.84, 0.73, 0.33, 0.92])
+        >>> metric = BinaryConfusionMatrix()
+        >>> metric.update(probs, target)
+        >>> metric.compute()
+        Array([[2, 1],
+               [1, 2]], dtype=int32)
+    """
+
     is_differentiable = False
     higher_is_better = None
     full_state_update = False
@@ -59,6 +73,21 @@ class BinaryConfusionMatrix(Metric):
 
 
 class MulticlassConfusionMatrix(Metric):
+    """(C, C) confusion matrix via one-hot matmul accumulation.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import MulticlassConfusionMatrix
+        >>> target = jnp.array([2, 1, 0, 1])
+        >>> preds = jnp.array([2, 1, 0, 1])
+        >>> metric = MulticlassConfusionMatrix(num_classes=3)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array([[1, 0, 0],
+               [0, 2, 0],
+               [0, 0, 1]], dtype=int32)
+    """
+
     is_differentiable = False
     higher_is_better = None
     full_state_update = False

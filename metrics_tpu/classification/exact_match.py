@@ -44,6 +44,19 @@ class _AbstractExactMatch(Metric):
 
 
 class MulticlassExactMatch(_AbstractExactMatch):
+    """Samplewise all-labels-correct indicator, averaged.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import MulticlassExactMatch
+        >>> target = jnp.array([[0, 1], [2, 1]])
+        >>> preds = jnp.array([[0, 1], [2, 0]])
+        >>> metric = MulticlassExactMatch(num_classes=3)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.5, dtype=float32)
+    """
+
     is_differentiable = False
     higher_is_better = True
     full_state_update = False

@@ -21,6 +21,19 @@ from metrics_tpu.utils.enums import ClassificationTask
 
 
 class BinaryFBetaScore(BinaryStatScores):
+    """F-beta for binary tasks over tp/fp/tn/fn sum states.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import BinaryFBetaScore
+        >>> target = jnp.array([0, 1, 0, 1, 0, 1])
+        >>> preds = jnp.array([0, 0, 1, 1, 0, 1])
+        >>> metric = BinaryFBetaScore(beta=2.0)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.6666667, dtype=float32)
+    """
+
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
@@ -89,6 +102,19 @@ class MultilabelFBetaScore(MultilabelStatScores):
 
 
 class BinaryF1Score(BinaryFBetaScore):
+    """F1 (harmonic precision/recall mean) for binary tasks.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import BinaryF1Score
+        >>> target = jnp.array([0, 1, 0, 1, 0, 1])
+        >>> preds = jnp.array([0, 0, 1, 1, 0, 1])
+        >>> metric = BinaryF1Score()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.6666667, dtype=float32)
+    """
+
     def __init__(self, threshold: float = 0.5, multidim_average: str = "global",
                  ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any) -> None:
         super().__init__(beta=1.0, threshold=threshold, multidim_average=multidim_average,
@@ -96,6 +122,19 @@ class BinaryF1Score(BinaryFBetaScore):
 
 
 class MulticlassF1Score(MulticlassFBetaScore):
+    """Macro-averaged multiclass F1 by default.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import MulticlassF1Score
+        >>> target = jnp.array([2, 1, 0, 1])
+        >>> preds = jnp.array([2, 1, 0, 1])
+        >>> metric = MulticlassF1Score(num_classes=3)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(1., dtype=float32)
+    """
+
     def __init__(self, num_classes: int, top_k: int = 1, average: Optional[str] = "macro",
                  multidim_average: str = "global", ignore_index: Optional[int] = None,
                  validate_args: bool = True, **kwargs: Any) -> None:
@@ -105,6 +144,19 @@ class MulticlassF1Score(MulticlassFBetaScore):
 
 
 class MultilabelF1Score(MultilabelFBetaScore):
+    """Per-label F1, macro-averaged by default.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import MultilabelF1Score
+        >>> target = jnp.array([[0, 1, 0], [1, 0, 1]])
+        >>> preds = jnp.array([[0.11, 0.58, 0.22], [0.84, 0.73, 0.33]])
+        >>> metric = MultilabelF1Score(num_labels=3)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.5555556, dtype=float32)
+    """
+
     def __init__(self, num_labels: int, threshold: float = 0.5, average: Optional[str] = "macro",
                  multidim_average: str = "global", ignore_index: Optional[int] = None,
                  validate_args: bool = True, **kwargs: Any) -> None:

@@ -13,6 +13,19 @@ from metrics_tpu.utils.enums import ClassificationTask
 
 
 class BinaryHammingDistance(BinaryStatScores):
+    """Fraction of disagreeing labels (1 - accuracy for binary).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import BinaryHammingDistance
+        >>> target = jnp.array([0, 1, 0, 1, 0, 1])
+        >>> preds = jnp.array([0, 0, 1, 1, 0, 1])
+        >>> metric = BinaryHammingDistance()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.3333333, dtype=float32)
+    """
+
     is_differentiable = False
     higher_is_better = False
     full_state_update = False

@@ -17,6 +17,19 @@ from metrics_tpu.utils.enums import ClassificationTask
 
 
 class BinaryJaccardIndex(BinaryConfusionMatrix):
+    """Intersection-over-union from the 2x2 confusion matrix.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import BinaryJaccardIndex
+        >>> target = jnp.array([0, 1, 0, 1, 0, 1])
+        >>> preds = jnp.array([0, 0, 1, 1, 0, 1])
+        >>> metric = BinaryJaccardIndex()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.5, dtype=float32)
+    """
+
     is_differentiable = False
     higher_is_better = True
     full_state_update = False

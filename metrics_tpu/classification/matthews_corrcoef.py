@@ -18,6 +18,19 @@ from metrics_tpu.utils.enums import ClassificationTask
 
 
 class BinaryMatthewsCorrCoef(BinaryConfusionMatrix):
+    """Matthews correlation coefficient from the confusion matrix.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import BinaryMatthewsCorrCoef
+        >>> target = jnp.array([0, 1, 0, 1, 0, 1])
+        >>> preds = jnp.array([0, 0, 1, 1, 0, 1])
+        >>> metric = BinaryMatthewsCorrCoef()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.33333334, dtype=float32)
+    """
+
     is_differentiable = False
     higher_is_better = True
     full_state_update = False

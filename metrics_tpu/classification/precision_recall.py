@@ -16,6 +16,19 @@ from metrics_tpu.utils.enums import ClassificationTask
 
 
 class BinaryPrecision(BinaryStatScores):
+    """Binary precision tp/(tp+fp).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import BinaryPrecision
+        >>> target = jnp.array([0, 1, 0, 1, 0, 1])
+        >>> preds = jnp.array([0, 0, 1, 1, 0, 1])
+        >>> metric = BinaryPrecision()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.6666667, dtype=float32)
+    """
+
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
@@ -28,6 +41,19 @@ class BinaryPrecision(BinaryStatScores):
 
 
 class MulticlassPrecision(MulticlassStatScores):
+    """Multiclass precision, macro-averaged by default.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import MulticlassPrecision
+        >>> target = jnp.array([2, 1, 0, 1])
+        >>> preds = jnp.array([2, 1, 0, 1])
+        >>> metric = MulticlassPrecision(num_classes=3)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(1., dtype=float32)
+    """
+
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
@@ -52,6 +78,19 @@ class MultilabelPrecision(MultilabelStatScores):
 
 
 class BinaryRecall(BinaryStatScores):
+    """Binary recall tp/(tp+fn).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import BinaryRecall
+        >>> target = jnp.array([0, 1, 0, 1, 0, 1])
+        >>> preds = jnp.array([0, 0, 1, 1, 0, 1])
+        >>> metric = BinaryRecall()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.6666667, dtype=float32)
+    """
+
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
@@ -64,6 +103,19 @@ class BinaryRecall(BinaryStatScores):
 
 
 class MulticlassRecall(MulticlassStatScores):
+    """Multiclass recall, macro-averaged by default.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import MulticlassRecall
+        >>> target = jnp.array([2, 1, 0, 1])
+        >>> preds = jnp.array([2, 1, 0, 1])
+        >>> metric = MulticlassRecall(num_classes=3)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(1., dtype=float32)
+    """
+
     is_differentiable = False
     higher_is_better = True
     full_state_update = False

@@ -13,6 +13,19 @@ from metrics_tpu.utils.enums import ClassificationTask
 
 
 class BinarySpecificity(BinaryStatScores):
+    """Binary specificity tn/(tn+fp).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import BinarySpecificity
+        >>> target = jnp.array([0, 1, 0, 1, 0, 1])
+        >>> preds = jnp.array([0, 0, 1, 1, 0, 1])
+        >>> metric = BinarySpecificity()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.6666667, dtype=float32)
+    """
+
     is_differentiable = False
     higher_is_better = True
     full_state_update = False

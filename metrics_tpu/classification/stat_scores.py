@@ -82,7 +82,18 @@ class _AbstractStatScores(Metric):
 
 
 class BinaryStatScores(_AbstractStatScores):
-    """Reference classification/stat_scores.py:84-192."""
+    """tp/fp/tn/fn counts plus support (reference classification/stat_scores.py:84-192).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import BinaryStatScores
+        >>> target = jnp.array([0, 1, 0, 1, 0, 1])
+        >>> preds = jnp.array([0, 0, 1, 1, 0, 1])
+        >>> metric = BinaryStatScores()
+        >>> metric.update(preds, target)
+        >>> metric.compute()  # [tp, fp, tn, fn, support]
+        Array([2, 1, 2, 1, 3], dtype=int32)
+    """
 
     is_differentiable: bool = False
     higher_is_better: Optional[bool] = None

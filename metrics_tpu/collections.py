@@ -27,7 +27,19 @@ from metrics_tpu.utils.prints import rank_zero_warn
 
 
 class MetricCollection:
-    """Dict of metrics with a single update/forward/compute/reset (reference :28)."""
+    """Dict of metrics with a single update/forward/compute/reset (reference :28).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MetricCollection
+        >>> from metrics_tpu.classification import BinaryAccuracy, BinaryF1Score
+        >>> collection = MetricCollection([BinaryAccuracy(), BinaryF1Score()])
+        >>> preds = jnp.array([0, 0, 1, 1, 0, 1])
+        >>> target = jnp.array([0, 1, 0, 1, 0, 1])
+        >>> collection.update(preds, target)
+        >>> {k: float(v) for k, v in collection.compute().items()}  # doctest: +ELLIPSIS
+        {'BinaryAccuracy': 0.666..., 'BinaryF1Score': 0.666...}
+    """
 
     _modules: "OrderedDict[str, Metric]"
 

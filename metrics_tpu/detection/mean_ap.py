@@ -162,8 +162,26 @@ class MeanAveragePrecision(Metric):
 
     # ------------------------------------------------------------------ update
 
+    @staticmethod
+    def _fetch_to_host(items: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+        """Overlapped device→host transfer of every array in ``items``.
+
+        The evaluation protocol is host-side (ragged COCO matching), so update must
+        land the inputs in host memory. Converting leaf-by-leaf with ``np.asarray``
+        issues one *blocking* D2H round-trip per array — dozens per call on an
+        accelerator. Starting all copies asynchronously first overlaps the
+        transfers, so the call pays one transfer latency instead of N.
+        """
+        for item in items:
+            for value in item.values():
+                if hasattr(value, "copy_to_host_async"):
+                    value.copy_to_host_async()
+        return [{k: (np.asarray(v) if hasattr(v, "shape") else v) for k, v in item.items()} for item in items]
+
     def update(self, preds: List[Dict[str, Any]], target: List[Dict[str, Any]]) -> None:
         _input_validator(preds, target, iou_type=self.iou_type)
+        preds = self._fetch_to_host(preds)
+        target = self._fetch_to_host(target)
 
         for item in preds:
             self.detections.append(self._get_safe_item_values(item))

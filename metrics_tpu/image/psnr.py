@@ -18,6 +18,19 @@ from metrics_tpu.utils.prints import rank_zero_warn
 
 
 class PeakSignalNoiseRatio(Metric):
+    """Peak Signal Noise Ratio.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import PeakSignalNoiseRatio
+        >>> preds = jnp.array([[[[0.1, 0.2], [0.3, 0.4]]]])
+        >>> target = jnp.array([[[[0.1, 0.25], [0.3, 0.45]]]])
+        >>> metric = PeakSignalNoiseRatio(data_range=1.0)
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 4)
+        29.0309
+    """
+
     is_differentiable = True
     higher_is_better = True
     full_state_update = False

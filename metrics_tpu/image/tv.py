@@ -17,6 +17,18 @@ from metrics_tpu.utils.data import dim_zero_cat
 
 
 class TotalVariation(Metric):
+    """Total Variation.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import TotalVariation
+        >>> img = jnp.array([[[[0.1, 0.2], [0.3, 0.4]]]])
+        >>> metric = TotalVariation()
+        >>> metric.update(img)
+        >>> metric.compute()
+        Array(0.6, dtype=float32)
+    """
+
     is_differentiable = True
     higher_is_better = False
     full_state_update = False

@@ -47,7 +47,18 @@ class _NominalBase(Metric):
 
 
 class CramersV(_NominalBase):
-    """Cramér's V (reference nominal/cramers.py)."""
+    """Cramér's V (reference nominal/cramers.py).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import CramersV
+        >>> a = jnp.array([0, 1, 2, 1, 0, 2, 1])
+        >>> b = jnp.array([0, 1, 2, 1, 0, 2, 2])
+        >>> metric = CramersV(num_classes=3)
+        >>> metric.update(a, b)
+        >>> round(float(metric.compute()), 4)
+        0.7638
+    """
 
     def __init__(self, num_classes: int, bias_correction: bool = True, **kwargs: Any) -> None:
         super().__init__(num_classes, **kwargs)

@@ -26,6 +26,19 @@ from metrics_tpu.metric import Metric
 
 
 class MeanAbsoluteError(Metric):
+    """Mean Absolute Error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanAbsoluteError
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> metric = MeanAbsoluteError()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.5, dtype=float32)
+    """
+
     is_differentiable = True
     higher_is_better = False
     full_state_update = False
@@ -45,6 +58,19 @@ class MeanAbsoluteError(Metric):
 
 
 class MeanSquaredError(Metric):
+    """Mean Squared Error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanSquaredError
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> metric = MeanSquaredError()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.375, dtype=float32)
+    """
+
     is_differentiable = True
     higher_is_better = False
     full_state_update = False
@@ -71,6 +97,19 @@ class MeanSquaredError(Metric):
 
 
 class MeanAbsolutePercentageError(Metric):
+    """Mean Absolute Percentage Error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanAbsolutePercentageError
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> metric = MeanAbsolutePercentageError()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.32738096, dtype=float32)
+    """
+
     is_differentiable = True
     higher_is_better = False
     full_state_update = False
@@ -90,6 +129,19 @@ class MeanAbsolutePercentageError(Metric):
 
 
 class SymmetricMeanAbsolutePercentageError(Metric):
+    """Symmetric Mean Absolute Percentage Error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import SymmetricMeanAbsolutePercentageError
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> metric = SymmetricMeanAbsolutePercentageError()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.5787879, dtype=float32)
+    """
+
     is_differentiable = True
     higher_is_better = False
     full_state_update = False
@@ -109,6 +161,19 @@ class SymmetricMeanAbsolutePercentageError(Metric):
 
 
 class WeightedMeanAbsolutePercentageError(Metric):
+    """Weighted Mean Absolute Percentage Error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import WeightedMeanAbsolutePercentageError
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> metric = WeightedMeanAbsolutePercentageError()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.16, dtype=float32)
+    """
+
     is_differentiable = True
     higher_is_better = False
     full_state_update = False
@@ -128,6 +193,19 @@ class WeightedMeanAbsolutePercentageError(Metric):
 
 
 class MeanSquaredLogError(Metric):
+    """Mean Squared Log Error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanSquaredLogError
+        >>> target = jnp.array([3.0, 5.0, 2.5, 7.0])
+        >>> preds = jnp.array([2.5, 5.0, 4.0, 8.0])
+        >>> metric = MeanSquaredLogError()
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 4)
+        0.0397
+    """
+
     is_differentiable = True
     higher_is_better = False
     full_state_update = False
@@ -147,6 +225,19 @@ class MeanSquaredLogError(Metric):
 
 
 class LogCoshError(Metric):
+    """Log Cosh Error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import LogCoshError
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> metric = LogCoshError()
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 4)
+        0.1685
+    """
+
     is_differentiable = True
     higher_is_better = False
     full_state_update = False

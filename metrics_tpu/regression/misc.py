@@ -24,6 +24,19 @@ from metrics_tpu.utils.data import dim_zero_cat
 
 
 class CosineSimilarity(Metric):
+    """Cosine Similarity.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import CosineSimilarity
+        >>> target = jnp.array([[3.0, 4.0], [0.0, 1.0]])
+        >>> preds = jnp.array([[3.0, 4.0], [1.0, 0.0]])
+        >>> metric = CosineSimilarity()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(1., dtype=float32)
+    """
+
     is_differentiable = True
     higher_is_better = True
     full_state_update = False
@@ -49,6 +62,19 @@ class CosineSimilarity(Metric):
 
 
 class KLDivergence(Metric):
+    """KL Divergence.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import KLDivergence
+        >>> p = jnp.array([[0.36, 0.48, 0.16]])
+        >>> q = jnp.array([[1/3, 1/3, 1/3]])
+        >>> metric = KLDivergence()
+        >>> metric.update(p, q)
+        >>> round(float(metric.compute()), 4)
+        0.0853
+    """
+
     is_differentiable = True
     higher_is_better = False
     full_state_update = False
@@ -87,6 +113,19 @@ class KLDivergence(Metric):
 
 
 class TweedieDevianceScore(Metric):
+    """Tweedie Deviance Score.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import TweedieDevianceScore
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> metric = TweedieDevianceScore()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.375, dtype=float32)
+    """
+
     is_differentiable = True
     higher_is_better = False
     full_state_update = False
@@ -109,6 +148,19 @@ class TweedieDevianceScore(Metric):
 
 
 class SpearmanCorrCoef(Metric):
+    """Spearman Corr Coef.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import SpearmanCorrCoef
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> metric = SpearmanCorrCoef()
+        >>> metric.update(preds, target)
+        >>> float(metric.compute())  # doctest: +ELLIPSIS
+        0.999...
+    """
+
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
@@ -136,6 +188,19 @@ class SpearmanCorrCoef(Metric):
 
 
 class KendallRankCorrCoef(Metric):
+    """Kendall Rank Corr Coef.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import KendallRankCorrCoef
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> metric = KendallRankCorrCoef()
+        >>> metric.update(preds, target)
+        >>> float(metric.compute())
+        1.0
+    """
+
     is_differentiable = False
     higher_is_better = None
     full_state_update = True

@@ -94,6 +94,19 @@ class _PearsonBase(Metric):
 
 
 class PearsonCorrCoef(_PearsonBase):
+    """Pearson Corr Coef.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import PearsonCorrCoef
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> metric = PearsonCorrCoef()
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 4)
+        0.9849
+    """
+
     higher_is_better = None
 
     def compute(self) -> Array:
@@ -102,6 +115,19 @@ class PearsonCorrCoef(_PearsonBase):
 
 
 class ConcordanceCorrCoef(_PearsonBase):
+    """Concordance Corr Coef.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import ConcordanceCorrCoef
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> metric = ConcordanceCorrCoef()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.9767892, dtype=float32)
+    """
+
     higher_is_better = None
 
     def compute(self) -> Array:
@@ -110,6 +136,19 @@ class ConcordanceCorrCoef(_PearsonBase):
 
 
 class ExplainedVariance(Metric):
+    """Explained Variance.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import ExplainedVariance
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> metric = ExplainedVariance()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.95717347, dtype=float32)
+    """
+
     is_differentiable = True
     higher_is_better = True
     full_state_update = False
@@ -142,6 +181,19 @@ class ExplainedVariance(Metric):
 
 
 class R2Score(Metric):
+    """R2 Score.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import R2Score
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> metric = R2Score()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.94860816, dtype=float32)
+    """
+
     is_differentiable = True
     higher_is_better = True
     full_state_update = False

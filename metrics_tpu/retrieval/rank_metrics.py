@@ -33,7 +33,19 @@ def _validate_k(k: Optional[int]) -> None:
 
 
 class RetrievalMAP(RetrievalMetric):
-    """Mean Average Precision over queries."""
+    """Mean Average Precision over queries.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalMAP
+        >>> indexes = jnp.array([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.array([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.array([False, False, True, False, True, False, True])
+        >>> metric = RetrievalMAP()
+        >>> metric.update(preds, target, indexes=indexes)
+        >>> metric.compute()
+        Array(0.7916667, dtype=float32)
+    """
 
     def _query_values(self, g: GroupedRanks) -> Array:
         prec_at_hit = g.cum_hits / (g.rank.astype(jnp.float32) + 1.0)
@@ -42,7 +54,19 @@ class RetrievalMAP(RetrievalMetric):
 
 
 class RetrievalMRR(RetrievalMetric):
-    """Mean Reciprocal Rank over queries."""
+    """Mean Reciprocal Rank over queries.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalMRR
+        >>> indexes = jnp.array([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.array([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.array([False, False, True, False, True, False, True])
+        >>> metric = RetrievalMRR()
+        >>> metric.update(preds, target, indexes=indexes)
+        >>> metric.compute()
+        Array(0.75, dtype=float32)
+    """
 
     def _query_values(self, g: GroupedRanks) -> Array:
         n = g.rank.shape[0]

@@ -16,6 +16,13 @@ class BLEUScore(Metric):
 
     States are four psum-able arrays: per-order clipped-match numerators and
     denominators plus corpus length counters.
+
+    Example:
+        >>> from metrics_tpu import BLEUScore
+        >>> metric = BLEUScore()
+        >>> metric.update(["the cat is on the mat"], [["the cat is on the mat"]])
+        >>> metric.compute()
+        Array(1., dtype=float32)
     """
 
     is_differentiable = False

@@ -12,7 +12,15 @@ from metrics_tpu.metric import Metric
 
 
 class CharErrorRate(Metric):
-    """Character error rate over a streaming corpus (reference text/cer.py:24-95)."""
+    """Character error rate over a streaming corpus (reference text/cer.py:24-95).
+
+    Example:
+        >>> from metrics_tpu import CharErrorRate
+        >>> metric = CharErrorRate()
+        >>> metric.update(["abcd"], ["abce"])
+        >>> metric.compute()
+        Array(0.25, dtype=float32)
+    """
 
     is_differentiable = False
     higher_is_better = False

@@ -12,7 +12,15 @@ from metrics_tpu.metric import Metric
 
 
 class WordErrorRate(Metric):
-    """Word error rate over a streaming corpus (reference text/wer.py:23-92)."""
+    """Word error rate over a streaming corpus (reference text/wer.py:23-92).
+
+    Example:
+        >>> from metrics_tpu import WordErrorRate
+        >>> metric = WordErrorRate()
+        >>> metric.update(["this is the prediction"], ["this is the reference"])
+        >>> metric.compute()
+        Array(0.25, dtype=float32)
+    """
 
     is_differentiable = False
     higher_is_better = False

@@ -13,6 +13,18 @@ from metrics_tpu.metric import Metric
 
 
 class ClasswiseWrapper(Metric):
+    """Classwise Wrapper.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import ClasswiseWrapper
+        >>> from metrics_tpu.classification import MulticlassAccuracy
+        >>> metric = ClasswiseWrapper(MulticlassAccuracy(num_classes=3, average=None))
+        >>> metric.update(jnp.array([0, 1, 2, 1]), jnp.array([0, 1, 2, 2]))
+        >>> {k: float(v) for k, v in metric.compute().items()}
+        {'multiclassaccuracy_0': 1.0, 'multiclassaccuracy_1': 1.0, 'multiclassaccuracy_2': 0.5}
+    """
+
     full_state_update: Optional[bool] = True
 
     def __init__(self, metric: Metric, labels: Optional[List[str]] = None, **kwargs: Any) -> None:

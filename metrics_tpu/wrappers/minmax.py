@@ -15,6 +15,20 @@ from metrics_tpu.metric import Metric
 
 
 class MinMaxMetric(Metric):
+    """Min Max Metric.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MinMaxMetric, MeanMetric
+        >>> metric = MinMaxMetric(MeanMetric())
+        >>> metric.update(jnp.array(2.0))
+        >>> {k: float(v) for k, v in metric.compute().items()}
+        {'raw': 2.0, 'max': 2.0, 'min': 2.0}
+        >>> metric.update(jnp.array(4.0))
+        >>> {k: float(v) for k, v in metric.compute().items()}
+        {'raw': 3.0, 'max': 3.0, 'min': 2.0}
+    """
+
     full_state_update: Optional[bool] = True
 
     min_val: Array

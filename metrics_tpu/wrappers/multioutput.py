@@ -30,6 +30,17 @@ def _get_nan_indices(*tensors: Array) -> Array:
 
 
 class MultioutputWrapper(Metric):
+    """Multioutput Wrapper.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MultioutputWrapper, MeanSquaredError
+        >>> metric = MultioutputWrapper(MeanSquaredError(), num_outputs=2)
+        >>> metric.update(jnp.array([[1.0, 10.0], [2.0, 20.0]]), jnp.array([[1.0, 11.0], [2.0, 22.0]]))
+        >>> metric.compute()
+        Array([0. , 2.5], dtype=float32)
+    """
+
     is_differentiable = False
 
     def __init__(

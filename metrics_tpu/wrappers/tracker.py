@@ -18,7 +18,19 @@ from metrics_tpu.utils.prints import rank_zero_warn
 
 
 class MetricTracker:
-    """List of deep-copied snapshots, one per ``increment()`` (reference tracker.py:26)."""
+    """List of deep-copied snapshots, one per ``increment()`` (reference tracker.py:26).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MetricTracker, MeanMetric
+        >>> tracker = MetricTracker(MeanMetric())
+        >>> tracker.increment()
+        >>> tracker.update(jnp.array(1.0))
+        >>> tracker.increment()
+        >>> tracker.update(jnp.array(3.0))
+        >>> float(tracker.best_metric())
+        3.0
+    """
 
     def __init__(self, metric: Union[Metric, MetricCollection], maximize: Union[bool, List[bool]] = True) -> None:
         if not isinstance(metric, (Metric, MetricCollection)):

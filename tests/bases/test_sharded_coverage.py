@@ -15,6 +15,7 @@ from metrics_tpu.classification import (
     MulticlassAccuracy,
     MulticlassStatScores,
 )
+from tests.helpers.testers import sharded_metric_eval
 
 NUM_DEVICES = 8
 NUM_CLASSES = 5
@@ -28,25 +29,9 @@ def _sharded_eval(metric, preds, target):
     """Update + sync inside shard_map; compute in-trace or on host per the metric."""
     preds_stack = jnp.stack([jnp.asarray(p) for p in preds])
     target_stack = jnp.stack([jnp.asarray(t) for t in target])
-    k = len(preds) // NUM_DEVICES
-
-    def step(p_shard, t_shard):
-        state = metric.init_state()
-        for i in range(k):
-            state = metric.update_state(state, p_shard[i], t_shard[i])
-        if metric._host_compute:
-            return metric.sync_state(state, "dp")
-        return metric.compute_from(state, axis_name="dp")
-
-    if metric._host_compute:
-        out_specs = {n: [P()] if isinstance(d, list) else P() for n, d in metric._defaults.items()}
-        out_specs["_update_count"] = P()
-    else:
-        out_specs = P()
-    result = jax.jit(
-        jax.shard_map(step, mesh=_mesh(), in_specs=(P("dp"), P("dp")), out_specs=out_specs, check_vma=False)
-    )(preds_stack, target_stack)
-    return metric.compute_from(result) if metric._host_compute else result
+    return sharded_metric_eval(
+        metric, preds_stack, target_stack, _mesh(), batches_per_device=len(preds) // NUM_DEVICES
+    )
 
 
 def test_ignore_index_through_sharded_path():

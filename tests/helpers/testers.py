@@ -94,6 +94,59 @@ def _fake_dist_sync_fns(metrics: Sequence[Metric]):
     return fn_for_rank
 
 
+def sharded_metric_eval(
+    metric: Metric,
+    preds_stack,
+    target_stack,
+    mesh: Mesh,
+    batches_per_device: int = 1,
+    shard_kw: Optional[Dict[str, Any]] = None,
+    const_kw: Optional[Dict[str, Any]] = None,
+):
+    """Run a metric's pure API through shard_map over ``mesh`` and return the value.
+
+    The single source of truth for the sharded wiring (step fn, out_specs derived
+    from ``_defaults``, the ``_update_count`` entry, and the check_vma gate for
+    all_gather states). ``preds_stack``/``target_stack`` lead with the stacked batch
+    axis (num_devices * batches_per_device); for ``_host_compute`` metrics the synced
+    state is returned to host and finished with ``compute_from``.
+    """
+    shard_kw = shard_kw or {}
+    const_kw = const_kw or {}
+    k = batches_per_device
+
+    def step(p_shard, t_shard, kw_shard):
+        state = metric.init_state()
+        for i in range(k):
+            kw_i = {name: v[i] for name, v in kw_shard.items()}
+            state = metric.update_state(state, p_shard[i], t_shard[i], **kw_i, **const_kw)
+        if metric._host_compute:
+            return metric.sync_state(state, "dp")
+        return metric.compute_from(state, axis_name="dp")
+
+    in_specs = (P("dp"), P("dp"), {name: P("dp") for name in shard_kw})
+    if metric._host_compute:
+        # synced state pytree: non-empty list states come back as 1-element lists
+        out_specs: Any = {
+            name: [P()] if isinstance(default, list) else P() for name, default in metric._defaults.items()
+        }
+        out_specs["_update_count"] = P()
+    else:
+        out_specs = P()
+
+    # cat/None-reduce states all_gather in-trace, whose outputs the vma system
+    # can't statically prove replicated — disable the check for those
+    has_gather_state = any(isinstance(d, list) for d in metric._defaults.values()) or any(
+        r is None or r == "cat" or callable(r) for r in metric._reductions.values()
+    )
+    result = jax.jit(
+        jax.shard_map(step, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=not has_gather_state)
+    )(preds_stack, target_stack, shard_kw)
+    if metric._host_compute:
+        result = metric.compute_from(result)
+    return result
+
+
 class MetricTester:
     """Drop-in analogue of the reference MetricTester (testers.py:337-…)."""
 
@@ -251,35 +304,9 @@ class MetricTester:
             else:
                 const_kw[name] = value
 
-        def step(p_shard, t_shard, kw_shard):
-            state = metric.init_state()
-            for i in range(k):
-                kw_i = {name: v[i] for name, v in kw_shard.items()}
-                state = metric.update_state(state, p_shard[i], t_shard[i], **kw_i, **const_kw)
-            if metric._host_compute:
-                return metric.sync_state(state, "dp")
-            return metric.compute_from(state, axis_name="dp")
-
-        in_specs = (P("dp"), P("dp"), {name: P("dp") for name in shard_kw})
-        if metric._host_compute:
-            # synced state pytree: non-empty list states come back as 1-element lists
-            out_specs: Any = {
-                name: [P()] if isinstance(default, list) else P() for name, default in metric._defaults.items()
-            }
-            out_specs["_update_count"] = P()
-        else:
-            out_specs = P()
-
-        # cat/None-reduce states all_gather in-trace, whose outputs the vma system
-        # can't statically prove replicated — disable the check for those
-        has_gather_state = any(isinstance(d, list) for d in metric._defaults.values()) or any(
-            r is None or r == "cat" or callable(r) for r in metric._reductions.values()
+        result = sharded_metric_eval(
+            metric, preds_stack, target_stack, mesh, k, shard_kw=shard_kw, const_kw=const_kw
         )
-        result = jax.jit(
-            jax.shard_map(step, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=not has_gather_state)
-        )(preds_stack, target_stack, shard_kw)
-        if metric._host_compute:
-            result = metric.compute_from(result)
         _assert_allclose(result, ref_result, atol=atol)
 
     def run_precision_test_cpu(
