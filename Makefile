@@ -5,9 +5,10 @@ test:
 	python -m pytest tests/ -q
 
 # Docstring examples across the package (reference runs --doctest-modules over src/,
-# /root/reference/Makefile:23-31 + pyproject.toml:28-33).
+# /root/reference/Makefile:23-31 + pyproject.toml:28-33). One walker — the same one
+# the normal test suite runs — so examples can't pass one config and fail another.
 doctest:
-	JAX_PLATFORMS=cpu python -m pytest --doctest-modules metrics_tpu/ -q --ignore=metrics_tpu/functional/text/bert.py
+	python -m pytest tests/test_doctests.py -q
 
 # Driver-facing artifacts.
 multichip:

@@ -55,8 +55,7 @@ def emit(name: str, value_ms: float, unit: str = "ms", **extra) -> None:
 
 
 def timed(fn, *run_args, steps=STEPS):
-    fn(*run_args)  # warm-up / compile
-    jax.block_until_ready(fn(*run_args))
+    jax.block_until_ready(fn(*run_args))  # warm-up / compile
     t0 = time.perf_counter()
     out = None
     for _ in range(steps):

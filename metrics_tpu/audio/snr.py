@@ -12,7 +12,18 @@ from metrics_tpu.metric import Metric
 
 
 class SignalNoiseRatio(Metric):
-    """Mean SNR over samples (reference audio/snr.py:22-83); jittable update."""
+    """Mean SNR over samples (reference audio/snr.py:22-83); jittable update.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import SignalNoiseRatio
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> metric = SignalNoiseRatio()
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 3)
+        16.18
+    """
 
     is_differentiable = True
     higher_is_better = True
@@ -34,7 +45,18 @@ class SignalNoiseRatio(Metric):
 
 
 class ScaleInvariantSignalNoiseRatio(Metric):
-    """Mean SI-SNR over samples (reference audio/snr.py:86-138); jittable update."""
+    """Mean SI-SNR over samples (reference audio/snr.py:86-138); jittable update.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import ScaleInvariantSignalNoiseRatio
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> metric = ScaleInvariantSignalNoiseRatio()
+        >>> metric.update(preds, target)
+        >>> round(float(metric.compute()), 3)
+        15.092
+    """
 
     is_differentiable = True
     higher_is_better = True

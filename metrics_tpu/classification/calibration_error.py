@@ -32,6 +32,17 @@ from metrics_tpu.utils.enums import ClassificationTaskNoMultilabel
 
 
 class BinaryCalibrationError(Metric):
+    """Expected calibration error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import BinaryCalibrationError
+        >>> metric = BinaryCalibrationError(n_bins=2)
+        >>> metric.update(jnp.array([0.9, 0.1, 0.8, 0.3]), jnp.array([1, 0, 1, 1]))
+        >>> round(float(metric.compute()), 4)
+        0.225
+    """
+
     is_differentiable = False
     higher_is_better = False
     full_state_update = False

@@ -18,6 +18,17 @@ from metrics_tpu.utils.data import dim_zero_cat
 
 
 class Dice(Metric):
+    """Dice coefficient.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Dice
+        >>> metric = Dice()
+        >>> metric.update(jnp.array([0, 1, 1, 0]), jnp.array([0, 1, 0, 0]))
+        >>> metric.compute()
+        Array(0.75, dtype=float32)
+    """
+
     is_differentiable = False
     higher_is_better = True
     full_state_update = False

@@ -27,6 +27,17 @@ from metrics_tpu.utils.enums import ClassificationTaskNoMultilabel
 
 
 class BinaryHingeLoss(Metric):
+    """Binary hinge loss.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import BinaryHingeLoss
+        >>> metric = BinaryHingeLoss()
+        >>> metric.update(jnp.array([0.9, 0.1, 0.8]), jnp.array([1, 0, 1]))
+        >>> metric.compute()
+        Array(0.4666667, dtype=float32)
+    """
+
     is_differentiable = True
     higher_is_better = False
     full_state_update = False

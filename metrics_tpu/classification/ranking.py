@@ -69,6 +69,19 @@ class MultilabelCoverageError(_MultilabelRankingMetric):
 
 
 class MultilabelRankingAveragePrecision(_MultilabelRankingMetric):
+    """Label-ranking average precision.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import MultilabelRankingAveragePrecision
+        >>> preds = jnp.array([[0.9, 0.1, 0.8], [0.3, 0.7, 0.2]])
+        >>> target = jnp.array([[1, 0, 1], [0, 1, 0]])
+        >>> metric = MultilabelRankingAveragePrecision(num_labels=3)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(1., dtype=float32)
+    """
+
     higher_is_better = True
     _update_fn = staticmethod(_multilabel_ranking_average_precision_update)
 

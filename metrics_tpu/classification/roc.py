@@ -22,6 +22,18 @@ from metrics_tpu.utils.enums import ClassificationTask
 
 
 class BinaryROC(BinaryPrecisionRecallCurve):
+    """ROC curve (fpr, tpr, thresholds).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import BinaryROC
+        >>> metric = BinaryROC(thresholds=None)
+        >>> metric.update(jnp.array([0.11, 0.22, 0.84, 0.73]), jnp.array([0, 1, 1, 1]))
+        >>> fpr, tpr, thresholds = metric.compute()
+        >>> fpr
+        Array([0., 0., 0., 0., 1.], dtype=float32)
+    """
+
     is_differentiable = False
     higher_is_better = None
     full_state_update = False

@@ -179,9 +179,12 @@ class MeanAveragePrecision(Metric):
         return [{k: (np.asarray(v) if hasattr(v, "shape") else v) for k, v in item.items()} for item in items]
 
     def update(self, preds: List[Dict[str, Any]], target: List[Dict[str, Any]]) -> None:
-        _input_validator(preds, target, iou_type=self.iou_type)
+        # fetch BEFORE validation: the validator materialises every array with
+        # np.asarray, which would serialise one blocking D2H round-trip per array
+        # and defeat the overlapped transfer below
         preds = self._fetch_to_host(preds)
         target = self._fetch_to_host(target)
+        _input_validator(preds, target, iou_type=self.iou_type)
 
         for item in preds:
             self.detections.append(self._get_safe_item_values(item))

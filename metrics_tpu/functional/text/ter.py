@@ -7,6 +7,13 @@ phrase-shift search that accepts shifts while they reduce the edit distance.
 TPU-first note: the DP cost rows are vectorized numpy (the within-row insertion
 chain is folded with a prefix-min accumulate); only the row loop and the heuristic
 shift search stay in Python. State is two psum-able scalars.
+
+Provenance: the host-side shift-search scaffolding (``_find_shifted_pairs``,
+``_perform_shift``, ``_trace_to_alignment``, the tokenizer regex tables, and the
+shift-ranking tuple order) is a deliberate transcription of the published sacrebleu
+``lib_ter`` tercom protocol — the exact rule set is required for bit-parity with the
+standard TER definition, so it intentionally mirrors the upstream algorithm rather
+than being an independent redesign. The DP kernel itself is original (see above).
 """
 
 from __future__ import annotations

@@ -69,14 +69,36 @@ class CramersV(_NominalBase):
 
 
 class PearsonsContingencyCoefficient(_NominalBase):
-    """Pearson's contingency coefficient (reference nominal/pearson.py)."""
+    """Pearson's contingency coefficient (reference nominal/pearson.py).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import PearsonsContingencyCoefficient
+        >>> a = jnp.array([0, 1, 2, 1, 0, 2, 1])
+        >>> b = jnp.array([0, 1, 2, 1, 0, 2, 2])
+        >>> metric = PearsonsContingencyCoefficient(num_classes=3)
+        >>> metric.update(a, b)
+        >>> round(float(metric.compute()), 4)
+        0.7687
+    """
 
     def compute(self) -> Array:
         return _pearsons_contingency_coefficient_compute(self.confmat)
 
 
 class TschuprowsT(_NominalBase):
-    """Tschuprow's T (reference nominal/tschuprows.py)."""
+    """Tschuprow's T (reference nominal/tschuprows.py).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import TschuprowsT
+        >>> a = jnp.array([0, 1, 2, 1, 0, 2, 1])
+        >>> b = jnp.array([0, 1, 2, 1, 0, 2, 2])
+        >>> metric = TschuprowsT(num_classes=3)
+        >>> metric.update(a, b)
+        >>> round(float(metric.compute()), 4)
+        0.7638
+    """
 
     def __init__(self, num_classes: int, bias_correction: bool = True, **kwargs: Any) -> None:
         super().__init__(num_classes, **kwargs)
@@ -87,7 +109,18 @@ class TschuprowsT(_NominalBase):
 
 
 class TheilsU(_NominalBase):
-    """Theil's U (reference nominal/theils_u.py)."""
+    """Theil's U (reference nominal/theils_u.py).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import TheilsU
+        >>> a = jnp.array([0, 1, 2, 1, 0, 2, 1])
+        >>> b = jnp.array([0, 1, 2, 1, 0, 2, 2])
+        >>> metric = TheilsU(num_classes=3)
+        >>> metric.update(a, b)
+        >>> round(float(metric.compute()), 4)
+        0.7472
+    """
 
     def compute(self) -> Array:
         return _theils_u_compute(self.confmat)

@@ -17,7 +17,15 @@ from metrics_tpu.metric import Metric
 
 
 class CHRFScore(Metric):
-    """chrF/chrF++ score over a streaming corpus (reference text/chrf.py:46-186)."""
+    """chrF/chrF++ score over a streaming corpus (reference text/chrf.py:46-186).
+
+    Example:
+        >>> from metrics_tpu import CHRFScore
+        >>> metric = CHRFScore()
+        >>> metric.update(["the cat"], [["the cat"]])
+        >>> metric.compute()
+        Array(1., dtype=float32)
+    """
 
     is_differentiable = False
     higher_is_better = True

@@ -13,7 +13,15 @@ from metrics_tpu.metric import Metric
 
 class ExtendedEditDistance(Metric):
     """EED over a streaming corpus; sentence scores kept as a ragged "cat" state
-    (reference text/eed.py:24-123)."""
+    (reference text/eed.py:24-123).
+
+    Example:
+        >>> from metrics_tpu import ExtendedEditDistance
+        >>> metric = ExtendedEditDistance()
+        >>> metric.update(["the cat"], ["the cat"])
+        >>> round(float(metric.compute()), 4)
+        0.0323
+    """
 
     is_differentiable = False
     higher_is_better = False

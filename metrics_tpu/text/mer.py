@@ -12,7 +12,15 @@ from metrics_tpu.metric import Metric
 
 
 class MatchErrorRate(Metric):
-    """Match error rate over a streaming corpus (reference text/mer.py:23-92)."""
+    """Match error rate over a streaming corpus (reference text/mer.py:23-92).
+
+    Example:
+        >>> from metrics_tpu import MatchErrorRate
+        >>> metric = MatchErrorRate()
+        >>> metric.update(["this is the prediction"], ["this is the reference"])
+        >>> metric.compute()
+        Array(0.25, dtype=float32)
+    """
 
     is_differentiable = False
     higher_is_better = False

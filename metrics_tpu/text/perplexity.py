@@ -16,6 +16,16 @@ class Perplexity(Metric):
 
     Fully jittable update/compute — usable inside a pjit'ed eval step via the
     functional ``update_state``/``compute_from`` API.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Perplexity
+        >>> probs = jnp.array([[[0.6, 0.2, 0.2], [0.2, 0.7, 0.1]]])
+        >>> target = jnp.array([[0, 1]])
+        >>> metric = Perplexity()
+        >>> metric.update(probs, target)
+        >>> round(float(metric.compute()), 4)
+        2.2461
     """
 
     is_differentiable = True

@@ -19,7 +19,15 @@ from metrics_tpu.utils.imports import _NLTK_AVAILABLE
 
 class ROUGEScore(Metric):
     """ROUGE-N/L/LSum over a streaming corpus; per-sample scores as ragged "cat"
-    states (reference text/rouge.py:31-175)."""
+    states (reference text/rouge.py:31-175).
+
+    Example:
+        >>> from metrics_tpu.text import ROUGEScore
+        >>> metric = ROUGEScore()
+        >>> scores = metric(["the cat is on the mat"], ["the cat is on the mat"])
+        >>> float(scores["rouge1_fmeasure"])
+        1.0
+    """
 
     is_differentiable = False
     higher_is_better = True

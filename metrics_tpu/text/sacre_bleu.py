@@ -10,7 +10,15 @@ from metrics_tpu.utils.imports import _REGEX_AVAILABLE
 
 
 class SacreBLEUScore(BLEUScore):
-    """BLEU with sacrebleu tokenization (reference text/sacre_bleu.py:29-112)."""
+    """BLEU with sacrebleu tokenization (reference text/sacre_bleu.py:29-112).
+
+    Example:
+        >>> from metrics_tpu import SacreBLEUScore
+        >>> metric = SacreBLEUScore()
+        >>> metric.update(["the cat is on the mat"], [["the cat is on the mat"]])
+        >>> metric.compute()
+        Array(1., dtype=float32)
+    """
 
     def __init__(
         self,

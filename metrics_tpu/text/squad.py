@@ -18,7 +18,16 @@ from metrics_tpu.metric import Metric
 
 
 class SQuAD(Metric):
-    """SQuAD EM/F1 over a streaming corpus (reference text/squad.py:29-115)."""
+    """SQuAD EM/F1 over a streaming corpus (reference text/squad.py:29-115).
+
+    Example:
+        >>> from metrics_tpu import SQuAD
+        >>> metric = SQuAD()
+        >>> metric.update([{"prediction_text": "the cat", "id": "1"}],
+        ...               [{"answers": {"text": ["the cat"], "answer_start": [0]}, "id": "1"}])
+        >>> {k: float(v) for k, v in metric.compute().items()}
+        {'exact_match': 100.0, 'f1': 100.0}
+    """
 
     is_differentiable = False
     higher_is_better = True

@@ -12,7 +12,15 @@ from metrics_tpu.metric import Metric
 
 
 class TranslationEditRate(Metric):
-    """TER over a streaming corpus (reference text/ter.py:24-122)."""
+    """TER over a streaming corpus (reference text/ter.py:24-122).
+
+    Example:
+        >>> from metrics_tpu import TranslationEditRate
+        >>> metric = TranslationEditRate()
+        >>> metric.update(["the cat"], [["the cat"]])
+        >>> metric.compute()
+        Array(0., dtype=float32)
+    """
 
     is_differentiable = False
     higher_is_better = False

@@ -12,7 +12,15 @@ from metrics_tpu.metric import Metric
 
 
 class WordInfoLost(Metric):
-    """Word information lost over a streaming corpus (reference text/wil.py:23-93)."""
+    """Word information lost over a streaming corpus (reference text/wil.py:23-93).
+
+    Example:
+        >>> from metrics_tpu import WordInfoLost
+        >>> metric = WordInfoLost()
+        >>> metric.update(["this is the prediction"], ["this is the reference"])
+        >>> metric.compute()
+        Array(0.4375, dtype=float32)
+    """
 
     is_differentiable = False
     higher_is_better = False

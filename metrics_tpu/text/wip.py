@@ -12,7 +12,15 @@ from metrics_tpu.metric import Metric
 
 
 class WordInfoPreserved(Metric):
-    """Word information preserved over a streaming corpus (reference text/wip.py:23-93)."""
+    """Word information preserved over a streaming corpus (reference text/wip.py:23-93).
+
+    Example:
+        >>> from metrics_tpu import WordInfoPreserved
+        >>> metric = WordInfoPreserved()
+        >>> metric.update(["this is the prediction"], ["this is the reference"])
+        >>> metric.compute()
+        Array(0.5625, dtype=float32)
+    """
 
     is_differentiable = False
     higher_is_better = True

@@ -58,14 +58,45 @@ def _assert_allclose(tm_result: Any, ref_result: Any, atol: float = 1e-8, key: O
 
 
 def _assert_dtype_support(metric: Optional[Metric], metric_functional: Optional[Callable], preds, target, dtype, **kwargs_update):
-    """bf16/f16 inputs must be accepted (TPU analogue of the reference fp16 tests)."""
+    """bf16/f16 inputs must be accepted AND close to the f32 result.
+
+    The reference's fp16 tests compare values, not just absence of crashes
+    (testers.py:488-549); the tolerance here is loose because bf16 has ~3 decimal
+    digits — this catches dtype-induced blowups (overflow, catastrophic
+    cancellation, accumulating in the input dtype), not rounding.
+    """
+    bf16_rtol, bf16_atol = 5e-2, 5e-2
     y_hat = preds[0].astype(dtype) if jnp.issubdtype(preds[0].dtype, jnp.floating) else preds[0]
     y = target[0].astype(dtype) if jnp.issubdtype(target[0].dtype, jnp.floating) else target[0]
+
+    def _close(low, full, where):
+        low_leaves, full_leaves = jax.tree.leaves(low), jax.tree.leaves(full)
+        assert len(low_leaves) == len(full_leaves), (
+            f"{where}: {dtype} result has a different tree structure than f32"
+        )
+        compared = 0
+        for lo, fu in zip(low_leaves, full_leaves):
+            lo, fu = np.asarray(lo, dtype=np.float64), np.asarray(fu, dtype=np.float64)
+            if lo.shape != fu.shape:
+                continue  # e.g. threshold vectors that depend on input dtype
+            np.testing.assert_allclose(
+                lo, fu, rtol=bf16_rtol, atol=bf16_atol,
+                err_msg=f"{where}: {dtype} result diverges from f32 beyond bf16 tolerance",
+            )
+            compared += 1
+        assert compared > 0, f"{where}: no comparable leaves — dtype check was vacuous"
+
     if metric is not None:
         metric.update(y_hat, y, **kwargs_update)
-        metric.compute()
+        low = metric.compute()
+        full_metric = metric.clone()
+        full_metric.reset()
+        full_metric.update(preds[0], target[0], **kwargs_update)
+        _close(low, full_metric.compute(), type(metric).__name__)
     if metric_functional is not None:
-        metric_functional(y_hat, y, **kwargs_update)
+        low = metric_functional(y_hat, y, **kwargs_update)
+        full = metric_functional(preds[0], target[0], **kwargs_update)
+        _close(low, full, getattr(metric_functional, "__name__", "functional"))
 
 
 def _fake_dist_sync_fns(metrics: Sequence[Metric]):

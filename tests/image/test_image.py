@@ -81,6 +81,10 @@ class TestPSNR(MetricTester):
         self.run_differentiability_test(PREDS, TARGET, PeakSignalNoiseRatio, peak_signal_noise_ratio,
                                         metric_args={"data_range": 1.0})
 
+    def test_bf16(self):
+        self.run_precision_test_cpu(PREDS, TARGET, PeakSignalNoiseRatio, peak_signal_noise_ratio,
+                                    metric_args={"data_range": 1.0})
+
 
 # ------------------------------------------------------------------------------ ssim
 
@@ -148,6 +152,10 @@ class TestSSIM(MetricTester):
     def test_differentiability(self):
         self.run_differentiability_test(PREDS, TARGET_SIM, StructuralSimilarityIndexMeasure,
                                         structural_similarity_index_measure, metric_args={"data_range": 1.0})
+
+    def test_bf16(self):
+        self.run_precision_test_cpu(PREDS, TARGET_SIM, StructuralSimilarityIndexMeasure,
+                                    structural_similarity_index_measure, metric_args={"data_range": 1.0})
 
     def test_ms_ssim_smoke(self):
         """MS-SSIM: identical images → 1, decreasing with distortion.
@@ -264,6 +272,9 @@ class TestSAM(MetricTester):
 
     def test_differentiability(self):
         self.run_differentiability_test(PREDS, TARGET_SIM, SpectralAngleMapper, spectral_angle_mapper)
+
+    def test_bf16(self):
+        self.run_precision_test_cpu(PREDS, TARGET_SIM, SpectralAngleMapper, spectral_angle_mapper)
 
 
 class TestERGAS(MetricTester):

@@ -92,3 +92,64 @@ def test_clip_score_module(tiny_clip):
         all_scores.append(np.asarray(s))
     expected = max(float(np.concatenate(all_scores).mean()), 0.0)
     assert float(metric.compute()) == pytest.approx(expected, abs=1e-4)
+
+
+def test_clip_score_reset_and_reuse(tiny_clip):
+    rng = np.random.RandomState(3)
+    metric = CLIPScore(model=tiny_clip, processor=_StubProcessor())
+    imgs = jnp.asarray(rng.randint(0, 255, (2, 3, IMG, IMG)).astype(np.float32))
+    metric.update(imgs, ["caption a", "caption b"])
+    first = float(metric.compute())
+    metric.reset()
+    assert metric.n_samples == 0
+    metric.update(imgs, ["caption a", "caption b"])
+    assert float(metric.compute()) == pytest.approx(first, abs=1e-6)
+
+
+def test_clip_score_fake_world_sync(tiny_clip):
+    """Score/n_samples sum states merge across a fake 2-rank world like any metric."""
+    from tests.helpers.testers import _fake_dist_sync_fns
+
+    rng = np.random.RandomState(4)
+    imgs = [jnp.asarray(rng.randint(0, 255, (2, 3, IMG, IMG)).astype(np.float32)) for _ in range(2)]
+    texts = [["rank zero a", "rank zero b"], ["rank one a", "rank one b"]]
+
+    ranks = [CLIPScore(model=tiny_clip, processor=_StubProcessor(),
+                       distributed_available_fn=lambda: True) for _ in range(2)]
+    for m, im, tx in zip(ranks, imgs, texts):
+        m.update(im, tx)
+    fn_for_rank = _fake_dist_sync_fns(ranks)  # snapshots current per-rank states
+    for r, m in enumerate(ranks):
+        m.dist_sync_fn = fn_for_rank(r)
+    synced = [float(m.compute()) for m in ranks]
+    assert synced[0] == pytest.approx(synced[1], abs=1e-6)
+
+    union = CLIPScore(model=tiny_clip, processor=_StubProcessor())
+    for im, tx in zip(imgs, texts):
+        union.update(im, tx)
+    assert synced[0] == pytest.approx(float(union.compute()), abs=1e-5)
+
+
+def test_clip_score_jit_functional_path(tiny_clip):
+    """update_state/compute_from with precomputed features stays jittable."""
+    rng = np.random.RandomState(5)
+    metric = CLIPScore(model=tiny_clip, processor=_StubProcessor())
+    imgs = jnp.asarray(rng.randint(0, 255, (2, 3, IMG, IMG)).astype(np.float32))
+    metric.update(imgs, ["caption a", "caption b"])
+    expected = float(metric.compute())
+
+    state = metric.init_state()
+    from metrics_tpu.functional.multimodal.clip_score import _clip_score_update
+
+    score, n = _clip_score_update(imgs, ["caption a", "caption b"], tiny_clip, _StubProcessor())
+    import jax as _jax
+
+    @_jax.jit
+    def accumulate(state, score_sum, count):
+        new = dict(state)
+        new["score"] = state["score"] + score_sum
+        new["n_samples"] = state["n_samples"] + count
+        return new
+
+    state = accumulate(state, jnp.sum(score), n)
+    assert float(metric.compute_from(state)) == pytest.approx(expected, abs=1e-5)

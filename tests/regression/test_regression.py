@@ -128,6 +128,10 @@ class TestRegressionMetrics(MetricTester):
         preds, target = data
         self.run_differentiability_test(preds, target, metric_class, metric_fn, metric_args)
 
+    def test_bf16(self, metric_class, metric_fn, sk_fn, metric_args, data):
+        preds, target = data
+        self.run_precision_test_cpu(preds, target, metric_class, metric_fn, metric_args)
+
 
 def test_cosine_similarity():
     import jax.numpy as jnp
