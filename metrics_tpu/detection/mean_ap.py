@@ -73,16 +73,20 @@ def _mask_area(masks: Sequence[Tuple]) -> np.ndarray:
     return np.asarray([mask_utils.area({"size": list(i[0]), "counts": i[1]}) for i in masks])
 
 
-def _input_validator(preds: Sequence[Dict], targets: Sequence[Dict], iou_type: str = "bbox") -> None:
-    """Validate the COCO-style list-of-dicts input (reference mean_ap.py:145-188)."""
+def _validate_structure(preds: Sequence[Dict], targets: Sequence[Dict], iou_type: str = "bbox") -> None:
+    """Type/key checks that need no array materialisation — safe to run pre-transfer."""
     item_val_name = "boxes" if iou_type == "bbox" else "masks"
 
-    if not isinstance(preds, Sequence):
+    if not isinstance(preds, Sequence) or isinstance(preds, (str, bytes)):
         raise ValueError("Expected argument `preds` to be of type Sequence")
-    if not isinstance(targets, Sequence):
+    if not isinstance(targets, Sequence) or isinstance(targets, (str, bytes)):
         raise ValueError("Expected argument `target` to be of type Sequence")
     if len(preds) != len(targets):
         raise ValueError("Expected argument `preds` and `target` to have the same length")
+    if any(not isinstance(p, dict) for p in preds):
+        raise ValueError("Expected all elements of `preds` to be of type dict")
+    if any(not isinstance(t, dict) for t in targets):
+        raise ValueError("Expected all elements of `target` to be of type dict")
 
     for k in [item_val_name, "scores", "labels"]:
         if any(k not in p for p in preds):
@@ -90,6 +94,11 @@ def _input_validator(preds: Sequence[Dict], targets: Sequence[Dict], iou_type: s
     for k in [item_val_name, "labels"]:
         if any(k not in p for p in targets):
             raise ValueError(f"Expected all dicts in `target` to contain the `{k}` key")
+
+
+def _validate_counts(preds: Sequence[Dict], targets: Sequence[Dict], iou_type: str = "bbox") -> None:
+    """Per-item boxes/scores/labels count consistency — materialises the arrays."""
+    item_val_name = "boxes" if iou_type == "bbox" else "masks"
 
     # per-item consistency (reference mean_ap.py:173-188)
     for i, item in enumerate(preds):
@@ -179,12 +188,14 @@ class MeanAveragePrecision(Metric):
         return [{k: (np.asarray(v) if hasattr(v, "shape") else v) for k, v in item.items()} for item in items]
 
     def update(self, preds: List[Dict[str, Any]], target: List[Dict[str, Any]]) -> None:
-        # fetch BEFORE validation: the validator materialises every array with
-        # np.asarray, which would serialise one blocking D2H round-trip per array
-        # and defeat the overlapped transfer below
+        # structural checks first (no array access), then fetch, then the count
+        # checks: the full validator materialises every array with np.asarray, which
+        # would serialise one blocking D2H round-trip per array and defeat the
+        # overlapped transfer
+        _validate_structure(preds, target, iou_type=self.iou_type)
         preds = self._fetch_to_host(preds)
         target = self._fetch_to_host(target)
-        _input_validator(preds, target, iou_type=self.iou_type)
+        _validate_counts(preds, target, iou_type=self.iou_type)
 
         for item in preds:
             self.detections.append(self._get_safe_item_values(item))

@@ -2,9 +2,12 @@
 
 Reference parity: src/torchmetrics/image/lpip.py (class
 ``LearnedPerceptualImagePatchSimilarity`` :34 wrapping the ``lpips`` pip package with
-scalar sum states :136-137). The package dependency is import-gated identically; a
-user-supplied callable ``(img1, img2) -> (N,)`` distance function is the TPU-native
-alternative (e.g. a flax VGG/AlexNet port).
+scalar sum states :136-137). TPU-native redesign: the default backbone is the JAX
+LPIPS network in :mod:`metrics_tpu.image.lpips_net` (alex/vgg/squeeze feature stacks
++ learned linear heads, offline weight loading), which runs inside the metric's XLA
+graph — no torch in the loop. A user-supplied callable ``(img1, img2) -> (N,)`` is
+still accepted, and the torch ``lpips`` package remains available as an explicit
+opt-in backend for bit-parity with the reference.
 """
 
 from __future__ import annotations
@@ -32,28 +35,49 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
         reduction: str = "mean",
         normalize: bool = False,
         distance_fn: Optional[Callable] = None,
+        weights_path: Optional[str] = None,
+        backend: str = "jax",
+        allow_random_weights: bool = False,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
-        if distance_fn is None:
-            if not _LPIPS_AVAILABLE:
-                raise ModuleNotFoundError(
-                    "LPIPS metric requires that lpips is installed."
-                    " Either install as `pip install torchmetrics[image]` or `pip install lpips`,"
-                    " or pass a `distance_fn` callable computing per-image perceptual distances."
-                )
-            valid_net_type = ("vgg", "alex", "squeeze")
-            if net_type not in valid_net_type:
-                raise ValueError(f"Argument `net_type` must be one of {valid_net_type}, but got {net_type}.")
-            import lpips  # pragma: no cover
-
-            net = lpips.LPIPS(net=net_type)  # pragma: no cover
-            distance_fn = lambda a, b: net(a, b).reshape(-1)  # noqa: E731  # pragma: no cover
+        valid_net_type = ("vgg", "alex", "squeeze")
+        if net_type not in valid_net_type:
+            raise ValueError(f"Argument `net_type` must be one of {valid_net_type}, but got {net_type}.")
+        if backend not in ("jax", "lpips"):
+            raise ValueError(f"Argument `backend` must be 'jax' or 'lpips', but got {backend}.")
         valid_reduction = ("mean", "sum")
         if reduction not in valid_reduction:
             raise ValueError(f"Argument `reduction` must be one of {valid_reduction}, but got {reduction}")
         if not isinstance(normalize, bool):
             raise ValueError(f"Argument `normalize` should be a bool but got {normalize}")
+        if distance_fn is None:
+            if backend == "lpips":
+                if not _LPIPS_AVAILABLE:
+                    raise ModuleNotFoundError(
+                        "backend='lpips' requires the lpips package (`pip install lpips`);"
+                        " the default backend='jax' needs no torch dependency."
+                    )
+                import lpips  # pragma: no cover
+                import numpy as _np  # pragma: no cover
+                import torch  # pragma: no cover
+
+                net = lpips.LPIPS(net=net_type)  # pragma: no cover
+
+                def distance_fn(a, b):  # pragma: no cover
+                    # torch-side bridge: jax arrays → torch tensors → numpy distances.
+                    # f32 cast: torch.from_numpy can't take ml_dtypes (bf16) arrays and
+                    # the lpips net weights are float32.
+                    ta = torch.from_numpy(_np.asarray(a, dtype=_np.float32))
+                    tb = torch.from_numpy(_np.asarray(b, dtype=_np.float32))
+                    with torch.no_grad():
+                        return _np.asarray(net(ta, tb).reshape(-1))
+            else:
+                from metrics_tpu.image.lpips_net import make_distance_fn
+
+                distance_fn = make_distance_fn(
+                    net_type, weights_path=weights_path, allow_random_weights=allow_random_weights
+                )
         self.distance_fn = distance_fn
         self.reduction = reduction
         self.normalize = normalize

@@ -1,0 +1,265 @@
+"""TPU-native LPIPS network (perceptual distance) in flax.
+
+Replaces the reference's dependency on the torch ``lpips`` pip package
+(src/torchmetrics/image/lpip.py:34) with a JAX implementation that runs inside the
+metric's XLA graph. Architecture follows the published LPIPS v0.1 design (Zhang et
+al. 2018): a frozen backbone feature stack (``alex`` / ``vgg`` / ``squeeze``),
+channel-unit-normalised features per tap, squared differences, learned non-negative
+1x1 linear heads per tap, spatial mean, sum over taps.
+
+Weights: offline-friendly, same protocol as :mod:`metrics_tpu.image.inception_net` —
+``load_params(path)`` reads a flat ``.npz`` written by ``save_params`` (keys are
+``/``-joined pytree paths). When no weight file is given and none is found at
+``$METRICS_TPU_LPIPS_WEIGHTS``, construction raises unless the caller explicitly
+opts into seeded random initialisation (``allow_random_weights=True``) —
+self-consistent for tests and relative comparisons, NOT comparable to published
+LPIPS numbers. ``tools/convert_lpips_weights.py`` produces the weight file from
+the torch-ecosystem checkpoints.
+
+Layout note: inputs follow the reference convention (N, C, H, W) in [-1, 1]
+(``normalize=True`` on the metric maps [0,1] inputs); internally NHWC, the
+TPU-native convolution layout.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.utils.prints import rank_zero_warn
+
+_WEIGHTS_ENV = "METRICS_TPU_LPIPS_WEIGHTS"
+
+# ImageNet scaling layer constants (lpips ScalingLayer)
+_SHIFT = np.array([-0.030, -0.088, -0.188], dtype=np.float32)
+_SCALE = np.array([0.458, 0.448, 0.450], dtype=np.float32)
+
+# tap channel widths per backbone (lpips v0.1)
+NET_CHANNELS = {
+    "alex": (64, 192, 384, 256, 256),
+    "vgg": (64, 128, 256, 512, 512),
+    "squeeze": (64, 128, 256, 384, 384, 512, 512),
+}
+
+
+def _max_pool(x: Array, window: int = 3, stride: int = 2) -> Array:
+    return nn.max_pool(x, (window, window), strides=(stride, stride))
+
+
+def _max_pool_ceil(x: Array, window: int = 3, stride: int = 2) -> Array:
+    """Max pool with torch ``ceil_mode=True`` semantics (squeezenet1_1 pools).
+
+    Torch's ceil mode keeps a final window that hangs off the right/bottom edge;
+    emulate by -inf padding up to the ceil output size before a VALID pool.
+    """
+    h, w = x.shape[1], x.shape[2]
+    out_h = -(-(h - window) // stride) + 1  # ceil division
+    out_w = -(-(w - window) // stride) + 1
+    pad_h = max((out_h - 1) * stride + window - h, 0)
+    pad_w = max((out_w - 1) * stride + window - w, 0)
+    if pad_h or pad_w:
+        x = jnp.pad(x, ((0, 0), (0, pad_h), (0, pad_w), (0, 0)), constant_values=-jnp.inf)
+    return nn.max_pool(x, (window, window), strides=(stride, stride))
+
+
+class AlexFeatures(nn.Module):
+    """AlexNet feature stack, taps after each of the 5 ReLUs."""
+
+    @nn.compact
+    def __call__(self, x: Array) -> Tuple[Array, ...]:
+        taps = []
+        x = nn.relu(nn.Conv(64, (11, 11), (4, 4), padding=((2, 2), (2, 2)), name="conv1")(x))
+        taps.append(x)
+        x = _max_pool(x)
+        x = nn.relu(nn.Conv(192, (5, 5), padding=((2, 2), (2, 2)), name="conv2")(x))
+        taps.append(x)
+        x = _max_pool(x)
+        x = nn.relu(nn.Conv(384, (3, 3), padding=((1, 1), (1, 1)), name="conv3")(x))
+        taps.append(x)
+        x = nn.relu(nn.Conv(256, (3, 3), padding=((1, 1), (1, 1)), name="conv4")(x))
+        taps.append(x)
+        x = nn.relu(nn.Conv(256, (3, 3), padding=((1, 1), (1, 1)), name="conv5")(x))
+        taps.append(x)
+        return tuple(taps)
+
+
+class VGG16Features(nn.Module):
+    """VGG16 stack, taps after relu1_2, relu2_2, relu3_3, relu4_3, relu5_3."""
+
+    @nn.compact
+    def __call__(self, x: Array) -> Tuple[Array, ...]:
+        taps = []
+        cfg = [(64, 2), (128, 2), (256, 3), (512, 3), (512, 3)]
+        for stage, (width, n_convs) in enumerate(cfg, start=1):
+            for i in range(1, n_convs + 1):
+                x = nn.relu(
+                    nn.Conv(width, (3, 3), padding=((1, 1), (1, 1)), name=f"conv{stage}_{i}")(x)
+                )
+            taps.append(x)
+            if stage < 5:
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        return tuple(taps)
+
+
+class Fire(nn.Module):
+    """SqueezeNet fire module: squeeze 1x1 → expand 1x1 + 3x3, concat."""
+
+    squeeze: int
+    expand: int
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        s = nn.relu(nn.Conv(self.squeeze, (1, 1), name="squeeze")(x))
+        e1 = nn.relu(nn.Conv(self.expand, (1, 1), name="expand1x1")(s))
+        e3 = nn.relu(nn.Conv(self.expand, (3, 3), padding=((1, 1), (1, 1)), name="expand3x3")(s))
+        return jnp.concatenate([e1, e3], axis=-1)
+
+
+class SqueezeFeatures(nn.Module):
+    """SqueezeNet 1.1 stack with the 7 LPIPS taps."""
+
+    @nn.compact
+    def __call__(self, x: Array) -> Tuple[Array, ...]:
+        taps = []
+        x = nn.relu(nn.Conv(64, (3, 3), (2, 2), padding="VALID", name="conv1")(x))
+        taps.append(x)  # 64
+        x = _max_pool_ceil(x)  # torchvision squeezenet1_1 pools use ceil_mode=True
+        x = Fire(16, 64, name="fire2")(x)
+        x = Fire(16, 64, name="fire3")(x)
+        taps.append(x)  # 128
+        x = _max_pool_ceil(x)
+        x = Fire(32, 128, name="fire4")(x)
+        x = Fire(32, 128, name="fire5")(x)
+        taps.append(x)  # 256
+        x = _max_pool_ceil(x)
+        x = Fire(48, 192, name="fire6")(x)
+        taps.append(x)  # 384
+        x = Fire(48, 192, name="fire7")(x)
+        taps.append(x)  # 384
+        x = Fire(64, 256, name="fire8")(x)
+        taps.append(x)  # 512
+        x = Fire(64, 256, name="fire9")(x)
+        taps.append(x)  # 512
+        return tuple(taps)
+
+
+_BACKBONES = {"alex": AlexFeatures, "vgg": VGG16Features, "squeeze": SqueezeFeatures}
+
+
+class LPIPSNet(nn.Module):
+    """Backbone + unit-normalise + squared diff + learned 1x1 heads + spatial mean."""
+
+    net_type: str = "alex"
+
+    @nn.compact
+    def __call__(self, img0: Array, img1: Array) -> Array:
+        # (N, C, H, W) in [-1, 1] → scaled NHWC
+        def prep(x):
+            x = jnp.transpose(x, (0, 2, 3, 1)).astype(jnp.float32)
+            return (x - _SHIFT) / _SCALE
+
+        backbone = _BACKBONES[self.net_type](name="features")
+        taps0 = backbone(prep(img0))
+        # flax reuses the same params for the second call inside one module scope
+        taps1 = backbone(prep(img1))
+
+        total = jnp.zeros((img0.shape[0],), jnp.float32)
+        for i, (f0, f1) in enumerate(zip(taps0, taps1)):
+            f0 = f0 / jnp.maximum(jnp.linalg.norm(f0, axis=-1, keepdims=True), 1e-10)
+            f1 = f1 / jnp.maximum(jnp.linalg.norm(f1, axis=-1, keepdims=True), 1e-10)
+            diff = (f0 - f1) ** 2
+            # learned non-negative linear head (lpips NetLinLayer): 1x1 conv, no bias
+            w = self.param(f"lin{i}", nn.initializers.uniform(scale=0.1), (diff.shape[-1], 1), jnp.float32)
+            contrib = diff @ jnp.abs(w)  # (N, H, W, 1); abs keeps the head a distance
+            total = total + jnp.mean(contrib, axis=(1, 2, 3))
+        return total
+
+
+# ------------------------------------------------------------------ params io
+
+
+def _flatten(d: Dict, prefix: str = ""):
+    for k, v in d.items():
+        key = f"{prefix}/{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            yield from _flatten(v, key)
+        else:
+            yield key, np.asarray(v)
+
+
+def save_params(params: Dict, path: str) -> None:
+    np.savez(path, **dict(_flatten(params)))
+
+
+def load_params(path: str) -> Dict:
+    data = np.load(path)
+    tree: Dict[str, Any] = {}
+    for key in data.files:
+        node = tree
+        parts = key.split("/")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = jnp.asarray(data[key])
+    return tree
+
+
+def init_params(net_type: str = "alex", seed: int = 0, image_size: int = 64) -> Dict:
+    model = LPIPSNet(net_type=net_type)
+    dummy = jnp.zeros((1, 3, image_size, image_size), jnp.float32)
+    return model.init(jax.random.PRNGKey(seed), dummy, dummy)
+
+
+def make_distance_fn(
+    net_type: str = "alex",
+    weights_path: str | None = None,
+    seed: int = 0,
+    allow_random_weights: bool = False,
+):
+    """Build ``(img0, img1) -> (N,)`` perceptual distances on the JAX net.
+
+    Weight resolution: explicit ``weights_path`` → ``$METRICS_TPU_LPIPS_WEIGHTS`` →
+    error, unless ``allow_random_weights=True`` opts into seeded random
+    initialisation (self-consistent for tests/relative comparisons, NOT comparable
+    to published LPIPS numbers — random weights must never reach an eval dashboard
+    silently).
+    """
+    if net_type not in _BACKBONES:
+        raise ValueError(f"Argument `net_type` must be one of {tuple(_BACKBONES)}, but got {net_type}.")
+    path = weights_path or os.environ.get(_WEIGHTS_ENV)
+    model = LPIPSNet(net_type=net_type)
+    if path:
+        variables = load_params(path)
+        # fail fast with a clear message when the file is for a different net_type —
+        # otherwise flax raises an opaque kernel-shape error deep in apply()
+        expected = init_params(net_type, seed=seed, image_size=16)
+        if jax.tree_util.tree_structure(variables) != jax.tree_util.tree_structure(expected) or any(
+            np.asarray(a).shape != np.asarray(b).shape
+            for a, b in zip(jax.tree_util.tree_leaves(variables), jax.tree_util.tree_leaves(expected))
+        ):
+            raise ValueError(
+                f"LPIPS weights at {path!r} do not match net_type={net_type!r}"
+                " (wrong backbone or corrupted file)."
+            )
+    elif allow_random_weights:
+        rank_zero_warn(
+            "LPIPS is using seeded RANDOM weights (allow_random_weights=True, no weights file)."
+            " Distances are self-consistent but NOT comparable to published LPIPS numbers."
+        )
+        variables = init_params(net_type, seed=seed)
+    else:
+        raise FileNotFoundError(
+            "No LPIPS weights available: pass `weights_path=`, set $METRICS_TPU_LPIPS_WEIGHTS,"
+            " or opt into random initialisation with `allow_random_weights=True`"
+            " (tests/relative comparisons only)."
+        )
+
+    def distance(img0: Array, img1: Array) -> Array:
+        return model.apply(variables, jnp.asarray(img0), jnp.asarray(img1))
+
+    return distance

@@ -61,7 +61,7 @@ class PeakSignalNoiseRatio(Metric):
             self.add_state("min_target", jnp.zeros(()), dist_reduce_fx="min")
             self.add_state("max_target", jnp.zeros(()), dist_reduce_fx="max")
         else:
-            self.add_state("data_range", jnp.asarray(float(data_range)), dist_reduce_fx="mean")
+            self.add_state("data_range", jnp.asarray(float(data_range), jnp.float32), dist_reduce_fx="mean")
         self.base = base
         self.reduction = reduction
         self.dim = tuple(dim) if isinstance(dim, (list, tuple)) else dim

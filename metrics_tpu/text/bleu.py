@@ -43,8 +43,8 @@ class BLEUScore(Metric):
             raise ValueError(f"List of weights has different weights than `n_gram`: {len(weights)} != {n_gram}")
         self.weights = weights if weights is not None else [1.0 / n_gram] * n_gram
 
-        self.add_state("preds_len", jnp.asarray(0.0), dist_reduce_fx="sum")
-        self.add_state("target_len", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("preds_len", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
+        self.add_state("target_len", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
         self.add_state("numerator", jnp.zeros(self.n_gram), dist_reduce_fx="sum")
         self.add_state("denominator", jnp.zeros(self.n_gram), dist_reduce_fx="sum")
 

@@ -28,8 +28,8 @@ class MatchErrorRate(Metric):
 
     def __init__(self, **kwargs: Any) -> None:
         super().__init__(**kwargs)
-        self.add_state("errors", jnp.asarray(0.0), dist_reduce_fx="sum")
-        self.add_state("total", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("errors", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
 
     def update(self, preds: Union[str, List[str]], target: Union[str, List[str]]) -> None:
         errors, total = _mer_update(preds, target)

@@ -47,8 +47,8 @@ class TranslationEditRate(Metric):
         self.tokenizer = _TercomTokenizer(normalize, no_punctuation, lowercase, asian_support)
         self.return_sentence_level_score = return_sentence_level_score
 
-        self.add_state("total_num_edits", jnp.asarray(0.0), dist_reduce_fx="sum")
-        self.add_state("total_tgt_len", jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total_num_edits", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total_tgt_len", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
         if self.return_sentence_level_score:
             self.add_state("sentence_ter", [], dist_reduce_fx="cat")
 

@@ -427,9 +427,11 @@ def test_lpips_gated():
     from metrics_tpu.image import LearnedPerceptualImagePatchSimilarity
     from metrics_tpu.utils.imports import _LPIPS_AVAILABLE
 
+    # the torch-backed backend stays gated on the lpips package; the default
+    # backend='jax' needs no torch (covered in test_lpips_net.py)
     if not _LPIPS_AVAILABLE:
         with pytest.raises(ModuleNotFoundError):
-            LearnedPerceptualImagePatchSimilarity()
+            LearnedPerceptualImagePatchSimilarity(backend="lpips")
 
     # user-supplied distance function path
     dist = lambda a, b: jnp.mean(jnp.abs(a - b), axis=(1, 2, 3))  # noqa: E731

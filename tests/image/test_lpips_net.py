@@ -1,0 +1,123 @@
+"""Tests for the JAX LPIPS network (metrics_tpu/image/lpips_net.py).
+
+Reference behaviour target: src/torchmetrics/image/lpip.py (lpips-package backed).
+With random weights the absolute values are not comparable to published LPIPS, so
+these tests pin the *metric properties*: identity distance 0, symmetry-of-scale,
+monotone growth with perturbation, weight round-trip, end-to-end module behaviour,
+and jit-ability.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from metrics_tpu.image import LearnedPerceptualImagePatchSimilarity
+from metrics_tpu.image.lpips_net import (
+    NET_CHANNELS,
+    init_params,
+    load_params,
+    make_distance_fn,
+    save_params,
+)
+
+IMG = 64
+_rng = np.random.RandomState(11)
+
+
+@pytest.fixture(autouse=True)
+def _hermetic_env(monkeypatch):
+    """An ambient weights env var must not leak into these tests."""
+    monkeypatch.delenv("METRICS_TPU_LPIPS_WEIGHTS", raising=False)
+IMG_A = jnp.asarray(_rng.rand(2, 3, IMG, IMG).astype(np.float32) * 2 - 1)
+NOISE = jnp.asarray(_rng.rand(2, 3, IMG, IMG).astype(np.float32) * 2 - 1)
+
+
+@pytest.mark.parametrize("net_type", ["alex", "vgg", "squeeze"])
+def test_identity_zero_and_monotone(net_type):
+    dist = make_distance_fn(net_type, allow_random_weights=True)
+    d0 = np.asarray(dist(IMG_A, IMG_A))
+    assert d0.shape == (2,)
+    np.testing.assert_allclose(d0, 0.0, atol=1e-6)
+
+    d_small = np.asarray(dist(IMG_A, IMG_A + 0.05 * NOISE))
+    d_large = np.asarray(dist(IMG_A, IMG_A + 0.4 * NOISE))
+    assert (d_small > 0).all()
+    assert (d_large > d_small).all()
+
+
+def test_weights_roundtrip(tmp_path):
+    params = init_params("alex", seed=3)
+    path = str(tmp_path / "lpips_alex.npz")
+    save_params(params, path)
+    loaded = load_params(path)
+
+    flat_a = jax.tree_util.tree_leaves(params)
+    flat_b = jax.tree_util.tree_leaves(loaded)
+    assert len(flat_a) == len(flat_b)
+    for a, b in zip(flat_a, flat_b):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+    d_init = make_distance_fn("alex", seed=3, allow_random_weights=True)(IMG_A, NOISE)
+    d_loaded = make_distance_fn("alex", weights_path=path)(IMG_A, NOISE)
+    np.testing.assert_allclose(np.asarray(d_init), np.asarray(d_loaded), rtol=1e-6)
+
+
+def test_jit_and_grad():
+    dist = make_distance_fn("alex", allow_random_weights=True)
+    jitted = jax.jit(dist)
+    np.testing.assert_allclose(np.asarray(jitted(IMG_A, NOISE)), np.asarray(dist(IMG_A, NOISE)), rtol=1e-5)
+
+    g = jax.grad(lambda x: jnp.sum(dist(x, NOISE)))(IMG_A)
+    assert bool(jnp.all(jnp.isfinite(g)))
+    assert float(jnp.max(jnp.abs(g))) > 0
+
+
+def test_module_end_to_end():
+    metric = LearnedPerceptualImagePatchSimilarity(net_type="alex", allow_random_weights=True)
+    metric.update(IMG_A, IMG_A)
+    assert float(metric.compute()) == pytest.approx(0.0, abs=1e-6)
+
+    metric2 = LearnedPerceptualImagePatchSimilarity(net_type="alex", normalize=True, allow_random_weights=True)
+    a01 = (IMG_A + 1) / 2
+    n01 = (NOISE + 1) / 2
+    metric2.update(a01, n01)
+    first = float(metric2.compute())
+    assert first > 0
+    # streaming mean over two batches == mean over the union
+    metric2.update(a01, n01)
+    assert float(metric2.compute()) == pytest.approx(first, rel=1e-5)
+
+
+def test_tap_channel_widths():
+    """Backbone taps must match the published LPIPS channel layout."""
+    from metrics_tpu.image.lpips_net import _BACKBONES
+
+    x = jnp.zeros((1, IMG, IMG, 3), jnp.float32)
+    for net_type, expected in NET_CHANNELS.items():
+        model = _BACKBONES[net_type]()
+        variables = model.init(jax.random.PRNGKey(0), x)
+        taps = model.apply(variables, x)
+        assert tuple(t.shape[-1] for t in taps) == expected, net_type
+
+
+def test_validation_errors():
+    with pytest.raises(ValueError):
+        LearnedPerceptualImagePatchSimilarity(net_type="resnet")
+    with pytest.raises(FileNotFoundError):
+        # no weights and no explicit opt-in must never silently produce numbers
+        LearnedPerceptualImagePatchSimilarity()
+    with pytest.raises(ValueError):
+        LearnedPerceptualImagePatchSimilarity(backend="torch")
+    with pytest.raises(ValueError):
+        LearnedPerceptualImagePatchSimilarity(reduction="median")
+
+
+def test_wrong_net_type_weights_rejected(tmp_path):
+    params = init_params("alex", seed=0)
+    path = str(tmp_path / "alex.npz")
+    save_params(params, path)
+    with pytest.raises(ValueError, match="net_type"):
+        make_distance_fn("vgg", weights_path=path)

@@ -1,0 +1,79 @@
+"""Layout tests for tools/convert_lpips_weights.py against synthetic torch-style
+state dicts — pins the torch→flax mapping so it cannot drift from the module
+structure without a test failure (the real pretrained download needs network)."""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2] / "tools"))
+
+from convert_lpips_weights import build_params  # noqa: E402
+from metrics_tpu.image.lpips_net import NET_CHANNELS, LPIPSNet, init_params  # noqa: E402
+
+_rng = np.random.RandomState(0)
+
+
+def _fake_alex_sd():
+    cfg = [(0, 64, 3, 11), (3, 192, 64, 5), (6, 384, 192, 3), (8, 256, 384, 3), (10, 256, 256, 3)]
+    sd = {}
+    for idx, out, inp, k in cfg:
+        sd[f"features.{idx}.weight"] = _rng.randn(out, inp, k, k).astype(np.float32) * 0.05
+        sd[f"features.{idx}.bias"] = _rng.randn(out).astype(np.float32) * 0.05
+    return sd
+
+
+def _fake_lpips_sd(net_type):
+    return {f"lin{i}.model.1.weight": np.abs(_rng.randn(1, c, 1, 1).astype(np.float32))
+            for i, c in enumerate(NET_CHANNELS[net_type])}
+
+
+def test_alex_conversion_matches_module_structure():
+    variables = build_params(_fake_alex_sd(), _fake_lpips_sd("alex"), "alex")
+
+    # structure must exactly match what the flax module initialises
+    expected = init_params("alex", image_size=32)
+    conv_paths = jax.tree_util.tree_structure(expected)
+    assert jax.tree_util.tree_structure(variables) == conv_paths
+    for a, b in zip(jax.tree_util.tree_leaves(expected), jax.tree_util.tree_leaves(variables)):
+        assert np.asarray(a).shape == np.asarray(b).shape
+
+    # and the converted params must actually run
+    model = LPIPSNet(net_type="alex")
+    img = jnp.asarray(_rng.rand(1, 3, 32, 32).astype(np.float32) * 2 - 1)
+    d = model.apply(jax.tree.map(jnp.asarray, variables), img, img)
+    np.testing.assert_allclose(np.asarray(d), 0.0, atol=1e-6)
+
+
+def test_conversion_direction_is_correct():
+    """The kernel transpose must map torch conv semantics onto flax conv semantics."""
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as F
+
+    w = _rng.randn(4, 3, 3, 3).astype(np.float32) * 0.1
+    b = _rng.randn(4).astype(np.float32) * 0.1
+    x = _rng.rand(1, 3, 8, 8).astype(np.float32)
+
+    torch_out = F.conv2d(torch.from_numpy(x), torch.from_numpy(w), torch.from_numpy(b), padding=1).numpy()
+
+    import flax.linen as nn
+
+    conv = nn.Conv(4, (3, 3), padding=((1, 1), (1, 1)))
+    variables = {"params": {"kernel": jnp.asarray(np.transpose(w, (2, 3, 1, 0))), "bias": jnp.asarray(b)}}
+    flax_out = conv.apply(variables, jnp.asarray(np.transpose(x, (0, 2, 3, 1))))
+    np.testing.assert_allclose(np.transpose(np.asarray(flax_out), (0, 3, 1, 2)), torch_out, atol=1e-5)
+
+
+def test_lin_shape_validation():
+    from convert_lpips_weights import convert_lins
+
+    bad = _fake_lpips_sd("alex")
+    bad["lin0.model.1.weight"] = np.zeros((1, 32, 1, 1), np.float32)
+    with pytest.raises(ValueError, match="lin0"):
+        convert_lins(bad, "alex")

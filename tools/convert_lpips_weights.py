@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Convert published LPIPS weights (torch) to the metrics_tpu ``.npz`` format.
+
+The JAX LPIPS net (:mod:`metrics_tpu.image.lpips_net`) loads weights from a flat
+``.npz``; this tool produces that file from the torch ecosystem checkpoints the
+reference uses:
+
+- backbone: ``torchvision.models.{alexnet,vgg16,squeezenet1_1}`` pretrained
+  state dicts,
+- linear heads: the ``lpips`` package's ``lin{i}.model.1.weight`` tensors.
+
+Run where torch+torchvision+lpips are installed (one-time, offline thereafter)::
+
+    python tools/convert_lpips_weights.py --net alex --out lpips_alex.npz
+    export METRICS_TPU_LPIPS_WEIGHTS=lpips_alex.npz
+
+The mapping functions are importable and unit-tested against synthetic state
+dicts (tests/image/test_weight_conversion.py), so the layout cannot silently
+drift from the flax module structure.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Dict, Mapping
+
+import numpy as np
+
+from metrics_tpu.image.lpips_net import NET_CHANNELS
+
+# torchvision `features` indices of the conv layers feeding each flax module name
+_ALEX_CONVS = {"conv1": 0, "conv2": 3, "conv3": 6, "conv4": 8, "conv5": 10}
+_VGG_CONVS = {
+    "conv1_1": 0, "conv1_2": 2,
+    "conv2_1": 5, "conv2_2": 7,
+    "conv3_1": 10, "conv3_2": 12, "conv3_3": 14,
+    "conv4_1": 17, "conv4_2": 19, "conv4_3": 21,
+    "conv5_1": 24, "conv5_2": 26, "conv5_3": 28,
+}
+# squeezenet1_1 features indices of the fire modules
+_SQUEEZE_FIRES = {"fire2": 3, "fire3": 4, "fire4": 6, "fire5": 7,
+                  "fire6": 9, "fire7": 10, "fire8": 11, "fire9": 12}
+
+
+def _conv(weight: np.ndarray, bias: np.ndarray) -> Dict[str, np.ndarray]:
+    """torch (O, I, kH, kW) conv → flax {kernel: (kH, kW, I, O), bias: (O,)}."""
+    return {"kernel": np.transpose(np.asarray(weight), (2, 3, 1, 0)),
+            "bias": np.asarray(bias)}
+
+
+def convert_backbone(state_dict: Mapping[str, np.ndarray], net_type: str) -> Dict:
+    """torchvision features state dict → flax params for the matching backbone."""
+    sd = {k: np.asarray(v) for k, v in state_dict.items()}
+    out: Dict = {}
+    if net_type == "alex":
+        for name, idx in _ALEX_CONVS.items():
+            out[name] = _conv(sd[f"features.{idx}.weight"], sd[f"features.{idx}.bias"])
+    elif net_type == "vgg":
+        for name, idx in _VGG_CONVS.items():
+            out[name] = _conv(sd[f"features.{idx}.weight"], sd[f"features.{idx}.bias"])
+    elif net_type == "squeeze":
+        out["conv1"] = _conv(sd["features.0.weight"], sd["features.0.bias"])
+        for name, idx in _SQUEEZE_FIRES.items():
+            out[name] = {
+                "squeeze": _conv(sd[f"features.{idx}.squeeze.weight"], sd[f"features.{idx}.squeeze.bias"]),
+                "expand1x1": _conv(sd[f"features.{idx}.expand1x1.weight"], sd[f"features.{idx}.expand1x1.bias"]),
+                "expand3x3": _conv(sd[f"features.{idx}.expand3x3.weight"], sd[f"features.{idx}.expand3x3.bias"]),
+            }
+    else:
+        raise ValueError(f"unknown net_type {net_type}")
+    return out
+
+
+def convert_lins(lpips_state: Mapping[str, np.ndarray], net_type: str) -> Dict:
+    """lpips ``lin{i}.model.1.weight`` (1, C, 1, 1) tensors → flax {lin{i}: (C, 1)}."""
+    out: Dict = {}
+    for i, width in enumerate(NET_CHANNELS[net_type]):
+        w = np.asarray(lpips_state[f"lin{i}.model.1.weight"])
+        if w.shape != (1, width, 1, 1):
+            raise ValueError(f"lin{i}: expected (1, {width}, 1, 1), got {w.shape}")
+        out[f"lin{i}"] = w.reshape(width, 1)
+    return out
+
+
+def build_params(backbone_sd: Mapping, lpips_sd: Mapping, net_type: str) -> Dict:
+    """Full flax variables dict {'params': {'features': ..., 'lin0': ...}}."""
+    params = {"features": convert_backbone(backbone_sd, net_type)}
+    params.update(convert_lins(lpips_sd, net_type))
+    return {"params": params}
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--net", choices=list(NET_CHANNELS), default="alex")
+    parser.add_argument("--out", required=True)
+    args = parser.parse_args()
+
+    import torch
+    import torchvision.models as tvm
+
+    backbone = {"alex": tvm.alexnet, "vgg": tvm.vgg16, "squeeze": tvm.squeezenet1_1}[args.net]
+    backbone_sd = {k: v.numpy() for k, v in backbone(weights="DEFAULT").state_dict().items()}
+
+    import lpips as lpips_pkg
+
+    net = lpips_pkg.LPIPS(net={"alex": "alex", "vgg": "vgg", "squeeze": "squeeze"}[args.net])
+    lpips_sd = {k: v.numpy() for k, v in net.state_dict().items()
+                if ".model.1.weight" in k}
+    # lpips prefixes lins with "lins.{i}." in newer versions; normalise to lin{i}.
+    lpips_sd = {k.replace("lins.", "lin"): v for k, v in lpips_sd.items()}
+
+    from metrics_tpu.image.lpips_net import save_params
+
+    save_params(build_params(backbone_sd, lpips_sd, args.net), args.out)
+    print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()
