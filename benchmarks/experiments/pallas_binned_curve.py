@@ -1,0 +1,121 @@
+"""Streaming binned-curve counts: ``tp[t] = Σ_i w_i·y_i·[p_i ≥ thr_t]`` (and fp).
+
+The workhorse of every binned curve metric (PrecisionRecallCurve / ROC / AUROC /
+AveragePrecision with ``thresholds=int``, reference
+``functional/classification/precision_recall_curve.py:184-201``). The natural XLA
+formulation — a ``(T, N)`` comparison matrix contracted against the targets —
+materialises T·N intermediate values in HBM: at N=1M, T=200 that is ~3.5 ms/update
+on a v5e chip, pure HBM traffic.
+
+The Pallas kernel streams the sample axis through VMEM in ``(BLOCK_ROWS, 128)``
+tiles and keeps a ``(T, 128)`` accumulator on-chip, so HBM traffic is one read of
+``preds``/``target``/``weights`` regardless of T. The TPU grid is sequential, which
+makes the accumulate-across-grid-steps pattern race-free (pallas_guide: grids are
+executed in order on TPU).
+
+Status: EXPERIMENT, not wired into the metric path. Measured on a v5e chip the
+kernel matches — but does not beat — XLA's fused comparison-matmul (both sit at
+the T·N-compare roofline; see benchmarks/README.md "Kernel experiments" for the
+numbers). Kept as a worked Pallas example with its measurement harness.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+_WIDE = 1024  # samples per kernel row (8 lanes-groups of 128)
+_ROWS = 8  # rows per grid step -> 8192 samples/step
+# the (T, WIDE) f32 compare block must stay ≪ the ~16 MB VMEM budget
+MAX_PALLAS_THRESHOLDS = 1024
+
+
+def _kernel(thr_ref, p_ref, t_ref, w_ref, tp_ref, fp_ref):
+    import jax.experimental.pallas as pl
+
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        tp_ref[:] = jnp.zeros_like(tp_ref)
+        fp_ref[:] = jnp.zeros_like(fp_ref)
+
+    thr = thr_ref[:]  # (T, 1)
+
+    def body(k, carry):
+        tp_acc, fp_acc = carry
+        sl = pl.ds(k, 1)
+        p = p_ref[sl, :]  # (1, WIDE) — samples on the lane axis, no reshape needed
+        t = t_ref[sl, :]
+        w = w_ref[sl, :]
+        # (T, WIDE) compare on the VPU, then MXU matvecs for the weighted reductions
+        pred_pos = (p >= thr).astype(jnp.float32)  # (T,1)>= (1,WIDE) -> (T, WIDE)
+        tp_acc = tp_acc + jax.lax.dot_general(
+            pred_pos, t, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # (T, 1)
+        fp_acc = fp_acc + jax.lax.dot_general(
+            pred_pos, w - t, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return tp_acc, fp_acc
+
+    zero = jnp.zeros(tp_ref.shape, jnp.float32)
+    tp, fp = jax.lax.fori_loop(0, _ROWS, body, (zero, zero))
+    tp_ref[:] += tp
+    fp_ref[:] += fp
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _pallas_counts(preds: Array, target_w: Array, w: Array, thresholds: Array, interpret: bool = False):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = preds.shape[0]
+    len_t = thresholds.shape[0]
+    tile = _ROWS * _WIDE
+    n_pad = -(-n // tile) * tile
+    pad = n_pad - n
+    # zero-weight padding contributes nothing to either count
+    preds = jnp.pad(preds.astype(jnp.float32), (0, pad), constant_values=-jnp.inf).reshape(-1, _WIDE)
+    target_w = jnp.pad(target_w.astype(jnp.float32), (0, pad)).reshape(-1, _WIDE)
+    w = jnp.pad(w.astype(jnp.float32), (0, pad)).reshape(-1, _WIDE)
+    thr = thresholds.astype(jnp.float32).reshape(len_t, 1)
+
+    grid = n_pad // tile
+    block = pl.BlockSpec((_ROWS, _WIDE), lambda i: (i, 0))
+    acc = pl.BlockSpec((len_t, 1), lambda i: (0, 0))
+    tp, fp = pl.pallas_call(
+        _kernel,
+        grid=(grid,),
+        in_specs=[pl.BlockSpec((len_t, 1), lambda i: (0, 0)), block, block, block],
+        out_specs=[acc, acc],
+        out_shape=[
+            jax.ShapeDtypeStruct((len_t, 1), jnp.float32),
+            jax.ShapeDtypeStruct((len_t, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(thr, preds, target_w, w)
+    return tp[:, 0], fp[:, 0]
+
+
+def _reference_counts(preds: Array, target_w: Array, w: Array, thresholds: Array):
+    """The jnp comparison-matmul formulation (always correct, any backend)."""
+    preds_t = (preds[None, :] >= thresholds[:, None]).astype(jnp.float32) * w[None, :]
+    tp = preds_t @ target_w
+    fp = preds_t @ (w - target_w)
+    return tp, fp
+
+
+def binned_curve_counts(preds: Array, target_w: Array, w: Array, thresholds: Array):
+    """``(tp, fp)`` of shape ``(T,)``: weighted counts of predictions ≥ each threshold.
+
+    ``target_w`` is the weighted positive indicator (``target * w``); ``w`` the sample
+    weights (1 where valid, 0 where masked). Uses the Pallas streaming kernel on TPU,
+    the jnp reference elsewhere.
+    """
+    on_tpu = preds.ndim == 1 and jax.default_backend() == "tpu"
+    if on_tpu and thresholds.shape[0] <= MAX_PALLAS_THRESHOLDS:
+        return _pallas_counts(preds, target_w, w, thresholds)
+    return _reference_counts(preds, target_w, w, thresholds)

@@ -39,6 +39,20 @@ from metrics_tpu.utils.enums import ClassificationTask
 
 
 class BinaryPrecisionRecallCurve(Metric):
+    """Precision-recall pairs at decision thresholds (exact or binned).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import BinaryPrecisionRecallCurve
+        >>> probs = jnp.array([0.11, 0.22, 0.84, 0.73, 0.33, 0.92])
+        >>> target = jnp.array([0, 1, 0, 1, 0, 1])
+        >>> metric = BinaryPrecisionRecallCurve(thresholds=None)
+        >>> metric.update(probs, target)
+        >>> precision, recall, thresholds = metric.compute()
+        >>> thresholds
+        Array([0.22, 0.33, 0.73, 0.84, 0.92], dtype=float32)
+    """
+
     is_differentiable = False
     higher_is_better = None
     full_state_update = False

@@ -31,6 +31,19 @@ from metrics_tpu.utils.enums import ClassificationTask
 
 
 class BinaryRecallAtFixedPrecision(BinaryPrecisionRecallCurve):
+    """Best recall with precision >= the constraint, plus the threshold.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import BinaryRecallAtFixedPrecision
+        >>> probs = jnp.array([0.11, 0.22, 0.84, 0.73, 0.33, 0.92])
+        >>> target = jnp.array([0, 1, 0, 1, 0, 1])
+        >>> metric = BinaryRecallAtFixedPrecision(min_precision=0.5)
+        >>> metric.update(probs, target)
+        >>> [round(float(v), 4) for v in metric.compute()]
+        [1.0, 0.22]
+    """
+
     is_differentiable = False
     higher_is_better = True
     full_state_update = False

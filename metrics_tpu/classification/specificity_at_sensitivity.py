@@ -31,6 +31,19 @@ from metrics_tpu.utils.enums import ClassificationTask
 
 
 class BinarySpecificityAtSensitivity(BinaryPrecisionRecallCurve):
+    """Best specificity with sensitivity >= the constraint, plus the threshold.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import BinarySpecificityAtSensitivity
+        >>> probs = jnp.array([0.11, 0.22, 0.84, 0.73, 0.33, 0.92])
+        >>> target = jnp.array([0, 1, 0, 1, 0, 1])
+        >>> metric = BinarySpecificityAtSensitivity(min_sensitivity=0.5)
+        >>> metric.update(probs, target)
+        >>> [round(float(v), 4) for v in metric.compute()]
+        [0.6667, 0.73]
+    """
+
     is_differentiable = False
     higher_is_better = True
     full_state_update = False

@@ -188,24 +188,7 @@ class InceptionV3(nn.Module):
         return out
 
 
-def save_params(params: Dict, path: str) -> None:
-    """Write a flax param/batch-stats pytree as a flat npz (keys = '/'-joined paths)."""
-    flat = jax.tree_util.tree_flatten_with_path(params)[0]
-    arrays = {jax.tree_util.keystr(kp, simple=True, separator="/"): np.asarray(v) for kp, v in flat}
-    np.savez(path, **arrays)
-
-
-def load_params(path: str) -> Dict:
-    """Inverse of :func:`save_params`."""
-    loaded = np.load(path)
-    tree: Dict = {}
-    for key in loaded.files:
-        node = tree
-        parts = key.split("/")
-        for part in parts[:-1]:
-            node = node.setdefault(part, {})
-        node[parts[-1]] = jnp.asarray(loaded[key])
-    return tree
+from metrics_tpu.utils.params_io import load_params, save_params  # noqa: E402,F401  (shared npz protocol)
 
 
 def init_params(seed: int = 0) -> Dict:

@@ -184,29 +184,7 @@ class LPIPSNet(nn.Module):
 # ------------------------------------------------------------------ params io
 
 
-def _flatten(d: Dict, prefix: str = ""):
-    for k, v in d.items():
-        key = f"{prefix}/{k}" if prefix else str(k)
-        if isinstance(v, dict):
-            yield from _flatten(v, key)
-        else:
-            yield key, np.asarray(v)
-
-
-def save_params(params: Dict, path: str) -> None:
-    np.savez(path, **dict(_flatten(params)))
-
-
-def load_params(path: str) -> Dict:
-    data = np.load(path)
-    tree: Dict[str, Any] = {}
-    for key in data.files:
-        node = tree
-        parts = key.split("/")
-        for p in parts[:-1]:
-            node = node.setdefault(p, {})
-        node[parts[-1]] = jnp.asarray(data[key])
-    return tree
+from metrics_tpu.utils.params_io import load_params, save_params  # noqa: E402,F401  (shared npz protocol)
 
 
 def init_params(net_type: str = "alex", seed: int = 0, image_size: int = 64) -> Dict:
@@ -236,10 +214,12 @@ def make_distance_fn(
     if path:
         variables = load_params(path)
         # fail fast with a clear message when the file is for a different net_type —
-        # otherwise flax raises an opaque kernel-shape error deep in apply()
-        expected = init_params(net_type, seed=seed, image_size=16)
+        # otherwise flax raises an opaque kernel-shape error deep in apply().
+        # eval_shape gives the expected tree/shapes without running any init FLOPs.
+        dummy = jnp.zeros((1, 3, 16, 16), jnp.float32)
+        expected = jax.eval_shape(model.init, jax.random.PRNGKey(0), dummy, dummy)
         if jax.tree_util.tree_structure(variables) != jax.tree_util.tree_structure(expected) or any(
-            np.asarray(a).shape != np.asarray(b).shape
+            np.asarray(a).shape != b.shape
             for a, b in zip(jax.tree_util.tree_leaves(variables), jax.tree_util.tree_leaves(expected))
         ):
             raise ValueError(

@@ -75,7 +75,19 @@ class RetrievalMRR(RetrievalMetric):
 
 
 class RetrievalPrecision(RetrievalMetric):
-    """Precision@k; ``adaptive_k`` clamps k to each query's size."""
+    """Precision@k; ``adaptive_k`` clamps k to each query's size.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalPrecision
+        >>> indexes = jnp.array([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.array([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.array([False, False, True, False, True, False, True])
+        >>> metric = RetrievalPrecision(k=2)
+        >>> metric.update(preds, target, indexes=indexes)
+        >>> metric.compute()
+        Array(0.5, dtype=float32)
+    """
 
     def __init__(
         self,
@@ -104,7 +116,19 @@ class RetrievalPrecision(RetrievalMetric):
 
 
 class RetrievalRecall(RetrievalMetric):
-    """Recall@k."""
+    """Recall@k.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalRecall
+        >>> indexes = jnp.array([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.array([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.array([False, False, True, False, True, False, True])
+        >>> metric = RetrievalRecall(k=2)
+        >>> metric.update(preds, target, indexes=indexes)
+        >>> metric.compute()
+        Array(0.75, dtype=float32)
+    """
 
     def __init__(
         self,
@@ -124,7 +148,19 @@ class RetrievalRecall(RetrievalMetric):
 
 
 class RetrievalFallOut(RetrievalMetric):
-    """Fall-out@k: retrieved-negative fraction of all negatives; lower is better."""
+    """Fall-out@k: retrieved-negative fraction of all negatives; lower is better.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalFallOut
+        >>> indexes = jnp.array([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.array([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.array([False, False, True, False, True, False, True])
+        >>> metric = RetrievalFallOut(k=2)
+        >>> metric.update(preds, target, indexes=indexes)
+        >>> metric.compute()
+        Array(0.5, dtype=float32)
+    """
 
     higher_is_better = False
     _empty_on = "negatives"
@@ -148,7 +184,19 @@ class RetrievalFallOut(RetrievalMetric):
 
 
 class RetrievalHitRate(RetrievalMetric):
-    """Hit rate@k: 1 if any relevant document in the top-k."""
+    """Hit rate@k: 1 if any relevant document in the top-k.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalHitRate
+        >>> indexes = jnp.array([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.array([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.array([False, False, True, False, True, False, True])
+        >>> metric = RetrievalHitRate(k=2)
+        >>> metric.update(preds, target, indexes=indexes)
+        >>> metric.compute()
+        Array(1., dtype=float32)
+    """
 
     def __init__(
         self,
@@ -168,7 +216,19 @@ class RetrievalHitRate(RetrievalMetric):
 
 
 class RetrievalRPrecision(RetrievalMetric):
-    """Precision at k = (# relevant documents of the query)."""
+    """Precision at k = (# relevant documents of the query).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalRPrecision
+        >>> indexes = jnp.array([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.array([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.array([False, False, True, False, True, False, True])
+        >>> metric = RetrievalRPrecision()
+        >>> metric.update(preds, target, indexes=indexes)
+        >>> metric.compute()
+        Array(0.75, dtype=float32)
+    """
 
     def _query_values(self, g: GroupedRanks) -> Array:
         in_top_r = (g.rank.astype(jnp.float32) < g.pos_per[g.seg]).astype(jnp.float32)
@@ -177,7 +237,19 @@ class RetrievalRPrecision(RetrievalMetric):
 
 
 class RetrievalNormalizedDCG(RetrievalMetric):
-    """nDCG@k with raw-gain DCG over possibly non-binary targets."""
+    """nDCG@k with raw-gain DCG over possibly non-binary targets.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalNormalizedDCG
+        >>> indexes = jnp.array([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.array([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.array([False, False, True, False, True, False, True])
+        >>> metric = RetrievalNormalizedDCG()
+        >>> metric.update(preds, target, indexes=indexes)
+        >>> round(float(metric.compute()), 4)
+        0.8467
+    """
 
     allow_non_binary_target = True
 

@@ -1,0 +1,35 @@
+"""Offline weight IO: flax variable pytrees ↔ flat ``.npz`` files.
+
+One shared protocol for every bundled network (InceptionV3 for FID/KID/IS,
+the LPIPS backbones): keys are ``/``-joined pytree paths, values are the raw
+arrays. Keeping a single implementation prevents the two ends of the format
+from drifting apart.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def save_params(params: Dict, path: str) -> None:
+    """Write a flax param/batch-stats pytree as a flat npz (keys = '/'-joined paths)."""
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    arrays = {jax.tree_util.keystr(kp, simple=True, separator="/"): np.asarray(v) for kp, v in flat}
+    np.savez(path, **arrays)
+
+
+def load_params(path: str) -> Dict:
+    """Inverse of :func:`save_params`."""
+    loaded = np.load(path)
+    tree: Dict = {}
+    for key in loaded.files:
+        node = tree
+        parts = key.split("/")
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = jnp.asarray(loaded[key])
+    return tree

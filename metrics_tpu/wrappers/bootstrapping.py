@@ -45,6 +45,17 @@ def _bootstrap_sampler(
 
 
 class BootStrapper(Metric):
+    """Bootstrap confidence intervals via one vmapped update over resampled copies.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import BootStrapper, MeanSquaredError
+        >>> metric = BootStrapper(MeanSquaredError(), num_bootstraps=20, seed=123)
+        >>> metric.update(jnp.array([2.5, 0.0, 2.0, 8.0]), jnp.array([3.0, -0.5, 2.0, 7.0]))
+        >>> {k: round(float(v), 4) for k, v in metric.compute().items()}
+        {'mean': 0.4051, 'std': 0.2428}
+    """
+
     full_state_update: Optional[bool] = True
 
     def __init__(

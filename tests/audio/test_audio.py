@@ -270,3 +270,37 @@ def test_differentiability(module_cls, functional):
     target = rng.randn(2, BATCH, TIME).astype(np.float32)
     preds = (target + 0.3 * rng.randn(2, BATCH, TIME)).astype(np.float32)
     MetricTester().run_differentiability_test(preds, target, module_cls, functional)
+
+
+def test_pesq_stoi_gating():
+    """PESQ/STOI require their host packages; the gate must raise a clear error
+    when absent and construct cleanly when present (reference audio/pesq.py:60,
+    audio/stoi.py:57)."""
+    from metrics_tpu.audio import PerceptualEvaluationSpeechQuality, ShortTimeObjectiveIntelligibility
+    from metrics_tpu.utils.imports import _PESQ_AVAILABLE, _PYSTOI_AVAILABLE
+
+    if _PESQ_AVAILABLE:
+        PerceptualEvaluationSpeechQuality(fs=16000, mode="wb")
+    else:
+        with pytest.raises(ModuleNotFoundError, match="pesq"):
+            PerceptualEvaluationSpeechQuality(fs=16000, mode="wb")
+
+    if _PYSTOI_AVAILABLE:
+        ShortTimeObjectiveIntelligibility(fs=16000)
+    else:
+        with pytest.raises(ModuleNotFoundError, match="pystoi"):
+            ShortTimeObjectiveIntelligibility(fs=16000)
+
+
+def test_pesq_gate_precedes_arg_validation():
+    """The dependency gate fires before fs/mode validation, mirroring the
+    reference's ordering (audio/pesq.py checks the import first)."""
+    from metrics_tpu.audio import PerceptualEvaluationSpeechQuality
+    from metrics_tpu.utils.imports import _PESQ_AVAILABLE
+
+    if not _PESQ_AVAILABLE:
+        with pytest.raises(ModuleNotFoundError):
+            PerceptualEvaluationSpeechQuality(fs=1234, mode="zz")
+    else:
+        with pytest.raises(ValueError):
+            PerceptualEvaluationSpeechQuality(fs=1234, mode="wb")
