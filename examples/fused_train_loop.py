@@ -1,0 +1,95 @@
+"""Flagship usage: metrics fused into a sharded training step.
+
+The reference accumulates metrics outside the training step (a host-side
+`metric(preds, target)` call per batch). Here the pure functional API puts the
+metric update INSIDE the jitted, sharded step, so XLA fuses metric accumulation
+with the model computation and syncs state with in-trace collectives — the
+design BASELINE.md's <1 % overhead target is measured against (see bench.py).
+
+Runs on whatever devices are available (8 virtual CPU devices if none):
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 python examples/fused_train_loop.py
+"""
+
+from __future__ import annotations
+
+import os
+
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from metrics_tpu.classification import MulticlassAccuracy, MulticlassF1Score
+
+NUM_CLASSES, HIDDEN, BATCH, STEPS = 8, 64, 256, 20
+
+
+def main() -> None:
+    devices = np.array(jax.devices())
+    mesh = Mesh(devices, ("dp",))
+    print(f"mesh: {len(devices)} x {devices[0].platform} over axis 'dp'")
+
+    metrics = {
+        "acc": MulticlassAccuracy(NUM_CLASSES, average="micro", validate_args=False),
+        "f1": MulticlassF1Score(NUM_CLASSES, validate_args=False),
+    }
+
+    def sharded_step(params, metric_states, x, y):
+        """One SPMD shard: grad step + metric delta, psum-synced and merged.
+
+        The carried metric state is replicated (P() in/out); each step builds a
+        shard-local DELTA state from its batch, syncs it with in-trace psum, and
+        merges it into the carried total — so the outputs really are replicated
+        and accumulation across steps stays exact.
+        """
+        def loss_fn(p):
+            logits = jnp.tanh(x @ p["w1"]) @ p["w2"]
+            return -jnp.mean(jnp.take_along_axis(jax.nn.log_softmax(logits), y[:, None], 1)), logits
+
+        (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        grads = jax.tree.map(lambda g: jax.lax.pmean(g, "dp"), grads)
+        params = jax.tree.map(lambda p, g: p - 0.1 * g, params, grads)
+
+        preds = jnp.argmax(logits, -1)
+        new_states, values = {}, {}
+        for name, m in metrics.items():
+            delta = m.update_state(m.init_state(), preds, y)  # this shard's batch only
+            synced = m.sync_state(delta, "dp")                 # in-trace psum
+            new_states[name] = m.merge_states(metric_states[name], synced)
+            values[name] = m.compute_from(new_states[name])    # already synced
+        return params, new_states, jax.lax.pmean(loss, "dp"), values
+
+    step = jax.jit(
+        jax.shard_map(
+            sharded_step,
+            mesh=mesh,
+            in_specs=(P(), P(), P("dp"), P("dp")),
+            out_specs=(P(), P(), P(), P()),
+        )
+    )
+
+    key = jax.random.PRNGKey(0)
+    k1, k2 = jax.random.split(key)
+    params = {
+        "w1": jax.random.normal(k1, (HIDDEN, HIDDEN)) * 0.1,
+        "w2": jax.random.normal(k2, (HIDDEN, NUM_CLASSES)) * 0.1,
+    }
+    states = {name: m.init_state() for name, m in metrics.items()}
+
+    rng = np.random.default_rng(0)
+    w_true = rng.normal(size=(HIDDEN, NUM_CLASSES))
+    for i in range(STEPS):
+        x = jnp.asarray(rng.normal(size=(BATCH, HIDDEN)).astype(np.float32))
+        y = jnp.asarray(np.argmax(rng.normal(size=(BATCH, NUM_CLASSES)) * 0.1 + x @ w_true, -1))
+        params, states, loss, values = step(params, states, x, y)
+        if i % 5 == 0 or i == STEPS - 1:
+            print(f"step {i:3d}  loss {float(loss):.4f}  "
+                  + "  ".join(f"{k} {float(v):.4f}" for k, v in values.items()))
+
+
+if __name__ == "__main__":
+    main()

@@ -146,6 +146,9 @@ class MeanAveragePrecision(Metric):
             raise ValueError(f"Expected argument `box_format` to be one of {allowed_box_formats} but got {box_format}")
         self.box_format = box_format
         self.iou_thresholds = iou_thresholds or np.linspace(0.5, 0.95, round((0.95 - 0.5) / 0.05) + 1).tolist()
+        # invariant across every _evaluate_image cell — hoisted out of the matcher
+        self._thr_vec = np.asarray(self.iou_thresholds)
+        self._iou_range = np.arange(len(self.iou_thresholds))
         self.rec_thresholds = rec_thresholds or np.linspace(0.0, 1.00, round(1.00 / 0.01) + 1).tolist()
         self.max_detection_thresholds = sorted(max_detection_thresholds or [1, 10, 100])
         if iou_type not in allowed_iou_types:
@@ -280,16 +283,19 @@ class MeanAveragePrecision(Metric):
         det_ignore = np.zeros((nb_iou_thrs, nb_det), dtype=bool)
 
         if ious_sorted.size:
-            for idx_iou, thr in enumerate(self.iou_thresholds):
-                for idx_det in range(nb_det):
-                    # best still-unmatched, non-ignored gt (mean_ap.py:663-689)
-                    masked = ious_sorted[idx_det] * ~(gt_matches[idx_iou] | gt_ignore)
-                    m = int(np.argmax(masked))
-                    if masked[m] <= thr:
-                        continue
-                    det_ignore[idx_iou, idx_det] = gt_ignore[m]
-                    det_matches[idx_iou, idx_det] = True
-                    gt_matches[idx_iou, m] = True
+            # the greedy matcher is sequential over detections (score order) by
+            # definition, but independent across IoU thresholds — vectorise the
+            # threshold axis so each det does ONE (T, G) argmax instead of T
+            # scalar-loop argmaxes (mean_ap.py:663-689 semantics preserved)
+            thr_vec, iou_range = self._thr_vec, self._iou_range
+            for idx_det in range(nb_det):
+                # best still-unmatched, non-ignored gt, per threshold
+                masked = ious_sorted[idx_det][None, :] * ~(gt_matches | gt_ignore[None, :])
+                m = np.argmax(masked, axis=1)  # (T,)
+                matched = masked[iou_range, m] > thr_vec
+                det_ignore[:, idx_det] = matched & gt_ignore[m]
+                det_matches[:, idx_det] = matched
+                gt_matches[matched, m[matched]] = True
 
         # unmatched detections outside the area range are ignored
         det_areas = self._areas(det)

@@ -1,0 +1,48 @@
+"""Smoke-run the examples/ scripts so they cannot silently rot.
+
+(The reference ships examples but never executes them in CI; running them is
+cheap insurance since they are the first code users copy.)
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+_REPO = Path(__file__).resolve().parent.parent
+_EXAMPLES = _REPO / "examples"
+
+
+def _run(name: str, timeout: int = 240) -> str:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = str(_REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    result = subprocess.run(
+        [sys.executable, str(_EXAMPLES / name)],
+        env=env, capture_output=True, text=True, timeout=timeout,
+    )
+    assert result.returncode == 0, f"{name} failed:\n{result.stdout}\n{result.stderr}"
+    return result.stdout
+
+
+def test_fused_train_loop():
+    out = _run("fused_train_loop.py")
+    assert "step  19" in out and "acc" in out
+
+
+def test_detection_map():
+    out = _run("detection_map.py")
+    assert "map" in out
+
+
+def test_rouge_own_normalizer():
+    _run("rouge_score-own_normalizer_and_tokenizer.py")
+
+
+def test_plotting():
+    pytest.importorskip("matplotlib")
+    _run("plotting.py")
