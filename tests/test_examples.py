@@ -17,13 +17,13 @@ _REPO = Path(__file__).resolve().parent.parent
 _EXAMPLES = _REPO / "examples"
 
 
-def _run(name: str, timeout: int = 240) -> str:
+def _run(name: str, *args: str, timeout: int = 240, cwd: str | None = None) -> str:
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = str(_REPO) + os.pathsep + env.get("PYTHONPATH", "")
     result = subprocess.run(
-        [sys.executable, str(_EXAMPLES / name)],
-        env=env, capture_output=True, text=True, timeout=timeout,
+        [sys.executable, str(_EXAMPLES / name), *args],
+        env=env, capture_output=True, text=True, timeout=timeout, cwd=cwd,
     )
     assert result.returncode == 0, f"{name} failed:\n{result.stdout}\n{result.stderr}"
     return result.stdout
@@ -43,6 +43,8 @@ def test_rouge_own_normalizer():
     _run("rouge_score-own_normalizer_and_tokenizer.py")
 
 
-def test_plotting():
+def test_plotting(tmp_path):
     pytest.importorskip("matplotlib")
-    _run("plotting.py")
+    # artifacts go to the tmp dir, never the repo root
+    _run("plotting.py", str(tmp_path), cwd=str(tmp_path))
+    assert (tmp_path / "confusion_matrix.png").exists()
