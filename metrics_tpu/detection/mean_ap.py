@@ -465,8 +465,19 @@ class MeanAveragePrecision(Metric):
                 map_per_class.append(cls_summary["map"])
                 mar_per_class.append(cls_summary[f"mar_{last}"])
 
-        metrics = {k: jnp.asarray(v, jnp.float32) for k, v in summary.items()}
-        metrics["map_per_class"] = jnp.asarray(map_per_class, jnp.float32)
-        metrics[f"mar_{self.max_detection_thresholds[-1]}_per_class"] = jnp.asarray(mar_per_class, jnp.float32)
-        metrics["classes"] = jnp.asarray(classes, jnp.int32)
+        # one packed H2D transfer for all float results (then device-side slices)
+        # instead of one transfer per key — each tiny transfer costs a full
+        # host-device round-trip, which dominates on remote/tunneled accelerators
+        keys = list(summary.keys())
+        packed = np.concatenate([
+            np.asarray([summary[k] for k in keys], dtype=np.float32),
+            np.asarray(map_per_class, dtype=np.float32),
+            np.asarray(mar_per_class, dtype=np.float32),
+        ])
+        dev = jnp.asarray(packed)
+        metrics: Dict[str, Array] = {k: dev[i] for i, k in enumerate(keys)}
+        n, m = len(keys), len(map_per_class)
+        metrics["map_per_class"] = dev[n : n + m]
+        metrics[f"mar_{self.max_detection_thresholds[-1]}_per_class"] = dev[n + m :]
+        metrics["classes"] = jnp.asarray(np.asarray(classes, dtype=np.int32))
         return metrics

@@ -90,6 +90,19 @@ class StructuralSimilarityIndexMeasure(Metric):
 
 
 class MultiScaleStructuralSimilarityIndexMeasure(Metric):
+    """Multi-scale SSIM over a pyramid of 2x-downsampled scales.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> import numpy as np
+        >>> from metrics_tpu import MultiScaleStructuralSimilarityIndexMeasure
+        >>> img = jnp.asarray(np.random.RandomState(0).rand(2, 3, 48, 48).astype(np.float32))
+        >>> metric = MultiScaleStructuralSimilarityIndexMeasure(data_range=1.0, betas=(0.2, 0.3, 0.5))
+        >>> metric.update(img, img)
+        >>> metric.compute()
+        Array(1., dtype=float32)
+    """
+
     is_differentiable = True
     higher_is_better = True
     full_state_update = False

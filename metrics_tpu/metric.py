@@ -841,6 +841,18 @@ class CompositionalMetric(Metric):
     Reference: metric.py:878-978. ``update``/``compute``/``reset``/``persistent``
     recurse into child metrics; its own ``_sync_dist`` is a no-op (children sync
     themselves inside their own ``compute``).
+
+    Built by the 30+ arithmetic overloads on :class:`Metric`:
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanMetric
+        >>> m1, m2 = MeanMetric(), MeanMetric()
+        >>> combo = m1 + 2 * m2
+        >>> m1.update(jnp.array(1.0))
+        >>> m2.update(jnp.array(3.0))
+        >>> combo.compute()
+        Array(7., dtype=float32)
     """
 
     def __init__(self, operator: Callable, metric_a: Union[Metric, float, Array, None], metric_b: Union[Metric, float, Array, None]) -> None:

@@ -322,3 +322,19 @@ def test_multi_output_compute_squeeze():
     m.update(jnp.asarray(1.0))
     out = m.compute()
     assert isinstance(out, list) and len(out) == 2
+
+
+def test_check_forward_full_state_property(capsys):
+    """The perf_counter-based forward-strategy advisor runs and prints a
+    recommendation (reference utilities/checks.py:626-714)."""
+    from metrics_tpu.utils.checks import check_forward_full_state_property
+    from tests.helpers.testers import DummyMetricSum
+
+    check_forward_full_state_property(
+        DummyMetricSum,
+        input_args={"x": jnp.ones(())},
+        num_update_to_compare=[2, 4],
+        reps=2,
+    )
+    out = capsys.readouterr().out
+    assert "full_state_update" in out
