@@ -1,5 +1,7 @@
 """Metric arithmetic tests — port of tests/unittests/bases/test_composition.py (548 LoC)."""
 
+import operator
+
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -136,3 +138,100 @@ def test_metrics_matmul():
     final = first_metric @ jnp.asarray([1.0, 1.0, 1.0])
     final.update()
     assert float(final.compute()) == 6.0
+
+
+# ---- exhaustive operator sweep (reference test_composition.py covers each op
+# against scalar, tensor, and metric operands; mirrored here parametrically) ----
+
+
+@pytest.mark.parametrize(
+    "op, a_val, b_val, expected",
+    [
+        (operator.add, 5, 2, 7),
+        (operator.sub, 5, 2, 3),
+        (operator.mul, 5, 2, 10),
+        (operator.truediv, 5, 2, 2.5),
+        (operator.floordiv, 5, 2, 2),
+        (operator.mod, 5, 2, 1),
+        (operator.pow, 5, 2, 25),
+        (operator.lt, 5, 2, False),
+        (operator.le, 5, 5, True),
+        (operator.gt, 5, 2, True),
+        (operator.ge, 2, 5, False),
+        (operator.eq, 5, 5, True),
+        (operator.ne, 5, 2, True),
+    ],
+    ids=lambda x: getattr(x, "__name__", str(x)),
+)
+@pytest.mark.parametrize("b_kind", ["scalar", "array", "metric"])
+def test_operator_sweep_metric_vs_operand(op, a_val, b_val, expected, b_kind):
+    a = DummyMetric(a_val)
+    b = {"scalar": b_val, "array": jnp.asarray(b_val), "metric": DummyMetric(b_val)}[b_kind]
+    composed = op(a, b)
+    assert isinstance(composed, CompositionalMetric)
+    composed.update()
+    np.testing.assert_allclose(np.asarray(composed.compute()), np.asarray(expected))
+
+
+@pytest.mark.parametrize(
+    "op, a_val, b_val, expected",
+    [
+        (operator.and_, 6, 3, 2),
+        (operator.or_, 6, 3, 7),
+        (operator.xor, 6, 3, 5),
+    ],
+    ids=lambda x: getattr(x, "__name__", str(x)),
+)
+def test_bitwise_operator_sweep(op, a_val, b_val, expected):
+    a = DummyMetric(a_val)
+    for b in (b_val, DummyMetric(b_val)):
+        composed = op(a, b)
+        composed.update()
+        np.testing.assert_allclose(np.asarray(composed.compute()), expected)
+
+
+def test_reflected_operators_with_scalar_left():
+    m = DummyMetric(2)
+    cases = [
+        (5 + m, 7), (5 - m, 3), (5 * m, 10), (5 / m, 2.5),
+        (5 // m, 2), (5 % m, 1), (5 ** m, 25),
+    ]
+    for composed, expected in cases:
+        assert isinstance(composed, CompositionalMetric)
+        composed.update()
+        np.testing.assert_allclose(np.asarray(composed.compute()), expected)
+
+
+def test_pos_and_invert():
+    # reference parity: __pos__ maps to abs (reference metric.py maps + to torch.abs)
+    assert float((+DummyMetric(-3)).compute()) == 3.0
+    inv = ~DummyMetric(6)
+    np.testing.assert_allclose(np.asarray(inv.compute()), ~np.int32(6))
+
+
+def test_composition_persistent_recurses():
+    a, b = DummyMetric(1), DummyMetric(2)
+    composed = a + b
+    composed.persistent(True)
+    assert all(a._persistent.values()) and all(b._persistent.values())
+    composed.persistent(False)
+    assert not any(a._persistent.values()) and not any(b._persistent.values())
+
+
+def test_composition_repr_and_pickle():
+    import pickle
+
+    composed = DummyMetric(2) + 1
+    assert "CompositionalMetric" in repr(composed)
+    clone = pickle.loads(pickle.dumps(composed))
+    clone.update()
+    np.testing.assert_allclose(np.asarray(clone.compute()), 3)
+
+
+def test_nested_composition_depth_3():
+    a, b, c = DummyMetric(2), DummyMetric(3), DummyMetric(4)
+    composed = (a + b) * c - 10  # (2+3)*4 - 10 = 10
+    composed.update()
+    np.testing.assert_allclose(np.asarray(composed.compute()), 10)
+    composed.reset()
+    assert int(a._num_updates) == 0 and int(c._num_updates) == 0

@@ -337,4 +337,5 @@ def test_check_forward_full_state_property(capsys):
         reps=2,
     )
     out = capsys.readouterr().out
-    assert "full_state_update" in out
+    # the recommendation line is timing-dependent; the summary line is not
+    assert "Output equal: True" in out
