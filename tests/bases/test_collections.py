@@ -217,3 +217,78 @@ def test_collection_functional_sharded():
         recall_score(all_t, all_labels, average="macro", labels=list(range(NUM_CLASSES)), zero_division=0),
         atol=1e-6,
     )
+
+
+def test_collection_add_metrics_after_init():
+    """add_metrics extends a live collection (reference test_collections.py)."""
+    coll = MetricCollection([MulticlassAccuracy(NUM_CLASSES, validate_args=False)])
+    coll.add_metrics({"f1": MulticlassF1Score(NUM_CLASSES, validate_args=False)})
+    preds = jnp.asarray(np.random.RandomState(0).randint(0, NUM_CLASSES, 32))
+    target = jnp.asarray(np.random.RandomState(1).randint(0, NUM_CLASSES, 32))
+    coll.update(preds, target)
+    out = coll.compute()
+    assert set(out) == {"MulticlassAccuracy", "f1"}
+
+
+def test_collection_clone_with_prefix():
+    """clone(prefix=...) deep-copies and renames (reference collections.py)."""
+    coll = MetricCollection([MulticlassAccuracy(NUM_CLASSES, validate_args=False)])
+    cloned = coll.clone(prefix="val_")
+    preds = jnp.asarray(np.random.RandomState(0).randint(0, NUM_CLASSES, 32))
+    target = jnp.asarray(np.random.RandomState(1).randint(0, NUM_CLASSES, 32))
+    cloned.update(preds, target)
+    assert set(cloned.compute()) == {"val_MulticlassAccuracy"}
+    # original untouched by clone's updates
+    assert coll["MulticlassAccuracy"]._update_count == 0
+
+
+def test_collection_state_dict_roundtrip():
+    """Collection state_dict/load_state_dict round-trips persistent states."""
+    rng = np.random.RandomState(3)
+    preds = jnp.asarray(rng.randint(0, NUM_CLASSES, 64))
+    target = jnp.asarray(rng.randint(0, NUM_CLASSES, 64))
+
+    coll = MetricCollection([
+        MulticlassAccuracy(NUM_CLASSES, validate_args=False),
+        MulticlassF1Score(NUM_CLASSES, validate_args=False),
+    ])
+    for m in coll.values():
+        m.persistent(True)
+    coll.update(preds, target)
+    states = {name: m.state_dict() for name, m in coll.items()}
+
+    fresh = MetricCollection([
+        MulticlassAccuracy(NUM_CLASSES, validate_args=False),
+        MulticlassF1Score(NUM_CLASSES, validate_args=False),
+    ])
+    for name, m in fresh.items():
+        m.persistent(True)
+        m.load_state_dict(states[name])
+    expected = coll.compute()
+    got = fresh.compute()
+    for key in expected:
+        np.testing.assert_allclose(np.asarray(got[key]), np.asarray(expected[key]))
+
+
+def test_compute_group_members_stay_correct_after_items():
+    """Copy-on-read: iterating items() must not corrupt subsequent updates."""
+    rng = np.random.RandomState(5)
+    coll = MetricCollection([
+        MulticlassAccuracy(NUM_CLASSES, average="macro", validate_args=False),
+        MulticlassRecall(NUM_CLASSES, average="macro", validate_args=False),
+    ])
+    ref_acc = MulticlassAccuracy(NUM_CLASSES, average="macro", validate_args=False)
+    for _ in range(3):
+        preds = jnp.asarray(rng.randint(0, NUM_CLASSES, 32))
+        target = jnp.asarray(rng.randint(0, NUM_CLASSES, 32))
+        coll.update(preds, target)
+        ref_acc.update(preds, target)
+        dict(coll.items())  # break aliasing mid-stream
+    np.testing.assert_allclose(
+        np.asarray(coll.compute()["MulticlassAccuracy"]), np.asarray(ref_acc.compute()), atol=1e-7
+    )
+
+
+def test_collection_repr_contains_members():
+    coll = MetricCollection([MulticlassAccuracy(NUM_CLASSES, validate_args=False)])
+    assert "MulticlassAccuracy" in repr(coll)
