@@ -7,8 +7,10 @@ step's XLA graph via the pure functional API. The reference's qualitative target
 <1% overhead; `vs_baseline` is value/1.0 (ratio to that 1% budget — smaller is better).
 
 Methodology (recorded per BASELINE.md): f32 params, compile excluded (warmup step),
-median-free mean of `STEPS` timed steps chained through the donated carry with one
-trailing host readback. Prints ONE JSON line and exits 0 even when degraded.
+mean of `STEPS` timed steps chained through the donated carry with one trailing host
+readback; best of 3 interleaved repetitions per mode (host jitter only inflates
+samples, so the minimum is the faithful step time). Prints ONE JSON line and exits 0
+even when degraded.
 
 Robustness (round-2 hardening): TPU backend init on this image can hang indefinitely
 when the tunnel is down — round 1's bench died there with a bare stack trace and no
@@ -26,10 +28,11 @@ import sys
 import time
 
 # Probe/retry schedule for the accelerator backend: (attempts, per-attempt timeout s,
-# backoff s between attempts).
-PROBE_ATTEMPTS = 2
-PROBE_TIMEOUT_S = 90
-PROBE_BACKOFF_S = (10,)
+# backoff s between attempts). The tunnel drops out for minutes at a time, so ride
+# out short outages before degrading to the host platform.
+PROBE_ATTEMPTS = 3
+PROBE_TIMEOUT_S = 120
+PROBE_BACKOFF_S = (20, 60)
 
 _PROBE_SNIPPET = (
     "import jax; d = jax.devices(); "
@@ -137,8 +140,17 @@ def run_benchmark(degraded_reason: str | None) -> dict:
     fresh_params = lambda: jax.tree_util.tree_map(jnp.copy, params)  # noqa: E731
     fresh_states = lambda: {n: metrics[n].init_state() for n in metrics}  # noqa: E731
 
-    t_bare, _ = run(bare, (fresh_params(),), steps)
-    t_fused, carry = run(fused, (fresh_params(), fresh_states()), steps)
+    # Interleave bare/fused repetitions and keep the per-mode minimum: host
+    # jitter (tunnel dispatch, a concurrent process stealing cores) only ever
+    # inflates a wall-clock sample, and interleaving keeps slow environmental
+    # drift from landing entirely on one mode.
+    reps = 3
+    bare_times, fused_times = [], []
+    for _ in range(reps):
+        bare_times.append(run(bare, (fresh_params(),), steps)[0])
+        t, carry = run(fused, (fresh_params(), fresh_states()), steps)
+        fused_times.append(t)
+    t_bare, t_fused = min(bare_times), min(fused_times)
 
     # validate the accumulated metric state computes
     acc = float(metrics["accuracy"].compute_from(carry[1]["accuracy"]))

@@ -68,13 +68,23 @@ def pairwise_cosine_similarity(
 def pairwise_euclidean_distance(
     x: Array, y: Optional[Array] = None, reduction: Optional[str] = None, zero_diagonal: Optional[bool] = None
 ) -> Array:
-    """Euclidean distance matrix via the one-matmul expansion (reference pairwise/euclidean.py)."""
+    """Euclidean distance matrix via the one-matmul expansion (reference pairwise/euclidean.py).
+
+    With a single input the diagonal is a self-distance — exactly 0
+    mathematically — and is pinned to 0 regardless of ``zero_diagonal``
+    (sklearn semantics), because the one-matmul expansion loses that exactness
+    to f32 cancellation at large magnitudes. Pass ``y=x`` explicitly to see the
+    raw expansion including its diagonal noise.
+    """
+    self_mode = y is None
     x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
     x_norm = jnp.sum(x * x, axis=1, keepdims=True)
     y_norm = jnp.sum(y * y, axis=1)
     distance = x_norm + y_norm[None, :] - 2.0 * _safe_matmul(x, y.T)
     distance = jnp.sqrt(jnp.maximum(distance, 0.0))
-    distance = _zero_diag(distance, zero_diagonal)
+    # Self-distances are exactly 0 mathematically, but the one-matmul expansion
+    # loses that to f32 cancellation at large magnitudes — pin the diagonal.
+    distance = _zero_diag(distance, zero_diagonal or self_mode)
     return _reduce_distance_matrix(distance, reduction)
 
 

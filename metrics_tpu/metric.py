@@ -439,10 +439,16 @@ class Metric(ABC):
         return type(self).compute.__get__(self)
 
     def init_state(self) -> Dict[str, Any]:
-        """Default state as a pytree (fixed states as arrays; ``_update_count`` included)."""
+        """Default state as a pytree (fixed states as arrays; ``_update_count`` included).
+
+        Array leaves are fresh copies, never views of the stored defaults: the
+        intended use is donating the state into a jitted step
+        (``jax.jit(step, donate_argnums=...)``), and a donated buffer must not be
+        the module's own default or a previously returned state.
+        """
         state: Dict[str, Any] = {}
         for name, default in self._defaults.items():
-            state[name] = [] if isinstance(default, list) else jnp.asarray(default)
+            state[name] = [] if isinstance(default, list) else jnp.array(default, copy=True)
         state["_update_count"] = jnp.zeros((), dtype=jnp.int32)
         return state
 

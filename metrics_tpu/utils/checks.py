@@ -214,7 +214,9 @@ def _check_retrieval_inputs(
         indexes, preds, target = indexes[valid], preds[valid], target[valid]
     if not allow_non_binary_target and _value_check_possible(target) and bool(jnp.any((jnp.asarray(target) > 1) | (jnp.asarray(target) < 0))):
         raise ValueError("`target` must contain `binary` values")
-    return indexes.reshape(-1).astype(jnp.int64), preds.reshape(-1).astype(jnp.float32), target.reshape(-1)
+    # int32 query ids: jax defaults to 32-bit ints (x64 disabled) and an int64
+    # request would just warn and truncate to int32 anyway.
+    return indexes.reshape(-1).astype(jnp.int32), preds.reshape(-1).astype(jnp.float32), target.reshape(-1)
 
 
 def _allclose_recursive(res1: Any, res2: Any, atol: float = 1e-8) -> bool:

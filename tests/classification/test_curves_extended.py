@@ -1,0 +1,227 @@
+"""Multiclass / multilabel curve-metric coverage vs sklearn.
+
+Extends test_curves.py to the per-class/per-label curve families the reference
+tests in tests/unittests/classification/{test_roc, test_precision_recall_curve,
+test_specificity_sensitivity, test_recall_fixed_precision}.py: exact and binned
+regimes, module accumulation, and the derived at-operating-point metrics.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import (
+    average_precision_score as sk_ap,
+    precision_recall_curve as sk_prc,
+    roc_curve as sk_roc,
+)
+
+from metrics_tpu.classification import (
+    MulticlassPrecisionRecallCurve,
+    MulticlassROC,
+    MultilabelAveragePrecision,
+    MultilabelPrecisionRecallCurve,
+    MultilabelROC,
+    MultilabelRecallAtFixedPrecision,
+)
+from metrics_tpu.functional.classification import (
+    binary_specificity_at_sensitivity,
+    multiclass_precision_recall_curve,
+    multiclass_roc,
+    multiclass_specificity_at_sensitivity,
+    multilabel_average_precision,
+    multilabel_precision_recall_curve,
+    multilabel_recall_at_fixed_precision,
+    multilabel_roc,
+    multilabel_specificity_at_sensitivity,
+)
+from tests.classification.inputs import _binary_probs, _multiclass_probs, _multilabel_probs
+from tests.helpers.testers import NUM_CLASSES
+
+_MC_PREDS = np.concatenate(list(_multiclass_probs.preds[:4]))  # (N, C)
+_MC_TARGET = np.concatenate(list(_multiclass_probs.target[:4]))
+_ML_PREDS = np.concatenate(list(_multilabel_probs.preds[:4]))  # (N, L)
+_ML_TARGET = np.concatenate(list(_multilabel_probs.target[:4]))
+
+
+def _assert_prc_matches_sklearn(prec, rec, sk_t, sk_p):
+    """Common-prefix comparison: sklearn keeps points past full recall, the
+    curve here trims them and appends the (1, 0) endpoint (see test_curves.py)."""
+    skp, skr, _ = sk_prc(sk_t, sk_p)
+    n = len(prec) - 1
+    offset = len(skp) - 1 - n
+    np.testing.assert_allclose(np.asarray(prec)[:-1], skp[offset:-1], atol=1e-6)
+    np.testing.assert_allclose(np.asarray(rec)[:-1], skr[offset:-1], atol=1e-6)
+
+
+class TestMulticlassCurvesExact:
+    def test_roc_per_class_vs_sklearn(self):
+        fprs, tprs, _ = multiclass_roc(jnp.asarray(_MC_PREDS), jnp.asarray(_MC_TARGET), NUM_CLASSES)
+        for i in range(NUM_CLASSES):
+            sk_fpr, sk_tpr, _ = sk_roc(_MC_TARGET == i, _MC_PREDS[:, i], drop_intermediate=False)
+            np.testing.assert_allclose(np.asarray(fprs[i]), sk_fpr, atol=1e-6)
+            np.testing.assert_allclose(np.asarray(tprs[i]), sk_tpr, atol=1e-6)
+
+    def test_prc_per_class_vs_sklearn(self):
+        precs, recs, _ = multiclass_precision_recall_curve(
+            jnp.asarray(_MC_PREDS), jnp.asarray(_MC_TARGET), NUM_CLASSES
+        )
+        for i in range(NUM_CLASSES):
+            _assert_prc_matches_sklearn(precs[i], recs[i], _MC_TARGET == i, _MC_PREDS[:, i])
+
+    def test_module_accumulation_matches_functional(self):
+        m = MulticlassROC(num_classes=NUM_CLASSES)
+        for i in range(4):
+            m.update(jnp.asarray(_multiclass_probs.preds[i]), jnp.asarray(_multiclass_probs.target[i]))
+        fprs, tprs, _ = m.compute()
+        ref_fprs, ref_tprs, _ = multiclass_roc(jnp.asarray(_MC_PREDS), jnp.asarray(_MC_TARGET), NUM_CLASSES)
+        for i in range(NUM_CLASSES):
+            np.testing.assert_allclose(np.asarray(fprs[i]), np.asarray(ref_fprs[i]), atol=1e-6)
+            np.testing.assert_allclose(np.asarray(tprs[i]), np.asarray(ref_tprs[i]), atol=1e-6)
+
+        mp = MulticlassPrecisionRecallCurve(num_classes=NUM_CLASSES)
+        for i in range(4):
+            mp.update(jnp.asarray(_multiclass_probs.preds[i]), jnp.asarray(_multiclass_probs.target[i]))
+        precs, recs, _ = mp.compute()
+        for i in range(NUM_CLASSES):
+            _assert_prc_matches_sklearn(precs[i], recs[i], _MC_TARGET == i, _MC_PREDS[:, i])
+
+
+class TestMulticlassCurvesBinned:
+    def test_binned_roc_close_to_exact(self):
+        """Binned (T, C) ROC interpolates the exact curve: every binned point's
+        TPR at its threshold must equal the exact curve evaluated there."""
+        fprs, tprs, thr = multiclass_roc(
+            jnp.asarray(_MC_PREDS), jnp.asarray(_MC_TARGET), NUM_CLASSES, thresholds=200
+        )
+        assert np.asarray(fprs).shape == (NUM_CLASSES, 200)
+        for i in range(NUM_CLASSES):
+            t = _MC_TARGET == i
+            p = _MC_PREDS[:, i]
+            for j in [0, 50, 100, 199]:
+                th = float(np.asarray(thr)[j])
+                exact_tpr = ((p >= th) & t).sum() / max(t.sum(), 1)
+                exact_fpr = ((p >= th) & ~t).sum() / max((~t).sum(), 1)
+                np.testing.assert_allclose(float(np.asarray(tprs)[i, j]), exact_tpr, atol=1e-6)
+                np.testing.assert_allclose(float(np.asarray(fprs)[i, j]), exact_fpr, atol=1e-6)
+
+    def test_binned_prc_shapes_and_endpoint(self):
+        precs, recs, thr = multiclass_precision_recall_curve(
+            jnp.asarray(_MC_PREDS), jnp.asarray(_MC_TARGET), NUM_CLASSES, thresholds=100
+        )
+        assert np.asarray(precs).shape == (NUM_CLASSES, 101)
+        assert np.asarray(recs).shape == (NUM_CLASSES, 101)
+        np.testing.assert_allclose(np.asarray(precs)[:, -1], 1.0)
+        np.testing.assert_allclose(np.asarray(recs)[:, -1], 0.0)
+
+
+class TestMultilabelCurves:
+    def test_roc_per_label_vs_sklearn(self):
+        fprs, tprs, _ = multilabel_roc(jnp.asarray(_ML_PREDS), jnp.asarray(_ML_TARGET), NUM_CLASSES)
+        for i in range(NUM_CLASSES):
+            sk_fpr, sk_tpr, _ = sk_roc(_ML_TARGET[:, i], _ML_PREDS[:, i], drop_intermediate=False)
+            np.testing.assert_allclose(np.asarray(fprs[i]), sk_fpr, atol=1e-6)
+            np.testing.assert_allclose(np.asarray(tprs[i]), sk_tpr, atol=1e-6)
+
+    def test_prc_per_label_vs_sklearn(self):
+        precs, recs, _ = multilabel_precision_recall_curve(
+            jnp.asarray(_ML_PREDS), jnp.asarray(_ML_TARGET), NUM_CLASSES
+        )
+        for i in range(NUM_CLASSES):
+            _assert_prc_matches_sklearn(precs[i], recs[i], _ML_TARGET[:, i], _ML_PREDS[:, i])
+
+    def test_module_binned_accumulation(self):
+        m = MultilabelPrecisionRecallCurve(num_labels=NUM_CLASSES, thresholds=100)
+        mr = MultilabelROC(num_labels=NUM_CLASSES, thresholds=100)
+        for i in range(4):
+            m.update(jnp.asarray(_multilabel_probs.preds[i]), jnp.asarray(_multilabel_probs.target[i]))
+            mr.update(jnp.asarray(_multilabel_probs.preds[i]), jnp.asarray(_multilabel_probs.target[i]))
+        precs, recs, _ = m.compute()
+        ref = multilabel_precision_recall_curve(
+            jnp.asarray(_ML_PREDS), jnp.asarray(_ML_TARGET), NUM_CLASSES, thresholds=100
+        )
+        np.testing.assert_allclose(np.asarray(precs), np.asarray(ref[0]), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(recs), np.asarray(ref[1]), atol=1e-6)
+        fprs, tprs, _ = mr.compute()
+        ref_roc = multilabel_roc(jnp.asarray(_ML_PREDS), jnp.asarray(_ML_TARGET), NUM_CLASSES, thresholds=100)
+        np.testing.assert_allclose(np.asarray(fprs), np.asarray(ref_roc[0]), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(tprs), np.asarray(ref_roc[1]), atol=1e-6)
+
+
+@pytest.mark.parametrize("average", ["micro", "macro", "weighted", "none"])
+def test_multilabel_average_precision_vs_sklearn(average):
+    got = multilabel_average_precision(
+        jnp.asarray(_ML_PREDS), jnp.asarray(_ML_TARGET), NUM_CLASSES, average=average
+    )
+    sk_avg = None if average == "none" else average
+    expected = sk_ap(_ML_TARGET, _ML_PREDS, average=sk_avg)
+    np.testing.assert_allclose(np.asarray(got), expected, atol=1e-5)
+
+    m = MultilabelAveragePrecision(num_labels=NUM_CLASSES, average=average)
+    for i in range(4):
+        m.update(jnp.asarray(_multilabel_probs.preds[i]), jnp.asarray(_multilabel_probs.target[i]))
+    np.testing.assert_allclose(np.asarray(m.compute()), expected, atol=1e-5)
+
+
+# ------------------------------------------------------- at-operating-point metrics
+def _np_spec_at_sens(preds, target, min_sensitivity):
+    fpr, tpr, thr = sk_roc(target, preds, drop_intermediate=False)
+    spec = 1 - fpr
+    qual = tpr >= min_sensitivity
+    if not qual.any():
+        return 0.0
+    return float(spec[qual].max())
+
+
+@pytest.mark.parametrize("min_sensitivity", [0.3, 0.6, 0.9])
+def test_binary_specificity_at_sensitivity_vs_sklearn(min_sensitivity):
+    p = np.concatenate(list(_binary_probs.preds[:4]))
+    t = np.concatenate(list(_binary_probs.target[:4]))
+    spec, thr = binary_specificity_at_sensitivity(jnp.asarray(p), jnp.asarray(t), min_sensitivity=min_sensitivity)
+    np.testing.assert_allclose(float(spec), _np_spec_at_sens(p, t, min_sensitivity), atol=1e-6)
+    # the returned threshold actually achieves the (sens, spec) pair
+    sens_at = ((p >= float(thr)) & (t == 1)).sum() / (t == 1).sum()
+    assert sens_at >= min_sensitivity - 1e-6
+
+
+@pytest.mark.parametrize("min_sensitivity", [0.5])
+def test_multiclass_and_multilabel_specificity_at_sensitivity(min_sensitivity):
+    specs, _ = multiclass_specificity_at_sensitivity(
+        jnp.asarray(_MC_PREDS), jnp.asarray(_MC_TARGET), NUM_CLASSES, min_sensitivity=min_sensitivity
+    )
+    for i in range(NUM_CLASSES):
+        np.testing.assert_allclose(
+            float(specs[i]), _np_spec_at_sens(_MC_PREDS[:, i], (_MC_TARGET == i).astype(int), min_sensitivity),
+            atol=1e-6,
+        )
+    specs_ml, _ = multilabel_specificity_at_sensitivity(
+        jnp.asarray(_ML_PREDS), jnp.asarray(_ML_TARGET), NUM_CLASSES, min_sensitivity=min_sensitivity
+    )
+    for i in range(NUM_CLASSES):
+        np.testing.assert_allclose(
+            float(specs_ml[i]), _np_spec_at_sens(_ML_PREDS[:, i], _ML_TARGET[:, i], min_sensitivity), atol=1e-6
+        )
+
+
+def _np_recall_at_precision(preds, target, min_precision):
+    prec, rec, _ = sk_prc(target, preds)
+    qual = prec >= min_precision
+    return float(rec[qual].max()) if qual.any() else 0.0
+
+
+@pytest.mark.parametrize("min_precision", [0.4, 0.7])
+def test_multilabel_recall_at_fixed_precision_vs_sklearn(min_precision):
+    recs, _ = multilabel_recall_at_fixed_precision(
+        jnp.asarray(_ML_PREDS), jnp.asarray(_ML_TARGET), NUM_CLASSES, min_precision=min_precision
+    )
+    for i in range(NUM_CLASSES):
+        np.testing.assert_allclose(
+            float(recs[i]), _np_recall_at_precision(_ML_PREDS[:, i], _ML_TARGET[:, i], min_precision), atol=1e-6
+        )
+
+    m = MultilabelRecallAtFixedPrecision(num_labels=NUM_CLASSES, min_precision=min_precision)
+    for i in range(4):
+        m.update(jnp.asarray(_multilabel_probs.preds[i]), jnp.asarray(_multilabel_probs.target[i]))
+    m_recs, _ = m.compute()
+    np.testing.assert_allclose(np.asarray(m_recs), np.asarray(recs), atol=1e-6)

@@ -1,0 +1,185 @@
+"""Extended nominal coverage: bias correction vs an independent numpy
+implementation, Theil's U vs a direct entropy computation, probability-matrix
+inputs, *_matrix pairwise association, and exact nan-strategy semantics.
+
+Mirrors the breadth of tests/unittests/nominal/test_{cramers,theils_u,...}.py,
+which validate against dython/pandas; here the independent oracle is written
+out explicitly (Bergsma-2013 corrected coefficients over a scipy crosstab).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.stats.contingency import crosstab
+
+from metrics_tpu.functional.nominal import (
+    cramers_v,
+    cramers_v_matrix,
+    pearsons_contingency_coefficient,
+    pearsons_contingency_coefficient_matrix,
+    theils_u,
+    theils_u_matrix,
+    tschuprows_t,
+    tschuprows_t_matrix,
+)
+from metrics_tpu.nominal import CramersV, TheilsU
+
+NUM_CLASSES = 5
+
+
+def _data(seed=0, n=300, classes=NUM_CLASSES):
+    rng = np.random.default_rng(seed)
+    preds = rng.integers(0, classes, n)
+    target = (preds + rng.integers(0, 3, n)) % classes
+    return preds, target
+
+
+def _chi2_phi2(ct):
+    ct = ct.astype(np.float64)
+    n = ct.sum()
+    expected = np.outer(ct.sum(1), ct.sum(0)) / n
+    chi2 = np.where(expected > 0, (ct - expected) ** 2 / np.where(expected > 0, expected, 1), 0).sum()
+    return chi2, chi2 / n, n
+
+
+def _np_corrected(preds, target, kind):
+    """Bergsma-2013 bias-corrected Cramér's V / Tschuprow's T."""
+    ct = crosstab(preds, target).count
+    ct = ct[ct.sum(1) != 0][:, ct.sum(0) != 0]
+    _, phi2, n = _chi2_phi2(ct)
+    r, k = ct.shape
+    phi2c = max(0.0, phi2 - (k - 1) * (r - 1) / (n - 1))
+    rc = r - (r - 1) ** 2 / (n - 1)
+    kc = k - (k - 1) ** 2 / (n - 1)
+    if kind == "cramer":
+        return np.sqrt(phi2c / min(rc - 1, kc - 1))
+    return np.sqrt(phi2c / np.sqrt((rc - 1) * (kc - 1)))
+
+
+def _np_theils_u(preds, target):
+    """U(X|Y) = (H(X) - H(X|Y)) / H(X) computed directly from joint frequencies."""
+    ct = crosstab(preds, target).count.astype(np.float64)
+    n = ct.sum()
+    p_xy = ct / n
+    p_x = p_xy.sum(1)
+    p_y = p_xy.sum(0)
+    h_x = -np.sum(p_x[p_x > 0] * np.log(p_x[p_x > 0]))
+    with np.errstate(divide="ignore", invalid="ignore"):
+        cond = p_xy / p_y[None, :]
+    mask = p_xy > 0
+    h_x_given_y = -np.sum(p_xy[mask] * np.log(cond[mask]))
+    return (h_x - h_x_given_y) / h_x
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_cramers_bias_corrected_vs_numpy(seed):
+    preds, target = _data(seed)
+    got = cramers_v(jnp.asarray(preds), jnp.asarray(target), bias_correction=True)
+    np.testing.assert_allclose(np.asarray(got), _np_corrected(preds, target, "cramer"), atol=1e-6)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_tschuprows_bias_corrected_vs_numpy(seed):
+    preds, target = _data(seed)
+    got = tschuprows_t(jnp.asarray(preds), jnp.asarray(target), bias_correction=True)
+    np.testing.assert_allclose(np.asarray(got), _np_corrected(preds, target, "tschuprow"), atol=1e-6)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_theils_u_vs_numpy(seed):
+    preds, target = _data(seed)
+    got = theils_u(jnp.asarray(preds), jnp.asarray(target))
+    np.testing.assert_allclose(np.asarray(got), _np_theils_u(preds, target), atol=1e-6)
+
+
+def test_probability_matrix_inputs_argmax():
+    """(N, C) float inputs are argmaxed to labels (reference nominal format step)."""
+    preds, target = _data(seed=3)
+    rng = np.random.default_rng(4)
+    preds_probs = rng.random((len(preds), NUM_CLASSES)).astype(np.float32)
+    preds_probs[np.arange(len(preds)), preds] += 10.0  # argmax == preds
+    got = cramers_v(jnp.asarray(preds_probs), jnp.asarray(target), bias_correction=False)
+    expected = cramers_v(jnp.asarray(preds), jnp.asarray(target), bias_correction=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=1e-6)
+
+
+@pytest.mark.parametrize(
+    "matrix_fn, pair_fn, kwargs",
+    [
+        (cramers_v_matrix, cramers_v, {"bias_correction": True}),
+        (tschuprows_t_matrix, tschuprows_t, {"bias_correction": True}),
+        (pearsons_contingency_coefficient_matrix, pearsons_contingency_coefficient, {}),
+        (theils_u_matrix, theils_u, {}),
+    ],
+)
+def test_matrix_functions_match_pairwise(matrix_fn, pair_fn, kwargs):
+    rng = np.random.default_rng(5)
+    m = rng.integers(0, 4, size=(150, 3))
+    out = np.asarray(matrix_fn(jnp.asarray(m), **kwargs))
+    assert out.shape == (3, 3)
+    np.testing.assert_allclose(np.diag(out), 1.0)
+    for i in range(3):
+        for j in range(3):
+            if i == j:
+                continue
+            expected = float(pair_fn(jnp.asarray(m[:, i]), jnp.asarray(m[:, j]), **kwargs))
+            np.testing.assert_allclose(out[i, j], expected, atol=1e-6)
+    # the chi2-based matrices are symmetric; Theil's U is directional
+    if matrix_fn is not theils_u_matrix:
+        np.testing.assert_allclose(out, out.T, atol=1e-6)
+
+
+def test_nan_replace_exact_semantics():
+    """'replace' maps NaN to the given class; result equals hand-replaced input."""
+    preds = np.asarray([0.0, 1.0, np.nan, 2.0, 1.0, np.nan])
+    target = np.asarray([0.0, 1.0, 1.0, 2.0, np.nan, 0.0])
+    replaced_p = np.nan_to_num(preds, nan=1.0)
+    replaced_t = np.nan_to_num(target, nan=1.0)
+    got = cramers_v(jnp.asarray(preds), jnp.asarray(target), bias_correction=False,
+                    nan_strategy="replace", nan_replace_value=1.0)
+    expected = cramers_v(jnp.asarray(replaced_p), jnp.asarray(replaced_t), bias_correction=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=1e-6)
+
+
+def test_nan_drop_exact_semantics():
+    """'drop' removes rows where either side is NaN."""
+    preds = np.asarray([0.0, 1.0, np.nan, 2.0, 1.0, 0.0])
+    target = np.asarray([0.0, 1.0, 1.0, 2.0, np.nan, 0.0])
+    keep = ~(np.isnan(preds) | np.isnan(target))
+    got = theils_u(jnp.asarray(preds), jnp.asarray(target), nan_strategy="drop")
+    expected = theils_u(jnp.asarray(preds[keep]), jnp.asarray(target[keep]))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=1e-6)
+
+
+def test_invalid_nan_strategy_raises():
+    for fn in (cramers_v, tschuprows_t, pearsons_contingency_coefficient, theils_u):
+        with pytest.raises(ValueError, match="nan_strategy"):
+            fn(jnp.zeros(4), jnp.zeros(4), nan_strategy="bogus")
+    with pytest.raises(ValueError, match="nan_replace"):
+        cramers_v(jnp.zeros(4), jnp.zeros(4), nan_strategy="replace", nan_replace_value=None)
+
+
+def test_single_class_returns_nan_with_warning():
+    """Degenerate tables (one occupied row/col after drop) → NaN + warning."""
+    preds = jnp.zeros(10, dtype=jnp.int32)
+    target = jnp.zeros(10, dtype=jnp.int32)
+    with pytest.warns(UserWarning, match="Unable to compute"):
+        out = cramers_v(preds, target, bias_correction=True)
+    assert np.isnan(np.asarray(out))
+    out_u = theils_u(preds, target)
+    assert np.isnan(np.asarray(out_u))
+
+
+def test_module_accumulation_matches_functional_union():
+    preds, target = _data(seed=6)
+    m = CramersV(num_classes=NUM_CLASSES)
+    u = TheilsU(num_classes=NUM_CLASSES)
+    for lo, hi in [(0, 100), (100, 300)]:
+        m.update(jnp.asarray(preds[lo:hi]), jnp.asarray(target[lo:hi]))
+        u.update(jnp.asarray(preds[lo:hi]), jnp.asarray(target[lo:hi]))
+    np.testing.assert_allclose(
+        np.asarray(m.compute()), np.asarray(cramers_v(jnp.asarray(preds), jnp.asarray(target))), atol=1e-6
+    )
+    np.testing.assert_allclose(np.asarray(u.compute()), _np_theils_u(preds, target), atol=1e-6)

@@ -1,0 +1,94 @@
+"""Extended pairwise coverage: zero_diagonal overrides, degenerate inputs,
+dtype robustness, and larger-shape agreement with sklearn.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics.pairwise import (
+    cosine_similarity as sk_cosine,
+    euclidean_distances as sk_euclidean,
+    linear_kernel as sk_linear,
+    manhattan_distances as sk_manhattan,
+)
+
+from metrics_tpu.functional.pairwise import (
+    pairwise_cosine_similarity,
+    pairwise_euclidean_distance,
+    pairwise_linear_similarity,
+    pairwise_manhattan_distance,
+)
+
+ALL = [
+    (pairwise_cosine_similarity, sk_cosine),
+    (pairwise_euclidean_distance, sk_euclidean),
+    (pairwise_manhattan_distance, sk_manhattan),
+    (pairwise_linear_similarity, sk_linear),
+]
+
+
+@pytest.mark.parametrize("tm_fn, sk_fn", ALL)
+def test_zero_diagonal_override_two_inputs(tm_fn, sk_fn):
+    """zero_diagonal=True with two distinct inputs zeroes the leading diagonal."""
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(7, 4)).astype(np.float32)
+    Y = rng.normal(size=(7, 4)).astype(np.float32)
+    res = np.asarray(tm_fn(jnp.asarray(X), jnp.asarray(Y), zero_diagonal=True))
+    expected = sk_fn(X, Y).astype(np.float64)
+    np.fill_diagonal(expected, 0)
+    np.testing.assert_allclose(res, expected, atol=1e-5)
+
+
+@pytest.mark.parametrize("tm_fn, sk_fn", ALL)
+def test_single_input_keep_diagonal(tm_fn, sk_fn):
+    """zero_diagonal=False with one input keeps the self-similarity diagonal."""
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(6, 5)).astype(np.float32)
+    res = np.asarray(tm_fn(jnp.asarray(X), zero_diagonal=False))
+    np.testing.assert_allclose(res, sk_fn(X, X), atol=1e-5)
+
+
+@pytest.mark.parametrize("tm_fn, sk_fn", ALL)
+def test_large_shapes(tm_fn, sk_fn):
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(128, 64)).astype(np.float32)
+    Y = rng.normal(size=(96, 64)).astype(np.float32)
+    res = np.asarray(tm_fn(jnp.asarray(X), jnp.asarray(Y)))
+    np.testing.assert_allclose(res, sk_fn(X, Y), atol=1e-3)
+
+
+def test_cosine_zero_vector_is_finite():
+    """A zero row must produce 0 similarity, not NaN (safe-divide semantics)."""
+    X = np.zeros((2, 3), dtype=np.float32)
+    X[1] = [1.0, 0.0, 0.0]
+    res = np.asarray(pairwise_cosine_similarity(jnp.asarray(X)))
+    assert np.all(np.isfinite(res))
+
+
+def test_euclidean_self_distance_nonnegative():
+    """Cancellation in ||x||² − 2x·y + ||y||² must not go negative, and the
+    self-distance diagonal is pinned to its exact value 0 (sklearn does the same)."""
+    rng = np.random.default_rng(3)
+    X = (rng.normal(size=(50, 8)) * 1e3).astype(np.float32)
+    res = np.asarray(pairwise_euclidean_distance(jnp.asarray(X), zero_diagonal=False))
+    assert np.all(res >= 0)
+    np.testing.assert_array_equal(np.diag(res), 0.0)
+    off_diag = res + np.diag(np.full(len(X), np.nan))
+    expected = sk_euclidean(X, X)
+    mask = ~np.isnan(off_diag)
+    np.testing.assert_allclose(off_diag[mask], expected[mask], rtol=1e-3, atol=1.0)
+
+
+def test_invalid_reduction_raises():
+    with pytest.raises(ValueError, match="reduction"):
+        pairwise_cosine_similarity(jnp.ones((4, 3)), reduction="bogus")
+
+
+def test_integer_inputs_upcast():
+    X = np.asarray([[1, 2], [3, 4]], dtype=np.int32)
+    res = np.asarray(pairwise_linear_similarity(jnp.asarray(X)))
+    expected = sk_linear(X.astype(np.float32), X.astype(np.float32)).astype(np.float64)
+    np.fill_diagonal(expected, 0)
+    np.testing.assert_allclose(res, expected, atol=1e-6)

@@ -1,0 +1,153 @@
+"""Extended retrieval coverage: nDCG with graded gains and large k, adaptive_k
+edge cases, all-empty-query corners, fake-world distributed sync of the
+cat-reduce (indexes, preds, target) states, and state/reset behavior.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.functional.retrieval import (
+    retrieval_average_precision,
+    retrieval_hit_rate,
+    retrieval_normalized_dcg,
+    retrieval_precision,
+    retrieval_recall,
+)
+from metrics_tpu.retrieval import (
+    RetrievalMAP,
+    RetrievalNormalizedDCG,
+    RetrievalPrecision,
+    RetrievalRecall,
+)
+from tests.helpers.testers import _fake_dist_sync_fns
+
+
+def _np_ndcg(p, t, k=None):
+    n = len(p)
+    k = n if k is None else k
+    t = t.astype(float)
+    t_s = t[np.argsort(-p, kind="stable")][: min(k, n)]
+    ideal = np.sort(t)[::-1][: min(k, n)]
+    disc = 1.0 / np.log2(np.arange(len(t_s)) + 2.0)
+    dcg, idcg = (t_s * disc).sum(), (ideal * disc).sum()
+    return 0.0 if idcg == 0 else float(dcg / idcg)
+
+
+def test_ndcg_k_larger_than_docs():
+    rng = np.random.RandomState(0)
+    p = rng.rand(6).astype(np.float32)
+    t = rng.randint(0, 5, 6)
+    np.testing.assert_allclose(
+        float(retrieval_normalized_dcg(jnp.asarray(p), jnp.asarray(t), k=50)), _np_ndcg(p, t, k=50), atol=1e-6
+    )
+
+
+def test_ndcg_graded_int_gains_and_float_rejection():
+    """Graded integer relevance is supported; float targets are rejected —
+    both per reference retrieval/ndcg.py:32 (bool/int only, non-binary allowed)."""
+    p = np.asarray([0.1, 0.2, 0.3, 4.0, 70.0], dtype=np.float32)
+    t = np.asarray([10, 0, 0, 1, 5])
+    np.testing.assert_allclose(
+        float(retrieval_normalized_dcg(jnp.asarray(p), jnp.asarray(t))), _np_ndcg(p, t), atol=1e-4
+    )
+    with pytest.raises(ValueError, match="booleans or integers"):
+        retrieval_normalized_dcg(jnp.asarray(p), jnp.asarray(t, dtype=np.float32))
+
+
+def test_precision_adaptive_k_caps_at_docs():
+    """adaptive_k clamps k to the number of documents in the query."""
+    p = np.asarray([0.9, 0.7, 0.3], dtype=np.float32)
+    t = np.asarray([1, 0, 1])
+    got = float(retrieval_precision(jnp.asarray(p), jnp.asarray(t), k=10, adaptive_k=True))
+    np.testing.assert_allclose(got, 2 / 3, atol=1e-6)
+    # without adaptive_k the denominator stays k
+    got_fixed = float(retrieval_precision(jnp.asarray(p), jnp.asarray(t), k=10))
+    np.testing.assert_allclose(got_fixed, 2 / 10, atol=1e-6)
+
+
+def test_functional_empty_target_returns_zero():
+    p = np.asarray([0.5, 0.4], dtype=np.float32)
+    t = np.zeros(2, dtype=np.int64)
+    for fn in (retrieval_average_precision, retrieval_recall, retrieval_hit_rate):
+        assert float(fn(jnp.asarray(p), jnp.asarray(t))) == 0.0
+
+
+@pytest.mark.parametrize("action,expected", [("neg", 0.0), ("pos", 1.0)])
+def test_all_queries_empty(action, expected):
+    m = RetrievalMAP(empty_target_action=action)
+    m.update(
+        jnp.asarray([0.3, 0.6, 0.1, 0.8]),
+        jnp.asarray([0, 0, 0, 0]),
+        indexes=jnp.asarray([0, 0, 1, 1]),
+    )
+    assert float(m.compute()) == expected
+
+
+def test_all_queries_empty_skip_returns_zero():
+    m = RetrievalMAP(empty_target_action="skip")
+    m.update(jnp.asarray([0.3, 0.6]), jnp.asarray([0, 0]), indexes=jnp.asarray([0, 0]))
+    assert float(m.compute()) == 0.0
+
+
+def test_fake_world_distributed_union():
+    """Cat-reduce states gather across a fake 2-rank world; the result equals the
+    single-process computation on the union (SURVEY §4 invariant)."""
+    rng = np.random.RandomState(3)
+    world = 2
+    n = 64
+    preds = rng.rand(world, n).astype(np.float32)
+    target = rng.randint(0, 2, (world, n))
+    indexes = rng.randint(0, 6, (world, n))
+
+    metrics = [RetrievalMAP() for _ in range(world)]
+    for r, m in enumerate(metrics):
+        m.update(jnp.asarray(preds[r]), jnp.asarray(target[r]), indexes=jnp.asarray(indexes[r]))
+    fns = _fake_dist_sync_fns(metrics)
+    for r, m in enumerate(metrics):
+        m.dist_sync_fn = fns(r)
+        m.distributed_available_fn = lambda: True
+    got = float(metrics[0].compute())
+
+    union = RetrievalMAP()
+    union.update(
+        jnp.asarray(preds.reshape(-1)), jnp.asarray(target.reshape(-1)), indexes=jnp.asarray(indexes.reshape(-1))
+    )
+    np.testing.assert_allclose(got, float(union.compute()), atol=1e-6)
+
+
+def test_reset_clears_list_states():
+    m = RetrievalRecall(k=2)
+    m.update(jnp.asarray([0.5, 0.2]), jnp.asarray([1, 0]), indexes=jnp.asarray([0, 0]))
+    first = float(m.compute())
+    m.reset()
+    m.update(jnp.asarray([0.9, 0.8, 0.1]), jnp.asarray([0, 1, 1]), indexes=jnp.asarray([1, 1, 1]))
+    second = float(m.compute())
+    assert first == 1.0
+    np.testing.assert_allclose(second, 0.5, atol=1e-6)
+
+
+def test_indexes_need_not_be_contiguous():
+    """Query ids may be arbitrary non-negative ints (sorted group-by semantics)."""
+    p = np.asarray([0.9, 0.1, 0.8, 0.3], dtype=np.float32)
+    t = np.asarray([1, 0, 0, 1])
+    idx = np.asarray([7, 7, 100, 100])
+    m = RetrievalPrecision(k=1)
+    m.update(jnp.asarray(p), jnp.asarray(t), indexes=jnp.asarray(idx))
+    # query 7: top-1 is relevant (1.0); query 100: top-1 not relevant (0.0)
+    np.testing.assert_allclose(float(m.compute()), 0.5, atol=1e-6)
+
+
+def test_ndcg_module_with_graded_gains_accumulation():
+    rng = np.random.RandomState(5)
+    preds = rng.rand(2, 40).astype(np.float32)
+    gains = rng.randint(0, 4, (2, 40))
+    indexes = rng.randint(0, 5, (2, 40))
+    m = RetrievalNormalizedDCG(k=5)
+    for i in range(2):
+        m.update(jnp.asarray(preds[i]), jnp.asarray(gains[i]), indexes=jnp.asarray(indexes[i]))
+    p, g, ix = preds.reshape(-1), gains.reshape(-1), indexes.reshape(-1)
+    per_query = [_np_ndcg(p[ix == q], g[ix == q], k=5) for q in np.unique(ix) if (g[ix == q] > 0).any()]
+    np.testing.assert_allclose(float(m.compute()), np.mean(per_query), atol=1e-5)
