@@ -1,0 +1,269 @@
+"""Direct unit tests for the L1 utilities layer.
+
+Port of tests/unittests/utilities/: each helper is checked against plain
+numpy/sklearn semantics rather than through the metrics that use it, so a
+regression pinpoints the utility itself.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.utils.checks import (
+    _check_same_shape,
+    _input_format_classification,
+    check_forward_full_state_property,
+)
+from metrics_tpu.utils.compute import _safe_divide, _safe_matmul, _safe_xlogy, auc
+from metrics_tpu.utils.data import (
+    _bincount,
+    _bincount_matmul,
+    _flatten,
+    _flatten_dict,
+    _flexible_bincount,
+    _squeeze_if_scalar,
+    allclose,
+    apply_to_collection,
+    dim_zero_cat,
+    dim_zero_max,
+    dim_zero_mean,
+    dim_zero_min,
+    dim_zero_sum,
+    select_topk,
+    to_categorical,
+    to_onehot,
+)
+from metrics_tpu.utils.distributed import class_reduce, gather_all_tensors, reduce
+from metrics_tpu.utils.enums import AverageMethod, ClassificationTask, DataType, EnumStr
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+
+# ----------------------------------------------------------------------- data
+def test_dim_zero_reductions():
+    x = jnp.asarray([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]])
+    np.testing.assert_allclose(np.asarray(dim_zero_sum(x)), [9.0, 12.0])
+    np.testing.assert_allclose(np.asarray(dim_zero_mean(x)), [3.0, 4.0])
+    np.testing.assert_allclose(np.asarray(dim_zero_max(x)), [5.0, 6.0])
+    np.testing.assert_allclose(np.asarray(dim_zero_min(x)), [1.0, 2.0])
+
+
+def test_dim_zero_cat_variants():
+    np.testing.assert_array_equal(np.asarray(dim_zero_cat(jnp.asarray([1, 2]))), [1, 2])
+    np.testing.assert_array_equal(
+        np.asarray(dim_zero_cat([jnp.asarray([1, 2]), jnp.asarray([3])])), [1, 2, 3]
+    )
+    # scalars are promoted to 1-d before concatenation
+    np.testing.assert_array_equal(np.asarray(dim_zero_cat([jnp.asarray(1), jnp.asarray(2)])), [1, 2])
+    with pytest.raises(ValueError, match="No samples"):
+        dim_zero_cat([])
+
+
+def test_flatten_helpers():
+    assert _flatten([[1, 2], [3], []]) == [1, 2, 3]
+    flat, dup = _flatten_dict({"a": {"x": 1}, "b": 2})
+    assert flat == {"x": 1, "b": 2} and dup is False
+    flat, dup = _flatten_dict({"a": {"x": 1}, "x": 2})
+    assert dup is True
+
+
+def test_to_onehot_matches_manual():
+    labels = jnp.asarray([0, 2, 1])
+    oh = to_onehot(labels, 3)
+    assert oh.shape == (3, 3)
+    np.testing.assert_array_equal(np.asarray(oh), np.eye(3)[[0, 2, 1]])
+    # trailing dims: (N, d) labels -> (N, C, d)
+    multi = to_onehot(jnp.asarray([[0, 1], [2, 0]]), 3)
+    assert multi.shape == (2, 3, 2)
+    assert int(multi[0, 0, 0]) == 1 and int(multi[0, 1, 1]) == 1
+
+
+@pytest.mark.parametrize("topk", [1, 2])
+def test_select_topk(topk):
+    probs = jnp.asarray([[0.1, 0.6, 0.3], [0.5, 0.2, 0.3]])
+    mask = np.asarray(select_topk(probs, topk))
+    assert mask.sum(axis=1).tolist() == [topk, topk]
+    order = np.argsort(-np.asarray(probs), axis=1)
+    for row in range(2):
+        assert set(np.flatnonzero(mask[row])) == set(order[row][:topk])
+
+
+def test_to_categorical_roundtrip():
+    labels = jnp.asarray([2, 0, 1])
+    probs = jax.nn.one_hot(labels, 3) * 0.9 + 0.05
+    np.testing.assert_array_equal(np.asarray(to_categorical(probs)), np.asarray(labels))
+
+
+def test_apply_to_collection_types():
+    from collections import namedtuple
+
+    NT = namedtuple("NT", ["a", "b"])
+    data = {"x": jnp.asarray([1.0]), "y": [jnp.asarray([2.0]), 3], "z": NT(jnp.asarray([4.0]), "s")}
+    out = apply_to_collection(data, jax.Array, lambda t: t * 2)
+    assert float(out["x"][0]) == 2.0
+    assert float(out["y"][0][0]) == 4.0 and out["y"][1] == 3
+    assert float(out["z"].a[0]) == 8.0 and out["z"].b == "s"
+    # wrong_dtype exclusion leaves matching elements untouched
+    out2 = apply_to_collection(jnp.asarray([1.0]), jax.Array, lambda t: t * 2, wrong_dtype=jax.Array)
+    assert float(out2[0]) == 1.0
+
+
+def test_squeeze_if_scalar():
+    out = _squeeze_if_scalar({"a": jnp.asarray([3.0]), "b": jnp.asarray([1.0, 2.0])})
+    assert out["a"].ndim == 0
+    assert out["b"].shape == (2,)
+
+
+@pytest.mark.parametrize("impl", [_bincount, _bincount_matmul])
+def test_bincount_matches_numpy(impl):
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 7, size=200)
+    np.testing.assert_array_equal(np.asarray(impl(jnp.asarray(x), 7)), np.bincount(x, minlength=7))
+
+
+def test_flexible_bincount():
+    x = jnp.asarray([5, 5, 9, 5, 9, 12])
+    counts = np.asarray(_flexible_bincount(x))
+    np.testing.assert_array_equal(counts, [3, 2, 1])
+
+
+def test_allclose_dtype_robust():
+    with pytest.warns(UserWarning, match="float64"):  # jax truncates to f32 under x64-off
+        wide = jnp.asarray([1.0], jnp.float64)
+    assert allclose(jnp.asarray([1.0], jnp.float32), wide)
+    assert not allclose(jnp.asarray([1.0]), jnp.asarray([1.1]))
+
+
+# -------------------------------------------------------------------- compute
+def test_safe_divide_semantics():
+    res = _safe_divide(jnp.asarray([1.0, 2.0]), jnp.asarray([0.0, 4.0]))
+    np.testing.assert_allclose(np.asarray(res), [0.0, 0.5])
+    res2 = _safe_divide(jnp.asarray([1.0]), jnp.asarray([0.0]), zero_division=1.0)
+    np.testing.assert_allclose(np.asarray(res2), [1.0])
+    # integer inputs upcast to float
+    assert jnp.issubdtype(_safe_divide(jnp.asarray([1]), jnp.asarray([2])).dtype, jnp.floating)
+
+
+def test_safe_xlogy():
+    res = _safe_xlogy(jnp.asarray([0.0, 2.0]), jnp.asarray([0.0, np.e]))
+    np.testing.assert_allclose(np.asarray(res), [0.0, 2.0], atol=1e-6)
+    assert np.all(np.isfinite(np.asarray(res)))
+
+
+def test_safe_matmul_upcasts_bf16():
+    x = jnp.full((2, 256), 0.1, dtype=jnp.bfloat16)
+    y = jnp.full((256, 2), 0.1, dtype=jnp.bfloat16)
+    out = _safe_matmul(x, y)
+    assert out.dtype == jnp.bfloat16
+    # 256 * 0.01 = 2.56; bf16-accumulated would drift much further than f32-accumulated
+    np.testing.assert_allclose(np.asarray(out, dtype=np.float32), 2.56, rtol=2e-2)
+
+
+def test_auc_trapezoid():
+    x = jnp.asarray([0.0, 1.0, 2.0])
+    y = jnp.asarray([0.0, 1.0, 0.0])
+    np.testing.assert_allclose(float(auc(x, y)), 1.0)
+    # descending x integrates with flipped sign
+    np.testing.assert_allclose(float(auc(x[::-1], y)), 1.0)
+    # reorder sorts first
+    np.testing.assert_allclose(float(auc(jnp.asarray([2.0, 0.0, 1.0]), jnp.asarray([0.0, 0.0, 1.0]), reorder=True)), 1.0)
+    with pytest.raises(ValueError, match="same length"):
+        auc(jnp.asarray([1.0, 2.0]), jnp.asarray([1.0]))
+    with pytest.raises(ValueError, match="1-d"):
+        auc(jnp.ones((2, 2)), jnp.ones((2, 2)))
+
+
+# ---------------------------------------------------------------- distributed
+def test_reduce_modes():
+    x = jnp.asarray([1.0, 2.0, 3.0])
+    np.testing.assert_allclose(float(reduce(x, "elementwise_mean")), 2.0)
+    np.testing.assert_allclose(float(reduce(x, "sum")), 6.0)
+    np.testing.assert_allclose(np.asarray(reduce(x, "none")), np.asarray(x))
+    with pytest.raises(ValueError, match="unknown"):
+        reduce(x, "bogus")
+
+
+def test_class_reduce_matches_manual():
+    num = jnp.asarray([2.0, 0.0, 3.0])
+    denom = jnp.asarray([4.0, 0.0, 3.0])
+    weights = jnp.asarray([4.0, 2.0, 3.0])
+    np.testing.assert_allclose(float(class_reduce(num, denom, weights, "micro")), 5.0 / 7.0)
+    np.testing.assert_allclose(float(class_reduce(num, denom, weights, "macro")), np.mean([0.5, 0.0, 1.0]))
+    np.testing.assert_allclose(
+        float(class_reduce(num, denom, weights, "weighted")), 0.5 * 4 / 9 + 0.0 * 2 / 9 + 1.0 * 3 / 9
+    )
+    np.testing.assert_allclose(np.asarray(class_reduce(num, denom, weights, "none")), [0.5, 0.0, 1.0])
+    with pytest.raises(ValueError, match="unknown"):
+        class_reduce(num, denom, weights, "bogus")
+
+
+def test_gather_all_tensors_single_process_identity():
+    out = gather_all_tensors(jnp.asarray([1.0, 2.0]))
+    assert len(out) == 1
+    np.testing.assert_allclose(np.asarray(out[0]), [1.0, 2.0])
+
+
+# ---------------------------------------------------------------------- enums
+def test_enumstr_case_insensitive():
+    assert DataType.from_str("Binary") is DataType.BINARY
+    assert AverageMethod.from_str("Weighted") is AverageMethod.WEIGHTED
+    assert DataType.from_str("bogus") is None
+    assert AverageMethod.MICRO == "MICRO"
+    assert ClassificationTask.from_str_or_raise("Binary") is ClassificationTask.BINARY
+    with pytest.raises(ValueError, match="Invalid Classification"):
+        ClassificationTask.from_str_or_raise("nope")
+    # EnumStr equality is case-insensitive both ways
+    class Custom(EnumStr):
+        A = "a"
+    assert Custom.A == "A"
+
+
+# --------------------------------------------------------------------- checks
+def test_check_same_shape_raises():
+    with pytest.raises(RuntimeError, match="same shape"):
+        _check_same_shape(jnp.ones(3), jnp.ones(4))
+
+
+def test_input_format_classification_modes():
+    # binary probs -> thresholded labels, flattened
+    preds, target, mode = _input_format_classification(
+        jnp.asarray([0.2, 0.7]), jnp.asarray([0, 1]), threshold=0.5
+    )
+    assert mode == DataType.BINARY
+    np.testing.assert_array_equal(np.asarray(preds).reshape(-1), [0, 1])
+    # multiclass probs -> one-hot of argmax
+    mc_preds = jnp.asarray([[0.1, 0.8, 0.1], [0.7, 0.2, 0.1]])
+    preds, target, mode = _input_format_classification(mc_preds, jnp.asarray([1, 0]), threshold=0.5)
+    assert mode in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS)
+    assert preds.shape == target.shape
+
+
+def test_check_forward_full_state_property_runs(capsys):
+    from metrics_tpu.classification import MulticlassAccuracy
+
+    check_forward_full_state_property(
+        MulticlassAccuracy,
+        init_args={"num_classes": 3},
+        input_args={"preds": jnp.asarray([0, 1, 2]), "target": jnp.asarray([0, 1, 1])},
+        num_update_to_compare=[2],
+        reps=2,
+    )
+    out = capsys.readouterr().out
+    # prints the equality verdict and (when applicable) the recommendation
+    assert "Output equal: True" in out
+
+
+# ------------------------------------------------------------------ exceptions
+def test_user_error_is_runtime_error():
+    with pytest.raises(MetricsTPUUserError):
+        raise MetricsTPUUserError("bad usage")
+
+
+# --------------------------------------------------------------------- prints
+def test_rank_zero_warn_fires_on_rank_zero():
+    from metrics_tpu.utils.prints import rank_zero_warn
+
+    with pytest.warns(UserWarning, match="hello"):
+        rank_zero_warn("hello")
