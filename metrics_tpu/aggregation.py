@@ -215,3 +215,6 @@ class MeanMetric(BaseAggregator):
 
     def compute(self) -> Array:
         return self.mean_value / self.weight
+
+
+__all__ = ["BaseAggregator", "MaxMetric", "MinMetric", "SumMetric", "CatMetric", "MeanMetric"]

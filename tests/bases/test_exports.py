@@ -98,29 +98,54 @@ def test_canonical_usage():
     assert abs(float(col.compute()["acc"]) - 1.0) < 1e-7
 
 
-def test_api_reference_doc_lists_every_module_metric():
-    """docs/source/api_reference.md must name every public metric class, so the
-    doc page cannot silently drift from the export surface."""
-    import importlib
+_DOC_DOMAINS = [
+    "classification", "regression", "retrieval", "text", "image", "audio",
+    "detection", "nominal", "multimodal", "wrappers", "aggregation",
+]
+
+
+def _api_reference_text():
     import pathlib
 
     doc = pathlib.Path(__file__).resolve().parents[2] / "docs" / "source" / "api_reference.md"
-    text = doc.read_text()
+    return doc.read_text()
+
+
+def test_api_reference_doc_lists_every_module_metric():
+    """docs/source/api_reference.md must name every public metric class, so the
+    doc page cannot silently drift behind the export surface."""
+    import importlib
+
+    text = _api_reference_text()
     missing = []
-    non_metric = {
-        "GroupedRanks", "RetrievalMetric",  # internal template machinery
-        "Any", "Callable", "Dict", "List", "Optional", "Sequence", "Tuple", "Union", "Array",  # typing leaks
-    }
-    for domain in [
-        "classification", "regression", "retrieval", "text", "image", "audio",
-        "detection", "nominal", "multimodal", "wrappers", "aggregation",
-    ]:
+    for domain in _DOC_DOMAINS:
         mod = importlib.import_module(f"metrics_tpu.{domain}")
-        for name in dir(mod):
+        for name in mod.__all__:
+            # internal template machinery is not part of the metric inventory
+            if name in ("GroupedRanks", "group_by_query"):
+                continue
             # require the backticked form — a bare substring match would let a
             # facade row (e.g. `Accuracy`) vanish while `BinaryAccuracy` still
             # matches it as a substring
-            if name[0].isupper() and not name.startswith("_") and name not in non_metric:
-                if f"`{name}`" not in text:
-                    missing.append(f"{domain}.{name}")
+            if name[0].isupper() and f"`{name}`" not in text:
+                missing.append(f"{domain}.{name}")
     assert not missing, f"api_reference.md is missing: {missing}"
+
+
+def test_api_reference_doc_has_no_stale_names():
+    """The reverse direction: every backticked CamelCase name the doc advertises
+    must still resolve somewhere in the package, so renames/removals can't
+    leave stale rows behind."""
+    import importlib
+    import re
+
+    import metrics_tpu
+
+    text = _api_reference_text()
+    modules = [importlib.import_module(f"metrics_tpu.{d}") for d in _DOC_DOMAINS]
+    modules.append(metrics_tpu)
+    stale = []
+    for token in set(re.findall(r"`([A-Z][A-Za-z0-9]*)`", text)):
+        if not any(hasattr(m, token) for m in modules):
+            stale.append(token)
+    assert not stale, f"api_reference.md advertises names that no longer exist: {sorted(stale)}"
