@@ -13,7 +13,18 @@ from metrics_tpu.utils.imports import _PESQ_AVAILABLE
 
 
 class PerceptualEvaluationSpeechQuality(Metric):
-    """Mean PESQ over samples (reference audio/pesq.py:22-114); host-side backend."""
+    """Mean PESQ over samples (reference audio/pesq.py:22-114); host-side backend.
+
+    Example (requires the optional `pesq` package; not executed offline):
+        >>> import jax
+        >>> from metrics_tpu.audio import PerceptualEvaluationSpeechQuality
+        >>> metric = PerceptualEvaluationSpeechQuality(fs=16000, mode="wb")  # doctest: +SKIP
+        >>> target = jax.random.normal(jax.random.PRNGKey(0), (8000,))  # doctest: +SKIP
+        >>> preds = target + 0.01 * jax.random.normal(jax.random.PRNGKey(1), (8000,))  # doctest: +SKIP
+        >>> metric.update(preds, target)  # doctest: +SKIP
+        >>> metric.compute()  # doctest: +SKIP
+        Array(3.9..., dtype=float32)
+    """
 
     is_differentiable = False
     higher_is_better = True

@@ -12,7 +12,20 @@ from metrics_tpu.metric import BASE_METRIC_KWARGS, Metric
 
 
 class PermutationInvariantTraining(Metric):
-    """Mean best-permutation metric over samples (reference audio/pit.py:23-95)."""
+    """Mean best-permutation metric over samples (reference audio/pit.py:23-95).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from metrics_tpu.audio import PermutationInvariantTraining
+        >>> from metrics_tpu.functional.audio import scale_invariant_signal_distortion_ratio
+        >>> key = jax.random.PRNGKey(0)
+        >>> target = jax.random.normal(key, (3, 2, 100))
+        >>> preds = target[:, ::-1] + 0.05 * jax.random.normal(jax.random.PRNGKey(1), (3, 2, 100))
+        >>> metric = PermutationInvariantTraining(scale_invariant_signal_distortion_ratio, 'max')
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(25.74117, dtype=float32)
+    """
 
     is_differentiable = True
     higher_is_better = True

@@ -15,7 +15,19 @@ from metrics_tpu.metric import Metric
 
 
 class SignalDistortionRatio(Metric):
-    """Mean SDR over samples (reference audio/sdr.py:24-112)."""
+    """Mean SDR over samples (reference audio/sdr.py:24-112).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from metrics_tpu.audio import SignalDistortionRatio
+        >>> key1, key2 = jax.random.split(jax.random.PRNGKey(0))
+        >>> target = jax.random.normal(key1, (2, 400))
+        >>> preds = target + 0.1 * jax.random.normal(key2, (2, 400))
+        >>> metric = SignalDistortionRatio(filter_length=64)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(20.418972, dtype=float32)
+    """
 
     is_differentiable = True
     higher_is_better = True

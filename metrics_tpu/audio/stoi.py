@@ -13,7 +13,18 @@ from metrics_tpu.utils.imports import _PYSTOI_AVAILABLE
 
 
 class ShortTimeObjectiveIntelligibility(Metric):
-    """Mean STOI over samples (reference audio/stoi.py:22-113); host-side backend."""
+    """Mean STOI over samples (reference audio/stoi.py:22-113); host-side backend.
+
+    Example (requires the optional `pystoi` package; not executed offline):
+        >>> import jax
+        >>> from metrics_tpu.audio import ShortTimeObjectiveIntelligibility
+        >>> metric = ShortTimeObjectiveIntelligibility(fs=16000)  # doctest: +SKIP
+        >>> target = jax.random.normal(jax.random.PRNGKey(0), (8000,))  # doctest: +SKIP
+        >>> preds = target + 0.01 * jax.random.normal(jax.random.PRNGKey(1), (8000,))  # doctest: +SKIP
+        >>> metric.update(preds, target)  # doctest: +SKIP
+        >>> metric.compute()  # doctest: +SKIP
+        Array(0.9..., dtype=float32)
+    """
 
     is_differentiable = False
     higher_is_better = True

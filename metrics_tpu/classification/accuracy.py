@@ -99,7 +99,16 @@ class MultilabelAccuracy(MultilabelStatScores):
 
 
 class Accuracy:
-    """Task façade (reference accuracy.py ``Accuracy.__new__``)."""
+    """Task façade (reference accuracy.py ``Accuracy.__new__``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import Accuracy
+        >>> metric = Accuracy(task="multiclass", num_classes=3)
+        >>> metric.update(jnp.array([0, 2, 1, 2]), jnp.array([0, 1, 1, 2]))
+        >>> metric.compute()
+        Array(0.75, dtype=float32)
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

@@ -61,6 +61,17 @@ class BinaryAUROC(BinaryPrecisionRecallCurve):
 
 
 class MulticlassAUROC(MulticlassPrecisionRecallCurve):
+    """Multiclass A U R O C.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import MulticlassAUROC
+        >>> metric = MulticlassAUROC(num_classes=3)
+        >>> metric.update(jnp.array([[0.7, 0.2, 0.1], [0.2, 0.6, 0.2], [0.1, 0.2, 0.7], [0.3, 0.4, 0.3]]),
+        ...               jnp.array([0, 1, 2, 1]))
+        >>> metric.compute()
+        Array(1., dtype=float32)
+    """
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
@@ -90,6 +101,17 @@ class MulticlassAUROC(MulticlassPrecisionRecallCurve):
 
 
 class MultilabelAUROC(MultilabelPrecisionRecallCurve):
+    """Multilabel A U R O C.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import MultilabelAUROC
+        >>> metric = MultilabelAUROC(num_labels=3)
+        >>> metric.update(jnp.array([[0.9, 0.1, 0.7], [0.2, 0.8, 0.3], [0.6, 0.4, 0.2], [0.1, 0.7, 0.9]]),
+        ...               jnp.array([[1, 0, 1], [0, 1, 0], [1, 0, 0], [0, 1, 1]]))
+        >>> metric.compute()
+        Array(1., dtype=float32)
+    """
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
@@ -122,7 +144,17 @@ class MultilabelAUROC(MultilabelPrecisionRecallCurve):
 
 
 class AUROC:
-    """Task façade (reference auroc.py)."""
+    """Task façade (reference auroc.py).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import AUROC
+        >>> metric = AUROC(task="multiclass", num_classes=3)
+        >>> metric.update(jnp.array([[0.7, 0.2, 0.1], [0.2, 0.6, 0.2], [0.1, 0.2, 0.7], [0.3, 0.4, 0.3]]),
+        ...               jnp.array([0, 1, 2, 1]))
+        >>> metric.compute()
+        Array(1., dtype=float32)
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

@@ -89,6 +89,17 @@ class BinaryCalibrationError(Metric):
 
 
 class MulticlassCalibrationError(Metric):
+    """Multiclass Calibration Error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import MulticlassCalibrationError
+        >>> metric = MulticlassCalibrationError(num_classes=3)
+        >>> metric.update(jnp.array([[0.7, 0.2, 0.1], [0.2, 0.6, 0.2], [0.1, 0.2, 0.7], [0.3, 0.4, 0.3]]),
+        ...               jnp.array([0, 1, 2, 1]))
+        >>> metric.compute()
+        Array(0.4, dtype=float32)
+    """
     is_differentiable = False
     higher_is_better = False
     full_state_update = False
@@ -137,7 +148,17 @@ class MulticlassCalibrationError(Metric):
 
 
 class CalibrationError:
-    """Task façade (reference calibration_error.py ``CalibrationError.__new__``)."""
+    """Task façade (reference calibration_error.py ``CalibrationError.__new__``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import CalibrationError
+        >>> metric = CalibrationError(task="multiclass", num_classes=3)
+        >>> metric.update(jnp.array([[0.7, 0.2, 0.1], [0.2, 0.6, 0.2], [0.1, 0.2, 0.7], [0.3, 0.4, 0.3]]),
+        ...               jnp.array([0, 1, 2, 1]))
+        >>> metric.compute()
+        Array(0.4, dtype=float32)
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

@@ -66,7 +66,16 @@ class MulticlassCohenKappa(MulticlassConfusionMatrix):
 
 
 class CohenKappa:
-    """Task façade (reference cohen_kappa.py)."""
+    """Task façade (reference cohen_kappa.py).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import CohenKappa
+        >>> metric = CohenKappa(task="multiclass", num_classes=3)
+        >>> metric.update(jnp.array([0, 2, 1, 2]), jnp.array([0, 1, 1, 2]))
+        >>> metric.compute()
+        Array(0.6363636, dtype=float32)
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

@@ -118,6 +118,24 @@ class MulticlassConfusionMatrix(Metric):
 
 
 class MultilabelConfusionMatrix(Metric):
+    """Multilabel Confusion Matrix.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import MultilabelConfusionMatrix
+        >>> metric = MultilabelConfusionMatrix(num_labels=3)
+        >>> metric.update(jnp.array([[1, 0, 1], [0, 1, 0], [1, 1, 0], [0, 0, 1]]),
+        ...               jnp.array([[1, 0, 0], [0, 1, 0], [1, 0, 0], [0, 1, 1]]))
+        >>> metric.compute()
+        Array([[[2, 0],
+                [0, 2]],
+        <BLANKLINE>
+               [[1, 1],
+                [1, 1]],
+        <BLANKLINE>
+               [[2, 1],
+                [0, 1]]], dtype=int32)
+    """
     is_differentiable = False
     higher_is_better = None
     full_state_update = False
@@ -150,7 +168,18 @@ class MultilabelConfusionMatrix(Metric):
 
 
 class ConfusionMatrix:
-    """Task façade (reference confusion_matrix.py)."""
+    """Task façade (reference confusion_matrix.py).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import ConfusionMatrix
+        >>> metric = ConfusionMatrix(task="multiclass", num_classes=3)
+        >>> metric.update(jnp.array([0, 2, 1, 2]), jnp.array([0, 1, 1, 2]))
+        >>> metric.compute()
+        Array([[1, 0, 0],
+               [0, 1, 1],
+               [0, 0, 1]], dtype=int32)
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

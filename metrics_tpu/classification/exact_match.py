@@ -90,6 +90,17 @@ class MulticlassExactMatch(_AbstractExactMatch):
 
 
 class MultilabelExactMatch(_AbstractExactMatch):
+    """Multilabel Exact Match.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import MultilabelExactMatch
+        >>> metric = MultilabelExactMatch(num_labels=3)
+        >>> metric.update(jnp.array([[1, 0, 1], [0, 1, 0], [1, 1, 0], [0, 0, 1]]),
+        ...               jnp.array([[1, 0, 0], [0, 1, 0], [1, 0, 0], [0, 1, 1]]))
+        >>> metric.compute()
+        Array(0.25, dtype=float32)
+    """
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
@@ -127,7 +138,16 @@ class MultilabelExactMatch(_AbstractExactMatch):
 
 
 class ExactMatch:
-    """Task façade (reference exact_match.py)."""
+    """Task façade (reference exact_match.py).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import ExactMatch
+        >>> metric = ExactMatch(task="multiclass", num_classes=3)
+        >>> metric.update(jnp.array([[0, 2], [1, 1]]), jnp.array([[0, 2], [1, 0]]))
+        >>> metric.compute()
+        Array(0.5, dtype=float32)
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

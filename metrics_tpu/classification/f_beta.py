@@ -56,6 +56,16 @@ class BinaryFBetaScore(BinaryStatScores):
 
 
 class MulticlassFBetaScore(MulticlassStatScores):
+    """Multiclass F-beta Score.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import MulticlassFBetaScore
+        >>> metric = MulticlassFBetaScore(num_classes=3, beta=0.5)
+        >>> metric.update(jnp.array([0, 2, 1, 2]), jnp.array([0, 1, 1, 2]))
+        >>> metric.compute()
+        Array(0.79629636, dtype=float32)
+    """
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
@@ -79,6 +89,17 @@ class MulticlassFBetaScore(MulticlassStatScores):
 
 
 class MultilabelFBetaScore(MultilabelStatScores):
+    """Multilabel F-beta Score.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import MultilabelFBetaScore
+        >>> metric = MultilabelFBetaScore(num_labels=3, beta=0.5)
+        >>> metric.update(jnp.array([[1, 0, 1], [0, 1, 0], [1, 1, 0], [0, 0, 1]]),
+        ...               jnp.array([[1, 0, 0], [0, 1, 0], [1, 0, 0], [0, 1, 1]]))
+        >>> metric.compute()
+        Array(0.6851852, dtype=float32)
+    """
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
@@ -166,7 +187,16 @@ class MultilabelF1Score(MultilabelFBetaScore):
 
 
 class FBetaScore:
-    """Task façade (reference f_beta.py)."""
+    """Task façade (reference f_beta.py).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import FBetaScore
+        >>> metric = FBetaScore(task="multiclass", num_classes=3, beta=0.5)
+        >>> metric.update(jnp.array([0, 2, 1, 2]), jnp.array([0, 1, 1, 2]))
+        >>> metric.compute()
+        Array(0.75, dtype=float32)
+    """
 
     def __new__(  # type: ignore[misc]
         cls,
@@ -197,7 +227,16 @@ class FBetaScore:
 
 
 class F1Score:
-    """Task façade (reference f_beta.py)."""
+    """Task façade (reference f_beta.py).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import F1Score
+        >>> metric = F1Score(task="multiclass", num_classes=3)
+        >>> metric.update(jnp.array([0, 2, 1, 2]), jnp.array([0, 1, 1, 2]))
+        >>> metric.compute()
+        Array(0.75, dtype=float32)
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

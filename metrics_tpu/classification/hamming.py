@@ -38,6 +38,16 @@ class BinaryHammingDistance(BinaryStatScores):
 
 
 class MulticlassHammingDistance(MulticlassStatScores):
+    """Multiclass Hamming Distance.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import MulticlassHammingDistance
+        >>> metric = MulticlassHammingDistance(num_classes=3)
+        >>> metric.update(jnp.array([0, 2, 1, 2]), jnp.array([0, 1, 1, 2]))
+        >>> metric.compute()
+        Array(0.16666667, dtype=float32)
+    """
     is_differentiable = False
     higher_is_better = False
     full_state_update = False
@@ -50,6 +60,17 @@ class MulticlassHammingDistance(MulticlassStatScores):
 
 
 class MultilabelHammingDistance(MultilabelStatScores):
+    """Multilabel Hamming Distance.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import MultilabelHammingDistance
+        >>> metric = MultilabelHammingDistance(num_labels=3)
+        >>> metric.update(jnp.array([[1, 0, 1], [0, 1, 0], [1, 1, 0], [0, 0, 1]]),
+        ...               jnp.array([[1, 0, 0], [0, 1, 0], [1, 0, 0], [0, 1, 1]]))
+        >>> metric.compute()
+        Array(0.25, dtype=float32)
+    """
     is_differentiable = False
     higher_is_better = False
     full_state_update = False
@@ -62,7 +83,16 @@ class MultilabelHammingDistance(MultilabelStatScores):
 
 
 class HammingDistance:
-    """Task façade (reference hamming.py)."""
+    """Task façade (reference hamming.py).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import HammingDistance
+        >>> metric = HammingDistance(task="multiclass", num_classes=3)
+        >>> metric.update(jnp.array([0, 2, 1, 2]), jnp.array([0, 1, 1, 2]))
+        >>> metric.compute()
+        Array(0.25, dtype=float32)
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

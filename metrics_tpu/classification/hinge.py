@@ -78,6 +78,17 @@ class BinaryHingeLoss(Metric):
 
 
 class MulticlassHingeLoss(Metric):
+    """Multiclass Hinge Loss.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import MulticlassHingeLoss
+        >>> metric = MulticlassHingeLoss(num_classes=3)
+        >>> metric.update(jnp.array([[0.7, 0.2, 0.1], [0.2, 0.6, 0.2], [0.1, 0.2, 0.7], [0.3, 0.4, 0.3]]),
+        ...               jnp.array([0, 1, 2, 1]))
+        >>> metric.compute()
+        Array(0.625, dtype=float32)
+    """
     is_differentiable = True
     higher_is_better = False
     full_state_update = False
@@ -122,7 +133,17 @@ class MulticlassHingeLoss(Metric):
 
 
 class HingeLoss:
-    """Task façade (reference hinge.py ``HingeLoss.__new__``)."""
+    """Task façade (reference hinge.py ``HingeLoss.__new__``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import HingeLoss
+        >>> metric = HingeLoss(task="multiclass", num_classes=3)
+        >>> metric.update(jnp.array([[0.7, 0.2, 0.1], [0.2, 0.6, 0.2], [0.1, 0.2, 0.7], [0.3, 0.4, 0.3]]),
+        ...               jnp.array([0, 1, 2, 1]))
+        >>> metric.compute()
+        Array(0.625, dtype=float32)
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

@@ -63,6 +63,17 @@ class MulticlassJaccardIndex(MulticlassConfusionMatrix):
 
 
 class MultilabelJaccardIndex(MultilabelConfusionMatrix):
+    """Multilabel Jaccard Index.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import MultilabelJaccardIndex
+        >>> metric = MultilabelJaccardIndex(num_labels=3)
+        >>> metric.update(jnp.array([[1, 0, 1], [0, 1, 0], [1, 1, 0], [0, 0, 1]]),
+        ...               jnp.array([[1, 0, 0], [0, 1, 0], [1, 0, 0], [0, 1, 1]]))
+        >>> metric.compute()
+        Array(0.61111116, dtype=float32)
+    """
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
@@ -86,7 +97,16 @@ class MultilabelJaccardIndex(MultilabelConfusionMatrix):
 
 
 class JaccardIndex:
-    """Task façade (reference jaccard.py)."""
+    """Task façade (reference jaccard.py).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import JaccardIndex
+        >>> metric = JaccardIndex(task="multiclass", num_classes=3)
+        >>> metric.update(jnp.array([0, 2, 1, 2]), jnp.array([0, 1, 1, 2]))
+        >>> metric.compute()
+        Array(0.6666667, dtype=float32)
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

@@ -67,6 +67,17 @@ class MulticlassMatthewsCorrCoef(MulticlassConfusionMatrix):
 
 
 class MultilabelMatthewsCorrCoef(MultilabelConfusionMatrix):
+    """Multilabel Matthews Corr Coef.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import MultilabelMatthewsCorrCoef
+        >>> metric = MultilabelMatthewsCorrCoef(num_labels=3)
+        >>> metric.update(jnp.array([[1, 0, 1], [0, 1, 0], [1, 1, 0], [0, 0, 1]]),
+        ...               jnp.array([[1, 0, 0], [0, 1, 0], [1, 0, 0], [0, 1, 1]]))
+        >>> metric.compute()
+        Array(0.50709254, dtype=float32)
+    """
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
@@ -86,7 +97,16 @@ class MultilabelMatthewsCorrCoef(MultilabelConfusionMatrix):
 
 
 class MatthewsCorrCoef:
-    """Task façade (reference matthews_corrcoef.py)."""
+    """Task façade (reference matthews_corrcoef.py).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import MatthewsCorrCoef
+        >>> metric = MatthewsCorrCoef(task="multiclass", num_classes=3)
+        >>> metric.update(jnp.array([0, 2, 1, 2]), jnp.array([0, 1, 1, 2]))
+        >>> metric.compute()
+        Array(0.7, dtype=float32)
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

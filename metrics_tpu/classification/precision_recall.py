@@ -66,6 +66,17 @@ class MulticlassPrecision(MulticlassStatScores):
 
 
 class MultilabelPrecision(MultilabelStatScores):
+    """Multilabel Precision.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import MultilabelPrecision
+        >>> metric = MultilabelPrecision(num_labels=3)
+        >>> metric.update(jnp.array([[1, 0, 1], [0, 1, 0], [1, 1, 0], [0, 0, 1]]),
+        ...               jnp.array([[1, 0, 0], [0, 1, 0], [1, 0, 0], [0, 1, 1]]))
+        >>> metric.compute()
+        Array(0.6666667, dtype=float32)
+    """
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
@@ -128,6 +139,17 @@ class MulticlassRecall(MulticlassStatScores):
 
 
 class MultilabelRecall(MultilabelStatScores):
+    """Multilabel Recall.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import MultilabelRecall
+        >>> metric = MultilabelRecall(num_labels=3)
+        >>> metric.update(jnp.array([[1, 0, 1], [0, 1, 0], [1, 1, 0], [0, 0, 1]]),
+        ...               jnp.array([[1, 0, 0], [0, 1, 0], [1, 0, 0], [0, 1, 1]]))
+        >>> metric.compute()
+        Array(0.8333334, dtype=float32)
+    """
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
@@ -140,7 +162,16 @@ class MultilabelRecall(MultilabelStatScores):
 
 
 class Precision:
-    """Task façade (reference precision_recall.py)."""
+    """Task façade (reference precision_recall.py).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import Precision
+        >>> metric = Precision(task="multiclass", num_classes=3)
+        >>> metric.update(jnp.array([0, 2, 1, 2]), jnp.array([0, 1, 1, 2]))
+        >>> metric.compute()
+        Array(0.75, dtype=float32)
+    """
 
     def __new__(  # type: ignore[misc]
         cls,
@@ -170,7 +201,16 @@ class Precision:
 
 
 class Recall:
-    """Task façade (reference precision_recall.py)."""
+    """Task façade (reference precision_recall.py).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import Recall
+        >>> metric = Recall(task="multiclass", num_classes=3)
+        >>> metric.update(jnp.array([0, 2, 1, 2]), jnp.array([0, 1, 1, 2]))
+        >>> metric.compute()
+        Array(0.75, dtype=float32)
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

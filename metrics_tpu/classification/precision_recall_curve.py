@@ -102,6 +102,18 @@ class BinaryPrecisionRecallCurve(Metric):
 
 
 class MulticlassPrecisionRecallCurve(Metric):
+    """Multiclass Precision Recall Curve.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import MulticlassPrecisionRecallCurve
+        >>> metric = MulticlassPrecisionRecallCurve(num_classes=3, thresholds=4)
+        >>> metric.update(jnp.array([[0.7, 0.2, 0.1], [0.2, 0.6, 0.2], [0.1, 0.2, 0.7], [0.3, 0.4, 0.3]]),
+        ...               jnp.array([0, 1, 2, 1]))
+        >>> precision, recall, thresholds = metric.compute()
+        >>> precision.shape
+        (3, 5)
+    """
     is_differentiable = False
     higher_is_better = None
     full_state_update = False
@@ -155,6 +167,18 @@ class MulticlassPrecisionRecallCurve(Metric):
 
 
 class MultilabelPrecisionRecallCurve(Metric):
+    """Multilabel Precision Recall Curve.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import MultilabelPrecisionRecallCurve
+        >>> metric = MultilabelPrecisionRecallCurve(num_labels=3, thresholds=4)
+        >>> metric.update(jnp.array([[0.9, 0.1, 0.7], [0.2, 0.8, 0.3], [0.6, 0.4, 0.2], [0.1, 0.7, 0.9]]),
+        ...               jnp.array([[1, 0, 1], [0, 1, 0], [1, 0, 0], [0, 1, 1]]))
+        >>> precision, recall, thresholds = metric.compute()
+        >>> recall.shape
+        (3, 5)
+    """
     is_differentiable = False
     higher_is_better = None
     full_state_update = False
@@ -214,7 +238,17 @@ class MultilabelPrecisionRecallCurve(Metric):
 
 
 class PrecisionRecallCurve:
-    """Task façade (reference precision_recall_curve.py)."""
+    """Task façade (reference precision_recall_curve.py).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import PrecisionRecallCurve
+        >>> metric = PrecisionRecallCurve(task="binary", thresholds=4)
+        >>> metric.update(jnp.array([0.1, 0.6, 0.8, 0.4]), jnp.array([0, 1, 1, 0]))
+        >>> precision, recall, thresholds = metric.compute()
+        >>> precision
+        Array([0.5      , 0.6666667, 1.       , 0.       , 1.       ], dtype=float32)
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

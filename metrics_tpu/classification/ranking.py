@@ -64,6 +64,17 @@ class _MultilabelRankingMetric(Metric):
 
 
 class MultilabelCoverageError(_MultilabelRankingMetric):
+    """Multilabel Coverage Error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import MultilabelCoverageError
+        >>> metric = MultilabelCoverageError(num_labels=3)
+        >>> metric.update(jnp.array([[0.9, 0.1, 0.7], [0.2, 0.8, 0.3], [0.6, 0.4, 0.2], [0.1, 0.7, 0.9]]),
+        ...               jnp.array([[1, 0, 1], [0, 1, 0], [1, 0, 0], [0, 1, 1]]))
+        >>> metric.compute()
+        Array(1.5, dtype=float32)
+    """
     higher_is_better = False
     _update_fn = staticmethod(_multilabel_coverage_error_update)
 
@@ -87,5 +98,16 @@ class MultilabelRankingAveragePrecision(_MultilabelRankingMetric):
 
 
 class MultilabelRankingLoss(_MultilabelRankingMetric):
+    """Multilabel Ranking Loss.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import MultilabelRankingLoss
+        >>> metric = MultilabelRankingLoss(num_labels=3)
+        >>> metric.update(jnp.array([[0.9, 0.1, 0.7], [0.2, 0.8, 0.3], [0.6, 0.4, 0.2], [0.1, 0.7, 0.9]]),
+        ...               jnp.array([[1, 0, 1], [0, 1, 0], [1, 0, 0], [0, 1, 1]]))
+        >>> metric.compute()
+        Array(0., dtype=float32)
+    """
     higher_is_better = False
     _update_fn = staticmethod(_multilabel_ranking_loss_update)

@@ -68,6 +68,17 @@ class BinaryRecallAtFixedPrecision(BinaryPrecisionRecallCurve):
 
 
 class MulticlassRecallAtFixedPrecision(MulticlassPrecisionRecallCurve):
+    """Multiclass Recall At Fixed Precision.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import MulticlassRecallAtFixedPrecision
+        >>> metric = MulticlassRecallAtFixedPrecision(num_classes=3, min_precision=0.5, thresholds=4)
+        >>> metric.update(jnp.array([[0.7, 0.2, 0.1], [0.2, 0.6, 0.2], [0.1, 0.2, 0.7], [0.3, 0.4, 0.3]]),
+        ...               jnp.array([0, 1, 2, 1]))
+        >>> metric.compute()
+        (Array([1., 1., 1.], dtype=float32), Array([0.6666667 , 0.33333334, 0.6666667 ], dtype=float32))
+    """
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
@@ -93,6 +104,17 @@ class MulticlassRecallAtFixedPrecision(MulticlassPrecisionRecallCurve):
 
 
 class MultilabelRecallAtFixedPrecision(MultilabelPrecisionRecallCurve):
+    """Multilabel Recall At Fixed Precision.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import MultilabelRecallAtFixedPrecision
+        >>> metric = MultilabelRecallAtFixedPrecision(num_labels=3, min_precision=0.5, thresholds=4)
+        >>> metric.update(jnp.array([[0.9, 0.1, 0.7], [0.2, 0.8, 0.3], [0.6, 0.4, 0.2], [0.1, 0.7, 0.9]]),
+        ...               jnp.array([[1, 0, 1], [0, 1, 0], [1, 0, 0], [0, 1, 1]]))
+        >>> metric.compute()
+        (Array([1., 1., 1.], dtype=float32), Array([0.33333334, 0.6666667 , 0.6666667 ], dtype=float32))
+    """
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
@@ -120,7 +142,16 @@ class MultilabelRecallAtFixedPrecision(MultilabelPrecisionRecallCurve):
 
 
 class RecallAtFixedPrecision:
-    """Task façade (reference recall_at_fixed_precision.py ``__new__``)."""
+    """Task façade (reference recall_at_fixed_precision.py ``__new__``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import RecallAtFixedPrecision
+        >>> metric = RecallAtFixedPrecision(task="binary", min_precision=0.5, thresholds=4)
+        >>> metric.update(jnp.array([0.1, 0.6, 0.8, 0.4]), jnp.array([0, 1, 1, 0]))
+        >>> metric.compute()
+        (Array(1., dtype=float32), Array(0.33333334, dtype=float32))
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

@@ -44,6 +44,18 @@ class BinaryROC(BinaryPrecisionRecallCurve):
 
 
 class MulticlassROC(MulticlassPrecisionRecallCurve):
+    """Multiclass R O C.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import MulticlassROC
+        >>> metric = MulticlassROC(num_classes=3, thresholds=4)
+        >>> metric.update(jnp.array([[0.7, 0.2, 0.1], [0.2, 0.6, 0.2], [0.1, 0.2, 0.7], [0.3, 0.4, 0.3]]),
+        ...               jnp.array([0, 1, 2, 1]))
+        >>> fpr, tpr, thresholds = metric.compute()
+        >>> tpr.shape
+        (3, 4)
+    """
     is_differentiable = False
     higher_is_better = None
     full_state_update = False
@@ -54,6 +66,18 @@ class MulticlassROC(MulticlassPrecisionRecallCurve):
 
 
 class MultilabelROC(MultilabelPrecisionRecallCurve):
+    """Multilabel R O C.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import MultilabelROC
+        >>> metric = MultilabelROC(num_labels=3, thresholds=4)
+        >>> metric.update(jnp.array([[0.9, 0.1, 0.7], [0.2, 0.8, 0.3], [0.6, 0.4, 0.2], [0.1, 0.7, 0.9]]),
+        ...               jnp.array([[1, 0, 1], [0, 1, 0], [1, 0, 0], [0, 1, 1]]))
+        >>> fpr, tpr, thresholds = metric.compute()
+        >>> fpr.shape
+        (3, 4)
+    """
     is_differentiable = False
     higher_is_better = None
     full_state_update = False
@@ -67,7 +91,17 @@ class MultilabelROC(MultilabelPrecisionRecallCurve):
 
 
 class ROC:
-    """Task façade (reference roc.py)."""
+    """Task façade (reference roc.py).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import ROC
+        >>> metric = ROC(task="binary", thresholds=4)
+        >>> metric.update(jnp.array([0.1, 0.6, 0.8, 0.4]), jnp.array([0, 1, 1, 0]))
+        >>> fpr, tpr, thresholds = metric.compute()
+        >>> tpr
+        Array([0. , 0.5, 1. , 1. ], dtype=float32)
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

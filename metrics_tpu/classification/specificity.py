@@ -38,6 +38,16 @@ class BinarySpecificity(BinaryStatScores):
 
 
 class MulticlassSpecificity(MulticlassStatScores):
+    """Multiclass Specificity.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import MulticlassSpecificity
+        >>> metric = MulticlassSpecificity(num_classes=3)
+        >>> metric.update(jnp.array([0, 2, 1, 2]), jnp.array([0, 1, 1, 2]))
+        >>> metric.compute()
+        Array(0.88888896, dtype=float32)
+    """
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
@@ -50,6 +60,17 @@ class MulticlassSpecificity(MulticlassStatScores):
 
 
 class MultilabelSpecificity(MultilabelStatScores):
+    """Multilabel Specificity.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import MultilabelSpecificity
+        >>> metric = MultilabelSpecificity(num_labels=3)
+        >>> metric.update(jnp.array([[1, 0, 1], [0, 1, 0], [1, 1, 0], [0, 0, 1]]),
+        ...               jnp.array([[1, 0, 0], [0, 1, 0], [1, 0, 0], [0, 1, 1]]))
+        >>> metric.compute()
+        Array(0.7222222, dtype=float32)
+    """
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
@@ -62,7 +83,16 @@ class MultilabelSpecificity(MultilabelStatScores):
 
 
 class Specificity:
-    """Task façade (reference specificity.py)."""
+    """Task façade (reference specificity.py).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import Specificity
+        >>> metric = Specificity(task="multiclass", num_classes=3)
+        >>> metric.update(jnp.array([0, 2, 1, 2]), jnp.array([0, 1, 1, 2]))
+        >>> metric.compute()
+        Array(0.875, dtype=float32)
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

@@ -68,6 +68,17 @@ class BinarySpecificityAtSensitivity(BinaryPrecisionRecallCurve):
 
 
 class MulticlassSpecificityAtSensitivity(MulticlassPrecisionRecallCurve):
+    """Multiclass Specificity At Sensitivity.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import MulticlassSpecificityAtSensitivity
+        >>> metric = MulticlassSpecificityAtSensitivity(num_classes=3, min_sensitivity=0.5, thresholds=4)
+        >>> metric.update(jnp.array([[0.7, 0.2, 0.1], [0.2, 0.6, 0.2], [0.1, 0.2, 0.7], [0.3, 0.4, 0.3]]),
+        ...               jnp.array([0, 1, 2, 1]))
+        >>> metric.compute()
+        (Array([1., 1., 1.], dtype=float32), Array([0.6666667 , 0.33333334, 0.6666667 ], dtype=float32))
+    """
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
@@ -93,6 +104,17 @@ class MulticlassSpecificityAtSensitivity(MulticlassPrecisionRecallCurve):
 
 
 class MultilabelSpecificityAtSensitivity(MultilabelPrecisionRecallCurve):
+    """Multilabel Specificity At Sensitivity.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import MultilabelSpecificityAtSensitivity
+        >>> metric = MultilabelSpecificityAtSensitivity(num_labels=3, min_sensitivity=0.5, thresholds=4)
+        >>> metric.update(jnp.array([[0.9, 0.1, 0.7], [0.2, 0.8, 0.3], [0.6, 0.4, 0.2], [0.1, 0.7, 0.9]]),
+        ...               jnp.array([[1, 0, 1], [0, 1, 0], [1, 0, 0], [0, 1, 1]]))
+        >>> metric.compute()
+        (Array([1., 1., 1.], dtype=float32), Array([0.6666667, 0.6666667, 0.6666667], dtype=float32))
+    """
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
@@ -119,7 +141,16 @@ class MultilabelSpecificityAtSensitivity(MultilabelPrecisionRecallCurve):
 
 
 class SpecificityAtSensitivity:
-    """Task façade (reference specificity_at_sensitivity.py ``__new__``)."""
+    """Task façade (reference specificity_at_sensitivity.py ``__new__``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import SpecificityAtSensitivity
+        >>> metric = SpecificityAtSensitivity(task="binary", min_sensitivity=0.5, thresholds=4)
+        >>> metric.update(jnp.array([0.1, 0.6, 0.8, 0.4]), jnp.array([0, 1, 1, 0]))
+        >>> metric.compute()
+        (Array(1., dtype=float32), Array(0.6666667, dtype=float32))
+    """
 
     def __new__(  # type: ignore[misc]
         cls,

@@ -129,7 +129,18 @@ class BinaryStatScores(_AbstractStatScores):
 
 
 class MulticlassStatScores(_AbstractStatScores):
-    """Reference classification/stat_scores.py:195-321."""
+    """Reference classification/stat_scores.py:195-321.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import MulticlassStatScores
+        >>> metric = MulticlassStatScores(num_classes=3)
+        >>> metric.update(jnp.array([0, 2, 1, 2]), jnp.array([0, 1, 1, 2]))
+        >>> metric.compute()
+        Array([[1, 0, 3, 0, 1],
+               [1, 0, 2, 1, 2],
+               [1, 1, 2, 0, 1]], dtype=int32)
+    """
 
     is_differentiable: bool = False
     higher_is_better: Optional[bool] = None
@@ -175,7 +186,19 @@ class MulticlassStatScores(_AbstractStatScores):
 
 
 class MultilabelStatScores(_AbstractStatScores):
-    """Reference classification/stat_scores.py:324-455."""
+    """Reference classification/stat_scores.py:324-455.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import MultilabelStatScores
+        >>> metric = MultilabelStatScores(num_labels=3)
+        >>> metric.update(jnp.array([[1, 0, 1], [0, 1, 0], [1, 1, 0], [0, 0, 1]]),
+        ...               jnp.array([[1, 0, 0], [0, 1, 0], [1, 0, 0], [0, 1, 1]]))
+        >>> metric.compute()
+        Array([[2, 0, 2, 0, 2],
+               [1, 1, 1, 1, 2],
+               [1, 1, 2, 0, 1]], dtype=int32)
+    """
 
     is_differentiable: bool = False
     higher_is_better: Optional[bool] = None
@@ -218,6 +241,14 @@ class StatScores:
     """Task-dispatch façade — ``__new__`` returns the task-specific metric.
 
     Reference classification/stat_scores.py:485-513.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import StatScores
+        >>> metric = StatScores(task="multiclass", num_classes=3)
+        >>> metric.update(jnp.array([0, 2, 1, 2]), jnp.array([0, 1, 1, 2]))
+        >>> metric.compute()
+        Array([3, 1, 7, 1, 4], dtype=int32)
     """
 
     def __new__(  # type: ignore[misc]

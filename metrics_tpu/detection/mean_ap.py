@@ -121,6 +121,17 @@ class MeanAveragePrecision(Metric):
     Returned dict keys: map, map_50, map_75, map_small, map_medium, map_large,
     mar_{k} per max-detection threshold, mar_small/medium/large, map_per_class,
     mar_{last}_per_class, classes.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.detection import MeanAveragePrecision
+        >>> preds = [dict(boxes=jnp.array([[10.0, 10.0, 50.0, 50.0]]), scores=jnp.array([0.9]), labels=jnp.array([0]))]
+        >>> target = [dict(boxes=jnp.array([[12.0, 10.0, 52.0, 50.0]]), labels=jnp.array([0]))]
+        >>> metric = MeanAveragePrecision()
+        >>> metric.update(preds, target)
+        >>> result = metric.compute()
+        >>> round(float(result['map_50']), 4)
+        1.0
     """
 
     is_differentiable = False

@@ -20,6 +20,19 @@ from metrics_tpu.utils.data import dim_zero_cat
 
 
 class SpectralDistortionIndex(Metric):
+    """Spectral Distortion Index.
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> key1, key2 = jax.random.split(jax.random.PRNGKey(0))
+        >>> preds = jax.random.uniform(key1, (2, 3, 16, 16))
+        >>> target = preds * 0.75 + jax.random.uniform(key2, (2, 3, 16, 16)) * 0.25
+        >>> from metrics_tpu.image import SpectralDistortionIndex
+        >>> metric = SpectralDistortionIndex()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.04102587, dtype=float32)
+    """
     is_differentiable = True
     higher_is_better = False
     full_state_update = False

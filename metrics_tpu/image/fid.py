@@ -103,6 +103,19 @@ def _resolve_feature_extractor(feature: Union[int, str, Callable]) -> tuple:
 
 
 class FrechetInceptionDistance(Metric):
+    """Frechet Inception Distance.
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from metrics_tpu.image import FrechetInceptionDistance
+        >>> flatten8 = lambda imgs: imgs.reshape(imgs.shape[0], -1)[:, :8].astype(jnp.float32)
+        >>> fid = FrechetInceptionDistance(feature=flatten8, num_features=8)  # tiny extractor for the example
+        >>> key1, key2 = jax.random.split(jax.random.PRNGKey(0))
+        >>> fid.update(jax.random.uniform(key1, (8, 3, 8, 8)), real=True)
+        >>> fid.update(jax.random.uniform(key2, (8, 3, 8, 8)), real=False)
+        >>> fid.compute()
+        Array(0.94201267, dtype=float32)
+    """
     is_differentiable = False
     higher_is_better = False
     full_state_update = False

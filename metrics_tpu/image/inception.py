@@ -19,6 +19,18 @@ from metrics_tpu.utils.data import dim_zero_cat
 
 
 class InceptionScore(Metric):
+    """Inception Score.
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from metrics_tpu.image import InceptionScore
+        >>> logits16 = lambda imgs: imgs.reshape(imgs.shape[0], -1)[:, :16].astype(jnp.float32)
+        >>> metric = InceptionScore(feature=logits16, splits=2)
+        >>> metric.update(jax.random.uniform(jax.random.PRNGKey(0), (8, 3, 8, 8)))
+        >>> score_mean, score_std = metric.compute()
+        >>> bool(score_mean > 0)
+        True
+    """
     is_differentiable = False
     higher_is_better = True
     full_state_update = False

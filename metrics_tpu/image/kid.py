@@ -43,6 +43,20 @@ def poly_mmd(f_real: Array, f_fake: Array, degree: int = 3, gamma: Optional[floa
 
 
 class KernelInceptionDistance(Metric):
+    """Kernel Inception Distance.
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from metrics_tpu.image import KernelInceptionDistance
+        >>> flatten8 = lambda imgs: imgs.reshape(imgs.shape[0], -1)[:, :8].astype(jnp.float32)
+        >>> kid = KernelInceptionDistance(feature=flatten8, subsets=2, subset_size=4)
+        >>> key1, key2 = jax.random.split(jax.random.PRNGKey(0))
+        >>> kid.update(jax.random.uniform(key1, (8, 3, 8, 8)), real=True)
+        >>> kid.update(jax.random.uniform(key2, (8, 3, 8, 8)), real=False)
+        >>> kid_mean, kid_std = kid.compute()
+        >>> bool(jnp.isfinite(kid_mean))
+        True
+    """
     is_differentiable = False
     higher_is_better = False
     full_state_update = False

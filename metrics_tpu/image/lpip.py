@@ -22,6 +22,19 @@ from metrics_tpu.utils.imports import _LPIPS_AVAILABLE
 
 
 class LearnedPerceptualImagePatchSimilarity(Metric):
+    """Learned Perceptual Image Patch Similarity over a JAX feature net.
+
+    Example (requires converted LPIPS weights on disk; not executed offline):
+        >>> import jax
+        >>> from metrics_tpu.image import LearnedPerceptualImagePatchSimilarity
+        >>> metric = LearnedPerceptualImagePatchSimilarity(net_type="alex")  # doctest: +SKIP
+        >>> img1 = jax.random.uniform(jax.random.PRNGKey(0), (2, 3, 64, 64)) * 2 - 1  # doctest: +SKIP
+        >>> img2 = jax.random.uniform(jax.random.PRNGKey(1), (2, 3, 64, 64)) * 2 - 1  # doctest: +SKIP
+        >>> metric.update(img1, img2)  # doctest: +SKIP
+        >>> metric.compute()  # doctest: +SKIP
+        Array(0.3..., dtype=float32)
+    """
+
     is_differentiable = True
     higher_is_better = False
     full_state_update = False

@@ -19,6 +19,19 @@ from metrics_tpu.utils.distributed import reduce
 
 
 class SpectralAngleMapper(Metric):
+    """Spectral Angle Mapper.
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> key1, key2 = jax.random.split(jax.random.PRNGKey(0))
+        >>> preds = jax.random.uniform(key1, (2, 3, 16, 16))
+        >>> target = preds * 0.75 + jax.random.uniform(key2, (2, 3, 16, 16)) * 0.25
+        >>> from metrics_tpu.image import SpectralAngleMapper
+        >>> metric = SpectralAngleMapper()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.15643196, dtype=float32)
+    """
     is_differentiable = True
     higher_is_better = False
     full_state_update = False

@@ -20,6 +20,19 @@ from metrics_tpu.utils.distributed import reduce
 
 
 class UniversalImageQualityIndex(Metric):
+    """Universal Image Quality Index.
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> key1, key2 = jax.random.split(jax.random.PRNGKey(0))
+        >>> preds = jax.random.uniform(key1, (2, 3, 16, 16))
+        >>> target = preds * 0.75 + jax.random.uniform(key2, (2, 3, 16, 16)) * 0.25
+        >>> from metrics_tpu.image import UniversalImageQualityIndex
+        >>> metric = UniversalImageQualityIndex()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.9225344, dtype=float32)
+    """
     is_differentiable = True
     higher_is_better = True
     full_state_update = False

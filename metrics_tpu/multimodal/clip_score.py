@@ -14,6 +14,15 @@ from metrics_tpu.metric import Metric
 class CLIPScore(Metric):
     """Streaming CLIPScore (reference multimodal/clip_score.py:29-116).
 
+    Example (requires the `transformers` FlaxCLIPModel; not executed offline):
+        >>> import jax
+        >>> from metrics_tpu.multimodal import CLIPScore
+        >>> metric = CLIPScore()  # doctest: +SKIP
+        >>> images = jax.random.uniform(jax.random.PRNGKey(0), (2, 3, 224, 224))  # doctest: +SKIP
+        >>> metric.update(images, ["a photo of a cat", "a photo of a dog"])  # doctest: +SKIP
+        >>> metric.compute()  # doctest: +SKIP
+        Array(19..., dtype=float32)
+
     Two psum-able scalar states (score sum + sample count); the CLIP model runs
     inside ``update``. Pass ``model``/``processor`` to use a local Flax model.
     """

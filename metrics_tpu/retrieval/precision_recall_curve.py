@@ -41,7 +41,18 @@ def _retrieval_recall_at_fixed_precision(
 
 
 class RetrievalPrecisionRecallCurve(RetrievalMetric):
-    """Averaged (over queries) precision@k / recall@k curve for k = 1..max_k."""
+    """Averaged (over queries) precision@k / recall@k curve for k = 1..max_k.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.retrieval import RetrievalPrecisionRecallCurve
+        >>> metric = RetrievalPrecisionRecallCurve(max_k=3)
+        >>> metric.update(jnp.array([0.9, 0.2, 0.7, 0.4]), jnp.array([1, 0, 1, 1]),
+        ...               indexes=jnp.array([0, 0, 1, 1]))
+        >>> precision, recall, top_k = metric.compute()
+        >>> top_k
+        Array([1, 2, 3], dtype=int32)
+    """
 
     higher_is_better = True
 
@@ -112,7 +123,18 @@ class RetrievalPrecisionRecallCurve(RetrievalMetric):
 
 
 class RetrievalRecallAtFixedPrecision(RetrievalPrecisionRecallCurve):
-    """Highest recall@k whose precision@k clears ``min_precision``."""
+    """Highest recall@k whose precision@k clears ``min_precision``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.retrieval import RetrievalRecallAtFixedPrecision
+        >>> metric = RetrievalRecallAtFixedPrecision(min_precision=0.5, max_k=3)
+        >>> metric.update(jnp.array([0.9, 0.2, 0.7, 0.4]), jnp.array([1, 0, 1, 1]),
+        ...               indexes=jnp.array([0, 0, 1, 1]))
+        >>> max_recall, best_k = metric.compute()
+        >>> (round(float(max_recall), 4), int(best_k))
+        (1.0, 3)
+    """
 
     def __init__(
         self,

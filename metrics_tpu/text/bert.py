@@ -22,6 +22,13 @@ def _preprocess(text: List[str], tokenizer: Any, max_length: int):
 class BERTScore(Metric):
     """Streaming BERTScore (reference text/bert.py:42-225).
 
+    Example (requires the `transformers` flax models; not executed offline):
+        >>> from metrics_tpu.text import BERTScore
+        >>> metric = BERTScore(model_name_or_path="roberta-large")  # doctest: +SKIP
+        >>> metric.update(["the cat sat"], ["a cat sat"])  # doctest: +SKIP
+        >>> {k: round(float(v), 3) for k, v in metric.compute().items()}  # doctest: +SKIP
+        {'precision': 0.99..., 'recall': 0.99..., 'f1': 0.99...}
+
     Tokenized sentences accumulate as ragged "cat" states; the heavy embedding
     model runs once at ``compute`` (reference design — BASELINE "large embedding
     states" scenario accumulates tokens, not embeddings).

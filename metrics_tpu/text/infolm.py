@@ -36,6 +36,13 @@ class InfoLM(Metric):
     Args mirror the reference class (text/infolm.py:107-128); ``model`` /
     ``user_tokenizer`` additionally allow injecting a Flax MLM + tokenizer pair so no
     pretrained download is needed.
+
+    Example (requires the `transformers` flax models; not executed offline):
+        >>> from metrics_tpu.text import InfoLM
+        >>> metric = InfoLM(model_name_or_path="google/bert_uncased_L-2_H-128_A-2")  # doctest: +SKIP
+        >>> metric.update(["he read the book"], ["he reads the book"])  # doctest: +SKIP
+        >>> metric.compute()  # doctest: +SKIP
+        Array(-0.1..., dtype=float32)
     """
 
     is_differentiable = False

@@ -4,6 +4,7 @@ add_state validation, reset/caching, forward paths, pickling, hashing, functiona
 
 import pickle
 from copy import deepcopy
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -339,3 +340,28 @@ def test_check_forward_full_state_property(capsys):
     out = capsys.readouterr().out
     # the recommendation line is timing-dependent; the summary line is not
     assert "Output equal: True" in out
+
+
+def test_init_state_is_donation_safe():
+    """init_state() must return fresh buffers, never views of the stored
+    defaults: donating the state into a jitted step (the documented fused-step
+    pattern) would otherwise kill every later init_state() call with
+    'buffer deleted or donated'."""
+    import jax
+
+    from metrics_tpu.classification import MulticlassAccuracy
+
+    m = MulticlassAccuracy(num_classes=4, average="micro", validate_args=False)
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def step(state, p, t):
+        return m.update_state(state, p, t)
+
+    p = jnp.asarray([0, 1, 2, 3])
+    t = jnp.asarray([0, 1, 2, 2])
+    step(m.init_state(), p, t)
+    out = step(m.init_state(), p, t)  # dies if init_state aliased the defaults
+    assert float(m.compute_from(out)) == 0.75
+    # the module's own default states must also still be alive
+    m.update(p, t)
+    assert float(m.compute()) == 0.75
