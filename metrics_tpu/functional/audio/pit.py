@@ -155,5 +155,15 @@ def permutation_invariant_training(
 
 
 def pit_permutate(preds: Array, perm: Array) -> Array:
-    """Reorder ``preds`` speakers by ``perm`` (reference pit.py:167-178); jittable."""
+    """Reorder ``preds`` speakers by ``perm`` (reference pit.py:167-178); jittable.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import pit_permutate
+        >>> preds = jnp.array([[[1.0, 1.0], [2.0, 2.0]]])
+        >>> perm = jnp.array([[1, 0]])
+        >>> pit_permutate(preds, perm)
+        Array([[[2., 2.],
+                [1., 1.]]], dtype=float32)
+    """
     return jnp.take_along_axis(preds, perm.reshape(perm.shape + (1,) * (preds.ndim - 2)), axis=1)

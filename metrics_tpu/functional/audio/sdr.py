@@ -62,6 +62,16 @@ def signal_distortion_ratio(
         filter_length: length of the allowed distortion filter
         zero_mean: subtract signal means before computation
         load_diag: diagonal loading to stabilize near-singular systems
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import signal_distortion_ratio
+        >>> import jax
+        >>> key1, key2 = jax.random.split(jax.random.PRNGKey(0))
+        >>> target = jax.random.normal(key1, (400,))
+        >>> preds = target + 0.1 * jax.random.normal(key2, (400,))
+        >>> signal_distortion_ratio(preds, target, filter_length=64)
+        Array(20.753, dtype=float32)
     """
     _check_same_shape(preds, target)
     del use_cg_iter  # parity-only: direct batched solve is the TPU path

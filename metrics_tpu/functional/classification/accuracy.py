@@ -93,7 +93,14 @@ def accuracy(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
-    """Task-dispatch façade (reference accuracy.py bottom)."""
+    """Task-dispatch façade (reference accuracy.py bottom).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import accuracy
+        >>> accuracy(jnp.array([0, 2, 1, 2]), jnp.array([0, 1, 1, 2]), task="multiclass", num_classes=3)
+        Array(0.75, dtype=float32)
+    """
     task = str(task).lower()
     if task == "binary":
         return binary_accuracy(preds, target, threshold, multidim_average, ignore_index, validate_args)

@@ -211,6 +211,16 @@ def auroc(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ):
+    """Auroc.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import auroc
+        >>> preds = jnp.array([[0.7, 0.2, 0.1], [0.2, 0.6, 0.2], [0.1, 0.2, 0.7], [0.3, 0.4, 0.3]])
+        >>> target = jnp.array([0, 1, 2, 1])
+        >>> auroc(preds, target, task="multiclass", num_classes=3)
+        Array(1., dtype=float32)
+    """
     task = str(task).lower()
     if task == "binary":
         return binary_auroc(preds, target, max_fpr, thresholds, ignore_index, validate_args)

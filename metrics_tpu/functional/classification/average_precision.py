@@ -185,6 +185,16 @@ def average_precision(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ):
+    """Average precision.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import average_precision
+        >>> preds = jnp.array([[0.7, 0.2, 0.1], [0.2, 0.6, 0.2], [0.1, 0.2, 0.7], [0.3, 0.4, 0.3]])
+        >>> target = jnp.array([0, 1, 2, 1])
+        >>> average_precision(preds, target, task="multiclass", num_classes=3)
+        Array(1., dtype=float32)
+    """
     task = str(task).lower()
     if task == "binary":
         return binary_average_precision(preds, target, thresholds, ignore_index, validate_args)

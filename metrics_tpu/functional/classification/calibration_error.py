@@ -173,7 +173,16 @@ def calibration_error(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
-    """Task-dispatch façade (reference :320-…)."""
+    """Task-dispatch façade (reference :320-…).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import calibration_error
+        >>> preds = jnp.array([[0.7, 0.2, 0.1], [0.2, 0.6, 0.2], [0.1, 0.2, 0.7], [0.3, 0.4, 0.3]])
+        >>> target = jnp.array([0, 1, 2, 1])
+        >>> calibration_error(preds, target, task="multiclass", num_classes=3)
+        Array(0.4, dtype=float32)
+    """
     task = str(task).lower()
     if task == "binary":
         return binary_calibration_error(preds, target, n_bins, norm, ignore_index, validate_args)

@@ -52,6 +52,14 @@ def multiclass_cohen_kappa(preds, target, num_classes, weights=None, ignore_inde
 def cohen_kappa(
     preds, target, task, threshold=0.5, num_classes=None, weights=None, ignore_index=None, validate_args=True,
 ) -> Array:
+    """Cohen kappa.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import cohen_kappa
+        >>> cohen_kappa(jnp.array([0, 2, 1, 2]), jnp.array([0, 1, 1, 2]), task="multiclass", num_classes=3)
+        Array(0.6363636, dtype=float32)
+    """
     task = str(task).lower()
     if task == "binary":
         return binary_cohen_kappa(preds, target, threshold, weights, ignore_index, validate_args)

@@ -149,6 +149,16 @@ def confusion_matrix(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
+    """Confusion matrix.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import confusion_matrix
+        >>> confusion_matrix(jnp.array([0, 2, 1, 2]), jnp.array([0, 1, 1, 2]), task="multiclass", num_classes=3)
+        Array([[1, 0, 0],
+               [0, 1, 1],
+               [0, 0, 1]], dtype=int32)
+    """
     task = str(task).lower()
     if task == "binary":
         return binary_confusion_matrix(preds, target, threshold, ignore_index, normalize, validate_args)

@@ -167,7 +167,14 @@ def dice(
     num_classes: Optional[int] = None,
     ignore_index: Optional[int] = None,
 ) -> Array:
-    """Dice score (reference :66-…)."""
+    """Dice score (reference :66-…).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import dice
+        >>> dice(jnp.array([0, 2, 1, 2]), jnp.array([0, 1, 1, 2]))
+        Array(0.75, dtype=float32)
+    """
     allowed_average = ("micro", "macro", "weighted", "samples", "none", None)
     if average not in allowed_average:
         raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")

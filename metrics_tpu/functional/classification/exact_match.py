@@ -80,6 +80,14 @@ def exact_match(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
+    """Exact match.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import exact_match
+        >>> exact_match(jnp.array([[0, 2], [1, 1]]), jnp.array([[0, 2], [1, 0]]), task="multiclass", num_classes=3)
+        Array(0.5, dtype=float32)
+    """
     task = str(task).lower()
     if task == "multiclass":
         assert num_classes is not None

@@ -80,6 +80,14 @@ def fbeta_score(
     preds, target, task, beta=1.0, threshold=0.5, num_classes=None, num_labels=None, average="micro",
     multidim_average="global", top_k=1, ignore_index=None, validate_args=True,
 ) -> Array:
+    """Fbeta score.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import fbeta_score
+        >>> fbeta_score(jnp.array([0, 2, 1, 2]), jnp.array([0, 1, 1, 2]), task="multiclass", num_classes=3, beta=0.5)
+        Array(0.75, dtype=float32)
+    """
     task = str(task).lower()
     if task == "binary":
         return binary_fbeta_score(preds, target, beta, threshold, multidim_average, ignore_index, validate_args)
@@ -94,4 +102,12 @@ def f1_score(
     preds, target, task, threshold=0.5, num_classes=None, num_labels=None, average="micro",
     multidim_average="global", top_k=1, ignore_index=None, validate_args=True,
 ) -> Array:
+    """F1 score.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import f1_score
+        >>> f1_score(jnp.array([0, 2, 1, 2]), jnp.array([0, 1, 1, 2]), task="multiclass", num_classes=3)
+        Array(0.75, dtype=float32)
+    """
     return fbeta_score(preds, target, task, 1.0, threshold, num_classes, num_labels, average, multidim_average, top_k, ignore_index, validate_args)

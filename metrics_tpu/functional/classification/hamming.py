@@ -58,6 +58,14 @@ def hamming_distance(
     preds, target, task, threshold=0.5, num_classes=None, num_labels=None, average="micro",
     multidim_average="global", top_k=1, ignore_index=None, validate_args=True,
 ) -> Array:
+    """Hamming distance.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import hamming_distance
+        >>> hamming_distance(jnp.array([0, 2, 1, 2]), jnp.array([0, 1, 1, 2]), task="multiclass", num_classes=3)
+        Array(0.25, dtype=float32)
+    """
     task = str(task).lower()
     if task == "binary":
         return binary_hamming_distance(preds, target, threshold, multidim_average, ignore_index, validate_args)

@@ -159,7 +159,16 @@ def hinge_loss(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
-    """Task-dispatch façade (reference :249-…)."""
+    """Task-dispatch façade (reference :249-…).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import hinge_loss
+        >>> preds = jnp.array([[0.7, 0.2, 0.1], [0.2, 0.6, 0.2], [0.1, 0.2, 0.7], [0.3, 0.4, 0.3]])
+        >>> target = jnp.array([0, 1, 2, 1])
+        >>> hinge_loss(preds, target, task="multiclass", num_classes=3)
+        Array(0.625, dtype=float32)
+    """
     task = str(task).lower()
     if task == "binary":
         return binary_hinge_loss(preds, target, squared, ignore_index, validate_args)

@@ -75,6 +75,14 @@ def jaccard_index(
     preds, target, task, threshold=0.5, num_classes=None, num_labels=None, average="macro",
     ignore_index=None, validate_args=True,
 ) -> Array:
+    """Jaccard index.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import jaccard_index
+        >>> jaccard_index(jnp.array([0, 2, 1, 2]), jnp.array([0, 1, 1, 2]), task="multiclass", num_classes=3)
+        Array(0.6666667, dtype=float32)
+    """
     task = str(task).lower()
     if task == "binary":
         return binary_jaccard_index(preds, target, threshold, ignore_index, validate_args)

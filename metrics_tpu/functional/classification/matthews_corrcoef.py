@@ -65,6 +65,14 @@ def multilabel_matthews_corrcoef(preds, target, num_labels, threshold=0.5, ignor
 def matthews_corrcoef(
     preds, target, task, threshold=0.5, num_classes=None, num_labels=None, ignore_index=None, validate_args=True,
 ) -> Array:
+    """Matthews corrcoef.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import matthews_corrcoef
+        >>> matthews_corrcoef(jnp.array([0, 2, 1, 2]), jnp.array([0, 1, 1, 2]), task="multiclass", num_classes=3)
+        Array(0.7, dtype=float32)
+    """
     task = str(task).lower()
     if task == "binary":
         return binary_matthews_corrcoef(preds, target, threshold, ignore_index, validate_args)

@@ -71,6 +71,14 @@ def precision(
     preds, target, task, threshold=0.5, num_classes=None, num_labels=None, average="micro",
     multidim_average="global", top_k=1, ignore_index=None, validate_args=True,
 ) -> Array:
+    """Precision.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import precision
+        >>> precision(jnp.array([0, 2, 1, 2]), jnp.array([0, 1, 1, 2]), task="multiclass", num_classes=3)
+        Array(0.75, dtype=float32)
+    """
     task = str(task).lower()
     if task == "binary":
         return binary_precision(preds, target, threshold, multidim_average, ignore_index, validate_args)
@@ -85,6 +93,14 @@ def recall(
     preds, target, task, threshold=0.5, num_classes=None, num_labels=None, average="micro",
     multidim_average="global", top_k=1, ignore_index=None, validate_args=True,
 ) -> Array:
+    """Recall.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import recall
+        >>> recall(jnp.array([0, 2, 1, 2]), jnp.array([0, 1, 1, 2]), task="multiclass", num_classes=3)
+        Array(0.75, dtype=float32)
+    """
     task = str(task).lower()
     if task == "binary":
         return binary_recall(preds, target, threshold, multidim_average, ignore_index, validate_args)

@@ -445,6 +445,17 @@ def precision_recall_curve(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ):
+    """Precision recall curve.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import precision_recall_curve
+        >>> preds = jnp.array([0.1, 0.6, 0.8, 0.4])
+        >>> target = jnp.array([0, 1, 1, 0])
+        >>> precision, recall, thresholds = precision_recall_curve(preds, target, task="binary", thresholds=4)
+        >>> precision
+        Array([0.5      , 0.6666667, 1.       , 0.       , 1.       ], dtype=float32)
+    """
     task = str(task).lower()
     if task == "binary":
         return binary_precision_recall_curve(preds, target, thresholds, ignore_index, validate_args)

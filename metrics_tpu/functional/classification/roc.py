@@ -172,6 +172,17 @@ def roc(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ):
+    """Roc.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import roc
+        >>> preds = jnp.array([0.1, 0.6, 0.8, 0.4])
+        >>> target = jnp.array([0, 1, 1, 0])
+        >>> fpr, tpr, thresholds = roc(preds, target, task="binary", thresholds=4)
+        >>> tpr
+        Array([0. , 0.5, 1. , 1. ], dtype=float32)
+    """
     task = str(task).lower()
     if task == "binary":
         return binary_roc(preds, target, thresholds, ignore_index, validate_args)

@@ -479,7 +479,14 @@ def stat_scores(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
-    """Task-dispatch façade (reference stat_scores.py:783-…)."""
+    """Task-dispatch façade (reference stat_scores.py:783-…).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import stat_scores
+        >>> stat_scores(jnp.array([0, 2, 1, 2]), jnp.array([0, 1, 1, 2]), task="multiclass", num_classes=3)
+        Array([3, 1, 7, 1, 4], dtype=int32)
+    """
     task = str(task).lower()
     if task == "binary":
         return binary_stat_scores(preds, target, threshold, multidim_average, ignore_index, validate_args)

@@ -71,7 +71,18 @@ def spectral_distortion_index(
     p: int = 1,
     reduction: Optional[str] = "elementwise_mean",
 ) -> Array:
-    """D_lambda (reference :91-…)."""
+    """D_lambda (reference :91-…).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import spectral_distortion_index
+        >>> import jax
+        >>> key1, key2 = jax.random.split(jax.random.PRNGKey(0))
+        >>> preds = jax.random.uniform(key1, (2, 3, 32, 32))
+        >>> target = preds * 0.75 + jax.random.uniform(key2, (2, 3, 32, 32)) * 0.25
+        >>> spectral_distortion_index(preds, target)
+        Array(0.00437204, dtype=float32)
+    """
     if not isinstance(p, int) or p <= 0:
         raise ValueError(f"Expected `p` to be a positive integer. Got p: {p}.")
     preds, target = _spectral_distortion_index_update(preds, target)

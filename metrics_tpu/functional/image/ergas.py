@@ -51,6 +51,17 @@ def error_relative_global_dimensionless_synthesis(
     ratio: Union[int, float] = 4,
     reduction: Optional[str] = "elementwise_mean",
 ) -> Array:
-    """ERGAS (reference :86-…)."""
+    """ERGAS (reference :86-…).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import error_relative_global_dimensionless_synthesis
+        >>> import jax
+        >>> key1, key2 = jax.random.split(jax.random.PRNGKey(0))
+        >>> preds = jax.random.uniform(key1, (2, 3, 32, 32))
+        >>> target = preds * 0.75 + jax.random.uniform(key2, (2, 3, 32, 32)) * 0.25
+        >>> error_relative_global_dimensionless_synthesis(preds, target, ratio=4)
+        Array(81.11109, dtype=float32)
+    """
     preds, target = _ergas_update(preds, target)
     return _ergas_compute(preds, target, ratio, reduction)

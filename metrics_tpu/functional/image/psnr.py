@@ -58,7 +58,18 @@ def peak_signal_noise_ratio(
     reduction: Optional[str] = "elementwise_mean",
     dim: Optional[Union[int, Tuple[int, ...]]] = None,
 ) -> Array:
-    """PSNR (reference :90-147)."""
+    """PSNR (reference :90-147).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import peak_signal_noise_ratio
+        >>> import jax
+        >>> key1, key2 = jax.random.split(jax.random.PRNGKey(0))
+        >>> preds = jax.random.uniform(key1, (2, 3, 32, 32))
+        >>> target = preds * 0.75 + jax.random.uniform(key2, (2, 3, 32, 32)) * 0.25
+        >>> peak_signal_noise_ratio(preds, target, data_range=1.0)
+        Array(19.837864, dtype=float32)
+    """
     if dim is None and reduction != "elementwise_mean":
         rank_zero_warn(f"The `reduction={reduction}` will not have any effect when `dim` is None.")
     preds = jnp.asarray(preds)

@@ -40,6 +40,17 @@ def _sam_compute(preds: Array, target: Array, reduction: Optional[str] = "elemen
 
 
 def spectral_angle_mapper(preds: Array, target: Array, reduction: Optional[str] = "elementwise_mean") -> Array:
-    """Per-pixel spectral angle between channel vectors, reduced (reference :84-…)."""
+    """Per-pixel spectral angle between channel vectors, reduced (reference :84-…).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import spectral_angle_mapper
+        >>> import jax
+        >>> key1, key2 = jax.random.split(jax.random.PRNGKey(0))
+        >>> preds = jax.random.uniform(key1, (2, 3, 32, 32))
+        >>> target = preds * 0.75 + jax.random.uniform(key2, (2, 3, 32, 32)) * 0.25
+        >>> spectral_angle_mapper(preds, target)
+        Array(0.14725654, dtype=float32)
+    """
     preds, target = _sam_update(preds, target)
     return _sam_compute(preds, target, reduction)

@@ -141,7 +141,18 @@ def structural_similarity_index_measure(
     return_full_image: bool = False,
     return_contrast_sensitivity: bool = False,
 ):
-    """SSIM (reference :202-…)."""
+    """SSIM (reference :202-…).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import structural_similarity_index_measure
+        >>> import jax
+        >>> key1, key2 = jax.random.split(jax.random.PRNGKey(0))
+        >>> preds = jax.random.uniform(key1, (2, 3, 32, 32))
+        >>> target = preds * 0.75 + jax.random.uniform(key2, (2, 3, 32, 32)) * 0.25
+        >>> structural_similarity_index_measure(preds, target, data_range=1.0)
+        Array(0.92449266, dtype=float32)
+    """
     preds, target = _ssim_check_inputs(preds, target)
     out = _ssim_update(
         preds, target, gaussian_kernel, sigma, kernel_size, data_range, k1, k2,
@@ -232,7 +243,18 @@ def multiscale_structural_similarity_index_measure(
     betas: Tuple[float, ...] = (0.0448, 0.2856, 0.3001, 0.2363, 0.1333),
     normalize: Optional[str] = "relu",
 ) -> Array:
-    """MS-SSIM (reference :433-…)."""
+    """MS-SSIM (reference :433-…).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import multiscale_structural_similarity_index_measure
+        >>> import jax
+        >>> key1, key2 = jax.random.split(jax.random.PRNGKey(0))
+        >>> preds = jax.random.uniform(key1, (2, 3, 192, 192))
+        >>> target = preds * 0.75 + jax.random.uniform(key2, (2, 3, 192, 192)) * 0.25
+        >>> multiscale_structural_similarity_index_measure(preds, target, data_range=1.0)
+        Array(0.9372308, dtype=float32)
+    """
     if not isinstance(betas, tuple) or not all(isinstance(b, float) for b in betas):
         raise ValueError("Argument `betas` is expected to be of a type tuple of floats.")
     if normalize is not None and normalize not in ("relu", "simple"):

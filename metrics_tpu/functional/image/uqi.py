@@ -86,6 +86,17 @@ def universal_image_quality_index(
     reduction: Optional[str] = "elementwise_mean",
     data_range: Optional[float] = None,
 ) -> Array:
-    """UQI (reference :126-…)."""
+    """UQI (reference :126-…).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import universal_image_quality_index
+        >>> import jax
+        >>> key1, key2 = jax.random.split(jax.random.PRNGKey(0))
+        >>> preds = jax.random.uniform(key1, (2, 3, 32, 32))
+        >>> target = preds * 0.75 + jax.random.uniform(key2, (2, 3, 32, 32)) * 0.25
+        >>> universal_image_quality_index(preds, target)
+        Array(0.92395675, dtype=float32)
+    """
     preds, target = _uqi_update(preds, target)
     return _uqi_compute(preds, target, kernel_size, sigma, reduction, data_range)

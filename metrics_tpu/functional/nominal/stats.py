@@ -77,7 +77,16 @@ def cramers_v(
     nan_strategy: str = "replace",
     nan_replace_value: Optional[float] = 0.0,
 ) -> Array:
-    """Cramér's V (reference functional/nominal/cramers.py)."""
+    """Cramér's V (reference functional/nominal/cramers.py).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import cramers_v
+        >>> preds = jnp.array([0, 1, 2, 1, 0, 2, 1, 2])
+        >>> target = jnp.array([0, 1, 2, 2, 0, 1, 1, 2])
+        >>> cramers_v(preds, target)
+        Array(0.6146363, dtype=float32)
+    """
     _nominal_input_validation(nan_strategy, nan_replace_value)
     preds, target = _format_nominal(preds, target, nan_strategy, nan_replace_value)
     nc = _num_classes_of(preds, target)
@@ -99,7 +108,16 @@ def pearsons_contingency_coefficient(
     nan_strategy: str = "replace",
     nan_replace_value: Optional[float] = 0.0,
 ) -> Array:
-    """Pearson's contingency coefficient (reference functional/nominal/pearson.py)."""
+    """Pearson's contingency coefficient (reference functional/nominal/pearson.py).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import pearsons_contingency_coefficient
+        >>> preds = jnp.array([0, 1, 2, 1, 0, 2, 1, 2])
+        >>> target = jnp.array([0, 1, 2, 2, 0, 1, 1, 2])
+        >>> pearsons_contingency_coefficient(preds, target)
+        Array(0.72547626, dtype=float32)
+    """
     _nominal_input_validation(nan_strategy, nan_replace_value)
     preds, target = _format_nominal(preds, target, nan_strategy, nan_replace_value)
     nc = _num_classes_of(preds, target)
@@ -131,7 +149,16 @@ def tschuprows_t(
     nan_strategy: str = "replace",
     nan_replace_value: Optional[float] = 0.0,
 ) -> Array:
-    """Tschuprow's T (reference functional/nominal/tschuprows.py)."""
+    """Tschuprow's T (reference functional/nominal/tschuprows.py).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import tschuprows_t
+        >>> preds = jnp.array([0, 1, 2, 1, 0, 2, 1, 2])
+        >>> target = jnp.array([0, 1, 2, 2, 0, 1, 1, 2])
+        >>> tschuprows_t(preds, target)
+        Array(0.6146363, dtype=float32)
+    """
     _nominal_input_validation(nan_strategy, nan_replace_value)
     preds, target = _format_nominal(preds, target, nan_strategy, nan_replace_value)
     nc = _num_classes_of(preds, target)
@@ -165,7 +192,16 @@ def theils_u(
     nan_strategy: str = "replace",
     nan_replace_value: Optional[float] = 0.0,
 ) -> Array:
-    """Theil's U (reference functional/nominal/theils_u.py)."""
+    """Theil's U (reference functional/nominal/theils_u.py).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import theils_u
+        >>> preds = jnp.array([0, 1, 2, 1, 0, 2, 1, 2])
+        >>> target = jnp.array([0, 1, 2, 2, 0, 1, 1, 2])
+        >>> theils_u(preds, target)
+        Array(0.558873, dtype=float32)
+    """
     _nominal_input_validation(nan_strategy, nan_replace_value)
     preds, target = _format_nominal(preds, target, nan_strategy, nan_replace_value)
     nc = _num_classes_of(preds, target)
@@ -187,6 +223,16 @@ def _matrix(fn, matrix: Array, **kwargs) -> Array:
 
 
 def cramers_v_matrix(matrix: Array, bias_correction: bool = True, nan_strategy: str = "replace", nan_replace_value: Optional[float] = 0.0) -> Array:
+    """Cramers v matrix.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import cramers_v_matrix
+        >>> matrix = jnp.array([[0, 1], [1, 0], [2, 1], [1, 2], [0, 0], [2, 2]])
+        >>> cramers_v_matrix(matrix)
+        Array([[1., 0.],
+               [0., 1.]], dtype=float32)
+    """
     out = jnp.ones((matrix.shape[1], matrix.shape[1]), dtype=jnp.float32)
     for i in range(matrix.shape[1]):
         for j in range(i + 1, matrix.shape[1]):
@@ -196,6 +242,16 @@ def cramers_v_matrix(matrix: Array, bias_correction: bool = True, nan_strategy: 
 
 
 def pearsons_contingency_coefficient_matrix(matrix: Array, nan_strategy: str = "replace", nan_replace_value: Optional[float] = 0.0) -> Array:
+    """Pearsons contingency coefficient matrix.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import pearsons_contingency_coefficient_matrix
+        >>> matrix = jnp.array([[0, 1], [1, 0], [2, 1], [1, 2], [0, 0], [2, 2]])
+        >>> pearsons_contingency_coefficient_matrix(matrix)
+        Array([[1.        , 0.57735026],
+               [0.57735026, 1.        ]], dtype=float32)
+    """
     out = jnp.ones((matrix.shape[1], matrix.shape[1]), dtype=jnp.float32)
     for i in range(matrix.shape[1]):
         for j in range(i + 1, matrix.shape[1]):
@@ -205,6 +261,16 @@ def pearsons_contingency_coefficient_matrix(matrix: Array, nan_strategy: str = "
 
 
 def tschuprows_t_matrix(matrix: Array, bias_correction: bool = True, nan_strategy: str = "replace", nan_replace_value: Optional[float] = 0.0) -> Array:
+    """Tschuprows t matrix.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import tschuprows_t_matrix
+        >>> matrix = jnp.array([[0, 1], [1, 0], [2, 1], [1, 2], [0, 0], [2, 2]])
+        >>> tschuprows_t_matrix(matrix)
+        Array([[1., 0.],
+               [0., 1.]], dtype=float32)
+    """
     out = jnp.ones((matrix.shape[1], matrix.shape[1]), dtype=jnp.float32)
     for i in range(matrix.shape[1]):
         for j in range(i + 1, matrix.shape[1]):
@@ -214,4 +280,14 @@ def tschuprows_t_matrix(matrix: Array, bias_correction: bool = True, nan_strateg
 
 
 def theils_u_matrix(matrix: Array, nan_strategy: str = "replace", nan_replace_value: Optional[float] = 0.0) -> Array:
+    """Theils u matrix.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import theils_u_matrix
+        >>> matrix = jnp.array([[0, 1], [1, 0], [2, 1], [1, 2], [0, 0], [2, 2]])
+        >>> theils_u_matrix(matrix)
+        Array([[1.        , 0.36907026],
+               [0.36907026, 1.        ]], dtype=float32)
+    """
     return _matrix(theils_u, matrix, nan_strategy=nan_strategy, nan_replace_value=nan_replace_value)

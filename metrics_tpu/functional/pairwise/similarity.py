@@ -56,7 +56,17 @@ def _zero_diag(mat: Array, zero_diagonal: bool) -> Array:
 def pairwise_cosine_similarity(
     x: Array, y: Optional[Array] = None, reduction: Optional[str] = None, zero_diagonal: Optional[bool] = None
 ) -> Array:
-    """Cosine similarity matrix (reference pairwise/cosine.py)."""
+    """Cosine similarity matrix (reference pairwise/cosine.py).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import pairwise_cosine_similarity
+        >>> x = jnp.array([[1.0, 2.0], [3.0, 4.0]])
+        >>> y = jnp.array([[0.0, 1.0], [2.0, 2.0]])
+        >>> pairwise_cosine_similarity(x, y)
+        Array([[0.8944272 , 0.94868326],
+               [0.8       , 0.9899495 ]], dtype=float32)
+    """
     x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
     norm_x = x / jnp.clip(jnp.linalg.norm(x, axis=1, keepdims=True), min=1e-12)
     norm_y = y / jnp.clip(jnp.linalg.norm(y, axis=1, keepdims=True), min=1e-12)
@@ -75,6 +85,15 @@ def pairwise_euclidean_distance(
     (sklearn semantics), because the one-matmul expansion loses that exactness
     to f32 cancellation at large magnitudes. Pass ``y=x`` explicitly to see the
     raw expansion including its diagonal noise.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import pairwise_euclidean_distance
+        >>> x = jnp.array([[1.0, 2.0], [3.0, 4.0]])
+        >>> y = jnp.array([[0.0, 1.0], [2.0, 2.0]])
+        >>> pairwise_euclidean_distance(x, y)
+        Array([[1.4142135, 1.       ],
+               [4.2426405, 2.236068 ]], dtype=float32)
     """
     self_mode = y is None
     x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
@@ -91,7 +110,17 @@ def pairwise_euclidean_distance(
 def pairwise_manhattan_distance(
     x: Array, y: Optional[Array] = None, reduction: Optional[str] = None, zero_diagonal: Optional[bool] = None
 ) -> Array:
-    """Manhattan (L1) distance matrix (reference pairwise/manhattan.py)."""
+    """Manhattan (L1) distance matrix (reference pairwise/manhattan.py).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import pairwise_manhattan_distance
+        >>> x = jnp.array([[1.0, 2.0], [3.0, 4.0]])
+        >>> y = jnp.array([[0.0, 1.0], [2.0, 2.0]])
+        >>> pairwise_manhattan_distance(x, y)
+        Array([[2., 1.],
+               [6., 3.]], dtype=float32)
+    """
     x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
     distance = jnp.sum(jnp.abs(x[:, None, :] - y[None, :, :]), axis=-1)
     distance = _zero_diag(distance, zero_diagonal)
@@ -101,7 +130,17 @@ def pairwise_manhattan_distance(
 def pairwise_linear_similarity(
     x: Array, y: Optional[Array] = None, reduction: Optional[str] = None, zero_diagonal: Optional[bool] = None
 ) -> Array:
-    """Linear (dot-product) similarity matrix (reference pairwise/linear.py)."""
+    """Linear (dot-product) similarity matrix (reference pairwise/linear.py).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import pairwise_linear_similarity
+        >>> x = jnp.array([[1.0, 2.0], [3.0, 4.0]])
+        >>> y = jnp.array([[0.0, 1.0], [2.0, 2.0]])
+        >>> pairwise_linear_similarity(x, y)
+        Array([[ 2.,  6.],
+               [ 4., 14.]], dtype=float32)
+    """
     x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
     distance = _safe_matmul(x, y.T)
     distance = _zero_diag(distance, zero_diagonal)

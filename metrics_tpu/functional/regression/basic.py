@@ -29,7 +29,16 @@ def _mean_absolute_error_compute(sum_abs_error: Array, num_obs: Array) -> Array:
 
 
 def mean_absolute_error(preds: Array, target: Array) -> Array:
-    """MAE (reference functional/regression/mae.py)."""
+    """MAE (reference functional/regression/mae.py).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import mean_absolute_error
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> mean_absolute_error(preds, target)
+        Array(0.5, dtype=float32)
+    """
     sum_abs_error, num_obs = _mean_absolute_error_update(preds, target)
     return _mean_absolute_error_compute(sum_abs_error, num_obs)
 
@@ -50,7 +59,16 @@ def _mean_squared_error_compute(sum_squared_error: Array, num_obs: Array, square
 
 
 def mean_squared_error(preds: Array, target: Array, squared: bool = True, num_outputs: int = 1) -> Array:
-    """MSE / RMSE (reference functional/regression/mse.py)."""
+    """MSE / RMSE (reference functional/regression/mse.py).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import mean_squared_error
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> mean_squared_error(preds, target)
+        Array(0.375, dtype=float32)
+    """
     sum_squared_error, num_obs = _mean_squared_error_update(preds, target, num_outputs)
     return _mean_squared_error_compute(sum_squared_error, num_obs, squared)
 
@@ -66,7 +84,16 @@ def _mean_absolute_percentage_error_compute(sum_abs_per_error: Array, num_obs: A
 
 
 def mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
-    """MAPE (reference functional/regression/mape.py)."""
+    """MAPE (reference functional/regression/mape.py).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import mean_absolute_percentage_error
+        >>> preds = jnp.array([0.5, 1.2, 2.0, 4.0])
+        >>> target = jnp.array([0.6, 1.0, 2.5, 3.5])
+        >>> mean_absolute_percentage_error(preds, target)
+        Array(0.17738096, dtype=float32)
+    """
     s, n = _mean_absolute_percentage_error_update(preds, target)
     return _mean_absolute_percentage_error_compute(s, n)
 
@@ -80,7 +107,16 @@ def _symmetric_mean_absolute_percentage_error_update(
 
 
 def symmetric_mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
-    """SMAPE (reference functional/regression/symmetric_mape.py)."""
+    """SMAPE (reference functional/regression/symmetric_mape.py).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import symmetric_mean_absolute_percentage_error
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> symmetric_mean_absolute_percentage_error(preds, target)
+        Array(0.5787879, dtype=float32)
+    """
     s, n = _symmetric_mean_absolute_percentage_error_update(preds, target)
     return s / n
 
@@ -97,7 +133,16 @@ def _weighted_mean_absolute_percentage_error_compute(sum_abs_error: Array, sum_s
 
 
 def weighted_mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
-    """WMAPE (reference functional/regression/wmape.py)."""
+    """WMAPE (reference functional/regression/wmape.py).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import weighted_mean_absolute_percentage_error
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> weighted_mean_absolute_percentage_error(preds, target)
+        Array(0.16, dtype=float32)
+    """
     s, scale = _weighted_mean_absolute_percentage_error_update(preds, target)
     return _weighted_mean_absolute_percentage_error_compute(s, scale)
 
@@ -109,7 +154,16 @@ def _mean_squared_log_error_update(preds: Array, target: Array) -> Tuple[Array, 
 
 
 def mean_squared_log_error(preds: Array, target: Array) -> Array:
-    """MSLE (reference functional/regression/log_mse.py)."""
+    """MSLE (reference functional/regression/log_mse.py).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import mean_squared_log_error
+        >>> preds = jnp.array([0.5, 1.2, 2.0, 4.0])
+        >>> target = jnp.array([0.6, 1.0, 2.5, 3.5])
+        >>> mean_squared_log_error(preds, target)
+        Array(0.01202814, dtype=float32)
+    """
     s, n = _mean_squared_log_error_update(preds, target)
     return s / n
 
@@ -138,6 +192,15 @@ def _log_cosh_error_compute(sum_log_cosh_error: Array, num_obs: Array) -> Array:
 
 
 def log_cosh_error(preds: Array, target: Array) -> Array:
-    """LogCosh error (reference functional/regression/log_cosh.py)."""
+    """LogCosh error (reference functional/regression/log_cosh.py).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import log_cosh_error
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> log_cosh_error(preds, target)
+        Array(0.16850246, dtype=float32)
+    """
     s, n = _log_cosh_error_update(preds, target, num_outputs=1)
     return _log_cosh_error_compute(s, n)

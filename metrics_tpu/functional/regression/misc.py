@@ -43,7 +43,16 @@ def _cosine_similarity_compute(preds: Array, target: Array, reduction: Optional[
 
 
 def cosine_similarity(preds: Array, target: Array, reduction: Optional[str] = "sum") -> Array:
-    """Cosine similarity (reference functional/regression/cosine_similarity.py)."""
+    """Cosine similarity (reference functional/regression/cosine_similarity.py).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import cosine_similarity
+        >>> preds = jnp.array([[1.0, 2.0], [3.0, 4.0]])
+        >>> target = jnp.array([[1.0, 1.0], [3.0, 5.0]])
+        >>> cosine_similarity(preds, target, reduction="mean")
+        Array(0.97168756, dtype=float32)
+    """
     preds, target = _cosine_similarity_update(preds, target)
     return _cosine_similarity_compute(preds, target, reduction)
 
@@ -78,7 +87,16 @@ def _kld_compute(measures: Array, total: Array, reduction: Optional[str] = "mean
 
 
 def kl_divergence(p: Array, q: Array, log_prob: bool = False, reduction: Optional[str] = "mean") -> Array:
-    """KL divergence (reference functional/regression/kl_divergence.py)."""
+    """KL divergence (reference functional/regression/kl_divergence.py).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import kl_divergence
+        >>> p = jnp.array([[0.4, 0.6], [0.5, 0.5]])
+        >>> q = jnp.array([[0.3, 0.7], [0.5, 0.5]])
+        >>> kl_divergence(p, q)
+        Array(0.01129122, dtype=float32)
+    """
     measures, total = _kld_update(p, q, log_prob)
     return _kld_compute(measures, total, reduction)
 
@@ -115,7 +133,16 @@ def _tweedie_deviance_score_compute(sum_deviance_score: Array, num_observations:
 
 
 def tweedie_deviance_score(preds: Array, target: Array, power: float = 0.0) -> Array:
-    """Tweedie deviance (reference functional/regression/tweedie_deviance.py)."""
+    """Tweedie deviance (reference functional/regression/tweedie_deviance.py).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import tweedie_deviance_score
+        >>> preds = jnp.array([0.5, 1.2, 2.0, 4.0])
+        >>> target = jnp.array([0.6, 1.0, 2.5, 3.5])
+        >>> tweedie_deviance_score(preds, target)
+        Array(0.1375, dtype=float32)
+    """
     if 0 < power < 1:
         raise ValueError(f"Deviance Score is not defined for power={power}.")
     s, n = _tweedie_deviance_score_update(preds, target, power)
@@ -157,7 +184,16 @@ def _spearman_corrcoef_compute(preds: Array, target: Array, eps: float = 1.17e-0
 
 
 def spearman_corrcoef(preds: Array, target: Array) -> Array:
-    """Spearman rank correlation (reference functional/regression/spearman.py)."""
+    """Spearman rank correlation (reference functional/regression/spearman.py).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import spearman_corrcoef
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> spearman_corrcoef(preds, target)
+        Array(0.99999905, dtype=float32)
+    """
     _check_same_shape(preds, target)
     if not jnp.issubdtype(preds.dtype, jnp.floating) or not jnp.issubdtype(target.dtype, jnp.floating):
         raise TypeError("Expected `preds` and `target` both to be floating point tensors")
@@ -214,7 +250,16 @@ def kendall_rank_corrcoef(
     alternative: Optional[str] = "two-sided",
 ) -> Array:
     """Kendall rank correlation; with ``t_test=True`` returns ``(tau, p_value)``
-    (reference functional/regression/kendall.py:343-416)."""
+    (reference functional/regression/kendall.py:343-416).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import kendall_rank_corrcoef
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> kendall_rank_corrcoef(preds, target)
+        Array(1., dtype=float32, weak_type=True)
+    """
     _check_same_shape(preds, target)
     if variant not in ("a", "b", "c"):
         raise ValueError(f"Argument `variant` is expected to be one of `['a', 'b', 'c']`, but got {variant!r}")

@@ -60,7 +60,16 @@ def _pearson_corrcoef_compute(var_x: Array, var_y: Array, corr_xy: Array, nb: Ar
 
 
 def pearson_corrcoef(preds: Array, target: Array) -> Array:
-    """Pearson correlation coefficient (reference functional/regression/pearson.py)."""
+    """Pearson correlation coefficient (reference functional/regression/pearson.py).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import pearson_corrcoef
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> pearson_corrcoef(preds, target)
+        Array(0.98486954, dtype=float32)
+    """
     d = preds.shape[1] if preds.ndim == 2 else 1
     shape = (d,) if d > 1 else ()
     zeros = jnp.zeros(shape, dtype=jnp.float32)
@@ -84,7 +93,16 @@ def _concordance_corrcoef_compute(
 
 
 def concordance_corrcoef(preds: Array, target: Array) -> Array:
-    """Concordance correlation coefficient (reference functional/regression/concordance.py)."""
+    """Concordance correlation coefficient (reference functional/regression/concordance.py).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import concordance_corrcoef
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> concordance_corrcoef(preds, target)
+        Array(0.9767892, dtype=float32)
+    """
     d = preds.shape[1] if preds.ndim == 2 else 1
     shape = (d,) if d > 1 else ()
     zeros = jnp.zeros(shape, dtype=jnp.float32)
@@ -148,7 +166,16 @@ def _explained_variance_compute(
 
 
 def explained_variance(preds: Array, target: Array, multioutput: str = "uniform_average") -> Array:
-    """Explained variance (reference functional/regression/explained_variance.py)."""
+    """Explained variance (reference functional/regression/explained_variance.py).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import explained_variance
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> explained_variance(preds, target)
+        Array(0.95717347, dtype=float32)
+    """
     n, se, sse, st, sst = _explained_variance_update(preds, target)
     return _explained_variance_compute(n, se, sse, st, sst, multioutput)
 
@@ -201,7 +228,16 @@ def _r2_score_compute(
 
 
 def r2_score(preds: Array, target: Array, adjusted: int = 0, multioutput: str = "uniform_average") -> Array:
-    """R² score (reference functional/regression/r2.py)."""
+    """R² score (reference functional/regression/r2.py).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import r2_score
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> r2_score(preds, target)
+        Array(0.94860816, dtype=float32)
+    """
     sum_squared_obs, sum_obs, residual, num_obs = _r2_score_update(preds, target)
     if num_obs < 2:
         raise ValueError("Needs at least two samples to calculate r2 score.")

@@ -33,7 +33,16 @@ from metrics_tpu.utils.compute import _safe_divide
 
 
 def retrieval_average_precision(preds: Array, target: Array) -> Array:
-    """AP over one query: mean of precision@hit over the hit positions."""
+    """AP over one query: mean of precision@hit over the hit positions.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import retrieval_average_precision
+        >>> preds = jnp.array([0.9, 0.2, 0.7, 0.4])
+        >>> target = jnp.array([1, 0, 1, 1])
+        >>> retrieval_average_precision(preds, target)
+        Array(1., dtype=float32)
+    """
     preds, target = _check_retrieval_functional_inputs(preds, target)
     t = _target_by_pred_rank(preds, target).astype(jnp.float32)
     cum_hits = jnp.cumsum(t)
@@ -43,7 +52,16 @@ def retrieval_average_precision(preds: Array, target: Array) -> Array:
 
 
 def retrieval_precision(preds: Array, target: Array, k: Optional[int] = None, adaptive_k: bool = False) -> Array:
-    """Precision@k = (# relevant in top-k) / k; ``adaptive_k`` clamps k to the query size."""
+    """Precision@k = (# relevant in top-k) / k; ``adaptive_k`` clamps k to the query size.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import retrieval_precision
+        >>> preds = jnp.array([0.9, 0.2, 0.7, 0.4])
+        >>> target = jnp.array([1, 0, 1, 1])
+        >>> retrieval_precision(preds, target, k=2)
+        Array(1., dtype=float32)
+    """
     preds, target = _check_retrieval_functional_inputs(preds, target)
     if not isinstance(adaptive_k, bool):
         raise ValueError("`adaptive_k` has to be a boolean")
@@ -57,7 +75,16 @@ def retrieval_precision(preds: Array, target: Array, k: Optional[int] = None, ad
 
 
 def retrieval_recall(preds: Array, target: Array, k: Optional[int] = None) -> Array:
-    """Recall@k = (# relevant in top-k) / (# relevant)."""
+    """Recall@k = (# relevant in top-k) / (# relevant).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import retrieval_recall
+        >>> preds = jnp.array([0.9, 0.2, 0.7, 0.4])
+        >>> target = jnp.array([1, 0, 1, 1])
+        >>> retrieval_recall(preds, target, k=2)
+        Array(0.6666667, dtype=float32)
+    """
     preds, target = _check_retrieval_functional_inputs(preds, target)
     _validate_k(k)
     n = preds.shape[0]
@@ -69,7 +96,16 @@ def retrieval_recall(preds: Array, target: Array, k: Optional[int] = None) -> Ar
 
 
 def retrieval_fall_out(preds: Array, target: Array, k: Optional[int] = None) -> Array:
-    """Fall-out@k = (# NON-relevant in top-k) / (# non-relevant)."""
+    """Fall-out@k = (# NON-relevant in top-k) / (# non-relevant).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import retrieval_fall_out
+        >>> preds = jnp.array([0.9, 0.2, 0.7, 0.4])
+        >>> target = jnp.array([1, 0, 1, 1])
+        >>> retrieval_fall_out(preds, target)
+        Array(1., dtype=float32)
+    """
     preds, target = _check_retrieval_functional_inputs(preds, target)
     _validate_k(k)
     n = preds.shape[0]
@@ -81,7 +117,16 @@ def retrieval_fall_out(preds: Array, target: Array, k: Optional[int] = None) -> 
 
 
 def retrieval_hit_rate(preds: Array, target: Array, k: Optional[int] = None) -> Array:
-    """1.0 if any relevant document is in the top-k, else 0.0."""
+    """1.0 if any relevant document is in the top-k, else 0.0.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import retrieval_hit_rate
+        >>> preds = jnp.array([0.9, 0.2, 0.7, 0.4])
+        >>> target = jnp.array([1, 0, 1, 1])
+        >>> retrieval_hit_rate(preds, target)
+        Array(1., dtype=float32)
+    """
     preds, target = _check_retrieval_functional_inputs(preds, target)
     _validate_k(k)
     n = preds.shape[0]
@@ -91,7 +136,16 @@ def retrieval_hit_rate(preds: Array, target: Array, k: Optional[int] = None) -> 
 
 
 def retrieval_r_precision(preds: Array, target: Array) -> Array:
-    """Precision at k = (# relevant); branch-free via a rank<R mask."""
+    """Precision at k = (# relevant); branch-free via a rank<R mask.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import retrieval_r_precision
+        >>> preds = jnp.array([0.9, 0.2, 0.7, 0.4])
+        >>> target = jnp.array([1, 0, 1, 1])
+        >>> retrieval_r_precision(preds, target)
+        Array(1., dtype=float32)
+    """
     preds, target = _check_retrieval_functional_inputs(preds, target)
     t = _target_by_pred_rank(preds, target).astype(jnp.float32)
     total = target.sum().astype(jnp.float32)
@@ -101,7 +155,16 @@ def retrieval_r_precision(preds: Array, target: Array) -> Array:
 
 
 def retrieval_reciprocal_rank(preds: Array, target: Array) -> Array:
-    """1 / rank of the first relevant document (argmax finds the first True)."""
+    """1 / rank of the first relevant document (argmax finds the first True).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import retrieval_reciprocal_rank
+        >>> preds = jnp.array([0.9, 0.2, 0.7, 0.4])
+        >>> target = jnp.array([1, 0, 1, 1])
+        >>> retrieval_reciprocal_rank(preds, target)
+        Array(1., dtype=float32)
+    """
     preds, target = _check_retrieval_functional_inputs(preds, target)
     t = _target_by_pred_rank(preds, target).astype(jnp.float32)
     first = jnp.argmax(t)  # first occurrence of the max (1.0) — the top-ranked hit
@@ -114,7 +177,16 @@ def _dcg(target: Array) -> Array:
 
 
 def retrieval_normalized_dcg(preds: Array, target: Array, k: Optional[int] = None) -> Array:
-    """nDCG@k with raw-gain DCG (gain = target value, like the reference)."""
+    """nDCG@k with raw-gain DCG (gain = target value, like the reference).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import retrieval_normalized_dcg
+        >>> preds = jnp.array([0.9, 0.2, 0.7, 0.4])
+        >>> target = jnp.array([1, 0, 1, 1])
+        >>> retrieval_normalized_dcg(preds, target)
+        Array(1., dtype=float32)
+    """
     preds, target = _check_retrieval_functional_inputs(preds, target, allow_non_binary_target=True)
     _validate_k(k)
     n = preds.shape[0]
@@ -133,7 +205,17 @@ def retrieval_precision_recall_curve(
     max_k: Optional[int] = None,
     adaptive_k: bool = False,
 ) -> Tuple[Array, Array, Array]:
-    """(precision@k, recall@k, k) for k in 1..max_k over one query."""
+    """(precision@k, recall@k, k) for k in 1..max_k over one query.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import retrieval_precision_recall_curve
+        >>> preds = jnp.array([0.9, 0.2, 0.7, 0.4])
+        >>> target = jnp.array([1, 0, 1, 1])
+        >>> precision, recall, top_k = retrieval_precision_recall_curve(preds, target, max_k=2)
+        >>> recall
+        Array([0.33333334, 0.6666667 ], dtype=float32)
+    """
     preds, target = _check_retrieval_functional_inputs(preds, target)
     if not isinstance(adaptive_k, bool):
         raise ValueError("`adaptive_k` has to be a boolean")

@@ -68,6 +68,15 @@ def perplexity(preds: Array, target: Array, ignore_index: Optional[int] = None) 
         preds: Unnormalized logits for each token, shape ``[batch, seq, vocab]``.
         target: Ground-truth token ids, shape ``[batch, seq]``.
         ignore_index: Target class that does not contribute to the score.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import perplexity
+        >>> import jax
+        >>> logits = jax.random.normal(jax.random.PRNGKey(0), (2, 4, 6))
+        >>> target = jnp.array([[0, 1, 2, 3], [4, 5, 0, 1]])
+        >>> perplexity(logits, target)
+        Array(4.349334, dtype=float32)
     """
     total, count = _perplexity_update(preds, target, ignore_index)
     return _perplexity_compute(total, count)
