@@ -211,7 +211,7 @@ def auroc(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ):
-    """Auroc.
+    """Task-dispatch façade over binary/multiclass/multilabel AUROC (reference functional/classification/auroc.py).
 
     Example:
         >>> import jax.numpy as jnp

@@ -185,7 +185,7 @@ def average_precision(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ):
-    """Average precision.
+    """Task-dispatch façade over binary/multiclass/multilabel average precision (reference functional/classification/average_precision.py).
 
     Example:
         >>> import jax.numpy as jnp

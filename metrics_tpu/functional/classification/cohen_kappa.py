@@ -52,7 +52,7 @@ def multiclass_cohen_kappa(preds, target, num_classes, weights=None, ignore_inde
 def cohen_kappa(
     preds, target, task, threshold=0.5, num_classes=None, weights=None, ignore_index=None, validate_args=True,
 ) -> Array:
-    """Cohen kappa.
+    """Task-dispatch façade over binary/multiclass Cohen's kappa (reference functional/classification/cohen_kappa.py).
 
     Example:
         >>> import jax.numpy as jnp

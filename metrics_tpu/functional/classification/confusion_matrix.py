@@ -149,7 +149,7 @@ def confusion_matrix(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
-    """Confusion matrix.
+    """Task-dispatch façade over binary/multiclass/multilabel confusion matrices (reference functional/classification/confusion_matrix.py).
 
     Example:
         >>> import jax.numpy as jnp

@@ -80,7 +80,7 @@ def exact_match(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
-    """Exact match.
+    """Task-dispatch façade over multiclass/multilabel exact match (reference functional/classification/exact_match.py).
 
     Example:
         >>> import jax.numpy as jnp

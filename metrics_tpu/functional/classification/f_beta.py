@@ -80,7 +80,7 @@ def fbeta_score(
     preds, target, task, beta=1.0, threshold=0.5, num_classes=None, num_labels=None, average="micro",
     multidim_average="global", top_k=1, ignore_index=None, validate_args=True,
 ) -> Array:
-    """Fbeta score.
+    """Task-dispatch façade over binary/multiclass/multilabel F-beta (reference functional/classification/f_beta.py).
 
     Example:
         >>> import jax.numpy as jnp
@@ -102,7 +102,7 @@ def f1_score(
     preds, target, task, threshold=0.5, num_classes=None, num_labels=None, average="micro",
     multidim_average="global", top_k=1, ignore_index=None, validate_args=True,
 ) -> Array:
-    """F1 score.
+    """Task-dispatch façade over binary/multiclass/multilabel F1 (reference functional/classification/f_beta.py).
 
     Example:
         >>> import jax.numpy as jnp

@@ -58,7 +58,7 @@ def hamming_distance(
     preds, target, task, threshold=0.5, num_classes=None, num_labels=None, average="micro",
     multidim_average="global", top_k=1, ignore_index=None, validate_args=True,
 ) -> Array:
-    """Hamming distance.
+    """Task-dispatch façade over binary/multiclass/multilabel Hamming distance (reference functional/classification/hamming.py).
 
     Example:
         >>> import jax.numpy as jnp

@@ -75,7 +75,7 @@ def jaccard_index(
     preds, target, task, threshold=0.5, num_classes=None, num_labels=None, average="macro",
     ignore_index=None, validate_args=True,
 ) -> Array:
-    """Jaccard index.
+    """Task-dispatch façade over binary/multiclass/multilabel Jaccard index (reference functional/classification/jaccard.py).
 
     Example:
         >>> import jax.numpy as jnp

@@ -65,7 +65,7 @@ def multilabel_matthews_corrcoef(preds, target, num_labels, threshold=0.5, ignor
 def matthews_corrcoef(
     preds, target, task, threshold=0.5, num_classes=None, num_labels=None, ignore_index=None, validate_args=True,
 ) -> Array:
-    """Matthews corrcoef.
+    """Task-dispatch façade over binary/multiclass/multilabel Matthews correlation (reference functional/classification/matthews_corrcoef.py).
 
     Example:
         >>> import jax.numpy as jnp

@@ -71,7 +71,7 @@ def precision(
     preds, target, task, threshold=0.5, num_classes=None, num_labels=None, average="micro",
     multidim_average="global", top_k=1, ignore_index=None, validate_args=True,
 ) -> Array:
-    """Precision.
+    """Task-dispatch façade over binary/multiclass/multilabel precision (reference functional/classification/precision_recall.py).
 
     Example:
         >>> import jax.numpy as jnp
@@ -93,7 +93,7 @@ def recall(
     preds, target, task, threshold=0.5, num_classes=None, num_labels=None, average="micro",
     multidim_average="global", top_k=1, ignore_index=None, validate_args=True,
 ) -> Array:
-    """Recall.
+    """Task-dispatch façade over binary/multiclass/multilabel recall (reference functional/classification/precision_recall.py).
 
     Example:
         >>> import jax.numpy as jnp

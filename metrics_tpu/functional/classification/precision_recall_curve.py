@@ -445,7 +445,7 @@ def precision_recall_curve(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ):
-    """Precision recall curve.
+    """Task-dispatch façade over binary/multiclass/multilabel precision-recall curves (reference functional/classification/precision_recall_curve.py).
 
     Example:
         >>> import jax.numpy as jnp

@@ -172,7 +172,7 @@ def roc(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ):
-    """Roc.
+    """Task-dispatch façade over binary/multiclass/multilabel ROC curves (reference functional/classification/roc.py).
 
     Example:
         >>> import jax.numpy as jnp

@@ -53,7 +53,7 @@ def specificity(
     preds, target, task, threshold=0.5, num_classes=None, num_labels=None, average="micro",
     multidim_average="global", top_k=1, ignore_index=None, validate_args=True,
 ) -> Array:
-    """Specificity.
+    """Task-dispatch façade over binary/multiclass/multilabel specificity (reference functional/classification/specificity.py).
 
     Example:
         >>> import jax.numpy as jnp

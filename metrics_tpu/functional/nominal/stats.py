@@ -223,7 +223,7 @@ def _matrix(fn, matrix: Array, **kwargs) -> Array:
 
 
 def cramers_v_matrix(matrix: Array, bias_correction: bool = True, nan_strategy: str = "replace", nan_replace_value: Optional[float] = 0.0) -> Array:
-    """Cramers v matrix.
+    """Pairwise column-association matrix of Cramér's V (reference functional/nominal/cramers.py `cramers_v_matrix`).
 
     Example:
         >>> import jax.numpy as jnp
@@ -242,7 +242,7 @@ def cramers_v_matrix(matrix: Array, bias_correction: bool = True, nan_strategy: 
 
 
 def pearsons_contingency_coefficient_matrix(matrix: Array, nan_strategy: str = "replace", nan_replace_value: Optional[float] = 0.0) -> Array:
-    """Pearsons contingency coefficient matrix.
+    """Pairwise column-association matrix of Pearson's contingency coefficient (reference functional/nominal/pearson.py).
 
     Example:
         >>> import jax.numpy as jnp
@@ -261,7 +261,7 @@ def pearsons_contingency_coefficient_matrix(matrix: Array, nan_strategy: str = "
 
 
 def tschuprows_t_matrix(matrix: Array, bias_correction: bool = True, nan_strategy: str = "replace", nan_replace_value: Optional[float] = 0.0) -> Array:
-    """Tschuprows t matrix.
+    """Pairwise column-association matrix of Tschuprow's T (reference functional/nominal/tschuprows.py `tschuprows_t_matrix`).
 
     Example:
         >>> import jax.numpy as jnp
@@ -280,7 +280,7 @@ def tschuprows_t_matrix(matrix: Array, bias_correction: bool = True, nan_strateg
 
 
 def theils_u_matrix(matrix: Array, nan_strategy: str = "replace", nan_replace_value: Optional[float] = 0.0) -> Array:
-    """Theils u matrix.
+    """Directional column-association matrix of Theil's U (reference functional/nominal/theils_u.py `theils_u_matrix`).
 
     Example:
         >>> import jax.numpy as jnp

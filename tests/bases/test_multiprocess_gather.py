@@ -37,6 +37,9 @@ def test_two_process_gather_all_tensors():
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
     env["PYTHONPATH"] = str(_REPO_ROOT) + os.pathsep + env.get("PYTHONPATH", "")
+    # CPU-only child: drop the accelerator-plugin trigger so interpreter startup
+    # (sitecustomize) can't stall for minutes dialing an unreachable TPU tunnel
+    env.pop("PALLAS_AXON_POOL_IPS", None)
 
     procs = [
         subprocess.Popen(

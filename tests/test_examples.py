@@ -21,6 +21,9 @@ def _run(name: str, *args: str, timeout: int = 240, cwd: str | None = None) -> s
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = str(_REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    # CPU-only child: drop the accelerator-plugin trigger so interpreter startup
+    # (sitecustomize) can't stall for minutes dialing an unreachable TPU tunnel
+    env.pop("PALLAS_AXON_POOL_IPS", None)
     result = subprocess.run(
         [sys.executable, str(_EXAMPLES / name), *args],
         env=env, capture_output=True, text=True, timeout=timeout, cwd=cwd,
