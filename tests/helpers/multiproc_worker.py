@@ -72,6 +72,38 @@ def main() -> None:
     union = np.mean([np.full((2, 3), float(r + 1)) for r in range(num_processes)])
     np.testing.assert_allclose(synced, union, atol=1e-6)
 
+    # --- in-trace cross-process collective (the DCN path) ---------------------
+    # One CPU device per process forms a global 2-device mesh; the metric's
+    # psum sync then runs INSIDE the compiled program across process boundaries
+    # — the multi-controller analogue of the single-process shard_map tests.
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from metrics_tpu.classification import MulticlassAccuracy
+
+    devices = np.array(jax.devices())
+    assert len(devices) == num_processes, devices
+    mesh = Mesh(devices, ("dp",))
+    acc = MulticlassAccuracy(4, average="micro", validate_args=False)
+
+    preds_global = np.array([0, 1, 2, 3], dtype=np.int32)
+    target_global = np.array([0, 1, 0, 3], dtype=np.int32)
+    shard = slice(2 * process_id, 2 * (process_id + 1))
+    row_sharding = NamedSharding(mesh, P("dp"))
+    p_g = jax.make_array_from_process_local_data(row_sharding, preds_global[shard], global_shape=(4,))
+    t_g = jax.make_array_from_process_local_data(row_sharding, target_global[shard], global_shape=(4,))
+    state_g = jax.device_put(acc.init_state(), NamedSharding(mesh, P()))
+
+    def step(state, p, t):
+        state = acc.update_state(state, p, t)
+        return acc.compute_from(state, axis_name="dp")
+
+    value = jax.jit(
+        jax.shard_map(step, mesh=mesh, in_specs=(P(), P("dp"), P("dp")), out_specs=P(), check_vma=False)
+    )(state_g, p_g, t_g)
+    expected = float(np.mean(preds_global == target_global))
+    np.testing.assert_allclose(float(value), expected, atol=1e-6)
+
     print(f"WORKER_OK rank={process_id}")
 
 
