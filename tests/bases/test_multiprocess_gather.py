@@ -5,7 +5,8 @@ Round-1 verdict weak #3: every in-repo "DDP" test injects a fake-world
 reference ``src/torchmetrics/utilities/distributed.py:126-148``) had zero coverage.
 This test spawns a genuine 2-process ``jax.distributed`` CPU job — the JAX analogue
 of the reference's localhost gloo pool (``tests/unittests/helpers/testers.py:49-61``)
-— and asserts the equal-shape path, the ragged path, and the union-of-data invariant.
+— and asserts the equal-shape path, the ragged path, the union-of-data invariant,
+and an in-trace cross-process ``shard_map`` psum (the compiled DCN path).
 """
 
 from __future__ import annotations

@@ -9,7 +9,10 @@ service (the JAX analogue of the reference's gloo process group,
 ``tests/unittests/helpers/testers.py:49-61``) and exercises the
 ``multihost_utils`` branch of :func:`metrics_tpu.utils.distributed.gather_all_tensors`
 — both the equal-shape fast path and the pad-to-max ragged protocol
-(reference ``src/torchmetrics/utilities/distributed.py:126-148``).
+(reference ``src/torchmetrics/utilities/distributed.py:126-148``) — and then the
+IN-TRACE path: the two processes' devices form one global mesh and the metric's
+psum sync compiles across the process boundary inside ``shard_map`` (the DCN
+path on a multi-host pod), with the vma replication check enabled.
 """
 
 from __future__ import annotations
@@ -76,7 +79,6 @@ def main() -> None:
     # One CPU device per process forms a global 2-device mesh; the metric's
     # psum sync then runs INSIDE the compiled program across process boundaries
     # — the multi-controller analogue of the single-process shard_map tests.
-    import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     from metrics_tpu.classification import MulticlassAccuracy
@@ -99,7 +101,7 @@ def main() -> None:
         return acc.compute_from(state, axis_name="dp")
 
     value = jax.jit(
-        jax.shard_map(step, mesh=mesh, in_specs=(P(), P("dp"), P("dp")), out_specs=P(), check_vma=False)
+        jax.shard_map(step, mesh=mesh, in_specs=(P(), P("dp"), P("dp")), out_specs=P(), check_vma=True)
     )(state_g, p_g, t_g)
     expected = float(np.mean(preds_global == target_global))
     np.testing.assert_allclose(float(value), expected, atol=1e-6)
