@@ -30,7 +30,19 @@ parser.add_argument("--backend", choices=["cpu", "default"], default="cpu")
 parser.add_argument("--steps", type=int, default=20)
 args = parser.parse_args()
 
-if args.backend == "cpu":
+use_cpu = args.backend == "cpu"
+if not use_cpu:
+    # Probe the accelerator in a killable subprocess first (same rationale as
+    # bench.py): an in-process backend init can hang indefinitely when the
+    # tunnel is down, and a hang is worse than a degraded-but-labelled run.
+    import bench
+
+    ok, detail = bench.probe_accelerator()
+    if not ok:
+        print(f"# accelerator probe failed ({detail}); running on the cpu mesh", file=sys.stderr)
+        use_cpu = True
+
+if use_cpu:
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
         os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
@@ -38,7 +50,7 @@ if args.backend == "cpu":
 
 import jax
 
-if args.backend == "cpu":
+if use_cpu:
     jax.config.update("jax_platforms", "cpu")
 
 import jax.numpy as jnp
