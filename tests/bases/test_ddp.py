@@ -155,3 +155,25 @@ def test_sum_metric_inside_pjit_global_array():
     m = SumMetric()
     m.update(data)
     assert float(m.compute()) == float(np.arange(NUM_DEVICES * 4).sum())
+
+
+def test_compositional_metric_under_fake_world_sync():
+    """Compositional metrics under DDP (reference test_ddp.py:85-92): the
+    composition's own _sync_dist is a no-op — each child syncs itself, and the
+    composed value is computed from the synced children."""
+    world = 2
+    pairs = [(DummyMetricSum(), DummyMetricSum()) for _ in range(world)]
+    compositions = [a + b for a, b in pairs]
+    for rank, (a, b) in enumerate(pairs):
+        a.update(jnp.asarray(float(rank + 1)))
+        b.update(jnp.asarray(10.0 * (rank + 1)))
+
+    for metrics in zip(*pairs):  # sync each child metric family across ranks
+        fns = _fake_dist_sync_fns(list(metrics))
+        for rank, m in enumerate(metrics):
+            m.dist_sync_fn = fns(rank)
+            m.distributed_available_fn = lambda: True
+
+    # every rank's composition computes the same union value: (1+2) + (10+20)
+    for comp in compositions:
+        np.testing.assert_allclose(float(comp.compute()), 33.0)
