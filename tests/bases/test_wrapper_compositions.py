@@ -1,0 +1,76 @@
+"""Wrapper-inside-collection compositions (reference behavior spot-checks).
+
+The reference allows arbitrary nesting of wrappers in collections; these lock
+the semantics that fall out of that composition: one-level dict flattening of
+MinMaxMetric results, ClasswiseWrapper label explosion under a collection
+prefix, tracker-over-collection best_metric dicts, and pickling mid-stream.
+"""
+
+from __future__ import annotations
+
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu import MetricCollection, MetricTracker, MinMaxMetric
+from metrics_tpu.classification import MulticlassAccuracy, MulticlassPrecision, MulticlassRecall
+from metrics_tpu.wrappers import ClasswiseWrapper
+
+P = jnp.asarray([0, 1, 2, 1, 0, 2])
+T = jnp.asarray([0, 1, 1, 1, 0, 2])
+
+
+def test_classwise_wrapper_inside_collection():
+    col = MetricCollection(
+        {
+            "cw_acc": ClasswiseWrapper(MulticlassAccuracy(3, average=None)),
+            "prec": MulticlassPrecision(3, average="macro"),
+        }
+    )
+    col.update(P, T)
+    out = {k: float(v) for k, v in col.compute().items()}
+    assert set(out) == {"multiclassaccuracy_0", "multiclassaccuracy_1", "multiclassaccuracy_2", "prec"}
+    np.testing.assert_allclose(out["multiclassaccuracy_0"], 1.0)
+    np.testing.assert_allclose(out["multiclassaccuracy_1"], 2 / 3, atol=1e-6)
+
+
+def test_minmax_result_flattens_one_level_in_collection():
+    """A dict-valued member flattens into the collection result (reference
+    _flatten_dict semantics) — raw/max/min become top-level keys."""
+    col = MetricCollection({"mm": MinMaxMetric(MulticlassAccuracy(3, average="micro"))})
+    col.update(P, T)
+    out = col.compute()
+    assert set(out) == {"raw", "max", "min"}
+    np.testing.assert_allclose(float(out["raw"]), 5 / 6, atol=1e-6)
+
+
+def test_tracker_over_collection_best_metric_dicts():
+    tr = MetricTracker(
+        MetricCollection(
+            {"acc": MulticlassAccuracy(3, average="micro"), "rec": MulticlassRecall(3, average="macro")}
+        )
+    )
+    tr.increment()
+    tr.update(P, T)
+    tr.increment()
+    tr.update(T, T)  # perfect epoch
+    best, step = tr.best_metric(return_step=True)
+    assert {k: float(v) for k, v in best.items()} == {"acc": 1.0, "rec": 1.0}
+    assert {k: int(v) for k, v in step.items()} == {"acc": 1, "rec": 1}
+
+
+def test_classwise_labels_with_collection_prefix_and_pickle():
+    col = MetricCollection(
+        {"cw": ClasswiseWrapper(MulticlassAccuracy(3, average=None), labels=["cat", "dog", "fish"])},
+        prefix="val_",
+    )
+    col.update(P, T)
+    keys = set(col.compute())
+    assert keys == {"val_multiclassaccuracy_cat", "val_multiclassaccuracy_dog", "val_multiclassaccuracy_fish"}
+
+    clone = pickle.loads(pickle.dumps(col))  # mid-accumulation round-trip
+    clone.update(P, T)
+    out = {k: float(v) for k, v in clone.compute().items()}
+    assert set(out) == keys
+    np.testing.assert_allclose(out["val_multiclassaccuracy_cat"], 1.0)
