@@ -50,10 +50,12 @@ def _binary_roc_compute(
 
     preds, target = state
     fps, tps, thres = _binary_clf_curve(preds, target, pos_label=pos_label)
-    # add an extra threshold so the curve starts at (0, 0)
+    # add an extra threshold so the curve starts at (0, 0); the sentinel is a
+    # constant 1.0 (reference roc.py:57 — probability semantics), not sklearn's
+    # max-score + 1
     tps = jnp.concatenate([jnp.zeros(1, dtype=tps.dtype), tps])
     fps = jnp.concatenate([jnp.zeros(1, dtype=fps.dtype), fps])
-    thres = jnp.concatenate([(thres[:1] + 1.0), thres])
+    thres = jnp.concatenate([jnp.ones(1, dtype=thres.dtype), thres])
     fpr = _safe_divide(fps, fps[-1])
     tpr = _safe_divide(tps, tps[-1])
     return fpr, tpr, thres

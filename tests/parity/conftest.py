@@ -1,0 +1,77 @@
+"""Differential-parity fixtures: import the actual reference implementation.
+
+The reference checkout at /root/reference is pure Python over torch (CPU torch
+is available), so the strongest possible parity check is to RUN it — same
+random inputs through both libraries, compare outputs. Its only hard external
+dependency, ``lightning_utilities``, is stubbed with faithful re-implementations
+of the two helpers the import graph needs.
+
+These tests never copy reference code; they execute it as an oracle.
+"""
+
+from __future__ import annotations
+
+import sys
+import types
+from pathlib import Path
+
+import pytest
+
+_REF_SRC = Path("/root/reference/src")
+
+
+def _install_stubs() -> None:
+    if "lightning_utilities" in sys.modules:
+        return
+    lu = types.ModuleType("lightning_utilities")
+    luc = types.ModuleType("lightning_utilities.core")
+    lui = types.ModuleType("lightning_utilities.core.imports")
+
+    def compare_version(package: str, op, version: str, use_base_version: bool = False) -> bool:
+        try:
+            import importlib.metadata
+
+            from packaging.version import Version
+
+            return op(Version(importlib.metadata.version(package)), Version(version))
+        except Exception:
+            return False
+
+    def package_available(name: str) -> bool:
+        import importlib.util
+
+        try:
+            return importlib.util.find_spec(name) is not None
+        except Exception:
+            return False
+
+    lui.compare_version = compare_version
+    lui.package_available = package_available
+    lu.core = luc
+    luc.imports = lui
+    sys.modules.update(
+        {"lightning_utilities": lu, "lightning_utilities.core": luc, "lightning_utilities.core.imports": lui}
+    )
+
+
+@pytest.fixture(scope="session")
+def tm():
+    """The reference ``torchmetrics`` package, imported from /root/reference.
+
+    NOTE: the sys.path insertion (and the stub modules) persist for the rest of
+    the pytest session — any later test that does ``import torchmetrics`` gets
+    THIS checkout, not an installed package. No test outside tests/parity/
+    imports torchmetrics; keep it that way or scope the insertion.
+    """
+    if not _REF_SRC.exists():
+        pytest.skip("reference checkout not present")
+    _install_stubs()
+    if str(_REF_SRC) not in sys.path:
+        sys.path.insert(0, str(_REF_SRC))
+    torchmetrics = pytest.importorskip("torchmetrics")
+    return torchmetrics
+
+
+@pytest.fixture(scope="session")
+def torch():
+    return pytest.importorskip("torch")
