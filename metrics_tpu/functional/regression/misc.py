@@ -217,7 +217,9 @@ def _kendall_tau_compute(preds: Array, target: Array, variant: str = "b") -> Arr
     ty = jnp.sum(jnp.sign(py)[iu] == 0)
     txy = jnp.sum((jnp.sign(px)[iu] == 0) & (jnp.sign(py)[iu] == 0))
     if variant == "a":
-        return (concordant - discordant) / n0
+        # reference convention (kendall.py:184-185): ties drop out of the
+        # denominator — (C − D) / (C + D), not the textbook (C − D) / C(n,2)
+        return (concordant - discordant) / (concordant + discordant)
     if variant == "b":
         return (concordant - discordant) / jnp.sqrt((n0 - tx) * (n0 - ty))
     # variant "c": needs the number of distinct values per variable

@@ -75,3 +75,13 @@ def tm():
 @pytest.fixture(scope="session")
 def torch():
     return pytest.importorskip("torch")
+
+
+def assert_close(ours, ref, atol=1e-5):
+    """Compare a metrics_tpu result against a torch reference result."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    ours = np.asarray(jnp.asarray(ours), dtype=np.float64)
+    ref = np.asarray(ref.detach().numpy() if hasattr(ref, "detach") else ref, dtype=np.float64)
+    np.testing.assert_allclose(ours, ref, atol=atol, rtol=1e-4)

@@ -1,0 +1,312 @@
+"""Differential parity, round 2: the awkward configurations.
+
+Same oracle setup as test_reference_parity.py (run the actual reference);
+these cases target the option surfaces where conventions most often drift:
+top_k, samplewise averaging, ignore_index, binned curve regimes, multioutput
+regression, weighted aggregation, wrappers, and collections with compute
+groups.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+NC = 4
+N = 64
+
+_rng = np.random.default_rng(77)
+_MC_PROBS = (lambda x: x / x.sum(-1, keepdims=True))(_rng.random((N, NC)).astype(np.float32) + 0.05)
+_MC_TARGET = _rng.integers(0, NC, N)
+_MC_PREDS = _rng.integers(0, NC, N)
+_BIN_PROBS = _rng.random(N).astype(np.float32)
+_BIN_TARGET = _rng.integers(0, 2, N)
+_ML_PROBS = _rng.random((N, NC)).astype(np.float32)
+_ML_TARGET = _rng.integers(0, 2, (N, NC))
+
+
+from tests.parity.conftest import assert_close as _close
+
+
+def test_top_k_parity(tm, torch):
+    from metrics_tpu.functional.classification import multiclass_accuracy, multiclass_precision
+
+    for top_k in (2, 3):
+        _close(
+            multiclass_accuracy(jnp.asarray(_MC_PROBS), jnp.asarray(_MC_TARGET), NC, top_k=top_k, average="micro"),
+            tm.functional.classification.multiclass_accuracy(
+                torch.tensor(_MC_PROBS), torch.tensor(_MC_TARGET), NC, top_k=top_k, average="micro"
+            ),
+        )
+        _close(
+            multiclass_precision(jnp.asarray(_MC_PROBS), jnp.asarray(_MC_TARGET), NC, top_k=top_k, average="macro"),
+            tm.functional.classification.multiclass_precision(
+                torch.tensor(_MC_PROBS), torch.tensor(_MC_TARGET), NC, top_k=top_k, average="macro"
+            ),
+        )
+
+
+def test_samplewise_multidim_parity(tm, torch):
+    from metrics_tpu.functional.classification import multiclass_accuracy, multiclass_stat_scores
+
+    rng = np.random.default_rng(201)
+    preds = rng.integers(0, NC, (8, 12))
+    target = rng.integers(0, NC, (8, 12))
+    _close(
+        multiclass_accuracy(jnp.asarray(preds), jnp.asarray(target), NC, multidim_average="samplewise", average="micro"),
+        tm.functional.classification.multiclass_accuracy(
+            torch.tensor(preds), torch.tensor(target), NC, multidim_average="samplewise", average="micro"
+        ),
+    )
+    _close(
+        multiclass_stat_scores(jnp.asarray(preds), jnp.asarray(target), NC, multidim_average="samplewise", average="micro"),
+        tm.functional.classification.multiclass_stat_scores(
+            torch.tensor(preds), torch.tensor(target), NC, multidim_average="samplewise", average="micro"
+        ),
+    )
+
+
+def test_ignore_index_parity(tm, torch):
+    from metrics_tpu.functional.classification import binary_f1_score, multiclass_accuracy
+
+    target = _MC_TARGET.copy()
+    target[::7] = -1
+    _close(
+        multiclass_accuracy(jnp.asarray(_MC_PROBS), jnp.asarray(target), NC, ignore_index=-1, average="macro"),
+        tm.functional.classification.multiclass_accuracy(
+            torch.tensor(_MC_PROBS), torch.tensor(target), NC, ignore_index=-1, average="macro"
+        ),
+    )
+    btarget = _BIN_TARGET.copy()
+    btarget[::5] = -1
+    _close(
+        binary_f1_score(jnp.asarray(_BIN_PROBS), jnp.asarray(btarget), ignore_index=-1),
+        tm.functional.classification.binary_f1_score(torch.tensor(_BIN_PROBS), torch.tensor(btarget), ignore_index=-1),
+    )
+
+
+def test_binned_curves_multiclass_multilabel_parity(tm, torch):
+    from metrics_tpu.functional.classification import (
+        multiclass_auroc,
+        multiclass_precision_recall_curve,
+        multilabel_roc,
+    )
+
+    p, r, t = multiclass_precision_recall_curve(jnp.asarray(_MC_PROBS), jnp.asarray(_MC_TARGET), NC, thresholds=20)
+    rp, rr, rt = tm.functional.classification.multiclass_precision_recall_curve(
+        torch.tensor(_MC_PROBS), torch.tensor(_MC_TARGET), NC, thresholds=20
+    )
+    _close(p, rp)
+    _close(r, rr)
+    _close(t, rt)
+
+    f, tp_, th = multilabel_roc(jnp.asarray(_ML_PROBS), jnp.asarray(_ML_TARGET), NC, thresholds=20)
+    rf, rtp, rth = tm.functional.classification.multilabel_roc(
+        torch.tensor(_ML_PROBS), torch.tensor(_ML_TARGET), NC, thresholds=20
+    )
+    _close(f, rf)
+    _close(tp_, rtp)
+    _close(th, rth)
+
+    _close(
+        multiclass_auroc(jnp.asarray(_MC_PROBS), jnp.asarray(_MC_TARGET), NC, thresholds=50),
+        tm.functional.classification.multiclass_auroc(
+            torch.tensor(_MC_PROBS), torch.tensor(_MC_TARGET), NC, thresholds=50
+        ),
+    )
+
+
+def test_threshold_list_parity(tm, torch):
+    from metrics_tpu.functional.classification import binary_roc
+
+    thresholds = [0.1, 0.35, 0.5, 0.75, 0.9]
+    f, tp_, th = binary_roc(jnp.asarray(_BIN_PROBS), jnp.asarray(_BIN_TARGET), thresholds=thresholds)
+    rf, rtp, rth = tm.functional.classification.binary_roc(
+        torch.tensor(_BIN_PROBS), torch.tensor(_BIN_TARGET), thresholds=thresholds
+    )
+    _close(f, rf)
+    _close(tp_, rtp)
+    _close(th, rth)
+
+
+def test_kendall_variants_and_ttest_parity(tm, torch):
+    from metrics_tpu.functional.regression import kendall_rank_corrcoef
+
+    rng = np.random.default_rng(202)
+    p = rng.integers(0, 8, 50).astype(np.float32)  # ties
+    t = (p + rng.integers(0, 3, 50)).astype(np.float32)
+    for variant in ("a", "b", "c"):
+        _close(
+            kendall_rank_corrcoef(jnp.asarray(p), jnp.asarray(t), variant=variant),
+            tm.functional.kendall_rank_corrcoef(torch.tensor(p), torch.tensor(t), variant=variant),
+        )
+    tau, pval = kendall_rank_corrcoef(jnp.asarray(p), jnp.asarray(t), variant="b", t_test=True)
+    rtau, rpval = tm.functional.kendall_rank_corrcoef(torch.tensor(p), torch.tensor(t), variant="b", t_test=True)
+    _close(tau, rtau)
+    _close(pval, rpval, atol=1e-3)
+
+
+def test_regression_multioutput_parity(tm, torch):
+    from metrics_tpu.functional.regression import explained_variance, r2_score
+
+    rng = np.random.default_rng(203)
+    p = rng.normal(size=(N, 3)).astype(np.float32)
+    t = (p * 0.6 + rng.normal(size=(N, 3)) * 0.4).astype(np.float32)
+    for mo in ("raw_values", "uniform_average", "variance_weighted"):
+        _close(
+            r2_score(jnp.asarray(p), jnp.asarray(t), multioutput=mo),
+            tm.functional.r2_score(torch.tensor(p), torch.tensor(t), multioutput=mo),
+            atol=1e-4,
+        )
+        _close(
+            explained_variance(jnp.asarray(p), jnp.asarray(t), multioutput=mo),
+            tm.functional.explained_variance(torch.tensor(p), torch.tensor(t), multioutput=mo),
+            atol=1e-4,
+        )
+
+
+def test_retrieval_module_with_indexes_parity(tm, torch):
+    from metrics_tpu.retrieval import RetrievalMAP, RetrievalNormalizedDCG
+
+    rng = np.random.default_rng(204)
+    preds = rng.random(80).astype(np.float32)
+    target = rng.integers(0, 2, 80)
+    gains = rng.integers(0, 4, 80)
+    indexes = rng.integers(0, 8, 80)
+
+    ours = RetrievalMAP()
+    ref = tm.retrieval.RetrievalMAP()
+    ours.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(indexes))
+    ref.update(torch.tensor(preds), torch.tensor(target), indexes=torch.tensor(indexes))
+    _close(ours.compute(), ref.compute())
+
+    ours_n = RetrievalNormalizedDCG(k=5)
+    ref_n = tm.retrieval.RetrievalNormalizedDCG(k=5)
+    ours_n.update(jnp.asarray(preds), jnp.asarray(gains), indexes=jnp.asarray(indexes))
+    ref_n.update(torch.tensor(preds), torch.tensor(gains), indexes=torch.tensor(indexes))
+    _close(ours_n.compute(), ref_n.compute())
+
+
+def test_aggregation_parity(tm, torch):
+    from metrics_tpu import CatMetric, MaxMetric, MeanMetric, MinMetric, SumMetric
+
+    rng = np.random.default_rng(205)
+    vals = rng.normal(size=(3, 7)).astype(np.float32)
+    weights = rng.uniform(0.5, 2.0, size=(3, 7)).astype(np.float32)
+    pairs = [
+        (MeanMetric(), tm.MeanMetric()),
+        (SumMetric(), tm.SumMetric()),
+        (MaxMetric(), tm.MaxMetric()),
+        (MinMetric(), tm.MinMetric()),
+        (CatMetric(), tm.CatMetric()),
+    ]
+    for ours, ref in pairs:
+        for i in range(3):
+            if isinstance(ours, MeanMetric):
+                ours.update(jnp.asarray(vals[i]), jnp.asarray(weights[i]))
+                ref.update(torch.tensor(vals[i]), torch.tensor(weights[i]))
+            else:
+                ours.update(jnp.asarray(vals[i]))
+                ref.update(torch.tensor(vals[i]))
+        _close(ours.compute(), ref.compute())
+
+
+def test_wrappers_parity(tm, torch):
+    from metrics_tpu.classification import MulticlassAccuracy
+    from metrics_tpu.regression import MeanSquaredError
+    from metrics_tpu.wrappers import ClasswiseWrapper, MinMaxMetric, MultioutputWrapper
+
+    # ClasswiseWrapper key naming + values
+    ours_cw = ClasswiseWrapper(MulticlassAccuracy(NC, average=None), labels=["a", "b", "c", "d"])
+    ref_cw = tm.ClasswiseWrapper(tm.classification.MulticlassAccuracy(NC, average=None), labels=["a", "b", "c", "d"])
+    ours_cw.update(jnp.asarray(_MC_PREDS), jnp.asarray(_MC_TARGET))
+    ref_cw.update(torch.tensor(_MC_PREDS), torch.tensor(_MC_TARGET))
+    ours_out = {k: float(v) for k, v in ours_cw.compute().items()}
+    ref_out = {k: float(v) for k, v in ref_cw.compute().items()}
+    assert set(ours_out) == set(ref_out)
+    for k in ref_out:
+        np.testing.assert_allclose(ours_out[k], ref_out[k], atol=1e-6)
+
+    # MinMaxMetric over two updates
+    ours_mm = MinMaxMetric(MulticlassAccuracy(NC, average="micro"))
+    ref_mm = tm.MinMaxMetric(tm.classification.MulticlassAccuracy(NC, average="micro"))
+    for chunk in (slice(0, 32), slice(32, 64)):
+        ours_mm.update(jnp.asarray(_MC_PREDS[chunk]), jnp.asarray(_MC_TARGET[chunk]))
+        ref_mm.update(torch.tensor(_MC_PREDS[chunk]), torch.tensor(_MC_TARGET[chunk]))
+        ours_v = ours_mm.compute()
+        ref_v = ref_mm.compute()
+        for k in ("raw", "min", "max"):
+            _close(ours_v[k], ref_v[k])
+
+    # MultioutputWrapper over 2-column regression
+    rng = np.random.default_rng(206)
+    p = rng.normal(size=(N, 2)).astype(np.float32)
+    t = (p + rng.normal(size=(N, 2)) * 0.3).astype(np.float32)
+    ours_mo = MultioutputWrapper(MeanSquaredError(), num_outputs=2)
+    ref_mo = tm.MultioutputWrapper(tm.MeanSquaredError(), num_outputs=2)
+    ours_mo.update(jnp.asarray(p), jnp.asarray(t))
+    ref_mo.update(torch.tensor(p), torch.tensor(t))
+    ref_out = ref_mo.compute()
+    if isinstance(ref_out, (list, tuple)):
+        ref_out = torch.stack(list(ref_out))
+    _close(ours_mo.compute(), ref_out)
+
+
+def test_collection_with_compute_groups_parity(tm, torch):
+    from metrics_tpu import MetricCollection
+    from metrics_tpu.classification import MulticlassAccuracy, MulticlassPrecision, MulticlassRecall
+
+    ours = MetricCollection(
+        {
+            "acc": MulticlassAccuracy(NC, average="micro"),
+            "prec": MulticlassPrecision(NC, average="macro"),
+            "rec": MulticlassRecall(NC, average="macro"),
+        }
+    )
+    ref = tm.MetricCollection(
+        {
+            "acc": tm.classification.MulticlassAccuracy(num_classes=NC, average="micro"),
+            "prec": tm.classification.MulticlassPrecision(num_classes=NC, average="macro"),
+            "rec": tm.classification.MulticlassRecall(num_classes=NC, average="macro"),
+        }
+    )
+    for chunk in (slice(0, 20), slice(20, 64)):
+        ours.update(jnp.asarray(_MC_PREDS[chunk]), jnp.asarray(_MC_TARGET[chunk]))
+        ref.update(torch.tensor(_MC_PREDS[chunk]), torch.tensor(_MC_TARGET[chunk]))
+    ours_out = {k: float(v) for k, v in ours.compute().items()}
+    ref_out = {k: float(v) for k, v in ref.compute().items()}
+    assert set(ours_out) == set(ref_out)
+    for k in ref_out:
+        np.testing.assert_allclose(ours_out[k], ref_out[k], atol=1e-6, err_msg=k)
+
+
+def test_confusion_matrix_normalize_parity(tm, torch):
+    from metrics_tpu.functional.classification import multiclass_confusion_matrix
+
+    for normalize in (None, "true", "pred", "all"):
+        _close(
+            multiclass_confusion_matrix(jnp.asarray(_MC_PREDS), jnp.asarray(_MC_TARGET), NC, normalize=normalize),
+            tm.functional.classification.multiclass_confusion_matrix(
+                torch.tensor(_MC_PREDS), torch.tensor(_MC_TARGET), NC, normalize=normalize
+            ),
+        )
+
+
+def test_fbeta_and_specificity_variants_parity(tm, torch):
+    from metrics_tpu.functional.classification import multiclass_fbeta_score, multilabel_specificity
+
+    for beta in (0.5, 2.0):
+        _close(
+            multiclass_fbeta_score(jnp.asarray(_MC_PREDS), jnp.asarray(_MC_TARGET), beta=beta, num_classes=NC, average="weighted"),
+            tm.functional.classification.multiclass_fbeta_score(
+                torch.tensor(_MC_PREDS), torch.tensor(_MC_TARGET), beta=beta, num_classes=NC, average="weighted"
+            ),
+        )
+    for average in ("micro", "macro", None):
+        _close(
+            multilabel_specificity(jnp.asarray(_ML_PROBS), jnp.asarray(_ML_TARGET), NC, average=average),
+            tm.functional.classification.multilabel_specificity(
+                torch.tensor(_ML_PROBS), torch.tensor(_ML_TARGET), NC, average=average
+            ),
+        )

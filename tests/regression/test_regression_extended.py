@@ -95,14 +95,15 @@ def test_mse_logcosh_pearson_spearman_num_outputs():
 
 # --------------------------------------------------------------- Kendall variants
 def _tau_a(x, y):
-    """tau-a = (concordant - discordant) / C(n,2); scipy only implements b/c."""
+    """Reference tau-a convention: (C - D) / (C + D), ties excluded from the
+    denominator (reference kendall.py:184-185); scipy only implements b/c."""
     n = len(x)
-    con_minus_dis = 0
+    con, dis = 0, 0
     for i in range(n):
-        dx = np.sign(x[i + 1 :] - x[i])
-        dy = np.sign(y[i + 1 :] - y[i])
-        con_minus_dis += int(np.sum(dx * dy))
-    return con_minus_dis / (n * (n - 1) / 2)
+        s = np.sign(x[i + 1 :] - x[i]) * np.sign(y[i + 1 :] - y[i])
+        con += int(np.sum(s > 0))
+        dis += int(np.sum(s < 0))
+    return (con - dis) / (con + dis)
 
 
 @pytest.mark.parametrize("variant", ["a", "b", "c"])
