@@ -310,3 +310,106 @@ def test_fbeta_and_specificity_variants_parity(tm, torch):
                 torch.tensor(_ML_PROBS), torch.tensor(_ML_TARGET), NC, average=average
             ),
         )
+
+
+def test_at_operating_point_parity(tm, torch):
+    """specificity_at_sensitivity / recall_at_fixed_precision — the derived
+    operating-point metrics have the subtlest selection logic."""
+    from metrics_tpu.functional.classification import (
+        binary_recall_at_fixed_precision,
+        binary_specificity_at_sensitivity,
+        multilabel_recall_at_fixed_precision,
+    )
+
+    for min_sens in (0.3, 0.7):
+        spec, thr = binary_specificity_at_sensitivity(
+            jnp.asarray(_BIN_PROBS), jnp.asarray(_BIN_TARGET), min_sensitivity=min_sens
+        )
+        rspec, rthr = tm.functional.classification.binary_specificity_at_sensitivity(
+            torch.tensor(_BIN_PROBS), torch.tensor(_BIN_TARGET), min_sensitivity=min_sens
+        )
+        _close(spec, rspec)
+        _close(thr, rthr)
+
+    for min_prec in (0.4, 0.8):
+        rec, thr = binary_recall_at_fixed_precision(
+            jnp.asarray(_BIN_PROBS), jnp.asarray(_BIN_TARGET), min_precision=min_prec
+        )
+        rrec, rthr = tm.functional.classification.binary_recall_at_fixed_precision(
+            torch.tensor(_BIN_PROBS), torch.tensor(_BIN_TARGET), min_precision=min_prec
+        )
+        _close(rec, rrec)
+        _close(thr, rthr)
+
+    recs, thrs = multilabel_recall_at_fixed_precision(
+        jnp.asarray(_ML_PROBS), jnp.asarray(_ML_TARGET), NC, min_precision=0.5
+    )
+    rrecs, rthrs = tm.functional.classification.multilabel_recall_at_fixed_precision(
+        torch.tensor(_ML_PROBS), torch.tensor(_ML_TARGET), NC, min_precision=0.5
+    )
+    _close(recs, rrecs)
+    _close(thrs, rthrs)
+
+
+def test_binary_auroc_max_fpr_parity(tm, torch):
+    from metrics_tpu.functional.classification import binary_auroc
+
+    for max_fpr in (0.25, 0.5, 1.0):
+        _close(
+            binary_auroc(jnp.asarray(_BIN_PROBS), jnp.asarray(_BIN_TARGET), max_fpr=max_fpr),
+            tm.functional.classification.binary_auroc(
+                torch.tensor(_BIN_PROBS), torch.tensor(_BIN_TARGET), max_fpr=max_fpr
+            ),
+        )
+
+
+def test_bleu_weights_parity(tm, torch):
+    from metrics_tpu.functional.text import bleu_score
+
+    preds = ["the cat sat on the mat there", "jax goes very fast on tpus"]
+    targets = [["a cat sat on the mat"], ["jax goes fast on tpu hardware"]]
+    for n_gram in (1, 2, 4):
+        _close(
+            bleu_score(preds, targets, n_gram=n_gram),
+            tm.functional.bleu_score(preds, targets, n_gram=n_gram),
+        )
+    _close(
+        bleu_score(preds, targets, smooth=True),
+        tm.functional.bleu_score(preds, targets, smooth=True),
+    )
+
+
+def test_ssim_kernel_options_parity(tm, torch):
+    from metrics_tpu.functional.image import structural_similarity_index_measure
+
+    rng = np.random.default_rng(207)
+    preds = rng.random((2, 1, 48, 48)).astype(np.float32)
+    target = (preds * 0.8 + rng.random((2, 1, 48, 48)) * 0.2).astype(np.float32)
+    for kwargs in (dict(kernel_size=7, sigma=1.0), dict(gaussian_kernel=False, kernel_size=9)):
+        _close(
+            structural_similarity_index_measure(jnp.asarray(preds), jnp.asarray(target), data_range=1.0, **kwargs),
+            tm.functional.structural_similarity_index_measure(
+                torch.tensor(preds), torch.tensor(target), data_range=1.0, **kwargs
+            ),
+            atol=1e-4,
+        )
+
+
+def test_exact_curves_with_ignore_index_parity(tm, torch):
+    from metrics_tpu.functional.classification import binary_average_precision, binary_roc
+
+    target = _BIN_TARGET.copy()
+    target[::6] = -1
+    f, tp_, th = binary_roc(jnp.asarray(_BIN_PROBS), jnp.asarray(target), ignore_index=-1)
+    rf, rtp, rth = tm.functional.classification.binary_roc(
+        torch.tensor(_BIN_PROBS), torch.tensor(target), ignore_index=-1
+    )
+    _close(f, rf)
+    _close(tp_, rtp)
+    _close(th, rth)
+    _close(
+        binary_average_precision(jnp.asarray(_BIN_PROBS), jnp.asarray(target), ignore_index=-1),
+        tm.functional.classification.binary_average_precision(
+            torch.tensor(_BIN_PROBS), torch.tensor(target), ignore_index=-1
+        ),
+    )
