@@ -1,0 +1,27 @@
+"""Pin the compiled-program scaling property benchmarks/scaling.py measures:
+metric sync lowers to ONE fused all-reduce whose payload is O(state) —
+identical bytes at different world sizes."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+
+@pytest.mark.parametrize("worlds", [(2, 8)])
+def test_sync_payload_world_size_independent(worlds):
+    import benchmarks.scaling as scaling
+
+    if len(jax.devices()) < max(worlds):
+        pytest.skip(f"needs {max(worlds)} devices")
+    stats = []
+    for w in worlds:
+        hlo = scaling._lower(Mesh(np.array(jax.devices()[:w]), ("dp",)))
+        stats.append(scaling._collective_stats(hlo))
+
+    counts = {c for c, _ in stats}
+    payloads = {p for _, p in stats}
+    assert counts == {1}, f"expected one fused all-reduce, got {stats}"
+    assert len(payloads) == 1 and payloads.pop() > 0, f"payload varied with world size: {stats}"
