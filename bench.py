@@ -196,6 +196,29 @@ def main() -> None:
         }
         if degraded_reason:
             record["degraded"] = f"accelerator unavailable: {degraded_reason}"
+    import os
+
+    results_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "benchmarks", "results_tpu_v5e.json")
+    if degraded_reason:
+        # Attach the accelerator-run history as clearly-labelled context. The file
+        # is maintained by the branch below — every record in it is a verbatim
+        # artifact of a previous successful accelerator run of this script.
+        try:
+            with open(results_path) as fh:
+                record["last_known_tpu"] = json.load(fh)
+        except Exception as exc:  # noqa: BLE001 — context is optional, but say why it's missing
+            record["last_known_tpu_error"] = repr(exc)
+    elif record.get("backend") not in (None, "cpu") and "error" not in record:
+        # Successful accelerator run: append this record verbatim so future
+        # degraded runs carry provenance-clean hardware evidence.
+        try:
+            with open(results_path) as fh:
+                history = json.load(fh)
+            history.setdefault("runs", []).append(record)
+            with open(results_path, "w") as fh:
+                json.dump(history, fh, indent=1)
+        except Exception as exc:  # noqa: BLE001 — recording must never break the artifact
+            record["results_log_error"] = repr(exc)
     print(json.dumps(record))
 
 
