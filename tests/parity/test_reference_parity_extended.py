@@ -413,3 +413,83 @@ def test_exact_curves_with_ignore_index_parity(tm, torch):
             torch.tensor(_BIN_PROBS), torch.tensor(target), ignore_index=-1
         ),
     )
+
+
+def test_streaming_module_parity_across_domains(tm, torch):
+    """Uneven-chunk streaming through module classes in several domains — the
+    accumulate/merge bookkeeping, not just the math."""
+    from metrics_tpu.classification import MulticlassAUROC
+    from metrics_tpu.image import PeakSignalNoiseRatio, StructuralSimilarityIndexMeasure
+    from metrics_tpu.regression import MeanSquaredError, PearsonCorrCoef
+    from metrics_tpu.text import BLEUScore, CharErrorRate
+
+    rng = np.random.default_rng(208)
+
+    # image: psnr + ssim over 3 chunks of 4-D batches
+    preds = rng.random((6, 3, 32, 32)).astype(np.float32)
+    target = (preds * 0.8 + rng.random((6, 3, 32, 32)) * 0.2).astype(np.float32)
+    pairs = [
+        (PeakSignalNoiseRatio(data_range=1.0), tm.PeakSignalNoiseRatio(data_range=1.0), 1e-4),
+        (StructuralSimilarityIndexMeasure(data_range=1.0), tm.StructuralSimilarityIndexMeasure(data_range=1.0), 1e-4),
+    ]
+    for ours, ref, atol in pairs:
+        for chunk in (slice(0, 1), slice(1, 4), slice(4, 6)):
+            ours.update(jnp.asarray(preds[chunk]), jnp.asarray(target[chunk]))
+            ref.update(torch.tensor(preds[chunk]), torch.tensor(target[chunk]))
+        _close(ours.compute(), ref.compute(), atol=atol)
+
+    # regression: moment states across chunks
+    p = rng.normal(size=90).astype(np.float32)
+    t = (p * 0.6 + rng.normal(size=90) * 0.5).astype(np.float32)
+    for ours, ref in ((MeanSquaredError(), tm.MeanSquaredError()), (PearsonCorrCoef(), tm.PearsonCorrCoef())):
+        for chunk in (slice(0, 13), slice(13, 50), slice(50, 90)):
+            ours.update(jnp.asarray(p[chunk]), jnp.asarray(t[chunk]))
+            ref.update(torch.tensor(p[chunk]), torch.tensor(t[chunk]))
+        _close(ours.compute(), ref.compute(), atol=1e-4)
+
+    # classification: AUROC exact mode accumulates raw preds/targets as lists
+    probs = rng.random((60, NC)).astype(np.float32)
+    probs = probs / probs.sum(-1, keepdims=True)
+    labels = rng.integers(0, NC, 60)
+    ours_a = MulticlassAUROC(NC, average="macro")
+    ref_a = tm.classification.MulticlassAUROC(num_classes=NC, average="macro")
+    for chunk in (slice(0, 7), slice(7, 31), slice(31, 60)):
+        ours_a.update(jnp.asarray(probs[chunk]), jnp.asarray(labels[chunk]))
+        ref_a.update(torch.tensor(probs[chunk]), torch.tensor(labels[chunk]))
+    _close(ours_a.compute(), ref_a.compute())
+
+    # text: BLEU n-gram counter states and CER edit counts
+    preds_txt = ["the cat is on the mat", "hello there general kenobi", "jax goes fast"]
+    target_txt = [["a cat is on the mat"], ["hello there !"], ["jax goes very fast"]]
+    ours_b, ref_b = BLEUScore(), tm.BLEUScore()
+    ours_c, ref_c = CharErrorRate(), tm.CharErrorRate()
+    for i in range(3):
+        ours_b.update([preds_txt[i]], [target_txt[i]])
+        ref_b.update([preds_txt[i]], [target_txt[i]])
+        ours_c.update([preds_txt[i]], [target_txt[i][0]])
+        ref_c.update([preds_txt[i]], [target_txt[i][0]])
+    _close(ours_b.compute(), ref_b.compute())
+    _close(ours_c.compute(), ref_c.compute())
+
+
+def test_task_facade_dispatch_parity(tm, torch):
+    """The task= facades dispatch to the same variants with the same results."""
+    from metrics_tpu import Accuracy, F1Score, StatScores
+
+    ours = Accuracy(task="multiclass", num_classes=NC, average="macro")
+    ref = tm.Accuracy(task="multiclass", num_classes=NC, average="macro")
+    ours.update(jnp.asarray(_MC_PREDS), jnp.asarray(_MC_TARGET))
+    ref.update(torch.tensor(_MC_PREDS), torch.tensor(_MC_TARGET))
+    _close(ours.compute(), ref.compute())
+
+    ours_f = F1Score(task="multilabel", num_labels=NC)
+    ref_f = tm.F1Score(task="multilabel", num_labels=NC)
+    ours_f.update(jnp.asarray(_ML_PROBS), jnp.asarray(_ML_TARGET))
+    ref_f.update(torch.tensor(_ML_PROBS), torch.tensor(_ML_TARGET))
+    _close(ours_f.compute(), ref_f.compute())
+
+    ours_s = StatScores(task="binary")
+    ref_s = tm.StatScores(task="binary")
+    ours_s.update(jnp.asarray(_BIN_PROBS), jnp.asarray(_BIN_TARGET))
+    ref_s.update(torch.tensor(_BIN_PROBS), torch.tensor(_BIN_TARGET))
+    _close(ours_s.compute(), ref_s.compute())
