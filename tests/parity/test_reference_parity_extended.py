@@ -493,3 +493,33 @@ def test_task_facade_dispatch_parity(tm, torch):
     ours_s.update(jnp.asarray(_BIN_PROBS), jnp.asarray(_BIN_TARGET))
     ref_s.update(torch.tensor(_BIN_PROBS), torch.tensor(_BIN_TARGET))
     _close(ours_s.compute(), ref_s.compute())
+
+
+def test_tracker_parity(tm, torch):
+    from metrics_tpu import MetricTracker
+    from metrics_tpu.classification import MulticlassAccuracy
+
+    rng = np.random.default_rng(209)
+    ours = MetricTracker(MulticlassAccuracy(NC, average="micro"))
+    ref = tm.MetricTracker(tm.classification.MulticlassAccuracy(num_classes=NC, average="micro"))
+    for _ in range(3):
+        p = rng.integers(0, NC, 40)
+        t = rng.integers(0, NC, 40)
+        ours.increment()
+        ref.increment()
+        ours.update(jnp.asarray(p), jnp.asarray(t))
+        ref.update(torch.tensor(p), torch.tensor(t))
+    _close(ours.compute_all(), ref.compute_all())
+    ours_best, ours_step = ours.best_metric(return_step=True)
+    ref_best, ref_step = ref.best_metric(return_step=True)
+    np.testing.assert_allclose(float(ours_best), float(ref_best), atol=1e-6)
+    assert int(ours_step) == int(ref_step)
+
+
+def test_nominal_matrix_parity(tm, torch):
+    from metrics_tpu.functional.nominal import cramers_v_matrix, theils_u_matrix
+
+    rng = np.random.default_rng(210)
+    m = rng.integers(0, 4, size=(150, 3))
+    _close(cramers_v_matrix(jnp.asarray(m)), tm.functional.nominal.cramers_v_matrix(torch.tensor(m)), atol=1e-5)
+    _close(theils_u_matrix(jnp.asarray(m)), tm.functional.nominal.theils_u_matrix(torch.tensor(m)), atol=1e-5)
