@@ -523,3 +523,18 @@ def test_nominal_matrix_parity(tm, torch):
     m = rng.integers(0, 4, size=(150, 3))
     _close(cramers_v_matrix(jnp.asarray(m)), tm.functional.nominal.cramers_v_matrix(torch.tensor(m)), atol=1e-5)
     _close(theils_u_matrix(jnp.asarray(m)), tm.functional.nominal.theils_u_matrix(torch.tensor(m)), atol=1e-5)
+
+
+def test_psnr_dim_reduction_parity(tm, torch):
+    from metrics_tpu.functional.image import peak_signal_noise_ratio
+
+    rng = np.random.default_rng(211)
+    preds = rng.random((4, 3, 16, 16)).astype(np.float32)
+    target = (preds * 0.9 + rng.random((4, 3, 16, 16)) * 0.1).astype(np.float32)
+    for kwargs in (dict(dim=(1, 2, 3), data_range=1.0), dict(dim=(1, 2, 3), data_range=1.0, reduction="none"),
+                   dict(dim=(1, 2, 3), data_range=1.0, reduction="sum"), dict(base=2.0, data_range=1.0)):
+        _close(
+            peak_signal_noise_ratio(jnp.asarray(preds), jnp.asarray(target), **kwargs),
+            tm.functional.peak_signal_noise_ratio(torch.tensor(preds), torch.tensor(target), **kwargs),
+            atol=1e-4,
+        )
