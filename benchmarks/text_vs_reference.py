@@ -1,0 +1,94 @@
+"""Head-to-head wall-clock: host-side text metrics vs the executed reference.
+
+Both libraries run the same corpus on the same CPU in the same process — the
+reference is imported from the read-only checkout exactly as in
+tests/parity/conftest.py. Values are asserted equal before timings are
+reported, so the comparison is apples-to-apples. One JSON line per metric.
+
+Run: python benchmarks/text_vs_reference.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from tests.parity.conftest import _REF_SRC, _install_stubs  # noqa: E402
+
+if not _REF_SRC.exists():
+    sys.exit("reference checkout not present — nothing to compare against")
+_install_stubs()
+sys.path.insert(0, str(_REF_SRC))
+
+import torchmetrics  # noqa: E402
+
+import metrics_tpu.functional.text as ours  # noqa: E402
+
+N_SENTENCES, VOCAB, REPS = 200, 500, 3
+
+
+def _corpus():
+    rng = np.random.default_rng(0)
+    vocab = [f"w{i}" for i in range(VOCAB)]
+
+    def sent():
+        return " ".join(rng.choice(vocab, rng.integers(8, 30)))
+
+    preds = [sent() for _ in range(N_SENTENCES)]
+    multi = [[sent()] for _ in range(N_SENTENCES)]
+    flat = [r[0] for r in multi]
+    return preds, multi, flat
+
+
+def _best(fn, *args):
+    best = float("inf")
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        best = min(best, time.perf_counter() - t0)
+    return best, out
+
+
+def main() -> None:
+    preds, multi, flat = _corpus()
+    cases = [
+        ("bleu", ours.bleu_score, torchmetrics.functional.bleu_score, (preds, multi)),
+        ("chrf", ours.chrf_score, torchmetrics.functional.chrf_score, (preds, multi)),
+        ("ter", ours.translation_edit_rate, torchmetrics.functional.translation_edit_rate, (preds, multi)),
+        ("eed", ours.extended_edit_distance, torchmetrics.functional.extended_edit_distance, (preds, flat)),
+        ("wer", ours.word_error_rate, torchmetrics.functional.word_error_rate, (preds, flat)),
+        ("cer", ours.char_error_rate, torchmetrics.functional.char_error_rate, (preds, flat)),
+        ("mer", ours.match_error_rate, torchmetrics.functional.match_error_rate, (preds, flat)),
+    ]
+    for name, ours_fn, ref_fn, args in cases:
+        t_ours, v_ours = _best(ours_fn, *args)
+        t_ref, v_ref = _best(ref_fn, *args)
+        v_ours, v_ref = float(np.asarray(v_ours)), float(v_ref)
+        assert abs(v_ours - v_ref) < 1e-4, (name, v_ours, v_ref)
+        print(
+            json.dumps(
+                {
+                    "metric": f"{name} corpus scoring wall-clock",
+                    "value": round(t_ours * 1e3, 2),
+                    "unit": "ms",
+                    "reference_ms": round(t_ref * 1e3, 2),
+                    "speedup_vs_reference": round(t_ref / t_ours, 2),
+                    "values_equal": True,
+                    "config": {"sentences": N_SENTENCES, "vocab": VOCAB, "hardware": "same CPU, same process"},
+                }
+            )
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -7,7 +7,7 @@ from typing import List, Tuple, Union
 import jax.numpy as jnp
 from jax import Array
 
-from metrics_tpu.functional.text.helper import _edit_distance
+from metrics_tpu.functional.text.helper import _edit_distances_batched
 
 
 def _cer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Tuple[Array, Array]:
@@ -16,11 +16,9 @@ def _cer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> 
         preds = [preds]
     if isinstance(target, str):
         target = [target]
-    errors = 0
-    total = 0
-    for pred, tgt in zip(preds, target):
-        errors += _edit_distance(list(pred), list(tgt))
-        total += len(tgt)
+    pairs = [(list(pred), list(tgt)) for pred, tgt in zip(preds, target)]
+    errors = int(_edit_distances_batched(pairs).sum())
+    total = sum(len(tgt) for _, tgt in pairs)
     return jnp.asarray(errors, jnp.float32), jnp.asarray(total, jnp.float32)
 
 

@@ -1,14 +1,17 @@
-"""Shared text helpers: corpus validation and a vectorized Levenshtein kernel.
+"""Shared text helpers: corpus validation and a batched Levenshtein kernel.
 
 Reference parity: src/torchmetrics/functional/text/helper.py (`_validate_inputs` :298,
-`_edit_distance` :333). TPU-first redesign: the reference's O(n·m) pure-Python DP loop
-is replaced by a wavefront formulation with only ONE Python loop (over the shorter
-sequence) and numpy vector work per row — the within-row insertion dependency
-``dp[j] = min(dp[j-1] + 1, cand[j])`` is solved in closed form as a running prefix-min
-of ``cand[j] - j`` (all insertion costs are 1), i.e. ``np.minimum.accumulate``.
+`_edit_distance` :333). Redesign: the reference runs an O(n·m) pure-Python DP per
+pair; here the row recurrence runs in LOCKSTEP ACROSS THE WHOLE CORPUS on padded
+(P, max_m) numpy arrays — the Python loop count drops from sum(n_p) to max(n_p).
+The within-row insertion dependency ``dp[j] = min(dp[j-1] + 1, cand[j])`` is solved
+in closed form as a running prefix-min of ``cand[j] - j`` (all insertion costs are
+1), i.e. ``np.minimum.accumulate``. Pairs are grouped into geometric length bands
+so outliers never inflate the padding of the rest of the corpus (measured 4.3-8.8x
+faster than the reference on WER/CER/MER corpora — benchmarks/text_vs_reference.py).
 
 String tokenization itself stays on host (SURVEY §2.5: state is small tensors; the
-algorithms are not worth jitting), but every per-row step is vectorized.
+algorithms are not worth jitting), but every DP step is a wide vector op.
 """
 
 from __future__ import annotations
@@ -16,6 +19,8 @@ from __future__ import annotations
 from typing import Dict, Hashable, List, Sequence, Tuple, Union
 
 import numpy as np
+
+_BUCKET = 512  # pairs per padded-DP bucket (see _edit_distances_batched)
 
 
 def _validate_inputs(
@@ -38,43 +43,84 @@ def _validate_inputs(
     return reference_corpus, hypothesis_corpus
 
 
-def _tokens_to_ids(*token_seqs: Sequence[Hashable]) -> List[np.ndarray]:
-    """Map arbitrary hashable tokens to a shared int32 id space (host-side)."""
+def _edit_distance(prediction_tokens: Sequence[Hashable], reference_tokens: Sequence[Hashable]) -> int:
+    """Levenshtein distance of one pair — thin wrapper over the batched kernel."""
+    return int(_edit_distances_batched([(prediction_tokens, reference_tokens)])[0])
+
+
+def _edit_distances_batched(pairs: Sequence[Tuple[Sequence[Hashable], Sequence[Hashable]]]) -> np.ndarray:
+    """Levenshtein distances for a whole corpus of pairs in ONE padded DP.
+
+    The per-pair kernel above still pays ~6 small-numpy calls per DP row, which
+    dominates for word-level pairs (tens of tokens). Here the row recurrence
+    runs in lockstep across all P pairs on (P, max_m) arrays — the Python loop
+    count drops from sum(n_p) to max(n_p) and every step is a wide vector op.
+    Each pair is oriented so its longer side is the row axis (Levenshtein is
+    symmetric), which minimizes the padded column width. Pads use distinct
+    sentinels (-1 vs -2) so padding never matches.
+    """
+    P = len(pairs)
+    if P == 0:
+        return np.zeros(0, dtype=np.int64)
+    # Length-bucket so outlier-length pairs don't pad the whole corpus to their
+    # size (the DP is O(P * max_n * max_m) over the padded shapes). Buckets are
+    # geometric length bands (powers of two of the longer side), so within a
+    # bucket padding wastes at most ~2x per axis, and an outlier only ever
+    # shares a bucket with pairs of its own magnitude. Bands are further split
+    # into chunks of _BUCKET pairs to bound the DP arrays.
+    lengths = [max(len(a), len(b)) for a, b in pairs]
+    bands: Dict[int, List[int]] = {}
+    for p, ln in enumerate(lengths):
+        bands.setdefault(max(ln, 1).bit_length(), []).append(p)
+    if len(bands) > 1 or P > _BUCKET:
+        result = np.zeros(P, dtype=np.int64)
+        for members in bands.values():
+            for lo in range(0, len(members), _BUCKET):
+                idx = members[lo : lo + _BUCKET]
+                result[idx] = _edit_distances_batched_same_band([pairs[p] for p in idx])
+        return result
+    return _edit_distances_batched_same_band(pairs)
+
+
+def _edit_distances_batched_same_band(pairs: Sequence[Tuple[Sequence[Hashable], Sequence[Hashable]]]) -> np.ndarray:
+    """The padded lockstep DP for one length band (see _edit_distances_batched)."""
+    P = len(pairs)
     vocab: Dict[Hashable, int] = {}
-    out = []
-    for seq in token_seqs:
-        ids = np.empty(len(seq), dtype=np.int32)
+
+    def ids(seq: Sequence[Hashable]) -> np.ndarray:
+        out = np.empty(len(seq), dtype=np.int64)
         for i, tok in enumerate(seq):
             if tok not in vocab:
                 vocab[tok] = len(vocab)
-            ids[i] = vocab[tok]
-        out.append(ids)
-    return out
+            out[i] = vocab[tok]
+        return out
 
+    rows, cols = [], []
+    for a, b in pairs:
+        a, b = (a, b) if len(a) >= len(b) else (b, a)  # rows = longer side
+        rows.append(ids(a))
+        cols.append(ids(b))
+    n_p = np.asarray([len(r) for r in rows])
+    m_p = np.asarray([len(c) for c in cols])
+    max_n, max_m = int(n_p.max()), int(m_p.max())
 
-def _edit_distance(prediction_tokens: Sequence[Hashable], reference_tokens: Sequence[Hashable]) -> int:
-    """Levenshtein distance via a vectorized row recurrence.
+    preds = np.full((P, max_n), -1, dtype=np.int64)
+    refs = np.full((P, max_m if max_m else 1), -2, dtype=np.int64)
+    for p in range(P):
+        preds[p, : n_p[p]] = rows[p]
+        refs[p, : m_p[p]] = cols[p]
 
-    Same contract as reference helper.py:333-353; unit costs. Row recurrence:
-    ``cand[j] = min(prev[j] + 1, prev[j-1] + sub_cost[j])`` is elementwise; the
-    remaining within-row term ``dp[j] = min(cand[j], dp[j-1] + 1)`` equals
-    ``j + running_min(cand[k] - k, k <= j)`` and is computed with minimum.accumulate.
-    """
-    pred_ids, ref_ids = _tokens_to_ids(prediction_tokens, reference_tokens)
-    n, m = len(pred_ids), len(ref_ids)
-    if n == 0:
-        return m
-    if m == 0:
-        return n
-    # iterate over the shorter axis to minimize Python-loop iterations
-    if n < m:
-        pred_ids, ref_ids, n, m = ref_ids, pred_ids, m, n
-
-    prev = np.arange(m + 1, dtype=np.int64)
-    offsets = prev  # [0, 1, ..., m] — reused as the prefix-min offset vector
-    for i in range(1, n + 1):
-        sub = prev[:-1] + (ref_ids != pred_ids[i - 1])
-        cand = np.minimum(prev[1:] + 1, sub)
-        cand = np.concatenate(([i], cand))
-        prev = np.minimum.accumulate(cand - offsets) + offsets
-    return int(prev[-1])
+    result = np.where(n_p == 0, m_p, 0).astype(np.int64)
+    offsets = np.arange(refs.shape[1] + 1, dtype=np.int64)
+    prev = np.broadcast_to(offsets, (P, offsets.shape[0])).copy()
+    col = np.empty((P, 1), dtype=np.int64)
+    for i in range(1, max_n + 1):
+        sub = prev[:, :-1] + (refs != preds[:, i - 1 : i])
+        cand = np.minimum(prev[:, 1:] + 1, sub)
+        col[:] = i
+        cand = np.concatenate([col, cand], axis=1)
+        prev = np.minimum.accumulate(cand - offsets, axis=1) + offsets
+        done = n_p == i
+        if done.any():
+            result[done] = prev[done, m_p[done]]
+    return result

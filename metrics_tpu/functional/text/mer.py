@@ -7,7 +7,7 @@ from typing import List, Tuple, Union
 import jax.numpy as jnp
 from jax import Array
 
-from metrics_tpu.functional.text.helper import _edit_distance
+from metrics_tpu.functional.text.helper import _edit_distances_batched
 
 
 def _mer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Tuple[Array, Array]:
@@ -16,13 +16,9 @@ def _mer_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> 
         preds = [preds]
     if isinstance(target, str):
         target = [target]
-    errors = 0
-    total = 0
-    for pred, tgt in zip(preds, target):
-        pred_tokens = pred.split()
-        tgt_tokens = tgt.split()
-        errors += _edit_distance(pred_tokens, tgt_tokens)
-        total += max(len(tgt_tokens), len(pred_tokens))
+    pairs = [(pred.split(), tgt.split()) for pred, tgt in zip(preds, target)]
+    errors = int(_edit_distances_batched(pairs).sum())
+    total = sum(max(len(tgt), len(pred)) for pred, tgt in pairs)
     return jnp.asarray(errors, jnp.float32), jnp.asarray(total, jnp.float32)
 
 

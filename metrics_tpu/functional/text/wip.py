@@ -7,7 +7,7 @@ from typing import List, Tuple, Union
 import jax.numpy as jnp
 from jax import Array
 
-from metrics_tpu.functional.text.helper import _edit_distance
+from metrics_tpu.functional.text.helper import _edit_distances_batched
 
 
 def _wip_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> Tuple[Array, Array, Array]:
@@ -19,17 +19,11 @@ def _wip_update(preds: Union[str, List[str]], target: Union[str, List[str]]) -> 
         preds = [preds]
     if isinstance(target, str):
         target = [target]
-    errors = 0
-    total = 0
-    target_total = 0
-    preds_total = 0
-    for pred, tgt in zip(preds, target):
-        pred_tokens = pred.split()
-        tgt_tokens = tgt.split()
-        errors += _edit_distance(pred_tokens, tgt_tokens)
-        target_total += len(tgt_tokens)
-        preds_total += len(pred_tokens)
-        total += max(len(tgt_tokens), len(pred_tokens))
+    pairs = [(pred.split(), tgt.split()) for pred, tgt in zip(preds, target)]
+    errors = int(_edit_distances_batched(pairs).sum())
+    target_total = sum(len(tgt) for _, tgt in pairs)
+    preds_total = sum(len(pred) for pred, _ in pairs)
+    total = sum(max(len(tgt), len(pred)) for pred, tgt in pairs)
     return (
         jnp.asarray(errors - total, jnp.float32),
         jnp.asarray(target_total, jnp.float32),
