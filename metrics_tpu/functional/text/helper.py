@@ -68,10 +68,11 @@ def _edit_distances_batched(pairs: Sequence[Tuple[Sequence[Hashable], Sequence[H
     # bucket padding wastes at most ~2x per axis, and an outlier only ever
     # shares a bucket with pairs of its own magnitude. Bands are further split
     # into chunks of _BUCKET pairs to bound the DP arrays.
-    lengths = [max(len(a), len(b)) for a, b in pairs]
-    bands: Dict[int, List[int]] = {}
-    for p, ln in enumerate(lengths):
-        bands.setdefault(max(ln, 1).bit_length(), []).append(p)
+    bands: Dict[Tuple[int, int], List[int]] = {}
+    for p, (a, b) in enumerate(pairs):
+        n, m = (len(a), len(b)) if len(a) >= len(b) else (len(b), len(a))
+        # key on BOTH axes so a band never pads short columns to a long max_m
+        bands.setdefault((max(n, 1).bit_length(), max(m, 1).bit_length()), []).append(p)
     if len(bands) > 1 or P > _BUCKET:
         result = np.zeros(P, dtype=np.int64)
         for members in bands.values():

@@ -174,6 +174,12 @@ class _LevenshteinEditDistance:
         costs[0] = np.arange(m + 1, dtype=np.float64)
         ops[0] = _OP_INSERT
 
+        # Typical tercom rows are a narrow beam window (tens of cells); plain
+        # Python beats numpy's per-op overhead there. Wide rows take the
+        # vectorized prefix-min path below.
+        if m < 64:
+            return self._scalar_rows(pred_ids, prediction_len, length_ratio, beam_width, costs, ops)
+
         offsets = np.arange(m + 1, dtype=np.float64)
         for i in range(1, prediction_len + 1):
             pseudo_diag = math.floor(i * length_ratio)
@@ -206,6 +212,59 @@ class _LevenshteinEditDistance:
                 row_ops[0] = _OP_DELETE
             ops[i, w0:w1] = row_ops
 
+        trace = self._get_trace(prediction_len, ops)
+        return int(costs[-1, -1]), trace
+
+    def _scalar_rows(
+        self,
+        pred_ids: np.ndarray,
+        prediction_len: int,
+        length_ratio: float,
+        beam_width: int,
+        costs: np.ndarray,
+        ops: np.ndarray,
+    ) -> Tuple[int, Tuple[int, ...]]:
+        """Plain-Python row loop — same recurrence, window, and tie order as the
+        vectorized path; faster when the beam window is a handful of cells."""
+        m = self.reference_len
+        ref = self._ref_ids.tolist()
+        pred = pred_ids.tolist()
+        inf = float(_INT_INFINITY)
+        prev = list(range(m + 1))
+        prev = [float(v) for v in prev]
+        for i in range(1, prediction_len + 1):
+            pseudo_diag = math.floor(i * length_ratio)
+            min_j = max(0, pseudo_diag - beam_width)
+            max_j = m + 1 if i == prediction_len else min(m + 1, pseudo_diag + beam_width)
+            if min_j >= max_j:
+                prev = [inf] * (m + 1)  # mirror the vectorized path: row stays INF
+                continue
+            cur = [inf] * (m + 1)
+            row_ops = ops[i]
+            p_tok = pred[i - 1]
+            left = inf
+            for j in range(min_j, max_j):
+                if j == 0:
+                    c = prev[0] + 1.0
+                    op = _OP_DELETE
+                else:
+                    diag = prev[j - 1] + (0.0 if ref[j - 1] == p_tok else 1.0)
+                    up = prev[j] + 1.0
+                    ins = left + 1.0
+                    c = diag if diag <= up else up
+                    if ins < c:
+                        c = ins
+                    if c == diag:
+                        op = _OP_NOTHING if ref[j - 1] == p_tok else _OP_SUBSTITUTE
+                    elif c == up:
+                        op = _OP_DELETE
+                    else:
+                        op = _OP_INSERT
+                cur[j] = c
+                left = c
+                row_ops[j] = op
+            costs[i] = cur
+            prev = cur
         trace = self._get_trace(prediction_len, ops)
         return int(costs[-1, -1]), trace
 

@@ -1,0 +1,131 @@
+"""Fuzz the two text edit-distance kernels against independent oracles.
+
+1. `_edit_distances_batched` (the banded corpus DP behind WER/CER/MER/WIL/WIP)
+   vs a naive O(n·m) per-pair DP, including cross-band mixes and degenerate
+   shapes.
+2. The TER tercom DP's scalar row path (narrow beam windows, m<64) vs its
+   vectorized prefix-min path — cost AND op trace must be identical, since the
+   shift search consumes the trace.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import pytest
+
+import metrics_tpu.functional.text.ter as ter_mod
+from metrics_tpu.functional.text.helper import _edit_distance, _edit_distances_batched
+
+
+def _naive_levenshtein(a, b) -> int:
+    n, m = len(a), len(b)
+    dp = np.zeros((n + 1, m + 1), dtype=np.int64)
+    dp[0] = np.arange(m + 1)
+    dp[:, 0] = np.arange(n + 1)
+    for i in range(1, n + 1):
+        for j in range(1, m + 1):
+            dp[i, j] = min(dp[i - 1, j - 1] + (a[i - 1] != b[j - 1]), dp[i - 1, j] + 1, dp[i, j - 1] + 1)
+    return int(dp[n, m])
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_batched_edit_distance_vs_naive(seed):
+    rng = np.random.default_rng(seed)
+    vocab = list("abcdefgh")
+    pairs = [
+        (list(rng.choice(vocab, rng.integers(0, 45))), list(rng.choice(vocab, rng.integers(0, 45))))
+        for _ in range(120)
+    ]
+    # degenerate and cross-band shapes
+    pairs += [([], []), (["a"], []), ([], ["b", "c"]), (list(rng.choice(vocab, 300)), ["a"]),
+              (list(rng.choice(vocab, 300)), list(rng.choice(vocab, 290)))]
+    got = _edit_distances_batched(pairs)
+    for i, (a, b) in enumerate(pairs):
+        assert got[i] == _naive_levenshtein(a, b), (i, a, b)
+
+
+def test_single_pair_wrapper_matches_batched():
+    rng = np.random.default_rng(2)
+    a = list(rng.choice(list("abc"), 20))
+    b = list(rng.choice(list("abc"), 25))
+    assert _edit_distance(a, b) == _naive_levenshtein(a, b)
+
+
+class _VectorizedOnly(ter_mod._LevenshteinEditDistance):
+    """Force the vectorized branch regardless of reference length."""
+
+    def _levenshtein_edit_distance(self, prediction_tokens):
+        prediction_len = len(prediction_tokens)
+        m = self.reference_len
+        ref_ids = self._ref_ids
+        pred_ids = self._to_ids(prediction_tokens)
+        length_ratio = m / prediction_len if prediction_tokens else 1.0
+        beam_width = (
+            math.ceil(length_ratio / 2 + ter_mod._BEAM_WIDTH)
+            if length_ratio / 2 > ter_mod._BEAM_WIDTH
+            else ter_mod._BEAM_WIDTH
+        )
+        costs = np.full((prediction_len + 1, m + 1), float(ter_mod._INT_INFINITY))
+        ops = np.full((prediction_len + 1, m + 1), ter_mod._OP_UNDEFINED, dtype=np.int8)
+        costs[0] = np.arange(m + 1, dtype=np.float64)
+        ops[0] = ter_mod._OP_INSERT
+        offsets = np.arange(m + 1, dtype=np.float64)
+        for i in range(1, prediction_len + 1):
+            pseudo_diag = math.floor(i * length_ratio)
+            min_j = max(0, pseudo_diag - beam_width)
+            max_j = m + 1 if i == prediction_len else min(m + 1, pseudo_diag + beam_width)
+            if min_j >= max_j:
+                continue
+            prev = costs[i - 1]
+            sub_cost = (ref_ids != pred_ids[i - 1]).astype(np.float64)
+            diag = np.concatenate(([float(ter_mod._INT_INFINITY)], prev[:-1] + sub_cost))
+            up = prev + 1.0
+            cand = np.minimum(diag, up)
+            if min_j == 0:
+                cand[0] = prev[0] + 1.0
+            w0, w1 = min_j, max_j
+            window = cand[w0:w1] - offsets[w0:w1]
+            row = np.minimum.accumulate(window) + offsets[w0:w1]
+            costs[i, w0:w1] = row
+            j_idx = np.arange(w0, w1)
+            is_sub = row == diag[w0:w1]
+            is_del = row == up[w0:w1]
+            row_ops = np.where(
+                is_sub,
+                np.where(sub_cost[j_idx - 1] == 0, ter_mod._OP_NOTHING, ter_mod._OP_SUBSTITUTE),
+                np.where(is_del, ter_mod._OP_DELETE, ter_mod._OP_INSERT),
+            )
+            if min_j == 0:
+                row_ops[0] = ter_mod._OP_DELETE
+            ops[i, w0:w1] = row_ops
+        trace = self._get_trace(prediction_len, ops)
+        return int(costs[-1, -1]), trace
+
+
+@pytest.mark.parametrize("seed", [3, 4])
+def test_ter_scalar_rows_match_vectorized(seed):
+    """The m<64 scalar fast path and the vectorized path must agree exactly —
+    cost AND op trace (the shift search replays the trace)."""
+    rng = np.random.default_rng(seed)
+    vocab = [f"w{i}" for i in range(25)]
+    for _ in range(150):
+        ref = list(rng.choice(vocab, rng.integers(1, 50)))
+        hyp = list(rng.choice(vocab, rng.integers(0, 50)))
+        scalar = ter_mod._LevenshteinEditDistance(ref)._levenshtein_edit_distance(hyp)
+        vectorized = _VectorizedOnly(ref)._levenshtein_edit_distance(hyp)
+        assert scalar == vectorized, (ref, hyp, scalar, vectorized)
+
+
+def test_ter_vectorized_path_still_used_for_long_references():
+    """References with 64+ tokens take the vectorized branch (and agree with
+    the scalar rows forced through the subclass)."""
+    rng = np.random.default_rng(5)
+    vocab = [f"w{i}" for i in range(40)]
+    ref = list(rng.choice(vocab, 80))
+    hyp = list(rng.choice(vocab, 75))
+    led = ter_mod._LevenshteinEditDistance(ref)
+    cost, trace = led._levenshtein_edit_distance(hyp)
+    v_cost, v_trace = _VectorizedOnly(ref)._levenshtein_edit_distance(hyp)
+    assert (cost, trace) == (v_cost, v_trace)
