@@ -15,7 +15,7 @@ from __future__ import annotations
 
 import re
 import unicodedata
-from typing import List, Optional, Sequence, Tuple, Union
+from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import jax.numpy as jnp
 import numpy as np
@@ -136,16 +136,81 @@ def _preprocess_sentences(
     return preds, target
 
 
-def _compute_sentence_statistics(
-    preds_word: str,
-    target_words: Sequence[str],
+def _eed_scores_batched(
+    pairs: Sequence[Tuple[str, str]],
     alpha: float = 2.0,
     rho: float = 0.3,
     deletion: float = 0.2,
     insertion: float = 1.0,
-) -> float:
-    """Best (lowest) score over all references (reference eed.py:285-313)."""
-    return min(_eed_function(preds_word, reference, alpha, rho, deletion, insertion) for reference in target_words)
+) -> np.ndarray:
+    """EED scores for many (hyp, ref) pairs in one lockstep DP.
+
+    Exactly the `_eed_function` recurrence run row-by-row across all pairs at
+    once on padded (P, max_n+1) arrays. Per-pair FP operation order is
+    unchanged (every op is elementwise per pair; the deletion-chain relaxation
+    runs until EVERY pair converges, and extra sweeps are no-ops for already
+    converged rows), so results are bit-identical to the per-pair kernel —
+    asserted by tests/text/test_edit_kernels.py. Hypothesis pads sit at +inf so
+    they never win the argmin/jump; rows of exhausted references freeze.
+    """
+    P = len(pairs)
+    if P == 0:
+        return np.zeros(0)
+    hyps = [np.frombuffer(h.encode("utf-32-le"), dtype=np.uint32) for h, _ in pairs]
+    refs = [np.frombuffer(r.encode("utf-32-le"), dtype=np.uint32) for _, r in pairs]
+    n_p = np.asarray([len(h) for h in hyps])
+    m_p = np.asarray([len(r) for r in refs])
+    max_n, max_m = int(n_p.max()), int(m_p.max())
+
+    hyp_pad = np.zeros((P, max_n if max_n else 1), dtype=np.uint32)
+    ref_pad = np.zeros((P, max_m if max_m else 1), dtype=np.uint32)
+    ref_is_space = np.zeros((P, max_m if max_m else 1), dtype=bool)
+    for p in range(P):
+        hyp_pad[p, : n_p[p]] = hyps[p]
+        ref_pad[p, : m_p[p]] = refs[p]
+        ref_is_space[p, : m_p[p]] = refs[p] == ord(" ")
+
+    inf = np.inf
+    cols = np.arange(max_n + 1)
+    pad_mask = cols[None, :] > n_p[:, None]  # True at padded hypothesis cells
+    row = np.ones((P, max_n + 1))
+    row[:, 0] = 0.0
+    row[pad_mask] = inf
+    visits = np.full((P, max_n + 1), -1, dtype=np.int64)
+
+    for w in range(1, max_m + 1):
+        active = w <= m_p  # pairs whose reference still has characters
+        if not active.any():
+            break
+        ref_ch = ref_pad[:, w - 1 : w]  # (P, 1)
+        sub = row[:, :-1] + (hyp_pad != ref_ch)
+        cand = np.empty_like(row)
+        cand[:, 0] = row[:, 0] + 1.0
+        if max_n:
+            cand[:, 1:] = np.minimum(sub, row[:, 1:] + insertion)
+        cand[pad_mask] = inf
+        next_row = cand
+        while True:
+            relaxed = np.minimum(next_row[:, 1:], next_row[:, :-1] + deletion)
+            relaxed[pad_mask[:, 1:]] = inf
+            if np.array_equal(relaxed, next_row[:, 1:]):
+                break
+            next_row = np.concatenate((next_row[:, :1], relaxed), axis=1)
+
+        min_index = np.argmin(next_row, axis=1)
+        visits[active, min_index[active]] += 1
+
+        jump = active & ref_is_space[:, w - 1]
+        if jump.any():
+            jumped = np.minimum(next_row, alpha + next_row[np.arange(P), min_index][:, None])
+            jumped[pad_mask] = inf
+            next_row[jump] = jumped[jump]
+
+        row = np.where(active[:, None], next_row, row)
+
+    coverage = rho * np.where(visits >= 0, visits, np.where(pad_mask, 0, 1)).sum(axis=1).astype(np.float64)
+    end = row[np.arange(P), n_p]
+    return np.minimum(1.0, (end + coverage) / (m_p.astype(np.float64) + coverage))
 
 
 def _eed_update(
@@ -164,10 +229,32 @@ def _eed_update(
     if 0 in (len(preds), len(target[0])):
         return []
 
-    return [
-        _compute_sentence_statistics(hypothesis, target_words, alpha, rho, deletion, insertion)
-        for hypothesis, target_words in zip(preds, target)
-    ]
+    # flatten (hyp, ref) combinations, batch the DP in geometric length bands,
+    # then take the per-hypothesis minimum over its references
+    pairs: List[Tuple[str, str]] = []
+    owner: List[int] = []
+    for h_idx, (hypothesis, target_words) in enumerate(zip(preds, target)):
+        if not target_words:
+            raise ValueError("Must provide at least one reference sentence per hypothesis")
+        for reference in target_words:
+            pairs.append((hypothesis, reference))
+            owner.append(h_idx)
+
+    scores = np.empty(len(pairs))
+    bands: Dict[Tuple[int, int], List[int]] = {}
+    for p, (h, r) in enumerate(pairs):
+        bands.setdefault((max(len(h), 1).bit_length(), max(len(r), 1).bit_length()), []).append(p)
+    for members in bands.values():
+        # chunk like helper._edit_distances_batched: bound the (P, max_n) DP arrays
+        for lo in range(0, len(members), 512):
+            idx = members[lo : lo + 512]
+            scores[idx] = _eed_scores_batched([pairs[p] for p in idx], alpha, rho, deletion, insertion)
+
+    out = [float("inf")] * len(preds)
+    for p, h_idx in enumerate(owner):
+        if scores[p] < out[h_idx]:
+            out[h_idx] = scores[p]
+    return out
 
 
 def _eed_compute(sentence_level_scores: Sequence[Array]) -> Array:

@@ -33,6 +33,9 @@ from metrics_tpu.functional.text.helper import _validate_inputs
 _MAX_SHIFT_SIZE = 10
 _MAX_SHIFT_DIST = 50
 _BEAM_WIDTH = 25
+# below this reference length the tercom DP uses plain-Python rows (numpy
+# per-op overhead dominates at narrow beam windows); tests monkeypatch this
+_SCALAR_ROW_MAX = 64
 
 # Sacrebleu-inspired limits
 _MAX_SHIFT_CANDIDATES = 1000
@@ -177,7 +180,7 @@ class _LevenshteinEditDistance:
         # Typical tercom rows are a narrow beam window (tens of cells); plain
         # Python beats numpy's per-op overhead there. Wide rows take the
         # vectorized prefix-min path below.
-        if m < 64:
+        if m < _SCALAR_ROW_MAX:
             return self._scalar_rows(pred_ids, prediction_len, length_ratio, beam_width, costs, ops)
 
         offsets = np.arange(m + 1, dtype=np.float64)

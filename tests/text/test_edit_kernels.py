@@ -10,7 +10,7 @@
 
 from __future__ import annotations
 
-import math
+
 
 import numpy as np
 import pytest
@@ -53,79 +53,42 @@ def test_single_pair_wrapper_matches_batched():
     assert _edit_distance(a, b) == _naive_levenshtein(a, b)
 
 
-class _VectorizedOnly(ter_mod._LevenshteinEditDistance):
-    """Force the vectorized branch regardless of reference length."""
-
-    def _levenshtein_edit_distance(self, prediction_tokens):
-        prediction_len = len(prediction_tokens)
-        m = self.reference_len
-        ref_ids = self._ref_ids
-        pred_ids = self._to_ids(prediction_tokens)
-        length_ratio = m / prediction_len if prediction_tokens else 1.0
-        beam_width = (
-            math.ceil(length_ratio / 2 + ter_mod._BEAM_WIDTH)
-            if length_ratio / 2 > ter_mod._BEAM_WIDTH
-            else ter_mod._BEAM_WIDTH
-        )
-        costs = np.full((prediction_len + 1, m + 1), float(ter_mod._INT_INFINITY))
-        ops = np.full((prediction_len + 1, m + 1), ter_mod._OP_UNDEFINED, dtype=np.int8)
-        costs[0] = np.arange(m + 1, dtype=np.float64)
-        ops[0] = ter_mod._OP_INSERT
-        offsets = np.arange(m + 1, dtype=np.float64)
-        for i in range(1, prediction_len + 1):
-            pseudo_diag = math.floor(i * length_ratio)
-            min_j = max(0, pseudo_diag - beam_width)
-            max_j = m + 1 if i == prediction_len else min(m + 1, pseudo_diag + beam_width)
-            if min_j >= max_j:
-                continue
-            prev = costs[i - 1]
-            sub_cost = (ref_ids != pred_ids[i - 1]).astype(np.float64)
-            diag = np.concatenate(([float(ter_mod._INT_INFINITY)], prev[:-1] + sub_cost))
-            up = prev + 1.0
-            cand = np.minimum(diag, up)
-            if min_j == 0:
-                cand[0] = prev[0] + 1.0
-            w0, w1 = min_j, max_j
-            window = cand[w0:w1] - offsets[w0:w1]
-            row = np.minimum.accumulate(window) + offsets[w0:w1]
-            costs[i, w0:w1] = row
-            j_idx = np.arange(w0, w1)
-            is_sub = row == diag[w0:w1]
-            is_del = row == up[w0:w1]
-            row_ops = np.where(
-                is_sub,
-                np.where(sub_cost[j_idx - 1] == 0, ter_mod._OP_NOTHING, ter_mod._OP_SUBSTITUTE),
-                np.where(is_del, ter_mod._OP_DELETE, ter_mod._OP_INSERT),
-            )
-            if min_j == 0:
-                row_ops[0] = ter_mod._OP_DELETE
-            ops[i, w0:w1] = row_ops
-        trace = self._get_trace(prediction_len, ops)
-        return int(costs[-1, -1]), trace
-
-
 @pytest.mark.parametrize("seed", [3, 4])
-def test_ter_scalar_rows_match_vectorized(seed):
-    """The m<64 scalar fast path and the vectorized path must agree exactly —
-    cost AND op trace (the shift search replays the trace)."""
+def test_ter_scalar_rows_match_vectorized(seed, monkeypatch):
+    """The scalar fast path and the vectorized path must agree exactly — cost
+    AND op trace (the shift search replays the trace). Both PRODUCTION paths
+    are exercised by monkeypatching the dispatch threshold."""
     rng = np.random.default_rng(seed)
     vocab = [f"w{i}" for i in range(25)]
-    for _ in range(150):
-        ref = list(rng.choice(vocab, rng.integers(1, 50)))
-        hyp = list(rng.choice(vocab, rng.integers(0, 50)))
-        scalar = ter_mod._LevenshteinEditDistance(ref)._levenshtein_edit_distance(hyp)
-        vectorized = _VectorizedOnly(ref)._levenshtein_edit_distance(hyp)
-        assert scalar == vectorized, (ref, hyp, scalar, vectorized)
+    cases = [
+        (list(rng.choice(vocab, rng.integers(1, 90))), list(rng.choice(vocab, rng.integers(0, 90))))
+        for _ in range(150)
+    ]
+    results = {}
+    for name, threshold in (("scalar", 10**9), ("vectorized", 0)):
+        monkeypatch.setattr(ter_mod, "_SCALAR_ROW_MAX", threshold)
+        results[name] = [
+            ter_mod._LevenshteinEditDistance(ref)._levenshtein_edit_distance(hyp) for ref, hyp in cases
+        ]
+    for case, scalar, vectorized in zip(cases, results["scalar"], results["vectorized"]):
+        assert scalar == vectorized, (case, scalar, vectorized)
 
 
-def test_ter_vectorized_path_still_used_for_long_references():
-    """References with 64+ tokens take the vectorized branch (and agree with
-    the scalar rows forced through the subclass)."""
-    rng = np.random.default_rng(5)
-    vocab = [f"w{i}" for i in range(40)]
-    ref = list(rng.choice(vocab, 80))
-    hyp = list(rng.choice(vocab, 75))
-    led = ter_mod._LevenshteinEditDistance(ref)
-    cost, trace = led._levenshtein_edit_distance(hyp)
-    v_cost, v_trace = _VectorizedOnly(ref)._levenshtein_edit_distance(hyp)
-    assert (cost, trace) == (v_cost, v_trace)
+@pytest.mark.parametrize("seed", [6, 7])
+def test_eed_batched_bit_identical_to_per_pair(seed):
+    """The lockstep batched EED DP must be BIT-identical to the per-pair kernel
+    (the coverage term depends on argmin ties, so even FP-association changes
+    would show)."""
+    from metrics_tpu.functional.text.eed import _eed_function, _eed_scores_batched
+
+    rng = np.random.default_rng(seed)
+    chars = list("abcdef ghij")
+
+    def s(n):
+        return "".join(rng.choice(chars, n))
+
+    pairs = [(s(rng.integers(0, 100)), s(rng.integers(1, 100))) for _ in range(120)]
+    pairs += [("", "abc"), ("abc", "a"), (" ", " "), ("a" * 150, "a b c " * 20)]
+    got = _eed_scores_batched(pairs)
+    for i, (h, r) in enumerate(pairs):
+        assert got[i] == _eed_function(h, r), (i, h[:20], r[:20])
