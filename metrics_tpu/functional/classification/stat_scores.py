@@ -293,6 +293,25 @@ def _multiclass_stat_scores_update(
     target_ = jnp.where(mask, target, 0).astype(jnp.int32)
     m = mask.astype(jnp.float32)
 
+    # Backend-dependent fast path: with label preds, top_k=1 and a global
+    # reduce, every count derives from the (C, C) confusion matrix, which is
+    # one O(N) masked bincount instead of O(N·C) one-hot arithmetic. On TPU the
+    # one-hot form rides the MXU and measures at zero step overhead (bench.py),
+    # so the scatter path is used only where it wins: the host CPU backend.
+    if (
+        multidim_average == "global"
+        and preds.ndim != 3
+        and jax.default_backend() == "cpu"
+    ):
+        from metrics_tpu.functional.classification.confusion_matrix import _multiclass_confusion_matrix_update
+
+        cm = _multiclass_confusion_matrix_update(preds, target, num_classes, ignore_index)
+        tp = jnp.diag(cm)
+        fn = jnp.sum(cm, axis=1) - tp
+        fp = jnp.sum(cm, axis=0) - tp
+        tn = jnp.sum(cm) - tp - fn - fp
+        return tp.astype(jnp.int32), fp.astype(jnp.int32), tn.astype(jnp.int32), fn.astype(jnp.int32)
+
     oh_target = jax.nn.one_hot(target_, num_classes, dtype=jnp.float32) * m[..., None]  # (N, X, C)
 
     if preds.ndim == 3:  # (N, C, X) probs with top_k > 1

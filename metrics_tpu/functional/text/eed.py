@@ -21,7 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import Array
 
-from metrics_tpu.functional.text.helper import _validate_inputs
+from metrics_tpu.functional.text.helper import _banded_chunks, _validate_inputs
 
 
 def _eed_function(
@@ -241,14 +241,8 @@ def _eed_update(
             owner.append(h_idx)
 
     scores = np.empty(len(pairs))
-    bands: Dict[Tuple[int, int], List[int]] = {}
-    for p, (h, r) in enumerate(pairs):
-        bands.setdefault((max(len(h), 1).bit_length(), max(len(r), 1).bit_length()), []).append(p)
-    for members in bands.values():
-        # chunk like helper._edit_distances_batched: bound the (P, max_n) DP arrays
-        for lo in range(0, len(members), 512):
-            idx = members[lo : lo + 512]
-            scores[idx] = _eed_scores_batched([pairs[p] for p in idx], alpha, rho, deletion, insertion)
+    for idx in _banded_chunks([(len(h), len(r)) for h, r in pairs]):
+        scores[idx] = _eed_scores_batched([pairs[p] for p in idx], alpha, rho, deletion, insertion)
 
     out = [float("inf")] * len(preds)
     for p, h_idx in enumerate(owner):

@@ -43,6 +43,22 @@ def _validate_inputs(
     return reference_corpus, hypothesis_corpus
 
 
+def _banded_chunks(dims: Sequence[Tuple[int, int]]) -> List[List[int]]:
+    """Group pair indices into geometric length bands (both axes), chunked at
+    ``_BUCKET`` — shared by the Levenshtein and EED lockstep kernels so one
+    outlier-size pair never inflates the padded DP of the rest."""
+    bands: Dict[Tuple[int, int], List[int]] = {}
+    for p, (n, m) in enumerate(dims):
+        if m > n:
+            n, m = m, n
+        bands.setdefault((max(n, 1).bit_length(), max(m, 1).bit_length()), []).append(p)
+    chunks: List[List[int]] = []
+    for members in bands.values():
+        for lo in range(0, len(members), _BUCKET):
+            chunks.append(members[lo : lo + _BUCKET])
+    return chunks
+
+
 def _edit_distance(prediction_tokens: Sequence[Hashable], reference_tokens: Sequence[Hashable]) -> int:
     """Levenshtein distance of one pair — thin wrapper over the batched kernel."""
     return int(_edit_distances_batched([(prediction_tokens, reference_tokens)])[0])
@@ -68,19 +84,13 @@ def _edit_distances_batched(pairs: Sequence[Tuple[Sequence[Hashable], Sequence[H
     # bucket padding wastes at most ~2x per axis, and an outlier only ever
     # shares a bucket with pairs of its own magnitude. Bands are further split
     # into chunks of _BUCKET pairs to bound the DP arrays.
-    bands: Dict[Tuple[int, int], List[int]] = {}
-    for p, (a, b) in enumerate(pairs):
-        n, m = (len(a), len(b)) if len(a) >= len(b) else (len(b), len(a))
-        # key on BOTH axes so a band never pads short columns to a long max_m
-        bands.setdefault((max(n, 1).bit_length(), max(m, 1).bit_length()), []).append(p)
-    if len(bands) > 1 or P > _BUCKET:
-        result = np.zeros(P, dtype=np.int64)
-        for members in bands.values():
-            for lo in range(0, len(members), _BUCKET):
-                idx = members[lo : lo + _BUCKET]
-                result[idx] = _edit_distances_batched_same_band([pairs[p] for p in idx])
-        return result
-    return _edit_distances_batched_same_band(pairs)
+    chunks = _banded_chunks([(len(a), len(b)) for a, b in pairs])
+    if len(chunks) == 1:
+        return _edit_distances_batched_same_band(pairs)
+    result = np.zeros(P, dtype=np.int64)
+    for idx in chunks:
+        result[idx] = _edit_distances_batched_same_band([pairs[p] for p in idx])
+    return result
 
 
 def _edit_distances_batched_same_band(pairs: Sequence[Tuple[Sequence[Hashable], Sequence[Hashable]]]) -> np.ndarray:

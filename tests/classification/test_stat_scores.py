@@ -112,3 +112,30 @@ def test_samplewise_multidim():
     for i in range(4):
         tn, fp, fn, tp = sk_confusion_matrix(target[i], preds[i], labels=[0, 1]).ravel()
         np.testing.assert_array_equal(res[i], [tp, fp, tn, fn, tp + fn])
+
+
+def test_bincount_and_onehot_stat_paths_agree(monkeypatch):
+    """The CPU bincount-confmat fast path and the MXU one-hot path must count
+    identically, including under ignore_index masking."""
+    import importlib
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    S = importlib.import_module("metrics_tpu.functional.classification.stat_scores")
+    rng = np.random.default_rng(3)
+    for _ in range(25):
+        C = int(rng.integers(2, 10))
+        n = int(rng.integers(1, 150))
+        preds = jnp.asarray(rng.integers(0, C, n)).reshape(n, 1)
+        target = jnp.asarray(rng.integers(0, C, n)).reshape(n, 1)
+        ii = int(rng.integers(0, C)) if rng.random() < 0.5 else None
+        # pin the backend probe both ways so the test is never vacuous on a
+        # machine whose real default backend isn't cpu
+        monkeypatch.setattr(S.jax, "default_backend", lambda: "cpu")
+        fast = S._multiclass_stat_scores_update(preds, target, C, ignore_index=ii)
+        monkeypatch.setattr(S.jax, "default_backend", lambda: "tpu")
+        slow = S._multiclass_stat_scores_update(preds, target, C, ignore_index=ii)
+        monkeypatch.undo()
+        for a, b in zip(fast, slow):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
