@@ -1,0 +1,97 @@
+"""Head-to-head wall-clock: retrieval metrics vs the executed reference.
+
+Same setup as text_vs_reference.py: both libraries run the same 100k-document
+corpus over 2000 queries on the same CPU, values asserted equal before timing.
+Our group-by-query pipeline is one vectorized sort + segment kernel; the
+reference loops over queries in Python per metric. One JSON line per metric.
+
+Run: python benchmarks/retrieval_vs_reference.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+
+from tests.parity.conftest import _REF_SRC, _install_stubs  # noqa: E402
+
+if not _REF_SRC.exists():
+    sys.exit("reference checkout not present — nothing to compare against")
+_install_stubs()
+sys.path.insert(0, str(_REF_SRC))
+
+import torch  # noqa: E402
+import torchmetrics  # noqa: E402
+
+import metrics_tpu.retrieval as ours  # noqa: E402
+
+N, Q, REPS = 100_000, 2000, 3
+
+
+def _best(fn):
+    fn()
+    best = float("inf")
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        out = fn()
+        best = min(best, time.perf_counter() - t0)
+    return best, out
+
+
+def main() -> None:
+    rng = np.random.default_rng(0)
+    preds = rng.random(N).astype(np.float32)
+    target = rng.integers(0, 2, N)
+    indexes = rng.integers(0, Q, N)
+
+    cases = [
+        ("retrieval_map", ours.RetrievalMAP, torchmetrics.retrieval.RetrievalMAP, {}),
+        ("retrieval_mrr", ours.RetrievalMRR, torchmetrics.retrieval.RetrievalMRR, {}),
+        ("retrieval_ndcg@10", ours.RetrievalNormalizedDCG, torchmetrics.retrieval.RetrievalNormalizedDCG, {"k": 10}),
+        ("retrieval_precision@10", ours.RetrievalPrecision, torchmetrics.retrieval.RetrievalPrecision, {"k": 10}),
+        ("retrieval_recall@10", ours.RetrievalRecall, torchmetrics.retrieval.RetrievalRecall, {"k": 10}),
+    ]
+    for name, ours_cls, ref_cls, kw in cases:
+
+        def run_ours():
+            m = ours_cls(**kw)
+            m.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(indexes))
+            return float(m.compute())
+
+        def run_ref():
+            m = ref_cls(**kw)
+            m.update(torch.tensor(preds), torch.tensor(target), indexes=torch.tensor(indexes))
+            return float(m.compute())
+
+        t_ours, v_ours = _best(run_ours)
+        t_ref, v_ref = _best(run_ref)
+        assert abs(v_ours - v_ref) < 1e-4, (name, v_ours, v_ref)
+        print(
+            json.dumps(
+                {
+                    "metric": f"{name} end-to-end (update + compute)",
+                    "value": round(t_ours * 1e3, 2),
+                    "unit": "ms",
+                    "reference_ms": round(t_ref * 1e3, 2),
+                    "speedup_vs_reference": round(t_ref / t_ours, 2),
+                    "values_equal": True,
+                    "config": {"documents": N, "queries": Q, "hardware": "same CPU, same process"},
+                }
+            )
+        )
+
+
+if __name__ == "__main__":
+    main()
