@@ -1,0 +1,98 @@
+"""Head-to-head wall-clock: audio metrics vs the executed reference.
+
+Same pattern as the text/retrieval harnesses: same inputs, same CPU, values
+asserted equal before timing. SDR is the heavy one (FFT autocorrelation +
+batched Toeplitz solve vs the reference's per-sample solves). One JSON line
+per metric.
+
+Run: python benchmarks/audio_vs_reference.py
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+
+from tests.parity.conftest import _REF_SRC, _install_stubs  # noqa: E402
+
+if not _REF_SRC.exists():
+    sys.exit("reference checkout not present — nothing to compare against")
+_install_stubs()
+sys.path.insert(0, str(_REF_SRC))
+
+import torch  # noqa: E402
+import torchmetrics  # noqa: E402
+
+import metrics_tpu.functional.audio as ours  # noqa: E402
+
+B, T, REPS = 64, 16000, 3
+
+
+def _best(fn):
+    fn()  # warm / compile
+    best = float("inf")
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        out = fn()
+        jax.tree.map(lambda x: x.block_until_ready() if hasattr(x, "block_until_ready") else x, out)
+        best = min(best, time.perf_counter() - t0)
+    return best, out
+
+
+def main() -> None:
+    rng = np.random.default_rng(0)
+    target = rng.normal(size=(B, T)).astype(np.float32)
+    preds = (target + 0.1 * rng.normal(size=(B, T))).astype(np.float32)
+    jp, jt = jnp.asarray(preds), jnp.asarray(target)
+    tp, tt = torch.tensor(preds), torch.tensor(target)
+
+    cases = [
+        ("snr", jax.jit(ours.signal_noise_ratio), lambda: torchmetrics.functional.signal_noise_ratio(tp, tt)),
+        (
+            "si_sdr",
+            jax.jit(ours.scale_invariant_signal_distortion_ratio),
+            lambda: torchmetrics.functional.scale_invariant_signal_distortion_ratio(tp, tt),
+        ),
+        (
+            "sdr_filter512",
+            jax.jit(functools.partial(ours.signal_distortion_ratio, filter_length=512)),
+            lambda: torchmetrics.functional.signal_distortion_ratio(tp, tt, filter_length=512),
+        ),
+    ]
+    for name, ours_fn, ref_fn in cases:
+        t_ours, v_ours = _best(lambda: ours_fn(jp, jt))
+        t_ref, v_ref = _best(ref_fn)
+        v_ours = float(np.mean(np.asarray(v_ours)))
+        v_ref = float(v_ref.mean())
+        tol = 1e-2 if "sdr_filter" in name else 1e-3
+        assert abs(v_ours - v_ref) < tol, (name, v_ours, v_ref)
+        print(
+            json.dumps(
+                {
+                    "metric": f"{name} batch scoring wall-clock",
+                    "value": round(t_ours * 1e3, 2),
+                    "unit": "ms",
+                    "reference_ms": round(t_ref * 1e3, 2),
+                    "speedup_vs_reference": round(t_ref / t_ours, 2),
+                    "values_equal": True,
+                    "config": {"batch": B, "samples": T, "hardware": "same CPU, same process"},
+                }
+            )
+        )
+
+
+if __name__ == "__main__":
+    main()
