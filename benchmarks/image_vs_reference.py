@@ -1,0 +1,100 @@
+"""Head-to-head wall-clock: SSIM-family image metrics vs the executed reference.
+
+Same pattern as the other *_vs_reference harnesses: identical inputs, same
+CPU, values asserted equal before timing. The separable windows run as banded
+matmuls (see metrics_tpu/functional/image/helper.py). One JSON line per
+metric.
+
+Run: python benchmarks/image_vs_reference.py
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+
+from tests.parity.conftest import _REF_SRC, _install_stubs  # noqa: E402
+
+if not _REF_SRC.exists():
+    sys.exit("reference checkout not present — nothing to compare against")
+_install_stubs()
+sys.path.insert(0, str(_REF_SRC))
+
+import torch  # noqa: E402
+import torchmetrics  # noqa: E402
+
+import metrics_tpu.functional.image as ours  # noqa: E402
+
+B, C, H, W, REPS = 8, 3, 256, 256, 3
+
+
+def _best(fn):
+    fn()  # warm / compile
+    best = float("inf")
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        out = fn()
+        jax.tree.map(lambda x: x.block_until_ready() if hasattr(x, "block_until_ready") else x, out)
+        best = min(best, time.perf_counter() - t0)
+    return best, out
+
+
+def main() -> None:
+    rng = np.random.default_rng(0)
+    preds = rng.random((B, C, H, W)).astype(np.float32)
+    target = (preds * 0.8 + rng.random((B, C, H, W)) * 0.2).astype(np.float32)
+    jp, jt = jnp.asarray(preds), jnp.asarray(target)
+    tp, tt = torch.tensor(preds), torch.tensor(target)
+
+    cases = [
+        (
+            "ssim",
+            jax.jit(functools.partial(ours.structural_similarity_index_measure, data_range=1.0)),
+            lambda: torchmetrics.functional.structural_similarity_index_measure(tp, tt, data_range=1.0),
+        ),
+        (
+            "ms_ssim",
+            jax.jit(functools.partial(ours.multiscale_structural_similarity_index_measure, data_range=1.0)),
+            lambda: torchmetrics.functional.multiscale_structural_similarity_index_measure(tp, tt, data_range=1.0),
+        ),
+        (
+            "uqi",
+            jax.jit(ours.universal_image_quality_index),
+            lambda: torchmetrics.functional.universal_image_quality_index(tp, tt),
+        ),
+    ]
+    for name, ours_fn, ref_fn in cases:
+        t_ours, v_ours = _best(lambda: ours_fn(jp, jt))
+        t_ref, v_ref = _best(ref_fn)
+        v_ours, v_ref = float(np.asarray(v_ours)), float(v_ref)
+        assert abs(v_ours - v_ref) < 2e-4, (name, v_ours, v_ref)
+        print(
+            json.dumps(
+                {
+                    "metric": f"{name} batch scoring wall-clock",
+                    "value": round(t_ours * 1e3, 2),
+                    "unit": "ms",
+                    "reference_ms": round(t_ref * 1e3, 2),
+                    "speedup_vs_reference": round(t_ref / t_ours, 2),
+                    "values_equal": True,
+                    "config": {"batch": B, "channels": C, "size": [H, W], "hardware": "same CPU, same process"},
+                }
+            )
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -18,11 +18,9 @@ from jax import Array
 
 from metrics_tpu.functional.image.helper import (
     _avg_pool,
-    _depthwise_conv,
-    _gaussian_kernel_2d,
-    _gaussian_kernel_3d,
+    _depthwise_conv_separable,
+    _gaussian,
     _reflection_pad,
-    _uniform_kernel,
 )
 from metrics_tpu.utils.checks import _check_same_shape
 from metrics_tpu.utils.distributed import reduce
@@ -83,10 +81,10 @@ def _ssim_update(
 
     if gaussian_kernel:
         size = [int(3.5 * s + 0.5) * 2 + 1 for s in sigma]
-        kernel = _gaussian_kernel_3d(channel, size, sigma, dtype) if is_3d else _gaussian_kernel_2d(channel, size, sigma, dtype)
+        factors = [_gaussian(k, s, dtype).reshape(-1) for k, s in zip(size, sigma)]
     else:
         size = list(kernel_size)
-        kernel = _uniform_kernel(channel, size, dtype)
+        factors = [jnp.ones(k, dtype=dtype) / k for k in size]
 
     pads = [(s - 1) // 2 for s in size]
     preds_p = _reflection_pad(preds, pads)
@@ -94,7 +92,7 @@ def _ssim_update(
 
     # one depthwise conv over the 5·B-stacked batch: μp, μt, E[p²], E[t²], E[pt]
     input_list = jnp.concatenate([preds_p, target_p, preds_p * preds_p, target_p * target_p, preds_p * target_p])
-    outputs = _depthwise_conv(input_list, kernel)
+    outputs = _depthwise_conv_separable(input_list, factors)
     b = preds.shape[0]
     mu_pred, mu_target, e_pp, e_tt, e_pt = (outputs[i * b : (i + 1) * b] for i in range(5))
 
@@ -253,7 +251,7 @@ def multiscale_structural_similarity_index_measure(
         >>> preds = jax.random.uniform(key1, (2, 3, 192, 192))
         >>> target = preds * 0.75 + jax.random.uniform(key2, (2, 3, 192, 192)) * 0.25
         >>> multiscale_structural_similarity_index_measure(preds, target, data_range=1.0)
-        Array(0.9372308, dtype=float32)
+        Array(0.9372302, dtype=float32)
     """
     if not isinstance(betas, tuple) or not all(isinstance(b, float) for b in betas):
         raise ValueError("Argument `betas` is expected to be of a type tuple of floats.")

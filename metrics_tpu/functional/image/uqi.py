@@ -12,7 +12,7 @@ from typing import Optional, Sequence, Tuple
 import jax.numpy as jnp
 from jax import Array
 
-from metrics_tpu.functional.image.helper import _depthwise_conv, _gaussian_kernel_2d, _reflection_pad
+from metrics_tpu.functional.image.helper import _depthwise_conv_separable, _gaussian, _reflection_pad
 from metrics_tpu.utils.checks import _check_same_shape
 from metrics_tpu.utils.distributed import reduce
 
@@ -50,14 +50,14 @@ def _uqi_compute(
     dtype = preds.dtype if jnp.issubdtype(preds.dtype, jnp.floating) else jnp.float32
     preds = preds.astype(dtype)
     target = target.astype(dtype)
-    kernel = _gaussian_kernel_2d(channel, kernel_size, sigma, dtype)
+    factors = [_gaussian(k, s, dtype).reshape(-1) for k, s in zip(kernel_size, sigma)]
     pads = [(k - 1) // 2 for k in kernel_size]
 
     preds_p = _reflection_pad(preds, pads)
     target_p = _reflection_pad(target, pads)
 
     input_list = jnp.concatenate([preds_p, target_p, preds_p * preds_p, target_p * target_p, preds_p * target_p])
-    outputs = _depthwise_conv(input_list, kernel)
+    outputs = _depthwise_conv_separable(input_list, factors)
     b = preds.shape[0]
     mu_pred, mu_target, e_pp, e_tt, e_pt = (outputs[i * b : (i + 1) * b] for i in range(5))
 
@@ -96,7 +96,7 @@ def universal_image_quality_index(
         >>> preds = jax.random.uniform(key1, (2, 3, 32, 32))
         >>> target = preds * 0.75 + jax.random.uniform(key2, (2, 3, 32, 32)) * 0.25
         >>> universal_image_quality_index(preds, target)
-        Array(0.92395675, dtype=float32)
+        Array(0.9239566, dtype=float32)
     """
     preds, target = _uqi_update(preds, target)
     return _uqi_compute(preds, target, kernel_size, sigma, reduction, data_range)

@@ -31,7 +31,7 @@ class SpectralDistortionIndex(Metric):
         >>> metric = SpectralDistortionIndex()
         >>> metric.update(preds, target)
         >>> metric.compute()
-        Array(0.04102587, dtype=float32)
+        Array(0.04102586, dtype=float32)
     """
     is_differentiable = True
     higher_is_better = False

@@ -31,7 +31,7 @@ class UniversalImageQualityIndex(Metric):
         >>> metric = UniversalImageQualityIndex()
         >>> metric.update(preds, target)
         >>> metric.compute()
-        Array(0.9225344, dtype=float32)
+        Array(0.9225343, dtype=float32)
     """
     is_differentiable = True
     higher_is_better = True
