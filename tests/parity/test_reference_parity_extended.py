@@ -538,3 +538,30 @@ def test_psnr_dim_reduction_parity(tm, torch):
             tm.functional.peak_signal_noise_ratio(torch.tensor(preds), torch.tensor(target), **kwargs),
             atol=1e-4,
         )
+
+
+def test_aggregation_nan_strategy_parity(tm, torch):
+    """NaN handling semantics (ignore / impute) match the reference exactly."""
+    import warnings
+
+    from metrics_tpu import MaxMetric, MeanMetric, SumMetric
+
+    vals = np.array([1.0, float("nan"), 5.0, 2.0], dtype=np.float32)
+    for ours_cls, ref_cls in ((MeanMetric, tm.MeanMetric), (SumMetric, tm.SumMetric), (MaxMetric, tm.MaxMetric)):
+        for strategy in ("ignore", 2.5):
+            ours = ours_cls(nan_strategy=strategy)
+            ref = ref_cls(nan_strategy=strategy)
+            ours.update(jnp.asarray(vals))
+            ref.update(torch.tensor(vals))
+            _close(ours.compute(), ref.compute())
+        # 'warn' warns once and imputes nothing (value equals ignore-with-keep semantics)
+        ours = ours_cls(nan_strategy="warn")
+        ref = ref_cls(nan_strategy="warn")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            ours.update(jnp.asarray(vals))
+            ref.update(torch.tensor(vals))
+        o, r = np.asarray(ours.compute()), ref.compute().numpy()
+        assert np.isnan(o) == np.isnan(r)
+        if not np.isnan(o):
+            np.testing.assert_allclose(o, r, atol=1e-6)
