@@ -30,12 +30,19 @@ def tiny_model():
 
 
 class _StubTokenizer:
-    """Whitespace tokenizer with [CLS]=1 / [SEP]=2 / pad=0, hashing words into the vocab."""
+    """Whitespace tokenizer with [CLS]=1 / [SEP]=2 / pad=0, hashing words into the vocab.
+
+    Uses crc32, not ``hash()``: Python string hashing is randomized per process,
+    which once in ~vocab runs collides two distinct test words into one id and
+    flips a strict-inequality assertion.
+    """
 
     def __call__(self, text, padding=None, truncation=True, max_length=SEQ, return_tensors="np"):
+        import zlib
+
         ids_batch, mask_batch = [], []
         for sentence in text:
-            ids = [1] + [3 + (hash(w) % (VOCAB - 3)) for w in sentence.split()][: max_length - 2] + [2]
+            ids = [1] + [3 + (zlib.crc32(w.encode()) % (VOCAB - 3)) for w in sentence.split()][: max_length - 2] + [2]
             mask = [1] * len(ids) + [0] * (max_length - len(ids))
             ids = ids + [0] * (max_length - len(ids))
             ids_batch.append(ids)
