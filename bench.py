@@ -215,8 +215,15 @@ def main() -> None:
             with open(results_path) as fh:
                 history = json.load(fh)
             history.setdefault("runs", []).append(record)
-            with open(results_path, "w") as fh:
+            # Atomic replace with a per-process tmp name: a crash mid-write must
+            # never corrupt the provenance log this file exists to protect, and
+            # two concurrent runs must not interleave writes into one tmp file
+            # (the later replace can still win the race and drop the earlier
+            # record — acceptable; corruption is not).
+            tmp_path = f"{results_path}.{os.getpid()}.tmp"
+            with open(tmp_path, "w") as fh:
                 json.dump(history, fh, indent=1)
+            os.replace(tmp_path, results_path)
         except Exception as exc:  # noqa: BLE001 — recording must never break the artifact
             record["results_log_error"] = repr(exc)
     print(json.dumps(record))

@@ -298,6 +298,10 @@ def _multiclass_stat_scores_update(
     # one O(N) masked bincount instead of O(N·C) one-hot arithmetic. On TPU the
     # one-hot form rides the MXU and measures at zero step overhead (bench.py),
     # so the scatter path is used only where it wins: the host CPU backend.
+    # The branch is trace-time and could in principle mismatch the executing
+    # device (jit with an explicit non-default device) — that is safe because
+    # both paths accumulate exactly in integers (the one-hot products below are
+    # summed as int32, not f32), so path choice affects speed only.
     if (
         multidim_average == "global"
         and preds.ndim != 3
@@ -321,12 +325,18 @@ def _multiclass_stat_scores_update(
         oh_preds = jax.nn.one_hot(preds.astype(jnp.int32), num_classes, dtype=jnp.float32) * m[..., None]
 
     sum_axes = (0, 1) if multidim_average == "global" else (1,)
-    tp = jnp.sum(oh_preds * oh_target, axis=sum_axes)
-    fp = jnp.sum(oh_preds * (1.0 - oh_target), axis=sum_axes)
-    fn = jnp.sum((1.0 - oh_preds) * oh_target, axis=sum_axes)
+    # The products are exact 0/1 values in f32; summing them in int32 keeps the
+    # counts exact past 2^24 (f32 accumulation would silently round there) and
+    # matches the bincount fast path bit-for-bit on every backend.
+    def _count(prod: Array) -> Array:
+        return jnp.sum(prod.astype(jnp.int32), axis=sum_axes)
+
+    tp = _count(oh_preds * oh_target)
+    fp = _count(oh_preds * (1.0 - oh_target))
+    fn = _count((1.0 - oh_preds) * oh_target)
     # tn must only count non-ignored positions: scale by mask
-    tn = jnp.sum((1.0 - oh_preds) * (1.0 - oh_target) * m[..., None], axis=sum_axes)
-    return tp.astype(jnp.int32), fp.astype(jnp.int32), tn.astype(jnp.int32), fn.astype(jnp.int32)
+    tn = _count((1.0 - oh_preds) * (1.0 - oh_target) * m[..., None])
+    return tp, fp, tn, fn
 
 
 def _multiclass_stat_scores_compute(

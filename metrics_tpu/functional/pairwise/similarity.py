@@ -80,11 +80,12 @@ def pairwise_euclidean_distance(
 ) -> Array:
     """Euclidean distance matrix via the one-matmul expansion (reference pairwise/euclidean.py).
 
-    With a single input the diagonal is a self-distance — exactly 0
-    mathematically — and is pinned to 0 regardless of ``zero_diagonal``
-    (sklearn semantics), because the one-matmul expansion loses that exactness
-    to f32 cancellation at large magnitudes. Pass ``y=x`` explicitly to see the
-    raw expansion including its diagonal noise.
+    With a single input and ``zero_diagonal`` unset, the diagonal is a
+    self-distance — exactly 0 mathematically — and is pinned to 0 (sklearn
+    semantics), because the one-matmul expansion loses that exactness to f32
+    cancellation at large magnitudes. An explicit ``zero_diagonal=False`` is
+    honoured (reference behaviour: you get the raw expansion, including its
+    diagonal noise), as is passing ``y=x``.
 
     Example:
         >>> import jax.numpy as jnp
@@ -95,15 +96,16 @@ def pairwise_euclidean_distance(
         Array([[1.4142135, 1.       ],
                [4.2426405, 2.236068 ]], dtype=float32)
     """
-    self_mode = y is None
     x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
     x_norm = jnp.sum(x * x, axis=1, keepdims=True)
     y_norm = jnp.sum(y * y, axis=1)
     distance = x_norm + y_norm[None, :] - 2.0 * _safe_matmul(x, y.T)
     distance = jnp.sqrt(jnp.maximum(distance, 0.0))
-    # Self-distances are exactly 0 mathematically, but the one-matmul expansion
-    # loses that to f32 cancellation at large magnitudes — pin the diagonal.
-    distance = _zero_diag(distance, zero_diagonal or self_mode)
+    # Self-mode defaults to a pinned diagonal (self-distances are exactly 0
+    # mathematically, but the one-matmul expansion loses that to f32
+    # cancellation); an explicit ``zero_diagonal=False`` opts out, matching the
+    # reference.
+    distance = _zero_diag(distance, zero_diagonal)
     return _reduce_distance_matrix(distance, reduction)
 
 

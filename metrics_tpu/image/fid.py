@@ -77,13 +77,16 @@ def _compute_fid(mu1: Array, sigma1: Array, mu2: Array, sigma2: Array, eps: floa
     return diff @ diff + jnp.trace(sigma1) + jnp.trace(sigma2) - 2 * tr_covmean
 
 
-def _resolve_feature_extractor(feature: Union[int, str, Callable]) -> tuple:
+def _resolve_feature_extractor(feature: Union[int, str, Callable], allow_random_weights: bool = False) -> tuple:
     """Returns (extract_fn, num_features).
 
     Integer (64/192/768/2048) and string ("logits_unbiased") inputs build the in-repo
     flax InceptionV3 (``image/inception_net.py``) — the TPU-native replacement for the
     reference's torch-fidelity ``NoTrainInceptionV3`` (src/torchmetrics/image/fid.py:41).
-    A callable is used as-is and must return an ``(N, d)`` feature matrix.
+    Weights come from ``$METRICS_TPU_INCEPTION_WEIGHTS`` (see
+    ``tools/convert_inception_weights.py``); ``allow_random_weights=True`` opts into
+    seeded random initialisation for tests/relative comparisons. A callable is used
+    as-is and must return an ``(N, d)`` feature matrix.
     """
     if isinstance(feature, (int, str)) and not isinstance(feature, bool):
         from metrics_tpu.image.inception_net import FEATURE_DIMS, InceptionFeatureExtractor
@@ -95,7 +98,7 @@ def _resolve_feature_extractor(feature: Union[int, str, Callable]) -> tuple:
                 f"Input to argument `feature` must be one of {valid_int_input} (feature taps)"
                 f" or {valid_str_input} (logit heads), but got {feature!r}."
             )
-        extractor = InceptionFeatureExtractor(feature)
+        extractor = InceptionFeatureExtractor(feature, allow_random_weights=allow_random_weights)
         return extractor, extractor.num_features
     if callable(feature):
         return feature, None
@@ -134,10 +137,11 @@ class FrechetInceptionDistance(Metric):
         normalize: bool = False,
         num_features: Optional[int] = None,
         sqrtm_backend: str = "scipy",
+        allow_random_weights: bool = False,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
-        self.extractor, inferred = _resolve_feature_extractor(feature)
+        self.extractor, inferred = _resolve_feature_extractor(feature, allow_random_weights)
         num_features = num_features or inferred or (feature if isinstance(feature, int) else None)
         if num_features is None:
             raise ValueError(

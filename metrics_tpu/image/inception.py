@@ -41,6 +41,7 @@ class InceptionScore(Metric):
         feature: Union[int, Callable] = "logits_unbiased",
         splits: int = 10,
         normalize: bool = False,
+        allow_random_weights: bool = False,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -48,7 +49,7 @@ class InceptionScore(Metric):
             raise ValueError(
                 f"Input to argument `feature` must be 'logits'/'logits_unbiased', an int or a callable, got {feature}"
             )
-        self.extractor, _ = _resolve_feature_extractor(feature)
+        self.extractor, _ = _resolve_feature_extractor(feature, allow_random_weights)
         if not (isinstance(splits, int) and splits > 0):
             raise ValueError("Argument `splits` expected to be integer larger than 0")
         self.splits = splits

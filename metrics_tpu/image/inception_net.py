@@ -9,10 +9,13 @@ the torch-fidelity FID variant: BN convs (eps=1e-3), Inception A/B/C/D/E towers,
 (final pool) are globally average-pooled to ``(N, C)``.
 
 Weights: offline-friendly. ``load_params(path)`` reads a flat ``.npz`` written by
-``save_params`` (keys are ``/``-joined pytree paths). When no weight file is given and
-none is found at ``$METRICS_TPU_INCEPTION_WEIGHTS``, the extractor falls back to
-seeded random initialisation with a rank-zero warning — self-consistent for tests and
-relative comparisons, but NOT comparable to published FID numbers.
+``save_params`` (keys are ``/``-joined pytree paths); produce it from the canonical
+FID checkpoint with ``tools/convert_inception_weights.py``. When no weight file is
+given and none is found at ``$METRICS_TPU_INCEPTION_WEIGHTS``, construction FAILS
+unless ``allow_random_weights=True`` opts into seeded random initialisation —
+self-consistent for tests and relative comparisons, but NOT comparable to published
+FID numbers, so it must never reach an eval dashboard silently (same posture as the
+LPIPS net).
 
 Layout note: inputs follow the reference convention (N, C, H, W) uint8; internally
 everything is NHWC, the TPU-native convolution layout.
@@ -214,9 +217,9 @@ def _cached_variables(weights_path: Optional[str], seed: int) -> Any:
     if weights_path is not None:
         return load_params(weights_path)
     rank_zero_warn(
-        "No InceptionV3 weights file found (set $METRICS_TPU_INCEPTION_WEIGHTS or pass"
-        " `weights_path`); using seeded random initialisation. FID/KID/IS values will be"
-        " self-consistent but NOT comparable to published numbers."
+        "InceptionV3 is using seeded RANDOM weights (allow_random_weights=True, no"
+        " weights file). FID/KID/IS values will be self-consistent but NOT comparable"
+        " to published numbers."
     )
     return init_params(seed)
 
@@ -228,7 +231,13 @@ class InceptionFeatureExtractor:
     (bilinear), maps to [-1, 1], runs the flax net, returns the requested tap.
     """
 
-    def __init__(self, feature: Any = 2048, weights_path: Optional[str] = None, seed: int = 0) -> None:
+    def __init__(
+        self,
+        feature: Any = 2048,
+        weights_path: Optional[str] = None,
+        seed: int = 0,
+        allow_random_weights: bool = False,
+    ) -> None:
         if feature not in FEATURE_DIMS:
             raise ValueError(f"`feature` must be one of {sorted(FEATURE_DIMS, key=str)}, got {feature}")
         self.feature = feature
@@ -236,6 +245,14 @@ class InceptionFeatureExtractor:
         weights_path = weights_path or os.environ.get(_WEIGHTS_ENV) or None
         if weights_path is not None and not os.path.exists(weights_path):
             raise FileNotFoundError(f"Inception weights file not found: {weights_path}")
+        if weights_path is None and not allow_random_weights:
+            raise FileNotFoundError(
+                "No InceptionV3 weights available: pass `weights_path=`, set"
+                " $METRICS_TPU_INCEPTION_WEIGHTS (produce the .npz with"
+                " tools/convert_inception_weights.py), or opt into random"
+                " initialisation with `allow_random_weights=True`"
+                " (tests/relative comparisons only)."
+            )
         self._variables = _cached_variables(weights_path, seed)
 
     def __call__(self, imgs: Array) -> Array:

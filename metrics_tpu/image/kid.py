@@ -72,10 +72,11 @@ class KernelInceptionDistance(Metric):
         coef: float = 1.0,
         reset_real_features: bool = True,
         normalize: bool = False,
+        allow_random_weights: bool = False,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
-        self.extractor, _ = _resolve_feature_extractor(feature)
+        self.extractor, _ = _resolve_feature_extractor(feature, allow_random_weights)
         if not (isinstance(subsets, int) and subsets > 0):
             raise ValueError("Argument `subsets` expected to be integer larger than 0")
         self.subsets = subsets

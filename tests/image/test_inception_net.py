@@ -29,15 +29,15 @@ def _imgs(n, seed=0, size=32):
 
 @pytest.mark.parametrize("feature", [64, 192, 768, 2048, "logits", "logits_unbiased"])
 def test_extractor_output_shapes(feature):
-    extractor = InceptionFeatureExtractor(feature)
+    extractor = InceptionFeatureExtractor(feature, allow_random_weights=True)
     out = np.asarray(extractor(_imgs(2)))
     assert out.shape == (2, FEATURE_DIMS[feature])
     assert np.all(np.isfinite(out))
 
 
 def test_extractor_deterministic_across_instances():
-    a = InceptionFeatureExtractor(64)
-    b = InceptionFeatureExtractor(64)
+    a = InceptionFeatureExtractor(64, allow_random_weights=True)
+    b = InceptionFeatureExtractor(64, allow_random_weights=True)
     imgs = _imgs(2, seed=1)
     np.testing.assert_allclose(np.asarray(a(imgs)), np.asarray(b(imgs)), atol=1e-6)
 
@@ -66,7 +66,7 @@ def test_weights_roundtrip(tmp_path):
     from metrics_tpu.image import inception_net
 
     inception_net._cached_variables.cache_clear()
-    default = InceptionFeatureExtractor(64, seed=3)
+    default = InceptionFeatureExtractor(64, seed=3, allow_random_weights=True)
     from_file = InceptionFeatureExtractor(64, weights_path=path)
     imgs = _imgs(2, seed=2)
     np.testing.assert_allclose(np.asarray(default(imgs)), np.asarray(from_file(imgs)), atol=1e-6)
@@ -90,14 +90,14 @@ def test_missing_weights_file_raises():
 
 
 def test_fid_integer_feature_end_to_end():
-    fid = FrechetInceptionDistance(feature=64, sqrtm_backend="newton")
+    fid = FrechetInceptionDistance(feature=64, sqrtm_backend="newton", allow_random_weights=True)
     fid.update(_imgs(12, seed=0), real=True)
     fid.update(_imgs(12, seed=1), real=False)
     val = float(fid.compute())
     assert np.isfinite(val) and val >= 0.0
 
     # same distribution on both sides -> FID ~ 0
-    fid2 = FrechetInceptionDistance(feature=64, sqrtm_backend="newton")
+    fid2 = FrechetInceptionDistance(feature=64, sqrtm_backend="newton", allow_random_weights=True)
     same = _imgs(12, seed=0)
     fid2.update(same, real=True)
     fid2.update(same, real=False)
@@ -105,7 +105,7 @@ def test_fid_integer_feature_end_to_end():
 
 
 def test_kid_integer_feature_end_to_end():
-    kid = KernelInceptionDistance(feature=64, subset_size=6, subsets=2)
+    kid = KernelInceptionDistance(feature=64, subset_size=6, subsets=2, allow_random_weights=True)
     kid.update(_imgs(8, seed=0), real=True)
     kid.update(_imgs(8, seed=1), real=False)
     mean, std = kid.compute()
@@ -113,7 +113,18 @@ def test_kid_integer_feature_end_to_end():
 
 
 def test_inception_score_default_feature_end_to_end():
-    inception = InceptionScore(splits=2)
+    inception = InceptionScore(splits=2, allow_random_weights=True)
     inception.update(_imgs(8, seed=0))
     mean, std = inception.compute()
     assert np.isfinite(float(mean)) and float(mean) > 0.0
+
+
+def test_no_weights_and_no_optin_raises(monkeypatch):
+    """Random-weight FID on an eval dashboard is a silent correctness bug — the
+    integer-feature path must refuse to construct without weights unless the
+    caller explicitly opts in (same posture as the LPIPS net)."""
+    monkeypatch.delenv("METRICS_TPU_INCEPTION_WEIGHTS", raising=False)
+    with pytest.raises(FileNotFoundError, match="allow_random_weights"):
+        InceptionFeatureExtractor(64)
+    with pytest.raises(FileNotFoundError, match="allow_random_weights"):
+        FrechetInceptionDistance(feature=2048)

@@ -43,11 +43,24 @@ def test_zero_diagonal_override_two_inputs(tm_fn, sk_fn):
 
 @pytest.mark.parametrize("tm_fn, sk_fn", ALL)
 def test_single_input_keep_diagonal(tm_fn, sk_fn):
-    """zero_diagonal=False with one input keeps the self-similarity diagonal."""
+    """zero_diagonal=False with one input keeps the self-similarity diagonal.
+
+    For euclidean the diagonal is the raw one-matmul expansion (reference
+    behaviour honours the explicit False), so it carries f32 cancellation noise
+    of order sqrt(eps)·‖x‖ — compare it at a loose tolerance.
+    """
     rng = np.random.default_rng(1)
     X = rng.normal(size=(6, 5)).astype(np.float32)
     res = np.asarray(tm_fn(jnp.asarray(X), zero_diagonal=False))
-    np.testing.assert_allclose(res, sk_fn(X, X), atol=1e-5)
+    expected = sk_fn(X, X)
+    if tm_fn is pairwise_euclidean_distance:
+        # only the diagonal carries the expansion's cancellation noise — keep
+        # off-diagonal parity tight
+        diag = np.eye(len(X), dtype=bool)
+        np.testing.assert_allclose(res[diag], expected[diag], atol=5e-3)
+        np.testing.assert_allclose(res[~diag], expected[~diag], atol=1e-5)
+    else:
+        np.testing.assert_allclose(res, expected, atol=1e-5)
 
 
 @pytest.mark.parametrize("tm_fn, sk_fn", ALL)
@@ -68,13 +81,15 @@ def test_cosine_zero_vector_is_finite():
 
 
 def test_euclidean_self_distance_nonnegative():
-    """Cancellation in ||x||² − 2x·y + ||y||² must not go negative, and the
-    self-distance diagonal is pinned to its exact value 0 (sklearn does the same)."""
+    """Cancellation in ||x||² − 2x·y + ||y||² must not go negative. With
+    ``zero_diagonal`` unset, self-mode pins the diagonal to its exact value 0
+    (sklearn does the same); explicit False returns the raw expansion."""
     rng = np.random.default_rng(3)
     X = (rng.normal(size=(50, 8)) * 1e3).astype(np.float32)
     res = np.asarray(pairwise_euclidean_distance(jnp.asarray(X), zero_diagonal=False))
     assert np.all(res >= 0)
-    np.testing.assert_array_equal(np.diag(res), 0.0)
+    res_default = np.asarray(pairwise_euclidean_distance(jnp.asarray(X)))
+    np.testing.assert_array_equal(np.diag(res_default), 0.0)
     off_diag = res + np.diag(np.full(len(X), np.nan))
     expected = sk_euclidean(X, X)
     mask = ~np.isnan(off_diag)

@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""Convert FID InceptionV3 weights (torch) to the metrics_tpu ``.npz`` format.
+
+The flax net (:mod:`metrics_tpu.image.inception_net`) loads weights from a flat
+``.npz``; this tool produces that file from the torch checkpoint the reference
+ecosystem uses — the TF-slim FID weights as packaged by pytorch-fid /
+torch-fidelity (``pt_inception-2015-12-05-*.pth``), whose state-dict keys follow
+torchvision's ``inception_v3`` naming (``Mixed_5b.branch1x1.conv.weight``, …)
+with a 1008-way ``fc``. That is the exact network behind the reference's
+``NoTrainInceptionV3`` (ref src/torchmetrics/image/fid.py:41).
+
+Run where torch is installed (one-time, offline thereafter)::
+
+    python tools/convert_inception_weights.py --src pt_inception-2015-12-05-6726825d.pth \
+        --out inception_fid.npz
+    export METRICS_TPU_INCEPTION_WEIGHTS=inception_fid.npz
+
+The mapping is DERIVED from the flax module tree (``jax.eval_shape`` over
+``InceptionV3.init``), not hand-listed: every flax leaf path is translated to
+its torch key and shape-checked, so the layout cannot silently drift from the
+module structure. It is unit-tested against synthetic state dicts
+(tests/image/test_weight_conversion.py) and numerically validated
+activation-by-activation against a torch-side forward
+(tests/image/test_inception_parity.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Dict, List, Mapping, Tuple
+
+import numpy as np
+
+
+def _flax_structure():
+    """Expected flax variables tree (shapes only — no FLOPs, no weight init)."""
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu.image.inception_net import InceptionV3
+
+    model = InceptionV3()
+    return jax.eval_shape(model.init, jax.random.PRNGKey(0), jnp.zeros((1, 299, 299, 3), jnp.float32))
+
+
+def _torch_key(path: Tuple[str, ...]) -> Tuple[str, Tuple[int, ...]]:
+    """flax leaf path -> (torch state-dict key, transpose axes or () for none).
+
+    ``path`` is (collection, module..., leaf), e.g.
+    ``('params', 'Mixed_5b', 'branch1x1', 'conv', 'kernel')``.
+    """
+    collection, *modules, leaf = path
+    prefix = ".".join(modules)
+    if collection == "params":
+        if leaf == "kernel" and modules[-1] == "conv":
+            return f"{prefix}.weight", (2, 3, 1, 0)  # (kH,kW,I,O) <- (O,I,kH,kW)
+        if leaf == "kernel":  # dense (fc): flax (in, out) <- torch (out, in)
+            return f"{prefix}.weight", (1, 0)
+        if leaf == "scale":  # batch-norm gamma
+            return f"{prefix}.weight", ()
+        if leaf == "bias":
+            return f"{prefix}.bias", ()
+    elif collection == "batch_stats":
+        if leaf == "mean":
+            return f"{prefix}.running_mean", ()
+        if leaf == "var":
+            return f"{prefix}.running_var", ()
+    raise ValueError(f"unmapped flax leaf path: {path}")
+
+
+def _iter_leaves(structure) -> List[Tuple[Tuple[str, ...], Tuple[int, ...]]]:
+    """Flatten the flax tree into (path, shape) rows, depth-first."""
+    import jax
+
+    rows = []
+    for keypath, leaf in jax.tree_util.tree_flatten_with_path(structure)[0]:
+        path = tuple(k.key for k in keypath)
+        rows.append((path, tuple(leaf.shape)))
+    return rows
+
+
+def expected_torch_keys() -> Dict[str, Tuple[int, ...]]:
+    """Map of torch state-dict key -> expected torch-layout shape."""
+    out: Dict[str, Tuple[int, ...]] = {}
+    for path, flax_shape in _iter_leaves(_flax_structure()):
+        key, axes = _torch_key(path)
+        if axes:
+            inv = np.argsort(axes)
+            out[key] = tuple(flax_shape[i] for i in inv)
+        else:
+            out[key] = flax_shape
+    return out
+
+
+def convert_state_dict(state_dict: Mapping[str, np.ndarray]) -> Dict:
+    """torchvision-style FID inception state dict -> flax variables pytree.
+
+    Unknown keys (e.g. ``AuxLogits.*``, ``num_batches_tracked``) are ignored;
+    a missing or wrong-shaped expected key raises with the offending name.
+    """
+    sd = {k: np.asarray(v) for k, v in state_dict.items()}
+    structure = _flax_structure()
+
+    import jax
+
+    def build(keypath, leaf):
+        path = tuple(k.key for k in keypath)
+        key, axes = _torch_key(path)
+        if key not in sd:
+            raise KeyError(f"state dict is missing {key!r} (for flax leaf {'/'.join(path)})")
+        arr = sd[key].astype(np.float32)
+        if axes:
+            arr = np.transpose(arr, axes)
+        if arr.shape != tuple(leaf.shape):
+            raise ValueError(
+                f"{key!r}: converted shape {arr.shape} does not match flax leaf "
+                f"{'/'.join(path)} shape {tuple(leaf.shape)}"
+            )
+        return arr
+
+    return jax.tree_util.tree_map_with_path(build, structure)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--src", required=True, help="torch .pth checkpoint (FID inception state dict)")
+    parser.add_argument("--out", required=True, help="output .npz path")
+    args = parser.parse_args()
+
+    import torch
+
+    sd = torch.load(args.src, map_location="cpu", weights_only=True)
+    if hasattr(sd, "state_dict"):
+        sd = sd.state_dict()
+    sd = {k: v.numpy() for k, v in sd.items() if hasattr(v, "numpy")}
+
+    from metrics_tpu.utils.params_io import save_params
+
+    save_params(convert_state_dict(sd), args.out)
+    print(f"wrote {args.out}")
+
+
+if __name__ == "__main__":
+    main()

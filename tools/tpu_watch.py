@@ -1,0 +1,91 @@
+"""Background accelerator watcher for the build round.
+
+The tunneled TPU drops out for hours at a time (BENCH_r01/r02 both degraded), so
+instead of trying once at the end of the round this loop probes the backend every
+few minutes and, whenever the chip is reachable, runs the two hardware artifacts:
+
+- ``bench.py``            — headline overhead number (appends to results_tpu_v5e.json)
+- ``tools/run_entry_tpu.py`` — entry() fused step with host-recompute assertion
+
+Everything is logged (timestamped) to ``benchmarks/tpu_watch.log``. The loop exits
+after ``MAX_SUCCESS`` successful bench runs or ``MAX_HOURS`` wall-clock hours.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(_REPO, "benchmarks", "tpu_watch.log")
+PROBE_SNIPPET = "import jax; d = jax.devices(); print(d[0].platform, len(d))"
+PROBE_TIMEOUT_S = 150
+SLEEP_DOWN_S = 240          # tunnel down: re-probe every 4 min
+SLEEP_AFTER_SUCCESS_S = 1500  # after a good run: space runs ~25 min apart
+MAX_SUCCESS = 8
+MAX_HOURS = 11.0
+
+
+def log(msg: str) -> None:
+    line = f"{time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())} {msg}"
+    with open(LOG, "a") as fh:
+        fh.write(line + "\n")
+    print(line, flush=True)
+
+
+def probe() -> tuple[bool, str]:
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", PROBE_SNIPPET],
+            capture_output=True, text=True, timeout=PROBE_TIMEOUT_S,
+        )
+        if r.returncode == 0:
+            plat = (r.stdout.split() or ["?"])[0]
+            return plat != "cpu", r.stdout.strip()
+        return False, (r.stderr.strip().splitlines() or ["rc=%d" % r.returncode])[-1]
+    except subprocess.TimeoutExpired:
+        return False, f"probe timeout {PROBE_TIMEOUT_S}s"
+    except Exception as exc:  # noqa: BLE001
+        return False, repr(exc)
+
+
+def run_logged(label: str, argv: list[str], timeout_s: int) -> bool:
+    t0 = time.time()
+    try:
+        r = subprocess.run(argv, capture_output=True, text=True, timeout=timeout_s, cwd=_REPO)
+        log(f"{label} rc={r.returncode} ({time.time()-t0:.0f}s) out={r.stdout.strip()[-2000:]} err={r.stderr.strip()[-500:]}")
+        return r.returncode == 0 and '"backend": "cpu"' not in r.stdout and '"degraded"' not in r.stdout
+    except subprocess.TimeoutExpired:
+        log(f"{label} TIMEOUT after {timeout_s}s")
+        return False
+    except Exception as exc:  # noqa: BLE001
+        log(f"{label} EXC {exc!r}")
+        return False
+
+
+def main() -> None:
+    successes = 0
+    deadline = time.time() + MAX_HOURS * 3600
+    log(f"watcher start pid={os.getpid()}")
+    while time.time() < deadline and successes < MAX_SUCCESS:
+        ok, detail = probe()
+        if not ok:
+            log(f"probe down: {detail}")
+            time.sleep(SLEEP_DOWN_S)
+            continue
+        log(f"probe UP: {detail}")
+        good = run_logged("bench", [sys.executable, os.path.join(_REPO, "bench.py")], 1800)
+        run_logged("entry", [sys.executable, os.path.join(_REPO, "tools", "run_entry_tpu.py")], 900)
+        if good:
+            successes += 1
+            log(f"success #{successes}")
+            time.sleep(SLEEP_AFTER_SUCCESS_S)
+        else:
+            time.sleep(SLEEP_DOWN_S)
+    log(f"watcher exit: successes={successes}")
+
+
+if __name__ == "__main__":
+    main()
