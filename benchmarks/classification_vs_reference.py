@@ -1,0 +1,106 @@
+"""Head-to-head wall-clock: classification stat-scores family vs the executed reference.
+
+The reference's home turf: its multiclass counting path is a single C++
+``torch.bincount`` over ``target*C + preds`` (ref
+src/torchmetrics/functional/classification/stat_scores.py:336-410). Ours is the
+same confusion-matrix derivation on CPU, but jit-compiled — XLA fuses the key
+construction, masking and scatter-add into one kernel, which beats the eager
+C++ op chain. Values asserted equal before timing; ours timed before the first
+torch execution (see retrieval_vs_reference.py on OMP-pool contamination).
+
+Run: python benchmarks/classification_vs_reference.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+
+from tests.parity.conftest import _REF_SRC, _install_stubs  # noqa: E402
+
+if not _REF_SRC.exists():
+    sys.exit("reference checkout not present — nothing to compare against")
+_install_stubs()
+sys.path.insert(0, str(_REF_SRC))
+
+import torch  # noqa: E402
+import torchmetrics.classification as ref  # noqa: E402
+
+import metrics_tpu.classification as ours  # noqa: E402
+
+N, C, REPS = 1_000_000, 100, 10
+
+
+def _best(fn):
+    fn()  # warm / compile
+    best = float("inf")
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        out = fn()
+        best = min(best, time.perf_counter() - t0)
+    return best, out
+
+
+def main() -> None:
+    rng = np.random.default_rng(0)
+    preds = rng.integers(0, C, N).astype(np.int32)
+    target = rng.integers(0, C, N).astype(np.int32)
+    jp, jt = jnp.asarray(preds), jnp.asarray(target)
+    tp, tt = torch.tensor(preds), torch.tensor(target)
+
+    cases = [
+        ("accuracy_micro", ours.MulticlassAccuracy, ref.MulticlassAccuracy, {"average": "micro"}),
+        ("f1_macro", ours.MulticlassF1Score, ref.MulticlassF1Score, {"average": "macro"}),
+        ("confusion_matrix", ours.MulticlassConfusionMatrix, ref.MulticlassConfusionMatrix, {}),
+        ("stat_scores_macro", ours.MulticlassStatScores, ref.MulticlassStatScores, {"average": None}),
+    ]
+
+    ours_results = {}
+    for name, ours_cls, _, kw in cases:
+
+        def run_ours(ours_cls=ours_cls, kw=kw):
+            m = ours_cls(num_classes=C, validate_args=False, **kw)
+            m.update(jp, jt)
+            return np.asarray(m.compute())
+
+        ours_results[name] = _best(run_ours)
+
+    for name, ours_cls, ref_cls, kw in cases:
+
+        def run_ref(ref_cls=ref_cls, kw=kw):
+            m = ref_cls(num_classes=C, validate_args=False, **kw)
+            m.update(tp, tt)
+            return m.compute().numpy()
+
+        t_ours, v_ours = ours_results[name]
+        t_ref, v_ref = _best(run_ref)
+        np.testing.assert_allclose(np.asarray(v_ours, np.float64), np.asarray(v_ref, np.float64), atol=1e-5)
+        print(
+            json.dumps(
+                {
+                    "metric": f"{name} end-to-end (update + compute)",
+                    "value": round(t_ours * 1e3, 2),
+                    "unit": "ms",
+                    "reference_ms": round(t_ref * 1e3, 2),
+                    "speedup_vs_reference": round(t_ref / t_ours, 2),
+                    "values_equal": True,
+                    "config": {"samples": N, "classes": C, "hardware": "same CPU, same process"},
+                }
+            )
+        )
+
+
+if __name__ == "__main__":
+    main()

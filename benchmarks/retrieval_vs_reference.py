@@ -63,19 +63,28 @@ def main() -> None:
         ("retrieval_precision@10", ours.RetrievalPrecision, torchmetrics.retrieval.RetrievalPrecision, {"k": 10}),
         ("retrieval_recall@10", ours.RetrievalRecall, torchmetrics.retrieval.RetrievalRecall, {"k": 10}),
     ]
+    # Time ALL of ours before the first torch execution: torch's OMP pool stays
+    # resident after a run and roughly doubles subsequent jax CPU dispatch in the
+    # same process (measured: 96ms isolated vs 192ms interleaved) — interleaving
+    # per case would charge that contamination to whichever library runs second.
+    ours_results = {}
     for name, ours_cls, ref_cls, kw in cases:
 
-        def run_ours():
+        def run_ours(ours_cls=ours_cls, kw=kw):
             m = ours_cls(**kw)
             m.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(indexes))
             return float(m.compute())
 
-        def run_ref():
+        ours_results[name] = _best(run_ours)
+
+    for name, ours_cls, ref_cls, kw in cases:
+
+        def run_ref(ref_cls=ref_cls, kw=kw):
             m = ref_cls(**kw)
             m.update(torch.tensor(preds), torch.tensor(target), indexes=torch.tensor(indexes))
             return float(m.compute())
 
-        t_ours, v_ours = _best(run_ours)
+        t_ours, v_ours = ours_results[name]
         t_ref, v_ref = _best(run_ref)
         assert abs(v_ours - v_ref) < 1e-4, (name, v_ours, v_ref)
         print(

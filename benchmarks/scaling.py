@@ -14,6 +14,9 @@ optimized HLO, and verifies both are CONSTANT as the mesh doubles. One JSON
 line per world size plus a verdict line.
 
 Run: XLA_FLAGS=--xla_force_host_platform_device_count=32 python benchmarks/scaling.py
+Override the world list (BASELINE's 256-chip north star) with
+``METRICS_TPU_SCALING_WORLDS=64,128,256`` — the virtual device count follows the
+largest requested world automatically.
 """
 
 from __future__ import annotations
@@ -25,7 +28,9 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-_DEFAULT_WORLDS = (2, 4, 8, 16, 32)
+_DEFAULT_WORLDS = tuple(
+    int(w) for w in os.environ.get("METRICS_TPU_SCALING_WORLDS", "2,4,8,16,32").split(",")
+)
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + f" --xla_force_host_platform_device_count={max(_DEFAULT_WORLDS)}").strip()

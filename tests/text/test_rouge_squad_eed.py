@@ -220,3 +220,68 @@ def test_eed_module_accumulation_and_sentence_scores():
 def test_eed_ja_language():
     score = extended_edit_distance(["アーロン", "エディー"], ["アーロン", "エディソン"], language="ja")
     assert 0 <= float(score) <= 1
+
+
+class TestVendoredSentenceSplitter:
+    """The deterministic punkt stand-in used for ROUGE-Lsum when nltk punkt
+    data is absent (the reference raises offline, ref rouge.py:52-77). Each
+    case pins the split punkt's English model produces on the same text."""
+
+    def test_plain_sentences(self):
+        from metrics_tpu.functional.text.rouge import _regex_sentence_split
+
+        assert _regex_sentence_split("The cat sat. The dog ran! Did it?") == [
+            "The cat sat.", "The dog ran!", "Did it?",
+        ]
+
+    def test_abbreviation_heavy(self):
+        from metrics_tpu.functional.text.rouge import _regex_sentence_split
+
+        text = "Dr. Smith met Mr. Jones at approx. 5 p.m. in town. They spoke. See fig. 3 for details."
+        got = _regex_sentence_split(text)
+        # titles and mid-sentence 'approx.'/'fig.' must not split; real boundaries must
+        assert got == [
+            "Dr. Smith met Mr. Jones at approx. 5 p.m. in town.",
+            "They spoke.",
+            "See fig. 3 for details.",
+        ]
+
+    def test_initials_and_acronyms(self):
+        from metrics_tpu.functional.text.rouge import _regex_sentence_split
+
+        assert _regex_sentence_split("J. R. Smith lives in the U.S.A. He is home. It works.") == [
+            "J. R. Smith lives in the U.S.A. He is home.",
+            "It works.",
+        ]
+
+    def test_decimals_not_split(self):
+        from metrics_tpu.functional.text.rouge import _regex_sentence_split
+
+        assert _regex_sentence_split("Pi is 3.14 about. Euler is 2.71 too.") == [
+            "Pi is 3.14 about.", "Euler is 2.71 too.",
+        ]
+
+    def test_quotes_and_empty(self):
+        from metrics_tpu.functional.text.rouge import _regex_sentence_split
+
+        assert _regex_sentence_split('She said "go." He went.') == ['She said "go."', "He went."]
+        assert _regex_sentence_split("   ") == []
+
+    def test_lsum_scores_with_abbreviations_match_presplit(self):
+        """Lsum via the vendored splitter == Lsum computed on the same text with
+        explicit newline-separated sentences (the rouge_score convention). The
+        splitter's boundaries are asserted first, so the score equality pins the
+        splitter path — not a union-LCS coincidence."""
+        from metrics_tpu.functional.text.rouge import _regex_sentence_split, rouge_score as rs
+
+        pred = "Dr. Smith arrived at approx. 5 p.m. yesterday. He gave a talk. The talk was long."
+        tgt = "Dr. Smith came in the evening. He presented a talk. It ran long."
+        pred_sents = ["Dr. Smith arrived at approx. 5 p.m. yesterday.", "He gave a talk.", "The talk was long."]
+        tgt_sents = ["Dr. Smith came in the evening.", "He presented a talk.", "It ran long."]
+        assert _regex_sentence_split(pred) == pred_sents
+        assert _regex_sentence_split(tgt) == tgt_sents
+        joined = rs(pred, tgt, rouge_keys=("rougeLsum",), accumulate="best")
+        presplit = rs("\n".join(pred_sents), "\n".join(tgt_sents), rouge_keys=("rougeLsum",), accumulate="best")
+        assert float(joined["rougeLsum_fmeasure"]) == pytest.approx(
+            float(presplit["rougeLsum_fmeasure"]), abs=1e-6
+        )

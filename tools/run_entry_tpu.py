@@ -106,7 +106,7 @@ def main() -> None:
             with open(log_path) as fh:
                 history = json.load(fh)
         history.append(record)
-        tmp = log_path + ".tmp"
+        tmp = f"{log_path}.{os.getpid()}.tmp"  # pid-qualified: watcher + manual runs can overlap
         with open(tmp, "w") as fh:
             json.dump(history, fh, indent=1)
         os.replace(tmp, log_path)
