@@ -43,10 +43,10 @@ import metrics_tpu.classification as ours  # noqa: E402
 N, C, REPS = 1_000_000, 100, 10
 
 
-def _best(fn):
+def _best(fn, reps=REPS):
     fn()  # warm / compile
     best = float("inf")
-    for _ in range(REPS):
+    for _ in range(reps):
         t0 = time.perf_counter()
         out = fn()
         best = min(best, time.perf_counter() - t0)
@@ -57,35 +57,56 @@ def main() -> None:
     rng = np.random.default_rng(0)
     preds = rng.integers(0, C, N).astype(np.int32)
     target = rng.integers(0, C, N).astype(np.int32)
-    jp, jt = jnp.asarray(preds), jnp.asarray(target)
-    tp, tt = torch.tensor(preds), torch.tensor(target)
+
+    # binned-curve metrics take O(seconds)/run — fewer reps, still best-of
+    scores = rng.random(N).astype(np.float32)
+    btarget = rng.integers(0, 2, N).astype(np.int32)
+    mc_n, mc_c = 200_000, 10
+    mc_probs = rng.random((mc_n, mc_c)).astype(np.float32)
+    mc_probs /= mc_probs.sum(1, keepdims=True)
+    mc_target = rng.integers(0, mc_c, mc_n)
+
+    inputs = {  # mode -> ((ours preds, ours target), (ref preds, ref target), ctor kwargs)
+        "labels": ((jnp.asarray(preds), jnp.asarray(target)), (torch.tensor(preds), torch.tensor(target)), {"num_classes": C}),
+        "binary_scores": ((jnp.asarray(scores), jnp.asarray(btarget)), (torch.tensor(scores), torch.tensor(btarget)), {}),
+        "mc_probs": (
+            (jnp.asarray(mc_probs), jnp.asarray(mc_target.astype(np.int32))),
+            (torch.tensor(mc_probs), torch.tensor(mc_target.astype(np.int64))),  # torch one_hot needs int64
+            {"num_classes": mc_c},
+        ),
+    }
 
     cases = [
-        ("accuracy_micro", ours.MulticlassAccuracy, ref.MulticlassAccuracy, {"average": "micro"}),
-        ("f1_macro", ours.MulticlassF1Score, ref.MulticlassF1Score, {"average": "macro"}),
-        ("confusion_matrix", ours.MulticlassConfusionMatrix, ref.MulticlassConfusionMatrix, {}),
-        ("stat_scores_macro", ours.MulticlassStatScores, ref.MulticlassStatScores, {"average": None}),
+        ("accuracy_micro", ours.MulticlassAccuracy, ref.MulticlassAccuracy, {"average": "micro"}, "labels", REPS),
+        ("f1_macro", ours.MulticlassF1Score, ref.MulticlassF1Score, {"average": "macro"}, "labels", REPS),
+        ("confusion_matrix", ours.MulticlassConfusionMatrix, ref.MulticlassConfusionMatrix, {}, "labels", REPS),
+        ("stat_scores_macro", ours.MulticlassStatScores, ref.MulticlassStatScores, {"average": None}, "labels", REPS),
+        ("auroc_binned100", ours.BinaryAUROC, ref.BinaryAUROC, {"thresholds": 100}, "binary_scores", 3),
+        ("avg_precision_binned100", ours.BinaryAveragePrecision, ref.BinaryAveragePrecision, {"thresholds": 100}, "binary_scores", 3),
+        ("auroc_multiclass_binned100", ours.MulticlassAUROC, ref.MulticlassAUROC, {"thresholds": 100}, "mc_probs", 3),
     ]
 
     ours_results = {}
-    for name, ours_cls, _, kw in cases:
+    for name, ours_cls, _, kw, mode, reps in cases:
 
-        def run_ours(ours_cls=ours_cls, kw=kw):
-            m = ours_cls(num_classes=C, validate_args=False, **kw)
-            m.update(jp, jt)
+        def run_ours(ours_cls=ours_cls, kw=kw, mode=mode):
+            (p, t), _, ckw = inputs[mode]
+            m = ours_cls(validate_args=False, **ckw, **kw)
+            m.update(p, t)
             return np.asarray(m.compute())
 
-        ours_results[name] = _best(run_ours)
+        ours_results[name] = _best(run_ours, reps)
 
-    for name, ours_cls, ref_cls, kw in cases:
+    for name, ours_cls, ref_cls, kw, mode, reps in cases:
 
-        def run_ref(ref_cls=ref_cls, kw=kw):
-            m = ref_cls(num_classes=C, validate_args=False, **kw)
-            m.update(tp, tt)
+        def run_ref(ref_cls=ref_cls, kw=kw, mode=mode):
+            _, (p, t), ckw = inputs[mode]
+            m = ref_cls(validate_args=False, **ckw, **kw)
+            m.update(p, t)
             return m.compute().numpy()
 
         t_ours, v_ours = ours_results[name]
-        t_ref, v_ref = _best(run_ref)
+        t_ref, v_ref = _best(run_ref, reps)
         np.testing.assert_allclose(np.asarray(v_ours, np.float64), np.asarray(v_ref, np.float64), atol=1e-5)
         print(
             json.dumps(

@@ -84,8 +84,11 @@ def _regex_sentence_split(text: str) -> List[str]:
             core = bare.lstrip("(\"'“‘[")
             if core.lower() in _NON_TERMINAL_ABBREVS:
                 continue  # "Dr. Smith arrived."
-            if len(core) == 1 and core.isalpha():
-                continue  # initials: "J. Smith"
+            if len(core) == 1 and core.isalpha() and core.isupper() and core != "I":
+                # initials: "J. Smith". Lowercase single letters and the pronoun
+                # "I" are real sentence ends far more often than initials
+                # ("So did I. Then we left."), so they DO split.
+                continue
             if "." in core:
                 continue  # dotted acronyms: "U.S.A. is large" (punkt keeps these)
             if core.replace(",", "").isdigit() and m.end() < len(text) and text[m.end()].isdigit():

@@ -225,3 +225,30 @@ def test_multilabel_recall_at_fixed_precision_vs_sklearn(min_precision):
         m.update(jnp.asarray(_multilabel_probs.preds[i]), jnp.asarray(_multilabel_probs.target[i]))
     m_recs, _ = m.compute()
     np.testing.assert_allclose(np.asarray(m_recs), np.asarray(recs), atol=1e-6)
+
+
+def test_binned_update_unsorted_thresholds_match_sorted():
+    """The bucketized host path computes in sorted-threshold space and
+    un-permutes; user-ordered (unsorted) thresholds must yield exactly the
+    counts of the direct comparison form, row for row."""
+    import numpy as np
+    import jax.numpy as jnp
+    from metrics_tpu.functional.classification.precision_recall_curve import (
+        _binary_precision_recall_curve_update,
+    )
+
+    rng = np.random.default_rng(0)
+    preds = jnp.asarray(rng.random(5000).astype(np.float32))
+    target = jnp.asarray(rng.integers(0, 2, 5000))
+    unsorted = jnp.asarray([0.9, 0.1, 0.5, 0.3, 0.7], jnp.float32)
+
+    got = np.asarray(_binary_precision_recall_curve_update(preds, target, unsorted))
+    # direct comparison-form oracle in numpy, per user-ordered threshold row
+    p, t = np.asarray(preds), np.asarray(target)
+    for i, thr in enumerate(np.asarray(unsorted)):
+        sel = p >= thr
+        tp = int((sel & (t == 1)).sum())
+        fp = int((sel & (t == 0)).sum())
+        fn = int(t.sum()) - tp
+        tn = int((t == 0).sum()) - fp
+        np.testing.assert_array_equal(got[i], [[tn, fp], [fn, tp]])

@@ -254,6 +254,11 @@ class TestVendoredSentenceSplitter:
             "It works.",
         ]
 
+    def test_pronoun_I_ends_sentence(self):
+        from metrics_tpu.functional.text.rouge import _regex_sentence_split
+
+        assert _regex_sentence_split("So did I. Then we left.") == ["So did I.", "Then we left."]
+
     def test_decimals_not_split(self):
         from metrics_tpu.functional.text.rouge import _regex_sentence_split
 

@@ -8,7 +8,9 @@ step ran on the hardware". When the tunneled TPU is reachable this script:
 3. recomputes every metric value on the host in pure numpy from the same inputs
    (forward pass, confusion matrix, micro-accuracy, macro-F1 — an independent
    implementation, not a second jax trace), and asserts agreement to 1e-5,
-4. appends a provenance record to ``benchmarks/entry_tpu_runs.json``.
+4. appends a provenance record to ``benchmarks/entry_tpu_runs.jsonl`` (one JSON
+   line per run; O_APPEND, so overlapping watcher + manual runs cannot drop or
+   corrupt each other's records).
 
 Prints ONE JSON line; exits 0 with a ``degraded`` field when the tunnel is down.
 """
@@ -99,17 +101,13 @@ def main() -> None:
             "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         }
     )
-    log_path = os.path.join(_REPO, "benchmarks", "entry_tpu_runs.json")
+    log_path = os.path.join(_REPO, "benchmarks", "entry_tpu_runs.jsonl")
     try:
-        history = []
-        if os.path.exists(log_path):
-            with open(log_path) as fh:
-                history = json.load(fh)
-        history.append(record)
-        tmp = f"{log_path}.{os.getpid()}.tmp"  # pid-qualified: watcher + manual runs can overlap
-        with open(tmp, "w") as fh:
-            json.dump(history, fh, indent=1)
-        os.replace(tmp, log_path)
+        # append-only JSONL: a single short O_APPEND write per run is atomic, so
+        # overlapping watcher + manual runs interleave lines instead of racing a
+        # read-modify-write of one document
+        with open(log_path, "a") as fh:
+            fh.write(json.dumps(record) + "\n")
     except Exception as exc:  # noqa: BLE001 — recording must never break the run
         record["log_error"] = repr(exc)
     print(json.dumps(record))

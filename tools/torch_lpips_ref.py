@@ -1,0 +1,143 @@
+"""Torch-side LPIPS forward, driven by the converter's state-dict layout.
+
+Numerical ground truth for :mod:`metrics_tpu.image.lpips_net`, exactly like
+``torch_inception_fid.py`` is for the inception net: a procedural walk of the
+LPIPS v0.1 formula (scaling layer → frozen backbone taps → channel unit
+normalisation → squared diff → non-negative 1x1 heads → spatial mean → sum)
+using only ``torch.nn.functional`` primitives — the same ops the reference's
+``lpips`` pip package executes (ref src/torchmetrics/image/lpip.py:34). Feeding
+one synthetic state dict through this forward and through
+``tools/convert_lpips_weights.build_params`` + the flax net must produce
+matching distances (tests/image/test_lpips_parity.py).
+
+:func:`random_state_dicts` generates the converter's INPUT format: a
+torchvision-style backbone ``features.*`` state dict plus the lpips package's
+``lin{i}.model.1.weight`` tensors.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+from metrics_tpu.image.lpips_net import NET_CHANNELS, _SCALE, _SHIFT
+from tools.convert_lpips_weights import _ALEX_CONVS, _SQUEEZE_FIRES, _VGG_CONVS
+
+# (out, in, kH, kW, stride, pad) per conv in architecture order
+_ALEX_SHAPES = {
+    "conv1": (64, 3, 11, 11, 4, 2),
+    "conv2": (192, 64, 5, 5, 1, 2),
+    "conv3": (384, 192, 3, 3, 1, 1),
+    "conv4": (256, 384, 3, 3, 1, 1),
+    "conv5": (256, 256, 3, 3, 1, 1),
+}
+_VGG_WIDTHS = {1: 64, 2: 128, 3: 256, 4: 512, 5: 512}
+_SQUEEZE_IN = {"fire2": 64, "fire3": 128, "fire4": 128, "fire5": 256, "fire6": 256, "fire7": 384, "fire8": 384, "fire9": 512}
+_SQUEEZE_SE = {"fire2": (16, 64), "fire3": (16, 64), "fire4": (32, 128), "fire5": (32, 128),
+               "fire6": (48, 192), "fire7": (48, 192), "fire8": (64, 256), "fire9": (64, 256)}
+
+
+def random_state_dicts(net_type: str, seed: int = 0) -> Tuple[Dict[str, np.ndarray], Dict[str, np.ndarray]]:
+    """(backbone ``features.*`` state dict, lpips ``lin{i}.model.1.weight`` dict)."""
+    rng = np.random.default_rng(seed)
+
+    def conv(o, i, kh, kw):
+        fan_in = i * kh * kw
+        return (
+            rng.normal(0.0, np.sqrt(2.0 / fan_in), size=(o, i, kh, kw)).astype(np.float32),
+            rng.normal(0.0, 0.05, size=(o,)).astype(np.float32),
+        )
+
+    backbone: Dict[str, np.ndarray] = {}
+    if net_type == "alex":
+        for name, idx in _ALEX_CONVS.items():
+            o, i, kh, kw, _, _ = _ALEX_SHAPES[name]
+            backbone[f"features.{idx}.weight"], backbone[f"features.{idx}.bias"] = conv(o, i, kh, kw)
+    elif net_type == "vgg":
+        prev = 3
+        for name, idx in _VGG_CONVS.items():
+            width = _VGG_WIDTHS[int(name[4])]
+            backbone[f"features.{idx}.weight"], backbone[f"features.{idx}.bias"] = conv(width, prev, 3, 3)
+            prev = width
+    elif net_type == "squeeze":
+        backbone["features.0.weight"], backbone["features.0.bias"] = conv(64, 3, 3, 3)
+        for name, idx in _SQUEEZE_FIRES.items():
+            cin, (s, e) = _SQUEEZE_IN[name], _SQUEEZE_SE[name]
+            backbone[f"features.{idx}.squeeze.weight"], backbone[f"features.{idx}.squeeze.bias"] = conv(s, cin, 1, 1)
+            backbone[f"features.{idx}.expand1x1.weight"], backbone[f"features.{idx}.expand1x1.bias"] = conv(e, s, 1, 1)
+            backbone[f"features.{idx}.expand3x3.weight"], backbone[f"features.{idx}.expand3x3.bias"] = conv(e, s, 3, 3)
+    else:
+        raise ValueError(net_type)
+
+    # lpips heads are non-negative by construction in the published weights
+    lins = {
+        f"lin{i}.model.1.weight": rng.uniform(0.0, 0.2, size=(1, w, 1, 1)).astype(np.float32)
+        for i, w in enumerate(NET_CHANNELS[net_type])
+    }
+    return backbone, lins
+
+
+def torch_lpips_distance(backbone_sd, lpips_sd, net_type: str, img0, img1) -> np.ndarray:
+    """(N,) LPIPS distances in torch from the raw state dicts. Inputs NCHW in [-1, 1]."""
+    import torch
+    import torch.nn.functional as F
+
+    bsd = {k: torch.as_tensor(np.asarray(v)) for k, v in backbone_sd.items()}
+    lsd = {k: torch.as_tensor(np.asarray(v)) for k, v in lpips_sd.items()}
+
+    def cv(x, idx, stride=1, padding=0, prefix="features"):
+        return F.relu(F.conv2d(x, bsd[f"{prefix}.{idx}.weight"], bsd[f"{prefix}.{idx}.bias"], stride=stride, padding=padding))
+
+    def fire(x, idx):
+        s = F.relu(F.conv2d(x, bsd[f"features.{idx}.squeeze.weight"], bsd[f"features.{idx}.squeeze.bias"]))
+        e1 = F.relu(F.conv2d(s, bsd[f"features.{idx}.expand1x1.weight"], bsd[f"features.{idx}.expand1x1.bias"]))
+        e3 = F.relu(F.conv2d(s, bsd[f"features.{idx}.expand3x3.weight"], bsd[f"features.{idx}.expand3x3.bias"], padding=1))
+        return torch.cat([e1, e3], dim=1)
+
+    def taps(x):
+        out = []
+        if net_type == "alex":
+            x = cv(x, _ALEX_CONVS["conv1"], stride=4, padding=2); out.append(x)
+            x = F.max_pool2d(x, 3, 2)
+            x = cv(x, _ALEX_CONVS["conv2"], padding=2); out.append(x)
+            x = F.max_pool2d(x, 3, 2)
+            x = cv(x, _ALEX_CONVS["conv3"], padding=1); out.append(x)
+            x = cv(x, _ALEX_CONVS["conv4"], padding=1); out.append(x)
+            x = cv(x, _ALEX_CONVS["conv5"], padding=1); out.append(x)
+        elif net_type == "vgg":
+            for stage in range(1, 6):
+                n_convs = 2 if stage <= 2 else 3
+                for i in range(1, n_convs + 1):
+                    x = cv(x, _VGG_CONVS[f"conv{stage}_{i}"], padding=1)
+                out.append(x)
+                if stage < 5:
+                    x = F.max_pool2d(x, 2, 2)
+        else:  # squeeze 1.1 — pools use ceil_mode, mirroring torchvision
+            x = cv(x, 0, stride=2); out.append(x)
+            x = F.max_pool2d(x, 3, 2, ceil_mode=True)
+            x = fire(x, _SQUEEZE_FIRES["fire2"])
+            x = fire(x, _SQUEEZE_FIRES["fire3"]); out.append(x)
+            x = F.max_pool2d(x, 3, 2, ceil_mode=True)
+            x = fire(x, _SQUEEZE_FIRES["fire4"])
+            x = fire(x, _SQUEEZE_FIRES["fire5"]); out.append(x)
+            x = F.max_pool2d(x, 3, 2, ceil_mode=True)
+            x = fire(x, _SQUEEZE_FIRES["fire6"]); out.append(x)
+            x = fire(x, _SQUEEZE_FIRES["fire7"]); out.append(x)
+            x = fire(x, _SQUEEZE_FIRES["fire8"]); out.append(x)
+            x = fire(x, _SQUEEZE_FIRES["fire9"]); out.append(x)
+        return out
+
+    with torch.no_grad():
+        shift = torch.as_tensor(_SHIFT).view(1, 3, 1, 1)
+        scale = torch.as_tensor(_SCALE).view(1, 3, 1, 1)
+        x0 = (torch.as_tensor(np.asarray(img0), dtype=torch.float32) - shift) / scale
+        x1 = (torch.as_tensor(np.asarray(img1), dtype=torch.float32) - shift) / scale
+        total = torch.zeros(x0.shape[0])
+        for i, (f0, f1) in enumerate(zip(taps(x0), taps(x1))):
+            n0 = f0 / torch.clamp(f0.pow(2).sum(1, keepdim=True).sqrt(), min=1e-10)
+            n1 = f1 / torch.clamp(f1.pow(2).sum(1, keepdim=True).sqrt(), min=1e-10)
+            diff = (n0 - n1) ** 2
+            w = lsd[f"lin{i}.model.1.weight"]
+            total = total + F.conv2d(diff, w).mean(dim=(1, 2, 3))
+    return total.numpy()

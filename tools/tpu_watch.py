@@ -79,6 +79,9 @@ def main() -> None:
         good = run_logged("bench", [sys.executable, os.path.join(_REPO, "bench.py")], 1800)
         run_logged("entry", [sys.executable, os.path.join(_REPO, "tools", "run_entry_tpu.py")], 900)
         if good:
+            # the BASELINE tracked configs on the real chip — appended to the watch
+            # log itself as labelled hardware evidence
+            run_logged("suite", [sys.executable, os.path.join(_REPO, "benchmarks", "suite.py"), "--backend", "default"], 2400)
             successes += 1
             log(f"success #{successes}")
             time.sleep(SLEEP_AFTER_SUCCESS_S)
