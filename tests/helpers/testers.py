@@ -17,6 +17,7 @@ Multi-"node" without a cluster, two ways (both single-process):
 
 from __future__ import annotations
 
+import os
 import warnings
 from copy import deepcopy
 from functools import partial
@@ -309,6 +310,29 @@ class MetricTester:
         metric = metric_class(**metric_args)
         num_batches = len(preds)
         num_devices = NUM_DEVICES if num_batches % NUM_DEVICES == 0 else NUM_PROCESSES
+        if len(jax.devices()) < num_devices:
+            if os.environ.get("METRICS_TPU_TEST_BACKEND", "cpu") == "cpu":
+                # the default tier must ALWAYS exercise the collective path — a
+                # short device count here is a broken mesh setup, not a skip
+                raise AssertionError(
+                    f"CPU-mesh tier has {len(jax.devices())} devices, sharded path"
+                    f" needs {num_devices}; check xla_force_host_platform_device_count"
+                )
+            # accelerator tier: use the biggest mesh that fits the hardware and
+            # still divides the batch count (a 4-chip slice runs a 4- or 2-way
+            # mesh rather than skipping the collective path entirely)
+            fitted = next(
+                (n for n in range(len(jax.devices()), 1, -1) if num_batches % n == 0),
+                None,
+            )
+            if fitted is None:
+                warnings.warn(
+                    f"sharded path SKIPPED for {metric_class.__name__}: backend has"
+                    f" {len(jax.devices())} device(s), none of 2..{len(jax.devices())}"
+                    f" divides {num_batches} batches", stacklevel=2,
+                )
+                return
+            num_devices = fitted
         if num_batches % num_devices != 0:
             warnings.warn(
                 f"sharded path SKIPPED for {metric_class.__name__}: {num_batches} batches"

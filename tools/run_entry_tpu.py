@@ -71,12 +71,22 @@ def main() -> None:
     params, states, x, y = args
     jfn = jax.jit(fn)
     loss, new_states, values = jfn(params, states, x, y)  # compile + run
+    float(loss)  # drain the compile + first dispatch before timing
+    # Per-step time must amortize the tunnel round-trip: a single timed call is
+    # dominated by the host<->device network hop (~0.5 s), not the chip. Chain
+    # N dispatches carrying the state pytree, then force ONE host readback.
+    n_steps = 20
     t0 = time.perf_counter()
-    loss, new_states, values = jfn(params, states, x, y)
-    jax.block_until_ready(values)
+    st = states
+    for _ in range(n_steps):
+        loss, st, values = jfn(params, st, x, y)
     # the tunneled backend's block_until_ready is unreliable — force a host readback
+    # (the float() fences all n_steps dispatches via the st data dependency)
+    float(loss)
+    step_ms = (time.perf_counter() - t0) * 1e3 / n_steps
+    # correctness below is asserted on a fresh single update, not the timed chain
+    loss, new_states, values = jfn(params, states, x, y)
     loss_f = float(loss)
-    step_ms = (time.perf_counter() - t0) * 1e3
 
     exp = _host_expected(params, x, y, ge._NUM_CLASSES)
     got_acc = float(values["accuracy"])

@@ -2,10 +2,16 @@
 
 The tunneled TPU drops out for hours at a time (BENCH_r01/r02 both degraded), so
 instead of trying once at the end of the round this loop probes the backend every
-few minutes and, whenever the chip is reachable, runs the two hardware artifacts:
+few minutes and, whenever the chip is reachable, runs the hardware artifacts:
 
 - ``bench.py``            — headline overhead number (appends to results_tpu_v5e.json)
 - ``tools/run_entry_tpu.py`` — entry() fused step with host-recompute assertion
+- ``tools/run_tests_tpu.py`` — tests/tpu_smoke tier on the chip (appends to
+  benchmarks/tpu_tests.jsonl)
+- ``benchmarks/suite.py`` — BASELINE tracked configs (after a good bench run)
+
+Worst-case UP cycle is the sum of the four timeouts (~2.5h), though a healthy
+tunnel finishes all four in a few minutes.
 
 Everything is logged (timestamped) to ``benchmarks/tpu_watch.log``. The loop exits
 after ``MAX_SUCCESS`` successful bench runs or ``MAX_HOURS`` wall-clock hours.
@@ -78,6 +84,9 @@ def main() -> None:
         log(f"probe UP: {detail}")
         good = run_logged("bench", [sys.executable, os.path.join(_REPO, "bench.py")], 1800)
         run_logged("entry", [sys.executable, os.path.join(_REPO, "tools", "run_entry_tpu.py")], 900)
+        # outer timeout > probe retries (3x120s) + startup + inner pytest 3600s,
+        # so the inner script always gets to record its own (possibly degraded) result
+        run_logged("tests", [sys.executable, os.path.join(_REPO, "tools", "run_tests_tpu.py")], 4200)
         if good:
             # the BASELINE tracked configs on the real chip — appended to the watch
             # log itself as labelled hardware evidence
