@@ -86,7 +86,14 @@ def main() -> None:
         ("auroc_multiclass_binned100", ours.MulticlassAUROC, ref.MulticlassAUROC, {"thresholds": 100}, "mc_probs", 3),
     ]
 
+    # Two alternating measurement phases per library (ours, ref, ours, ref) with
+    # best-of aggregation across phases: a transient ambient-load spike during
+    # any single phase (observed flipping the ~1.1-1.3x parity rows below 1.0x
+    # when another benchmark ran just before) cannot bias one library, while
+    # ours still gets a pre-torch phase so the resident-OMP-pool contamination
+    # (see retrieval_vs_reference.py) never penalizes a library's only sample.
     ours_results = {}
+    ours_fns = {}
     for name, ours_cls, _, kw, mode, reps in cases:
 
         def run_ours(ours_cls=ours_cls, kw=kw, mode=mode):
@@ -96,6 +103,7 @@ def main() -> None:
             return np.asarray(m.compute())
 
         ours_results[name] = _best(run_ours, reps)
+        ours_fns[name] = run_ours
 
     for name, ours_cls, ref_cls, kw, mode, reps in cases:
 
@@ -107,6 +115,11 @@ def main() -> None:
 
         t_ours, v_ours = ours_results[name]
         t_ref, v_ref = _best(run_ref, reps)
+        # phase 2: re-time both, keep the per-library best across phases
+        t_ours2, _ = _best(ours_fns[name], reps)
+        t_ref2, _ = _best(run_ref, reps)
+        t_ours = min(t_ours, t_ours2)
+        t_ref = min(t_ref, t_ref2)
         np.testing.assert_allclose(np.asarray(v_ours, np.float64), np.asarray(v_ref, np.float64), atol=1e-5)
         print(
             json.dumps(

@@ -176,7 +176,10 @@ class Metric(ABC):
             default = jnp.asarray(default)
 
         setattr(self, name, [] if isinstance(default, list) else default)
-        self._defaults[name] = deepcopy(default)
+        # jax arrays are immutable, so the registered default can be shared with
+        # the live state outright — no deepcopy (which would dispatch a device
+        # copy per state per constructor); list defaults are always empty here
+        self._defaults[name] = [] if isinstance(default, list) else default
         self._persistent[name] = persistent
         self._reductions[name] = dist_reduce_fx
 

@@ -320,19 +320,10 @@ class MetricTester:
                 )
             # accelerator tier: use the biggest mesh that fits the hardware and
             # still divides the batch count (a 4-chip slice runs a 4- or 2-way
-            # mesh rather than skipping the collective path entirely)
-            fitted = next(
-                (n for n in range(len(jax.devices()), 1, -1) if num_batches % n == 0),
-                None,
+            # mesh; a single chip still exercises the psum sync as a 1-way mesh)
+            num_devices = next(
+                n for n in range(len(jax.devices()), 0, -1) if num_batches % n == 0
             )
-            if fitted is None:
-                warnings.warn(
-                    f"sharded path SKIPPED for {metric_class.__name__}: backend has"
-                    f" {len(jax.devices())} device(s), none of 2..{len(jax.devices())}"
-                    f" divides {num_batches} batches", stacklevel=2,
-                )
-                return
-            num_devices = fitted
         if num_batches % num_devices != 0:
             warnings.warn(
                 f"sharded path SKIPPED for {metric_class.__name__}: {num_batches} batches"

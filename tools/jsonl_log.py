@@ -1,0 +1,19 @@
+"""Shared append-only JSONL recording for the hardware-evidence tools.
+
+A single short O_APPEND write per record is atomic on POSIX, so overlapping
+watcher + manual runs interleave whole lines instead of racing a
+read-modify-write of one document. Recording must never break the run that is
+being recorded: failures are noted on the record itself instead of raised.
+"""
+
+from __future__ import annotations
+
+import json
+
+
+def append_jsonl(path: str, record: dict) -> None:
+    try:
+        with open(path, "a") as fh:
+            fh.write(json.dumps(record) + "\n")
+    except Exception as exc:  # noqa: BLE001
+        record["log_error"] = repr(exc)

@@ -26,6 +26,7 @@ _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
 from bench import probe_accelerator  # killable subprocess probe w/ retries
+from tools.jsonl_log import append_jsonl
 
 
 def _host_expected(params, x, y, num_classes):
@@ -80,9 +81,11 @@ def main() -> None:
     st = states
     for _ in range(n_steps):
         loss, st, values = jfn(params, st, x, y)
-    # the tunneled backend's block_until_ready is unreliable — force a host readback
-    # (the float() fences all n_steps dispatches via the st data dependency)
-    float(loss)
+    # the tunneled backend's block_until_ready is unreliable — force a host
+    # readback of a STATE leaf: unlike loss (a function of params/x/y only),
+    # the state chain threads through every step, so this read provably fences
+    # all n_steps dispatches by data dependency on any execution model
+    np.asarray(jax.tree_util.tree_leaves(st)[0])
     step_ms = (time.perf_counter() - t0) * 1e3 / n_steps
     # correctness below is asserted on a fresh single update, not the timed chain
     loss, new_states, values = jfn(params, states, x, y)
@@ -111,15 +114,7 @@ def main() -> None:
             "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         }
     )
-    log_path = os.path.join(_REPO, "benchmarks", "entry_tpu_runs.jsonl")
-    try:
-        # append-only JSONL: a single short O_APPEND write per run is atomic, so
-        # overlapping watcher + manual runs interleave lines instead of racing a
-        # read-modify-write of one document
-        with open(log_path, "a") as fh:
-            fh.write(json.dumps(record) + "\n")
-    except Exception as exc:  # noqa: BLE001 — recording must never break the run
-        record["log_error"] = repr(exc)
+    append_jsonl(os.path.join(_REPO, "benchmarks", "entry_tpu_runs.jsonl"), record)
     print(json.dumps(record))
 
 
