@@ -122,6 +122,17 @@ class MeanAveragePrecision(Metric):
     mar_{k} per max-detection threshold, mar_small/medium/large, map_per_class,
     mar_{last}_per_class, classes.
 
+    Documented divergence from the reference for non-default
+    ``max_detection_thresholds``: the reference summarizes the headline ``map``
+    key at a hardcoded ``max_dets=100`` lookup (reference mean_ap.py:697,714
+    via the default at :804), so e.g. ``[2, 5, 50]`` yields ``map = -1`` there
+    (its other keys — map_50/map_75/area maps and the dynamic ``mar_{k}`` —
+    already use ``maxDets[-1]``); here ``map`` follows the COCO/pycocotools
+    convention of summarizing at ``maxDets[-1]`` like every other key. The
+    conventions coincide whenever 100 is in the list (the default), which is
+    pinned against the executed reference in
+    tests/parity/test_detection_parity.py.
+
     Example:
         >>> import jax.numpy as jnp
         >>> from metrics_tpu.detection import MeanAveragePrecision

@@ -77,6 +77,47 @@ def torch():
     return pytest.importorskip("torch")
 
 
+def install_torchvision_box_ops(torch):
+    """Inject minimal torch implementations of the three torchvision box
+    utilities the reference's MeanAveragePrecision imports (ref
+    mean_ap.py:24-27) and return the now-usable reference class.
+
+    torchvision is absent in this image; these reimplement only the documented
+    semantics (area / pairwise IoU / format conversion) so the reference's OWN
+    matching and accumulation logic can execute as an oracle.
+    """
+    import torchmetrics.detection.mean_ap as ref_mod
+
+    def box_area(boxes):
+        return (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+
+    def box_iou(a, b):
+        area1, area2 = box_area(a), box_area(b)
+        lt = torch.max(a[:, None, :2], b[None, :, :2])
+        rb = torch.min(a[:, None, 2:], b[None, :, 2:])
+        wh = (rb - lt).clamp(min=0)
+        inter = wh[..., 0] * wh[..., 1]
+        return inter / (area1[:, None] + area2[None, :] - inter)
+
+    def box_convert(boxes, in_fmt, out_fmt):
+        assert out_fmt == "xyxy", out_fmt
+        if in_fmt == "xyxy":
+            return boxes
+        if in_fmt == "xywh":
+            x, y, w, h = boxes.unbind(-1)
+            return torch.stack([x, y, x + w, y + h], dim=-1)
+        if in_fmt == "cxcywh":
+            cx, cy, w, h = boxes.unbind(-1)
+            return torch.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], dim=-1)
+        raise ValueError(in_fmt)
+
+    ref_mod._TORCHVISION_GREATER_EQUAL_0_8 = True
+    ref_mod.box_area = box_area
+    ref_mod.box_iou = box_iou
+    ref_mod.box_convert = box_convert
+    return ref_mod.MeanAveragePrecision
+
+
 def assert_close(ours, ref, atol=1e-5):
     """Compare a metrics_tpu result against a torch reference result."""
     import jax.numpy as jnp
