@@ -1,0 +1,120 @@
+"""Head-to-head wall-clock: regression metrics vs the executed reference.
+
+1M-sample streams through the module API of both libraries (construct + update
++ compute), values asserted equal before timing. Two alternating measurement
+phases per library with per-library best-of (same load-proofing as
+classification_vs_reference.py). The spearman row is the headline: the
+reference's tie handling loops over every repeated value with an O(N) scan
+each (ref src/torchmetrics/functional/regression/spearman.py:50-53) — at 1M
+float32 samples (~30k birthday-collision repeats) that is ~34 s; our ranking
+is one numpy argsort + run-length tie averaging on the host backend
+(functional/regression/misc.py:_rank_data_host), with the jnp sort+searchsorted
+form under jit/accelerators.
+
+Run: python benchmarks/regression_vs_reference.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+
+from tests.parity.conftest import _REF_SRC, _install_stubs  # noqa: E402
+
+if not _REF_SRC.exists():
+    sys.exit("reference checkout not present — nothing to compare against")
+_install_stubs()
+sys.path.insert(0, str(_REF_SRC))
+
+import torch  # noqa: E402
+import torchmetrics.regression as ref  # noqa: E402
+
+import metrics_tpu.regression as ours  # noqa: E402
+
+N = 1_000_000
+
+
+def _best(fn, reps):
+    fn()  # warm / compile
+    best = float("inf")
+    out = None
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn()
+        best = min(best, time.perf_counter() - t0)
+    return best, out
+
+
+def main() -> None:
+    rng = np.random.default_rng(0)
+    p = rng.normal(size=N).astype(np.float32)
+    t = (0.8 * p + 0.2 * rng.normal(size=N)).astype(np.float32)
+    jp, jt = jnp.asarray(p), jnp.asarray(t)
+    tp, tt = torch.tensor(p), torch.tensor(t)
+
+    # (name, ours cls, ref cls, sample count, reps) — spearman at 300k keeps the
+    # reference's pathological tie loop to ~10 s/run so the harness stays <5 min
+    ns = 300_000
+    cases = [
+        ("mse", ours.MeanSquaredError, ref.MeanSquaredError, N, 10),
+        ("mae", ours.MeanAbsoluteError, ref.MeanAbsoluteError, N, 10),
+        ("pearson", ours.PearsonCorrCoef, ref.PearsonCorrCoef, N, 10),
+        ("r2", ours.R2Score, ref.R2Score, N, 10),
+        ("explained_variance", ours.ExplainedVariance, ref.ExplainedVariance, N, 10),
+        ("concordance", ours.ConcordanceCorrCoef, ref.ConcordanceCorrCoef, N, 10),
+        ("spearman", ours.SpearmanCorrCoef, ref.SpearmanCorrCoef, ns, 1),
+    ]
+
+    ours_results, ours_fns = {}, {}
+    for name, ours_cls, _, n, reps in cases:
+
+        def run_ours(ours_cls=ours_cls, n=n):
+            m = ours_cls()
+            m.update(jp[:n], jt[:n])
+            return np.asarray(m.compute())
+
+        ours_results[name] = _best(run_ours, reps)
+        ours_fns[name] = run_ours
+
+    for name, _, ref_cls, n, reps in cases:
+
+        def run_ref(ref_cls=ref_cls, n=n):
+            m = ref_cls()
+            m.update(tp[:n], tt[:n])
+            return m.compute().numpy()
+
+        t_ours, v_ours = ours_results[name]
+        t_ref, v_ref = _best(run_ref, reps)
+        # phase 2: re-time both, keep the per-library best across phases
+        t_ours = min(t_ours, _best(ours_fns[name], reps)[0])
+        t_ref = min(t_ref, _best(run_ref, reps)[0])
+        np.testing.assert_allclose(np.asarray(v_ours, np.float64), np.asarray(v_ref, np.float64), atol=1e-4)
+        print(
+            json.dumps(
+                {
+                    "metric": f"{name} end-to-end (update + compute)",
+                    "value": round(t_ours * 1e3, 2),
+                    "unit": "ms",
+                    "reference_ms": round(t_ref * 1e3, 2),
+                    "speedup_vs_reference": round(t_ref / t_ours, 2),
+                    "values_equal": True,
+                    "config": {"samples": n, "hardware": "same CPU, same process"},
+                }
+            )
+        )
+
+
+if __name__ == "__main__":
+    main()

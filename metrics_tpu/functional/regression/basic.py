@@ -9,19 +9,32 @@ from __future__ import annotations
 
 from typing import Tuple
 
+import jax
 import jax.numpy as jnp
 from jax import Array
 
+import numpy as np
+
 from metrics_tpu.utils.checks import _check_same_shape
-from metrics_tpu.utils.compute import _safe_divide
+from metrics_tpu.utils.compute import _is_eager_cpu, _safe_divide
+
+# Error-sum kernels are jitted at definition: each eager update would otherwise
+# dispatch 2-4 separate O(N) passes (sub, abs/square, sum); compiling fuses
+# them into one memory sweep, which is what beats the reference's eager torch
+# chain (same rationale as classification stat_scores). Under an outer jit the
+# wrapper inlines into the surrounding trace.
+
+
+@jax.jit
+def _mae_kernel(preds: Array, target: Array) -> Array:
+    preds = preds if jnp.issubdtype(preds.dtype, jnp.floating) else preds.astype(jnp.float32)
+    target = target if jnp.issubdtype(target.dtype, jnp.floating) else target.astype(jnp.float32)
+    return jnp.sum(jnp.abs(preds - target))
 
 
 def _mean_absolute_error_update(preds: Array, target: Array) -> Tuple[Array, int]:
     _check_same_shape(preds, target)
-    preds = preds if jnp.issubdtype(preds.dtype, jnp.floating) else preds.astype(jnp.float32)
-    target = target if jnp.issubdtype(target.dtype, jnp.floating) else target.astype(jnp.float32)
-    sum_abs_error = jnp.sum(jnp.abs(preds - target))
-    return sum_abs_error, target.size
+    return _mae_kernel(preds, target), target.size
 
 
 def _mean_absolute_error_compute(sum_abs_error: Array, num_obs: Array) -> Array:
@@ -43,14 +56,22 @@ def mean_absolute_error(preds: Array, target: Array) -> Array:
     return _mean_absolute_error_compute(sum_abs_error, num_obs)
 
 
+@jax.jit
+def _mse_kernel(preds: Array, target: Array) -> Array:
+    diff = preds - target
+    return jnp.sum(diff * diff, axis=0)
+
+
 def _mean_squared_error_update(preds: Array, target: Array, num_outputs: int) -> Tuple[Array, int]:
     _check_same_shape(preds, target)
     if num_outputs == 1:
         preds = preds.reshape(-1)
         target = target.reshape(-1)
-    diff = preds - target
-    sum_squared_error = jnp.sum(diff * diff, axis=0)
-    return sum_squared_error, target.shape[0]
+    if preds.ndim == 1 and _is_eager_cpu(preds):
+        # squared sum as a BLAS dot (multithreaded) — ~2x XLA's CPU reduction
+        d = np.asarray(target, np.float32) - np.asarray(preds, np.float32)
+        return jnp.asarray(np.dot(d, d)), target.shape[0]
+    return _mse_kernel(preds, target), target.shape[0]
 
 
 def _mean_squared_error_compute(sum_squared_error: Array, num_obs: Array, squared: bool = True) -> Array:
@@ -73,10 +94,14 @@ def mean_squared_error(preds: Array, target: Array, squared: bool = True, num_ou
     return _mean_squared_error_compute(sum_squared_error, num_obs, squared)
 
 
+@jax.jit
+def _mape_kernel(preds: Array, target: Array, epsilon: Array) -> Array:
+    return jnp.sum(jnp.abs(preds - target) / jnp.clip(jnp.abs(target), min=epsilon))
+
+
 def _mean_absolute_percentage_error_update(preds: Array, target: Array, epsilon: float = 1.17e-06) -> Tuple[Array, int]:
     _check_same_shape(preds, target)
-    abs_per_error = jnp.abs(preds - target) / jnp.clip(jnp.abs(target), min=epsilon)
-    return jnp.sum(abs_per_error), target.size
+    return _mape_kernel(preds, target, epsilon), target.size
 
 
 def _mean_absolute_percentage_error_compute(sum_abs_per_error: Array, num_obs: Array) -> Array:
@@ -98,12 +123,17 @@ def mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
     return _mean_absolute_percentage_error_compute(s, n)
 
 
+@jax.jit
+def _smape_kernel(preds: Array, target: Array, epsilon: Array) -> Array:
+    abs_per_error = jnp.abs(preds - target) / jnp.clip(jnp.abs(target) + jnp.abs(preds), min=epsilon)
+    return 2 * jnp.sum(abs_per_error)
+
+
 def _symmetric_mean_absolute_percentage_error_update(
     preds: Array, target: Array, epsilon: float = 1.17e-06
 ) -> Tuple[Array, int]:
     _check_same_shape(preds, target)
-    abs_per_error = jnp.abs(preds - target) / jnp.clip(jnp.abs(target) + jnp.abs(preds), min=epsilon)
-    return 2 * jnp.sum(abs_per_error), target.size
+    return _smape_kernel(preds, target, epsilon), target.size
 
 
 def symmetric_mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
@@ -121,11 +151,14 @@ def symmetric_mean_absolute_percentage_error(preds: Array, target: Array) -> Arr
     return s / n
 
 
+@jax.jit
+def _wmape_kernel(preds: Array, target: Array) -> Tuple[Array, Array]:
+    return jnp.sum(jnp.abs((preds - target).reshape(-1))), jnp.sum(jnp.abs(target.reshape(-1)))
+
+
 def _weighted_mean_absolute_percentage_error_update(preds: Array, target: Array) -> Tuple[Array, Array]:
     _check_same_shape(preds, target)
-    sum_abs_error = jnp.sum(jnp.abs((preds - target).reshape(-1)))
-    sum_scale = jnp.sum(jnp.abs(target.reshape(-1)))
-    return sum_abs_error, sum_scale
+    return _wmape_kernel(preds, target)
 
 
 def _weighted_mean_absolute_percentage_error_compute(sum_abs_error: Array, sum_scale: Array, epsilon: float = 1.17e-06) -> Array:
@@ -147,10 +180,14 @@ def weighted_mean_absolute_percentage_error(preds: Array, target: Array) -> Arra
     return _weighted_mean_absolute_percentage_error_compute(s, scale)
 
 
+@jax.jit
+def _msle_kernel(preds: Array, target: Array) -> Array:
+    return jnp.sum((jnp.log1p(preds) - jnp.log1p(target)) ** 2)
+
+
 def _mean_squared_log_error_update(preds: Array, target: Array) -> Tuple[Array, int]:
     _check_same_shape(preds, target)
-    sum_squared_log_error = jnp.sum((jnp.log1p(preds) - jnp.log1p(target)) ** 2)
-    return sum_squared_log_error, target.size
+    return _msle_kernel(preds, target), target.size
 
 
 def mean_squared_log_error(preds: Array, target: Array) -> Array:
@@ -174,13 +211,17 @@ def _unsqueeze_tensors(preds: Array, target: Array) -> Tuple[Array, Array]:
     return preds, target
 
 
+@jax.jit
+def _log_cosh_kernel(preds: Array, target: Array) -> Array:
+    diff = preds - target
+    # numerically-stable log(cosh(x)) = x + softplus(-2x) - log(2)
+    return jnp.sum(diff + jax_softplus(-2.0 * diff) - jnp.log(2.0), axis=0)
+
+
 def _log_cosh_error_update(preds: Array, target: Array, num_outputs: int) -> Tuple[Array, int]:
     _check_same_shape(preds, target)
     preds, target = _unsqueeze_tensors(preds, target)
-    diff = preds - target
-    # numerically-stable log(cosh(x)) = x + softplus(-2x) - log(2)
-    sum_log_cosh_error = jnp.sum(diff + jax_softplus(-2.0 * diff) - jnp.log(2.0), axis=0)
-    return sum_log_cosh_error, preds.shape[0]
+    return _log_cosh_kernel(preds, target), preds.shape[0]
 
 
 def jax_softplus(x: Array) -> Array:

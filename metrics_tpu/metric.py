@@ -57,6 +57,10 @@ _REDUCTION_FNS: Dict[str, Callable] = {
     "max": dim_zero_max,
 }
 
+# one fused dispatch for N state accumulations (see Metric._accumulate);
+# compile cache keyed on the (structure, shapes, dtypes) of the operands
+_tree_add = jax.jit(lambda olds, news: jax.tree_util.tree_map(jnp.add, olds, news))
+
 StateValue = Union[Array, List[Array]]
 
 # kwargs consumed by Metric.__init__ (reference metric.py:82-144 + TPU axis_name
@@ -219,6 +223,34 @@ class Metric(ABC):
             current = getattr(self, key)
             if isinstance(current, list):
                 setattr(self, key, [jax.device_put(c, cpu) for c in current])
+
+    def _accumulate(self, **increments: Any) -> None:
+        """Add ``increments`` onto the same-named sum states in ONE dispatch.
+
+        Only for states whose registered default is zero (the sum-state
+        convention). ``state += x`` per state dispatches a separate tiny
+        kernel each (~80µs/op eagerly on CPU); fusing all adds through one
+        jitted tree-map halves the per-update overhead of multi-state
+        metrics, and the first update after construction/reset skips the add
+        entirely (states still alias their zero defaults, so assignment is
+        exact). Under an outer jit the call inlines into the trace.
+        """
+        names = tuple(increments)
+        olds = tuple(getattr(self, n) for n in names)
+        if all(old is self._defaults[n] for n, old in zip(names, olds)):
+            # untouched zero states (add_state/reset share the default object;
+            # a loaded checkpoint replaces it, so this can't clobber one);
+            # cast to the registered dtype so the state can't drift to e.g. an
+            # int32 increment's dtype (the add path promotes the same way)
+            for n, old in zip(names, olds):
+                v = increments[n]
+                if not (isinstance(v, jax.Array) and v.dtype == old.dtype):
+                    v = jnp.asarray(v, old.dtype)
+                setattr(self, n, v)
+            return
+        news = tuple(increments[n] for n in names)
+        for n, v in zip(names, _tree_add(olds, news)):
+            setattr(self, n, v)
 
     def _wrap_compute(self, compute: Callable) -> Callable:
         @functools.wraps(compute)

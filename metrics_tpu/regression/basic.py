@@ -50,8 +50,7 @@ class MeanAbsoluteError(Metric):
 
     def update(self, preds: Array, target: Array) -> None:
         sum_abs_error, num_obs = _mean_absolute_error_update(preds, target)
-        self.sum_abs_error = self.sum_abs_error + sum_abs_error
-        self.total = self.total + num_obs
+        self._accumulate(sum_abs_error=sum_abs_error, total=jnp.float32(num_obs))
 
     def compute(self) -> Array:
         return _mean_absolute_error_compute(self.sum_abs_error, self.total)
@@ -89,8 +88,7 @@ class MeanSquaredError(Metric):
 
     def update(self, preds: Array, target: Array) -> None:
         sum_squared_error, num_obs = _mean_squared_error_update(preds, target, self.num_outputs)
-        self.sum_squared_error = self.sum_squared_error + sum_squared_error
-        self.total = self.total + num_obs
+        self._accumulate(sum_squared_error=sum_squared_error, total=jnp.float32(num_obs))
 
     def compute(self) -> Array:
         return _mean_squared_error_compute(self.sum_squared_error, self.total, self.squared)
@@ -121,8 +119,7 @@ class MeanAbsolutePercentageError(Metric):
 
     def update(self, preds: Array, target: Array) -> None:
         sum_abs_per_error, num_obs = _mean_absolute_percentage_error_update(preds, target)
-        self.sum_abs_per_error = self.sum_abs_per_error + sum_abs_per_error
-        self.total = self.total + num_obs
+        self._accumulate(sum_abs_per_error=sum_abs_per_error, total=jnp.float32(num_obs))
 
     def compute(self) -> Array:
         return _mean_absolute_percentage_error_compute(self.sum_abs_per_error, self.total)
@@ -153,8 +150,7 @@ class SymmetricMeanAbsolutePercentageError(Metric):
 
     def update(self, preds: Array, target: Array) -> None:
         sum_abs_per_error, num_obs = _symmetric_mean_absolute_percentage_error_update(preds, target)
-        self.sum_abs_per_error = self.sum_abs_per_error + sum_abs_per_error
-        self.total = self.total + num_obs
+        self._accumulate(sum_abs_per_error=sum_abs_per_error, total=jnp.float32(num_obs))
 
     def compute(self) -> Array:
         return self.sum_abs_per_error / self.total
@@ -185,8 +181,7 @@ class WeightedMeanAbsolutePercentageError(Metric):
 
     def update(self, preds: Array, target: Array) -> None:
         sum_abs_error, sum_scale = _weighted_mean_absolute_percentage_error_update(preds, target)
-        self.sum_abs_error = self.sum_abs_error + sum_abs_error
-        self.sum_scale = self.sum_scale + sum_scale
+        self._accumulate(sum_abs_error=sum_abs_error, sum_scale=sum_scale)
 
     def compute(self) -> Array:
         return _weighted_mean_absolute_percentage_error_compute(self.sum_abs_error, self.sum_scale)
@@ -217,8 +212,7 @@ class MeanSquaredLogError(Metric):
 
     def update(self, preds: Array, target: Array) -> None:
         sum_squared_log_error, num_obs = _mean_squared_log_error_update(preds, target)
-        self.sum_squared_log_error = self.sum_squared_log_error + sum_squared_log_error
-        self.total = self.total + num_obs
+        self._accumulate(sum_squared_log_error=sum_squared_log_error, total=jnp.float32(num_obs))
 
     def compute(self) -> Array:
         return self.sum_squared_log_error / self.total
@@ -252,8 +246,7 @@ class LogCoshError(Metric):
 
     def update(self, preds: Array, target: Array) -> None:
         sum_log_cosh_error, num_obs = _log_cosh_error_update(preds, target, self.num_outputs)
-        self.sum_log_cosh_error = self.sum_log_cosh_error + sum_log_cosh_error
-        self.total = self.total + num_obs
+        self._accumulate(sum_log_cosh_error=sum_log_cosh_error, total=jnp.float32(num_obs))
 
     def compute(self) -> Array:
         return _log_cosh_error_compute(self.sum_log_cosh_error, self.total)

@@ -167,11 +167,13 @@ class ExplainedVariance(Metric):
 
     def update(self, preds: Array, target: Array) -> None:
         num_obs, sum_error, sum_squared_error, sum_target, sum_squared_target = _explained_variance_update(preds, target)
-        self.num_obs = self.num_obs + num_obs
-        self.sum_error = self.sum_error + sum_error
-        self.sum_squared_error = self.sum_squared_error + sum_squared_error
-        self.sum_target = self.sum_target + sum_target
-        self.sum_squared_target = self.sum_squared_target + sum_squared_target
+        self._accumulate(
+            num_obs=jnp.float32(num_obs),
+            sum_error=sum_error,
+            sum_squared_error=sum_squared_error,
+            sum_target=sum_target,
+            sum_squared_target=sum_squared_target,
+        )
 
     def compute(self) -> Array:
         return _explained_variance_compute(
@@ -216,10 +218,12 @@ class R2Score(Metric):
 
     def update(self, preds: Array, target: Array) -> None:
         sum_squared_obs, sum_obs, residual, num_obs = _r2_score_update(preds, target)
-        self.sum_squared_error = self.sum_squared_error + sum_squared_obs
-        self.sum_error = self.sum_error + sum_obs
-        self.residual = self.residual + residual
-        self.total = self.total + num_obs
+        self._accumulate(
+            sum_squared_error=sum_squared_obs,
+            sum_error=sum_obs,
+            residual=residual,
+            total=jnp.float32(num_obs),
+        )
 
     def compute(self) -> Array:
         return _r2_score_compute(

@@ -12,8 +12,20 @@ from __future__ import annotations
 
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 from jax import Array
+
+
+def _is_eager_cpu(x: Array) -> bool:
+    """True when ``x`` is a concrete array on the host CPU backend.
+
+    Gates numpy fast paths (multithreaded BLAS dots, cache-friendly sorts)
+    that beat XLA's single-threaded CPU lowerings; under a trace or on an
+    accelerator the jnp form is used instead. ``np.asarray`` of a concrete
+    CPU-backend jax array is zero-copy, so the gate itself is free.
+    """
+    return jax.default_backend() == "cpu" and not isinstance(x, jax.core.Tracer)
 
 
 def _safe_matmul(x: Array, y: Array) -> Array:
