@@ -65,7 +65,8 @@ def main() -> None:
     tp, tt = torch.tensor(p), torch.tensor(t)
 
     # (name, ours cls, ref cls, sample count, reps) — spearman at 300k keeps the
-    # reference's pathological tie loop to ~10 s/run so the harness stays <5 min
+    # reference's pathological tie loop to ~1.2 s/run (it is ~34 s at 1M; the
+    # repeat count grows quadratically) so the harness stays well under 5 min
     ns = 300_000
     cases = [
         ("mse", ours.MeanSquaredError, ref.MeanSquaredError, N, 10),

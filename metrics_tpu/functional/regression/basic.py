@@ -67,9 +67,16 @@ def _mean_squared_error_update(preds: Array, target: Array, num_outputs: int) ->
     if num_outputs == 1:
         preds = preds.reshape(-1)
         target = target.reshape(-1)
-    if preds.ndim == 1 and _is_eager_cpu(preds):
-        # squared sum as a BLAS dot (multithreaded) — ~2x XLA's CPU reduction
-        d = np.asarray(target, np.float32) - np.asarray(preds, np.float32)
+    if (
+        preds.ndim == 1
+        and preds.dtype == jnp.float32
+        and target.dtype == jnp.float32
+        and _is_eager_cpu(preds)
+    ):
+        # squared sum as a BLAS dot (multithreaded) — ~2x XLA's CPU reduction.
+        # f32-only: unlike the r2/explained-variance kernels, _mse_kernel
+        # preserves the input dtype, so wider/integer inputs must not downcast
+        d = np.asarray(target) - np.asarray(preds)
         return jnp.asarray(np.dot(d, d)), target.shape[0]
     return _mse_kernel(preds, target), target.shape[0]
 

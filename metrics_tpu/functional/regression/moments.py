@@ -19,17 +19,20 @@ from metrics_tpu.utils.checks import _check_same_shape
 from metrics_tpu.utils.compute import _is_eager_cpu
 from metrics_tpu.utils.prints import rank_zero_warn
 
-# single-entry cache: plain sums on the host path run as BLAS dots against a
-# ones vector (multithreaded) instead of numpy's single-threaded reduce; one
-# entry bounds memory while serving the common fixed-batch streaming case
+# small bounded cache: plain sums on the host path run as BLAS dots against a
+# ones vector (multithreaded) instead of numpy's single-threaded reduce; a few
+# entries serve streams that alternate batch sizes (e.g. a trailing partial
+# batch) without reallocating the ones vector every update
 _ONES_CACHE: dict = {}
+_ONES_CACHE_MAX = 8
 
 
 def _host_sum(x: "np.ndarray") -> "np.ndarray":
     n = x.shape[0]
     ones = _ONES_CACHE.get(n)
     if ones is None:
-        _ONES_CACHE.clear()
+        if len(_ONES_CACHE) >= _ONES_CACHE_MAX:
+            _ONES_CACHE.pop(next(iter(_ONES_CACHE)))  # FIFO eviction
         ones = np.ones(n, np.float32)
         _ONES_CACHE[n] = ones
     return np.dot(x, ones)
