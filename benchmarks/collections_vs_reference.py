@@ -49,7 +49,7 @@ import torchmetrics.classification as ref  # noqa: E402
 import metrics_tpu as ours_tm  # noqa: E402
 import metrics_tpu.classification as ours  # noqa: E402
 
-N, C, REPS = 1_000_000, 100, 10
+N, C, REPS = 1_000_000, 100, 3  # reps per phase; two phases per variant
 
 
 def _make(lib, cls_src, groups: bool):
@@ -102,17 +102,7 @@ def main() -> None:
         return col, fn
 
     def run_ref(groups):
-        col = ref_tm.MetricCollection(
-            {
-                "acc": ref.MulticlassAccuracy(average="micro", num_classes=C, validate_args=False),
-                "prec": ref.MulticlassPrecision(average="macro", num_classes=C, validate_args=False),
-                "rec": ref.MulticlassRecall(average="macro", num_classes=C, validate_args=False),
-                "f1": ref.MulticlassF1Score(average="macro", num_classes=C, validate_args=False),
-                "spec": ref.MulticlassSpecificity(average="macro", num_classes=C, validate_args=False),
-                "cm": ref.MulticlassConfusionMatrix(num_classes=C, validate_args=False),
-            },
-            compute_groups=groups,
-        )
+        col = _make(ref_tm, ref, groups)
         col.update(tp, tt)
 
         def fn():
@@ -125,17 +115,17 @@ def main() -> None:
     # ours first (pre-torch; see retrieval_vs_reference.py on OMP contamination),
     # then two-phase per-library best-of
     col_og, fn_og = run_ours(True)
-    t_ours_g, _ = _best(fn_og, 3)
+    t_ours_g, _ = _best(fn_og, REPS)
     col_ou, fn_ou = run_ours(False)
-    t_ours_u, _ = _best(fn_ou, 3)
+    t_ours_u, _ = _best(fn_ou, REPS)
     col_rg, fn_rg = run_ref(True)
-    t_ref_g, _ = _best(fn_rg, 3)
+    t_ref_g, _ = _best(fn_rg, REPS)
     col_ru, fn_ru = run_ref(False)
-    t_ref_u, _ = _best(fn_ru, 3)
-    t_ours_g = min(t_ours_g, _best(fn_og, 3)[0])
-    t_ours_u = min(t_ours_u, _best(fn_ou, 3)[0])
-    t_ref_g = min(t_ref_g, _best(fn_rg, 3)[0])
-    t_ref_u = min(t_ref_u, _best(fn_ru, 3)[0])
+    t_ref_u, _ = _best(fn_ru, REPS)
+    t_ours_g = min(t_ours_g, _best(fn_og, REPS)[0])
+    t_ours_u = min(t_ours_u, _best(fn_ou, REPS)[0])
+    t_ref_g = min(t_ref_g, _best(fn_rg, REPS)[0])
+    t_ref_u = min(t_ref_u, _best(fn_ru, REPS)[0])
 
     v_og = {k: np.asarray(v, np.float64) for k, v in col_og.compute().items()}
     for col in (col_ou,):

@@ -67,6 +67,12 @@ def _mean_squared_error_update(preds: Array, target: Array, num_outputs: int) ->
     if num_outputs == 1:
         preds = preds.reshape(-1)
         target = target.reshape(-1)
+    # half-precision inputs accumulate in f32 (f16 overflows at 65504; bf16
+    # loses whole counts past 256) — the repo-wide dtype policy
+    if jnp.issubdtype(preds.dtype, jnp.floating) and jnp.finfo(preds.dtype).bits < 32:
+        preds = preds.astype(jnp.float32)
+    if jnp.issubdtype(target.dtype, jnp.floating) and jnp.finfo(target.dtype).bits < 32:
+        target = target.astype(jnp.float32)
     if (
         preds.ndim == 1
         and preds.dtype == jnp.float32
