@@ -1,0 +1,125 @@
+"""Head-to-head wall-clock: nominal association + pairwise matrices vs the executed reference.
+
+Nominal (1M paired categorical observations, 12x12 contingency): the
+reference builds the contingency table with a Python-indexed bincount chain
+and applies bias corrections eagerly; ours is one fused-jit masked bincount
+(same design as the classification counting path). Pairwise (2000x256):
+(N,D)x(M,D) GEMM-shaped — on the eager CPU path the matrix is computed
+through the host BLAS (functional/pairwise/similarity.py:_host_pairwise),
+under jit/TPU it rides XLA/the MXU. Values asserted equal before timing;
+two alternating phases per library with per-library best-of.
+
+Run: python benchmarks/nominal_pairwise_vs_reference.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+
+from tests.parity.conftest import _REF_SRC, _install_stubs  # noqa: E402
+
+if not _REF_SRC.exists():
+    sys.exit("reference checkout not present — nothing to compare against")
+_install_stubs()
+sys.path.insert(0, str(_REF_SRC))
+
+import torch  # noqa: E402
+import torchmetrics.functional as ref_f  # noqa: E402
+
+import metrics_tpu.functional as ours_f  # noqa: E402
+
+N, CATS, REPS = 1_000_000, 12, 8
+PN, PD = 2000, 256
+
+
+def _best(fn, reps=REPS):
+    fn()
+    best = float("inf")
+    out = None
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn()
+        best = min(best, time.perf_counter() - t0)
+    return best, out
+
+
+def main() -> None:
+    rng = np.random.default_rng(0)
+    a = rng.integers(0, CATS, N)
+    b = (a + rng.integers(0, 4, N)) % CATS
+    ja, jb = jnp.asarray(a), jnp.asarray(b)
+    ta, tb = torch.tensor(a), torch.tensor(b)
+    X = rng.normal(size=(PN, PD)).astype(np.float32)
+    jX, tX = jnp.asarray(X), torch.tensor(X)
+
+    cases = [
+        ("cramers_v", lambda: ours_f.cramers_v(ja, jb), lambda: ref_f.cramers_v(ta, tb)),
+        ("theils_u", lambda: ours_f.theils_u(ja, jb), lambda: ref_f.theils_u(ta, tb)),
+        (
+            "pearsons_contingency",
+            lambda: ours_f.pearsons_contingency_coefficient(ja, jb),
+            lambda: ref_f.pearsons_contingency_coefficient(ta, tb),
+        ),
+        ("tschuprows_t", lambda: ours_f.tschuprows_t(ja, jb), lambda: ref_f.tschuprows_t(ta, tb)),
+        (
+            "pairwise_cosine (2000x256)",
+            lambda: ours_f.pairwise_cosine_similarity(jX),
+            lambda: ref_f.pairwise_cosine_similarity(tX),
+        ),
+        (
+            "pairwise_euclidean (2000x256)",
+            lambda: ours_f.pairwise_euclidean_distance(jX),
+            lambda: ref_f.pairwise_euclidean_distance(tX),
+        ),
+        (
+            "pairwise_linear (2000x256)",
+            lambda: ours_f.pairwise_linear_similarity(jX),
+            lambda: ref_f.pairwise_linear_similarity(tX),
+        ),
+    ]
+
+    ours_results = {}
+    for name, fo, _ in cases:
+        ours_results[name] = _best(lambda fo=fo: np.asarray(fo()))
+
+    for name, fo, fr in cases:
+        t_ours, v_ours = ours_results[name]
+        t_ref, v_ref = _best(lambda fr=fr: fr().numpy())
+        t_ours = min(t_ours, _best(lambda fo=fo: np.asarray(fo()))[0])
+        t_ref = min(t_ref, _best(lambda fr=fr: fr().numpy())[0])
+        np.testing.assert_allclose(
+            np.asarray(v_ours, np.float64), np.asarray(v_ref, np.float64), atol=2e-4, err_msg=name
+        )
+        print(
+            json.dumps(
+                {
+                    "metric": f"{name} end-to-end",
+                    "value": round(t_ours * 1e3, 2),
+                    "unit": "ms",
+                    "reference_ms": round(t_ref * 1e3, 2),
+                    "speedup_vs_reference": round(t_ref / t_ours, 2),
+                    "values_equal": True,
+                    "config": {
+                        "samples": N if "pairwise" not in name else f"{PN}x{PD}",
+                        "hardware": "same CPU, same process",
+                    },
+                }
+            )
+        )
+
+
+if __name__ == "__main__":
+    main()
