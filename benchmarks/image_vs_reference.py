@@ -75,10 +75,36 @@ def main() -> None:
             jax.jit(ours.universal_image_quality_index),
             lambda: torchmetrics.functional.universal_image_quality_index(tp, tt),
         ),
+        (
+            "psnr",
+            # eager: exercises the host BLAS-dot path (psnr.py:_psnr_update)
+            functools.partial(ours.peak_signal_noise_ratio, data_range=1.0),
+            lambda: torchmetrics.functional.peak_signal_noise_ratio(tp, tt, data_range=1.0),
+        ),
+        (
+            "sam",
+            jax.jit(ours.spectral_angle_mapper),
+            lambda: torchmetrics.functional.spectral_angle_mapper(tp, tt),
+        ),
+        (
+            "ergas",
+            # eager: exercises the host einsum-dot path (ergas.py:_ergas_compute)
+            ours.error_relative_global_dimensionless_synthesis,
+            lambda: torchmetrics.functional.error_relative_global_dimensionless_synthesis(tp, tt),
+        ),
     ]
+    # all OURS rows first (before any torch execution: the resident OMP pool
+    # inflates subsequent eager jax/numpy work ~2x — it halved the small psnr/
+    # ergas rows when this loop interleaved), then refs, then a second phase
+    # of each with per-library best-of (same load-proofing as classification)
+    ours_results = {}
+    for name, ours_fn, _ in cases:
+        ours_results[name] = _best(lambda ours_fn=ours_fn: ours_fn(jp, jt))
     for name, ours_fn, ref_fn in cases:
-        t_ours, v_ours = _best(lambda: ours_fn(jp, jt))
+        t_ours, v_ours = ours_results[name]
         t_ref, v_ref = _best(ref_fn)
+        t_ours = min(t_ours, _best(lambda ours_fn=ours_fn: ours_fn(jp, jt))[0])
+        t_ref = min(t_ref, _best(ref_fn)[0])
         v_ours, v_ref = float(np.asarray(v_ours)), float(v_ref)
         assert abs(v_ours - v_ref) < 2e-4, (name, v_ours, v_ref)
         print(

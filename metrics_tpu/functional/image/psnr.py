@@ -11,6 +11,7 @@ from typing import Optional, Tuple, Union
 import jax.numpy as jnp
 from jax import Array
 
+from metrics_tpu.utils.compute import _host_sq_diff_sum
 from metrics_tpu.utils.distributed import reduce
 from metrics_tpu.utils.prints import rank_zero_warn
 
@@ -33,6 +34,9 @@ def _psnr_update(
     dim: Optional[Union[int, Tuple[int, ...]]] = None,
 ) -> Tuple[Array, Array]:
     if dim is None:
+        host = _host_sq_diff_sum(preds, target)
+        if host is not None:
+            return host, jnp.asarray(target.size, dtype=jnp.float32)
         sum_squared_error = jnp.sum(jnp.square(preds - target))
         n_obs = jnp.asarray(target.size, dtype=jnp.float32)
         return sum_squared_error, n_obs

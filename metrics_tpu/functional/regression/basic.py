@@ -16,7 +16,7 @@ from jax import Array
 import numpy as np
 
 from metrics_tpu.utils.checks import _check_same_shape
-from metrics_tpu.utils.compute import _is_eager_cpu, _safe_divide
+from metrics_tpu.utils.compute import _host_sq_diff_sum, _safe_divide
 
 # Error-sum kernels are jitted at definition: each eager update would otherwise
 # dispatch 2-4 separate O(N) passes (sub, abs/square, sum); compiling fuses
@@ -73,17 +73,10 @@ def _mean_squared_error_update(preds: Array, target: Array, num_outputs: int) ->
         preds = preds.astype(jnp.float32)
     if jnp.issubdtype(target.dtype, jnp.floating) and jnp.finfo(target.dtype).bits < 32:
         target = target.astype(jnp.float32)
-    if (
-        preds.ndim == 1
-        and preds.dtype == jnp.float32
-        and target.dtype == jnp.float32
-        and _is_eager_cpu(preds)
-    ):
-        # squared sum as a BLAS dot (multithreaded) — ~2x XLA's CPU reduction.
-        # f32-only: unlike the r2/explained-variance kernels, _mse_kernel
-        # preserves the input dtype, so wider/integer inputs must not downcast
-        d = np.asarray(target) - np.asarray(preds)
-        return jnp.asarray(np.dot(d, d)), target.shape[0]
+    if preds.ndim == 1:
+        host = _host_sq_diff_sum(preds, target)
+        if host is not None:
+            return host, target.shape[0]
     return _mse_kernel(preds, target), target.shape[0]
 
 

@@ -28,6 +28,27 @@ def _is_eager_cpu(x: Array) -> bool:
     return jax.default_backend() == "cpu" and not isinstance(x, jax.core.Tracer)
 
 
+def _host_sq_diff_sum(preds: Array, target: Array):
+    """``sum((target-preds)**2)`` as one multithreaded host BLAS dot, or None.
+
+    Engages only for concrete f32 arrays on the eager CPU backend (the jnp
+    fallbacks preserve wider/integer dtypes, so those must not downcast);
+    callers fall back to their jnp form on None. ~2x XLA's single-threaded
+    CPU reduction at 1M elements.
+    """
+    import numpy as np
+
+    if (
+        preds.dtype == jnp.float32
+        and target.dtype == jnp.float32
+        and _is_eager_cpu(preds)
+        and _is_eager_cpu(target)
+    ):
+        d = (np.asarray(target) - np.asarray(preds)).ravel()
+        return jnp.asarray(np.dot(d, d))
+    return None
+
+
 def _safe_matmul(x: Array, y: Array) -> Array:
     """Matmul that upcasts half-precision inputs so accumulation happens in f32."""
     if x.dtype in (jnp.float16, jnp.bfloat16) or y.dtype in (jnp.float16, jnp.bfloat16):
