@@ -29,18 +29,58 @@ from metrics_tpu.metric import Metric
 from metrics_tpu.utils.data import apply_to_collection
 
 
+# CDF of Poisson(lam=1) at k=0..35: P(X<=k) = e^-1 * sum_{i<=k} 1/i!
+_POISSON1_CDF = np.cumsum(np.exp(-1.0) / np.cumprod(np.concatenate([[1.0], np.arange(1.0, 36.0)])))
+
+
+def _chunk_spans(n: int, chunkable: bool):
+    """Split ``[0, n)`` into a 4096-aligned head span + power-of-two tail spans.
+
+    Poisson resampling draws a fresh ragged length every update; feeding those
+    shapes straight to the jitted update kernels means a compile-cache miss
+    per copy per update (measured ~250 ms each — a 20-copy update took 5 s).
+    Chunking bounds the set of shapes ever seen: the head is one span of
+    ``(n // 4096) * 4096`` elements (a Poisson(size) total concentrates on a
+    couple of distinct multiples), the < 4096 remainder decomposes into at
+    most 12 power-of-two spans shared by every update. update() accumulates
+    across calls, so chunked updates equal the single-batch update for
+    streaming metrics.
+    """
+    if not chunkable or n <= 0:
+        return [(0, n)]
+    spans = []
+    head = (n >> 12) << 12
+    if head:
+        spans.append((0, head))
+    off = head
+    while off < n:
+        chunk = 1 << ((n - off).bit_length() - 1)
+        spans.append((off, off + chunk))
+        off += chunk
+    return spans
+
+
 def _bootstrap_sampler(
     size: int,
     sampling_strategy: str = "poisson",
     rng: Optional[np.random.Generator] = None,
-) -> Array:
-    """Resampling indices (reference bootstrapping.py ``_bootstrap_sampler``)."""
+) -> np.ndarray:
+    """Resampling indices (reference bootstrapping.py ``_bootstrap_sampler``).
+
+    Returned as a host numpy array: the per-copy loop slices it into
+    shape-stable chunks (free in numpy) before the single device gather per
+    chunk — see ``_chunk_spans``.
+    """
     rng = rng or np.random.default_rng()
     if sampling_strategy == "poisson":
-        p = rng.poisson(1, size)
-        return jnp.asarray(np.arange(size).repeat(p))
+        # Poisson(1) via inverse-CDF on a uniform draw: one vectorized
+        # rng.random + a searchsorted over a 36-entry table is ~3x numpy's
+        # per-value transformed-rejection sampler, and exact — the table
+        # covers k<=35 where the residual tail probability underflows f64
+        p = np.searchsorted(_POISSON1_CDF, rng.random(size), side="left")
+        return np.arange(size).repeat(p)
     if sampling_strategy == "multinomial":
-        return jnp.asarray(rng.integers(0, size, size))
+        return rng.integers(0, size, size)
     raise ValueError("Unknown sampling strategy")
 
 
@@ -131,12 +171,13 @@ class BootStrapper(Metric):
         return True
 
     def _batch_size(self, args: Any, kwargs: Any) -> int:
-        args_sizes = apply_to_collection(args, jax.Array, len)
-        kwargs_sizes = apply_to_collection(kwargs, jax.Array, len)
-        if len(args_sizes) > 0:
-            return jax.tree.leaves(args_sizes)[0]
-        if len(kwargs_sizes) > 0:
-            return jax.tree.leaves(kwargs_sizes)[0]
+        # only jax-array leaves define the resample axis (they are the only
+        # leaves the gather touches); anything else cannot be bootstrapped —
+        # same contract as the reference, which fails on tensor-free inputs
+        # (ref bootstrapping.py:122-129)
+        for leaf in jax.tree.leaves((args, kwargs)):
+            if isinstance(leaf, jax.Array) and leaf.ndim > 0:
+                return int(leaf.shape[0])
         raise ValueError("None of the input contained tensors, so could not determine the sampling size")
 
     def update(self, *args: Any, **kwargs: Any) -> None:
@@ -153,13 +194,32 @@ class BootStrapper(Metric):
             del self._stacked_state
 
         size = self._batch_size(args, kwargs)
+        chunkable = self._chunkable(args, kwargs)
         for idx in range(self.num_bootstraps):
             sample_idx = _bootstrap_sampler(size, self.sampling_strategy, self._rng)
             if sample_idx.size == 0:
                 continue
-            new_args = apply_to_collection(args, jax.Array, jnp.take, sample_idx, axis=0)
-            new_kwargs = apply_to_collection(kwargs, jax.Array, jnp.take, sample_idx, axis=0)
-            self.metrics[idx].update(*new_args, **new_kwargs)
+            for lo, hi in _chunk_spans(int(sample_idx.size), chunkable):
+                # numpy slice (free) then ONE gather per chunk: jnp.take is
+                # compile-cached by SHAPE, and power-of-two chunk shapes bound
+                # the cache; eager `a[lo:hi]` would recompile per (lo, hi) pair
+                chunk = jnp.asarray(sample_idx[lo:hi])
+                chunk_args = apply_to_collection(args, jax.Array, jnp.take, chunk, axis=0)
+                chunk_kwargs = apply_to_collection(kwargs, jax.Array, jnp.take, chunk, axis=0)
+                self.metrics[idx].update(*chunk_args, **chunk_kwargs)
+
+    @staticmethod
+    def _chunkable(args: Any, kwargs: Any) -> bool:
+        """Chunking applies when every leaf is either a jax array (gathered
+        and sliced along axis 0) or a passthrough scalar/flag (e.g. FID's
+        ``real=True``, identical in every chunk). Host batch content such as
+        lists of strings (flattened to str leaves) disables chunking — the
+        full resample must reach the base metric in one call."""
+        leaves = jax.tree.leaves((args, kwargs))
+        return any(isinstance(l, jax.Array) for l in leaves) and all(
+            isinstance(l, (jax.Array, bool, int, float, complex, type(None))) for l in leaves
+        )
+
 
     def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Array]:
         """Accumulate globally AND return the batch-only bootstrap statistics.
