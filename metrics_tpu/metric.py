@@ -675,7 +675,12 @@ class Metric(ABC):
                 continue
             current = getattr(self, key)
             if isinstance(current, list):
-                destination[prefix + key] = [np.asarray(c) for c in current]
+                # array entries become numpy leaves (orbax-friendly); host-side
+                # entries (e.g. detection's nested RLE tuples) pass through as
+                # the picklable python objects they already are
+                destination[prefix + key] = [
+                    np.asarray(c) if isinstance(c, (jax.Array, np.ndarray)) else c for c in current
+                ]
             else:
                 destination[prefix + key] = np.asarray(current)
         return destination
@@ -687,7 +692,12 @@ class Metric(ABC):
             if name in state_dict:
                 val = state_dict[name]
                 if isinstance(val, list):
-                    setattr(self, key, [jnp.asarray(v) for v in val])
+                    # restore entries verbatim: state_dict saved numpy leaves,
+                    # and host-compute metrics (detection) depend on numpy
+                    # semantics (a jnp conversion here broke their area-range
+                    # compares via weak-int overflow); device metrics accept
+                    # numpy entries transparently in jnp ops
+                    setattr(self, key, list(val))
                 else:
                     setattr(self, key, jnp.asarray(val))
             elif strict and self._persistent[key]:

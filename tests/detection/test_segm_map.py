@@ -1,0 +1,127 @@
+"""Native segmentation mAP (iou_type='segm') — no pycocotools required.
+
+The reference refuses to run segm without pycocotools (ref mean_ap.py:389);
+here RLE encode/decode is vectorized numpy and mask IoU is one dense matmul
+(detection/mean_ap.py:_rle_encode/_rle_decode/_segm_iou). Tests validate the
+RLE pipeline against dense masks directly, and the whole protocol end-to-end
+via the rectangle equivalence: for axis-aligned rectangular masks, mask IoU
+equals box IoU and mask area equals box area, so segm mAP must equal bbox mAP
+on the same scenes — which reuses the full COCO-protocol oracle transitively.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from metrics_tpu.detection import MeanAveragePrecision
+from metrics_tpu.detection.mean_ap import _mask_area, _rle_decode, _rle_encode, _segm_iou
+
+from tests.detection.test_coco_protocol_oracle import _random_scene
+
+
+def _random_masks(rng, n, h=64, w=64):
+    masks = np.zeros((n, h, w), bool)
+    for i in range(n):
+        # random blobby mask: union of a rectangle and a disk
+        x0, y0 = rng.integers(0, w - 8), rng.integers(0, h - 8)
+        x1, y1 = x0 + rng.integers(4, w - x0), y0 + rng.integers(4, h - y0)
+        masks[i, y0:y1, x0:x1] = True
+        cy, cx, r = rng.integers(0, h), rng.integers(0, w), rng.integers(3, 12)
+        yy, xx = np.ogrid[:h, :w]
+        masks[i] |= (yy - cy) ** 2 + (xx - cx) ** 2 <= r**2
+    return masks
+
+
+class TestRLE:
+    def test_roundtrip(self):
+        rng = np.random.default_rng(0)
+        masks = _random_masks(rng, 8)
+        for m in masks:
+            counts = _rle_encode(m)
+            back = _rle_decode(m.shape, counts).reshape(m.shape, order="F")
+            assert (back == m).all()
+            assert counts.sum() == m.size
+
+    def test_empty_and_full(self):
+        z = np.zeros((5, 7), bool)
+        counts = _rle_encode(z)
+        assert counts.tolist() == [35]
+        f = np.ones((5, 7), bool)
+        counts = _rle_encode(f)
+        assert counts.tolist() == [0, 35]
+        assert _mask_area([((5, 7), np.asarray([0, 35]))])[0] == 35.0
+
+    def test_area_matches_dense(self):
+        rng = np.random.default_rng(1)
+        masks = _random_masks(rng, 6)
+        rles = [(m.shape, _rle_encode(m)) for m in masks]
+        np.testing.assert_array_equal(_mask_area(rles), masks.sum((1, 2)).astype(np.float64))
+
+
+class TestSegmIoU:
+    def test_matches_dense_iou(self):
+        rng = np.random.default_rng(2)
+        det = _random_masks(rng, 5)
+        gt = _random_masks(rng, 4)
+        got = _segm_iou(
+            [(m.shape, _rle_encode(m)) for m in det],
+            [(m.shape, _rle_encode(m)) for m in gt],
+        )
+        # independent dense-set oracle
+        exp = np.zeros((5, 4))
+        for i in range(5):
+            for j in range(4):
+                inter = (det[i] & gt[j]).sum()
+                union = (det[i] | gt[j]).sum()
+                exp[i, j] = inter / union if union else 0.0
+        np.testing.assert_allclose(got, exp, atol=1e-6)
+
+
+def _boxes_to_masks(boxes, labels_len, h=420, w=420):
+    """Axis-aligned integer rectangles as dense masks."""
+    b = np.floor(np.asarray(boxes)).astype(int).clip(0, [w, h, w, h])
+    masks = np.zeros((len(b), h, w), bool)
+    for i, (x0, y0, x1, y1) in enumerate(b):
+        masks[i, y0:y1, x0:x1] = True
+    return masks
+
+
+@pytest.mark.parametrize("seed", [0, 4])
+def test_segm_map_equals_bbox_map_on_rectangles(seed):
+    """For rectangular masks, mask IoU == box IoU and mask area == box area,
+    so the full segm protocol must reproduce bbox mAP exactly (which is
+    itself pinned against the in-test COCO oracle)."""
+    rng = np.random.default_rng(seed)
+    preds, targets = _random_scene(rng, n_images=6, n_classes=3)
+
+    # snap boxes to integer grid so the rectangle masks represent them exactly
+    def snap(ds, with_scores):
+        out = []
+        for d in ds:
+            b = np.floor(np.asarray(d["boxes"])).clip(0, 419)
+            item = {"boxes": b, "labels": d["labels"]}
+            if with_scores:
+                item["scores"] = d["scores"]
+            out.append(item)
+        return out
+
+    preds, targets = snap(preds, True), snap(targets, False)
+
+    bbox_metric = MeanAveragePrecision(iou_type="bbox")
+    bbox_metric.update(preds, targets)
+    res_bbox = bbox_metric.compute()
+
+    segm_metric = MeanAveragePrecision(iou_type="segm")
+    segm_metric.update(
+        [
+            {"masks": _boxes_to_masks(p["boxes"], len(p["labels"])), "scores": p["scores"], "labels": p["labels"]}
+            for p in preds
+        ],
+        [{"masks": _boxes_to_masks(t["boxes"], len(t["labels"])), "labels": t["labels"]} for t in targets],
+    )
+    res_segm = segm_metric.compute()
+
+    for key in ["map", "map_50", "map_75", "map_small", "map_medium", "map_large", "mar_1", "mar_10", "mar_100"]:
+        a, b = float(np.asarray(res_segm[key])), float(np.asarray(res_bbox[key]))
+        assert a == pytest.approx(b, abs=1e-6), (key, a, b)
