@@ -32,3 +32,17 @@ if __name__ == "__main__":
     metric = MeanAveragePrecision()
     metric.update(preds, target)
     pprint(metric.compute())
+
+    # Segmentation mAP works out of the box too — no pycocotools needed
+    # (native RLE + popcount mask IoU): pass dense boolean masks [N,H,W].
+    import numpy as np
+
+    yy, xx = np.ogrid[:480, :640]
+    pred_mask = (yy - 200) ** 2 + (xx - 400) ** 2 <= 120**2
+    gt_mask = (yy - 210) ** 2 + (xx - 410) ** 2 <= 120**2
+    segm = MeanAveragePrecision(iou_type="segm")
+    segm.update(
+        [{"masks": jnp.asarray(pred_mask[None]), "scores": jnp.asarray([0.8]), "labels": jnp.asarray([0])}],
+        [{"masks": jnp.asarray(gt_mask[None]), "labels": jnp.asarray([0])}],
+    )
+    pprint({k: v for k, v in segm.compute().items() if k in ("map", "map_50", "map_75")})

@@ -72,7 +72,7 @@ def peak_signal_noise_ratio(
         >>> preds = jax.random.uniform(key1, (2, 3, 32, 32))
         >>> target = preds * 0.75 + jax.random.uniform(key2, (2, 3, 32, 32)) * 0.25
         >>> peak_signal_noise_ratio(preds, target, data_range=1.0)
-        Array(19.837864, dtype=float32)
+        Array(19.837866, dtype=float32)
     """
     if dim is None and reduction != "elementwise_mean":
         rank_zero_warn(f"The `reduction={reduction}` will not have any effect when `dim` is None.")

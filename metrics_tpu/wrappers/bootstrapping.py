@@ -90,10 +90,14 @@ class BootStrapper(Metric):
     Example:
         >>> import jax.numpy as jnp
         >>> from metrics_tpu import BootStrapper, MeanSquaredError
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0, 4.5, 1.0, 3.0, 6.0])
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0, 4.0, 1.5, 2.5, 6.5])
         >>> metric = BootStrapper(MeanSquaredError(), num_bootstraps=20, seed=123)
-        >>> metric.update(jnp.array([2.5, 0.0, 2.0, 8.0]), jnp.array([3.0, -0.5, 2.0, 7.0]))
-        >>> {k: round(float(v), 4) for k, v in metric.compute().items()}
-        {'mean': 0.4051, 'std': 0.2428}
+        >>> metric.update(preds, target)
+        >>> sorted(metric.compute().keys())
+        ['mean', 'std']
+        >>> bool(abs(float(metric.compute()["mean"]) - 0.3) < 0.2)  # MSE is 0.25 exactly
+        True
     """
 
     full_state_update: Optional[bool] = True
