@@ -26,13 +26,12 @@ _SACREBLEU_AVAILABLE = _package_available("sacrebleu")
 _REGEX_AVAILABLE = _package_available("regex")
 _PESQ_AVAILABLE = _package_available("pesq")
 _PYSTOI_AVAILABLE = _package_available("pystoi")
-_FAST_BSS_EVAL_AVAILABLE = _package_available("fast_bss_eval")
-_PYCOCOTOOLS_AVAILABLE = _package_available("pycocotools")
-_TORCHVISION_AVAILABLE = _package_available("torchvision")
-_TORCH_FIDELITY_AVAILABLE = _package_available("torch_fidelity")
 _LPIPS_AVAILABLE = _package_available("lpips")
-_TQDM_AVAILABLE = _package_available("tqdm")
 _MATPLOTLIB_AVAILABLE = _package_available("matplotlib")
+# The reference additionally gates on pycocotools/torchvision/torch-fidelity/
+# fast_bss_eval/tqdm (ref imports.py:36-44); those paths are fully native here
+# (detection mAP incl. segm, SDR, inception features, no progress-bar dep), so
+# no flags exist for them.
 _SKLEARN_AVAILABLE = _package_available("sklearn")
 _FLAX_AVAILABLE = _package_available("flax")
 _TORCH_AVAILABLE = _package_available("torch")

@@ -96,7 +96,7 @@ class BootStrapper(Metric):
         >>> metric.update(preds, target)
         >>> sorted(metric.compute().keys())
         ['mean', 'std']
-        >>> bool(abs(float(metric.compute()["mean"]) - 0.3) < 0.2)  # MSE is 0.25 exactly
+        >>> bool(abs(float(metric.compute()["mean"]) - 0.3) < 0.2)  # MSE is 0.3125 exactly
         True
     """
 

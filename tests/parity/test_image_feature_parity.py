@@ -1,0 +1,166 @@
+"""Differential parity for the feature-extractor image metrics' MATH.
+
+FID/KID/IS have two halves: the InceptionV3 feature extractor (already pinned
+by activation-parity tests against a torch-side forward of shared weights,
+``tests/image/test_inception_net.py``) and the statistics computed on top of
+the features — running mean+covariance bookkeeping and the Frechet distance
+with its matrix square root (ref src/torchmetrics/image/fid.py:261-296),
+polynomial-kernel MMD subsampling (ref src/torchmetrics/image/kid.py:243-268),
+and the per-split softmax-KL Inception Score (ref
+src/torchmetrics/image/inception.py:143-163).
+
+This file pins the statistics half against the EXECUTED reference: both
+libraries accept a user feature extractor (ref fid.py:238-241 probes a custom
+``Module`` with a dummy 299x299 uint8 image), so one shared random projection
+is installed on both sides — a torch ``Module`` for the reference, the same
+weights as a jax callable for us — and identical uint8 images flow through
+both metrics end to end.
+
+Determinism notes (both sides draw subsets/permutations from their own RNG,
+so configs are chosen to make the randomness a no-op):
+- KID runs with ``subset_size == n_samples``: every subset is the full set and
+  poly-MMD is permutation-invariant, so mean is exact and std is 0 on both.
+- IS runs with ``splits=1``: one chunk regardless of the shuffle. Its std over
+  one split is NaN on both sides (ddof=1) and is not compared.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+torch_lib = pytest.importorskip("torch")
+
+import jax.numpy as jnp  # noqa: E402
+
+from metrics_tpu.image import (  # noqa: E402
+    FrechetInceptionDistance,
+    InceptionScore,
+    KernelInceptionDistance,
+)
+
+IN_DIM = 3 * 8 * 8  # flattened test images; the 299x299 dummy probe is sliced to this
+FEAT_DIM = 16
+_rng = np.random.RandomState(1234)
+_W = _rng.randn(IN_DIM, FEAT_DIM).astype(np.float32) * 0.1
+_B = _rng.randn(FEAT_DIM).astype(np.float32) * 0.01
+
+
+def _torch_feature_module():
+    class _Proj(torch_lib.nn.Module):
+        def __init__(self) -> None:
+            super().__init__()
+            self.register_buffer("w", torch_lib.from_numpy(_W.copy()))
+            self.register_buffer("b", torch_lib.from_numpy(_B.copy()))
+
+        def forward(self, x):  # (N, 3, H, W) uint8 -> (N, FEAT_DIM) f32
+            flat = x.float().div(255.0).flatten(1)[:, : self.w.shape[0]]
+            return flat @ self.w + self.b
+
+    return _Proj()
+
+
+def _jax_feature_fn(imgs):
+    flat = jnp.asarray(imgs).astype(jnp.float32) / 255.0
+    flat = flat.reshape(flat.shape[0], -1)[:, :IN_DIM]
+    return flat @ jnp.asarray(_W) + jnp.asarray(_B)
+
+
+def _images(seed: int, n: int, shift: int = 0) -> np.ndarray:
+    rng = np.random.RandomState(seed)
+    imgs = rng.randint(0, 200, (n, 3, 8, 8)).astype(np.uint8)
+    return np.clip(imgs.astype(np.int32) + shift, 0, 255).astype(np.uint8)
+
+
+def _ref_class(module: str, name: str):
+    # torchmetrics.image.__init__ gates these exports on torch-fidelity being
+    # installed; the classes themselves only need it for the INT feature path
+    # (ref fid.py:224-233), so with a custom Module they import and run fine
+    # from their defining submodules. Callers must request the ``tm`` fixture
+    # first — it puts the reference on sys.path and installs its stubs.
+    import importlib
+
+    if not hasattr(np, "float_"):
+        # the reference's MatrixSquareRoot casts through np.float_ (ref
+        # fid.py:71,82-83), an alias NumPy 2.0 removed; restore it so the
+        # oracle can execute under the in-image numpy
+        np.float_ = np.float64
+    return getattr(importlib.import_module(f"torchmetrics.image.{module}"), name)
+
+
+@pytest.mark.parametrize("batches", [1, 3])
+def test_fid_math_parity(tm, torch, batches):
+    """Running mean+cov accumulation and the sqrtm Frechet distance agree."""
+    ref = _ref_class("fid", "FrechetInceptionDistance")(feature=_torch_feature_module())
+    ours = FrechetInceptionDistance(feature=_jax_feature_fn, num_features=FEAT_DIM)
+
+    for real, base_seed, shift in ((True, 10, 0), (False, 40, 25)):
+        for b in range(batches):
+            imgs = _images(base_seed + b, 24, shift=shift)
+            ref.update(torch_lib.from_numpy(imgs), real=real)
+            ours.update(jnp.asarray(imgs), real=real)
+
+    assert float(ours.compute()) == pytest.approx(float(ref.compute()), rel=2e-3)
+
+
+def test_fid_reset_real_features_parity(tm, torch):
+    """reset_real_features=False keeps real stats through reset on both sides."""
+    ref = _ref_class("fid", "FrechetInceptionDistance")(feature=_torch_feature_module(), reset_real_features=False)
+    ours = FrechetInceptionDistance(
+        feature=_jax_feature_fn, num_features=FEAT_DIM, reset_real_features=False
+    )
+    real, fake1, fake2 = _images(1, 32), _images(2, 32, shift=30), _images(3, 32, shift=-20)
+
+    for m, t in ((ref, torch_lib.from_numpy), (ours, jnp.asarray)):
+        m.update(t(real), real=True)
+        m.update(t(fake1), real=False)
+    first = (float(ref.compute()), float(ours.compute()))
+    assert first[1] == pytest.approx(first[0], rel=2e-3)
+
+    ref.reset()
+    ours.reset()
+    ref.update(torch_lib.from_numpy(fake2), real=False)
+    ours.update(jnp.asarray(fake2), real=False)
+    second = (float(ref.compute()), float(ours.compute()))
+    assert second[1] == pytest.approx(second[0], rel=2e-3)
+    assert abs(second[0] - first[0]) > 1e-6  # the fake stats really did reset
+
+
+@pytest.mark.parametrize(
+    ("degree", "gamma", "coef"),
+    [(3, None, 1.0), (2, 0.5, 2.0)],
+)
+def test_kid_math_parity(tm, torch, degree, gamma, coef):
+    """Polynomial-kernel MMD agrees; subset_size == N makes sampling a no-op."""
+    n = 40
+    ref = _ref_class("kid", "KernelInceptionDistance")(
+        feature=_torch_feature_module(), subsets=3, subset_size=n, degree=degree, gamma=gamma, coef=coef
+    )
+    ours = KernelInceptionDistance(
+        feature=_jax_feature_fn, subsets=3, subset_size=n, degree=degree, gamma=gamma, coef=coef
+    )
+    real, fake = _images(7, n), _images(8, n, shift=40)
+    for m, t in ((ref, torch_lib.from_numpy), (ours, jnp.asarray)):
+        m.update(t(real), real=True)
+        m.update(t(fake), real=False)
+
+    ref_mean, ref_std = (float(x) for x in ref.compute())
+    our_mean, our_std = (float(x) for x in ours.compute())
+    assert our_mean == pytest.approx(ref_mean, rel=2e-3)
+    # full-set subsets are mathematically identical; the stds differ from 0
+    # only by f32 summation-order noise on each side
+    assert ref_std == pytest.approx(0.0, abs=1e-4)
+    assert our_std == pytest.approx(0.0, abs=1e-4)
+
+
+def test_inception_score_math_parity(tm, torch):
+    """Per-split softmax-KL score agrees; splits=1 makes the shuffle a no-op."""
+    ref = _ref_class("inception", "InceptionScore")(feature=_torch_feature_module(), splits=1)
+    ours = InceptionScore(feature=_jax_feature_fn, splits=1)
+    imgs = _images(11, 48)
+    ref.update(torch_lib.from_numpy(imgs))
+    ours.update(jnp.asarray(imgs))
+    ref_mean, _ = ref.compute()
+    our_mean, _ = ours.compute()
+    assert float(our_mean) == pytest.approx(float(ref_mean), rel=2e-3)
