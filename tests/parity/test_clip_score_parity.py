@@ -1,0 +1,112 @@
+"""Differential parity for CLIPScore — the last model-based metric.
+
+Mirrors the BERTScore/InfoLM tier (``test_model_text_parity.py``): one tiny
+random-weight CLIP checkpoint is written to disk in BOTH torch and flax
+formats together with a real ``CLIPProcessor`` (BPE tokenizer + image
+processor), then identical uint8 images and captions flow through the
+executed reference (ref src/torchmetrics/multimodal/clip_score.py:105-116,
+torch side) and through our implementation (flax side). The whole pipeline is
+compared end to end: processor preprocessing, both CLIP towers, the
+100·cos(E_I, E_C) scoring, streaming accumulation, and the final clamp at 0.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+torch_lib = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import jax.numpy as jnp  # noqa: E402
+
+from metrics_tpu.functional.multimodal import clip_score as ours_clip_score  # noqa: E402
+from metrics_tpu.multimodal import CLIPScore as OursCLIPScore  # noqa: E402
+
+IMG = 32  # == vision image_size, so the image processor's resize is identity
+
+# tiny BPE assets in the style of the transformers CLIP test fixtures
+_VOCAB = ["l", "o", "w", "e", "r", "s", "t", "i", "d", "n", "lo", "l</w>", "w</w>", "r</w>", "t</w>",
+          "low</w>", "er</w>", "lowest</w>", "newer</w>", "wider", "<unk>", "<|startoftext|>", "<|endoftext|>"]
+_MERGES = ["#version: 0.2", "l o", "lo w</w>", "e r</w>"]
+_CAPTIONS_A = ["lower newer", "newer lower"]
+_CAPTIONS_B = ["low er", "wider newer"]
+
+
+@pytest.fixture(scope="module")
+def tiny_clip_dir(tmp_path_factory, tm):
+    from transformers import (
+        CLIPConfig,
+        CLIPImageProcessor,
+        CLIPModel,
+        CLIPProcessor,
+        CLIPTextConfig,
+        CLIPTokenizer,
+        CLIPVisionConfig,
+        FlaxCLIPModel,
+    )
+
+    d = str(tmp_path_factory.mktemp("tiny_clip"))
+    with open(os.path.join(d, "vocab.json"), "w") as fh:
+        import json
+
+        json.dump({tok: i for i, tok in enumerate(_VOCAB)}, fh)
+    with open(os.path.join(d, "merges.txt"), "w") as fh:
+        fh.write("\n".join(_MERGES))
+
+    tokenizer = CLIPTokenizer(os.path.join(d, "vocab.json"), os.path.join(d, "merges.txt"))
+    image_processor = CLIPImageProcessor(
+        size={"shortest_edge": IMG}, crop_size={"height": IMG, "width": IMG}
+    )
+    CLIPProcessor(image_processor=image_processor, tokenizer=tokenizer).save_pretrained(d)
+
+    config = CLIPConfig(
+        text_config=CLIPTextConfig(
+            vocab_size=len(_VOCAB), hidden_size=16, intermediate_size=32, num_hidden_layers=2,
+            num_attention_heads=2, max_position_embeddings=16, projection_dim=8,
+        ).to_dict(),
+        vision_config=CLIPVisionConfig(
+            hidden_size=16, intermediate_size=32, num_hidden_layers=2, num_attention_heads=2,
+            image_size=IMG, patch_size=8, projection_dim=8,
+        ).to_dict(),
+        projection_dim=8,
+    )
+    torch_lib.manual_seed(0)
+    CLIPModel(config).eval().save_pretrained(d)
+    FlaxCLIPModel.from_pretrained(d, from_pt=True).save_pretrained(d)
+    return d
+
+
+def _imgs(seed: int, n: int) -> np.ndarray:
+    return np.random.RandomState(seed).randint(0, 255, (n, 3, IMG, IMG)).astype(np.uint8)
+
+
+def test_clip_score_functional_parity(tm, torch, tiny_clip_dir):
+    import importlib
+
+    ref_fn = importlib.import_module("torchmetrics.functional.multimodal.clip_score").clip_score
+    imgs = _imgs(0, 2)
+    ref = ref_fn(
+        torch_lib.from_numpy(imgs.astype(np.int64)), _CAPTIONS_A, model_name_or_path=tiny_clip_dir
+    )
+    ours = ours_clip_score(jnp.asarray(imgs), _CAPTIONS_A, model_name_or_path=tiny_clip_dir)
+    assert float(ours) == pytest.approx(float(ref), abs=2e-2)
+
+
+def test_clip_score_module_streaming_parity(tm, torch, tiny_clip_dir):
+    """Two update batches accumulate to the same clamped mean on both sides."""
+    import importlib
+
+    ref = importlib.import_module("torchmetrics.multimodal.clip_score").CLIPScore(model_name_or_path=tiny_clip_dir)
+    ours = OursCLIPScore(model_name_or_path=tiny_clip_dir)
+
+    for seed, captions in ((1, _CAPTIONS_A), (2, _CAPTIONS_B)):
+        imgs = _imgs(seed, len(captions))
+        ref.update(torch_lib.from_numpy(imgs.astype(np.int64)), captions)
+        ours.update(jnp.asarray(imgs), captions)
+
+    assert int(ref.n_samples) == int(ours.n_samples) == 4
+    assert float(ours.compute()) == pytest.approx(float(ref.compute()), abs=2e-2)
