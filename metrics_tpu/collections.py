@@ -20,6 +20,7 @@ from typing import Any, Dict, Hashable, Iterable, Iterator, List, Optional, Sequ
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from metrics_tpu.metric import Metric
 from metrics_tpu.utils.data import _flatten_dict, allclose
@@ -232,12 +233,13 @@ class MetricCollection:
             return False
         if metric1._defaults.keys() != metric2._defaults.keys():
             return False
+        # numpy scalars/arrays appear as states on the eager host paths; they
+        # compare interchangeably with jax arrays (value comparison, not type)
+        array_like = (jax.Array, np.ndarray, np.generic)
         for key in metric1._defaults:
             state1 = getattr(metric1, key)
             state2 = getattr(metric2, key)
-            if type(state1) != type(state2):  # noqa: E721
-                return False
-            if isinstance(state1, jax.Array) and isinstance(state2, jax.Array):
+            if isinstance(state1, array_like) and isinstance(state2, array_like):
                 if state1.shape != state2.shape or state1.dtype != state2.dtype:
                     return False
                 if not allclose(state1, state2):
@@ -249,6 +251,9 @@ class MetricCollection:
                     s1.shape == s2.shape and allclose(s1, s2) for s1, s2 in zip(state1, state2)
                 ):
                     return False
+            else:
+                # mixed or unrecognised state kinds: never group on a guess
+                return False
         return True
 
     def _compute_groups_create_state_ref(self, copy: bool = False) -> None:

@@ -7,6 +7,8 @@ making the states fixed-shape and psum-mergeable.
 
 from __future__ import annotations
 
+import os
+import threading
 from typing import Tuple, Union
 
 import jax
@@ -27,7 +29,19 @@ _ONES_CACHE: dict = {}
 _ONES_CACHE_MAX = 8
 
 
+# With >1 core, BLAS's threaded dot-against-ones beats numpy's single-threaded
+# pairwise sum despite reading 2x the bytes (ones vector included); on a single
+# core the extra 4 MB read makes it strictly slower, so plain np.sum wins.
+# sched_getaffinity sees cgroup/taskset limits that os.cpu_count ignores.
+try:
+    _SUM_VIA_DOT = len(os.sched_getaffinity(0)) > 1
+except AttributeError:  # platforms without sched_getaffinity
+    _SUM_VIA_DOT = (os.cpu_count() or 1) > 1
+
+
 def _host_sum(x: "np.ndarray") -> "np.ndarray":
+    if not _SUM_VIA_DOT:
+        return np.sum(x)
     n = x.shape[0]
     ones = _ONES_CACHE.get(n)
     if ones is None:
@@ -36,6 +50,28 @@ def _host_sum(x: "np.ndarray") -> "np.ndarray":
         ones = np.ones(n, np.float32)
         _ONES_CACHE[n] = ones
     return np.dot(x, ones)
+
+
+_SCRATCH = threading.local()
+
+
+def _host_diff(t: "np.ndarray", p: "np.ndarray") -> "np.ndarray":
+    """``t - p`` into a reusable per-thread scratch buffer.
+
+    A fresh 4 MB temporary per 1M-sample update is page-fault-bound (~0.5 ms —
+    half the whole r2 kernel); writing into a kept buffer pays only the memory
+    bandwidth after the first call at a given size. The returned view is only
+    valid until the next ``_host_diff`` call on the same thread, so callers
+    must reduce it (dot/sum) before computing another diff.
+    """
+    n = t.shape[0]
+    buf = getattr(_SCRATCH, "buf", None)
+    if buf is None or buf.shape[0] < n:
+        buf = np.empty(n, np.float32)
+        _SCRATCH.buf = buf
+    out = buf[:n]
+    np.subtract(t, p, out=out)
+    return out
 
 
 # --------------------------------------------------------------------------- pearson
@@ -170,15 +206,17 @@ def _explained_variance_update(preds: Array, target: Array) -> Tuple[int, Array,
     """Streaming sums (reference explained_variance.py:~30)."""
     _check_same_shape(preds, target)
     if preds.ndim == 1 and _is_eager_cpu(preds):
-        # squared sums as BLAS dots (multithreaded) — ~2x XLA's CPU reduction
+        # squared sums as BLAS dots — ~2x XLA's CPU reduction; results stay as
+        # numpy scalars (no device put — _accumulate and the compute jit both
+        # take them natively)
         t = np.asarray(target, np.float32)
-        d = t - np.asarray(preds, np.float32)
+        d = _host_diff(t, np.asarray(preds, np.float32))
         return (
             preds.shape[0],
-            jnp.asarray(_host_sum(d)),
-            jnp.asarray(np.dot(d, d)),
-            jnp.asarray(_host_sum(t)),
-            jnp.asarray(np.dot(t, t)),
+            _host_sum(d),
+            np.dot(d, d),
+            _host_sum(t),
+            np.dot(t, t),
         )
     sum_error, sum_squared_error, sum_target, sum_squared_target = _explained_variance_kernel(preds, target)
     return preds.shape[0], sum_error, sum_squared_error, sum_target, sum_squared_target
@@ -252,13 +290,15 @@ def _r2_score_update(preds: Array, target: Array) -> Tuple[Array, Array, Array, 
     """Streaming sums (reference r2.py:~25)."""
     _check_same_shape(preds, target)
     if preds.ndim == 1 and _is_eager_cpu(preds):
-        # squared sums as BLAS dots (multithreaded) — ~2x XLA's CPU reduction
+        # squared sums as BLAS dots — ~2x XLA's CPU reduction; results stay as
+        # numpy scalars (no device put — _accumulate and the compute jit both
+        # take them natively)
         t = np.asarray(target, np.float32)
-        d = t - np.asarray(preds, np.float32)
+        d = _host_diff(t, np.asarray(preds, np.float32))
         return (
-            jnp.asarray(np.dot(t, t)),
-            jnp.asarray(_host_sum(t)),
-            jnp.asarray(np.dot(d, d)),
+            np.dot(t, t),
+            _host_sum(t),
+            np.dot(d, d),
             target.shape[0],
         )
     sum_squared_obs, sum_obs, residual = _r2_kernel(preds, target)

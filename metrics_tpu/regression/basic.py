@@ -6,6 +6,7 @@ from __future__ import annotations
 from typing import Any
 
 import jax.numpy as jnp
+import numpy as np
 from jax import Array
 
 from metrics_tpu.functional.regression.basic import (
@@ -22,7 +23,7 @@ from metrics_tpu.functional.regression.basic import (
     _weighted_mean_absolute_percentage_error_compute,
     _weighted_mean_absolute_percentage_error_update,
 )
-from metrics_tpu.metric import Metric
+from metrics_tpu.metric import Metric, zero_state
 
 
 class MeanAbsoluteError(Metric):
@@ -45,12 +46,12 @@ class MeanAbsoluteError(Metric):
 
     def __init__(self, **kwargs: Any) -> None:
         super().__init__(**kwargs)
-        self.add_state("sum_abs_error", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
-        self.add_state("total", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("sum_abs_error", zero_state(), dist_reduce_fx="sum")
+        self.add_state("total", zero_state(), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         sum_abs_error, num_obs = _mean_absolute_error_update(preds, target)
-        self._accumulate(sum_abs_error=sum_abs_error, total=jnp.float32(num_obs))
+        self._accumulate(sum_abs_error=sum_abs_error, total=np.float32(num_obs))
 
     def compute(self) -> Array:
         return _mean_absolute_error_compute(self.sum_abs_error, self.total)
@@ -83,12 +84,12 @@ class MeanSquaredError(Metric):
             raise ValueError(f"Expected num_outputs to be a positive integer but got {num_outputs}")
         self.num_outputs = num_outputs
         shape = () if num_outputs == 1 else (num_outputs,)
-        self.add_state("sum_squared_error", jnp.zeros(shape, jnp.float32), dist_reduce_fx="sum")
-        self.add_state("total", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("sum_squared_error", zero_state(shape), dist_reduce_fx="sum")
+        self.add_state("total", zero_state(), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         sum_squared_error, num_obs = _mean_squared_error_update(preds, target, self.num_outputs)
-        self._accumulate(sum_squared_error=sum_squared_error, total=jnp.float32(num_obs))
+        self._accumulate(sum_squared_error=sum_squared_error, total=np.float32(num_obs))
 
     def compute(self) -> Array:
         return _mean_squared_error_compute(self.sum_squared_error, self.total, self.squared)
@@ -114,12 +115,12 @@ class MeanAbsolutePercentageError(Metric):
 
     def __init__(self, **kwargs: Any) -> None:
         super().__init__(**kwargs)
-        self.add_state("sum_abs_per_error", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
-        self.add_state("total", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("sum_abs_per_error", zero_state(), dist_reduce_fx="sum")
+        self.add_state("total", zero_state(), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         sum_abs_per_error, num_obs = _mean_absolute_percentage_error_update(preds, target)
-        self._accumulate(sum_abs_per_error=sum_abs_per_error, total=jnp.float32(num_obs))
+        self._accumulate(sum_abs_per_error=sum_abs_per_error, total=np.float32(num_obs))
 
     def compute(self) -> Array:
         return _mean_absolute_percentage_error_compute(self.sum_abs_per_error, self.total)
@@ -145,12 +146,12 @@ class SymmetricMeanAbsolutePercentageError(Metric):
 
     def __init__(self, **kwargs: Any) -> None:
         super().__init__(**kwargs)
-        self.add_state("sum_abs_per_error", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
-        self.add_state("total", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("sum_abs_per_error", zero_state(), dist_reduce_fx="sum")
+        self.add_state("total", zero_state(), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         sum_abs_per_error, num_obs = _symmetric_mean_absolute_percentage_error_update(preds, target)
-        self._accumulate(sum_abs_per_error=sum_abs_per_error, total=jnp.float32(num_obs))
+        self._accumulate(sum_abs_per_error=sum_abs_per_error, total=np.float32(num_obs))
 
     def compute(self) -> Array:
         return self.sum_abs_per_error / self.total
@@ -176,8 +177,8 @@ class WeightedMeanAbsolutePercentageError(Metric):
 
     def __init__(self, **kwargs: Any) -> None:
         super().__init__(**kwargs)
-        self.add_state("sum_abs_error", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
-        self.add_state("sum_scale", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("sum_abs_error", zero_state(), dist_reduce_fx="sum")
+        self.add_state("sum_scale", zero_state(), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         sum_abs_error, sum_scale = _weighted_mean_absolute_percentage_error_update(preds, target)
@@ -207,12 +208,12 @@ class MeanSquaredLogError(Metric):
 
     def __init__(self, **kwargs: Any) -> None:
         super().__init__(**kwargs)
-        self.add_state("sum_squared_log_error", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
-        self.add_state("total", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("sum_squared_log_error", zero_state(), dist_reduce_fx="sum")
+        self.add_state("total", zero_state(), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         sum_squared_log_error, num_obs = _mean_squared_log_error_update(preds, target)
-        self._accumulate(sum_squared_log_error=sum_squared_log_error, total=jnp.float32(num_obs))
+        self._accumulate(sum_squared_log_error=sum_squared_log_error, total=np.float32(num_obs))
 
     def compute(self) -> Array:
         return self.sum_squared_log_error / self.total
@@ -241,12 +242,12 @@ class LogCoshError(Metric):
         if not (isinstance(num_outputs, int) and num_outputs > 0):
             raise ValueError(f"Expected num_outputs to be a positive integer but got {num_outputs}")
         self.num_outputs = num_outputs
-        self.add_state("sum_log_cosh_error", jnp.zeros((num_outputs,), jnp.float32), dist_reduce_fx="sum")
-        self.add_state("total", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("sum_log_cosh_error", zero_state((num_outputs,)), dist_reduce_fx="sum")
+        self.add_state("total", zero_state(), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         sum_log_cosh_error, num_obs = _log_cosh_error_update(preds, target, self.num_outputs)
-        self._accumulate(sum_log_cosh_error=sum_log_cosh_error, total=jnp.float32(num_obs))
+        self._accumulate(sum_log_cosh_error=sum_log_cosh_error, total=np.float32(num_obs))
 
     def compute(self) -> Array:
         return _log_cosh_error_compute(self.sum_log_cosh_error, self.total)

@@ -18,7 +18,7 @@ from metrics_tpu.functional.regression.misc import (
     _tweedie_deviance_score_compute,
     _tweedie_deviance_score_update,
 )
-from metrics_tpu.metric import Metric
+from metrics_tpu.metric import Metric, zero_state
 from metrics_tpu.utils.checks import _check_same_shape
 from metrics_tpu.utils.data import dim_zero_cat
 
@@ -90,10 +90,10 @@ class KLDivergence(Metric):
         self.reduction = reduction
 
         if self.reduction in ("mean", "sum"):
-            self.add_state("measures", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+            self.add_state("measures", zero_state(), dist_reduce_fx="sum")
         else:
             self.add_state("measures", [], dist_reduce_fx="cat")
-        self.add_state("total", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total", zero_state(), dist_reduce_fx="sum")
 
     def update(self, p: Array, q: Array) -> None:
         measures, total = _kld_update(p, q, self.log_prob)
@@ -135,8 +135,8 @@ class TweedieDevianceScore(Metric):
         if 0 < power < 1:
             raise ValueError(f"Deviance Score is not defined for power={power}.")
         self.power = power
-        self.add_state("sum_deviance_score", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
-        self.add_state("num_observations", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("sum_deviance_score", zero_state(), dist_reduce_fx="sum")
+        self.add_state("num_observations", zero_state(), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         sum_deviance_score, num_observations = _tweedie_deviance_score_update(preds, target, self.power)

@@ -6,6 +6,7 @@ from __future__ import annotations
 from typing import Any, Callable, List
 
 import jax.numpy as jnp
+import numpy as np
 from jax import Array
 
 from metrics_tpu.functional.regression.moments import (
@@ -17,7 +18,7 @@ from metrics_tpu.functional.regression.moments import (
     _r2_score_compute,
     _r2_score_update,
 )
-from metrics_tpu.metric import Metric
+from metrics_tpu.metric import Metric, zero_state
 
 
 def _final_aggregation(
@@ -73,12 +74,12 @@ class _PearsonBase(Metric):
         shape = (num_outputs,) if num_outputs > 1 else ()
         # dist_reduce_fx=None → states gathered (stacked) across replicas, merged in
         # compute via the parallel-Welford _final_aggregation (reference pearson.py)
-        self.add_state("mean_x", jnp.zeros(shape, jnp.float32), dist_reduce_fx=None)
-        self.add_state("mean_y", jnp.zeros(shape, jnp.float32), dist_reduce_fx=None)
-        self.add_state("var_x", jnp.zeros(shape, jnp.float32), dist_reduce_fx=None)
-        self.add_state("var_y", jnp.zeros(shape, jnp.float32), dist_reduce_fx=None)
-        self.add_state("corr_xy", jnp.zeros(shape, jnp.float32), dist_reduce_fx=None)
-        self.add_state("n_total", jnp.zeros((), jnp.float32), dist_reduce_fx=None)
+        self.add_state("mean_x", zero_state(shape), dist_reduce_fx=None)
+        self.add_state("mean_y", zero_state(shape), dist_reduce_fx=None)
+        self.add_state("var_x", zero_state(shape), dist_reduce_fx=None)
+        self.add_state("var_y", zero_state(shape), dist_reduce_fx=None)
+        self.add_state("corr_xy", zero_state(shape), dist_reduce_fx=None)
+        self.add_state("n_total", zero_state(), dist_reduce_fx=None)
 
     def update(self, preds: Array, target: Array) -> None:
         self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total = _pearson_corrcoef_update(
@@ -159,16 +160,16 @@ class ExplainedVariance(Metric):
         if multioutput not in allowed_multioutput:
             raise ValueError(f"Invalid input to argument `multioutput`. Choose one of the following: {allowed_multioutput}")
         self.multioutput = multioutput
-        self.add_state("sum_error", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
-        self.add_state("sum_squared_error", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
-        self.add_state("sum_target", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
-        self.add_state("sum_squared_target", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
-        self.add_state("num_obs", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("sum_error", zero_state(), dist_reduce_fx="sum")
+        self.add_state("sum_squared_error", zero_state(), dist_reduce_fx="sum")
+        self.add_state("sum_target", zero_state(), dist_reduce_fx="sum")
+        self.add_state("sum_squared_target", zero_state(), dist_reduce_fx="sum")
+        self.add_state("num_obs", zero_state(), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         num_obs, sum_error, sum_squared_error, sum_target, sum_squared_target = _explained_variance_update(preds, target)
         self._accumulate(
-            num_obs=jnp.float32(num_obs),
+            num_obs=np.float32(num_obs),
             sum_error=sum_error,
             sum_squared_error=sum_squared_error,
             sum_target=sum_target,
@@ -211,10 +212,10 @@ class R2Score(Metric):
             raise ValueError(f"Invalid input to argument `multioutput`. Choose one of the following: {allowed_multioutput}")
         self.multioutput = multioutput
         shape = (num_outputs,) if num_outputs > 1 else ()
-        self.add_state("sum_squared_error", jnp.zeros(shape, jnp.float32), dist_reduce_fx="sum")
-        self.add_state("sum_error", jnp.zeros(shape, jnp.float32), dist_reduce_fx="sum")
-        self.add_state("residual", jnp.zeros(shape, jnp.float32), dist_reduce_fx="sum")
-        self.add_state("total", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("sum_squared_error", zero_state(shape), dist_reduce_fx="sum")
+        self.add_state("sum_error", zero_state(shape), dist_reduce_fx="sum")
+        self.add_state("residual", zero_state(shape), dist_reduce_fx="sum")
+        self.add_state("total", zero_state(), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         sum_squared_obs, sum_obs, residual, num_obs = _r2_score_update(preds, target)
@@ -222,7 +223,7 @@ class R2Score(Metric):
             sum_squared_error=sum_squared_obs,
             sum_error=sum_obs,
             residual=residual,
-            total=jnp.float32(num_obs),
+            total=np.float32(num_obs),
         )
 
     def compute(self) -> Array:
