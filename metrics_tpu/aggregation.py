@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 from jax import Array
 
-from metrics_tpu.metric import Metric
+from metrics_tpu.metric import Metric, zero_state
 from metrics_tpu.utils.checks import _value_check_possible
 from metrics_tpu.utils.data import dim_zero_cat
 from metrics_tpu.utils.prints import rank_zero_warn
@@ -152,7 +152,7 @@ class SumMetric(BaseAggregator):
     """
 
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
-        super().__init__("sum", jnp.zeros((), dtype=jnp.float32), nan_strategy, state_name="sum_value", **kwargs)
+        super().__init__("sum", zero_state((), jnp.float32), nan_strategy, state_name="sum_value", **kwargs)
 
     def update(self, value: Union[float, Array]) -> None:
         value, _ = self._cast_and_nan_check_input(value)
@@ -203,8 +203,8 @@ class MeanMetric(BaseAggregator):
     """
 
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
-        super().__init__("sum", jnp.zeros((), dtype=jnp.float32), nan_strategy, state_name="mean_value", **kwargs)
-        self.add_state("weight", default=jnp.zeros((), dtype=jnp.float32), dist_reduce_fx="sum")
+        super().__init__("sum", zero_state((), jnp.float32), nan_strategy, state_name="mean_value", **kwargs)
+        self.add_state("weight", default=zero_state((), dtype=jnp.float32), dist_reduce_fx="sum")
 
     def update(self, value: Union[float, Array], weight: Union[float, Array] = 1.0) -> None:
         value, weight = self._cast_and_nan_check_input(value, weight)

@@ -27,7 +27,7 @@ from metrics_tpu.functional.classification.calibration_error import (
     _multiclass_calibration_error_update,
 )
 from metrics_tpu.functional.classification.stat_scores import _ignore_mask, _sigmoid_if_logits, _softmax_if_logits
-from metrics_tpu.metric import Metric
+from metrics_tpu.metric import Metric, zero_state
 from metrics_tpu.utils.enums import ClassificationTaskNoMultilabel
 
 
@@ -66,9 +66,9 @@ class BinaryCalibrationError(Metric):
         self.norm = norm
         self.ignore_index = ignore_index
         self.validate_args = validate_args
-        self.add_state("acc_bin", jnp.zeros(n_bins, dtype=jnp.float32), dist_reduce_fx="sum")
-        self.add_state("conf_bin", jnp.zeros(n_bins, dtype=jnp.float32), dist_reduce_fx="sum")
-        self.add_state("count_bin", jnp.zeros(n_bins, dtype=jnp.float32), dist_reduce_fx="sum")
+        self.add_state("acc_bin", zero_state(n_bins, dtype=jnp.float32), dist_reduce_fx="sum")
+        self.add_state("conf_bin", zero_state(n_bins, dtype=jnp.float32), dist_reduce_fx="sum")
+        self.add_state("count_bin", zero_state(n_bins, dtype=jnp.float32), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         if self.validate_args:
@@ -125,9 +125,9 @@ class MulticlassCalibrationError(Metric):
         self.norm = norm
         self.ignore_index = ignore_index
         self.validate_args = validate_args
-        self.add_state("acc_bin", jnp.zeros(n_bins, dtype=jnp.float32), dist_reduce_fx="sum")
-        self.add_state("conf_bin", jnp.zeros(n_bins, dtype=jnp.float32), dist_reduce_fx="sum")
-        self.add_state("count_bin", jnp.zeros(n_bins, dtype=jnp.float32), dist_reduce_fx="sum")
+        self.add_state("acc_bin", zero_state(n_bins, dtype=jnp.float32), dist_reduce_fx="sum")
+        self.add_state("conf_bin", zero_state(n_bins, dtype=jnp.float32), dist_reduce_fx="sum")
+        self.add_state("count_bin", zero_state(n_bins, dtype=jnp.float32), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         if self.validate_args:

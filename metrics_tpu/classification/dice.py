@@ -13,7 +13,7 @@ import jax.numpy as jnp
 from jax import Array
 
 from metrics_tpu.functional.classification.dice import _dice_compute, _dice_stat_scores_update
-from metrics_tpu.metric import Metric
+from metrics_tpu.metric import Metric, zero_state
 from metrics_tpu.utils.data import dim_zero_cat
 
 
@@ -73,7 +73,7 @@ class Dice(Metric):
         # global micro/macro accumulate fixed-shape sums
         if mdmc_average != "samplewise" and self.reduce != "samples":
             shape = () if self.reduce == "micro" else (num_classes,)
-            default, reduce_fx = jnp.zeros(shape, dtype=jnp.int32), "sum"
+            default, reduce_fx = zero_state(shape, dtype=jnp.int32), "sum"
             self.add_state("tp", default, dist_reduce_fx=reduce_fx)
             self.add_state("fp", default, dist_reduce_fx=reduce_fx)
             self.add_state("tn", default, dist_reduce_fx=reduce_fx)

@@ -17,7 +17,7 @@ from metrics_tpu.functional.classification.stat_scores import (
     _multilabel_stat_scores_format,
     _multilabel_stat_scores_tensor_validation,
 )
-from metrics_tpu.metric import Metric
+from metrics_tpu.metric import Metric, zero_state
 from metrics_tpu.utils.data import dim_zero_cat
 from metrics_tpu.utils.enums import ClassificationTask
 
@@ -26,10 +26,10 @@ class _AbstractExactMatch(Metric):
     def _create_state(self, multidim_average: str) -> None:
         if multidim_average == "samplewise":
             self.add_state("correct", [], dist_reduce_fx="cat")
-            self.add_state("total", jnp.zeros((), dtype=jnp.float32), dist_reduce_fx="sum")
+            self.add_state("total", zero_state((), dtype=jnp.float32), dist_reduce_fx="sum")
         else:
-            self.add_state("correct", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
-            self.add_state("total", jnp.zeros((), dtype=jnp.float32), dist_reduce_fx="sum")
+            self.add_state("correct", zero_state((), dtype=jnp.int32), dist_reduce_fx="sum")
+            self.add_state("total", zero_state((), dtype=jnp.float32), dist_reduce_fx="sum")
 
     def _update_state(self, correct: Array, total: Array) -> None:
         if isinstance(self.correct, list):

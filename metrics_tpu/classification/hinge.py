@@ -22,7 +22,7 @@ from metrics_tpu.functional.classification.hinge import (
     _multiclass_hinge_loss_update,
 )
 from metrics_tpu.functional.classification.stat_scores import _ignore_mask, _sigmoid_if_logits
-from metrics_tpu.metric import Metric
+from metrics_tpu.metric import Metric, zero_state
 from metrics_tpu.utils.enums import ClassificationTaskNoMultilabel
 
 
@@ -58,8 +58,8 @@ class BinaryHingeLoss(Metric):
         self.squared = squared
         self.ignore_index = ignore_index
         self.validate_args = validate_args
-        self.add_state("measures", jnp.zeros((), dtype=jnp.float32), dist_reduce_fx="sum")
-        self.add_state("total", jnp.zeros((), dtype=jnp.float32), dist_reduce_fx="sum")
+        self.add_state("measures", zero_state((), dtype=jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total", zero_state((), dtype=jnp.float32), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         if self.validate_args:
@@ -114,8 +114,8 @@ class MulticlassHingeLoss(Metric):
         self.ignore_index = ignore_index
         self.validate_args = validate_args
         shape = () if multiclass_mode == "crammer-singer" else (num_classes,)
-        self.add_state("measures", jnp.zeros(shape, dtype=jnp.float32), dist_reduce_fx="sum")
-        self.add_state("total", jnp.zeros((), dtype=jnp.float32), dist_reduce_fx="sum")
+        self.add_state("measures", zero_state(shape, dtype=jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total", zero_state((), dtype=jnp.float32), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         if self.validate_args:

@@ -32,7 +32,7 @@ from metrics_tpu.functional.classification.precision_recall_curve import (
     _multilabel_precision_recall_curve_tensor_validation,
     _multilabel_precision_recall_curve_update,
 )
-from metrics_tpu.metric import Metric
+from metrics_tpu.metric import Metric, zero_state
 from metrics_tpu.utils.checks import _value_check_possible
 from metrics_tpu.utils.data import dim_zero_cat
 from metrics_tpu.utils.enums import ClassificationTask
@@ -82,7 +82,7 @@ class BinaryPrecisionRecallCurve(Metric):
             self.add_state("target", [], dist_reduce_fx="cat")
         else:
             self.thresholds = thresholds
-            self.add_state("confmat", jnp.zeros((len(thresholds), 2, 2), dtype=jnp.int32), dist_reduce_fx="sum")
+            self.add_state("confmat", zero_state((len(thresholds), 2, 2), dtype=jnp.int32), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         if self.validate_args:
@@ -142,7 +142,7 @@ class MulticlassPrecisionRecallCurve(Metric):
         else:
             self.thresholds = thresholds
             self.add_state(
-                "confmat", jnp.zeros((len(thresholds), num_classes, 2, 2), dtype=jnp.int32), dist_reduce_fx="sum"
+                "confmat", zero_state((len(thresholds), num_classes, 2, 2), dtype=jnp.int32), dist_reduce_fx="sum"
             )
 
     def update(self, preds: Array, target: Array) -> None:
@@ -208,7 +208,7 @@ class MultilabelPrecisionRecallCurve(Metric):
         else:
             self.thresholds = thresholds
             self.add_state(
-                "confmat", jnp.zeros((len(thresholds), num_labels, 2, 2), dtype=jnp.int32), dist_reduce_fx="sum"
+                "confmat", zero_state((len(thresholds), num_labels, 2, 2), dtype=jnp.int32), dist_reduce_fx="sum"
             )
 
     def update(self, preds: Array, target: Array) -> None:

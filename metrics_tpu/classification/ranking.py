@@ -21,7 +21,7 @@ from metrics_tpu.functional.classification.ranking import (
     _multilabel_ranking_tensor_validation,
     _ranking_reduce,
 )
-from metrics_tpu.metric import Metric
+from metrics_tpu.metric import Metric, zero_state
 
 
 class _MultilabelRankingMetric(Metric):
@@ -48,8 +48,8 @@ class _MultilabelRankingMetric(Metric):
         self.num_labels = num_labels
         self.ignore_index = ignore_index
         self.validate_args = validate_args
-        self.add_state("measure", jnp.zeros((), dtype=jnp.float32), dist_reduce_fx="sum")
-        self.add_state("total", jnp.zeros((), dtype=jnp.float32), dist_reduce_fx="sum")
+        self.add_state("measure", zero_state((), dtype=jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total", zero_state((), dtype=jnp.float32), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         if self.validate_args:

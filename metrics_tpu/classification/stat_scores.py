@@ -34,7 +34,7 @@ from metrics_tpu.functional.classification.stat_scores import (
     _multilabel_stat_scores_tensor_validation,
     _multilabel_stat_scores_update,
 )
-from metrics_tpu.metric import Metric
+from metrics_tpu.metric import Metric, zero_state
 from metrics_tpu.utils.data import dim_zero_cat
 from metrics_tpu.utils.enums import ClassificationTask
 
@@ -57,7 +57,7 @@ class _AbstractStatScores(Metric):
         else:
             shape = () if size == 1 else (size,)
             for s in ("tp", "fp", "tn", "fn"):
-                self.add_state(s, jnp.zeros(shape, dtype=jnp.int32), dist_reduce_fx="sum")
+                self.add_state(s, zero_state(shape, dtype=jnp.int32), dist_reduce_fx="sum")
 
     def _update_state(self, tp: Array, fp: Array, tn: Array, fn: Array) -> None:
         """Accumulate (+= for tensor states, append for list states)."""

@@ -26,7 +26,7 @@ from jax import Array
 
 import jax
 
-from metrics_tpu.metric import Metric
+from metrics_tpu.metric import Metric, zero_state
 from metrics_tpu.utils.imports import _SCIPY_AVAILABLE
 from metrics_tpu.utils.prints import rank_zero_info
 
@@ -165,17 +165,17 @@ class FrechetInceptionDistance(Metric):
         # f64 accumulators when x64 is enabled (host/CPU), else f32 (TPU-native)
         ftype = jax.dtypes.canonicalize_dtype(jnp.float64)
         itype = jax.dtypes.canonicalize_dtype(jnp.int64)
-        self.add_state("real_features_sum", jnp.zeros(d, dtype=ftype), dist_reduce_fx="sum")
-        self.add_state("real_features_cov_sum", jnp.zeros((d, d), dtype=ftype), dist_reduce_fx="sum")
-        self.add_state("real_features_num_samples", jnp.zeros((), dtype=itype), dist_reduce_fx="sum")
-        self.add_state("fake_features_sum", jnp.zeros(d, dtype=ftype), dist_reduce_fx="sum")
-        self.add_state("fake_features_cov_sum", jnp.zeros((d, d), dtype=ftype), dist_reduce_fx="sum")
-        self.add_state("fake_features_num_samples", jnp.zeros((), dtype=itype), dist_reduce_fx="sum")
+        self.add_state("real_features_sum", zero_state(d, dtype=ftype), dist_reduce_fx="sum")
+        self.add_state("real_features_cov_sum", zero_state((d, d), dtype=ftype), dist_reduce_fx="sum")
+        self.add_state("real_features_num_samples", zero_state((), dtype=itype), dist_reduce_fx="sum")
+        self.add_state("fake_features_sum", zero_state(d, dtype=ftype), dist_reduce_fx="sum")
+        self.add_state("fake_features_cov_sum", zero_state((d, d), dtype=ftype), dist_reduce_fx="sum")
+        self.add_state("fake_features_num_samples", zero_state((), dtype=itype), dist_reduce_fx="sum")
         # first-batch centering shift: a constant feature shift leaves the covariance
         # (and the FID mean-difference) unchanged but removes the catastrophic
         # cancellation of accumulating raw second moments in f32 on TPU
-        self.add_state("real_center", jnp.zeros(d, dtype=ftype), dist_reduce_fx="mean")
-        self.add_state("fake_center", jnp.zeros(d, dtype=ftype), dist_reduce_fx="mean")
+        self.add_state("real_center", zero_state(d, dtype=ftype), dist_reduce_fx="mean")
+        self.add_state("fake_center", zero_state(d, dtype=ftype), dist_reduce_fx="mean")
 
     def _extract(self, imgs: Array) -> Array:
         imgs = (jnp.asarray(imgs) * 255).astype(jnp.uint8) if self.normalize else jnp.asarray(imgs)

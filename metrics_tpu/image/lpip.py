@@ -17,7 +17,7 @@ from typing import Any, Callable, Optional
 import jax.numpy as jnp
 from jax import Array
 
-from metrics_tpu.metric import Metric
+from metrics_tpu.metric import Metric, zero_state
 from metrics_tpu.utils.imports import _LPIPS_AVAILABLE
 
 
@@ -95,8 +95,8 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
         self.reduction = reduction
         self.normalize = normalize
 
-        self.add_state("sum_scores", jnp.zeros((), dtype=jnp.float32), dist_reduce_fx="sum")
-        self.add_state("total", jnp.zeros((), dtype=jnp.float32), dist_reduce_fx="sum")
+        self.add_state("sum_scores", zero_state((), dtype=jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total", zero_state((), dtype=jnp.float32), dist_reduce_fx="sum")
 
     def update(self, img1: Array, img2: Array) -> None:
         img1 = jnp.asarray(img1)

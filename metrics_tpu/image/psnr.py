@@ -12,7 +12,7 @@ import jax.numpy as jnp
 from jax import Array
 
 from metrics_tpu.functional.image.psnr import _psnr_compute, _psnr_update
-from metrics_tpu.metric import Metric
+from metrics_tpu.metric import Metric, zero_state
 from metrics_tpu.utils.data import dim_zero_cat
 from metrics_tpu.utils.prints import rank_zero_warn
 
@@ -48,8 +48,8 @@ class PeakSignalNoiseRatio(Metric):
             rank_zero_warn(f"The `reduction={reduction}` will not have any effect when `dim` is None.")
 
         if dim is None:
-            self.add_state("sum_squared_error", jnp.zeros(()), dist_reduce_fx="sum")
-            self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+            self.add_state("sum_squared_error", zero_state(()), dist_reduce_fx="sum")
+            self.add_state("total", zero_state(()), dist_reduce_fx="sum")
         else:
             self.add_state("sum_squared_error", [], dist_reduce_fx="cat")
             self.add_state("total", [], dist_reduce_fx="cat")
@@ -58,8 +58,8 @@ class PeakSignalNoiseRatio(Metric):
             if dim is not None:
                 raise ValueError("The `data_range` must be given when `dim` is not None.")
             self.data_range = None
-            self.add_state("min_target", jnp.zeros(()), dist_reduce_fx="min")
-            self.add_state("max_target", jnp.zeros(()), dist_reduce_fx="max")
+            self.add_state("min_target", zero_state(()), dist_reduce_fx="min")
+            self.add_state("max_target", zero_state(()), dist_reduce_fx="max")
         else:
             self.add_state("data_range", jnp.asarray(float(data_range), jnp.float32), dist_reduce_fx="mean")
         self.base = base

@@ -13,7 +13,7 @@ import jax.numpy as jnp
 from jax import Array
 
 from metrics_tpu.functional.image.sam import _sam_compute, _sam_update
-from metrics_tpu.metric import Metric
+from metrics_tpu.metric import Metric, zero_state
 from metrics_tpu.utils.data import dim_zero_cat
 from metrics_tpu.utils.distributed import reduce
 
@@ -40,8 +40,8 @@ class SpectralAngleMapper(Metric):
         super().__init__(**kwargs)
         self.reduction = reduction
         if reduction in ("elementwise_mean", "sum"):
-            self.add_state("score_sum", jnp.zeros(()), dist_reduce_fx="sum")
-            self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+            self.add_state("score_sum", zero_state(()), dist_reduce_fx="sum")
+            self.add_state("total", zero_state(()), dist_reduce_fx="sum")
         else:
             self.add_state("scores", [], dist_reduce_fx="cat")
 

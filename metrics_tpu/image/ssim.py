@@ -12,7 +12,7 @@ import jax.numpy as jnp
 from jax import Array
 
 from metrics_tpu.functional.image.ssim import _multiscale_ssim_update, _ssim_check_inputs, _ssim_update
-from metrics_tpu.metric import Metric
+from metrics_tpu.metric import Metric, zero_state
 from metrics_tpu.utils.data import dim_zero_cat
 
 
@@ -53,10 +53,10 @@ class StructuralSimilarityIndexMeasure(Metric):
             raise ValueError(f"Argument `reduction` must be one of {valid_reduction}, but got {reduction}")
 
         if reduction in ("elementwise_mean", "sum"):
-            self.add_state("similarity", jnp.zeros(()), dist_reduce_fx="sum")
+            self.add_state("similarity", zero_state(()), dist_reduce_fx="sum")
         else:
             self.add_state("similarity", [], dist_reduce_fx="cat")
-        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", zero_state(()), dist_reduce_fx="sum")
 
         if return_contrast_sensitivity or return_full_image:
             self.add_state("image_return", [], dist_reduce_fx="cat")
@@ -139,10 +139,10 @@ class MultiScaleStructuralSimilarityIndexMeasure(Metric):
             raise ValueError(f"Argument `reduction` must be one of {valid_reduction}, but got {reduction}")
 
         if reduction in ("elementwise_mean", "sum"):
-            self.add_state("similarity", jnp.zeros(()), dist_reduce_fx="sum")
+            self.add_state("similarity", zero_state(()), dist_reduce_fx="sum")
         else:
             self.add_state("similarity", [], dist_reduce_fx="cat")
-        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", zero_state(()), dist_reduce_fx="sum")
 
         if not (isinstance(kernel_size, (Sequence, int))):
             raise ValueError("Argument `kernel_size` expected to be an sequence or an int")

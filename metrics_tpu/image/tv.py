@@ -12,7 +12,7 @@ import jax.numpy as jnp
 from jax import Array
 
 from metrics_tpu.functional.image.tv import _total_variation_compute, _total_variation_update
-from metrics_tpu.metric import Metric
+from metrics_tpu.metric import Metric, zero_state
 from metrics_tpu.utils.data import dim_zero_cat
 
 
@@ -42,8 +42,8 @@ class TotalVariation(Metric):
         if self.reduction is None or self.reduction == "none":
             self.add_state("score", [], dist_reduce_fx="cat")
         else:
-            self.add_state("score", jnp.zeros(()), dist_reduce_fx="sum")
-        self.add_state("num_elements", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+            self.add_state("score", zero_state(()), dist_reduce_fx="sum")
+        self.add_state("num_elements", zero_state((), dtype=jnp.int32), dist_reduce_fx="sum")
 
     def update(self, img: Array) -> None:
         score, num_elements = _total_variation_update(jnp.asarray(img))

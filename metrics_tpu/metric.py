@@ -22,6 +22,7 @@ TPU-native redesign (SURVEY §7.1):
 from __future__ import annotations
 
 import functools
+import math
 import operator
 import inspect
 from abc import ABC, abstractmethod
@@ -64,7 +65,7 @@ _tree_add = jax.jit(lambda olds, news: jax.tree_util.tree_map(jnp.add, olds, new
 _ZERO_STATE_CACHE: Dict[Any, Array] = {}
 
 
-def zero_state(shape: Any = (), dtype: Any = jnp.float32) -> Array:
+def zero_state(shape: Any = (), dtype: Any = None) -> Array:
     """A shared all-zeros array for ``add_state`` defaults.
 
     jax arrays are immutable, so every metric instance (and every state within
@@ -77,7 +78,17 @@ def zero_state(shape: Any = (), dtype: Any = jnp.float32) -> Array:
     """
     if isinstance(shape, int):
         shape = (shape,)
-    key = (tuple(shape), np.dtype(dtype).name)
+    # same dtype semantics as jnp.zeros: the default is the x64-aware float,
+    # and explicit requests are canonicalized (f64 -> f32 when x64 is off);
+    # keying the cache on the canonical dtype keeps it correct if the x64
+    # flag changes between constructions
+    canon = jax.dtypes.canonicalize_dtype(float if dtype is None else dtype)
+    key = (tuple(shape), np.dtype(canon).name)
+    if math.prod(key[0]) > 4096:
+        # don't pin large buffers (e.g. binned-curve confmats at high
+        # threshold/class counts) in the process-lifetime cache — the dispatch
+        # saving is negligible against their allocation cost anyway
+        return jnp.zeros(key[0], key[1])
     out = _ZERO_STATE_CACHE.get(key)
     if out is None:
         out = _ZERO_STATE_CACHE.setdefault(key, jnp.zeros(key[0], key[1]))
@@ -663,13 +674,26 @@ class Metric(ABC):
     def device(self) -> Any:
         if self._device is not None:
             return self._device
+        saw_host_state = False
         for attr in self._defaults:
             val = getattr(self, attr)
+            if isinstance(val, list) and val and isinstance(val[0], jax.Array):
+                val = val[0]
             if isinstance(val, jax.Array):
                 try:
                     return next(iter(val.devices()))
                 except Exception:
                     return None
+            if isinstance(val, (np.ndarray, np.generic)):
+                saw_host_state = True
+        if saw_host_state:
+            # numpy states (eager host-path increments kept native by
+            # _accumulate) live in host memory — report the same device a
+            # fresh jnp state would occupy on the cpu backend
+            try:
+                return jax.local_devices(backend="cpu")[0]
+            except Exception:
+                return None
         return None
 
     def set_dtype(self, dst_type: Any) -> "Metric":

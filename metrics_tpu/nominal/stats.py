@@ -16,7 +16,7 @@ from metrics_tpu.functional.nominal.stats import (
     _tschuprows_t_compute,
 )
 from metrics_tpu.functional.nominal.utils import _joint_confusion_matrix, _nominal_input_validation
-from metrics_tpu.metric import Metric
+from metrics_tpu.metric import Metric, zero_state
 
 
 class _NominalBase(Metric):
@@ -39,7 +39,7 @@ class _NominalBase(Metric):
         _nominal_input_validation(nan_strategy, nan_replace_value)
         self.nan_strategy = nan_strategy
         self.nan_replace_value = nan_replace_value
-        self.add_state("confmat", jnp.zeros((num_classes, num_classes), jnp.int32), dist_reduce_fx="sum")
+        self.add_state("confmat", zero_state((num_classes, num_classes), jnp.int32), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         preds, target = _format_nominal(preds, target, self.nan_strategy, self.nan_replace_value)

@@ -84,7 +84,7 @@ class MeanSquaredError(Metric):
             raise ValueError(f"Expected num_outputs to be a positive integer but got {num_outputs}")
         self.num_outputs = num_outputs
         shape = () if num_outputs == 1 else (num_outputs,)
-        self.add_state("sum_squared_error", zero_state(shape), dist_reduce_fx="sum")
+        self.add_state("sum_squared_error", zero_state(shape, jnp.float32), dist_reduce_fx="sum")
         self.add_state("total", zero_state(), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
@@ -242,7 +242,7 @@ class LogCoshError(Metric):
         if not (isinstance(num_outputs, int) and num_outputs > 0):
             raise ValueError(f"Expected num_outputs to be a positive integer but got {num_outputs}")
         self.num_outputs = num_outputs
-        self.add_state("sum_log_cosh_error", zero_state((num_outputs,)), dist_reduce_fx="sum")
+        self.add_state("sum_log_cosh_error", zero_state((num_outputs,), jnp.float32), dist_reduce_fx="sum")
         self.add_state("total", zero_state(), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:

@@ -90,10 +90,10 @@ class KLDivergence(Metric):
         self.reduction = reduction
 
         if self.reduction in ("mean", "sum"):
-            self.add_state("measures", zero_state(), dist_reduce_fx="sum")
+            self.add_state("measures", zero_state((), jnp.float32), dist_reduce_fx="sum")
         else:
             self.add_state("measures", [], dist_reduce_fx="cat")
-        self.add_state("total", zero_state(), dist_reduce_fx="sum")
+        self.add_state("total", zero_state((), jnp.float32), dist_reduce_fx="sum")
 
     def update(self, p: Array, q: Array) -> None:
         measures, total = _kld_update(p, q, self.log_prob)
@@ -135,8 +135,8 @@ class TweedieDevianceScore(Metric):
         if 0 < power < 1:
             raise ValueError(f"Deviance Score is not defined for power={power}.")
         self.power = power
-        self.add_state("sum_deviance_score", zero_state(), dist_reduce_fx="sum")
-        self.add_state("num_observations", zero_state(), dist_reduce_fx="sum")
+        self.add_state("sum_deviance_score", zero_state((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("num_observations", zero_state((), jnp.float32), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         sum_deviance_score, num_observations = _tweedie_deviance_score_update(preds, target, self.power)

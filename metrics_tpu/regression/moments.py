@@ -74,11 +74,11 @@ class _PearsonBase(Metric):
         shape = (num_outputs,) if num_outputs > 1 else ()
         # dist_reduce_fx=None → states gathered (stacked) across replicas, merged in
         # compute via the parallel-Welford _final_aggregation (reference pearson.py)
-        self.add_state("mean_x", zero_state(shape), dist_reduce_fx=None)
-        self.add_state("mean_y", zero_state(shape), dist_reduce_fx=None)
-        self.add_state("var_x", zero_state(shape), dist_reduce_fx=None)
-        self.add_state("var_y", zero_state(shape), dist_reduce_fx=None)
-        self.add_state("corr_xy", zero_state(shape), dist_reduce_fx=None)
+        self.add_state("mean_x", zero_state(shape, jnp.float32), dist_reduce_fx=None)
+        self.add_state("mean_y", zero_state(shape, jnp.float32), dist_reduce_fx=None)
+        self.add_state("var_x", zero_state(shape, jnp.float32), dist_reduce_fx=None)
+        self.add_state("var_y", zero_state(shape, jnp.float32), dist_reduce_fx=None)
+        self.add_state("corr_xy", zero_state(shape, jnp.float32), dist_reduce_fx=None)
         self.add_state("n_total", zero_state(), dist_reduce_fx=None)
 
     def update(self, preds: Array, target: Array) -> None:
@@ -212,9 +212,9 @@ class R2Score(Metric):
             raise ValueError(f"Invalid input to argument `multioutput`. Choose one of the following: {allowed_multioutput}")
         self.multioutput = multioutput
         shape = (num_outputs,) if num_outputs > 1 else ()
-        self.add_state("sum_squared_error", zero_state(shape), dist_reduce_fx="sum")
-        self.add_state("sum_error", zero_state(shape), dist_reduce_fx="sum")
-        self.add_state("residual", zero_state(shape), dist_reduce_fx="sum")
+        self.add_state("sum_squared_error", zero_state(shape, jnp.float32), dist_reduce_fx="sum")
+        self.add_state("sum_error", zero_state(shape, jnp.float32), dist_reduce_fx="sum")
+        self.add_state("residual", zero_state(shape, jnp.float32), dist_reduce_fx="sum")
         self.add_state("total", zero_state(), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:

@@ -8,7 +8,7 @@ import jax.numpy as jnp
 from jax import Array
 
 from metrics_tpu.functional.text.bleu import _bleu_score_compute, _bleu_score_update, _tokenize_fn
-from metrics_tpu.metric import Metric
+from metrics_tpu.metric import Metric, zero_state
 
 
 class BLEUScore(Metric):
@@ -43,10 +43,10 @@ class BLEUScore(Metric):
             raise ValueError(f"List of weights has different weights than `n_gram`: {len(weights)} != {n_gram}")
         self.weights = weights if weights is not None else [1.0 / n_gram] * n_gram
 
-        self.add_state("preds_len", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
-        self.add_state("target_len", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
-        self.add_state("numerator", jnp.zeros(self.n_gram), dist_reduce_fx="sum")
-        self.add_state("denominator", jnp.zeros(self.n_gram), dist_reduce_fx="sum")
+        self.add_state("preds_len", zero_state((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("target_len", zero_state((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("numerator", zero_state(self.n_gram), dist_reduce_fx="sum")
+        self.add_state("denominator", zero_state(self.n_gram), dist_reduce_fx="sum")
 
     def update(self, preds: Sequence[str], target: Sequence[Sequence[str]]) -> None:
         preds_ = [preds] if isinstance(preds, str) else preds

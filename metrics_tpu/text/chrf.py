@@ -13,7 +13,7 @@ import jax.numpy as jnp
 from jax import Array
 
 from metrics_tpu.functional.text.chrf import _chrf_score_compute, _chrf_score_update
-from metrics_tpu.metric import Metric
+from metrics_tpu.metric import Metric, zero_state
 
 
 class CHRFScore(Metric):
@@ -56,12 +56,12 @@ class CHRFScore(Metric):
         self.return_sentence_level_score = return_sentence_level_score
         self.n_order = float(n_char_order + n_word_order)
 
-        self.add_state("total_preds_char_n_grams", jnp.zeros(n_char_order), dist_reduce_fx="sum")
-        self.add_state("total_preds_word_n_grams", jnp.zeros(n_word_order), dist_reduce_fx="sum")
-        self.add_state("total_target_char_n_grams", jnp.zeros(n_char_order), dist_reduce_fx="sum")
-        self.add_state("total_target_word_n_grams", jnp.zeros(n_word_order), dist_reduce_fx="sum")
-        self.add_state("total_matching_char_n_grams", jnp.zeros(n_char_order), dist_reduce_fx="sum")
-        self.add_state("total_matching_word_n_grams", jnp.zeros(n_word_order), dist_reduce_fx="sum")
+        self.add_state("total_preds_char_n_grams", zero_state(n_char_order), dist_reduce_fx="sum")
+        self.add_state("total_preds_word_n_grams", zero_state(n_word_order), dist_reduce_fx="sum")
+        self.add_state("total_target_char_n_grams", zero_state(n_char_order), dist_reduce_fx="sum")
+        self.add_state("total_target_word_n_grams", zero_state(n_word_order), dist_reduce_fx="sum")
+        self.add_state("total_matching_char_n_grams", zero_state(n_char_order), dist_reduce_fx="sum")
+        self.add_state("total_matching_word_n_grams", zero_state(n_word_order), dist_reduce_fx="sum")
         if self.return_sentence_level_score:
             self.add_state("sentence_chrf_score", [], dist_reduce_fx="cat")
 
