@@ -675,17 +675,26 @@ class Metric(ABC):
         if self._device is not None:
             return self._device
         saw_host_state = False
+        list_candidate = None
+        # plain array states take priority over list entries: with
+        # compute_on_cpu on an accelerator the list states are relocated to
+        # the host while array states keep the true compute device
         for attr in self._defaults:
             val = getattr(self, attr)
-            if isinstance(val, list) and val and isinstance(val[0], jax.Array):
-                val = val[0]
             if isinstance(val, jax.Array):
                 try:
                     return next(iter(val.devices()))
                 except Exception:
                     return None
+            if list_candidate is None and isinstance(val, list) and val and isinstance(val[0], jax.Array):
+                list_candidate = val[0]
             if isinstance(val, (np.ndarray, np.generic)):
                 saw_host_state = True
+        if list_candidate is not None:
+            try:
+                return next(iter(list_candidate.devices()))
+            except Exception:
+                return None
         if saw_host_state:
             # numpy states (eager host-path increments kept native by
             # _accumulate) live in host memory — report the same device a

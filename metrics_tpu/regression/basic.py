@@ -46,8 +46,8 @@ class MeanAbsoluteError(Metric):
 
     def __init__(self, **kwargs: Any) -> None:
         super().__init__(**kwargs)
-        self.add_state("sum_abs_error", zero_state(), dist_reduce_fx="sum")
-        self.add_state("total", zero_state(), dist_reduce_fx="sum")
+        self.add_state("sum_abs_error", zero_state((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total", zero_state((), jnp.float32), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         sum_abs_error, num_obs = _mean_absolute_error_update(preds, target)
@@ -85,7 +85,7 @@ class MeanSquaredError(Metric):
         self.num_outputs = num_outputs
         shape = () if num_outputs == 1 else (num_outputs,)
         self.add_state("sum_squared_error", zero_state(shape, jnp.float32), dist_reduce_fx="sum")
-        self.add_state("total", zero_state(), dist_reduce_fx="sum")
+        self.add_state("total", zero_state((), jnp.float32), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         sum_squared_error, num_obs = _mean_squared_error_update(preds, target, self.num_outputs)
@@ -115,8 +115,8 @@ class MeanAbsolutePercentageError(Metric):
 
     def __init__(self, **kwargs: Any) -> None:
         super().__init__(**kwargs)
-        self.add_state("sum_abs_per_error", zero_state(), dist_reduce_fx="sum")
-        self.add_state("total", zero_state(), dist_reduce_fx="sum")
+        self.add_state("sum_abs_per_error", zero_state((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total", zero_state((), jnp.float32), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         sum_abs_per_error, num_obs = _mean_absolute_percentage_error_update(preds, target)
@@ -146,8 +146,8 @@ class SymmetricMeanAbsolutePercentageError(Metric):
 
     def __init__(self, **kwargs: Any) -> None:
         super().__init__(**kwargs)
-        self.add_state("sum_abs_per_error", zero_state(), dist_reduce_fx="sum")
-        self.add_state("total", zero_state(), dist_reduce_fx="sum")
+        self.add_state("sum_abs_per_error", zero_state((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total", zero_state((), jnp.float32), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         sum_abs_per_error, num_obs = _symmetric_mean_absolute_percentage_error_update(preds, target)
@@ -177,8 +177,8 @@ class WeightedMeanAbsolutePercentageError(Metric):
 
     def __init__(self, **kwargs: Any) -> None:
         super().__init__(**kwargs)
-        self.add_state("sum_abs_error", zero_state(), dist_reduce_fx="sum")
-        self.add_state("sum_scale", zero_state(), dist_reduce_fx="sum")
+        self.add_state("sum_abs_error", zero_state((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("sum_scale", zero_state((), jnp.float32), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         sum_abs_error, sum_scale = _weighted_mean_absolute_percentage_error_update(preds, target)
@@ -208,8 +208,8 @@ class MeanSquaredLogError(Metric):
 
     def __init__(self, **kwargs: Any) -> None:
         super().__init__(**kwargs)
-        self.add_state("sum_squared_log_error", zero_state(), dist_reduce_fx="sum")
-        self.add_state("total", zero_state(), dist_reduce_fx="sum")
+        self.add_state("sum_squared_log_error", zero_state((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total", zero_state((), jnp.float32), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         sum_squared_log_error, num_obs = _mean_squared_log_error_update(preds, target)
@@ -243,7 +243,7 @@ class LogCoshError(Metric):
             raise ValueError(f"Expected num_outputs to be a positive integer but got {num_outputs}")
         self.num_outputs = num_outputs
         self.add_state("sum_log_cosh_error", zero_state((num_outputs,), jnp.float32), dist_reduce_fx="sum")
-        self.add_state("total", zero_state(), dist_reduce_fx="sum")
+        self.add_state("total", zero_state((), jnp.float32), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         sum_log_cosh_error, num_obs = _log_cosh_error_update(preds, target, self.num_outputs)

@@ -79,7 +79,7 @@ class _PearsonBase(Metric):
         self.add_state("var_x", zero_state(shape, jnp.float32), dist_reduce_fx=None)
         self.add_state("var_y", zero_state(shape, jnp.float32), dist_reduce_fx=None)
         self.add_state("corr_xy", zero_state(shape, jnp.float32), dist_reduce_fx=None)
-        self.add_state("n_total", zero_state(), dist_reduce_fx=None)
+        self.add_state("n_total", zero_state((), jnp.float32), dist_reduce_fx=None)
 
     def update(self, preds: Array, target: Array) -> None:
         self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total = _pearson_corrcoef_update(
@@ -160,11 +160,11 @@ class ExplainedVariance(Metric):
         if multioutput not in allowed_multioutput:
             raise ValueError(f"Invalid input to argument `multioutput`. Choose one of the following: {allowed_multioutput}")
         self.multioutput = multioutput
-        self.add_state("sum_error", zero_state(), dist_reduce_fx="sum")
-        self.add_state("sum_squared_error", zero_state(), dist_reduce_fx="sum")
-        self.add_state("sum_target", zero_state(), dist_reduce_fx="sum")
-        self.add_state("sum_squared_target", zero_state(), dist_reduce_fx="sum")
-        self.add_state("num_obs", zero_state(), dist_reduce_fx="sum")
+        self.add_state("sum_error", zero_state((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("sum_squared_error", zero_state((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("sum_target", zero_state((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("sum_squared_target", zero_state((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("num_obs", zero_state((), jnp.float32), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         num_obs, sum_error, sum_squared_error, sum_target, sum_squared_target = _explained_variance_update(preds, target)
@@ -215,7 +215,7 @@ class R2Score(Metric):
         self.add_state("sum_squared_error", zero_state(shape, jnp.float32), dist_reduce_fx="sum")
         self.add_state("sum_error", zero_state(shape, jnp.float32), dist_reduce_fx="sum")
         self.add_state("residual", zero_state(shape, jnp.float32), dist_reduce_fx="sum")
-        self.add_state("total", zero_state(), dist_reduce_fx="sum")
+        self.add_state("total", zero_state((), jnp.float32), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         sum_squared_obs, sum_obs, residual, num_obs = _r2_score_update(preds, target)

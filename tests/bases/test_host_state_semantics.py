@@ -1,0 +1,148 @@
+"""Regression tests for the round-3 dispatch-cut semantics: shared zero_state
+defaults, numpy-scalar states from the eager host paths, and every consumer
+that must keep working with them (sync seam, hash, device, checkpoints,
+compute groups). These pin the fixes from the round-3 review so a future
+refactor cannot silently reintroduce the device-put-per-state update path or
+break a numpy-state consumer."""
+
+from __future__ import annotations
+
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.aggregation import SumMetric
+from metrics_tpu.collections import MetricCollection
+from metrics_tpu.metric import zero_state
+from metrics_tpu.regression import ExplainedVariance, MeanAbsoluteError, R2Score
+
+
+def _pair(n=512, seed=11):
+    rng = np.random.default_rng(seed)
+    p = rng.normal(size=n).astype(np.float32)
+    t = (0.7 * p + 0.3 * rng.normal(size=n)).astype(np.float32)
+    return p, t
+
+
+# ------------------------------------------------------------------ zero_state
+
+
+def test_zero_state_shares_one_buffer_per_shape_dtype():
+    assert zero_state() is zero_state()
+    assert zero_state((3,), jnp.float32) is zero_state(3, jnp.float32)
+    assert zero_state() is not zero_state((), jnp.int32)
+
+
+def test_zero_state_dtype_semantics_match_jnp_zeros():
+    # default dtype follows jnp.zeros (x64-aware float); explicit requests
+    # canonicalize exactly like jnp.zeros would
+    assert zero_state().dtype == jnp.zeros(()).dtype
+    assert zero_state((), jnp.float64).dtype == jnp.zeros((), jnp.float64).dtype
+    assert zero_state((2, 2), jnp.int32).dtype == jnp.int32
+
+
+def test_zero_state_large_buffers_bypass_cache():
+    a = zero_state((80, 80))  # 6400 elements > 4096 cap
+    b = zero_state((80, 80))
+    assert a is not b
+    np.testing.assert_array_equal(np.asarray(a), 0.0)
+
+
+def test_shared_defaults_do_not_bleed_between_instances():
+    a, b = SumMetric(), SumMetric()
+    a.update(jnp.asarray(3.0))
+    assert float(a.compute()) == 3.0
+    b.update(jnp.asarray(1.0))
+    assert float(b.compute()) == 1.0  # untouched by a's accumulation
+
+
+def test_hash_distinct_for_fresh_instances_with_shared_defaults():
+    a, b = R2Score(), R2Score()
+    assert hash(a) != hash(b)
+    assert len({a, b}) == 2
+
+
+# ------------------------------------------------- numpy states (host paths)
+
+
+def _host_updated_r2():
+    p, t = _pair()
+    m = R2Score()
+    m.update(jnp.asarray(p), jnp.asarray(t))
+    return m, p, t
+
+
+def test_host_path_keeps_numpy_states_without_device_put():
+    m, p, t = _host_updated_r2()
+    if jax.default_backend() != "cpu":  # host fast path is cpu-backend-only
+        pytest.skip("eager host path requires the cpu backend")
+    assert isinstance(m.residual, (np.ndarray, np.generic))
+    from sklearn.metrics import r2_score
+
+    assert abs(float(m.compute()) - r2_score(t, p)) < 1e-5
+
+
+def test_device_property_reports_cpu_for_numpy_states():
+    if jax.default_backend() != "cpu":  # host fast path is cpu-backend-only
+        pytest.skip("eager host path requires the cpu backend")
+    m, _, _ = _host_updated_r2()
+    dev = m.device
+    assert dev is not None
+    assert dev.platform == jax.local_devices(backend="cpu")[0].platform
+
+
+def test_numpy_states_sync_through_dist_seam():
+    # fake world-2 gather through the pluggable seam: numpy states must be
+    # coerced to jax and actually gathered (sum reduction -> same mean)
+    p, t = _pair()
+    m = MeanAbsoluteError(
+        dist_sync_fn=lambda x, group=None: [x, x],
+        distributed_available_fn=lambda: True,
+        sync_on_compute=True,
+    )
+    m.update(jnp.asarray(p), jnp.asarray(t))
+    want = float(np.mean(np.abs(p - t)))
+    assert abs(float(m.compute()) - want) < 1e-6
+
+
+def test_numpy_states_survive_checkpoint_and_pickle():
+    m, p, t = _host_updated_r2()
+    m.persistent(True)
+    got = float(m.compute())
+    sd = m.state_dict()
+    assert len(sd) == 4 and all(isinstance(v, np.ndarray) for v in sd.values())
+    m2 = R2Score()
+    m2.load_state_dict(sd)
+    assert abs(float(m2.compute()) - got) < 1e-6
+    m3 = pickle.loads(pickle.dumps(m))
+    assert abs(float(m3.compute()) - got) < 1e-6
+
+
+def test_numpy_states_merge_in_forward_reduced_path():
+    p, t = _pair()
+    m = R2Score()  # full_state_update=False -> reduced-state forward merge
+    m.forward(jnp.asarray(p[:256]), jnp.asarray(t[:256]))
+    m.forward(jnp.asarray(p[256:]), jnp.asarray(t[256:]))
+    from sklearn.metrics import r2_score
+
+    assert abs(float(m.compute()) - r2_score(t, p)) < 1e-5
+
+
+def test_compute_groups_value_compare_with_numpy_states():
+    p, t = _pair()
+    col = MetricCollection({"r2": R2Score(), "ev": ExplainedVariance(), "mae": MeanAbsoluteError()})
+    col.update(jnp.asarray(p), jnp.asarray(t))
+    col.update(jnp.asarray(p), jnp.asarray(t))  # triggers group formation
+    out = {k: float(v) for k, v in col.compute().items()}
+    from sklearn.metrics import explained_variance_score, mean_absolute_error, r2_score
+
+    p2, t2 = np.concatenate([p, p]), np.concatenate([t, t])
+    assert abs(out["r2"] - r2_score(t2, p2)) < 1e-5
+    assert abs(out["ev"] - explained_variance_score(t2, p2)) < 1e-5
+    assert abs(out["mae"] - mean_absolute_error(t2, p2)) < 1e-5
+    # r2 and ev share identical state layouts but different state names, and
+    # mae differs entirely: three separate groups, values must stay distinct
+    assert out["r2"] != out["mae"]
