@@ -8,7 +8,7 @@ import jax.numpy as jnp
 from jax import Array
 
 from metrics_tpu.functional.audio.pesq import perceptual_evaluation_speech_quality
-from metrics_tpu.metric import Metric
+from metrics_tpu.metric import Metric, zero_state
 from metrics_tpu.utils.imports import _PESQ_AVAILABLE
 
 
@@ -43,8 +43,8 @@ class PerceptualEvaluationSpeechQuality(Metric):
         if mode not in ("wb", "nb"):
             raise ValueError(f"Expected argument `mode` to either be 'wb' or 'nb' but got {mode}")
         self.mode = mode
-        self.add_state("sum_pesq", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
-        self.add_state("total", jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")
+        self.add_state("sum_pesq", zero_state((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total", zero_state((), jnp.int32), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         pesq_batch = perceptual_evaluation_speech_quality(preds, target, self.fs, self.mode).reshape(-1)

@@ -8,7 +8,7 @@ import jax.numpy as jnp
 from jax import Array
 
 from metrics_tpu.functional.audio.pit import permutation_invariant_training
-from metrics_tpu.metric import BASE_METRIC_KWARGS, Metric
+from metrics_tpu.metric import BASE_METRIC_KWARGS, Metric, zero_state
 
 
 class PermutationInvariantTraining(Metric):
@@ -44,8 +44,8 @@ class PermutationInvariantTraining(Metric):
         self.metric_func = metric_func
         self.eval_func = eval_func
         self.kwargs = kwargs  # remaining kwargs forwarded to metric_func (reference pit.py:78)
-        self.add_state("sum_pit_metric", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
-        self.add_state("total", jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")
+        self.add_state("sum_pit_metric", zero_state((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total", zero_state((), jnp.int32), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         pit_metric = permutation_invariant_training(preds, target, self.metric_func, self.eval_func, **self.kwargs)[0]

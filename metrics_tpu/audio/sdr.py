@@ -11,7 +11,7 @@ from metrics_tpu.functional.audio.sdr import (
     scale_invariant_signal_distortion_ratio,
     signal_distortion_ratio,
 )
-from metrics_tpu.metric import Metric
+from metrics_tpu.metric import Metric, zero_state
 
 
 class SignalDistortionRatio(Metric):
@@ -46,8 +46,8 @@ class SignalDistortionRatio(Metric):
         self.filter_length = filter_length
         self.zero_mean = zero_mean
         self.load_diag = load_diag
-        self.add_state("sum_sdr", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
-        self.add_state("total", jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")
+        self.add_state("sum_sdr", zero_state((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total", zero_state((), jnp.int32), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         sdr_batch = signal_distortion_ratio(
@@ -81,8 +81,8 @@ class ScaleInvariantSignalDistortionRatio(Metric):
     def __init__(self, zero_mean: bool = False, **kwargs: Any) -> None:
         super().__init__(**kwargs)
         self.zero_mean = zero_mean
-        self.add_state("sum_si_sdr", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
-        self.add_state("total", jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")
+        self.add_state("sum_si_sdr", zero_state((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total", zero_state((), jnp.int32), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         si_sdr_batch = scale_invariant_signal_distortion_ratio(preds=preds, target=target, zero_mean=self.zero_mean)

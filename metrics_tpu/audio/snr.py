@@ -8,7 +8,7 @@ import jax.numpy as jnp
 from jax import Array
 
 from metrics_tpu.functional.audio.snr import scale_invariant_signal_noise_ratio, signal_noise_ratio
-from metrics_tpu.metric import Metric
+from metrics_tpu.metric import Metric, zero_state
 
 
 class SignalNoiseRatio(Metric):
@@ -32,8 +32,8 @@ class SignalNoiseRatio(Metric):
     def __init__(self, zero_mean: bool = False, **kwargs: Any) -> None:
         super().__init__(**kwargs)
         self.zero_mean = zero_mean
-        self.add_state("sum_snr", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
-        self.add_state("total", jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")
+        self.add_state("sum_snr", zero_state((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total", zero_state((), jnp.int32), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         snr_batch = signal_noise_ratio(preds=preds, target=target, zero_mean=self.zero_mean)
@@ -64,8 +64,8 @@ class ScaleInvariantSignalNoiseRatio(Metric):
 
     def __init__(self, **kwargs: Any) -> None:
         super().__init__(**kwargs)
-        self.add_state("sum_si_snr", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
-        self.add_state("total", jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")
+        self.add_state("sum_si_snr", zero_state((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total", zero_state((), jnp.int32), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         si_snr_batch = scale_invariant_signal_noise_ratio(preds=preds, target=target)

@@ -8,7 +8,7 @@ import jax.numpy as jnp
 from jax import Array
 
 from metrics_tpu.functional.audio.stoi import short_time_objective_intelligibility
-from metrics_tpu.metric import Metric
+from metrics_tpu.metric import Metric, zero_state
 from metrics_tpu.utils.imports import _PYSTOI_AVAILABLE
 
 
@@ -39,8 +39,8 @@ class ShortTimeObjectiveIntelligibility(Metric):
             )
         self.fs = fs
         self.extended = extended
-        self.add_state("sum_stoi", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
-        self.add_state("total", jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")
+        self.add_state("sum_stoi", zero_state((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total", zero_state((), jnp.int32), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         stoi_batch = short_time_objective_intelligibility(preds, target, self.fs, self.extended).reshape(-1)

@@ -83,7 +83,10 @@ def zero_state(shape: Any = (), dtype: Any = None) -> Array:
     # keying the cache on the canonical dtype keeps it correct if the x64
     # flag changes between constructions
     canon = jax.dtypes.canonicalize_dtype(float if dtype is None else dtype)
-    key = (tuple(shape), np.dtype(canon).name)
+    # key on the active default device too: a zeros buffer cached under one
+    # device must not serve a metric constructed under jax.default_device(...)
+    # pointing elsewhere (.device would misreport until the first update)
+    key = (tuple(shape), np.dtype(canon).name, str(jax.config.jax_default_device))
     if math.prod(key[0]) > 4096:
         # don't pin large buffers (e.g. binned-curve confmats at high
         # threshold/class counts) in the process-lifetime cache — the dispatch

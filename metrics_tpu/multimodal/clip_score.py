@@ -8,7 +8,7 @@ import jax.numpy as jnp
 from jax import Array
 
 from metrics_tpu.functional.multimodal.clip_score import _clip_score_update, _get_model_and_processor
-from metrics_tpu.metric import Metric
+from metrics_tpu.metric import Metric, zero_state
 
 
 class CLIPScore(Metric):
@@ -45,8 +45,8 @@ class CLIPScore(Metric):
             model, processor = _get_model_and_processor(model_name_or_path)
         self.model = model
         self.processor = processor
-        self.add_state("score", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
-        self.add_state("n_samples", jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")
+        self.add_state("score", zero_state((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("n_samples", zero_state((), jnp.int32), dist_reduce_fx="sum")
 
     def update(self, images: Union[Array, List[Array]], text: Union[str, List[str]]) -> None:
         score, n_samples = _clip_score_update(images, text, self.model, self.processor)

@@ -8,7 +8,7 @@ import jax.numpy as jnp
 from jax import Array
 
 from metrics_tpu.functional.text.cer import _cer_compute, _cer_update
-from metrics_tpu.metric import Metric
+from metrics_tpu.metric import Metric, zero_state
 
 
 class CharErrorRate(Metric):
@@ -28,8 +28,8 @@ class CharErrorRate(Metric):
 
     def __init__(self, **kwargs: Any) -> None:
         super().__init__(**kwargs)
-        self.add_state("errors", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
-        self.add_state("total", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
+        self.add_state("errors", zero_state((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total", zero_state((), jnp.float32), dist_reduce_fx="sum")
 
     def update(self, preds: Union[str, List[str]], target: Union[str, List[str]]) -> None:
         errors, total = _cer_update(preds, target)

@@ -8,7 +8,7 @@ import jax.numpy as jnp
 from jax import Array
 
 from metrics_tpu.functional.text.perplexity import _perplexity_compute, _perplexity_update
-from metrics_tpu.metric import Metric
+from metrics_tpu.metric import Metric, zero_state
 
 
 class Perplexity(Metric):
@@ -37,8 +37,8 @@ class Perplexity(Metric):
         if ignore_index is not None and not isinstance(ignore_index, int):
             raise ValueError(f"Argument `ignore_index` expected to either be `None` or an `int` but got {ignore_index}")
         self.ignore_index = ignore_index
-        self.add_state("total_log_probs", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
-        self.add_state("count", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total_log_probs", zero_state((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("count", zero_state((), jnp.float32), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:
         total_log_probs, count = _perplexity_update(preds, target, self.ignore_index)

@@ -14,7 +14,7 @@ from metrics_tpu.functional.text.squad import (
     _squad_input_check,
     _squad_update,
 )
-from metrics_tpu.metric import Metric
+from metrics_tpu.metric import Metric, zero_state
 
 
 class SQuAD(Metric):
@@ -35,9 +35,9 @@ class SQuAD(Metric):
 
     def __init__(self, **kwargs: Any) -> None:
         super().__init__(**kwargs)
-        self.add_state("f1_score", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
-        self.add_state("exact_match", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
-        self.add_state("total", jnp.asarray(0, jnp.int32), dist_reduce_fx="sum")
+        self.add_state("f1_score", zero_state((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("exact_match", zero_state((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total", zero_state((), jnp.int32), dist_reduce_fx="sum")
 
     def update(self, preds: PREDS_TYPE, target: TARGETS_TYPE) -> None:
         preds_dict, target_dict = _squad_input_check(preds, target)

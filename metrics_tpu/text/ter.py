@@ -8,7 +8,7 @@ import jax.numpy as jnp
 from jax import Array
 
 from metrics_tpu.functional.text.ter import _TercomTokenizer, _ter_compute, _ter_update
-from metrics_tpu.metric import Metric
+from metrics_tpu.metric import Metric, zero_state
 
 
 class TranslationEditRate(Metric):
@@ -47,8 +47,8 @@ class TranslationEditRate(Metric):
         self.tokenizer = _TercomTokenizer(normalize, no_punctuation, lowercase, asian_support)
         self.return_sentence_level_score = return_sentence_level_score
 
-        self.add_state("total_num_edits", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
-        self.add_state("total_tgt_len", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total_num_edits", zero_state((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total_tgt_len", zero_state((), jnp.float32), dist_reduce_fx="sum")
         if self.return_sentence_level_score:
             self.add_state("sentence_ter", [], dist_reduce_fx="cat")
 

@@ -8,7 +8,7 @@ import jax.numpy as jnp
 from jax import Array
 
 from metrics_tpu.functional.text.wil import _wil_compute, _wil_update
-from metrics_tpu.metric import Metric
+from metrics_tpu.metric import Metric, zero_state
 
 
 class WordInfoLost(Metric):
@@ -28,9 +28,9 @@ class WordInfoLost(Metric):
 
     def __init__(self, **kwargs: Any) -> None:
         super().__init__(**kwargs)
-        self.add_state("errors", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
-        self.add_state("target_total", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
-        self.add_state("preds_total", jnp.asarray(0.0, jnp.float32), dist_reduce_fx="sum")
+        self.add_state("errors", zero_state((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("target_total", zero_state((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("preds_total", zero_state((), jnp.float32), dist_reduce_fx="sum")
 
     def update(self, preds: Union[str, List[str]], target: Union[str, List[str]]) -> None:
         errors, target_total, preds_total = _wil_update(preds, target)
