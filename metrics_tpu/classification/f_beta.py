@@ -64,7 +64,7 @@ class MulticlassFBetaScore(MulticlassStatScores):
         >>> metric = MulticlassFBetaScore(num_classes=3, beta=0.5)
         >>> metric.update(jnp.array([0, 2, 1, 2]), jnp.array([0, 1, 1, 2]))
         >>> metric.compute()
-        Array(0.7962963, dtype=float32)
+        Array(0.79629636, dtype=float32)
     """
     is_differentiable = False
     higher_is_better = True

@@ -41,7 +41,7 @@ def _fbeta_reduce(
         fp = jnp.sum(fp, axis=axis)
         return _safe_divide((1 + beta2) * tp, (1 + beta2) * tp + beta2 * fn + fp)
     score = _safe_divide((1 + beta2) * tp, (1 + beta2) * tp + beta2 * fn + fp)
-    return _adjust_weights_safe_divide(score, average, multilabel, tp, fp, fn)
+    return _adjust_weights_safe_divide(score, average, tp, fn)
 
 
 def _validate_beta(beta: float) -> None:

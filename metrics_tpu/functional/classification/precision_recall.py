@@ -34,7 +34,7 @@ def _precision_recall_reduce(
         different_stat = jnp.sum(different_stat, axis=axis)
         return _safe_divide(tp, tp + different_stat)
     score = _safe_divide(tp, tp + different_stat)
-    return _adjust_weights_safe_divide(score, average, multilabel, tp, fp, fn)
+    return _adjust_weights_safe_divide(score, average, tp, fn)
 
 
 def binary_precision(preds, target, threshold=0.5, multidim_average="global", ignore_index=None, validate_args=True) -> Array:

@@ -235,8 +235,13 @@ def _binary_precision_recall_curve_compute(
 
     preds, target = state
     fps, tps, thresh = _binary_clf_curve(preds, target, pos_label=pos_label)
-    precision = _safe_divide(tps, tps + fps)
-    recall = _safe_divide(tps, tps[-1])
+    # plain division, NOT _safe_divide: with zero positives the reference's
+    # exact regime yields NaN recall (ref :224-225), which downstream macro
+    # reductions then exclude with a warning — a deliberate regime difference
+    # from the binned path above (ref binned uses _safe_divide). tps+fps >= 1
+    # at every observed threshold, so only recall can produce NaN.
+    precision = tps / (tps + fps)
+    recall = tps / tps[-1]
 
     # stop when full recall attained and reverse the outputs so recall is non-increasing
     last_ind = jnp.argmax(tps >= tps[-1])

@@ -31,7 +31,7 @@ def _specificity_reduce(
         fp = jnp.sum(fp, axis=axis)
         return _safe_divide(tn, tn + fp)
     specificity_score = _safe_divide(tn, tn + fp)
-    return _adjust_weights_safe_divide(specificity_score, average, multilabel, tp, fp, fn)
+    return _adjust_weights_safe_divide(specificity_score, average, tp, fn)
 
 
 def binary_specificity(preds, target, threshold=0.5, multidim_average="global", ignore_index=None, validate_args=True) -> Array:

@@ -75,18 +75,19 @@ def _safe_divide(num: Array, denom: Array, zero_division: float = 0.0) -> Array:
     return jnp.where(zero, jnp.asarray(zero_division, dtype=res.dtype), res)
 
 
-def _adjust_weights_safe_divide(
-    score: Array, average: Optional[str], multilabel: bool, tp: Array, fp: Array, fn: Array
-) -> Array:
+def _adjust_weights_safe_divide(score: Array, average: Optional[str], tp: Array, fn: Array) -> Array:
     """Weighted / macro / none averaging of per-class scores (reference: compute.py)."""
     if average is None or average == "none":
         return score
     if average == "weighted":
         weights = tp + fn
     else:
+        # plain ones, matching the reference exactly (accuracy.py:76,
+        # f_beta.py:58, precision_recall.py:58, specificity.py:55,
+        # hamming.py:78): classes absent from preds AND target contribute a
+        # 0/0 -> 0 score to the macro mean rather than being excluded (the
+        # exclusion convention only appears in later torchmetrics versions)
         weights = jnp.ones_like(score)
-        if not multilabel:
-            weights = jnp.where(tp + fp + fn == 0, jnp.zeros_like(weights), weights)
     weights = weights.astype(jnp.float32)
     return jnp.sum(_safe_divide(weights, jnp.sum(weights, axis=-1, keepdims=True)) * score, axis=-1)
 
