@@ -44,6 +44,8 @@ def _recall_at_precision(
     The curve's final sentinel point (precision=1, recall=0) has no threshold — it is
     excluded from the threshold lookup but its (1, 0) value cannot win the recall max
     anyway unless nothing qualifies, in which case recall=0/threshold=1e6 is returned.
+    Exact-mode zero-positive curves (all-NaN recall) return (nan, thresholds[0]),
+    matching the reference's tuple-max degeneration.
     """
     precision = jnp.asarray(precision)
     recall = jnp.asarray(recall)
@@ -63,6 +65,17 @@ def _recall_at_precision(
     max_recall = jnp.where(jnp.isfinite(max_recall), max_recall, 0.0)
     any_qualify = jnp.any(qualify) & (max_recall > 0.0)
     best_threshold = jnp.where(any_qualify, t_best, 1e6)
+    # exact-mode zero-positive curve: recall is all-NaN (plain division in
+    # _binary_precision_recall_curve_compute, reference semantics) and the
+    # reference's python tuple-max then degenerates to the FIRST curve point,
+    # returning (nan, thresholds[0]) — reproduce that instead of clamping to
+    # the (0.0, 1e6) nothing-qualifies convention. NaN recall is all-or-none
+    # (it only arises when tps[-1] == 0), so any() is equivalent to checking
+    # the first point.
+    if n_t:
+        nan_curve = jnp.any(qualify) & jnp.any(jnp.isnan(recall))
+        max_recall = jnp.where(nan_curve, jnp.asarray(jnp.nan, max_recall.dtype), max_recall)
+        best_threshold = jnp.where(nan_curve, thresholds[0], best_threshold)
     return max_recall, best_threshold
 
 

@@ -113,6 +113,12 @@ def test_all_negative_targets_nan_recall_parity(tm, torch):
     o_ap = ours_mod.binary_average_precision(jnp.asarray(probs), jnp.asarray(target))
     r_ap = ref_mod.binary_average_precision(torch.tensor(probs), torch.tensor(target))
     assert bool(jnp.isnan(o_ap)) and bool(torch.isnan(r_ap))
+    # recall@fixed-precision consumes the NaN curve: reference's tuple max
+    # degenerates to (nan, thresholds[0]) — both libraries must agree
+    o_r, o_t = ours_mod.binary_recall_at_fixed_precision(jnp.asarray(probs), jnp.asarray(target), min_precision=0.0)
+    r_r, r_t = ref_mod.binary_recall_at_fixed_precision(torch.tensor(probs), torch.tensor(target), min_precision=0.0)
+    assert bool(jnp.isnan(o_r)) and bool(torch.isnan(r_r))
+    assert abs(float(o_t) - float(r_t)) < 1e-6
 
 
 @pytest.mark.parametrize("seed", SEEDS)
