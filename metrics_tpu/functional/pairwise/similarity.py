@@ -31,13 +31,27 @@ def _host_pairwise(kind: str, x: Array, y: Array, zero_diagonal: bool, reduction
     # returns a distinct view object each call, so `yh is xh` is always False
     xh, yh = np.asarray(x), np.asarray(y)
     if kind == "cosine":
-        xn = xh / np.maximum(np.linalg.norm(xh, axis=1, keepdims=True), 1e-12)
-        yn = xn if same else yh / np.maximum(np.linalg.norm(yh, axis=1, keepdims=True), 1e-12)
+        # plain division (reference cosine.py:36-39): zero rows go NaN; the
+        # errstate guard mirrors torch's warning-free 0/0
+        with np.errstate(divide="ignore", invalid="ignore"):
+            xn = xh / np.linalg.norm(xh, axis=1, keepdims=True)
+            yn = xn if same else yh / np.linalg.norm(yh, axis=1, keepdims=True)
         mat = xn @ yn.T
     elif kind == "euclidean":
-        x_norm = np.sum(xh * xh, axis=1, keepdims=True)
-        y_norm = x_norm.ravel() if same else np.sum(yh * yh, axis=1)
-        mat = np.sqrt(np.maximum(x_norm + y_norm[None, :] - 2.0 * (xh @ yh.T), 0.0))
+        # f64 expansion like the reference (euclidean.py:34-40 "upcast to
+        # float64 to prevent precision issues"), squared distances cast back
+        # to the input dtype before the sqrt — near-duplicate rows would
+        # otherwise read ~1e-3 instead of ~1e-8 from f32 cancellation.
+        # Deliberate deviation: squared distances that round to a tiny
+        # NEGATIVE after the cast-back are clamped to 0 where the reference
+        # takes sqrt(negative) -> NaN — an epsilon-level rounding artifact
+        # should read as zero distance, not poison downstream reductions
+        x64 = xh.astype(np.float64)
+        y64 = x64 if same else yh.astype(np.float64)
+        x_norm = np.sum(x64 * x64, axis=1, keepdims=True)
+        y_norm = x_norm.ravel() if same else np.sum(y64 * y64, axis=1)
+        sq = (x_norm + y_norm[None, :] - 2.0 * (x64 @ y64.T)).astype(xh.dtype)
+        mat = np.sqrt(np.maximum(sq, 0.0))
     else:  # linear
         mat = xh @ yh.T
     if zero_diagonal:
@@ -115,8 +129,11 @@ def pairwise_cosine_similarity(
     x, y, zero_diagonal = _check_input(x, y, zero_diagonal)
     if _is_eager_cpu(x) and _is_eager_cpu(y):
         return _host_pairwise("cosine", x, y, zero_diagonal, reduction)
-    norm_x = x / jnp.clip(jnp.linalg.norm(x, axis=1, keepdims=True), min=1e-12)
-    norm_y = y / jnp.clip(jnp.linalg.norm(y, axis=1, keepdims=True), min=1e-12)
+    # plain division, matching the reference (cosine.py:36-39): an all-zero
+    # row has 0/0 norm and propagates NaN through its similarities rather
+    # than being clamped to 0 — a zero vector has no defined direction
+    norm_x = x / jnp.linalg.norm(x, axis=1, keepdims=True)
+    norm_y = y / jnp.linalg.norm(y, axis=1, keepdims=True)
     distance = _safe_matmul(norm_x, norm_y.T)
     distance = _zero_diag(distance, zero_diagonal)
     return _reduce_distance_matrix(distance, reduction)
@@ -133,6 +150,11 @@ def pairwise_euclidean_distance(
     cancellation at large magnitudes. An explicit ``zero_diagonal=False`` is
     honoured (reference behaviour: you get the raw expansion, including its
     diagonal noise), as is passing ``y=x``.
+
+    Precision: the eager host path upcasts the expansion to f64 exactly like
+    the reference (euclidean.py:34); the in-jit/accelerator path keeps f32
+    (TPU has no f64 units), where near-duplicate rows carry expansion noise
+    of order ``sqrt(eps)*scale`` (~1e-3) — the documented deviation.
 
     Example:
         >>> import jax.numpy as jnp

@@ -72,12 +72,17 @@ def test_large_shapes(tm_fn, sk_fn):
     np.testing.assert_allclose(res, sk_fn(X, Y), atol=1e-3)
 
 
-def test_cosine_zero_vector_is_finite():
-    """A zero row must produce 0 similarity, not NaN (safe-divide semantics)."""
+def test_cosine_zero_vector_goes_nan():
+    """A zero row has no direction: its off-diagonal similarities are NaN
+    (plain 0/0 normalization — reference cosine.py:36-39 parity; the
+    zero-diagonal overwrite still pins the diagonal to 0). Round 3 replaced
+    the earlier clamped-to-0 convention after the fuzz-parity tier flagged
+    the divergence."""
     X = np.zeros((2, 3), dtype=np.float32)
     X[1] = [1.0, 0.0, 0.0]
     res = np.asarray(pairwise_cosine_similarity(jnp.asarray(X)))
-    assert np.all(np.isfinite(res))
+    assert np.isnan(res[0, 1]) and np.isnan(res[1, 0])
+    np.testing.assert_array_equal(np.diag(res), 0.0)  # zero_diagonal default
 
 
 def test_euclidean_self_distance_nonnegative():
