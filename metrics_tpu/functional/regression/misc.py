@@ -72,7 +72,10 @@ def _kld_update(p: Array, q: Array, log_prob: bool) -> Tuple[Array, int]:
     else:
         p = p / jnp.sum(p, axis=-1, keepdims=True)
         q = q / jnp.sum(q, axis=-1, keepdims=True)
-        q = jnp.clip(q, min=1.17e-06)
+        # no epsilon clamp on q (reference kl_divergence.py:43-45): a tiny q
+        # bin under p mass must contribute its full p*log(p/q) — a clamp at
+        # ~1e-6 silently halved KL on peaked q distributions (caught by the
+        # fuzz-parity tier); q == 0 with p > 0 correctly yields inf
         measures = jnp.sum(_safe_xlogy(p, p / q), axis=-1)
     return measures, total
 

@@ -1,0 +1,98 @@
+"""Fuzz parity for the remaining convention-heavy functionals: KL divergence
+(empty/zero probability bins, log_prob form), calibration error (all three
+norms on saturated confidences), Tweedie deviance (every power regime), and
+regression cosine similarity (zero vectors). Executed reference as oracle."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.parity.conftest import assert_close
+
+
+def _close_or_both_nonfinite(ours, ref, atol=1e-5):
+    o = np.asarray(jnp.asarray(ours), np.float64)
+    r = np.asarray(ref.detach().numpy() if hasattr(ref, "detach") else ref, np.float64)
+    np.testing.assert_array_equal(np.isnan(o), np.isnan(r))
+    np.testing.assert_array_equal(np.isinf(o), np.isinf(r))
+    mask = np.isfinite(o)
+    if mask.any():
+        np.testing.assert_allclose(o[mask], r[mask], atol=atol, rtol=1e-4)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+@pytest.mark.parametrize("log_prob", [False, True])
+def test_kl_divergence_fuzz_parity(tm, torch, seed, log_prob):
+    import metrics_tpu.functional.regression as ours_r
+    import torchmetrics.functional.regression as ref_r
+
+    rng = np.random.default_rng(seed)
+    n, d = int(rng.integers(2, 32)), 6
+    p = rng.random((n, d)).astype(np.float32) + 1e-3
+    q = rng.random((n, d)).astype(np.float32) + 1e-3
+    if seed == 1:
+        q[:, 0] = 1e-12  # q bin ~0 where p has mass: KL explodes
+    if seed == 2:
+        p[:, 2] = 0.0  # p bin exactly 0: 0*log(0) -> 0 convention
+    p /= p.sum(-1, keepdims=True)
+    q /= q.sum(-1, keepdims=True)
+    pp, qq = (np.log(p), np.log(q)) if log_prob else (p, q)
+    ours = ours_r.kl_divergence(jnp.asarray(pp), jnp.asarray(qq), log_prob=log_prob)
+    ref = ref_r.kl_divergence(torch.tensor(pp), torch.tensor(qq), log_prob=log_prob)
+    _close_or_both_nonfinite(ours, ref, atol=1e-4)
+
+
+@pytest.mark.parametrize("norm", ["l1", "l2", "max"])
+@pytest.mark.parametrize("saturated", [False, True])
+def test_calibration_error_fuzz_parity(tm, torch, norm, saturated):
+    import metrics_tpu.functional.classification as ours_c
+    import torchmetrics.functional.classification as ref_c
+
+    rng = np.random.default_rng(7)
+    n = 120
+    probs = rng.random(n).astype(np.float32)
+    if saturated:
+        # near-0 rather than exact 0: the REFERENCE crashes on confidence 0.0
+        # (its bucketize maps it to bin -1); 1.0 exactly is handled
+        probs[: n // 3] = 1e-7
+        probs[n // 3 : 2 * n // 3] = 1.0  # bin-edge confidences
+    target = rng.integers(0, 2, n)
+    ours = ours_c.binary_calibration_error(jnp.asarray(probs), jnp.asarray(target), n_bins=10, norm=norm)
+    ref = ref_c.binary_calibration_error(torch.tensor(probs), torch.tensor(target), n_bins=10, norm=norm)
+    assert_close(ours, ref, atol=1e-5)
+
+    mc = rng.random((n, 4)).astype(np.float32)
+    mc /= mc.sum(-1, keepdims=True)
+    tgt_mc = rng.integers(0, 4, n)
+    ours = ours_c.multiclass_calibration_error(jnp.asarray(mc), jnp.asarray(tgt_mc), num_classes=4, n_bins=7, norm=norm)
+    ref = ref_c.multiclass_calibration_error(torch.tensor(mc), torch.tensor(tgt_mc), num_classes=4, n_bins=7, norm=norm)
+    assert_close(ours, ref, atol=1e-5)
+
+
+@pytest.mark.parametrize("power", [0.0, 1.0, 1.5, 2.0, 3.0])
+def test_tweedie_fuzz_parity(tm, torch, power):
+    import metrics_tpu.functional.regression as ours_r
+    import torchmetrics.functional.regression as ref_r
+
+    rng = np.random.default_rng(13)
+    n = 200
+    p = np.abs(rng.normal(size=n)).astype(np.float32) + 0.1
+    t = np.abs(rng.normal(size=n)).astype(np.float32) + 0.1
+    if 1.0 <= power < 2.0:
+        t[:10] = 0.0  # zero targets are legal only in the poisson/compound regime
+    ours = ours_r.tweedie_deviance_score(jnp.asarray(p), jnp.asarray(t), power=power)
+    ref = ref_r.tweedie_deviance_score(torch.tensor(p), torch.tensor(t), power=power)
+    _close_or_both_nonfinite(ours, ref, atol=1e-3)
+
+
+def test_regression_cosine_zero_vector_parity(tm, torch):
+    import metrics_tpu.functional.regression as ours_r
+    import torchmetrics.functional.regression as ref_r
+
+    x = np.array([[0.0, 0.0, 0.0], [1.0, 2.0, 3.0]], np.float32)
+    y = np.array([[1.0, 1.0, 1.0], [1.0, 2.0, 3.0]], np.float32)
+    ours = ours_r.cosine_similarity(jnp.asarray(x), jnp.asarray(y), reduction="none")
+    ref = ref_r.cosine_similarity(torch.tensor(x), torch.tensor(y), reduction="none")
+    _close_or_both_nonfinite(ours, ref)
