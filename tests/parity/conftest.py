@@ -126,3 +126,22 @@ def assert_close(ours, ref, atol=1e-5):
     ours = np.asarray(jnp.asarray(ours), dtype=np.float64)
     ref = np.asarray(ref.detach().numpy() if hasattr(ref, "detach") else ref, dtype=np.float64)
     np.testing.assert_allclose(ours, ref, atol=atol, rtol=1e-4)
+
+
+def assert_close_or_both_nonfinite(ours, ref, atol=1e-4):
+    """assert_close that also accepts matching non-finite patterns: NaN masks
+    must agree, infinities must agree in position AND sign, and the finite
+    cells must be allclose. Shared by the fuzz-parity tiers."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    o = np.asarray(jnp.asarray(ours), dtype=np.float64)
+    r = np.asarray(ref.detach().numpy() if hasattr(ref, "detach") else ref, dtype=np.float64)
+    np.testing.assert_array_equal(np.isnan(o), np.isnan(r))
+    np.testing.assert_array_equal(np.isinf(o), np.isinf(r))
+    inf_mask = np.isinf(o)
+    if inf_mask.any():
+        np.testing.assert_array_equal(np.sign(o[inf_mask]), np.sign(r[inf_mask]))
+    fin = np.isfinite(o)
+    if fin.any():
+        np.testing.assert_allclose(o[fin], r[fin], atol=atol, rtol=1e-4)

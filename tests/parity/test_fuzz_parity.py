@@ -160,6 +160,28 @@ def test_regression_fuzz_parity(tm, torch, seed):
         assert_close(ours, ref, atol=1e-4)
 
 
+@pytest.mark.parametrize("seed", SEEDS[:3])
+def test_streaming_with_per_batch_absent_classes(tm, torch, seed):
+    """Module-API streaming where some classes appear only in SOME batches:
+    the accumulated states must reproduce the reference's single-shot macro
+    conventions after merging."""
+    import metrics_tpu.classification as ours_c
+    import torchmetrics.classification as ref_c
+
+    rng = np.random.default_rng(seed + 500)
+    om = ours_c.MulticlassF1Score(num_classes=NC, average="macro")
+    rm = ref_c.MulticlassF1Score(num_classes=NC, average="macro")
+    for b in range(3):
+        n = int(rng.integers(2, 40))
+        probs = rng.random((n, NC)).astype(np.float32)
+        probs /= probs.sum(-1, keepdims=True)
+        # batch b only ever contains classes {0..b+1} — later classes absent
+        target = rng.integers(0, b + 2, n)
+        om.update(jnp.asarray(probs), jnp.asarray(target))
+        rm.update(torch.tensor(probs), torch.tensor(target))
+    assert_close(om.compute(), rm.compute())
+
+
 @pytest.mark.parametrize("seed", SEEDS[:4])
 def test_single_sample_and_tiny_batches(tm, torch, seed):
     """n=1 updates exercise every zero-division guard at once."""

@@ -9,17 +9,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from tests.parity.conftest import assert_close
-
-
-def _close_or_both_nonfinite(ours, ref, atol=1e-5):
-    o = np.asarray(jnp.asarray(ours), np.float64)
-    r = np.asarray(ref.detach().numpy() if hasattr(ref, "detach") else ref, np.float64)
-    np.testing.assert_array_equal(np.isnan(o), np.isnan(r))
-    np.testing.assert_array_equal(np.isinf(o), np.isinf(r))
-    mask = np.isfinite(o)
-    if mask.any():
-        np.testing.assert_allclose(o[mask], r[mask], atol=atol, rtol=1e-4)
+from tests.parity.conftest import assert_close, assert_close_or_both_nonfinite
 
 
 @pytest.mark.parametrize("seed", [0, 1, 2, 3])
@@ -41,7 +31,7 @@ def test_kl_divergence_fuzz_parity(tm, torch, seed, log_prob):
     pp, qq = (np.log(p), np.log(q)) if log_prob else (p, q)
     ours = ours_r.kl_divergence(jnp.asarray(pp), jnp.asarray(qq), log_prob=log_prob)
     ref = ref_r.kl_divergence(torch.tensor(pp), torch.tensor(qq), log_prob=log_prob)
-    _close_or_both_nonfinite(ours, ref, atol=1e-4)
+    assert_close_or_both_nonfinite(ours, ref, atol=1e-4)
 
 
 @pytest.mark.parametrize("norm", ["l1", "l2", "max"])
@@ -84,7 +74,7 @@ def test_tweedie_fuzz_parity(tm, torch, power):
         t[:10] = 0.0  # zero targets are legal only in the poisson/compound regime
     ours = ours_r.tweedie_deviance_score(jnp.asarray(p), jnp.asarray(t), power=power)
     ref = ref_r.tweedie_deviance_score(torch.tensor(p), torch.tensor(t), power=power)
-    _close_or_both_nonfinite(ours, ref, atol=1e-3)
+    assert_close_or_both_nonfinite(ours, ref, atol=1e-3)
 
 
 def test_regression_cosine_zero_vector_parity(tm, torch):
@@ -95,4 +85,4 @@ def test_regression_cosine_zero_vector_parity(tm, torch):
     y = np.array([[1.0, 1.0, 1.0], [1.0, 2.0, 3.0]], np.float32)
     ours = ours_r.cosine_similarity(jnp.asarray(x), jnp.asarray(y), reduction="none")
     ref = ref_r.cosine_similarity(torch.tensor(x), torch.tensor(y), reduction="none")
-    _close_or_both_nonfinite(ours, ref)
+    assert_close_or_both_nonfinite(ours, ref)

@@ -10,20 +10,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from tests.parity.conftest import assert_close
-
-
-def _close_or_both_nonfinite(ours, ref, atol=1e-4):
-    o = np.asarray(jnp.asarray(ours), np.float64)
-    r = np.asarray(ref.detach().numpy() if hasattr(ref, "detach") else ref, np.float64)
-    if not (np.isfinite(o).all() and np.isfinite(r).all()):
-        np.testing.assert_array_equal(np.isfinite(o), np.isfinite(r))
-        np.testing.assert_array_equal(np.sign(o[~np.isfinite(o) & ~np.isnan(o)]), np.sign(r[~np.isfinite(r) & ~np.isnan(r)]))
-        np.testing.assert_array_equal(np.isnan(o), np.isnan(r))
-        if np.isfinite(o).any():
-            np.testing.assert_allclose(o[np.isfinite(o)], r[np.isfinite(r)], atol=atol, rtol=1e-4)
-    else:
-        np.testing.assert_allclose(o, r, atol=atol, rtol=1e-4)
+from tests.parity.conftest import assert_close, assert_close_or_both_nonfinite
 
 
 # ---------------------------------------------------------------------- audio
@@ -49,7 +36,7 @@ def test_audio_fuzz_parity(tm, torch, seed):
     ]:
         ours = getattr(ours_a, name)(jnp.asarray(est), jnp.asarray(tgt), **kwargs)
         ref = getattr(ref_a, name)(torch.tensor(est), torch.tensor(tgt), **kwargs)
-        _close_or_both_nonfinite(ours, ref, atol=1e-4)
+        assert_close_or_both_nonfinite(ours, ref, atol=1e-4)
 
     # SDR solves a 512-tap Toeplitz system: on (near-)identical channels the
     # system is singular and the two libraries' solvers diverge into
@@ -59,7 +46,7 @@ def test_audio_fuzz_parity(tm, torch, seed):
     est_sdr = tgt + 0.05 * rng.normal(size=tgt.shape).astype(np.float32)
     ours = ours_a.signal_distortion_ratio(jnp.asarray(est_sdr), jnp.asarray(tgt))
     ref = ref_a.signal_distortion_ratio(torch.tensor(est_sdr), torch.tensor(tgt))
-    _close_or_both_nonfinite(ours, ref, atol=1e-2)
+    assert_close_or_both_nonfinite(ours, ref, atol=1e-2)
 
 
 def test_pit_fuzz_parity(tm, torch):
@@ -109,7 +96,7 @@ def test_image_fuzz_parity(tm, torch, seed):
         else:
             ours = getattr(ours_i, name)(jnp.asarray(x), jnp.asarray(y), **kwargs)
             ref = getattr(ref_i, name)(torch.tensor(x), torch.tensor(y), **kwargs)
-        _close_or_both_nonfinite(ours, ref, atol=1e-3)
+        assert_close_or_both_nonfinite(ours, ref, atol=1e-3)
 
 
 # --------------------------------------------------------------------- nominal
@@ -131,7 +118,7 @@ def test_nominal_fuzz_parity(tm, torch, seed):
     for name in ["cramers_v", "pearsons_contingency_coefficient", "tschuprows_t", "theils_u"]:
         ours = getattr(ours_n, name)(jnp.asarray(a), jnp.asarray(b))
         ref = getattr(ref_n, name)(torch.tensor(a), torch.tensor(b))
-        _close_or_both_nonfinite(ours, ref, atol=1e-4)
+        assert_close_or_both_nonfinite(ours, ref, atol=1e-4)
 
 
 # -------------------------------------------------------------------- pairwise
@@ -154,4 +141,4 @@ def test_pairwise_zero_vector_parity(tm, torch):
     ]:
         ours = getattr(ours_p, name)(jnp.asarray(x))
         ref = getattr(ref_p, name)(torch.tensor(x))
-        _close_or_both_nonfinite(ours, ref, atol=1e-4)
+        assert_close_or_both_nonfinite(ours, ref, atol=1e-4)
