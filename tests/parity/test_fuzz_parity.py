@@ -58,6 +58,10 @@ _MC_FNS = [
     ("multiclass_cohen_kappa", dict(num_classes=NC)),
     ("multiclass_auroc", dict(num_classes=NC, average="macro")),
     ("multiclass_average_precision", dict(num_classes=NC, average="macro")),
+    # weighted reductions take the NaN-ignoring weighted branch when a class
+    # is absent (weights renormalized over the finite classes)
+    ("multiclass_auroc", dict(num_classes=NC, average="weighted")),
+    ("multiclass_average_precision", dict(num_classes=NC, average="weighted")),
 ]
 
 _BIN_FNS = [
