@@ -111,7 +111,12 @@ def test_bert_score_parity_tiny_model(tiny_bert_dir, tm, idf):
         ("kl_divergence", {}),
         ("fisher_rao_distance", {}),
         ("alpha_divergence", {"alpha": 0.5}),
+        ("beta_divergence", {"beta": 0.5}),
+        ("ab_divergence", {"alpha": 0.5, "beta": 0.7}),
+        ("renyi_divergence", {"alpha": 0.5}),
+        ("l1_distance", {}),
         ("l2_distance", {}),
+        ("l_infinity_distance", {}),
     ],
 )
 def test_infolm_parity_tiny_model(tiny_bert_dir, tm, ref_enum_format_fix, measure, kwargs):
