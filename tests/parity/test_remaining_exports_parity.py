@@ -1,0 +1,89 @@
+"""Differential parity for the last functional exports no other tier names:
+image_gradients, the nominal matrix variants, pit_permutate,
+retrieval_precision_recall_curve, sacre_bleu_score (all four tokenizers) and
+spectral_distortion_index. After this file, every one of the 85 functional
+exports appears in at least one executed-reference comparison
+(cross-referenced by tests/parity coverage scan)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.parity.conftest import assert_close
+
+
+def test_image_gradients_parity(tm, torch):
+    import metrics_tpu.functional as ours_f
+    import torchmetrics.functional as ref_f
+
+    rng = np.random.default_rng(0)
+    img = rng.random((2, 3, 12, 16)).astype(np.float32)
+    o_dy, o_dx = ours_f.image_gradients(jnp.asarray(img))
+    r_dy, r_dx = ref_f.image_gradients(torch.tensor(img))
+    assert_close(o_dy, r_dy)
+    assert_close(o_dx, r_dx)
+
+
+def test_nominal_matrix_variants_parity(tm, torch):
+    import metrics_tpu.functional.nominal as ours_n
+    import torchmetrics.functional.nominal as ref_n
+
+    rng = np.random.default_rng(3)
+    mat = rng.integers(0, 4, (300, 3))
+    for name in ["pearsons_contingency_coefficient_matrix", "tschuprows_t_matrix"]:
+        ours = getattr(ours_n, name)(jnp.asarray(mat))
+        ref = getattr(ref_n, name)(torch.tensor(mat))
+        assert_close(ours, ref, atol=1e-5)
+
+
+def test_pit_permutate_parity(tm, torch):
+    import metrics_tpu.functional.audio as ours_a
+    import torchmetrics.functional.audio as ref_a
+
+    rng = np.random.default_rng(4)
+    x = rng.normal(size=(2, 3, 64)).astype(np.float32)
+    perm = np.array([[2, 0, 1], [1, 2, 0]])
+    ours = ours_a.pit_permutate(jnp.asarray(x), jnp.asarray(perm))
+    ref = ref_a.pit_permutate(torch.tensor(x), torch.tensor(perm))
+    assert_close(ours, ref)
+
+
+def test_retrieval_precision_recall_curve_parity(tm, torch):
+    import metrics_tpu.functional.retrieval as ours_r
+    import torchmetrics.functional.retrieval as ref_r
+
+    rng = np.random.default_rng(6)
+    preds = rng.random(20).astype(np.float32)
+    target = rng.integers(0, 2, 20)
+    o_p, o_r, o_k = ours_r.retrieval_precision_recall_curve(jnp.asarray(preds), jnp.asarray(target), max_k=10)
+    r_p, r_r, r_k = ref_r.retrieval_precision_recall_curve(torch.tensor(preds), torch.tensor(target), max_k=10)
+    assert_close(o_p, r_p, atol=1e-6)
+    assert_close(o_r, r_r, atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(o_k), r_k.numpy())
+
+
+@pytest.mark.parametrize("tokenize", ["13a", "intl", "char", "none"])
+def test_sacre_bleu_tokenizers_parity(tm, torch, tokenize):
+    import metrics_tpu.functional.text as ours_t
+    import torchmetrics.functional.text as ref_t
+
+    preds = ["the cat, sat; on the mat!", "naïve café — résumé"]
+    refs = [["the cat sat on the mat.", "a cat sat."], ["naïve café — résumé"]]
+    ours = ours_t.sacre_bleu_score(preds, refs, tokenize=tokenize)
+    ref = ref_t.sacre_bleu_score(preds, refs, tokenize=tokenize)
+    assert_close(ours, ref, atol=1e-6)
+
+
+def test_spectral_distortion_index_parity(tm, torch):
+    import metrics_tpu.functional.image as ours_i
+    import torchmetrics.functional.image as ref_i
+
+    rng = np.random.default_rng(8)
+    preds = rng.random((2, 3, 32, 32)).astype(np.float32)
+    target = rng.random((2, 3, 32, 32)).astype(np.float32)
+    for p, reduction in [(1, "elementwise_mean"), (3, "sum")]:
+        ours = ours_i.spectral_distortion_index(jnp.asarray(preds), jnp.asarray(target), p=p, reduction=reduction)
+        ref = ref_i.spectral_distortion_index(torch.tensor(preds), torch.tensor(target), p=p, reduction=reduction)
+        assert_close(ours, ref, atol=1e-5)
