@@ -15,7 +15,7 @@ reference which also keeps them out of the top-level ``__all__``.
 
 import logging as __logging
 
-__version__ = "0.2.0"
+__version__ = "0.3.0"
 
 _logger = __logging.getLogger("metrics_tpu")
 _logger.addHandler(__logging.StreamHandler())
