@@ -100,6 +100,25 @@ def test_binary_fuzz_parity(tm, torch, seed, name, kwargs):
     assert_close(ours, ref)
 
 
+@pytest.mark.parametrize("seed", [3, 21])
+def test_binned_ap_absent_class_parity(tm, torch, seed):
+    """BINNED regime with an absent class: the deliberate opposite of the
+    exact regime — _safe_divide yields 0 (not NaN) for the absent class and
+    the macro mean includes it on both sides."""
+    import metrics_tpu.functional.classification as ours_mod
+    import torchmetrics.functional.classification as ref_mod
+
+    _, probs, target, _, _ = _draws(seed)  # seed%3==0 -> class NC-1 absent
+    for avg in ["macro", "none"]:
+        ours = ours_mod.multiclass_average_precision(
+            jnp.asarray(probs), jnp.asarray(target), num_classes=NC, average=avg, thresholds=20
+        )
+        ref = ref_mod.multiclass_average_precision(
+            torch.tensor(probs), torch.tensor(target), num_classes=NC, average=avg, thresholds=20
+        )
+        assert_close(ours, ref)
+
+
 def test_all_negative_targets_nan_recall_parity(tm, torch):
     """Zero positives in exact mode: recall is NaN (plain division, ref
     :224-225) and AP is NaN on both sides — the case motivating the
