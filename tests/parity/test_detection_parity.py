@@ -124,3 +124,31 @@ def test_mean_ap_parity_xywh_and_thresholds(ref_map_cls, torch):
         got = float(np.asarray(res_ours[key]))
         want = float(res_ref[key])
         assert got == pytest.approx(want, abs=1e-5), (key, got, want)
+
+
+def test_mean_ap_parity_empty_scenes(ref_map_cls, torch):
+    """Degenerate scenes: an image with no predictions, an image with no
+    ground truth, and one fully empty image — the unmatched-detection /
+    unmatched-target bookkeeping both libraries must agree on."""
+    rng = np.random.default_rng(17)
+    preds, targets = _random_scene(rng, n_images=6, n_classes=3)
+    empty_pred = {"boxes": np.zeros((0, 4), np.float32), "scores": np.zeros((0,), np.float32),
+                  "labels": np.zeros((0,), np.int64)}
+    empty_tgt = {"boxes": np.zeros((0, 4), np.float32), "labels": np.zeros((0,), np.int64)}
+    preds[1] = dict(empty_pred)   # no detections for image 1
+    targets[2] = dict(empty_tgt)  # no ground truth for image 2
+    preds[4] = dict(empty_pred)   # image 4 fully empty
+    targets[4] = dict(empty_tgt)
+
+    ours = MeanAveragePrecision()
+    ours.update(preds, targets)
+    res_ours = ours.compute()
+
+    ref = ref_map_cls()
+    ref.update(_to_torch(torch, preds, True), _to_torch(torch, targets, False))
+    res_ref = ref.compute()
+
+    for key in KEYS:
+        got = float(np.asarray(res_ours[key]))
+        want = float(res_ref[key])
+        assert got == pytest.approx(want, abs=1e-5), (key, got, want)
