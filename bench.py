@@ -10,8 +10,9 @@ Methodology (recorded per BASELINE.md): f32 params, compile excluded (warmup ste
 mean of `STEPS` timed steps chained through the donated carry with one trailing host
 readback; best of N interleaved repetitions per mode (N=5 on accelerator, 3 on the
 degraded CPU path — host jitter only inflates samples, so the minimum is the faithful
-step time), after an untimed tunnel warm-up phase on accelerator runs. Prints ONE
-JSON line and exits 0 even when degraded.
+step time), after an untimed tunnel warm-up phase on accelerator runs. The FINAL
+stdout line is always one compact parseable JSON summary (bulky context, e.g. the
+degraded-run history blob, goes on its own line above it); exits 0 even when degraded.
 
 Robustness (round-2 hardening): TPU backend init on this image can hang indefinitely
 when the tunnel is down — round 1's bench died there with a bare stack trace and no
@@ -169,16 +170,24 @@ def run_benchmark(degraded_reason: str | None) -> dict:
     acc = float(metrics["accuracy"].compute_from(carry[1]["accuracy"]))
     assert 0.0 <= acc <= 1.0
 
-    overhead_pct = max(0.0, (t_fused - t_bare) / t_bare * 100.0)
+    # raw_overhead_pct is the unclamped delta: negative values mean the fused
+    # step measured *faster* than the bare step, i.e. the true overhead is below
+    # the noise floor. The clamped headline value stays (a negative "overhead"
+    # is measurement noise, not speedup), but the raw number is recorded so the
+    # noise floor is visible and a drift from -1% to +0.9% is not invisible.
+    raw_overhead_pct = (t_fused - t_bare) / t_bare * 100.0
+    overhead_pct = max(0.0, raw_overhead_pct)
     record = {
         "metric": "fused Accuracy+F1+ConfusionMatrix metric-update overhead per train step",
         "value": round(overhead_pct, 3),
         "unit": "%",
         "vs_baseline": round(overhead_pct / 1.0, 3),
         "overhead_pct": round(overhead_pct, 3),
+        "raw_overhead_pct": round(raw_overhead_pct, 3),
         "bare_ms_per_step": round(t_bare * 1e3, 3),
         "fused_ms_per_step": round(t_fused * 1e3, 3),
         "backend": jax.default_backend(),
+        "reps": reps,
         "config": {"batch": batch, "hidden": hidden, "classes": classes, "layers": layers, "steps": steps},
     }
     if degraded_reason:
@@ -239,6 +248,14 @@ def main() -> None:
             os.replace(tmp_path, results_path)
         except Exception as exc:  # noqa: BLE001 — recording must never break the artifact
             record["results_log_error"] = repr(exc)
+    # Stdout contract: the FINAL line is a compact one-line JSON summary the
+    # driver can parse mechanically even when it tail-truncates the capture.
+    # Anything bulky (the accelerator-run history attached on degraded runs)
+    # is printed on its own line ABOVE the summary.
+    history_ctx = record.pop("last_known_tpu", None)
+    if history_ctx is not None:
+        print(json.dumps({"last_known_tpu": history_ctx}))
+        record["last_known_tpu"] = "see preceding stdout line"
     print(json.dumps(record))
 
 
