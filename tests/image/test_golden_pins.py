@@ -1,0 +1,82 @@
+"""Golden activation pins for the weight-converted nets (VERDICT r3 item #1b).
+
+The three-way parity tests (test_inception_parity.py, test_lpips_parity.py)
+prove the flax nets agree with two independently constructed torch oracles
+*today*. These pins freeze that verified behavior: per-tap summary statistics
+of the flax InceptionV3 and flax LPIPS outputs for a fixed seed, hard-coded at
+the commit where all three implementations agreed. Any future drift — in the
+flax nets, the converters, or the synthetic state-dict generator — fails here
+loudly even if someone edits both sides of a parity test in lockstep.
+
+Values were computed on the 8-virtual-device CPU mesh with
+``jax_default_matmul_precision="highest"`` (the suite's conftest pins this).
+Tolerances allow cross-platform conv-reduction jitter (~1e-3 relative at
+94-conv depth) while failing hard on any structural change: a transposed
+kernel, swapped pooling mode, or wrong padding shifts these statistics by
+orders of magnitude more than the tolerance.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.image.inception_net import InceptionV3
+from metrics_tpu.image.lpips_net import LPIPSNet
+from tools.convert_inception_weights import convert_state_dict
+from tools.convert_lpips_weights import build_params
+from tools.torch_inception_fid import random_state_dict
+from tools.torch_lpips_ref import random_state_dicts
+
+# tap -> (mean, std, abs_max) of the flax forward for state-dict seed 0,
+# image seed 1 (2 uint8 images, 299x299), normalisation x/255*2-1.
+_INCEPTION_GOLDEN = {
+    64: (0.9097548766440013, 0.5819444886803089, 2.4488961696624756),
+    192: (1.3531149724186922, 1.612339255823801, 7.589737892150879),
+    768: (2.700367048652358, 3.684532371244497, 20.87458038330078),
+    2048: (4.385478612518455, 5.84683887035887, 56.79060745239258),
+    "logits": (0.1512592381904907, 7.375230430294431, 23.592145919799805),
+    "logits_unbiased": (0.14996113583061194, 7.374414622161451, 23.557924270629883),
+}
+
+# net_type -> the two LPIPS distances for state-dict seed 0, image seed 1
+# (2 image pairs; 35x35 for squeeze to exercise ceil-mode pools, else 64x64).
+_LPIPS_GOLDEN = {
+    "alex": (0.18635683, 0.18597622),
+    "vgg": (0.14239317, 0.1415795),
+    "squeeze": (0.19500725, 0.19645211),
+}
+
+
+@pytest.fixture(scope="module")
+def inception_taps():
+    sd = random_state_dict(seed=0)
+    rng = np.random.default_rng(1)
+    imgs = rng.integers(0, 255, size=(2, 3, 299, 299), dtype=np.uint8)
+    variables = jax.tree_util.tree_map(jnp.asarray, convert_state_dict(sd))
+    x = jnp.transpose(jnp.asarray(imgs, jnp.float32) / 255.0 * 2.0 - 1.0, (0, 2, 3, 1))
+    return InceptionV3().apply(variables, x)
+
+
+@pytest.mark.parametrize("tap", list(_INCEPTION_GOLDEN))
+def test_inception_tap_statistics_pinned(inception_taps, tap):
+    arr = np.asarray(inception_taps[tap], np.float64)
+    mean, std, abs_max = _INCEPTION_GOLDEN[tap]
+    # the mean is a difference of large numbers for the logits taps, so its
+    # jitter budget scales with the activation spread, not the mean itself
+    assert abs(float(arr.mean()) - mean) < 1e-2 * std
+    np.testing.assert_allclose(float(arr.std()), std, rtol=1e-2)
+    np.testing.assert_allclose(float(np.abs(arr).max()), abs_max, rtol=1e-2)
+
+
+@pytest.mark.parametrize("net_type", list(_LPIPS_GOLDEN))
+def test_lpips_distances_pinned(net_type):
+    backbone_sd, lpips_sd = random_state_dicts(net_type, seed=0)
+    rng = np.random.default_rng(1)
+    size = 35 if net_type == "squeeze" else 64
+    img0 = rng.uniform(-1, 1, size=(2, 3, size, size)).astype(np.float32)
+    img1 = rng.uniform(-1, 1, size=(2, 3, size, size)).astype(np.float32)
+    variables = jax.tree_util.tree_map(jnp.asarray, build_params(backbone_sd, lpips_sd, net_type))
+    got = np.asarray(LPIPSNet(net_type=net_type).apply(variables, jnp.asarray(img0), jnp.asarray(img1)))
+    np.testing.assert_allclose(got, np.asarray(_LPIPS_GOLDEN[net_type]), atol=2e-4, rtol=1e-3)

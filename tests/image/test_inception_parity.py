@@ -7,12 +7,17 @@ is run through
 
 - ``tools/torch_inception_fid.torch_forward`` — pure ``torch.nn.functional``
   ops, the same primitives the reference's torch-fidelity net executes
-  (ref src/torchmetrics/image/fid.py:41), and
+  (ref src/torchmetrics/image/fid.py:41),
+- ``tools/torch_inception_module.module_forward`` — an independently written
+  nn.Module graph with hard-coded torchvision widths/strides/paddings and a
+  ``strict=True`` state-dict load (VERDICT r3 item #1: breaks the shared
+  provenance between the first oracle and the flax net), and
 - ``tools/convert_inception_weights.convert_state_dict`` + the flax net,
 
 and every feature tap (64 / 192 / 768 / 2048 / logits / logits_unbiased) must
-agree to ~1e-4. A single transposed conv kernel, swapped pooling mode, wrong BN
-epsilon, or asymmetric-padding flip anywhere in the 94-conv network fails this.
+agree three ways to ~1e-4. A single transposed conv kernel, swapped pooling
+mode, wrong BN epsilon, or asymmetric-padding flip anywhere in the 94-conv
+network fails this.
 """
 
 import numpy as np
@@ -24,6 +29,7 @@ import jax.numpy as jnp
 from metrics_tpu.image.inception_net import FEATURE_DIMS, InceptionFeatureExtractor, InceptionV3, save_params
 from tools.convert_inception_weights import convert_state_dict, expected_torch_keys
 from tools.torch_inception_fid import random_state_dict, torch_forward
+from tools.torch_inception_module import module_forward
 
 torch = pytest.importorskip("torch")
 
@@ -32,23 +38,46 @@ TAPS = [64, 192, 768, 2048, "logits", "logits_unbiased"]
 
 @pytest.fixture(scope="module")
 def shared():
-    """One state dict + one image batch + both forwards, reused across cases."""
+    """One state dict + one image batch + all three forwards, reused across cases."""
     sd = random_state_dict(seed=0)
     rng = np.random.default_rng(1)
     imgs = rng.integers(0, 255, size=(2, 3, 299, 299), dtype=np.uint8)
     torch_taps = torch_forward(sd, imgs)
+    module_taps = module_forward(sd, imgs)
     variables = jax.tree_util.tree_map(jnp.asarray, convert_state_dict(sd))
     x = jnp.transpose(jnp.asarray(imgs, jnp.float32) / 255.0 * 2.0 - 1.0, (0, 2, 3, 1))
     flax_taps = InceptionV3().apply(variables, x)
-    return sd, imgs, torch_taps, flax_taps
+    return sd, imgs, torch_taps, flax_taps, module_taps
 
 
 @pytest.mark.parametrize("tap", TAPS)
 def test_activation_parity_at_tap(shared, tap):
-    _, _, torch_taps, flax_taps = shared
+    _, _, torch_taps, flax_taps, _ = shared
     got = np.asarray(flax_taps[tap])
     want = torch_taps[tap]
     assert got.shape == (2, FEATURE_DIMS[tap])
+    scale = max(1.0, float(np.abs(want).max()))
+    np.testing.assert_allclose(got, want, atol=1e-4 * scale, rtol=1e-4)
+
+
+@pytest.mark.parametrize("tap", TAPS)
+def test_independent_module_oracle_agrees(shared, tap):
+    """Oracle-vs-oracle: the strict-loaded nn.Module graph must reproduce the
+    procedural functional walk at every tap (both torch, so near-bit-exact).
+    Disagreement means one of the two architecture descriptions is mistranscribed
+    — the failure mode the shared-provenance pair could never surface."""
+    _, _, torch_taps, _, module_taps = shared
+    want = torch_taps[tap]
+    scale = max(1.0, float(np.abs(want).max()))
+    np.testing.assert_allclose(module_taps[tap], want, atol=1e-5 * scale, rtol=1e-5)
+
+
+@pytest.mark.parametrize("tap", TAPS)
+def test_flax_vs_independent_module_oracle(shared, tap):
+    """The flax net must also match the independent module oracle directly."""
+    _, _, _, flax_taps, module_taps = shared
+    got = np.asarray(flax_taps[tap])
+    want = module_taps[tap]
     scale = max(1.0, float(np.abs(want).max()))
     np.testing.assert_allclose(got, want, atol=1e-4 * scale, rtol=1e-4)
 
@@ -59,7 +88,7 @@ def test_extractor_end_to_end_matches_torch(shared, tmp_path):
     Exercises the full user path: file round-trip, uint8 ingestion, the NCHW→NHWC
     transpose, the (identity) 299→299 resize, and the [-1, 1] normalisation.
     """
-    sd, imgs, torch_taps, _ = shared
+    sd, imgs, torch_taps, _, _ = shared
     path = str(tmp_path / "inception_fid.npz")
     save_params(convert_state_dict(sd), path)
     extractor = InceptionFeatureExtractor(2048, weights_path=path)

@@ -18,6 +18,7 @@ import jax.numpy as jnp
 
 from metrics_tpu.image.lpips_net import LPIPSNet
 from tools.convert_lpips_weights import build_params
+from tools.torch_lpips_module import module_lpips_distance
 from tools.torch_lpips_ref import random_state_dicts, torch_lpips_distance
 
 pytest.importorskip("torch")
@@ -32,6 +33,12 @@ def test_lpips_distance_parity(net_type):
     img1 = rng.uniform(-1, 1, size=(2, 3, size, size)).astype(np.float32)
 
     want = torch_lpips_distance(backbone_sd, lpips_sd, net_type, img0, img1)
+
+    # Independent oracle (VERDICT r3 item #1): strict-loaded torchvision-style
+    # Sequential backbones with hard-coded indices/widths. Oracle-vs-oracle
+    # disagreement means one architecture description is mistranscribed.
+    independent = module_lpips_distance(backbone_sd, lpips_sd, net_type, img0, img1)
+    np.testing.assert_allclose(independent, want, atol=1e-6, rtol=1e-5)
 
     variables = jax.tree_util.tree_map(jnp.asarray, build_params(backbone_sd, lpips_sd, net_type))
     got = np.asarray(LPIPSNet(net_type=net_type).apply(variables, jnp.asarray(img0), jnp.asarray(img1)))

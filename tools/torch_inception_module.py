@@ -1,0 +1,246 @@
+"""Second, independent torch oracle for the FID InceptionV3: an nn.Module graph.
+
+Why this exists (VERDICT r3 item #1): ``tools/torch_inception_fid.torch_forward``
+and the flax net in :mod:`metrics_tpu.image.inception_net` share provenance — a
+common-mode transcription error (same wrong stride on both sides) would pass
+every tap of ``tests/image/test_inception_parity.py``. This module is a third
+implementation built along a DIFFERENT construction path:
+
+- It reconstructs the torchvision ``inception_v3`` module graph (``BasicConv2d``
+  + ``InceptionA/B/C/D/E`` classes) with the torch-fidelity FID patches — the
+  1008-way ``fc``, ``count_include_pad=False`` average pooling, and the
+  max-pooled ``branch_pool`` in ``Mixed_7c`` — which is the network behind the
+  reference's ``NoTrainInceptionV3`` (ref src/torchmetrics/image/fid.py:41,
+  importing ``torch_fidelity.feature_extractor_inceptionv3``). Neither
+  torch-fidelity nor torchvision ships in this offline image, so their source
+  cannot be vendored verbatim; this is a reconstruction of that module
+  structure from the torchvision architecture, attributed here.
+- Every channel width, kernel size, stride, and padding is HARD-CODED in the
+  module constructors below, whereas ``expected_torch_keys()`` derives shapes
+  from the flax module tree. ``load_state_dict(strict=True)`` therefore
+  cross-checks the flax net's layer shapes against an independently written
+  description of the architecture — a transposed kernel, a swapped
+  (1,7)/(7,1) factorisation, or a wrong branch width anywhere in the 94-conv
+  net fails the load before any numerics run.
+- The forward runs through torch's module path (``nn.Conv2d`` /
+  ``nn.BatchNorm2d`` in ``eval()``), not the functional calls the first oracle
+  uses.
+
+Residual risk, stated honestly: all three implementations are authored in this
+repo, so an error in the *architecture description itself* (e.g. a wrong
+pooling mode recalled identically three times) remains undetectable offline.
+``tests/image/test_golden_pins.py`` pins golden activations so any future
+drift fails loudly; running ``tools/convert_inception_weights.py`` against the
+real ``pt_inception-2015-12-05`` checkpoint (needs network once) remains the
+final confirmation step.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+
+def _build_modules():
+    """Define the module classes lazily so importing this file needs no torch."""
+    import torch
+    import torch.nn as nn
+    import torch.nn.functional as F
+
+    class BasicConv2d(nn.Module):
+        def __init__(self, in_ch: int, out_ch: int, **kwargs):
+            super().__init__()
+            self.conv = nn.Conv2d(in_ch, out_ch, bias=False, **kwargs)
+            self.bn = nn.BatchNorm2d(out_ch, eps=0.001)
+
+        def forward(self, x):
+            return F.relu(self.bn(self.conv(x)), inplace=True)
+
+    def _fid_avg_pool(x):
+        # torch-fidelity's FID patch: TF-style average pooling that excludes
+        # the zero padding from the divisor.
+        return F.avg_pool2d(x, kernel_size=3, stride=1, padding=1, count_include_pad=False)
+
+    class InceptionA(nn.Module):
+        def __init__(self, in_ch: int, pool_features: int):
+            super().__init__()
+            self.branch1x1 = BasicConv2d(in_ch, 64, kernel_size=1)
+            self.branch5x5_1 = BasicConv2d(in_ch, 48, kernel_size=1)
+            self.branch5x5_2 = BasicConv2d(48, 64, kernel_size=5, padding=2)
+            self.branch3x3dbl_1 = BasicConv2d(in_ch, 64, kernel_size=1)
+            self.branch3x3dbl_2 = BasicConv2d(64, 96, kernel_size=3, padding=1)
+            self.branch3x3dbl_3 = BasicConv2d(96, 96, kernel_size=3, padding=1)
+            self.branch_pool = BasicConv2d(in_ch, pool_features, kernel_size=1)
+
+        def forward(self, x):
+            b1 = self.branch1x1(x)
+            b5 = self.branch5x5_2(self.branch5x5_1(x))
+            bd = self.branch3x3dbl_3(self.branch3x3dbl_2(self.branch3x3dbl_1(x)))
+            bp = self.branch_pool(_fid_avg_pool(x))
+            return torch.cat([b1, b5, bd, bp], 1)
+
+    class InceptionB(nn.Module):
+        def __init__(self, in_ch: int):
+            super().__init__()
+            self.branch3x3 = BasicConv2d(in_ch, 384, kernel_size=3, stride=2)
+            self.branch3x3dbl_1 = BasicConv2d(in_ch, 64, kernel_size=1)
+            self.branch3x3dbl_2 = BasicConv2d(64, 96, kernel_size=3, padding=1)
+            self.branch3x3dbl_3 = BasicConv2d(96, 96, kernel_size=3, stride=2)
+
+        def forward(self, x):
+            b3 = self.branch3x3(x)
+            bd = self.branch3x3dbl_3(self.branch3x3dbl_2(self.branch3x3dbl_1(x)))
+            bp = F.max_pool2d(x, kernel_size=3, stride=2)
+            return torch.cat([b3, bd, bp], 1)
+
+    class InceptionC(nn.Module):
+        def __init__(self, in_ch: int, channels_7x7: int):
+            super().__init__()
+            c7 = channels_7x7
+            self.branch1x1 = BasicConv2d(in_ch, 192, kernel_size=1)
+            self.branch7x7_1 = BasicConv2d(in_ch, c7, kernel_size=1)
+            self.branch7x7_2 = BasicConv2d(c7, c7, kernel_size=(1, 7), padding=(0, 3))
+            self.branch7x7_3 = BasicConv2d(c7, 192, kernel_size=(7, 1), padding=(3, 0))
+            self.branch7x7dbl_1 = BasicConv2d(in_ch, c7, kernel_size=1)
+            self.branch7x7dbl_2 = BasicConv2d(c7, c7, kernel_size=(7, 1), padding=(3, 0))
+            self.branch7x7dbl_3 = BasicConv2d(c7, c7, kernel_size=(1, 7), padding=(0, 3))
+            self.branch7x7dbl_4 = BasicConv2d(c7, c7, kernel_size=(7, 1), padding=(3, 0))
+            self.branch7x7dbl_5 = BasicConv2d(c7, 192, kernel_size=(1, 7), padding=(0, 3))
+            self.branch_pool = BasicConv2d(in_ch, 192, kernel_size=1)
+
+        def forward(self, x):
+            b1 = self.branch1x1(x)
+            b7 = self.branch7x7_3(self.branch7x7_2(self.branch7x7_1(x)))
+            bd = self.branch7x7dbl_5(
+                self.branch7x7dbl_4(self.branch7x7dbl_3(self.branch7x7dbl_2(self.branch7x7dbl_1(x))))
+            )
+            bp = self.branch_pool(_fid_avg_pool(x))
+            return torch.cat([b1, b7, bd, bp], 1)
+
+    class InceptionD(nn.Module):
+        def __init__(self, in_ch: int):
+            super().__init__()
+            self.branch3x3_1 = BasicConv2d(in_ch, 192, kernel_size=1)
+            self.branch3x3_2 = BasicConv2d(192, 320, kernel_size=3, stride=2)
+            self.branch7x7x3_1 = BasicConv2d(in_ch, 192, kernel_size=1)
+            self.branch7x7x3_2 = BasicConv2d(192, 192, kernel_size=(1, 7), padding=(0, 3))
+            self.branch7x7x3_3 = BasicConv2d(192, 192, kernel_size=(7, 1), padding=(3, 0))
+            self.branch7x7x3_4 = BasicConv2d(192, 192, kernel_size=3, stride=2)
+
+        def forward(self, x):
+            b3 = self.branch3x3_2(self.branch3x3_1(x))
+            b7 = self.branch7x7x3_4(self.branch7x7x3_3(self.branch7x7x3_2(self.branch7x7x3_1(x))))
+            bp = F.max_pool2d(x, kernel_size=3, stride=2)
+            return torch.cat([b3, b7, bp], 1)
+
+    class InceptionE(nn.Module):
+        """``pool``: 'avg' = FIDInceptionE_1 (Mixed_7b), 'max' = FIDInceptionE_2 (Mixed_7c)."""
+
+        def __init__(self, in_ch: int, pool: str):
+            super().__init__()
+            self.pool = pool
+            self.branch1x1 = BasicConv2d(in_ch, 320, kernel_size=1)
+            self.branch3x3_1 = BasicConv2d(in_ch, 384, kernel_size=1)
+            self.branch3x3_2a = BasicConv2d(384, 384, kernel_size=(1, 3), padding=(0, 1))
+            self.branch3x3_2b = BasicConv2d(384, 384, kernel_size=(3, 1), padding=(1, 0))
+            self.branch3x3dbl_1 = BasicConv2d(in_ch, 448, kernel_size=1)
+            self.branch3x3dbl_2 = BasicConv2d(448, 384, kernel_size=3, padding=1)
+            self.branch3x3dbl_3a = BasicConv2d(384, 384, kernel_size=(1, 3), padding=(0, 1))
+            self.branch3x3dbl_3b = BasicConv2d(384, 384, kernel_size=(3, 1), padding=(1, 0))
+            self.branch_pool = BasicConv2d(in_ch, 192, kernel_size=1)
+
+        def forward(self, x):
+            b1 = self.branch1x1(x)
+            b3 = self.branch3x3_1(x)
+            b3 = torch.cat([self.branch3x3_2a(b3), self.branch3x3_2b(b3)], 1)
+            bd = self.branch3x3dbl_2(self.branch3x3dbl_1(x))
+            bd = torch.cat([self.branch3x3dbl_3a(bd), self.branch3x3dbl_3b(bd)], 1)
+            if self.pool == "avg":
+                bp = _fid_avg_pool(x)
+            else:
+                bp = F.max_pool2d(x, kernel_size=3, stride=1, padding=1)
+            bp = self.branch_pool(bp)
+            return torch.cat([b1, b3, bd, bp], 1)
+
+    class FIDInceptionV3(nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.Conv2d_1a_3x3 = BasicConv2d(3, 32, kernel_size=3, stride=2)
+            self.Conv2d_2a_3x3 = BasicConv2d(32, 32, kernel_size=3)
+            self.Conv2d_2b_3x3 = BasicConv2d(32, 64, kernel_size=3, padding=1)
+            self.Conv2d_3b_1x1 = BasicConv2d(64, 80, kernel_size=1)
+            self.Conv2d_4a_3x3 = BasicConv2d(80, 192, kernel_size=3)
+            self.Mixed_5b = InceptionA(192, pool_features=32)
+            self.Mixed_5c = InceptionA(256, pool_features=64)
+            self.Mixed_5d = InceptionA(288, pool_features=64)
+            self.Mixed_6a = InceptionB(288)
+            self.Mixed_6b = InceptionC(768, channels_7x7=128)
+            self.Mixed_6c = InceptionC(768, channels_7x7=160)
+            self.Mixed_6d = InceptionC(768, channels_7x7=160)
+            self.Mixed_6e = InceptionC(768, channels_7x7=192)
+            self.Mixed_7a = InceptionD(768)
+            self.Mixed_7b = InceptionE(1280, pool="avg")
+            self.Mixed_7c = InceptionE(2048, pool="max")
+            self.fc = nn.Linear(2048, 1008)
+
+        def forward(self, x) -> Dict:
+            out: Dict = {}
+            x = self.Conv2d_1a_3x3(x)
+            x = self.Conv2d_2a_3x3(x)
+            x = self.Conv2d_2b_3x3(x)
+            x = F.max_pool2d(x, kernel_size=3, stride=2)
+            out[64] = x.mean(dim=(2, 3)).numpy()
+            x = self.Conv2d_3b_1x1(x)
+            x = self.Conv2d_4a_3x3(x)
+            x = F.max_pool2d(x, kernel_size=3, stride=2)
+            out[192] = x.mean(dim=(2, 3)).numpy()
+            x = self.Mixed_5b(x)
+            x = self.Mixed_5c(x)
+            x = self.Mixed_5d(x)
+            x = self.Mixed_6a(x)
+            x = self.Mixed_6b(x)
+            x = self.Mixed_6c(x)
+            x = self.Mixed_6d(x)
+            x = self.Mixed_6e(x)
+            out[768] = x.mean(dim=(2, 3)).numpy()
+            x = self.Mixed_7a(x)
+            x = self.Mixed_7b(x)
+            x = self.Mixed_7c(x)
+            pooled = x.mean(dim=(2, 3))
+            out[2048] = pooled.numpy()
+            out["logits"] = self.fc(pooled).numpy()
+            out["logits_unbiased"] = (pooled @ self.fc.weight.T).numpy()
+            return out
+
+    return FIDInceptionV3
+
+
+def module_forward(state_dict, imgs_uint8) -> Dict:
+    """Strict-load ``state_dict`` into the module graph and return every tap.
+
+    Same contract as ``torch_inception_fid.torch_forward``: ``imgs_uint8`` is
+    (N, 3, 299, 299) uint8, normalised x/255*2-1, taps keyed
+    64/192/768/2048/"logits"/"logits_unbiased".
+
+    ``strict=True`` is the point: a state dict whose shapes disagree anywhere
+    with the hard-coded architecture above raises before the forward runs.
+    """
+    import torch
+
+    net = _build_modules()()
+    net.eval()
+    sd = {
+        k: torch.as_tensor(np.asarray(v), dtype=torch.float32)
+        for k, v in state_dict.items()
+        if not k.startswith("AuxLogits.") and not k.endswith("num_batches_tracked")
+    }
+    # BatchNorm2d tracks num_batches_tracked in its state dict; the checkpoint
+    # layout (and the synthetic generator) may omit it — irrelevant in eval().
+    for k, v in net.state_dict().items():
+        if k.endswith("num_batches_tracked"):
+            sd[k] = v
+    net.load_state_dict(sd, strict=True)
+    with torch.no_grad():
+        x = torch.as_tensor(np.asarray(imgs_uint8), dtype=torch.float32) / 255.0 * 2.0 - 1.0
+        return net(x)
