@@ -33,6 +33,8 @@ _ONES_CACHE_MAX = 8
 # pairwise sum despite reading 2x the bytes (ones vector included); on a single
 # core the extra 4 MB read makes it strictly slower, so plain np.sum wins.
 # sched_getaffinity sees cgroup/taskset limits that os.cpu_count ignores.
+# Captured ONCE at import: if process affinity changes later (worker-pool
+# pinning, cgroup update) the heuristic goes stale — perf-only, never wrong.
 try:
     _SUM_VIA_DOT = len(os.sched_getaffinity(0)) > 1
 except AttributeError:  # platforms without sched_getaffinity
@@ -55,23 +57,26 @@ def _host_sum(x: "np.ndarray") -> "np.ndarray":
 _SCRATCH = threading.local()
 
 
-def _host_diff(t: "np.ndarray", p: "np.ndarray") -> "np.ndarray":
-    """``t - p`` into a reusable per-thread scratch buffer.
+def _host_diff_sums(
+    t: "np.ndarray", p: "np.ndarray", want_sum: bool = True
+) -> Tuple["np.ndarray", "np.ndarray"]:
+    """``(sum(t - p), sum((t - p)**2))`` via a reusable per-thread scratch buffer.
 
     A fresh 4 MB temporary per 1M-sample update is page-fault-bound (~0.5 ms —
-    half the whole r2 kernel); writing into a kept buffer pays only the memory
-    bandwidth after the first call at a given size. The returned view is only
-    valid until the next ``_host_diff`` call on the same thread, so callers
-    must reduce it (dot/sum) before computing another diff.
+    half the whole r2 kernel); writing the diff into a kept buffer pays only the
+    memory bandwidth after the first call at a given size. The scratch view is
+    reduced HERE and never escapes, so no caller can hold a view that the next
+    call silently invalidates. ``want_sum=False`` skips the plain-sum pass for
+    callers that only need the squared sum (r2), returning ``(None, dot)``.
     """
     n = t.shape[0]
     buf = getattr(_SCRATCH, "buf", None)
     if buf is None or buf.shape[0] < n:
         buf = np.empty(n, np.float32)
         _SCRATCH.buf = buf
-    out = buf[:n]
-    np.subtract(t, p, out=out)
-    return out
+    d = buf[:n]
+    np.subtract(t, p, out=d)
+    return (_host_sum(d) if want_sum else None), np.dot(d, d)
 
 
 # --------------------------------------------------------------------------- pearson
@@ -210,11 +215,11 @@ def _explained_variance_update(preds: Array, target: Array) -> Tuple[int, Array,
         # numpy scalars (no device put — _accumulate and the compute jit both
         # take them natively)
         t = np.asarray(target, np.float32)
-        d = _host_diff(t, np.asarray(preds, np.float32))
+        sum_d, dot_dd = _host_diff_sums(t, np.asarray(preds, np.float32))
         return (
             preds.shape[0],
-            _host_sum(d),
-            np.dot(d, d),
+            sum_d,
+            dot_dd,
             _host_sum(t),
             np.dot(t, t),
         )
@@ -294,11 +299,11 @@ def _r2_score_update(preds: Array, target: Array) -> Tuple[Array, Array, Array, 
         # numpy scalars (no device put — _accumulate and the compute jit both
         # take them natively)
         t = np.asarray(target, np.float32)
-        d = _host_diff(t, np.asarray(preds, np.float32))
+        _, dot_dd = _host_diff_sums(t, np.asarray(preds, np.float32), want_sum=False)
         return (
             np.dot(t, t),
             _host_sum(t),
-            np.dot(d, d),
+            dot_dd,
             target.shape[0],
         )
     sum_squared_obs, sum_obs, residual = _r2_kernel(preds, target)

@@ -63,6 +63,11 @@ _REDUCTION_FNS: Dict[str, Callable] = {
 _tree_add = jax.jit(lambda olds, news: jax.tree_util.tree_map(jnp.add, olds, news))
 
 _ZERO_STATE_CACHE: Dict[Any, Array] = {}
+# Entry-count cap with FIFO eviction: each entry is ≤4096 elements, but programs
+# constructing metrics over many distinct small shapes (varying num_classes /
+# n_bins) would otherwise grow the key set without bound. A miss after eviction
+# just falls back to jnp.zeros.
+_ZERO_STATE_CACHE_MAX = 256
 
 
 def zero_state(shape: Any = (), dtype: Any = None) -> Array:
@@ -94,6 +99,11 @@ def zero_state(shape: Any = (), dtype: Any = None) -> Array:
         return jnp.zeros(key[0], key[1])
     out = _ZERO_STATE_CACHE.get(key)
     if out is None:
+        if len(_ZERO_STATE_CACHE) >= _ZERO_STATE_CACHE_MAX:
+            try:  # tolerate a concurrent evictor winning the race for the same key
+                _ZERO_STATE_CACHE.pop(next(iter(_ZERO_STATE_CACHE)), None)
+            except (StopIteration, RuntimeError):
+                pass
         out = _ZERO_STATE_CACHE.setdefault(key, jnp.zeros(key[0], key[1]))
     return out
 

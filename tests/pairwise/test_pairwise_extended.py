@@ -112,3 +112,18 @@ def test_integer_inputs_upcast():
     expected = sk_linear(X.astype(np.float32), X.astype(np.float32)).astype(np.float64)
     np.fill_diagonal(expected, 0)
     np.testing.assert_allclose(res, expected, atol=1e-6)
+
+
+def test_euclidean_duplicate_rows_clamp_to_zero_not_nan():
+    """Pins the documented host-path deviation (similarity.py ``_host_pairwise``):
+    squared distances that round to a tiny NEGATIVE after the f64 expansion are
+    clamped to 0, where the reference takes sqrt(negative) -> NaN
+    (ref euclidean.py:34-40). Seed 9 deterministically produces sq ~ -3.7e-9
+    for the duplicated pair — without the clamp this asserts on NaN. Guards the
+    fuzz-parity tier from "fixing" the convention back to NaN unintentionally."""
+    rng = np.random.default_rng(9)
+    X = (rng.normal(size=(40, 16)) * 1e3).astype(np.float32)
+    X[7] = X[3]  # exact duplicate rows at large norm -> f64 cancellation goes negative
+    res = np.asarray(pairwise_euclidean_distance(jnp.asarray(X), zero_diagonal=False))
+    assert not np.isnan(res).any()
+    assert res[3, 7] == 0.0 and res[7, 3] == 0.0
