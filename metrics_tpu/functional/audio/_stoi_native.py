@@ -1,0 +1,279 @@
+"""Native jittable STOI/ESTOI (Taal et al. 2011; Jensen & Taal 2016).
+
+The reference wraps the C-backed ``pystoi`` pip package and refuses to run
+without it (ref src/torchmetrics/functional/audio/stoi.py:24, 75-79). STOI is
+~150 lines of pure DSP, so this framework implements it end-to-end in JAX —
+polyphase resample → hann STFT → 1/3-octave filterbank → silent-frame removal
+→ 30-frame segment correlation — every stage fixed-shape and mask-based, so
+the whole metric runs inside ``jax.jit`` on TPU (the same
+exceed-the-reference move as the native ``iou_type='segm'`` mAP vs
+pycocotools).
+
+Algorithm constants and step order follow the published algorithm and the
+pystoi reference implementation's conventions (MIT-licensed pystoi, Pariente;
+not installed in this image — conventions reproduced from the published
+algorithm description):
+
+- internal rate 10 kHz; frames of 256 with hop 128 under ``hanning(258)[1:-1]``
+- 512-point rfft; 15 one-third-octave bands from 150 Hz with edges
+  ``150·2^((2k∓1)/6)`` snapped to the nearest rfft bin
+- frames whose clean-signal energy is >40 dB below the loudest are removed and
+  the survivors overlap-added back together before the STFT
+- segments of N=30 frames; degraded segments are scaled to the clean segment's
+  band norm and clipped at ``(1+10^(15/20))·clean`` (BETA = −15 dB); the score
+  is the mean over bands and segments of the centred, normalised correlation
+- extended mode (ESTOI) replaces scale+clip with row- then column-mean/variance
+  normalisation of each segment block and averages ``Σ x̂·ŷ / N`` per segment
+
+Documented deviations from pystoi (each invisible at ≥1e-4 on the reference
+anchor, tests/audio/test_stoi_native.py):
+
+- float32 throughout (TPU-native) with ``EPS = finfo(float32).eps`` in guarded
+  divisions, where pystoi is float64 with f64 eps
+- ESTOI's normalisation does not add pystoi's ``EPS·randn`` dither (that jitter
+  is below f32 resolution and would make a jitted metric nondeterministic)
+- a signal with fewer than 30 post-removal frames returns 1e-5 like pystoi,
+  but the warning is only raisable on the eager path (inside jit the value is
+  selected by ``jnp.where``)
+
+The silent-frame machinery is the interesting TPU bit: pystoi drops frames by
+boolean indexing (data-dependent shapes). Here frames are stably permuted so
+survivors lead (``argsort`` of the drop mask), zeroed past the survivor count,
+overlap-added into a fixed-length buffer, and every downstream stage carries a
+segment-validity mask — identical numerics, static shapes.
+"""
+
+from __future__ import annotations
+
+import fractions
+import functools
+import warnings
+from typing import Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+FS = 10_000  # internal sample rate (Hz)
+N_FRAME = 256
+HOP = N_FRAME // 2
+NFFT = 512
+NUMBAND = 15
+MINFREQ = 150.0
+N_SEG = 30  # frames per intermediate-intelligibility segment
+BETA = -15.0  # lower SDR bound (dB)
+DYN_RANGE = 40.0  # silent-frame dynamic range (dB)
+EPS = float(np.finfo(np.float32).eps)
+TOO_SHORT_VALUE = 1e-5  # pystoi's sentinel when <N_SEG frames survive
+
+
+@functools.lru_cache(maxsize=None)
+def _third_octave_matrix() -> np.ndarray:
+    """(NUMBAND, NFFT//2+1) 0/1 band matrix with edges snapped to rfft bins."""
+    f = np.linspace(0, FS, NFFT + 1)[: NFFT // 2 + 1]
+    k = np.arange(NUMBAND, dtype=np.float64)
+    freq_low = MINFREQ * 2.0 ** ((2 * k - 1) / 6)
+    freq_high = MINFREQ * 2.0 ** ((2 * k + 1) / 6)
+    obm = np.zeros((NUMBAND, len(f)), np.float32)
+    for i in range(NUMBAND):
+        lo = int(np.argmin(np.square(f - freq_low[i])))
+        hi = int(np.argmin(np.square(f - freq_high[i])))
+        obm[i, lo:hi] = 1.0
+    return obm
+
+
+@functools.lru_cache(maxsize=None)
+def _hann() -> np.ndarray:
+    return np.hanning(N_FRAME + 2)[1:-1].astype(np.float32)
+
+
+def _octave_resample_window(up: int, down: int) -> np.ndarray:
+    """Octave-compatible anti-aliasing FIR (the resampler STOI was defined with).
+
+    Standard Kaiser-design-by-formula lowpass (Oppenheim/Schafer): 60 dB
+    stopband rejection, cutoff ``1/(2·max(up, down))``, roll-off width a tenth
+    of the cutoff, ideal-sinc prototype apodised by the β-formula Kaiser
+    window. This is deliberately NOT scipy's default resample_poly filter —
+    STOI's published reference values assume the Octave/MATLAB ``resample``
+    filter, and the two differ enough to move scores by ~2e-4.
+    """
+    rejection_db = 60.0
+    cutoff = 1.0 / (2.0 * max(up, down))
+    roll_off_width = cutoff / 10.0
+    half_len = int(np.ceil((rejection_db - 8.0) / (28.714 * roll_off_width)))
+    t = np.arange(-half_len, half_len + 1)
+    ideal = 2 * up * cutoff * np.sinc(2 * cutoff * t)
+    beta = 0.1102 * (rejection_db - 8.7)
+    return np.kaiser(2 * half_len + 1, beta) * ideal
+
+
+@functools.lru_cache(maxsize=None)
+def _resample_plan(fs: int) -> Tuple[np.ndarray, int, int, int, int]:
+    """(flipped padded FIR, up, down, n_pre_remove, len_h) for fs -> 10 kHz.
+
+    Filter: the Octave-compatible window above, unit-sum normalised then
+    scaled by ``up`` — numerically what ``scipy.signal.resample_poly(x, up,
+    down, window=octave_window/sum)`` applies. Centring mirrors scipy's
+    zero-pre-pad so the polyphase phase matches; parity vs scipy is asserted
+    in tests/audio/test_stoi_native.py.
+    """
+    frac = fractions.Fraction(FS, int(fs))
+    up, down = frac.numerator, frac.denominator
+    h = _octave_resample_window(up, down).astype(np.float64)
+    h = h / np.sum(h)
+    half_len = (len(h) - 1) // 2
+    h = h * up
+    n_pre_pad = down - half_len % down
+    n_pre_remove = (half_len + n_pre_pad) // down
+    h = np.concatenate([np.zeros(n_pre_pad), h])
+    # conv_general_dilated correlates; flip to convolve
+    return h[::-1].astype(np.float32).copy(), up, down, n_pre_remove, len(h)
+
+
+def _resample_to_10k(x: Array, fs: int) -> Array:
+    """Polyphase resample (..., T) -> (..., ceil(T*up/down)), scipy-equivalent."""
+    if fs == FS:
+        return x
+    h, up, down, n_pre_remove, len_h = _resample_plan(fs)
+    n_in = x.shape[-1]
+    n_out = -(-n_in * up // down)
+    lead = x.shape[:-1]
+    lhs = x.reshape((-1, 1, n_in)).astype(jnp.float32)
+    # upfirdn(h, x, up, down) == strided full correlation of the zero-stuffed
+    # input with the (flipped) filter; lhs_dilation does the zero-stuffing
+    # without materialising it
+    out = jax.lax.conv_general_dilated(
+        lhs,
+        jnp.asarray(h).reshape((1, 1, len_h)),
+        window_strides=(down,),
+        padding=[(len_h - 1, len_h - 1)],
+        lhs_dilation=(up,),
+    )[:, 0, :]
+    # the dilated input ends at the last real sample, so any strided-output
+    # positions past it are exactly zero (scipy reaches them via n_post_pad)
+    avail = ((n_in - 1) * up + len_h - 1) // down + 1
+    short = n_pre_remove + n_out - avail
+    if short > 0:
+        out = jnp.pad(out, ((0, 0), (0, short)))
+    return out[..., n_pre_remove : n_pre_remove + n_out].reshape(lead + (n_out,))
+
+
+def _frame(x: Array) -> Array:
+    """(..., T) -> (..., M, N_FRAME) hop-128 frames.
+
+    Frame starts are ``range(0, T - N_FRAME, HOP)`` — an EXCLUSIVE stop, as in
+    the reference pystoi implementation, so a frame ending exactly at T is
+    dropped. The reference STOI values embody this convention (the post-OLA
+    spectrogram always ends on an exact boundary, so it always loses its final
+    frame); matching it is worth ~1.5e-4 on the published anchor.
+    """
+    n_frames = max((x.shape[-1] - N_FRAME + HOP - 1) // HOP, 0)
+    idx = np.arange(n_frames)[:, None] * HOP + np.arange(N_FRAME)[None, :]
+    return x[..., idx]
+
+
+def _overlap_add(frames: Array) -> Array:
+    """(M, N_FRAME) hop-128 frames -> ((M+1)*HOP,) signal via scatter-free OLA."""
+    m = frames.shape[0]
+    halves = frames.reshape(m, 2, HOP)
+    slots = jnp.zeros((m + 1, HOP), frames.dtype)
+    slots = slots.at[:m].add(halves[:, 0, :])
+    slots = slots.at[1 : m + 1].add(halves[:, 1, :])
+    return slots.reshape(-1)
+
+
+def _stoi_pair(x: Array, y: Array, extended: bool) -> Array:
+    """STOI of one (clean x, degraded y) pair, both already at 10 kHz, 1-D."""
+    w = jnp.asarray(_hann())
+    x_frames = _frame(x) * w
+    y_frames = _frame(y) * w
+    m = x_frames.shape[0]
+    # the re-framed post-OLA signal statically yields m-1 spectral frames
+    # (exact-alignment frame drop, see _frame); segments need N_SEG of those
+    if m - 1 < N_SEG:
+        # statically too short for even one segment: pystoi warns and returns
+        # the sentinel at runtime; here the shape already proves it
+        warnings.warn(
+            "Not enough STFT segments to compute intermediate intelligibility measure; returning 1e-5",
+            RuntimeWarning,
+            stacklevel=4,
+        )
+        return jnp.float32(TOO_SHORT_VALUE)
+
+    # ---- silent-frame removal (mask/permute form of pystoi's boolean indexing)
+    energies = 20.0 * jnp.log10(jnp.linalg.norm(x_frames, axis=1) + EPS)
+    keep = energies > (jnp.max(energies) - DYN_RANGE)
+    n_kept = jnp.sum(keep)
+    order = jnp.argsort(~keep, stable=True)  # survivors first, original order
+    valid_frame = jnp.arange(m) < n_kept
+    x_kept = x_frames[order] * valid_frame[:, None]
+    y_kept = y_frames[order] * valid_frame[:, None]
+    x_sil = _overlap_add(x_kept)
+    y_sil = _overlap_add(y_kept)
+
+    # ---- 1/3-octave band spectrogram (frames k >= n_kept are zero/garbage and
+    # masked out at the segment stage)
+    x_spec = jnp.fft.rfft(_frame(x_sil) * w, n=NFFT)
+    y_spec = jnp.fft.rfft(_frame(y_sil) * w, n=NFFT)
+    obm = jnp.asarray(_third_octave_matrix())
+    x_tob = jnp.sqrt((jnp.abs(x_spec) ** 2) @ obm.T).T  # (NUMBAND, M)
+    y_tob = jnp.sqrt((jnp.abs(y_spec) ** 2) @ obm.T).T
+
+    # ---- N_SEG-frame segments with validity mask. The OLA signal really ends
+    # after n_kept+1 half-frames, so its spectrogram has n_kept-1 valid frames
+    # (the last aligned frame is dropped, as in the reference implementation)
+    # and n_kept-N_SEG valid segments.
+    n_segments = x_tob.shape[1] - N_SEG + 1  # static upper bound (= m-1-N_SEG+1)
+    seg_idx = np.arange(n_segments)[:, None] + np.arange(N_SEG)[None, :]
+    x_seg = x_tob[:, seg_idx]  # (NUMBAND, S, N_SEG)
+    y_seg = y_tob[:, seg_idx]
+    n_valid = jnp.maximum(n_kept - N_SEG, 0)
+    valid_seg = (jnp.arange(n_segments) < n_valid)[None, :, None]
+
+    if extended:
+
+        def row_col_normalize(z):
+            z = z - jnp.mean(z, axis=-1, keepdims=True)
+            z = z / (jnp.linalg.norm(z, axis=-1, keepdims=True) + EPS)
+            z = z - jnp.mean(z, axis=0, keepdims=True)
+            return z / (jnp.linalg.norm(z, axis=0, keepdims=True) + EPS)
+
+        x_n = row_col_normalize(x_seg)
+        y_n = row_col_normalize(y_seg)
+        d = jnp.sum(x_n * y_n * valid_seg) / (N_SEG * jnp.maximum(n_valid, 1))
+    else:
+        norm_x = jnp.linalg.norm(x_seg, axis=-1, keepdims=True)
+        norm_y = jnp.linalg.norm(y_seg, axis=-1, keepdims=True)
+        clip_value = 10.0 ** (-BETA / 20.0)
+        y_prime = jnp.minimum(y_seg * norm_x / (norm_y + EPS), x_seg * (1.0 + clip_value))
+        xc = x_seg - jnp.mean(x_seg, axis=-1, keepdims=True)
+        yc = y_prime - jnp.mean(y_prime, axis=-1, keepdims=True)
+        xc = xc / (jnp.linalg.norm(xc, axis=-1, keepdims=True) + EPS)
+        yc = yc / (jnp.linalg.norm(yc, axis=-1, keepdims=True) + EPS)
+        corr = jnp.sum(xc * yc * valid_seg, axis=-1)  # (NUMBAND, S)
+        d = jnp.sum(corr) / (NUMBAND * jnp.maximum(n_valid, 1))
+
+    return jnp.where(n_valid > 0, d, jnp.float32(TOO_SHORT_VALUE)).astype(jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("fs", "extended"))
+def _stoi_batch(preds: Array, target: Array, fs: int, extended: bool) -> Array:
+    """(..., T) batched native STOI; clean reference is ``target``."""
+    lead = preds.shape[:-1]
+    p = _resample_to_10k(preds.reshape((-1, preds.shape[-1])).astype(jnp.float32), fs)
+    t = _resample_to_10k(target.reshape((-1, target.shape[-1])).astype(jnp.float32), fs)
+    vals = jax.vmap(lambda xt, yp: _stoi_pair(xt, yp, extended))(t, p)
+    return vals.reshape(lead)
+
+
+def native_stoi(preds: Array, target: Array, fs: int, extended: bool = False) -> Array:
+    """Batched native STOI with the module-level constants above.
+
+    ``preds``/``target``: (..., time). Returns shape ``preds.shape[:-1]``
+    (0-d for 1-D inputs), float32, on the default device.
+    """
+    if fs <= 0 or not float(fs).is_integer():
+        raise ValueError(f"fs must be a positive integer sample rate, got {fs}")
+    return _stoi_batch(preds, target, int(fs), bool(extended))

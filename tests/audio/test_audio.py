@@ -285,11 +285,15 @@ def test_pesq_stoi_gating():
         with pytest.raises(ModuleNotFoundError, match="pesq"):
             PerceptualEvaluationSpeechQuality(fs=16000, mode="wb")
 
+    # STOI's default backend is now native JAX (zero optional deps), so the
+    # default constructor must ALWAYS succeed; the reference's gated behavior
+    # survives behind backend="pystoi".
+    ShortTimeObjectiveIntelligibility(fs=16000)
     if _PYSTOI_AVAILABLE:
-        ShortTimeObjectiveIntelligibility(fs=16000)
+        ShortTimeObjectiveIntelligibility(fs=16000, backend="pystoi")
     else:
         with pytest.raises(ModuleNotFoundError, match="pystoi"):
-            ShortTimeObjectiveIntelligibility(fs=16000)
+            ShortTimeObjectiveIntelligibility(fs=16000, backend="pystoi")
 
 
 def test_pesq_gate_precedes_arg_validation():

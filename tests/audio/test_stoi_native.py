@@ -1,0 +1,153 @@
+"""Native JAX STOI/ESTOI: published-anchor parity, DSP-stage oracles, jit/shard.
+
+The pystoi package is not installed in this image, so the strongest available
+oracle is the reference's own doctest value (ref
+src/torchmetrics/functional/audio/stoi.py:66-70): seeded torch inputs through
+REAL pystoi produced ``tensor(-0.0100)`` — reproducing those exact inputs here
+and matching that value end-to-end exercises the resampler, framing, silent
+-frame removal, third-octave bands and segment correlation in one assertion.
+Each DSP stage also has an independent oracle: scipy for the polyphase
+resampler, the published band-edge formula for the filterbank.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.audio import ShortTimeObjectiveIntelligibility
+from metrics_tpu.functional.audio import short_time_objective_intelligibility
+from metrics_tpu.functional.audio._stoi_native import (
+    _octave_resample_window,
+    _resample_to_10k,
+    _third_octave_matrix,
+    native_stoi,
+)
+
+
+def test_reference_doctest_anchor():
+    """torch.manual_seed(1); randn(8000) x2; fs=8000 → pystoi gave -0.0100
+    (displayed at 4 decimals, so the true value lies in [-0.01005, -0.00995]).
+    The native value must round to the same 4 decimals."""
+    torch = pytest.importorskip("torch")
+    torch.manual_seed(1)
+    preds = torch.randn(8000).numpy()
+    target = torch.randn(8000).numpy()
+    val = float(native_stoi(jnp.asarray(preds), jnp.asarray(target), 8000))
+    assert round(val, 4) == -0.0100
+    # and through the public functional API (default backend)
+    val2 = float(short_time_objective_intelligibility(jnp.asarray(preds), jnp.asarray(target), 8000))
+    assert val2 == pytest.approx(val)
+
+
+def test_resampler_matches_scipy_octave_window():
+    """The jax polyphase path == scipy.resample_poly with the octave window."""
+    from fractions import Fraction
+
+    from scipy.signal import resample_poly
+
+    rng = np.random.default_rng(0)
+    for fs in [8000, 16000, 11025, 44100]:
+        x = rng.normal(size=3000)
+        up, down = Fraction(10000, fs).as_integer_ratio()
+        w = _octave_resample_window(up, down)
+        want = resample_poly(x, up, down, window=w / np.sum(w))
+        got = np.asarray(_resample_to_10k(jnp.asarray(x, jnp.float32), fs))
+        assert got.shape == want.shape
+        np.testing.assert_allclose(got, want, atol=2e-6)
+
+
+def test_third_octave_matrix_band_edges():
+    """15 bands from 150 Hz, edges 150·2^((2k∓1)/6) snapped to rfft bins; bands
+    are disjoint, contiguous in frequency, and centred at 150·2^(k/3)."""
+    obm = _third_octave_matrix()
+    assert obm.shape == (15, 257)
+    f = np.linspace(0, 10000, 513)[:257]
+    assert (obm.sum(axis=0) <= 1).all()  # disjoint
+    for k in range(15):
+        bins = np.flatnonzero(obm[k])
+        assert bins.size > 0 and (np.diff(bins) == 1).all()  # contiguous
+        cf = 150 * 2 ** (k / 3)
+        assert f[bins[0]] <= cf <= f[bins[-1]] + (f[1] - f[0])
+
+
+def test_identity_is_one_and_batch_shapes():
+    rng = np.random.default_rng(1)
+    sig = rng.normal(size=(2, 3, 12000)).astype(np.float32)
+    out = native_stoi(jnp.asarray(sig), jnp.asarray(sig), 10000)
+    assert out.shape == (2, 3)
+    np.testing.assert_allclose(np.asarray(out), 1.0, atol=1e-5)
+    out_e = native_stoi(jnp.asarray(sig), jnp.asarray(sig), 10000, extended=True)
+    np.testing.assert_allclose(np.asarray(out_e), 1.0, atol=1e-5)
+
+
+def test_monotone_in_snr():
+    rng = np.random.default_rng(2)
+    t = np.arange(30000) / 10000
+    clean = (np.sin(2 * np.pi * 440 * t) * (0.5 + 0.5 * np.sin(2 * np.pi * 3 * t))).astype(np.float32)
+    noise = rng.normal(size=30000).astype(np.float32)
+    vals = []
+    for snr in [20, 10, 0, -10]:
+        noisy = clean + noise * np.linalg.norm(clean) / np.linalg.norm(noise) * 10 ** (-snr / 20)
+        vals.append(float(native_stoi(jnp.asarray(noisy), jnp.asarray(clean), 10000)))
+    assert all(a > b for a, b in zip(vals, vals[1:])), vals
+
+
+def test_silent_frames_are_removed():
+    """Padding the signals with silence must not change the score (the silent
+    frames are dropped before the band analysis, ref pystoi behavior)."""
+    rng = np.random.default_rng(3)
+    clean = rng.normal(size=12000).astype(np.float32)
+    noisy = clean + 0.3 * rng.normal(size=12000).astype(np.float32)
+    base = float(native_stoi(jnp.asarray(noisy), jnp.asarray(clean), 10000))
+    pad = np.zeros(2560, np.float32)
+    clean_p = np.concatenate([pad, clean, pad])
+    noisy_p = np.concatenate([pad, noisy, pad])
+    padded = float(native_stoi(jnp.asarray(noisy_p), jnp.asarray(clean_p), 10000))
+    assert padded == pytest.approx(base, abs=2e-3)
+
+
+def test_too_short_returns_sentinel():
+    rng = np.random.default_rng(4)
+    sig = rng.normal(size=1000).astype(np.float32)  # < 31 frames at 10 kHz
+    with pytest.warns(RuntimeWarning, match="1e-5"):
+        val = float(native_stoi(jnp.asarray(sig), jnp.asarray(sig), 10000))
+    assert val == pytest.approx(1e-5)
+
+
+def test_runs_inside_jit_and_grad_free_path():
+    """The whole metric (resample included) compiles into a single jit graph."""
+    rng = np.random.default_rng(5)
+    clean = rng.normal(size=(2, 16000)).astype(np.float32)
+    noisy = clean + 0.5 * rng.normal(size=(2, 16000)).astype(np.float32)
+
+    @jax.jit
+    def fused(p, t):
+        return native_stoi(p, t, 16000) * 1.0
+
+    out = np.asarray(fused(jnp.asarray(noisy), jnp.asarray(clean)))
+    want = np.asarray(native_stoi(jnp.asarray(noisy), jnp.asarray(clean), 16000))
+    np.testing.assert_allclose(out, want, rtol=1e-6)
+
+
+def test_module_streaming_mean():
+    rng = np.random.default_rng(6)
+    m = ShortTimeObjectiveIntelligibility(fs=10000)
+    vals = []
+    for _ in range(3):
+        clean = rng.normal(size=(2, 12000)).astype(np.float32)
+        noisy = clean + 0.4 * rng.normal(size=(2, 12000)).astype(np.float32)
+        m.update(jnp.asarray(noisy), jnp.asarray(clean))
+        vals.append(np.asarray(native_stoi(jnp.asarray(noisy), jnp.asarray(clean), 10000)))
+    want = np.concatenate([v.reshape(-1) for v in vals]).mean()
+    assert float(m.compute()) == pytest.approx(float(want), rel=1e-5)
+
+
+def test_extended_differs_from_plain():
+    rng = np.random.default_rng(7)
+    clean = rng.normal(size=20000).astype(np.float32)
+    noisy = clean + 0.5 * rng.normal(size=20000).astype(np.float32)
+    plain = float(native_stoi(jnp.asarray(noisy), jnp.asarray(clean), 10000))
+    ext = float(native_stoi(jnp.asarray(noisy), jnp.asarray(clean), 10000, extended=True))
+    assert plain != pytest.approx(ext, abs=1e-4)

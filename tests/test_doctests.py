@@ -26,9 +26,7 @@ _SKIP_MODULES = {
     "metrics_tpu.image.lpip",
     "metrics_tpu.functional.image.lpip",
     "metrics_tpu.audio.pesq",
-    "metrics_tpu.audio.stoi",
     "metrics_tpu.functional.audio.pesq",
-    "metrics_tpu.functional.audio.stoi",
     "metrics_tpu.text.bert",
     "metrics_tpu.functional.text.bert",
     "metrics_tpu.text.infolm",
