@@ -23,7 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu.metric import Metric
-from metrics_tpu.utils.data import _flatten_dict, allclose
+from metrics_tpu.utils.data import _flatten_dict
 from metrics_tpu.utils.prints import rank_zero_warn
 
 
@@ -142,8 +142,78 @@ class MetricCollection:
                         )
             self._groups_checked = True
         else:
-            # Initial state: every metric is its own group; merged after first update
-            self._groups = {i: [name] for i, name in enumerate(self._modules)}
+            # Structural fast path (SURVEY §7.2 step 4): metrics sharing the
+            # same update code, the same non-state config, and the same state
+            # spec provably evolve identical states (update is a pure function
+            # of config, inputs and prior state), so they are seeded into one
+            # group here and the ported runtime value comparison
+            # (_merge_compute_groups) only has to arbitrate the remaining
+            # leaders — e.g. metrics of different classes whose states happen
+            # to coincide in value, which the reference also merges. Seeding is
+            # strictly a subset of what the runtime comparison would merge, so
+            # group membership is identical to the reference's; only the
+            # number of first-update allclose dispatches shrinks.
+            groups: List[List[str]] = []
+            for name in self._modules:
+                m = self._modules[name]
+                for g in groups:
+                    if self._structurally_identical(self._modules[g[0]], m):
+                        g.append(name)
+                        break
+                else:
+                    groups.append([name])
+            self._groups = dict(enumerate(groups))
+
+    @staticmethod
+    def _structurally_identical(m1: Metric, m2: Metric) -> bool:
+        """True only when ``m1`` and ``m2`` provably produce equal states.
+
+        Criteria: identical ``update`` function (class-level, not the
+        per-instance forward wrapper), non-empty identical state specs (names,
+        list-vs-array kind, default shapes/dtypes/values, reduce fx) and equal
+        public config attributes. Callable config that is not the same object
+        is conservatively treated as different; anything unrecognisable keeps
+        the metrics apart — a false negative only costs a runtime comparison.
+        """
+        if type(m1).update is not type(m2).update:
+            return False
+        if len(m1._defaults) == 0 or m1._defaults.keys() != m2._defaults.keys():
+            return False
+        for key in m1._defaults:
+            d1, d2 = m1._defaults[key], m2._defaults[key]
+            r1 = getattr(m1, "_reductions", {}).get(key)
+            r2 = getattr(m2, "_reductions", {}).get(key)
+            if r1 is not r2 and r1 != r2:
+                return False
+            if isinstance(d1, list) or isinstance(d2, list):
+                if not (isinstance(d1, list) and isinstance(d2, list) and d1 == d2):
+                    return False
+                continue
+            if d1 is d2:  # shared zero_state buffers — the common case
+                continue
+            if getattr(d1, "shape", None) != getattr(d2, "shape", None) or getattr(d1, "dtype", None) != getattr(
+                d2, "dtype", None
+            ):
+                return False
+            if not np.array_equal(np.asarray(d1), np.asarray(d2)):
+                return False
+        skip = set(m1._defaults) | {"update", "compute"}
+        keys1 = {k for k in m1.__dict__ if not k.startswith("_") and k not in skip}
+        keys2 = {k for k in m2.__dict__ if not k.startswith("_") and k not in skip}
+        if keys1 != keys2:
+            return False
+        for k in keys1:
+            a, b = m1.__dict__[k], m2.__dict__[k]
+            if a is b:
+                continue
+            if callable(a) or callable(b):
+                return False
+            try:
+                if not bool(a == b):
+                    return False
+            except Exception:  # noqa: BLE001 — uncomparable config: keep apart
+                return False
+        return True
 
     # ------------------------------------------------------------------ dict protocol
 
@@ -236,19 +306,27 @@ class MetricCollection:
         # numpy scalars/arrays appear as states on the eager host paths; they
         # compare interchangeably with jax arrays (value comparison, not type)
         array_like = (jax.Array, np.ndarray, np.generic)
+
+        def _host_allclose(a, b) -> bool:
+            # formation-round states are small; comparing on the host replaces
+            # several eager device dispatches per pair (~200µs each on the
+            # degraded CPU path) with a copy + np.allclose (~µs). Same
+            # semantics as utils.data.allclose (NaN != NaN, as the reference).
+            return bool(np.allclose(np.asarray(a), np.asarray(b)))
+
         for key in metric1._defaults:
             state1 = getattr(metric1, key)
             state2 = getattr(metric2, key)
             if isinstance(state1, array_like) and isinstance(state2, array_like):
                 if state1.shape != state2.shape or state1.dtype != state2.dtype:
                     return False
-                if not allclose(state1, state2):
+                if not _host_allclose(state1, state2):
                     return False
             elif isinstance(state1, list) and isinstance(state2, list):
                 if len(state1) != len(state2):
                     return False
                 if not all(
-                    s1.shape == s2.shape and allclose(s1, s2) for s1, s2 in zip(state1, state2)
+                    s1.shape == s2.shape and _host_allclose(s1, s2) for s1, s2 in zip(state1, state2)
                 ):
                     return False
             else:

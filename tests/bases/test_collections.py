@@ -292,3 +292,54 @@ def test_compute_group_members_stay_correct_after_items():
 def test_collection_repr_contains_members():
     coll = MetricCollection([MulticlassAccuracy(NUM_CLASSES, validate_args=False)])
     assert "MulticlassAccuracy" in repr(coll)
+
+
+def test_structural_groups_seeded_before_first_update():
+    """VERDICT r4 item 5: same-update-fn/same-config metrics are grouped at
+    construction (state-spec equality), before any update runs — the O(n²)
+    runtime value comparison then only arbitrates the remaining leaders."""
+    mc = MetricCollection(
+        [
+            MulticlassPrecision(NUM_CLASSES, average="macro"),
+            MulticlassRecall(NUM_CLASSES, average="macro"),
+            MulticlassF1Score(NUM_CLASSES, average="macro"),
+            MulticlassConfusionMatrix(NUM_CLASSES),
+        ]
+    )
+    assert not mc._groups_checked  # formation round hasn't happened
+    groups = {tuple(sorted(v)) for v in mc._groups.values()}
+    # Precision/Recall share update fn + config -> seeded together. F1 carries
+    # an extra `beta` config attr, so the conservative structural check leaves
+    # it for the runtime merge (test_compute_groups_formed proves the merge
+    # completes the trio after the first update).
+    assert tuple(sorted(["MulticlassPrecision", "MulticlassRecall"])) in groups
+    assert ("MulticlassF1Score",) in groups
+    assert ("MulticlassConfusionMatrix",) in groups
+    # differing config must keep metrics apart structurally
+    mc2 = MetricCollection(
+        {
+            "macro": MulticlassPrecision(NUM_CLASSES, average="macro"),
+            "micro": MulticlassPrecision(NUM_CLASSES, average="micro"),
+        }
+    )
+    assert all(len(v) == 1 for v in mc2._groups.values())
+
+
+def test_runtime_merge_still_groups_value_equal_states():
+    """Metrics with DIFFERENT update code whose states coincide in value are
+    still merged by the ported runtime comparison (reference behavior) — the
+    structural seeding must not replace that path."""
+
+    class SumA(DummyMetricSum):
+        def update(self, x):
+            self.x = self.x + x
+
+    class SumB(DummyMetricSum):
+        def update(self, x):
+            self.x = x + self.x  # different function object, same trajectory
+
+    mc = MetricCollection({"a": SumA(), "b": SumB()})
+    assert all(len(v) == 1 for v in mc._groups.values())  # structurally apart
+    mc.update(jnp.asarray(2.0))
+    groups = {tuple(sorted(v)) for v in mc.compute_groups.values()}
+    assert ("a", "b") in groups  # runtime value comparison merged them
