@@ -14,6 +14,7 @@ import jax.numpy as jnp
 from jax import Array
 
 from metrics_tpu.functional.classification.precision_recall_curve import (
+    _exact_mode_filter,
     Thresholds,
     _adjust_threshold_arg,
     _binary_precision_recall_curve_arg_validation,
@@ -33,7 +34,6 @@ from metrics_tpu.functional.classification.precision_recall_curve import (
     _multilabel_precision_recall_curve_update,
 )
 from metrics_tpu.metric import Metric, zero_state
-from metrics_tpu.utils.checks import _value_check_possible
 from metrics_tpu.utils.data import dim_zero_cat
 from metrics_tpu.utils.enums import ClassificationTask
 
@@ -89,8 +89,10 @@ class BinaryPrecisionRecallCurve(Metric):
             _binary_precision_recall_curve_tensor_validation(preds, target, self.ignore_index)
         preds, target, _, mask = _binary_precision_recall_curve_format(preds, target, self.thresholds, self.ignore_index)
         if self.thresholds is None:
-            if self.ignore_index is not None and _value_check_possible(mask):
-                preds, target = preds[mask], target[mask]
+            # eager: filter like the reference; in-trace: static-shape sentinel
+            # fill that the host compute drops (_binary_clf_curve) — previously
+            # a traced update silently kept ignored rows as negatives
+            preds, target = _exact_mode_filter(preds, target, None, self.ignore_index, mask)
             self.preds.append(preds)
             self.target.append(target)
         else:
@@ -152,8 +154,8 @@ class MulticlassPrecisionRecallCurve(Metric):
             preds, target, self.num_classes, self.thresholds, self.ignore_index
         )
         if self.thresholds is None:
-            if self.ignore_index is not None and _value_check_possible(mask):
-                preds, target = preds[mask], target[mask]
+            # see BinaryPrecisionRecallCurve.update on the sentinel path
+            preds, target = _exact_mode_filter(preds, target, None, self.ignore_index, mask)
             self.preds.append(preds)
             self.target.append(target)
         else:

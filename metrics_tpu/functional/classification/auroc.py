@@ -15,6 +15,7 @@ from jax import Array
 from metrics_tpu.functional.classification.precision_recall_curve import (
     Thresholds,
     _exact_mode_filter,
+    _exact_target_for_weights,
     _binary_precision_recall_curve_arg_validation,
     _binary_precision_recall_curve_format,
     _binary_precision_recall_curve_tensor_validation,
@@ -121,7 +122,7 @@ def _multiclass_auroc_compute(
 ) -> Array:
     fpr, tpr, _ = _multiclass_roc_compute(state, num_classes, thresholds)
     if isinstance(state, tuple):
-        weights = jnp.bincount(jnp.asarray(state[1]), length=num_classes).astype(jnp.float32)
+        weights = jnp.bincount(_exact_target_for_weights(state), length=num_classes).astype(jnp.float32)
     else:
         weights = (state[0, :, 1, 0] + state[0, :, 1, 1]).astype(jnp.float32)
     return _reduce_auroc(fpr, tpr, average, weights=weights)

@@ -14,6 +14,7 @@ from jax import Array
 from metrics_tpu.functional.classification.precision_recall_curve import (
     Thresholds,
     _exact_mode_filter,
+    _exact_target_for_weights,
     _binary_precision_recall_curve_arg_validation,
     _binary_precision_recall_curve_compute,
     _binary_precision_recall_curve_format,
@@ -98,7 +99,7 @@ def _multiclass_average_precision_compute(
 ) -> Array:
     precision, recall, _ = _multiclass_precision_recall_curve_compute(state, num_classes, thresholds)
     if isinstance(state, tuple):
-        weights = jnp.bincount(jnp.asarray(state[1]), length=num_classes).astype(jnp.float32)
+        weights = jnp.bincount(_exact_target_for_weights(state), length=num_classes).astype(jnp.float32)
     else:
         weights = (state[0, :, 1, 0] + state[0, :, 1, 1]).astype(jnp.float32)
     return _reduce_average_precision(precision, recall, average, weights=weights)

@@ -77,6 +77,23 @@ def _binary_clf_curve(
     """
     if sample_weights is not None and not isinstance(sample_weights, Array):
         sample_weights = jnp.asarray(sample_weights, dtype=jnp.float32)
+    if not _value_check_possible(preds):
+        raise RuntimeError(
+            "Exact-mode (thresholds=None) curve COMPUTE cannot run inside jit: the number"
+            " of distinct thresholds is data-dependent. Pass `thresholds=...` for the"
+            " binned, fully jit-native mode — or keep only compute on the host: the"
+            " module API's `update_state`/`sync_state` (including `ignore_index`, which"
+            " is sentinel-masked at static shape) can stay fused; run `compute_from`"
+            " eagerly."
+        )
+    # drop sentinel-marked (in-jit ignore_index) rows; host-side boolean
+    # indexing is fine here — exact compute never runs under a tracer
+    keep = preds != _EXACT_IGNORE_SENTINEL
+    if not bool(keep.all()):
+        preds = preds[keep]
+        target = target[keep]
+        if sample_weights is not None:
+            sample_weights = sample_weights[keep]
     order = jnp.argsort(preds)[::-1]
     preds = preds[order]
     target = target[order]
@@ -103,22 +120,48 @@ def _adjust_threshold_arg(thresholds: Thresholds = None) -> Optional[Array]:
 
 
 
-def _exact_mode_filter(preds, target, thresholds, ignore_index, mask):
-    """Apply the ignore_index filter for exact mode, or raise inside jit.
+# Exact-mode ignore marker: formatted preds are probabilities in [0, 1]
+# (sigmoid/softmax applied in the *_format helpers), so -1 can never collide
+# with a real score.
+_EXACT_IGNORE_SENTINEL = -1.0
 
-    Exact mode's filtering is data-dependent; running it under a tracer would
-    silently count ignored samples as negatives, so it is an explicit error —
-    the binned mode (``thresholds=...``) is the jit-native path.
+
+def _exact_mode_filter(preds, target, thresholds, ignore_index, mask):
+    """Apply the ignore_index filter for exact mode; sentinel-fill inside jit.
+
+    Eagerly the ignored rows are boolean-filtered out, exactly like the
+    reference. Under a tracer that filter is data-dependent, so instead the
+    ignored rows are kept at static shape with their scores overwritten by
+    ``_EXACT_IGNORE_SENTINEL`` (a 0-weight marker outside the probability
+    range); the host-side exact compute (``_binary_clf_curve``) drops sentinel
+    rows before sorting, so the fused update runs in-trace and the computed
+    curve is identical to the filtered one (SURVEY §7.1: "implement
+    ignore_index as a 0-weight mask").
+
+    For 2-D ``preds`` (multiclass one-vs-rest layout) the (N,)-mask ignores
+    whole rows.
     """
     if thresholds is None and ignore_index is not None:
         if not _value_check_possible(mask):
-            raise RuntimeError(
-                "Exact-mode (thresholds=None) curve metrics with `ignore_index` cannot run"
-                " inside jit: the filter is data-dependent. Pass `thresholds` to use the"
-                " binned, jit-native mode instead."
-            )
+            row_mask = mask[:, None] if preds.ndim == 2 and mask.ndim == 1 else mask
+            preds = jnp.where(row_mask, preds, _EXACT_IGNORE_SENTINEL)
+            # target was already zeroed on ignored rows by the format helper;
+            # re-assert it so this function is safe standalone
+            return preds, jnp.where(mask, target, 0)
         return preds[mask], target[mask]
     return preds, target
+
+
+def _exact_target_for_weights(state) -> Array:
+    """Host-side: the target rows of an exact-mode tuple state with any in-jit
+    sentinel rows removed — for ``average="weighted"`` bincounts, which would
+    otherwise count sentinel rows (target zeroed) into class 0."""
+    preds, target = jnp.asarray(state[0]), jnp.asarray(state[1])
+    col = preds[:, 0] if preds.ndim == 2 else preds
+    keep = col != _EXACT_IGNORE_SENTINEL
+    if not bool(keep.all()):
+        target = target[keep]
+    return target
 
 
 def _binary_precision_recall_curve_arg_validation(
