@@ -110,7 +110,7 @@ class MaxMetric(BaseAggregator):
 
     def update(self, value: Union[float, Array]) -> None:
         value, _ = self._cast_and_nan_check_input(value)
-        if value.size:  # make sure tensor not empty
+        if value.size:  # a fully-NaN-filtered batch contributes nothing
             self.max_value = jnp.maximum(self.max_value, jnp.max(value))
 
 

@@ -77,19 +77,20 @@ def _binary_auroc_compute(
     if max_fpr is None or max_fpr == 1:
         return _auc_compute_without_check(fpr, tpr, 1.0)
 
+    # Partial AUC over fpr <= max_fpr (semantics per reference auroc.py:96-110,
+    # itself the sklearn convention): the curve is cut at max_fpr — the cut
+    # point's tpr is linearly interpolated between its bracketing ROC points —
+    # and the truncated area is then rescaled onto [0.5, 1] so chance stays at
+    # 0.5 and a perfect ranking at 1 (McClish 1989). The denominator clamp
+    # guards the repeated-fpr case where the bracketing points coincide.
     max_area = jnp.asarray(max_fpr, dtype=jnp.float32)
-    # Add a single point at max_fpr and interpolate its tpr value
     stop = jnp.searchsorted(fpr, max_area, side="right")
     weight = (max_area - fpr[stop - 1]) / jnp.maximum(fpr[stop] - fpr[stop - 1], 1e-12)
     interp_tpr = tpr[stop - 1] + weight * (tpr[stop] - tpr[stop - 1])
     tpr = jnp.concatenate([tpr[:stop], interp_tpr.reshape(1)])
     fpr = jnp.concatenate([fpr[:stop], max_area.reshape(1)])
-
-    # Compute partial AUC
     partial_auc = _auc_compute_without_check(fpr, tpr, 1.0)
-
-    # McClish correction: standardize result to be 0.5 if non-discriminant and 1 if maximal
-    min_area = 0.5 * max_area**2
+    min_area = 0.5 * max_area**2  # area under the chance diagonal up to the cut
     return 0.5 * (1 + (partial_auc - min_area) / (max_area - min_area))
 
 

@@ -25,7 +25,7 @@ def _precision_recall_reduce(
     multidim_average: str = "global",
     multilabel: bool = False,
 ) -> Array:
-    different_stat = fp if stat == "precision" else fn  # this is what differs between the two scores
+    different_stat = fp if stat == "precision" else fn  # P = tp/(tp+fp), R = tp/(tp+fn)
     if average == "binary":
         return _safe_divide(tp, tp + different_stat)
     if average == "micro":

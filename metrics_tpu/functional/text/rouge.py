@@ -105,7 +105,7 @@ def _split_sentence(x: str) -> Sequence[str]:
     """Sentence-split for ROUGE-Lsum (nltk punkt when available; vendored
     deterministic splitter otherwise — the reference raises offline,
     reference rouge.py:52-77)."""
-    x = re.sub("<n>", "", x)  # remove pegasus newline char
+    x = re.sub("<n>", "", x)  # strip the "<n>" newline token Pegasus outputs emit
     if _NLTK_AVAILABLE:
         import nltk
 

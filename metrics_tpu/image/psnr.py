@@ -72,7 +72,7 @@ class PeakSignalNoiseRatio(Metric):
         sum_squared_error, n_obs = _psnr_update(preds, target, dim=self.dim)
         if self.dim is None:
             if self.data_range is None:
-                # keep track of min and max target values
+                # data_range unset: infer it later from the running target extrema
                 self.min_target = jnp.minimum(jnp.min(target), self.min_target)
                 self.max_target = jnp.maximum(jnp.max(target), self.max_target)
             self.sum_squared_error = self.sum_squared_error + sum_squared_error

@@ -441,7 +441,8 @@ class Metric(ABC):
         )
 
         for attr, reduction_fn in self._reductions.items():
-            # pre-processing ops (stack or flatten for inputs)
+            # normalise gathered list states before reduction: an empty cat
+            # state stays an empty list
             if isinstance(output_dict[attr], list) and len(output_dict[attr]) == 0:
                 setattr(self, attr, [])
                 continue
@@ -1005,7 +1006,7 @@ class CompositionalMetric(Metric):
             self.metric_b.update(*args, **self.metric_b._filter_kwargs(**kwargs))
 
     def compute(self) -> Any:
-        # also some parsing for kwargs?
+        # operands may be Metric instances (compute now) or captured constants
         val_a = self.metric_a.compute() if isinstance(self.metric_a, Metric) else self.metric_a
         val_b = self.metric_b.compute() if isinstance(self.metric_b, Metric) else self.metric_b
         if val_b is None:
