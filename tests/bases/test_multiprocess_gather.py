@@ -6,7 +6,9 @@ reference ``src/torchmetrics/utilities/distributed.py:126-148``) had zero covera
 This test spawns a genuine 2-process ``jax.distributed`` CPU job — the JAX analogue
 of the reference's localhost gloo pool (``tests/unittests/helpers/testers.py:49-61``)
 — and asserts the equal-shape path, the ragged path, the union-of-data invariant,
-and an in-trace cross-process ``shard_map`` psum (the compiled DCN path).
+an in-trace cross-process ``shard_map`` psum (the compiled DCN path), and a fused
+3-step train loop (grad pmean + in-graph metric update) whose streamed accuracy,
+loss and weights must equal a single-process replay on the union of the shards.
 """
 
 from __future__ import annotations

@@ -106,6 +106,81 @@ def main() -> None:
     expected = float(np.mean(preds_global == target_global))
     np.testing.assert_allclose(float(value), expected, atol=1e-6)
 
+    # --- fused 3-step train loop across processes (VERDICT r4 item 9) ---------
+    # Closes the gap between "collective proven" and "loop proven": a compiled
+    # train step (forward, grad pmean, SGD update) with the metric update FUSED
+    # into the same graph runs 3 steps over the 2-process mesh; the streamed
+    # accuracy and loss must equal a single-process replay on the union of the
+    # per-process shards (equal shard sizes -> pmean grad == full-batch grad).
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    feats, classes, per_step = 6, 4, 8  # global batch per step; 4 rows per process
+    xs = rng.normal(size=(3, per_step, feats)).astype(np.float32)
+    ys = rng.integers(0, classes, (3, per_step)).astype(np.int32)
+    w0 = rng.normal(size=(feats, classes)).astype(np.float32) * 0.1
+
+    def loss_fn(w, x, y):
+        logits = x @ w
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1)), logits
+
+    def train_step(w, acc_state, loss_sum, x, y):
+        (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(w, x, y)
+        grads = jax.lax.pmean(grads, "dp")  # DCN collective inside the step
+        w = w - 0.1 * grads
+        acc_state = acc.update_state(acc_state, jnp.argmax(logits, axis=-1), y)
+        return w, acc_state, loss_sum + jax.lax.pmean(loss, "dp")
+
+    fused = jax.jit(
+        jax.shard_map(
+            train_step,
+            mesh=mesh,
+            in_specs=(P(), P(), P(), P("dp"), P("dp")),
+            out_specs=(P(), P(), P()),
+            check_vma=False,
+        )
+    )
+    w = jax.device_put(jnp.asarray(w0), NamedSharding(mesh, P()))
+    acc_state = jax.device_put(acc.init_state(), NamedSharding(mesh, P()))
+    loss_sum = jax.device_put(jnp.zeros(()), NamedSharding(mesh, P()))
+    half = per_step // num_processes
+    for step_i in range(3):
+        x_g = jax.make_array_from_process_local_data(
+            NamedSharding(mesh, P("dp")), xs[step_i, half * process_id : half * (process_id + 1)],
+            global_shape=(per_step, feats),
+        )
+        y_g = jax.make_array_from_process_local_data(
+            NamedSharding(mesh, P("dp")), ys[step_i, half * process_id : half * (process_id + 1)],
+            global_shape=(per_step,),
+        )
+        w, acc_state, loss_sum = fused(w, acc_state, loss_sum, x_g, y_g)
+
+    streamed_acc = float(
+        jax.jit(
+            jax.shard_map(
+                lambda s: acc.compute_from(s, axis_name="dp"),
+                mesh=mesh, in_specs=(P(),), out_specs=P(), check_vma=False,
+            )
+        )(acc_state)
+    )
+
+    # single-process replay on the union of the data
+    w_ref = jnp.asarray(w0)
+    correct = total = 0
+    loss_ref = 0.0
+    for step_i in range(3):
+        (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            w_ref, jnp.asarray(xs[step_i]), jnp.asarray(ys[step_i])
+        )
+        w_ref = w_ref - 0.1 * grads
+        correct += int(np.sum(np.argmax(np.asarray(logits), -1) == ys[step_i]))
+        total += per_step
+        loss_ref += float(loss)
+    np.testing.assert_allclose(streamed_acc, correct / total, atol=1e-6)
+    np.testing.assert_allclose(float(loss_sum), loss_ref, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(w), np.asarray(w_ref), atol=1e-5)
+
     print(f"WORKER_OK rank={process_id}")
 
 
