@@ -13,6 +13,10 @@ Configs (BASELINE.json "configs"):
   4. bert_embedding_states — BERTScore-style ragged token-id cat states: update cost
                            + embedding/score compute with an injected cheap model
   5. fid_cov_sync        — FID covariance-sum states (2 x d x d) psum over the mesh
+
+Plus (not a BASELINE.json tracked config): ``bench_roofline`` — samples/s +
+achieved GB/s / GFLOP/s for six flagship device paths (accounting:
+benchmarks/README.md "Roofline rows").
 """
 
 from __future__ import annotations
@@ -75,6 +79,14 @@ def timed(fn, *run_args, steps=STEPS):
     jax.block_until_ready(out)
     return (time.perf_counter() - t0) / steps * 1e3
 
+
+
+def _rand_boxes(rng, n):
+    """xyxy boxes in [0, 100): shared by the detection benches so the
+    generation protocol cannot drift between them."""
+    b = rng.uniform(0, 100, (n, 4)).astype(np.float32)
+    b[:, 2:] += b[:, :2]
+    return b
 
 def bench_accuracy_single() -> None:
     from metrics_tpu.classification import MulticlassAccuracy
@@ -152,14 +164,9 @@ def bench_detection_map() -> None:
     rng = np.random.default_rng(2)
     metric = MeanAveragePrecision()
 
-    def make(n):
-        boxes = rng.uniform(0, 100, (n, 4)).astype(np.float32)
-        boxes[:, 2:] += boxes[:, :2]
-        return boxes
-
-    preds = [{"boxes": jnp.asarray(make(20)), "scores": jnp.asarray(rng.uniform(size=20).astype(np.float32)),
+    preds = [{"boxes": jnp.asarray(_rand_boxes(rng, 20)), "scores": jnp.asarray(rng.uniform(size=20).astype(np.float32)),
               "labels": jnp.asarray(rng.integers(0, 3, 20))} for _ in range(8)]
-    target = [{"boxes": jnp.asarray(make(10)), "labels": jnp.asarray(rng.integers(0, 3, 10))} for _ in range(8)]
+    target = [{"boxes": jnp.asarray(_rand_boxes(rng, 10)), "labels": jnp.asarray(rng.integers(0, 3, 10))} for _ in range(8)]
 
     metric.update(preds, target)  # warm-up: first call pays one-time dispatch costs
     metric.reset()  # keep the timed state at exactly 8*STEPS images
@@ -217,9 +224,121 @@ def bench_fid_cov_sync() -> None:
     emit("fid_cov_sync psum (2x sum + 2x dxd cov)", ms, config={"feature_dim": d, "ranks": n_dev})
 
 
+def bench_roofline() -> None:
+    """Quantified throughput + achieved-bandwidth/FLOP rows (VERDICT r4 item 3).
+
+    Six flagship device paths, each emitted with samples/s AND the
+    roofline-relevant rate — achieved input GB/s for the memory-bound paths,
+    achieved GFLOP/s for the matmul-shaped ones. The arithmetic-intensity
+    accounting behind each row is written down in benchmarks/README.md
+    ("Roofline rows"); published v5e ceilings for context: 819 GB/s HBM,
+    197 bf16 TFLOP/s. Sizes shrink on the CPU mesh (relative story only —
+    the absolute record is the TPU capture in the watch log).
+    """
+    rng = np.random.default_rng(7)
+    big = BACKEND != "cpu"
+    M = 1_000_000 if big else 200_000  # samples for the counting paths
+    C = 100
+
+    # --- 1. stat-scores update (macro tp/fp/tn/fn) — memory-bound ----------
+    from metrics_tpu.classification import MulticlassStatScores
+
+    ss = MulticlassStatScores(C, average="macro", validate_args=False)
+    preds_i = jnp.asarray(rng.integers(0, C, M).astype(np.int32))
+    target_i = jnp.asarray(rng.integers(0, C, M).astype(np.int32))
+    step = jax.jit(ss.update_state)
+    state = ss.init_state()
+    ms = timed(lambda: step(state, preds_i, target_i))
+    in_bytes = 2 * 4 * M  # int32 preds + target; states are O(C), negligible
+    emit("roofline stat_scores update", ms,
+         samples_per_s=round(M / (ms / 1e3)),
+         achieved_gb_s=round(in_bytes / (ms / 1e3) / 1e9, 2),
+         config={"samples": M, "classes": C, "bound": "memory (input stream)"})
+
+    # --- 2. binned-curve update — comparison matmul (MXU) vs bucketize -----
+    from metrics_tpu.functional.classification.precision_recall_curve import (
+        _binary_precision_recall_curve_update,
+    )
+
+    T = 100
+    probs = jnp.asarray(rng.uniform(size=M).astype(np.float32))
+    btarget = jnp.asarray(rng.integers(0, 2, M).astype(np.int32))
+    thresholds = jnp.linspace(0, 1, T, dtype=jnp.float32)
+    upd = jax.jit(lambda p, t: _binary_precision_recall_curve_update(p, t, thresholds))
+    ms = timed(lambda: upd(probs, btarget))
+    # TPU lowering: (T, M) compare + two (T,M)@(M,) matvecs -> ~6*T*M flop-ish;
+    # CPU lowering is the bucketized histogram (memory-bound, 8 B/sample)
+    rate = {"achieved_gflop_s": round(6 * T * M / (ms / 1e3) / 1e9, 1)} if big else \
+           {"achieved_gb_s": round(8 * M / (ms / 1e3) / 1e9, 2)}
+    emit("roofline binned_curve update", ms,
+         samples_per_s=round(M / (ms / 1e3)),
+         config={"samples": M, "thresholds": T,
+                 "bound": "MXU comparison-matmul" if big else "memory (bucketized)"}, **rate)
+
+    # --- 3. confusion matrix update — scatter-add, memory-bound ------------
+    from metrics_tpu.classification import MulticlassConfusionMatrix
+
+    cm = MulticlassConfusionMatrix(C, validate_args=False)
+    cstep = jax.jit(cm.update_state)
+    cstate = cm.init_state()
+    ms = timed(lambda: cstep(cstate, preds_i, target_i))
+    emit("roofline confusion_matrix update", ms,
+         samples_per_s=round(M / (ms / 1e3)),
+         achieved_gb_s=round(2 * 4 * M / (ms / 1e3) / 1e9, 2),
+         config={"samples": M, "classes": C, "bound": "memory (input stream)"})
+
+    # --- 4. SSIM window pass — banded-matmul separable windows -------------
+    from metrics_tpu.functional.image.ssim import structural_similarity_index_measure
+
+    N, H = (16, 256) if big else (4, 128)
+    img_a = jnp.asarray(rng.uniform(size=(N, 3, H, H)).astype(np.float32))
+    img_b = jnp.asarray(rng.uniform(size=(N, 3, H, H)).astype(np.float32))
+    ssim_fn = jax.jit(lambda a, b: structural_similarity_index_measure(a, b, data_range=1.0))
+    ms = timed(lambda: ssim_fn(img_a, img_b))
+    pix = N * 3 * H * H
+    win = 11
+    # 5 window maps (mu_x, mu_y, x², y², xy), separable = 2 passes × win MACs
+    flops = 5 * 2 * win * 2 * pix
+    emit("roofline ssim window pass", ms,
+         mpixels_per_s=round(pix / (ms / 1e3) / 1e6, 1),
+         achieved_gflop_s=round(flops / (ms / 1e3) / 1e9, 1),
+         config={"images": N, "hw": H, "window": win, "bound": "banded GEMM"})
+
+    # --- 5. pairwise GEMM — the pure MXU row -------------------------------
+    from metrics_tpu.functional import pairwise_cosine_similarity
+
+    Npw, D = (4096, 512) if big else (1024, 256)
+    X = jnp.asarray(rng.normal(size=(Npw, D)).astype(np.float32))
+    pw = jax.jit(lambda x: pairwise_cosine_similarity(x, zero_diagonal=False))
+    ms = timed(lambda: pw(X))
+    flops = 2 * Npw * Npw * D
+    emit("roofline pairwise cosine GEMM", ms,
+         achieved_gflop_s=round(flops / (ms / 1e3) / 1e9, 1),
+         config={"n": Npw, "d": D, "dtype": "f32", "bound": "MXU GEMM"})
+
+    # --- 6. detection ingest — overlapped D2H, boxes/s ---------------------
+    from metrics_tpu.detection import MeanAveragePrecision
+
+    det = MeanAveragePrecision()
+    imgs, nb = 64, 100
+    dpreds = [{"boxes": jnp.asarray(_rand_boxes(rng, nb)), "scores": jnp.asarray(rng.uniform(size=nb).astype(np.float32)),
+               "labels": jnp.asarray(rng.integers(0, 5, nb))} for _ in range(imgs)]
+    dtarget = [{"boxes": jnp.asarray(_rand_boxes(rng, nb // 2)), "labels": jnp.asarray(rng.integers(0, 5, nb // 2))} for _ in range(imgs)]
+    det.update(dpreds, dtarget)  # warm-up
+    det.reset()
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        det.update(dpreds, dtarget)
+    ms = (time.perf_counter() - t0) / STEPS * 1e3
+    emit("roofline detection ingest", ms,
+         boxes_per_s=round(imgs * (nb + nb // 2) / (ms / 1e3)),
+         config={"images": imgs, "boxes_per_img": nb, "bound": "async D2H enqueue"})
+
+
 if __name__ == "__main__":
     bench_accuracy_single()
     bench_collection_mesh()
     bench_detection_map()
     bench_bert_embedding_states()
     bench_fid_cov_sync()
+    bench_roofline()
