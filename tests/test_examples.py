@@ -46,6 +46,11 @@ def test_rouge_own_normalizer():
     _run("rouge_score-own_normalizer_and_tokenizer.py")
 
 
+def test_audio_eval():
+    out = _run("audio_eval.py")
+    assert "jit-fused mean STOI" in out
+
+
 def test_plotting(tmp_path):
     pytest.importorskip("matplotlib")
     # artifacts go to the tmp dir, never the repo root; generous timeout — the
