@@ -426,3 +426,16 @@ def test_hinge_differentiability():
     MetricTester().run_differentiability_test(
         preds, target, BinaryHingeLoss, binary_hinge_loss, metric_args={"validate_args": False},
     )
+
+
+def test_calibration_error_confidence_exactly_zero_robust():
+    """Confidence exactly 0.0 crashes the reference (its bucketize maps 0.0 to
+    bin -1 and the scatter indexes out of range); ours bins it into bin 0 and
+    returns a finite value — an intentional robustness improvement, pinned so
+    parity work never 'fixes' it back to a crash. (The fuzz-parity tier
+    deliberately avoids exact-0.0 confidence for this reason.)"""
+    probs = jnp.asarray(np.array([0.0, 0.3, 0.7, 1.0], np.float32))
+    target = jnp.asarray(np.array([0, 0, 1, 1]))
+    for norm in ["l1", "l2", "max"]:
+        v = float(binary_calibration_error(probs, target, n_bins=5, norm=norm))
+        assert np.isfinite(v) and 0.0 <= v <= 1.0
