@@ -343,3 +343,64 @@ def test_runtime_merge_still_groups_value_equal_states():
     mc.update(jnp.asarray(2.0))
     groups = {tuple(sorted(v)) for v in mc.compute_groups.values()}
     assert ("a", "b") in groups  # runtime value comparison merged them
+
+
+def test_structural_identity_implies_value_equality_property():
+    """Soundness property of the structural seeding: whenever
+    ``_structurally_identical(a, b)`` holds for two metrics from a varied
+    pool, independently updating both on the same random batches must leave
+    their states value-equal (i.e. the runtime comparison would have merged
+    them too). If this invariant ever breaks, grouped collections would
+    silently compute from the wrong shared state."""
+    from metrics_tpu.classification import BinaryAccuracy, MulticlassStatScores
+    from metrics_tpu.regression import MeanAbsoluteError, MeanSquaredError
+
+    rng = np.random.default_rng(0)
+
+    def pool():
+        return [
+            MulticlassAccuracy(NUM_CLASSES, average="macro", validate_args=False),
+            MulticlassAccuracy(NUM_CLASSES, average="micro", validate_args=False),
+            MulticlassPrecision(NUM_CLASSES, average="macro", validate_args=False),
+            MulticlassRecall(NUM_CLASSES, average="macro", validate_args=False),
+            MulticlassRecall(NUM_CLASSES, average="macro", ignore_index=0, validate_args=False),
+            MulticlassF1Score(NUM_CLASSES, average="macro", validate_args=False),
+            MulticlassStatScores(NUM_CLASSES, average="macro", validate_args=False),
+            MulticlassConfusionMatrix(NUM_CLASSES, validate_args=False),
+            BinaryAccuracy(validate_args=False),
+            MeanSquaredError(),
+            MeanAbsoluteError(),
+        ]
+
+    a_pool, b_pool = pool(), pool()
+    preds_mc = jnp.asarray(rng.integers(0, NUM_CLASSES, 200))
+    target_mc = jnp.asarray(rng.integers(0, NUM_CLASSES, 200))
+    preds_f = jnp.asarray(rng.uniform(size=200).astype(np.float32))
+    target_f = jnp.asarray(rng.uniform(size=200).astype(np.float32))
+
+    n_structural_pairs = 0
+    n_cross_class_pairs = 0
+    for i, a in enumerate(a_pool):
+        for j, b in enumerate(b_pool):
+            if not MetricCollection._structurally_identical(a, b):
+                continue
+            n_structural_pairs += 1
+            if type(a) is not type(b):
+                n_cross_class_pairs += 1
+            # feed BOTH the same data through their own update paths
+            for m in (a, b):
+                if isinstance(m, (MeanSquaredError, MeanAbsoluteError)):
+                    m.update(preds_f, target_f)
+                elif isinstance(m, BinaryAccuracy):
+                    m.update(preds_f, jnp.asarray(np.asarray(target_f) > 0.5))
+                else:
+                    m.update(preds_mc, target_mc)
+            assert MetricCollection._equal_metric_states(a, b), (i, j, type(a), type(b))
+            a.reset()
+            b.reset()
+    # sanity: the CROSS-class structural family (Acc/Precision/Recall/StatScores
+    # macro, sharing MulticlassStatScores.update) must really have been
+    # exercised — diagonal same-class pairs alone are near-vacuous for the
+    # property. Measured pool yield: 23 pairs = 11 diagonal + 12 cross.
+    assert n_structural_pairs >= 20, n_structural_pairs
+    assert n_cross_class_pairs >= 10, n_cross_class_pairs
