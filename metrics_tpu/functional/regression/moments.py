@@ -17,7 +17,7 @@ from jax import Array
 
 import numpy as np
 
-from metrics_tpu.utils.checks import _check_same_shape
+from metrics_tpu.utils.checks import _check_same_shape, _value_check_possible
 from metrics_tpu.utils.compute import _is_eager_cpu
 from metrics_tpu.utils.prints import rank_zero_warn
 
@@ -130,11 +130,17 @@ def _pearson_kernel(
 
 
 def _pearson_corrcoef_compute(var_x: Array, var_y: Array, corr_xy: Array, nb: Array) -> Array:
-    """Reference pearson.py ``_pearson_corrcoef_compute``."""
+    """Reference pearson.py ``_pearson_corrcoef_compute``.
+
+    Plain division, as the reference (pearson.py:77-81): a zero-variance input
+    (constant preds or target) gives 0/0 → NaN, which ``clip`` preserves.
+    An earlier epsilon-clamp here silently returned 0.0 on constant inputs —
+    caught by the round-4 fuzz soak against the executed reference.
+    """
     var_x = var_x / (nb - 1)
     var_y = var_y / (nb - 1)
     corr_xy = corr_xy / (nb - 1)
-    corrcoef = corr_xy / jnp.sqrt(jnp.clip(var_x * var_y, min=1e-24))
+    corrcoef = corr_xy / jnp.sqrt(var_x * var_y)
     return jnp.clip(corrcoef, -1.0, 1.0)
 
 
@@ -164,11 +170,18 @@ def pearson_corrcoef(preds: Array, target: Array) -> Array:
 def _concordance_corrcoef_compute(
     mean_x: Array, mean_y: Array, var_x: Array, var_y: Array, corr_xy: Array, nb: Array
 ) -> Array:
-    """CCC = 2·cov / (var_x + var_y + (mean_x − mean_y)²) (reference concordance.py)."""
-    var_x = var_x / nb
-    var_y = var_y / nb
-    corr_xy = corr_xy / nb
-    return 2.0 * corr_xy / (var_x + var_y + (mean_x - mean_y) ** 2)
+    """CCC via the (clamped) pearson factor, exactly as the reference
+    (concordance.py:20-30): ``2·ρ·σx·σy / (σx² + σy² + (μx − μy)²)`` with the
+    n−1-normalised variances from ``_pearson_corrcoef_compute``. The earlier
+    algebraically-simplified ``2·cov/(...)`` form normalised by n instead of
+    n−1, which diverges by O(Δμ²/n) whenever the means differ (≈1e-4 at
+    n≈200 — caught by the round-4 fuzz soak), and bypassed the reference's
+    ρ-clamp and its NaN on zero-variance inputs.
+    """
+    pearson = _pearson_corrcoef_compute(var_x, var_y, corr_xy, nb)
+    var_x = var_x / (nb - 1)
+    var_y = var_y / (nb - 1)
+    return 2.0 * pearson * jnp.sqrt(var_x) * jnp.sqrt(var_y) / (var_x + var_y + (mean_x - mean_y) ** 2)
 
 
 def concordance_corrcoef(preds: Array, target: Array) -> Array:
@@ -180,7 +193,7 @@ def concordance_corrcoef(preds: Array, target: Array) -> Array:
         >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
         >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
         >>> concordance_corrcoef(preds, target)
-        Array(0.9767892, dtype=float32)
+        Array(0.9777347, dtype=float32)
     """
     d = preds.shape[1] if preds.ndim == 2 else 1
     shape = (d,) if d > 1 else ()
@@ -319,10 +332,17 @@ def _r2_score_compute(
     multioutput: str = "uniform_average",
 ) -> Array:
     """Reference r2.py compute (incl. adjusted-R² variant)."""
+    if _value_check_possible(num_obs) and num_obs < 2:
+        # the reference raises inside compute (r2.py:78-80); keep the guard
+        # here so the MODULE path hits it too, not only the functional wrapper
+        raise ValueError("Needs at least two samples to calculate r2 score.")
     mean_obs = sum_obs / num_obs
     tss = sum_squared_obs - sum_obs * mean_obs
-    raw_scores = 1 - (residual / jnp.where(tss == 0, jnp.ones_like(tss), tss))
-    raw_scores = jnp.where(tss == 0, jnp.zeros_like(raw_scores), raw_scores)
+    # plain division, as the reference (r2.py:83-84): constant targets give
+    # tss == 0 → -inf (or NaN when the residual is also 0), NOT a masked 0 —
+    # that masking convention belongs to explained_variance only (sklearn
+    # semantics there; caught by the round-4 fuzz soak)
+    raw_scores = 1 - (residual / tss)
 
     if multioutput == "raw_values":
         r2 = raw_scores
@@ -339,7 +359,25 @@ def _r2_score_compute(
     if adjusted < 0 or not isinstance(adjusted, int):
         raise ValueError("`adjusted` parameter should be an integer larger or equal to 0.")
     if adjusted != 0:
-        return 1 - (1 - r2) * (num_obs - 1) / (num_obs - adjusted - 1)
+        # reference r2.py:101-112: degenerate adjustments warn and FALL BACK to
+        # the standard score instead of dividing by zero / flipping sign
+        if _value_check_possible(num_obs):
+            if adjusted > num_obs - 1:
+                rank_zero_warn(
+                    "More independent regressions than data points in adjusted r2 score. "
+                    "Falls back to standard r2 score.",
+                    UserWarning,
+                )
+            elif adjusted == num_obs - 1:
+                rank_zero_warn(
+                    "Division by zero in adjusted r2 score. Falls back to standard r2 score.", UserWarning
+                )
+            else:
+                return 1 - (1 - r2) * (num_obs - 1) / (num_obs - adjusted - 1)
+            return r2
+        # traced num_obs: same fallback, selected in-graph
+        adjusted_r2 = 1 - (1 - r2) * (num_obs - 1) / (num_obs - adjusted - 1)
+        return jnp.where(num_obs - adjusted - 1 > 0, adjusted_r2, r2)
     return r2
 
 

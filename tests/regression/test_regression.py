@@ -68,9 +68,12 @@ _ppreds, _ptarget = _inputs(positive=True)
 
 
 def _sk_concordance(preds, target):
+    # ddof=1 (n−1) variances, matching the reference's CCC (concordance.py:29-30
+    # derives from the n−1-normalised pearson statistics); the Δμ² term makes
+    # the ddof choice observable, ~O(Δμ²/n)
     p, t = preds.flatten(), target.flatten()
     r = pearsonr(p, t)[0]
-    return 2 * r * p.std() * t.std() / (p.var() + t.var() + (p.mean() - t.mean()) ** 2)
+    return 2 * r * p.std(ddof=1) * t.std(ddof=1) / (p.var(ddof=1) + t.var(ddof=1) + (p.mean() - t.mean()) ** 2)
 
 
 def _sk_logcosh(preds, target):
