@@ -181,9 +181,10 @@ def _theils_u_compute(confmat: Array) -> Array:
     p_xy = cm / total
     h_xy = -jnp.sum(jnp.where(p_xy > 0, p_xy * jnp.log(jnp.where(p_xy > 0, p_xy / p_y, 1.0)), 0.0))
 
-    if _value_check_possible(h_x) and float(h_x) == 0.0:
-        return jnp.asarray(jnp.nan)
-    return (h_x - h_xy) / h_x
+    # zero-entropy X (single observed category): the reference returns 0, not
+    # NaN (theils_u.py:99-100) — caught by the round-4 fuzz soak; the where
+    # form keeps the branch in-trace
+    return jnp.where(h_x == 0.0, jnp.zeros_like(h_x), (h_x - h_xy) / jnp.where(h_x == 0.0, 1.0, h_x))
 
 
 def theils_u(

@@ -161,15 +161,22 @@ def test_invalid_nan_strategy_raises():
         cramers_v(jnp.zeros(4), jnp.zeros(4), nan_strategy="replace", nan_replace_value=None)
 
 
-def test_single_class_returns_nan_with_warning():
-    """Degenerate tables (one occupied row/col after drop) → NaN + warning."""
+def test_single_class_degenerate_conventions():
+    """Degenerate single-category tables: cramers_v → NaN + warning, but
+    theils_u → 0 — the reference's zero-entropy branch returns 0, not NaN
+    (ref theils_u.py:99-100; verified against the executed reference in the
+    round-4 fuzz soak, which caught an earlier NaN here)."""
     preds = jnp.zeros(10, dtype=jnp.int32)
     target = jnp.zeros(10, dtype=jnp.int32)
     with pytest.warns(UserWarning, match="Unable to compute"):
         out = cramers_v(preds, target, bias_correction=True)
     assert np.isnan(np.asarray(out))
     out_u = theils_u(preds, target)
-    assert np.isnan(np.asarray(out_u))
+    assert float(out_u) == 0.0
+    # asymmetric degeneracy: constant x with varied y is also 0 (H(x) = 0)
+    varied = jnp.asarray(np.arange(10) % 3)
+    assert float(theils_u(preds, varied)) == 0.0
+    assert float(theils_u(varied, preds)) == 0.0  # H(x|y)=H(x) -> (H-H)/H = 0
 
 
 def test_module_accumulation_matches_functional_union():
