@@ -190,3 +190,35 @@ def test_module_accumulation_matches_functional_union():
         np.asarray(m.compute()), np.asarray(cramers_v(jnp.asarray(preds), jnp.asarray(target))), atol=1e-6
     )
     np.testing.assert_allclose(np.asarray(u.compute()), _np_theils_u(preds, target), atol=1e-6)
+
+
+def test_bias_corrected_2x2_table_works_where_reference_crashes():
+    """The reference's default bias_correction=True CRASHES on any 2x2 table
+    (binary x binary inputs): its phi2 correction in-place-subtracts a float
+    into an integer tensor ("result type Float can't be cast to Long") —
+    found by the round-4 fuzz soak; reproduced on int and float inputs alike.
+    Ours must produce the bias-corrected Bergsma value, checked here against
+    an independent numpy oracle."""
+    rng = np.random.default_rng(608)
+    a = rng.integers(0, 2, 153)
+    b = (a ^ (rng.random(153) < 0.4)).astype(np.int64)  # correlated binary
+
+    got_v = float(cramers_v(jnp.asarray(a), jnp.asarray(b), bias_correction=True))
+    got_t = float(tschuprows_t(jnp.asarray(a), jnp.asarray(b), bias_correction=True))
+
+    # numpy oracle: chi2 over the 2x2 table, Bergsma-Wicher correction
+    cm = np.zeros((2, 2))
+    for x, y in zip(a, b):
+        cm[x, y] += 1
+    n = cm.sum()
+    expected = np.outer(cm.sum(1), cm.sum(0)) / n
+    chi2 = ((cm - expected) ** 2 / expected).sum()
+    phi2 = chi2 / n
+    r = k = 2
+    phi2c = max(0.0, phi2 - (r - 1) * (k - 1) / (n - 1))
+    rc = r - (r - 1) ** 2 / (n - 1)
+    kc = k - (k - 1) ** 2 / (n - 1)
+    want_v = np.sqrt(phi2c / min(rc - 1, kc - 1))
+    want_t = np.sqrt(phi2c / np.sqrt((rc - 1) * (kc - 1)))
+    np.testing.assert_allclose(got_v, want_v, atol=1e-5)
+    np.testing.assert_allclose(got_t, want_t, atol=1e-5)
