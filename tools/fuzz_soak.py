@@ -7,9 +7,9 @@ ARBITRARY seed range for soak sessions::
 
     python tools/fuzz_soak.py --surfaces all --seeds 100:140
 
-Round-4 soak (~2500 oracle comparisons over fresh seed ranges across the four
-surfaces below) found and fixed four real convention divergences the fixed
-tiers had missed:
+The round-4 soak (~2500 oracle comparisons over fresh seed ranges across the
+first four surfaces below; the `modules` streaming surface was added after)
+found and fixed four real convention divergences the fixed tiers had missed:
 
 - pearson epsilon-clamped 0/0 to 0.0 on constant inputs (reference: NaN),
 - concordance normalised variances by n instead of the reference's n−1
@@ -240,11 +240,60 @@ def soak_image_audio(seeds) -> None:
                  atol=1e-2 if name == "signal_distortion_ratio" else 1e-4)
 
 
+def soak_modules(seeds) -> None:
+    """Module-API streaming over RANDOM batch splits through both libraries:
+    exercises the state accumulation/merge machinery, not just the math —
+    a split-invariance bug (wrong reduce op, missed carry) shows up here even
+    when the single-batch functional paths agree."""
+    import metrics_tpu.classification as ours_c
+    import metrics_tpu.regression as ours_r
+    import torchmetrics.classification as ref_c
+    import torchmetrics.regression as ref_r
+
+    for seed in seeds:
+        rng = np.random.default_rng(seed)
+        n = int(rng.integers(40, 400))
+        nc = 5
+        probs = rng.random((n, nc)).astype(np.float32)
+        probs /= probs.sum(-1, keepdims=True)
+        target = rng.integers(0, nc, n)
+        p_reg = rng.normal(size=n).astype(np.float32)
+        t_reg = (p_reg + 0.5 * rng.normal(size=n)).astype(np.float32)
+        # random split points, 1-5 batches
+        cuts = np.sort(rng.choice(np.arange(1, n), size=int(rng.integers(0, 5)), replace=False))
+        spans = list(zip([0, *cuts.tolist()], [*cuts.tolist(), n]))
+
+        pairs = [
+            (ours_c.MulticlassAccuracy(nc, average="macro"), ref_c.MulticlassAccuracy(nc, average="macro"), probs, target),
+            (ours_c.MulticlassF1Score(nc, average="weighted"), ref_c.MulticlassF1Score(nc, average="weighted"), probs, target),
+            (ours_c.MulticlassAUROC(nc, thresholds=20), ref_c.MulticlassAUROC(nc, thresholds=20), probs, target),
+            (ours_c.MulticlassConfusionMatrix(nc, normalize="true"), ref_c.MulticlassConfusionMatrix(nc, normalize="true"), probs, target),
+            (ours_r.MeanSquaredError(), ref_r.MeanSquaredError(), p_reg, t_reg),
+            (ours_r.PearsonCorrCoef(), ref_r.PearsonCorrCoef(), p_reg, t_reg),
+            (ours_r.SpearmanCorrCoef(), ref_r.SpearmanCorrCoef(), p_reg, t_reg),
+        ]
+        for ours_m, ref_m, P, T in pairs:
+            tag = type(ours_m).__name__ + "/stream"
+
+            def run_ours(m=ours_m, P=P, T=T):
+                for lo, hi in spans:
+                    m.update(jnp.asarray(P[lo:hi]), jnp.asarray(T[lo:hi]))
+                return m.compute()
+
+            def run_ref(m=ref_m, P=P, T=T):
+                for lo, hi in spans:
+                    m.update(torch.tensor(P[lo:hi]), torch.tensor(T[lo:hi]))
+                return m.compute()
+
+            _cmp(tag, seed, run_ours, run_ref)
+
+
 SURFACES = {
     "classification": soak_classification,
     "regression_retrieval": soak_regression_retrieval,
     "text_nominal": soak_text_nominal,
     "image_audio": soak_image_audio,
+    "modules": soak_modules,
 }
 
 
