@@ -279,3 +279,30 @@ def test_exact_mode_ignore_index_fuzz_parity(tm, torch, seed):
         torch.tensor(probs), torch.tensor(target), num_classes=NC, average="macro", ignore_index=-1
     )
     assert_close(in_jit, ref)
+
+
+def test_jaccard_macro_includes_class_absent_from_both(tm, torch):
+    """Round-4 soak finding: a class absent from BOTH preds and target has
+    denom == 0 and must still contribute its _safe_divide 0 to the macro mean
+    (plain ones weights, ref jaccard.py:80-81) — zero-weighting it is the
+    LATER torchmetrics convention. The absent-class seeds in _draws only
+    removed classes from target, so preds could still hit them; this pins the
+    both-absent case directly."""
+    import metrics_tpu.functional.classification as ours_mod
+    import torchmetrics.functional.classification as ref_mod
+
+    rng = np.random.default_rng(1046)
+    n = 12
+    probs = rng.random((n, NC)).astype(np.float32)
+    probs[:, 2] = 0.0  # class 2 never predicted...
+    probs /= probs.sum(-1, keepdims=True)
+    target = rng.integers(0, NC, n)
+    target[target == 2] = 1  # ...and never in target
+    for avg in ["macro", "weighted", "none", "micro"]:
+        ours = ours_mod.multiclass_jaccard_index(jnp.asarray(probs), jnp.asarray(target), num_classes=NC, average=avg)
+        ref = ref_mod.multiclass_jaccard_index(torch.tensor(probs), torch.tensor(target), num_classes=NC, average=avg)
+        assert_close(ours, ref)
+    # the ignored CLASS also stays in the macro mean as 0 (v0.12 semantics)
+    ours = ours_mod.multiclass_jaccard_index(jnp.asarray(probs), jnp.asarray(target), num_classes=NC, ignore_index=1)
+    ref = ref_mod.multiclass_jaccard_index(torch.tensor(probs), torch.tensor(target), num_classes=NC, ignore_index=1)
+    assert_close(ours, ref)
