@@ -9,13 +9,15 @@ ARBITRARY seed range for soak sessions::
 
 The round-4 soak (~2500 oracle comparisons over fresh seed ranges across the
 first four surfaces below; the `modules` streaming surface was added after)
-found and fixed four real convention divergences the fixed tiers had missed:
+found and fixed five real convention divergences the fixed tiers had missed:
 
 - pearson epsilon-clamped 0/0 to 0.0 on constant inputs (reference: NaN),
 - concordance normalised variances by n instead of the reference's n−1
   (O(Δμ²/n) error, ~1e-4 at n≈200),
 - r2 masked tss == 0 to 0 (reference: plain division → -inf),
-- theils_u returned NaN for zero-entropy X (reference: 0).
+- theils_u returned NaN for zero-entropy X (reference: 0),
+- macro-jaccard zero-weighted both-absent classes and the ignored class
+  (v0.12: plain ones weights, they count as 0).
 
 Known NON-failures this tool will report on some draws (all documented, each
 with an in-repo pin or provenance note):
