@@ -8,8 +8,9 @@ ARBITRARY seed range for soak sessions::
     python tools/fuzz_soak.py --surfaces all --seeds 100:140
 
 The round-4 soak (~2500 oracle comparisons over fresh seed ranges across the
-first four surfaces below; the `modules` streaming surface was added after)
-found and fixed five real convention divergences the fixed tiers had missed:
+first four surfaces below; the `modules` and `wrappers_aggregation` surfaces
+were added after) found and fixed five real convention divergences the fixed
+tiers had missed:
 
 - pearson epsilon-clamped 0/0 to 0.0 on constant inputs (reference: NaN),
 - concordance normalised variances by n instead of the reference's n−1
@@ -290,12 +291,96 @@ def soak_modules(seeds) -> None:
             _cmp(tag, seed, run_ours, run_ref)
 
 
+def soak_wrappers_aggregation(seeds) -> None:
+    """Deterministic wrappers (Classwise/MinMax/Multioutput) and the
+    aggregators' nan strategies, streamed through both libraries."""
+    import metrics_tpu as ours_tm
+    import metrics_tpu.classification as ours_c
+    import metrics_tpu.wrappers as ours_w
+    import torchmetrics as ref_tm
+    import torchmetrics.classification as ref_c
+
+    for seed in seeds:
+        rng = np.random.default_rng(seed)
+        n, nc = int(rng.integers(30, 200)), 4
+        probs = rng.random((n, nc)).astype(np.float32)
+        probs /= probs.sum(-1, keepdims=True)
+        target = rng.integers(0, nc, n)
+
+        def run_classwise_ours():
+            m = ours_w.ClasswiseWrapper(ours_c.MulticlassRecall(nc, average=None))
+            m.update(jnp.asarray(probs), jnp.asarray(target))
+            return tuple(np.asarray(v) for _, v in sorted(m.compute().items()))
+
+        def run_classwise_ref():
+            m = ref_tm.ClasswiseWrapper(ref_c.MulticlassRecall(nc, average=None))
+            m.update(torch.tensor(probs), torch.tensor(target))
+            return tuple(v.numpy() for _, v in sorted(m.compute().items()))
+
+        _cmp("ClasswiseWrapper", seed, run_classwise_ours, run_classwise_ref)
+
+        def run_minmax_ours():
+            m = ours_w.MinMaxMetric(ours_c.MulticlassAccuracy(nc, average="micro"))
+            for lo, hi in [(0, n // 2), (n // 2, n)]:
+                m.update(jnp.asarray(probs[lo:hi]), jnp.asarray(target[lo:hi]))
+                m.compute()
+            out = m.compute()
+            return (np.asarray(out["raw"]), np.asarray(out["min"]), np.asarray(out["max"]))
+
+        def run_minmax_ref():
+            m = ref_tm.MinMaxMetric(ref_c.MulticlassAccuracy(nc, average="micro"))
+            for lo, hi in [(0, n // 2), (n // 2, n)]:
+                m.update(torch.tensor(probs[lo:hi]), torch.tensor(target[lo:hi]))
+                m.compute()
+            out = m.compute()
+            return (out["raw"].numpy(), out["min"].numpy(), out["max"].numpy())
+
+        _cmp("MinMaxMetric", seed, run_minmax_ours, run_minmax_ref)
+
+        p2 = rng.normal(size=(n, 3)).astype(np.float32)
+        t2 = (p2 + 0.3 * rng.normal(size=(n, 3))).astype(np.float32)
+
+        def run_multiout_ours():
+            import metrics_tpu.regression as ours_r
+
+            m = ours_w.MultioutputWrapper(ours_r.MeanSquaredError(), num_outputs=3)
+            m.update(jnp.asarray(p2), jnp.asarray(t2))
+            return np.asarray(m.compute())
+
+        def run_multiout_ref():
+            import torchmetrics.regression as ref_r
+
+            m = ref_tm.MultioutputWrapper(ref_r.MeanSquaredError(), num_outputs=3)
+            m.update(torch.tensor(p2), torch.tensor(t2))
+            out = m.compute()
+            return np.asarray([v.item() for v in out]) if isinstance(out, list) else out.numpy()
+
+        _cmp("MultioutputWrapper", seed, run_multiout_ours, run_multiout_ref)
+
+        vals = rng.normal(size=n).astype(np.float32)
+        vals[rng.random(n) < 0.2] = np.nan
+        for cls_name, kw in [("MeanMetric", dict(nan_strategy="ignore")),
+                             ("SumMetric", dict(nan_strategy="ignore")),
+                             ("MaxMetric", dict(nan_strategy="ignore")),
+                             ("MeanMetric", dict(nan_strategy=0.5))]:
+            def run_agg(lib, t_fn, cls_name=cls_name, kw=kw):
+                m = getattr(lib, cls_name)(**kw)
+                for lo, hi in [(0, n // 3), (n // 3, n)]:
+                    m.update(t_fn(vals[lo:hi]))
+                return m.compute()
+
+            _cmp(f"{cls_name}{kw}", seed,
+                 lambda: run_agg(ours_tm, jnp.asarray),
+                 lambda: run_agg(ref_tm, torch.tensor))
+
+
 SURFACES = {
     "classification": soak_classification,
     "regression_retrieval": soak_regression_retrieval,
     "text_nominal": soak_text_nominal,
     "image_audio": soak_image_audio,
     "modules": soak_modules,
+    "wrappers_aggregation": soak_wrappers_aggregation,
 }
 
 
