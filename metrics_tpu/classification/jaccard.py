@@ -72,7 +72,7 @@ class MultilabelJaccardIndex(MultilabelConfusionMatrix):
         >>> metric.update(jnp.array([[1, 0, 1], [0, 1, 0], [1, 1, 0], [0, 0, 1]]),
         ...               jnp.array([[1, 0, 0], [0, 1, 0], [1, 0, 0], [0, 1, 1]]))
         >>> metric.compute()
-        Array(0.61111116, dtype=float32)
+        Array(0.6111111, dtype=float32)
     """
     is_differentiable = False
     higher_is_better = True
