@@ -154,10 +154,21 @@ def soak_regression_retrieval(seeds) -> None:
         for name in ["mean_squared_error", "mean_absolute_error", "explained_variance",
                      "r2_score", "pearson_corrcoef", "spearman_corrcoef", "concordance_corrcoef",
                      "mean_absolute_percentage_error", "symmetric_mean_absolute_percentage_error",
-                     "log_cosh_error"]:
+                     "log_cosh_error", "kendall_rank_corrcoef"]:
             _cmp(name, seed,
                  lambda: getattr(ours_f, name)(jnp.asarray(p), jnp.asarray(t)),
                  lambda: getattr(ref_f, name)(torch.tensor(p), torch.tensor(t)))
+        p_pos = np.abs(p) + 0.1
+        t_pos = np.abs(t) + 0.1
+        _cmp("tweedie_deviance_score", seed,
+             lambda: ours_f.tweedie_deviance_score(jnp.asarray(p_pos), jnp.asarray(t_pos), power=1.5),
+             lambda: ref_f.tweedie_deviance_score(torch.tensor(p_pos), torch.tensor(t_pos), power=1.5))
+        q = np.abs(rng.normal(size=(4, 8))).astype(np.float32) + 0.05
+        q2 = np.abs(rng.normal(size=(4, 8))).astype(np.float32) + 0.05
+        q /= q.sum(-1, keepdims=True); q2 /= q2.sum(-1, keepdims=True)
+        _cmp("kl_divergence", seed,
+             lambda: ours_f.kl_divergence(jnp.asarray(q), jnp.asarray(q2)),
+             lambda: ref_f.kl_divergence(torch.tensor(q), torch.tensor(q2)))
         rp = rng.random(n).astype(np.float32)
         rt = rng.integers(0, 2, n)
         if seed % 3 == 0:
@@ -266,11 +277,16 @@ def soak_modules(seeds) -> None:
         cuts = np.sort(rng.choice(np.arange(1, n), size=int(rng.integers(0, 5)), replace=False))
         spans = list(zip([0, *cuts.tolist()], [*cuts.tolist(), n]))
 
+        bin_probs = rng.random(n).astype(np.float32)
+        bin_target = rng.integers(0, 2, n)
         pairs = [
             (ours_c.MulticlassAccuracy(nc, average="macro"), ref_c.MulticlassAccuracy(nc, average="macro"), probs, target),
             (ours_c.MulticlassF1Score(nc, average="weighted"), ref_c.MulticlassF1Score(nc, average="weighted"), probs, target),
             (ours_c.MulticlassAUROC(nc, thresholds=20), ref_c.MulticlassAUROC(nc, thresholds=20), probs, target),
             (ours_c.MulticlassConfusionMatrix(nc, normalize="true"), ref_c.MulticlassConfusionMatrix(nc, normalize="true"), probs, target),
+            # exact-mode curve modules: ragged cat states across the splits
+            (ours_c.BinaryAUROC(thresholds=None), ref_c.BinaryAUROC(thresholds=None), bin_probs, bin_target),
+            (ours_c.BinaryAveragePrecision(thresholds=None), ref_c.BinaryAveragePrecision(thresholds=None), bin_probs, bin_target),
             (ours_r.MeanSquaredError(), ref_r.MeanSquaredError(), p_reg, t_reg),
             (ours_r.PearsonCorrCoef(), ref_r.PearsonCorrCoef(), p_reg, t_reg),
             (ours_r.SpearmanCorrCoef(), ref_r.SpearmanCorrCoef(), p_reg, t_reg),
