@@ -222,3 +222,20 @@ def test_bias_corrected_2x2_table_works_where_reference_crashes():
     want_t = np.sqrt(phi2c / np.sqrt((rc - 1) * (kc - 1)))
     np.testing.assert_allclose(got_v, want_v, atol=1e-5)
     np.testing.assert_allclose(got_t, want_t, atol=1e-5)
+
+
+def test_asymmetric_category_ranges_work_where_reference_crashes():
+    """Columns whose observed category maxima differ (e.g. {1,2,3} vs {2,3,4})
+    crash the reference for theils_u / pearsons_contingency_coefficient: it
+    infers one class count and reshapes the joint bincount to a square table
+    ("shape '[4, 4]' is invalid for input of size 20") — found by the round-4
+    soak at seed 3045. Ours builds the rectangular table and must match the
+    independent numpy oracles."""
+    a = np.asarray([1, 2, 3, 1, 2, 3, 1, 2, 3, 1])
+    b = np.asarray([2, 3, 4, 4, 3, 2, 2, 2, 4, 3])
+    got_u = float(theils_u(jnp.asarray(a), jnp.asarray(b)))
+    np.testing.assert_allclose(got_u, _np_theils_u(a, b), atol=1e-6)
+    got_p = float(pearsons_contingency_coefficient(jnp.asarray(a), jnp.asarray(b)))
+    ct = crosstab(a, b).count
+    chi2, _, n = _chi2_phi2(ct)
+    np.testing.assert_allclose(got_p, np.sqrt(chi2 / (chi2 + n)), atol=1e-6)

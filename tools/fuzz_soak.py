@@ -34,7 +34,11 @@ with an in-repo pin or provenance note):
 - cramers_v / tschuprows_t on 2x2 tables (binary x binary draws): the
   REFERENCE crashes with its default bias_correction=True ("result type
   Float can't be cast to Long"); ours computes the corrected value
-  (tests/nominal/test_nominal_extended.py pin vs a numpy oracle).
+  (tests/nominal/test_nominal_extended.py pin vs a numpy oracle),
+- theils_u / pearsons_contingency on columns whose observed category maxima
+  differ: the REFERENCE reshapes the joint bincount to a square table and
+  crashes ("shape '[r, r]' is invalid"); ours builds the rectangular table
+  (same test file, pinned vs numpy oracles).
 """
 
 from __future__ import annotations
