@@ -394,6 +394,49 @@ def soak_wrappers_aggregation(seeds) -> None:
                  lambda: run_agg(ref_tm, torch.tensor))
 
 
+def soak_detection(seeds) -> None:
+    """Randomized COCO scenes through both mAP implementations (the reference
+    runs with the in-test torchvision box ops from the parity conftest);
+    every headline key compared per scene. Slow (~reference mAP cost per
+    seed) — use small seed ranges."""
+    from metrics_tpu.detection import MeanAveragePrecision
+
+    from tests.detection.test_coco_protocol_oracle import _random_scene
+    from tests.parity.conftest import install_torchvision_box_ops
+
+    ref_cls = install_torchvision_box_ops(torch)
+    keys = ["map", "map_50", "map_75", "map_small", "map_medium", "map_large",
+            "mar_1", "mar_10", "mar_100", "mar_small", "mar_medium", "mar_large"]
+
+    def to_torch(dicts, with_scores):
+        out = []
+        for d in dicts:
+            item = {"boxes": torch.tensor(np.asarray(d["boxes"], np.float32)),
+                    "labels": torch.tensor(np.asarray(d["labels"], np.int64))}
+            if with_scores:
+                item["scores"] = torch.tensor(np.asarray(d["scores"], np.float32))
+            out.append(item)
+        return out
+
+    for seed in seeds:
+        rng = np.random.default_rng(seed)
+        preds, targets = _random_scene(rng, n_images=int(rng.integers(3, 9)), n_classes=int(rng.integers(2, 5)))
+
+        def run_ours(preds=preds, targets=targets):
+            m = MeanAveragePrecision()
+            m.update(preds, targets)
+            res = m.compute()
+            return tuple(float(np.asarray(res[k])) for k in keys)
+
+        def run_ref(preds=preds, targets=targets):
+            m = ref_cls()
+            m.update(to_torch(preds, True), to_torch(targets, False))
+            res = m.compute()
+            return tuple(float(res[k]) for k in keys)
+
+        _cmp("mean_ap", seed, run_ours, run_ref, atol=1e-5)
+
+
 SURFACES = {
     "classification": soak_classification,
     "regression_retrieval": soak_regression_retrieval,
@@ -401,6 +444,7 @@ SURFACES = {
     "image_audio": soak_image_audio,
     "modules": soak_modules,
     "wrappers_aggregation": soak_wrappers_aggregation,
+    "detection": soak_detection,
 }
 
 
