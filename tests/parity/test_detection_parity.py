@@ -152,3 +152,31 @@ def test_mean_ap_parity_empty_scenes(ref_map_cls, torch):
         got = float(np.asarray(res_ours[key]))
         want = float(res_ref[key])
         assert got == pytest.approx(want, abs=1e-5), (key, got, want)
+
+
+@pytest.mark.parametrize("seed", [4111, 4113, 4123])
+def test_scenes_where_reference_deviates_from_coco_protocol(ref_map_cls, torch, seed):
+    """Round-4 soak found random scenes where the reference's mAP deviates from
+    the COCO protocol by 3e-4..3e-3 (map/map_50). The independent in-test
+    COCOeval-specification oracle arbitrates: OURS matches the oracle exactly
+    on every such scene; the reference does not. Pinned so (a) our
+    spec-correctness on these scenes cannot regress and (b) the deviation is
+    on record as the reference's, not ours."""
+    from tests.detection.test_coco_protocol_oracle import coco_oracle
+
+    rng = np.random.default_rng(seed)
+    preds, targets = _random_scene(rng, n_images=int(rng.integers(3, 9)), n_classes=int(rng.integers(2, 5)))
+
+    ours = MeanAveragePrecision()
+    ours.update(preds, targets)
+    res_ours = ours.compute()
+    oracle = coco_oracle(preds, targets)
+    for key in ("map", "map_50", "map_75", "mar_100"):
+        np.testing.assert_allclose(float(np.asarray(res_ours[key])), oracle[key], atol=1e-6, err_msg=key)
+
+    ref = ref_map_cls()
+    ref.update(_to_torch(torch, preds, True), _to_torch(torch, targets, False))
+    res_ref = ref.compute()
+    # the reference's deviation from the spec on these scenes (~3e-4..3e-3);
+    # bounded loosely so environment drift doesn't break the record
+    assert abs(float(res_ref["map"]) - oracle["map"]) < 0.01

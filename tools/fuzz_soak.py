@@ -38,7 +38,11 @@ with an in-repo pin or provenance note):
 - theils_u / pearsons_contingency on columns whose observed category maxima
   differ: the REFERENCE reshapes the joint bincount to a square table and
   crashes ("shape '[r, r]' is invalid"); ours builds the rectangular table
-  (same test file, pinned vs numpy oracles).
+  (same test file, pinned vs numpy oracles),
+- mean_ap on some random scenes (~3e-4..3e-3 on map/map_50): the REFERENCE
+  deviates from the COCO protocol there — the independent COCOeval oracle
+  agrees with ours exactly on every such scene
+  (tests/parity/test_detection_parity.py::test_scenes_where_reference_deviates...).
 """
 
 from __future__ import annotations
