@@ -80,12 +80,25 @@ def main() -> None:
     t_ours = min(t_ours, _best(run_ours)[0])
     t_ref = min(t_ref, _best(run_ref)[0])
 
+    # Tight f32-noise gate vs the reference by default (ref accumulates
+    # precision/recall in float32, ref mean_ap.py:766-768; ours float64 —
+    # ~5e-5 observed). Keys where the tight check fails are arbitrated
+    # against the in-repo COCOeval spec oracle instead: the reference's
+    # matcher deviates from the protocol on some scenes (it never lets a det
+    # soak into an area-ignored gt) and the oracle sides with ours there
+    # (tests/parity/test_detection_parity.py
+    # ::test_scenes_where_reference_deviates_from_coco_protocol).
+    oracle = None
     for key in KEYS:
         a, b = float(np.asarray(v_ours[key])), float(v_ref[key])
-        # the reference accumulates precision/recall in float32 tensors
-        # (ref mean_ap.py:766-768); ours uses float64 numpy, so at this scene
-        # count the two legitimately differ by f32 rounding (~5e-5 observed)
-        np.testing.assert_allclose(a, b, atol=1e-4, err_msg=key)
+        if abs(a - b) <= 1e-4:
+            continue
+        if oracle is None:
+            from tests.detection.test_coco_protocol_oracle import coco_oracle
+
+            oracle = coco_oracle(preds, targets)
+        np.testing.assert_allclose(a, oracle[key], atol=1e-6,
+                                   err_msg=f"{key}: ours diverges from the spec oracle (ref={b})")
 
     print(
         json.dumps(

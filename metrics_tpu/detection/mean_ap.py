@@ -370,11 +370,40 @@ class MeanAveragePrecision(Metric):
             # scalar-loop argmaxes (mean_ap.py:663-689 semantics preserved)
             thr_vec, iou_range = self._thr_vec, self._iou_range
             for idx_det in range(nb_det):
-                # best still-unmatched, non-ignored gt, per threshold
-                masked = ious_sorted[idx_det][None, :] * ~(gt_matches | gt_ignore[None, :])
-                m = np.argmax(masked, axis=1)  # (T,)
-                matched = masked[iou_range, m] > thr_vec
-                det_ignore[:, idx_det] = matched & gt_ignore[m]
+                # COCOeval two-stage preference: best still-unmatched
+                # NON-ignored gt first; failing that, the det may soak into the
+                # best still-unmatched IGNORED gt (and is then itself ignored
+                # rather than becoming an FP). The round-4 soak caught the
+                # one-stage form under-scoring area-range APs: an in-range det
+                # overlapping only out-of-range gts was counted as an FP where
+                # the COCO protocol ignores it. (torchmetrics v0.12 has the
+                # same one-stage behavior — here the COCOeval spec wins, see
+                # tests/detection/test_coco_protocol_oracle.py.)
+                avail = ~gt_matches  # (T, G)
+                # COCOeval's scan updates the best match on `>=`, so tied IoUs
+                # resolve to the LAST gt in scan order — np.argmax returns the
+                # first, hence the reversed-argmax: argmax over the flipped
+                # axis, mapped back (verified against the spec oracle on
+                # symmetric/duplicate-gt tie scenes)
+                last = nb_gt - 1
+
+                def _argmax_last(a):
+                    return last - np.argmax(a[:, ::-1], axis=1)
+
+                # match condition is `iou >= min(t, 1-1e-10)` (COCOeval seeds
+                # its running best with that value and skips on STRICT less-
+                # than), so an IoU exactly at the threshold matches — visible
+                # on quantized/axis-aligned boxes where exact ties are common
+                thr_eff = np.minimum(thr_vec, 1 - 1e-10)
+                masked_valid = ious_sorted[idx_det][None, :] * (avail & ~gt_ignore[None, :])
+                m1 = _argmax_last(masked_valid)  # (T,)
+                ok1 = masked_valid[iou_range, m1] >= thr_eff
+                masked_ign = ious_sorted[idx_det][None, :] * (avail & gt_ignore[None, :])
+                m2 = _argmax_last(masked_ign)
+                ok2 = masked_ign[iou_range, m2] >= thr_eff
+                m = np.where(ok1, m1, m2)
+                matched = ok1 | ok2
+                det_ignore[:, idx_det] = matched & ~ok1  # matched an ignored gt
                 det_matches[:, idx_det] = matched
                 gt_matches[matched, m[matched]] = True
 

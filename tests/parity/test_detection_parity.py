@@ -120,10 +120,23 @@ def test_mean_ap_parity_xywh_and_thresholds(ref_map_cls, torch):
     ref.update(_to_torch(torch, conv(preds), True), _to_torch(torch, conv(targets), False))
     res_ref = ref.compute()
 
+    # this scene contains the matcher cell where the reference deviates from
+    # the COCO protocol (it never lets a det soak into an area-IGNORED gt, so
+    # an in-range det becomes an FP where COCOeval ignores it) — arbitrate
+    # every key with the spec oracle at the same custom thresholds, and assert
+    # reference equality only on the keys where the two agree
+    from tests.detection.test_coco_protocol_oracle import coco_oracle
+
+    oracle = coco_oracle(preds, targets, iou_thrs=kw["iou_thresholds"], max_dets=kw["max_detection_thresholds"])
     for key in ["map", "map_75", "map_small", "map_medium", "map_large", "mar_100"]:
         got = float(np.asarray(res_ours[key]))
+        assert got == pytest.approx(oracle[key], abs=1e-5), ("oracle", key, got, oracle[key])
         want = float(res_ref[key])
-        assert got == pytest.approx(want, abs=1e-5), (key, got, want)
+        if key == "map_large":
+            # the reference's one-stage matcher under-scores this key here
+            assert want < got, (key, got, want)
+        else:
+            assert got == pytest.approx(want, abs=1e-5), (key, got, want)
 
 
 def test_mean_ap_parity_empty_scenes(ref_map_cls, torch):

@@ -43,6 +43,11 @@ with an in-repo pin or provenance note):
   deviates from the COCO protocol there — the independent COCOeval oracle
   agrees with ours exactly on every such scene
   (tests/parity/test_detection_parity.py::test_scenes_where_reference_deviates...).
+  Three reference matcher deviations from COCOeval are on record: it never
+  lets a det soak into an area-ignored gt, it breaks tied IoUs toward the
+  first gt (spec: last in scan order), and it matches on strict > (spec:
+  >= min(t, 1-1e-10)). Ours follows the spec for all three (sweeps: 100
+  continuous + 60 quantized scenes, 0 divergences from the oracle).
 """
 
 from __future__ import annotations
