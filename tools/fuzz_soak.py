@@ -427,23 +427,48 @@ def soak_detection(seeds) -> None:
             out.append(item)
         return out
 
+    from tests.detection.test_coco_protocol_oracle import coco_oracle
+
+    def _matches_oracle(a: float, b: float) -> bool:
+        # oracle encodes "no value" as -1.0; our compute surfaces it as NaN or
+        # -1 depending on the key — a one-sided NaN against a real oracle
+        # value must NOT pass (tolerance matches the primary 1e-5 gate: the
+        # oracle is f64 while ours is an f32 pipeline)
+        if np.isnan(a):
+            return b == -1.0 or np.isnan(b)
+        return abs(a - b) <= 1e-5
+
+    ref_deviations = 0
     for seed in seeds:
         rng = np.random.default_rng(seed)
         preds, targets = _random_scene(rng, n_images=int(rng.integers(3, 9)), n_classes=int(rng.integers(2, 5)))
-
-        def run_ours(preds=preds, targets=targets):
+        try:
             m = MeanAveragePrecision()
             m.update(preds, targets)
             res = m.compute()
-            return tuple(float(np.asarray(res[k])) for k in keys)
-
-        def run_ref(preds=preds, targets=targets):
-            m = ref_cls()
-            m.update(to_torch(preds, True), to_torch(targets, False))
-            res = m.compute()
-            return tuple(float(res[k]) for k in keys)
-
-        _cmp("mean_ap", seed, run_ours, run_ref, atol=1e-5)
+            ours = {k: float(np.asarray(res[k])) for k in keys}
+            rm = ref_cls()
+            rm.update(to_torch(preds, True), to_torch(targets, False))
+            rres = rm.compute()
+            ref = {k: float(rres[k]) for k in keys}
+        except Exception as exc:  # noqa: BLE001 — record crash seeds, keep soaking
+            FAILS.append((seed, "mean_ap", "raised: " + repr(exc)[:140]))
+            continue
+        oracle = None
+        for k in keys:
+            if abs(ours[k] - ref[k]) <= 1e-5 or (np.isnan(ours[k]) and np.isnan(ref[k])):
+                continue
+            # disagreement: the COCOeval spec oracle arbitrates — only an
+            # ours-vs-oracle mismatch is a failure (the reference's matcher
+            # deviations from the spec are documented, see module docstring)
+            if oracle is None:
+                oracle = coco_oracle(preds, targets)
+            if not _matches_oracle(ours[k], oracle[k]):
+                FAILS.append((seed, f"mean_ap/{k}", f"ours {ours[k]} vs oracle {oracle[k]} (ref {ref[k]})"))
+            else:
+                ref_deviations += 1
+    if ref_deviations:
+        print(f"  (detection: reference deviated from the COCO-protocol oracle on {ref_deviations} key(s); ours matched the oracle on all of them)")
 
 
 SURFACES = {
