@@ -257,6 +257,17 @@ def test_exact_mode_ignore_index_fuzz_parity(tm, torch, seed):
         else:
             assert_close(ours, ref)
 
+    # multilabel exact curves ride the mask-state path (not the sentinel) —
+    # pin them against the reference too
+    ml_probs = rng.random((n, 4)).astype(np.float32)
+    ml_target = rng.integers(0, 2, (n, 4))
+    ml_target[rng.random((n, 4)) < 0.25] = -1
+    for name, kw in [("multilabel_auroc", dict(num_labels=4, average="micro")),
+                     ("multilabel_average_precision", dict(num_labels=4, average="weighted"))]:
+        ours = getattr(ours_mod, name)(jnp.asarray(ml_probs), jnp.asarray(ml_target), ignore_index=-1, **kw)
+        ref = getattr(ref_mod, name)(torch.tensor(ml_probs), torch.tensor(ml_target), ignore_index=-1, **kw)
+        assert_close(ours, ref)
+
     # multiclass sweep + the in-jit sentinel path vs eager (module state API)
     probs = rng.random((n, NC)).astype(np.float32)
     probs /= probs.sum(-1, keepdims=True)
