@@ -29,6 +29,8 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from tools.jsonl_log import append_jsonl  # noqa: E402 (needs the sys.path insert)
+
 parser = argparse.ArgumentParser()
 parser.add_argument("--backend", choices=["cpu", "default"], default="cpu")
 parser.add_argument("--steps", type=int, default=20)
@@ -65,9 +67,16 @@ BACKEND = jax.devices()[0].platform
 STEPS = args.steps
 
 
+_RUNS_LOG = os.path.join(os.path.dirname(os.path.abspath(__file__)), "suite_runs.jsonl")
+
+
 def emit(name: str, value_ms: float, unit: str = "ms", **extra) -> None:
-    print(json.dumps({"metric": name, "value": round(value_ms, 4), "unit": unit,
-                      "backend": BACKEND, **extra}))
+    row = {"metric": name, "value": round(value_ms, 4), "unit": unit,
+           "backend": BACKEND, **extra}
+    print(json.dumps(row))
+    # Persist every row (the watch log truncates subprocess stdout, which is how
+    # round 4 ended with zero durable roofline captures).
+    append_jsonl(_RUNS_LOG, dict(row))
 
 
 def timed(fn, *run_args, steps=STEPS):

@@ -9,10 +9,12 @@ being recorded: failures are noted on the record itself instead of raised.
 from __future__ import annotations
 
 import json
+import time
 
 
 def append_jsonl(path: str, record: dict) -> None:
     try:
+        record.setdefault("utc", time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
         with open(path, "a") as fh:
             fh.write(json.dumps(record) + "\n")
     except Exception as exc:  # noqa: BLE001

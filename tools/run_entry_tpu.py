@@ -96,6 +96,9 @@ def main() -> None:
     got_f1 = float(values["f1"])
     got_cm = np.asarray(values["confmat"])
     # both calls start from the same fresh `states`, so values reflect ONE update
+    # entry() constructs labels so step-0 accuracy is strictly inside (0, 1):
+    # matching a non-trivial value is real evidence (VERDICT r4 weak #6)
+    assert 0.0 < got_acc < 1.0, f"trivial accuracy {got_acc}; host match would be vacuous"
     assert abs(got_acc - exp["accuracy"]) < 1e-5, (got_acc, exp["accuracy"])
     assert abs(got_f1 - exp["f1"]) < 1e-5, (got_f1, exp["f1"])
     assert got_cm.sum() == exp["confmat_sum"], (got_cm.sum(), exp["confmat_sum"])
