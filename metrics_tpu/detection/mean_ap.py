@@ -220,6 +220,7 @@ class MeanAveragePrecision(Metric):
         rec_thresholds: Optional[List[float]] = None,
         max_detection_thresholds: Optional[List[int]] = None,
         class_metrics: bool = False,
+        reference_compat: bool = False,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -249,6 +250,16 @@ class MeanAveragePrecision(Metric):
         if not isinstance(class_metrics, bool):
             raise ValueError("Expected argument `class_metrics` to be a boolean")
         self.class_metrics = class_metrics
+        if not isinstance(reference_compat, bool):
+            raise ValueError("Expected argument `reference_compat` to be a boolean")
+        # Migration switch (default off = COCOeval spec): reproduce torchmetrics
+        # v0.12's matcher (reference mean_ap.py:663-689), which deviates from
+        # COCOeval three ways — ignored gts removed from candidates entirely (no
+        # det soak into area-ignored gts), ties resolved to the FIRST gt
+        # (argmax), and STRICT `>` threshold comparison. Deviations are
+        # 3e-4..3e-3 on area-range APs / exact-tie scenes; see
+        # docs/source/domains/detection.md "Migrating from torchmetrics".
+        self.reference_compat = reference_compat
 
         self.add_state("detections", default=[], dist_reduce_fx=None)
         self.add_state("detection_scores", default=[], dist_reduce_fx=None)
@@ -363,7 +374,23 @@ class MeanAveragePrecision(Metric):
         det_matches = np.zeros((nb_iou_thrs, nb_det), dtype=bool)
         det_ignore = np.zeros((nb_iou_thrs, nb_det), dtype=bool)
 
-        if ious_sorted.size:
+        if ious_sorted.size and self.reference_compat:
+            # torchmetrics v0.12 matcher (reference mean_ap.py:663-689,
+            # _find_best_gt_match): ignored gts removed from the candidate set
+            # entirely, FIRST gt on ties (plain argmax over the zero-masked
+            # row), STRICT `>` comparison against the raw threshold. Kept
+            # bit-compatible for drop-in migrators; the spec path below is the
+            # default.
+            thr_vec, iou_range = self._thr_vec, self._iou_range
+            for idx_det in range(nb_det):
+                avail = ~gt_matches  # (T, G)
+                masked = ious_sorted[idx_det][None, :] * (avail & ~gt_ignore[None, :])
+                m = np.argmax(masked, axis=1)
+                ok = masked[iou_range, m] > thr_vec
+                det_matches[:, idx_det] = ok
+                gt_matches[ok, m[ok]] = True
+                # det_ignore-from-match never fires: ignored gts are not candidates
+        elif ious_sorted.size:
             # the greedy matcher is sequential over detections (score order) by
             # definition, but independent across IoU thresholds — vectorise the
             # threshold axis so each det does ONE (T, G) argmax instead of T
@@ -393,12 +420,18 @@ class MeanAveragePrecision(Metric):
                 # match condition is `iou >= min(t, 1-1e-10)` (COCOeval seeds
                 # its running best with that value and skips on STRICT less-
                 # than), so an IoU exactly at the threshold matches — visible
-                # on quantized/axis-aligned boxes where exact ties are common
+                # on quantized/axis-aligned boxes where exact ties are common.
+                # Unavailable/ignored columns are masked to -1 (NOT 0): with a
+                # user-supplied iou threshold of 0.0 a zero-overlap candidate
+                # legitimately matches under COCOeval's `>=` scan, but an
+                # all-masked row must not — the -1 sentinel keeps the argmax on
+                # genuine candidates and fails the >= test when none exist.
                 thr_eff = np.minimum(thr_vec, 1 - 1e-10)
-                masked_valid = ious_sorted[idx_det][None, :] * (avail & ~gt_ignore[None, :])
+                iou_row = ious_sorted[idx_det][None, :]
+                masked_valid = np.where(avail & ~gt_ignore[None, :], iou_row, -1.0)
                 m1 = _argmax_last(masked_valid)  # (T,)
                 ok1 = masked_valid[iou_range, m1] >= thr_eff
-                masked_ign = ious_sorted[idx_det][None, :] * (avail & gt_ignore[None, :])
+                masked_ign = np.where(avail & gt_ignore[None, :], iou_row, -1.0)
                 m2 = _argmax_last(masked_ign)
                 ok2 = masked_ign[iou_range, m2] >= thr_eff
                 m = np.where(ok1, m1, m2)
@@ -496,7 +529,16 @@ class MeanAveragePrecision(Metric):
             pr = np.maximum.accumulate(pr[::-1])[::-1]
 
             prec = np.zeros(nb_rec_thrs)
-            inds_r = np.searchsorted(rc, rec_thresholds, side="left")
+            if self.reference_compat:
+                # the reference runs this lookup in float32 (torch.float rc and
+                # rec_thresholds): at e.g. rc == 7/10 vs threshold 0.7 the f32
+                # values are EQUAL and searchsorted-left includes the entry,
+                # while in f64 linspace's 0.7000000000000001 lands one index
+                # later (the COCOeval/pycocotools f64 behavior of the default
+                # path) — visibly different precision at exact-boundary recalls
+                inds_r = np.searchsorted(rc.astype(np.float32), rec_thresholds.astype(np.float32), side="left")
+            else:
+                inds_r = np.searchsorted(rc, rec_thresholds, side="left")
             valid = inds_r < nd
             prec[valid] = pr[inds_r[valid]]
             precision[idx_iou, :, idx_cls, idx_area, idx_max_det] = prec

@@ -265,3 +265,27 @@ def test_quantized_tie_scenes_match_oracle(seed):
         if np.isnan(got) and (want == -1 or np.isnan(want)):
             continue
         np.testing.assert_allclose(got, want, atol=1e-6, err_msg=key)
+
+
+@pytest.mark.parametrize("seed", [7001, 7023])
+def test_zero_iou_threshold_matches_oracle(seed):
+    """iou_thresholds containing 0.0: under COCOeval's `>=` scan a
+    zero-overlap candidate legitimately matches at t=0, but a detection with
+    NO available candidates (all gts matched/none present for the class in the
+    cell) must not fabricate one. Regression for the masked-argmax 0-threshold
+    edge (round-4 advisor finding): the -1 sentinel keeps the two apart."""
+    rng = np.random.default_rng(seed)
+    preds, targets = _random_scene(rng, n_images=int(rng.integers(2, 6)), n_classes=2)
+    # disjoint far-apart boxes maximise zero-IoU det/gt pairs
+    for d in preds:
+        d["boxes"] = np.asarray(d["boxes"]) + rng.choice([0.0, 500.0], size=(len(d["boxes"]), 1))
+    kw = dict(iou_thresholds=[0.0, 0.5, 0.75])
+    m = MeanAveragePrecision(**kw)
+    m.update(preds, targets)
+    res = m.compute()
+    expected = coco_oracle(preds, targets, iou_thrs=kw["iou_thresholds"])
+    for key, want in expected.items():
+        got = float(np.asarray(res[key]))
+        if np.isnan(got) and (want == -1 or np.isnan(want)):
+            continue
+        np.testing.assert_allclose(got, want, atol=1e-6, err_msg=key)
