@@ -1,8 +1,17 @@
-.PHONY: test doctest clean env multichip bench
+.PHONY: test doctest soak clean env multichip bench
 
-# Test suite on the 8-virtual-device CPU mesh (tests/conftest.py pins the platform).
+# Test suite on the 8-virtual-device CPU mesh (tests/conftest.py pins the platform),
+# then a small fixed-seed slice of the executed-reference fuzz soak — the single
+# highest-yield bug-finder in this project's history (11+ real convention
+# divergences across rounds); fresh seed ranges each round via `make soak`.
 test:
 	python -m pytest tests/ -q
+	python tools/fuzz_soak.py --surfaces all --seeds 500:502
+
+# Wider randomized sweep (pass SEEDS=a:b to pick a fresh range).
+SEEDS ?= 1000:1020
+soak:
+	python tools/fuzz_soak.py --surfaces all --seeds $(SEEDS)
 
 # Docstring examples across the package (reference runs --doctest-modules over src/,
 # /root/reference/Makefile:23-31 + pyproject.toml:28-33). One walker — the same one

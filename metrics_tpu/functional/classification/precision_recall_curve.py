@@ -67,6 +67,7 @@ def _binary_clf_curve(
     target: Array,
     sample_weights: Optional[Array] = None,
     pos_label: int = 1,
+    drop_ignore_sentinel: bool = False,
 ) -> Tuple[Array, Array, Array]:
     """fps/tps/thresholds by descending-score cumsum (reference :27-76).
 
@@ -74,6 +75,12 @@ def _binary_clf_curve(
     threshold point (keeping the last cumsum value per distinct score), matching the
     reference/sklearn ``_binary_clf_curve``. Data-dependent output length — exact mode
     never runs inside jit.
+
+    ``drop_ignore_sentinel`` must be set ONLY by callers whose preds went
+    through the *_format helpers (probabilities in [0, 1], where the in-jit
+    ``ignore_index`` path sentinel-fills with -1): unformatted scores (logits,
+    distances) can legitimately contain -1.0, and silently deleting those rows
+    here would corrupt the curve (round-4 advisor finding).
     """
     if sample_weights is not None and not isinstance(sample_weights, Array):
         sample_weights = jnp.asarray(sample_weights, dtype=jnp.float32)
@@ -86,14 +93,15 @@ def _binary_clf_curve(
             " is sentinel-masked at static shape) can stay fused; run `compute_from`"
             " eagerly."
         )
-    # drop sentinel-marked (in-jit ignore_index) rows; host-side boolean
-    # indexing is fine here — exact compute never runs under a tracer
-    keep = preds != _EXACT_IGNORE_SENTINEL
-    if not bool(keep.all()):
-        preds = preds[keep]
-        target = target[keep]
-        if sample_weights is not None:
-            sample_weights = sample_weights[keep]
+    if drop_ignore_sentinel:
+        # drop sentinel-marked (in-jit ignore_index) rows; host-side boolean
+        # indexing is fine here — exact compute never runs under a tracer
+        keep = preds != _EXACT_IGNORE_SENTINEL
+        if not bool(keep.all()):
+            preds = preds[keep]
+            target = target[keep]
+            if sample_weights is not None:
+                sample_weights = sample_weights[keep]
     order = jnp.argsort(preds)[::-1]
     preds = preds[order]
     target = target[order]
@@ -277,7 +285,7 @@ def _binary_precision_recall_curve_compute(
         return precision, recall, thresholds
 
     preds, target = state
-    fps, tps, thresh = _binary_clf_curve(preds, target, pos_label=pos_label)
+    fps, tps, thresh = _binary_clf_curve(preds, target, pos_label=pos_label, drop_ignore_sentinel=True)
     # plain division, NOT _safe_divide: with zero positives the reference's
     # exact regime yields NaN recall (ref :224-225), which downstream macro
     # reductions then exclude with a warning — a deliberate regime difference

@@ -49,7 +49,7 @@ def _binary_roc_compute(
         return fpr, tpr, thres
 
     preds, target = state
-    fps, tps, thres = _binary_clf_curve(preds, target, pos_label=pos_label)
+    fps, tps, thres = _binary_clf_curve(preds, target, pos_label=pos_label, drop_ignore_sentinel=True)
     # add an extra threshold so the curve starts at (0, 0); the sentinel is a
     # constant 1.0 (reference roc.py:57 — probability semantics), not sklearn's
     # max-score + 1

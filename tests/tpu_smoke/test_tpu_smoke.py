@@ -98,7 +98,8 @@ class TestClassification:
         fps = (probs[None, :] >= thr[:, None]) & (target == 0)
         tpr = tps.sum(1) / max((target == 1).sum(), 1)
         fpr = fps.sum(1) / max((target == 0).sum(), 1)
-        exp = -np.trapz(tpr, fpr)  # fpr decreasing in threshold order
+        trapezoid = getattr(np, "trapezoid", np.trapz)  # numpy<2 fallback
+        exp = -trapezoid(tpr, fpr)  # fpr decreasing in threshold order
         assert got == pytest.approx(exp, abs=1e-6)
 
 
@@ -228,8 +229,9 @@ class TestRuntime:
         """The in-trace psum sync path executes on whatever devices exist (1 on
         the real chip, 8 on the CPU mesh uses only the first here)."""
         from jax.sharding import Mesh
-        from jax.experimental.shard_map import shard_map
         from jax.sharding import PartitionSpec as P
+
+        shard_map = jax.shard_map
 
         acc = MulticlassAccuracy(5, average="micro", validate_args=False)
         mesh = Mesh(np.asarray(jax.devices()[:1]), ("dp",))
