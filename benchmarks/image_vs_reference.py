@@ -93,6 +93,17 @@ def main() -> None:
             lambda: torchmetrics.functional.error_relative_global_dimensionless_synthesis(tp, tt),
         ),
     ]
+    cases.append(
+        (
+            "tv",
+            # single-metric TV: three bandwidth-bound passes; the reference's
+            # multithreaded eager chain wins this row on CPU — quoted as a
+            # loss; the fused-collection row below is the TPU-relevant story
+            jax.jit(lambda p, t: ours.total_variation(p)),
+            lambda: torchmetrics.functional.total_variation(tp),
+        )
+    )
+
     # all OURS rows first (before any torch execution: the resident OMP pool
     # inflates subsequent eager jax/numpy work ~2x — it halved the small psnr/
     # ergas rows when this loop interleaved), then refs, then a second phase
@@ -100,13 +111,52 @@ def main() -> None:
     ours_results = {}
     for name, ours_fn, _ in cases:
         ours_results[name] = _best(lambda ours_fn=ours_fn: ours_fn(jp, jt))
+
+    # TV-in-a-fused-eval-step (VERDICT r4 #6): an image eval step usually
+    # scores several metrics over the SAME batch in ONE jitted program, so
+    # TV's INCREMENTAL cost there is what a user actually pays. Paired with
+    # psnr (a ~1.5 ms base) so the subtraction is above measurement noise —
+    # pairing with ssim (~105 ms) drowned the effect.
+    def fused_base(p, t):
+        return ours.peak_signal_noise_ratio(p, t, data_range=1.0)
+
+    def fused_with_tv(p, t):
+        return (fused_base(p, t), ours.total_variation(p))
+
+    t_base, _ = _best(lambda f=jax.jit(fused_base): f(jp, jt))
+    t_with, _ = _best(lambda f=jax.jit(fused_with_tv): f(jp, jt))
+    def ref_base():
+        return torchmetrics.functional.peak_signal_noise_ratio(tp, tt, data_range=1.0)
+
+    def ref_with():
+        return (ref_base(), torchmetrics.functional.total_variation(tp))
+
+    t_ref_base, _ = _best(ref_base)
+    t_ref_with, _ = _best(ref_with)
+    print(
+        json.dumps(
+            {
+                "metric": "tv incremental cost inside a fused eval step (psnr [+tv])",
+                "value": round(max(t_with - t_base, 0.0) * 1e3, 2),
+                "unit": "ms",
+                "reference_ms": round(max(t_ref_with - t_ref_base, 0.0) * 1e3, 2),
+                "note": "one jitted program scoring the same batch vs the reference's "
+                        "eager chain added on top; pairs TV with the cheap psnr base "
+                        "so the subtraction is above noise",
+                "config": {"batch": B, "channels": C, "size": [H, W], "hardware": "same CPU, same process"},
+            }
+        )
+    )
+
     for name, ours_fn, ref_fn in cases:
         t_ours, v_ours = ours_results[name]
         t_ref, v_ref = _best(ref_fn)
         t_ours = min(t_ours, _best(lambda ours_fn=ours_fn: ours_fn(jp, jt))[0])
         t_ref = min(t_ref, _best(ref_fn)[0])
         v_ours, v_ref = float(np.asarray(v_ours)), float(v_ref)
-        assert abs(v_ours - v_ref) < 2e-4, (name, v_ours, v_ref)
+        # relative tolerance: TV sums O(1e5) absolute values where the scoring
+        # metrics are O(1) means
+        assert abs(v_ours - v_ref) <= 2e-4 * max(1.0, abs(v_ref)), (name, v_ours, v_ref)
         print(
             json.dumps(
                 {

@@ -325,6 +325,21 @@ def bench_roofline() -> None:
          achieved_gflop_s=round(flops / (ms / 1e3) / 1e9, 1),
          config={"n": Npw, "d": D, "dtype": "f32", "bound": "MXU GEMM"})
 
+    # --- 5b. total variation — pure bandwidth row (VERDICT r4 #6) ----------
+    # The one benchmark row the reference wins on CPU (0.81x single-metric,
+    # image_vs_reference.py); on TPU the same three passes ride 819 GB/s HBM.
+    from metrics_tpu.functional.image import total_variation
+
+    Ntv, Htv = (16, 256) if big else (8, 128)
+    img_tv = jnp.asarray(rng.uniform(size=(Ntv, 3, Htv, Htv)).astype(np.float32))
+    tv_fn = jax.jit(total_variation)
+    ms = timed(lambda: tv_fn(img_tv))
+    tv_bytes = 4 * Ntv * 3 * Htv * Htv  # one f32 read of the image per pass pair
+    emit("roofline total_variation", ms,
+         mpixels_per_s=round(Ntv * 3 * Htv * Htv / (ms / 1e3) / 1e6, 1),
+         achieved_gb_s=round(tv_bytes / (ms / 1e3) / 1e9, 2),
+         config={"images": Ntv, "hw": Htv, "bound": "memory (abs-diff reduce)"})
+
     # --- 6. detection ingest — overlapped D2H, boxes/s ---------------------
     from metrics_tpu.detection import MeanAveragePrecision
 
