@@ -1,0 +1,106 @@
+"""Pin: the reference's grouped MetricCollection double-counts after add_metrics.
+
+Found by the round-5 ``collections`` fuzz-soak surface (tools/fuzz_soak.py,
+seed 9007). Mechanism in the reference (src/torchmetrics/collections.py):
+
+- the formation round merges value-equal metrics and immediately aliases the
+  leader's state tensors onto members (``_compute_groups_create_state_ref``,
+  :265-282 — same tensor OBJECTS);
+- ``add_metrics`` (:317-374) resets ``_groups_checked`` WITHOUT breaking that
+  aliasing;
+- the next update therefore runs per-metric again (:193-196), and every
+  ex-member's in-place ``+=`` lands on the ONE shared tensor — the batch is
+  counted once per ex-member.
+
+Ours deepcopies member states at ``add_metrics`` before re-arbitration
+(metrics_tpu/collections.py), so grouped == ungrouped == the reference's OWN
+ungrouped collection; the reference's grouped result deviates from all three.
+This file keeps the deviation on record as the reference's, not ours.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from metrics_tpu import MetricCollection
+from metrics_tpu.classification import MulticlassAccuracy, MulticlassJaccardIndex
+
+
+def _batches(rng, n_batches=3, n=40, nc=5):
+    out = []
+    for _ in range(n_batches):
+        probs = rng.random((n, nc)).astype(np.float32)
+        probs /= probs.sum(-1, keepdims=True)
+        out.append((probs, rng.integers(0, nc, n)))
+    return out
+
+
+def _drive(col, batches, to_x, add_fn):
+    for j, (p, t) in enumerate(batches):
+        col.update(to_x(p), to_x(t))
+        if j == 0:
+            col.add_metrics({"extra": add_fn()})
+    return {k: np.asarray(v, np.float64) for k, v in _to_np(col.compute()).items()}
+
+
+def _to_np(d):
+    out = {}
+    for k, v in d.items():
+        out[k] = v.numpy() if hasattr(v, "numpy") and not isinstance(v, (np.ndarray, jnp.ndarray)) else np.asarray(v)
+    return out
+
+
+@pytest.mark.parametrize("seed", [9007, 9101, 9102])
+def test_grouped_add_metrics_midstream_is_exact_here_and_buggy_in_reference(tm, torch, seed):
+    rng = np.random.default_rng(seed)
+    batches = _batches(rng)
+    nc = 5
+
+    def ours_metrics():
+        return {
+            "j1": MulticlassJaccardIndex(num_classes=nc, average="micro"),
+            "j2": MulticlassJaccardIndex(num_classes=nc, average="micro"),
+        }
+
+    ours_g = _drive(
+        MetricCollection(ours_metrics(), compute_groups=True), batches, jnp.asarray,
+        lambda: MulticlassAccuracy(num_classes=nc, average="macro"),
+    )
+    ours_u = _drive(
+        MetricCollection(ours_metrics(), compute_groups=False), batches, jnp.asarray,
+        lambda: MulticlassAccuracy(num_classes=nc, average="macro"),
+    )
+
+    import torchmetrics.classification as ref_c
+
+    def ref_metrics():
+        return {
+            "j1": ref_c.MulticlassJaccardIndex(num_classes=nc, average="micro"),
+            "j2": ref_c.MulticlassJaccardIndex(num_classes=nc, average="micro"),
+        }
+
+    ref_g = _drive(
+        tm.MetricCollection(ref_metrics(), compute_groups=True), batches, torch.tensor,
+        lambda: ref_c.MulticlassAccuracy(num_classes=nc, average="macro"),
+    )
+    ref_u = _drive(
+        tm.MetricCollection(ref_metrics(), compute_groups=False), batches, torch.tensor,
+        lambda: ref_c.MulticlassAccuracy(num_classes=nc, average="macro"),
+    )
+
+    # ours: grouped == ungrouped == reference-ungrouped (the correct value)
+    for k in ours_g:
+        np.testing.assert_allclose(ours_g[k], ours_u[k], atol=1e-6, err_msg=k)
+        np.testing.assert_allclose(ours_g[k], ref_u[k], atol=1e-5, err_msg=k)
+
+    # the reference's grouped path double-counts batch 2 in the merged group:
+    # its own grouped and ungrouped results DISAGREE on the jaccard keys
+    assert not np.allclose(ref_g["j1"], ref_u["j1"], atol=1e-6), (
+        "reference grouped == ungrouped here — its add_metrics aliasing bug "
+        "appears fixed; re-evaluate whether ours should match the grouped path"
+    )
+    # and the disagreement is exactly a double-counted second batch, not noise
+    assert abs(float(ref_g["j1"]) - float(ref_u["j1"])) > 1e-5

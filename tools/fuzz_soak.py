@@ -39,6 +39,13 @@ with an in-repo pin or provenance note):
   differ: the REFERENCE reshapes the joint bincount to a square table and
   crashes ("shape '[r, r]' is invalid"); ours builds the rectangular table
   (same test file, pinned vs numpy oracles),
+- grouped MetricCollection with ``add_metrics`` mid-stream: the REFERENCE
+  double-counts the next batch in previously-merged groups (its formation
+  re-run leaves member states tensor-aliased and each member's in-place `+=`
+  hits the shared tensor); ours breaks the aliasing at add_metrics and equals
+  the reference's OWN ungrouped result exactly — the surface arbitrates via
+  ref-ungrouped; pinned in tests/parity/test_collections_reference_bug.py
+  (found by this surface, seed 9007, round 5),
 - mean_ap on some random scenes (~3e-4..3e-3 on map/map_50): the REFERENCE
   deviates from the COCO protocol there — the independent COCOeval oracle
   agrees with ours exactly on every such scene
@@ -403,6 +410,102 @@ def soak_wrappers_aggregation(seeds) -> None:
                  lambda: run_agg(ref_tm, torch.tensor))
 
 
+def soak_collections(seeds) -> None:
+    """MetricCollection compute-group machinery under randomized composition:
+    random metric subsets/configs, random batch splits, grouped AND ungrouped,
+    vs the reference's grouped collection — with mid-stream ``add_metrics``
+    and copy-on-read ``items()`` reads thrown in. Targets the round-5 changes
+    (structural seeding, leaders-only formation, aliasing breaks): a grouping
+    bug shows up as grouped/ungrouped divergence or drift from the reference
+    even when every individual metric is correct."""
+    import metrics_tpu as ours_tm
+    import metrics_tpu.classification as ours_c
+    import torchmetrics as ref_tm
+    import torchmetrics.classification as ref_c
+
+    def _candidates(rng, nc):
+        avg = lambda: str(rng.choice(["micro", "macro", "weighted"]))
+        norm = lambda: rng.choice([None, "true"])
+        cands = [
+            lambda a=avg(): ("MulticlassAccuracy", dict(num_classes=nc, average=a)),
+            lambda a=avg(): ("MulticlassPrecision", dict(num_classes=nc, average=a)),
+            lambda a=avg(): ("MulticlassRecall", dict(num_classes=nc, average=a)),
+            lambda a=avg(): ("MulticlassF1Score", dict(num_classes=nc, average=a)),
+            lambda a=avg(): ("MulticlassSpecificity", dict(num_classes=nc, average=a)),
+            lambda a=avg(): ("MulticlassJaccardIndex", dict(num_classes=nc, average=a)),
+            lambda n=norm(): ("MulticlassConfusionMatrix", dict(num_classes=nc, normalize=None if n is None else str(n))),
+            lambda: ("MulticlassAUROC", dict(num_classes=nc, thresholds=20)),
+        ]
+        k = int(rng.integers(3, 7))
+        picks = rng.choice(len(cands), size=k, replace=True)
+        return [cands[i]() for i in picks]
+
+    for seed in seeds:
+        rng = np.random.default_rng(seed)
+        nc = 5
+        n = int(rng.integers(60, 300))
+        probs = rng.random((n, nc)).astype(np.float32)
+        probs /= probs.sum(-1, keepdims=True)
+        target = rng.integers(0, nc, n)
+        cuts = np.sort(rng.choice(np.arange(1, n), size=int(rng.integers(1, 4)), replace=False))
+        spans = list(zip([0, *cuts.tolist()], [*cuts.tolist(), n]))
+        specs = _candidates(rng, nc)
+        do_add = bool(rng.integers(0, 2))
+        do_read = bool(rng.integers(0, 2))
+        add_spec = ("MulticlassAccuracy", dict(num_classes=nc, average="macro"))
+
+        def _build(mod, grouped):
+            metrics = {f"m{i}": getattr(mod, name)(**kw) for i, (name, kw) in enumerate(specs)}
+            lib = ours_tm if mod is ours_c else ref_tm
+            return lib.MetricCollection(metrics, compute_groups=grouped)
+
+        def _run(col, to_x, mod):
+            for j, (lo, hi) in enumerate(spans):
+                col.update(to_x(probs[lo:hi]), to_x(target[lo:hi]))
+                if j == 0 and do_read:
+                    list(col.items())  # copy-on-read escape hatch mid-stream
+                if j == 0 and do_add:
+                    name, kw = add_spec
+                    col.add_metrics({"extra": getattr(mod, name)(**kw)})
+            out = col.compute()
+            return tuple(out[k] for k in sorted(out))
+
+        tag = f"collection/{len(specs)}m add={do_add} read={do_read}"
+        ours_grouped = _run(_build(ours_c, True), jnp.asarray, ours_c)
+        ours_ungrouped = _run(_build(ours_c, False), jnp.asarray, ours_c)
+        # grouped vs ungrouped must agree EXACTLY in our own library
+        try:
+            for a, b in zip(ours_grouped, ours_ungrouped):
+                np.testing.assert_allclose(np.asarray(a, np.float64), np.asarray(b, np.float64), atol=1e-6)
+        except AssertionError as exc:
+            FAILS.append((seed, tag + " grouped-vs-ungrouped", str(exc)[:160]))
+
+        def _vals(v):
+            return [np.asarray(torch.as_tensor(x).numpy() if not isinstance(x, (np.ndarray, jnp.ndarray)) else x, np.float64) for x in v]
+
+        ref_grouped = _vals(_run(_build(ref_c, True), torch.tensor, ref_c))
+        agree_grouped = all(
+            a.shape == b.shape and np.allclose(a, b, atol=1e-5, rtol=1e-4, equal_nan=True)
+            for a, b in zip(_vals(ours_grouped), ref_grouped)
+        )
+        if not agree_grouped:
+            # Arbitrate against the reference's OWN ungrouped collection: when
+            # add_metrics lands mid-stream, the reference's grouped path
+            # double-counts the next batch in previously-merged groups (its
+            # formation re-run leaves member states aliased and every member's
+            # in-place `+=` hits the shared tensor; pinned in
+            # tests/parity/test_collections_reference_bug.py). Ours breaking
+            # the aliasing at add_metrics IS the correct behavior, so equality
+            # with ref-ungrouped means the reference deviated, not us.
+            ref_ungrouped = _vals(_run(_build(ref_c, False), torch.tensor, ref_c))
+            agree_ungrouped = all(
+                a.shape == b.shape and np.allclose(a, b, atol=1e-5, rtol=1e-4, equal_nan=True)
+                for a, b in zip(_vals(ours_grouped), ref_ungrouped)
+            )
+            if not agree_ungrouped:
+                FAILS.append((seed, tag, "ours-grouped matches neither ref-grouped nor ref-ungrouped"))
+
+
 def soak_detection(seeds) -> None:
     """Randomized COCO scenes through both mAP implementations (the reference
     runs with the in-test torchvision box ops from the parity conftest);
@@ -478,6 +581,7 @@ SURFACES = {
     "image_audio": soak_image_audio,
     "modules": soak_modules,
     "wrappers_aggregation": soak_wrappers_aggregation,
+    "collections": soak_collections,
     "detection": soak_detection,
 }
 
