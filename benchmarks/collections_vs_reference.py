@@ -114,12 +114,42 @@ def main() -> None:
 
         return col, fn
 
+    def run_ours_forward(groups):
+        col = _make(ours_tm, ours, groups)
+        col.update(jp, jt)  # forms groups
+
+        def fn():
+            out = None
+            for _ in range(STEPS):
+                out = col.forward(jp, jt)
+            return out
+
+        return col, fn
+
+    def run_ref_forward(groups):
+        col = _make(ref_tm, ref, groups)
+        col.update(tp, tt)
+
+        def fn():
+            out = None
+            for _ in range(STEPS):
+                out = col.forward(tp, tt)
+            return out
+
+        return col, fn
+
     # ours first (pre-torch; see retrieval_vs_reference.py on OMP contamination),
     # then two-phase per-library best-of
     col_og, fn_og = run_ours(True)
     t_ours_g, _ = _best(fn_og, REPS)
     col_ou, fn_ou = run_ours(False)
     t_ours_u, _ = _best(fn_ou, REPS)
+    # grouped forward (round 5): one update per GROUP on the hot path; the
+    # reference's forward always runs every metric even with groups formed
+    col_fg, fn_fg = run_ours_forward(True)
+    t_fwd_g, v_fwd_g = _best(fn_fg, REPS)
+    col_fu, fn_fu = run_ours_forward(False)
+    t_fwd_u, v_fwd_u = _best(fn_fu, REPS)
     # formation round for ours also measured pre-torch (same protocol)
     fp_small, ft_small = jnp.asarray(preds[:10_000]), jnp.asarray(target[:10_000])
     t_form_ours, _ = _best(lambda: _make(ours_tm, ours, True).update(fp_small, ft_small), 5)
@@ -127,10 +157,26 @@ def main() -> None:
     t_ref_g, _ = _best(fn_rg, REPS)
     col_ru, fn_ru = run_ref(False)
     t_ref_u, _ = _best(fn_ru, REPS)
+    col_rfg, fn_rfg = run_ref_forward(True)
+    t_ref_fwd_g, v_ref_fwd_g = _best(fn_rfg, REPS)
+    col_rfu, fn_rfu = run_ref_forward(False)
+    t_ref_fwd_u, _ = _best(fn_rfu, REPS)
     t_ours_g = min(t_ours_g, _best(fn_og, REPS)[0])
     t_ours_u = min(t_ours_u, _best(fn_ou, REPS)[0])
+    t_fwd_g = min(t_fwd_g, _best(fn_fg, REPS)[0])
+    t_fwd_u = min(t_fwd_u, _best(fn_fu, REPS)[0])
     t_ref_g = min(t_ref_g, _best(fn_rg, REPS)[0])
     t_ref_u = min(t_ref_u, _best(fn_ru, REPS)[0])
+    t_ref_fwd_g = min(t_ref_fwd_g, _best(fn_rfg, REPS)[0])
+    t_ref_fwd_u = min(t_ref_fwd_u, _best(fn_rfu, REPS)[0])
+
+    # per-batch forward values equal across all three forward paths
+    for k, v in v_fwd_g.items():
+        np.testing.assert_allclose(np.asarray(v, np.float64), np.asarray(v_fwd_u[k], np.float64),
+                                   atol=1e-5, err_msg=("forward", k))
+        np.testing.assert_allclose(np.asarray(v, np.float64),
+                                   np.asarray(v_ref_fwd_g[k].numpy(), np.float64),
+                                   atol=1e-5, err_msg=("forward-vs-ref", k))
 
     v_og = {k: np.asarray(v, np.float64) for k, v in col_og.compute().items()}
     for col in (col_ou,):
@@ -164,6 +210,8 @@ def main() -> None:
     rows = [
         ("collection_grouped steady-state update (6 metrics, shared stat-scores state)", t_ours_g, t_ref_g),
         ("collection_ungrouped steady-state update (6 metrics)", t_ours_u, t_ref_u),
+        ("collection_grouped forward — batch value + accumulate (one update per GROUP)", t_fwd_g, t_ref_fwd_g),
+        ("collection_ungrouped forward", t_fwd_u, t_ref_fwd_u),
     ]
     for name, t_o, t_r in rows:
         print(

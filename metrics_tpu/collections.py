@@ -193,7 +193,8 @@ class MetricCollection:
     _INSTANCE_ATTR_SKIP = frozenset({
         "_device", "_defaults", "_persistent", "_reductions", "_update_count",
         "_computed", "_to_sync", "_should_unsync", "_enable_grad", "_cache",
-        "_is_synced", "_update_called", "_forward_cache", "update", "compute",
+        "_is_synced", "_update_called", "_forward_cache", "_batch_state",
+        "update", "compute",
     })
 
     @classmethod
@@ -430,8 +431,38 @@ class MetricCollection:
         self._state_is_copy = copy
 
     def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
-        """Per-batch value from every metric (reference :167-175)."""
-        res = {k: m(*args, **m._filter_kwargs(**kwargs)) for k, m in self._modules.items()}
+        """Per-batch value from every metric (reference :167-175).
+
+        Beyond-parity: once compute groups are known, each group runs ONE
+        forward (the leader's) and members derive their batch value from the
+        leader's stashed batch-only state via their own ``compute``
+        (`Metric._compute_batch_value`) — the training-loop hot path pays one
+        update per GROUP, where the reference's forward always pays one update
+        per METRIC even with groups formed (ref :167-175 iterates all).
+        Exactly as sound as the grouped ``update``: members share the
+        leader's state evolution by the group invariant both libraries rely
+        on. Formation still happens in ``update`` (as in the reference —
+        forward never forms groups in either library).
+        """
+        if self._groups_checked:
+            by_name: Dict[str, Any] = {}
+            for cg in self._groups.values():
+                m0 = self._modules[cg[0]]
+                by_name[cg[0]] = m0(*args, **m0._filter_kwargs(**kwargs))
+                for name in cg[1:]:
+                    mi = self._modules[name]
+                    if m0._batch_state is not None:
+                        by_name[name] = mi._compute_batch_value(m0._batch_state)
+                    else:
+                        # leader's forward didn't stash a batch state (custom
+                        # forward override): member pays its own forward
+                        by_name[name] = mi(*args, **mi._filter_kwargs(**kwargs))
+            if self._state_is_copy:
+                self._compute_groups_create_state_ref(copy=False)
+                self._state_is_copy = False
+            res = {k: by_name[k] for k in self._modules}
+        else:
+            res = {k: m(*args, **m._filter_kwargs(**kwargs)) for k, m in self._modules.items()}
         res, _ = _flatten_dict(res)
         return {self._set_name(k): v for k, v in res.items()}
 

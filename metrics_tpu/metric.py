@@ -193,6 +193,7 @@ class Metric(ABC):
 
         self._update_called = False
         self._forward_cache: Any = None
+        self._batch_state: Optional[Dict[str, StateValue]] = None
 
         # wrap update/compute on the instance (reference metric.py:92-93)
         self.update: Callable = self._wrap_update(self.update)  # type: ignore[method-assign]
@@ -327,6 +328,14 @@ class Metric(ABC):
 
         return wrapped_func
 
+    def _snapshot_state(self) -> Dict[str, StateValue]:
+        """Shallow snapshot of all state attrs: immutable-array references plus
+        shallow list copies (list states mutate in place during update)."""
+        return {
+            attr: (list(v) if isinstance(v, list) else v)
+            for attr, v in ((a, getattr(self, a)) for a in self._defaults)
+        }
+
     def forward(self, *args: Any, **kwargs: Any) -> Any:
         """Accumulate global state AND return the metric value on this batch.
 
@@ -347,12 +356,15 @@ class Metric(ABC):
         self.update(*args, **kwargs)
         _update_count = self._update_count
         self._to_sync = self.dist_sync_on_step
-        cache = {attr: getattr(self, attr) for attr in self._defaults}
-        cache = {k: list(v) if isinstance(v, list) else v for k, v in cache.items()}
+        cache = self._snapshot_state()
         self._should_unsync = False
         # reset to default values and compute batch-only value
         self.reset()
         self.update(*args, **kwargs)
+        # stash the batch-only state for compute-group members
+        # (MetricCollection's grouped forward), before the global state is
+        # restored
+        self._batch_state = self._snapshot_state()
         batch_val = self.compute()
         # restore context
         for attr, val in cache.items():
@@ -366,8 +378,7 @@ class Metric(ABC):
 
     def _forward_reduce_state_update(self, *args: Any, **kwargs: Any) -> Any:
         """1×-update path + associative state merge (reference metric.py:297-363)."""
-        global_state = {attr: getattr(self, attr) for attr in self._defaults}
-        global_state = {k: list(v) if isinstance(v, list) else v for k, v in global_state.items()}
+        global_state = self._snapshot_state()
         _update_count = self._update_count
         self.reset()
 
@@ -375,6 +386,9 @@ class Metric(ABC):
         self._should_unsync = False
 
         self.update(*args, **kwargs)
+        # stash the batch-only state for compute-group members (see
+        # _forward_full_state_update for the rationale)
+        self._batch_state = self._snapshot_state()
         batch_val = self.compute()
 
         self._update_count = _update_count + 1
@@ -384,6 +398,40 @@ class Metric(ABC):
         self._to_sync = self.sync_on_compute
         self._computed = None
         self._forward_cache = batch_val
+        return batch_val
+
+    def _compute_batch_value(self, batch_state: Dict[str, StateValue]) -> Any:
+        """This metric's per-batch forward value from an externally supplied
+        batch-only state (a group leader's ``_batch_state``).
+
+        Used by MetricCollection's grouped ``forward``: a compute-group member
+        shares the leader's state evolution by group invariant, so its batch
+        value is its OWN ``compute`` over the leader's batch state — no second
+        update. The flag dance mirrors ``_forward_reduce_state_update`` (sync
+        iff ``dist_sync_on_step``, like any forward batch value); this metric's
+        stale global state is untouched (the group machinery re-aliases it from
+        the leader at the next read).
+        """
+        saved = {attr: getattr(self, attr) for attr in self._defaults}
+        saved_count = self._update_count
+        for attr, val in batch_state.items():
+            setattr(self, attr, val)
+        self._update_count = 1
+        self._update_called = True
+        self._to_sync = self.dist_sync_on_step
+        self._should_unsync = False
+        self._computed = None
+        batch_val = None
+        try:
+            batch_val = self.compute()
+        finally:
+            for attr, val in saved.items():
+                setattr(self, attr, val)
+            self._update_count = saved_count
+            self._should_unsync = True
+            self._to_sync = self.sync_on_compute
+            self._computed = None
+            self._forward_cache = batch_val
         return batch_val
 
     def _reduce_states(self, incoming_state: Dict[str, StateValue]) -> None:
@@ -634,6 +682,9 @@ class Metric(ABC):
         self._update_count = 0
         self._update_called = False
         self._computed = None
+        # drop the grouped-forward stash: it pins the last batch's whole state
+        # (for cat-state metrics, the batch's preds/target arrays) otherwise
+        self._batch_state = None
 
         for attr, default in self._defaults.items():
             if isinstance(default, list):

@@ -459,3 +459,60 @@ def test_state_dict_after_leaders_only_update_serializes_member_states():
     sd = mc.state_dict()
     assert float(np.asarray(sd["p1.x"])) == 5.0, sd
     assert float(np.asarray(sd["p2.x"])) == 5.0, sd
+
+
+def test_grouped_forward_matches_ungrouped_per_batch():
+    """Round-5 beyond-parity: after groups form, collection.forward runs ONE
+    update per group, members deriving their batch value from the leader's
+    stashed batch state. Per-batch values AND final accumulated computes must
+    equal the ungrouped collection's exactly."""
+    rng = np.random.default_rng(11)
+    C = 6
+
+    def make(grouped):
+        return MetricCollection(
+            {
+                "acc": MulticlassAccuracy(C, average="micro"),
+                "prec": MulticlassPrecision(C),
+                "rec": MulticlassRecall(C),
+                "f1": MulticlassF1Score(C),
+                "cm": MulticlassConfusionMatrix(C),
+            },
+            compute_groups=grouped,
+        )
+
+    g, u = make(True), make(False)
+    # first batch via update() so groups form, then forward-driven batches
+    p0, t0 = rng.integers(0, C, 100), rng.integers(0, C, 100)
+    g.update(jnp.asarray(p0), jnp.asarray(t0))
+    u.update(jnp.asarray(p0), jnp.asarray(t0))
+    assert any(len(cg) > 1 for cg in g.compute_groups.values())
+    for _ in range(3):
+        p, t = rng.integers(0, C, 80), rng.integers(0, C, 80)
+        fg = g.forward(jnp.asarray(p), jnp.asarray(t))
+        fu = u.forward(jnp.asarray(p), jnp.asarray(t))
+        assert fg.keys() == fu.keys()
+        for k in fg:
+            np.testing.assert_allclose(np.asarray(fg[k], np.float64), np.asarray(fu[k], np.float64),
+                                       atol=1e-6, err_msg=k)
+    cg_res, cu_res = g.compute(), u.compute()
+    for k in cg_res:
+        np.testing.assert_allclose(np.asarray(cg_res[k], np.float64), np.asarray(cu_res[k], np.float64),
+                                   atol=1e-6, err_msg=k)
+
+
+def test_grouped_forward_before_formation_matches_ungrouped():
+    """forward() before any update (groups unformed) takes the per-metric
+    path; values and later accumulation must still be exact."""
+    rng = np.random.default_rng(12)
+    C = 4
+    g = MetricCollection([MulticlassPrecision(C), MulticlassRecall(C)], compute_groups=True)
+    u = MetricCollection([MulticlassPrecision(C), MulticlassRecall(C)], compute_groups=False)
+    for _ in range(2):
+        p, t = rng.integers(0, C, 50), rng.integers(0, C, 50)
+        fg = g.forward(jnp.asarray(p), jnp.asarray(t))
+        fu = u.forward(jnp.asarray(p), jnp.asarray(t))
+        for k in fg:
+            np.testing.assert_allclose(np.asarray(fg[k]), np.asarray(fu[k]), atol=1e-6, err_msg=k)
+    for k, v in g.compute().items():
+        np.testing.assert_allclose(np.asarray(v), np.asarray(u.compute()[k]), atol=1e-6, err_msg=k)

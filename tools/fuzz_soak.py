@@ -459,16 +459,26 @@ def soak_collections(seeds) -> None:
             lib = ours_tm if mod is ours_c else ref_tm
             return lib.MetricCollection(metrics, compute_groups=grouped)
 
+        use_forward = bool(rng.integers(0, 2))
+
         def _run(col, to_x, mod):
+            fwd_vals = []
             for j, (lo, hi) in enumerate(spans):
-                col.update(to_x(probs[lo:hi]), to_x(target[lo:hi]))
+                if use_forward and j > 0:
+                    # forward after formation: exercises the grouped forward
+                    # (one update per group + member batch values from the
+                    # leader's stashed batch state)
+                    out = col.forward(to_x(probs[lo:hi]), to_x(target[lo:hi]))
+                    fwd_vals.append(tuple(out[k] for k in sorted(out)))
+                else:
+                    col.update(to_x(probs[lo:hi]), to_x(target[lo:hi]))
                 if j == 0 and do_read:
                     list(col.items())  # copy-on-read escape hatch mid-stream
                 if j == 0 and do_add:
                     name, kw = add_spec
                     col.add_metrics({"extra": getattr(mod, name)(**kw)})
             out = col.compute()
-            return tuple(out[k] for k in sorted(out))
+            return tuple(out[k] for k in sorted(out)) + tuple(v for vs in fwd_vals for v in vs)
 
         tag = f"collection/{len(specs)}m add={do_add} read={do_read}"
         ours_grouped = _run(_build(ours_c, True), jnp.asarray, ours_c)
