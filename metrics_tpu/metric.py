@@ -370,6 +370,11 @@ class Metric(ABC):
         for attr, val in cache.items():
             setattr(self, attr, val)
         self._update_count = _update_count
+        # with dist_sync_on_step the compute above synced WITHOUT unsyncing
+        # (should_unsync=False); drop the sync marker or the next forward
+        # raises "shouldn't be synced" (reference metric.py:286 does the same)
+        self._is_synced = False
+        self._cache = None
         self._should_unsync = True
         self._to_sync = self.sync_on_compute
         self._computed = None
@@ -394,6 +399,10 @@ class Metric(ABC):
         self._update_count = _update_count + 1
         self._reduce_states(global_state)
 
+        # see _forward_full_state_update: clear the dist_sync_on_step sync
+        # marker (reference metric.py:325)
+        self._is_synced = False
+        self._cache = None
         self._should_unsync = True
         self._to_sync = self.sync_on_compute
         self._computed = None
@@ -428,6 +437,8 @@ class Metric(ABC):
             for attr, val in saved.items():
                 setattr(self, attr, val)
             self._update_count = saved_count
+            self._is_synced = False
+            self._cache = None
             self._should_unsync = True
             self._to_sync = self.sync_on_compute
             self._computed = None

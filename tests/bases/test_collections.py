@@ -516,3 +516,37 @@ def test_grouped_forward_before_formation_matches_ungrouped():
             np.testing.assert_allclose(np.asarray(fg[k]), np.asarray(fu[k]), atol=1e-6, err_msg=k)
     for k, v in g.compute().items():
         np.testing.assert_allclose(np.asarray(v), np.asarray(u.compute()[k]), atol=1e-6, err_msg=k)
+
+
+def test_grouped_forward_dist_sync_on_step_matches_ungrouped():
+    """Grouped forward under dist_sync_on_step: member batch values must go
+    through the same per-batch sync the leader's value does (the
+    _forward_full_state_update stash site + _compute_batch_value's
+    _to_sync=dist_sync_on_step flag dance)."""
+
+    def double(t, group=None):  # fake 2-rank world: every rank holds the same shard
+        return [t, t]
+
+    kw = dict(dist_sync_on_step=True, dist_sync_fn=double,
+              distributed_available_fn=lambda: True)
+
+    def make(grouped):
+        return MetricCollection(
+            {"p": MulticlassPrecision(NUM_CLASSES, **kw), "r": MulticlassRecall(NUM_CLASSES, **kw)},
+            compute_groups=grouped,
+        )
+
+    rng = np.random.default_rng(5)
+    g, u = make(True), make(False)
+    p0, t0 = rng.integers(0, NUM_CLASSES, 40), rng.integers(0, NUM_CLASSES, 40)
+    g.update(jnp.asarray(p0), jnp.asarray(t0))
+    u.update(jnp.asarray(p0), jnp.asarray(t0))
+    assert any(len(cg) > 1 for cg in g.compute_groups.values())
+    for _ in range(2):
+        p, t = rng.integers(0, NUM_CLASSES, 30), rng.integers(0, NUM_CLASSES, 30)
+        fg = g.forward(jnp.asarray(p), jnp.asarray(t))
+        fu = u.forward(jnp.asarray(p), jnp.asarray(t))
+        for k in fg:
+            np.testing.assert_allclose(np.asarray(fg[k]), np.asarray(fu[k]), atol=1e-6, err_msg=k)
+    for k, v in g.compute().items():
+        np.testing.assert_allclose(np.asarray(v), np.asarray(u.compute()[k]), atol=1e-6, err_msg=k)
