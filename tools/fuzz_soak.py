@@ -311,18 +311,32 @@ def soak_modules(seeds) -> None:
             (ours_r.PearsonCorrCoef(), ref_r.PearsonCorrCoef(), p_reg, t_reg),
             (ours_r.SpearmanCorrCoef(), ref_r.SpearmanCorrCoef(), p_reg, t_reg),
         ]
+        # every other seed drives the dual-path forward (batch value + global
+        # accumulate) instead of plain update — the reference's forward
+        # semantics (full_state_update vs reduce path) are compared per batch
+        # AND through the final compute (the round-5 grouped-forward work
+        # found a real forward-path sync bug, so this path earns fuzz coverage)
+        use_forward = bool(seed % 2)
         for ours_m, ref_m, P, T in pairs:
-            tag = type(ours_m).__name__ + "/stream"
+            tag = type(ours_m).__name__ + ("/fwd-stream" if use_forward else "/stream")
 
             def run_ours(m=ours_m, P=P, T=T):
+                vals = []
                 for lo, hi in spans:
-                    m.update(jnp.asarray(P[lo:hi]), jnp.asarray(T[lo:hi]))
-                return m.compute()
+                    if use_forward:
+                        vals.append(m.forward(jnp.asarray(P[lo:hi]), jnp.asarray(T[lo:hi])))
+                    else:
+                        m.update(jnp.asarray(P[lo:hi]), jnp.asarray(T[lo:hi]))
+                return (m.compute(), *vals)
 
             def run_ref(m=ref_m, P=P, T=T):
+                vals = []
                 for lo, hi in spans:
-                    m.update(torch.tensor(P[lo:hi]), torch.tensor(T[lo:hi]))
-                return m.compute()
+                    if use_forward:
+                        vals.append(m.forward(torch.tensor(P[lo:hi]), torch.tensor(T[lo:hi])))
+                    else:
+                        m.update(torch.tensor(P[lo:hi]), torch.tensor(T[lo:hi]))
+                return (m.compute(), *vals)
 
             _cmp(tag, seed, run_ours, run_ref)
 
