@@ -1,0 +1,81 @@
+"""A/B: multiclass confusion-matrix count — scatter (bincount) vs MXU one-hot matmul.
+
+The round-5 chained-device roofline capture showed the (C, C) count at ~6.6 ms
+for 1M samples x 100 classes on the v5e: `jnp.bincount(t*C + p)` lowers to a
+serialized scatter-add, the one op family the TPU is bad at. The candidate
+lowering builds the two (N, C) one-hots in bf16 (0/1 exact) and rides the MXU:
+``cm = dot(oh_t.T, oh_p, preferred_element_type=f32)`` — every product is an
+exact 0/1 and the f32 accumulation is exact for any per-update N < 2**24.
+
+Timing uses the same two-point chained-loop protocol as suite.py's
+``timed_device`` (launch latency cancels in the k2-k1 difference; the loop body
+shifts inputs by the loop index so XLA cannot hoist it; jnp.max over the output
+prevents DCE without being algebraically collapsible).
+
+Run on the chip: ``python benchmarks/experiments/onehot_confmat_tpu.py``
+(appends one row per variant to benchmarks/suite_runs.jsonl, metric names
+``experiment confmat/*``).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tools.chained_timing import timed_device
+from tools.jsonl_log import append_jsonl
+
+RUNS = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "suite_runs.jsonl")
+BACKEND = jax.devices()[0].platform
+
+
+def cm_bincount(p, t, C):
+    bins = jnp.bincount(t * C + p, length=C * C)
+    return bins.reshape(C, C)
+
+
+def cm_onehot_matmul(p, t, C):
+    oh_t = jax.nn.one_hot(t, C, dtype=jnp.bfloat16)
+    oh_p = jax.nn.one_hot(p, C, dtype=jnp.bfloat16)
+    cm = jax.lax.dot_general(oh_t, oh_p, (((0,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    return cm.astype(jnp.int32)
+
+
+def main() -> None:
+    rng = np.random.default_rng(11)
+    M, C = (1_000_000, 100) if BACKEND != "cpu" else (200_000, 100)
+    p = jnp.asarray(rng.integers(0, C, M).astype(np.int32))
+    t = jnp.asarray(rng.integers(0, C, M).astype(np.int32))
+
+    a = jax.jit(lambda p_, t_: cm_bincount(p_, t_, C))(p, t)
+    b = jax.jit(lambda p_, t_: cm_onehot_matmul(p_, t_, C))(p, t)
+    assert (np.asarray(a) == np.asarray(b)).all(), "lowerings disagree"
+
+    for name, fn, k1, k2 in [("bincount-scatter", cm_bincount, 10, 50),
+                             ("onehot-mxu-matmul", cm_onehot_matmul, 100, 500)]:
+        ms = timed_device(
+            lambda i, acc, fn=fn: acc + jnp.max(fn((p + i) % C, (t + i) % C, C)),
+            jnp.int32(0), k1, k2)
+        if ms is None:
+            row = {"metric": f"experiment confmat/{name}", "value": None,
+                   "unit": "ms", "backend": BACKEND,
+                   "invalid": "noise-dominated chained capture",
+                   "config": {"samples": M, "classes": C}}
+        else:
+            row = {"metric": f"experiment confmat/{name}", "value": round(ms, 4),
+                   "unit": "ms", "backend": BACKEND,
+                   "samples_per_s": round(M / (ms / 1e3)),
+                   "config": {"samples": M, "classes": C}}
+        print(row)
+        append_jsonl(RUNS, row)
+
+
+if __name__ == "__main__":
+    main()

@@ -34,6 +34,8 @@ from tools.jsonl_log import append_jsonl  # noqa: E402 (needs the sys.path inser
 parser = argparse.ArgumentParser()
 parser.add_argument("--backend", choices=["cpu", "default"], default="cpu")
 parser.add_argument("--steps", type=int, default=20)
+parser.add_argument("--only", choices=["roofline"], default=None,
+                    help="run a single section (roofline) instead of the full suite")
 args = parser.parse_args()
 
 use_cpu = args.backend == "cpu"
@@ -88,6 +90,34 @@ def timed(fn, *run_args, steps=STEPS):
     jax.block_until_ready(out)
     return (time.perf_counter() - t0) / steps * 1e3
 
+
+from tools.chained_timing import timed_device  # noqa: E402 (needs the sys.path insert)
+
+
+def emit_chained(name, ms, disp_ms, config, samples=None, in_bytes=None,
+                 flops=None, pixels=None):
+    """One chained-device roofline row. ``ms=None`` (noise-dominated capture,
+    see tools/chained_timing.py) emits an explicitly invalid row with NO
+    derived rates, instead of a clamped fake-fast number — the first TPU
+    capture durably recorded 0.0 ms / 1e15 samples/s rows that way."""
+    extra = {"per_dispatch_ms": round(disp_ms, 4), "config": config}
+    if ms is None:
+        row = {"metric": name, "value": None, "unit": "ms", "backend": BACKEND,
+               "invalid": "noise-dominated chained capture (diff<=0 after retry)",
+               **extra}
+        print(json.dumps(row))
+        append_jsonl(_RUNS_LOG, dict(row))
+        return
+    rates = {}
+    if samples is not None:
+        rates["samples_per_s"] = round(samples / (ms / 1e3))
+    if in_bytes is not None:
+        rates["achieved_gb_s"] = round(in_bytes / (ms / 1e3) / 1e9, 2)
+    if flops is not None:
+        rates["achieved_gflop_s"] = round(flops / (ms / 1e3) / 1e9, 1)
+    if pixels is not None:
+        rates["mpixels_per_s"] = round(pixels / (ms / 1e3) / 1e6, 1)
+    emit(name, ms, timing="chained-device", **rates, **extra)
 
 
 def _rand_boxes(rng, n):
@@ -257,12 +287,15 @@ def bench_roofline() -> None:
     target_i = jnp.asarray(rng.integers(0, C, M).astype(np.int32))
     step = jax.jit(ss.update_state)
     state = ss.init_state()
-    ms = timed(lambda: step(state, preds_i, target_i))
-    in_bytes = 2 * 4 * M  # int32 preds + target; states are O(C), negligible
-    emit("roofline stat_scores update", ms,
-         samples_per_s=round(M / (ms / 1e3)),
-         achieved_gb_s=round(in_bytes / (ms / 1e3) / 1e9, 2),
-         config={"samples": M, "classes": C, "bound": "memory (input stream)"})
+    disp_ms = timed(lambda: step(state, preds_i, target_i))
+    # chained: shift preds/target by the loop index (mod C) so the body is
+    # loop-variant — one extra elementwise pass, NOT credited in the GB/s
+    ms = timed_device(lambda i, s: step(s, (preds_i + i) % C, (target_i + i) % C),
+                      state, 50, 250)
+    emit_chained("roofline stat_scores update", ms, disp_ms,
+                 {"samples": M, "classes": C, "bound": "memory (input stream)"},
+                 samples=M,
+                 in_bytes=2 * 4 * M)  # int32 preds + target; states O(C), negligible
 
     # --- 2. binned-curve update — comparison matmul (MXU) vs bucketize -----
     from metrics_tpu.functional.classification.precision_recall_curve import (
@@ -274,15 +307,23 @@ def bench_roofline() -> None:
     btarget = jnp.asarray(rng.integers(0, 2, M).astype(np.int32))
     thresholds = jnp.linspace(0, 1, T, dtype=jnp.float32)
     upd = jax.jit(lambda p, t: _binary_precision_recall_curve_update(p, t, thresholds))
-    ms = timed(lambda: upd(probs, btarget))
+    disp_ms = timed(lambda: upd(probs, btarget))
+    # chained: wobble probs by i (sub-f32-ulp, still a runtime add so XLA
+    # cannot hoist). Reduce with max, not sum — the cell-sum of a clf-curve
+    # state algebraically collapses to T*M (XLA simplifies c + (1-c)), and a
+    # [0]-slice would let DCE drop all but one threshold's matvec.
+    ms = timed_device(
+        lambda i, acc: acc + jnp.max(
+            upd((probs + jnp.float32(i) * 1e-12) % 1.0, btarget)).astype(jnp.float32),
+        jnp.float32(0.0), 10, 50)
     # TPU lowering: (T, M) compare + two (T,M)@(M,) matvecs -> ~6*T*M flop-ish;
     # CPU lowering is the bucketized histogram (memory-bound, 8 B/sample)
-    rate = {"achieved_gflop_s": round(6 * T * M / (ms / 1e3) / 1e9, 1)} if big else \
-           {"achieved_gb_s": round(8 * M / (ms / 1e3) / 1e9, 2)}
-    emit("roofline binned_curve update", ms,
-         samples_per_s=round(M / (ms / 1e3)),
-         config={"samples": M, "thresholds": T,
-                 "bound": "MXU comparison-matmul" if big else "memory (bucketized)"}, **rate)
+    emit_chained("roofline binned_curve update", ms, disp_ms,
+                 {"samples": M, "thresholds": T,
+                  "bound": "MXU comparison-matmul" if big else "memory (bucketized)"},
+                 samples=M,
+                 flops=6 * T * M if big else None,
+                 in_bytes=None if big else 8 * M)
 
     # --- 3. confusion matrix update — scatter-add, memory-bound ------------
     from metrics_tpu.classification import MulticlassConfusionMatrix
@@ -290,11 +331,12 @@ def bench_roofline() -> None:
     cm = MulticlassConfusionMatrix(C, validate_args=False)
     cstep = jax.jit(cm.update_state)
     cstate = cm.init_state()
-    ms = timed(lambda: cstep(cstate, preds_i, target_i))
-    emit("roofline confusion_matrix update", ms,
-         samples_per_s=round(M / (ms / 1e3)),
-         achieved_gb_s=round(2 * 4 * M / (ms / 1e3) / 1e9, 2),
-         config={"samples": M, "classes": C, "bound": "memory (input stream)"})
+    disp_ms = timed(lambda: cstep(cstate, preds_i, target_i))
+    ms = timed_device(lambda i, s: cstep(s, (preds_i + i) % C, (target_i + i) % C),
+                      cstate, 50, 250)
+    emit_chained("roofline confusion_matrix update", ms, disp_ms,
+                 {"samples": M, "classes": C, "bound": "memory (input stream)"},
+                 samples=M, in_bytes=2 * 4 * M)
 
     # --- 4. SSIM window pass — banded-matmul separable windows -------------
     from metrics_tpu.functional.image.ssim import structural_similarity_index_measure
@@ -303,15 +345,16 @@ def bench_roofline() -> None:
     img_a = jnp.asarray(rng.uniform(size=(N, 3, H, H)).astype(np.float32))
     img_b = jnp.asarray(rng.uniform(size=(N, 3, H, H)).astype(np.float32))
     ssim_fn = jax.jit(lambda a, b: structural_similarity_index_measure(a, b, data_range=1.0))
-    ms = timed(lambda: ssim_fn(img_a, img_b))
+    disp_ms = timed(lambda: ssim_fn(img_a, img_b))
+    ms = timed_device(
+        lambda i, acc: acc + ssim_fn(img_a + jnp.float32(i) * 1e-12, img_b),
+        jnp.float32(0.0), 20, 100)
     pix = N * 3 * H * H
     win = 11
     # 5 window maps (mu_x, mu_y, x², y², xy), separable = 2 passes × win MACs
-    flops = 5 * 2 * win * 2 * pix
-    emit("roofline ssim window pass", ms,
-         mpixels_per_s=round(pix / (ms / 1e3) / 1e6, 1),
-         achieved_gflop_s=round(flops / (ms / 1e3) / 1e9, 1),
-         config={"images": N, "hw": H, "window": win, "bound": "banded GEMM"})
+    emit_chained("roofline ssim window pass", ms, disp_ms,
+                 {"images": N, "hw": H, "window": win, "bound": "banded GEMM"},
+                 pixels=pix, flops=5 * 2 * win * 2 * pix)
 
     # --- 5. pairwise GEMM — the pure MXU row -------------------------------
     from metrics_tpu.functional import pairwise_cosine_similarity
@@ -319,11 +362,15 @@ def bench_roofline() -> None:
     Npw, D = (4096, 512) if big else (1024, 256)
     X = jnp.asarray(rng.normal(size=(Npw, D)).astype(np.float32))
     pw = jax.jit(lambda x: pairwise_cosine_similarity(x, zero_diagonal=False))
-    ms = timed(lambda: pw(X))
-    flops = 2 * Npw * Npw * D
-    emit("roofline pairwise cosine GEMM", ms,
-         achieved_gflop_s=round(flops / (ms / 1e3) / 1e9, 1),
-         config={"n": Npw, "d": D, "dtype": "f32", "bound": "MXU GEMM"})
+    disp_ms = timed(lambda: pw(X))
+    # max over the full (N, N) output: a [0,0]-slice would let XLA compute a
+    # single dot product instead of the GEMM (observed: 0.0 ms rows)
+    ms = timed_device(
+        lambda i, acc: acc + jnp.max(pw(X + jnp.float32(i) * 1e-12)),
+        jnp.float32(0.0), 20, 100)
+    emit_chained("roofline pairwise cosine GEMM", ms, disp_ms,
+                 {"n": Npw, "d": D, "dtype": "f32", "bound": "MXU GEMM"},
+                 flops=2 * Npw * Npw * D)
 
     # --- 5b. total variation — pure bandwidth row (VERDICT r4 #6) ----------
     # The one benchmark row the reference wins on CPU (0.81x single-metric,
@@ -333,12 +380,14 @@ def bench_roofline() -> None:
     Ntv, Htv = (16, 256) if big else (8, 128)
     img_tv = jnp.asarray(rng.uniform(size=(Ntv, 3, Htv, Htv)).astype(np.float32))
     tv_fn = jax.jit(total_variation)
-    ms = timed(lambda: tv_fn(img_tv))
-    tv_bytes = 4 * Ntv * 3 * Htv * Htv  # one f32 read of the image per pass pair
-    emit("roofline total_variation", ms,
-         mpixels_per_s=round(Ntv * 3 * Htv * Htv / (ms / 1e3) / 1e6, 1),
-         achieved_gb_s=round(tv_bytes / (ms / 1e3) / 1e9, 2),
-         config={"images": Ntv, "hw": Htv, "bound": "memory (abs-diff reduce)"})
+    disp_ms = timed(lambda: tv_fn(img_tv))
+    ms = timed_device(
+        lambda i, acc: acc + tv_fn(img_tv + jnp.float32(i) * 1e-12),
+        jnp.float32(0.0), 50, 250)
+    emit_chained("roofline total_variation", ms, disp_ms,
+                 {"images": Ntv, "hw": Htv, "bound": "memory (abs-diff reduce)"},
+                 pixels=Ntv * 3 * Htv * Htv,
+                 in_bytes=4 * Ntv * 3 * Htv * Htv)  # one f32 image read (lower bound)
 
     # --- 6. detection ingest — overlapped D2H, boxes/s ---------------------
     from metrics_tpu.detection import MeanAveragePrecision
@@ -360,9 +409,12 @@ def bench_roofline() -> None:
 
 
 if __name__ == "__main__":
-    bench_accuracy_single()
-    bench_collection_mesh()
-    bench_detection_map()
-    bench_bert_embedding_states()
-    bench_fid_cov_sync()
-    bench_roofline()
+    if args.only == "roofline":
+        bench_roofline()
+    else:
+        bench_accuracy_single()
+        bench_collection_mesh()
+        bench_detection_map()
+        bench_bert_embedding_states()
+        bench_fid_cov_sync()
+        bench_roofline()

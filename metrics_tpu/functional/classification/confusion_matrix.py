@@ -3,10 +3,19 @@
 Reference parity: src/torchmetrics/functional/classification/confusion_matrix.py
 (binary/multiclass/multilabel + ``_confusion_matrix_reduce`` normalisation).
 
-TPU notes: the multiclass count uses ``jnp.bincount(target*C + preds, length=C*C)``
-(static-shape scatter-add; deterministic on XLA — the reference needed a fallback loop
-for this, data.py:206-228). ``ignore_index`` routes ignored pairs to an overflow bucket
-that is dropped, instead of boolean filtering.
+TPU notes: the multiclass count has two value-identical lowerings chosen at
+trace time per backend. On accelerators it is an MXU one-hot matmul —
+``one_hot(target).T @ one_hot(preds)`` in bf16 with f32 accumulation (0/1
+products are exact in bf16 and the f32 sums are exact for any per-call
+N < 2**24) — measured 33x faster than the scatter on a v5e (0.23 ms vs 7.7 ms
+at 1M samples x 100 classes, 44% of MXU bf16 peak; see
+benchmarks/experiments/onehot_confmat_tpu.py). On the host backend (and for
+N >= 2**24 per call) it is ``jnp.bincount(target*C + preds, length=C*C)``
+(static-shape scatter-add; deterministic on XLA — the reference needed a
+fallback loop for this, data.py:206-228), where the CPU's serial scatter beats
+materializing (N, C) one-hots. ``ignore_index`` routes ignored pairs to an
+overflow bucket (scatter) or zeroes the target row (matmul) instead of boolean
+filtering.
 """
 
 from __future__ import annotations
@@ -86,18 +95,48 @@ def binary_confusion_matrix(
     return _confusion_matrix_reduce(confmat, normalize)
 
 
+def _matmul_lowering_eligible(size: int, num_classes: int) -> bool:
+    """Single source of truth for the accelerator matmul-lowering guard (also
+    imported by stat_scores.py, which routes through the cm on eligibility).
+    2**24: f32-accumulation exactness bound. 2**29: cap the (N, C) bf16
+    one-hot operands at ~2 GiB — beyond that the O(N) scatter is the safer
+    lowering even though it is slower per element (OOM beats slow)."""
+    return size < 2**24 and size * num_classes <= 2**29
+
+
+def _multiclass_confusion_matrix_matmul(p: Array, t: Array, mask: Array, num_classes: int) -> Array:
+    """(C, C) counts as an MXU one-hot matmul (exactness argument in the module
+    docstring; ignored samples contribute an all-zero target row; out-of-range
+    indices yield all-zero one-hots, i.e. the pair is dropped)."""
+    oh_t = jax.nn.one_hot(t, num_classes, dtype=jnp.bfloat16) * mask.astype(jnp.bfloat16)[:, None]
+    oh_p = jax.nn.one_hot(p, num_classes, dtype=jnp.bfloat16)
+    cm = jax.lax.dot_general(oh_t, oh_p, (((0,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    return cm.astype(jnp.int32)
+
+
 @functools.partial(jax.jit, static_argnums=(2, 3))
 def _multiclass_confusion_matrix_update(
     preds: Array, target: Array, num_classes: int, ignore_index: Optional[int] = None
 ) -> Array:
     """(C, C) counts, rows = true class (reference confusion_matrix.py multiclass
-    update). Jitted at definition: fusing key construction + masking + the
-    scatter-add beats the reference's eager C++ bincount ~2x at 1M samples."""
+    update). Jitted at definition: fusing key construction + masking + the count
+    beats the reference's eager C++ bincount (~2x on CPU, 33x on the v5e via the
+    matmul lowering). The backend branch is trace-time and both lowerings are
+    integer-exact with identical semantics — out-of-range class indices (only
+    reachable with validate_args=False, undefined in the reference) are DROPPED
+    by both, so a device/trace mismatch affects speed only."""
     mask = _ignore_mask(target, ignore_index)
     t = jnp.where(mask, target, 0).astype(jnp.int32)
     p = preds.astype(jnp.int32)
-    # ignored pairs go to an overflow bucket (index C*C) that is trimmed after counting
-    unique_mapping = jnp.where(mask.reshape(-1), (t * num_classes + p).reshape(-1), num_classes * num_classes)
+    if jax.default_backend() != "cpu" and _matmul_lowering_eligible(p.size, num_classes):
+        return _multiclass_confusion_matrix_matmul(p.reshape(-1), t.reshape(-1),
+                                                   mask.reshape(-1), num_classes)
+    # ignored and out-of-range pairs go to an overflow bucket (index C*C) that
+    # is trimmed after counting (the one-hot path drops them as zero rows)
+    in_range = (p >= 0) & (p < num_classes) & (t >= 0) & (t < num_classes)
+    unique_mapping = jnp.where((mask & in_range).reshape(-1),
+                               (t * num_classes + p).reshape(-1), num_classes * num_classes)
     bins = jnp.bincount(unique_mapping, length=num_classes * num_classes + 1)[: num_classes * num_classes]
     return bins.reshape(num_classes, num_classes)
 

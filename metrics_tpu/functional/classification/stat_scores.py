@@ -303,22 +303,30 @@ def _multiclass_stat_scores_update(
     target_ = jnp.where(mask, target, 0).astype(jnp.int32)
     m = mask.astype(jnp.float32)
 
-    # Backend-dependent fast path: with label preds, top_k=1 and a global
-    # reduce, every count derives from the (C, C) confusion matrix, which is
-    # one O(N) masked bincount instead of O(N·C) one-hot arithmetic. On TPU the
-    # one-hot form rides the MXU and measures at zero step overhead (bench.py),
-    # so the scatter path is used only where it wins: the host CPU backend.
+    # Fast path: with label preds, top_k=1 and a global reduce, every count
+    # derives from the (C, C) confusion matrix — on the host backend one O(N)
+    # masked bincount, on accelerators an MXU one-hot matmul (both picked
+    # inside _multiclass_confusion_matrix_update; the matmul measured 33x over
+    # the scatter on the v5e, benchmarks/experiments/onehot_confmat_tpu.py,
+    # and needs one (C,C)-product where the O(N*C) elementwise one-hot form
+    # this path previously used on accelerators needs four). Excluded:
+    # matmul-ineligible sizes on accelerators, where the cm update would fall
+    # back to the TPU-slow scatter — the elementwise one-hot arithmetic below
+    # is the better floor there.
     # The branch is trace-time and could in principle mismatch the executing
     # device (jit with an explicit non-default device) — that is safe because
-    # both paths accumulate exactly in integers (the one-hot products below are
-    # summed as int32, not f32), so path choice affects speed only.
+    # every path is integer-exact, so path choice affects speed only.
+    from metrics_tpu.functional.classification.confusion_matrix import (
+        _matmul_lowering_eligible,
+        _multiclass_confusion_matrix_update,
+    )
+
     if (
         multidim_average == "global"
         and preds.ndim != 3
-        and jax.default_backend() == "cpu"
+        and (jax.default_backend() == "cpu"
+             or _matmul_lowering_eligible(preds.size, num_classes))
     ):
-        from metrics_tpu.functional.classification.confusion_matrix import _multiclass_confusion_matrix_update
-
         cm = _multiclass_confusion_matrix_update(preds, target, num_classes, ignore_index)
         tp = jnp.diag(cm)
         fn = jnp.sum(cm, axis=1) - tp

@@ -187,15 +187,18 @@ def test_collection_functional_sharded():
     import jax
     from jax.sharding import Mesh, PartitionSpec as P
 
+    from tests.helpers.testers import mesh_world
+
+    world = mesh_world()
     mc = MetricCollection(
         [MulticlassPrecision(NUM_CLASSES, average="macro"), MulticlassRecall(NUM_CLASSES, average="macro")],
         compute_groups=[["MulticlassPrecision", "MulticlassRecall"]],  # user-specified groups
     )
     rng = np.random.default_rng(0)
-    preds = jnp.asarray(rng.normal(size=(8, 16, NUM_CLASSES)).astype(np.float32))
-    target = jnp.asarray(rng.integers(0, NUM_CLASSES, (8, 16)))
+    preds = jnp.asarray(rng.normal(size=(world, 16, NUM_CLASSES)).astype(np.float32))
+    target = jnp.asarray(rng.integers(0, NUM_CLASSES, (world, 16)))
 
-    mesh = Mesh(np.array(jax.devices()[:8]), ("dp",))
+    mesh = Mesh(np.array(jax.devices()[:world]), ("dp",))
 
     def step(p, t):
         state = mc.init_state()

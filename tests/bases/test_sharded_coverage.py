@@ -15,22 +15,28 @@ from metrics_tpu.classification import (
     MulticlassAccuracy,
     MulticlassStatScores,
 )
-from tests.helpers.testers import sharded_metric_eval
+from tests.helpers.testers import mesh_world, sharded_metric_eval
 
 NUM_DEVICES = 8
 NUM_CLASSES = 5
 
 
-def _mesh():
-    return Mesh(np.array(jax.devices()[:NUM_DEVICES]), ("dp",))
+def _world(num_batches: int) -> int:
+    """testers.mesh_world (loud failure on a broken CPU-tier mesh), narrowed to
+    the biggest width dividing the batch count — on a single chip all 16
+    batches flow through one shard instead of 2 each through 8."""
+    w = mesh_world(NUM_DEVICES)
+    return next(n for n in range(min(w, num_batches), 0, -1) if num_batches % n == 0)
 
 
 def _sharded_eval(metric, preds, target):
     """Update + sync inside shard_map; compute in-trace or on host per the metric."""
+    world = _world(len(preds))
+    mesh = Mesh(np.array(jax.devices()[:world]), ("dp",))
     preds_stack = jnp.stack([jnp.asarray(p) for p in preds])
     target_stack = jnp.stack([jnp.asarray(t) for t in target])
     return sharded_metric_eval(
-        metric, preds_stack, target_stack, _mesh(), batches_per_device=len(preds) // NUM_DEVICES
+        metric, preds_stack, target_stack, mesh, batches_per_device=len(preds) // world
     )
 
 

@@ -219,3 +219,60 @@ class TestExactMatch(MetricTester):
             metric_class=MultilabelExactMatch, reference_metric=ref,
             metric_args={"num_labels": NUM_CLASSES},
         )
+
+
+class TestConfusionMatrixMatmulLowering:
+    """The accelerator lowering of the multiclass count (MXU one-hot matmul,
+    confusion_matrix.py `_multiclass_confusion_matrix_matmul`) must equal the
+    host bincount-scatter bit-for-bit — the CPU test tier otherwise never
+    executes it (the backend branch picks the scatter here)."""
+
+    @pytest.mark.parametrize("n,c,ignore_index", [
+        (1, 2, None), (17, 3, None), (1000, 7, None),
+        (5000, 13, 3), (257, 2, 0), (4096, 100, 99),
+    ])
+    def test_matches_bincount(self, n, c, ignore_index):
+        import jax.numpy as jnp
+
+        from metrics_tpu.functional.classification.confusion_matrix import (
+            _multiclass_confusion_matrix_matmul,
+            _multiclass_confusion_matrix_update,
+        )
+        from metrics_tpu.functional.classification.stat_scores import _ignore_mask
+
+        rng = np.random.default_rng(n * c)
+        t = jnp.asarray(rng.integers(0, c, n).astype(np.int32))
+        p = jnp.asarray(rng.integers(0, c, n).astype(np.int32))
+        scatter = _multiclass_confusion_matrix_update(p, t, c, ignore_index)
+        mask = _ignore_mask(t, ignore_index)
+        matmul = _multiclass_confusion_matrix_matmul(
+            p, jnp.where(mask, t, 0).astype(jnp.int32), mask, c
+        )
+        np.testing.assert_array_equal(np.asarray(scatter), np.asarray(matmul))
+        # independent oracle: sklearn on the kept rows
+        tn_, pn_ = np.asarray(t), np.asarray(p)
+        keep = np.ones(n, bool) if ignore_index is None else tn_ != ignore_index
+        sk = sk_confusion_matrix(tn_[keep], pn_[keep], labels=np.arange(c))
+        np.testing.assert_array_equal(np.asarray(matmul), sk)
+
+    def test_out_of_range_dropped_identically(self):
+        """Out-of-range class indices (reachable only with validate_args=False;
+        undefined behavior in the reference) are DROPPED by both lowerings, so
+        the trace-time backend branch can never change values."""
+        import jax.numpy as jnp
+
+        from metrics_tpu.functional.classification.confusion_matrix import (
+            _multiclass_confusion_matrix_matmul,
+            _multiclass_confusion_matrix_update,
+        )
+
+        c = 3
+        p = jnp.asarray(np.array([0, 5, 1, -1, 2], np.int32))
+        t = jnp.asarray(np.array([1, 1, 7, 2, -3], np.int32))
+        scatter = _multiclass_confusion_matrix_update(p, t, c, None)
+        ones = jnp.ones(5, bool)
+        matmul = _multiclass_confusion_matrix_matmul(p, t, ones, c)
+        np.testing.assert_array_equal(np.asarray(scatter), np.asarray(matmul))
+        exp = np.zeros((c, c), np.int64)
+        exp[1, 0] = 1  # only the (t=1, p=0) pair is fully in range
+        np.testing.assert_array_equal(np.asarray(scatter), exp)

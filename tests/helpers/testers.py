@@ -42,6 +42,26 @@ EXTRA_DIM = 3
 THRESHOLD = 0.5
 
 
+def mesh_world(max_devices: int = NUM_DEVICES) -> int:
+    """Mesh width for shard_map tests, shared by every test module that builds
+    its own mesh. On the CPU tier this is always ``max_devices`` — fewer
+    devices means the virtual mesh setup is broken and must fail LOUDLY (the
+    collective path would otherwise silently degrade to world=1 and still pass,
+    the exact silent-skip failure mode the sharded tests exist to prevent). On
+    accelerator tiers (METRICS_TPU_TEST_BACKEND != cpu) it is the biggest width
+    the hardware offers: a 4-chip slice runs 4-way, a single chip exercises the
+    sync as a 1-way mesh."""
+    n = len(jax.devices())
+    if os.environ.get("METRICS_TPU_TEST_BACKEND", "cpu") == "cpu":
+        if n < max_devices:
+            raise AssertionError(
+                f"CPU-mesh tier has {n} devices, mesh needs {max_devices};"
+                " check xla_force_host_platform_device_count"
+            )
+        return max_devices
+    return min(n, max_devices)
+
+
 def _assert_allclose(tm_result: Any, ref_result: Any, atol: float = 1e-8, key: Optional[str] = None) -> None:
     if isinstance(tm_result, (jax.Array, np.ndarray)) and key is None:
         np.testing.assert_allclose(np.asarray(tm_result), np.asarray(ref_result), atol=atol, rtol=1e-5)

@@ -1,0 +1,60 @@
+"""Chained-device timing: per-iteration device time with dispatch latency cancelled.
+
+Per-dispatch timing over the tunneled TPU has a ~4 ms floor that buries every
+sub-millisecond device op (the first round-5 roofline capture showed all seven
+rows pinned at 3-10 ms regardless of workload size). The protocol here runs the
+body k1 resp. k2 times inside ONE ``lax.fori_loop`` dispatch and reports
+``(t_k2 - t_k1) / (k2 - k1)``: launch + tunnel round-trip appear in both
+timings and cancel in the difference.
+
+Requirements on ``body(i, carry) -> carry``:
+- depend on ``i`` (or the carry), or XLA's while-loop invariant code motion
+  hoists the computation out of the loop;
+- consume the full output through a non-collapsible reduction (``jnp.max``, or
+  carrying the state) — a ``[0, 0]`` slice lets DCE drop all but one element's
+  work, and a plain ``sum`` over classification counts algebraically collapses
+  (XLA simplifies ``c + (1 - c)``).
+
+Shared by benchmarks/suite.py and benchmarks/experiments/* so the protocol
+cannot drift between them.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+import jax
+
+
+def timed_device(body: Callable, init_carry, k1: int, k2: int, reps: int = 3) -> Optional[float]:
+    """Return ms per iteration, or ``None`` when the capture is noise-dominated.
+
+    Best-of-reps PER LOOP LENGTH, then difference: min(t2 - t1) over paired
+    reps is biased low under load noise (one lucky fast t2 against one slow t1
+    reads as ~0), whereas each length's own minimum approximates its
+    uncontended time and the launch floor still cancels in the difference.
+    A non-positive difference means the true per-iter cost is below the noise
+    floor for this k2 - k1; retry once with 4x the loop lengths, then report
+    the failure as ``None`` rather than clamping to a fake fast number.
+    """
+    from jax import lax
+
+    for scale in (1, 4):
+        ka, kb = k1 * scale, k2 * scale
+        run1 = jax.jit(lambda c, ka=ka: lax.fori_loop(0, ka, body, c))
+        run2 = jax.jit(lambda c, kb=kb: lax.fori_loop(0, kb, body, c))
+        jax.block_until_ready(run1(init_carry))
+        jax.block_until_ready(run2(init_carry))
+        best1 = best2 = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(run2(init_carry))
+            best2 = min(best2, time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            jax.block_until_ready(run1(init_carry))
+            best1 = min(best1, time.perf_counter() - t0)
+        diff = (best2 - best1) / (kb - ka)
+        if diff > 0:
+            return diff * 1e3
+    return None

@@ -93,7 +93,12 @@ def run_full() -> None:
                 capture_output=True, text=True, cwd=_REPO, env=env, timeout=5400,
             )
             row["rc"] = r.returncode
-            row["summary"] = "\n".join(r.stdout.strip().splitlines()[-3:])
+            lines = r.stdout.strip().splitlines()
+            # keep every FAILED name (the first capture lost 6 of 8 failure
+            # names to the 3-line tail) plus the count line; don't repeat
+            # FAILED names already inside the tail
+            failed = [ln for ln in lines[:-3] if "FAILED" in ln][:40]
+            row["summary"] = "\n".join(failed + lines[-3:])
             total_rc = total_rc or r.returncode
             if r.returncode == 0:
                 green.add(chunk)
