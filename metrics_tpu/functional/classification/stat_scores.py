@@ -334,6 +334,16 @@ def _multiclass_stat_scores_update(
         tn = jnp.sum(cm) - tp - fn - fp
         return tp.astype(jnp.int32), fp.astype(jnp.int32), tn.astype(jnp.int32), fn.astype(jnp.int32)
 
+    # Out-of-range indices (reachable only with validate_args=False) drop the
+    # whole PAIR, exactly like the cm fast path above — otherwise which route
+    # runs (and hence the counts) would depend on batch size on accelerators.
+    # one_hot already zeroes the out-of-range index itself; the pair-drop needs
+    # the mask so e.g. an out-of-range pred doesn't leave its target counted
+    # as fn.
+    if preds.ndim != 3:
+        m = m * ((preds >= 0) & (preds < num_classes)).astype(jnp.float32)
+    m = m * ((target_ >= 0) & (target_ < num_classes)).astype(jnp.float32)
+
     oh_target = jax.nn.one_hot(target_, num_classes, dtype=jnp.float32) * m[..., None]  # (N, X, C)
 
     if preds.ndim == 3:  # (N, C, X) probs with top_k > 1

@@ -139,3 +139,30 @@ def test_bincount_and_onehot_stat_paths_agree(monkeypatch):
         monkeypatch.undo()
         for a, b in zip(fast, slow):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_out_of_range_pairs_dropped_on_every_path():
+    """With validate_args=False, out-of-range class indices drop the whole
+    pair on EVERY route (cm fast path, elementwise one-hot fallback), so the
+    trace-time route choice can never change values."""
+    import jax.numpy as jnp
+
+    from metrics_tpu.functional.classification.stat_scores import _multiclass_stat_scores_update
+
+    p = jnp.asarray(np.array([0, 5, 1, -1, 2, 1], np.int32))
+    t = jnp.asarray(np.array([1, 1, 7, 2, -3, 1], np.int32))
+    C = 3
+    # global -> cm fast path on the host backend
+    g = _multiclass_stat_scores_update(p, t, C, top_k=1, average="macro",
+                                       multidim_average="global")
+    # samplewise -> elementwise one-hot path; summing samples must equal global
+    s = _multiclass_stat_scores_update(p[None, :], t[None, :], C, top_k=1,
+                                       average="macro", multidim_average="samplewise")
+    for gv, sv in zip(g, s):
+        np.testing.assert_array_equal(np.asarray(gv), np.asarray(sv).sum(0) if np.asarray(sv).ndim > 1 else np.asarray(sv)[0])
+    # oracle: only pairs (0,1) and (1,1) are fully in range
+    tp, fp, tn, fn = (np.asarray(x) for x in g)
+    np.testing.assert_array_equal(tp, [0, 1, 0])
+    np.testing.assert_array_equal(fp, [1, 0, 0])
+    np.testing.assert_array_equal(fn, [0, 1, 0])
+    np.testing.assert_array_equal(tn, [1, 0, 2])
