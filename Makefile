@@ -26,6 +26,11 @@ multichip:
 bench:
 	python bench.py
 
+# Fraction-of-ceiling verdicts from the latest durable roofline captures
+# (suite.py --only roofline appends them to benchmarks/suite_runs.jsonl).
+roofline-report:
+	python tools/roofline_report.py --backend tpu --write
+
 env:
 	pip install -e ".[test]"
 

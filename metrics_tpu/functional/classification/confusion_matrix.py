@@ -104,15 +104,25 @@ def _matmul_lowering_eligible(size: int, num_classes: int) -> bool:
     return size < 2**24 and size * num_classes <= 2**29
 
 
+def _onehot_count_matmul(row_idx: Array, col_idx: Array, num_rows: int, num_cols: int,
+                         row_mask: Optional[Array] = None) -> Array:
+    """(num_rows, num_cols) pair counts as a bf16 one-hot MXU matmul — the ONE
+    implementation of the lowering (exactness argument in the module
+    docstring), shared by the classification confusion matrix and the nominal
+    contingency table. Masked samples contribute an all-zero row one-hot;
+    out-of-range indices yield all-zero one-hots, i.e. the pair is dropped."""
+    oh_r = jax.nn.one_hot(row_idx, num_rows, dtype=jnp.bfloat16)
+    if row_mask is not None:
+        oh_r = oh_r * row_mask.astype(jnp.bfloat16)[:, None]
+    oh_c = jax.nn.one_hot(col_idx, num_cols, dtype=jnp.bfloat16)
+    counts = jax.lax.dot_general(oh_r, oh_c, (((0,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+    return counts.astype(jnp.int32)
+
+
 def _multiclass_confusion_matrix_matmul(p: Array, t: Array, mask: Array, num_classes: int) -> Array:
-    """(C, C) counts as an MXU one-hot matmul (exactness argument in the module
-    docstring; ignored samples contribute an all-zero target row; out-of-range
-    indices yield all-zero one-hots, i.e. the pair is dropped)."""
-    oh_t = jax.nn.one_hot(t, num_classes, dtype=jnp.bfloat16) * mask.astype(jnp.bfloat16)[:, None]
-    oh_p = jax.nn.one_hot(p, num_classes, dtype=jnp.bfloat16)
-    cm = jax.lax.dot_general(oh_t, oh_p, (((0,), (0,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    return cm.astype(jnp.int32)
+    """(C, C) counts, rows = true class, via the shared one-hot matmul."""
+    return _onehot_count_matmul(t, p, num_classes, num_classes, row_mask=mask)
 
 
 @functools.partial(jax.jit, static_argnums=(2, 3))

@@ -82,18 +82,17 @@ def _joint_confusion_matrix(preds: Array, target: Array, num_classes_preds: int,
     bucket (``jnp.bincount`` would otherwise CLIP a negative key to bin 0)."""
     import jax
 
-    from metrics_tpu.functional.classification.confusion_matrix import _matmul_lowering_eligible
+    from metrics_tpu.functional.classification.confusion_matrix import (
+        _matmul_lowering_eligible,
+        _onehot_count_matmul,
+    )
 
     p = preds.reshape(-1).astype(jnp.int32)
     t = target.reshape(-1).astype(jnp.int32)
     if jax.default_backend() != "cpu" and _matmul_lowering_eligible(
         p.size, max(num_classes_preds, num_classes_target)
     ):
-        oh_p = jax.nn.one_hot(p, num_classes_preds, dtype=jnp.bfloat16)
-        oh_t = jax.nn.one_hot(t, num_classes_target, dtype=jnp.bfloat16)
-        cm = jax.lax.dot_general(oh_p, oh_t, (((0,), (0,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        return cm.astype(jnp.int32)
+        return _onehot_count_matmul(p, t, num_classes_preds, num_classes_target)
     size = num_classes_preds * num_classes_target
     in_range = (p >= 0) & (p < num_classes_preds) & (t >= 0) & (t < num_classes_target)
     mapping = jnp.where(in_range, p * num_classes_target + t, size)

@@ -135,8 +135,14 @@ def test_bincount_and_onehot_stat_paths_agree(monkeypatch):
         monkeypatch.setattr(S.jax, "default_backend", lambda: "cpu")
         fast = S._multiclass_stat_scores_update(preds, target, C, ignore_index=ii)
         monkeypatch.setattr(S.jax, "default_backend", lambda: "tpu")
+        # the update is jitted at definition: without a cache clear the second
+        # call reuses the executable traced under the "cpu" probe and never
+        # traces the accelerator branch (the probe is trace-time, not part of
+        # the jit cache key) — the comparison would be vacuous
+        S.jax.clear_caches()
         slow = S._multiclass_stat_scores_update(preds, target, C, ignore_index=ii)
         monkeypatch.undo()
+        S.jax.clear_caches()
         for a, b in zip(fast, slow):
             np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 

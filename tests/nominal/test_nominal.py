@@ -72,10 +72,12 @@ def test_nan_strategies():
     assert np.isfinite(np.asarray(res_drop)) or np.isnan(np.asarray(res_drop))
 
 
-def test_joint_confusion_matrix_matmul_lowering_matches_bincount():
+def test_joint_confusion_matrix_matmul_lowering_matches_bincount(monkeypatch):
     """The accelerator one-hot matmul lowering of the (Cx, Cy) contingency
     table must equal the host bincount scatter bit-for-bit, including
-    rectangular tables — the CPU tier otherwise never executes it."""
+    rectangular tables. Drives the PRODUCTION branch by pinning the trace-time
+    backend probe (the function is eager, so no jit cache can mask it) —
+    the CPU tier otherwise never executes it."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -88,22 +90,21 @@ def test_joint_confusion_matrix_matmul_lowering_matches_bincount():
         p = jnp.asarray(rng.integers(0, cx, n).astype(np.int32))
         t = jnp.asarray(rng.integers(0, cy, n).astype(np.int32))
         assert _matmul_lowering_eligible(n, max(cx, cy))
-        scatter = _joint_confusion_matrix(p, t, cx, cy)  # cpu backend -> bincount
-        oh_p = jax.nn.one_hot(p, cx, dtype=jnp.bfloat16)
-        oh_t = jax.nn.one_hot(t, cy, dtype=jnp.bfloat16)
-        matmul = jax.lax.dot_general(
-            oh_p, oh_t, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        ).astype(jnp.int32)
+        monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+        scatter = _joint_confusion_matrix(p, t, cx, cy)
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        matmul = _joint_confusion_matrix(p, t, cx, cy)
+        monkeypatch.undo()
         np.testing.assert_array_equal(np.asarray(scatter), np.asarray(matmul))
         exp = np.zeros((cx, cy), np.int64)
         np.add.at(exp, (np.asarray(p), np.asarray(t)), 1)
         np.testing.assert_array_equal(np.asarray(scatter), exp)
 
 
-def test_joint_confusion_matrix_out_of_range_dropped():
+def test_joint_confusion_matrix_out_of_range_dropped(monkeypatch):
     """Out-of-range category values (e.g. a negative nan_replace_value) are
-    dropped by BOTH lowerings — jnp.bincount would otherwise CLIP a negative
-    key into bin 0 and silently corrupt cell (0, 0)."""
+    dropped by BOTH production lowerings — jnp.bincount would otherwise CLIP a
+    negative key into bin 0 and silently corrupt cell (0, 0)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -113,12 +114,11 @@ def test_joint_confusion_matrix_out_of_range_dropped():
     p = jnp.asarray(np.array([0, -1, 1, 3, 2], np.int32))
     t = jnp.asarray(np.array([1, 0, -2, 1, 5], np.int32))
     cx, cy = 3, 2
-    scatter = _joint_confusion_matrix(p, t, cx, cy)  # cpu backend -> bincount
-    oh_p = jax.nn.one_hot(p, cx, dtype=jnp.bfloat16)
-    oh_t = jax.nn.one_hot(t, cy, dtype=jnp.bfloat16)
-    matmul = jax.lax.dot_general(
-        oh_p, oh_t, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
-    ).astype(jnp.int32)
+    monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+    scatter = _joint_confusion_matrix(p, t, cx, cy)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    matmul = _joint_confusion_matrix(p, t, cx, cy)
+    monkeypatch.undo()
     np.testing.assert_array_equal(np.asarray(scatter), np.asarray(matmul))
     exp = np.zeros((cx, cy), np.int64)
     exp[0, 1] = 1  # only (p=0, t=1) is fully in range
