@@ -25,6 +25,14 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
 
 import jax
+
+# jax is pre-imported by sitecustomize with the axon platform pinned, so the
+# JAX_PLATFORMS env var alone cannot switch backends — honor it explicitly,
+# and force CPU for --check-only outright (a correctness-only run must not
+# hang in axon init on a tunnel-down machine)
+if os.environ.get("JAX_PLATFORMS") == "cpu" or "--check-only" in sys.argv:
+    jax.config.update("jax_platforms", "cpu")
+
 import jax.numpy as jnp
 import numpy as np
 
@@ -48,18 +56,52 @@ def cm_onehot_matmul(p, t, C):
     return cm.astype(jnp.int32)
 
 
+def ss_via_cm(p, t, C):
+    """Global multiclass stat-scores derived from the (C, C) matmul cm — the
+    production accelerator route as of round 5 (stat_scores.py fast path)."""
+    cm = cm_onehot_matmul(p, t, C)
+    tp = jnp.diag(cm)
+    fn = jnp.sum(cm, axis=1) - tp
+    fp = jnp.sum(cm, axis=0) - tp
+    tn = jnp.sum(cm) - tp - fn - fp
+    return jnp.stack([tp, fp, tn, fn])
+
+
+def ss_elementwise(p, t, C):
+    """The pre-round-5 accelerator route: four O(N*C) one-hot products."""
+    oh_t = jax.nn.one_hot(t, C, dtype=jnp.float32)
+    oh_p = jax.nn.one_hot(p, C, dtype=jnp.float32)
+    tp = jnp.sum((oh_p * oh_t).astype(jnp.int32), axis=0)
+    fp = jnp.sum((oh_p * (1.0 - oh_t)).astype(jnp.int32), axis=0)
+    fn = jnp.sum(((1.0 - oh_p) * oh_t).astype(jnp.int32), axis=0)
+    tn = jnp.sum(((1.0 - oh_p) * (1.0 - oh_t)).astype(jnp.int32), axis=0)
+    return jnp.stack([tp, fp, tn, fn])
+
+
 def main() -> None:
+    check_only = "--check-only" in sys.argv
     rng = np.random.default_rng(11)
-    M, C = (1_000_000, 100) if BACKEND != "cpu" else (200_000, 100)
+    if check_only:
+        M, C = 20_000, 37
+    else:
+        M, C = (1_000_000, 100) if BACKEND != "cpu" else (200_000, 100)
     p = jnp.asarray(rng.integers(0, C, M).astype(np.int32))
     t = jnp.asarray(rng.integers(0, C, M).astype(np.int32))
 
     a = jax.jit(lambda p_, t_: cm_bincount(p_, t_, C))(p, t)
     b = jax.jit(lambda p_, t_: cm_onehot_matmul(p_, t_, C))(p, t)
     assert (np.asarray(a) == np.asarray(b)).all(), "lowerings disagree"
+    sa = jax.jit(lambda p_, t_: ss_via_cm(p_, t_, C))(p, t)
+    sb = jax.jit(lambda p_, t_: ss_elementwise(p_, t_, C))(p, t)
+    assert (np.asarray(sa) == np.asarray(sb)).all(), "stat-score routes disagree"
+    if check_only:
+        print("all variants agree (check-only)")
+        return
 
     for name, fn, k1, k2 in [("bincount-scatter", cm_bincount, 10, 50),
-                             ("onehot-mxu-matmul", cm_onehot_matmul, 100, 500)]:
+                             ("onehot-mxu-matmul", cm_onehot_matmul, 100, 500),
+                             ("stat-scores-via-cm", ss_via_cm, 100, 500),
+                             ("stat-scores-elementwise", ss_elementwise, 50, 250)]:
         ms = timed_device(
             lambda i, acc, fn=fn: acc + jnp.max(fn((p + i) % C, (t + i) % C, C)),
             jnp.int32(0), k1, k2)

@@ -292,10 +292,15 @@ def bench_roofline() -> None:
     # loop-variant — one extra elementwise pass, NOT credited in the GB/s
     ms = timed_device(lambda i, s: step(s, (preds_i + i) % C, (target_i + i) % C),
                       state, 50, 250)
+    # accelerator lowering is the (C, C) one-hot matmul (2*M*C^2 MACs) — the
+    # binding resource there is the MXU, so emit flops alongside the
+    # input-stream GB/s (which on the matmul route is a demand metric only)
     emit_chained("roofline stat_scores update", ms, disp_ms,
-                 {"samples": M, "classes": C, "bound": "memory (input stream)"},
+                 {"samples": M, "classes": C,
+                  "bound": "MXU one-hot matmul" if big else "memory (input stream)"},
                  samples=M,
-                 in_bytes=2 * 4 * M)  # int32 preds + target; states O(C), negligible
+                 in_bytes=2 * 4 * M,  # int32 preds + target; states O(C), negligible
+                 flops=2 * M * C * C if big else None)
 
     # --- 2. binned-curve update — comparison matmul (MXU) vs bucketize -----
     from metrics_tpu.functional.classification.precision_recall_curve import (
@@ -335,8 +340,10 @@ def bench_roofline() -> None:
     ms = timed_device(lambda i, s: cstep(s, (preds_i + i) % C, (target_i + i) % C),
                       cstate, 50, 250)
     emit_chained("roofline confusion_matrix update", ms, disp_ms,
-                 {"samples": M, "classes": C, "bound": "memory (input stream)"},
-                 samples=M, in_bytes=2 * 4 * M)
+                 {"samples": M, "classes": C,
+                  "bound": "MXU one-hot matmul" if big else "memory (input stream)"},
+                 samples=M, in_bytes=2 * 4 * M,
+                 flops=2 * M * C * C if big else None)
 
     # --- 4. SSIM window pass — banded-matmul separable windows -------------
     from metrics_tpu.functional.image.ssim import structural_similarity_index_measure
