@@ -235,8 +235,10 @@ def test_snr_sharded_functional_path():
     """SNR module functional API under shard_map with psum sync."""
     from jax.sharding import Mesh, PartitionSpec as P
 
+    from tests.helpers.testers import mesh_world
+
     rng = np.random.RandomState(9)
-    num_devices = 8
+    num_devices = mesh_world()
     target = jnp.asarray(rng.randn(num_devices, BATCH, TIME).astype(np.float32))
     preds = jnp.asarray(rng.randn(num_devices, BATCH, TIME).astype(np.float32))
     metric = SignalNoiseRatio()

@@ -156,13 +156,17 @@ def test_eval_loop_under_sharded_inference(trained_setup):
     the host metric on the union of shards."""
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+    from tests.helpers.testers import mesh_world
+
     model, params, *_ = trained_setup
-    n_dev = 8
+    n_dev = mesh_world()
     mesh = Mesh(np.array(jax.devices()[:n_dev]), ("dp",))
     acc = MulticlassAccuracy(NUM_CLASSES, average="micro", validate_args=False)
     xs, ys = _data(4, 1)
-    x = jnp.asarray(np.tile(xs[0], (n_dev // 4 if n_dev >= 4 else 1, 1))[: n_dev * 16])
-    y = jnp.asarray(np.tile(ys[0], max(1, n_dev * 16 // len(ys[0])))[: n_dev * 16])
+    # ceiling tile factors: floor division under-replicates for device counts
+    # that don't divide the base batch (e.g. a 5-7 chip slice)
+    x = jnp.asarray(np.tile(xs[0], (-(-n_dev * 16 // len(xs[0])), 1))[: n_dev * 16])
+    y = jnp.asarray(np.tile(ys[0], -(-n_dev * 16 // len(ys[0])))[: n_dev * 16])
 
     def eval_step(p, x, y):
         logits = model.apply(p, x)

@@ -59,9 +59,12 @@ def test_perplexity_validation():
 
 
 def test_perplexity_sharded_functional_path():
-    """update_state/compute_from inside shard_map over the 8-device mesh."""
+    """update_state/compute_from inside shard_map over the dp mesh (8-way on
+    the CPU tier; hardware-sized on chip via testers.mesh_world)."""
+    from tests.helpers.testers import mesh_world
+
     rng = np.random.RandomState(2)
-    num_devices = 8
+    num_devices = mesh_world()
     preds = jnp.asarray(rng.randn(num_devices, BATCH, SEQ, VOCAB).astype(np.float32))
     target = jnp.asarray(rng.randint(VOCAB, size=(num_devices, BATCH, SEQ)))
     metric = Perplexity()

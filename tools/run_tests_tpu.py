@@ -43,10 +43,14 @@ _LOG = os.path.join(_REPO, "benchmarks", "tpu_tests.jsonl")
 
 
 def _expand_dir(d: str) -> list[str]:
-    return sorted(
-        f"{d}/{f}" for f in os.listdir(os.path.join(_REPO, d))
-        if f.startswith("test_") and f.endswith(".py")
-    )
+    """All test files under ``d``, recursively — a non-recursive listing would
+    silently drop tests later added in subdirectories from the 'ENTIRE tests/
+    tree' contract while all_green still reported true."""
+    out = []
+    for root, _dirs, files in os.walk(os.path.join(_REPO, d)):
+        rel = os.path.relpath(root, _REPO)
+        out.extend(f"{rel}/{f}" for f in files if f.startswith("test_") and f.endswith(".py"))
+    return sorted(out)
 
 
 # doctest ids look like test_doctest_module[metrics_tpu.functional.image.ssim];
@@ -94,7 +98,11 @@ def _already_green() -> set[str]:
                     row = json.loads(line)
                 except ValueError:
                     continue
-                if row.get("mode") == "full" and row.get("rc") == 0 and "degraded" not in row:
+                # empty chunks (note='no tests collected') are NOT banked: a
+                # zero-evidence pass must be re-checked every run so tests
+                # later added to the chunk are not skipped forever
+                if (row.get("mode") == "full" and row.get("rc") == 0
+                        and "degraded" not in row and "note" not in row):
                     green.add(row.get("what", "").removeprefix("full-suite chunk "))
     except OSError:
         pass
