@@ -1,0 +1,68 @@
+"""Unit tests for tools/roofline_report.py — the generator behind the
+judge-facing benchmarks/ROOFLINE.md. Pins the verdict policy: latest capture
+per row wins, invalid/impossible captures can never read as success, and the
+counting rows prefer the MXU (GFLOP/s) framing when present."""
+
+import json
+
+import tools.roofline_report as rr
+
+
+def _write_rows(tmp_path, rows):
+    p = tmp_path / "runs.jsonl"
+    with open(p, "w") as fh:
+        for r in rows:
+            fh.write(json.dumps(r) + "\n")
+    return str(p)
+
+
+def test_verdict_classification(tmp_path, monkeypatch):
+    rows = [
+        # at roofline: 500/819 = 61%
+        {"metric": "roofline total_variation", "value": 0.02, "unit": "ms",
+         "backend": "tpu", "achieved_gb_s": 500.0},
+        # stale earlier capture for the same metric must NOT win
+        {"metric": "roofline pairwise cosine GEMM", "value": 9.0, "unit": "ms",
+         "backend": "tpu", "achieved_gflop_s": 1.0},
+        # latest wins: below, no note -> needs action
+        {"metric": "roofline pairwise cosine GEMM", "value": 1.0, "unit": "ms",
+         "backend": "tpu", "achieved_gflop_s": 10000.0},
+        # explicitly invalid capture
+        {"metric": "roofline binned_curve update", "value": None, "unit": "ms",
+         "backend": "tpu", "invalid": "noise-dominated chained capture"},
+        # physically impossible rate -> invalid, never success
+        {"metric": "roofline ssim window pass", "value": 0.0, "unit": "ms",
+         "backend": "tpu", "achieved_gflop_s": 6e8},
+        # counting row: GFLOP/s framing preferred over the GB/s demand metric
+        {"metric": "roofline stat_scores update", "value": 0.2, "unit": "ms",
+         "backend": "tpu", "achieved_gb_s": 40.0, "achieved_gflop_s": 100000.0},
+        # cpu row for the same metric must not leak into the tpu report
+        {"metric": "roofline confusion_matrix update", "value": 0.4, "unit": "ms",
+         "backend": "cpu", "achieved_gb_s": 4.0},
+    ]
+    monkeypatch.setattr(rr, "RUNS", _write_rows(tmp_path, rows))
+    text, n_at, n_below = rr.render("tpu")
+
+    tv_line = next(ln for ln in text.splitlines() if "total_variation" in ln)
+    assert "AT ROOFLINE" in tv_line and "61.1%" in tv_line
+    gemm_line = next(ln for ln in text.splitlines() if "GEMM" in ln)
+    assert "BELOW (needs action)" in gemm_line and "10000.0" in gemm_line
+    binned_line = next(ln for ln in text.splitlines() if "binned_curve" in ln)
+    assert "INVALID CAPTURE" in binned_line
+    ssim_line = next(ln for ln in text.splitlines() if "ssim" in ln)
+    assert "INVALID CAPTURE (rate above ceiling)" in ssim_line
+    ss_line = next(ln for ln in text.splitlines() if "stat_scores" in ln)
+    assert "GFLOP/s" in ss_line and "197 TFLOP/s MXU" in ss_line
+    # 100000/197000 = 50.8% -> at roofline
+    assert "AT ROOFLINE" in ss_line
+    cm_line = next(ln for ln in text.splitlines() if "confusion_matrix" in ln)
+    assert "NO CAPTURE" in cm_line  # the cpu row must not satisfy the tpu report
+    assert "2 invalid" in text
+    assert n_at == 2 and n_below == 1
+
+
+def test_empty_log_renders_no_captures(tmp_path, monkeypatch):
+    monkeypatch.setattr(rr, "RUNS", str(tmp_path / "missing.jsonl"))
+    text, n_at, n_below = rr.render("tpu")
+    assert n_at == 0 and n_below == 0
+    assert text.count("NO CAPTURE") == len(rr.CEILINGS)

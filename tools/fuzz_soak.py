@@ -598,6 +598,97 @@ def soak_detection(seeds) -> None:
         print(f"  (detection: reference deviated from the COCO-protocol oracle on {ref_deviations} key(s); ours matched the oracle on all of them)")
 
 
+def soak_checkpoint_resume(seeds) -> None:
+    """Mid-stream checkpoint/resume self-consistency under randomized
+    composition (SURVEY §5.4): stream random batch spans into a fresh metric,
+    interrupt at a random span boundary, round-trip the persistent state
+    through ``state_dict`` -> pickle -> a FRESH instance's
+    ``load_state_dict``, finish streaming there, and require the final
+    ``compute`` to equal an uninterrupted twin exactly. States are opted into
+    persistence first (``.persistent(True)`` — reference-parity semantics
+    exclude metric states from ``state_dict`` by default). Covers scalar-sum,
+    tensor, and list ('cat') states (exact-mode curves and CatMetric keep
+    lists), plus grouped MetricCollections, whose state aliasing must not
+    leak through serialization."""
+    import pickle
+
+    import metrics_tpu as ours_tm
+    import metrics_tpu.classification as ours_c
+    import metrics_tpu.regression as ours_r
+
+    def _values(tree):
+        if isinstance(tree, dict):
+            return {k: _values(v) for k, v in sorted(tree.items())}
+        if isinstance(tree, (list, tuple)):
+            return [_values(v) for v in tree]
+        return np.asarray(tree)
+
+    def _assert_equal(a, b, tag, seed):
+        a, b = _values(a), _values(b)
+        try:
+            jax.tree_util.tree_map(
+                lambda x, y: np.testing.assert_array_equal(np.asarray(x), np.asarray(y)), a, b
+            )
+        except Exception as exc:  # noqa: BLE001
+            FAILS.append((seed, tag, "resume != uninterrupted: " + repr(exc)[:140]))
+
+    for seed in seeds:
+        rng = np.random.default_rng(seed)
+        nc = int(rng.integers(3, 7))
+        n = int(rng.integers(40, 200))
+        probs = rng.random((n, nc)).astype(np.float32)
+        probs /= probs.sum(-1, keepdims=True)
+        labels = rng.integers(0, nc, n)
+        bprobs = rng.random(n).astype(np.float32)
+        btarget = rng.integers(0, 2, n)
+        x = rng.standard_normal(n).astype(np.float32)
+        y = (0.6 * x + 0.4 * rng.standard_normal(n)).astype(np.float32)
+        cuts = np.sort(rng.choice(np.arange(1, n), size=int(rng.integers(2, 5)), replace=False))
+        spans = list(zip([0, *cuts.tolist()], [*cuts.tolist(), n]))
+        stop = int(rng.integers(1, len(spans)))  # checkpoint after this many spans
+
+        avg = str(rng.choice(["micro", "macro", "weighted"]))
+        cases = [
+            ("acc", lambda: ours_c.MulticlassAccuracy(nc, average=avg, validate_args=False),
+             lambda m, lo, hi: m.update(jnp.asarray(probs[lo:hi]), jnp.asarray(labels[lo:hi]))),
+            ("auroc_binned", lambda: ours_c.MulticlassAUROC(nc, thresholds=17, validate_args=False),
+             lambda m, lo, hi: m.update(jnp.asarray(probs[lo:hi]), jnp.asarray(labels[lo:hi]))),
+            ("prc_exact", lambda: ours_c.BinaryPrecisionRecallCurve(thresholds=None, validate_args=False),
+             lambda m, lo, hi: m.update(jnp.asarray(bprobs[lo:hi]), jnp.asarray(btarget[lo:hi]))),
+            ("pearson", lambda: ours_r.PearsonCorrCoef(),
+             lambda m, lo, hi: m.update(jnp.asarray(x[lo:hi]), jnp.asarray(y[lo:hi]))),
+            ("cat", lambda: ours_tm.CatMetric(),
+             lambda m, lo, hi: m.update(jnp.asarray(x[lo:hi]))),
+            ("grouped_collection",
+             lambda: ours_tm.MetricCollection(
+                 [ours_c.MulticlassPrecision(nc, average=avg, validate_args=False),
+                  ours_c.MulticlassRecall(nc, average=avg, validate_args=False),
+                  ours_c.MulticlassF1Score(nc, average=avg, validate_args=False)],
+                 compute_groups=True),
+             lambda m, lo, hi: m.update(jnp.asarray(probs[lo:hi]), jnp.asarray(labels[lo:hi]))),
+        ]
+        for tag, factory, feed in cases:
+            try:
+                twin = factory()
+                for lo, hi in spans:
+                    feed(twin, lo, hi)
+                expected = twin.compute()
+
+                first = factory()
+                first.persistent(True)
+                for lo, hi in spans[:stop]:
+                    feed(first, lo, hi)
+                blob = pickle.dumps(first.state_dict())
+                resumed = factory()
+                resumed.persistent(True)
+                resumed.load_state_dict(pickle.loads(blob))
+                for lo, hi in spans[stop:]:
+                    feed(resumed, lo, hi)
+                _assert_equal(resumed.compute(), expected, tag, seed)
+            except Exception as exc:  # noqa: BLE001
+                FAILS.append((seed, tag, "resume surface raised: " + repr(exc)[:140]))
+
+
 SURFACES = {
     "classification": soak_classification,
     "regression_retrieval": soak_regression_retrieval,
@@ -607,6 +698,7 @@ SURFACES = {
     "wrappers_aggregation": soak_wrappers_aggregation,
     "collections": soak_collections,
     "detection": soak_detection,
+    "checkpoint_resume": soak_checkpoint_resume,
 }
 
 
