@@ -24,7 +24,7 @@ def test_verdict_classification(tmp_path, monkeypatch):
         # stale earlier capture for the same metric must NOT win
         {"metric": "roofline pairwise cosine GEMM", "value": 9.0, "unit": "ms",
          "backend": "tpu", "achieved_gflop_s": 1.0},
-        # latest wins: below, no note -> needs action
+        # latest wins: below threshold, carries its structural-bound note
         {"metric": "roofline pairwise cosine GEMM", "value": 1.0, "unit": "ms",
          "backend": "tpu", "achieved_gflop_s": 10000.0},
         # explicitly invalid capture
@@ -46,7 +46,7 @@ def test_verdict_classification(tmp_path, monkeypatch):
     tv_line = next(ln for ln in text.splitlines() if "total_variation" in ln)
     assert "AT ROOFLINE" in tv_line and "61.1%" in tv_line
     gemm_line = next(ln for ln in text.splitlines() if "GEMM" in ln)
-    assert "BELOW (needs action)" in gemm_line and "10000.0" in gemm_line
+    assert "BELOW (lower-bound accounting" in gemm_line and "10000.0" in gemm_line
     binned_line = next(ln for ln in text.splitlines() if "binned_curve" in ln)
     assert "INVALID CAPTURE" in binned_line
     ssim_line = next(ln for ln in text.splitlines() if "ssim" in ln)

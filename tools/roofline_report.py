@@ -76,6 +76,10 @@ LOWER_BOUND_NOTES = {
     "roofline confusion_matrix update": ("C=100 one-hots pad to 128 MXU lanes (~61% max tile "
                                          "utilization); the bare matmul measured 44% of peak — "
                                          "effectively at the achievable cap for this shape"),
+    "roofline pairwise cosine GEMM": ("f32 GEMM lowers to multi-pass bf16 on the MXU (3 passes at "
+                                      "default precision), so ~2/3 of the halved f32 ceiling is the "
+                                      "practical cap; the normalization epilogue adds a bandwidth "
+                                      "pass on top"),
 }
 
 
