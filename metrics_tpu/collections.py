@@ -497,19 +497,20 @@ class MetricCollection:
         for m in self._modules.values():
             m.persistent(mode)
 
-    def state_dict(self) -> Dict[str, Any]:
+    def state_dict(self, destination: Optional[Dict[str, Any]] = None, prefix: str = "") -> Dict[str, Any]:
         # group members may hold never-updated default states (only leaders
         # update) — refresh the aliasing so persistent states serialize with
-        # their group's real values
+        # their group's real values. destination/prefix mirror Metric's
+        # signature so wrappers (MetricTracker) can nest collections.
         self._compute_groups_create_state_ref()
-        destination: Dict[str, Any] = {}
+        destination = {} if destination is None else destination
         for name, m in self._modules.items():
-            m.state_dict(destination, prefix=f"{name}.")
+            m.state_dict(destination, prefix=f"{prefix}{name}.")
         return destination
 
-    def load_state_dict(self, state_dict: Dict[str, Any], strict: bool = True) -> None:
+    def load_state_dict(self, state_dict: Dict[str, Any], prefix: str = "", strict: bool = True) -> None:
         for name, m in self._modules.items():
-            m.load_state_dict(state_dict, prefix=f"{name}.", strict=strict)
+            m.load_state_dict(state_dict, prefix=f"{prefix}{name}.", strict=strict)
 
     def to_device(self, device: Any) -> "MetricCollection":
         for m in self._modules.values():

@@ -800,10 +800,40 @@ class Metric(ABC):
 
     # ------------------------------------------------------------------ persistence / serialization
 
+    def _child_metrics(self):
+        """Directly-held child metrics (wrapper bases, compositional operands),
+        as ``(attr_path, metric)`` pairs. The reference gets nested-metric
+        serialization for free from ``nn.Module`` child recursion
+        (wrappers/minmax.py holds the base metric as a submodule); without it,
+        a wrapped metric's accumulation would silently vanish through a
+        checkpoint — found by the ``checkpoint_resume`` fuzz surface."""
+        for name, val in self.__dict__.items():
+            if isinstance(val, Metric):
+                yield name, val
+            elif isinstance(val, (list, tuple)):
+                for i, v in enumerate(val):
+                    if isinstance(v, Metric):
+                        yield f"{name}.{i}", v
+
     def persistent(self, mode: bool = False) -> None:
-        """Set persistence of all states (reference metric.py:676-679)."""
+        """Set persistence of all states, including directly-held child
+        metrics' (reference metric.py:676-679; its CompositionalMetric
+        recurses the same way at :965-969 — we extend that to every nested
+        metric so ``wrapper.persistent(True)`` is sufficient to checkpoint)."""
         for key in self._persistent:
             self._persistent[key] = mode
+        for _name, child in self._child_metrics():
+            child.persistent(mode)
+
+    def _any_persistent(self) -> bool:
+        """True if any state here OR in any nested child metric is persistent —
+        wrappers gate their extra checkpoint payload (running extremes, RNG
+        streams) on this. A one-level check would read False for a
+        wrapper-typed base (which registers no states of its own) and
+        silently drop the payload."""
+        if any(self._persistent.values()):
+            return True
+        return any(child._any_persistent() for _name, child in self._child_metrics())
 
     def state_dict(self, destination: Optional[Dict] = None, prefix: str = "") -> Dict[str, Any]:
         """Persistent states as a flat dict of numpy arrays (orbax-friendly pytree).
@@ -825,6 +855,8 @@ class Metric(ABC):
                 ]
             else:
                 destination[prefix + key] = np.asarray(current)
+        for name, child in self._child_metrics():
+            child.state_dict(destination, prefix=f"{prefix}{name}.")
         return destination
 
     def load_state_dict(self, state_dict: Dict[str, Any], prefix: str = "", strict: bool = True) -> None:
@@ -844,6 +876,8 @@ class Metric(ABC):
                     setattr(self, key, jnp.asarray(val))
             elif strict and self._persistent[key]:
                 raise KeyError(f"Missing key {name} in state_dict")
+        for name, child in self._child_metrics():
+            child.load_state_dict(state_dict, prefix=f"{prefix}{name}.", strict=strict)
 
     def __getstate__(self) -> Dict[str, Any]:
         """Drop instance-wrapped fns for pickling (reference metric.py:587-591)."""
@@ -1100,11 +1134,8 @@ class CompositionalMetric(Metric):
         if isinstance(self.metric_b, Metric):
             self.metric_b.reset()
 
-    def persistent(self, mode: bool = False) -> None:
-        if isinstance(self.metric_a, Metric):
-            self.metric_a.persistent(mode=mode)
-        if isinstance(self.metric_b, Metric):
-            self.metric_b.persistent(mode=mode)
+    # persistent() needs no override: the base class's _child_metrics recursion
+    # reaches metric_a/metric_b (reference metric.py:965-969 recursed manually)
 
     def __repr__(self) -> str:
         _op_metrics = f"(\n  {self.op.__name__ if hasattr(self.op, '__name__') else self.op}(\n    {self.metric_a!r},\n    {self.metric_b!r}\n  )\n)"

@@ -9,6 +9,7 @@ from typing import Any, Dict, Optional, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import Array
 
 from metrics_tpu.metric import Metric
@@ -41,6 +42,14 @@ class MinMaxMetric(Metric):
                 f"Expected base metric to be an instance of `metrics_tpu.Metric` but received {base_metric}"
             )
         self._base_metric = base_metric
+        # deliberately PLAIN attributes, not registered states: they mutate
+        # inside compute(), and forward()'s full-state snapshot/restore (and
+        # the distributed sync/unsync context) would revert a registered
+        # state's compute-time mutation, freezing the running extremes — the
+        # reference keeps them unregistered for the same reason. Checkpointing
+        # is handled by the explicit state_dict/load_state_dict overrides
+        # below (the reference loses them through state_dict; found by the
+        # checkpoint_resume fuzz surface).
         self.min_val = jnp.asarray(float("inf"))
         self.max_val = jnp.asarray(float("-inf"))
 
@@ -57,10 +66,31 @@ class MinMaxMetric(Metric):
         return {"raw": jnp.asarray(val), "max": self.max_val, "min": self.min_val}
 
     def reset(self) -> None:
+        """Reset the base metric. The running extremes are deliberately KEPT:
+        the reference behaves this way (minmax.py:92-95 — its docstring claims
+        the bounds reset, but the body never touches the plain attributes),
+        and `forward` relies on it — the full-state forward path calls
+        `reset()` internally, so clearing here would wipe the extremes every
+        batch (observed: min==max==last batch value, vs the reference's
+        running min/max across forwards)."""
         super().reset()
         self._base_metric.reset()
-        self.min_val = jnp.asarray(float("inf"))
-        self.max_val = jnp.asarray(float("-inf"))
+
+    def state_dict(self, destination: Optional[Dict] = None, prefix: str = "") -> Dict[str, Any]:
+        destination = super().state_dict(destination, prefix)  # recurses into _base_metric
+        if self._any_persistent():  # recursive — the base may itself be a wrapper
+            destination[prefix + "min_val"] = np.asarray(self.min_val)
+            destination[prefix + "max_val"] = np.asarray(self.max_val)
+        return destination
+
+    def load_state_dict(self, state_dict: Dict[str, Any], prefix: str = "", strict: bool = True) -> None:
+        super().load_state_dict(state_dict, prefix, strict)
+        for key in ("min_val", "max_val"):
+            name = prefix + key
+            if name in state_dict:
+                setattr(self, key, jnp.asarray(state_dict[name]))
+            elif strict and self._any_persistent():
+                raise KeyError(f"Missing key {name} in state_dict")
 
     @staticmethod
     def _is_suitable_val(val: Union[float, Array]) -> bool:

@@ -275,3 +275,62 @@ class TestMultioutput:
         mo.update(data)
         res = np.asarray(mo.compute())
         np.testing.assert_allclose(res, [2.0, 4.0])
+
+
+def test_minmax_forward_and_reset_extremes_reference_semantics():
+    """Pins the executed-reference behavior verified round 5: extremes advance
+    with each forward's BATCH value (the full-state forward calls reset()
+    internally, so reset must NOT clear them — the reference's reset keeps the
+    plain attributes despite its docstring), and a user reset() likewise
+    preserves the running extremes while resetting the base accumulation."""
+    import jax.numpy as jnp
+
+    from metrics_tpu import MeanMetric, MinMaxMetric
+
+    m = MinMaxMetric(MeanMetric())
+    m(jnp.asarray(2.0))
+    m(jnp.asarray(4.0))
+    out = {k: float(v) for k, v in m.compute().items()}
+    assert out == {"raw": 4.0, "max": 4.0, "min": 2.0}  # == executed reference
+
+    m2 = MinMaxMetric(MeanMetric())
+    m2.update(jnp.asarray(5.0))
+    m2.compute()
+    m2.reset()
+    m2.update(jnp.asarray(1.0))
+    out2 = {k: float(v) for k, v in m2.compute().items()}
+    assert out2 == {"raw": 1.0, "max": 5.0, "min": 1.0}  # == executed reference
+
+
+def test_bootstrapper_checkpoint_restores_across_modes():
+    """A checkpoint records which execution mode produced it (vmapped single
+    state vs per-copy metrics — the vmap->copies runtime fallback is
+    permanent), and load re-shapes a fresh instance to the checkpoint's mode
+    before restoring, so accumulation survives regardless of how the fresh
+    instance would have initialized."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from metrics_tpu import BootStrapper
+    from metrics_tpu.classification import MulticlassAccuracy
+
+    rng = np.random.default_rng(0)
+    p = jnp.asarray(rng.random((24, 3)).astype(np.float32))
+    t = jnp.asarray(rng.integers(0, 3, 24))
+
+    src = BootStrapper(MulticlassAccuracy(3, validate_args=False), num_bootstraps=4,
+                       sampling_strategy="poisson", seed=5)  # copies mode
+    src.persistent(True)
+    src.update(p, t)
+    sd = src.state_dict()
+    assert bool(sd["_use_vmap"]) is False
+    assert all(isinstance(v, np.ndarray) for v in sd.values())
+
+    dst = BootStrapper(MulticlassAccuracy(3, validate_args=False), num_bootstraps=4,
+                       sampling_strategy="multinomial", seed=5)  # vmap mode
+    assert dst._use_vmap
+    dst.persistent(True)
+    dst.load_state_dict(sd)
+    assert not dst._use_vmap  # re-shaped to the checkpoint's mode
+    for k, v in src.compute().items():
+        np.testing.assert_array_equal(np.asarray(v), np.asarray(dst.compute()[k]))

@@ -276,3 +276,16 @@ class TestConfusionMatrixMatmulLowering:
         exp = np.zeros((c, c), np.int64)
         exp[1, 0] = 1  # only the (t=1, p=0) pair is fully in range
         np.testing.assert_array_equal(np.asarray(scatter), exp)
+
+    def test_matmul_eligibility_bounds(self):
+        """Boundary behavior of the shared accelerator-lowering guard: the f32
+        exactness bound is strict at 2^24 samples and the one-hot operand cap
+        is inclusive at 2^29 elements."""
+        from metrics_tpu.functional.classification.confusion_matrix import (
+            _matmul_lowering_eligible,
+        )
+
+        assert _matmul_lowering_eligible(2**24 - 1, 32)       # 2^29 - 32 operand elems
+        assert not _matmul_lowering_eligible(2**24, 2)        # f32 exactness bound
+        assert not _matmul_lowering_eligible(2**20, 2**10)    # 2^30 > 2^29 operand cap
+        assert _matmul_lowering_eligible(2**20, 2**9)         # exactly 2^29 is allowed

@@ -666,6 +666,30 @@ def soak_checkpoint_resume(seeds) -> None:
                   ours_c.MulticlassF1Score(nc, average=avg, validate_args=False)],
                  compute_groups=True),
              lambda m, lo, hi: m.update(jnp.asarray(probs[lo:hi]), jnp.asarray(labels[lo:hi]))),
+            ("minmax_wrapper",
+             lambda: ours_tm.MinMaxMetric(ours_c.MulticlassAccuracy(nc, average="micro", validate_args=False)),
+             lambda m, lo, hi: m.update(jnp.asarray(probs[lo:hi]), jnp.asarray(labels[lo:hi]))),
+            ("classwise_wrapper",
+             lambda: ours_tm.ClasswiseWrapper(ours_c.MulticlassF1Score(nc, average=None, validate_args=False)),
+             lambda m, lo, hi: m.update(jnp.asarray(probs[lo:hi]), jnp.asarray(labels[lo:hi]))),
+            # one tracked step per span: exercises dynamic-structure rebuild
+            ("tracker",
+             lambda: ours_tm.MetricTracker(ours_c.MulticlassAccuracy(nc, average="micro", validate_args=False)),
+             lambda m, lo, hi: (m.increment(),
+                                m.update(jnp.asarray(probs[lo:hi]), jnp.asarray(labels[lo:hi])))),
+            # seeded rng: the sampling stream must round-trip with the state
+            ("bootstrapper",
+             lambda: ours_tm.BootStrapper(
+                 ours_c.MulticlassAccuracy(nc, average="micro", validate_args=False),
+                 num_bootstraps=4, seed=int(seed)),
+             lambda m, lo, hi: m.update(jnp.asarray(probs[lo:hi]), jnp.asarray(labels[lo:hi]))),
+            # multinomial -> the vmapped single-state path: exercises the
+            # _stacked_state serialization, which the copies path never touches
+            ("bootstrapper_vmap",
+             lambda: ours_tm.BootStrapper(
+                 ours_c.MulticlassAccuracy(nc, average="micro", validate_args=False),
+                 num_bootstraps=4, sampling_strategy="multinomial", seed=int(seed)),
+             lambda m, lo, hi: m.update(jnp.asarray(probs[lo:hi]), jnp.asarray(labels[lo:hi]))),
         ]
         for tag, factory, feed in cases:
             try:
