@@ -690,6 +690,22 @@ def soak_checkpoint_resume(seeds) -> None:
                  ours_c.MulticlassAccuracy(nc, average="micro", validate_args=False),
                  num_bootstraps=4, sampling_strategy="multinomial", seed=int(seed)),
              lambda m, lo, hi: m.update(jnp.asarray(probs[lo:hi]), jnp.asarray(labels[lo:hi]))),
+            # per-output metric copies held in a list attribute
+            ("multioutput",
+             lambda: ours_tm.MultioutputWrapper(ours_r.MeanSquaredError(), num_outputs=2),
+             lambda m, lo, hi: m.update(jnp.asarray(np.stack([x[lo:hi], y[lo:hi]], -1)),
+                                        jnp.asarray(np.stack([y[lo:hi], x[lo:hi]], -1)))),
+            # metric arithmetic: operands are child metrics of the composition
+            ("compositional",
+             lambda: ours_c.MulticlassAccuracy(nc, average="micro", validate_args=False)
+                     + ours_c.MulticlassF1Score(nc, average="macro", validate_args=False),
+             lambda m, lo, hi: m.update(jnp.asarray(probs[lo:hi]), jnp.asarray(labels[lo:hi]))),
+            # collection mixing reducible and cat (exact-curve) states
+            ("collection_with_curve",
+             lambda: ours_tm.MetricCollection(
+                 {"acc": ours_c.BinaryAccuracy(validate_args=False),
+                  "prc": ours_c.BinaryPrecisionRecallCurve(thresholds=None, validate_args=False)}),
+             lambda m, lo, hi: m.update(jnp.asarray(bprobs[lo:hi]), jnp.asarray(btarget[lo:hi]))),
         ]
         for tag, factory, feed in cases:
             try:
