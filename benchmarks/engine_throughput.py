@@ -1,0 +1,144 @@
+"""Engine throughput: StreamingEngine vs naive per-call ``metric(preds, target)``.
+
+The acceptance bar for the serving runtime (ISSUE 1): at batch-1 submits on the CPU
+backend (8-device virtual mesh config), the engine must sustain >= 10x the requests/s
+of eagerly calling ``BinaryAccuracy.forward`` per request, with per-key results
+bit-identical to a single-threaded oracle run and the XLA compile count bounded by the
+bucket count after warmup.
+
+Method (benchmarks/README.md conventions): warmup excluded — the engine pass first
+runs one covering pass over the bucket ladder, the naive pass pays one warm forward;
+timed region is wall time over N completed requests (engine: submit from ``--threads``
+client threads + flush barrier). One JSON line per figure, appended to
+``suite_runs.jsonl``.
+
+Run: ``python benchmarks/engine_throughput.py [--requests 8000] [--threads 4]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from metrics_tpu.classification import BinaryAccuracy  # noqa: E402
+from metrics_tpu.engine import StreamingEngine  # noqa: E402
+from tools.jsonl_log import append_jsonl  # noqa: E402
+
+_RUNS_LOG = os.path.join(os.path.dirname(os.path.abspath(__file__)), "suite_runs.jsonl")
+BACKEND = jax.devices()[0].platform
+
+
+def emit(metric: str, value: float, unit: str, **extra) -> None:
+    row = {"metric": metric, "value": round(value, 4), "unit": unit, "backend": BACKEND, **extra}
+    print(json.dumps(row))
+    append_jsonl(_RUNS_LOG, dict(row))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=8000, help="engine-side request count")
+    ap.add_argument("--naive-requests", type=int, default=300, help="naive per-call sample size")
+    ap.add_argument("--threads", type=int, default=4, help="engine client threads")
+    ap.add_argument("--keys", type=int, default=8, help="tenant keys")
+    args = ap.parse_args()
+
+    rng = np.random.default_rng(0)
+    # batch-1 submits: the hardest regime for per-call dispatch overhead
+    stream = [
+        (f"tenant-{rng.integers(0, args.keys)}",
+         jnp.asarray(rng.integers(0, 2, 1)),
+         jnp.asarray(rng.integers(0, 2, 1)))
+        for _ in range(args.requests)
+    ]
+
+    # ---------------- naive per-call baseline: eager forward per request
+    naive = BinaryAccuracy()
+    p1, t1 = stream[0][1], stream[0][2]
+    naive(p1, t1)  # warm
+    t0 = time.perf_counter()
+    for i in range(args.naive_requests):
+        _, p, t = stream[i % len(stream)]
+        naive(p, t)
+    naive_dt = time.perf_counter() - t0
+    naive_rps = args.naive_requests / naive_dt
+    emit("naive per-call forward throughput", naive_rps, "req/s",
+         config={"metric": "BinaryAccuracy", "batch": 1, "n": args.naive_requests})
+
+    # ---------------- engine: coalesced micro-batched dispatch
+    buckets = (64, 256)
+    engine = StreamingEngine(BinaryAccuracy(), buckets=buckets, max_queue=2048, capacity=args.keys)
+    try:
+        # warmup: one covering pass over the bucket ladder with all keys allocated
+        for key, _, _ in stream:
+            engine._alloc_slot(key)
+        for rows in buckets:
+            engine.submit("tenant-0", jnp.asarray(rng.integers(0, 2, rows)),
+                          jnp.asarray(rng.integers(0, 2, rows)))
+        engine.flush()
+        engine.reset()
+        warm_compiles = engine.telemetry_snapshot()["compiles"]
+
+        t0 = time.perf_counter()
+
+        def client(tid: int) -> None:
+            for i in range(tid, len(stream), args.threads):
+                key, p, t = stream[i]
+                engine.submit(key, p, t)
+
+        threads = [threading.Thread(target=client, args=(tid,)) for tid in range(args.threads)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        engine.flush()
+        engine_dt = time.perf_counter() - t0
+        engine_rps = len(stream) / engine_dt
+
+        snap = engine.telemetry_snapshot()
+        emit("engine submit throughput", engine_rps, "req/s",
+             config={"metric": "BinaryAccuracy", "batch": 1, "n": len(stream),
+                     "threads": args.threads, "keys": args.keys, "buckets": list(buckets)},
+             mean_batch_occupancy=snap["mean_batch_occupancy"])
+        emit("engine p99 submit latency", snap["latency_s"]["p99"] * 1e3, "ms",
+             p50_ms=round(snap["latency_s"]["p50"] * 1e3, 4))
+        emit("engine speedup vs naive per-call", engine_rps / naive_rps, "x")
+
+        # ---------------- acceptance checks
+        oracles = {}
+        for key, p, t in stream:
+            oracles.setdefault(key, BinaryAccuracy()).update(p, t)
+        mismatches = [
+            key for key, oracle in oracles.items()
+            if float(engine.compute(key)) != float(oracle.compute())
+        ]
+        compiles_after = engine.telemetry_snapshot()["compiles"]
+        checks = {
+            "speedup_ge_10x": engine_rps / naive_rps >= 10.0,
+            "bit_identical_to_oracle": not mismatches,
+            "compiles_bounded_by_buckets": warm_compiles <= len(buckets)
+            and compiles_after == warm_compiles,
+        }
+        emit("engine acceptance", float(all(checks.values())), "bool",
+             checks=checks, compiles=compiles_after, mismatched_keys=mismatches[:4])
+        if not all(checks.values()):
+            sys.exit(1)
+    finally:
+        engine.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from metrics_tpu.metric import Metric
+from metrics_tpu.metric import Metric, _cached_jitted_updater, _raise_on_unconsumed
 from metrics_tpu.utils.data import _flatten_dict
 from metrics_tpu.utils.prints import rank_zero_warn
 
@@ -508,9 +508,15 @@ class MetricCollection:
             m.state_dict(destination, prefix=f"{prefix}{name}.")
         return destination
 
-    def load_state_dict(self, state_dict: Dict[str, Any], prefix: str = "", strict: bool = True) -> None:
+    def load_state_dict(
+        self, state_dict: Dict[str, Any], prefix: str = "", strict: bool = True, _consumed: Optional[set] = None
+    ) -> None:
+        owns_check = _consumed is None
+        consumed: set = set() if owns_check else _consumed
         for name, m in self._modules.items():
-            m.load_state_dict(state_dict, prefix=f"{prefix}{name}.", strict=strict)
+            m.load_state_dict(state_dict, prefix=f"{prefix}{name}.", strict=strict, _consumed=consumed)
+        if owns_check and strict:
+            _raise_on_unconsumed(state_dict, prefix, consumed)
 
     def to_device(self, device: Any) -> "MetricCollection":
         for m in self._modules.values():
@@ -534,6 +540,27 @@ class MetricCollection:
             new_state[name] = m.update_state(sub, *args, **m._filter_kwargs(**kwargs))
         return new_state
 
+    def merge_states(self, state_a: Dict[str, Any], state_b: Dict[str, Any]) -> Dict[str, Any]:
+        """Associatively merge two collection state pytrees, per member metric.
+
+        The collection analogue of :meth:`Metric.merge_states` — the streaming
+        engine's sliding windows and cross-shard folds need it for collections too.
+        States are keyed as ``init_state`` produced them (per metric, or per group
+        leader once groups are known).
+        """
+        return {name: self._modules[name].merge_states(state_a[name], state_b[name]) for name in state_a}
+
+    def jitted_update_state(self, donate: bool = True) -> Any:
+        """Fused single-dispatch collection update (engine hook).
+
+        ``update_state`` walks every group leader in Python; under ``jax.jit`` that
+        whole walk fuses into ONE compiled dispatch updating every member state — the
+        engine's collection path pays per-batch dispatch cost independent of the
+        number of metrics. Donated state buffers as in
+        :meth:`Metric.jitted_update_state`.
+        """
+        return _cached_jitted_updater(self, donate)
+
     def compute_from(self, state: Dict[str, Any], axis_name: Optional[Any] = None) -> Dict[str, Any]:
         """Pure compute for all metrics from the (group-deduped) state pytree."""
         leader_of = {}
@@ -550,6 +577,11 @@ class MetricCollection:
     @property
     def compute_groups(self) -> Dict[int, List[str]]:
         return self._groups
+
+    def __getstate__(self) -> Dict[str, Any]:
+        # compiled executables (the jitted-updater cache) neither pickle nor deepcopy;
+        # clone() rebuilds them lazily on first use
+        return {k: v for k, v in self.__dict__.items() if k != "_jitted_update_state"}
 
     def __repr__(self) -> str:
         repr_str = self.__class__.__name__ + "(\n"

@@ -1,0 +1,34 @@
+"""Streaming metric engine — async micro-batched, multi-tenant metric serving.
+
+Turns any ``Metric`` / ``MetricCollection`` into a high-throughput service::
+
+    from metrics_tpu.engine import StreamingEngine
+
+    engine = StreamingEngine(BinaryAccuracy(), buckets=(8, 64, 256), max_queue=1024)
+    fut = engine.submit(client_id, preds, target)   # non-blocking; Future receipt
+    value = engine.compute(client_id)               # flush + per-tenant compute
+    engine.close()
+
+Layout: ``bucketing.py`` (shape-bucketed padding), ``runtime.py`` (bounded-queue
+dispatcher + jitted bucket kernels + backpressure/degradation), ``stream.py``
+(stacked multi-tenant keyed state + sliding windows), ``telemetry.py`` (counters,
+occupancy, p50/p99 latency).
+"""
+
+from metrics_tpu.engine.bucketing import DEFAULT_BUCKETS, choose_bucket, inspect_request, pad_micro_batch
+from metrics_tpu.engine.runtime import EngineBackpressure, EngineClosed, StreamingEngine
+from metrics_tpu.engine.stream import EagerKeyedState, KeyedState
+from metrics_tpu.engine.telemetry import EngineTelemetry
+
+__all__ = [
+    "DEFAULT_BUCKETS",
+    "EagerKeyedState",
+    "EngineBackpressure",
+    "EngineClosed",
+    "EngineTelemetry",
+    "KeyedState",
+    "StreamingEngine",
+    "choose_bucket",
+    "inspect_request",
+    "pad_micro_batch",
+]

@@ -1,0 +1,137 @@
+"""Shape-bucketed padding of incoming requests — keeps the jit cache bounded and hot.
+
+A serving process receives requests of arbitrary row counts. Dispatching the jitted
+updater on the raw shapes would compile once per distinct row count (the same failure
+mode BootStrapper's poisson path hit before ``_chunk_spans``: ~250 ms per cache miss).
+Instead, coalesced request rows are padded up to a small fixed set of bucket sizes
+(powers of two by default) with a boolean row mask, so the engine's per-bucket kernels
+compile once each and every subsequent micro-batch reuses a hot executable.
+
+Padded rows are *carried but never applied*: the dispatch kernel (runtime.py) selects
+the pre-update state for masked rows, so padding contributes exactly zero to every
+tenant's state — no reliance on the metric having a neutral input value.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+# Default micro-batch row buckets. Small buckets keep padding waste low for trickle
+# traffic; the largest bounds one dispatch's work under burst load.
+DEFAULT_BUCKETS: Tuple[int, ...] = (8, 16, 32, 64, 128, 256)
+
+# (trailing shape, dtype name) per positional arg — the jit-cache-relevant part of a
+# request's shape, i.e. everything except the bucketed leading (row) axis.
+Signature = Tuple[Tuple[Tuple[int, ...], str], ...]
+
+
+def normalize_buckets(buckets: Sequence[int]) -> Tuple[int, ...]:
+    """Sorted, deduplicated, validated bucket sizes."""
+    sizes = sorted({int(b) for b in buckets})
+    if not sizes or sizes[0] < 1:
+        raise MetricsTPUUserError(f"`buckets` must be positive integers, got {buckets!r}")
+    return tuple(sizes)
+
+
+def inspect_request(args: Sequence[Any]) -> Tuple[int, Signature]:
+    """Row count and shape signature of one request's positional arrays.
+
+    Every arg must share the leading (row) axis — that is the axis the engine
+    coalesces, masks and buckets over.
+    """
+    if not args:
+        raise MetricsTPUUserError("submit() needs at least one array argument")
+    rows = None
+    sig: List[Tuple[Tuple[int, ...], str]] = []
+    for a in args:
+        arr = a if isinstance(a, (jax.Array, np.ndarray)) else np.asarray(a)
+        if arr.ndim < 1:
+            raise MetricsTPUUserError(
+                "submit() arguments must have a leading batch axis (got a 0-d array); "
+                "wrap scalars as shape-(1,) arrays"
+            )
+        if rows is None:
+            rows = int(arr.shape[0])
+        elif int(arr.shape[0]) != rows:
+            raise MetricsTPUUserError(
+                f"submit() arguments disagree on the leading axis: {rows} vs {int(arr.shape[0])}"
+            )
+        # canonical dtype, not the submitted one: pad_micro_batch feeds the kernel
+        # through jnp.asarray, which canonicalizes (int64 -> int32 with x64 off) — a
+        # raw-numpy client and a jnp client submitting identical data must share one
+        # kernel, not trace duplicate ladders per submitted dtype
+        canon = jax.dtypes.canonicalize_dtype(arr.dtype)
+        sig.append((tuple(int(s) for s in arr.shape[1:]), np.dtype(canon).name))
+    return rows, tuple(sig)
+
+
+def choose_bucket(rows: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket that fits ``rows`` (deterministic); the largest if none does.
+
+    Callers split loads larger than the top bucket into several micro-batches, so
+    returning the cap here keeps the choice total.
+    """
+    for b in buckets:
+        if rows <= b:
+            return b
+    return buckets[-1]
+
+
+def pad_micro_batch(
+    requests: Sequence[Tuple[int, Sequence[Any], int]],
+    bucket: int,
+) -> Tuple[List[jax.Array], jax.Array, jax.Array]:
+    """Assemble one padded micro-batch from coalesced requests.
+
+    ``requests`` is a sequence of ``(slot, args, rows)`` triples whose total rows fit
+    ``bucket``. Rows are laid out back-to-back in submission order (the dispatch kernel
+    scans them in this order, preserving per-tenant sequential semantics); the tail is
+    zero-padded and masked out. Returns ``(columns, key_ids, mask)`` where each column
+    has shape ``(bucket, 1, *trailing)`` — the per-row scan slice keeps a leading batch
+    axis of 1 so metric ``update_state`` sees an ordinary (tiny) batch.
+
+    Padding rows carry the first request's slot id: it is always a valid index into the
+    stacked state (so the masked gather/scatter stays in bounds) and the mask guarantees
+    it is never applied.
+    """
+    total = sum(r for _, _, r in requests)
+    if total > bucket:
+        raise MetricsTPUUserError(f"micro-batch of {total} rows exceeds bucket {bucket}")
+    n_args = len(requests[0][1])
+    key_ids = np.full(bucket, requests[0][0], dtype=np.int32)
+    mask = np.zeros(bucket, dtype=bool)
+    columns: List[np.ndarray] = []
+    for j in range(n_args):
+        ref = np.asarray(requests[0][1][j])
+        col = np.zeros((bucket, 1) + ref.shape[1:], dtype=ref.dtype)
+        off = 0
+        for slot, args, rows in requests:
+            col[off : off + rows, 0] = np.asarray(args[j])
+            if j == 0:
+                key_ids[off : off + rows] = slot
+                mask[off : off + rows] = True
+            off += rows
+        columns.append(col)
+    return [jnp.asarray(c) for c in columns], jnp.asarray(key_ids), jnp.asarray(mask)
+
+
+def split_rows(args: Sequence[Any], max_rows: int) -> List[Tuple[Sequence[Any], int]]:
+    """Split one oversized request into row-chunks of at most ``max_rows``.
+
+    Engine semantics are per-row streaming updates (see runtime.py), so chunking a
+    request along rows is exact for the supported metric class.
+    """
+    rows, _ = inspect_request(args)
+    if rows <= max_rows:
+        return [(tuple(args), rows)]
+    out: List[Tuple[Sequence[Any], int]] = []
+    for lo in range(0, rows, max_rows):
+        hi = min(lo + max_rows, rows)
+        out.append((tuple(a[lo:hi] for a in args), hi - lo))
+    return out

@@ -1,0 +1,647 @@
+"""StreamingEngine — async micro-batched, multi-tenant metric serving runtime.
+
+The pure-functional core (``Metric.update_state`` / ``compute_from`` /
+``merge_states``) is the substrate: state is an explicit pytree, updates are pure and
+jittable, so a serving process does not have to serialize clients through a lock or
+pay one dispatch per request. Instead:
+
+    client threads ── submit(key, *arrays) ──► bounded queue ──► dispatcher thread
+        │                                         │ coalesce + shape-bucket (bucketing.py)
+        │  Future (receipt)  ◄─────────────────── │ ONE jitted donated-buffer dispatch
+        │                                         ▼ per bucket: masked scan over rows,
+        └── compute(key) ◄── flush ── keyed stacked state (stream.py), all tenants
+
+Dispatch semantics are **per-row streaming updates in submission order**: the bucket
+kernel scans the coalesced rows, applying the metric's own ``update_state`` to each
+tenant's slice and masking padded rows back to their pre-update state. For the
+engine's supported metric class (fixed-shape array states — every sum/count/extreme
+accumulator) this is exactly the sequential per-request semantics, bit-for-bit; the
+compile cache is bounded by ``len(buckets) × log2(tenant capacity)`` kernels PER
+request signature (trailing shape + canonical dtype — a serving deployment has a
+small fixed set of these; dtypes are canonicalized so numpy/jnp clients share
+kernels).
+
+Degradation ladder (each step is correctness-preserving, only slower):
+
+1. fused micro-batched dispatch (the hot path);
+2. metrics whose update cannot trace (ragged "cat" states, host-compute, data-dependent
+   Python) demote permanently to eager per-request ``update_state`` on the dispatcher
+   thread — still async, still multi-tenant;
+3. if the dispatcher thread itself dies, the engine completes its in-flight work
+   synchronously and every later ``submit`` runs inline on the caller's thread
+   (per-call dispatch) — no request is ever silently lost.
+
+Backpressure at a full queue follows ``policy``: ``"block"`` (wait for space),
+``"drop"`` (raise :class:`EngineBackpressure` immediately), ``"timeout"`` (wait up to
+``submit_timeout`` seconds, then raise).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Callable, Dict, Hashable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from metrics_tpu.collections import MetricCollection
+from metrics_tpu.engine.bucketing import (
+    DEFAULT_BUCKETS,
+    Signature,
+    choose_bucket,
+    inspect_request,
+    normalize_buckets,
+    pad_micro_batch,
+    split_rows,
+)
+from metrics_tpu.engine.stream import EagerKeyedState, KeyedState
+from metrics_tpu.engine.telemetry import EngineTelemetry
+from metrics_tpu.metric import Metric
+from metrics_tpu.parallel.sync import sync_state_host
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+_POLICIES = ("block", "drop", "timeout")
+
+
+class EngineClosed(MetricsTPUUserError):
+    """submit() after close()."""
+
+
+class EngineBackpressure(MetricsTPUUserError):
+    """Request rejected at a full queue (drop policy, or timeout policy expiry)."""
+
+
+class _FusedUnsupported(Exception):
+    """Internal: the metric's update cannot trace inside the bucket kernel."""
+
+
+class _Request:
+    __slots__ = ("key", "slot", "args", "rows", "signature", "future", "t_submit", "rows_done")
+
+    def __init__(self, key: Hashable, slot: Optional[int], args: Tuple[Any, ...],
+                 rows: int, signature: Signature, future: "Future", t_submit: float) -> None:
+        self.key = key
+        self.slot = slot
+        self.args = args
+        self.rows = rows
+        self.signature = signature
+        self.future = future
+        # stamped at submit() ENTRY, before any backpressure wait — the latency
+        # percentiles must include the stall they exist to surface
+        self.t_submit = t_submit
+        # rows already committed to the state (fused chunks commit incrementally, so a
+        # mid-batch fused→eager demotion must not re-apply them)
+        self.rows_done = 0
+
+
+def _component_metrics(metric: Any) -> List[Metric]:
+    if isinstance(metric, MetricCollection):
+        return list(metric._modules.values())
+    return [metric]
+
+
+class StreamingEngine:
+    """Serve a ``Metric`` or ``MetricCollection`` to many concurrent clients.
+
+    Args:
+        metric_or_collection: the logical metric. The engine works on a private clone,
+            so the caller's instance stays free for direct use.
+        buckets: micro-batch row sizes the kernels compile for (powers of two by
+            default). The compile cache after warmup is bounded by this set.
+        max_queue: bound on queued (not yet dispatched) requests.
+        policy: backpressure policy at a full queue — "block" | "drop" | "timeout".
+        submit_timeout: seconds a "timeout"-policy submit waits for queue space.
+        window: sliding-window length in segments (see :meth:`rotate_window`);
+            ``None`` disables windowing.
+        capacity: initial tenant capacity (rounded up to a power of two; grows by
+            doubling as keys arrive — each growth recompiles the bucket kernels once).
+        start: launch the dispatcher thread immediately.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.classification import BinaryAccuracy
+        >>> from metrics_tpu.engine import StreamingEngine
+        >>> engine = StreamingEngine(BinaryAccuracy(), buckets=(4, 8))
+        >>> for preds, target in [([1, 0], [1, 1]), ([1], [1])]:
+        ...     fut = engine.submit("tenant-a", jnp.array(preds), jnp.array(target))
+        >>> engine.flush()
+        >>> engine.compute("tenant-a")
+        Array(0.6666667, dtype=float32)
+        >>> engine.close()
+    """
+
+    def __init__(
+        self,
+        metric_or_collection: Any,
+        *,
+        buckets: Sequence[int] = DEFAULT_BUCKETS,
+        max_queue: int = 1024,
+        policy: str = "block",
+        submit_timeout: float = 1.0,
+        window: Optional[int] = None,
+        capacity: int = 8,
+        telemetry_window: int = 2048,
+        start: bool = True,
+    ) -> None:
+        if not isinstance(metric_or_collection, (Metric, MetricCollection)):
+            raise MetricsTPUUserError(
+                f"StreamingEngine serves a Metric or MetricCollection, got {type(metric_or_collection)!r}"
+            )
+        if policy not in _POLICIES:
+            raise MetricsTPUUserError(f"`policy` must be one of {_POLICIES}, got {policy!r}")
+        if max_queue < 1:
+            raise MetricsTPUUserError(f"`max_queue` must be >= 1, got {max_queue}")
+
+        self._metric = metric_or_collection.clone()
+        self._buckets = normalize_buckets(buckets)
+        self._max_rows = self._buckets[-1]
+        self._max_queue = int(max_queue)
+        self._policy = policy
+        self._submit_timeout = float(submit_timeout)
+        self.telemetry = EngineTelemetry(latency_window=telemetry_window)
+
+        # Fused eligibility is structural: every component metric must hold only
+        # fixed-shape array states (ragged "cat" lists cannot stack along a key axis)
+        # and compute on device. Untraceable *updates* are only discoverable at trace
+        # time — those demote at the first kernel build instead (telemetry
+        # `fused_fallbacks`).
+        self._fused = all(
+            not m._host_compute and not any(isinstance(d, list) for d in m._defaults.values())
+            for m in _component_metrics(self._metric)
+        )
+        self._keyed = (
+            KeyedState(self._metric, capacity=capacity, window=window)
+            if self._fused
+            else EagerKeyedState(self._metric, window=window)
+        )
+        self._window = window
+
+        # (signature, bucket, capacity) -> jitted kernel
+        self._kernels: Dict[Tuple[Signature, int, int], Callable] = {}
+
+        self._lock = threading.RLock()
+        self._not_empty = threading.Condition(self._lock)
+        self._not_full = threading.Condition(self._lock)
+        self._idle = threading.Condition(self._lock)
+        self._queue: List[_Request] = []
+        self._inflight = 0
+        self._closed = False
+        self._degraded = False
+        self._worker_error: Optional[BaseException] = None
+        # serializes use of the private metric instance (update_state/compute_from
+        # swap state attrs in and out, so two threads must not interleave there)
+        self._dispatch_lock = threading.Lock()
+        # test/ops hook: clearing holds the dispatcher *before* it processes a drained
+        # batch, letting backpressure be exercised deterministically
+        self._worker_gate = threading.Event()
+        self._worker_gate.set()
+
+        self._worker: Optional[threading.Thread] = None
+        if start:
+            self.start()
+
+    # ------------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        with self._lock:
+            if self._worker is not None or self._closed:
+                return
+            self._worker = threading.Thread(
+                target=self._run, name="metrics-tpu-engine-dispatch", daemon=True
+            )
+            self._worker.start()
+
+    def close(self, flush: bool = True) -> None:
+        """Stop accepting work; by default drain what was already accepted."""
+        with self._lock:
+            if self._closed:
+                return
+        if flush:
+            self.flush()
+        with self._lock:
+            self._closed = True
+            self._not_empty.notify_all()
+            self._not_full.notify_all()
+            self._idle.notify_all()
+            worker = self._worker
+        if worker is not None and worker is not threading.current_thread():
+            worker.join(timeout=10.0)
+
+    def __enter__(self) -> "StreamingEngine":
+        return self
+
+    def __exit__(self, *exc_info: Any) -> None:
+        self.close()
+
+    # ------------------------------------------------------------------ client API
+
+    def submit(self, key: Hashable, *args: Any) -> "Future":
+        """Enqueue one update for tenant ``key``; resolves to a receipt dict once the
+        state update has committed.
+
+        Raises :class:`EngineBackpressure` per the configured policy when the queue is
+        full, and :class:`EngineClosed` after :meth:`close`.
+        """
+        t_submit = time.perf_counter()
+        rows, signature = inspect_request(args)
+        future: Future = Future()
+        with self._not_full:
+            if self._closed:
+                raise EngineClosed("submit() on a closed StreamingEngine")
+            if self._degraded or self._worker is None:
+                # synchronous per-call dispatch (dispatcher dead or never started)
+                req = _Request(key, self._alloc_slot(key), tuple(args), rows, signature, future, t_submit)
+                self.telemetry.count("submitted")
+                self._apply_inline(req)
+                return future
+            deadline = time.monotonic() + self._submit_timeout
+            while len(self._queue) >= self._max_queue:
+                if self._policy == "drop":
+                    self.telemetry.count("dropped")
+                    raise EngineBackpressure(f"queue full ({self._max_queue}); request dropped")
+                if self._policy == "timeout":
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        self.telemetry.count("timed_out")
+                        raise EngineBackpressure(
+                            f"queue full ({self._max_queue}); timed out after {self._submit_timeout}s"
+                        )
+                    self._not_full.wait(remaining)
+                else:
+                    self._not_full.wait()
+                if self._closed:
+                    raise EngineClosed("StreamingEngine closed while waiting for queue space")
+                if self._degraded:
+                    req = _Request(key, self._alloc_slot(key), tuple(args), rows, signature, future, t_submit)
+                    self.telemetry.count("submitted")
+                    self._apply_inline(req)
+                    return future
+            req = _Request(key, self._alloc_slot(key), tuple(args), rows, signature, future, t_submit)
+            self._queue.append(req)
+            self.telemetry.count("submitted")
+            self.telemetry.gauge_queue_depth(len(self._queue))
+            self._not_empty.notify()
+        return future
+
+    def flush(self, timeout: Optional[float] = None) -> None:
+        """Block until every accepted request has committed (or ``timeout`` elapses).
+
+        Holds through a worker death too: the death handler keeps ``_inflight`` equal
+        to the number of accepted-but-unreplayed requests while it replays them
+        inline, so 'accepted implies committed after flush' survives degradation.
+        """
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._idle:
+            while self._queue or self._inflight:
+                remaining = 0.1 if deadline is None else min(0.1, deadline - time.monotonic())
+                if remaining <= 0:
+                    raise TimeoutError("StreamingEngine.flush timed out")
+                # bounded waits double as liveness checks against a dying dispatcher
+                self._idle.wait(remaining)
+
+    def compute(self, key: Hashable, *, window: bool = False, sync: bool = False) -> Any:
+        """Final metric value for tenant ``key`` (flushes first).
+
+        ``window=True`` computes over the sliding window (requires ``window=`` at
+        construction); ``sync=True`` all-reduces the state across JAX processes first
+        (multi-host serving), via :func:`metrics_tpu.parallel.sync.sync_state_host`.
+        """
+        if window and self._window is None:
+            # a silent fall-through would return unbounded lifetime accumulation
+            # mislabeled as a sliding-window value
+            raise MetricsTPUUserError("compute(window=True) requires the engine to be built with `window=`")
+        self.flush()
+        with self._dispatch_lock:
+            if key not in self._keyed.keys:
+                raise KeyError(f"unknown tenant key {key!r}")
+            state = self._keyed.merged_state(key) if window else self._keyed.state_of(key)
+            if sync:
+                state = self._sync_state(state)
+            return self._metric.compute_from(state)
+
+    def compute_all(self, *, window: bool = False, sync: bool = False) -> Dict[Hashable, Any]:
+        """``compute`` for every known tenant key — one flush, one consistent snapshot.
+
+        All tenants' states are read under a single dispatch-lock acquisition after a
+        single flush, so under live traffic the returned mapping is a point-in-time
+        view (per-key ``compute`` in a loop would re-flush per tenant and interleave
+        with new submissions).
+        """
+        if window and self._window is None:
+            raise MetricsTPUUserError("compute_all(window=True) requires the engine to be built with `window=`")
+        self.flush()
+        with self._dispatch_lock:
+            out: Dict[Hashable, Any] = {}
+            for key in self._keyed.keys:
+                state = self._keyed.merged_state(key) if window else self._keyed.state_of(key)
+                if sync:
+                    state = self._sync_state(state)
+                out[key] = self._metric.compute_from(state)
+            return out
+
+    def rotate_window(self) -> None:
+        """Close the current sliding-window segment for ALL tenants (flushes first)."""
+        self.flush()
+        with self._dispatch_lock:
+            self._keyed.rotate()
+        self.telemetry.count("window_rotations")
+
+    def reset(self) -> None:
+        """Drop all tenant state (keys stay allocated)."""
+        self.flush()
+        with self._dispatch_lock:
+            self._keyed.reset()
+
+    @property
+    def fused(self) -> bool:
+        """True while the engine serves via the single-dispatch bucket kernels."""
+        return self._fused
+
+    @property
+    def degraded(self) -> bool:
+        """True once the dispatcher died and submits run inline."""
+        return self._degraded
+
+    def telemetry_snapshot(self) -> Dict[str, Any]:
+        snap = self.telemetry.snapshot()
+        snap["fused"] = self._fused
+        snap["degraded"] = self._degraded
+        snap["tenants"] = len(self._keyed.keys)
+        return snap
+
+    # ------------------------------------------------------------------ internals
+
+    def _alloc_slot(self, key: Hashable) -> Optional[int]:
+        return self._keyed.slot_for(key)
+
+    def _sync_state(self, state: Any) -> Any:
+        if isinstance(self._metric, MetricCollection):
+            return {
+                name: sync_state_host(sub, self._metric._modules[name]._reductions)
+                for name, sub in state.items()
+            }
+        return sync_state_host(state, self._metric._reductions)
+
+    def _run(self) -> None:
+        while True:
+            with self._not_empty:
+                while not self._queue and not self._closed:
+                    self._not_empty.wait(0.1)
+                if not self._queue and self._closed:
+                    return
+                batch = self._queue
+                self._queue = []
+                self._inflight = len(batch)
+                self.telemetry.gauge_queue_depth(0)
+                self._not_full.notify_all()
+            self._worker_gate.wait()
+            try:
+                self._process(batch)
+                with self._lock:
+                    self._inflight = 0
+                    self._idle.notify_all()
+            except BaseException as exc:  # noqa: BLE001 — dispatcher death: degrade, don't lose work
+                self._on_worker_death(exc, batch)
+                return
+
+    def _process(self, batch: List[_Request]) -> None:
+        if self._fused:
+            try:
+                self._process_fused(batch)
+                return
+            except _FusedUnsupported:
+                pass
+            # A trace failure is ambiguous: the metric's update may be untraceable
+            # (demote permanently), or ONE malformed request may have poisoned its
+            # chunk's trace (reject that request, keep the fused path for everyone
+            # else). The eager retry distinguishes them: it re-runs the same updates
+            # outside the trace, so a malformed request fails ITS future there while
+            # an untraceable-but-valid update succeeds for every request.
+            remaining = [req for req in batch if not req.future.done()]
+            self._process_eager(remaining)
+            if remaining and all(req.future.exception() is None for req in remaining):
+                self._demote_to_eager()
+            return
+        self._process_eager([req for req in batch if not req.future.done()])
+
+    # ---------------------------------------------------- fused (bucketed) dispatch
+
+    def _process_fused(self, batch: List[_Request]) -> None:
+        with self._dispatch_lock:
+            if self._keyed.ensure_capacity():
+                self.telemetry.count("key_growths")
+            for signature, reqs in self._signature_groups(batch):
+                self._dispatch_group(signature, reqs)
+
+    @staticmethod
+    def _signature_groups(batch: List[_Request]) -> List[Tuple[Signature, List[_Request]]]:
+        """Coalesce the drained batch into dispatch groups of one shape signature.
+
+        Batch-wide grouping maximizes bucket occupancy but replays a tenant's
+        requests signature-by-signature, which reorders them when ONE tenant mixes
+        shapes in the same drain. Per-tenant submission order is part of the engine's
+        sequential-semantics contract, so that (rare) case falls back to grouping by
+        consecutive same-signature runs — order-preserving, slightly smaller
+        micro-batches."""
+        tenant_sig: Dict[Hashable, Signature] = {}
+        mixed = False
+        for req in batch:
+            prev = tenant_sig.setdefault(req.key, req.signature)
+            if prev != req.signature:
+                mixed = True
+                break
+        groups: List[Tuple[Signature, List[_Request]]] = []
+        if not mixed:
+            by_sig: Dict[Signature, List[_Request]] = {}
+            for req in batch:
+                by_sig.setdefault(req.signature, []).append(req)
+            groups.extend(by_sig.items())
+        else:
+            for req in batch:
+                if groups and groups[-1][0] == req.signature:
+                    groups[-1][1].append(req)
+                else:
+                    groups.append((req.signature, [req]))
+        return groups
+
+    def _dispatch_group(self, signature: Signature, reqs: List[_Request]) -> None:
+        # expand oversized requests into row-chunks, then greedily pack chunks into
+        # micro-batches of at most max_rows rows
+        units: List[Tuple[_Request, Tuple[Any, ...], int, bool]] = []
+        for req in reqs:
+            chunks = split_rows(req.args, self._max_rows)
+            for i, (chunk_args, rows) in enumerate(chunks):
+                units.append((req, chunk_args, rows, i == len(chunks) - 1))
+
+        pending: List[Tuple[_Request, Tuple[Any, ...], int, bool]] = []
+        pending_rows = 0
+        for unit in units:
+            if pending and pending_rows + unit[2] > self._max_rows:
+                self._dispatch_chunk(signature, pending, pending_rows)
+                pending, pending_rows = [], 0
+            pending.append(unit)
+            pending_rows += unit[2]
+        if pending:
+            self._dispatch_chunk(signature, pending, pending_rows)
+
+    def _dispatch_chunk(
+        self,
+        signature: Signature,
+        units: List[Tuple[_Request, Tuple[Any, ...], int, bool]],
+        total_rows: int,
+    ) -> None:
+        bucket = choose_bucket(total_rows, self._buckets)
+        kernel = self._get_kernel(signature, bucket, self._keyed.capacity)
+        columns, key_ids, mask = pad_micro_batch(
+            [(req.slot, chunk_args, rows) for req, chunk_args, rows, _ in units], bucket
+        )
+        self._keyed.stacked = kernel(self._keyed.stacked, key_ids, mask, *columns)
+        # commit before completing futures: surfaces device-side errors here and makes
+        # the receipt mean "your rows are in the state", not "your rows are enqueued"
+        jax.block_until_ready(self._keyed.stacked)
+        self.telemetry.observe_batch(total_rows, bucket)
+        now = time.perf_counter()
+        for req, _, rows, is_last in units:
+            req.rows_done += rows
+            if not is_last:
+                continue
+            self.telemetry.count("processed")
+            self.telemetry.observe_latency(now - req.t_submit)
+            req.future.set_result({"key": req.key, "rows": req.rows, "bucket": bucket})
+
+    def _get_kernel(self, signature: Signature, bucket: int, capacity: int) -> Callable:
+        cache_key = (signature, bucket, capacity)
+        kernel = self._kernels.get(cache_key)
+        if kernel is None:
+            kernel = self._build_kernel()
+            self._kernels[cache_key] = kernel
+        return kernel
+
+    def _build_kernel(self) -> Callable:
+        """One jitted micro-batch kernel: masked per-row scan over the stacked state.
+
+        The scan body runs the metric's own ``update_state`` on the addressed tenant's
+        slice and `where`-selects the pre-update state for masked (padding) rows, then
+        scatters the slice back — sequential per-tenant semantics, one XLA dispatch for
+        the whole micro-batch across all tenants. The input stack is donated: the
+        engine owns it exclusively, so XLA can update the buffers in place.
+        """
+        metric = self._metric
+        telemetry = self.telemetry
+
+        def kernel(stacked: Any, key_ids: jax.Array, mask: jax.Array, *columns: jax.Array) -> Any:
+            # executes at trace time only — counts actual recompiles, not calls
+            telemetry.count("compiles")
+
+            def step(carry: Any, xs: Tuple[Any, ...]) -> Tuple[Any, None]:
+                kid, mk = xs[0], xs[1]
+                rows = xs[2:]
+                per_key = jax.tree.map(lambda s: s[kid], carry)
+                new = metric.update_state(per_key, *rows)
+                new = jax.tree.map(lambda n, o: jnp.where(mk, n, o), new, per_key)
+                carry = jax.tree.map(lambda s, n: s.at[kid].set(n), carry, new)
+                return carry, None
+
+            carry, _ = lax.scan(step, stacked, (key_ids, mask, *columns))
+            return carry
+
+        jitted = jax.jit(kernel, donate_argnums=0)
+
+        def guarded(stacked: Any, key_ids: jax.Array, mask: jax.Array, *columns: jax.Array) -> Any:
+            try:
+                return jitted(stacked, key_ids, mask, *columns)
+            except Exception as exc:  # noqa: BLE001
+                # Trace/compile failures here are either an untraceable metric update
+                # (TracerBoolConversionError/ConcretizationTypeError as TypeError,
+                # data-dependent masking as IndexError) or ONE malformed request
+                # poisoning its chunk (shape errors as TypeError/ValueError). Both
+                # funnel into _process's eager retry, which re-runs the same updates
+                # outside the trace — a genuine bug is re-raised on its own request's
+                # future, never masked, and the dispatcher never dies on a per-chunk
+                # failure.
+                raise _FusedUnsupported(repr(exc)) from exc
+
+        return guarded
+
+    def _demote_to_eager(self) -> None:
+        """Permanent fused→eager fallback: migrate accumulated stacked state."""
+        with self._dispatch_lock:
+            old = self._keyed
+            eager = EagerKeyedState(self._metric, window=self._window)
+            for key in old.keys:
+                eager.slot_for(key)
+                eager.set_state(key, old.state_of(key))
+            if old._ring is not None and eager._ring is not None:
+                for cap, snap in old._ring:
+                    seg: Dict[Hashable, Any] = {}
+                    for key in old.keys:
+                        slot = old._slots[key]
+                        if slot < cap:
+                            seg[key] = jax.tree.map(lambda x: x[slot], snap)
+                    eager._ring.append(seg)
+            self._keyed = eager
+            self._fused = False
+            self._kernels.clear()
+        self.telemetry.count("fused_fallbacks")
+
+    # ---------------------------------------------------- eager / degraded dispatch
+
+    def _process_eager(self, batch: List[_Request]) -> None:
+        for req in batch:
+            self._apply_inline(req)
+
+    def _apply_inline(self, req: _Request) -> None:
+        """Synchronous per-request dispatch (eager mode, and the degraded path).
+
+        Applies only the rows a fused chunk has not already committed, so a request
+        caught mid-demotion is never double-counted.
+        """
+        try:
+            args = req.args if req.rows_done == 0 else tuple(a[req.rows_done :] for a in req.args)
+            with self._dispatch_lock:
+                if isinstance(self._keyed, EagerKeyedState):
+                    self._keyed.update(req.key, *args)
+                else:
+                    state = self._keyed.state_of(req.key)
+                    state = self._metric.update_state(state, *args)
+                    self._keyed.set_state(req.key, state)
+        except Exception as exc:  # noqa: BLE001 — fail THIS request, keep serving
+            self.telemetry.count("failed")
+            req.future.set_exception(exc)
+            return
+        self.telemetry.count("processed")
+        if self._degraded or self._worker is None:
+            # only true caller-thread dispatch counts: the healthy eager path also
+            # lands here, and counting it would make a healthy engine look degraded
+            self.telemetry.count("inline_dispatches")
+        self.telemetry.observe_latency(time.perf_counter() - req.t_submit)
+        req.future.set_result({"key": req.key, "rows": req.rows, "bucket": None})
+
+    def _on_worker_death(self, exc: BaseException, batch: List[_Request]) -> None:
+        """Dispatcher crashed: complete all accepted work inline, then degrade.
+
+        ``_inflight`` stays equal to the unreplayed remainder throughout, so a
+        concurrent ``flush()`` keeps blocking until the replay finishes — 'accepted
+        implies committed after flush' holds across the degradation.
+        """
+        self._worker_error = exc
+        self.telemetry.count("worker_deaths")
+        with self._lock:
+            self._degraded = True
+            pending = [req for req in batch if not req.future.done()] + self._queue
+            self._queue = []
+            self._inflight = len(pending)
+            self.telemetry.gauge_queue_depth(0)
+            self._not_full.notify_all()
+        try:
+            for req in pending:
+                self._apply_inline(req)
+                with self._lock:
+                    self._inflight -= 1
+        finally:
+            with self._lock:
+                self._inflight = 0
+                self._idle.notify_all()

@@ -1,0 +1,196 @@
+"""Multi-tenant keyed state: one logical metric, N client keys, one device dispatch.
+
+Two regimes, one interface (``slot_for`` / ``state_of`` / ``rotate`` / ``merged_state``):
+
+- :class:`KeyedState` — the fused regime. Every tenant's state pytree is stacked along
+  a leading key axis, so the dispatch kernel (runtime.py) updates all tenants in ONE
+  XLA dispatch via masked dynamic gather/scatter. Capacity grows by doubling (each
+  growth changes the stacked shape, i.e. costs one recompile set — bounded log₂(K)).
+- :class:`EagerKeyedState` — the host regime for metrics the fused kernel cannot trace
+  (ragged "cat" list states, host-compute metrics): a plain dict of per-key state
+  pytrees updated eagerly. Same tenancy and windowing semantics, more dispatches.
+
+Sliding windows ride on the pure ``merge_states`` API: ``rotate()`` snapshots the
+current segment into a ring (maxlen = window - 1) and resets the live segment;
+``merged_state(key)`` folds the surviving ring segments into the live one. Eviction is
+the ring's maxlen — no timestamps, no per-row bookkeeping.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Deque, Dict, Hashable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+
+def _validate_window(window: Optional[int]) -> Optional[int]:
+    if window is None:
+        return None
+    window = int(window)
+    if window < 1:
+        raise MetricsTPUUserError(f"`window` must be >= 1 segment, got {window}")
+    return window
+
+
+class KeyedState:
+    """Stacked per-key state for the fused dispatch path."""
+
+    def __init__(self, metric: Any, capacity: int = 8, window: Optional[int] = None) -> None:
+        self._metric = metric
+        self._init = metric.init_state()
+        self.capacity = 1
+        while self.capacity < max(1, int(capacity)):
+            self.capacity *= 2
+        self.stacked = self._tiled(self.capacity)
+        self._slots: Dict[Hashable, int] = {}
+        self.window = _validate_window(window)
+        # ring entries are (capacity_at_snapshot, stacked_snapshot): a key allocated
+        # after a snapshot was taken simply has no contribution in that segment
+        self._ring: Optional[Deque[Tuple[int, Any]]] = (
+            deque(maxlen=self.window - 1) if self.window and self.window > 1 else None
+        )
+
+    # ------------------------------------------------------------------ slots
+
+    def _tiled(self, k: int) -> Any:
+        return jax.tree.map(lambda x: jnp.broadcast_to(jnp.asarray(x), (k,) + jnp.shape(x)), self._init)
+
+    @property
+    def keys(self) -> Tuple[Hashable, ...]:
+        return tuple(self._slots)
+
+    def slot_for(self, key: Hashable) -> int:
+        """Slot index for ``key``, allocating the next one on first sight.
+
+        Callers serialize allocation (the engine holds its submit lock); the slot may
+        temporarily exceed ``capacity`` until the dispatcher calls ``ensure_capacity``.
+        """
+        slot = self._slots.get(key)
+        if slot is None:
+            slot = len(self._slots)
+            self._slots[key] = slot
+        return slot
+
+    def ensure_capacity(self) -> bool:
+        """Grow the key axis (doubling) to fit every allocated slot. True if grown."""
+        need = len(self._slots)
+        if need <= self.capacity:
+            return False
+        new_cap = self.capacity
+        while new_cap < need:
+            new_cap *= 2
+        pad = self._tiled(new_cap - self.capacity)
+        self.stacked = jax.tree.map(lambda s, p: jnp.concatenate([s, p], axis=0), self.stacked, pad)
+        self.capacity = new_cap
+        return True
+
+    # ------------------------------------------------------------------ reads
+
+    def state_of(self, key: Hashable) -> Any:
+        """Per-key live-segment state pytree (a fresh init state for a key that was
+        allocated but never dispatched into the stacked buffer)."""
+        slot = self._slots[key]
+        if slot >= self.capacity:
+            return self._metric.init_state()
+        return jax.tree.map(lambda x: x[slot], self.stacked)
+
+    def set_state(self, key: Hashable, state: Any) -> None:
+        """Scatter one key's state back into the stack (degraded inline path)."""
+        self.ensure_capacity()
+        slot = self._slots[key]
+        self.stacked = jax.tree.map(lambda s, n: s.at[slot].set(n), self.stacked, state)
+
+    # ------------------------------------------------------------------ windowing
+
+    def rotate(self) -> None:
+        """Close the live segment: snapshot it into the ring, reset the live stack.
+
+        With ``window=1`` there is no ring — rotation is a plain reset, i.e. only the
+        live segment ever counts. The ring's maxlen evicts the oldest segment
+        automatically once ``window`` segments exist.
+        """
+        if self.window is None:
+            raise MetricsTPUUserError("rotate() requires the engine/state to be built with `window=`")
+        if self._ring is not None:
+            self._ring.append((self.capacity, self.stacked))
+        self.stacked = self._tiled(self.capacity)
+
+    def merged_state(self, key: Hashable) -> Any:
+        """Window view: ring segments merged (oldest first) into the live segment."""
+        state = self.state_of(key)
+        if not self._ring:
+            return state
+        slot = self._slots[key]
+        merged = None
+        for cap, snap in self._ring:
+            if slot >= cap:
+                continue  # key didn't exist in this segment
+            seg = jax.tree.map(lambda x: x[slot], snap)
+            merged = seg if merged is None else self._metric.merge_states(merged, seg)
+        return state if merged is None else self._metric.merge_states(merged, state)
+
+    def reset(self) -> None:
+        self.stacked = self._tiled(self.capacity)
+        if self._ring is not None:
+            self._ring.clear()
+
+
+class EagerKeyedState:
+    """Per-key host-side states for metrics the fused kernel cannot serve."""
+
+    def __init__(self, metric: Any, window: Optional[int] = None) -> None:
+        self._metric = metric
+        self._states: Dict[Hashable, Any] = {}
+        self.window = _validate_window(window)
+        self._ring: Optional[Deque[Dict[Hashable, Any]]] = (
+            deque(maxlen=self.window - 1) if self.window and self.window > 1 else None
+        )
+
+    @property
+    def keys(self) -> Tuple[Hashable, ...]:
+        return tuple(self._states)
+
+    def slot_for(self, key: Hashable) -> None:
+        self._states.setdefault(key, self._metric.init_state())
+        return None
+
+    def ensure_capacity(self) -> bool:
+        return False
+
+    def state_of(self, key: Hashable) -> Any:
+        return self._states[key]
+
+    def set_state(self, key: Hashable, state: Any) -> None:
+        self._states[key] = state
+
+    def update(self, key: Hashable, *args: Any) -> None:
+        self._states[key] = self._metric.update_state(
+            self._states.setdefault(key, self._metric.init_state()), *args
+        )
+
+    def rotate(self) -> None:
+        if self.window is None:
+            raise MetricsTPUUserError("rotate() requires the engine/state to be built with `window=`")
+        if self._ring is not None:
+            self._ring.append(self._states)
+        self._states = {k: self._metric.init_state() for k in self._states}
+
+    def merged_state(self, key: Hashable) -> Any:
+        state = self.state_of(key)
+        if not self._ring:
+            return state
+        merged = None
+        for snap in self._ring:
+            if key not in snap:
+                continue
+            merged = snap[key] if merged is None else self._metric.merge_states(merged, snap[key])
+        return state if merged is None else self._metric.merge_states(merged, state)
+
+    def reset(self) -> None:
+        self._states = {k: self._metric.init_state() for k in self._states}
+        if self._ring is not None:
+            self._ring.clear()

@@ -1,0 +1,121 @@
+"""Engine observability: counters, batch-occupancy histogram, submit→result latency.
+
+All recording is O(1) and lock-protected (submits land from many client threads, the
+dispatcher records from its own); reads produce a plain dict so the snapshot can go
+straight into logs, dashboards, or a ``tools/jsonl_log.py`` line.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, Dict
+
+import numpy as np
+
+
+def _append_jsonl(path: str, record: Dict[str, Any]) -> None:
+    """Same record format and atomicity contract as ``tools/jsonl_log.append_jsonl``
+    (one O_APPEND line, failures noted on the record) — reimplemented here because
+    ``tools/`` is repo tooling, not part of the installed package."""
+    try:
+        record.setdefault("utc", time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
+        with open(path, "a") as fh:
+            fh.write(json.dumps(record) + "\n")
+    except Exception as exc:  # noqa: BLE001 — recording must never break serving
+        record["log_error"] = repr(exc)
+
+# Batch-occupancy histogram edges: fraction of real (unmasked) rows per dispatched
+# micro-batch. Low occupancy means the bucket ladder is too coarse for the traffic.
+_OCCUPANCY_EDGES = (0.25, 0.5, 0.75, 1.0)
+
+_COUNTERS = (
+    "submitted",          # requests accepted into the queue (or applied inline)
+    "processed",          # requests whose state update committed
+    "failed",             # requests completed with an exception
+    "dropped",            # rejected by the drop policy at a full queue
+    "timed_out",          # rejected by the timeout policy at a full queue
+    "batches",            # micro-batches dispatched
+    "rows",               # real rows committed
+    "padded_rows",        # masked filler rows dispatched
+    "compiles",           # kernel traces (== XLA compiles; counted at trace time)
+    "fused_fallbacks",    # fused→eager demotions (untraceable metric update)
+    "inline_dispatches",  # requests applied synchronously (degraded mode)
+    "worker_deaths",      # dispatcher thread crashes survived
+    "window_rotations",   # sliding-window segment rotations
+    "key_growths",        # tenant-capacity doublings (each costs one recompile set)
+)
+
+
+class EngineTelemetry:
+    """Thread-safe counters + histograms for one :class:`StreamingEngine`."""
+
+    def __init__(self, latency_window: int = 2048) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = {name: 0 for name in _COUNTERS}
+        self._queue_depth = 0
+        self._occupancy_hist = [0] * len(_OCCUPANCY_EDGES)
+        # latency ring: fixed-size, overwritten oldest-first — percentile quality
+        # degrades gracefully under sustained load instead of growing without bound
+        self._latencies = np.zeros(max(8, int(latency_window)), dtype=np.float64)
+        self._lat_count = 0
+
+    # ------------------------------------------------------------------ recording
+
+    def count(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    def gauge_queue_depth(self, depth: int) -> None:
+        with self._lock:
+            self._queue_depth = depth
+
+    def observe_batch(self, real_rows: int, bucket: int) -> None:
+        frac = real_rows / bucket if bucket else 0.0
+        with self._lock:
+            self._counters["batches"] += 1
+            self._counters["rows"] += real_rows
+            self._counters["padded_rows"] += bucket - real_rows
+            for i, edge in enumerate(_OCCUPANCY_EDGES):
+                if frac <= edge:
+                    self._occupancy_hist[i] += 1
+                    break
+
+    def observe_latency(self, seconds: float) -> None:
+        with self._lock:
+            self._latencies[self._lat_count % len(self._latencies)] = seconds
+            self._lat_count += 1
+
+    # ------------------------------------------------------------------ reading
+
+    def snapshot(self) -> Dict[str, Any]:
+        """All counters + derived stats as one plain dict."""
+        with self._lock:
+            out: Dict[str, Any] = dict(self._counters)
+            out["queue_depth"] = self._queue_depth
+            out["batch_occupancy_hist"] = {
+                f"<={edge}": self._occupancy_hist[i] for i, edge in enumerate(_OCCUPANCY_EDGES)
+            }
+            n = min(self._lat_count, len(self._latencies))
+            lat = np.sort(self._latencies[:n]) if n else None
+        if lat is not None and n:
+            out["latency_s"] = {
+                "count": int(self._lat_count),
+                "p50": float(lat[int(0.50 * (n - 1))]),
+                "p99": float(lat[int(0.99 * (n - 1))]),
+                "max": float(lat[-1]),
+            }
+        else:
+            out["latency_s"] = {"count": 0, "p50": None, "p99": None, "max": None}
+        batches = out["batches"]
+        out["mean_batch_occupancy"] = (
+            out["rows"] / (out["rows"] + out["padded_rows"]) if batches else None
+        )
+        return out
+
+    def emit(self, path: str, **extra: Any) -> Dict[str, Any]:
+        """Append one snapshot as a JSONL record (``tools/jsonl_log.py`` format)."""
+        record: Dict[str, Any] = {"what": "engine_telemetry", **self.snapshot(), **extra}
+        _append_jsonl(path, record)
+        return record

@@ -109,6 +109,29 @@ def zero_state(shape: Any = (), dtype: Any = None) -> Array:
 
 StateValue = Union[Array, List[Array]]
 
+
+def _cached_jitted_updater(obj: Any, donate: bool) -> Callable:
+    """Shared body of ``{Metric,MetricCollection}.jitted_update_state``: one compiled
+    updater per (instance, donate flag), cached under ``_jitted_update_state`` — the
+    key both classes' ``__getstate__`` drops, since executables neither pickle nor
+    deepcopy."""
+    cache = obj.__dict__.setdefault("_jitted_update_state", {})
+    fn = cache.get(donate)
+    if fn is None:
+        fn = jax.jit(obj.update_state, donate_argnums=0) if donate else jax.jit(obj.update_state)
+        cache[donate] = fn
+    return fn
+
+
+def _raise_on_unconsumed(state_dict: Dict[str, Any], prefix: str, consumed: set) -> None:
+    """Strict-mode guard shared by every ``load_state_dict`` implementation: any key
+    under ``prefix`` that no (nested) metric consumed is unexpected — a silent skip
+    would hide stale, misspelled, or misrouted checkpoint entries."""
+    unexpected = sorted(k for k in state_dict if k.startswith(prefix) and k not in consumed)
+    if unexpected:
+        shown = ", ".join(unexpected[:8]) + (" ..." if len(unexpected) > 8 else "")
+        raise KeyError(f"Unexpected key(s) in state_dict under prefix {prefix!r}: {shown}")
+
 # kwargs consumed by Metric.__init__ (reference metric.py:82-144 + TPU axis_name
 # extension) — wrappers that split base kwargs from passthrough kwargs key off this.
 BASE_METRIC_KWARGS = frozenset(
@@ -662,6 +685,17 @@ class Metric(ABC):
                 synced[name] = reduce_in_trace(val, reduction, axis_name)
         return synced
 
+    def jitted_update_state(self, donate: bool = True) -> Callable:
+        """The pure updater compiled with (optionally) donated state buffers.
+
+        The serving-engine hook (``metrics_tpu/engine``): a runtime that owns its state
+        pytree exclusively can donate it into the jitted update so XLA reuses the
+        buffers in place — ``state = updater(state, preds, target)``. The caller must
+        NOT touch a donated input state afterwards; compile cache is per instance and
+        keyed on operand shapes/dtypes as usual.
+        """
+        return _cached_jitted_updater(self, donate)
+
     def merge_states(self, state_a: Dict[str, Any], state_b: Dict[str, Any]) -> Dict[str, Any]:
         """Associatively merge two state pytrees (pure analogue of ``_reduce_states``)."""
         merged: Dict[str, Any] = {}
@@ -859,11 +893,27 @@ class Metric(ABC):
             child.state_dict(destination, prefix=f"{prefix}{name}.")
         return destination
 
-    def load_state_dict(self, state_dict: Dict[str, Any], prefix: str = "", strict: bool = True) -> None:
-        """Inverse of :meth:`state_dict` (reference metric.py:702-719)."""
+    def load_state_dict(
+        self,
+        state_dict: Dict[str, Any],
+        prefix: str = "",
+        strict: bool = True,
+        _consumed: Optional[set] = None,
+    ) -> None:
+        """Inverse of :meth:`state_dict` (reference metric.py:702-719).
+
+        ``strict=True`` raises on BOTH missing persistent keys and unexpected keys
+        under this instance's prefix (``nn.Module.load_state_dict`` semantics — a
+        stale or misrouted checkpoint entry must not vanish silently). ``_consumed``
+        is internal plumbing: nested metrics record which keys they restored, and only
+        the outermost call (``_consumed is None``) owns the unexpected-key check.
+        """
+        owns_check = _consumed is None
+        consumed: set = set() if owns_check else _consumed
         for key in self._defaults:
             name = prefix + key
             if name in state_dict:
+                consumed.add(name)
                 val = state_dict[name]
                 if isinstance(val, list):
                     # restore entries verbatim: state_dict saved numpy leaves,
@@ -877,11 +927,17 @@ class Metric(ABC):
             elif strict and self._persistent[key]:
                 raise KeyError(f"Missing key {name} in state_dict")
         for name, child in self._child_metrics():
-            child.load_state_dict(state_dict, prefix=f"{prefix}{name}.", strict=strict)
+            child.load_state_dict(state_dict, prefix=f"{prefix}{name}.", strict=strict, _consumed=consumed)
+        if owns_check and strict:
+            _raise_on_unconsumed(state_dict, prefix, consumed)
 
     def __getstate__(self) -> Dict[str, Any]:
-        """Drop instance-wrapped fns for pickling (reference metric.py:587-591)."""
-        return {k: v for k, v in self.__dict__.items() if k not in ("update", "compute")}
+        """Drop instance-wrapped fns for pickling (reference metric.py:587-591).
+
+        The jitted-updater cache is dropped too: compiled executables neither pickle
+        nor deepcopy, and a clone rebuilds them lazily on first use.
+        """
+        return {k: v for k, v in self.__dict__.items() if k not in ("update", "compute", "_jitted_update_state")}
 
     def __setstate__(self, state: Dict[str, Any]) -> None:
         self.__dict__.update(state)

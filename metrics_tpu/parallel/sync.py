@@ -59,3 +59,57 @@ def reduce_in_trace(x: Array, reduce_fx: Optional[str], axis_name: AxisName) -> 
 
 def in_trace(x: Any) -> bool:
     return isinstance(x, jax.core.Tracer)
+
+
+def sync_state_host(
+    state: Dict[str, Any],
+    reductions: Dict[str, Any],
+    gather_fn: Optional[Callable] = None,
+    distributed_available_fn: Optional[Callable] = None,
+) -> Dict[str, Any]:
+    """Host-level all-reduce of a functional state pytree across JAX processes.
+
+    The serving-engine analogue of ``Metric._sync_dist``: the engine holds state as
+    explicit pytrees (never inside a ``Metric`` instance), so its ``compute(key)``
+    syncs here instead — gather every reducible leaf with
+    :func:`metrics_tpu.utils.distributed.gather_all_tensors`, then apply the state's
+    registered reduction. ``_update_count`` always sums (each process counted its own
+    updates). Single-process (the common case, and every CPU-mesh test) is the
+    identity. ``gather_fn`` / ``distributed_available_fn`` are injectable for tests
+    and for custom transport.
+    """
+    from metrics_tpu.utils.data import dim_zero_cat
+    from metrics_tpu.utils.distributed import distributed_available, gather_all_tensors
+
+    is_distributed = (distributed_available_fn or distributed_available)()
+    if not is_distributed:
+        return state
+    gather = gather_fn or gather_all_tensors
+
+    synced = dict(state)
+    for name, reduction in reductions.items():
+        val = state[name]
+        if isinstance(val, list):
+            if not val:
+                continue
+            gathered = gather(dim_zero_cat(val))
+            synced[name] = [dim_zero_cat(gathered)]
+            continue
+        gathered = jnp.stack(gather(jnp.asarray(val)))
+        if reduction == "sum":
+            synced[name] = jnp.sum(gathered, axis=0)
+        elif reduction == "mean":
+            synced[name] = jnp.mean(gathered, axis=0)
+        elif reduction == "max":
+            synced[name] = jnp.max(gathered, axis=0)
+        elif reduction == "min":
+            synced[name] = jnp.min(gathered, axis=0)
+        elif reduction == "cat":
+            synced[name] = jnp.concatenate(list(gathered), axis=0)
+        elif callable(reduction):
+            synced[name] = reduction(gathered)
+        else:  # None: stack, matching reduce_in_trace's all_gather
+            synced[name] = gathered
+    if "_update_count" in state:
+        synced["_update_count"] = jnp.sum(jnp.stack(gather(jnp.asarray(state["_update_count"]))), axis=0)
+    return synced

@@ -25,7 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import Array
 
-from metrics_tpu.metric import Metric
+from metrics_tpu.metric import Metric, _raise_on_unconsumed
 from metrics_tpu.utils.data import apply_to_collection
 
 
@@ -321,6 +321,12 @@ class BootStrapper(Metric):
             # a fresh instance may reconstruct in the other mode and must be
             # re-shaped before restoring (see load_state_dict)
             destination[prefix + "_use_vmap"] = np.asarray(self._use_vmap)
+            # resampling config: a checkpoint restored into an instance with a
+            # different bootstrap count or sampling strategy is a silently
+            # different estimator (wrong copy count / wrong resampling law), so
+            # both are recorded and verified at load (advisor round-5 finding)
+            destination[prefix + "_num_bootstraps"] = np.asarray(self.num_bootstraps)
+            destination[prefix + "_sampling_strategy"] = np.asarray(self.sampling_strategy)
             if self._use_vmap:
                 for k, v in self._stacked_state.items():
                     destination[f"{prefix}_stacked_state.{k}"] = np.asarray(v)
@@ -329,8 +335,36 @@ class BootStrapper(Metric):
                 destination[prefix + "_rng_state"] = encoded
         return destination
 
-    def load_state_dict(self, state_dict: Dict[str, Any], prefix: str = "", strict: bool = True) -> None:
+    def load_state_dict(
+        self, state_dict: Dict[str, Any], prefix: str = "", strict: bool = True, _consumed: Optional[set] = None
+    ) -> None:
+        owns_check = _consumed is None
+        consumed: set = set() if owns_check else _consumed
+        # config guard FIRST: re-shaping the stacked state or restoring copies
+        # against a mismatched bootstrap configuration would corrupt silently
+        nb_key = prefix + "_num_bootstraps"
+        if nb_key in state_dict:
+            consumed.add(nb_key)
+            ckpt_nb = int(np.asarray(state_dict[nb_key]))
+            if ckpt_nb != self.num_bootstraps:
+                raise ValueError(
+                    f"BootStrapper checkpoint was written with num_bootstraps={ckpt_nb} but this"
+                    f" instance has num_bootstraps={self.num_bootstraps}; construct the instance to"
+                    " match the checkpoint"
+                )
+        ss_key = prefix + "_sampling_strategy"
+        if ss_key in state_dict:
+            consumed.add(ss_key)
+            ckpt_ss = str(np.asarray(state_dict[ss_key]))
+            if ckpt_ss != self.sampling_strategy:
+                raise ValueError(
+                    f"BootStrapper checkpoint was written with sampling_strategy={ckpt_ss!r} but this"
+                    f" instance has sampling_strategy={self.sampling_strategy!r}; construct the"
+                    " instance to match the checkpoint"
+                )
         mode_key = prefix + "_use_vmap"
+        if mode_key in state_dict:
+            consumed.add(mode_key)
         if mode_key in state_dict and bool(np.asarray(state_dict[mode_key])) != self._use_vmap:
             # re-shape to the checkpoint's mode, mirroring __init__'s branches —
             # otherwise a copies-mode checkpoint loaded into a fresh vmap-mode
@@ -342,18 +376,22 @@ class BootStrapper(Metric):
                 self._stacked_state = self._init_stacked_state()
             else:
                 self.metrics = [deepcopy(self.base_metric) for _ in range(self.num_bootstraps)]
-        super().load_state_dict(state_dict, prefix, strict)
+        super().load_state_dict(state_dict, prefix, strict, _consumed=consumed)
         if self._use_vmap:
             for k in list(self._stacked_state):
                 name = f"{prefix}_stacked_state.{k}"
                 if name in state_dict:
+                    consumed.add(name)
                     self._stacked_state[k] = jnp.asarray(state_dict[name])
                 elif strict and self.base_metric._persistent.get(k, False):
                     raise KeyError(f"Missing key {name} in state_dict")
         rng_key = prefix + "_rng_state"
         if rng_key in state_dict:
+            consumed.add(rng_key)
             self._rng.bit_generator.state = self._decode_rng_state(state_dict[rng_key])
         elif strict and self._any_persistent():
             # a resume without the rng stream would silently diverge from the
             # uninterrupted run in its post-resume resampling draws
             raise KeyError(f"Missing key {rng_key} in state_dict")
+        if owns_check and strict:
+            _raise_on_unconsumed(state_dict, prefix, consumed)

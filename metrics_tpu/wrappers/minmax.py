@@ -12,7 +12,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import Array
 
-from metrics_tpu.metric import Metric
+from metrics_tpu.metric import Metric, _raise_on_unconsumed
 
 
 class MinMaxMetric(Metric):
@@ -83,14 +83,21 @@ class MinMaxMetric(Metric):
             destination[prefix + "max_val"] = np.asarray(self.max_val)
         return destination
 
-    def load_state_dict(self, state_dict: Dict[str, Any], prefix: str = "", strict: bool = True) -> None:
-        super().load_state_dict(state_dict, prefix, strict)
+    def load_state_dict(
+        self, state_dict: Dict[str, Any], prefix: str = "", strict: bool = True, _consumed: Optional[set] = None
+    ) -> None:
+        owns_check = _consumed is None
+        consumed: set = set() if owns_check else _consumed
+        super().load_state_dict(state_dict, prefix, strict, _consumed=consumed)
         for key in ("min_val", "max_val"):
             name = prefix + key
             if name in state_dict:
+                consumed.add(name)
                 setattr(self, key, jnp.asarray(state_dict[name]))
             elif strict and self._any_persistent():
                 raise KeyError(f"Missing key {name} in state_dict")
+        if owns_check and strict:
+            _raise_on_unconsumed(state_dict, prefix, consumed)
 
     @staticmethod
     def _is_suitable_val(val: Union[float, Array]) -> bool:

@@ -13,7 +13,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu.collections import MetricCollection
-from metrics_tpu.metric import Metric
+from metrics_tpu.metric import Metric, _raise_on_unconsumed
 from metrics_tpu.utils.prints import rank_zero_warn
 
 
@@ -85,12 +85,17 @@ class MetricTracker:
             m.state_dict(destination, prefix=f"{prefix}_metrics.{i}.")
         return destination
 
-    def load_state_dict(self, state_dict: Dict[str, Any], prefix: str = "", strict: bool = True) -> None:
+    def load_state_dict(
+        self, state_dict: Dict[str, Any], prefix: str = "", strict: bool = True, _consumed: Optional[set] = None
+    ) -> None:
+        owns_check = _consumed is None
+        consumed: set = set() if owns_check else _consumed
         key = prefix + "_n_steps"
         if key not in state_dict:
             if strict:
                 raise KeyError(f"Missing key {key} in state_dict")
             return
+        consumed.add(key)
         n = int(state_dict[key])
         while len(self._metrics) < n:
             self.increment()
@@ -99,7 +104,9 @@ class MetricTracker:
         del self._metrics[n:]
         self._increment_called = n > 0
         for i in range(n):
-            self._metrics[i].load_state_dict(state_dict, prefix=f"{prefix}_metrics.{i}.", strict=strict)
+            self._metrics[i].load_state_dict(state_dict, prefix=f"{prefix}_metrics.{i}.", strict=strict, _consumed=consumed)
+        if owns_check and strict:
+            _raise_on_unconsumed(state_dict, prefix, consumed)
 
     def _check_for_increment(self, method: str) -> None:
         if not self._increment_called:

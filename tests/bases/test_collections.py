@@ -553,3 +553,39 @@ def test_grouped_forward_dist_sync_on_step_matches_ungrouped():
             np.testing.assert_allclose(np.asarray(fg[k]), np.asarray(fu[k]), atol=1e-6, err_msg=k)
     for k, v in g.compute().items():
         np.testing.assert_allclose(np.asarray(v), np.asarray(u.compute()[k]), atol=1e-6, err_msg=k)
+
+
+def test_collection_merge_states_and_jitted_update():
+    """Engine hooks on collections: ``merge_states`` folds two collection state
+    pytrees per member metric, and ``jitted_update_state`` compiles the whole
+    member walk into one dispatch (the fused single-dispatch collection update)."""
+    from metrics_tpu.classification import BinaryAccuracy, BinaryF1Score
+
+    mc = MetricCollection([BinaryAccuracy(), BinaryF1Score()])
+    updater = mc.jitted_update_state()
+    assert updater is mc.jitted_update_state()  # cached per (instance, donate)
+
+    rng = np.random.default_rng(0)
+    shards = []
+    for _ in range(2):
+        state = mc.init_state()
+        for _ in range(3):
+            p, t = rng.integers(0, 2, 8), rng.integers(0, 2, 8)
+            state = updater(state, jnp.asarray(p), jnp.asarray(t))
+        shards.append(state)
+    merged = mc.merge_states(shards[0], shards[1])
+
+    # oracle: one collection fed every batch sequentially
+    rng = np.random.default_rng(0)
+    oracle = MetricCollection([BinaryAccuracy(), BinaryF1Score()])
+    for _ in range(6):
+        p, t = rng.integers(0, 2, 8), rng.integers(0, 2, 8)
+        oracle.update(jnp.asarray(p), jnp.asarray(t))
+    got = mc.compute_from(merged)
+    exp = oracle.compute()
+    assert got.keys() == exp.keys()
+    for k in exp:
+        np.testing.assert_allclose(np.asarray(got[k]), np.asarray(exp[k]), atol=1e-6, err_msg=k)
+
+    # clone/pickle must not choke on the compiled-fn cache
+    assert "_jitted_update_state" not in mc.clone().__dict__

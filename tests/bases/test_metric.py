@@ -218,6 +218,50 @@ def test_state_dict():
     assert float(m2.x) == 5
 
 
+def test_load_state_dict_strict_unexpected_keys():
+    """``strict=True`` must raise on keys under the instance's prefix that no
+    (nested) metric consumed — a stale or misrouted checkpoint entry silently
+    skipped would be an invisible restore bug (advisor round-5 finding)."""
+    m = DummyMetric()
+    m.persistent(True)
+    m.update()
+    sd = m.state_dict()
+
+    # unexpected top-level key
+    bad = dict(sd, stale_key=np.asarray(1.0))
+    m2 = DummyMetric()
+    m2.persistent(True)
+    with pytest.raises(KeyError, match="Unexpected key"):
+        m2.load_state_dict(bad)
+    # strict=False keeps the permissive semantics
+    m3 = DummyMetric()
+    m3.persistent(True)
+    m3.load_state_dict(bad, strict=False)
+    assert float(m3.x) == float(m.x)
+
+    # keys OUTSIDE the instance's prefix are not ours to judge
+    m4 = DummyMetric()
+    m4.persistent(True)
+    prefixed = {f"mine.{k}": v for k, v in sd.items()}
+    prefixed["other.x"] = np.asarray(9.0)
+    m4.load_state_dict(prefixed, prefix="mine.")
+    assert float(m4.x) == float(m.x)
+
+    # nested: an unexpected key under a child wrapper's prefix raises too
+    from metrics_tpu import MinMaxMetric
+
+    mm = MinMaxMetric(DummyMetricSum())
+    mm.persistent(True)
+    mm.update(jnp.asarray(2.0))
+    mm.compute()
+    mm_sd = mm.state_dict()
+    mm_sd["_base_metric.zombie"] = np.asarray(0.0)
+    mm2 = MinMaxMetric(DummyMetricSum())
+    mm2.persistent(True)
+    with pytest.raises(KeyError, match="Unexpected key"):
+        mm2.load_state_dict(mm_sd)
+
+
 def test_child_metric_state_dict():
     """Wrapped/child metric states survive state_dict round trip."""
     m = DummyMetricSum()
@@ -316,6 +360,25 @@ def test_merge_states():
     s2 = m.update_state(s2, jnp.asarray(4.0))
     merged = m.merge_states(s1, s2)
     assert float(m.compute_from(merged)) == 7
+
+
+def test_jitted_update_state_hook():
+    """The serving-engine hook: a cached, donated-buffer jitted updater. Donation
+    means the caller hands over the state buffers, so the returned state is the only
+    valid handle afterwards; the compiled fn is cached per (instance, donate flag)
+    and dropped through clone/pickle (executables don't serialize)."""
+    m = DummyMetricSum()
+    updater = m.jitted_update_state()
+    assert updater is m.jitted_update_state()  # cached
+    assert updater is not m.jitted_update_state(donate=False)
+    state = m.init_state()
+    state = updater(state, jnp.asarray(3.0))
+    state = updater(state, jnp.asarray(4.0))
+    assert float(m.compute_from(state)) == 7
+    assert int(state["_update_count"]) == 2
+    clone = m.clone()  # must not choke on the compiled-fn cache
+    assert "_jitted_update_state" not in clone.__dict__
+    assert float(clone.jitted_update_state()(clone.init_state(), jnp.asarray(5.0))["x"]) == 5
 
 
 def test_multi_output_compute_squeeze():

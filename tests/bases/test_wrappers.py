@@ -307,7 +307,9 @@ def test_bootstrapper_checkpoint_restores_across_modes():
     state vs per-copy metrics — the vmap->copies runtime fallback is
     permanent), and load re-shapes a fresh instance to the checkpoint's mode
     before restoring, so accumulation survives regardless of how the fresh
-    instance would have initialized."""
+    instance would have initialized. Both sides share the same sampling
+    strategy: strategy/num_bootstraps mismatches are now rejected at load
+    (see test_bootstrapper_checkpoint_config_guard)."""
     import numpy as np
     import jax.numpy as jnp
 
@@ -319,9 +321,15 @@ def test_bootstrapper_checkpoint_restores_across_modes():
     t = jnp.asarray(rng.integers(0, 3, 24))
 
     src = BootStrapper(MulticlassAccuracy(3, validate_args=False), num_bootstraps=4,
-                       sampling_strategy="poisson", seed=5)  # copies mode
+                       sampling_strategy="multinomial", seed=5)
+    assert src._use_vmap
+    # force the permanent vmap->copies runtime fallback before updating, so the
+    # checkpoint is written in copies mode while a FRESH multinomial instance
+    # would initialize in vmap mode
+    src._vmap_update = lambda *a, **k: False
     src.persistent(True)
     src.update(p, t)
+    assert not src._use_vmap
     sd = src.state_dict()
     assert bool(sd["_use_vmap"]) is False
     assert all(isinstance(v, np.ndarray) for v in sd.values())
@@ -334,3 +342,37 @@ def test_bootstrapper_checkpoint_restores_across_modes():
     assert not dst._use_vmap  # re-shaped to the checkpoint's mode
     for k, v in src.compute().items():
         np.testing.assert_array_equal(np.asarray(v), np.asarray(dst.compute()[k]))
+
+
+def test_bootstrapper_checkpoint_config_guard():
+    """The checkpoint carries ``num_bootstraps`` and ``sampling_strategy``; a
+    load into a mismatched instance raises instead of silently restoring into
+    a differently-configured estimator (advisor round-5 finding)."""
+    import numpy as np
+    import jax.numpy as jnp
+    import pytest
+
+    from metrics_tpu import BootStrapper, MeanSquaredError
+
+    src = BootStrapper(MeanSquaredError(), num_bootstraps=4, seed=0)
+    src.persistent(True)
+    src.update(jnp.asarray([1.0, 2.0, 3.0]), jnp.asarray([1.5, 2.0, 2.5]))
+    sd = src.state_dict()
+    assert int(sd["_num_bootstraps"]) == 4
+    assert str(np.asarray(sd["_sampling_strategy"])) == "poisson"
+
+    wrong_n = BootStrapper(MeanSquaredError(), num_bootstraps=8, seed=0)
+    wrong_n.persistent(True)
+    with pytest.raises(ValueError, match="num_bootstraps=4"):
+        wrong_n.load_state_dict(sd)
+
+    wrong_s = BootStrapper(MeanSquaredError(), num_bootstraps=4, sampling_strategy="multinomial", seed=0)
+    wrong_s.persistent(True)
+    with pytest.raises(ValueError, match="sampling_strategy='poisson'"):
+        wrong_s.load_state_dict(sd)
+
+    ok = BootStrapper(MeanSquaredError(), num_bootstraps=4, seed=0)
+    ok.persistent(True)
+    ok.load_state_dict(sd)  # matching config round-trips
+    for k, v in src.compute().items():
+        np.testing.assert_array_equal(np.asarray(v), np.asarray(ok.compute()[k]))

@@ -1,0 +1,106 @@
+"""Bucketing: deterministic bucket choice, exact padding layout, zero contribution
+from padded rows (via the engine's masked kernel — the property the whole fused path
+rests on)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.classification import BinaryAccuracy
+from metrics_tpu.engine import StreamingEngine, choose_bucket, inspect_request, pad_micro_batch
+from metrics_tpu.engine.bucketing import normalize_buckets, split_rows
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+
+def test_choose_bucket_deterministic_and_minimal():
+    buckets = normalize_buckets((8, 64, 16, 8))  # dedup + sort
+    assert buckets == (8, 16, 64)
+    for n in range(1, 100):
+        b = choose_bucket(n, buckets)
+        assert b == choose_bucket(n, buckets)  # same inputs => same bucket
+        if n <= 64:
+            assert b >= n
+            assert all(other >= b or other < n for other in buckets)  # smallest that fits
+        else:
+            assert b == 64  # cap: callers chunk
+
+
+def test_normalize_buckets_rejects_bad():
+    with pytest.raises(MetricsTPUUserError):
+        normalize_buckets(())
+    with pytest.raises(MetricsTPUUserError):
+        normalize_buckets((0, 4))
+
+
+def test_inspect_request_signature_and_errors():
+    rows, sig = inspect_request((jnp.zeros((3, 5)), jnp.zeros(3, jnp.int32)))
+    assert rows == 3
+    assert sig == (((5,), "float32"), ((), "int32"))
+    # dtypes canonicalize: a raw-numpy int64 client and a jnp int32 client feed the
+    # kernel identical arrays (jnp.asarray canonicalizes), so they must share ONE
+    # signature — not trace duplicate kernel ladders
+    _, sig_np = inspect_request((np.zeros((3, 5)), np.zeros(3, np.int64)))
+    assert sig_np == (((5,), "float32"), ((), "int32"))
+    with pytest.raises(MetricsTPUUserError, match="leading batch axis"):
+        inspect_request((jnp.asarray(1.0),))
+    with pytest.raises(MetricsTPUUserError, match="disagree on the leading axis"):
+        inspect_request((jnp.zeros(3), jnp.zeros(4)))
+    with pytest.raises(MetricsTPUUserError, match="at least one array"):
+        inspect_request(())
+
+
+def test_pad_micro_batch_layout_deterministic():
+    reqs = [
+        (2, (np.array([1.0, 2.0]), np.array([0, 1])), 2),
+        (0, (np.array([3.0]), np.array([1])), 1),
+    ]
+    cols_a, kids_a, mask_a = pad_micro_batch(reqs, bucket=8)
+    cols_b, kids_b, mask_b = pad_micro_batch(reqs, bucket=8)
+    # deterministic: identical bytes both times
+    for a, b in zip(cols_a, cols_b):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(np.asarray(kids_a), np.asarray(kids_b))
+    np.testing.assert_array_equal(np.asarray(mask_a), np.asarray(mask_b))
+    # layout: rows back-to-back in submission order, (bucket, 1, *trailing)
+    assert cols_a[0].shape == (8, 1)
+    np.testing.assert_array_equal(np.asarray(cols_a[0][:3, 0]), [1.0, 2.0, 3.0])
+    np.testing.assert_array_equal(np.asarray(kids_a[:3]), [2, 2, 0])
+    np.testing.assert_array_equal(np.asarray(mask_a), [True] * 3 + [False] * 5)
+    # padding carries the first request's (valid) slot id
+    assert set(np.asarray(kids_a[3:]).tolist()) == {2}
+
+
+def test_pad_micro_batch_overflow_raises():
+    with pytest.raises(MetricsTPUUserError, match="exceeds bucket"):
+        pad_micro_batch([(0, (np.zeros(9),), 9)], bucket=8)
+
+
+def test_split_rows():
+    args = (jnp.arange(10.0), jnp.arange(10))
+    chunks = split_rows(args, 4)
+    assert [r for _, r in chunks] == [4, 4, 2]
+    np.testing.assert_array_equal(np.asarray(chunks[2][0][0]), [8.0, 9.0])
+    assert split_rows(args, 16) == [(args, 10)]
+
+
+def test_padded_rows_contribute_zero():
+    """A request of n rows into a bucket of 8 must produce bit-identical state to
+    the unpadded sequential update — the mask, not a neutral input value, guarantees
+    padding never lands in any tenant's state."""
+    engine = StreamingEngine(BinaryAccuracy(), buckets=(8,))
+    try:
+        preds = jnp.asarray([1, 0, 1])
+        target = jnp.asarray([1, 1, 1])
+        engine.submit("t", preds, target)
+        engine.flush()
+        snap = engine.telemetry_snapshot()
+        assert snap["padded_rows"] == 5 and snap["rows"] == 3
+        oracle = BinaryAccuracy()
+        oracle.update(preds, target)
+        assert float(engine.compute("t")) == float(oracle.compute())
+        # the state itself (not just the quotient) must be untouched by padding
+        state = engine._keyed.state_of("t")
+        assert int(state["tp"]) == 2 and int(state["fn"]) == 1
+        assert int(state["tn"]) + int(state["fp"]) == 0
+    finally:
+        engine.close()

@@ -1,0 +1,404 @@
+"""StreamingEngine runtime: concurrent multi-client correctness, backpressure
+policies, worker-death degradation, compile-count bounds, eager fallback."""
+
+import threading
+import time
+from concurrent.futures import wait
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import MeanSquaredError, MetricCollection
+from metrics_tpu.classification import BinaryAccuracy, BinaryAUROC, BinaryF1Score
+from metrics_tpu.engine import EngineBackpressure, EngineClosed, StreamingEngine
+
+
+def _random_stream(seed, n_requests, n_keys, max_rows=5):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n_requests):
+        key = f"client-{rng.integers(0, n_keys)}"
+        rows = int(rng.integers(1, max_rows + 1))
+        preds = rng.integers(0, 2, rows)
+        target = rng.integers(0, 2, rows)
+        out.append((key, preds, target))
+    return out
+
+
+def test_concurrent_multi_client_equals_sequential_reference():
+    """N client threads × random keys/batch sizes: every tenant's compute must be
+    bit-identical to a fresh metric fed that tenant's requests sequentially (integer
+    count states make the comparison exact regardless of interleaving)."""
+    stream = _random_stream(seed=7, n_requests=200, n_keys=6)
+    engine = StreamingEngine(BinaryAccuracy(), buckets=(8, 32), capacity=4)
+    try:
+        futures = []
+        fut_lock = threading.Lock()
+
+        def client(tid):
+            for i, (key, p, t) in enumerate(stream):
+                if i % 4 == tid:
+                    f = engine.submit(key, jnp.asarray(p), jnp.asarray(t))
+                    with fut_lock:
+                        futures.append(f)
+
+        threads = [threading.Thread(target=client, args=(tid,)) for tid in range(4)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        engine.flush()
+        done, not_done = wait(futures, timeout=30)
+        assert not not_done
+        for f in done:
+            assert f.exception() is None
+
+        oracles = {}
+        for key, p, t in stream:
+            oracles.setdefault(key, BinaryAccuracy()).update(jnp.asarray(p), jnp.asarray(t))
+        for key, oracle in oracles.items():
+            assert float(engine.compute(key)) == float(oracle.compute()), key
+        snap = engine.telemetry_snapshot()
+        assert snap["processed"] == len(stream)
+        assert snap["fused"] and not snap["degraded"]
+    finally:
+        engine.close()
+
+
+def test_collection_single_dispatch_update():
+    """A MetricCollection engine: the fused kernel updates every member in the same
+    dispatch, and per-tenant computes match a sequentially-updated collection."""
+    engine = StreamingEngine(MetricCollection([BinaryAccuracy(), BinaryF1Score()]), buckets=(16,))
+    try:
+        oracle = MetricCollection([BinaryAccuracy(), BinaryF1Score()])
+        rng = np.random.default_rng(3)
+        for _ in range(30):
+            p = jnp.asarray(rng.integers(0, 2, 4))
+            t = jnp.asarray(rng.integers(0, 2, 4))
+            engine.submit("tenant", p, t)
+            oracle.update(p, t)
+        got = engine.compute("tenant")
+        exp = oracle.compute()
+        assert got.keys() == exp.keys()
+        for k in exp:
+            assert float(got[k]) == float(exp[k]), k
+    finally:
+        engine.close()
+
+
+def test_backpressure_block_policy():
+    engine = StreamingEngine(BinaryAccuracy(), max_queue=2, policy="block", buckets=(8,))
+    try:
+        engine._worker_gate.clear()  # hold the dispatcher before it processes
+        p, t = jnp.asarray([1]), jnp.asarray([1])
+        engine.submit("k", p, t)  # drained into the held dispatcher
+        time.sleep(0.2)
+        engine.submit("k", p, t)
+        engine.submit("k", p, t)  # queue now full (2)
+        blocked_done = threading.Event()
+
+        def blocked_submit():
+            engine.submit("k", p, t)
+            blocked_done.set()
+
+        th = threading.Thread(target=blocked_submit)
+        th.start()
+        time.sleep(0.3)
+        assert not blocked_done.is_set()  # block policy: waiting, not raising
+        engine._worker_gate.set()  # release the dispatcher
+        assert blocked_done.wait(10)
+        th.join()
+        engine.flush()
+        assert float(engine.compute("k")) == 1.0
+        assert engine.telemetry_snapshot()["processed"] == 4
+    finally:
+        engine._worker_gate.set()
+        engine.close()
+
+
+def test_backpressure_drop_policy():
+    engine = StreamingEngine(BinaryAccuracy(), max_queue=2, policy="drop", buckets=(8,))
+    try:
+        engine._worker_gate.clear()
+        p, t = jnp.asarray([1]), jnp.asarray([1])
+        engine.submit("k", p, t)
+        time.sleep(0.2)
+        engine.submit("k", p, t)
+        engine.submit("k", p, t)
+        with pytest.raises(EngineBackpressure, match="dropped"):
+            engine.submit("k", p, t)
+        assert engine.telemetry_snapshot()["dropped"] == 1
+        engine._worker_gate.set()
+        engine.flush()
+        assert engine.telemetry_snapshot()["processed"] == 3  # the dropped one is gone
+    finally:
+        engine._worker_gate.set()
+        engine.close()
+
+
+def test_backpressure_timeout_policy():
+    engine = StreamingEngine(
+        BinaryAccuracy(), max_queue=1, policy="timeout", submit_timeout=0.2, buckets=(8,)
+    )
+    try:
+        engine._worker_gate.clear()
+        p, t = jnp.asarray([1]), jnp.asarray([1])
+        engine.submit("k", p, t)
+        time.sleep(0.2)
+        engine.submit("k", p, t)
+        t0 = time.monotonic()
+        with pytest.raises(EngineBackpressure, match="timed out"):
+            engine.submit("k", p, t)
+        assert time.monotonic() - t0 >= 0.15
+        assert engine.telemetry_snapshot()["timed_out"] == 1
+    finally:
+        engine._worker_gate.set()
+        engine.close()
+
+
+def test_worker_death_degrades_to_inline_dispatch():
+    """If the dispatcher thread dies, accepted requests still complete (inline) and
+    subsequent submits run synchronously on the caller's thread — correctness over
+    throughput, no request lost."""
+    engine = StreamingEngine(BinaryAccuracy(), buckets=(8,))
+    try:
+        p, t = jnp.asarray([1, 0]), jnp.asarray([1, 1])
+        engine.submit("k", p, t)
+        engine.flush()
+
+        boom = RuntimeError("injected dispatcher crash")
+
+        def exploding_process(batch):
+            raise boom
+
+        engine._process = exploding_process
+        f = engine.submit("k", p, t)  # this batch kills the dispatcher
+        assert f.result(timeout=10)["key"] == "k"  # ...but still completes (inline)
+        deadline = time.monotonic() + 10
+        while not engine.degraded and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert engine.degraded
+        assert engine._worker_error is boom
+
+        f2 = engine.submit("k", p, t)  # degraded: synchronous per-call dispatch
+        assert f2.done() and f2.result()["bucket"] is None
+
+        oracle = BinaryAccuracy()
+        for _ in range(3):
+            oracle.update(p, t)
+        assert float(engine.compute("k")) == float(oracle.compute())
+        snap = engine.telemetry_snapshot()
+        assert snap["worker_deaths"] == 1
+        assert snap["inline_dispatches"] >= 2
+    finally:
+        engine.close()
+
+
+def test_compile_count_bounded_by_buckets_after_warmup():
+    """After one pass over every bucket, further traffic may not trigger a single
+    extra trace: the compile cache is exactly the bucket ladder."""
+    buckets = (4, 8, 16)
+    engine = StreamingEngine(BinaryAccuracy(), buckets=buckets, capacity=4)
+    try:
+        rng = np.random.default_rng(0)
+        # warmup: hit each bucket with the final key population already allocated
+        for key in ("a", "b", "c", "d"):
+            engine._alloc_slot(key)
+        for rows in (3, 7, 15):
+            engine.submit("a", jnp.asarray(rng.integers(0, 2, rows)), jnp.asarray(rng.integers(0, 2, rows)))
+            engine.flush()
+        warm = engine.telemetry_snapshot()["compiles"]
+        assert warm <= len(buckets)
+        # steady state: all bucket sizes, all keys — zero new compiles
+        for _ in range(40):
+            key = ("a", "b", "c", "d")[int(rng.integers(0, 4))]
+            rows = int(rng.integers(1, 17))
+            engine.submit(key, jnp.asarray(rng.integers(0, 2, rows)), jnp.asarray(rng.integers(0, 2, rows)))
+        engine.flush()
+        assert engine.telemetry_snapshot()["compiles"] == warm
+    finally:
+        engine.close()
+
+
+def test_oversized_request_chunks_exactly():
+    engine = StreamingEngine(BinaryAccuracy(), buckets=(4,))
+    try:
+        rng = np.random.default_rng(5)
+        p = rng.integers(0, 2, 19)
+        t = rng.integers(0, 2, 19)
+        f = engine.submit("big", jnp.asarray(p), jnp.asarray(t))
+        assert f.result(timeout=30)["rows"] == 19
+        oracle = BinaryAccuracy()
+        oracle.update(jnp.asarray(p), jnp.asarray(t))
+        assert float(engine.compute("big")) == float(oracle.compute())
+    finally:
+        engine.close()
+
+
+def test_eager_fallback_for_list_state_metric():
+    """Ragged 'cat' states cannot stack along a key axis: the engine serves them on
+    the eager path — same tenancy semantics, no fused kernel."""
+    engine = StreamingEngine(BinaryAUROC(thresholds=None))
+    try:
+        assert not engine.fused
+        oracle = BinaryAUROC(thresholds=None)
+        rng = np.random.default_rng(11)
+        for _ in range(8):
+            p = jnp.asarray(rng.random(5, dtype=np.float32))
+            t = jnp.asarray(rng.integers(0, 2, 5))
+            engine.submit("x", p, t)
+            oracle.update(p, t)
+        assert float(engine.compute("x")) == float(oracle.compute())
+    finally:
+        engine.close()
+
+
+def test_untraceable_update_demotes_to_eager():
+    """A metric whose update cannot live inside a trace (data-dependent Python
+    branching) demotes at the first kernel build — accumulated state preserved,
+    results still exact."""
+    from metrics_tpu.metric import Metric
+
+    class BranchyMean(Metric):
+        def __init__(self):
+            super().__init__()
+            self.add_state("total", jnp.asarray(0.0), "sum")
+            self.add_state("count", jnp.asarray(0.0), "sum")
+
+        def update(self, x):
+            if float(jnp.sum(x)) >= 0:  # concretization error inside jit
+                self.total = self.total + jnp.sum(x)
+            else:
+                self.total = self.total + jnp.sum(jnp.abs(x))
+            self.count = self.count + x.shape[0]
+
+        def compute(self):
+            return self.total / self.count
+
+    engine = StreamingEngine(BranchyMean(), buckets=(8,))
+    try:
+        assert engine.fused  # structurally eligible...
+        vals = [jnp.asarray([1.0, 2.0]), jnp.asarray([3.0])]
+        for v in vals:
+            engine.submit("k", v)
+        engine.flush()
+        assert not engine.fused  # ...demoted at trace time
+        assert engine.telemetry_snapshot()["fused_fallbacks"] == 1
+        assert not engine.degraded  # the dispatcher survived
+        assert float(engine.compute("k")) == 2.0
+    finally:
+        engine.close()
+
+
+def test_malformed_request_rejected_without_demoting_engine():
+    """One tenant submitting shape-incompatible arrays must fail ONLY that request's
+    future: the engine stays fused (no permanent demotion) and the dispatcher stays
+    alive — a single bad client cannot destroy everyone's throughput."""
+    engine = StreamingEngine(MeanSquaredError(), buckets=(8,))
+    try:
+        good = engine.submit("ok", jnp.asarray([1.0, 2.0]), jnp.asarray([1.0, 1.0]))
+        assert good.result(timeout=10)["rows"] == 2
+        # same leading axis, incompatible trailing shapes -> fails inside update
+        bad = engine.submit("bad", jnp.zeros((2, 3)), jnp.zeros((2, 4)))
+        assert bad.exception(timeout=10) is not None
+        engine.flush()
+        assert engine.fused  # malformed request != untraceable metric
+        assert not engine.degraded
+        good2 = engine.submit("ok", jnp.asarray([3.0]), jnp.asarray([3.0]))
+        assert good2.result(timeout=10)["bucket"] == 8  # still the fused path
+        assert float(engine.compute("ok")) == pytest.approx(1.0 / 3)  # sq errors (0,1,0) over 3 rows
+        assert engine.telemetry_snapshot()["failed"] == 1
+    finally:
+        engine.close()
+
+
+def test_flush_blocks_through_worker_death_replay():
+    """flush() must not return while the death handler is still replaying accepted
+    requests inline — 'accepted implies committed after flush' holds across the
+    degradation."""
+    engine = StreamingEngine(BinaryAccuracy(), buckets=(8,))
+    try:
+        engine._worker_gate.clear()  # hold the dispatcher with work queued
+        futures = [engine.submit("k", jnp.asarray([1]), jnp.asarray([1])) for _ in range(6)]
+        engine._process = lambda batch: (_ for _ in ()).throw(RuntimeError("boom"))
+        engine._worker_gate.set()
+        engine.flush(timeout=30)
+        assert all(f.done() and f.exception() is None for f in futures)
+        assert engine.degraded
+        assert float(engine.compute("k")) == 1.0
+    finally:
+        engine._worker_gate.set()
+        engine.close()
+
+
+def test_mixed_signature_tenant_preserves_submission_order():
+    """A tenant mixing shape signatures in one drained batch must have its requests
+    dispatched in submission order (run-based grouping), while single-signature
+    batches keep the occupancy-maximizing signature grouping."""
+    from metrics_tpu.engine.runtime import StreamingEngine as SE
+
+    class R:  # minimal _Request stand-in for the grouping helper
+        def __init__(self, key, sig):
+            self.key, self.signature = key, sig
+
+    a, b = ("sigA",), ("sigB",)
+    # no tenant mixes signatures: batch-wide grouping, 2 groups
+    groups = SE._signature_groups([R("x", a), R("y", b), R("x", a)])
+    assert [(s, len(rs)) for s, rs in groups] == [(a, 2), (b, 1)]
+    # tenant "x" mixes: consecutive-run grouping preserves its order
+    groups = SE._signature_groups([R("x", a), R("x", b), R("y", a)])
+    assert [(s, [r.key for r in rs]) for s, rs in groups] == [(a, ["x"]), (b, ["x"]), (a, ["y"])]
+
+
+def test_close_semantics():
+    engine = StreamingEngine(BinaryAccuracy(), buckets=(8,))
+    f = engine.submit("k", jnp.asarray([1]), jnp.asarray([1]))
+    engine.close()  # default: drains accepted work first
+    assert f.result(timeout=5)["rows"] == 1
+    with pytest.raises(EngineClosed):
+        engine.submit("k", jnp.asarray([1]), jnp.asarray([1]))
+    engine.close()  # idempotent
+
+
+def test_context_manager_and_receipt():
+    with StreamingEngine(MeanSquaredError(), buckets=(8,)) as engine:
+        f = engine.submit(("tuple", "key"), jnp.asarray([1.0, 2.0]), jnp.asarray([1.0, 1.0]))
+        receipt = f.result(timeout=10)
+        assert receipt["key"] == ("tuple", "key")
+        assert receipt["rows"] == 2
+        assert receipt["bucket"] == 8
+        assert float(engine.compute(("tuple", "key"))) == pytest.approx(0.5)
+        with pytest.raises(KeyError):
+            engine.compute("never-seen")
+
+
+def test_compute_all_consistent_snapshot():
+    engine = StreamingEngine(BinaryAccuracy(), buckets=(8,))
+    try:
+        engine.submit("a", jnp.asarray([1, 1]), jnp.asarray([1, 0]))
+        engine.submit("b", jnp.asarray([1]), jnp.asarray([1]))
+        out = engine.compute_all()
+        assert set(out) == {"a", "b"}
+        assert float(out["a"]) == 0.5 and float(out["b"]) == 1.0
+        with pytest.raises(Exception, match="window"):
+            engine.compute_all(window=True)  # window-less engine: explicit error
+    finally:
+        engine.close()
+
+
+def test_telemetry_emit_jsonl(tmp_path):
+    path = str(tmp_path / "telemetry.jsonl")
+    with StreamingEngine(BinaryAccuracy(), buckets=(8,)) as engine:
+        engine.submit("k", jnp.asarray([1]), jnp.asarray([1]))
+        engine.flush()
+        record = engine.telemetry.emit(path, run="unit")
+    import json
+
+    lines = [json.loads(line) for line in open(path)]
+    assert len(lines) == 1
+    assert lines[0]["what"] == "engine_telemetry"
+    assert lines[0]["processed"] == 1
+    assert lines[0]["run"] == "unit"
+    assert "utc" in lines[0]
+    assert record["latency_s"]["p99"] is not None

@@ -1,0 +1,141 @@
+"""Keyed state: tenant capacity growth, sliding-window semantics vs brute-force
+recompute, windowing on the eager path, engine reset."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import MeanSquaredError
+from metrics_tpu.classification import BinaryAccuracy, BinaryAUROC
+from metrics_tpu.engine import KeyedState, StreamingEngine
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+
+def test_capacity_growth_preserves_state():
+    """Start with capacity 2, stream 7 tenants: every tenant's result must match its
+    sequential oracle across the (doubling) growths."""
+    engine = StreamingEngine(BinaryAccuracy(), buckets=(8,), capacity=2)
+    try:
+        rng = np.random.default_rng(0)
+        oracles = {}
+        for i in range(60):
+            key = f"k{rng.integers(0, 7)}"
+            p = jnp.asarray(rng.integers(0, 2, 2))
+            t = jnp.asarray(rng.integers(0, 2, 2))
+            engine.submit(key, p, t)
+            oracles.setdefault(key, BinaryAccuracy()).update(p, t)
+        engine.flush()
+        assert len(oracles) == 7
+        assert engine._keyed.capacity == 8  # 2 -> 4 -> 8
+        assert engine.telemetry_snapshot()["key_growths"] >= 1
+        for key, oracle in oracles.items():
+            assert float(engine.compute(key)) == float(oracle.compute()), key
+    finally:
+        engine.close()
+
+
+def test_keyed_state_fresh_key_reads_init():
+    m = BinaryAccuracy()
+    ks = KeyedState(m, capacity=1)
+    ks.slot_for("a")
+    ks.slot_for("b")  # slot 1 >= capacity until a dispatch grows the stack
+    state = ks.state_of("b")
+    assert int(state["tp"]) == 0 and int(state["_update_count"]) == 0
+
+
+def _window_oracle(metric_factory, segments):
+    """Brute-force window reference: replay the raw data of the surviving segments
+    into a fresh metric."""
+    m = metric_factory()
+    for seg in segments:
+        for p, t in seg:
+            m.update(p, t)
+    return float(m.compute())
+
+
+@pytest.mark.parametrize("metric_factory", [BinaryAccuracy, lambda: BinaryAUROC(thresholds=None)],
+                         ids=["fused", "eager"])
+def test_sliding_window_eviction_vs_brute_force(metric_factory):
+    """window=3: after each rotation the windowed compute must equal a brute-force
+    recompute over the last 3 segments' raw data — including eviction of the oldest
+    segment, on both the fused and the eager (list-state) path."""
+    rng = np.random.default_rng(42)
+    engine = StreamingEngine(metric_factory(), buckets=(8,), window=3)
+    try:
+        segments = []
+        for seg_idx in range(6):
+            if seg_idx:
+                engine.rotate_window()
+            seg = []
+            for _ in range(4):
+                p = jnp.asarray(rng.random(3, dtype=np.float32))
+                t = jnp.asarray(rng.integers(0, 2, 3))
+                engine.submit("w", p, t)
+                seg.append((p, t))
+            segments.append(seg)
+            engine.flush()
+            expected = _window_oracle(metric_factory, segments[-3:])
+            got = float(engine.compute("w", window=True))
+            assert got == pytest.approx(expected, abs=1e-6), f"segment {seg_idx}"
+        # lifetime compute (window=False) still covers only the live segment
+        live_only = _window_oracle(metric_factory, segments[-1:])
+        assert float(engine.compute("w")) == pytest.approx(live_only, abs=1e-6)
+        assert engine.telemetry_snapshot()["window_rotations"] == 5
+    finally:
+        engine.close()
+
+
+def test_window_one_is_reset_per_segment():
+    engine = StreamingEngine(BinaryAccuracy(), buckets=(8,), window=1)
+    try:
+        engine.submit("k", jnp.asarray([1]), jnp.asarray([0]))
+        engine.rotate_window()
+        engine.submit("k", jnp.asarray([1]), jnp.asarray([1]))
+        assert float(engine.compute("k", window=True)) == 1.0  # only the live segment
+    finally:
+        engine.close()
+
+
+def test_rotate_without_window_raises():
+    engine = StreamingEngine(BinaryAccuracy(), buckets=(8,))
+    try:
+        with pytest.raises(MetricsTPUUserError, match="window"):
+            engine.rotate_window()
+        # compute(window=True) on a window-less engine must raise too, not silently
+        # return lifetime accumulation mislabeled as a window value
+        engine.submit("k", jnp.asarray([1]), jnp.asarray([1]))
+        with pytest.raises(MetricsTPUUserError, match="window"):
+            engine.compute("k", window=True)
+    finally:
+        engine.close()
+
+
+def test_window_key_absent_from_old_segments():
+    """A tenant first seen in segment 2 must not crash the window merge over a ring
+    that predates it."""
+    engine = StreamingEngine(MeanSquaredError(), buckets=(8,), window=3, capacity=1)
+    try:
+        engine.submit("old", jnp.asarray([1.0]), jnp.asarray([0.0]))
+        engine.rotate_window()
+        engine.submit("new", jnp.asarray([2.0]), jnp.asarray([0.0]))  # triggers growth too
+        engine.flush()
+        assert float(engine.compute("new", window=True)) == pytest.approx(4.0)
+        assert float(engine.compute("old", window=True)) == pytest.approx(1.0)
+    finally:
+        engine.close()
+
+
+def test_engine_reset_clears_all_tenants():
+    engine = StreamingEngine(BinaryAccuracy(), buckets=(8,))
+    try:
+        engine.submit("a", jnp.asarray([1]), jnp.asarray([1]))
+        engine.flush()
+        engine.reset()
+        state = engine._keyed.state_of("a")
+        assert int(state["tp"]) == 0 and int(state["_update_count"]) == 0
+        # keys survive a reset; fresh traffic accumulates from zero
+        engine.submit("a", jnp.asarray([1, 1]), jnp.asarray([1, 0]))
+        engine.flush()
+        assert float(engine.compute("a")) == 0.5
+    finally:
+        engine.close()

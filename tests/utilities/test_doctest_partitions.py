@@ -1,0 +1,51 @@
+"""tools/run_tests_tpu.py doctest partitions: the chunk planner derives its buckets
+from the collected module list without importing jax — these tests pin that the
+derivation matches reality (else the TPU full-suite run silently skips modules) and
+that the buckets are disjoint (the old keyword ``-k`` partitions overlapped)."""
+
+import os
+import re
+import shlex
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+from tools.run_tests_tpu import _doctest_chunks, _doctest_modules  # noqa: E402
+
+
+def test_doctest_module_derivation_matches_collector():
+    """The AST/filesystem derivation must equal what tests/test_doctests.py actually
+    parametrizes — a drift here makes the resume ledger lie about coverage."""
+    from tests.test_doctests import _MODULES
+
+    assert _doctest_modules() == list(_MODULES)
+
+
+def test_doctest_chunks_disjoint_and_complete():
+    chunks = _doctest_chunks()
+    id_pat = re.compile(r"tests/test_doctests\.py::test_doctest_module\[([^\]]+)\]")
+    seen: list = []
+    for chunk in chunks[:-1]:
+        ids = id_pat.findall(chunk)
+        assert ids, f"id-less partition chunk: {chunk!r}"
+        # every token is an explicit test id — nothing a -k could over-match
+        assert len(ids) == len(shlex.split(chunk))
+        seen.extend(ids)
+    assert len(seen) == len(set(seen)), "partitions overlap"
+    assert sorted(seen) == _doctest_modules(), "partitions miss or invent modules"
+    # the trailing chunk covers the file's non-parameterized tests, disjointly
+    assert chunks[-1] == "tests/test_doctests.py -k 'not test_doctest_module'"
+
+
+def test_doctest_partition_assignment_is_stable_under_module_churn():
+    """Chunks are banked green in the resume ledger by exact string: adding one
+    module must perturb only the chunk that receives it, not reshuffle the rest
+    (a positional round-robin would wipe the whole banked doctest tier)."""
+    mods = _doctest_modules()
+    before = set(_doctest_chunks(mods)[:-1])
+    after = set(_doctest_chunks(mods + ["metrics_tpu.zzz_hypothetical_new_module"])[:-1])
+    # every chunk except the one that absorbed the new module survives verbatim
+    assert len(before - after) == 1
+    assert len(after - before) == 1
+    (changed,) = after - before
+    assert "zzz_hypothetical_new_module" in changed

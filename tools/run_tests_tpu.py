@@ -17,8 +17,9 @@ Tunnel outages — probe-down at launch or a stall mid-suite — exit 0 with a
 suite green on the TPU backend"), chunked so a tunnel stall mid-run loses one
 chunk, not the whole capture: per top-level directory for the cheap tiers,
 PER FILE for the heavy eager tiers (parity/text/image), and the doctest
-walker partitioned by module keyword — each chunk is one jsonl row and one
-resume unit, so short tunnel windows accumulate green state across runs.
+walker partitioned into disjoint module-id buckets derived from the collected
+module list — each chunk is one jsonl row and one resume unit, so short
+tunnel windows accumulate green state across runs.
 The tunnel is re-probed between chunks and the run aborts cleanly (degraded,
 rc=0) if it drops.
 """
@@ -36,8 +37,15 @@ import time
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, _REPO)
 
-from bench import probe_accelerator  # killable subprocess probe w/ retries
 from tools.jsonl_log import append_jsonl
+
+
+def probe_accelerator():
+    # lazy: bench pulls in the jax import chain, which the chunk PLANNER (and the
+    # partition unit test) doesn't need — only actual runs pay it
+    import bench
+
+    return bench.probe_accelerator()
 
 _LOG = os.path.join(_REPO, "benchmarks", "tpu_tests.jsonl")
 
@@ -54,12 +62,62 @@ def _expand_dir(d: str) -> list[str]:
 
 
 # doctest ids look like test_doctest_module[metrics_tpu.functional.image.ssim];
-# these keywords partition them so each sub-chunk fits a short tunnel window
-_DOCTEST_KEYS = ["classification", "image", "text", "audio", "detection", "regression",
-                 "retrieval", "nominal", "multimodal", "pairwise", "wrappers",
-                 # functional.nominal.utils / functional.retrieval._utils would
-                 # otherwise run twice over the tunneled backend
-                 "utils and not nominal and not retrieval"]
+# partitions are DISJOINT buckets of explicit test ids derived from the collected
+# module list (the old keyword `-k` partitions overlapped — e.g. "image" also matched
+# multimodal.clip_image modules — double-paying tunnel time and making per-chunk rc
+# ambiguous)
+_N_DOCTEST_PARTITIONS = 12
+
+
+def _doctest_modules() -> list[str]:
+    """The exact module list tests/test_doctests.py parametrizes over, derived
+    WITHOUT importing it (the planner must stay light — no jax): pkgutil's walk over
+    an installed package is, by construction, its .py file tree, and the skip set is
+    read from the test module's AST so the two sources cannot drift."""
+    import ast
+
+    skip: set = set()
+    tree = ast.parse(open(os.path.join(_REPO, "tests", "test_doctests.py")).read())
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and any(
+            getattr(t, "id", None) == "_SKIP_MODULES" for t in node.targets
+        ):
+            skip = set(ast.literal_eval(node.value))
+    mods: list[str] = []
+    for root, dirs, files in os.walk(os.path.join(_REPO, "metrics_tpu")):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        if "__init__.py" not in files:
+            dirs[:] = []  # not a package: pkgutil would not descend either
+            continue
+        base = os.path.relpath(root, _REPO).replace(os.sep, ".")
+        if base != "metrics_tpu":
+            mods.append(base)
+        mods.extend(f"{base}.{f[:-3]}" for f in files if f.endswith(".py") and f != "__init__.py")
+    return sorted(m for m in mods if m not in skip)
+
+
+def _doctest_chunks(mods: list[str] | None = None) -> list[str]:
+    """Disjoint doctest partitions as explicit test-id lists, plus one chunk for the
+    file's non-parameterized tests.
+
+    Assignment is a STABLE content hash of the module name (crc32 % N), not
+    positional: chunks are banked green in the resume ledger by their exact string,
+    and a round-robin slice of the sorted list would reshuffle nearly every chunk
+    whenever one module is added or removed — wiping the accumulated green state the
+    chunking exists to preserve. With the hash, a package change only perturbs the
+    chunks containing the changed modules."""
+    import zlib
+
+    parts: list[list[str]] = [[] for _ in range(_N_DOCTEST_PARTITIONS)]
+    for m in mods if mods is not None else _doctest_modules():
+        parts[zlib.crc32(m.encode()) % _N_DOCTEST_PARTITIONS].append(m)
+    chunks = [
+        " ".join(f"tests/test_doctests.py::test_doctest_module[{m}]" for m in part)
+        for part in parts
+        if part
+    ]
+    chunks.append("tests/test_doctests.py -k 'not test_doctest_module'")
+    return chunks
 
 
 def _chunks() -> list[str]:
@@ -80,10 +138,7 @@ def _chunks() -> list[str]:
         if d in {"__pycache__", "helpers", "bases", "classification", "tpu_smoke"}:
             continue
         rest.extend(_expand_dir(f"tests/{d}") if d in per_file else [f"tests/{d}"])
-    doctests = [f"tests/test_doctests.py -k {shlex.quote(k)}" for k in _DOCTEST_KEYS]
-    remainder = "not (" + " or ".join(f"({k})" for k in _DOCTEST_KEYS) + ")"
-    doctests.append(f"tests/test_doctests.py -k {shlex.quote(remainder)}")
-    return first + rest + doctests + ["tests/test_examples.py"]
+    return first + rest + _doctest_chunks() + ["tests/test_examples.py"]
 
 
 def _already_green() -> set[str]:
