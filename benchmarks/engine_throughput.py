@@ -54,7 +54,15 @@ def main() -> None:
     ap.add_argument("--naive-requests", type=int, default=300, help="naive per-call sample size")
     ap.add_argument("--threads", type=int, default=4, help="engine client threads")
     ap.add_argument("--keys", type=int, default=8, help="tenant keys")
+    ap.add_argument("--obs", action="store_true",
+                    help="run with library-wide instrumentation enabled (obs.enable()) — "
+                    "the >=10x acceptance gate must hold with spans/retrace/sync attribution on")
     args = ap.parse_args()
+
+    if args.obs:
+        from metrics_tpu import obs
+
+        obs.enable()
 
     rng = np.random.default_rng(0)
     # batch-1 submits: the hardest regime for per-call dispatch overhead
@@ -133,7 +141,8 @@ def main() -> None:
             and compiles_after == warm_compiles,
         }
         emit("engine acceptance", float(all(checks.values())), "bool",
-             checks=checks, compiles=compiles_after, mismatched_keys=mismatches[:4])
+             checks=checks, compiles=compiles_after, mismatched_keys=mismatches[:4],
+             obs_enabled=args.obs)
         if not all(checks.values()):
             sys.exit(1)
     finally:

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu.metric import Metric, _cached_jitted_updater, _raise_on_unconsumed
+from metrics_tpu.obs import instrument as _obs
 from metrics_tpu.utils.data import _flatten_dict
 from metrics_tpu.utils.prints import rank_zero_warn
 
@@ -337,9 +338,12 @@ class MetricCollection:
         merge arbitrates the remaining leaders. Group membership stays
         identical to the reference's.
         """
-        for cg in self._groups.values():
-            m0 = self._modules[cg[0]]
-            m0.update(*args, **m0._filter_kwargs(**kwargs))
+        # collection-level span: member updates nest under it in the trace, so a
+        # Perfetto view shows which member dominates the collection's wall time
+        with _obs.metric_op("update", self):
+            for cg in self._groups.values():
+                m0 = self._modules[cg[0]]
+                m0.update(*args, **m0._filter_kwargs(**kwargs))
         if self._groups_checked:
             if self._state_is_copy:
                 # If a copy was made, the aliasing is broken — restore it
@@ -471,8 +475,9 @@ class MetricCollection:
 
     def compute(self) -> Dict[str, Any]:
         """Compute every metric (group members see the leader's synced state)."""
-        self._compute_groups_create_state_ref()
-        res = {k: m.compute() for k, m in self._modules.items()}
+        with _obs.metric_op("compute", self):
+            self._compute_groups_create_state_ref()
+            res = {k: m.compute() for k, m in self._modules.items()}
         res, _ = _flatten_dict(res)
         return {self._set_name(k): v for k, v in res.items()}
 
@@ -580,8 +585,12 @@ class MetricCollection:
 
     def __getstate__(self) -> Dict[str, Any]:
         # compiled executables (the jitted-updater cache) neither pickle nor deepcopy;
-        # clone() rebuilds them lazily on first use
-        return {k: v for k, v in self.__dict__.items() if k != "_jitted_update_state"}
+        # clone() rebuilds them lazily on first use. The obs instance label is dropped
+        # so a clone gets its own telemetry series instead of aliasing its source's.
+        return {
+            k: v for k, v in self.__dict__.items()
+            if k not in ("_jitted_update_state", "_obs_instance_label")
+        }
 
     def __repr__(self) -> str:
         repr_str = self.__class__.__name__ + "(\n"

@@ -12,7 +12,8 @@ Turns any ``Metric`` / ``MetricCollection`` into a high-throughput service::
 Layout: ``bucketing.py`` (shape-bucketed padding), ``runtime.py`` (bounded-queue
 dispatcher + jitted bucket kernels + backpressure/degradation), ``stream.py``
 (stacked multi-tenant keyed state + sliding windows), ``telemetry.py`` (counters,
-occupancy, p50/p99 latency).
+occupancy, p50/p99 latency — registry-backed: the series appear in
+``metrics_tpu.obs.render_prometheus()`` under a per-engine label).
 """
 
 from metrics_tpu.engine.bucketing import DEFAULT_BUCKETS, choose_bucket, inspect_request, pad_micro_batch

@@ -60,6 +60,8 @@ from metrics_tpu.engine.bucketing import (
 from metrics_tpu.engine.stream import EagerKeyedState, KeyedState
 from metrics_tpu.engine.telemetry import EngineTelemetry
 from metrics_tpu.metric import Metric
+from metrics_tpu.obs import instrument as _obs
+from metrics_tpu.obs.registry import OBS as _OBS
 from metrics_tpu.parallel.sync import sync_state_host
 from metrics_tpu.utils.exceptions import MetricsTPUUserError
 
@@ -498,10 +500,12 @@ class StreamingEngine:
         columns, key_ids, mask = pad_micro_batch(
             [(req.slot, chunk_args, rows) for req, chunk_args, rows, _ in units], bucket
         )
-        self._keyed.stacked = kernel(self._keyed.stacked, key_ids, mask, *columns)
-        # commit before completing futures: surfaces device-side errors here and makes
-        # the receipt mean "your rows are in the state", not "your rows are enqueued"
-        jax.block_until_ready(self._keyed.stacked)
+        with _obs.engine_span("engine.dispatch", bucket=bucket, rows=total_rows):
+            self._keyed.stacked = kernel(self._keyed.stacked, key_ids, mask, *columns)
+            # commit before completing futures: surfaces device-side errors here and
+            # makes the receipt mean "your rows are in the state", not "your rows are
+            # enqueued"
+            jax.block_until_ready(self._keyed.stacked)
         self.telemetry.observe_batch(total_rows, bucket)
         now = time.perf_counter()
         for req, _, rows, is_last in units:
@@ -516,6 +520,10 @@ class StreamingEngine:
         cache_key = (signature, bucket, capacity)
         kernel = self._kernels.get(cache_key)
         if kernel is None:
+            # kernel-cache miss == one fresh XLA compile: attribute it to the
+            # request signature that caused it (obs retrace attribution)
+            if _OBS.enabled:
+                _obs.record_engine_compile(signature, bucket, capacity)
             kernel = self._build_kernel()
             self._kernels[cache_key] = kernel
         return kernel
@@ -601,7 +609,7 @@ class StreamingEngine:
         """
         try:
             args = req.args if req.rows_done == 0 else tuple(a[req.rows_done :] for a in req.args)
-            with self._dispatch_lock:
+            with _obs.engine_span("engine.inline", rows=req.rows), self._dispatch_lock:
                 if isinstance(self._keyed, EagerKeyedState):
                     self._keyed.update(req.key, *args)
                 else:

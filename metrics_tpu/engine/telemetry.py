@@ -1,34 +1,40 @@
 """Engine observability: counters, batch-occupancy histogram, submit→result latency.
 
-All recording is O(1) and lock-protected (submits land from many client threads, the
-dispatcher records from its own); reads produce a plain dict so the snapshot can go
-straight into logs, dashboards, or a ``tools/jsonl_log.py`` line.
+Rebased onto the library-wide registry (:mod:`metrics_tpu.obs.registry`): every
+engine series lives in the process-global ``REGISTRY`` under a per-engine
+``engine=<id>`` label, so one Prometheus scrape (``obs.render_prometheus()``)
+exposes every live engine alongside the rest of the stack's instrumentation.
+Recording is unconditional — the engine's own telemetry does not ride the
+``obs.enable()`` master switch (that switch gates the *automatic*
+instrumentation hooks; a subsystem that records explicitly always records).
+
+:meth:`EngineTelemetry.snapshot` keeps its original flat-dict shape (counters,
+``queue_depth``, ``batch_occupancy_hist``, ``latency_s``,
+``mean_batch_occupancy``) so existing dashboards and tests are unaffected.
+
+Counter names are a closed set: :meth:`count` on a name that was never declared
+raises instead of silently minting a new series (a typo'd counter that reads 0
+forever is worse than a crash at the call site); extend the set explicitly with
+:meth:`register_counter`.
 """
 
 from __future__ import annotations
 
-import json
+import itertools
 import threading
-import time
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import numpy as np
 
-
-def _append_jsonl(path: str, record: Dict[str, Any]) -> None:
-    """Same record format and atomicity contract as ``tools/jsonl_log.append_jsonl``
-    (one O_APPEND line, failures noted on the record) — reimplemented here because
-    ``tools/`` is repo tooling, not part of the installed package."""
-    try:
-        record.setdefault("utc", time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
-        with open(path, "a") as fh:
-            fh.write(json.dumps(record) + "\n")
-    except Exception as exc:  # noqa: BLE001 — recording must never break serving
-        record["log_error"] = repr(exc)
+from metrics_tpu.obs.jsonl import append_jsonl
+from metrics_tpu.obs.registry import REGISTRY, Registry
 
 # Batch-occupancy histogram edges: fraction of real (unmasked) rows per dispatched
 # micro-batch. Low occupancy means the bucket ladder is too coarse for the traffic.
 _OCCUPANCY_EDGES = (0.25, 0.5, 0.75, 1.0)
+
+# submit→commit latency edges (seconds): 100µs → 10s decades, engine-shaped
+_LATENCY_EDGES = (1e-4, 1e-3, 1e-2, 1e-1, 1.0, 10.0)
 
 _COUNTERS = (
     "submitted",          # requests accepted into the queue (or applied inline)
@@ -47,75 +53,154 @@ _COUNTERS = (
     "key_growths",        # tenant-capacity doublings (each costs one recompile set)
 )
 
+# distinguishes engines within one process; monotone so labels never collide
+_ENGINE_IDS = itertools.count()
+
 
 class EngineTelemetry:
-    """Thread-safe counters + histograms for one :class:`StreamingEngine`."""
+    """Registry-backed counters + histograms for one :class:`StreamingEngine`."""
 
-    def __init__(self, latency_window: int = 2048) -> None:
-        self._lock = threading.Lock()
-        self._counters: Dict[str, int] = {name: 0 for name in _COUNTERS}
-        self._queue_depth = 0
-        self._occupancy_hist = [0] * len(_OCCUPANCY_EDGES)
-        # latency ring: fixed-size, overwritten oldest-first — percentile quality
-        # degrades gracefully under sustained load instead of growing without bound
+    def __init__(self, latency_window: int = 2048, registry: Optional[Registry] = None) -> None:
+        reg = registry if registry is not None else REGISTRY
+        self._registry = reg
+        self.engine_id = str(next(_ENGINE_IDS))
+        self._label = {"engine": self.engine_id}
+
+        self._events = reg.counter(
+            "metrics_tpu_engine_events_total", "StreamingEngine request/dispatch lifecycle events."
+        )
+        self._depth = reg.gauge(
+            "metrics_tpu_engine_queue_depth", "Requests queued but not yet drained by the dispatcher."
+        )
+        self._occupancy = reg.histogram(
+            "metrics_tpu_engine_batch_occupancy",
+            "Fraction of real (unmasked) rows per dispatched micro-batch.",
+            buckets=_OCCUPANCY_EDGES,
+        )
+        self._latency = reg.histogram(
+            "metrics_tpu_engine_latency_seconds",
+            "submit()→commit latency, backpressure stalls included.",
+            buckets=_LATENCY_EDGES,
+        )
+
+        # closed counter-name set, in declaration order (snapshot key order);
+        # label identities are precomputed ONCE so the per-request hot path
+        # (submit/process under the engine's >=10x acceptance gate) does a bare
+        # dict-add under the counter lock — no per-call validation/sort/str
+        self._allowed = list(_COUNTERS)
+        self._event_keys = {
+            name: self._events.label_key(event=name, **self._label) for name in self._allowed
+        }
+        for key in self._event_keys.values():
+            self._events.inc_key(key, 0)
+        self._depth_key = self._depth.label_key(**self._label)
+        self._depth.set_key(self._depth_key, 0)
+        self._occupancy_key = self._occupancy.label_key(**self._label)
+        self._latency_key = self._latency.label_key(**self._label)
+
+        # latency ring: fixed-size, overwritten oldest-first — exact-percentile
+        # quality degrades gracefully under sustained load instead of growing
+        # without bound (the registry histogram keeps only bucketed counts)
+        self._ring_lock = threading.Lock()
         self._latencies = np.zeros(max(8, int(latency_window)), dtype=np.float64)
         self._lat_count = 0
 
     # ------------------------------------------------------------------ recording
 
+    def register_counter(self, name: str) -> None:
+        """Declare an extra counter name; only declared names may be counted."""
+        if name not in self._allowed:
+            self._allowed.append(name)
+            key = self._events.label_key(event=name, **self._label)
+            self._event_keys[name] = key
+            self._events.inc_key(key, 0)
+
     def count(self, name: str, n: int = 1) -> None:
-        with self._lock:
-            self._counters[name] = self._counters.get(name, 0) + n
+        key = self._event_keys.get(name)
+        if key is None:
+            raise KeyError(
+                f"unknown telemetry counter {name!r}; declared: {sorted(self._allowed)}. "
+                "Declare new names explicitly with register_counter() — a typo'd counter "
+                "that silently reads 0 forever is a debugging trap."
+            )
+        self._events.inc_key(key, n)
 
     def gauge_queue_depth(self, depth: int) -> None:
-        with self._lock:
-            self._queue_depth = depth
+        self._depth.set_key(self._depth_key, depth)
 
     def observe_batch(self, real_rows: int, bucket: int) -> None:
         frac = real_rows / bucket if bucket else 0.0
-        with self._lock:
-            self._counters["batches"] += 1
-            self._counters["rows"] += real_rows
-            self._counters["padded_rows"] += bucket - real_rows
-            for i, edge in enumerate(_OCCUPANCY_EDGES):
-                if frac <= edge:
-                    self._occupancy_hist[i] += 1
-                    break
+        # one lock acquisition for the batch's three counters: a concurrent
+        # snapshot never sees rows committed without their batch/padding
+        self._events.inc_many_keys(
+            [
+                (1, self._event_keys["batches"]),
+                (real_rows, self._event_keys["rows"]),
+                (bucket - real_rows, self._event_keys["padded_rows"]),
+            ]
+        )
+        self._occupancy.observe_key(self._occupancy_key, frac)
 
     def observe_latency(self, seconds: float) -> None:
-        with self._lock:
+        self._latency.observe_key(self._latency_key, seconds)
+        with self._ring_lock:
             self._latencies[self._lat_count % len(self._latencies)] = seconds
             self._lat_count += 1
 
     # ------------------------------------------------------------------ reading
 
     def snapshot(self) -> Dict[str, Any]:
-        """All counters + derived stats as one plain dict."""
-        with self._lock:
-            out: Dict[str, Any] = dict(self._counters)
-            out["queue_depth"] = self._queue_depth
-            out["batch_occupancy_hist"] = {
-                f"<={edge}": self._occupancy_hist[i] for i, edge in enumerate(_OCCUPANCY_EDGES)
-            }
+        """All counters + derived stats as one plain dict (original flat shape)."""
+        # ONE collect() == one lock acquisition across every event series: the
+        # counters are mutually consistent (submitted >= processed etc.), as the
+        # pre-registry single-lock snapshot was
+        events = self._events.collect()
+        out: Dict[str, Any] = {
+            name: int(events.get(self._event_keys[name], 0)) for name in self._allowed
+        }
+        out["queue_depth"] = int(self._depth.value(**self._label))
+        occ = self._occupancy.bucket_counts(**self._label)
+        out["batch_occupancy_hist"] = {f"<={edge}": occ[edge] for edge in _OCCUPANCY_EDGES}
+        with self._ring_lock:
             n = min(self._lat_count, len(self._latencies))
-            lat = np.sort(self._latencies[:n]) if n else None
-        if lat is not None and n:
+            lat = np.array(self._latencies[:n]) if n else None
+            total = self._lat_count
+        if lat is not None:
+            # nearest-rank percentiles: p99 reaches max on small n (index
+            # truncation made it unreachable below n=100 and degraded badly on a
+            # partially-filled ring), and n=1 / wrapped-ring cases are exact
+            p50, p99 = np.percentile(lat, [50, 99], method="nearest")
             out["latency_s"] = {
-                "count": int(self._lat_count),
-                "p50": float(lat[int(0.50 * (n - 1))]),
-                "p99": float(lat[int(0.99 * (n - 1))]),
-                "max": float(lat[-1]),
+                "count": int(total),
+                "p50": float(p50),
+                "p99": float(p99),
+                "max": float(lat.max()),
             }
         else:
             out["latency_s"] = {"count": 0, "p50": None, "p99": None, "max": None}
-        batches = out["batches"]
         out["mean_batch_occupancy"] = (
-            out["rows"] / (out["rows"] + out["padded_rows"]) if batches else None
+            out["rows"] / (out["rows"] + out["padded_rows"]) if out["batches"] else None
         )
         return out
 
     def emit(self, path: str, **extra: Any) -> Dict[str, Any]:
-        """Append one snapshot as a JSONL record (``tools/jsonl_log.py`` format)."""
+        """Append one snapshot as a JSONL record through the shared writer
+        (:mod:`metrics_tpu.obs.jsonl` — same format/atomicity as ``tools/jsonl_log.py``)."""
         record: Dict[str, Any] = {"what": "engine_telemetry", **self.snapshot(), **extra}
-        _append_jsonl(path, record)
+        append_jsonl(path, record)
         return record
+
+    # ------------------------------------------------------------------ lifecycle
+
+    def retire(self) -> None:
+        """Evict this engine's series from the process-global registry.
+
+        The registry never evicts on its own, so a long-lived process creating
+        many transient engines should call this once an engine (and any
+        post-close snapshot reads — benchmarks read after ``close()``) is done
+        with, or every future Prometheus scrape carries the dead engine's
+        series. Recording after ``retire()`` is harmless: the series simply
+        rematerialise.
+        """
+        for inst in (self._events, self._depth, self._occupancy, self._latency):
+            inst.drop_labels(**self._label)

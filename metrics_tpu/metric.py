@@ -35,6 +35,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import Array
 
+from metrics_tpu.obs import instrument as _obs
+from metrics_tpu.obs.registry import OBS as _OBS
 from metrics_tpu.parallel.sync import reduce_in_trace
 from metrics_tpu.utils.data import (
     _flatten,
@@ -119,6 +121,10 @@ def _cached_jitted_updater(obj: Any, donate: bool) -> Callable:
     fn = cache.get(donate)
     if fn is None:
         fn = jax.jit(obj.update_state, donate_argnums=0) if donate else jax.jit(obj.update_state)
+        # retrace attribution (obs.instrument): the cached callable derives each
+        # call's abstract-shape signature and records fresh ones — i.e. compiles
+        # — against that signature; one attribute test per call when obs is off
+        fn = _obs.wrap_jitted_updater(fn, obj)
         cache[donate] = fn
     return fn
 
@@ -278,9 +284,16 @@ class Metric(ABC):
                     "The Metric has already been synced. HINT: call `unsync()` before modifying the state."
                 )
             # named_scope: shows up in jax.profiler traces and XLA HLO metadata, the
-            # tracing hook the reference lacks (SURVEY §5.1).
-            with jax.named_scope(f"{type(self).__name__}.update"):
-                update(*args, **kwargs)
+            # tracing hook the reference lacks (SURVEY §5.1). The obs branch is
+            # gated on ONE attribute test so the disabled hot path stays within
+            # the <5% overhead budget (benchmarks/obs_overhead.py).
+            if _OBS.enabled:
+                with _obs.metric_op("update", self):
+                    with jax.named_scope(f"{type(self).__name__}.update"):
+                        update(*args, **kwargs)
+            else:
+                with jax.named_scope(f"{type(self).__name__}.update"):
+                    update(*args, **kwargs)
             if self.compute_on_cpu:
                 self._move_list_states_to_cpu()
 
@@ -338,15 +351,18 @@ class Metric(ABC):
             if self._computed is not None:
                 return self._computed
 
-            with self.sync_context(
-                dist_sync_fn=self.dist_sync_fn,
-                process_group=self.process_group,
-                should_sync=self._to_sync,
-                should_unsync=self._should_unsync,
-            ):
-                with jax.named_scope(f"{type(self).__name__}.compute"):
-                    value = compute(*args, **kwargs)
-                self._computed = _squeeze_if_scalar(value)
+            # metric_op is a shared no-op when obs is disabled; compute is not
+            # the per-batch hot path, so the single call is cheap enough here
+            with _obs.metric_op("compute", self):
+                with self.sync_context(
+                    dist_sync_fn=self.dist_sync_fn,
+                    process_group=self.process_group,
+                    should_sync=self._to_sync,
+                    should_unsync=self._should_unsync,
+                ):
+                    with jax.named_scope(f"{type(self).__name__}.compute"):
+                        value = compute(*args, **kwargs)
+                    self._computed = _squeeze_if_scalar(value)
             return self._computed
 
         return wrapped_func
@@ -510,6 +526,13 @@ class Metric(ABC):
             for attr, v in ((attr, getattr(self, attr)) for attr in self._reductions)
         }
 
+        if _OBS.enabled:
+            # payload accounting BEFORE the gather: this is the byte volume the
+            # all-gather moves per participant
+            _obs.record_sync_bytes(
+                "Metric._sync_dist", type(self).__name__, _obs.tree_nbytes(input_dict)
+            )
+
         for attr, reduction_fn in self._reductions.items():
             # pre-concatenate metric states that are lists to reduce number of all-gathers
             if isinstance(input_dict[attr], list) and len(input_dict[attr]) >= 1:
@@ -567,8 +590,10 @@ class Metric(ABC):
         self._cache = {attr: getattr(self, attr) for attr in self._defaults}
         self._cache = {k: list(v) if isinstance(v, list) else v for k, v in self._cache.items()}
 
-        # sync
-        self._sync_dist(dist_sync_fn, process_group=process_group)
+        # sync (timed here rather than in _sync_dist so overriding subclasses —
+        # CompositionalMetric's no-op, wrappers — stay covered)
+        with _obs.metric_op("sync", self):
+            self._sync_dist(dist_sync_fn, process_group=process_group)
         self._is_synced = True
 
     def unsync(self, should_unsync: bool = True) -> None:
@@ -935,9 +960,12 @@ class Metric(ABC):
         """Drop instance-wrapped fns for pickling (reference metric.py:587-591).
 
         The jitted-updater cache is dropped too: compiled executables neither pickle
-        nor deepcopy, and a clone rebuilds them lazily on first use.
+        nor deepcopy, and a clone rebuilds them lazily on first use. The obs
+        instance label is dropped so a clone gets its own telemetry series instead
+        of aliasing its source's.
         """
-        return {k: v for k, v in self.__dict__.items() if k not in ("update", "compute", "_jitted_update_state")}
+        drop = ("update", "compute", "_jitted_update_state", "_obs_instance_label")
+        return {k: v for k, v in self.__dict__.items() if k not in drop}
 
     def __setstate__(self, state: Dict[str, Any]) -> None:
         self.__dict__.update(state)

@@ -1,0 +1,132 @@
+"""metrics_tpu.obs — library-wide observability: metrics registry, span tracing,
+retrace/sync attribution.
+
+One process-global, zero-third-party-dependency subsystem spanning the whole
+stack::
+
+    from metrics_tpu import obs
+
+    obs.enable()                                  # master switch (default: off)
+    with obs.span("eval.epoch", split="val"):     # your spans nest with the library's
+        metric.update(preds, target)              # -> metric.update span + wall-time histogram
+        metric.compute()                          #    retraces + sync payloads attributed too
+
+    obs.snapshot()                                # everything as one plain dict
+    print(obs.render_prometheus())                # Prometheus v0.0.4 text exposition
+    obs.export_chrome_trace("trace.json")         # load in Perfetto / chrome://tracing
+    obs.disable()
+
+Layout: :mod:`~metrics_tpu.obs.registry` (thread-safe labeled
+counters/gauges/histograms + Prometheus exposition + the :data:`OBS` master
+gate), :mod:`~metrics_tpu.obs.trace` (thread-local span propagation,
+ring-buffered storage, Chrome trace export), :mod:`~metrics_tpu.obs.instrument`
+(the hooks ``metric.py`` / ``collections.py`` / ``engine/`` / ``parallel/``
+call into), :mod:`~metrics_tpu.obs.jsonl` (the one shared JSONL writer).
+
+Cost contract (gated by ``benchmarks/obs_overhead.py``): with the switch off,
+every hook exits after a single attribute test — no lock, no allocation —
+adding <5% to a hot eager ``update()`` loop; enabled, <15%.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from metrics_tpu.obs.jsonl import append_jsonl
+from metrics_tpu.obs.registry import (
+    OBS,
+    REGISTRY,
+    Counter,
+    Gauge,
+    Histogram,
+    ObsGate,
+    Registry,
+)
+from metrics_tpu.obs.trace import TRACER, Tracer
+from metrics_tpu.obs import instrument  # noqa: F401  (registers the hook instruments)
+
+
+def enable() -> None:
+    """Turn on library-wide instrumentation (spans, op timing, retrace/sync attribution)."""
+    OBS.enabled = True
+
+
+def disable() -> None:
+    """Turn instrumentation off. Recorded data is kept; recording stops."""
+    OBS.enabled = False
+
+
+def enabled() -> bool:
+    return OBS.enabled
+
+
+def span(name: str, **attrs: Any) -> Any:
+    """Open a trace span on the process tracer (no-op context manager when disabled)."""
+    return TRACER.span(name, **attrs)
+
+
+def counter(name: str, help: str = "") -> Counter:
+    return REGISTRY.counter(name, help)
+
+
+def gauge(name: str, help: str = "") -> Gauge:
+    return REGISTRY.gauge(name, help)
+
+
+def histogram(name: str, help: str = "", buckets: Any = None) -> Histogram:
+    return REGISTRY.histogram(name, help, buckets=buckets)
+
+
+def snapshot() -> Dict[str, Any]:
+    """The whole registry as one plain dict."""
+    return REGISTRY.snapshot()
+
+
+def render_prometheus() -> str:
+    """Prometheus text exposition (serve with ``Content-Type: text/plain; version=0.0.4``)."""
+    return REGISTRY.render_prometheus()
+
+
+def export_chrome_trace(path: Optional[str] = None) -> Dict[str, Any]:
+    """Retained spans as Chrome trace-event JSON (optionally written to ``path``)."""
+    return TRACER.export_chrome_trace(path)
+
+
+def emit(path: str, **extra: Any) -> Dict[str, Any]:
+    """Append one registry snapshot as a JSONL record through the shared writer."""
+    return REGISTRY.emit(path, **extra)
+
+
+def reset() -> None:
+    """Disable and clear all recorded values/spans, keeping registered
+    instruments (and references held to them) valid. Test-isolation hook."""
+    disable()
+    REGISTRY.clear_values()
+    TRACER.clear()
+
+
+__all__ = [
+    "OBS",
+    "REGISTRY",
+    "TRACER",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "ObsGate",
+    "Registry",
+    "Tracer",
+    "append_jsonl",
+    "counter",
+    "disable",
+    "emit",
+    "enable",
+    "enabled",
+    "export_chrome_trace",
+    "gauge",
+    "histogram",
+    "instrument",
+    "render_prometheus",
+    "reset",
+    "snapshot",
+    "span",
+]

@@ -1,0 +1,341 @@
+"""Instrumentation hooks the core library calls into — the obs side of the wiring.
+
+``metric.py`` / ``collections.py`` / ``engine/runtime.py`` / ``parallel/sync.py``
+call these entry points; everything here funnels into the process-global
+:data:`~metrics_tpu.obs.registry.REGISTRY` and
+:data:`~metrics_tpu.obs.trace.TRACER`. Three concerns:
+
+- **op timing** (:func:`metric_op`): per-instance wall time of
+  ``update``/``compute``/``sync`` as a histogram + a trace span;
+- **retrace attribution** (:func:`record_retrace`, :func:`wrap_jitted_updater`):
+  which abstract-shape signature caused each new compile, counted at
+  jit-cache-miss time — the number that explains "why is serving slow after
+  that deploy" when the answer is an unstable input shape;
+- **sync payload accounting** (:func:`record_sync_bytes`, :func:`tree_nbytes`):
+  state-tree byte size per host gather / in-trace all-gather.
+
+Every hook is behind the master gate: callers on hot paths test
+``OBS.enabled`` themselves (one attribute load), and each hook re-checks so
+cold-path callers can call unconditionally.
+
+Stdlib only — array leaves are duck-typed on ``shape``/``dtype``/``nbytes``,
+never imported.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+import threading
+import time
+from typing import Any, Callable, Dict, List
+
+from metrics_tpu.obs.registry import OBS, REGISTRY
+from metrics_tpu.obs.trace import _NULL_SPAN, TRACER
+
+# byte-sized buckets for payload histograms: 64B → 64MB, ×16 per step
+_BYTE_BUCKETS = (64.0, 1024.0, 16384.0, 262144.0, 4194304.0, 67108864.0)
+
+OP_SECONDS = REGISTRY.histogram(
+    "metrics_tpu_op_seconds",
+    "Wall time of metric operations (op=update|compute|sync|jitted_update), per metric class and instance.",
+)
+RETRACES = REGISTRY.counter(
+    "metrics_tpu_retraces_total",
+    "New compiles attributed to the abstract-shape signature that caused them, counted at jit-cache-miss time.",
+)
+SYNC_BYTES = REGISTRY.counter(
+    "metrics_tpu_sync_bytes_total",
+    "Cumulative state-tree payload bytes moved through HOST-level distributed sync (counted per call).",
+)
+SYNC_TRACED_BYTES = REGISTRY.counter(
+    "metrics_tpu_sync_traced_bytes_total",
+    "Per-compile payload accounting for in-trace collectives: bytes each EXECUTION of the "
+    "traced collective moves per participant, recorded ONCE at trace time — multiply by the "
+    "step rate yourself; do not compare against the per-call host counter.",
+)
+SYNC_PAYLOAD = REGISTRY.histogram(
+    "metrics_tpu_sync_payload_bytes",
+    "State-tree byte size per host-level sync/all-gather.",
+    buckets=_BYTE_BUCKETS,
+)
+
+# Bounded per-instance labeling: the registry never evicts, so unbounded distinct
+# instance ids (per-request metrics, clones) would grow series forever in a
+# long-lived serving process. The label is stored ON the object (monotone issue
+# number — id() reuse after GC can never alias a new metric onto a dead one's
+# series); past the cap, new instances share one overflow label — per-class
+# series stay exact, per-instance attribution degrades last.
+_INSTANCE_CAP = 256
+_INSTANCE_ATTR = "_obs_instance_label"
+_instance_ids = itertools.count()
+
+
+def instance_label(obj: Any) -> str:
+    """Stable-for-the-lifetime-of-the-object instance id label (bounded set)."""
+    label = getattr(obj, _INSTANCE_ATTR, None)
+    if label is not None:
+        return label
+    n = next(_instance_ids)
+    label = str(n) if n < _INSTANCE_CAP else "overflow"
+    try:
+        object.__setattr__(obj, _INSTANCE_ATTR, label)
+    except Exception:  # noqa: BLE001 — slotted/immutable hosts: don't burn cap slots on them
+        return "untracked"
+    return label
+
+
+# ---------------------------------------------------------------------- op timing
+
+
+class _OpTimer:
+    """Span + wall-time histogram around one metric operation."""
+
+    __slots__ = ("_op", "_metric", "_instance", "_span", "_t0")
+
+    def __init__(self, op: str, metric: str, instance: str) -> None:
+        self._op = op
+        self._metric = metric
+        self._instance = instance
+
+    def __enter__(self) -> "_OpTimer":
+        self._span = TRACER.span(f"metric.{self._op}", metric=self._metric)
+        self._span.__enter__()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type: Any, exc: Any, tb: Any) -> bool:
+        dur = time.perf_counter() - self._t0
+        self._span.__exit__(exc_type, exc, tb)
+        OP_SECONDS.observe(dur, op=self._op, metric=self._metric, instance=self._instance)
+        return False
+
+    def set_attr(self, **attrs: Any) -> None:
+        self._span.set_attr(**attrs)
+
+
+def metric_op(op: str, owner: Any) -> Any:
+    """Context manager timing one ``update``/``compute``/``sync`` on ``owner``.
+
+    Returns a shared no-op when the master switch is off, so cold-path callers
+    can use it unconditionally; hot paths should branch on ``OBS.enabled``
+    themselves to skip even this call.
+    """
+    if not OBS.enabled:
+        return _NULL_SPAN
+    return _OpTimer(op, type(owner).__name__, instance_label(owner))
+
+
+# ---------------------------------------------------------------------- retrace attribution
+
+
+def record_retrace(site: str, signature: str) -> None:
+    """Count one fresh compile at ``site`` against the signature that caused it."""
+    if not OBS.enabled:
+        return
+    RETRACES.inc(1, site=site, signature=signature)
+
+
+def abstract_signature(tree: Any) -> str:
+    """Compact, deterministic abstract-shape signature of a pytree-ish value.
+
+    Array-like leaves (anything with ``shape`` + ``dtype``) render as
+    ``dtype[d0xd1]``; containers recurse (dicts in key order); other leaves
+    render as their type name — exactly the identity jax's jit cache keys on
+    at our level of abstraction, so one signature ↔ one compile.
+    """
+    parts: List[str] = []
+    _walk_signature(tree, parts)
+    return ",".join(parts)
+
+
+def _walk_signature(x: Any, parts: List[str]) -> None:
+    if isinstance(x, dict):
+        parts.append("{")
+        for k in sorted(x, key=str):
+            parts.append(f"{k}:")
+            _walk_signature(x[k], parts)
+        parts.append("}")
+        return
+    if isinstance(x, (list, tuple)):
+        parts.append("(")
+        for item in x:
+            _walk_signature(item, parts)
+        parts.append(")")
+        return
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        parts.append(f"{dtype}[{'x'.join(map(str, shape))}]")
+        return
+    parts.append(type(x).__name__)
+
+
+class _InstrumentedUpdater:
+    """Retrace attribution + timing around a compiled updater.
+
+    This callable is what ``_cached_jitted_updater`` caches, so identity-caching
+    semantics (``updater is metric.jitted_update_state()``) are preserved, and
+    unknown attributes (``.lower``, ``.clear_cache``, ...) forward to the
+    underlying ``jax.jit`` callable — the pre-obs return surface keeps working.
+    Disabled, the only per-call cost is one attribute test.
+
+    Each call (with obs on) derives the operands' abstract signature
+    (positional AND keyword — both key the jit cache) and records a retrace
+    against it when the call actually compiled. Freshness prefers the runtime's
+    own jit-cache size (immune to the warm-process pitfall where enabling obs
+    late would count already-compiled signatures). Observed cache growth is
+    CLAIMED under a lock (a high-water mark): one compile can never be recorded
+    twice, and a signature already marked seen is never re-recorded. Under
+    truly concurrent first-calls the attribution of a single observed compile
+    to *which* signature is best-effort — we bias toward undercounting rather
+    than phantom retraces on innocent warm signatures.
+    """
+
+    __slots__ = ("_fn", "_owner", "_metric_name", "_site", "_seen", "_seen_lock", "_claimed")
+
+    def __init__(self, fn: Callable, owner: Any) -> None:
+        self._fn = fn
+        self._owner = owner
+        self._metric_name = type(owner).__name__
+        self._site = f"{self._metric_name}.jitted_update_state"
+        self._seen: set = set()
+        self._seen_lock = threading.Lock()
+        self._claimed: Any = None  # cache-size high-water mark already attributed
+
+    @property
+    def __wrapped__(self) -> Callable:
+        return self._fn
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._fn, name)
+
+    def _cache_size(self) -> Any:
+        probe = getattr(self._fn, "_cache_size", None)
+        if probe is None:
+            return None
+        try:
+            return probe()
+        except Exception:  # noqa: BLE001 — private API: degrade to the seen-set
+            return None
+
+    def __call__(self, state: Any, *args: Any, **kwargs: Any) -> Any:
+        if not OBS.enabled:
+            return self._fn(state, *args, **kwargs)
+        signature = abstract_signature((state, args, kwargs))
+        size_before = self._cache_size()
+        t0 = time.perf_counter()
+        with TRACER.span("metric.jitted_update", metric=self._metric_name) as span:
+            out = self._fn(state, *args, **kwargs)
+        dur = time.perf_counter() - t0
+        size_after = self._cache_size() if size_before is not None else None
+        with self._seen_lock:
+            if size_after is not None:
+                if self._claimed is None:
+                    # first probed call: everything compiled before obs was
+                    # watching is pre-claimed, never attributed to anyone
+                    self._claimed = size_before
+                # only UNCLAIMED growth past the high-water mark counts, so a
+                # concurrent compile straddling our probes is claimed at most
+                # once across all callers
+                compiled = size_after > self._claimed
+                if compiled:
+                    self._claimed = size_after
+            else:
+                compiled = True  # probe unavailable: let the seen-set decide alone
+            fresh = compiled and signature not in self._seen
+            # a warm signature is known-compiled even when `compiled` is False —
+            # remember it so a later straddling probe can't misattribute it
+            self._seen.add(signature)
+        if fresh:
+            RETRACES.inc(1, site=self._site, signature=signature)
+            span.set_attr(retrace=True)
+        OP_SECONDS.observe(
+            dur, op="jitted_update", metric=self._metric_name, instance=instance_label(self._owner)
+        )
+        return out
+
+
+def wrap_jitted_updater(fn: Callable, owner: Any) -> Callable:
+    """Wrap a compiled updater for retrace attribution + timing (see
+    :class:`_InstrumentedUpdater`)."""
+    return _InstrumentedUpdater(fn, owner)
+
+
+# ---------------------------------------------------------------------- sync payload
+
+
+def tree_nbytes(tree: Any) -> int:
+    """Total byte size of every array-like leaf in a state pytree.
+
+    Duck-typed: concrete arrays report ``nbytes``; abstract values inside a
+    trace (shape + dtype, no buffer) fall back to ``prod(shape) * itemsize`` —
+    so recording at trace time prices the payload the collective will move.
+    """
+    total = 0
+    stack = [tree]
+    while stack:
+        x = stack.pop()
+        if isinstance(x, dict):
+            stack.extend(x.values())
+        elif isinstance(x, (list, tuple)):
+            stack.extend(x)
+        else:
+            nbytes = getattr(x, "nbytes", None)
+            if nbytes is not None:
+                try:
+                    total += int(nbytes)
+                    continue
+                except Exception:  # noqa: BLE001 — aval nbytes may be symbolic
+                    pass
+            shape = getattr(x, "shape", None)
+            dtype = getattr(x, "dtype", None)
+            if shape is not None and dtype is not None:
+                try:
+                    total += int(math.prod(shape)) * int(getattr(dtype, "itemsize", 0))
+                except Exception:  # noqa: BLE001 — dynamic dims: skip the leaf
+                    pass
+    return total
+
+
+def record_sync_bytes(site: str, metric: str, nbytes: int) -> None:
+    """Account one HOST-level sync's state-tree payload (per-call counter + distribution)."""
+    if not OBS.enabled:
+        return
+    SYNC_BYTES.inc(nbytes, site=site, metric=metric)
+    SYNC_PAYLOAD.observe(nbytes, site=site)
+
+
+def record_traced_sync_bytes(site: str, metric: str, nbytes: int) -> None:
+    """Account an IN-TRACE collective's payload, once per compile.
+
+    Kept in a separate counter from :func:`record_sync_bytes`: this body runs at
+    trace time only, so the number means 'bytes per execution of the compiled
+    collective', not 'cumulative bytes moved' — summing the two sites into one
+    series would make the traced path look ~free next to per-call host syncs.
+    """
+    if not OBS.enabled:
+        return
+    SYNC_TRACED_BYTES.inc(nbytes, site=site, metric=metric)
+
+
+# ---------------------------------------------------------------------- engine hooks
+
+
+def record_engine_compile(signature: Any, bucket: int, capacity: int) -> None:
+    """Retrace attribution for the engine's bucket kernels, at kernel-cache-miss
+    time: one recorded compile per new (request signature, bucket, capacity)."""
+    if not OBS.enabled:
+        return
+    RETRACES.inc(
+        1,
+        site="engine.bucket_kernel",
+        signature=f"{abstract_signature(signature)}|bucket={bucket}|capacity={capacity}",
+    )
+
+
+def engine_span(name: str, **attrs: Any) -> Any:
+    """Trace span for engine internals (dispatch, drain, inline apply)."""
+    if not OBS.enabled:
+        return _NULL_SPAN
+    return TRACER.span(name, **attrs)

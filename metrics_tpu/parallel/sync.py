@@ -28,6 +28,9 @@ import jax.numpy as jnp
 from jax import lax
 from jax import Array
 
+from metrics_tpu.obs import instrument as _obs
+from metrics_tpu.obs.registry import OBS as _OBS
+
 AxisName = Union[str, Tuple[str, ...]]
 
 # Reduction registry: maps dist_reduce_fx names to (in-trace collective, host-side stack reduce)
@@ -45,6 +48,14 @@ def reduce_in_trace(x: Array, reduce_fx: Optional[str], axis_name: AxisName) -> 
     ``cat``/``None`` → ``all_gather`` (tiled for cat: shards concatenate along dim 0,
     matching the reference's dim-0 cat of the gathered list).
     """
+    if _OBS.enabled:
+        # trace-time payload accounting: this body runs once per compile, so the
+        # recorded bytes price what each EXECUTION of the collective moves per
+        # participant (tree_nbytes prices tracers from shape × itemsize); kept in
+        # the dedicated per-compile counter, NOT the per-call host counter
+        _obs.record_traced_sync_bytes(
+            "reduce_in_trace", str(reduce_fx) if not callable(reduce_fx) else "callable", _obs.tree_nbytes(x)
+        )
     if reduce_fx in _TRACE_REDUCERS:
         return _TRACE_REDUCERS[reduce_fx](x, axis_name)
     if reduce_fx == "cat":
@@ -85,6 +96,9 @@ def sync_state_host(
     if not is_distributed:
         return state
     gather = gather_fn or gather_all_tensors
+
+    if _OBS.enabled:
+        _obs.record_sync_bytes("sync_state_host", "state_pytree", _obs.tree_nbytes(state))
 
     synced = dict(state)
     for name, reduction in reductions.items():

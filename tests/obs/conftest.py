@@ -1,0 +1,17 @@
+"""Per-test isolation for the process-global observability state."""
+
+import pytest
+
+from metrics_tpu import obs
+
+
+@pytest.fixture(autouse=True)
+def _obs_isolation():
+    """Disable + clear recorded values around every test in this package.
+
+    ``obs.reset()`` zeroes samples and spans but keeps registered instruments,
+    so references held by live subsystems (engine telemetry) stay valid.
+    """
+    obs.reset()
+    yield
+    obs.reset()

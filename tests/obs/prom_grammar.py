@@ -1,0 +1,114 @@
+"""A strict-enough Prometheus text-format (v0.0.4) grammar checker for tests.
+
+Validates line shapes (HELP/TYPE comments, sample lines with optional labels),
+name/label identifier grammars, and the histogram contract: per label set,
+``_bucket`` counts cumulative and monotone in ``le``, a ``+Inf`` bucket equal
+to ``_count``, and ``_sum``/``_count`` present.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Tuple
+
+_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_LABEL_NAME = r"[a-zA-Z_][a-zA-Z0-9_]*"
+_HELP_RE = re.compile(rf"^# HELP ({_NAME}) .*$")
+_TYPE_RE = re.compile(rf"^# TYPE ({_NAME}) (counter|gauge|histogram|summary|untyped)$")
+_LABEL_RE = re.compile(rf'^({_LABEL_NAME})="((?:[^"\\\n]|\\["\\n])*)"$')
+_VALUE_RE = re.compile(r"^(NaN|[+-]Inf|[+-]?[0-9]*\.?[0-9]+([eE][+-]?[0-9]+)?)$")
+_SAMPLE_RE = re.compile(rf"^({_NAME})(\{{(.*)\}})? (\S+)( [0-9]+)?$")
+
+
+def parse(text: str) -> Tuple[Dict[str, str], List[Tuple[str, Dict[str, str], float]]]:
+    """Validate ``text``; returns (family types, samples). Raises AssertionError."""
+    types: Dict[str, str] = {}
+    samples: List[Tuple[str, Dict[str, str], float]] = []
+    for lineno, line in enumerate(text.split("\n"), 1):
+        if not line:
+            continue
+        if line.startswith("#"):
+            if line.startswith("# HELP "):
+                assert _HELP_RE.match(line), f"line {lineno}: bad HELP: {line!r}"
+            elif line.startswith("# TYPE "):
+                m = _TYPE_RE.match(line)
+                assert m, f"line {lineno}: bad TYPE: {line!r}"
+                assert m.group(1) not in types, f"line {lineno}: duplicate TYPE for {m.group(1)}"
+                types[m.group(1)] = m.group(2)
+            # other comments are legal and ignored
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"line {lineno}: bad sample line: {line!r}"
+        name, _, labelblob, value, _ = m.groups()
+        assert _VALUE_RE.match(value), f"line {lineno}: bad value {value!r}"
+        labels: Dict[str, str] = {}
+        if labelblob:
+            for part in _split_labels(labelblob, lineno):
+                lm = _LABEL_RE.match(part)
+                assert lm, f"line {lineno}: bad label pair {part!r}"
+                labels[lm.group(1)] = lm.group(2)
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[: -len(suffix)] in types:
+                base = name[: -len(suffix)]
+        assert base in types, f"line {lineno}: sample {name!r} before any TYPE declaration"
+        samples.append((name, labels, float(value.replace("Inf", "inf"))))
+    _check_histograms(types, samples)
+    return types, samples
+
+
+def _split_labels(blob: str, lineno: int) -> List[str]:
+    """Split ``k="v",k2="v2"`` on commas outside quotes (values may contain commas)."""
+    parts, buf, in_quotes, escaped = [], [], False, False
+    for ch in blob:
+        if escaped:
+            buf.append(ch)
+            escaped = False
+            continue
+        if ch == "\\":
+            buf.append(ch)
+            escaped = True
+            continue
+        if ch == '"':
+            in_quotes = not in_quotes
+            buf.append(ch)
+            continue
+        if ch == "," and not in_quotes:
+            parts.append("".join(buf))
+            buf = []
+            continue
+        buf.append(ch)
+    assert not in_quotes, f"line {lineno}: unterminated label quote in {blob!r}"
+    if buf:
+        parts.append("".join(buf))
+    return parts
+
+
+def _check_histograms(types: Dict[str, str], samples: List[Tuple[str, Dict[str, str], float]]) -> None:
+    for family, kind in types.items():
+        if kind != "histogram":
+            continue
+        by_labelset: Dict[Tuple[Tuple[str, str], ...], Dict[str, object]] = {}
+        for name, labels, value in samples:
+            if not name.startswith(family):
+                continue
+            key = tuple(sorted((k, v) for k, v in labels.items() if k != "le"))
+            row = by_labelset.setdefault(key, {"buckets": [], "sum": None, "count": None})
+            if name == f"{family}_bucket":
+                assert "le" in labels, f"{family}_bucket without le label"
+                row["buckets"].append((float(labels["le"].replace("Inf", "inf")), value))
+            elif name == f"{family}_sum":
+                row["sum"] = value
+            elif name == f"{family}_count":
+                row["count"] = value
+        for key, row in by_labelset.items():
+            buckets = row["buckets"]
+            assert buckets, f"{family}{dict(key)}: no _bucket samples"
+            assert row["sum"] is not None, f"{family}{dict(key)}: missing _sum"
+            assert row["count"] is not None, f"{family}{dict(key)}: missing _count"
+            edges = [e for e, _ in buckets]
+            counts = [c for _, c in buckets]
+            assert edges == sorted(edges), f"{family}{dict(key)}: le edges not sorted"
+            assert edges[-1] == float("inf"), f"{family}{dict(key)}: missing +Inf bucket"
+            assert counts == sorted(counts), f"{family}{dict(key)}: buckets not cumulative"
+            assert counts[-1] == row["count"], f"{family}{dict(key)}: +Inf bucket != _count"

@@ -1,0 +1,272 @@
+"""Instrumentation hooks: gating, op timing, retrace attribution, sync payload bytes."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import obs
+from metrics_tpu.aggregation import SumMetric
+from metrics_tpu.classification import BinaryAccuracy
+from metrics_tpu.collections import MetricCollection
+from metrics_tpu.engine import StreamingEngine
+from metrics_tpu.obs import instrument
+from metrics_tpu.obs.instrument import (
+    OP_SECONDS,
+    RETRACES,
+    SYNC_BYTES,
+    abstract_signature,
+    tree_nbytes,
+)
+
+
+def _retraces_for(site):
+    return {
+        dict(key).get("signature"): value
+        for key, value in RETRACES.collect().items()
+        if dict(key).get("site") == site
+    }
+
+
+class TestGating:
+    def test_disabled_records_nothing(self):
+        m = BinaryAccuracy()
+        m.update(jnp.array([1, 0]), jnp.array([1, 1]))
+        m.compute()
+        assert OP_SECONDS.collect() == {}
+        assert obs.TRACER.total_recorded == 0
+
+    def test_enable_disable_roundtrip(self):
+        m = BinaryAccuracy()
+        obs.enable()
+        m.update(jnp.array([1, 0]), jnp.array([1, 1]))
+        obs.disable()
+        m.update(jnp.array([1, 0]), jnp.array([1, 1]))
+        assert OP_SECONDS.count(op="update", metric="BinaryAccuracy",
+                                instance=instrument.instance_label(m)) == 1
+
+
+class TestOpTiming:
+    def test_update_and_compute_timed_per_instance(self):
+        obs.enable()
+        m1, m2 = BinaryAccuracy(), BinaryAccuracy()
+        for _ in range(3):
+            m1.update(jnp.array([1, 0]), jnp.array([1, 1]))
+        m2.update(jnp.array([1]), jnp.array([1]))
+        m1.compute()
+        i1, i2 = instrument.instance_label(m1), instrument.instance_label(m2)
+        assert OP_SECONDS.count(op="update", metric="BinaryAccuracy", instance=i1) == 3
+        assert OP_SECONDS.count(op="update", metric="BinaryAccuracy", instance=i2) == 1
+        assert OP_SECONDS.count(op="compute", metric="BinaryAccuracy", instance=i1) == 1
+        assert OP_SECONDS.sum(op="update", metric="BinaryAccuracy", instance=i1) > 0
+
+    def test_update_span_recorded(self):
+        obs.enable()
+        m = BinaryAccuracy()
+        m.update(jnp.array([1]), jnp.array([1]))
+        names = [s["name"] for s in obs.TRACER.spans()]
+        assert "metric.update" in names
+
+    def test_collection_span_nests_member_updates(self):
+        obs.enable()
+        mc = MetricCollection([BinaryAccuracy()])
+        mc.update(jnp.array([1, 0]), jnp.array([1, 1]))
+        spans = obs.TRACER.spans()
+        (member,) = [s for s in spans if s["attrs"].get("metric") == "BinaryAccuracy"]
+        assert member["parent"] == "metric.update"  # member nests under the collection span
+        assert OP_SECONDS.count(op="update", metric="MetricCollection",
+                                instance=instrument.instance_label(mc)) == 1
+
+
+class TestRetraceAttribution:
+    def test_jitted_updater_one_retrace_per_signature(self):
+        obs.enable()
+        m = SumMetric()
+        updater = m.jitted_update_state(donate=False)
+        site = "SumMetric.jitted_update_state"
+
+        state = m.init_state()
+        state = updater(state, jnp.ones(4))
+        state = updater(state, jnp.ones(4))  # same signature: no new compile
+        assert list(_retraces_for(site).values()) == [1]
+
+        state8 = updater(m.init_state(), jnp.ones(8))  # new shape: one new compile
+        retraces = _retraces_for(site)
+        assert sorted(retraces.values()) == [1, 1]
+        assert len(retraces) == 2
+        assert float(state["sum_value"]) == 8.0 and float(state8["sum_value"]) == 8.0
+
+    def test_signature_string_names_shape_and_dtype(self):
+        obs.enable()
+        m = SumMetric()
+        updater = m.jitted_update_state(donate=False)
+        updater(m.init_state(), jnp.ones(4, dtype=jnp.float32))
+        (sig,) = _retraces_for("SumMetric.jitted_update_state")
+        assert "float32[4]" in sig
+
+    def test_wrapped_updater_keeps_identity_cache(self):
+        m = SumMetric()
+        assert m.jitted_update_state() is m.jitted_update_state()
+        assert m.jitted_update_state() is not m.jitted_update_state(donate=False)
+
+    def test_wrapped_updater_forwards_jit_attributes(self):
+        # the pre-obs return surface (.lower/.clear_cache/...) must keep working
+        m = SumMetric()
+        updater = m.jitted_update_state(donate=False)
+        lowered = updater.lower(m.init_state(), jnp.ones(4))
+        assert "sum" in lowered.as_text().lower()
+        assert updater.__wrapped__ is not None
+        updater.clear_cache()
+
+    def test_warm_enable_records_no_false_retrace(self):
+        # compile while obs is OFF, then enable: the already-cached signature
+        # must NOT count as a retrace (freshness keys off the real jit cache)
+        m = SumMetric()
+        updater = m.jitted_update_state(donate=False)
+        updater(m.init_state(), jnp.ones(4))  # compiles, obs disabled
+        obs.enable()
+        updater(m.init_state(), jnp.ones(4))  # warm: no compile happens
+        assert _retraces_for("SumMetric.jitted_update_state") == {}
+        updater(m.init_state(), jnp.ones(16))  # genuinely new shape: one compile
+        assert list(_retraces_for("SumMetric.jitted_update_state").values()) == [1]
+
+    def test_kwargs_participate_in_retrace_signature(self):
+        obs.enable()
+        m = SumMetric()
+        updater = m.jitted_update_state(donate=False)
+        updater(m.init_state(), value=jnp.ones(4))
+        updater(m.init_state(), value=jnp.ones(8))  # kwarg shape change => new compile
+        retraces = _retraces_for("SumMetric.jitted_update_state")
+        assert len(retraces) == 2
+        assert any("float32[8]" in sig for sig in retraces)
+
+    def test_engine_one_recorded_compile_per_new_bucket_signature(self):
+        obs.enable()
+        engine = StreamingEngine(BinaryAccuracy(), buckets=(4, 8), capacity=4)
+        try:
+            site = "engine.bucket_kernel"
+
+            def submit_rows(rows, repeats=1):
+                # flush per submit: the dispatcher must see one request per drain,
+                # else coalescing merges them into a bigger (different) bucket
+                for _ in range(repeats):
+                    engine.submit("k", jnp.ones(rows, jnp.int32), jnp.ones(rows, jnp.int32))
+                    engine.flush()
+
+            submit_rows(2, repeats=3)  # bucket 4: exactly ONE compile despite 3 submits
+            assert list(_retraces_for(site).values()) == [1]
+
+            submit_rows(6, repeats=2)  # bucket 8: one more
+            retraces = _retraces_for(site)
+            assert len(retraces) == 2 and set(retraces.values()) == {1}
+            assert any("bucket=4" in sig for sig in retraces)
+            assert any("bucket=8" in sig for sig in retraces)
+
+            # attribution agrees with the engine's own compile counter
+            assert engine.telemetry_snapshot()["compiles"] == 2
+
+            submit_rows(2)  # warm signatures: nothing new
+            assert sum(_retraces_for(site).values()) == 2
+        finally:
+            engine.close()
+
+
+class TestSyncPayload:
+    def test_sync_dist_records_state_bytes(self):
+        obs.enable()
+        m = SumMetric(
+            dist_sync_fn=lambda x, group=None: [x, x],
+            distributed_available_fn=lambda: True,
+        )
+        m.update(jnp.array(2.0))
+        m.compute()
+        recorded = SYNC_BYTES.value(site="Metric._sync_dist", metric="SumMetric")
+        assert recorded == tree_nbytes({"sum_value": m.sum_value})
+        assert recorded > 0
+        assert float(m.compute()) == 4.0  # fake 2-process gather still sums
+
+    def test_sync_state_host_records_bytes(self):
+        obs.enable()
+        from metrics_tpu.parallel.sync import sync_state_host
+
+        m = SumMetric()
+        state = m.init_state()
+        sync_state_host(
+            state,
+            m._reductions,
+            gather_fn=lambda x, group=None: [x, x],
+            distributed_available_fn=lambda: True,
+        )
+        assert SYNC_BYTES.value(site="sync_state_host", metric="state_pytree") == tree_nbytes(state)
+
+    def test_reduce_in_trace_records_per_compile_into_separate_counter(self):
+        import functools
+
+        import jax
+        import numpy as np
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from metrics_tpu.obs.instrument import SYNC_TRACED_BYTES
+
+        obs.enable()
+        m = SumMetric()
+        mesh = Mesh(np.array(jax.devices()[:2]), ("dp",))
+
+        @jax.jit
+        @functools.partial(shard_map, mesh=mesh, in_specs=P("dp"), out_specs=P())
+        def sharded(x):
+            state = m.update_state(m.init_state(), x)
+            return m.compute_from(state, axis_name="dp")
+
+        assert float(sharded(jnp.arange(4, dtype=jnp.float32))) == 6.0
+        # recorded ONCE at trace time — a second (cached) execution adds nothing,
+        # and the per-call host counter is untouched
+        traced = SYNC_TRACED_BYTES.value(site="reduce_in_trace", metric="sum")
+        assert traced == 4  # one f32 scalar sum state per participant
+        assert float(sharded(jnp.arange(4, dtype=jnp.float32))) == 6.0
+        assert SYNC_TRACED_BYTES.value(site="reduce_in_trace", metric="sum") == traced
+        assert SYNC_BYTES.value(site="reduce_in_trace", metric="sum") == 0
+
+    def test_instance_label_cardinality_is_bounded(self, monkeypatch):
+        class Host:
+            pass
+
+        a, b = Host(), Host()
+        label_a = instrument.instance_label(a)
+        assert instrument.instance_label(a) == label_a  # stable for a live object
+        monkeypatch.setattr(instrument, "_INSTANCE_CAP", 0)  # cap exhausted
+        assert instrument.instance_label(b) == "overflow"  # past the cap: shared bucket
+        assert instrument.instance_label(a) == label_a  # pre-cap labels stay stable
+        # unsettable hosts never consume per-instance series
+        assert instrument.instance_label(object()) == "untracked"
+
+    def test_clone_gets_its_own_instance_label(self):
+        m = SumMetric()
+        label = instrument.instance_label(m)
+        clone = m.clone()
+        assert instrument.instance_label(clone) != label  # no series aliasing
+
+
+class TestHelpers:
+    def test_abstract_signature_deterministic_and_shape_keyed(self):
+        a = {"x": jnp.ones((2, 3)), "y": [jnp.zeros(4, jnp.int32), 1.5]}
+        b = {"y": [jnp.zeros(4, jnp.int32), 2.5], "x": jnp.ones((2, 3))}  # same shapes
+        assert abstract_signature(a) == abstract_signature(b)
+        assert abstract_signature(a) != abstract_signature({"x": jnp.ones((3, 2))})
+        assert "float32[2x3]" in abstract_signature(a)
+
+    def test_tree_nbytes(self):
+        tree = {"a": np.zeros((4, 2), np.float32), "b": [np.zeros(3, np.int64)], "c": 1.0}
+        assert tree_nbytes(tree) == 4 * 2 * 4 + 3 * 8
+
+    def test_tree_nbytes_prices_tracers_from_shape(self):
+        import jax
+
+        seen = {}
+
+        def f(x):
+            seen["bytes"] = tree_nbytes({"x": x})
+            return x
+
+        jax.jit(f)(jnp.ones((8, 4), jnp.float32))
+        assert seen["bytes"] == 8 * 4 * 4

@@ -1,0 +1,26 @@
+"""Local repro of the CI ``obs-overhead`` gate (slow tier: timing-sensitive)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.mark.slow
+def test_obs_overhead_gates(tmp_path):
+    """benchmarks/obs_overhead.py must pass its <5% disabled / <15% enabled gates."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "benchmarks", "obs_overhead.py"),
+         "--out-dir", str(tmp_path),
+         "--runs-log", str(tmp_path / "runs.jsonl")],  # keep the tracked evidence log canonical
+        capture_output=True,
+        text=True,
+        timeout=600,
+        cwd=_REPO,
+    )
+    assert proc.returncode == 0, f"overhead gate failed:\n{proc.stdout}\n{proc.stderr}"
+    assert (tmp_path / "obs_trace.json").exists()
+    assert (tmp_path / "obs_metrics.prom").exists()

@@ -1,0 +1,222 @@
+"""Registry: counters/gauges/histograms, labels, thread-safety, Prometheus grammar."""
+
+import json
+import threading
+
+import pytest
+
+from metrics_tpu import obs
+from metrics_tpu.obs.registry import Registry
+
+from tests.obs.prom_grammar import parse as parse_prometheus
+
+
+class TestInstruments:
+    def test_counter_inc_and_value(self):
+        reg = Registry()
+        c = reg.counter("requests_total", "Requests.")
+        c.inc()
+        c.inc(5)
+        assert c.value() == 6
+        assert c.value(site="other") == 0  # unknown label set reads 0, never raises
+
+    def test_counter_labels_are_independent_and_order_insensitive(self):
+        reg = Registry()
+        c = reg.counter("events_total")
+        c.inc(2, site="a", op="x")
+        c.inc(3, op="x", site="a")  # same series, different kwarg order
+        c.inc(7, site="b", op="x")
+        assert c.value(site="a", op="x") == 5
+        assert c.value(site="b", op="x") == 7
+
+    def test_counter_rejects_negative(self):
+        reg = Registry()
+        with pytest.raises(ValueError, match="cannot decrease"):
+            reg.counter("c_total").inc(-1)
+
+    def test_inc_many_applies_all_and_rejects_negative(self):
+        reg = Registry()
+        c = reg.counter("grouped_total")
+        c.inc_many([(1, {"e": "batches"}), (3, {"e": "rows"}), (5, {"e": "padded"})])
+        assert c.value(e="batches") == 1 and c.value(e="rows") == 3 and c.value(e="padded") == 5
+        with pytest.raises(ValueError, match="cannot decrease"):
+            c.inc_many([(1, {"e": "ok"}), (-2, {"e": "bad"})])
+        assert c.value(e="ok") == 0  # validation rejects the whole group
+
+    def test_gauge_set_overwrites(self):
+        reg = Registry()
+        g = reg.gauge("depth")
+        g.set(4)
+        g.set(2)
+        assert g.value() == 2
+        g.inc(3)
+        assert g.value() == 5
+
+    def test_histogram_buckets_sum_count(self):
+        reg = Registry()
+        h = reg.histogram("lat_seconds", buckets=(0.1, 1.0))
+        for v in (0.05, 0.1, 0.5, 2.0):  # 0.1 is upper-INCLUSIVE (le semantics)
+            h.observe(v)
+        counts = h.bucket_counts()
+        assert counts[0.1] == 2 and counts[1.0] == 1 and counts[float("inf")] == 1
+        assert h.count() == 4
+        assert h.sum() == pytest.approx(2.65)
+
+    def test_histogram_rejects_bad_edges(self):
+        reg = Registry()
+        with pytest.raises(ValueError):
+            reg.histogram("h1", buckets=())
+        with pytest.raises(ValueError):
+            reg.histogram("h2", buckets=(1.0, float("inf")))
+        with pytest.raises(ValueError):
+            reg.histogram("h3", buckets=(1.0, 1.0))
+
+
+class TestRegistry:
+    def test_get_or_create_is_idempotent(self):
+        reg = Registry()
+        assert reg.counter("x_total") is reg.counter("x_total")
+
+    def test_kind_conflict_raises(self):
+        reg = Registry()
+        reg.counter("x_total")
+        with pytest.raises(TypeError, match="already a counter"):
+            reg.gauge("x_total")
+
+    def test_histogram_edge_conflict_raises(self):
+        reg = Registry()
+        reg.histogram("h", buckets=(1.0, 2.0))
+        with pytest.raises(ValueError, match="already registered with edges"):
+            reg.histogram("h", buckets=(1.0, 3.0))
+
+    def test_histogram_get_without_buckets_returns_existing(self):
+        # a plain get of a custom-edge family must not trip the conflict check
+        reg = Registry()
+        h = reg.histogram("h_custom", buckets=(0.25, 0.5))
+        assert reg.histogram("h_custom") is h
+        assert reg.histogram("h_default").edges != h.edges  # creation defaults apply
+
+    def test_invalid_names_raise(self):
+        reg = Registry()
+        with pytest.raises(ValueError, match="invalid Prometheus metric name"):
+            reg.counter("0bad")
+        with pytest.raises(ValueError, match="invalid Prometheus label name"):
+            reg.counter("ok_total").inc(1, **{"bad-label": "v"})
+
+    def test_snapshot_shape(self):
+        reg = Registry()
+        reg.counter("c_total", "help").inc(2, site="a")
+        reg.histogram("h", buckets=(1.0,)).observe(0.5, op="u")
+        snap = reg.snapshot()
+        assert snap["c_total"]["type"] == "counter"
+        assert snap["c_total"]["values"] == {"site=a": 2}
+        hvals = snap["h"]["values"]["op=u"]
+        assert hvals["count"] == 1 and hvals["buckets"]["1.0"] == 1
+        json.dumps(snap)  # snapshot must be plainly serializable
+
+    def test_clear_values_keeps_instruments(self):
+        reg = Registry()
+        c = reg.counter("c_total")
+        c.inc(9)
+        reg.clear_values()
+        assert c.value() == 0
+        assert reg.counter("c_total") is c  # same object, still registered
+
+    def test_emit_jsonl(self, tmp_path):
+        reg = Registry()
+        reg.counter("c_total").inc(3)
+        path = str(tmp_path / "obs.jsonl")
+        reg.emit(path, run="unit")
+        reg.emit(path, run="unit")
+        lines = [json.loads(line) for line in open(path)]
+        assert len(lines) == 2
+        assert lines[0]["what"] == "obs_registry" and lines[0]["run"] == "unit"
+        assert lines[0]["registry"]["c_total"]["values"][""] == 3
+        assert "utc" in lines[0]
+
+
+class TestThreadSafety:
+    def test_counter_hammering_no_lost_updates(self):
+        reg = Registry()
+        c = reg.counter("hammer_total")
+        threads_n, per_thread = 8, 5000
+
+        def worker(tid):
+            for _ in range(per_thread):
+                c.inc(1, thread=str(tid % 2))  # 2 contended series
+
+        threads = [threading.Thread(target=worker, args=(t,)) for t in range(threads_n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value(thread="0") + c.value(thread="1") == threads_n * per_thread
+
+    def test_histogram_hammering_no_lost_updates(self):
+        reg = Registry()
+        h = reg.histogram("hammer_seconds", buckets=(0.5,))
+        threads_n, per_thread = 8, 2500
+
+        def worker():
+            for i in range(per_thread):
+                h.observe(0.25 if i % 2 else 0.75)
+
+        threads = [threading.Thread(target=worker) for _ in range(threads_n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        total = threads_n * per_thread
+        assert h.count() == total
+        counts = h.bucket_counts()
+        assert counts[0.5] == total // 2 and counts[float("inf")] == total // 2
+        assert h.sum() == pytest.approx(total // 2 * 0.25 + total // 2 * 0.75)
+
+    def test_concurrent_get_or_create_single_instance(self):
+        reg = Registry()
+        seen = []
+
+        def worker():
+            seen.append(reg.counter("race_total"))
+
+        threads = [threading.Thread(target=worker) for _ in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert all(inst is seen[0] for inst in seen)
+
+
+class TestPrometheusRendering:
+    def test_render_parses_under_grammar(self):
+        reg = Registry()
+        reg.counter("svc_requests_total", "Total requests.").inc(3, route="/v1", code="200")
+        reg.gauge("svc_queue_depth", "Depth.").set(7)
+        h = reg.histogram("svc_latency_seconds", "Latency.", buckets=(0.1, 1.0))
+        for v in (0.05, 0.5, 5.0):
+            h.observe(v, route="/v1")
+        types, samples = parse_prometheus(reg.render_prometheus())
+        assert types == {
+            "svc_requests_total": "counter",
+            "svc_queue_depth": "gauge",
+            "svc_latency_seconds": "histogram",
+        }
+        by_name = {}
+        for name, labels, value in samples:
+            by_name.setdefault(name, []).append((labels, value))
+        assert by_name["svc_requests_total"] == [({"route": "/v1", "code": "200"}, 3.0)]
+        assert by_name["svc_queue_depth"] == [({}, 7.0)]
+        assert len(by_name["svc_latency_seconds_bucket"]) == 3  # 2 edges + Inf
+
+    def test_label_value_escaping(self):
+        reg = Registry()
+        reg.counter("esc_total").inc(1, path='a"b\\c\nd')
+        types, samples = parse_prometheus(reg.render_prometheus())
+        ((name, labels, value),) = [s for s in samples if s[0] == "esc_total"]
+        assert value == 1.0
+        # escaped forms survive the round-trip through the grammar
+        assert labels["path"] == 'a\\"b\\\\c\\nd'
+
+    def test_global_registry_render_parses(self):
+        # the process-global registry (engine + instrumentation series included)
+        parse_prometheus(obs.render_prometheus())

@@ -1,0 +1,173 @@
+"""EngineTelemetry on the shared registry: strict names, percentiles, snapshot compat."""
+
+import json
+
+import numpy as np
+import pytest
+
+from metrics_tpu import obs
+from metrics_tpu.engine.telemetry import _COUNTERS, EngineTelemetry
+
+from tests.obs.prom_grammar import parse as parse_prometheus
+
+
+class TestStrictCounterNames:
+    def test_unknown_name_raises_instead_of_minting(self):
+        t = EngineTelemetry()
+        with pytest.raises(KeyError, match="unknown telemetry counter"):
+            t.count("procesed")  # the typo the old dict.get(name, 0) silently absorbed
+        assert "procesed" not in t.snapshot()
+
+    def test_register_counter_extends_the_set(self):
+        t = EngineTelemetry()
+        t.register_counter("custom_evictions")
+        t.count("custom_evictions", 3)
+        assert t.snapshot()["custom_evictions"] == 3
+
+    def test_all_runtime_call_sites_are_declared(self):
+        # audit: every count() call site in engine/runtime.py uses a declared name
+        import inspect
+        import re
+
+        from metrics_tpu.engine import runtime
+
+        src = inspect.getsource(runtime)
+        called = set(re.findall(r"""telemetry\.count\(\s*["']([a-z_]+)["']""", src))
+        assert called, "audit regex found no call sites"
+        assert called <= set(_COUNTERS)
+
+
+class TestPercentiles:
+    def test_single_observation(self):
+        t = EngineTelemetry(latency_window=8)
+        t.observe_latency(0.5)
+        lat = t.snapshot()["latency_s"]
+        assert lat["count"] == 1
+        assert lat["p50"] == lat["p99"] == lat["max"] == 0.5
+
+    def test_partially_filled_ring_p99_reaches_max(self):
+        t = EngineTelemetry(latency_window=64)
+        values = [i / 100 for i in range(1, 11)]  # 10 < window
+        for v in values:
+            t.observe_latency(v)
+        lat = t.snapshot()["latency_s"]
+        # nearest-rank: p99 on small n is the max (index truncation gave values[8])
+        assert lat["p99"] == lat["max"] == 0.10
+        assert lat["p50"] == float(np.percentile(values, 50, method="nearest"))
+        assert lat["count"] == 10
+
+    def test_wrapped_ring_uses_only_retained_window(self):
+        t = EngineTelemetry(latency_window=8)
+        for v in range(1, 21):  # 20 observations into an 8-slot ring
+            t.observe_latency(float(v))
+        lat = t.snapshot()["latency_s"]
+        retained = list(range(13, 21))  # oldest 12 overwritten
+        assert lat["count"] == 20  # total ever, as before
+        assert lat["max"] == 20.0
+        assert lat["p99"] == 20.0
+        assert lat["p50"] == float(np.percentile(retained, 50, method="nearest"))
+
+    def test_empty_ring(self):
+        t = EngineTelemetry()
+        assert t.snapshot()["latency_s"] == {"count": 0, "p50": None, "p99": None, "max": None}
+
+
+class TestRegistryRebase:
+    def test_snapshot_keeps_backwards_compatible_shape(self):
+        t = EngineTelemetry()
+        t.count("submitted", 4)
+        t.observe_batch(real_rows=3, bucket=4)
+        t.gauge_queue_depth(2)
+        snap = t.snapshot()
+        for name in _COUNTERS:
+            assert isinstance(snap[name], int)
+        assert snap["submitted"] == 4
+        assert snap["queue_depth"] == 2
+        assert snap["batch_occupancy_hist"] == {"<=0.25": 0, "<=0.5": 0, "<=0.75": 1, "<=1.0": 0}
+        assert snap["mean_batch_occupancy"] == 0.75
+
+    def test_instances_do_not_cross_contaminate(self):
+        t1, t2 = EngineTelemetry(), EngineTelemetry()
+        t1.count("submitted", 5)
+        t2.count("submitted", 1)
+        assert t1.snapshot()["submitted"] == 5
+        assert t2.snapshot()["submitted"] == 1
+
+    def test_series_visible_in_prometheus_scrape(self):
+        t = EngineTelemetry()
+        t.count("processed", 2)
+        t.observe_latency(0.01)
+        types, samples = parse_prometheus(obs.render_prometheus())
+        assert types["metrics_tpu_engine_events_total"] == "counter"
+        assert types["metrics_tpu_engine_latency_seconds"] == "histogram"
+        match = [
+            value
+            for name, labels, value in samples
+            if name == "metrics_tpu_engine_events_total"
+            and labels.get("engine") == t.engine_id
+            and labels.get("event") == "processed"
+        ]
+        assert match == [2.0]
+
+    def test_recording_is_not_gated_by_master_switch(self):
+        assert not obs.enabled()
+        t = EngineTelemetry()
+        t.count("submitted")
+        assert t.snapshot()["submitted"] == 1
+
+    def test_retire_evicts_only_this_engines_series(self):
+        t1, t2 = EngineTelemetry(), EngineTelemetry()
+        t1.count("submitted", 3)
+        t1.observe_latency(0.01)
+        t2.count("submitted", 7)
+        t1.retire()
+        prom = obs.render_prometheus()
+        assert f'engine="{t1.engine_id}"' not in prom  # t1's series gone from scrapes
+        assert t2.snapshot()["submitted"] == 7  # t2 untouched
+        t1.count("submitted")  # recording after retire rematerialises, not raises
+        assert t1.snapshot()["submitted"] == 1
+
+
+class TestSharedJsonlWriter:
+    def test_tools_and_engine_share_one_writer(self):
+        import tools.jsonl_log as tools_jsonl
+
+        from metrics_tpu.obs import jsonl as obs_jsonl
+
+        # one source of truth: tools-side binding executes the SAME file
+        # (identity when metrics_tpu was already imported, file-loaded otherwise
+        # — either way co_filename pins the single implementation)
+        assert (
+            tools_jsonl.append_jsonl.__code__.co_filename
+            == obs_jsonl.append_jsonl.__code__.co_filename
+        )
+
+    def test_tools_writer_importable_without_jax(self):
+        import subprocess
+        import sys as _sys
+
+        repo = __file__.rsplit("/tests/", 1)[0]
+        code = (
+            "import sys; sys.path.insert(0, %r); "
+            "from tools.jsonl_log import append_jsonl; "
+            "assert 'jax' not in sys.modules, 'tools.jsonl_log must stay jax-free'"
+        ) % repo
+        subprocess.run([_sys.executable, "-c", code], check=True, timeout=120)
+
+    def test_emit_format_roundtrip(self, tmp_path):
+        path = str(tmp_path / "telemetry.jsonl")
+        t = EngineTelemetry()
+        t.count("submitted", 2)
+        record = t.emit(path, run="unit")
+        (line,) = [json.loads(line) for line in open(path)]
+        assert line["what"] == "engine_telemetry"
+        assert line["run"] == "unit"
+        assert line["submitted"] == 2
+        assert "utc" in line and "utc" in record
+
+    def test_writer_never_raises(self, tmp_path):
+        from metrics_tpu.obs.jsonl import append_jsonl
+
+        record = {"what": "x"}
+        append_jsonl(str(tmp_path / "no" / "such" / "dir" / "f.jsonl"), record)
+        assert "log_error" in record

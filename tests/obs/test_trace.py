@@ -1,0 +1,153 @@
+"""Tracer: span nesting, thread-local propagation, ring bounds, Chrome trace export."""
+
+import json
+import threading
+
+from metrics_tpu import obs
+from metrics_tpu.obs.trace import Tracer
+from metrics_tpu.obs.registry import OBS
+
+
+def _enabled_tracer(capacity=64):
+    OBS.enabled = True  # restored by the package conftest fixture
+    return Tracer(capacity=capacity)
+
+
+class TestSpans:
+    def test_disabled_span_is_shared_noop(self):
+        tracer = Tracer()
+        assert OBS.enabled is False
+        s1 = tracer.span("a")
+        s2 = tracer.span("b", k=1)
+        assert s1 is s2  # one shared null object: no allocation when disabled
+        with s1:
+            pass
+        assert tracer.total_recorded == 0
+
+    def test_nesting_records_parent(self):
+        tracer = _enabled_tracer()
+        with tracer.span("outer"):
+            assert tracer.current_span_name() == "outer"
+            with tracer.span("inner"):
+                assert tracer.current_span_name() == "inner"
+        spans = tracer.spans()
+        assert [(s["name"], s["parent"]) for s in spans] == [("inner", "outer"), ("outer", None)]
+        # inner is contained in outer
+        inner, outer = spans[0], spans[1]
+        assert outer["start_ns"] <= inner["start_ns"]
+        assert inner["start_ns"] + inner["dur_ns"] <= outer["start_ns"] + outer["dur_ns"]
+
+    def test_exception_annotates_and_propagates(self):
+        tracer = _enabled_tracer()
+        try:
+            with tracer.span("boom"):
+                raise ValueError("x")
+        except ValueError:
+            pass
+        (span,) = tracer.spans()
+        assert span["attrs"]["error"] == "ValueError"
+
+    def test_set_attr_mid_span(self):
+        tracer = _enabled_tracer()
+        with tracer.span("s") as span:
+            span.set_attr(rows=17)
+        assert tracer.spans()[0]["attrs"]["rows"] == 17
+
+    def test_threads_have_independent_context(self):
+        tracer = _enabled_tracer()
+        barrier = threading.Barrier(2)
+
+        def worker(name):
+            with tracer.span(name):
+                barrier.wait()  # both spans open simultaneously
+                with tracer.span(f"{name}.child"):
+                    pass
+
+        threads = [threading.Thread(target=worker, args=(f"t{i}",)) for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        parents = {s["name"]: s["parent"] for s in tracer.spans()}
+        assert parents["t0.child"] == "t0" and parents["t1.child"] == "t1"
+
+    def test_ring_overwrites_oldest_first(self):
+        tracer = _enabled_tracer(capacity=4)
+        for i in range(10):
+            with tracer.span(f"s{i}"):
+                pass
+        assert tracer.total_recorded == 10
+        assert [s["name"] for s in tracer.spans()] == ["s6", "s7", "s8", "s9"]
+
+
+class TestChromeTraceExport:
+    def _make_trace(self, tracer):
+        with tracer.span("phase", step=1):
+            with tracer.span("work"):
+                pass
+            with tracer.span("more_work"):
+                pass
+
+        def worker():
+            with tracer.span("bg"):
+                pass
+
+        t = threading.Thread(target=worker, name="bg-thread")
+        t.start()
+        t.join()
+
+    def test_export_is_valid_trace_event_json(self, tmp_path):
+        tracer = _enabled_tracer()
+        self._make_trace(tracer)
+        path = str(tmp_path / "trace.json")
+        doc = tracer.export_chrome_trace(path)
+        loaded = json.load(open(path))  # file round-trips as valid JSON
+        assert loaded == json.loads(json.dumps(doc))
+        events = loaded["traceEvents"]
+        assert events, "no events exported"
+        for ev in events:
+            assert ev["ph"] in ("X", "M")
+            assert isinstance(ev["pid"], int) and isinstance(ev["tid"], int)
+            if ev["ph"] == "X":
+                assert ev["ts"] >= 0 and ev["dur"] >= 0
+                assert ev["cat"] == "metrics_tpu"
+
+    def test_x_events_monotone_ts_and_complete(self):
+        tracer = _enabled_tracer()
+        self._make_trace(tracer)
+        events = tracer.export_chrome_trace()["traceEvents"]
+        xs = [ev for ev in events if ev["ph"] == "X"]
+        ts = [ev["ts"] for ev in xs]
+        assert ts == sorted(ts)  # monotone timestamps
+        # all spans are complete events — no dangling B without E by construction
+        assert {ev["name"] for ev in xs} == {"phase", "work", "more_work", "bg"}
+
+    def test_parent_attribution_and_thread_metadata(self):
+        tracer = _enabled_tracer()
+        self._make_trace(tracer)
+        events = tracer.export_chrome_trace()["traceEvents"]
+        by_name = {ev["name"]: ev for ev in events if ev["ph"] == "X"}
+        assert by_name["work"]["args"]["parent"] == "phase"
+        assert "parent" not in by_name["phase"]["args"]
+        metas = [ev for ev in events if ev["ph"] == "M" and ev["name"] == "thread_name"]
+        assert "bg-thread" in {ev["args"]["name"] for ev in metas}
+        assert by_name["bg"]["tid"] != by_name["phase"]["tid"]
+
+    def test_golden_structure(self):
+        """Deterministic (name, parent) sequence — the golden skeleton of the trace."""
+        tracer = _enabled_tracer()
+        with tracer.span("a"):
+            with tracer.span("b"):
+                with tracer.span("c"):
+                    pass
+            with tracer.span("d"):
+                pass
+        golden = [("c", "b"), ("b", "a"), ("d", "a"), ("a", None)]
+        assert [(s["name"], s["parent"]) for s in tracer.spans()] == golden
+
+    def test_export_through_package_api(self, tmp_path):
+        obs.enable()
+        with obs.span("pkg"):
+            pass
+        doc = obs.export_chrome_trace(str(tmp_path / "t.json"))
+        assert any(ev["name"] == "pkg" for ev in doc["traceEvents"])

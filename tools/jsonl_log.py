@@ -1,21 +1,42 @@
 """Shared append-only JSONL recording for the hardware-evidence tools.
 
-A single short O_APPEND write per record is atomic on POSIX, so overlapping
-watcher + manual runs interleave whole lines instead of racing a
-read-modify-write of one document. Recording must never break the run that is
-being recorded: failures are noted on the record itself instead of raised.
+The writer itself now lives in the installed package
+(``metrics_tpu/obs/jsonl.py``) so the library's own emitters
+(``EngineTelemetry.emit``, ``obs.Registry.emit``) and this repo tooling share
+ONE source of truth: one record format, one atomicity contract (a single short
+``O_APPEND`` write per record is atomic on POSIX, so overlapping watcher +
+manual runs interleave whole lines instead of racing a read-modify-write of one
+document; recording never raises — failures are noted on the record).
+
+This module stays as the tools-side import point (``from tools.jsonl_log
+import append_jsonl``). It deliberately does NOT ``import metrics_tpu`` — the
+package ``__init__`` pulls the whole jax import chain, and tool-side consumers
+like the ``run_tests_tpu.py`` chunk planner must stay light (no jax). Instead
+it reuses the already-imported module when present, else executes the writer
+module straight from its file.
 """
 
 from __future__ import annotations
 
-import json
-import time
+import importlib.util
+import os
+import sys
+
+_WRITER_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "metrics_tpu", "obs", "jsonl.py"
+)
 
 
-def append_jsonl(path: str, record: dict) -> None:
-    try:
-        record.setdefault("utc", time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
-        with open(path, "a") as fh:
-            fh.write(json.dumps(record) + "\n")
-    except Exception as exc:  # noqa: BLE001
-        record["log_error"] = repr(exc)
+def _load_writer():
+    mod = sys.modules.get("metrics_tpu.obs.jsonl")
+    if mod is not None:
+        return mod
+    spec = importlib.util.spec_from_file_location("_tools_metrics_tpu_obs_jsonl", _WRITER_PATH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+append_jsonl = _load_writer().append_jsonl
+
+__all__ = ["append_jsonl"]
