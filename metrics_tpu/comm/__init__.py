@@ -1,0 +1,111 @@
+"""metrics_tpu.comm — compressed, fault-tolerant collective sync.
+
+The single chokepoint for all state synchronisation in the library::
+
+    from metrics_tpu import comm
+
+    # opt large float cat-states into blockwise int8 on the wire
+    comm.configure(policy=comm.CodecPolicy(lossy="int8"))
+    # give multihost gathers a deadline + retry budget
+    comm.configure(timeout_s=30.0, max_retries=3)
+
+    engine.compute(k, sync=True)  # the engine's host sync rides the plane
+    comm.last_report()            # what it cost / whether it degraded
+    # Metric.sync() keeps the reference's leaf-level dist_sync_fn protocol —
+    # spans/accounting/transport come from the plane; codecs and the retry
+    # ladder apply to the pytree paths (sync_state_host, engine sync)
+
+Three layers (see docs/source/comm.md):
+
+- :mod:`~metrics_tpu.comm.codec` — how a leaf looks on the wire (lossless /
+  fp16 / blockwise int8), chosen per state by a dtype- and reduction-aware
+  :class:`CodecPolicy`;
+- :mod:`~metrics_tpu.comm.plan` — signature-cached transfer plans: coalesce
+  small fixed-shape leaves into one buffer per dtype, chunk big ones, route
+  ragged ``cat`` states through the pad-to-max (or exact-broadcast) protocol;
+- :mod:`~metrics_tpu.comm.transport` — who moves the buffers
+  (``multihost_utils``, an in-process :class:`LoopbackWorld`, or injected
+  fakes) and the failure vocabulary the retry → degradation ladder in
+  :mod:`~metrics_tpu.comm.plane` consumes.
+"""
+
+from metrics_tpu.comm.codec import (
+    Codec,
+    CodecPolicy,
+    EncodedLeaf,
+    Fp16Codec,
+    Int8BlockCodec,
+    LosslessCodec,
+    get_codec,
+    register_codec,
+)
+from metrics_tpu.comm.plan import TransferPlan, build_plan, clear_plan_cache, plan_cache_info
+from metrics_tpu.comm.plane import (
+    CommConfig,
+    SyncReport,
+    configure,
+    default_transport,
+    get_config,
+    last_report,
+    reduce_in_trace,
+    sync_pytree,
+    sync_pytree_in_trace,
+    sync_state,
+    sync_with_gather_fn,
+    use_config,
+)
+from metrics_tpu.comm.transport import (
+    DeadPeerTransport,
+    FlakyTransport,
+    LocalTransport,
+    LoopbackWorld,
+    MultihostTransport,
+    PeerLostError,
+    ReplicaFakeTransport,
+    ScriptedFakeTransport,
+    StallTransport,
+    Transport,
+    TransportError,
+    TransportTimeout,
+    gather_ragged,
+)
+
+__all__ = [
+    "Codec",
+    "CodecPolicy",
+    "CommConfig",
+    "DeadPeerTransport",
+    "EncodedLeaf",
+    "FlakyTransport",
+    "Fp16Codec",
+    "Int8BlockCodec",
+    "LocalTransport",
+    "LoopbackWorld",
+    "LosslessCodec",
+    "MultihostTransport",
+    "PeerLostError",
+    "ReplicaFakeTransport",
+    "ScriptedFakeTransport",
+    "StallTransport",
+    "SyncReport",
+    "TransferPlan",
+    "Transport",
+    "TransportError",
+    "TransportTimeout",
+    "build_plan",
+    "clear_plan_cache",
+    "configure",
+    "default_transport",
+    "gather_ragged",
+    "get_codec",
+    "get_config",
+    "last_report",
+    "plan_cache_info",
+    "reduce_in_trace",
+    "register_codec",
+    "sync_pytree",
+    "sync_pytree_in_trace",
+    "sync_state",
+    "sync_with_gather_fn",
+    "use_config",
+]

@@ -1,0 +1,427 @@
+"""Host transports: who moves the wire buffers, and what happens when they don't.
+
+A :class:`Transport` is the buffer-level boundary of the comm plane: it moves
+numpy arrays between processes and knows nothing about metric states, codecs,
+or plans. The contract is the classic same-shape ``allgather`` (every rank
+passes an identically-shaped array, gets back the per-rank list in rank order);
+transports that can also do per-rank exact-size ``broadcast_from`` advertise it
+with ``supports_broadcast`` so :func:`gather_ragged` can skip pad-to-max when
+padding would dominate the wire.
+
+Concrete transports:
+
+- :class:`LocalTransport` — world 1, identity. The single-process default.
+- :class:`MultihostTransport` — ``jax.experimental.multihost_utils`` over a
+  real multi-controller job (``process_allgather`` / ``broadcast_one_to_all``).
+- :class:`LoopbackWorld` — an in-process N-rank world over threads + barriers,
+  for protocol tests and fault rehearsal without a cluster.
+- :class:`ReplicaFakeTransport` / :class:`ScriptedFakeTransport` — single-caller
+  fakes: every peer mirrors the caller, or replies are scripted per call.
+- :class:`FlakyTransport` / :class:`StallTransport` / :class:`DeadPeerTransport`
+  — fault injectors wrapping any inner transport, for exercising the retry →
+  degradation ladder (Prime PCCL's failure taxonomy, arxiv 2505.14065).
+
+Failure vocabulary: :class:`TransportError` (transient collective failure),
+:class:`TransportTimeout` (a peer stalled past the deadline),
+:class:`PeerLostError` (membership broke — retrying the same world cannot
+succeed). The plane's ladder treats them uniformly except that a lost peer
+skips straight past same-step retries.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = [
+    "DeadPeerTransport",
+    "FlakyTransport",
+    "LocalTransport",
+    "LoopbackWorld",
+    "MultihostTransport",
+    "PeerLostError",
+    "ReplicaFakeTransport",
+    "ScriptedFakeTransport",
+    "StallTransport",
+    "Transport",
+    "TransportError",
+    "TransportTimeout",
+    "gather_ragged",
+]
+
+
+class TransportError(RuntimeError):
+    """A collective failed for a reason worth retrying (transient fabric/peer hiccup)."""
+
+
+class TransportTimeout(TransportError):
+    """A peer stalled past the configured deadline."""
+
+
+class PeerLostError(TransportError):
+    """Membership degraded — a peer is gone; retrying the same world cannot succeed."""
+
+
+class Transport:
+    """Buffer-level collective boundary. Same-shape allgather is the one requirement."""
+
+    name = "transport"
+    supports_broadcast = False
+
+    def world_size(self) -> int:
+        raise NotImplementedError
+
+    def allgather(self, x: np.ndarray) -> List[np.ndarray]:
+        """Every rank passes an identically-shaped array; returns rank-ordered rows."""
+        raise NotImplementedError
+
+    def broadcast_from(self, x: Optional[np.ndarray], root: int, shape: Any, dtype: Any) -> np.ndarray:
+        """Root's exact-size array to every rank (non-roots pass ``x=None``)."""
+        raise NotImplementedError(f"{self.name} does not support broadcast_from")
+
+
+class LocalTransport(Transport):
+    """World of one — every collective is the identity."""
+
+    name = "local"
+    supports_broadcast = True
+
+    def world_size(self) -> int:
+        return 1
+
+    def allgather(self, x: np.ndarray) -> List[np.ndarray]:
+        return [np.asarray(x)]
+
+    def broadcast_from(self, x: Optional[np.ndarray], root: int, shape: Any, dtype: Any) -> np.ndarray:
+        assert root == 0 and x is not None
+        return np.asarray(x)
+
+
+class MultihostTransport(Transport):
+    """The real thing: multi-controller JAX via ``multihost_utils``."""
+
+    name = "multihost"
+    supports_broadcast = True
+
+    def world_size(self) -> int:
+        import jax
+
+        return jax.process_count()
+
+    @property
+    def rank(self) -> int:
+        import jax
+
+        return jax.process_index()
+
+    def allgather(self, x: np.ndarray) -> List[np.ndarray]:
+        from jax.experimental import multihost_utils
+
+        gathered = np.asarray(multihost_utils.process_allgather(np.asarray(x)))
+        return [gathered[i] for i in range(self.world_size())]
+
+    def broadcast_from(self, x: Optional[np.ndarray], root: int, shape: Any, dtype: Any) -> np.ndarray:
+        import jax
+        from jax.experimental import multihost_utils
+
+        is_source = jax.process_index() == root
+        payload = np.asarray(x) if is_source else np.zeros(tuple(shape), np.dtype(dtype))
+        return np.asarray(multihost_utils.broadcast_one_to_all(payload, is_source=is_source))
+
+
+# --------------------------------------------------------------------- loopback world
+
+
+class LoopbackWorld:
+    """An in-process N-rank world: one transport per simulated rank, matched up
+    with barriers, so the *real* wire protocols (pad-to-max, exact broadcast,
+    plan execution) run end to end without a cluster.
+
+    Every rank must make the same sequence of collective calls; a rank that
+    falls behind past ``timeout`` breaks the barrier and every participant
+    raises :class:`TransportTimeout` instead of deadlocking.
+    """
+
+    def __init__(self, world: int, timeout: float = 30.0) -> None:
+        if world < 1:
+            raise ValueError("world must be >= 1")
+        self.world = world
+        self.timeout = timeout
+        self._deposit_barrier = threading.Barrier(world)
+        self._read_barrier = threading.Barrier(world)
+        self._slots: List[Optional[np.ndarray]] = [None] * world
+
+    def transport(self, rank: int) -> "_LoopbackTransport":
+        if not 0 <= rank < self.world:
+            raise ValueError(f"rank {rank} outside world {self.world}")
+        return _LoopbackTransport(self, rank)
+
+    def run(self, fns: Sequence[Callable[["_LoopbackTransport"], Any]]) -> List[Any]:
+        """Run one callable per rank (each given its transport); returns results
+        in rank order, re-raising the first per-rank exception."""
+        if len(fns) != self.world:
+            raise ValueError(f"need exactly {self.world} rank fns, got {len(fns)}")
+        results: List[Any] = [None] * self.world
+        errors: List[Optional[BaseException]] = [None] * self.world
+
+        def _runner(rank: int) -> None:
+            try:
+                results[rank] = fns[rank](self.transport(rank))
+            except BaseException as exc:  # noqa: BLE001 — propagated to the caller below
+                errors[rank] = exc
+                self._deposit_barrier.abort()
+                self._read_barrier.abort()
+
+        threads = [threading.Thread(target=_runner, args=(r,), daemon=True) for r in range(self.world)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(self.timeout * 4)
+        for exc in errors:
+            if exc is not None:
+                raise exc
+        return results
+
+    def _exchange(self, rank: int, x: Optional[np.ndarray]) -> List[Optional[np.ndarray]]:
+        self._slots[rank] = None if x is None else np.asarray(x)
+        try:
+            self._deposit_barrier.wait(self.timeout)
+            out = list(self._slots)
+            self._read_barrier.wait(self.timeout)
+        except threading.BrokenBarrierError:
+            self._deposit_barrier.abort()
+            self._read_barrier.abort()
+            raise TransportTimeout(f"loopback rank {rank}: a peer stalled or died mid-collective") from None
+        return out
+
+
+class _LoopbackTransport(Transport):
+    name = "loopback"
+    supports_broadcast = True
+
+    def __init__(self, world: LoopbackWorld, rank: int) -> None:
+        self._world = world
+        self.rank = rank
+
+    def world_size(self) -> int:
+        return self._world.world
+
+    def allgather(self, x: np.ndarray) -> List[np.ndarray]:
+        rows = self._world._exchange(self.rank, np.asarray(x))
+        if any(r is None for r in rows):
+            raise TransportError(f"loopback rank {self.rank}: a peer deposited nothing")
+        return [np.asarray(r) for r in rows]
+
+    def broadcast_from(self, x: Optional[np.ndarray], root: int, shape: Any, dtype: Any) -> np.ndarray:
+        rows = self._world._exchange(self.rank, x if self.rank == root else None)
+        got = rows[root]
+        if got is None:
+            raise TransportError(f"loopback rank {self.rank}: root {root} deposited nothing")
+        return np.asarray(got)
+
+
+# --------------------------------------------------------------------- test fakes
+
+
+class ReplicaFakeTransport(Transport):
+    """Every peer mirrors the caller — the cheapest way to fake world=N when
+    per-rank contents don't matter (sum → N·x, cat → x repeated N times)."""
+
+    name = "replica_fake"
+    supports_broadcast = True
+
+    def __init__(self, world: int) -> None:
+        self._world = int(world)
+        self.calls = 0
+
+    def world_size(self) -> int:
+        return self._world
+
+    def allgather(self, x: np.ndarray) -> List[np.ndarray]:
+        self.calls += 1
+        x = np.asarray(x)
+        return [x.copy() for _ in range(self._world)]
+
+    def broadcast_from(self, x: Optional[np.ndarray], root: int, shape: Any, dtype: Any) -> np.ndarray:
+        self.calls += 1
+        assert x is not None  # with mirrored peers the caller is every root
+        return np.asarray(x)
+
+
+class ScriptedFakeTransport(Transport):
+    """Replies scripted per call: ``script[i]`` is the rank-ordered row list the
+    i-th allgather returns (the caller's own row replaced by its live buffer)."""
+
+    name = "scripted_fake"
+
+    def __init__(self, world: int, script: Sequence[Sequence[np.ndarray]], rank: int = 0) -> None:
+        self._world = int(world)
+        self._script = [list(rows) for rows in script]
+        self._rank = rank
+        self.calls = 0
+
+    def world_size(self) -> int:
+        return self._world
+
+    def allgather(self, x: np.ndarray) -> List[np.ndarray]:
+        if self.calls >= len(self._script):
+            raise TransportError(f"scripted transport exhausted after {len(self._script)} calls")
+        rows = [np.asarray(r) for r in self._script[self.calls]]
+        rows[self._rank] = np.asarray(x)
+        self.calls += 1
+        return rows
+
+
+class FlakyTransport(Transport):
+    """Raise on the first ``fail`` collective calls, then delegate — the
+    transient-failure injector for retry tests."""
+
+    name = "flaky"
+
+    def __init__(self, inner: Transport, fail: int = 1, exc: Callable[[], Exception] = TransportError) -> None:
+        self._inner = inner
+        self._remaining = int(fail)
+        self._exc = exc
+        self.failures_injected = 0
+
+    @property
+    def supports_broadcast(self) -> bool:  # type: ignore[override]
+        return self._inner.supports_broadcast
+
+    @property
+    def rank(self) -> Optional[int]:
+        return getattr(self._inner, "rank", None)
+
+    def world_size(self) -> int:
+        return self._inner.world_size()
+
+    def _maybe_fail(self) -> None:
+        if self._remaining > 0:
+            self._remaining -= 1
+            self.failures_injected += 1
+            raise self._exc()
+
+    def allgather(self, x: np.ndarray) -> List[np.ndarray]:
+        self._maybe_fail()
+        return self._inner.allgather(x)
+
+    def broadcast_from(self, x: Optional[np.ndarray], root: int, shape: Any, dtype: Any) -> np.ndarray:
+        self._maybe_fail()
+        return self._inner.broadcast_from(x, root, shape, dtype)
+
+
+class StallTransport(Transport):
+    """Sleep ``stall_s`` before the first ``stalls`` collectives complete — what a
+    wedged peer looks like to the plane's deadline."""
+
+    name = "stall"
+
+    def __init__(self, inner: Transport, stall_s: float, stalls: int = 1) -> None:
+        self._inner = inner
+        self._stall_s = stall_s
+        self._remaining = int(stalls)
+
+    @property
+    def supports_broadcast(self) -> bool:  # type: ignore[override]
+        return self._inner.supports_broadcast
+
+    @property
+    def rank(self) -> Optional[int]:
+        return getattr(self._inner, "rank", None)
+
+    def world_size(self) -> int:
+        return self._inner.world_size()
+
+    def _maybe_stall(self) -> None:
+        if self._remaining > 0:
+            self._remaining -= 1
+            time.sleep(self._stall_s)
+
+    def allgather(self, x: np.ndarray) -> List[np.ndarray]:
+        self._maybe_stall()
+        return self._inner.allgather(x)
+
+    def broadcast_from(self, x: Optional[np.ndarray], root: int, shape: Any, dtype: Any) -> np.ndarray:
+        self._maybe_stall()
+        return self._inner.broadcast_from(x, root, shape, dtype)
+
+
+class DeadPeerTransport(Transport):
+    """Every collective fails with :class:`PeerLostError` — the bottom of the
+    ladder: membership is broken and only local state remains."""
+
+    name = "dead_peer"
+
+    def __init__(self, world: int = 2) -> None:
+        self._world = world
+
+    def world_size(self) -> int:
+        return self._world
+
+    def allgather(self, x: np.ndarray) -> List[np.ndarray]:
+        raise PeerLostError("peer left the membership")
+
+    def broadcast_from(self, x: Optional[np.ndarray], root: int, shape: Any, dtype: Any) -> np.ndarray:
+        raise PeerLostError("peer left the membership")
+
+
+# --------------------------------------------------------------------- ragged gather
+
+
+def _shape_vector(x: np.ndarray) -> np.ndarray:
+    return np.asarray(x.shape, dtype=np.int64) if x.ndim else np.zeros((0,), np.int64)
+
+
+def gather_ragged(
+    transport: Transport,
+    x: np.ndarray,
+    *,
+    rank: Optional[int] = None,
+    max_pad_ratio: float = 1.25,
+) -> List[np.ndarray]:
+    """Gather a possibly-ragged array from every rank, in rank order.
+
+    The reference protocol (torchmetrics ``gather_all_tensors``): gather shape
+    vectors first; equal shapes → one allgather; unequal → pad to the
+    elementwise max along every dim, gather, trim each rank back. Mixed ranks
+    (different ``ndim``) are a protocol error, as in the reference.
+
+    When the transport supports exact-size broadcast and pad-to-max would ship
+    more than ``max_pad_ratio``× the real payload, each rank broadcasts its
+    exact buffer instead — W rounds, zero pad bytes; the transfer planner leans
+    on this for heavily skewed ``cat`` states.
+    """
+    x = np.asarray(x)
+    world = transport.world_size()
+    if world == 1:
+        return [x]
+    shapes = transport.allgather(_shape_vector(x))
+    if any(s.shape != shapes[0].shape for s in shapes):
+        ranks = sorted({int(s.size) for s in shapes})
+        raise ValueError(
+            f"gather_ragged: mixed-rank shards (ndims {ranks}); the pad-to-max protocol "
+            "requires every process to contribute the same number of dimensions"
+        )
+    all_shapes = [tuple(int(d) for d in s) for s in shapes]
+    if all(s == all_shapes[0] for s in all_shapes):
+        return transport.allgather(x)
+    max_shape = tuple(max(s[d] for s in all_shapes) for d in range(len(all_shapes[0])))
+    total = sum(int(np.prod(s, dtype=np.int64)) for s in all_shapes)
+    padded_total = world * int(np.prod(max_shape, dtype=np.int64))
+    if rank is None:
+        rank = getattr(transport, "rank", None)
+    # exact-size broadcast needs to know which rank WE are (the root must pass
+    # its live buffer); without that, pad-to-max is the only correct protocol
+    if transport.supports_broadcast and rank is not None and total > 0 and padded_total > max_pad_ratio * total:
+        out = []
+        for r in range(world):
+            mine = r == rank
+            out.append(transport.broadcast_from(x if mine else None, r, all_shapes[r], x.dtype))
+        return out
+    pad = [(0, m - s) for m, s in zip(max_shape, x.shape)]
+    padded = np.pad(x, pad)
+    gathered = transport.allgather(padded)
+    return [np.asarray(gathered[i])[tuple(slice(0, d) for d in all_shapes[i])] for i in range(world)]

@@ -380,12 +380,15 @@ class StreamingEngine:
         return self._keyed.slot_for(key)
 
     def _sync_state(self, state: Any) -> Any:
+        # multi-host serving rides the comm plane (codecs, coalesced transfers,
+        # retry/degradation ladder) with its own site label so engine syncs are
+        # attributable separately from bare sync_state_host callers
         if isinstance(self._metric, MetricCollection):
             return {
-                name: sync_state_host(sub, self._metric._modules[name]._reductions)
+                name: sync_state_host(sub, self._metric._modules[name]._reductions, site="engine.compute")
                 for name, sub in state.items()
             }
-        return sync_state_host(state, self._metric._reductions)
+        return sync_state_host(state, self._metric._reductions, site="engine.compute")
 
     def _run(self) -> None:
         while True:

@@ -37,7 +37,7 @@ from jax import Array
 
 from metrics_tpu.obs import instrument as _obs
 from metrics_tpu.obs.registry import OBS as _OBS
-from metrics_tpu.parallel.sync import reduce_in_trace
+from metrics_tpu.comm import plane as _comm_plane
 from metrics_tpu.utils.data import (
     _flatten,
     _squeeze_if_scalar,
@@ -538,9 +538,11 @@ class Metric(ABC):
             if isinstance(input_dict[attr], list) and len(input_dict[attr]) >= 1:
                 input_dict[attr] = [dim_zero_cat(input_dict[attr])]
 
-        output_dict = apply_to_collection(
+        # the gather step rides the comm plane (spans + raw/wire accounting);
+        # dist_sync_fn keeps the reference leaf protocol, and the default
+        # gather_all_tensors runs on the configured comm transport underneath
+        output_dict = _comm_plane.gather_metric_leaves(
             input_dict,
-            jax.Array,
             dist_sync_fn,
             group=process_group or self.process_group,
         )
@@ -698,17 +700,8 @@ class Metric(ABC):
             return self._sync_state_impl(state, axis_name)
 
     def _sync_state_impl(self, state: Dict[str, Any], axis_name: Any) -> Dict[str, Any]:
-        synced = dict(state)
-        for name, reduction in self._reductions.items():
-            val = state[name]
-            if isinstance(val, list):
-                if not val:
-                    synced[name] = val
-                else:
-                    synced[name] = [reduce_in_trace(dim_zero_cat(val), "cat", axis_name)]
-            else:
-                synced[name] = reduce_in_trace(val, reduction, axis_name)
-        return synced
+        # one collective per state, emitted through the comm plane's traced path
+        return _comm_plane.sync_pytree_in_trace(state, self._reductions, axis_name)
 
     def jitted_update_state(self, donate: bool = True) -> Callable:
         """The pure updater compiled with (optionally) donated state buffers.

@@ -319,6 +319,78 @@ def record_traced_sync_bytes(site: str, metric: str, nbytes: int) -> None:
     SYNC_TRACED_BYTES.inc(nbytes, site=site, metric=metric)
 
 
+# ---------------------------------------------------------------------- comm plane
+
+COMM_RAW_BYTES = REGISTRY.counter(
+    "metrics_tpu_comm_raw_bytes_total",
+    "Cumulative pre-codec state bytes handed to the comm plane per sync site.",
+)
+COMM_WIRE_BYTES = REGISTRY.counter(
+    "metrics_tpu_comm_wire_bytes_total",
+    "Cumulative post-codec bytes this process actually put on the wire per sync site.",
+)
+COMM_RATIO = REGISTRY.gauge(
+    "metrics_tpu_comm_compression_ratio",
+    "raw/wire byte ratio of the most recent comm sync per site (1.0 = lossless passthrough).",
+)
+COMM_RETRIES = REGISTRY.counter(
+    "metrics_tpu_comm_retries_total",
+    "Comm-plane sync attempts re-issued after a transient transport failure, per site.",
+)
+COMM_TIMEOUTS = REGISTRY.counter(
+    "metrics_tpu_comm_timeouts_total",
+    "Comm-plane collectives that blew the configured deadline, per site.",
+)
+COMM_DEGRADATIONS = REGISTRY.counter(
+    "metrics_tpu_comm_degradations_total",
+    "Degradation-ladder rungs taken (step=lossless_only|local_state), per site.",
+)
+COMM_STALE = REGISTRY.gauge(
+    "metrics_tpu_comm_stale_state",
+    "1 while the most recent sync at this site served LOCAL state (ladder bottom), else 0.",
+)
+
+
+def record_comm_payload(site: str, raw_bytes: int, wire_bytes: int) -> None:
+    """Account one comm sync's pre-codec vs on-the-wire bytes (+ ratio gauge)."""
+    if not OBS.enabled:
+        return
+    COMM_RAW_BYTES.inc(raw_bytes, site=site)
+    COMM_WIRE_BYTES.inc(wire_bytes, site=site)
+    COMM_RATIO.set(raw_bytes / wire_bytes if wire_bytes else 1.0, site=site)
+
+
+def record_comm_retry(site: str) -> None:
+    if not OBS.enabled:
+        return
+    COMM_RETRIES.inc(1, site=site)
+
+
+def record_comm_timeout(site: str) -> None:
+    if not OBS.enabled:
+        return
+    COMM_TIMEOUTS.inc(1, site=site)
+
+
+def record_comm_degradation(site: str, step: str) -> None:
+    if not OBS.enabled:
+        return
+    COMM_DEGRADATIONS.inc(1, site=site, step=step)
+
+
+def set_comm_stale(site: str, stale: bool) -> None:
+    if not OBS.enabled:
+        return
+    COMM_STALE.set(1.0 if stale else 0.0, site=site)
+
+
+def comm_span(name: str, **attrs: Any) -> Any:
+    """Trace span for comm-plane internals (sync, gather, encode/decode)."""
+    if not OBS.enabled:
+        return _NULL_SPAN
+    return TRACER.span(name, **attrs)
+
+
 # ---------------------------------------------------------------------- engine hooks
 
 

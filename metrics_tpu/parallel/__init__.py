@@ -5,6 +5,6 @@ collective merge at compute. Here that maps onto ``jax.sharding.Mesh`` axes; met
 updates run inside ``shard_map``/``pjit`` and sync with XLA collectives over ICI/DCN.
 """
 
-from metrics_tpu.parallel.sync import in_trace, reduce_in_trace
+from metrics_tpu.parallel.sync import in_trace, reduce_in_trace, sync_state_host
 
-__all__ = ["in_trace", "reduce_in_trace"]
+__all__ = ["in_trace", "reduce_in_trace", "sync_state_host"]

@@ -4,68 +4,51 @@ Reference parity: src/torchmetrics/metric.py:365-395 (``_sync_dist``) +
 src/torchmetrics/utilities/distributed.py:99-148 (``gather_all_tensors``). The reference
 has exactly one collective (all_gather) and reduces the gathered stack in Python.
 
-TPU-native redesign (SURVEY §2.3/§5.8): reducible states never gather — ``sum/mean/max/
-min`` lower directly to ``lax.psum/pmax/pmin`` over named mesh axes (strictly less ICI
-traffic than gather-then-reduce: O(state) vs O(world·state)). Only ``cat``/``None``
+TPU-native redesign (SURVEY §2.3/§5.8): reducible states never gather — ``sum/mean/
+max/min`` lower directly to ``lax.psum/pmax/pmin`` over named mesh axes (strictly less
+ICI traffic than gather-then-reduce: O(state) vs O(world·state)). Only ``cat``/``None``
 states all_gather. Three execution contexts, one API:
 
-- **in-trace** (inside ``shard_map``/``pjit`` over a Mesh): ``sync_state(state, specs,
-  axis_name='dp')`` emits XLA collectives; this is how metric state fuses into a
-  training step.
+- **in-trace** (inside ``shard_map``/``pjit`` over a Mesh): ``reduce_in_trace`` emits
+  XLA collectives; this is how metric state fuses into a training step.
 - **host, single-controller**: states computed from globally-sharded arrays are already
   global — sync is the identity.
-- **host, multi-controller**: falls back to process-level gather
-  (:func:`metrics_tpu.utils.distributed.gather_all_tensors`) + reduction, mirroring the
-  reference protocol (incl. ragged pad-to-max).
+- **host, multi-controller**: :func:`sync_state_host` rides the comm plane
+  (:mod:`metrics_tpu.comm`): signature-cached transfer plans, per-state codecs,
+  coalesced/chunked collectives, and a timeout → retry → degradation ladder.
+
+Both entries are thin façades over :mod:`metrics_tpu.comm.plane` — the library-wide
+sync chokepoint — and keep their pre-comm signatures (``gather_fn`` /
+``distributed_available_fn`` stay injectable for tests and custom transports).
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+from typing import Any, Callable, Dict, Optional, Tuple, Union
 
 import jax
-import jax.numpy as jnp
-from jax import lax
 from jax import Array
 
+from metrics_tpu.comm import plane as _plane
 from metrics_tpu.obs import instrument as _obs
 from metrics_tpu.obs.registry import OBS as _OBS
 
 AxisName = Union[str, Tuple[str, ...]]
 
-# Reduction registry: maps dist_reduce_fx names to (in-trace collective, host-side stack reduce)
-_TRACE_REDUCERS: Dict[str, Callable[[Array, AxisName], Array]] = {
-    "sum": lambda x, ax: lax.psum(x, ax),
-    "mean": lambda x, ax: lax.pmean(x, ax),
-    "max": lambda x, ax: lax.pmax(x, ax),
-    "min": lambda x, ax: lax.pmin(x, ax),
-}
 
-
-def reduce_in_trace(x: Array, reduce_fx: Optional[str], axis_name: AxisName) -> Array:
+def reduce_in_trace(
+    x: Array, reduce_fx: Optional[str], axis_name: AxisName, codec: Any = None
+) -> Array:
     """Apply one state reduction as an XLA collective over ``axis_name``.
 
     ``cat``/``None`` → ``all_gather`` (tiled for cat: shards concatenate along dim 0,
-    matching the reference's dim-0 cat of the gathered list).
+    matching the reference's dim-0 cat of the gathered list). Pass ``codec="int8"``
+    (or ``"fp16"``) to move gather-style payloads quantized through the collective
+    and dequantize on the far side — see :func:`metrics_tpu.comm.plane.reduce_in_trace`.
     """
-    if _OBS.enabled:
-        # trace-time payload accounting: this body runs once per compile, so the
-        # recorded bytes price what each EXECUTION of the collective moves per
-        # participant (tree_nbytes prices tracers from shape × itemsize); kept in
-        # the dedicated per-compile counter, NOT the per-call host counter
-        _obs.record_traced_sync_bytes(
-            "reduce_in_trace", str(reduce_fx) if not callable(reduce_fx) else "callable", _obs.tree_nbytes(x)
-        )
-    if reduce_fx in _TRACE_REDUCERS:
-        return _TRACE_REDUCERS[reduce_fx](x, axis_name)
-    if reduce_fx == "cat":
-        return lax.all_gather(x, axis_name, axis=0, tiled=True)
-    if reduce_fx is None:
-        return lax.all_gather(x, axis_name, axis=0)  # stack: (world, ...)
-    if callable(reduce_fx):
-        gathered = lax.all_gather(x, axis_name, axis=0)
-        return reduce_fx(gathered)
-    raise ValueError(f"Unsupported dist_reduce_fx inside trace: {reduce_fx!r}")
+    # obs trace-time payload accounting happens inside the plane (once per
+    # compile, in the dedicated per-compile counter)
+    return _plane.reduce_in_trace(x, reduce_fx, axis_name, codec=codec)
 
 
 def in_trace(x: Any) -> bool:
@@ -77,53 +60,45 @@ def sync_state_host(
     reductions: Dict[str, Any],
     gather_fn: Optional[Callable] = None,
     distributed_available_fn: Optional[Callable] = None,
+    *,
+    transport: Optional[Any] = None,
+    config: Optional[Any] = None,
+    site: str = "sync_state_host",
 ) -> Dict[str, Any]:
     """Host-level all-reduce of a functional state pytree across JAX processes.
 
     The serving-engine analogue of ``Metric._sync_dist``: the engine holds state as
     explicit pytrees (never inside a ``Metric`` instance), so its ``compute(key)``
-    syncs here instead — gather every reducible leaf with
-    :func:`metrics_tpu.utils.distributed.gather_all_tensors`, then apply the state's
-    registered reduction. ``_update_count`` always sums (each process counted its own
-    updates). Single-process (the common case, and every CPU-mesh test) is the
-    identity. ``gather_fn`` / ``distributed_available_fn`` are injectable for tests
-    and for custom transport.
+    syncs here instead. Single-process (the common case, and every CPU-mesh test)
+    is the identity.
+
+    Two routes, both through :mod:`metrics_tpu.comm.plane`:
+
+    - ``gather_fn`` injected → the leaf-at-a-time reference protocol
+      (:func:`~metrics_tpu.comm.plane.sync_with_gather_fn`); no codecs — an
+      injected gather returns decoded peer tensors.
+    - otherwise → the planned path (:func:`~metrics_tpu.comm.plane.sync_pytree`):
+      cached plan, policy codecs, coalesced collectives, retry/degradation ladder.
+      ``transport``/``config`` override the process-wide ``comm.configure`` state.
+
+    ``_update_count`` always sums (each process counted its own updates) — exactly
+    once, even when a caller also lists it in ``reductions``.
     """
-    from metrics_tpu.utils.data import dim_zero_cat
-    from metrics_tpu.utils.distributed import distributed_available, gather_all_tensors
+    from metrics_tpu.utils.distributed import distributed_available
 
-    is_distributed = (distributed_available_fn or distributed_available)()
-    if not is_distributed:
-        return state
-    gather = gather_fn or gather_all_tensors
+    if gather_fn is not None:
+        if not (distributed_available_fn or distributed_available)():
+            return state
+        if _OBS.enabled:
+            _obs.record_sync_bytes(site, "state_pytree", _obs.tree_nbytes(state))
+        return _plane.sync_with_gather_fn(state, reductions, gather_fn, site=site)
 
+    cfg = config or _plane.get_config()
+    tr = transport or cfg.transport
+    if tr is None:
+        if not (distributed_available_fn or distributed_available)():
+            return state
+        tr = _plane.default_transport()
     if _OBS.enabled:
-        _obs.record_sync_bytes("sync_state_host", "state_pytree", _obs.tree_nbytes(state))
-
-    synced = dict(state)
-    for name, reduction in reductions.items():
-        val = state[name]
-        if isinstance(val, list):
-            if not val:
-                continue
-            gathered = gather(dim_zero_cat(val))
-            synced[name] = [dim_zero_cat(gathered)]
-            continue
-        gathered = jnp.stack(gather(jnp.asarray(val)))
-        if reduction == "sum":
-            synced[name] = jnp.sum(gathered, axis=0)
-        elif reduction == "mean":
-            synced[name] = jnp.mean(gathered, axis=0)
-        elif reduction == "max":
-            synced[name] = jnp.max(gathered, axis=0)
-        elif reduction == "min":
-            synced[name] = jnp.min(gathered, axis=0)
-        elif reduction == "cat":
-            synced[name] = jnp.concatenate(list(gathered), axis=0)
-        elif callable(reduction):
-            synced[name] = reduction(gathered)
-        else:  # None: stack, matching reduce_in_trace's all_gather
-            synced[name] = gathered
-    if "_update_count" in state:
-        synced["_update_count"] = jnp.sum(jnp.stack(gather(jnp.asarray(state["_update_count"]))), axis=0)
-    return synced
+        _obs.record_sync_bytes(site, "state_pytree", _obs.tree_nbytes(state))
+    return _plane.sync_pytree(state, reductions, transport=tr, config=cfg, site=site)

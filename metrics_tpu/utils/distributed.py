@@ -17,7 +17,7 @@ torch.distributed, reduce afterwards in Python) becomes, in order of preference:
 
 from __future__ import annotations
 
-from typing import Any, Callable, List, Optional
+from typing import Any, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -65,37 +65,27 @@ def distributed_available() -> bool:
         return False
 
 
-def gather_all_tensors(result: Array, group: Optional[Any] = None) -> List[Array]:
+def gather_all_tensors(result: Array, group: Optional[Any] = None, *, transport: Optional[Any] = None) -> List[Array]:
     """Gather a tensor from every process into a list (reference :93-148).
 
-    Cross-process host-level gather for multi-controller JAX. Handles uneven first-dim
-    shapes with the reference's pad-to-max + trim protocol. On a single process this is
-    a cheap identity wrap.
+    Cross-process host-level gather for multi-controller JAX, routed through the
+    comm plane's transport layer (:func:`metrics_tpu.comm.transport.gather_ragged`):
+    shapes gather first, then one allgather for equal shapes or the reference's
+    pad-to-max + trim protocol for ragged first dims (exact-size broadcast when
+    the transport supports it and padding would dominate). Mixed-rank shards
+    raise — same constraint as the reference protocol. On a single process this
+    is a cheap identity wrap. ``transport`` is injectable for tests and custom
+    fabrics; the default is the process-wide comm transport.
     """
-    if not distributed_available():
-        return [jnp.asarray(result)]
+    from metrics_tpu.comm import plane as _plane
+    from metrics_tpu.comm.transport import gather_ragged
 
-    from jax.experimental import multihost_utils
-
-    result = jnp.asarray(result)
-    world = jax.process_count()
-    # gather shapes first (same protocol as reference :126-142)
-    local_shape = np.asarray(result.shape, dtype=np.int64) if result.ndim else np.zeros((0,), np.int64)
-    all_shapes = multihost_utils.process_allgather(local_shape)  # (world, ndim)
-    all_shapes = [tuple(int(d) for d in s) for s in np.asarray(all_shapes)]
-    if all(s == all_shapes[0] for s in all_shapes):
-        gathered = multihost_utils.process_allgather(result)  # (world, ...)
-        return [jnp.asarray(gathered[i]) for i in range(world)]
-    # uneven: pad to max along every dim, gather, trim
-    max_shape = tuple(max(s[d] for s in all_shapes) for d in range(len(all_shapes[0])))
-    pad = [(0, m - s) for m, s in zip(max_shape, result.shape)]
-    padded = jnp.pad(result, pad)
-    gathered = multihost_utils.process_allgather(padded)
-    out = []
-    for i in range(world):
-        slices = tuple(slice(0, d) for d in all_shapes[i])
-        out.append(jnp.asarray(gathered[i])[slices])
-    return out
+    if transport is None:
+        if not distributed_available():
+            return [jnp.asarray(result)]
+        transport = _plane.get_config().transport or _plane.default_transport()
+    rows = gather_ragged(transport, np.asarray(result), rank=getattr(transport, "rank", None))
+    return [jnp.asarray(r) for r in rows]
 
 
 def default_dist_sync_fn(result: Array, group: Optional[Any] = None) -> List[Array]:

@@ -1,0 +1,20 @@
+"""Per-test isolation for comm-plane process-global state (config, plan cache, obs)."""
+
+import pytest
+
+from metrics_tpu import comm, obs
+from metrics_tpu.comm import plane as comm_plane
+
+
+@pytest.fixture(autouse=True)
+def _comm_isolation():
+    """Restore the default comm config, clear the plan cache, and reset obs
+    around every test so configure()/quantization leaks can't cross tests."""
+    prev = comm_plane.configure()  # no-op replace, captures current
+    comm_plane._CONFIG = comm_plane.CommConfig()
+    comm.clear_plan_cache()
+    obs.reset()
+    yield
+    comm_plane._CONFIG = prev
+    comm.clear_plan_cache()
+    obs.reset()

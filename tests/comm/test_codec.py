@@ -1,0 +1,127 @@
+"""Codec round trips, documented error bounds, and policy routing."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from metrics_tpu.comm.codec import (
+    CodecPolicy,
+    Fp16Codec,
+    Int8BlockCodec,
+    LosslessCodec,
+    get_codec,
+)
+
+
+def _cases(seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        rng.standard_normal(5000).astype(np.float32),
+        (rng.standard_normal((33, 7)) * 1e4).astype(np.float32),
+        np.zeros(2048, np.float32),
+        np.asarray(3.25, np.float32),  # 0-d
+        np.zeros((0,), np.float32),  # empty
+        rng.standard_normal(1023).astype(np.float32),  # non-multiple of block
+    ]
+
+
+class TestLossless:
+    @pytest.mark.parametrize("x", _cases(), ids=lambda x: f"shape={x.shape}")
+    def test_bit_identical_roundtrip(self, x):
+        c = LosslessCodec()
+        enc = c.encode(x)
+        dec = c.decode(enc)
+        assert dec.dtype == x.dtype and dec.shape == x.shape
+        np.testing.assert_array_equal(dec, x)
+        assert enc.wire_nbytes == enc.raw_nbytes
+
+    def test_int_dtypes_roundtrip(self):
+        c = LosslessCodec()
+        for dtype in (np.int32, np.int64, np.bool_, np.uint8):
+            x = np.arange(17).astype(dtype)
+            np.testing.assert_array_equal(c.decode(c.encode(x)), x)
+
+
+class TestFp16:
+    def test_error_bound_normal_range(self):
+        rng = np.random.default_rng(1)
+        x = (rng.standard_normal(8192) * 100).astype(np.float32)
+        c = Fp16Codec()
+        dec = c.decode(c.encode(x))
+        # documented: rel error <= 2^-11 in fp16 normal range (+ subnormal quantum)
+        assert np.all(np.abs(dec - x) <= 2.0**-11 * np.abs(x) + 2.0**-24)
+
+    def test_wire_is_half(self):
+        x = np.ones(1000, np.float32)
+        enc = Fp16Codec().encode(x)
+        assert enc.wire_nbytes * 2 == enc.raw_nbytes
+
+
+class TestInt8Block:
+    @pytest.mark.parametrize("block", [16, 256, 1024])
+    @pytest.mark.parametrize("x", _cases(), ids=lambda x: f"shape={x.shape}")
+    def test_documented_error_bound(self, x, block):
+        c = Int8BlockCodec(block=block)
+        enc = c.encode(x)
+        dec = c.decode(enc)
+        assert dec.shape == x.shape and dec.dtype == x.dtype
+        flat = x.astype(np.float32).ravel()
+        n = flat.size
+        if n == 0:
+            return
+        padded = np.zeros(((n + block - 1) // block) * block, np.float32)
+        padded[:n] = flat
+        absmax = np.abs(padded.reshape(-1, block)).max(axis=1)
+        bound = np.repeat(absmax / 254.0, block)[:n]
+        err = np.abs(dec.astype(np.float32).ravel() - flat)
+        assert np.all(err <= bound + 1e-7), f"max excess {np.max(err - bound)}"
+
+    def test_all_zero_block_exact(self):
+        c = Int8BlockCodec(block=64)
+        x = np.zeros(130, np.float32)
+        np.testing.assert_array_equal(c.decode(c.encode(x)), x)
+
+    def test_wire_shrinks_4x_ish(self):
+        x = np.random.default_rng(2).standard_normal(1 << 16).astype(np.float32)
+        enc = Int8BlockCodec(block=1024).encode(x)
+        ratio = enc.raw_nbytes / enc.wire_nbytes
+        assert 3.8 <= ratio <= 4.0  # 1B codes + 4B/1024 scales
+
+    def test_payload_specs_match_encode(self):
+        c = Int8BlockCodec(block=128)
+        for x in _cases():
+            enc = c.encode(x)
+            specs = c.payload_specs(tuple(x.shape), x.dtype)
+            assert [(tuple(p.shape), p.dtype) for p in enc.payloads] == [
+                (s, d) for s, d in specs
+            ]
+
+    def test_registry_aliases(self):
+        assert get_codec("int8") is get_codec("int8x1024")
+        with pytest.raises(KeyError):
+            get_codec("zstd")
+
+
+class TestPolicy:
+    def test_default_is_all_lossless(self):
+        p = CodecPolicy()
+        assert p.choose("preds", "cat", np.float32, 1 << 20) == "lossless"
+
+    def test_lossy_routes_large_float_cat_only(self):
+        p = CodecPolicy(lossy="int8", min_bytes=4096)
+        assert p.choose("preds", "cat", np.float32, 1 << 20) == "int8"
+        assert p.choose("preds", None, np.float32, 1 << 20) == "int8"
+        # counts / ints / small / reducible stay lossless
+        assert p.choose("_update_count", "sum", np.int32, 1 << 20) == "lossless"
+        assert p.choose("tp", "sum", np.int64, 1 << 20) == "lossless"
+        assert p.choose("preds", "cat", np.float32, 100) == "lossless"
+        assert p.choose("total", "sum", np.float32, 1 << 20) == "lossless"
+
+    def test_quantize_reducible_opt_in(self):
+        p = CodecPolicy(lossy="fp16", quantize_reducible=True)
+        assert p.choose("total", "sum", np.float32, 1 << 20) == "fp16"
+
+    def test_all_lossless_ladder_step(self):
+        p = CodecPolicy(lossy="int8")
+        assert p.all_lossless().choose("preds", "cat", np.float32, 1 << 20) == "lossless"

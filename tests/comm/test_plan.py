@@ -1,0 +1,113 @@
+"""Transfer planner: routing, coalescing, chunking, and the signature cache."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.comm import (
+    CodecPolicy,
+    ReplicaFakeTransport,
+    build_plan,
+    clear_plan_cache,
+    plan_cache_info,
+    sync_pytree,
+)
+
+
+def _state():
+    return {
+        "tp": jnp.zeros(10, jnp.int32),
+        "fp": jnp.zeros(10, jnp.int32),
+        "total": jnp.asarray(0.0),
+        "preds": jnp.zeros((6, 2)),
+        "_update_count": jnp.asarray(0),
+    }
+
+
+_REDS = {"tp": "sum", "fp": "sum", "total": "sum", "preds": "cat"}
+
+
+class TestRouting:
+    def test_reducible_coalesces_ragged_gathers(self):
+        plan = build_plan(_state(), _REDS, CodecPolicy())
+        routes = {lf.name: lf.route for lf in plan.leaves}
+        assert routes == {
+            "tp": "coalesce",
+            "fp": "coalesce",
+            "total": "coalesce",
+            "preds": "ragged",
+            "_update_count": "coalesce",
+        }
+        assert plan.has_update_count_extra
+
+    def test_one_buffer_per_wire_dtype(self):
+        plan = build_plan(_state(), _REDS, CodecPolicy())
+        dtypes = sorted(b.dtype for b in plan.buffers)
+        # tp/fp/_update_count share the int32 buffer; total gets the float32 one
+        assert dtypes == ["float32", "int32"]
+        int_buf = next(b for b in plan.buffers if b.dtype == "int32")
+        assert [s.leaf for s in int_buf.slots] == ["tp", "fp", "_update_count"]
+        assert int_buf.total == 21
+
+    def test_coalesce_off_means_buffer_per_leaf(self):
+        plan = build_plan(_state(), _REDS, CodecPolicy(), coalesce=False)
+        assert len([b for b in plan.buffers]) == 4  # tp, fp, total, _update_count
+
+    def test_empty_list_state_skips(self):
+        state = {"vals": [], "_update_count": jnp.asarray(0)}
+        plan = build_plan(state, {"vals": "cat"}, CodecPolicy())
+        assert [lf.route for lf in plan.leaves] == ["skip", "coalesce"]
+
+    def test_callable_and_none_reductions_go_ragged(self):
+        state = {"a": jnp.zeros(4), "b": jnp.zeros(4)}
+        plan = build_plan(state, {"a": lambda g: g.sum(0), "b": None}, CodecPolicy())
+        assert all(lf.route == "ragged" for lf in plan.leaves)
+
+
+class TestChunking:
+    def test_large_buffer_splits_to_chunk_bytes(self):
+        state = {"big": jnp.zeros(1000, jnp.float32)}
+        plan = build_plan(state, {"big": "sum"}, CodecPolicy(), chunk_bytes=1024)
+        buf = plan.buffers[0]
+        assert len(buf.chunks) == 4  # 4000B / 1024B → 256-elem chunks
+        assert buf.chunks[0] == (0, 256) and buf.chunks[-1] == (768, 1000)
+
+    def test_chunked_sync_still_correct(self):
+        state = {"big": jnp.arange(1000, dtype=jnp.float32), "_update_count": jnp.asarray(1)}
+        tr = ReplicaFakeTransport(3)
+        from metrics_tpu.comm import CommConfig
+
+        out = sync_pytree(state, {"big": "sum"}, transport=tr, config=CommConfig(chunk_bytes=1024))
+        np.testing.assert_array_equal(np.asarray(out["big"]), np.arange(1000) * 3.0)
+        assert tr.calls >= 4  # one collective per chunk (+ _update_count buffer)
+
+
+class TestCache:
+    def test_same_signature_hits(self):
+        clear_plan_cache()
+        p1 = build_plan(_state(), _REDS, CodecPolicy())
+        p2 = build_plan(_state(), _REDS, CodecPolicy())
+        assert p1 is p2
+        info = plan_cache_info()
+        assert info["hits"] == 1 and info["misses"] == 1
+
+    def test_shape_change_misses(self):
+        clear_plan_cache()
+        build_plan(_state(), _REDS, CodecPolicy())
+        other = _state()
+        other["preds"] = jnp.zeros((9, 2))
+        build_plan(other, _REDS, CodecPolicy())
+        assert plan_cache_info()["misses"] == 2
+
+    def test_policy_change_misses(self):
+        clear_plan_cache()
+        build_plan(_state(), _REDS, CodecPolicy())
+        build_plan(_state(), _REDS, CodecPolicy(lossy="int8", min_bytes=1))
+        assert plan_cache_info()["misses"] == 2
+
+    def test_lossy_policy_changes_leaf_codec(self):
+        plan = build_plan(_state(), _REDS, CodecPolicy(lossy="int8", min_bytes=1))
+        by_name = {lf.name: lf.codec_name for lf in plan.leaves}
+        assert by_name["preds"].startswith("int8")
+        assert by_name["tp"] == "lossless" and by_name["_update_count"] == "lossless"

@@ -1,0 +1,149 @@
+"""Transports: loopback world semantics, fault injectors, ragged protocols."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from metrics_tpu.comm.transport import (
+    DeadPeerTransport,
+    FlakyTransport,
+    LocalTransport,
+    LoopbackWorld,
+    PeerLostError,
+    ReplicaFakeTransport,
+    ScriptedFakeTransport,
+    StallTransport,
+    TransportError,
+    TransportTimeout,
+    gather_ragged,
+)
+
+
+class TestLoopbackWorld:
+    def test_allgather_rank_order(self):
+        world = LoopbackWorld(3)
+        out = world.run([lambda t: t.allgather(np.full(2, t.rank)) for _ in range(3)])
+        for rows in out:
+            assert [int(r[0]) for r in rows] == [0, 1, 2]
+
+    def test_broadcast_from_each_root(self):
+        world = LoopbackWorld(2)
+
+        def fn(t):
+            got = []
+            for root in range(2):
+                x = np.asarray([t.rank * 10.0]) if t.rank == root else None
+                got.append(float(t.broadcast_from(x, root, (1,), np.float32)[0]))
+            return got
+
+        assert world.run([fn, fn]) == [[0.0, 10.0], [0.0, 10.0]]
+
+    def test_straggler_breaks_barrier_not_deadlock(self):
+        world = LoopbackWorld(2, timeout=0.2)
+
+        def fast(t):
+            return t.allgather(np.zeros(1))
+
+        def dead(t):
+            time.sleep(1.0)
+            return None
+
+        with pytest.raises(TransportTimeout):
+            world.run([fast, dead])
+
+
+class TestFaultInjectors:
+    def test_flaky_fails_then_recovers(self):
+        tr = FlakyTransport(ReplicaFakeTransport(2), fail=2)
+        for _ in range(2):
+            with pytest.raises(TransportError):
+                tr.allgather(np.zeros(1))
+        assert len(tr.allgather(np.zeros(1))) == 2
+        assert tr.failures_injected == 2
+
+    def test_stall_then_delegate(self):
+        tr = StallTransport(ReplicaFakeTransport(2), stall_s=0.05, stalls=1)
+        t0 = time.perf_counter()
+        tr.allgather(np.zeros(1))
+        assert time.perf_counter() - t0 >= 0.05
+        t0 = time.perf_counter()
+        tr.allgather(np.zeros(1))
+        assert time.perf_counter() - t0 < 0.05
+
+    def test_dead_peer_always_raises(self):
+        with pytest.raises(PeerLostError):
+            DeadPeerTransport(2).allgather(np.zeros(1))
+
+    def test_scripted_replies_in_order(self):
+        tr = ScriptedFakeTransport(2, [[np.zeros(1), np.ones(1)]])
+        rows = tr.allgather(np.full(1, 7.0))
+        assert float(rows[0][0]) == 7.0 and float(rows[1][0]) == 1.0
+        with pytest.raises(TransportError):
+            tr.allgather(np.zeros(1))
+
+
+class TestGatherRagged:
+    def test_world_one_identity(self):
+        x = np.arange(3.0)
+        (row,) = gather_ragged(LocalTransport(), x)
+        np.testing.assert_array_equal(row, x)
+
+    def test_equal_shapes_one_collective(self):
+        tr = ReplicaFakeTransport(4)
+        rows = gather_ragged(tr, np.arange(6.0).reshape(2, 3))
+        assert len(rows) == 4 and tr.calls == 2  # shapes + payload
+
+    def test_ragged_pad_trim_loopback(self):
+        shards = [np.arange(6.0).reshape(3, 2), np.arange(2.0).reshape(1, 2)]
+        world = LoopbackWorld(2)
+        out = world.run(
+            [lambda t, r=r: gather_ragged(t, shards[r], rank=t.rank) for r in range(2)]
+        )
+        for rows in out:
+            for r in range(2):
+                np.testing.assert_array_equal(rows[r], shards[r])
+
+    def test_fault_wrappers_preserve_exact_broadcast(self):
+        # regression: Flaky/Stall must forward the inner rank, or the exact
+        # protocol would see rank=None and every rank would broadcast nothing
+        shards = [np.arange(1000.0), np.arange(10.0)]
+        world = LoopbackWorld(2)
+        out = world.run(
+            [
+                lambda t, r=r: gather_ragged(
+                    FlakyTransport(StallTransport(t, stall_s=0.0), fail=0), shards[r], max_pad_ratio=1.25
+                )
+                for r in range(2)
+            ]
+        )
+        for rows in out:
+            for r in range(2):
+                np.testing.assert_array_equal(rows[r], shards[r])
+
+    def test_rankless_transport_falls_back_to_pad(self):
+        # a transport that claims broadcast but exposes no rank must still
+        # round-trip (pad-to-max path) instead of broadcasting x=None
+        class RanklessReplica(ReplicaFakeTransport):
+            rank = None
+
+        rows = gather_ragged(RanklessReplica(3), np.arange(5.0), max_pad_ratio=1.0)
+        for r in rows:
+            np.testing.assert_array_equal(r, np.arange(5.0))
+
+    def test_exact_broadcast_on_heavy_skew(self):
+        # skew > max_pad_ratio: the protocol switches to per-rank exact broadcast
+        shards = [np.arange(100.0), np.arange(10.0)]
+        world = LoopbackWorld(2)
+        out = world.run(
+            [
+                lambda t, r=r: gather_ragged(t, shards[r], rank=t.rank, max_pad_ratio=1.25)
+                for r in range(2)
+            ]
+        )
+        for rows in out:
+            for r in range(2):
+                np.testing.assert_array_equal(rows[r], shards[r])

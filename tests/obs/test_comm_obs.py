@@ -1,0 +1,86 @@
+"""Comm series exposure: Prometheus rendering + jsonl emitter (ISSUE 3 satellite).
+
+The comm plane's counters/gauges must surface through the same two exits as
+the rest of the stack: ``obs.render_prometheus()`` (scrape) and
+``Registry.emit`` (jsonl) — including the compression-ratio gauge, snapshot-
+tested here against a quantized fake-world sync.
+"""
+
+from __future__ import annotations
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import comm, obs
+from metrics_tpu.comm import CodecPolicy, CommConfig, DeadPeerTransport, ReplicaFakeTransport
+
+from tests.obs.prom_grammar import parse as parse_prometheus
+
+
+@pytest.fixture
+def quantized_sync_done():
+    obs.enable()
+    state = {
+        "preds": jnp.asarray(np.random.default_rng(0).standard_normal(8192), jnp.float32),
+        "_update_count": jnp.asarray(1),
+    }
+    cfg = CommConfig(policy=CodecPolicy(lossy="int8"), max_retries=0, backoff_base_s=0.001)
+    comm.sync_pytree(state, {"preds": "cat"}, transport=ReplicaFakeTransport(2), config=cfg, site="obs.test")
+    comm.sync_pytree(state, {"preds": "cat"}, transport=DeadPeerTransport(2), config=cfg, site="obs.dead")
+    return comm.last_report()
+
+
+class TestPrometheusExposure:
+    def test_comm_series_render(self, quantized_sync_done):
+        text = obs.render_prometheus()
+        parse_prometheus(text)  # grammar-valid exposition
+        for family in (
+            "metrics_tpu_comm_raw_bytes_total",
+            "metrics_tpu_comm_wire_bytes_total",
+            "metrics_tpu_comm_compression_ratio",
+            "metrics_tpu_comm_degradations_total",
+            "metrics_tpu_comm_stale_state",
+        ):
+            assert f"# TYPE {family}" in text, family
+        assert 'metrics_tpu_comm_compression_ratio{site="obs.test"}' in text
+        assert 'metrics_tpu_comm_degradations_total{site="obs.dead",step="local_state"} 1' in text
+        assert 'metrics_tpu_comm_stale_state{site="obs.dead"} 1' in text
+
+    def test_ratio_value_matches_report(self, quantized_sync_done):
+        from metrics_tpu.obs.instrument import COMM_RATIO, COMM_RAW_BYTES, COMM_WIRE_BYTES
+
+        ratio = COMM_RATIO.value(site="obs.test")
+        raw = COMM_RAW_BYTES.value(site="obs.test")
+        wire = COMM_WIRE_BYTES.value(site="obs.test")
+        assert ratio == pytest.approx(raw / wire)
+        assert ratio > 3.0  # int8 on a large fp32 cat state
+
+
+class TestJsonlExposure:
+    def test_emit_includes_compression_ratio_gauge(self, quantized_sync_done, tmp_path):
+        path = str(tmp_path / "registry.jsonl")
+        obs.emit(path, run="comm-snapshot-test")
+        lines = [json.loads(ln) for ln in open(path)]
+        assert len(lines) == 1
+        record = lines[0]
+        assert record["what"] == "obs_registry" and record["run"] == "comm-snapshot-test"
+        reg = record["registry"]
+        ratio_family = reg["metrics_tpu_comm_compression_ratio"]
+        assert ratio_family["type"] == "gauge"
+        values = ratio_family["values"]
+        assert "site=obs.test" in values
+        assert values["site=obs.test"] == pytest.approx(
+            reg["metrics_tpu_comm_raw_bytes_total"]["values"]["site=obs.test"]
+            / reg["metrics_tpu_comm_wire_bytes_total"]["values"]["site=obs.test"]
+        )
+        # the degraded site is visible in the same snapshot
+        assert reg["metrics_tpu_comm_stale_state"]["values"]["site=obs.dead"] == 1
+
+    def test_snapshot_shape_stable(self, quantized_sync_done):
+        snap = obs.snapshot()
+        fam = snap["metrics_tpu_comm_compression_ratio"]
+        assert set(fam) == {"type", "help", "values"}
+        assert all(isinstance(v, float) for v in fam["values"].values())
