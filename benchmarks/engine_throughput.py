@@ -57,6 +57,10 @@ def main() -> None:
     ap.add_argument("--obs", action="store_true",
                     help="run with library-wide instrumentation enabled (obs.enable()) — "
                     "the >=10x acceptance gate must hold with spans/retrace/sync attribution on")
+    ap.add_argument("--checkpoint", action="store_true",
+                    help="add a second engine pass with the durable state plane enabled "
+                    "(async snapshots + WAL) and gate its steady-state overhead at <5%% "
+                    "vs the plain pass (ISSUE 4 acceptance)")
     args = ap.parse_args()
 
     if args.obs:
@@ -88,6 +92,36 @@ def main() -> None:
 
     # ---------------- engine: coalesced micro-batched dispatch
     buckets = (64, 256)
+
+    def run_engine_pass(checkpoint=None):
+        """One warmed, timed engine pass over the stream; returns req/s."""
+        engine = StreamingEngine(BinaryAccuracy(), buckets=buckets, max_queue=2048,
+                                 capacity=args.keys, checkpoint=checkpoint)
+        try:
+            for key, _, _ in stream:
+                engine._alloc_slot(key)
+            for rows in buckets:
+                engine.submit("tenant-0", jnp.asarray(rng.integers(0, 2, rows)),
+                              jnp.asarray(rng.integers(0, 2, rows)))
+            engine.flush()
+            engine.reset()
+            t0 = time.perf_counter()
+
+            def client(tid: int) -> None:
+                for i in range(tid, len(stream), args.threads):
+                    key, p, t = stream[i]
+                    engine.submit(key, p, t)
+
+            threads = [threading.Thread(target=client, args=(tid,)) for tid in range(args.threads)]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            engine.flush()
+            return len(stream) / (time.perf_counter() - t0)
+        finally:
+            engine.close()
+
     engine = StreamingEngine(BinaryAccuracy(), buckets=buckets, max_queue=2048, capacity=args.keys)
     try:
         # warmup: one covering pass over the bucket ladder with all keys allocated
@@ -147,6 +181,29 @@ def main() -> None:
             sys.exit(1)
     finally:
         engine.close()
+
+    # ---------------- durable state plane overhead gate (ISSUE 4): async
+    # checkpointing + WAL must cost <5% of steady-state engine throughput.
+    # Best-of-2 per variant to keep the CI gate off the scheduler-noise floor.
+    if args.checkpoint:
+        import tempfile
+
+        from metrics_tpu.engine import CheckpointConfig
+
+        plain_rps = max(run_engine_pass() for _ in range(2))
+        ckpt_runs = []
+        for _ in range(2):
+            with tempfile.TemporaryDirectory() as ckpt_dir:
+                cfg = CheckpointConfig(directory=ckpt_dir, interval_s=0.25, retain=3)
+                ckpt_runs.append(run_engine_pass(checkpoint=cfg))
+        ckpt_rps = max(ckpt_runs)
+        overhead = plain_rps / ckpt_rps - 1.0
+        ok = overhead < 0.05
+        emit("engine ckpt overhead", overhead * 100.0, "%",
+             plain_rps=round(plain_rps, 1), ckpt_rps=round(ckpt_rps, 1),
+             checks={"ckpt_overhead_lt_5pct": ok})
+        if not ok:
+            sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -25,6 +25,7 @@ from metrics_tpu import functional  # noqa: E402
 from metrics_tpu import obs  # noqa: E402  (observability layer; not in reference-parity __all__)
 from metrics_tpu import comm  # noqa: E402  (collective sync plane; not in reference-parity __all__)
 from metrics_tpu import engine  # noqa: E402  (serving runtime; not in reference-parity __all__)
+from metrics_tpu import ckpt  # noqa: E402  (durable state plane; not in reference-parity __all__)
 from metrics_tpu.aggregation import CatMetric, MaxMetric, MeanMetric, MinMetric, SumMetric  # noqa: E402
 from metrics_tpu.audio import (  # noqa: E402
     PermutationInvariantTraining,

@@ -1,0 +1,71 @@
+"""metrics_tpu.ckpt — durable state plane: crash-safe checkpoint, recovery, replay.
+
+The persistence story for everything stateful in the library::
+
+    from metrics_tpu import ckpt
+
+    metric.persistent(True)          # optional: ckpt.save captures full state anyway
+    metric.save("acc.ckpt")          # atomic, checksummed, lossless by default
+    fresh = BinaryAccuracy()
+    fresh.restore("acc.ckpt")        # strict schema validation; bit-identical compute()
+
+    store = ckpt.SnapshotStore("/var/ckpt", retain=3)        # generational + GC
+    writer = ckpt.AsyncCheckpointer(store, interval_s=30.0)  # background, bounded staleness
+    writer.maybe_checkpoint(lambda: (state_tree, {"step": 7}))
+    gen, snap = store.latest_valid()                         # skips corrupt generations
+
+Layout: :mod:`~metrics_tpu.ckpt.format` (versioned manifest + per-leaf CRC32 +
+comm-codec compression), :mod:`~metrics_tpu.ckpt.store` (atomic tmp+fsync+rename
+commits, retention, per-rank sharded layout, WAL request journal),
+:mod:`~metrics_tpu.ckpt.writer` (async background checkpointer),
+:mod:`~metrics_tpu.ckpt.restore` (strict validation + migration hooks +
+``save``/``restore``), :mod:`~metrics_tpu.ckpt.faults` (torn-write/bit-flip/
+partial-manifest/disk-full injection for durability tests).
+
+The engine integration (periodic per-tenant snapshots, WAL replay, restart
+recovery) lives in :mod:`metrics_tpu.engine.runtime` behind
+``StreamingEngine(checkpoint=CheckpointConfig(...))``. Guarantees and format
+spec: ``docs/source/persistence.md``.
+"""
+
+from __future__ import annotations
+
+from metrics_tpu.ckpt.format import (
+    FORMAT_VERSION,
+    CorruptSnapshotError,
+    Snapshot,
+    dumps,
+    loads,
+    read_manifest,
+)
+from metrics_tpu.ckpt.restore import (
+    CKPT_SCHEMA_VERSION,
+    CkptSchemaError,
+    clear_migrations,
+    migrate,
+    register_migration,
+    restore,
+    save,
+)
+from metrics_tpu.ckpt.store import RequestJournal, SnapshotStore, atomic_write
+from metrics_tpu.ckpt.writer import AsyncCheckpointer
+
+__all__ = [
+    "CKPT_SCHEMA_VERSION",
+    "FORMAT_VERSION",
+    "AsyncCheckpointer",
+    "CkptSchemaError",
+    "CorruptSnapshotError",
+    "RequestJournal",
+    "Snapshot",
+    "SnapshotStore",
+    "atomic_write",
+    "clear_migrations",
+    "dumps",
+    "loads",
+    "migrate",
+    "read_manifest",
+    "register_migration",
+    "restore",
+    "save",
+]

@@ -1,0 +1,391 @@
+"""Durable snapshot store + request journal: crash-safe files on a local dir.
+
+**SnapshotStore** — generational snapshots with atomic commit. A commit writes
+to a dot-prefixed temp file in the same directory, ``fsync``\\ s it, then
+``os.replace``\\ s onto the final name and fsyncs the directory: a reader (or a
+restart) either sees the complete previous generation or the complete new one,
+never a torn file under the real name. A torn *temp* file left by a crash is
+invisible to the generation scan and swept on the next construction.
+Retention keeps the newest ``retain`` generations per rank; recovery
+(:meth:`latest_valid`) walks generations newest-first and skips anything whose
+checksums (or caller-supplied validation) fail — a bit-flipped or truncated
+snapshot costs one generation of staleness, never a corrupt restore.
+
+Multihost: each rank owns its own file per generation
+(``gen-<g>.rank<r>-of<w>.ckpt``) — persisting never needs a gather, and one
+rank's corruption never blocks another's recovery.
+
+**RequestJournal** — a WAL-style append log for the engine's
+accepted-after-last-snapshot requests. Records are length+CRC framed; replay
+stops at the first torn frame (the crash tail), so a record is either replayed
+whole or not at all. Segments rotate at snapshot commits and segments fully
+covered by a snapshot are deleted.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import zlib
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+from metrics_tpu.ckpt import format as ckpt_format
+from metrics_tpu.ckpt.format import CorruptSnapshotError, Snapshot
+
+__all__ = ["RequestJournal", "SnapshotStore", "atomic_write"]
+
+_TMP_PREFIX = ".tmp."
+
+
+def _fsync_dir(path: str) -> None:
+    """Make a rename durable: fsync the containing directory (POSIX). Best
+    effort — platforms without dir-fd fsync (or exotic filesystems) skip."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write(path: str, data: bytes, *, durable: bool = True) -> None:
+    """Write ``data`` to ``path`` atomically: temp file + fsync + rename.
+
+    ``durable=False`` skips the fsyncs (tests, throwaway dirs) but keeps the
+    atomic rename — readers still never observe a torn file.
+    """
+    d, name = os.path.split(os.path.abspath(path))
+    tmp = os.path.join(d, f"{_TMP_PREFIX}{name}.{os.getpid()}")
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        if durable:
+            os.fsync(f.fileno())
+    os.replace(tmp, path)
+    if durable:
+        _fsync_dir(d)
+
+
+class SnapshotStore:
+    """Generational snapshot files under one directory, atomic per commit."""
+
+    def __init__(
+        self,
+        root: str,
+        *,
+        retain: int = 3,
+        rank: int = 0,
+        world: int = 1,
+        durable: bool = True,
+    ) -> None:
+        if retain < 1:
+            raise ValueError(f"retain must be >= 1, got {retain}")
+        if not (0 <= rank < world):
+            raise ValueError(f"rank must be in [0, world), got rank={rank} world={world}")
+        self.root = os.path.abspath(root)
+        self.retain = int(retain)
+        self.rank = int(rank)
+        self.world = int(world)
+        self.durable = durable
+        # generations skipped by the last latest_valid scan: (generation, reason)
+        self.last_skipped: List[Tuple[int, str]] = []
+        os.makedirs(self.root, exist_ok=True)
+        self._sweep_tmp()
+
+    # ------------------------------------------------------------------ layout
+
+    def _suffix(self) -> str:
+        return f".rank{self.rank:05d}-of{self.world:05d}.ckpt"
+
+    def path(self, generation: int) -> str:
+        return os.path.join(self.root, f"gen-{generation:012d}{self._suffix()}")
+
+    def generations(self) -> List[int]:
+        """This rank's committed generations, ascending."""
+        out = []
+        suffix = self._suffix()
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return []
+        for name in names:
+            if name.startswith("gen-") and name.endswith(suffix):
+                try:
+                    out.append(int(name[len("gen-") : len("gen-") + 12]))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    def _sweep_tmp(self) -> None:
+        # A crash mid-commit leaves only an invisible temp file. Sweep every
+        # temp matching THIS store's rank suffix regardless of pid — the dead
+        # writer's pid is gone, and each rank has a single owner, so any
+        # same-rank temp here is an orphan (other ranks' temps are left alone).
+        marker = f"{_TMP_PREFIX}gen-"
+        suffix = self._suffix() + "."
+        for name in os.listdir(self.root):
+            if name.startswith(marker) and suffix in name:
+                try:
+                    os.remove(os.path.join(self.root, name))
+                except OSError:
+                    pass
+
+    # ------------------------------------------------------------------ writes
+
+    def next_generation(self) -> int:
+        gens = self.generations()
+        return (gens[-1] + 1) if gens else 0
+
+    def commit(self, data: bytes, *, generation: Optional[int] = None) -> int:
+        """Atomically persist one snapshot blob; returns its generation."""
+        gen = self.next_generation() if generation is None else int(generation)
+        atomic_write(self.path(gen), data, durable=self.durable)
+        self.gc()
+        return gen
+
+    def gc(self) -> List[int]:
+        """Delete this rank's oldest generations beyond ``retain``; returns them."""
+        gens = self.generations()
+        dropped = gens[: -self.retain] if len(gens) > self.retain else []
+        for g in dropped:
+            try:
+                os.remove(self.path(g))
+            except OSError:
+                pass
+        return dropped
+
+    def delete(self, generation: int) -> None:
+        try:
+            os.remove(self.path(generation))
+        except FileNotFoundError:
+            pass
+
+    # ------------------------------------------------------------------ reads
+
+    def read(self, generation: int) -> bytes:
+        with open(self.path(generation), "rb") as f:
+            return f.read()
+
+    def read_meta(self, generation: int) -> Dict[str, Any]:
+        """One generation's manifest ``meta`` — header + manifest bytes only,
+        no payload decode (CRC-checked; corrupt manifests raise)."""
+        import struct
+
+        from metrics_tpu.ckpt.format import MAGIC
+
+        with open(self.path(generation), "rb") as f:
+            head = f.read(len(MAGIC) + 12)
+            if len(head) < len(MAGIC) + 12:
+                raise CorruptSnapshotError("truncated header")
+            (mlen,) = struct.unpack_from("<Q", head, len(MAGIC))
+            data = head + f.read(mlen)
+        return ckpt_format.read_manifest(data).get("meta", {})
+
+    def latest_valid(
+        self, *, validate: Optional[Callable[[Snapshot], None]] = None
+    ) -> Optional[Tuple[int, Snapshot]]:
+        """Newest generation that decodes, checksums, and validates clean.
+
+        Walks newest-first; a corrupt/torn/unreadable generation (or one the
+        caller's ``validate`` rejects) is recorded in :attr:`last_skipped` and
+        the scan falls back to the previous one. ``None`` when nothing valid
+        exists.
+        """
+        self.last_skipped = []
+        for gen in reversed(self.generations()):
+            try:
+                snap = ckpt_format.loads(self.read(gen))
+                if validate is not None:
+                    validate(snap)
+                return gen, snap
+            except (CorruptSnapshotError, OSError, ValueError, KeyError, TypeError) as exc:
+                self.last_skipped.append((gen, f"{type(exc).__name__}: {exc}"))
+        return None
+
+
+# ---------------------------------------------------------------------- journal
+
+_FRAME = struct.Struct("<II")  # payload nbytes, payload crc32
+
+
+class RequestJournal:
+    """Append-only, CRC-framed request log with segment rotation.
+
+    Each record gets a monotone sequence number, persistent across reopen
+    (segments are named by their first seq; a record's seq is first_seq +
+    index). Appends go through an internal lock; :meth:`append_many` batches
+    one ``write`` for a drained engine batch. ``sync`` policy per append is
+    the caller's call — :meth:`flush` exposes flush-only and fsync levels.
+    """
+
+    def __init__(self, root: str, *, name: str = "wal", rank: int = 0, durable: bool = True) -> None:
+        self.root = os.path.abspath(root)
+        self.name = name
+        self.rank = int(rank)
+        self.durable = durable
+        self.torn_records = 0  # frames dropped at a torn tail during scan/replay
+        self._lock = threading.Lock()
+        self._file: Optional[Any] = None
+        os.makedirs(self.root, exist_ok=True)
+        self.last_seq = -1
+        segs = self._segments()
+        if segs:
+            # resume numbering after everything already on disk; a torn tail
+            # (crash mid-append) is truncated away so records appended after
+            # the reopen stay replayable behind an unbroken seq chain
+            first, path = segs[-1]
+            records, clean_len, torn = self._scan_segment(path)
+            if torn:
+                with open(path, "r+b") as f:
+                    f.truncate(clean_len)
+            self.last_seq = first + records - 1
+
+    # ------------------------------------------------------------------ layout
+
+    def _seg_path(self, first_seq: int) -> str:
+        return os.path.join(self.root, f"{self.name}-{first_seq:012d}.rank{self.rank:05d}.log")
+
+    def _segments(self) -> List[Tuple[int, str]]:
+        """(first_seq, path) ascending."""
+        out = []
+        marker = f".rank{self.rank:05d}.log"
+        prefix = f"{self.name}-"
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return []
+        for n in names:
+            if n.startswith(prefix) and n.endswith(marker):
+                try:
+                    out.append((int(n[len(prefix) : len(prefix) + 12]), os.path.join(self.root, n)))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    # ------------------------------------------------------------------ writes
+
+    def _ensure_file(self) -> Any:
+        if self._file is None:
+            self._file = open(self._seg_path(self.last_seq + 1), "ab")
+        return self._file
+
+    @staticmethod
+    def _frame(payload: bytes) -> bytes:
+        return _FRAME.pack(len(payload), zlib.crc32(payload) & 0xFFFFFFFF) + payload
+
+    def append(self, payload: bytes) -> int:
+        """Append one record; returns its sequence number."""
+        return self.append_many([payload])[-1]
+
+    def append_many(self, payloads: List[bytes]) -> List[int]:
+        """Append a batch under one lock/one write; returns the seqs in order."""
+        if not payloads:
+            return []
+        frames = b"".join(self._frame(p) for p in payloads)
+        with self._lock:
+            f = self._ensure_file()
+            f.write(frames)
+            seqs = list(range(self.last_seq + 1, self.last_seq + 1 + len(payloads)))
+            self.last_seq = seqs[-1]
+        return seqs
+
+    def flush(self, *, fsync: bool = False) -> None:
+        with self._lock:
+            if self._file is not None:
+                self._file.flush()
+                if fsync and self.durable:
+                    os.fsync(self._file.fileno())
+
+    def rotate(self, covered_seq: int) -> None:
+        """Start a fresh segment; drop segments fully covered by ``covered_seq``
+        (i.e. whose every record a snapshot at that seq already includes)."""
+        with self._lock:
+            if self._file is not None:
+                self._file.flush()
+                if self.durable:
+                    os.fsync(self._file.fileno())
+                self._file.close()
+                self._file = None
+            segs = self._segments()
+            for i, (first, path) in enumerate(segs):
+                next_first = segs[i + 1][0] if i + 1 < len(segs) else self.last_seq + 1
+                if next_first - 1 <= covered_seq:
+                    try:
+                        os.remove(path)
+                    except OSError:
+                        pass
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                self._file.flush()
+                if self.durable:
+                    os.fsync(self._file.fileno())
+                self._file.close()
+                self._file = None
+
+    # ------------------------------------------------------------------ reads
+
+    @staticmethod
+    def _scan_segment(path: str) -> Tuple[int, int, bool]:
+        """(intact record count, clean byte length, torn?) for one segment."""
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except OSError:
+            return 0, 0, False
+        off = records = 0
+        while off + _FRAME.size <= len(data):
+            n, crc = _FRAME.unpack_from(data, off)
+            payload = data[off + _FRAME.size : off + _FRAME.size + n]
+            if len(payload) != n or (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+                return records, off, True
+            records += 1
+            off += _FRAME.size + n
+        return records, off, off != len(data)
+
+    def _read_segment(self, path: str) -> Iterator[bytes]:
+        """Yield whole records; stop at the first torn/corrupt frame (crash tail)."""
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except OSError:
+            return
+        off = 0
+        while off + _FRAME.size <= len(data):
+            n, crc = _FRAME.unpack_from(data, off)
+            payload = data[off + _FRAME.size : off + _FRAME.size + n]
+            if len(payload) != n or (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+                self.torn_records += 1
+                return
+            yield payload
+            off += _FRAME.size + n
+        if off != len(data):
+            self.torn_records += 1
+
+    def replay(self, after_seq: int = -1) -> Iterator[Tuple[int, bytes]]:
+        """Yield ``(seq, record)`` for every intact record with seq > ``after_seq``.
+
+        A torn frame ends its segment, and everything after the tear is
+        unordered relative to it — replay stops there: exactly the records
+        whose append completed before the crash, in order.
+        """
+        self.flush()
+        expected = None
+        for first, path in self._segments():
+            if expected is not None and first != expected:
+                return  # seq gap (e.g. manually removed segment): stop
+            before = self.torn_records
+            seq = first
+            for payload in self._read_segment(path):
+                if seq > after_seq:
+                    yield seq, payload
+                seq += 1
+            if self.torn_records != before:
+                return  # torn tail: nothing after it is trustworthy
+            expected = seq

@@ -528,6 +528,24 @@ class MetricCollection:
             m.to_device(device)
         return self
 
+    def save(self, path: str, *, policy: Any = None, meta: Optional[Dict[str, Any]] = None) -> None:
+        """Persist the collection's FULL state (every member, every state) to
+        ``path`` — atomic, checksummed, lossless by default. See
+        :meth:`Metric.save`; group members serialize with their leader's real
+        values (aliasing refreshed first, as in :meth:`state_dict`)."""
+        from metrics_tpu.ckpt import save as _ckpt_save
+
+        _ckpt_save(self, path, policy=policy, meta=meta)
+
+    def restore(self, path: str) -> Any:
+        """Load a :meth:`save` snapshot into this collection (strict — see
+        :meth:`Metric.restore`). Compute-group aliasing is re-established after
+        the load: members point at their leader's freshly restored arrays,
+        never at stale pre-restore state."""
+        from metrics_tpu.ckpt import restore as _ckpt_restore
+
+        return _ckpt_restore(self, path)
+
     # ------------------------------------------------------------------ functional API (TPU-first)
 
     def init_state(self) -> Dict[str, Any]:

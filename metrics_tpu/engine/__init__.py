@@ -10,19 +10,28 @@ Turns any ``Metric`` / ``MetricCollection`` into a high-throughput service::
     engine.close()
 
 Layout: ``bucketing.py`` (shape-bucketed padding), ``runtime.py`` (bounded-queue
-dispatcher + jitted bucket kernels + backpressure/degradation), ``stream.py``
-(stacked multi-tenant keyed state + sliding windows), ``telemetry.py`` (counters,
-occupancy, p50/p99 latency — registry-backed: the series appear in
-``metrics_tpu.obs.render_prometheus()`` under a per-engine label).
+dispatcher + jitted bucket kernels + backpressure/degradation + the durable
+state plane wiring — ``checkpoint=CheckpointConfig(...)`` adds periodic async
+snapshots, a WAL of accepted work, and exactly-once restart recovery via
+``metrics_tpu.ckpt``), ``stream.py`` (stacked multi-tenant keyed state +
+sliding windows), ``telemetry.py`` (counters, occupancy, p50/p99 latency —
+registry-backed: the series appear in ``metrics_tpu.obs.render_prometheus()``
+under a per-engine label).
 """
 
 from metrics_tpu.engine.bucketing import DEFAULT_BUCKETS, choose_bucket, inspect_request, pad_micro_batch
-from metrics_tpu.engine.runtime import EngineBackpressure, EngineClosed, StreamingEngine
+from metrics_tpu.engine.runtime import (
+    CheckpointConfig,
+    EngineBackpressure,
+    EngineClosed,
+    StreamingEngine,
+)
 from metrics_tpu.engine.stream import EagerKeyedState, KeyedState
 from metrics_tpu.engine.telemetry import EngineTelemetry
 
 __all__ = [
     "DEFAULT_BUCKETS",
+    "CheckpointConfig",
     "EagerKeyedState",
     "EngineBackpressure",
     "EngineClosed",

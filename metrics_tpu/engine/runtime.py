@@ -38,15 +38,22 @@ Backpressure at a full queue follows ``policy``: ``"block"`` (wait for space),
 
 from __future__ import annotations
 
+import os
+import pickle
+import struct
 import threading
 import time
 from concurrent.futures import Future
+from dataclasses import dataclass
 from typing import Any, Callable, Dict, Hashable, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
+from metrics_tpu.ckpt.store import RequestJournal, SnapshotStore
+from metrics_tpu.ckpt.writer import AsyncCheckpointer
 from metrics_tpu.collections import MetricCollection
 from metrics_tpu.engine.bucketing import (
     DEFAULT_BUCKETS,
@@ -66,6 +73,126 @@ from metrics_tpu.parallel.sync import sync_state_host
 from metrics_tpu.utils.exceptions import MetricsTPUUserError
 
 _POLICIES = ("block", "drop", "timeout")
+_WAL_FLUSH = ("none", "flush", "fsync")
+
+# WAL record encoding. Two record types, hand-rolled rather than pickled
+# because encoding rides the dispatcher's critical path and per-request
+# np.ndarray pickling alone would blow the <5% checkpoint-overhead gate:
+#
+# - b"C" CHUNK records — the fused hot path. One record per dispatched
+#   micro-batch holding the PADDED columns + key_ids + mask exactly as the
+#   kernel saw them, plus pickled key mappings for any slot ids this journal
+#   has not introduced yet. Cost is a handful of ``tobytes`` calls per up-to-
+#   256-row chunk (<0.1µs/request); replay walks the masked rows in scan
+#   order, reproducing the kernel's per-row accumulation bit-for-bit.
+# - b"R" REQUEST records — eager metrics, degraded/inline submits, and the
+#   eager retry after a fused trace failure: pickled key + raw
+#   dtype/shape/bytes per arg, applied whole-request on replay (matching how
+#   those paths applied it originally).
+
+_WAL_U32 = struct.Struct("<I")
+
+
+def _enc_array(parts: List[bytes], a: np.ndarray) -> None:
+    if a.dtype.byteorder == ">":
+        a = a.astype(a.dtype.newbyteorder("="))
+    name = a.dtype.name.encode()
+    parts.append(bytes((len(name), a.ndim)))
+    parts.append(name)
+    if a.ndim:
+        parts.append(struct.pack(f"<{a.ndim}q", *a.shape))
+    parts.append(a.tobytes())
+
+
+def _dec_array(payload: bytes, off: int) -> Tuple[np.ndarray, int]:
+    from metrics_tpu.ckpt.format import _dtype_from_name
+
+    nlen, ndim = payload[off], payload[off + 1]
+    off += 2
+    dtype = _dtype_from_name(payload[off : off + nlen].decode())
+    off += nlen
+    shape = struct.unpack_from(f"<{ndim}q", payload, off) if ndim else ()
+    off += 8 * ndim
+    count = int(np.prod(shape)) if ndim else 1
+    arr = np.frombuffer(payload, dtype, count, off).reshape(shape)
+    return arr, off + count * dtype.itemsize
+
+
+def _encode_request_record(key_bytes: bytes, args: Tuple[Any, ...]) -> bytes:
+    parts = [b"R", _WAL_U32.pack(len(key_bytes)), key_bytes, bytes((len(args),))]
+    for a in args:
+        _enc_array(parts, np.asarray(a))
+    return b"".join(parts)
+
+
+def _decode_request_record(payload: bytes) -> Tuple[Hashable, Tuple[Any, ...]]:
+    (klen,) = _WAL_U32.unpack_from(payload, 1)
+    off = 1 + _WAL_U32.size + klen
+    key = pickle.loads(payload[1 + _WAL_U32.size : off])
+    nargs = payload[off]
+    off += 1
+    args = []
+    for _ in range(nargs):
+        arr, off = _dec_array(payload, off)
+        args.append(arr)
+    return key, tuple(args)
+
+
+def _encode_chunk_record(
+    new_slots: List[Tuple[int, bytes]],
+    key_ids: np.ndarray,
+    mask: np.ndarray,
+    columns: Sequence[np.ndarray],
+) -> bytes:
+    parts = [b"C", struct.pack("<H", len(new_slots))]
+    for slot, key_bytes in new_slots:
+        parts.append(_WAL_U32.pack(slot))
+        parts.append(_WAL_U32.pack(len(key_bytes)))
+        parts.append(key_bytes)
+    parts.append(bytes((len(columns),)))
+    _enc_array(parts, key_ids)
+    _enc_array(parts, mask)
+    for col in columns:
+        _enc_array(parts, col)
+    return b"".join(parts)
+
+# Engine snapshot payload schema. Engine snapshots are operational (serving
+# continuity), not archival: a version bump invalidates old generations — the
+# recovery scan just skips them — rather than migrating them.
+_ENGINE_SCHEMA_VERSION = 1
+
+
+@dataclass(frozen=True)
+class CheckpointConfig:
+    """Durable-state-plane wiring for one :class:`StreamingEngine`.
+
+    ``directory`` holds the generational snapshots AND the WAL segments. A
+    background :class:`~metrics_tpu.ckpt.writer.AsyncCheckpointer` persists the
+    full multi-tenant state every ``interval_s`` seconds (the dispatcher hands
+    it a consistent host view between micro-batches — the submit hot path never
+    blocks on IO). The WAL journals each committed fused micro-batch as ONE
+    chunk record (padded columns + key ids + mask, journaled after the kernel
+    commit and before the chunk's futures resolve) and each eager/inline
+    request individually, so a restart recovers the newest valid snapshot and
+    replays exactly the work acknowledged after it, in the original per-row
+    order (see ``docs/source/persistence.md`` for the exactly-once argument).
+    ``policy=None`` keeps snapshots lossless.
+
+    ``wal_flush``: per-drained-batch durability of the journal — ``"none"``
+    (OS-buffered; flushed at rotation/close), ``"flush"`` (python-level flush,
+    the default), ``"fsync"`` (fsync per batch — strongest, slowest).
+    """
+
+    directory: str
+    interval_s: float = 30.0
+    retain: int = 3
+    policy: Optional[Any] = None  # comm.CodecPolicy; None = lossless
+    wal: bool = True
+    wal_flush: str = "flush"
+    resume: bool = True
+    durable: bool = True
+    rank: int = 0
+    world: int = 1
 
 
 class EngineClosed(MetricsTPUUserError):
@@ -81,7 +208,7 @@ class _FusedUnsupported(Exception):
 
 
 class _Request:
-    __slots__ = ("key", "slot", "args", "rows", "signature", "future", "t_submit", "rows_done")
+    __slots__ = ("key", "slot", "args", "rows", "signature", "future", "t_submit", "rows_done", "seq")
 
     def __init__(self, key: Hashable, slot: Optional[int], args: Tuple[Any, ...],
                  rows: int, signature: Signature, future: "Future", t_submit: float) -> None:
@@ -97,6 +224,9 @@ class _Request:
         # rows already committed to the state (fused chunks commit incrementally, so a
         # mid-batch fused→eager demotion must not re-apply them)
         self.rows_done = 0
+        # WAL sequence number once journaled (None while checkpointing is off
+        # or the record hasn't been appended yet) — the double-journal guard
+        self.seq: Optional[int] = None
 
 
 def _component_metrics(metric: Any) -> List[Metric]:
@@ -146,6 +276,7 @@ class StreamingEngine:
         window: Optional[int] = None,
         capacity: int = 8,
         telemetry_window: int = 2048,
+        checkpoint: Optional[CheckpointConfig] = None,
         start: bool = True,
     ) -> None:
         if not isinstance(metric_or_collection, (Metric, MetricCollection)):
@@ -201,6 +332,21 @@ class StreamingEngine:
         self._worker_gate = threading.Event()
         self._worker_gate.set()
 
+        # durable state plane (None-checked on every hot path: checkpointing
+        # off costs one attribute test per drained batch)
+        self._ckpt_cfg: Optional[CheckpointConfig] = None
+        self._ckpt_store: Optional[SnapshotStore] = None
+        self._ckpt_writer: Optional[AsyncCheckpointer] = None
+        self._journal: Optional[RequestJournal] = None
+        self._wal_seq = -1
+        self._wal_error: Optional[BaseException] = None
+        self._wal_key_cache: Dict[Hashable, bytes] = {}
+        self._wal_slots_sent: set = set()  # slot ids already introduced to the journal
+        self._replay_slot_keys: Dict[int, Hashable] = {}
+        self._snapshot_seqs: Dict[int, int] = {}  # generation -> WAL seq it covers
+        if checkpoint is not None:
+            self._init_checkpoint(checkpoint)
+
         self._worker: Optional[threading.Thread] = None
         if start:
             self.start()
@@ -216,13 +362,21 @@ class StreamingEngine:
             )
             self._worker.start()
 
-    def close(self, flush: bool = True) -> None:
-        """Stop accepting work; by default drain what was already accepted."""
+    def close(self, flush: bool = True, checkpoint: bool = True) -> None:
+        """Stop accepting work; by default drain what was already accepted.
+
+        With checkpointing configured, a final snapshot is committed after the
+        drain (``checkpoint=False`` skips it — the crash-simulation hook: the
+        WAL then carries everything since the last periodic snapshot, exactly
+        what a restart must replay).
+        """
         with self._lock:
             if self._closed:
                 return
         if flush:
             self.flush()
+        if flush and checkpoint and self._ckpt_writer is not None:
+            self._ckpt_writer.checkpoint_sync(self._checkpoint_view)
         with self._lock:
             self._closed = True
             self._not_empty.notify_all()
@@ -231,6 +385,10 @@ class StreamingEngine:
             worker = self._worker
         if worker is not None and worker is not threading.current_thread():
             worker.join(timeout=10.0)
+        if self._ckpt_writer is not None:
+            self._ckpt_writer.close()
+        if self._journal is not None:
+            self._journal.close()
 
     def __enter__(self) -> "StreamingEngine":
         return self
@@ -372,6 +530,9 @@ class StreamingEngine:
         snap["fused"] = self._fused
         snap["degraded"] = self._degraded
         snap["tenants"] = len(self._keyed.keys)
+        if self._ckpt_writer is not None:
+            snap["ckpt_generation"] = self._ckpt_writer.last_generation
+            snap["wal_seq"] = self._wal_seq
         return snap
 
     # ------------------------------------------------------------------ internals
@@ -389,6 +550,337 @@ class StreamingEngine:
                 for name, sub in state.items()
             }
         return sync_state_host(state, self._metric._reductions, site="engine.compute")
+
+    # ---------------------------------------------------- durable state plane
+
+    def _init_checkpoint(self, cfg: CheckpointConfig) -> None:
+        if cfg.wal_flush not in _WAL_FLUSH:
+            raise MetricsTPUUserError(f"`wal_flush` must be one of {_WAL_FLUSH}, got {cfg.wal_flush!r}")
+        self._ckpt_cfg = cfg
+        self._ckpt_store = SnapshotStore(
+            cfg.directory, retain=cfg.retain, rank=cfg.rank, world=cfg.world, durable=cfg.durable
+        )
+        if cfg.wal:
+            self._journal = RequestJournal(cfg.directory, rank=cfg.rank, durable=cfg.durable)
+        self._ckpt_writer = AsyncCheckpointer(
+            self._ckpt_store,
+            interval_s=cfg.interval_s,
+            site="engine",
+            policy=cfg.policy,
+            schema_version=_ENGINE_SCHEMA_VERSION,
+            on_commit=self._on_snapshot_commit,
+            on_error=lambda exc: self.telemetry.count("checkpoint_failures"),
+        )
+        if cfg.resume:
+            self._recover()
+
+    def _on_snapshot_commit(self, generation: int, tree: Any, meta: Optional[Dict[str, Any]]) -> None:
+        """Writer-thread callback: rotate the WAL past what every RETAINED
+        generation covers. Rotating to the newest snapshot's seq would be
+        wrong: if that file is later corrupted, recovery falls back to an
+        older generation whose tail records must still be replayable — so the
+        rotation point is the OLDEST retained generation's coverage."""
+        self.telemetry.count("checkpoints")
+        if self._journal is None:
+            return
+        self._snapshot_seqs[generation] = int(tree["seq"])
+        retained = self._ckpt_store.generations()
+        self._snapshot_seqs = {g: s for g, s in self._snapshot_seqs.items() if g in retained}
+        covered = None
+        for gen in retained:
+            seq = self._snapshot_seqs.get(gen)
+            if seq is None:
+                try:  # generation committed by a previous process: read its meta
+                    seq = int(self._ckpt_store.read_meta(gen).get("seq", -1))
+                    self._snapshot_seqs[gen] = seq
+                except Exception:  # noqa: BLE001 — unreadable: don't rotate past it
+                    seq = -1
+            covered = seq if covered is None else min(covered, seq)
+        if covered is not None and covered >= 0:
+            self._journal.rotate(covered_seq=covered)
+
+    def _key_bytes(self, key: Hashable) -> bytes:
+        key_bytes = self._wal_key_cache.get(key)
+        if key_bytes is None:
+            key_bytes = self._wal_key_cache[key] = pickle.dumps(
+                key, protocol=pickle.HIGHEST_PROTOCOL
+            )
+        return key_bytes
+
+    def _journal_append(self, payloads: List[bytes]) -> Optional[List[int]]:
+        """Append + flush per policy; a journal IO failure disables the WAL
+        (counted, remembered) instead of failing serving — durability degrades,
+        availability does not."""
+        try:
+            seqs = self._journal.append_many(payloads)
+            flush = self._ckpt_cfg.wal_flush
+            if flush != "none":
+                self._journal.flush(fsync=flush == "fsync")
+        except Exception as exc:  # noqa: BLE001
+            self._wal_error = exc
+            journal, self._journal = self._journal, None
+            try:
+                journal.close()  # release the fd; flush whatever still can be
+            except Exception:  # noqa: BLE001 — already in the failure path
+                pass
+            self.telemetry.count("checkpoint_failures")
+            return None
+        self._wal_seq = max(self._wal_seq, seqs[-1])
+        self.telemetry.count("wal_records", len(payloads))
+        return seqs
+
+    def _journal_chunk(
+        self,
+        units: List[Tuple[_Request, Tuple[Any, ...], int, bool]],
+        key_ids: Any,
+        mask: Any,
+        columns: Sequence[Any],
+    ) -> None:
+        """Journal one committed fused micro-batch as a single chunk record.
+
+        Called AFTER the kernel committed and BEFORE the chunk's futures
+        resolve: an acknowledged request is always either in a snapshot or
+        replayable, and a chunk that failed to trace is never journaled (its
+        eager retry journals per-request instead — no double entry). Replay
+        reapplies the masked rows in scan order, so a snapshot at seq S plus
+        records > S reproduces the lost process's state exactly once, bit-for-
+        bit.
+        """
+        if self._journal is None:
+            return
+        new_slots = []
+        for req, _, _, _ in units:
+            if req.slot not in self._wal_slots_sent:
+                self._wal_slots_sent.add(req.slot)
+                new_slots.append((req.slot, self._key_bytes(req.key)))
+        record = _encode_chunk_record(
+            new_slots, np.asarray(key_ids), np.asarray(mask), [np.asarray(c) for c in columns]
+        )
+        self._journal_append([record])
+
+    def _journal_requests(self, reqs: List[_Request], args_override: Optional[Tuple[Any, ...]] = None) -> None:
+        """Per-request WAL records for the non-fused paths (eager metrics,
+        degraded/inline submits, eager retry). ``args_override`` journals a
+        trimmed argument view when part of the request already committed (and
+        was journaled) through fused chunks."""
+        if self._journal is None:
+            return
+        todo = [req for req in reqs if req.seq is None]
+        if not todo:
+            return
+        payloads = [
+            _encode_request_record(
+                self._key_bytes(req.key), req.args if args_override is None else args_override
+            )
+            for req in todo
+        ]
+        seqs = self._journal_append(payloads)
+        if seqs is not None:
+            for req, seq in zip(todo, seqs):
+                req.seq = seq
+
+    def _checkpoint_view(self) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        """Consistent host-side snapshot tree of ALL tenant state + WAL position.
+
+        Runs on the dispatcher thread between micro-batches (or on a quiesced
+        caller thread) under the dispatch lock: jax arrays are immutable, so
+        the device_get is the only copy and the submit path never stalls on it.
+        """
+        with self._dispatch_lock:
+            keyed = self._keyed
+            tree: Dict[str, Any] = {"kind": "engine", "seq": int(self._wal_seq)}
+            if isinstance(keyed, KeyedState):
+                tree["mode"] = "fused"
+                tree["capacity"] = int(keyed.capacity)
+                tree["slots"] = dict(keyed._slots)  # non-str keys -> object leaf
+                tree["stacked"] = jax.device_get(keyed.stacked)
+                tree["ring"] = [
+                    {"capacity": int(cap), "stacked": jax.device_get(snap)}
+                    for cap, snap in (keyed._ring or [])
+                ]
+            else:
+                keys = list(keyed._states)
+                tree["mode"] = "eager"
+                tree["keys"] = {"values": keys}  # wrapped: keys may be non-JSON-able
+                tree["states"] = [jax.device_get(keyed._states[k]) for k in keys]
+                tree["ring"] = [
+                    {
+                        "keys": {"values": list(seg)},
+                        "states": [jax.device_get(seg[k]) for k in seg],
+                    }
+                    for seg in (keyed._ring or [])
+                ]
+        meta = {"tenants": len(keyed.keys), "seq": tree["seq"]}
+        return tree, meta
+
+    def _maybe_checkpoint(self) -> None:
+        if self._ckpt_writer is None:
+            return
+        try:
+            self._ckpt_writer.maybe_checkpoint(self._checkpoint_view)
+        except Exception:  # noqa: BLE001 — a snapshot failure must not kill the dispatcher
+            self.telemetry.count("checkpoint_failures")
+
+    def checkpoint_now(self) -> Optional[int]:
+        """Flush, then snapshot synchronously; returns the committed generation.
+
+        ``None`` when checkpointing is off or the write failed (the failure is
+        counted and kept on ``self._ckpt_writer.last_error``, never raised).
+        """
+        if self._ckpt_writer is None:
+            return None
+        self.flush()
+        return self._ckpt_writer.checkpoint_sync(self._checkpoint_view)
+
+    def _validate_engine_snapshot(self, snap: Any) -> None:
+        tree = snap.tree
+        if snap.schema_version != _ENGINE_SCHEMA_VERSION:
+            raise ValueError(f"engine snapshot schema v{snap.schema_version} != v{_ENGINE_SCHEMA_VERSION}")
+        if not isinstance(tree, dict) or tree.get("kind") != "engine":
+            raise ValueError("not an engine snapshot")
+        mode = tree.get("mode")
+        ref_leaves, ref_def = jax.tree_util.tree_flatten(self._metric.init_state())
+        if mode == "fused":
+            if not isinstance(self._keyed, KeyedState):
+                raise ValueError("fused snapshot but the live engine serves eagerly")
+            cap = int(tree["capacity"])
+            for entry in [tree] + list(tree.get("ring", [])):
+                leaves, treedef = jax.tree_util.tree_flatten(entry["stacked"])
+                if treedef != ref_def:
+                    raise ValueError("stacked state structure does not match the live metric")
+                ecap = int(entry["capacity"]) if "capacity" in entry else cap
+                for ref, got in zip(ref_leaves, leaves):
+                    if np.dtype(got.dtype) != np.dtype(ref.dtype) or tuple(got.shape) != (ecap, *ref.shape):
+                        raise ValueError(
+                            f"stacked leaf {np.dtype(got.dtype).name}{tuple(got.shape)} does not match "
+                            f"live {np.dtype(ref.dtype).name}{(ecap, *ref.shape)}"
+                        )
+        elif mode == "eager":
+            ref = self._metric.init_state()
+            for entry in [tree] + list(tree.get("ring", [])):
+                if len(entry["keys"]["values"]) != len(entry["states"]):
+                    raise ValueError("eager snapshot keys/states length mismatch")
+                for st in entry["states"]:
+                    # top-level key check only: ragged cat lists make full
+                    # treedef comparison reject legitimate snapshots
+                    if not isinstance(st, dict) or set(st) != set(ref):
+                        raise ValueError("eager state structure does not match the live metric")
+        else:
+            raise ValueError(f"unknown engine snapshot mode {mode!r}")
+
+    def _restore_keyed(self, tree: Dict[str, Any]) -> None:
+        if tree["mode"] == "fused":
+            if not isinstance(self._keyed, KeyedState):
+                raise ValueError("fused snapshot but the live engine serves eagerly")
+            keyed = KeyedState(self._metric, capacity=tree["capacity"], window=self._window)
+            keyed.capacity = int(tree["capacity"])
+            keyed.stacked = jax.tree.map(jnp.asarray, tree["stacked"])
+            keyed._slots = dict(tree["slots"])
+            if keyed._ring is not None:
+                for entry in tree.get("ring", []):
+                    keyed._ring.append(
+                        (int(entry["capacity"]), jax.tree.map(jnp.asarray, entry["stacked"]))
+                    )
+            self._keyed = keyed
+        else:
+            # an eager snapshot (e.g. the crashed engine had demoted) restores
+            # into a fused-capable engine by demoting it up front — recovering
+            # slower always beats refusing to recover
+            if not isinstance(self._keyed, EagerKeyedState):
+                self._fused = False
+                self._kernels.clear()
+            keyed = EagerKeyedState(self._metric, window=self._window)
+            keyed._states = dict(zip(tree["keys"]["values"], tree["states"]))
+            if keyed._ring is not None:
+                for entry in tree.get("ring", []):
+                    keyed._ring.append(dict(zip(entry["keys"]["values"], entry["states"])))
+            self._keyed = keyed
+
+    def _replay_chunk(self, payload: bytes) -> None:
+        """Re-apply one fused micro-batch record: masked rows in scan order."""
+        off = 1
+        (n_new,) = struct.unpack_from("<H", payload, off)
+        off += 2
+        for _ in range(n_new):
+            (slot,) = _WAL_U32.unpack_from(payload, off)
+            off += 4
+            (klen,) = _WAL_U32.unpack_from(payload, off)
+            off += 4
+            self._replay_slot_keys[slot] = pickle.loads(payload[off : off + klen])
+            off += klen
+        ncols = payload[off]
+        off += 1
+        key_ids, off = _dec_array(payload, off)
+        mask, off = _dec_array(payload, off)
+        columns = []
+        for _ in range(ncols):
+            col, off = _dec_array(payload, off)
+            columns.append(col)
+        eager = isinstance(self._keyed, EagerKeyedState)
+        for i in range(len(key_ids)):
+            if not mask[i]:
+                continue
+            key = self._replay_slot_keys[int(key_ids[i])]
+            self._keyed.slot_for(key)
+            rows = tuple(col[i] for col in columns)  # (1, *trailing) — the scan slice
+            if eager:
+                self._keyed.update(key, *rows)
+            else:
+                self._keyed.ensure_capacity()
+                state = self._keyed.state_of(key)
+                self._keyed.set_state(key, self._metric.update_state(state, *rows))
+
+    def _replay_request(self, key: Hashable, args: Tuple[Any, ...]) -> None:
+        """Re-apply one 'R' record as ONE whole-request update — exactly how
+        the eager/inline paths that produce these records applied it (fused
+        work replays through chunk records instead), so float accumulation
+        rounds identically to the lost process."""
+        if isinstance(self._keyed, EagerKeyedState):
+            self._keyed.slot_for(key)
+            self._keyed.update(key, *args)
+        else:
+            self._keyed.slot_for(key)
+            self._keyed.ensure_capacity()
+            state = self._keyed.state_of(key)
+            self._keyed.set_state(key, self._metric.update_state(state, *args))
+
+    def _recover(self) -> None:
+        """Restart path: newest valid snapshot + exactly-once WAL replay."""
+        t0 = time.perf_counter()
+        found = self._ckpt_store.latest_valid(validate=self._validate_engine_snapshot)
+        if found is not None:
+            gen, snap = found
+            with self._dispatch_lock:
+                self._restore_keyed(snap.tree)
+            self._wal_seq = int(snap.tree.get("seq", -1))
+            if snap.tree["mode"] == "fused":
+                # chunk records reference slot ids; mappings introduced before
+                # the snapshot live in rotated-away segments, so seed the
+                # table from the snapshot's own slot map
+                self._replay_slot_keys = {
+                    slot: key for key, slot in snap.tree["slots"].items()
+                }
+            self.telemetry.count("recoveries")
+            _obs.record_ckpt_io(
+                "engine", "restore",
+                os.path.getsize(self._ckpt_store.path(gen)),
+                time.perf_counter() - t0, generation=gen,
+            )
+        if self._journal is not None:
+            replayed = 0
+            for seq, payload in self._journal.replay(after_seq=self._wal_seq):
+                try:
+                    with self._dispatch_lock:
+                        if payload[:1] == b"C":
+                            self._replay_chunk(payload)
+                        else:
+                            self._replay_request(*_decode_request_record(payload))
+                except Exception:  # noqa: BLE001 — it failed when first accepted too
+                    self.telemetry.count("failed")
+                replayed += 1
+                self._wal_seq = max(self._wal_seq, seq)
+            if replayed:
+                self.telemetry.count("replayed", replayed)
 
     def _run(self) -> None:
         while True:
@@ -408,6 +900,7 @@ class StreamingEngine:
                 with self._lock:
                     self._inflight = 0
                     self._idle.notify_all()
+                self._maybe_checkpoint()
             except BaseException as exc:  # noqa: BLE001 — dispatcher death: degrade, don't lose work
                 self._on_worker_death(exc, batch)
                 return
@@ -509,6 +1002,10 @@ class StreamingEngine:
             # makes the receipt mean "your rows are in the state", not "your rows are
             # enqueued"
             jax.block_until_ready(self._keyed.stacked)
+        # WAL after commit, before acks: an acknowledged chunk is always
+        # replayable, and a chunk whose trace failed is never journaled
+        if self._journal is not None:
+            self._journal_chunk(units, key_ids, mask, columns)
         self.telemetry.observe_batch(total_rows, bucket)
         now = time.perf_counter()
         for req, _, rows, is_last in units:
@@ -613,6 +1110,11 @@ class StreamingEngine:
         try:
             args = req.args if req.rows_done == 0 else tuple(a[req.rows_done :] for a in req.args)
             with _obs.engine_span("engine.inline", rows=req.rows), self._dispatch_lock:
+                # journal INSIDE the dispatch lock: a snapshot (same lock)
+                # must never record WAL coverage of a not-yet-applied request.
+                # Trimmed args keep rows already committed (and chunk-
+                # journaled) out of the record
+                self._journal_requests([req], args_override=args)
                 if isinstance(self._keyed, EagerKeyedState):
                     self._keyed.update(req.key, *args)
                 else:

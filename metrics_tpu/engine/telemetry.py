@@ -51,6 +51,12 @@ _COUNTERS = (
     "worker_deaths",      # dispatcher thread crashes survived
     "window_rotations",   # sliding-window segment rotations
     "key_growths",        # tenant-capacity doublings (each costs one recompile set)
+    # durable state plane (zero unless the engine was built with checkpoint=)
+    "checkpoints",          # snapshots committed (periodic + quiesce + close)
+    "checkpoint_failures",  # snapshot/serialize/commit failures absorbed
+    "wal_records",          # requests journaled ahead of their state commit
+    "replayed",             # journaled requests re-applied during recovery
+    "recoveries",           # restart-time restores from a valid snapshot
 )
 
 # distinguishes engines within one process; monotone so labels never collide

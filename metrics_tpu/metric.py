@@ -949,6 +949,32 @@ class Metric(ABC):
         if owns_check and strict:
             _raise_on_unconsumed(state_dict, prefix, consumed)
 
+    def save(self, path: str, *, policy: Any = None, meta: Optional[Dict[str, Any]] = None) -> None:
+        """Persist this metric's FULL state to ``path`` — atomic, checksummed,
+        lossless by default (see :mod:`metrics_tpu.ckpt`).
+
+        Unlike :meth:`state_dict` (reference-parity: persistent states only),
+        ``save`` captures every registered state plus update counts, so
+        ``restore`` on a fresh instance reproduces ``compute()`` bit-identically.
+        ``policy`` opts into the comm plane's lossy codecs (counts stay exact).
+        """
+        from metrics_tpu.ckpt import save as _ckpt_save
+
+        _ckpt_save(self, path, policy=policy, meta=meta)
+
+    def restore(self, path: str) -> Any:
+        """Load a :meth:`save` snapshot into this instance.
+
+        Strict: integrity (CRC) failures raise
+        :class:`~metrics_tpu.ckpt.CorruptSnapshotError`, schema/shape/dtype
+        mismatches raise :class:`~metrics_tpu.ckpt.CkptSchemaError`, and
+        missing/stray keys raise through the strict ``load_state_dict``
+        machinery — in every case this instance is left as it was.
+        """
+        from metrics_tpu.ckpt import restore as _ckpt_restore
+
+        return _ckpt_restore(self, path)
+
     def __getstate__(self) -> Dict[str, Any]:
         """Drop instance-wrapped fns for pickling (reference metric.py:587-591).
 

@@ -28,7 +28,7 @@ import itertools
 import math
 import threading
 import time
-from typing import Any, Callable, Dict, List
+from typing import Any, Callable, Dict, List, Optional
 
 from metrics_tpu.obs.registry import OBS, REGISTRY
 from metrics_tpu.obs.trace import _NULL_SPAN, TRACER
@@ -386,6 +386,51 @@ def set_comm_stale(site: str, stale: bool) -> None:
 
 def comm_span(name: str, **attrs: Any) -> Any:
     """Trace span for comm-plane internals (sync, gather, encode/decode)."""
+    if not OBS.enabled:
+        return _NULL_SPAN
+    return TRACER.span(name, **attrs)
+
+
+# ---------------------------------------------------------------------- ckpt plane
+
+CKPT_BYTES = REGISTRY.counter(
+    "metrics_tpu_ckpt_bytes_total",
+    "Cumulative snapshot bytes moved through the durable state plane, per site and op (write|restore).",
+)
+CKPT_SECONDS = REGISTRY.histogram(
+    "metrics_tpu_ckpt_seconds",
+    "Wall time of checkpoint writes and restores (serialize + commit / read + validate + apply).",
+)
+CKPT_FAILURES = REGISTRY.counter(
+    "metrics_tpu_ckpt_failures_total",
+    "Checkpoint operations that failed (and were absorbed, not raised), per site and op.",
+)
+CKPT_GENERATION = REGISTRY.gauge(
+    "metrics_tpu_ckpt_generation",
+    "Most recently committed (op=write) or recovered (op=restore) snapshot generation, per site.",
+)
+
+
+def record_ckpt_io(
+    site: str, op: str, nbytes: int, seconds: float, generation: Optional[int] = None
+) -> None:
+    """Account one checkpoint write/restore: bytes, latency, generation gauge."""
+    if not OBS.enabled:
+        return
+    CKPT_BYTES.inc(nbytes, site=site, op=op)
+    CKPT_SECONDS.observe(seconds, site=site, op=op)
+    if generation is not None:
+        CKPT_GENERATION.set(generation, site=site, op=op)
+
+
+def record_ckpt_failure(site: str, op: str) -> None:
+    if not OBS.enabled:
+        return
+    CKPT_FAILURES.inc(1, site=site, op=op)
+
+
+def ckpt_span(name: str, **attrs: Any) -> Any:
+    """Trace span for durable-state-plane internals (serialize, commit, restore)."""
     if not OBS.enabled:
         return _NULL_SPAN
     return TRACER.span(name, **attrs)

@@ -1,0 +1,242 @@
+"""StreamingEngine durable state plane: periodic snapshots, WAL exactly-once
+replay, restart recovery, windowed/eager/degraded modes, checkpoint overhead
+isolation. The 10k-request restart soak rides ``-m slow`` (CI ckpt-soak job)."""
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.classification import BinaryAccuracy, BinaryAUROC
+from metrics_tpu.engine import CheckpointConfig, StreamingEngine
+from metrics_tpu.regression import MeanSquaredError
+
+
+def _stream(seed, n, keys=4, rows=4, float_data=False):
+    rng = np.random.default_rng(seed)
+    draw = (lambda: rng.random(rows, dtype=np.float32)) if float_data else (
+        lambda: rng.integers(0, 2, rows)
+    )
+    return [(f"k{rng.integers(0, keys)}", draw(), draw()) for _ in range(n)]
+
+
+def _oracles(stream, factory):
+    oracles = {}
+    for key, p, t in stream:
+        oracles.setdefault(key, factory()).update(jnp.asarray(p), jnp.asarray(t))
+    return oracles
+
+
+def _cfg(tmp_path, **kw):
+    kw.setdefault("interval_s", 3600.0)  # periodic off unless the test wants it
+    kw.setdefault("durable", False)
+    return CheckpointConfig(directory=str(tmp_path), **kw)
+
+
+class TestSnapshotAndRecover:
+    def test_restart_recovers_snapshot_plus_wal_exactly_once(self, tmp_path):
+        stream = _stream(0, 300)
+        cfg = _cfg(tmp_path)
+        e1 = StreamingEngine(BinaryAccuracy(), buckets=(8, 32), checkpoint=cfg)
+        for key, p, t in stream[:120]:
+            e1.submit(key, jnp.asarray(p), jnp.asarray(t))
+        gen = e1.checkpoint_now()  # snapshot covers the first 120
+        assert gen == 0
+        for key, p, t in stream[120:200]:  # these live only in the WAL
+            e1.submit(key, jnp.asarray(p), jnp.asarray(t))
+        e1.flush()
+        e1.close(checkpoint=False)  # crash-style: no final snapshot
+
+        e2 = StreamingEngine(BinaryAccuracy(), buckets=(8, 32), checkpoint=cfg)
+        snap = e2.telemetry_snapshot()
+        assert snap["recoveries"] == 1
+        assert snap["replayed"] >= 1  # the post-snapshot chunk records, once each
+        for key, p, t in stream[200:]:
+            e2.submit(key, jnp.asarray(p), jnp.asarray(t))
+        e2.flush()
+        for key, oracle in _oracles(stream, BinaryAccuracy).items():
+            assert float(e2.compute(key)) == float(oracle.compute()), key
+        e2.close()
+
+    def test_periodic_snapshots_land_without_explicit_calls(self, tmp_path):
+        cfg = _cfg(tmp_path, interval_s=0.01)
+        engine = StreamingEngine(BinaryAccuracy(), buckets=(8,), checkpoint=cfg)
+        for key, p, t in _stream(1, 150):
+            engine.submit(key, jnp.asarray(p), jnp.asarray(t))
+            time.sleep(0.0005)
+        engine.flush()
+        snap = engine.telemetry_snapshot()
+        assert snap["checkpoints"] >= 1
+        assert snap["wal_records"] >= 1  # chunk records, one per dispatched micro-batch
+        engine.close()
+
+    def test_clean_close_needs_no_replay(self, tmp_path):
+        stream = _stream(2, 200)
+        cfg = _cfg(tmp_path)
+        e1 = StreamingEngine(BinaryAccuracy(), buckets=(8, 32), checkpoint=cfg)
+        for key, p, t in stream:
+            e1.submit(key, jnp.asarray(p), jnp.asarray(t))
+        e1.close()  # final snapshot + WAL rotation
+        e2 = StreamingEngine(BinaryAccuracy(), buckets=(8, 32), checkpoint=cfg)
+        snap = e2.telemetry_snapshot()
+        assert snap["recoveries"] == 1 and snap["replayed"] == 0
+        for key, oracle in _oracles(stream, BinaryAccuracy).items():
+            assert float(e2.compute(key)) == float(oracle.compute())
+        e2.close()
+
+    def test_corrupt_newest_generation_falls_back(self, tmp_path):
+        from metrics_tpu.ckpt.faults import flip_bit
+
+        stream = _stream(3, 200)
+        cfg = _cfg(tmp_path, wal=False)  # isolate snapshot fallback
+        e1 = StreamingEngine(BinaryAccuracy(), buckets=(8, 32), checkpoint=cfg)
+        for key, p, t in stream[:100]:
+            e1.submit(key, jnp.asarray(p), jnp.asarray(t))
+        e1.checkpoint_now()
+        for key, p, t in stream[100:]:
+            e1.submit(key, jnp.asarray(p), jnp.asarray(t))
+        e1.flush()
+        gen2 = e1.checkpoint_now()
+        e1.close(checkpoint=False)
+        flip_bit(e1._ckpt_store.path(gen2))
+        e2 = StreamingEngine(BinaryAccuracy(), buckets=(8, 32), checkpoint=cfg)
+        assert e2.telemetry_snapshot()["recoveries"] == 1
+        # recovered the older intact generation = first 100 requests
+        for key, oracle in _oracles(stream[:100], BinaryAccuracy).items():
+            assert float(e2.compute(key)) == float(oracle.compute())
+        e2.close()
+
+    def test_no_snapshot_no_wal_starts_fresh(self, tmp_path):
+        cfg = _cfg(tmp_path)
+        engine = StreamingEngine(BinaryAccuracy(), buckets=(8,), checkpoint=cfg)
+        assert engine.telemetry_snapshot()["recoveries"] == 0
+        assert engine._keyed.keys == ()
+        engine.close()
+
+
+class TestModesAndShapes:
+    def test_eager_metric_checkpoints_too(self, tmp_path):
+        # BinaryAUROC(thresholds=None) holds ragged cat states -> eager regime
+        rng = np.random.default_rng(4)
+        stream = [
+            (f"k{rng.integers(0, 3)}", rng.random(4, dtype=np.float32), rng.integers(0, 2, 4))
+            for _ in range(60)
+        ]
+        cfg = _cfg(tmp_path)
+        e1 = StreamingEngine(BinaryAUROC(thresholds=None), buckets=(8,), checkpoint=cfg)
+        assert not e1.fused
+        for key, p, t in stream[:40]:
+            e1.submit(key, jnp.asarray(p), jnp.asarray(t))
+        e1.checkpoint_now()
+        for key, p, t in stream[40:]:
+            e1.submit(key, jnp.asarray(p), jnp.asarray(t))
+        e1.flush()
+        e1.close(checkpoint=False)
+        e2 = StreamingEngine(BinaryAUROC(thresholds=None), buckets=(8,), checkpoint=cfg)
+        assert e2.telemetry_snapshot()["replayed"] == 20
+        for key, oracle in _oracles(stream, lambda: BinaryAUROC(thresholds=None)).items():
+            assert float(e2.compute(key)) == float(oracle.compute()), key
+        e2.close()
+
+    def test_float_states_restore_bit_identical(self, tmp_path):
+        # float sums depend on accumulation order, so the bit-identity claim is
+        # vs an UNINTERRUPTED engine fed the same stream one request at a time
+        # (per-row streaming order), not vs a batch oracle
+        stream = _stream(5, 200, float_data=True)
+        cfg = _cfg(tmp_path)
+        e1 = StreamingEngine(MeanSquaredError(), buckets=(8, 32), checkpoint=cfg)
+        twin = StreamingEngine(MeanSquaredError(), buckets=(8, 32))
+        for i, (key, p, t) in enumerate(stream):
+            e1.submit(key, jnp.asarray(p), jnp.asarray(t))
+            twin.submit(key, jnp.asarray(p), jnp.asarray(t))
+            if i == 120:
+                e1.checkpoint_now()  # the tail rides the WAL -> replay path
+        e1.flush()
+        e1.close(checkpoint=False)
+        twin.flush()
+        e2 = StreamingEngine(MeanSquaredError(), buckets=(8, 32), checkpoint=cfg)
+        assert e2.telemetry_snapshot()["replayed"] >= 1
+        for key in {k for k, _, _ in stream}:
+            assert float(e2.compute(key)) == float(twin.compute(key)), key
+        e2.close()
+        twin.close()
+
+    def test_windowed_engine_restores_ring(self, tmp_path):
+        stream = _stream(6, 120)
+        cfg = _cfg(tmp_path)
+        e1 = StreamingEngine(BinaryAccuracy(), buckets=(8,), window=3, checkpoint=cfg)
+        for i, (key, p, t) in enumerate(stream):
+            e1.submit(key, jnp.asarray(p), jnp.asarray(t))
+            if i in (40, 80):
+                e1.rotate_window()
+        e1.close()
+        e2 = StreamingEngine(BinaryAccuracy(), buckets=(8,), window=3, checkpoint=cfg)
+        e1_again = StreamingEngine(BinaryAccuracy(), buckets=(8,), window=3)
+        for i, (key, p, t) in enumerate(stream):
+            e1_again.submit(key, jnp.asarray(p), jnp.asarray(t))
+            if i in (40, 80):
+                e1_again.rotate_window()
+        e1_again.flush()
+        for key in {k for k, _, _ in stream}:
+            assert float(e2.compute(key, window=True)) == float(
+                e1_again.compute(key, window=True)
+            ), key
+        e2.close()
+        e1_again.close()
+
+    def test_schema_mismatch_snapshot_skipped(self, tmp_path):
+        stream = _stream(7, 100)
+        cfg = _cfg(tmp_path, wal=False)
+        e1 = StreamingEngine(BinaryAccuracy(), buckets=(8,), checkpoint=cfg)
+        for key, p, t in stream:
+            e1.submit(key, jnp.asarray(p), jnp.asarray(t))
+        e1.close()
+        # a different metric's engine must NOT recover BinaryAccuracy state
+        e2 = StreamingEngine(MeanSquaredError(), buckets=(8,), checkpoint=cfg)
+        assert e2.telemetry_snapshot()["recoveries"] == 0
+        assert e2._ckpt_store.last_skipped  # it saw and rejected the snapshot
+        e2.close(checkpoint=False)
+
+
+class TestDegradedMode:
+    def test_inline_submits_are_journaled(self, tmp_path):
+        stream = _stream(8, 60)
+        cfg = _cfg(tmp_path)
+        engine = StreamingEngine(BinaryAccuracy(), buckets=(8,), checkpoint=cfg, start=False)
+        for key, p, t in stream:  # no dispatcher: every submit runs inline
+            engine.submit(key, jnp.asarray(p), jnp.asarray(t))
+        snap = engine.telemetry_snapshot()
+        assert snap["inline_dispatches"] == 60 and snap["wal_records"] == 60
+        engine.close(checkpoint=False)
+        e2 = StreamingEngine(BinaryAccuracy(), buckets=(8,), checkpoint=cfg)
+        assert e2.telemetry_snapshot()["replayed"] == 60
+        for key, oracle in _oracles(stream, BinaryAccuracy).items():
+            assert float(e2.compute(key)) == float(oracle.compute())
+        e2.close()
+
+
+@pytest.mark.slow
+class TestRestartSoak:
+    def test_10k_stream_with_mid_stream_restart_bit_identical(self, tmp_path):
+        """Acceptance: snapshots + WAL replay reproduce compute() bit-identically
+        vs an uninterrupted run on a 10k-request stream with a restart."""
+        stream = _stream(9, 10_000, keys=16)
+        cfg = CheckpointConfig(directory=str(tmp_path), interval_s=0.05, durable=False)
+        cut = 6_000
+        e1 = StreamingEngine(BinaryAccuracy(), buckets=(16, 64), checkpoint=cfg)
+        for key, p, t in stream[:cut]:
+            e1.submit(key, jnp.asarray(p), jnp.asarray(t))
+        e1.flush()
+        e1.close(checkpoint=False)  # restart mid-stream, no final snapshot
+
+        e2 = StreamingEngine(BinaryAccuracy(), buckets=(16, 64), checkpoint=cfg)
+        s = e2.telemetry_snapshot()
+        assert s["recoveries"] == 1
+        assert s["replayed"] >= 1  # periodic snapshots mean SOME tail replays
+        for key, p, t in stream[cut:]:
+            e2.submit(key, jnp.asarray(p), jnp.asarray(t))
+        e2.flush()
+        for key, oracle in _oracles(stream, BinaryAccuracy).items():
+            assert float(e2.compute(key)) == float(oracle.compute()), key
+        e2.close()

@@ -1,0 +1,151 @@
+"""Snapshot wire format: round trips, codec policy, corruption detection."""
+
+import numpy as np
+import pytest
+
+from metrics_tpu.ckpt import CorruptSnapshotError, dumps, loads, read_manifest
+from metrics_tpu.ckpt import format as ckpt_format
+from metrics_tpu.comm.codec import CodecPolicy
+
+
+def _sample_tree():
+    rng = np.random.default_rng(0)
+    return {
+        "fixed": rng.standard_normal((3, 4)).astype(np.float32),
+        "counts": np.arange(6, dtype=np.int64),
+        "flag": np.zeros((), np.bool_),
+        "cat": [rng.standard_normal(5).astype(np.float32), np.zeros(0, np.float32)],
+        "empty_list": [],
+        "nested": {"tup": (np.float16(2.5), [1, 2]), "none": None, "s": "label"},
+        "scalars": {"i": 7, "f": 1.25, "b": True},
+        "opaque": {(1, "non-str-key"): b"payload"},
+        "_update_count": np.int32(11),
+    }
+
+
+class TestRoundTrip:
+    def test_lossless_bit_identical(self):
+        tree = _sample_tree()
+        snap = loads(dumps(tree, meta={"step": 3}, schema_version=2))
+        assert snap.schema_version == 2
+        assert snap.meta == {"step": 3}
+        assert np.array_equal(snap.tree["fixed"], tree["fixed"])
+        assert snap.tree["fixed"].dtype == np.float32
+        assert np.array_equal(snap.tree["counts"], tree["counts"])
+        assert snap.tree["counts"].dtype == np.int64
+        assert snap.tree["flag"].dtype == np.bool_
+        assert isinstance(snap.tree["cat"], list) and len(snap.tree["cat"]) == 2
+        assert np.array_equal(snap.tree["cat"][0], tree["cat"][0])
+        assert snap.tree["cat"][1].shape == (0,)
+        assert snap.tree["empty_list"] == []
+        assert isinstance(snap.tree["nested"]["tup"], tuple)
+        assert snap.tree["nested"]["none"] is None
+        assert snap.tree["scalars"] == {"i": 7, "f": 1.25, "b": True}
+        assert snap.tree["scalars"]["b"] is True
+        assert snap.tree["opaque"] == {(1, "non-str-key"): b"payload"}
+        assert int(snap.tree["_update_count"]) == 11
+
+    def test_zero_dim_and_weird_dtypes(self):
+        tree = {
+            "scalar": np.float64(3.5),
+            "u8": np.arange(4, dtype=np.uint8),
+            "c64": np.array([1 + 2j], dtype=np.complex64),
+        }
+        out = loads(dumps(tree)).tree
+        assert out["scalar"].shape == () and float(out["scalar"]) == 3.5
+        assert out["u8"].dtype == np.uint8
+        assert out["c64"].dtype == np.complex64 and out["c64"][0] == 1 + 2j
+
+    def test_bfloat16_round_trip(self):
+        import ml_dtypes
+
+        x = np.arange(8, dtype=np.float32).astype(ml_dtypes.bfloat16)
+        out = loads(dumps({"x": x})).tree["x"]
+        assert out.dtype == ml_dtypes.bfloat16
+        assert np.array_equal(out.astype(np.float32), x.astype(np.float32))
+
+    def test_nan_inf_survive(self):
+        x = np.array([np.nan, np.inf, -np.inf, 0.0], np.float32)
+        out = loads(dumps({"x": x})).tree["x"]
+        assert np.array_equal(out, x, equal_nan=True)
+
+
+class TestCodecPolicy:
+    def test_default_is_lossless(self):
+        tree = _sample_tree()
+        manifest = read_manifest(dumps(tree))
+        assert all(
+            leaf["codec"] == "lossless" for leaf in manifest["leaves"] if leaf["kind"] == "array"
+        )
+
+    def test_lossy_policy_quantizes_large_floats_keeps_counts_exact(self):
+        rng = np.random.default_rng(1)
+        tree = {
+            "scores": rng.standard_normal(8192).astype(np.float32),
+            "tiny": rng.standard_normal(8).astype(np.float32),
+            "counts": np.arange(100, dtype=np.int64),
+            "_update_count": np.int32(9),
+        }
+        policy = CodecPolicy(lossy="int8")
+        blob = dumps(tree, policy=policy, reductions={"scores": "cat", "tiny": "cat"})
+        lossless = dumps(tree)
+        assert len(blob) < len(lossless) / 2.5  # the big leaf actually shrank
+        snap = loads(blob)
+        # counts and the small leaf are bit-exact; the quantized leaf is bounded
+        assert np.array_equal(snap.tree["counts"], tree["counts"])
+        assert int(snap.tree["_update_count"]) == 9
+        assert np.array_equal(snap.tree["tiny"], tree["tiny"])
+        err = np.abs(snap.tree["scores"] - tree["scores"])
+        assert err.max() > 0  # it did quantize
+        # blockwise int8 bound: absmax_block / 254 per element
+        blocks = tree["scores"].reshape(-1, 1024)
+        bound = np.repeat(np.abs(blocks).max(axis=1) / 254.0, 1024)
+        assert np.all(err <= bound + 1e-7)
+
+    def test_reducible_states_stay_lossless_under_lossy_policy(self):
+        tree = {"total": np.random.default_rng(2).standard_normal(8192).astype(np.float32)}
+        blob = dumps(tree, policy=CodecPolicy(lossy="int8"), reductions={"total": "sum"})
+        assert np.array_equal(loads(blob).tree["total"], tree["total"])
+
+
+class TestCorruption:
+    def test_bad_magic(self):
+        blob = dumps(_sample_tree())
+        with pytest.raises(CorruptSnapshotError, match="magic"):
+            loads(b"NOTMAGIC" + blob[8:])
+
+    @pytest.mark.parametrize("frac", [0.0, 0.1, 0.5, 0.95])
+    def test_truncation_always_detected(self, frac):
+        blob = dumps(_sample_tree())
+        with pytest.raises(CorruptSnapshotError):
+            loads(blob[: int(len(blob) * frac)])
+
+    def test_bit_flips_in_manifest_and_payload_detected(self):
+        blob = dumps({"x": np.arange(64, dtype=np.float32)})
+        for off in (len(ckpt_format.MAGIC) + 13, len(blob) - 5):  # manifest / payload
+            bad = bytearray(blob)
+            bad[off] ^= 0x10
+            with pytest.raises(CorruptSnapshotError):
+                loads(bytes(bad))
+
+    def test_read_manifest_checks_crc_without_payloads(self):
+        blob = dumps(_sample_tree())
+        assert read_manifest(blob)["format_version"] == ckpt_format.FORMAT_VERSION
+        bad = bytearray(blob)
+        bad[len(ckpt_format.MAGIC) + 14] ^= 1  # inside the manifest JSON
+        with pytest.raises(CorruptSnapshotError):
+            read_manifest(bytes(bad))
+
+    def test_unknown_format_version_rejected(self):
+        import json
+        import struct
+        import zlib
+
+        blob = dumps({"x": np.ones(2)})
+        manifest = read_manifest(blob)
+        manifest["format_version"] = 99
+        mbytes = json.dumps(manifest, separators=(",", ":")).encode()
+        header = ckpt_format.MAGIC + struct.pack("<QI", len(mbytes), zlib.crc32(mbytes) & 0xFFFFFFFF)
+        payload = blob[len(blob) - manifest["payload_nbytes"]:]
+        with pytest.raises(CorruptSnapshotError, match="format_version"):
+            loads(header + mbytes + payload)

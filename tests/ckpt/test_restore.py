@@ -1,0 +1,294 @@
+"""save()/restore(): bit-identical round trips, strict validation, migrations,
+and the compute-group aliasing regression (restore must never leave group
+members serving stale pre-restore state)."""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import CatMetric, MetricCollection, MetricTracker, MinMaxMetric, ckpt
+from metrics_tpu.classification import (
+    BinaryPrecisionRecallCurve,
+    MulticlassAccuracy,
+    MulticlassF1Score,
+    MulticlassPrecision,
+    MulticlassRecall,
+)
+from metrics_tpu.regression import MeanSquaredError, PearsonCorrCoef
+
+
+@pytest.fixture
+def data():
+    rng = np.random.default_rng(0)
+    probs = rng.random((48, 5)).astype(np.float32)
+    probs /= probs.sum(-1, keepdims=True)
+    return jnp.asarray(probs), jnp.asarray(rng.integers(0, 5, 48))
+
+
+def _tree_equal(a, b):
+    import jax
+
+    jax.tree_util.tree_map(
+        lambda x, y: np.testing.assert_array_equal(np.asarray(x), np.asarray(y)), a, b
+    )
+
+
+class TestRoundTrip:
+    def test_metric_bit_identical_and_resumable(self, data, tmp_path):
+        probs, target = data
+        path = str(tmp_path / "m.ckpt")
+        m = MulticlassAccuracy(5, average="macro")
+        m.update(probs[:30], target[:30])
+        m.save(path)
+        m2 = MulticlassAccuracy(5, average="macro")
+        m2.restore(path)
+        assert m2._update_count == m._update_count
+        assert np.array_equal(np.asarray(m2.compute()), np.asarray(m.compute()))
+        # resuming the stream from the restored instance stays bit-identical
+        m.update(probs[30:], target[30:])
+        m2.update(probs[30:], target[30:])
+        assert np.array_equal(np.asarray(m2.compute()), np.asarray(m.compute()))
+
+    def test_save_captures_full_state_without_persistent_flags(self, data, tmp_path):
+        probs, target = data
+        path = str(tmp_path / "m.ckpt")
+        m = MulticlassAccuracy(5, average="micro")  # states default persistent=False
+        m.update(probs, target)
+        assert m.state_dict() == {}  # parity semantics untouched...
+        m.save(path)  # ...but save captures everything
+        assert m.state_dict() == {}  # and does not permanently flip the flags
+        m2 = MulticlassAccuracy(5, average="micro")
+        m2.restore(path)
+        assert float(m2.compute()) == float(m.compute())
+
+    def test_cat_state_metric(self, data, tmp_path):
+        probs, target = data
+        path = str(tmp_path / "curve.ckpt")
+        c = BinaryPrecisionRecallCurve(thresholds=None)
+        c.update(probs[:, 0], (target == 0).astype(jnp.int32))
+        c.save(path)
+        c2 = BinaryPrecisionRecallCurve(thresholds=None)
+        c2.restore(path)
+        _tree_equal(list(c2.compute()), list(c.compute()))
+
+    def test_wrapper_extras_round_trip(self, data, tmp_path):
+        probs, target = data
+        path = str(tmp_path / "mm.ckpt")
+        w = MinMaxMetric(MulticlassAccuracy(5, average="micro"))
+        w.update(probs[:16], target[:16])
+        w.compute()
+        w.update(probs[16:], target[16:])
+        w.save(path)
+        w2 = MinMaxMetric(MulticlassAccuracy(5, average="micro"))
+        w2.restore(path)
+        _tree_equal(w2.compute(), w.compute())
+
+    def test_tracker_dynamic_history(self, data, tmp_path):
+        probs, target = data
+        path = str(tmp_path / "tr.ckpt")
+        tr = MetricTracker(MulticlassAccuracy(5, average="micro"))
+        for lo in (0, 24):
+            tr.increment()
+            tr.update(probs[lo : lo + 24], target[lo : lo + 24])
+        ckpt.save(tr, path)
+        fresh = MetricTracker(MulticlassAccuracy(5, average="micro"))
+        ckpt.restore(fresh, path)
+        assert fresh.n_steps == 2
+        _tree_equal(fresh.compute_all(), tr.compute_all())
+
+    def test_lossy_policy_bounded_not_identical(self, tmp_path):
+        from metrics_tpu.comm.codec import CodecPolicy
+
+        path = str(tmp_path / "cat.ckpt")
+        m = CatMetric()
+        big = np.random.default_rng(1).standard_normal(8192).astype(np.float32)
+        m.update(jnp.asarray(big))
+        ckpt.save(m, path, policy=CodecPolicy(lossy="int8"))
+        m2 = CatMetric()
+        m2.restore(path)
+        got = np.asarray(m2.compute())
+        assert not np.array_equal(got, big)  # it did quantize...
+        assert np.max(np.abs(got - big)) < np.abs(big).max() / 100  # ...within bound
+
+
+class TestComputeGroupAliasing:
+    """Satellite regression: restoring a grouped collection re-establishes the
+    leader→member state aliasing and drops every stale cache."""
+
+    def _grouped(self):
+        return MetricCollection(
+            [MulticlassPrecision(5), MulticlassRecall(5), MulticlassF1Score(5)],
+            compute_groups=True,
+        )
+
+    def test_restore_into_fresh_collection(self, data, tmp_path):
+        probs, target = data
+        path = str(tmp_path / "col.ckpt")
+        col = self._grouped()
+        col.update(probs, target)
+        assert len(col.compute_groups) == 1  # sanity: they really grouped
+        col.save(path)
+        fresh = self._grouped()
+        fresh.restore(path)
+        _tree_equal(fresh.compute(), col.compute())
+        # post-restore updates flow through the group machinery identically
+        col.update(probs[:10], target[:10])
+        fresh.update(probs[:10], target[:10])
+        _tree_equal(fresh.compute(), col.compute())
+
+    def test_restore_over_live_collection_drops_stale_state(self, data, tmp_path):
+        probs, target = data
+        path = str(tmp_path / "col.ckpt")
+        col = self._grouped()
+        col.update(probs[:20], target[:20])
+        expected = col.compute()
+        col.save(path)
+        # advance the live collection past the snapshot AND cache computes
+        col.update(probs[20:], target[20:])
+        advanced = col.compute()
+        assert not all(
+            np.array_equal(np.asarray(expected[k]), np.asarray(advanced[k])) for k in expected
+        )
+        col.restore(path)
+        # every member (leaders AND aliased members) serves the snapshot state,
+        # not its cached compute or its pre-restore arrays
+        _tree_equal(col.compute(), expected)
+        for name, member in col.items(copy_state=False):
+            assert member._computed is None or np.array_equal(
+                np.asarray(member.compute()), np.asarray(expected[name])
+            )
+
+    def test_members_alias_leader_arrays_after_restore(self, data, tmp_path):
+        probs, target = data
+        path = str(tmp_path / "col.ckpt")
+        col = self._grouped()
+        col.update(probs, target)
+        col.save(path)
+        col.restore(path)
+        group = next(iter(col.compute_groups.values()))
+        leader = col._modules[group[0]]
+        for name in group[1:]:
+            member = col._modules[name]
+            for state in leader._defaults:
+                assert getattr(member, state) is getattr(leader, state), (
+                    f"{name}.{state} does not alias the leader's restored array"
+                )
+
+
+class TestStrictValidation:
+    def test_wrong_metric_class_missing_keys(self, data, tmp_path):
+        probs, target = data
+        path = str(tmp_path / "m.ckpt")
+        m = MulticlassAccuracy(5)
+        m.update(probs, target)
+        m.save(path)
+        wrong = PearsonCorrCoef()
+        with pytest.raises((ckpt.CkptSchemaError, KeyError)):
+            wrong.restore(path)
+        # the failed restore left the instance untouched
+        assert wrong._update_count == 0
+        assert float(np.asarray(wrong.n_total)) == 0
+
+    def test_shape_mismatch_raises_schema_error(self, data, tmp_path):
+        probs, target = data
+        path = str(tmp_path / "m.ckpt")
+        m = MulticlassAccuracy(5)
+        m.update(probs, target)
+        m.save(path)
+        other = MulticlassAccuracy(7)  # same states, different num_classes shape
+        with pytest.raises(ckpt.CkptSchemaError, match="shape"):
+            other.restore(path)
+
+    def test_dtype_mismatch_raises_schema_error(self, tmp_path):
+        path = str(tmp_path / "m.ckpt")
+        m = MeanSquaredError()
+        m.update(jnp.asarray([1.0, 2.0]), jnp.asarray([1.0, 1.0]))
+        m.save(path)
+        other = MeanSquaredError().set_dtype(jnp.float16)
+        with pytest.raises(ckpt.CkptSchemaError, match="dtype"):
+            other.restore(path)
+
+    def test_collection_vs_metric_kind_mismatch(self, data, tmp_path):
+        probs, target = data
+        path = str(tmp_path / "m.ckpt")
+        m = MulticlassAccuracy(5)
+        m.update(probs, target)
+        m.save(path)
+        col = MetricCollection([MulticlassAccuracy(5)])
+        with pytest.raises(ckpt.CkptSchemaError, match="kind|holds"):
+            col.restore(path)
+
+    def test_corrupt_file_raises_corrupt_error(self, data, tmp_path):
+        probs, target = data
+        path = str(tmp_path / "m.ckpt")
+        m = MulticlassAccuracy(5)
+        m.update(probs, target)
+        m.save(path)
+        with open(path, "r+b") as f:
+            f.seek(os.path.getsize(path) // 2)
+            f.write(b"\x00\x01\x02")
+        with pytest.raises(ckpt.CorruptSnapshotError):
+            MulticlassAccuracy(5).restore(path)
+
+
+class TestMigrations:
+    @pytest.fixture(autouse=True)
+    def _clean_registry(self):
+        ckpt.clear_migrations()
+        yield
+        ckpt.clear_migrations()
+
+    def _old_snapshot(self, path, data):
+        """Write a v0 snapshot whose state_dict uses a legacy key name."""
+        from metrics_tpu.ckpt.restore import _build_tree
+
+        probs, target = data
+        m = MulticlassAccuracy(5)
+        m.update(probs, target)
+        tree, _ = _build_tree(m)
+        sd = tree["state_dict"]
+        sd["true_positives"] = sd.pop("tp")  # simulate an old schema
+        blob = ckpt.dumps(tree, schema_version=0, meta={"v": "old"})
+        with open(path, "wb") as f:
+            f.write(blob)
+        return m
+
+    def test_migration_hook_bridges_old_schema(self, data, tmp_path):
+        path = str(tmp_path / "old.ckpt")
+        original = self._old_snapshot(path, data)
+
+        def to_v1(tree, meta):
+            sd = dict(tree["state_dict"])
+            sd["tp"] = sd.pop("true_positives")
+            return {**tree, "state_dict": sd}
+
+        ckpt.register_migration(0, to_v1)
+        fresh = MulticlassAccuracy(5)
+        fresh.restore(path)
+        assert float(fresh.compute()) == float(original.compute())
+
+    def test_missing_migration_refuses(self, data, tmp_path):
+        path = str(tmp_path / "old.ckpt")
+        self._old_snapshot(path, data)
+        with pytest.raises(ckpt.CkptSchemaError, match="migration"):
+            MulticlassAccuracy(5).restore(path)
+
+    def test_newer_schema_refuses(self, data, tmp_path):
+        probs, target = data
+        path = str(tmp_path / "future.ckpt")
+        from metrics_tpu.ckpt.restore import _build_tree
+
+        m = MulticlassAccuracy(5)
+        m.update(probs, target)
+        tree, _ = _build_tree(m)
+        with open(path, "wb") as f:
+            f.write(ckpt.dumps(tree, schema_version=ckpt.CKPT_SCHEMA_VERSION + 1))
+        with pytest.raises(ckpt.CkptSchemaError, match="NEWER"):
+            MulticlassAccuracy(5).restore(path)
+
+    def test_duplicate_registration_raises(self):
+        ckpt.register_migration(0, lambda t, m: t)
+        with pytest.raises(ValueError, match="already registered"):
+            ckpt.register_migration(0, lambda t, m: t)

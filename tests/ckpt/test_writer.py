@@ -1,0 +1,87 @@
+"""AsyncCheckpointer: background commits, bounded staleness, absorbed failures."""
+
+import numpy as np
+import pytest
+
+from metrics_tpu.ckpt import AsyncCheckpointer, SnapshotStore
+from metrics_tpu.ckpt.faults import DiskFull
+
+
+def _view(val=1.0):
+    return lambda: ({"x": np.full(8, val, np.float32)}, {"val": val})
+
+
+@pytest.fixture
+def store(tmp_path):
+    return SnapshotStore(str(tmp_path), retain=4, durable=False)
+
+
+class TestAsyncWrites:
+    def test_background_commit_lands(self, store):
+        w = AsyncCheckpointer(store, interval_s=0.0)
+        assert w.maybe_checkpoint(_view(3.0))
+        w.quiesce(timeout=10.0)
+        w.close()
+        assert w.writes == 1 and w.last_generation == 0
+        gen, snap = store.latest_valid()
+        assert float(snap.tree["x"][0]) == 3.0 and snap.meta["val"] == 3.0
+
+    def test_interval_gates_submissions(self, store):
+        w = AsyncCheckpointer(store, interval_s=3600.0)
+        assert w.maybe_checkpoint(_view()) is False  # not due yet (fresh timer)
+        assert w.maybe_checkpoint(_view(), force=True)
+        w.quiesce(timeout=10.0)
+        w.close()
+        assert w.writes == 1
+
+    def test_busy_writer_skips_not_queues(self, store):
+        w = AsyncCheckpointer(store, interval_s=0.0)
+        calls = []
+        # simulate an in-flight write holding the writer: a due snapshot is
+        # SKIPPED (bounded staleness), never queued behind it, and the
+        # snapshot function is not even called
+        w._idle.clear()
+        assert w.maybe_checkpoint(lambda: calls.append(1) or ({"x": np.ones(1)}, None)) is False
+        assert w.skipped == 1 and calls == []
+        w._idle.set()
+        w.close()
+
+    def test_checkpoint_sync_returns_generation(self, store):
+        w = AsyncCheckpointer(store, interval_s=3600.0)
+        assert w.checkpoint_sync(_view(7.0)) == 0
+        assert w.checkpoint_sync(_view(8.0)) == 1
+        w.close()
+        gen, snap = store.latest_valid()
+        assert gen == 1 and float(snap.tree["x"][0]) == 8.0
+
+    def test_on_commit_hook_sees_generation_and_tree(self, store):
+        seen = []
+        w = AsyncCheckpointer(store, interval_s=0.0, on_commit=lambda g, t, m: seen.append((g, m)))
+        w.checkpoint_sync(_view(5.0))
+        w.close()
+        assert seen == [(0, {"val": 5.0})]
+
+
+class TestFailureAbsorption:
+    def test_failed_write_counted_not_raised(self, store):
+        errors = []
+        w = AsyncCheckpointer(store, interval_s=0.0, on_error=errors.append)
+        with DiskFull():
+            assert w.checkpoint_sync(_view()) is None
+        assert w.failures == 1
+        assert isinstance(w.last_error, OSError)
+        assert len(errors) == 1
+        # the writer recovers on the next attempt
+        assert w.checkpoint_sync(_view(2.0)) == 0
+        w.close()
+
+    def test_unserializable_tree_absorbed(self, store):
+        w = AsyncCheckpointer(store, interval_s=0.0)
+
+        class Evil:
+            def __reduce__(self):
+                raise RuntimeError("nope")
+
+        assert w.checkpoint_sync(lambda: ({"bad": Evil()}, None)) is None
+        assert w.failures == 1
+        w.close()

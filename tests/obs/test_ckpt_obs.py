@@ -1,0 +1,102 @@
+"""Ckpt series exposure: Prometheus rendering + jsonl emitter (ISSUE 4 satellite).
+
+The durable state plane's bytes/latency/generation/failure series must surface
+through the same two exits as the rest of the stack — and stay completely
+silent when ``obs`` is disabled (the ckpt hooks are master-gated automatic
+instrumentation, unlike the engine's always-on telemetry)."""
+
+from __future__ import annotations
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import obs
+from metrics_tpu.ckpt import AsyncCheckpointer, SnapshotStore
+from metrics_tpu.ckpt.faults import DiskFull
+from metrics_tpu.classification import BinaryAccuracy
+
+from tests.obs.prom_grammar import parse as parse_prometheus
+
+_FAMILIES = (
+    "metrics_tpu_ckpt_bytes_total",
+    "metrics_tpu_ckpt_seconds",
+    "metrics_tpu_ckpt_failures_total",
+    "metrics_tpu_ckpt_generation",
+)
+
+
+@pytest.fixture
+def ckpt_activity_done(tmp_path):
+    """One metric save+restore, one engine-writer commit, one absorbed failure."""
+    obs.enable()
+    m = BinaryAccuracy()
+    m.update(jnp.asarray([1, 0, 1]), jnp.asarray([1, 1, 1]))
+    path = str(tmp_path / "m.ckpt")
+    m.save(path)
+    BinaryAccuracy().restore(path)
+    store = SnapshotStore(str(tmp_path / "store"), durable=False)
+    w = AsyncCheckpointer(store, interval_s=0.0, site="engine")
+    w.checkpoint_sync(lambda: ({"x": np.ones(4, np.float32)}, None))
+    with DiskFull():
+        w.checkpoint_sync(lambda: ({"x": np.ones(4, np.float32)}, None))
+    w.close()
+    return path
+
+
+class TestPrometheusExposure:
+    def test_ckpt_series_render(self, ckpt_activity_done):
+        text = obs.render_prometheus()
+        parse_prometheus(text)  # grammar-valid exposition
+        for family in _FAMILIES:
+            assert f"# TYPE {family}" in text, family
+        assert 'metrics_tpu_ckpt_bytes_total{op="write",site="metric"}' in text
+        assert 'metrics_tpu_ckpt_bytes_total{op="restore",site="metric"}' in text
+        assert 'metrics_tpu_ckpt_generation{op="write",site="engine"} 0' in text
+        assert 'metrics_tpu_ckpt_failures_total{op="write",site="engine"} 1' in text
+
+    def test_latency_histogram_counts_operations(self, ckpt_activity_done):
+        from metrics_tpu.obs.instrument import CKPT_SECONDS
+
+        assert CKPT_SECONDS.count(site="metric", op="write") == 1
+        assert CKPT_SECONDS.count(site="metric", op="restore") == 1
+        assert CKPT_SECONDS.count(site="engine", op="write") == 1
+
+
+class TestJsonlExposure:
+    def test_emit_includes_ckpt_families(self, ckpt_activity_done, tmp_path):
+        path = str(tmp_path / "registry.jsonl")
+        obs.emit(path, run="ckpt-snapshot-test")
+        record = [json.loads(ln) for ln in open(path)][0]
+        reg = record["registry"]
+        assert reg["metrics_tpu_ckpt_bytes_total"]["type"] == "counter"
+        values = reg["metrics_tpu_ckpt_bytes_total"]["values"]
+        assert "op=write,site=metric" in values and values["op=write,site=metric"] > 0
+        gen = reg["metrics_tpu_ckpt_generation"]["values"]
+        assert gen["op=write,site=engine"] == 0
+        hist = reg["metrics_tpu_ckpt_seconds"]["values"]["op=write,site=metric"]
+        assert hist["count"] == 1
+
+
+class TestDisabledSilence:
+    def test_ckpt_ops_record_nothing_when_obs_disabled(self, tmp_path):
+        assert not obs.enabled()  # conftest isolation disabled it
+        m = BinaryAccuracy()
+        m.update(jnp.asarray([1, 0]), jnp.asarray([1, 1]))
+        path = str(tmp_path / "m.ckpt")
+        m.save(path)
+        BinaryAccuracy().restore(path)
+        store = SnapshotStore(str(tmp_path / "store"), durable=False)
+        w = AsyncCheckpointer(store, interval_s=0.0)
+        w.checkpoint_sync(lambda: ({"x": np.ones(2)}, None))
+        with DiskFull():
+            w.checkpoint_sync(lambda: ({"x": np.ones(2)}, None))
+        w.close()
+        snap = obs.snapshot()
+        for family in _FAMILIES:
+            assert snap[family]["values"] == {}, family
+        text = obs.render_prometheus()
+        for family in _FAMILIES:
+            assert family + "{" not in text, family

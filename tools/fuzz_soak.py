@@ -812,6 +812,182 @@ def soak_engine(seeds) -> None:
                 engine.close()
 
 
+# ---------------------------------------------------------------------- ckpt crash surface
+
+
+def _ckpt_metric_case(seed):
+    """Deterministic (factory, feed) pair for the metric-mode crash child —
+    varied across seed to cover int sums, float sums, grouped collections and
+    ragged cat states."""
+    import metrics_tpu as ours_tm
+    import metrics_tpu.classification as ours_c
+    import metrics_tpu.regression as ours_r
+
+    rng = np.random.default_rng(seed)
+    nc = 5
+    probs = rng.random((64, nc)).astype(np.float32)
+    probs /= probs.sum(-1, keepdims=True)
+    labels = rng.integers(0, nc, 64)
+    x = rng.standard_normal(64).astype(np.float32)
+    y = (0.6 * x + 0.4 * rng.standard_normal(64)).astype(np.float32)
+    kind = seed % 4
+    if kind == 0:
+        return (lambda: ours_c.MulticlassAccuracy(nc, average="macro", validate_args=False),
+                lambda m, i: m.update(jnp.asarray(probs[(4 * i) % 60 : (4 * i) % 60 + 4]),
+                                      jnp.asarray(labels[(4 * i) % 60 : (4 * i) % 60 + 4])))
+    if kind == 1:
+        return (lambda: ours_r.MeanSquaredError(),
+                lambda m, i: m.update(jnp.asarray(x[(3 * i) % 60 : (3 * i) % 60 + 3]),
+                                      jnp.asarray(y[(3 * i) % 60 : (3 * i) % 60 + 3])))
+    if kind == 2:
+        return (lambda: ours_tm.MetricCollection(
+                    [ours_c.MulticlassPrecision(nc, validate_args=False),
+                     ours_c.MulticlassRecall(nc, validate_args=False)], compute_groups=True),
+                lambda m, i: m.update(jnp.asarray(probs[(4 * i) % 60 : (4 * i) % 60 + 4]),
+                                      jnp.asarray(labels[(4 * i) % 60 : (4 * i) % 60 + 4])))
+    return (lambda: ours_c.BinaryPrecisionRecallCurve(thresholds=None, validate_args=False),
+            lambda m, i: m.update(jnp.asarray(probs[(4 * i) % 60 : (4 * i) % 60 + 4, 0]),
+                                  jnp.asarray((labels[(4 * i) % 60 : (4 * i) % 60 + 4] == 0).astype(np.int32))))
+
+
+def _ckpt_engine_stream(seed, n=4000):
+    rng = np.random.default_rng(seed)
+    return [(f"k{rng.integers(0, 5)}", rng.integers(0, 2, 3), rng.integers(0, 2, 3))
+            for _ in range(n)]
+
+
+def ckpt_crash_child(mode, dirpath, seed):
+    """Child half of the SIGKILL surface: write checkpoints continuously until
+    killed. Prints READY once the first commit can no longer be outrun."""
+    from metrics_tpu import ckpt
+    from metrics_tpu.ckpt.restore import CKPT_SCHEMA_VERSION, _build_tree
+
+    if mode == "metric":
+        factory, feed = _ckpt_metric_case(seed)
+        m = factory()
+        store = ckpt.SnapshotStore(dirpath, retain=3, durable=True)
+        print("READY", flush=True)
+        for i in range(1_000_000):
+            feed(m, i)
+            tree, reds = _build_tree(m)
+            store.commit(ckpt.dumps(tree, reductions=reds,
+                                    schema_version=CKPT_SCHEMA_VERSION,
+                                    meta={"batches": i + 1}))
+    else:
+        from metrics_tpu.classification import BinaryAccuracy
+        from metrics_tpu.engine import CheckpointConfig, StreamingEngine
+
+        stream = _ckpt_engine_stream(seed)
+        cfg = CheckpointConfig(directory=dirpath, interval_s=0.02, retain=3,
+                               durable=True, wal_flush="fsync")
+        engine = StreamingEngine(BinaryAccuracy(), buckets=(8, 32), checkpoint=cfg)
+        print("READY", flush=True)
+        while True:  # cycle until killed
+            for key, p, t in stream:
+                engine.submit(key, jnp.asarray(p), jnp.asarray(t))
+
+
+def _verify_ckpt_metric_kill(dirpath, seed, tag):
+    from metrics_tpu import ckpt
+
+    store = ckpt.SnapshotStore(dirpath, retain=3, durable=False)
+    found = store.latest_valid()
+    if found is None:
+        if store.generations():
+            FAILS.append((seed, tag, "committed generations exist but none restore cleanly"))
+        return  # killed before the first commit completed — nothing to verify
+    gen, snap = found
+    batches = int(snap.meta["batches"])
+    factory, feed = _ckpt_metric_case(seed)
+    oracle = factory()
+    for i in range(batches):
+        feed(oracle, i)
+    restored = factory()
+    ckpt.restore(restored, store.path(gen))
+    try:
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+            restored.compute(), oracle.compute(),
+        )
+    except Exception as exc:  # noqa: BLE001
+        FAILS.append((seed, tag, f"restore != oracle at gen {gen} ({batches} batches): {repr(exc)[:140]}"))
+
+
+def _verify_ckpt_engine_kill(dirpath, seed, tag):
+    from metrics_tpu.classification import BinaryAccuracy
+    from metrics_tpu.engine import CheckpointConfig, StreamingEngine
+
+    stream = _ckpt_engine_stream(seed)
+    cfg = CheckpointConfig(directory=dirpath, interval_s=3600.0, durable=False)
+    engine = StreamingEngine(BinaryAccuracy(), buckets=(8, 32), checkpoint=cfg)
+    try:
+        metric = BinaryAccuracy()
+        per_key_rows = {}
+        for key, p, t in stream:
+            per_key_rows.setdefault(key, []).extend((p[i : i + 1], t[i : i + 1]) for i in range(len(p)))
+        for key in engine._keyed.keys:
+            state = jax.device_get(engine._keyed.state_of(key))
+            rows_applied = int(np.asarray(state["_update_count"]))
+            rows = per_key_rows.get(key, [])
+            if rows_applied > len(rows):
+                FAILS.append((seed, tag, f"key {key}: {rows_applied} rows recovered > {len(rows)} submitted (double replay)"))
+                continue
+            # exactly-once + order: the recovered state must equal the oracle
+            # applied to exactly the first rows_applied rows, per-row
+            oracle_state = metric.init_state()
+            for p_row, t_row in rows[:rows_applied]:
+                oracle_state = metric.update_state(oracle_state, jnp.asarray(p_row), jnp.asarray(t_row))
+            try:
+                jax.tree_util.tree_map(
+                    lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+                    state, jax.device_get(oracle_state),
+                )
+            except Exception as exc:  # noqa: BLE001
+                FAILS.append((seed, tag, f"key {key}: recovered state != first-{rows_applied}-rows oracle: {repr(exc)[:120]}"))
+    finally:
+        engine.close(checkpoint=False)
+
+
+def soak_ckpt(seeds) -> None:
+    """Crash-recovery soak (ISSUE 4): a child process checkpoints continuously
+    and is SIGKILLed at a random moment — possibly mid-write; the parent then
+    proves the newest valid generation restores bit-identically to an
+    uninterrupted oracle at that generation (metric mode), or that the engine's
+    snapshot+WAL recovery is an exactly-once, order-preserving prefix of the
+    submitted stream (engine mode). Self-oracled — needs no reference checkout."""
+    import signal
+    import subprocess
+    import tempfile
+    import time as _time
+
+    for seed in seeds:
+        mode = "engine" if seed % 3 == 0 else "metric"
+        tag = f"ckpt/{mode}"
+        with tempfile.TemporaryDirectory() as d:
+            child = subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__), "--ckpt-child", mode, d, str(seed)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            )
+            try:
+                line = child.stdout.readline()
+                if "READY" not in line:
+                    err = child.stderr.read()[:200]
+                    FAILS.append((seed, tag, f"child failed to start: {line!r} {err!r}"))
+                    continue
+                rng = np.random.default_rng(seed ^ 0xC4A5)
+                _time.sleep(float(rng.uniform(0.05, 0.6)))
+                child.send_signal(signal.SIGKILL)
+                child.wait(timeout=30)
+            finally:
+                if child.poll() is None:
+                    child.kill()
+                    child.wait(timeout=30)
+            if mode == "metric":
+                _verify_ckpt_metric_kill(d, seed, tag)
+            else:
+                _verify_ckpt_engine_kill(d, seed, tag)
+
+
 SURFACES = {
     "classification": soak_classification,
     "regression_retrieval": soak_regression_retrieval,
@@ -823,18 +999,26 @@ SURFACES = {
     "detection": soak_detection,
     "checkpoint_resume": soak_checkpoint_resume,
     "engine": soak_engine,
+    "ckpt": soak_ckpt,
 }
 
 # surfaces that execute the reference as their oracle (everything except the
-# self-oracled engine surface)
-_NEEDS_REF = {name for name in SURFACES if name != "engine"}
+# self-oracled engine and ckpt crash-recovery surfaces)
+_NEEDS_REF = {name for name in SURFACES if name not in ("engine", "ckpt")}
 
 
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--surfaces", default="all", help="comma list or 'all': " + ", ".join(SURFACES))
     parser.add_argument("--seeds", default="100:120", help="start:stop seed range")
+    parser.add_argument("--ckpt-child", nargs=3, metavar=("MODE", "DIR", "SEED"),
+                        help="internal: run the ckpt crash-surface child (killed by the parent)")
     args = parser.parse_args()
+
+    if args.ckpt_child is not None:
+        mode, dirpath, seed = args.ckpt_child
+        ckpt_crash_child(mode, dirpath, int(seed))
+        return
 
     start, stop = (int(x) for x in args.seeds.split(":"))
     seeds = range(start, stop)
