@@ -18,6 +18,7 @@ Run: ``python benchmarks/engine_throughput.py [--requests 8000] [--threads 4]``
 from __future__ import annotations
 
 import argparse
+import gc
 import json
 import os
 import sys
@@ -61,6 +62,12 @@ def main() -> None:
                     help="add a second engine pass with the durable state plane enabled "
                     "(async snapshots + WAL) and gate its steady-state overhead at <5%% "
                     "vs the plain pass (ISSUE 4 acceptance)")
+    ap.add_argument("--guard", action="store_true",
+                    help="guard-plane gates (ISSUE 5): (a) well-behaved traffic with the "
+                    "guard enabled loses <5%% throughput vs the plain pass; (b) under a "
+                    "100x skewed adversary, light-tenant p99 stays bounded (<=2x its solo "
+                    "baseline) with the guard's fair drain, while the unguarded FIFO drain "
+                    "lets it blow past 10x")
     args = ap.parse_args()
 
     if args.obs:
@@ -93,18 +100,22 @@ def main() -> None:
     # ---------------- engine: coalesced micro-batched dispatch
     buckets = (64, 256)
 
-    def run_engine_pass(checkpoint=None):
+    def run_engine_pass(checkpoint=None, guard=None):
         """One warmed, timed engine pass over the stream; returns req/s."""
         engine = StreamingEngine(BinaryAccuracy(), buckets=buckets, max_queue=2048,
-                                 capacity=args.keys, checkpoint=checkpoint)
+                                 capacity=args.keys, checkpoint=checkpoint, guard=guard)
         try:
             for key, _, _ in stream:
                 engine._alloc_slot(key)
             for rows in buckets:
                 engine.submit("tenant-0", jnp.asarray(rng.integers(0, 2, rows)),
                               jnp.asarray(rng.integers(0, 2, rows)))
-            engine.flush()
+                engine.flush()  # per-rung: coalescing must not skip a bucket compile
             engine.reset()
+            # GC paused for the timed region: collector pauses land on random
+            # passes and swamp the few-percent effect the gates measure
+            gc.collect()
+            gc.disable()
             t0 = time.perf_counter()
 
             def client(tid: int) -> None:
@@ -120,6 +131,7 @@ def main() -> None:
             engine.flush()
             return len(stream) / (time.perf_counter() - t0)
         finally:
+            gc.enable()
             engine.close()
 
     engine = StreamingEngine(BinaryAccuracy(), buckets=buckets, max_queue=2048, capacity=args.keys)
@@ -130,7 +142,7 @@ def main() -> None:
         for rows in buckets:
             engine.submit("tenant-0", jnp.asarray(rng.integers(0, 2, rows)),
                           jnp.asarray(rng.integers(0, 2, rows)))
-        engine.flush()
+            engine.flush()  # per-rung: coalescing must not skip a bucket compile
         engine.reset()
         warm_compiles = engine.telemetry_snapshot()["compiles"]
 
@@ -190,19 +202,163 @@ def main() -> None:
 
         from metrics_tpu.engine import CheckpointConfig
 
-        plain_rps = max(run_engine_pass() for _ in range(2))
-        ckpt_runs = []
-        for _ in range(2):
+        def ckpt_pass():
             with tempfile.TemporaryDirectory() as ckpt_dir:
                 cfg = CheckpointConfig(directory=ckpt_dir, interval_s=0.25, retain=3)
-                ckpt_runs.append(run_engine_pass(checkpoint=cfg))
-        ckpt_rps = max(ckpt_runs)
-        overhead = plain_rps / ckpt_rps - 1.0
+                return run_engine_pass(checkpoint=cfg)
+
+        # paired runs, alternating order, median of per-pair ratios — the same
+        # noise-rejection shape as the guard gate below: best-of-2 flapped on
+        # shared boxes whose run-to-run variance exceeds the gated effect
+        pair_ratios = []
+        plain_best = ckpt_best = 0.0
+        for i in range(6):
+            if i % 2 == 0:
+                p = run_engine_pass()
+                c = ckpt_pass()
+            else:
+                c = ckpt_pass()
+                p = run_engine_pass()
+            pair_ratios.append(p / c)
+            plain_best, ckpt_best = max(plain_best, p), max(ckpt_best, c)
+        overhead = float(np.median(pair_ratios)) - 1.0
         ok = overhead < 0.05
         emit("engine ckpt overhead", overhead * 100.0, "%",
-             plain_rps=round(plain_rps, 1), ckpt_rps=round(ckpt_rps, 1),
+             plain_rps=round(plain_best, 1), ckpt_rps=round(ckpt_best, 1),
+             pair_ratios=[round(r, 4) for r in pair_ratios],
              checks={"ckpt_overhead_lt_5pct": ok})
         if not ok:
+            sys.exit(1)
+
+    # ---------------- guard plane gates (ISSUE 5): (a) the admission/fairness
+    # machinery must cost <5% on well-behaved traffic; (b) under a 100x skewed
+    # adversary the fair drain must keep light-tenant p99 bounded (<=2x its
+    # solo baseline) while the unguarded FIFO drain lets it blow past 10x.
+    if args.guard:
+        import threading as _threading
+
+        from metrics_tpu.engine import GuardConfig
+
+        # paired runs, alternating order, median of per-pair ratios: run-to-run
+        # variance on shared CI boxes is larger than the effect being gated and
+        # drifts with process age. A pair's two passes share adjacent machine
+        # conditions, alternating which variant goes first cancels residual
+        # drift, and the median rejects straggler pairs.
+        pair_ratios = []
+        plain_best = guard_best = 0.0
+        for i in range(6):
+            if i % 2 == 0:
+                p = run_engine_pass()
+                g = run_engine_pass(guard=GuardConfig())
+            else:
+                g = run_engine_pass(guard=GuardConfig())
+                p = run_engine_pass()
+            pair_ratios.append(p / g)
+            plain_best, guard_best = max(plain_best, p), max(guard_best, g)
+        overhead = float(np.median(pair_ratios)) - 1.0
+        ok_overhead = overhead < 0.05
+        emit("engine guard overhead", overhead * 100.0, "%",
+             plain_rps=round(plain_best, 1), guard_rps=round(guard_best, 1),
+             pair_ratios=[round(r, 4) for r in pair_ratios],
+             checks={"guard_overhead_lt_5pct": ok_overhead})
+
+        # ---- skewed adversary: one tenant bursts 400 x 64-row requests (a
+        # ~25k-row backlog dump, 100x+ the light tenants' row rate) every 0.4s;
+        # nine light tenants submit paced batch-1 requests and measure their
+        # submit->commit p99. Unguarded FIFO drains make every light request
+        # behind a burst wait out the whole dump; the guard's fair drain serves
+        # light tenants at their share regardless of the heavy backlog depth.
+        light_requests, light_tenants = 100, 9
+        heavy_args = (jnp.asarray(rng.integers(0, 2, 64)), jnp.asarray(rng.integers(0, 2, 64)))
+        light_args = (jnp.asarray(rng.integers(0, 2, 1)), jnp.asarray(rng.integers(0, 2, 1)))
+
+        def skew_pass(guard=None, flood=True):
+            engine = StreamingEngine(BinaryAccuracy(), buckets=buckets, max_queue=16384,
+                                     capacity=16, guard=guard)
+            lat_lock = _threading.Lock()
+            light_lat = []
+            stop = _threading.Event()
+            try:
+                for rows in buckets:  # warm the ladder with all keys allocated
+                    engine.submit("heavy", jnp.asarray(rng.integers(0, 2, rows)),
+                                  jnp.asarray(rng.integers(0, 2, rows)))
+                    engine.flush()  # per-rung: coalescing must not skip a bucket compile
+                for k in range(light_tenants):
+                    engine.submit(f"light-{k}", *light_args)
+                engine.flush()
+                engine.reset()
+                gc.collect()
+                gc.disable()
+
+                def heavy_client():
+                    while not stop.is_set():
+                        for _ in range(400):
+                            engine.submit("heavy", *heavy_args)
+                        if stop.wait(0.4):
+                            return
+
+                def light_client(k):
+                    for _ in range(light_requests):
+                        t0 = time.perf_counter()
+                        engine.submit(f"light-{k}", *light_args).add_done_callback(
+                            lambda f, t0=t0: (lat_lock.acquire(),
+                                              light_lat.append(time.perf_counter() - t0),
+                                              lat_lock.release()))
+                        time.sleep(0.0005)  # paced: a polite interactive tenant
+
+                threads = [_threading.Thread(target=light_client, args=(k,))
+                           for k in range(light_tenants)]
+                heavy = _threading.Thread(target=heavy_client)
+                if flood:
+                    heavy.start()
+                for th in threads:
+                    th.start()
+                for th in threads:
+                    th.join()
+                stop.set()
+                if flood:
+                    heavy.join()
+                engine.flush()
+                assert len(light_lat) == light_tenants * light_requests
+                return float(np.percentile(np.asarray(light_lat), 99, method="nearest"))
+            finally:
+                gc.enable()
+                stop.set()
+                engine.close()
+
+        # latency-tuned serving config: a small drain quantum bounds how long a
+        # light request can sit behind the flood's current drain (the
+        # latency-vs-coalescing knob an operator tunes; shedding off keeps the
+        # comparison loss-free). The solo baseline runs the SAME config, paired
+        # with its flooded run; gates take the median pair ratio (same
+        # noise-rejection rationale as the overhead gate above).
+        skew_guard = GuardConfig(shed=False, drain_quantum_rows=128)
+        guarded_pairs = []
+        solo_p99 = guarded_p99 = None
+        for _ in range(5):
+            s = skew_pass(guard=skew_guard, flood=False)
+            f = skew_pass(guard=skew_guard)
+            guarded_pairs.append(f / s)
+            solo_p99 = s if solo_p99 is None else min(solo_p99, s)
+            guarded_p99 = f if guarded_p99 is None else min(guarded_p99, f)
+        unguarded_pairs = []
+        unguarded_p99 = None
+        for _ in range(2):
+            s = skew_pass(guard=None, flood=False)
+            f = skew_pass(guard=None)
+            unguarded_pairs.append(f / s)
+            unguarded_p99 = f if unguarded_p99 is None else min(unguarded_p99, f)
+        guarded_ratio = float(np.median(guarded_pairs))
+        unguarded_ratio = float(np.median(unguarded_pairs))
+        ok_guarded = guarded_ratio <= 2.0
+        ok_unguarded = unguarded_ratio > 10.0
+        emit("light-tenant p99 under 100x skew", guarded_p99 * 1e3, "ms",
+             solo_ms=round(solo_p99 * 1e3, 3), unguarded_ms=round(unguarded_p99 * 1e3, 3),
+             guarded_over_solo=round(guarded_ratio, 2),
+             unguarded_over_solo=round(unguarded_ratio, 2),
+             checks={"guarded_le_2x_solo": ok_guarded,
+                     "unguarded_gt_10x_solo": ok_unguarded})
+        if not (ok_overhead and ok_guarded and ok_unguarded):
             sys.exit(1)
 
 

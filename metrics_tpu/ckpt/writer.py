@@ -64,6 +64,9 @@ class AsyncCheckpointer:
         self.writes = 0
         self.skipped = 0  # due snapshots dropped because the writer was busy
         self.failures = 0
+        # failure streak since the last successful commit — what a circuit
+        # breaker (metrics_tpu.guard) or an operator dashboard keys off
+        self.consecutive_failures = 0
         self.last_generation: Optional[int] = None
         self.last_error: Optional[BaseException] = None
 
@@ -182,6 +185,7 @@ class AsyncCheckpointer:
                 gen = self.store.commit(data)
         except BaseException as exc:  # noqa: BLE001 — a failed write must not kill the owner
             self.failures += 1
+            self.consecutive_failures += 1
             self.last_error = exc
             _obs.record_ckpt_failure(self.site, "write")
             if self.on_error is not None:
@@ -191,6 +195,7 @@ class AsyncCheckpointer:
                     pass
             return None
         self.writes += 1
+        self.consecutive_failures = 0
         self.last_generation = gen
         _obs.record_ckpt_io(self.site, "write", len(data), time.perf_counter() - t0, generation=gen)
         if self.on_commit is not None:

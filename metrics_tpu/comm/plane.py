@@ -83,6 +83,11 @@ class CommConfig:
     backoff_max_s: float = 2.0
     degrade: bool = True
     transport: Optional[Transport] = None
+    # observer hook: called with every published SyncReport (success, degraded
+    # or stale) — how health machinery (e.g. the engine's comm circuit breaker,
+    # metrics_tpu.guard) watches sync outcomes without polling last_report().
+    # Exceptions are swallowed: observation must never fail a sync.
+    on_report: Optional[Callable[["SyncReport"], None]] = None
 
 
 _CONFIG = CommConfig()
@@ -163,10 +168,16 @@ def last_report() -> Optional[SyncReport]:
         return _LAST_REPORT
 
 
-def _publish(report: SyncReport) -> None:
+def _publish(report: SyncReport, config: Optional[CommConfig] = None) -> None:
     global _LAST_REPORT
     with _REPORT_LOCK:
         _LAST_REPORT = report
+    hook = config.on_report if config is not None else None
+    if hook is not None:
+        try:
+            hook(report)
+        except Exception:  # noqa: BLE001 — observation must never fail a sync
+            pass
 
 
 # ----------------------------------------------------------------- transport wrappers
@@ -453,7 +464,7 @@ def sync_pytree(
                     report.wire_bytes = metered.sent_bytes
                     _obs.record_comm_payload(site, raw, metered.sent_bytes)
                     _obs.set_comm_stale(site, False)
-                    _publish(report)
+                    _publish(report, cfg)
                     return synced
                 if attempt < cfg.max_retries:
                     report.retries += 1
@@ -465,13 +476,13 @@ def sync_pytree(
 
     # ladder exhausted: serve local state, flagged stale
     if not cfg.degrade:
-        _publish(report)
+        _publish(report, cfg)
         raise TransportError(f"comm sync at {site!r} failed after the full retry ladder (degrade=False)")
     report.degraded_step = "local_state"
     report.stale = True
     _obs.record_comm_degradation(site, "local_state")
     _obs.set_comm_stale(site, True)
-    _publish(report)
+    _publish(report, cfg)
     return dict(state)
 
 

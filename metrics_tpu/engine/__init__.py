@@ -16,7 +16,10 @@ snapshots, a WAL of accepted work, and exactly-once restart recovery via
 ``metrics_tpu.ckpt``), ``stream.py`` (stacked multi-tenant keyed state +
 sliding windows), ``telemetry.py`` (counters, occupancy, p50/p99 latency —
 registry-backed: the series appear in ``metrics_tpu.obs.render_prometheus()``
-under a per-engine label).
+under a per-engine label). Overload/abuse protection is the guard plane
+(``guard=GuardConfig(...)``, :mod:`metrics_tpu.guard`): quotas, fair drain,
+deadlines + shedding, circuit breakers, quarantine, watchdog, and
+``engine.health()`` — see docs/source/robustness.md.
 """
 
 from metrics_tpu.engine.bucketing import DEFAULT_BUCKETS, choose_bucket, inspect_request, pad_micro_batch
@@ -28,16 +31,32 @@ from metrics_tpu.engine.runtime import (
 )
 from metrics_tpu.engine.stream import EagerKeyedState, KeyedState
 from metrics_tpu.engine.telemetry import EngineTelemetry
+from metrics_tpu.guard import (
+    DeadlineExceeded,
+    EngineQuarantined,
+    GuardConfig,
+    GuardRejected,
+    QuotaExceeded,
+    RequestShed,
+    TenantQuarantined,
+)
 
 __all__ = [
     "DEFAULT_BUCKETS",
     "CheckpointConfig",
+    "DeadlineExceeded",
     "EagerKeyedState",
     "EngineBackpressure",
     "EngineClosed",
+    "EngineQuarantined",
     "EngineTelemetry",
+    "GuardConfig",
+    "GuardRejected",
     "KeyedState",
+    "QuotaExceeded",
+    "RequestShed",
     "StreamingEngine",
+    "TenantQuarantined",
     "choose_bucket",
     "inspect_request",
     "pad_micro_batch",

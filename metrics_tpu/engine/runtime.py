@@ -34,6 +34,15 @@ Degradation ladder (each step is correctness-preserving, only slower):
 Backpressure at a full queue follows ``policy``: ``"block"`` (wait for space),
 ``"drop"`` (raise :class:`EngineBackpressure` immediately), ``"timeout"`` (wait up to
 ``submit_timeout`` seconds, then raise).
+
+Overload and abuse protection is the guard plane (``guard=GuardConfig(...)``,
+:mod:`metrics_tpu.guard`): per-tenant token-bucket admission, weighted fair
+drain forming, request deadlines + CoDel-style shedding, circuit breakers
+around compiles/checkpoints/comm sync, poison-tenant quarantine, and a
+dispatch watchdog that supersedes a hung worker (inline replay + restart when
+the dispatch lock is free; engine quarantine when the device itself is
+wedged). ``engine.health()`` exposes the resulting SERVING → DEGRADED →
+QUARANTINED state machine. See docs/source/robustness.md.
 """
 
 from __future__ import annotations
@@ -43,6 +52,7 @@ import pickle
 import struct
 import threading
 import time
+import warnings
 from concurrent.futures import Future
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Hashable, List, Optional, Sequence, Tuple
@@ -66,6 +76,10 @@ from metrics_tpu.engine.bucketing import (
 )
 from metrics_tpu.engine.stream import EagerKeyedState, KeyedState
 from metrics_tpu.engine.telemetry import EngineTelemetry
+from metrics_tpu.guard.config import GuardConfig
+from metrics_tpu.guard.errors import EngineQuarantined
+from metrics_tpu.guard.plane import GuardPlane
+from metrics_tpu.guard.watchdog import HangDetector, Watchdog
 from metrics_tpu.metric import Metric
 from metrics_tpu.obs import instrument as _obs
 from metrics_tpu.obs.registry import OBS as _OBS
@@ -207,11 +221,20 @@ class _FusedUnsupported(Exception):
     """Internal: the metric's update cannot trace inside the bucket kernel."""
 
 
+class _WorkerSuperseded(BaseException):
+    """Internal: this dispatcher generation was taken over by the hang handler
+    mid-batch — retire without touching shared accounting (BaseException so
+    per-request isolation never absorbs it)."""
+
+
 class _Request:
-    __slots__ = ("key", "slot", "args", "rows", "signature", "future", "t_submit", "rows_done", "seq")
+    __slots__ = ("key", "slot", "args", "rows", "signature", "future", "t_submit",
+                 "rows_done", "seq", "deadline", "priority", "t_enqueue", "is_probe")
 
     def __init__(self, key: Hashable, slot: Optional[int], args: Tuple[Any, ...],
-                 rows: int, signature: Signature, future: "Future", t_submit: float) -> None:
+                 rows: int, signature: Signature, future: "Future", t_submit: float,
+                 deadline: Optional[float] = None, priority: int = 0,
+                 t_enqueue: float = 0.0, is_probe: bool = False) -> None:
         self.key = key
         self.slot = slot
         self.args = args
@@ -227,6 +250,14 @@ class _Request:
         # WAL sequence number once journaled (None while checkpointing is off
         # or the record hasn't been appended yet) — the double-journal guard
         self.seq: Optional[int] = None
+        # guard plane: absolute deadline + shed priority on the guard clock,
+        # the enqueue stamp sojourn-time shedding reads, and whether this
+        # request is a quarantined tenant's single half-open probe (a probe
+        # rejected in-queue must free its slot, not wedge the tenant)
+        self.deadline = deadline
+        self.priority = priority
+        self.t_enqueue = t_enqueue
+        self.is_probe = is_probe
 
 
 def _component_metrics(metric: Any) -> List[Metric]:
@@ -277,6 +308,7 @@ class StreamingEngine:
         capacity: int = 8,
         telemetry_window: int = 2048,
         checkpoint: Optional[CheckpointConfig] = None,
+        guard: Optional[GuardConfig] = None,
         start: bool = True,
     ) -> None:
         if not isinstance(metric_or_collection, (Metric, MetricCollection)):
@@ -323,7 +355,15 @@ class StreamingEngine:
         self._inflight = 0
         self._closed = False
         self._degraded = False
+        self._quarantined = False  # hung worker wedged in a device call: fail fast
         self._worker_error: Optional[BaseException] = None
+        # dispatcher generations: the hang handler supersedes a worker by
+        # bumping the epoch; a worker re-validates its epoch at every shared-
+        # state touchpoint and retires silently when stale
+        self._worker_epoch = 0
+        self._active_batch: Optional[List[_Request]] = None
+        self._worker_restarts = 0
+        self._zombie_workers = 0
         # serializes use of the private metric instance (update_state/compute_from
         # swap state attrs in and out, so two threads must not interleave there)
         self._dispatch_lock = threading.Lock()
@@ -344,6 +384,18 @@ class StreamingEngine:
         self._wal_slots_sent: set = set()  # slot ids already introduced to the journal
         self._replay_slot_keys: Dict[int, Hashable] = {}
         self._snapshot_seqs: Dict[int, int] = {}  # generation -> WAL seq it covers
+        # guard plane (None-checked on every hot path, like checkpointing)
+        self._guard: Optional[GuardPlane] = None
+        self._hang_detector: Optional[HangDetector] = None
+        self._watchdog: Optional[Watchdog] = None
+        if guard is not None:
+            self._guard = GuardPlane(guard, telemetry=self.telemetry, max_rows=self._max_rows)
+            if guard.watchdog_timeout_s is not None:
+                self._hang_detector = HangDetector(guard.watchdog_timeout_s, clock=guard.clock)
+                self._watchdog = Watchdog(
+                    self._hang_detector.hung, self._on_worker_hang, poll_s=guard.watchdog_poll_s
+                )
+
         if checkpoint is not None:
             self._init_checkpoint(checkpoint)
 
@@ -357,10 +409,17 @@ class StreamingEngine:
         with self._lock:
             if self._worker is not None or self._closed:
                 return
-            self._worker = threading.Thread(
-                target=self._run, name="metrics-tpu-engine-dispatch", daemon=True
-            )
-            self._worker.start()
+            self._spawn_worker()
+
+    def _spawn_worker(self) -> None:
+        """Start a dispatcher thread for the CURRENT epoch (caller holds the lock)."""
+        self._worker = threading.Thread(
+            target=self._run,
+            args=(self._worker_epoch,),
+            name="metrics-tpu-engine-dispatch",
+            daemon=True,
+        )
+        self._worker.start()
 
     def close(self, flush: bool = True, checkpoint: bool = True) -> None:
         """Stop accepting work; by default drain what was already accepted.
@@ -373,9 +432,11 @@ class StreamingEngine:
         with self._lock:
             if self._closed:
                 return
-        if flush:
+        if flush and not self._quarantined:
             self.flush()
-        if flush and checkpoint and self._ckpt_writer is not None:
+        if flush and checkpoint and self._ckpt_writer is not None and not self._quarantined:
+            # a quarantined engine's dispatch lock may be held by the wedged
+            # worker forever — taking a final snapshot would hang close()
             self._ckpt_writer.checkpoint_sync(self._checkpoint_view)
         with self._lock:
             self._closed = True
@@ -383,8 +444,24 @@ class StreamingEngine:
             self._not_full.notify_all()
             self._idle.notify_all()
             worker = self._worker
+        if self._watchdog is not None:
+            self._watchdog.stop()
         if worker is not None and worker is not threading.current_thread():
             worker.join(timeout=10.0)
+            if worker.is_alive():
+                # the dispatcher outlived its join: surface the zombie instead
+                # of returning as if the engine closed cleanly — it may still
+                # hold the dispatch lock or a device, and health() says so
+                self._zombie_workers += 1
+                self.telemetry.count("zombie_workers")
+                warnings.warn(
+                    "StreamingEngine.close(): dispatcher thread did not exit within "
+                    "10s and is now a zombie (possibly wedged in a device call); "
+                    "engine health is DEGRADED, state may be incomplete",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+        self._publish_health()
         if self._ckpt_writer is not None:
             self._ckpt_writer.close()
         if self._journal is not None:
@@ -398,53 +475,104 @@ class StreamingEngine:
 
     # ------------------------------------------------------------------ client API
 
-    def submit(self, key: Hashable, *args: Any) -> "Future":
+    def submit(
+        self,
+        key: Hashable,
+        *args: Any,
+        deadline: Optional[float] = None,
+        priority: int = 0,
+    ) -> "Future":
         """Enqueue one update for tenant ``key``; resolves to a receipt dict once the
         state update has committed.
 
         Raises :class:`EngineBackpressure` per the configured policy when the queue is
-        full, and :class:`EngineClosed` after :meth:`close`.
+        full, and :class:`EngineClosed` after :meth:`close`. With a guard plane
+        configured (``guard=GuardConfig(...)``): ``deadline`` (seconds from now) makes
+        the request fail fast with :class:`~metrics_tpu.guard.errors.DeadlineExceeded`
+        if it expires before dispatch; ``priority`` orders overload shedding (requests
+        at or below the configured shed priority are droppable under standing
+        overload); quota-exhausted and quarantined tenants are rejected at entry
+        (:class:`~metrics_tpu.guard.errors.QuotaExceeded` /
+        :class:`~metrics_tpu.guard.errors.TenantQuarantined`); a quarantined *engine*
+        (wedged device) rejects everything with
+        :class:`~metrics_tpu.guard.errors.EngineQuarantined`.
         """
         t_submit = time.perf_counter()
         rows, signature = inspect_request(args)
-        future: Future = Future()
-        with self._not_full:
-            if self._closed:
-                raise EngineClosed("submit() on a closed StreamingEngine")
-            if self._degraded or self._worker is None:
-                # synchronous per-call dispatch (dispatcher dead or never started)
-                req = _Request(key, self._alloc_slot(key), tuple(args), rows, signature, future, t_submit)
-                self.telemetry.count("submitted")
-                self._apply_inline(req)
-                return future
-            deadline = time.monotonic() + self._submit_timeout
-            while len(self._queue) >= self._max_queue:
-                if self._policy == "drop":
-                    self.telemetry.count("dropped")
-                    raise EngineBackpressure(f"queue full ({self._max_queue}); request dropped")
-                if self._policy == "timeout":
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0:
-                        self.telemetry.count("timed_out")
-                        raise EngineBackpressure(
-                            f"queue full ({self._max_queue}); timed out after {self._submit_timeout}s"
-                        )
-                    self._not_full.wait(remaining)
-                else:
-                    self._not_full.wait()
+        guard = self._guard
+        abs_deadline: Optional[float] = None
+        t_enqueue = 0.0
+        is_probe = False
+        if guard is not None:
+            if self._quarantined:
+                raise EngineQuarantined(
+                    "submit() on a quarantined StreamingEngine (dispatcher wedged in a device call)"
+                )
+            # full admission only when there is something to check — a guarded
+            # submit with no quotas, no deadline and a clean quarantine ledger
+            # costs attribute loads, not calls (the guard <5% overhead gate)
+            if deadline is not None or guard.admission_active or guard._quarantine_entries:
+                abs_deadline, is_probe = guard.admit(key, rows, deadline)
+            if guard.stamp_enqueue:
+                # the default guard clock IS perf_counter: reuse the entry stamp
+                t_enqueue = t_submit if guard.clock is time.perf_counter else guard.clock()
+        try:
+            future: Future = Future()
+            with self._not_full:
                 if self._closed:
-                    raise EngineClosed("StreamingEngine closed while waiting for queue space")
-                if self._degraded:
-                    req = _Request(key, self._alloc_slot(key), tuple(args), rows, signature, future, t_submit)
+                    raise EngineClosed("submit() on a closed StreamingEngine")
+                if self._quarantined:
+                    raise EngineQuarantined(
+                        "submit() on a quarantined StreamingEngine (dispatcher wedged in a device call)"
+                    )
+                if self._degraded or self._worker is None:
+                    # synchronous per-call dispatch (dispatcher dead or never started)
+                    req = _Request(key, self._alloc_slot(key), tuple(args), rows, signature,
+                                   future, t_submit, abs_deadline, priority, t_enqueue, is_probe)
                     self.telemetry.count("submitted")
                     self._apply_inline(req)
                     return future
-            req = _Request(key, self._alloc_slot(key), tuple(args), rows, signature, future, t_submit)
-            self._queue.append(req)
-            self.telemetry.count("submitted")
-            self.telemetry.gauge_queue_depth(len(self._queue))
-            self._not_empty.notify()
-        return future
+                backlog = guard.backlog if guard is not None else None
+                wait_deadline = time.monotonic() + self._submit_timeout
+                while len(self._queue) + (backlog.count if backlog is not None else 0) >= self._max_queue:
+                    if self._policy == "drop":
+                        self.telemetry.count("dropped")
+                        raise EngineBackpressure(f"queue full ({self._max_queue}); request dropped")
+                    if self._policy == "timeout":
+                        remaining = wait_deadline - time.monotonic()
+                        if remaining <= 0:
+                            self.telemetry.count("timed_out")
+                            raise EngineBackpressure(
+                                f"queue full ({self._max_queue}); timed out after {self._submit_timeout}s"
+                            )
+                        self._not_full.wait(remaining)
+                    else:
+                        self._not_full.wait()
+                    if self._closed:
+                        raise EngineClosed("StreamingEngine closed while waiting for queue space")
+                    if self._quarantined:
+                        raise EngineQuarantined(
+                            "StreamingEngine quarantined while waiting for queue space"
+                        )
+                    if self._degraded:
+                        req = _Request(key, self._alloc_slot(key), tuple(args), rows, signature,
+                                       future, t_submit, abs_deadline, priority, t_enqueue, is_probe)
+                        self.telemetry.count("submitted")
+                        self._apply_inline(req)
+                        return future
+                req = _Request(key, self._alloc_slot(key), tuple(args), rows, signature,
+                               future, t_submit, abs_deadline, priority, t_enqueue, is_probe)
+                self._queue.append(req)
+                self.telemetry.count("submitted")
+                self.telemetry.gauge_queue_depth(len(self._queue))
+                self._not_empty.notify()
+            return future
+        except Exception:
+            if is_probe:
+                # the admitted quarantine probe never reached processing:
+                # free the probe slot so the tenant is not wedged in probation
+                guard.abandon_probe(key)
+            raise
 
     def flush(self, timeout: Optional[float] = None) -> None:
         """Block until every accepted request has committed (or ``timeout`` elapses).
@@ -452,15 +580,27 @@ class StreamingEngine:
         Holds through a worker death too: the death handler keeps ``_inflight`` equal
         to the number of accepted-but-unreplayed requests while it replays them
         inline, so 'accepted implies committed after flush' survives degradation.
+
+        Condition-variable wakeups, not polling: every transition that empties the
+        queue/in-flight set notifies ``_idle`` (batch completion, worker-death and
+        hang-takeover replay, engine quarantine, close), so a waiting flush pays no
+        busy-wait tax and wakes the moment the engine is drained.
         """
         deadline = None if timeout is None else time.monotonic() + timeout
+        backlog = self._guard.backlog if self._guard is not None else None
         with self._idle:
-            while self._queue or self._inflight:
-                remaining = 0.1 if deadline is None else min(0.1, deadline - time.monotonic())
-                if remaining <= 0:
-                    raise TimeoutError("StreamingEngine.flush timed out")
-                # bounded waits double as liveness checks against a dying dispatcher
-                self._idle.wait(remaining)
+            while (
+                self._queue
+                or self._inflight
+                or (backlog is not None and backlog.count)
+            ):
+                if deadline is None:
+                    self._idle.wait()
+                else:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise TimeoutError("StreamingEngine.flush timed out")
+                    self._idle.wait(remaining)
 
     def compute(self, key: Hashable, *, window: bool = False, sync: bool = False) -> Any:
         """Final metric value for tenant ``key`` (flushes first).
@@ -473,6 +613,7 @@ class StreamingEngine:
             # a silent fall-through would return unbounded lifetime accumulation
             # mislabeled as a sliding-window value
             raise MetricsTPUUserError("compute(window=True) requires the engine to be built with `window=`")
+        self._check_quarantined("compute")
         self.flush()
         with self._dispatch_lock:
             if key not in self._keyed.keys:
@@ -492,6 +633,7 @@ class StreamingEngine:
         """
         if window and self._window is None:
             raise MetricsTPUUserError("compute_all(window=True) requires the engine to be built with `window=`")
+        self._check_quarantined("compute_all")
         self.flush()
         with self._dispatch_lock:
             out: Dict[Hashable, Any] = {}
@@ -502,8 +644,16 @@ class StreamingEngine:
                 out[key] = self._metric.compute_from(state)
             return out
 
+    def _check_quarantined(self, op: str) -> None:
+        """Fail fast instead of deadlocking on a dispatch lock a wedged worker holds."""
+        if self._quarantined:
+            raise EngineQuarantined(
+                f"{op}() on a quarantined StreamingEngine (dispatcher wedged in a device call)"
+            )
+
     def rotate_window(self) -> None:
         """Close the current sliding-window segment for ALL tenants (flushes first)."""
+        self._check_quarantined("rotate_window")
         self.flush()
         with self._dispatch_lock:
             self._keyed.rotate()
@@ -511,6 +661,7 @@ class StreamingEngine:
 
     def reset(self) -> None:
         """Drop all tenant state (keys stay allocated)."""
+        self._check_quarantined("reset")
         self.flush()
         with self._dispatch_lock:
             self._keyed.reset()
@@ -525,10 +676,80 @@ class StreamingEngine:
         """True once the dispatcher died and submits run inline."""
         return self._degraded
 
+    @property
+    def quarantined(self) -> bool:
+        """True once a hung dispatcher could not be safely superseded (device
+        wedged): the engine fails fast instead of hanging callers."""
+        return self._quarantined
+
+    def health(self) -> Dict[str, Any]:
+        """The engine's health state machine, one plain dict.
+
+        ``state`` walks ``SERVING → DEGRADED → QUARANTINED``:
+
+        - ``SERVING`` — nominal (fused or eager, dispatcher alive);
+        - ``DEGRADED`` — serving continues with reduced quality: the
+          dispatcher died and submits run inline, a circuit breaker is open,
+          the overload controller is actively shedding, the WAL was disabled
+          after an IO failure, or a zombie worker survived ``close()``;
+        - ``QUARANTINED`` — the engine cannot serve safely (a hung worker
+          holds the dispatch lock); every call fails fast.
+
+        Mirrored to the master-gated ``metrics_tpu_guard_health_state`` gauge
+        whenever it is read or transitions.
+        """
+        with self._lock:
+            quarantined = self._quarantined
+            degraded = self._degraded
+            zombies = self._zombie_workers
+            worker = self._worker
+            closed = self._closed
+            restarts = self._worker_restarts
+            queue_depth = len(self._queue)
+            if self._guard is not None:
+                queue_depth += self._guard.backlog.count
+        guard = self._guard
+        breakers = guard.breaker_snapshots() if guard is not None else {}
+        shedding = guard.shedding if guard is not None else False
+        wal_disabled = self._wal_error is not None
+        if quarantined:
+            state = "QUARANTINED"
+        elif (
+            degraded
+            or zombies
+            or shedding
+            or wal_disabled
+            or any(snap["state"] != "closed" for snap in breakers.values())
+        ):
+            state = "DEGRADED"
+        else:
+            state = "SERVING"
+        out: Dict[str, Any] = {
+            "state": state,
+            "closed": closed,
+            "worker_alive": worker is not None and worker.is_alive() and not degraded,
+            "worker_restarts": restarts,
+            "zombie_workers": zombies,
+            "queue_depth": queue_depth,
+            "shedding": shedding,
+            "wal_disabled": wal_disabled,
+            "breakers": breakers,
+            "quarantined_tenants": dict(guard.quarantine.active()) if guard is not None else {},
+        }
+        if guard is not None:
+            guard.publish_health(state)
+        return out
+
+    def _publish_health(self) -> None:
+        """Refresh the obs health gauge after a state transition (no-op without guard)."""
+        if self._guard is not None:
+            self.health()
+
     def telemetry_snapshot(self) -> Dict[str, Any]:
         snap = self.telemetry.snapshot()
         snap["fused"] = self._fused
         snap["degraded"] = self._degraded
+        snap["quarantined"] = self._quarantined
         snap["tenants"] = len(self._keyed.keys)
         if self._ckpt_writer is not None:
             snap["ckpt_generation"] = self._ckpt_writer.last_generation
@@ -544,12 +765,58 @@ class StreamingEngine:
         # multi-host serving rides the comm plane (codecs, coalesced transfers,
         # retry/degradation ladder) with its own site label so engine syncs are
         # attributable separately from bare sync_state_host callers
-        if isinstance(self._metric, MetricCollection):
-            return {
-                name: sync_state_host(sub, self._metric._modules[name]._reductions, site="engine.compute")
-                for name, sub in state.items()
-            }
-        return sync_state_host(state, self._metric._reductions, site="engine.compute")
+        guard = self._guard
+        breaker = guard.comm_breaker if guard is not None else None
+        if breaker is not None and not breaker.permit():
+            # repeated degraded/stale syncs: pin sync=False for the probation —
+            # local state NOW beats a retry ladder walk that ends stale anyway
+            self.telemetry.count("sync_pinned")
+            return state
+        from metrics_tpu.comm import plane as _comm_plane
+
+        # only reports THIS call produced may judge the breaker: the
+        # single-process identity path publishes nothing, and a stale report
+        # from an earlier sync must not re-trip a healthy probe. For a
+        # collection, EVERY member's sync is judged — one member walking the
+        # ladder to stale local state makes the whole result partially stale.
+        prev = _comm_plane.last_report() if breaker is not None else None
+        degraded = False
+        conclusive = False
+
+        def _judge() -> None:
+            nonlocal prev, degraded, conclusive
+            report = _comm_plane.last_report()
+            if report is not None and report is not prev and report.site == "engine.compute":
+                conclusive = True
+                if report.stale or report.degraded_step != "none":
+                    degraded = True
+            prev = report
+
+        try:
+            if isinstance(self._metric, MetricCollection):
+                synced = {}
+                for name, sub in state.items():
+                    synced[name] = sync_state_host(
+                        sub, self._metric._modules[name]._reductions, site="engine.compute"
+                    )
+                    if breaker is not None:
+                        _judge()
+            else:
+                synced = sync_state_host(state, self._metric._reductions, site="engine.compute")
+                if breaker is not None:
+                    _judge()
+        except Exception:
+            if breaker is not None:
+                breaker.record_failure()
+            raise
+        if breaker is not None:
+            if degraded:
+                breaker.record_failure()
+            elif conclusive:
+                breaker.record_success()
+            else:
+                breaker.abandon_probe()
+        return synced
 
     # ---------------------------------------------------- durable state plane
 
@@ -569,7 +836,7 @@ class StreamingEngine:
             policy=cfg.policy,
             schema_version=_ENGINE_SCHEMA_VERSION,
             on_commit=self._on_snapshot_commit,
-            on_error=lambda exc: self.telemetry.count("checkpoint_failures"),
+            on_error=self._on_snapshot_error,
         )
         if cfg.resume:
             self._recover()
@@ -581,6 +848,8 @@ class StreamingEngine:
         older generation whose tail records must still be replayable — so the
         rotation point is the OLDEST retained generation's coverage."""
         self.telemetry.count("checkpoints")
+        if self._guard is not None and self._guard.ckpt_breaker is not None:
+            self._guard.ckpt_breaker.record_success()
         if self._journal is None:
             return
         self._snapshot_seqs[generation] = int(tree["seq"])
@@ -713,8 +982,35 @@ class StreamingEngine:
         meta = {"tenants": len(keyed.keys), "seq": tree["seq"]}
         return tree, meta
 
+    def _on_snapshot_error(self, exc: BaseException) -> None:
+        """Writer-thread callback: count the absorbed failure, feed the breaker."""
+        self.telemetry.count("checkpoint_failures")
+        if self._guard is not None and self._guard.ckpt_breaker is not None:
+            self._guard.ckpt_breaker.record_failure()
+
     def _maybe_checkpoint(self) -> None:
         if self._ckpt_writer is None:
+            return
+        breaker = self._guard.ckpt_breaker if self._guard is not None else None
+        if breaker is not None:
+            if not breaker.permit():
+                # repeated commit failures: suspend snapshot attempts for the
+                # (exponentially growing) probation instead of paying a doomed
+                # serialize+write every interval — the WAL still covers the gap
+                self.telemetry.count("ckpt_suspended")
+                return
+            issued = False
+            try:
+                issued = self._ckpt_writer.maybe_checkpoint(self._checkpoint_view)
+            except Exception:  # noqa: BLE001 — a snapshot failure must not kill the dispatcher
+                self.telemetry.count("checkpoint_failures")
+                breaker.record_failure()
+                return
+            finally:
+                if not issued and breaker is not None:
+                    # nothing was attempted (not due / writer busy): a permitted
+                    # half-open probe must not stay claimed forever
+                    breaker.abandon_probe()
             return
         try:
             self._ckpt_writer.maybe_checkpoint(self._checkpoint_view)
@@ -727,7 +1023,7 @@ class StreamingEngine:
         ``None`` when checkpointing is off or the write failed (the failure is
         counted and kept on ``self._ckpt_writer.last_error``, never raised).
         """
-        if self._ckpt_writer is None:
+        if self._ckpt_writer is None or self._quarantined:
             return None
         self.flush()
         return self._ckpt_writer.checkpoint_sync(self._checkpoint_view)
@@ -882,33 +1178,77 @@ class StreamingEngine:
             if replayed:
                 self.telemetry.count("replayed", replayed)
 
-    def _run(self) -> None:
+    def _run(self, epoch: int = 0) -> None:
+        detector = self._hang_detector
+        backlog = self._guard.backlog if self._guard is not None else None
         while True:
             with self._not_empty:
-                while not self._queue and not self._closed:
+                while (
+                    not self._queue
+                    and not (backlog is not None and backlog.count)
+                    and not self._closed
+                    and self._worker_epoch == epoch
+                ):
                     self._not_empty.wait(0.1)
-                if not self._queue and self._closed:
+                if self._worker_epoch != epoch:
+                    return  # superseded while idle: a fresh generation owns the queue
+                if not self._queue and not (backlog is not None and backlog.count) and self._closed:
                     return
-                batch = self._queue
-                self._queue = []
+                if self._guard is not None:
+                    # arrival queue moves into the guard's persistent fair
+                    # backlog; selection costs O(quantum), never O(backlog)
+                    batch, rejected = self._guard.form_drain(self._queue)
+                    self._queue = []
+                else:
+                    batch, rejected = self._queue, []
+                    self._queue = []
                 self._inflight = len(batch)
-                self.telemetry.gauge_queue_depth(0)
+                # a hang takeover replays exactly this list (minus resolved futures)
+                self._active_batch = batch
+                self.telemetry.gauge_queue_depth(backlog.count if backlog is not None else 0)
                 self._not_full.notify_all()
+                if not batch and not self._queue and not (backlog is not None and backlog.count):
+                    self._idle.notify_all()
+            if detector is not None:
+                detector.mark_busy()
+            # fail expired/shed requests fast, outside the engine lock (future
+            # callbacks run arbitrary user code)
+            for req, exc in rejected:
+                self.telemetry.count("failed")
+                req.future.set_exception(exc)
+            if not batch:
+                if detector is not None:
+                    detector.mark_idle()
+                continue
             self._worker_gate.wait()
+            with self._lock:
+                if self._worker_epoch != epoch:
+                    return  # declared hung at the gate: the handler owns the batch now
             try:
-                self._process(batch)
+                self._process(batch, epoch)
                 with self._lock:
+                    if self._worker_epoch != epoch:
+                        return  # superseded mid-batch: the handler owns accounting
+                    self._active_batch = None
                     self._inflight = 0
                     self._idle.notify_all()
                 self._maybe_checkpoint()
+                if detector is not None:
+                    detector.mark_idle()
+            except _WorkerSuperseded:
+                return
             except BaseException as exc:  # noqa: BLE001 — dispatcher death: degrade, don't lose work
-                self._on_worker_death(exc, batch)
+                self._on_worker_death(exc, batch, epoch)
                 return
 
-    def _process(self, batch: List[_Request]) -> None:
+    def _check_epoch(self, epoch: Optional[int]) -> None:
+        if epoch is not None and self._worker_epoch != epoch:
+            raise _WorkerSuperseded()
+
+    def _process(self, batch: List[_Request], epoch: Optional[int] = None) -> None:
         if self._fused:
             try:
-                self._process_fused(batch)
+                self._process_fused(batch, epoch)
                 return
             except _FusedUnsupported:
                 pass
@@ -919,16 +1259,19 @@ class StreamingEngine:
             # outside the trace, so a malformed request fails ITS future there while
             # an untraceable-but-valid update succeeds for every request.
             remaining = [req for req in batch if not req.future.done()]
-            self._process_eager(remaining)
+            self._process_eager(remaining, epoch)
             if remaining and all(req.future.exception() is None for req in remaining):
                 self._demote_to_eager()
             return
-        self._process_eager([req for req in batch if not req.future.done()])
+        self._process_eager([req for req in batch if not req.future.done()], epoch)
 
     # ---------------------------------------------------- fused (bucketed) dispatch
 
-    def _process_fused(self, batch: List[_Request]) -> None:
+    def _process_fused(self, batch: List[_Request], epoch: Optional[int] = None) -> None:
         with self._dispatch_lock:
+            # re-validate the generation under the lock a hang takeover must
+            # acquire before replaying: a superseded worker never dispatches
+            self._check_epoch(epoch)
             if self._keyed.ensure_capacity():
                 self.telemetry.count("key_growths")
             for signature, reqs in self._signature_groups(batch):
@@ -992,6 +1335,17 @@ class StreamingEngine:
         total_rows: int,
     ) -> None:
         bucket = choose_bucket(total_rows, self._buckets)
+        if (
+            self._guard is not None
+            and (signature, bucket, self._keyed.capacity) not in self._kernels
+            and not self._guard.allow_compile()
+        ):
+            # compile breaker open: a novel signature would grow the compile
+            # cache — run this chunk eagerly instead. Cached kernels keep
+            # serving everyone else at full speed; the signature sprayer pays
+            # with its own latency.
+            self._apply_chunk_eager(units)
+            return
         kernel = self._get_kernel(signature, bucket, self._keyed.capacity)
         columns, key_ids, mask = pad_micro_batch(
             [(req.slot, chunk_args, rows) for req, chunk_args, rows, _ in units], bucket
@@ -1015,6 +1369,40 @@ class StreamingEngine:
             self.telemetry.count("processed")
             self.telemetry.observe_latency(now - req.t_submit)
             req.future.set_result({"key": req.key, "rows": req.rows, "bucket": bucket})
+            if self._guard is not None and self._guard._quarantine_entries:
+                # successes only matter to tenants with a live failure ledger
+                self._guard.on_request_outcome(req.key, True)
+
+    def _apply_chunk_eager(self, units: List[Tuple[_Request, Tuple[Any, ...], int, bool]]) -> None:
+        """Apply one chunk's rows eagerly under the dispatch lock (compile breaker
+        open): whole-chunk ``update_state`` per request — the same semantics as the
+        eager/inline path, journaled the same way (one 'R' record per chunk) so a
+        replay reproduces exactly what was applied."""
+        for req, chunk_args, rows, is_last in units:
+            if req.future.done():
+                continue  # an earlier chunk of this request already failed it
+            try:
+                if self._journal is not None:
+                    self._journal_append(
+                        [_encode_request_record(self._key_bytes(req.key), chunk_args)]
+                    )
+                self._keyed.ensure_capacity()
+                state = self._keyed.state_of(req.key)
+                self._keyed.set_state(req.key, self._metric.update_state(state, *chunk_args))
+            except Exception as exc:  # noqa: BLE001 — fail THIS request, keep serving
+                self.telemetry.count("failed")
+                req.future.set_exception(exc)
+                if self._guard is not None:
+                    self._guard.on_request_outcome(req.key, False)
+                continue
+            req.rows_done += rows
+            if not is_last:
+                continue
+            self.telemetry.count("processed")
+            self.telemetry.observe_latency(time.perf_counter() - req.t_submit)
+            req.future.set_result({"key": req.key, "rows": req.rows, "bucket": None})
+            if self._guard is not None:
+                self._guard.on_request_outcome(req.key, True)
 
     def _get_kernel(self, signature: Signature, bucket: int, capacity: int) -> Callable:
         cache_key = (signature, bucket, capacity)
@@ -1097,19 +1485,27 @@ class StreamingEngine:
 
     # ---------------------------------------------------- eager / degraded dispatch
 
-    def _process_eager(self, batch: List[_Request]) -> None:
+    def _process_eager(self, batch: List[_Request], epoch: Optional[int] = None) -> None:
         for req in batch:
+            self._check_epoch(epoch)
             self._apply_inline(req)
 
     def _apply_inline(self, req: _Request) -> None:
         """Synchronous per-request dispatch (eager mode, and the degraded path).
 
         Applies only the rows a fused chunk has not already committed, so a request
-        caught mid-demotion is never double-counted.
+        caught mid-demotion is never double-counted. Duplicate application from a
+        hang-takeover replay racing the superseded worker is excluded UNDER the
+        dispatch lock: the skip check reads ``future.done()`` *and* ``rows_done``
+        (marked applied inside the lock, before resolution happens outside it), so
+        two appliers serialize — the loser sees the marker and returns without
+        touching state or the future.
         """
         try:
             args = req.args if req.rows_done == 0 else tuple(a[req.rows_done :] for a in req.args)
             with _obs.engine_span("engine.inline", rows=req.rows), self._dispatch_lock:
+                if req.future.done() or (req.rows > 0 and req.rows_done >= req.rows):
+                    return
                 # journal INSIDE the dispatch lock: a snapshot (same lock)
                 # must never record WAL coverage of a not-yet-applied request.
                 # Trimmed args keep rows already committed (and chunk-
@@ -1121,9 +1517,21 @@ class StreamingEngine:
                     state = self._keyed.state_of(req.key)
                     state = self._metric.update_state(state, *args)
                     self._keyed.set_state(req.key, state)
+                # applied: mark before leaving the lock, so a concurrent
+                # replayer can never re-apply while we resolve outside it
+                req.rows_done = req.rows
         except Exception as exc:  # noqa: BLE001 — fail THIS request, keep serving
+            try:
+                req.future.set_exception(exc)
+            except Exception:  # noqa: BLE001 — already resolved by a racing applier
+                return
             self.telemetry.count("failed")
-            req.future.set_exception(exc)
+            if self._guard is not None:
+                self._guard.on_request_outcome(req.key, False)
+            return
+        try:
+            req.future.set_result({"key": req.key, "rows": req.rows, "bucket": None})
+        except Exception:  # noqa: BLE001 — already resolved by a racing applier
             return
         self.telemetry.count("processed")
         if self._degraded or self._worker is None:
@@ -1131,20 +1539,30 @@ class StreamingEngine:
             # lands here, and counting it would make a healthy engine look degraded
             self.telemetry.count("inline_dispatches")
         self.telemetry.observe_latency(time.perf_counter() - req.t_submit)
-        req.future.set_result({"key": req.key, "rows": req.rows, "bucket": None})
+        if self._guard is not None and self._guard._quarantine_entries:
+            self._guard.on_request_outcome(req.key, True)
 
-    def _on_worker_death(self, exc: BaseException, batch: List[_Request]) -> None:
+    def _on_worker_death(self, exc: BaseException, batch: List[_Request], epoch: Optional[int] = None) -> None:
         """Dispatcher crashed: complete all accepted work inline, then degrade.
 
         ``_inflight`` stays equal to the unreplayed remainder throughout, so a
         concurrent ``flush()`` keeps blocking until the replay finishes — 'accepted
-        implies committed after flush' holds across the degradation.
+        implies committed after flush' holds across the degradation. With a guard
+        plane configured for restarts, a fresh dispatcher is started once the
+        replay completes and the engine returns to ``SERVING``.
         """
         self._worker_error = exc
         self.telemetry.count("worker_deaths")
         with self._lock:
+            if epoch is not None and self._worker_epoch != epoch:
+                return  # a hang takeover already owns this batch and the queue
+            # supersede ourselves so a concurrent hang takeover cannot double-own
+            self._worker_epoch += 1
             self._degraded = True
+            self._active_batch = None
             pending = [req for req in batch if not req.future.done()] + self._queue
+            if self._guard is not None:
+                pending += self._guard.take_backlog()
             self._queue = []
             self._inflight = len(pending)
             self.telemetry.gauge_queue_depth(0)
@@ -1158,3 +1576,97 @@ class StreamingEngine:
             with self._lock:
                 self._inflight = 0
                 self._idle.notify_all()
+            if self._hang_detector is not None:
+                self._hang_detector.mark_idle()
+        self._maybe_restart_worker()
+        self._publish_health()
+
+    def _on_worker_hang(self) -> None:
+        """Watchdog callback: the dispatcher has been busy on one batch past the
+        timeout. Supersede it (epoch bump) and decide by probing the dispatch lock:
+
+        - lock acquirable within ``hang_lock_timeout_s`` → the worker is stuck
+          *outside* the device path (and can never dispatch again: it re-checks
+          its epoch under this very lock). Replay the taken-over batch + queue
+          inline — the existing flush-correct worker-death ladder — then
+          restart a fresh dispatcher if configured.
+        - lock NOT acquirable → the worker is wedged inside a device call;
+          replaying would risk double-commit if the call ever completes.
+          QUARANTINE the engine: fail every pending future fast and reject all
+          further calls instead of hanging clients on a dead device.
+        """
+        with self._lock:
+            if self._closed or self._degraded or self._quarantined:
+                return
+            if self._active_batch is None and not self._queue and not self._guard.backlog.count:
+                return  # raced with batch completion: nothing is actually stuck
+            self._worker_epoch += 1
+            self._degraded = True  # submits go inline while we sort this out
+            batch = self._active_batch or []
+            self._active_batch = None
+            pending = [req for req in batch if not req.future.done()] + self._queue
+            pending += self._guard.take_backlog()
+            self._queue = []
+            self._inflight = len(pending)
+            self.telemetry.gauge_queue_depth(0)
+            self._not_full.notify_all()
+        self.telemetry.count("worker_hangs")
+        self._worker_error = TimeoutError(
+            f"dispatcher hung: busy past the {self._guard.cfg.watchdog_timeout_s}s watchdog timeout"
+        )
+        timeout = self._guard.cfg.hang_lock_timeout_s
+        if not self._dispatch_lock.acquire(timeout=timeout):
+            self._quarantine_engine(pending)
+            return
+        self._dispatch_lock.release()
+        try:
+            for req in pending:
+                self._apply_inline(req)
+                with self._lock:
+                    self._inflight -= 1
+        finally:
+            with self._lock:
+                self._inflight = 0
+                self._idle.notify_all()
+            if self._hang_detector is not None:
+                self._hang_detector.mark_idle()
+        self._maybe_restart_worker()
+        self._publish_health()
+
+    def _quarantine_engine(self, pending: List[_Request]) -> None:
+        """The wedged worker cannot be taken over safely: fail fast from now on."""
+        with self._lock:
+            self._quarantined = True
+            self._not_full.notify_all()
+        exc = EngineQuarantined(
+            "StreamingEngine quarantined: dispatcher wedged in a device call; "
+            "request not committed"
+        )
+        for req in pending:
+            if not req.future.done():
+                self.telemetry.count("failed")
+                req.future.set_exception(exc)
+            if req.is_probe and self._guard is not None:
+                self._guard.abandon_probe(req.key)
+        with self._lock:
+            self._inflight = 0
+            self._idle.notify_all()
+        if self._hang_detector is not None:
+            self._hang_detector.mark_idle()
+        self._publish_health()
+
+    def _maybe_restart_worker(self) -> None:
+        """Start a fresh dispatcher after a death/hang takeover, budget permitting."""
+        guard = self._guard
+        if guard is None or not guard.cfg.restart:
+            return
+        with self._lock:
+            if self._closed or self._quarantined:
+                return
+            if self._worker_restarts >= guard.cfg.max_restarts:
+                return  # stay degraded-inline: restart storms help nobody
+            self._worker_restarts += 1
+            self._degraded = False
+            self._spawn_worker()
+        self.telemetry.count("watchdog_restarts")
+        _obs.record_guard_event(guard._engine_label, "watchdog_restarts")

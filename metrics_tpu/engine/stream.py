@@ -23,6 +23,7 @@ from typing import Any, Deque, Dict, Hashable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from metrics_tpu.utils.exceptions import MetricsTPUUserError
 
@@ -57,7 +58,16 @@ class KeyedState:
     # ------------------------------------------------------------------ slots
 
     def _tiled(self, k: int) -> Any:
-        return jax.tree.map(lambda x: jnp.broadcast_to(jnp.asarray(x), (k,) + jnp.shape(x)), self._init)
+        # strong-typed leaves: scalar init values come in weak-typed, while the
+        # kernel's outputs are strong-typed — mixing the two makes the first
+        # dispatch after every reset/rotate a fresh jit-cache miss (a silent
+        # ~100ms XLA recompile per bucket)
+        def tile(x: Any) -> Any:
+            arr = jnp.asarray(x)
+            arr = lax.convert_element_type(arr, arr.dtype)
+            return jnp.broadcast_to(arr, (k,) + arr.shape)
+
+        return jax.tree.map(tile, self._init)
 
     @property
     def keys(self) -> Tuple[Hashable, ...]:

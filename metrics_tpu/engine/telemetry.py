@@ -57,6 +57,21 @@ _COUNTERS = (
     "wal_records",          # requests journaled ahead of their state commit
     "replayed",             # journaled requests re-applied during recovery
     "recoveries",           # restart-time restores from a valid snapshot
+    # guard plane (zero unless the engine was built with guard=; see
+    # metrics_tpu/guard/ and docs/source/robustness.md)
+    "shed",                    # requests dropped by the overload controller
+    "quota_rejections",        # submits refused by a tenant's token bucket
+    "deadline_expired",        # requests whose deadline lapsed before dispatch
+    "quarantines",             # tenants placed under failure probation
+    "quarantine_rejections",   # submits failed fast from quarantined tenants
+    "compile_rejections",      # novel-signature chunks routed eager by the compile breaker
+    "ckpt_suspended",          # snapshot attempts skipped while the ckpt breaker is open
+    "sync_pinned",             # sync=True computes served local state (comm breaker open)
+    "worker_hangs",            # dispatchers declared hung by the watchdog
+    "watchdog_restarts",       # fresh dispatchers started after a hang/death takeover
+    # zombie surfacing is guard-independent: close() counts a worker that
+    # outlived its join timeout whether or not a guard plane is configured
+    "zombie_workers",
 )
 
 # distinguishes engines within one process; monotone so labels never collide

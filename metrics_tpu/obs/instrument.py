@@ -436,6 +436,75 @@ def ckpt_span(name: str, **attrs: Any) -> Any:
     return TRACER.span(name, **attrs)
 
 
+# ---------------------------------------------------------------------- guard plane
+
+GUARD_SHED = REGISTRY.counter(
+    "metrics_tpu_guard_shed_total",
+    "Requests dropped by the overload controller (queue sojourn above target for a full interval), per engine.",
+)
+GUARD_QUOTA_REJECTIONS = REGISTRY.counter(
+    "metrics_tpu_guard_quota_rejections_total",
+    "Submits refused at admission because the tenant's token bucket was empty, per engine.",
+)
+GUARD_DEADLINE_EXPIRED = REGISTRY.counter(
+    "metrics_tpu_guard_deadline_expired_total",
+    "Requests whose deadline expired before dispatch (failed fast, no batch slot), per engine.",
+)
+GUARD_WATCHDOG_RESTARTS = REGISTRY.counter(
+    "metrics_tpu_guard_watchdog_restarts_total",
+    "Dispatcher workers superseded and restarted after the watchdog declared them hung, per engine.",
+)
+GUARD_QUARANTINES = REGISTRY.counter(
+    "metrics_tpu_guard_quarantines_total",
+    "Tenants placed under quarantine probation after repeated request failures, per engine.",
+)
+GUARD_BREAKER_STATE = REGISTRY.gauge(
+    "metrics_tpu_guard_breaker_state",
+    "Circuit breaker state per engine and dependency (0=closed, 1=half-open, 2=open).",
+)
+GUARD_HEALTH_STATE = REGISTRY.gauge(
+    "metrics_tpu_guard_health_state",
+    "Engine health state machine (0=SERVING, 1=DEGRADED, 2=QUARANTINED).",
+)
+
+_GUARD_EVENT_COUNTERS = {
+    "shed": GUARD_SHED,
+    "quota_rejections": GUARD_QUOTA_REJECTIONS,
+    "deadline_expired": GUARD_DEADLINE_EXPIRED,
+    "watchdog_restarts": GUARD_WATCHDOG_RESTARTS,
+    "quarantines": GUARD_QUARANTINES,
+}
+
+_HEALTH_CODES = {"SERVING": 0, "DEGRADED": 1, "QUARANTINED": 2}
+
+
+def record_guard_event(engine: str, kind: str, n: int = 1) -> None:
+    """Count one guard decision (kind in shed|quota_rejections|deadline_expired|
+    watchdog_restarts|quarantines) against its engine label."""
+    if not OBS.enabled:
+        return
+    _GUARD_EVENT_COUNTERS[kind].inc(n, engine=engine)
+
+
+def set_guard_breaker_state(engine: str, breaker: str, state_code: int) -> None:
+    if not OBS.enabled:
+        return
+    GUARD_BREAKER_STATE.set(state_code, engine=engine, breaker=breaker)
+
+
+def set_guard_health(engine: str, state: str) -> None:
+    if not OBS.enabled:
+        return
+    GUARD_HEALTH_STATE.set(_HEALTH_CODES[state], engine=engine)
+
+
+def guard_span(name: str, **attrs: Any) -> Any:
+    """Trace span for guard-plane internals (drain forming, hang handling)."""
+    if not OBS.enabled:
+        return _NULL_SPAN
+    return TRACER.span(name, **attrs)
+
+
 # ---------------------------------------------------------------------- engine hooks
 
 

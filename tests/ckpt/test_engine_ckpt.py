@@ -224,8 +224,21 @@ class TestRestartSoak:
         stream = _stream(9, 10_000, keys=16)
         cfg = CheckpointConfig(directory=str(tmp_path), interval_s=0.05, durable=False)
         cut = 6_000
+        tail = 200
         e1 = StreamingEngine(BinaryAccuracy(), buckets=(16, 64), checkpoint=cfg)
-        for key, p, t in stream[:cut]:
+        for key, p, t in stream[: cut - tail]:
+            e1.submit(key, jnp.asarray(p), jnp.asarray(t))
+        e1.flush()
+        deadline = time.monotonic() + 30
+        while e1._ckpt_writer.writes == 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert e1._ckpt_writer.writes >= 1
+        # freeze periodic snapshots so the final stretch DETERMINISTICALLY
+        # lives only in the WAL (a due snapshot racing close(checkpoint=False)
+        # could otherwise cover the whole stream and leave nothing to replay)
+        e1._ckpt_writer.interval_s = 1e9
+        e1._ckpt_writer.quiesce(timeout=30)
+        for key, p, t in stream[cut - tail : cut]:
             e1.submit(key, jnp.asarray(p), jnp.asarray(t))
         e1.flush()
         e1.close(checkpoint=False)  # restart mid-stream, no final snapshot
@@ -233,7 +246,7 @@ class TestRestartSoak:
         e2 = StreamingEngine(BinaryAccuracy(), buckets=(16, 64), checkpoint=cfg)
         s = e2.telemetry_snapshot()
         assert s["recoveries"] == 1
-        assert s["replayed"] >= 1  # periodic snapshots mean SOME tail replays
+        assert s["replayed"] >= 1  # the frozen-snapshot tail must replay
         for key, p, t in stream[cut:]:
             e2.submit(key, jnp.asarray(p), jnp.asarray(t))
         e2.flush()

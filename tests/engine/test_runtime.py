@@ -169,7 +169,7 @@ def test_worker_death_degrades_to_inline_dispatch():
 
         boom = RuntimeError("injected dispatcher crash")
 
-        def exploding_process(batch):
+        def exploding_process(batch, *args):
             raise boom
 
         engine._process = exploding_process
@@ -321,7 +321,7 @@ def test_flush_blocks_through_worker_death_replay():
     try:
         engine._worker_gate.clear()  # hold the dispatcher with work queued
         futures = [engine.submit("k", jnp.asarray([1]), jnp.asarray([1])) for _ in range(6)]
-        engine._process = lambda batch: (_ for _ in ()).throw(RuntimeError("boom"))
+        engine._process = lambda batch, *a: (_ for _ in ()).throw(RuntimeError("boom"))
         engine._worker_gate.set()
         engine.flush(timeout=30)
         assert all(f.done() and f.exception() is None for f in futures)

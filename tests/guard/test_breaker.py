@@ -1,0 +1,130 @@
+"""Circuit breaker state machine: trip threshold, exponential probation,
+half-open single probe, success reset — manual clock, no sleeps."""
+
+from metrics_tpu.guard.breaker import BREAKER_STATE_CODES, CircuitBreaker, CompileGovernor
+from metrics_tpu.guard.faults import ManualClock
+
+
+def _breaker(clock, **kw):
+    kw.setdefault("failure_threshold", 3)
+    kw.setdefault("probation_s", 1.0)
+    kw.setdefault("probation_max_s", 8.0)
+    kw.setdefault("probation_factor", 2.0)
+    return CircuitBreaker("test", clock=clock, **kw)
+
+
+def test_trips_only_on_consecutive_failures():
+    clock = ManualClock()
+    b = _breaker(clock)
+    b.record_failure()
+    b.record_failure()
+    b.record_success()  # streak broken
+    b.record_failure()
+    b.record_failure()
+    assert b.state == "closed"
+    b.record_failure()  # third consecutive
+    assert b.state == "open"
+    assert not b.permit()
+
+
+def test_half_open_single_probe_then_close():
+    clock = ManualClock()
+    b = _breaker(clock)
+    for _ in range(3):
+        b.record_failure()
+    assert not b.permit()
+    clock.advance(1.01)  # probation elapsed
+    assert b.permit()  # the ONE probe
+    assert not b.permit()  # everyone else still refused
+    assert b.state == "half_open"
+    b.record_success()
+    assert b.state == "closed"
+    assert b.permit()
+
+
+def test_failed_probe_doubles_probation_up_to_cap():
+    clock = ManualClock()
+    b = _breaker(clock)
+    for _ in range(3):
+        b.record_failure()
+    expected = [2.0, 4.0, 8.0, 8.0]  # base 1.0 tripped once already; factor 2, cap 8
+    for probation in expected:
+        clock.advance(1e9)  # any probation has long elapsed
+        assert b.permit()  # probe
+        b.record_failure()  # probe fails -> re-open, ladder grows
+        snap = b.snapshot()
+        assert snap["state"] == "open"
+        assert snap["open_until"] - clock() == probation
+
+
+def test_success_resets_probation_ladder():
+    clock = ManualClock()
+    b = _breaker(clock)
+    for _ in range(3):
+        b.record_failure()
+    clock.advance(1e9)
+    assert b.permit()
+    b.record_failure()  # trips=2 now
+    clock.advance(1e9)
+    assert b.permit()
+    b.record_success()  # full recovery
+    for _ in range(3):
+        b.record_failure()  # fresh trip
+    snap = b.snapshot()
+    assert snap["open_until"] - clock() == 1.0  # base probation again, not 4.0
+
+
+def test_abandon_probe_frees_the_slot():
+    clock = ManualClock()
+    b = _breaker(clock)
+    for _ in range(3):
+        b.record_failure()
+    clock.advance(1.01)
+    assert b.permit()
+    assert not b.permit()
+    b.abandon_probe()
+    assert b.permit()  # slot free again
+
+
+def test_transition_hook_sees_every_edge():
+    clock = ManualClock()
+    edges = []
+    b = CircuitBreaker(
+        "hooked", failure_threshold=1, probation_s=1.0, clock=clock,
+        on_transition=lambda name, old, new: edges.append((name, old, new)),
+    )
+    b.record_failure()
+    clock.advance(1.01)
+    b.permit()
+    b.record_success()
+    assert edges == [
+        ("hooked", "closed", "open"),
+        ("hooked", "open", "half_open"),
+        ("hooked", "half_open", "closed"),
+    ]
+
+
+def test_state_codes_cover_all_states():
+    assert BREAKER_STATE_CODES == {"closed": 0, "half_open": 1, "open": 2}
+
+
+class TestCompileGovernor:
+    def test_within_budget_compiles_freely(self):
+        clock = ManualClock()
+        gov = CompileGovernor(1.0, 4.0, _breaker(clock, failure_threshold=2))
+        assert all(gov.allow_compile() for _ in range(4))
+        assert gov.breaker.state == "closed"
+
+    def test_storm_trips_then_probe_recovers(self):
+        clock = ManualClock()
+        gov = CompileGovernor(1.0, 4.0, _breaker(clock, failure_threshold=2))
+        for _ in range(4):
+            assert gov.allow_compile()
+        assert not gov.allow_compile()  # budget gone: failure 1
+        assert not gov.allow_compile()  # failure 2 -> trips
+        assert gov.breaker.state == "open"
+        clock.advance(0.5)
+        assert not gov.allow_compile()  # probation running: no bucket check at all
+        clock.advance(1.0)  # probation over AND ~1.5 tokens refilled
+        assert gov.allow_compile()  # half-open probe finds budget -> closed
+        assert gov.breaker.state == "closed"

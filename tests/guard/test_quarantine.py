@@ -1,0 +1,87 @@
+"""Poison-tenant quarantine: threshold, exponential probation, half-open probe,
+forgiveness, bounded memory — manual clock."""
+
+from metrics_tpu.guard.faults import ManualClock
+from metrics_tpu.guard.quarantine import ALLOW, DENY, PROBE, TenantQuarantine
+
+
+def _q(clock, **kw):
+    kw.setdefault("threshold", 3)
+    kw.setdefault("probation_s", 1.0)
+    kw.setdefault("probation_max_s", 8.0)
+    kw.setdefault("probation_factor", 2.0)
+    return TenantQuarantine(clock=clock, **kw)
+
+
+def test_threshold_consecutive_failures_quarantines():
+    clock = ManualClock()
+    q = _q(clock)
+    assert not q.record("t", ok=False)
+    assert not q.record("t", ok=False)
+    assert q.check("t") == ALLOW  # not yet
+    assert q.record("t", ok=False)  # third: quarantined
+    assert q.check("t") == DENY
+    assert q.is_quarantined("t")
+    assert "t" in q.active()
+
+
+def test_success_breaks_the_streak_and_clears_memory():
+    clock = ManualClock()
+    q = _q(clock)
+    q.record("t", ok=False)
+    q.record("t", ok=False)
+    q.record("t", ok=True)  # streak broken before the threshold
+    q.record("t", ok=False)
+    q.record("t", ok=False)
+    assert q.check("t") == ALLOW  # 2 < threshold again: never quarantined
+    assert q.active() == {}
+    q.record("t", ok=True)
+    assert q._entries == {}  # bounded memory: success deletes the ledger entry
+
+
+def test_probe_after_probation_then_release():
+    clock = ManualClock()
+    q = _q(clock)
+    for _ in range(3):
+        q.record("t", ok=False)
+    assert q.check("t") == DENY
+    clock.advance(1.01)
+    assert q.check("t") == PROBE  # exactly one
+    assert q.check("t") == DENY  # while the probe is in flight
+    q.record("t", ok=True)
+    assert q.check("t") == ALLOW
+    assert not q.is_quarantined("t")
+
+
+def test_failed_probe_doubles_probation():
+    clock = ManualClock()
+    q = _q(clock)
+    for _ in range(3):
+        q.record("t", ok=False)  # offense 1: probation 1.0
+    for probation in (2.0, 4.0, 8.0, 8.0):  # capped at 8
+        clock.advance(1e9)
+        assert q.check("t") == PROBE
+        q.record("t", ok=False)
+        assert q.active()["t"] - clock() == probation
+
+
+def test_abandoned_probe_frees_the_slot():
+    clock = ManualClock()
+    q = _q(clock)
+    for _ in range(3):
+        q.record("t", ok=False)
+    clock.advance(1.01)
+    assert q.check("t") == PROBE
+    q.abandon("t")  # the probe submit got rejected downstream
+    assert q.check("t") == PROBE  # next submit gets the slot
+
+
+def test_tenants_are_independent():
+    clock = ManualClock()
+    q = _q(clock)
+    for _ in range(3):
+        q.record("bad", ok=False)
+    assert q.check("bad") == DENY
+    assert q.check("good") == ALLOW
+    q.record("good", ok=True)
+    assert q.check("good") == ALLOW

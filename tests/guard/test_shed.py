@@ -1,0 +1,58 @@
+"""CoDel-style shedder: interval detection, escalation, hysteresis exit —
+driven entirely by a manual clock."""
+
+from metrics_tpu.guard.faults import ManualClock
+from metrics_tpu.guard.shed import CoDelShedder
+
+
+def _shedder(clock):
+    return CoDelShedder(target_s=0.05, interval_s=0.1, clock=clock)
+
+
+def test_below_target_never_sheds():
+    clock = ManualClock()
+    shedder = _shedder(clock)
+    for _ in range(100):
+        clock.advance(0.01)
+        assert shedder.on_drain(0.01) == 0
+    assert not shedder.dropping
+
+
+def test_transient_spike_does_not_shed():
+    """One slow drain (a compile, a growth) must not drop anyone: the sojourn
+    has to stay above target for a FULL interval first."""
+    clock = ManualClock()
+    shedder = _shedder(clock)
+    assert shedder.on_drain(0.5) == 0  # spike starts the interval timer...
+    clock.advance(0.05)  # ...but recovery inside the interval
+    assert shedder.on_drain(0.01) == 0
+    assert not shedder.dropping
+    clock.advance(1.0)
+    assert shedder.on_drain(0.5) == 0  # a fresh spike starts a FRESH timer
+
+
+def test_standing_overload_sheds_and_escalates():
+    clock = ManualClock()
+    shedder = _shedder(clock)
+    assert shedder.on_drain(0.2) == 0  # timer armed
+    clock.advance(0.11)  # a full interval above target
+    assert shedder.on_drain(0.2) == 1
+    assert shedder.dropping
+    clock.advance(0.01)
+    assert shedder.on_drain(0.2) == 2  # escalation: one more per overloaded drain
+    clock.advance(0.01)
+    assert shedder.on_drain(0.2) == 3
+
+
+def test_recovery_exits_dropping_and_resets_escalation():
+    clock = ManualClock()
+    shedder = _shedder(clock)
+    shedder.on_drain(0.2)
+    clock.advance(0.11)
+    assert shedder.on_drain(0.2) == 1
+    assert shedder.on_drain(0.01) == 0  # sojourn back under target
+    assert not shedder.dropping
+    # the next overload episode starts from scratch: timer, then 1
+    assert shedder.on_drain(0.2) == 0
+    clock.advance(0.11)
+    assert shedder.on_drain(0.2) == 1

@@ -1,0 +1,202 @@
+"""Dispatch watchdog: detection policy (manual clock), and the two takeover
+paths through a real engine — recoverable hang (inline replay + restart) vs
+device-wedged hang (engine quarantine, fail fast)."""
+
+import time
+
+import jax.numpy as jnp
+import pytest
+
+from metrics_tpu.classification import BinaryAccuracy
+from metrics_tpu.engine import EngineQuarantined, GuardConfig, StreamingEngine
+from metrics_tpu.guard.faults import ManualClock, hold_dispatch_lock, wedge_dispatcher
+from metrics_tpu.guard.watchdog import HangDetector, Watchdog
+
+
+class TestHangDetector:
+    def test_idle_is_never_hung(self):
+        clock = ManualClock()
+        det = HangDetector(1.0, clock=clock)
+        clock.advance(100.0)
+        assert not det.hung()
+
+    def test_busy_past_timeout_is_hung(self):
+        clock = ManualClock()
+        det = HangDetector(1.0, clock=clock)
+        det.mark_busy()
+        clock.advance(0.9)
+        assert not det.hung()
+        clock.advance(0.2)
+        assert det.hung()
+
+    def test_idle_mark_resets(self):
+        clock = ManualClock()
+        det = HangDetector(1.0, clock=clock)
+        det.mark_busy()
+        clock.advance(2.0)
+        det.mark_idle()
+        assert not det.hung()
+        det.mark_busy()  # a fresh batch starts a fresh window
+        clock.advance(0.5)
+        assert not det.hung()
+
+    def test_repeated_busy_marks_keep_first_stamp(self):
+        """mark_busy is idempotent while busy: re-marking must not push the
+        window forward and hide a slowly-progressing hang."""
+        clock = ManualClock()
+        det = HangDetector(1.0, clock=clock)
+        det.mark_busy()
+        clock.advance(0.8)
+        det.mark_busy()
+        clock.advance(0.3)
+        assert det.hung()
+
+
+class TestWatchdogThread:
+    def test_fires_on_hang_and_records_probe_errors(self):
+        fired = []
+        hang = [False]
+        dog = Watchdog(lambda: hang[0], lambda: (fired.append(1), hang.__setitem__(0, False)), poll_s=0.01)
+        try:
+            time.sleep(0.05)
+            assert not fired
+            hang[0] = True
+            deadline = time.monotonic() + 5
+            while not fired and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert fired == [1]
+        finally:
+            dog.stop()
+
+    def test_probe_exception_is_recorded_not_fatal(self):
+        def bad_probe():
+            raise ValueError("probe exploded")
+
+        dog = Watchdog(bad_probe, lambda: None, poll_s=0.01)
+        try:
+            deadline = time.monotonic() + 5
+            while dog.last_error is None and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert isinstance(dog.last_error, ValueError)
+            assert dog._thread.is_alive()  # the monitor survived its probe
+        finally:
+            dog.stop()
+
+
+def _engine(**guard_kw):
+    guard_kw.setdefault("shed", False)
+    guard_kw.setdefault("watchdog_timeout_s", 0.2)
+    guard_kw.setdefault("watchdog_poll_s", 0.02)
+    guard_kw.setdefault("hang_lock_timeout_s", 0.2)
+    return StreamingEngine(
+        BinaryAccuracy(), buckets=(8,), capacity=4, guard=GuardConfig(**guard_kw)
+    )
+
+
+class TestEngineHangRecovery:
+    def test_gate_hang_is_replayed_and_restarted(self):
+        """Worker wedged OUTSIDE the device path (drained batch, gate held):
+        the watchdog takes the batch over, replays it inline (flush-correct),
+        restarts a fresh dispatcher, and health returns to SERVING."""
+        engine = _engine()
+        try:
+            with wedge_dispatcher(engine):
+                futures = [
+                    engine.submit("k", jnp.asarray([1]), jnp.asarray([1])) for _ in range(5)
+                ]
+                engine.flush(timeout=30)  # held open by the takeover until replay completes
+                assert all(f.result(timeout=1)["rows"] == 1 for f in futures)
+                deadline = time.monotonic() + 10  # the restart lands just after replay
+                while engine.degraded and time.monotonic() < deadline:
+                    time.sleep(0.01)
+                snap = engine.telemetry_snapshot()
+                assert snap["worker_hangs"] == 1
+                assert snap["watchdog_restarts"] == 1
+                assert not engine.degraded  # restarted, not permanently inline
+            assert engine.health()["state"] == "SERVING"
+            assert float(engine.compute("k")) == 1.0
+            # the restarted dispatcher serves the fused path again
+            f = engine.submit("k", jnp.asarray([1, 0]), jnp.asarray([1, 1]))
+            assert f.result(timeout=10)["bucket"] == 8
+        finally:
+            engine.close()
+
+    def test_device_wedge_quarantines_the_engine(self):
+        """Worker wedged INSIDE a device call (dispatch lock held): replay
+        would risk double-commit, so the engine quarantines — pending futures
+        fail fast, submits/computes raise, close() does not hang."""
+        engine = _engine()
+        try:
+            with wedge_dispatcher(engine), hold_dispatch_lock(engine):
+                futures = [
+                    engine.submit("k", jnp.asarray([1]), jnp.asarray([1])) for _ in range(3)
+                ]
+                deadline = time.monotonic() + 10
+                while not engine.quarantined and time.monotonic() < deadline:
+                    time.sleep(0.01)
+                assert engine.quarantined
+                for f in futures:
+                    assert isinstance(f.exception(timeout=1), EngineQuarantined)
+                engine.flush(timeout=5)  # drained by fail-fast, returns immediately
+            assert engine.health()["state"] == "QUARANTINED"
+            with pytest.raises(EngineQuarantined):
+                engine.submit("k", jnp.asarray([1]), jnp.asarray([1]))
+            with pytest.raises(EngineQuarantined):
+                engine.compute("k")
+            assert engine.telemetry_snapshot()["worker_hangs"] == 1
+            assert engine.telemetry_snapshot()["watchdog_restarts"] == 0
+        finally:
+            engine.close()  # must not hang on the quarantined engine
+
+    def test_restart_budget_exhausts_to_inline_degradation(self):
+        """max_restarts=1: the first hang restarts, the second leaves the
+        engine degraded-inline (still correct, no restart storm)."""
+        engine = _engine(max_restarts=1)
+        try:
+            for round_no in (1, 2):
+                with wedge_dispatcher(engine):
+                    f = engine.submit("k", jnp.asarray([1]), jnp.asarray([1]))
+                    engine.flush(timeout=30)
+                    assert f.result(timeout=1)["rows"] == 1
+                # wait out the takeover decision before re-wedging
+                deadline = time.monotonic() + 10
+                while engine.telemetry_snapshot()["worker_hangs"] < round_no and time.monotonic() < deadline:
+                    time.sleep(0.01)
+            snap = engine.telemetry_snapshot()
+            assert snap["worker_hangs"] == 2
+            assert snap["watchdog_restarts"] == 1
+            assert engine.degraded  # budget spent: inline mode
+            assert engine.health()["state"] == "DEGRADED"
+            # inline serving still correct
+            f = engine.submit("k", jnp.asarray([0]), jnp.asarray([1]))
+            assert f.result(timeout=10)["bucket"] is None
+            assert float(engine.compute("k")) == pytest.approx(2 / 3)
+        finally:
+            engine.close()
+
+    def test_worker_death_restarts_under_guard(self):
+        """The pre-guard permanent inline degradation becomes death → replay →
+        restart when a guard plane with restart budget is configured."""
+        engine = _engine()
+        try:
+            from metrics_tpu.guard.faults import kill_dispatcher
+
+            boom = kill_dispatcher(engine)
+            f = engine.submit("k", jnp.asarray([1]), jnp.asarray([1]))
+            assert f.result(timeout=10)["rows"] == 1
+            deadline = time.monotonic() + 10
+            while (
+                engine.telemetry_snapshot()["watchdog_restarts"] < 1
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.01)
+            assert not engine.degraded
+            assert engine._worker_error is boom
+            snap = engine.telemetry_snapshot()
+            assert snap["worker_deaths"] == 1
+            assert snap["watchdog_restarts"] == 1
+            assert engine.health()["state"] == "SERVING"
+            f2 = engine.submit("k", jnp.asarray([1]), jnp.asarray([1]))
+            assert f2.result(timeout=10)["bucket"] == 8  # fused again
+        finally:
+            engine.close()

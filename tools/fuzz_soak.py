@@ -988,6 +988,205 @@ def soak_ckpt(seeds) -> None:
                 _verify_ckpt_engine_kill(d, seed, tag)
 
 
+# ---------------------------------------------------------------------- guard chaos surface
+
+
+def soak_guard(seeds) -> None:
+    """Chaos soak for the guard plane (ISSUE 5): one guarded engine runs a
+    randomized multi-tenant stream through COMPOSED fault injections — DiskFull
+    checkpoint commits, a flaky comm transport, an in-process dispatcher kill,
+    a gate-wedged dispatcher hang (watchdog takeover + restart), a poison
+    tenant, and a torn newest snapshot at the end — and must (a) end with
+    ``health() == SERVING``, (b) hold per-tenant state bit-identical to an
+    unfaulted oracle fed the same accepted requests, and (c) recover a fresh
+    engine from the torn-snapshot store to the same state. Self-oracled —
+    needs no reference checkout (BinaryAccuracy's integer count states make
+    every comparison exact)."""
+    import tempfile
+    import time as _time
+
+    from metrics_tpu.ckpt.faults import DiskFull, tear
+    from metrics_tpu.classification import BinaryAccuracy
+    from metrics_tpu.comm import plane as comm_plane
+    from metrics_tpu.comm.transport import FlakyTransport, LocalTransport, TransportError
+    from metrics_tpu.engine import CheckpointConfig, GuardConfig, StreamingEngine, TenantQuarantined
+    from metrics_tpu.guard.faults import kill_dispatcher, poison_args, wedge_dispatcher
+
+    def _await(cond, timeout=15.0):
+        deadline = _time.monotonic() + timeout
+        while not cond() and _time.monotonic() < deadline:
+            _time.sleep(0.01)
+        return cond()
+
+    for seed in seeds:
+        rng = np.random.default_rng(seed)
+        tag = f"guard/chaos seed={seed}"
+        keys = [f"k{i}" for i in range(6)]
+        accepted: list = []  # (key, preds, target) whose futures must commit
+
+        def good_burst(engine, n):
+            futs = []
+            for _ in range(n):
+                key = keys[int(rng.integers(0, len(keys)))]
+                rows = int(rng.integers(1, 9))
+                p, t = rng.integers(0, 2, rows), rng.integers(0, 2, rows)
+                futs.append((key, p, t, engine.submit(key, jnp.asarray(p), jnp.asarray(t))))
+            return futs
+
+        all_futs: list = []
+        with tempfile.TemporaryDirectory() as ckpt_dir:
+            guard = GuardConfig(
+                shed=False,  # parity run: nothing droppable, every accepted row counts
+                quarantine_threshold=3, quarantine_probation_s=0.2,
+                breaker_failure_threshold=2, breaker_probation_s=0.1,
+                breaker_probation_max_s=0.5, compile_rate_per_s=100.0, compile_burst=64.0,
+                watchdog_timeout_s=0.3, watchdog_poll_s=0.05, hang_lock_timeout_s=0.5,
+            )
+            cfg = CheckpointConfig(directory=ckpt_dir, interval_s=0.05, retain=3,
+                                   durable=False, wal_flush="flush")
+            engine = StreamingEngine(BinaryAccuracy(), buckets=(8, 32), capacity=8,
+                                     max_queue=512, checkpoint=cfg, guard=guard)
+            try:
+                # phase A: healthy traffic
+                all_futs += good_burst(engine, 60)
+                # phase B: checkpoint commits fail (ENOSPC) -> ckpt breaker opens,
+                # snapshots suspend; serving continues
+                with DiskFull():
+                    all_futs += good_burst(engine, 60)
+                    engine.flush()
+                    engine._ckpt_writer.quiesce(timeout=10)
+                    _await(lambda: engine.telemetry_snapshot()["checkpoint_failures"] >= 1)
+                # phase C: poison tenant -> quarantine -> fail-fast -> parole
+                p_bad, t_bad = poison_args()
+                for _ in range(3):
+                    f = engine.submit("poison", jnp.asarray(p_bad), jnp.asarray(t_bad))
+                    if f.exception(timeout=15) is None:
+                        FAILS.append((seed, tag, "poison request unexpectedly succeeded"))
+                    engine.flush()
+                try:
+                    engine.submit("poison", jnp.asarray(p_bad), jnp.asarray(t_bad))
+                    FAILS.append((seed, tag, "quarantined tenant was not rejected"))
+                except TenantQuarantined:
+                    pass
+                # phase D: dispatcher crash -> inline replay -> guard restart
+                kill_dispatcher(engine)
+                all_futs += good_burst(engine, 20)
+                engine.flush(timeout=30)
+                if not _await(lambda: engine.telemetry_snapshot()["watchdog_restarts"] >= 1):
+                    FAILS.append((seed, tag, "no restart after dispatcher kill"))
+                # phase E: dispatcher hang at the gate -> watchdog takeover + restart
+                with wedge_dispatcher(engine):
+                    all_futs += good_burst(engine, 10)
+                    if not _await(lambda: engine.telemetry_snapshot()["worker_hangs"] >= 1):
+                        FAILS.append((seed, tag, "watchdog never detected the wedged dispatcher"))
+                    engine.flush(timeout=30)
+                    _await(lambda: engine.telemetry_snapshot()["watchdog_restarts"] >= 2)
+                # phase F: comm faults -> degraded syncs -> breaker pins local state
+                flaky = FlakyTransport(LocalTransport(), fail=10**6, exc=TransportError)
+                with comm_plane.use_config(transport=flaky, max_retries=0, backoff_base_s=0.0):
+                    engine.flush()
+                    for _ in range(2):
+                        engine.compute(keys[0], sync=True)
+                if engine._guard.comm_breaker.state == "closed":
+                    FAILS.append((seed, tag, "comm breaker did not trip on degraded syncs"))
+                # recovery: probations elapse, probes succeed, breakers close
+                _time.sleep(0.55)
+                with comm_plane.use_config(transport=LocalTransport()):
+                    engine.compute(keys[0], sync=True)  # comm probe
+                if engine.checkpoint_now() is None:  # ckpt probe (disk healthy again)
+                    FAILS.append((seed, tag, "checkpoint_now failed after DiskFull lifted"))
+                probe = engine.submit("poison", jnp.asarray([1]), jnp.asarray([1]))
+                if probe.exception(timeout=15) is not None:
+                    FAILS.append((seed, tag, "poison parole probe rejected"))
+                accepted.append(("poison", np.asarray([1]), np.asarray([1])))
+                all_futs += good_burst(engine, 40)
+                engine.flush(timeout=30)
+
+                # verdicts: every accepted future committed; health back to SERVING
+                for key, p, t, f in all_futs:
+                    if f.exception(timeout=15) is None:
+                        accepted.append((key, p, t))
+                    else:
+                        FAILS.append((seed, tag, f"good request failed: {f.exception()!r}"))
+                health = engine.health()
+                if health["state"] != "SERVING":
+                    FAILS.append((seed, tag, f"health ended {health['state']}: "
+                                  f"breakers={health['breakers']} shedding={health['shedding']} "
+                                  f"wal_disabled={health['wal_disabled']}"))
+                # bit-identical accumulation vs the unfaulted twin. The
+                # `_update_count` leaf is excluded from THIS comparison only:
+                # fused dispatch counts one update per ROW while the
+                # inline/replay paths the faults exercised count one per
+                # REQUEST — both are correct engine semantics, and which path
+                # a request took is exactly what the faults perturb. The
+                # row-sum accumulator leaves (what compute() reads) must match
+                # bit-for-bit, and the recovery leg below compares FULL state
+                # (incl. _update_count) against the lost engine's own.
+                twin = BinaryAccuracy()
+                oracles: dict = {}
+                for key, p, t in accepted:
+                    state = oracles.get(key)
+                    if state is None:
+                        state = twin.init_state()
+                    oracles[key] = twin.update_state(state, jnp.asarray(p), jnp.asarray(t))
+
+                def _core(state):
+                    return {k: v for k, v in state.items() if k != "_update_count"}
+
+                for key, o_state in oracles.items():
+                    o_state = jax.device_get(o_state)
+                    e_state = jax.device_get(engine._keyed.state_of(key))
+                    try:
+                        jax.tree_util.tree_map(
+                            lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+                            _core(e_state), _core(o_state),
+                        )
+                    except Exception as exc:  # noqa: BLE001
+                        FAILS.append((seed, tag, f"key {key}: state != unfaulted twin: {repr(exc)[:120]}"))
+                    got, exp = float(engine.compute(key)), float(twin.compute_from(oracles[key]))
+                    if got != exp:
+                        FAILS.append((seed, tag, f"key {key}: compute {got} != twin {exp}"))
+                final_states = {
+                    key: jax.device_get(engine._keyed.state_of(key))
+                    for key in engine._keyed.keys
+                }
+                engine.close(checkpoint=False)  # crash-sim close: WAL carries the tail
+            except Exception as exc:  # noqa: BLE001 — record crash seeds, keep soaking
+                FAILS.append((seed, tag, "surface raised: " + repr(exc)[:160]))
+                engine.close(checkpoint=False)
+                continue
+
+            # phase G: torn newest snapshot -> recovery must skip it and still
+            # reconstruct the lost engine's state EXACTLY (older snapshot + WAL
+            # replay; full bit-identity, _update_count included — the journal
+            # records which path each request took)
+            from metrics_tpu.ckpt.store import SnapshotStore
+
+            store = SnapshotStore(ckpt_dir, durable=False)
+            gens = store.generations()
+            if len(gens) >= 2:
+                # tear only when a fallback generation exists: the WAL is
+                # rotated to the OLDEST retained generation's coverage, so
+                # corrupting a sole generation after rotation is unrecoverable
+                # by design (that is what retain>1 is for)
+                tear(store.path(gens[-1]), frac=0.5)
+            recovered = StreamingEngine(BinaryAccuracy(), buckets=(8, 32), capacity=8,
+                                        checkpoint=CheckpointConfig(directory=ckpt_dir, durable=False),
+                                        start=False)
+            try:
+                for key, f_state in final_states.items():
+                    r_state = jax.device_get(recovered._keyed.state_of(key))
+                    try:
+                        jax.tree_util.tree_map(
+                            lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+                            r_state, f_state,
+                        )
+                    except Exception as exc:  # noqa: BLE001
+                        FAILS.append((seed, tag, f"key {key}: torn-snapshot recovery != lost engine: {repr(exc)[:120]}"))
+            finally:
+                recovered.close(checkpoint=False)
+
+
 SURFACES = {
     "classification": soak_classification,
     "regression_retrieval": soak_regression_retrieval,
@@ -1000,11 +1199,12 @@ SURFACES = {
     "checkpoint_resume": soak_checkpoint_resume,
     "engine": soak_engine,
     "ckpt": soak_ckpt,
+    "guard": soak_guard,
 }
 
 # surfaces that execute the reference as their oracle (everything except the
-# self-oracled engine and ckpt crash-recovery surfaces)
-_NEEDS_REF = {name for name in SURFACES if name not in ("engine", "ckpt")}
+# self-oracled engine, ckpt crash-recovery and guard chaos surfaces)
+_NEEDS_REF = {name for name in SURFACES if name not in ("engine", "ckpt", "guard")}
 
 
 def main() -> None:
