@@ -269,6 +269,33 @@ def _decode_leaf(entry: Dict[str, Any], payload: bytes) -> Any:
     return get_codec(entry["codec"]).decode(enc)
 
 
+def verify(data: bytes) -> Dict[str, Any]:
+    """Integrity-check one snapshot blob — manifest CRC + every payload chunk
+    length/CRC — WITHOUT decoding any leaf (no numpy reconstruction, no codec
+    decode, no unpickling). Returns the validated manifest.
+
+    The repl shipper's pre-flight: it ships the raw bytes, so it needs the
+    corruption-skip guarantee and ``meta["seq"]``, not the decoded tree —
+    :func:`loads` would rebuild the whole state every checkpoint interval
+    just to throw it away. Raises :class:`CorruptSnapshotError` exactly when
+    :func:`loads` would for integrity failures (a CRC-clean but undecodable
+    leaf — a writer bug, not corruption — is only caught by a full decode).
+    """
+    manifest, payload = _split(data)
+    if len(payload) < int(manifest.get("payload_nbytes", 0)):
+        raise CorruptSnapshotError(
+            f"truncated payload region: {len(payload)} < {manifest['payload_nbytes']} bytes"
+        )
+    for entry in manifest["leaves"]:
+        for rec in entry["payloads"]:
+            chunk = payload[rec["off"] : rec["off"] + rec["n"]]
+            if len(chunk) != rec["n"]:
+                raise CorruptSnapshotError("truncated payload (torn write)")
+            if _crc(chunk) != rec["crc"]:
+                raise CorruptSnapshotError("payload CRC mismatch (corrupt leaf)")
+    return manifest
+
+
 def loads(data: bytes) -> Snapshot:
     """Decode + integrity-check one snapshot blob back into a host-numpy tree."""
     manifest, payload = _split(data)

@@ -32,8 +32,10 @@ from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 from metrics_tpu.ckpt import format as ckpt_format
 from metrics_tpu.ckpt.format import CorruptSnapshotError, Snapshot
+from metrics_tpu.obs import instrument as _obs
+from metrics_tpu.utils.prints import rank_zero_warn
 
-__all__ = ["RequestJournal", "SnapshotStore", "atomic_write"]
+__all__ = ["JournalTailCursor", "RequestJournal", "SnapshotStore", "atomic_write"]
 
 _TMP_PREFIX = ".tmp."
 
@@ -197,15 +199,33 @@ class SnapshotStore:
         exists.
         """
         self.last_skipped = []
+        found = None
         for gen in reversed(self.generations()):
             try:
                 snap = ckpt_format.loads(self.read(gen))
                 if validate is not None:
                     validate(snap)
-                return gen, snap
+                found = (gen, snap)
+                break
             except (CorruptSnapshotError, OSError, ValueError, KeyError, TypeError) as exc:
                 self.last_skipped.append((gen, f"{type(exc).__name__}: {exc}"))
-        return None
+        if self.last_skipped:
+            # a silently skipped generation is silent corruption to an operator:
+            # each skip costs one generation of recovery staleness, and a full
+            # sweep of skips means NOTHING was recoverable — say so, loudly
+            # (warn always; counter master-gated like every obs series)
+            for gen, reason in self.last_skipped:
+                _obs.record_ckpt_skipped(reason.split(":", 1)[0])
+            rank_zero_warn(
+                f"SnapshotStore({self.root!r}): skipped {len(self.last_skipped)} corrupt/invalid "
+                f"generation(s) during recovery scan: "
+                + "; ".join(f"gen {g}: {r}" for g, r in self.last_skipped[:3])
+                + ("; ..." if len(self.last_skipped) > 3 else "")
+                + (" — recovered from an older generation" if found is not None
+                   else " — NO valid generation remained"),
+                RuntimeWarning,
+            )
+        return found
 
 
 # ---------------------------------------------------------------------- journal
@@ -368,6 +388,46 @@ class RequestJournal:
         if off != len(data):
             self.torn_records += 1
 
+    def read_from(self, after_seq: int = -1) -> Iterator[Tuple[int, bytes]]:
+        """Cross-segment tail-follow read: ``(seq, record)`` for every intact
+        record with seq > ``after_seq``, in order — safe under a live writer and
+        concurrent :meth:`rotate`.
+
+        Unlike :meth:`replay` (the exclusive-reopen recovery path), this NEVER
+        truncates: a follower/shipper tailing the primary's journal must not
+        destroy the primary's in-flight tail. An incomplete final frame (the
+        writer mid-append) simply ends the iteration — call again with the last
+        yielded seq to continue once the append lands. Segments wholly covered
+        by ``after_seq`` are skipped without reading; a segment deleted by a
+        concurrent ``rotate(covered_seq)`` ends the iteration (its records were
+        snapshot-covered — the caller sees the seq discontinuity on the next
+        call and falls back to a snapshot). Yielded seqs are strictly ascending
+        and contiguous within one call.
+
+        One frame-parse implementation serves both tail-follow APIs: this is a
+        thin one-pass loop over :class:`JournalTailCursor`, with the
+        within-one-call contiguity contract enforced here (the stateful cursor
+        instead surfaces a rotation gap as a seq jump across polls).
+        """
+        cursor = self.tail_cursor(after_seq)
+        last: Optional[int] = None
+        batch = 1024  # stream in bounded slices — read_from must stay lazy
+        while True:
+            records = cursor.read(max_records=batch)
+            for seq, payload in records:
+                if last is not None and seq != last + 1:
+                    return  # discontinuity (tear/rotation mid-walk): stop here
+                yield seq, payload
+                last = seq
+            if len(records) < batch:
+                return  # reached the tail: one pass, like the segment walk
+
+    def tail_cursor(self, after_seq: int = -1) -> "JournalTailCursor":
+        """A stateful incremental reader with :meth:`read_from`'s semantics —
+        for pollers (the repl shipper) that tail the journal every few ms and
+        must not re-read/re-CRC the whole active segment per poll."""
+        return JournalTailCursor(self, after_seq)
+
     def replay(self, after_seq: int = -1) -> Iterator[Tuple[int, bytes]]:
         """Yield ``(seq, record)`` for every intact record with seq > ``after_seq``.
 
@@ -389,3 +449,108 @@ class RequestJournal:
             if self.torn_records != before:
                 return  # torn tail: nothing after it is trustworthy
             expected = seq
+
+
+class JournalTailCursor:
+    """Stateful tail-follow over a live :class:`RequestJournal`.
+
+    Same contract as :meth:`RequestJournal.read_from` (never truncates; an
+    incomplete tail frame ends a poll; a rotation-induced gap surfaces as a
+    seq jump), but the position — (segment, byte offset, next seq) — persists
+    between polls, so each :meth:`read` costs only the NEW tail bytes. Polling
+    ``read_from`` instead re-reads and re-CRCs the entire active segment every
+    time: O(segment) per poll, quadratic over a segment's lifetime — exactly
+    what a 20ms-tick shipper must not do.
+    """
+
+    def __init__(self, journal: RequestJournal, after_seq: int = -1) -> None:
+        self._journal = journal
+        self.seq = int(after_seq)  # last seq handed out
+        self._path: Optional[str] = None
+        self._first = 0  # first seq of the current segment
+        self._next = 0  # seq of the next frame at _offset
+        self._offset = 0  # byte offset of the next frame in the current segment
+
+    def _locate(self) -> bool:
+        """Point at the first segment not wholly covered by ``self.seq``."""
+        segs = self._journal._segments()
+        for i, (first, path) in enumerate(segs):
+            nxt = segs[i + 1][0] if i + 1 < len(segs) else None
+            if nxt is not None and nxt - 1 <= self.seq:
+                continue
+            self._path, self._first, self._next, self._offset = path, first, first, 0
+            return True
+        return False
+
+    def read(self, max_records: Optional[int] = None) -> List[Tuple[int, bytes]]:
+        """Every intact record appended since the last poll (bounded by
+        ``max_records``), as ``(seq, payload)`` in order."""
+        with self._journal._lock:
+            if self._journal._file is not None:
+                self._journal._file.flush()
+        out: List[Tuple[int, bytes]] = []
+        relocated = False
+        while True:
+            if self._path is None and not self._locate():
+                return out
+            try:
+                with open(self._path, "rb") as f:
+                    f.seek(self._offset)
+                    data = f.read()
+            except OSError:
+                # segment rotated away under us: its records were snapshot-
+                # covered — re-locate (once per poll, bounding the loop under
+                # a racing rotator); the caller sees the resulting seq jump.
+                # Records already buffered are flushed FIRST: one read() never
+                # spans a discontinuity, so a caller checking contiguity at
+                # records[0] (the shipper) cannot ship across a hidden gap.
+                self._path = None
+                if out or relocated:
+                    return out
+                relocated = True
+                continue
+            off = 0
+            while off + _FRAME.size <= len(data):
+                n, crc = _FRAME.unpack_from(data, off)
+                payload = data[off + _FRAME.size : off + _FRAME.size + n]
+                if len(payload) != n or (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+                    break  # incomplete (live append) or torn: stop at it
+                if self._next > self.seq:
+                    out.append((self._next, payload))
+                    self.seq = self._next
+                self._next += 1
+                off += _FRAME.size + n
+                if max_records is not None and len(out) >= max_records:
+                    self._offset += off
+                    return out
+            self._offset += off
+            nxt = None
+            for first, path in self._journal._segments():
+                if first > self._first and (nxt is None or first < nxt[0]):
+                    nxt = (first, path)
+            if len(data) - off > 0:
+                if nxt is None:
+                    # leftover bytes in the NEWEST segment: a live writer's
+                    # in-flight frame — stop exactly at the unparsed bytes and
+                    # wait for the append to land
+                    return out
+                # mid-history tear: a newer segment exists, so this one is
+                # immutable (rotation closed its file before the next segment
+                # was created) and the bytes can never complete — waiting here
+                # would wedge the cursor forever, silently stalling a shipper
+                # rewound below the tear with no gap signal. Hop to the next
+                # segment; the seq jump surfaces at the caller's records[0]
+                # contiguity check (buffered records flush FIRST so one read
+                # never spans the discontinuity).
+                if out:
+                    return out
+                self._path, self._first, self._next, self._offset = nxt[1], nxt[0], nxt[0], 0
+                continue
+            if nxt is None:
+                return out  # newest segment: wait for appends
+            if out and nxt[0] != self._next:
+                # rotation GC'd the segments in between: flush what we have so
+                # the seq jump lands at the START of the next read, where the
+                # caller's records[0] continuity check can see it
+                return out
+            self._path, self._first, self._next, self._offset = nxt[1], nxt[0], nxt[0], 0

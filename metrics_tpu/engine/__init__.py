@@ -19,7 +19,11 @@ registry-backed: the series appear in ``metrics_tpu.obs.render_prometheus()``
 under a per-engine label). Overload/abuse protection is the guard plane
 (``guard=GuardConfig(...)``, :mod:`metrics_tpu.guard`): quotas, fair drain,
 deadlines + shedding, circuit breakers, quarantine, watchdog, and
-``engine.health()`` — see docs/source/robustness.md.
+``engine.health()`` — see docs/source/robustness.md. Read scale-out and hot
+failover are the replication plane (``replication=ReplConfig(...)``,
+:mod:`metrics_tpu.repl`): WAL shipping off the write path, bit-identical
+follower replay, bounded-staleness reads, epoch-fenced promotion — see
+docs/source/replication.md.
 """
 
 from metrics_tpu.engine.bucketing import DEFAULT_BUCKETS, choose_bucket, inspect_request, pad_micro_batch
@@ -40,6 +44,12 @@ from metrics_tpu.guard import (
     RequestShed,
     TenantQuarantined,
 )
+from metrics_tpu.repl import (
+    NotPrimaryError,
+    ReplConfig,
+    ReplicaLag,
+    StalenessExceeded,
+)
 
 __all__ = [
     "DEFAULT_BUCKETS",
@@ -53,8 +63,12 @@ __all__ = [
     "GuardConfig",
     "GuardRejected",
     "KeyedState",
+    "NotPrimaryError",
     "QuotaExceeded",
+    "ReplConfig",
+    "ReplicaLag",
     "RequestShed",
+    "StalenessExceeded",
     "StreamingEngine",
     "TenantQuarantined",
     "choose_bucket",

@@ -62,6 +62,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from metrics_tpu.ckpt import format as ckpt_format
 from metrics_tpu.ckpt.store import RequestJournal, SnapshotStore
 from metrics_tpu.ckpt.writer import AsyncCheckpointer
 from metrics_tpu.collections import MetricCollection
@@ -84,6 +85,10 @@ from metrics_tpu.metric import Metric
 from metrics_tpu.obs import instrument as _obs
 from metrics_tpu.obs.registry import OBS as _OBS
 from metrics_tpu.parallel.sync import sync_state_host
+from metrics_tpu.repl.config import ReplConfig, ReplicaLag
+from metrics_tpu.repl.errors import NotPrimaryError, StalenessExceeded
+from metrics_tpu.repl.replica import ReplicaApplier
+from metrics_tpu.repl.shipper import Shipper
 from metrics_tpu.utils.exceptions import MetricsTPUUserError
 
 _POLICIES = ("block", "drop", "timeout")
@@ -103,6 +108,10 @@ _WAL_FLUSH = ("none", "flush", "fsync")
 #   eager retry after a fused trace failure: pickled key + raw
 #   dtype/shape/bytes per arg, applied whole-request on replay (matching how
 #   those paths applied it originally).
+# - b"Z" RESET / b"W" ROTATE records — single-byte markers for the two state
+#   transitions that are not submits: without them a recovery (or a follower)
+#   would replay post-reset/post-rotation requests onto pre-transition state
+#   and silently diverge from the engine that journaled them.
 
 _WAL_U32 = struct.Struct("<I")
 
@@ -309,6 +318,7 @@ class StreamingEngine:
         telemetry_window: int = 2048,
         checkpoint: Optional[CheckpointConfig] = None,
         guard: Optional[GuardConfig] = None,
+        replication: Optional[ReplConfig] = None,
         start: bool = True,
     ) -> None:
         if not isinstance(metric_or_collection, (Metric, MetricCollection)):
@@ -321,6 +331,34 @@ class StreamingEngine:
             raise MetricsTPUUserError(f"`max_queue` must be >= 1, got {max_queue}")
 
         self._metric = metric_or_collection.clone()
+        # reads get their OWN clone: compute_from swaps state attrs in and out
+        # of its instance, so computing on the dispatch metric would serialize
+        # every read behind dispatch/replay on the dispatch lock. With a read
+        # clone, compute() only needs that lock for the state slice (an
+        # enqueue-only pytree gather), and readers serialize among themselves
+        # on _read_lock — the follower read-throughput gate rides on this.
+        self._read_metric = self._metric.clone()
+        self._read_lock = threading.Lock()
+        # jitted fused read path: slice + compute_from as ONE compiled call
+        # (slot is a traced operand — one kernel per capacity serves every
+        # tenant). Its closure gets a third clone: compute_from swaps attrs at
+        # trace time, and tracing (dispatch lock) must not race an eager
+        # reader (_read_lock). Falls back permanently on the first trace
+        # failure (host-compute/untraceable computes read eagerly).
+        self._read_jit_metric = self._metric.clone()
+        self._read_kernels: Dict[int, Callable] = {}
+        # serializes first-read trace+compile per capacity (compute_from swaps
+        # attrs on _read_jit_metric at trace time — two cold readers must not
+        # trace concurrently). Taken OFF the dispatch lock: a read compile must
+        # never stall dispatch (primary) or WAL replay (follower).
+        self._read_compile_lock = threading.Lock()
+        self._read_jit_ok = True
+        # serializes sync=True collective syncs: two readers syncing different
+        # tenants concurrently would issue cross-process collectives in
+        # whatever order their threads race to — ranks disagreeing on that
+        # order deadlocks (or cross-wires) the job. Dispatch used to provide
+        # this ordering incidentally when compute() synced under its lock.
+        self._sync_state_lock = threading.Lock()
         self._buckets = normalize_buckets(buckets)
         self._max_rows = self._buckets[-1]
         self._max_queue = int(max_queue)
@@ -396,11 +434,32 @@ class StreamingEngine:
                     self._hang_detector.hung, self._on_worker_hang, poll_s=guard.watchdog_poll_s
                 )
 
+        # replication plane (metrics_tpu.repl): primary ships its snapshot+WAL
+        # lineage off-thread; a follower is a read replica that replays it
+        self._repl_cfg: Optional[ReplConfig] = None
+        self._shipper: Optional[Shipper] = None
+        self._applier: Optional[ReplicaApplier] = None
+        self._repl_follower = False
+        self._repl_epoch = 0
+        self._promote_lock = threading.Lock()
+        # health-transition tracking (guard on_health_transition hook)
+        self._last_health_state = "SERVING"
+
+        if replication is not None and replication.role == "follower" and checkpoint is not None:
+            raise MetricsTPUUserError(
+                "a follower replica does not own a durable lineage while following — its state "
+                "is the primary's, re-bootstrappable from the ship link. Configure the lineage "
+                "it should open AT PROMOTION via ReplConfig(promote_checkpoint=CheckpointConfig(...))"
+            )
         if checkpoint is not None:
             self._init_checkpoint(checkpoint)
+        if replication is not None:
+            self._init_replication(replication)
 
         self._worker: Optional[threading.Thread] = None
-        if start:
+        if start and not self._repl_follower:
+            # a follower has no dispatcher: the applier thread owns its state
+            # until promote() flips it writable (which starts one)
             self.start()
 
     # ------------------------------------------------------------------ lifecycle
@@ -446,6 +505,10 @@ class StreamingEngine:
             worker = self._worker
         if self._watchdog is not None:
             self._watchdog.stop()
+        if self._shipper is not None:
+            self._shipper.close()
+        if self._applier is not None:
+            self._applier.stop()
         if worker is not None and worker is not threading.current_thread():
             worker.join(timeout=10.0)
             if worker.is_alive():
@@ -497,6 +560,11 @@ class StreamingEngine:
         (wedged device) rejects everything with
         :class:`~metrics_tpu.guard.errors.EngineQuarantined`.
         """
+        if self._repl_follower:
+            raise NotPrimaryError(
+                "submit() on a follower replica: writes go to the primary; this engine serves "
+                "bounded-staleness reads until promote() flips it writable"
+            )
         t_submit = time.perf_counter()
         rows, signature = inspect_request(args)
         guard = self._guard
@@ -614,14 +682,99 @@ class StreamingEngine:
             # mislabeled as a sliding-window value
             raise MetricsTPUUserError("compute(window=True) requires the engine to be built with `window=`")
         self._check_quarantined("compute")
+        self._check_staleness()
         self.flush()
+        # dispatch lock covers only the read's enqueue: the warm jitted fused
+        # read (slice + compute in one compiled call) or the state slice. Slice
+        # ops are enqueued against still-valid buffers (a later kernel donation
+        # cannot reach them); sync + eager compute run off-lock on the read
+        # clone, and a COLD read's trace+compile runs off-lock on a private
+        # buffer copy — reads never wait out a dispatch or a replay, and
+        # dispatch never waits out a read compile. This is what lets a read
+        # replica serve dashboard traffic at multiples of the primary's read
+        # rate (benchmarks/engine_throughput.py --replica).
+        cold_read = None
         with self._dispatch_lock:
-            if key not in self._keyed.keys:
+            keyed = self._keyed
+            if key not in keyed.keys:
                 raise KeyError(f"unknown tenant key {key!r}")
-            state = self._keyed.merged_state(key) if window else self._keyed.state_of(key)
-            if sync:
-                state = self._sync_state(state)
-            return self._metric.compute_from(state)
+            if (
+                not window
+                and not sync
+                and self._read_jit_ok
+                and isinstance(keyed, KeyedState)
+                and keyed._slots[key] < keyed.capacity
+            ):
+                slot = jnp.asarray(keyed._slots[key], jnp.int32)
+                kernel = self._read_kernels.get(keyed.capacity)
+                if kernel is not None:
+                    try:
+                        return kernel(keyed.stacked, slot)
+                    except Exception as exc:  # noqa: BLE001 — untraceable compute: eager forever
+                        self._disable_read_jit(exc)
+                else:
+                    # first read at this capacity: jax.jit traces + compiles at
+                    # call time, which can take 100ms-1s — far too long to hold
+                    # the dispatch lock (it would stall every queued write on a
+                    # primary and all WAL replay on a follower). Snapshot the
+                    # tenant's buffers into private copies (enqueued here, under
+                    # the lock, so a later donating dispatch can't invalidate
+                    # them — and jnp.copy preserves avals, so the compiled
+                    # kernel serves subsequent warm reads of the live buffers)
+                    # and pay the compile OFF the lock.
+                    cold_read = (
+                        jax.tree.map(jnp.copy, keyed.stacked), slot, keyed.capacity
+                    )
+            if cold_read is None:
+                state = keyed.merged_state(key) if window else keyed.state_of(key)
+        if cold_read is not None:
+            stacked_copy, slot, capacity = cold_read
+            try:
+                with self._read_compile_lock:
+                    kernel = self._read_kernels.get(capacity)
+                    if kernel is None:
+                        kernel = self._build_read_kernel()
+                        out = kernel(stacked_copy, slot)  # trace+compile happens HERE
+                        # publish only after the tracing call completes: warm
+                        # readers call published kernels without this lock, so
+                        # an uncompiled kernel in the dict would let a warm
+                        # reader trace concurrently on the shared
+                        # _read_jit_metric clone (whose compute_from swaps
+                        # attrs at trace time — the race this lock exists for)
+                        self._read_kernels[capacity] = kernel
+                        return out
+                    return kernel(stacked_copy, slot)
+            except Exception as exc:  # noqa: BLE001 — untraceable compute: eager forever
+                self._disable_read_jit(exc)
+                with self._dispatch_lock:
+                    state = self._keyed.state_of(key)
+        if sync:
+            state = self._sync_state(state)
+        with self._read_lock:
+            return self._read_metric.compute_from(state)
+
+    def _disable_read_jit(self, exc: BaseException) -> None:
+        # loudly, not silently: losing the compiled read path costs the replica
+        # read-throughput property, and the trigger may be a real bug rather
+        # than an untraceable compute
+        self._read_jit_ok = False
+        self.telemetry.count("read_jit_fallbacks")
+        warnings.warn(
+            f"StreamingEngine: jitted read path disabled after {exc!r}; "
+            "compute() serves eagerly from now on",
+            RuntimeWarning,
+            stacklevel=3,
+        )
+
+    def _build_read_kernel(self) -> Callable:
+        """A fresh unpublished jitted read — the caller compiles it (first call)
+        under ``_read_compile_lock`` and publishes to ``_read_kernels`` after."""
+        metric = self._read_jit_metric
+
+        def read(stacked: Any, slot: jax.Array) -> Any:
+            return metric.compute_from(jax.tree.map(lambda x: x[slot], stacked))
+
+        return jax.jit(read)
 
     def compute_all(self, *, window: bool = False, sync: bool = False) -> Dict[Hashable, Any]:
         """``compute`` for every known tenant key — one flush, one consistent snapshot.
@@ -634,15 +787,20 @@ class StreamingEngine:
         if window and self._window is None:
             raise MetricsTPUUserError("compute_all(window=True) requires the engine to be built with `window=`")
         self._check_quarantined("compute_all")
+        self._check_staleness()
         self.flush()
         with self._dispatch_lock:
-            out: Dict[Hashable, Any] = {}
-            for key in self._keyed.keys:
-                state = self._keyed.merged_state(key) if window else self._keyed.state_of(key)
-                if sync:
-                    state = self._sync_state(state)
-                out[key] = self._metric.compute_from(state)
-            return out
+            states: Dict[Hashable, Any] = {
+                key: self._keyed.merged_state(key) if window else self._keyed.state_of(key)
+                for key in self._keyed.keys
+            }
+        out: Dict[Hashable, Any] = {}
+        for key, state in states.items():
+            if sync:
+                state = self._sync_state(state)
+            with self._read_lock:
+                out[key] = self._read_metric.compute_from(state)
+        return out
 
     def _check_quarantined(self, op: str) -> None:
         """Fail fast instead of deadlocking on a dispatch lock a wedged worker holds."""
@@ -654,17 +812,32 @@ class StreamingEngine:
     def rotate_window(self) -> None:
         """Close the current sliding-window segment for ALL tenants (flushes first)."""
         self._check_quarantined("rotate_window")
+        self._check_writable("rotate_window")
         self.flush()
         with self._dispatch_lock:
+            # journaled INSIDE the lock, before the transition: a recovery or a
+            # follower replays it at exactly this point in the request order
+            if self._journal is not None:
+                self._journal_append([b"W"])
             self._keyed.rotate()
         self.telemetry.count("window_rotations")
 
     def reset(self) -> None:
         """Drop all tenant state (keys stay allocated)."""
         self._check_quarantined("reset")
+        self._check_writable("reset")
         self.flush()
         with self._dispatch_lock:
+            if self._journal is not None:
+                self._journal_append([b"Z"])
             self._keyed.reset()
+
+    def _check_writable(self, op: str) -> None:
+        if self._repl_follower:
+            raise NotPrimaryError(
+                f"{op}() on a follower replica: its state mirrors the primary's and is "
+                "mutated only by replay (promote() flips this engine writable)"
+            )
 
     @property
     def fused(self) -> bool:
@@ -712,6 +885,26 @@ class StreamingEngine:
         breakers = guard.breaker_snapshots() if guard is not None else {}
         shedding = guard.shedding if guard is not None else False
         wal_disabled = self._wal_error is not None
+        # a fenced shipper is a deposed primary still serving local writes:
+        # split-brain territory — loudly DEGRADED, never silently nominal
+        repl_fenced = self._shipper is not None and self._shipper.fenced
+        # a failing ship/apply loop is a replica silently going stale (or a
+        # primary silently not replicating): both loops deliberately record
+        # the error and clear it on the next clean pass — surface it, or a
+        # dead link is invisible until staleness bites the readers
+        # the applier's error only counts while we ARE a follower: promotion
+        # parks the applier with whatever its last poll recorded (a frame torn
+        # by the dying primary, typically) frozen forever — folding that into
+        # the promoted primary's health would alert on the healthy new writer
+        # for the dead lineage's sins (the string stays visible in the
+        # replication section for post-mortems)
+        repl_link_error = (
+            self._shipper is not None and self._shipper.last_error is not None
+        ) or (
+            self._repl_follower
+            and self._applier is not None
+            and self._applier.last_error is not None
+        )
         if quarantined:
             state = "QUARANTINED"
         elif (
@@ -719,6 +912,8 @@ class StreamingEngine:
             or zombies
             or shedding
             or wal_disabled
+            or repl_fenced
+            or repl_link_error
             or any(snap["state"] != "closed" for snap in breakers.values())
         ):
             state = "DEGRADED"
@@ -736,8 +931,34 @@ class StreamingEngine:
             "breakers": breakers,
             "quarantined_tenants": dict(guard.quarantine.active()) if guard is not None else {},
         }
+        if self._repl_cfg is not None:
+            out["replication"] = self._replication_health()
         if guard is not None:
             guard.publish_health(state)
+        # health-transition observer (GuardConfig.on_health_transition): detect
+        # under the lock (exactly once per transition, however many concurrent
+        # health() readers observe it), fire OUTSIDE every lock, absorb errors
+        hook_args: Optional[Tuple[str, str]] = None
+        with self._lock:
+            if state != self._last_health_state:
+                hook_args = (self._last_health_state, state)
+                self._last_health_state = state
+        if hook_args is not None and guard is not None and guard.cfg.on_health_transition is not None:
+            try:
+                guard.cfg.on_health_transition(*hook_args)
+            except Exception as exc:  # noqa: BLE001 — an observer crash must not poison health reads
+                # ...but it must not vanish either: transitions fire ONCE per
+                # edge, so a swallowed failover-hook raise (promote() refusing
+                # an unbootstrapped follower, say) means automatic failover is
+                # permanently lost for this quarantine — the operator needs a
+                # signal to intervene
+                warnings.warn(
+                    f"on_health_transition({hook_args[0]!r} -> {hook_args[1]!r}) raised "
+                    f"{type(exc).__name__}: {exc} — the transition will not re-fire; if this "
+                    "was the replication failover hook, promote the follower manually",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
         return out
 
     def _publish_health(self) -> None:
@@ -762,6 +983,13 @@ class StreamingEngine:
         return self._keyed.slot_for(key)
 
     def _sync_state(self, state: Any) -> Any:
+        # one collective sync at a time per process (_sync_state_lock): every
+        # rank must issue collectives in the same order, and the breaker's
+        # last_report() judging below must not see another call's report
+        with self._sync_state_lock:
+            return self._sync_state_inner(state)
+
+    def _sync_state_inner(self, state: Any) -> Any:
         # multi-host serving rides the comm plane (codecs, coalesced transfers,
         # retry/degradation ladder) with its own site label so engine syncs are
         # attributable separately from bare sync_state_host callers
@@ -893,6 +1121,10 @@ class StreamingEngine:
             except Exception:  # noqa: BLE001 — already in the failure path
                 pass
             self.telemetry.count("checkpoint_failures")
+            if self._shipper is not None:
+                # shipping from a dead journal would heartbeat a frozen seq —
+                # the follower would report fresh while diverging unbounded
+                self._shipper.mark_journal_lost()
             return None
         self._wal_seq = max(self._wal_seq, seqs[-1])
         self.telemetry.count("wal_records", len(payloads))
@@ -980,6 +1212,10 @@ class StreamingEngine:
                     for seg in (keyed._ring or [])
                 ]
         meta = {"tenants": len(keyed.keys), "seq": tree["seq"]}
+        if self._repl_cfg is not None:
+            # the lineage's fencing token: a recovered promoted node knows which
+            # epoch it owns without re-walking the promotion
+            meta["epoch"] = self._repl_epoch
         return tree, meta
 
     def _on_snapshot_error(self, exc: BaseException) -> None:
@@ -1072,6 +1308,10 @@ class StreamingEngine:
             keyed.capacity = int(tree["capacity"])
             keyed.stacked = jax.tree.map(jnp.asarray, tree["stacked"])
             keyed._slots = dict(tree["slots"])
+            # the allocation watermark must survive restore: a recovered
+            # primary / promoted follower taking a NEW tenant after this would
+            # otherwise be handed slot 0 — an existing tenant's accumulator row
+            keyed._max_slot = max(keyed._slots.values(), default=-1)
             if keyed._ring is not None:
                 for entry in tree.get("ring", []):
                     keyed._ring.append(
@@ -1092,8 +1332,31 @@ class StreamingEngine:
                     keyed._ring.append(dict(zip(entry["keys"]["values"], entry["states"])))
             self._keyed = keyed
 
+    @staticmethod
+    def _chunk_signature(columns: Sequence[np.ndarray]) -> Signature:
+        """Rebuild the request signature a chunk record's columns were padded
+        under: column shape is (bucket, 1, *trailing), so the signature's
+        trailing shape is ``col.shape[2:]`` (dtypes were canonicalized before
+        padding, but re-canonicalize for robustness across x64 settings)."""
+        return tuple(
+            (tuple(int(s) for s in col.shape[2:]),
+             np.dtype(jax.dtypes.canonicalize_dtype(col.dtype)).name)
+            for col in columns
+        )
+
     def _replay_chunk(self, payload: bytes) -> None:
-        """Re-apply one fused micro-batch record: masked rows in scan order."""
+        """Re-apply one fused micro-batch record.
+
+        A fused engine replays it through its OWN bucket kernel — the record
+        holds the padded columns + key ids + mask exactly as the primary's
+        kernel saw them, so one compiled dispatch reproduces the committed
+        result bit-for-bit at full speed (what lets a follower keep pace with
+        a fused primary). Slot intros install the PRIMARY'S ids (key_ids index
+        by them; intros may arrive gapped because chunk commit order is not
+        slot assignment order). A demoted/eager engine — or a chunk whose
+        update cannot trace here — falls back to the per-row host walk, which
+        is the same scan semantics, only slower.
+        """
         off = 1
         (n_new,) = struct.unpack_from("<H", payload, off)
         off += 2
@@ -1102,8 +1365,11 @@ class StreamingEngine:
             off += 4
             (klen,) = _WAL_U32.unpack_from(payload, off)
             off += 4
-            self._replay_slot_keys[slot] = pickle.loads(payload[off : off + klen])
+            key = pickle.loads(payload[off : off + klen])
             off += klen
+            self._replay_slot_keys[slot] = key
+            if isinstance(self._keyed, KeyedState):
+                self._keyed.install_slot(key, slot)
         ncols = payload[off]
         off += 1
         key_ids, off = _dec_array(payload, off)
@@ -1112,19 +1378,43 @@ class StreamingEngine:
         for _ in range(ncols):
             col, off = _dec_array(payload, off)
             columns.append(col)
-        eager = isinstance(self._keyed, EagerKeyedState)
-        for i in range(len(key_ids)):
-            if not mask[i]:
-                continue
-            key = self._replay_slot_keys[int(key_ids[i])]
-            self._keyed.slot_for(key)
-            rows = tuple(col[i] for col in columns)  # (1, *trailing) — the scan slice
-            if eager:
-                self._keyed.update(key, *rows)
-            else:
-                self._keyed.ensure_capacity()
-                state = self._keyed.state_of(key)
-                self._keyed.set_state(key, self._metric.update_state(state, *rows))
+        keyed = self._keyed
+        if isinstance(keyed, KeyedState):
+            max_id = int(key_ids.max()) + 1 if len(key_ids) else 0
+            if keyed.ensure_capacity(min_slots=max_id):
+                self.telemetry.count("key_growths")
+            try:
+                kernel = self._get_kernel(
+                    self._chunk_signature(columns), int(len(key_ids)), keyed.capacity
+                )
+                # no block_until_ready here (unlike live dispatch): replay has
+                # no future to ack, and letting the applier pipeline chunk
+                # kernels is what keeps a follower abreast of a fused primary;
+                # readers force the value when they consume it
+                keyed.stacked = kernel(
+                    keyed.stacked,
+                    jnp.asarray(key_ids),
+                    jnp.asarray(mask),
+                    *[jnp.asarray(c) for c in columns],
+                )
+                return
+            except _FusedUnsupported:
+                pass  # untraceable on this engine: per-row host walk below
+            for i in range(len(key_ids)):
+                if not mask[i]:
+                    continue
+                key = self._replay_slot_keys[int(key_ids[i])]
+                rows = tuple(col[i] for col in columns)  # (1, *trailing) — the scan slice
+                state = keyed.state_of(key)
+                keyed.set_state(key, self._metric.update_state(state, *rows))
+        else:
+            for i in range(len(key_ids)):
+                if not mask[i]:
+                    continue
+                key = self._replay_slot_keys[int(key_ids[i])]
+                keyed.slot_for(key)
+                rows = tuple(col[i] for col in columns)
+                keyed.update(key, *rows)
 
     def _replay_request(self, key: Hashable, args: Tuple[Any, ...]) -> None:
         """Re-apply one 'R' record as ONE whole-request update — exactly how
@@ -1167,16 +1457,290 @@ class StreamingEngine:
             for seq, payload in self._journal.replay(after_seq=self._wal_seq):
                 try:
                     with self._dispatch_lock:
-                        if payload[:1] == b"C":
-                            self._replay_chunk(payload)
-                        else:
-                            self._replay_request(*_decode_request_record(payload))
+                        self._apply_wal_payload(payload)
                 except Exception:  # noqa: BLE001 — it failed when first accepted too
                     self.telemetry.count("failed")
                 replayed += 1
                 self._wal_seq = max(self._wal_seq, seq)
             if replayed:
                 self.telemetry.count("replayed", replayed)
+
+    # ---------------------------------------------------- replication plane
+
+    def _init_replication(self, cfg: ReplConfig) -> None:
+        self._repl_cfg = cfg
+        self._repl_epoch = int(cfg.epoch)
+        if cfg.role == "primary":
+            if self._journal is None:
+                raise MetricsTPUUserError(
+                    "replication role 'primary' requires checkpoint=CheckpointConfig(..., wal=True): "
+                    "the shipper publishes the durable plane's snapshot + WAL lineage"
+                )
+            # recover the lineage's fencing token: a restarted promoted node
+            # must resume at the epoch it owns (recorded in snapshot meta at
+            # promotion), or its own fence would reject its shipments
+            resumed = bool(self._ckpt_store.generations())
+            for gen in reversed(self._ckpt_store.generations()):
+                try:
+                    self._repl_epoch = max(
+                        self._repl_epoch, int(self._ckpt_store.read_meta(gen).get("epoch", 0))
+                    )
+                    break
+                except Exception:  # noqa: BLE001 — corrupt meta: fall back a generation
+                    continue
+            if resumed or self._wal_seq > -1:
+                # every resume starts a NEW lineage epoch: a restarted primary
+                # may RE-USE seqs its dead incarnation already shipped (a
+                # non-fsynced WAL tail lost to power loss recovers behind
+                # records the shipper read from the page cache and published),
+                # and within one epoch the follower's seq chain would drop the
+                # re-used seqs as duplicates — applying everything after them
+                # onto divergent state, silently, while lag() reads caught-up.
+                # The bump makes followers re-bootstrap from the restart
+                # snapshot instead of trusting cross-incarnation arithmetic;
+                # the pin snapshot persists it so a crash before the first
+                # periodic snapshot cannot hand two incarnations one epoch.
+                self._repl_epoch += 1
+                if self._ckpt_writer is not None:
+                    self._ckpt_writer.checkpoint_sync(self._checkpoint_view)
+            self._shipper = Shipper(
+                cfg,
+                store=self._ckpt_store,
+                journal=self._journal,
+                telemetry=self.telemetry,
+                engine_label=self.telemetry.engine_id,
+                epoch=self._repl_epoch,
+            )
+        else:
+            self._repl_follower = True
+            self._applier = ReplicaApplier(
+                self, cfg, telemetry=self.telemetry, engine_label=self.telemetry.engine_id
+            )
+
+    def _repl_reset_state(self) -> None:
+        """Applier callback: drop ALL replica state (a wiped/replaced primary
+        lineage restarted seq numbering — the old mirror is meaningless)."""
+        with self._dispatch_lock:
+            if isinstance(self._keyed, KeyedState):
+                self._keyed = KeyedState(
+                    self._metric, capacity=self._keyed.capacity, window=self._window
+                )
+            else:
+                self._keyed = EagerKeyedState(self._metric, window=self._window)
+            self._replay_slot_keys = {}
+
+    def _repl_restore_snapshot(self, data: bytes) -> int:
+        """Applier callback: bootstrap/rebootstrap from one shipped snapshot via
+        the exact restore path recovery uses; returns the WAL seq it covers."""
+        snap = ckpt_format.loads(data)
+        self._validate_engine_snapshot(snap)
+        with self._dispatch_lock:
+            self._restore_keyed(snap.tree)
+            if snap.tree["mode"] == "fused":
+                # chunk records reference slot ids; mappings introduced before
+                # the snapshot live in rotated-away segments (same seeding as
+                # the local recovery path)
+                self._replay_slot_keys = {slot: key for key, slot in snap.tree["slots"].items()}
+        return int(snap.tree.get("seq", -1))
+
+    def _apply_wal_payload(self, payload: bytes) -> None:
+        """Dispatch one WAL record to its replayer (caller holds the dispatch lock)."""
+        kind = payload[:1]
+        if kind == b"C":
+            self._replay_chunk(payload)
+        elif kind == b"Z":
+            self._keyed.reset()
+        elif kind == b"W":
+            self._keyed.rotate()
+        else:
+            self._replay_request(*_decode_request_record(payload))
+
+    def _repl_apply_record(self, payload: bytes) -> None:
+        """Applier callback: replay ONE shipped WAL record — identical machinery
+        to restart recovery, so the follower is bit-identical to the primary at
+        every applied seq. A record that failed on the primary fails here too
+        (counted, absorbed) — and still advances the seq chain, as it did there."""
+        try:
+            with self._dispatch_lock:
+                self._apply_wal_payload(payload)
+        except Exception:  # noqa: BLE001 — it failed when the primary first accepted it too
+            self.telemetry.count("failed")
+
+    def _repl_quiesce(self) -> None:
+        """Applier callback: force the pending replay chain (called OUTSIDE the
+        dispatch lock, once per received frame batch — bounds how much pending
+        work a concurrent reader's value-force can inherit)."""
+        keyed = self._keyed
+        if isinstance(keyed, KeyedState):
+            with self._dispatch_lock:
+                stacked = keyed.stacked
+            jax.block_until_ready(stacked)
+
+    def replica_lag(self) -> Optional[ReplicaLag]:
+        """This follower's staleness bound (``None`` unless role='follower').
+
+        Every read path tags itself with this: ``compute``/``compute_all``
+        refuse beyond the configured ``max_staleness``, ``health()`` embeds it
+        under ``"replication"``, and the master-gated lag gauges mirror it.
+        """
+        applier = self._applier
+        if applier is None or not self._repl_follower:
+            return None
+        lag = applier.lag()
+        _obs.set_repl_lag(self.telemetry.engine_id, lag.seqs_behind, lag.seconds_behind)
+        return lag
+
+    def _check_staleness(self) -> None:
+        """Refuse a follower read beyond the configured staleness bound."""
+        applier = self._applier
+        if applier is None or not self._repl_follower:
+            return
+        cfg = self._repl_cfg
+        bounded = cfg.max_staleness_seqs is not None or cfg.max_staleness_s is not None
+        if not bounded:
+            return
+        if not applier.bootstrapped:
+            self.telemetry.count("stale_read_refusals")
+            raise StalenessExceeded(
+                "read refused: replica has not bootstrapped from the primary yet "
+                "(its staleness is unbounded)"
+            )
+        lag = applier.lag()
+        if lag.exceeds(cfg.max_staleness_seqs, cfg.max_staleness_s):
+            self.telemetry.count("stale_read_refusals")
+            raise StalenessExceeded(
+                f"read refused: replica lag ({lag.seqs_behind} seqs, {lag.seconds_behind:.3f}s) "
+                f"exceeds max_staleness (seqs={cfg.max_staleness_seqs}, s={cfg.max_staleness_s})"
+            )
+
+    def promote(self) -> None:
+        """Follower → primary hot failover.
+
+        Drains the shipped tail (everything the deposed primary published is
+        applied — the promoted node serves exactly the acked prefix, no loss,
+        no double-apply: the seq chain drops duplicates and parks on gaps),
+        fences the transport at ``deposed epoch + 1`` (a zombie primary's late
+        shipments are rejected at the transport boundary from that instant),
+        re-opens this node's OWN durable lineage (``promote_checkpoint``) with
+        a synchronous pin snapshot, and starts a dispatcher — the engine is
+        writable when this returns. Idempotent; triggered explicitly or by the
+        guard hook (``GuardConfig(on_health_transition=repl.failover_hook(...))``).
+        """
+        cfg = self._repl_cfg
+        if cfg is None or cfg.role != "follower":
+            raise MetricsTPUUserError("promote() requires replication=ReplConfig(role='follower')")
+        with self._promote_lock:
+            if not self._repl_follower:
+                return  # already promoted (explicit call raced the failover hook)
+            applier = self._applier
+            if not applier.bootstrapped:
+                # a replica that never received its bootstrap snapshot holds
+                # FRESH INIT state: flipping it writable would pin empty state
+                # as the authoritative new lineage — every tenant's history
+                # silently replaced by zeros served as legitimate. Refuse;
+                # the guard failover hook absorbs the raise (the quarantined
+                # primary stays down, the follower keeps refusing bounded
+                # reads — conservative, loud, and retryable once a snapshot
+                # lands). An EMPTY-bootstrap replica is promotable: its
+                # primary genuinely had no state.
+                raise MetricsTPUUserError(
+                    "promote(): this follower never bootstrapped — promoting would pin "
+                    "fresh-init state as the new durable lineage, losing all tenant "
+                    "history; retry once a snapshot has been applied"
+                )
+            # 1. stop the poll thread, then drain what was already shipped;
+            # park() makes the cutoff hard — stop()'s join can time out on a
+            # poll thread wedged in a cold kernel compile, and once writable,
+            # a late replay of old-primary records would mutate promoted
+            # state without ever being journaled in the new lineage
+            applier.stop()
+            applier.drain(cfg.drain_timeout_s)
+            applier.park()
+            # 2. fence: from this instant the old epoch is dead at the boundary
+            new_epoch = applier.epoch + 1
+            cfg.transport.fence(new_epoch)
+            with self._lock:
+                self._repl_epoch = new_epoch
+                self._repl_follower = False
+            # 3. own lineage: fresh WAL numbering + a synchronous pin snapshot
+            # (without it, a crash before the first periodic snapshot would
+            # replay the new WAL onto an EMPTY state)
+            self._wal_seq = -1
+            try:
+                self._open_promoted_lineage(cfg)
+            except Exception as exc:  # noqa: BLE001 — promotion must stay exception-safe:
+                # the state flip (fence, _repl_follower) already happened, and
+                # the failover hook absorbs raises — failing HERE without
+                # starting the dispatcher would leave a half-promoted engine
+                # that accepts submits nothing ever drains, with the
+                # idempotency guard blocking every retry. An unopenable
+                # lineage (unwritable/full directory) degrades to serving
+                # WITHOUT durability instead — loud, available, recoverable.
+                self._ckpt_writer = None
+                self._journal = None
+                self._wal_seq = -1
+                warnings.warn(
+                    f"promote(): opening the promote_checkpoint lineage failed "
+                    f"({type(exc).__name__}: {exc}) — the promoted primary is serving "
+                    "WITHOUT durability",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+            # 4. writable
+            self.start()
+        self.telemetry.count("promotions")
+        _obs.record_repl_promotion(self.telemetry.engine_id)
+        self._publish_health()
+
+    def _open_promoted_lineage(self, cfg: ReplConfig) -> None:
+        """Promotion step 3: the node's OWN durable plane + pin snapshot."""
+        if cfg.promote_checkpoint is None:
+            warnings.warn(
+                "promote(): no ReplConfig.promote_checkpoint lineage configured — the "
+                "promoted primary is serving WITHOUT durability",
+                RuntimeWarning,
+                stacklevel=3,
+            )
+            return
+        from dataclasses import replace as _dc_replace
+
+        self._init_checkpoint(_dc_replace(cfg.promote_checkpoint, resume=False))
+        if self._journal is not None:
+            # the directory may not be fresh: a node promoted ONCE, dead,
+            # re-attached as follower and promoted AGAIN with the same static
+            # config re-opens its old lineage's journal, which continues
+            # numbering past the leftover segments. Anchor at the re-opened
+            # tail — the pin below then covers every stale record, so a later
+            # recovery replays only THIS incarnation's writes (starting from
+            # -1 would replay the dead incarnation's records 0..k on top of
+            # the pinned state, silently corrupting every touched tenant),
+            # and rotation GC's the stale segments.
+            self._wal_seq = int(self._journal.last_seq)
+        self._ckpt_writer.checkpoint_sync(self._checkpoint_view)
+
+    def _replication_health(self) -> Dict[str, Any]:
+        info: Dict[str, Any] = {
+            "role": "follower" if self._repl_follower else "primary",
+            "epoch": self._repl_epoch,
+        }
+        shipper, applier = self._shipper, self._applier
+        if shipper is not None:
+            info["shipped_seq"] = shipper.last_shipped_seq
+            info["shipped_generation"] = shipper.shipped_generation
+            info["fenced"] = shipper.fenced
+            err = shipper.last_error
+            info["ship_error"] = None if err is None else f"{type(err).__name__}: {err}"
+        if applier is not None:
+            info["applied_seq"] = applier.applied_seq
+            info["known_seq"] = applier.known_seq
+            info["bootstrapped"] = applier.bootstrapped
+            err = applier.last_error
+            info["apply_error"] = None if err is None else f"{type(err).__name__}: {err}"
+            if self._repl_follower:
+                lag = applier.lag()
+                info["lag_seqs"] = lag.seqs_behind
+                info["lag_seconds"] = lag.seconds_behind
+        return info
 
     def _run(self, epoch: int = 0) -> None:
         detector = self._hang_detector

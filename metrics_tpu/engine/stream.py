@@ -48,6 +48,7 @@ class KeyedState:
             self.capacity *= 2
         self.stacked = self._tiled(self.capacity)
         self._slots: Dict[Hashable, int] = {}
+        self._max_slot = -1  # highest installed id (ids can be gapped — see slot_for)
         self.window = _validate_window(window)
         # ring entries are (capacity_at_snapshot, stacked_snapshot): a key allocated
         # after a snapshot was taken simply has no contribution in that segment
@@ -74,20 +75,45 @@ class KeyedState:
         return tuple(self._slots)
 
     def slot_for(self, key: Hashable) -> int:
-        """Slot index for ``key``, allocating the next one on first sight.
+        """Slot index for ``key``, allocating the next FREE one on first sight.
 
         Callers serialize allocation (the engine holds its submit lock); the slot may
         temporarily exceed ``capacity`` until the dispatcher calls ``ensure_capacity``.
+        Allocation is ``max(installed ids) + 1``, not ``len(slots)``: WAL/ship
+        replay installs the PRIMARY'S slot ids, which can arrive gapped (chunk
+        commit order is not slot assignment order) — a length-based allocator
+        would eventually hand a new tenant an id inside such a gap's occupied
+        tail, silently sharing one accumulator row between two tenants.
         """
         slot = self._slots.get(key)
         if slot is None:
-            slot = len(self._slots)
+            slot = self._max_slot + 1
             self._slots[key] = slot
+            self._max_slot = slot
         return slot
 
-    def ensure_capacity(self) -> bool:
-        """Grow the key axis (doubling) to fit every allocated slot. True if grown."""
-        need = len(self._slots)
+    def install_slot(self, key: Hashable, slot: int) -> int:
+        """Install an externally assigned (primary's) slot id for ``key`` —
+        WAL/ship replay's ``setdefault``, kept here so the max-id watermark that
+        :meth:`slot_for` allocates above stays in sync. Returns the effective id
+        (the existing one if ``key`` was already installed)."""
+        existing = self._slots.setdefault(key, int(slot))
+        self._max_slot = max(self._max_slot, existing)
+        return existing
+
+    def ensure_capacity(self, min_slots: Optional[int] = None) -> bool:
+        """Grow the key axis (doubling) to fit every allocated slot. True if grown.
+
+        The needed capacity is ``max id + 1``, not ``len(slots)`` — replay can
+        install the primary's ids gapped (see :meth:`slot_for`); ``min_slots``
+        raises the floor further for ids a replayed chunk is about to index
+        before they are all installed. Runs on every fused dispatch batch, so
+        the watermark is a cached integer, never a scan of the slot map.
+        """
+        need = max(
+            self._max_slot + 1,
+            int(min_slots) if min_slots is not None else 0,
+        )
         if need <= self.capacity:
             return False
         new_cap = self.capacity
@@ -168,7 +194,7 @@ class EagerKeyedState:
         self._states.setdefault(key, self._metric.init_state())
         return None
 
-    def ensure_capacity(self) -> bool:
+    def ensure_capacity(self, min_slots: Optional[int] = None) -> bool:
         return False
 
     def state_of(self, key: Hashable) -> Any:

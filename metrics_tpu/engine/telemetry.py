@@ -72,6 +72,20 @@ _COUNTERS = (
     # zombie surfacing is guard-independent: close() counts a worker that
     # outlived its join timeout whether or not a guard plane is configured
     "zombie_workers",
+    # replication plane (zero unless the engine was built with replication=;
+    # see metrics_tpu/repl/ and docs/source/replication.md)
+    "shipped_records",      # WAL records published over the repl transport (primary)
+    "shipped_snapshots",    # snapshot frames published (bootstrap + re-ship)
+    "ship_failures",        # transient transport send failures absorbed + retried
+    "applied_records",      # shipped WAL records replayed into local state (follower)
+    "snapshot_loads",       # follower bootstraps/re-bootstraps from a shipped snapshot
+    "fenced_rejections",    # frames/sends rejected by epoch fencing (zombie primary)
+    "ship_journal_lost",    # shipper parked: engine disabled its WAL (IO failure)
+    "ship_history_holes",   # bootstrap parked: best valid snapshot + retained WAL can't form a chain
+    "apply_failures",       # follower frames that raised during apply (absorbed)
+    "stale_read_refusals",  # follower reads refused beyond max_staleness
+    "promotions",           # follower→primary promotions served by this engine
+    "read_jit_fallbacks",   # compiled read path disabled (trace failure; eager from then on)
 )
 
 # distinguishes engines within one process; monotone so labels never collide

@@ -131,6 +131,16 @@ class GuardConfig:
     restart: bool = True
     max_restarts: int = 3
 
+    # ---- health-transition observer: called as ``hook(old_state, new_state)``
+    # exactly once per observed SERVING/DEGRADED/QUARANTINED transition, outside
+    # the engine's locks, exceptions absorbed. Every internal transition point
+    # (worker death/hang takeover, quarantine, restart, close) publishes health,
+    # so quarantine fires promptly; purely breaker-driven DEGRADED flips are
+    # observed at the next health() read. The replication plane's failover
+    # rides this: ``on_health_transition=repl.failover_hook(follower)`` promotes
+    # the follower the moment the watchdog quarantines a wedged primary.
+    on_health_transition: Optional[Callable[[str, str], None]] = None
+
     def __post_init__(self) -> None:
         if self.quota_rows_per_s is not None and self.quota_rows_per_s < 0:
             raise ValueError(f"`quota_rows_per_s` must be >= 0, got {self.quota_rows_per_s}")

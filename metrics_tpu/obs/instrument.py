@@ -409,6 +409,11 @@ CKPT_GENERATION = REGISTRY.gauge(
     "metrics_tpu_ckpt_generation",
     "Most recently committed (op=write) or recovered (op=restore) snapshot generation, per site.",
 )
+CKPT_SKIPPED = REGISTRY.counter(
+    "metrics_tpu_ckpt_skipped_generations_total",
+    "Snapshot generations skipped as corrupt/torn/invalid during a latest_valid recovery scan, "
+    "by failure reason — each skip silently cost one generation of recovery staleness.",
+)
 
 
 def record_ckpt_io(
@@ -427,6 +432,13 @@ def record_ckpt_failure(site: str, op: str) -> None:
     if not OBS.enabled:
         return
     CKPT_FAILURES.inc(1, site=site, op=op)
+
+
+def record_ckpt_skipped(reason: str, n: int = 1) -> None:
+    """Count one generation skipped by a recovery scan (reason = exception type)."""
+    if not OBS.enabled:
+        return
+    CKPT_SKIPPED.inc(n, reason=reason)
 
 
 def ckpt_span(name: str, **attrs: Any) -> Any:
@@ -500,6 +512,65 @@ def set_guard_health(engine: str, state: str) -> None:
 
 def guard_span(name: str, **attrs: Any) -> Any:
     """Trace span for guard-plane internals (drain forming, hang handling)."""
+    if not OBS.enabled:
+        return _NULL_SPAN
+    return TRACER.span(name, **attrs)
+
+
+# ---------------------------------------------------------------------- repl plane
+
+REPL_SHIPPED = REGISTRY.counter(
+    "metrics_tpu_repl_shipped_records_total",
+    "WAL records the primary's shipper published over the replication transport, per engine.",
+)
+REPL_APPLIED = REGISTRY.counter(
+    "metrics_tpu_repl_applied_records_total",
+    "Shipped WAL records a follower replayed into its local state, per engine.",
+)
+REPL_LAG_SEQS = REGISTRY.gauge(
+    "metrics_tpu_repl_lag_seqs",
+    "Follower staleness in WAL records: known primary position minus applied position, per engine.",
+)
+REPL_LAG_SECONDS = REGISTRY.gauge(
+    "metrics_tpu_repl_lag_seconds",
+    "Follower staleness in wall-clock seconds (now minus the primary instant the replica is "
+    "known current through); -1 before bootstrap (unbounded).",
+)
+REPL_PROMOTIONS = REGISTRY.counter(
+    "metrics_tpu_repl_promotions_total",
+    "Follower→primary promotions (explicit promote() or guard-quarantine failover), per engine.",
+)
+
+
+def record_repl_shipped(engine: str, n: int = 1) -> None:
+    if not OBS.enabled:
+        return
+    REPL_SHIPPED.inc(n, engine=engine)
+
+
+def record_repl_applied(engine: str, n: int = 1) -> None:
+    if not OBS.enabled:
+        return
+    REPL_APPLIED.inc(n, engine=engine)
+
+
+def set_repl_lag(engine: str, seqs_behind: int, seconds_behind: float) -> None:
+    if not OBS.enabled:
+        return
+    REPL_LAG_SEQS.set(seqs_behind, engine=engine)
+    REPL_LAG_SECONDS.set(
+        -1.0 if seconds_behind == float("inf") else seconds_behind, engine=engine
+    )
+
+
+def record_repl_promotion(engine: str) -> None:
+    if not OBS.enabled:
+        return
+    REPL_PROMOTIONS.inc(1, engine=engine)
+
+
+def repl_span(name: str, **attrs: Any) -> Any:
+    """Trace span for replication internals (ship tick, bootstrap, promotion)."""
     if not OBS.enabled:
         return _NULL_SPAN
     return TRACER.span(name, **attrs)

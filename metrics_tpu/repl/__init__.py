@@ -1,0 +1,111 @@
+"""Replication plane — WAL shipping, follower replay, bounded-staleness reads,
+hot failover.
+
+The sixth plane of the serving stack, built entirely on the artifacts the
+others already produce: the ckpt plane's atomic snapshots + CRC-framed
+seq-numbered WAL are the replication log, the engine's recovery machinery is
+the replayer, and the guard plane's health transitions are the failover
+trigger. Topology is one primary (owns the write path and the durable
+lineage) plus ONE read replica per ship link — every transport here is a
+single-consumer stream (``recv`` consumes), so two followers must never share
+a link; an engine currently wires one transport, i.e. one follower per
+primary (multi-link fan-out is a transport-layer extension, not an engine
+change)::
+
+    from metrics_tpu.engine import CheckpointConfig, ReplConfig, StreamingEngine
+    from metrics_tpu.repl import LoopbackLink
+
+    link = LoopbackLink()
+    primary = StreamingEngine(
+        metric,
+        checkpoint=CheckpointConfig(directory="/data/primary"),
+        replication=ReplConfig(role="primary", transport=link),
+    )
+    follower = StreamingEngine(
+        metric,
+        replication=ReplConfig(
+            role="follower", transport=link, max_staleness_s=2.0,
+            promote_checkpoint=CheckpointConfig(directory="/data/follower"),
+        ),
+    )
+    follower.compute(key)          # read replica: refused beyond max_staleness
+    follower.replica_lag()         # ReplicaLag(seqs_behind, seconds_behind)
+    follower.promote()             # hot failover: drain, fence, go writable
+
+Failover wires through the guard plane's health-transition hook — when the
+watchdog quarantines a wedged primary, the follower promotes itself::
+
+    primary = StreamingEngine(..., guard=GuardConfig(
+        watchdog_timeout_s=1.0, on_health_transition=failover_hook(follower)))
+
+Fencing: promotion adopts ``deposed epoch + 1`` and fences the transport, so a
+zombie primary's late shipments are rejected at the transport boundary and can
+never leak into the promoted lineage. See ``docs/source/replication.md``.
+"""
+
+from metrics_tpu.repl.config import ReplConfig, ReplicaLag
+from metrics_tpu.repl.errors import (
+    FencedError,
+    NotPrimaryError,
+    ReplPeerLostError,
+    ReplTransportError,
+    StalenessExceeded,
+)
+from metrics_tpu.repl.replica import ReplicaApplier
+from metrics_tpu.repl.shipper import Shipper
+from metrics_tpu.repl.transport import (
+    DeadPeerLink,
+    DirectoryTransport,
+    FlakyLink,
+    HeartbeatFrame,
+    LoopbackLink,
+    ReplTransport,
+    ShipFrame,
+    SnapshotFrame,
+    SocketShipReceiver,
+    SocketShipSender,
+    StallLink,
+    WalFrame,
+)
+
+__all__ = [
+    "DeadPeerLink",
+    "DirectoryTransport",
+    "FencedError",
+    "FlakyLink",
+    "HeartbeatFrame",
+    "LoopbackLink",
+    "NotPrimaryError",
+    "ReplConfig",
+    "ReplPeerLostError",
+    "ReplTransport",
+    "ReplTransportError",
+    "ReplicaApplier",
+    "ReplicaLag",
+    "ShipFrame",
+    "Shipper",
+    "SnapshotFrame",
+    "SocketShipReceiver",
+    "SocketShipSender",
+    "StalenessExceeded",
+    "StallLink",
+    "WalFrame",
+    "failover_hook",
+]
+
+
+def failover_hook(follower_engine, *, on_state: str = "QUARANTINED"):
+    """Build a ``GuardConfig(on_health_transition=...)`` observer that promotes
+    ``follower_engine`` the moment the primary's health reaches ``on_state``.
+
+    The guard fires the hook outside its locks and absorbs exceptions, and the
+    two engines share no locks, so the promotion runs inline — by the time the
+    quarantined primary's callers see their failures, the follower is already
+    writable.
+    """
+
+    def _hook(old: str, new: str) -> None:
+        if new == on_state and old != on_state:
+            follower_engine.promote()
+
+    return _hook

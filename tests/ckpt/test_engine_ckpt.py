@@ -59,6 +59,30 @@ class TestSnapshotAndRecover:
             assert float(e2.compute(key)) == float(oracle.compute()), key
         e2.close()
 
+    def test_new_tenant_after_recovery_gets_a_fresh_slot(self, tmp_path):
+        # regression: snapshot restore rebuilt the slot map but left the
+        # allocation watermark at -1 — the first NEW tenant a recovered engine
+        # accepted was handed slot 0, an existing tenant's accumulator row
+        # (two tenants silently sharing state). No WAL intros land here (the
+        # snapshot covers everything), so restore alone must fix the watermark.
+        cfg = _cfg(tmp_path)
+        e1 = StreamingEngine(BinaryAccuracy(), buckets=(8,), checkpoint=cfg)
+        stream = _stream(7, 80, keys=3)
+        for key, p, t in stream:
+            e1.submit(key, jnp.asarray(p), jnp.asarray(t))
+        e1.checkpoint_now()
+        e1.close(checkpoint=False)
+
+        e2 = StreamingEngine(BinaryAccuracy(), buckets=(8,), checkpoint=cfg)
+        e2.submit("brand-new", jnp.asarray([1, 1, 0, 0]), jnp.asarray([1, 0, 0, 1]))
+        e2.flush()
+        slots = e2._keyed._slots
+        assert len(set(slots.values())) == len(slots), "slot id collision after recovery"
+        for key, oracle in _oracles(stream, BinaryAccuracy).items():
+            assert float(e2.compute(key)) == float(oracle.compute()), key
+        assert float(e2.compute("brand-new")) == 0.5
+        e2.close()
+
     def test_periodic_snapshots_land_without_explicit_calls(self, tmp_path):
         cfg = _cfg(tmp_path, interval_s=0.01)
         engine = StreamingEngine(BinaryAccuracy(), buckets=(8,), checkpoint=cfg)

@@ -1,0 +1,49 @@
+"""latest_valid skip surfacing (ISSUE 6 satellite): silent corruption-skips
+become a rank-zero warning (always) + a master-gated counter (tests/obs)."""
+
+import pytest
+
+from metrics_tpu.ckpt import SnapshotStore, dumps
+from metrics_tpu.ckpt.faults import flip_bit, tear
+
+
+def _blob(v: int) -> bytes:
+    import numpy as np
+
+    return dumps({"x": np.full(16, v, np.float32)})
+
+
+class TestSkipWarnings:
+    def test_skip_warns_and_names_the_fallback(self, tmp_path):
+        store = SnapshotStore(str(tmp_path), durable=False)
+        store.commit(_blob(0))
+        store.commit(_blob(1))
+        tear(store.path(1), frac=0.5)
+        with pytest.warns(RuntimeWarning, match="recovered from an older generation"):
+            gen, snap = store.latest_valid()
+        assert gen == 0
+        assert store.last_skipped and store.last_skipped[0][0] == 1
+
+    def test_total_loss_warns_loudly(self, tmp_path):
+        store = SnapshotStore(str(tmp_path), durable=False)
+        store.commit(_blob(0))
+        flip_bit(store.path(0), offset=40)
+        with pytest.warns(RuntimeWarning, match="NO valid generation remained"):
+            assert store.latest_valid() is None
+
+    def test_clean_scan_is_silent(self, tmp_path, recwarn):
+        store = SnapshotStore(str(tmp_path), durable=False)
+        store.commit(_blob(0))
+        gen, _ = store.latest_valid()
+        assert gen == 0
+        assert not [w for w in recwarn.list if "skipped" in str(w.message)]
+
+    def test_warning_lists_reasons_capped(self, tmp_path):
+        store = SnapshotStore(str(tmp_path), retain=6, durable=False)
+        for v in range(5):
+            store.commit(_blob(v))
+        for g in range(1, 5):
+            tear(store.path(g), frac=0.3)
+        with pytest.warns(RuntimeWarning, match=r"skipped 4 .*; \.\.\."):
+            gen, _ = store.latest_valid()
+        assert gen == 0

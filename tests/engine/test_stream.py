@@ -43,6 +43,25 @@ def test_keyed_state_fresh_key_reads_init():
     assert int(state["tp"]) == 0 and int(state["_update_count"]) == 0
 
 
+def test_keyed_state_allocation_skips_replay_installed_gaps():
+    # WAL/ship replay installs the PRIMARY's slot ids, which arrive gapped
+    # (chunk commit order is not slot assignment order). A later live submit
+    # (promoted follower / recovered primary taking new tenants) must never be
+    # handed an id inside the gap's occupied tail — that would silently share
+    # one accumulator row between two tenants.
+    m = BinaryAccuracy()
+    ks = KeyedState(m, capacity=8)
+    ks.install_slot("a", 0)
+    ks.install_slot("b", 5)  # replay-installed, gapped
+    ks.ensure_capacity()
+    assert ks.capacity >= 6  # gap-aware: need is max id + 1, not len(slots)
+    fresh = [ks.slot_for(k) for k in ("c", "d", "e", "f")]
+    assert len(set(ks._slots.values())) == len(ks._slots), "slot id collision"
+    assert all(s > 5 for s in fresh)
+    # install_slot is a setdefault: a re-delivered intro keeps the first id
+    assert ks.install_slot("b", 7) == 5
+
+
 def _window_oracle(metric_factory, segments):
     """Brute-force window reference: replay the raw data of the surviving segments
     into a fresh metric."""

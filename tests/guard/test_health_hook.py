@@ -1,0 +1,114 @@
+"""GuardConfig.on_health_transition: exactly once per transition, outside locks,
+exception-absorbed (the replication plane's failover trigger)."""
+
+import time
+
+import jax.numpy as jnp
+import pytest
+
+from metrics_tpu.classification import BinaryAccuracy
+from metrics_tpu.engine import GuardConfig, StreamingEngine
+from metrics_tpu.guard.faults import hold_dispatch_lock, kill_dispatcher, wedge_dispatcher
+
+
+def _await(cond, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while not cond() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    return cond()
+
+
+class TestHealthTransitionHook:
+    def test_fires_exactly_once_per_transition(self):
+        fired = []
+        guard = GuardConfig(restart=False, on_health_transition=lambda old, new: fired.append((old, new)))
+        engine = StreamingEngine(BinaryAccuracy(), buckets=(8,), guard=guard)
+        try:
+            for _ in range(3):
+                assert engine.health()["state"] == "SERVING"
+            assert fired == []  # no transition, no fire — however many readers
+            kill_dispatcher(engine)
+            engine.submit("t", jnp.asarray([1]), jnp.asarray([1]))
+            engine.flush()
+            assert _await(lambda: engine.degraded)
+            for _ in range(3):
+                assert engine.health()["state"] == "DEGRADED"
+            assert fired == [("SERVING", "DEGRADED")]  # once, not thrice
+        finally:
+            engine.close()
+
+    def test_fires_on_quarantine_without_explicit_health_read(self):
+        fired = []
+        guard = GuardConfig(
+            watchdog_timeout_s=0.2,
+            watchdog_poll_s=0.02,
+            hang_lock_timeout_s=0.2,
+            on_health_transition=lambda old, new: fired.append((old, new)),
+        )
+        engine = StreamingEngine(BinaryAccuracy(), buckets=(8,), guard=guard)
+        try:
+            with hold_dispatch_lock(engine), wedge_dispatcher(engine):
+                engine.submit("t", jnp.asarray([1]), jnp.asarray([1]))
+                assert _await(lambda: engine.quarantined)
+            # the quarantine path publishes health itself — the hook fired
+            # without anyone calling engine.health()
+            assert _await(lambda: ("SERVING", "QUARANTINED") in fired)
+            assert fired.count(("SERVING", "QUARANTINED")) == 1
+        finally:
+            engine.close()
+
+    def test_recovery_round_trip_transitions_pair_exactly_once(self):
+        # transitions fire when OBSERVED (health reads / internal publishes):
+        # a poller that catches the takeover's DEGRADED window must see exactly
+        # one DEGRADED entry and exactly one recovery back to SERVING — never
+        # duplicates, never a dangling half of the round trip
+        fired = []
+        guard = GuardConfig(
+            watchdog_timeout_s=0.2,
+            watchdog_poll_s=0.02,
+            hang_lock_timeout_s=0.5,
+            on_health_transition=lambda old, new: fired.append((old, new)),
+        )
+        engine = StreamingEngine(BinaryAccuracy(), buckets=(8,), guard=guard)
+        try:
+            assert engine.health()["state"] == "SERVING"
+            with wedge_dispatcher(engine):  # recoverable hang: takeover + restart
+                engine.submit("t", jnp.asarray([1]), jnp.asarray([1]))
+                assert _await(
+                    lambda: engine.health() is not None
+                    and engine.telemetry_snapshot()["watchdog_restarts"] >= 1
+                )
+            engine.flush()
+            assert engine.health()["state"] == "SERVING"
+            assert fired.count(("SERVING", "DEGRADED")) == fired.count(("DEGRADED", "SERVING"))
+            assert fired.count(("SERVING", "DEGRADED")) <= 1
+        finally:
+            engine.close()
+
+    def test_hook_exceptions_are_absorbed(self):
+        def explode(old, new):
+            raise RuntimeError("observer bug")
+
+        guard = GuardConfig(restart=False, on_health_transition=explode)
+        engine = StreamingEngine(BinaryAccuracy(), buckets=(8,), guard=guard)
+        try:
+            kill_dispatcher(engine)
+            engine.submit("t", jnp.asarray([1]), jnp.asarray([1]))
+            engine.flush()
+            assert _await(lambda: engine.degraded)
+            assert engine.health()["state"] == "DEGRADED"  # read survives the observer crash
+        finally:
+            engine.close()
+
+    def test_no_hook_no_overhead_path(self):
+        # hookless guard engines keep working and track state silently
+        engine = StreamingEngine(BinaryAccuracy(), buckets=(8,), guard=GuardConfig(restart=False))
+        try:
+            assert engine.health()["state"] == "SERVING"
+            kill_dispatcher(engine)
+            engine.submit("t", jnp.asarray([1]), jnp.asarray([1]))
+            engine.flush()
+            assert _await(lambda: engine.degraded)
+            assert engine.health()["state"] == "DEGRADED"
+        finally:
+            engine.close()

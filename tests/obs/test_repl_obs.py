@@ -1,0 +1,136 @@
+"""Repl series exposure: Prometheus rendering + jsonl emitter (ISSUE 6).
+
+The replication plane's shipped/applied/lag/promotion series must surface
+through the same two exits as the rest of the stack — and stay completely
+silent when ``obs`` is disabled (the repl hooks are master-gated automatic
+instrumentation; the engine's always-on telemetry carries the same counts in
+its flat snapshot regardless). Also covers the ckpt skipped-generations
+satellite counter, which rides the same gate.
+"""
+
+from __future__ import annotations
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import obs
+from metrics_tpu.classification import BinaryAccuracy
+from metrics_tpu.engine import CheckpointConfig, ReplConfig, StreamingEngine
+from metrics_tpu.repl import LoopbackLink
+
+from tests.obs.prom_grammar import parse as parse_prometheus
+
+_FAMILIES = (
+    "metrics_tpu_repl_shipped_records_total",
+    "metrics_tpu_repl_applied_records_total",
+    "metrics_tpu_repl_lag_seqs",
+    "metrics_tpu_repl_lag_seconds",
+    "metrics_tpu_repl_promotions_total",
+)
+
+
+def _run_pair(tmp_path, enabled: bool):
+    if enabled:
+        obs.enable()
+    link = LoopbackLink()
+    primary = StreamingEngine(
+        BinaryAccuracy(),
+        buckets=(8,),
+        # no periodic snapshot: every record must travel as a WAL frame, so the
+        # shipped/applied counters are deterministically nonzero
+        checkpoint=CheckpointConfig(directory=str(tmp_path / "p"), interval_s=3600.0, durable=False),
+        replication=ReplConfig(role="primary", transport=link, ship_interval_s=0.01, heartbeat_interval_s=0.05),
+    )
+    follower = StreamingEngine(
+        BinaryAccuracy(),
+        buckets=(8,),
+        replication=ReplConfig(
+            role="follower",
+            transport=link,
+            poll_interval_s=0.01,
+            promote_checkpoint=CheckpointConfig(directory=str(tmp_path / "f"), durable=False),
+        ),
+    )
+    try:
+        for _ in range(10):
+            primary.submit("t", jnp.asarray([1, 0]), jnp.asarray([1, 1]))
+        primary.flush()
+        assert follower._applier.await_seq(primary._wal_seq, timeout_s=15)
+        follower.replica_lag()  # refresh the gauges
+        follower.promote()
+    finally:
+        primary.close(checkpoint=False)
+        follower.close()
+    return primary, follower
+
+
+class TestPrometheusExposure:
+    def test_repl_series_render_when_enabled(self, tmp_path):
+        primary, follower = _run_pair(tmp_path, enabled=True)
+        text = obs.render_prometheus()
+        parse_prometheus(text)
+        for family in _FAMILIES:
+            assert f"# TYPE {family}" in text, family
+        p_label, f_label = primary.telemetry.engine_id, follower.telemetry.engine_id
+        assert f'metrics_tpu_repl_shipped_records_total{{engine="{p_label}"}}' in text
+        assert f'metrics_tpu_repl_applied_records_total{{engine="{f_label}"}}' in text
+        assert f'metrics_tpu_repl_lag_seqs{{engine="{f_label}"}} 0' in text
+        assert f'metrics_tpu_repl_promotions_total{{engine="{f_label}"}} 1' in text
+
+    def test_silent_when_disabled(self, tmp_path):
+        _run_pair(tmp_path, enabled=False)
+        text = obs.render_prometheus()
+        for family in _FAMILIES:
+            # family headers may render; no samples may exist
+            assert family + "{" not in text, family
+
+    def test_always_on_telemetry_regardless(self, tmp_path):
+        primary, follower = _run_pair(tmp_path, enabled=False)
+        # the flat snapshot carries the counts even with obs off
+        assert primary.telemetry_snapshot()["shipped_records"] > 0
+        assert follower.telemetry_snapshot()["applied_records"] > 0
+        assert follower.telemetry_snapshot()["promotions"] == 1
+
+
+class TestJsonlExposure:
+    def test_emit_includes_repl_families(self, tmp_path):
+        _run_pair(tmp_path, enabled=True)
+        path = str(tmp_path / "registry.jsonl")
+        obs.emit(path, run="repl-snapshot-test")
+        record = [json.loads(ln) for ln in open(path)][0]
+        reg = record["registry"]
+        assert reg["metrics_tpu_repl_shipped_records_total"]["type"] == "counter"
+        assert any(v > 0 for v in reg["metrics_tpu_repl_applied_records_total"]["values"].values())
+
+
+class TestCkptSkippedCounter:
+    def _skip_activity(self, tmp_path, enabled: bool):
+        from metrics_tpu.ckpt import dumps
+        from metrics_tpu.ckpt.faults import tear
+        from metrics_tpu.ckpt.store import SnapshotStore
+
+        if enabled:
+            obs.enable()
+        store = SnapshotStore(str(tmp_path / "s"), durable=False)
+        for v in range(2):
+            store.commit(dumps({"x": np.full(32, v, np.float32)}))
+        tear(store.path(1), frac=0.5)
+        with pytest.warns(RuntimeWarning, match="skipped 1 corrupt"):
+            gen, _ = store.latest_valid()
+        assert gen == 0
+
+    def test_counter_renders_with_reason_when_enabled(self, tmp_path):
+        self._skip_activity(tmp_path, enabled=True)
+        text = obs.render_prometheus()
+        parse_prometheus(text)
+        assert "# TYPE metrics_tpu_ckpt_skipped_generations_total" in text
+        assert 'metrics_tpu_ckpt_skipped_generations_total{reason="CorruptSnapshotError"} 1' in text
+
+    def test_counter_silent_when_disabled(self, tmp_path):
+        # the warning still fires (operators always hear about skips); only the
+        # master-gated series stays silent
+        self._skip_activity(tmp_path, enabled=False)
+        assert "metrics_tpu_ckpt_skipped_generations_total{" not in obs.render_prometheus()

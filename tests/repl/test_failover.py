@@ -1,0 +1,482 @@
+"""Hot failover: promotion, epoch fencing, guard-quarantine trigger, lineage."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.classification import BinaryAccuracy
+from metrics_tpu.engine import (
+    CheckpointConfig,
+    EngineQuarantined,
+    GuardConfig,
+    NotPrimaryError,
+    ReplConfig,
+    StreamingEngine,
+)
+from metrics_tpu.guard.faults import hold_dispatch_lock, wedge_dispatcher
+from metrics_tpu.repl import FlakyLink, LoopbackLink, StallLink, failover_hook
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+
+def _pair(tmp_path, link=None, guard=None, ship_faults=None, **fkw):
+    link = link if link is not None else LoopbackLink()
+    transport = ship_faults(link) if ship_faults is not None else link
+    primary = StreamingEngine(
+        BinaryAccuracy(),
+        buckets=(8, 32),
+        checkpoint=CheckpointConfig(directory=str(tmp_path / "primary"), interval_s=0.05, durable=False),
+        guard=guard,
+        replication=ReplConfig(
+            role="primary", transport=transport, ship_interval_s=0.01, heartbeat_interval_s=0.05
+        ),
+    )
+    follower = StreamingEngine(
+        BinaryAccuracy(),
+        buckets=(8, 32),
+        replication=ReplConfig(
+            role="follower",
+            transport=link,
+            poll_interval_s=0.01,
+            promote_checkpoint=CheckpointConfig(
+                directory=str(tmp_path / "follower"), interval_s=0.1, durable=False
+            ),
+            **fkw,
+        ),
+    )
+    return primary, follower
+
+
+def _feed(engine, seed, n=60):
+    rng = np.random.default_rng(seed)
+    for _ in range(n):
+        rows = int(rng.integers(1, 7))
+        engine.submit(
+            f"t{rng.integers(0, 4)}",
+            jnp.asarray(rng.integers(0, 2, rows)),
+            jnp.asarray(rng.integers(0, 2, rows)),
+        )
+    engine.flush()
+
+
+class TestLineageGapParking:
+    def test_gapped_follower_parks_replay_until_snapshot(self, tmp_path):
+        # a replacement primary's restarted seq numbering makes seq arithmetic
+        # meaningless across lineages: once gapped (here via the epoch bump), a
+        # new-lineage record whose seq happens to land on applied+1 must NOT
+        # replay onto old-lineage state — replay parks until that lineage's
+        # snapshot arrives
+        import pickle
+
+        from metrics_tpu.engine.runtime import _encode_request_record
+        from metrics_tpu.repl import WalFrame
+
+        link = LoopbackLink()
+        primary, follower = _pair(tmp_path, link=link)
+        try:
+            _feed(primary, seed=11)
+            assert follower._applier.await_seq(primary._wal_seq, timeout_s=15)
+            applied = follower._applier.applied_seq
+            keys_before = set(follower._keyed.keys)
+            payload = _encode_request_record(
+                pickle.dumps("zz-new-lineage"),
+                (np.asarray([1, 1]), np.asarray([0, 1])),
+            )
+            link.send([WalFrame(99, applied + 1, payload, time.time())])
+            deadline = time.time() + 5
+            while time.time() < deadline and follower._applier.epoch != 99:
+                time.sleep(0.01)
+            assert follower._applier.epoch == 99
+            assert follower._applier._gap  # parked, awaiting the new lineage's snapshot
+            assert follower._applier.applied_seq == applied  # nothing applied
+            assert set(follower._keyed.keys) == keys_before
+        finally:
+            primary.close(checkpoint=False)
+            follower.close()
+
+
+class TestPromotion:
+    def test_promote_drains_flips_writable_and_fences(self, tmp_path):
+        primary, follower = _pair(tmp_path)
+        try:
+            _feed(primary, seed=1)
+            acked_seq = primary._wal_seq
+            # wait for the SHIPPER to publish the acked tail — shipping is
+            # async, and what was never shipped cannot survive a failover. But
+            # do NOT wait for the applier: frames sitting in the link are
+            # exactly what promote()'s drain must pick up.
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline and primary._shipper.last_shipped_seq < acked_seq:
+                time.sleep(0.01)
+            assert primary._shipper.last_shipped_seq == acked_seq
+            follower.promote()
+            # drained tail: everything the primary acked before promotion is in
+            assert follower._applier.applied_seq == acked_seq
+            assert follower.health()["replication"]["role"] == "primary"
+            assert follower._repl_epoch == 1
+            assert follower.replica_lag() is None
+            fut = follower.submit("t0", jnp.asarray([1]), jnp.asarray([1]))
+            assert fut.result(timeout=10)["rows"] == 1
+        finally:
+            primary.close(checkpoint=False)
+            follower.close()
+
+    def test_straggler_apply_after_promotion_is_a_noop(self, tmp_path):
+        # regression: applier.stop()'s join can time out on a poll thread
+        # wedged in a cold kernel compile — a batch it applies AFTER promote()
+        # returns must not replay old-primary records into the now-writable
+        # engine (they would mutate promoted state unjournaled in the new
+        # lineage). park() is the hard cutoff; the frame here carries the
+        # applier's own epoch so nothing but the park stops it.
+        import pickle
+
+        from metrics_tpu.engine.runtime import _encode_request_record
+        from metrics_tpu.repl import WalFrame
+
+        primary, follower = _pair(tmp_path)
+        try:
+            _feed(primary, seed=12)
+            assert follower._applier.await_seq(primary._wal_seq, timeout_s=15)
+            follower.promote()
+            applier = follower._applier
+            applied = applier.applied_seq
+            payload = _encode_request_record(
+                pickle.dumps("straggler"), (np.asarray([1]), np.asarray([1]))
+            )
+            applier.apply_frames(
+                [WalFrame(applier.epoch, applied + 1, payload, time.time())]
+            )
+            assert applier.applied_seq == applied
+            assert "straggler" not in set(follower._keyed.keys)
+        finally:
+            primary.close(checkpoint=False)
+            follower.close()
+
+    def test_promote_is_idempotent(self, tmp_path):
+        primary, follower = _pair(tmp_path)
+        try:
+            deadline = time.monotonic() + 10.0
+            while not follower._applier.bootstrapped and time.monotonic() < deadline:
+                time.sleep(0.01)  # promote refuses an unbootstrapped replica
+            follower.promote()
+            follower.promote()  # no-op, no error
+            assert follower.telemetry_snapshot()["promotions"] == 1
+        finally:
+            primary.close(checkpoint=False)
+            follower.close()
+
+    def test_promote_on_non_follower_refused(self, tmp_path):
+        primary, follower = _pair(tmp_path)
+        try:
+            with pytest.raises(MetricsTPUUserError):
+                primary.promote()
+        finally:
+            primary.close(checkpoint=False)
+            follower.close()
+
+    def test_zombie_primary_shipments_rejected_after_fencing(self, tmp_path):
+        primary, follower = _pair(tmp_path)
+        try:
+            _feed(primary, seed=2)
+            assert follower._applier.await_seq(primary._wal_seq, timeout_s=15)
+            promoted_state = None
+            follower.promote()
+            promoted_state = {
+                k: jax.device_get(follower._keyed.state_of(k)) for k in follower._keyed.keys
+            }
+            # the deposed primary keeps writing — a zombie. Its late shipments
+            # must be rejected at the transport boundary and never reach the
+            # promoted node's state.
+            _feed(primary, seed=3, n=30)
+            deadline = time.monotonic() + 5.0
+            while not primary._shipper.fenced and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert primary._shipper.fenced
+            assert primary.health()["state"] == "DEGRADED"  # split-brain surfaced
+            for key, before in promoted_state.items():
+                jax.tree_util.tree_map(
+                    lambda x, y: np.testing.assert_array_equal(np.asarray(x), np.asarray(y)),
+                    jax.device_get(follower._keyed.state_of(key)),
+                    before,
+                )
+        finally:
+            primary.close(checkpoint=False)
+            follower.close()
+
+    def test_promoted_lineage_survives_restart(self, tmp_path):
+        primary, follower = _pair(tmp_path)
+        try:
+            _feed(primary, seed=4)
+            assert follower._applier.await_seq(primary._wal_seq, timeout_s=15)
+            follower.promote()
+            _feed(follower, seed=5, n=30)  # post-promotion writes into the NEW lineage
+            final = {k: jax.device_get(follower._keyed.state_of(k)) for k in follower._keyed.keys}
+            follower.close(checkpoint=False)  # crash-sim: the new WAL carries the tail
+            recovered = StreamingEngine(
+                BinaryAccuracy(),
+                buckets=(8, 32),
+                checkpoint=CheckpointConfig(directory=str(tmp_path / "follower"), durable=False),
+                start=False,
+            )
+            try:
+                for key, want in final.items():
+                    jax.tree_util.tree_map(
+                        lambda x, y: np.testing.assert_array_equal(np.asarray(x), np.asarray(y)),
+                        jax.device_get(recovered._keyed.state_of(key)),
+                        want,
+                    )
+            finally:
+                recovered.close(checkpoint=False)
+        finally:
+            primary.close(checkpoint=False)
+
+    def test_promote_refuses_unbootstrapped_follower(self):
+        # regression: promoting a follower that never received its bootstrap
+        # snapshot flipped FRESH INIT state writable and pinned it as the new
+        # durable lineage — every tenant's history silently replaced by zeros
+        # served as legitimate (the guard hook could do this automatically
+        # whenever a primary wedged before its first ship completed)
+        from metrics_tpu.repl import SnapshotFrame
+
+        follower = StreamingEngine(
+            BinaryAccuracy(),
+            buckets=(8, 32),
+            replication=ReplConfig(role="follower", transport=LoopbackLink(), poll_interval_s=0.01),
+        )
+        try:
+            with pytest.raises(MetricsTPUUserError, match="never bootstrapped"):
+                follower.promote()
+            assert follower._repl_follower  # refusal left the replica intact
+            # an EMPTY-bootstrap replica IS promotable: its primary had no state
+            follower._applier.apply_frames([SnapshotFrame(0, -1, -1, None, time.time())])
+            with pytest.warns(RuntimeWarning):  # no promote_checkpoint configured
+                follower.promote()
+            assert not follower._repl_follower
+        finally:
+            follower.close()
+
+    def test_promote_survives_unopenable_lineage_directory(self, tmp_path):
+        # regression: promote() flipped the role and fenced BEFORE opening
+        # the promote_checkpoint lineage — an unwritable directory raised out
+        # of the middle, the failover hook absorbed it, and the half-promoted
+        # engine accepted submits nothing ever drained (no dispatcher), with
+        # the idempotency guard blocking every retry. It must degrade to
+        # serving WITHOUT durability instead.
+        from metrics_tpu.repl import SnapshotFrame
+
+        blocker = tmp_path / "not-a-dir"
+        blocker.write_text("a file where the lineage directory must go")
+        follower = StreamingEngine(
+            BinaryAccuracy(),
+            buckets=(8, 32),
+            replication=ReplConfig(
+                role="follower", transport=LoopbackLink(), poll_interval_s=0.01,
+                promote_checkpoint=CheckpointConfig(directory=str(blocker), durable=False),
+            ),
+        )
+        try:
+            follower._applier.apply_frames([SnapshotFrame(0, -1, -1, None, time.time())])
+            with pytest.warns(RuntimeWarning, match="WITHOUT durability"):
+                follower.promote()
+            assert not follower._repl_follower
+            # writable and DRAINING: the engine is degraded, not wedged
+            follower.submit("t0", jnp.asarray([1]), jnp.asarray([1])).result(timeout=10)
+            assert float(follower.compute("t0")) == 1.0
+        finally:
+            follower.close()
+
+    def test_repromotion_onto_stale_lineage_directory_recovers_cleanly(self, tmp_path):
+        # regression: a node promoted once, dead, re-attached as follower and
+        # promoted AGAIN with the same static promote_checkpoint directory
+        # re-opened the old lineage's journal — numbering continued past the
+        # leftover segments while the pin snapshot recorded seq -1, so the
+        # next crash recovery replayed the DEAD incarnation's records on top
+        # of the pinned state, silently corrupting every touched tenant.
+        # promote() now anchors at the re-opened journal tail: the pin covers
+        # every stale record and recovery replays only this incarnation's.
+        lineage = str(tmp_path / "promo")
+        dead = StreamingEngine(
+            BinaryAccuracy(),
+            buckets=(8, 32),
+            checkpoint=CheckpointConfig(directory=lineage, interval_s=3600.0, durable=False),
+        )
+        _feed(dead, seed=95, n=12)
+        dead.checkpoint_now()
+        _feed(dead, seed=96, n=6)  # leftovers: a generation + post-snapshot WAL
+        dead.close(checkpoint=False)
+
+        link = LoopbackLink()
+        primary = StreamingEngine(
+            BinaryAccuracy(),
+            buckets=(8, 32),
+            checkpoint=CheckpointConfig(directory=str(tmp_path / "primary"), interval_s=0.05, durable=False),
+            replication=ReplConfig(
+                role="primary", transport=link, ship_interval_s=0.01, heartbeat_interval_s=0.05
+            ),
+        )
+        follower = StreamingEngine(
+            BinaryAccuracy(),
+            buckets=(8, 32),
+            replication=ReplConfig(
+                role="follower", transport=link, poll_interval_s=0.01,
+                promote_checkpoint=CheckpointConfig(directory=lineage, interval_s=3600.0, durable=False),
+            ),
+        )
+        try:
+            _feed(primary, seed=97, n=30)
+            assert follower._applier.await_seq(primary._wal_seq, timeout_s=15)
+            primary.close(checkpoint=False)
+            follower.promote()
+            _feed(follower, seed=98, n=10)
+            final = {k: jax.device_get(follower._keyed.state_of(k)) for k in follower._keyed.keys}
+            follower.close(checkpoint=False)  # crash-sim: the new WAL carries the tail
+            recovered = StreamingEngine(
+                BinaryAccuracy(),
+                buckets=(8, 32),
+                checkpoint=CheckpointConfig(directory=lineage, durable=False),
+                start=False,
+            )
+            try:
+                assert set(recovered._keyed.keys) == set(final)
+                for key, want in final.items():
+                    jax.tree_util.tree_map(
+                        lambda x, y: np.testing.assert_array_equal(np.asarray(x), np.asarray(y)),
+                        jax.device_get(recovered._keyed.state_of(key)),
+                        want,
+                    )
+            finally:
+                recovered.close(checkpoint=False)
+        finally:
+            follower.close()
+
+    def test_restarted_promoted_primary_recovers_its_epoch(self, tmp_path):
+        # the promotion epoch rides snapshot meta: a promoted node that
+        # crashes and restarts as a primary on its own lineage must resume at
+        # that epoch, not be fenced out of the link by its own fence
+        primary, follower = _pair(tmp_path)
+        try:
+            _feed(primary, seed=9)
+            assert follower._applier.await_seq(primary._wal_seq, timeout_s=15)
+            link = follower._repl_cfg.transport
+            follower.promote()
+            follower.close(checkpoint=False)
+        finally:
+            primary.close(checkpoint=False)
+        restarted = StreamingEngine(
+            BinaryAccuracy(),
+            buckets=(8, 32),
+            checkpoint=CheckpointConfig(directory=str(tmp_path / "follower"), durable=False),
+            replication=ReplConfig(
+                role="primary", transport=link, ship_interval_s=0.01, heartbeat_interval_s=0.05
+            ),  # epoch defaults to 0: the lineage meta must override it
+        )
+        try:
+            # meta hands back the owned epoch 1, and the resume bump advances
+            # past it (a restart is a new lineage) — strictly above the fence
+            assert restarted._repl_epoch == 2
+            assert restarted._shipper.epoch == 2
+            _feed(restarted, seed=10, n=20)
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline and not restarted._shipper.fenced:
+                if restarted._shipper.last_shipped_seq >= restarted._wal_seq >= 0:
+                    break
+                time.sleep(0.02)
+            assert not restarted._shipper.fenced  # its own fence must not reject it
+            assert restarted._shipper.last_shipped_seq >= 0  # shipping resumed
+        finally:
+            restarted.close(checkpoint=False)
+
+    def test_promote_without_lineage_warns(self, tmp_path):
+        from metrics_tpu.repl import SnapshotFrame
+
+        link = LoopbackLink()
+        follower = StreamingEngine(
+            BinaryAccuracy(),
+            replication=ReplConfig(role="follower", transport=link, poll_interval_s=0.01),
+        )
+        try:
+            follower._applier.apply_frames([SnapshotFrame(0, -1, -1, None, time.time())])
+            with pytest.warns(RuntimeWarning, match="WITHOUT durability"):
+                follower.promote()
+        finally:
+            follower.close()
+
+    def test_promotion_under_flaky_ship_link(self, tmp_path):
+        # transient ship failures before promotion: records still arrive
+        # (shipper retries), and the promoted node serves the acked prefix
+        primary, follower = _pair(tmp_path, ship_faults=lambda inner: FlakyLink(inner, fail=3))
+        try:
+            _feed(primary, seed=6)
+            assert follower._applier.await_seq(primary._wal_seq, timeout_s=15)
+            follower.promote()
+            assert follower._applier.applied_seq == primary._wal_seq
+        finally:
+            primary.close(checkpoint=False)
+            follower.close()
+
+    def test_promotion_under_stalled_ship_link(self, tmp_path):
+        primary, follower = _pair(tmp_path, ship_faults=lambda inner: StallLink(inner, 0.05, stalls=4))
+        try:
+            _feed(primary, seed=7)
+            assert follower._applier.await_seq(primary._wal_seq, timeout_s=15)
+            follower.promote()
+            assert follower._applier.applied_seq == primary._wal_seq
+        finally:
+            primary.close(checkpoint=False)
+            follower.close()
+
+
+class TestGuardFailover:
+    def test_quarantine_transition_promotes_follower(self, tmp_path):
+        guard = GuardConfig(
+            watchdog_timeout_s=0.2, watchdog_poll_s=0.02, hang_lock_timeout_s=0.2
+        )
+        primary, follower = _pair(tmp_path)
+        primary.close(checkpoint=False)
+        # rebuild the primary with the failover hook wired (needs the follower)
+        link = follower._repl_cfg.transport
+        guard = GuardConfig(
+            watchdog_timeout_s=0.2,
+            watchdog_poll_s=0.02,
+            hang_lock_timeout_s=0.2,
+            on_health_transition=failover_hook(follower),
+        )
+        primary = StreamingEngine(
+            BinaryAccuracy(),
+            buckets=(8, 32),
+            checkpoint=CheckpointConfig(directory=str(tmp_path / "p2"), interval_s=0.05, durable=False),
+            guard=guard,
+            replication=ReplConfig(
+                role="primary", transport=link, ship_interval_s=0.01, heartbeat_interval_s=0.05
+            ),
+        )
+        try:
+            _feed(primary, seed=8)
+            assert follower._applier.await_seq(primary._wal_seq, timeout_s=15)
+            # wedge the dispatcher INSIDE the dispatch path (lock held) so the
+            # watchdog's lock probe fails → engine quarantine → hook fires
+            with hold_dispatch_lock(primary), wedge_dispatcher(primary):
+                try:
+                    primary.submit("t0", jnp.asarray([1]), jnp.asarray([1]))
+                except EngineQuarantined:
+                    pass  # watchdog beat the submit under load: the goal state
+                deadline = time.monotonic() + 10.0
+                while not primary.quarantined and time.monotonic() < deadline:
+                    time.sleep(0.02)
+            assert primary.quarantined
+            # quarantined flips before the health publish that fires the hook:
+            # give the promotion its moment, then assert it happened
+            deadline = time.monotonic() + 10.0
+            while follower._repl_follower and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert follower.health()["replication"]["role"] == "primary"
+            assert follower.telemetry_snapshot()["promotions"] == 1
+            fut = follower.submit("t1", jnp.asarray([1]), jnp.asarray([1]))
+            fut.result(timeout=10)
+            with pytest.raises(EngineQuarantined):
+                primary.submit("t0", jnp.asarray([1]), jnp.asarray([1]))
+        finally:
+            primary.close(checkpoint=False)
+            follower.close()

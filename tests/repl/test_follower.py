@@ -1,0 +1,1028 @@
+"""Follower replica: bootstrap, bit-identical replay, staleness contract."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.classification import BinaryAccuracy
+from metrics_tpu.engine import (
+    CheckpointConfig,
+    NotPrimaryError,
+    ReplConfig,
+    StalenessExceeded,
+    StreamingEngine,
+)
+from metrics_tpu.repl import HeartbeatFrame, LoopbackLink, ReplicaLag
+
+
+def _primary(tmp_path, link, **kw):
+    return StreamingEngine(
+        BinaryAccuracy(),
+        buckets=(8, 32),
+        checkpoint=CheckpointConfig(directory=str(tmp_path / "primary"), interval_s=0.05, durable=False),
+        replication=ReplConfig(
+            role="primary", transport=link, ship_interval_s=0.01, heartbeat_interval_s=0.05, **kw
+        ),
+    )
+
+
+def _follower(link, **kw):
+    return StreamingEngine(
+        BinaryAccuracy(),
+        buckets=(8, 32),
+        replication=ReplConfig(role="follower", transport=link, poll_interval_s=0.01, **kw),
+    )
+
+
+def _feed(engine, seed, n=120, keys=4):
+    rng = np.random.default_rng(seed)
+    for _ in range(n):
+        rows = int(rng.integers(1, 7))
+        engine.submit(
+            f"t{rng.integers(0, keys)}",
+            jnp.asarray(rng.integers(0, 2, rows)),
+            jnp.asarray(rng.integers(0, 2, rows)),
+        )
+    engine.flush()
+
+
+def _assert_states_equal(a_engine, b_engine):
+    assert set(a_engine._keyed.keys) == set(b_engine._keyed.keys)
+    for key in a_engine._keyed.keys:
+        jax.tree_util.tree_map(
+            lambda x, y: np.testing.assert_array_equal(np.asarray(x), np.asarray(y)),
+            jax.device_get(a_engine._keyed.state_of(key)),
+            jax.device_get(b_engine._keyed.state_of(key)),
+        )
+
+
+class TestReplay:
+    def test_follower_is_bit_identical_at_applied_seq(self, tmp_path):
+        link = LoopbackLink()
+        primary, follower = _primary(tmp_path, link), _follower(link)
+        try:
+            _feed(primary, seed=1)
+            target = primary._wal_seq
+            assert follower._applier.await_seq(target, timeout_s=15)
+            _assert_states_equal(primary, follower)
+            for key in primary._keyed.keys:
+                assert float(follower.compute(key)) == float(primary.compute(key))
+        finally:
+            primary.close(checkpoint=False)
+            follower.close()
+
+    def test_follower_tracks_continued_traffic(self, tmp_path):
+        link = LoopbackLink()
+        primary, follower = _primary(tmp_path, link), _follower(link)
+        try:
+            for seed in (1, 2, 3):
+                _feed(primary, seed=seed, n=40)
+                assert follower._applier.await_seq(primary._wal_seq, timeout_s=15)
+                _assert_states_equal(primary, follower)
+        finally:
+            primary.close(checkpoint=False)
+            follower.close()
+
+    def test_rejoining_follower_bootstraps_from_fresh_snapshot(self, tmp_path):
+        link = LoopbackLink()
+        primary = _primary(tmp_path, link)
+        first = _follower(link)
+        try:
+            _feed(primary, seed=4, n=60)
+            assert first._applier.await_seq(primary._wal_seq, timeout_s=15)
+            first.close()  # follower dies
+            _feed(primary, seed=5, n=60)  # traffic continues while it is gone
+            primary.checkpoint_now()
+            rejoined = _follower(link)
+            try:
+                # the rejoiner sees a mid-stream tail, detects the gap, and
+                # requests a snapshot over the backchannel
+                _feed(primary, seed=6, n=30)
+                assert rejoined._applier.await_seq(primary._wal_seq, timeout_s=15)
+                _assert_states_equal(primary, rejoined)
+            finally:
+                rejoined.close()
+        finally:
+            primary.close(checkpoint=False)
+            first.close()
+
+    def test_unbootstrapped_follower_requests_snapshot(self):
+        # a replacement follower attaching after the shipper's attach-time
+        # snapshot was consumed (by a dead predecessor) must actively ask for
+        # one over the backchannel — waiting passively for the next checkpoint
+        # generation strands it unbootstrapped if the primary's checkpointer
+        # is failing or on a long interval
+        link = LoopbackLink()
+        follower = _follower(link)
+        try:
+            requested = False
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                if link.take_snapshot_request():
+                    requested = True
+                    break
+                time.sleep(0.01)
+            assert requested, "unbootstrapped follower never requested a snapshot"
+        finally:
+            follower.close()
+
+    def test_reset_and_rotation_replicate_and_recover(self, tmp_path):
+        # reset()/rotate_window() are state transitions like any other: they
+        # ride the WAL (b"Z"/b"W" records), so followers AND crash recovery
+        # replay them at the right point instead of silently diverging
+        link = LoopbackLink()
+        primary = StreamingEngine(
+            BinaryAccuracy(), buckets=(8, 32), window=2,
+            checkpoint=CheckpointConfig(directory=str(tmp_path / "p"), interval_s=3600.0, durable=False),
+            replication=ReplConfig(role="primary", transport=link, ship_interval_s=0.01,
+                                   heartbeat_interval_s=0.05),
+        )
+        follower = StreamingEngine(
+            BinaryAccuracy(), buckets=(8, 32), window=2,
+            replication=ReplConfig(role="follower", transport=link, poll_interval_s=0.01),
+        )
+        try:
+            _feed(primary, seed=30, n=30)
+            primary.rotate_window()
+            _feed(primary, seed=31, n=30)
+            primary.reset()
+            _feed(primary, seed=32, n=30)
+            assert follower._applier.await_seq(primary._wal_seq, timeout_s=15)
+            _assert_states_equal(primary, follower)
+            for key in primary._keyed.keys:
+                assert float(follower.compute(key, window=True)) == float(
+                    primary.compute(key, window=True)
+                )
+            # crash recovery replays the same transitions
+            final = {k: jax.device_get(primary._keyed.state_of(k)) for k in primary._keyed.keys}
+            primary.close(checkpoint=False)
+            recovered = StreamingEngine(
+                BinaryAccuracy(), buckets=(8, 32), window=2,
+                checkpoint=CheckpointConfig(directory=str(tmp_path / "p"), durable=False),
+                start=False,
+            )
+            try:
+                for key, want in final.items():
+                    jax.tree_util.tree_map(
+                        lambda x, y: np.testing.assert_array_equal(np.asarray(x), np.asarray(y)),
+                        jax.device_get(recovered._keyed.state_of(key)), want,
+                    )
+            finally:
+                recovered.close(checkpoint=False)
+        finally:
+            primary.close(checkpoint=False)
+            follower.close()
+
+    def test_fresh_bootstrap_keeps_heartbeat_known_seq(self):
+        # a heartbeat heard BEFORE the empty bootstrap must survive it: a
+        # just-attached replica with the WAL still in flight is behind, not
+        # caught up
+        from metrics_tpu.repl import SnapshotFrame
+
+        follower = _follower(LoopbackLink())
+        try:
+            applier = follower._applier
+            applier.stop()
+            now = time.time()
+            applier.apply_frames([HeartbeatFrame(0, 41, now)])
+            applier.apply_frames([SnapshotFrame(0, -1, -1, None, now)])
+            assert applier.bootstrapped
+            assert applier.lag().seqs_behind == 42
+        finally:
+            follower.close()
+
+    def test_convergence_under_periodic_send_failures(self, tmp_path):
+        # a send failure mid-tail must not lose the batch: the shipper's
+        # cursor only advances on DELIVERY, so failed batches retransmit and
+        # the follower still converges bit-identically (duplicates, if any,
+        # are dropped by its seq chain)
+        from metrics_tpu.repl import ReplTransportError
+        from metrics_tpu.repl.transport import FlakyLink
+
+        class EveryThirdSendFails(FlakyLink):
+            def __init__(self, inner):
+                super().__init__(inner, fail=0)
+                self._n = 0
+
+            def send(self, frames):
+                self._n += 1
+                if self._n % 3 == 0:
+                    self.failures_injected += 1
+                    raise ReplTransportError("injected periodic send failure")
+                self._inner.send(frames)
+
+        link = LoopbackLink()
+        faulted = EveryThirdSendFails(link)
+        primary = StreamingEngine(
+            BinaryAccuracy(),
+            buckets=(8, 32),
+            checkpoint=CheckpointConfig(directory=str(tmp_path / "p"), interval_s=3600.0, durable=False),
+            replication=ReplConfig(role="primary", transport=faulted,
+                                   ship_interval_s=0.01, heartbeat_interval_s=0.05),
+        )
+        follower = _follower(link)
+        try:
+            for seed in (11, 12, 13):
+                _feed(primary, seed=seed, n=40)
+                assert follower._applier.await_seq(primary._wal_seq, timeout_s=20)
+                _assert_states_equal(primary, follower)
+            assert faulted.failures_injected > 0  # the fault actually fired
+        finally:
+            primary.close(checkpoint=False)
+            follower.close()
+
+    def test_rotation_before_first_tail_ship_rescues_via_bootstrap_snapshot(self, tmp_path):
+        # regression: follower bootstraps from the empty frame, then a
+        # checkpoint commits and rotation GC's the whole WAL BEFORE the
+        # shipper ever read the tail. Two bugs composed into a permanent
+        # deadlock here: (a) the routine new-generation ship advanced
+        # last_shipped_seq to the snapshot's seq, stranding every record
+        # under it unshipped; (b) the follower dropped the shipper's
+        # re-bootstrap snapshot because its (empty) seq chain looked intact,
+        # waiting forever for records that had been rotated away unshipped.
+        link = LoopbackLink()
+        primary = StreamingEngine(
+            BinaryAccuracy(),
+            buckets=(8, 32),
+            checkpoint=CheckpointConfig(directory=str(tmp_path / "p"), interval_s=3600.0, durable=False),
+            replication=ReplConfig(
+                role="primary", transport=link, ship_interval_s=3600.0,
+                heartbeat_interval_s=3600.0,
+            ),
+        )
+        follower = _follower(link)
+        try:
+            primary._shipper.tick()  # empty bootstrap: no snapshot, journal starts at 0
+            deadline = time.monotonic() + 10.0
+            while not follower._applier.bootstrapped and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert follower._applier.bootstrapped
+            assert follower._applier.applied_seq == -1
+            _feed(primary, seed=40, n=30)
+            primary.checkpoint_now()  # covers the whole journal; rotation GC's it
+            primary._shipper.tick()  # new generation (backchannel link: routine
+            # ship suppressed) — either way the tail must NOT advance
+            assert primary._shipper.last_shipped_seq == -1
+            _feed(primary, seed=41, n=30)  # new records land past the GC'd range
+            primary._shipper.tick()  # tail discontinuity detected → re-bootstrap
+            primary._shipper.tick()  # bootstrap snapshot + tail from its seq
+            assert follower._applier.await_seq(primary._wal_seq, timeout_s=15)
+            _assert_states_equal(primary, follower)
+        finally:
+            primary.close(checkpoint=False)
+            follower.close()
+
+    def test_wal_loss_parks_shipper_instead_of_heartbeating_frozen_seq(self, tmp_path):
+        # regression: after an IO failure disables the engine's WAL, the
+        # shipper kept heartbeating the dead journal's frozen last_seq — a
+        # follower would report itself FRESH while the still-writing primary
+        # diverged unbounded. The shipper must go silent (staleness grows,
+        # bounded reads refuse: the conservative contract).
+        link = LoopbackLink()
+        primary = _primary(tmp_path, link)  # no follower: we own link.recv
+        try:
+            _feed(primary, seed=50, n=20)
+            deadline = time.monotonic() + 15.0
+            while time.monotonic() < deadline and primary._shipper.last_shipped_seq < primary._wal_seq:
+                time.sleep(0.01)
+            # break the WAL: the next journaled batch disables it
+            def _boom(payloads):
+                raise OSError("disk full")
+
+            primary._journal.append_many = _boom
+            primary.submit("t0", jnp.asarray([1]), jnp.asarray([1])).result(timeout=10)
+            primary.flush()
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline and not primary._shipper.journal_lost:
+                time.sleep(0.02)
+            assert primary._shipper.journal_lost
+            assert primary._journal is None  # engine disabled the WAL
+            assert primary.telemetry_snapshot()["ship_journal_lost"] == 1
+            link.recv()  # drain anything shipped before the loss
+            time.sleep(0.2)  # several heartbeat intervals
+            assert link.pending == 0, "parked shipper must not publish anything"
+        finally:
+            primary.close(checkpoint=False)
+
+    def test_bad_frame_does_not_discard_rest_of_batch(self):
+        # regression: recv is destructive — an exception mid-apply_frames
+        # (e.g. a snapshot that CRC-verified on the shipper but fails decode
+        # here) unwound the loop and silently dropped every frame behind it
+        from metrics_tpu.repl import SnapshotFrame
+
+        follower = _follower(LoopbackLink())
+        try:
+            applier = follower._applier
+            applier.stop()
+            now = time.time()
+            bad = SnapshotFrame(0, 0, 3, b"not a snapshot container", now)
+            applier.apply_frames([bad, HeartbeatFrame(0, 9, now)])
+            assert applier.known_seq == 9  # the frame BEHIND the bad one landed
+            assert not applier.bootstrapped
+            assert applier.last_error is not None
+            assert follower.telemetry_snapshot()["apply_failures"] == 1
+        finally:
+            follower.close()
+
+    def test_empty_bootstrap_without_any_snapshot(self, tmp_path):
+        # a brand-new primary with no committed generation yet: the follower
+        # starts from fresh init state at seq -1 and replays from 0
+        link = LoopbackLink()
+        primary = StreamingEngine(
+            BinaryAccuracy(),
+            buckets=(8,),
+            checkpoint=CheckpointConfig(directory=str(tmp_path / "p"), interval_s=3600.0, durable=False),
+            replication=ReplConfig(role="primary", transport=link, ship_interval_s=0.01),
+        )
+        follower = _follower(link)
+        try:
+            _feed(primary, seed=7, n=30)
+            assert follower._applier.await_seq(primary._wal_seq, timeout_s=15)
+            _assert_states_equal(primary, follower)
+        finally:
+            primary.close(checkpoint=False)
+            follower.close()
+
+
+    def test_same_lineage_rewind_snapshot_keeps_known_seq(self):
+        # regression: a gap healed by a snapshot OLDER than the applied
+        # position (checkpoints lag the WAL tail, so a requested re-bootstrap
+        # routinely lands behind the follower) was misread as a lineage
+        # restart — wiping known_seq reported the replica caught up while the
+        # records between the snapshot and the primary's real position were
+        # still in flight, so bounded reads served exactly the staleness they
+        # were configured to refuse
+        from metrics_tpu.repl import SnapshotFrame
+
+        follower = _follower(LoopbackLink())
+        try:
+            applier = follower._applier
+            applier.stop()
+            now = time.time()
+            applier.apply_frames([SnapshotFrame(0, -1, -1, None, now)])
+            applier.applied_seq = 1000  # replayed deep into the lineage
+            applier.apply_frames([HeartbeatFrame(0, 1005, now)])
+            applier._gap = True  # records 1001-1005 lost on the link
+            # same-epoch re-bootstrap lands BEHIND us: a rewind, not a restart
+            applier.apply_frames([SnapshotFrame(0, 3, 950, None, now + 1)])
+            assert applier.applied_seq == 950
+            assert not applier._gap
+            assert applier.known_seq == 1005  # primary's position survives
+            lag = applier.lag()
+            assert lag.seqs_behind == 55
+            assert lag.seconds_behind == float("inf")  # never false-fresh
+        finally:
+            follower.close()
+
+    def test_epoch_bump_snapshot_resets_seq_accounting(self):
+        # the lineage-restart signal is the EPOCH BUMP: a replacement
+        # primary's fresh seq numbering makes the old lineage's known
+        # position meaningless, so its snapshot resets the accounting that a
+        # same-epoch rewind (above) must preserve
+        from metrics_tpu.repl import SnapshotFrame
+
+        follower = _follower(LoopbackLink())
+        try:
+            applier = follower._applier
+            applier.stop()
+            now = time.time()
+            applier.apply_frames([SnapshotFrame(0, -1, -1, None, now)])
+            applier.applied_seq = 1000
+            applier.apply_frames([HeartbeatFrame(0, 1005, now)])
+            applier.apply_frames([SnapshotFrame(1, -1, 40, None, now + 1)])
+            assert applier.epoch == 1
+            assert applier.applied_seq == 40
+            assert applier.known_seq == 40  # old lineage's 1005 is meaningless
+            assert not applier._gap
+        finally:
+            follower.close()
+
+    def test_fresh_attach_to_higher_epoch_primary_keeps_heartbeat_known_seq(self):
+        # regression: a replacement follower (default epoch 0) attaching to a
+        # long-running primary whose epoch advanced past 0 treated the benign
+        # epoch difference as a lineage restart — its first bootstrap snapshot
+        # wiped the heartbeat-learned known position and stamped itself caught
+        # up, serving bounded reads beyond their configured staleness until
+        # the next frame corrected it. Positions are tracked per LINEAGE now:
+        # a snapshot of the same lineage the heartbeats came from keeps them.
+        from metrics_tpu.repl import SnapshotFrame
+
+        follower = _follower(LoopbackLink())
+        try:
+            applier = follower._applier
+            applier.stop()
+            now = time.time()
+            applier.apply_frames([HeartbeatFrame(5, 10050, now)])  # learned tip
+            applier.apply_frames([SnapshotFrame(5, 7, 10000, None, now, bootstrap=True)])
+            assert applier.bootstrapped
+            assert applier.known_seq == 10050  # the learned tip survives
+            lag = applier.lag()
+            assert lag.seqs_behind == 50
+            assert lag.seconds_behind == float("inf")  # never stamped fresh
+        finally:
+            follower.close()
+
+    def test_gapped_replica_reports_unbounded_staleness(self):
+        # while the chain is broken, applied and known may be positions in two
+        # different lineages (old applied 10000 vs a replacement's tip 40) —
+        # neither axis can prove a bound, and a cross-lineage heartbeat must
+        # not stamp the broken replica fresh
+        from metrics_tpu.repl import SnapshotFrame
+
+        follower = _follower(LoopbackLink())
+        try:
+            applier = follower._applier
+            applier.stop()
+            now = time.time()
+            applier.apply_frames([SnapshotFrame(0, -1, -1, None, now)])
+            applier.applied_seq = 10000
+            applier.apply_frames([HeartbeatFrame(1, 40, now)])  # new lineage: gap
+            assert applier._gap
+            assert applier.lag().seconds_behind == float("inf")
+        finally:
+            follower.close()
+
+    def test_routine_generation_ship_retries_after_send_failure(self, tmp_path):
+        # regression: _seen_generation was marked before the send, so a
+        # routine new-generation snapshot lost to a transport blip was never
+        # re-shipped until the NEXT checkpoint generation committed — on a
+        # backchannel-less link that ship is the only thing that can un-park
+        # a gapped follower
+        from metrics_tpu.repl import ReplTransportError, SnapshotFrame
+        from metrics_tpu.repl.transport import FlakyLink
+
+        link = LoopbackLink()
+
+        class FailArmedSnapshotSend(FlakyLink):
+            has_backchannel = False  # routine ships only exist on such links
+
+            def __init__(self, inner):
+                super().__init__(inner, fail=0)
+                self.arm = False
+
+            def send(self, frames):
+                if self.arm and any(isinstance(f, SnapshotFrame) for f in frames):
+                    self.arm = False
+                    self.failures_injected += 1
+                    raise ReplTransportError("injected snapshot send failure")
+                self._inner.send(frames)
+
+        faulted = FailArmedSnapshotSend(link)
+        primary = StreamingEngine(
+            BinaryAccuracy(),
+            buckets=(8, 32),
+            checkpoint=CheckpointConfig(directory=str(tmp_path / "p"), interval_s=3600.0, durable=False),
+            replication=ReplConfig(
+                role="primary", transport=faulted, ship_interval_s=3600.0,
+                heartbeat_interval_s=3600.0,
+            ),
+        )
+        try:
+            shipper = primary._shipper  # thread parked on the 3600s interval
+            shipper.tick()  # attach-time empty bootstrap
+            _feed(primary, seed=60, n=10)
+            primary.checkpoint_now()
+            faulted.arm = True
+            with pytest.raises(ReplTransportError):
+                shipper.tick()  # new generation: the ship is lost in flight
+            assert faulted.failures_injected == 1
+            shipper.tick()  # next tick must RETRY the same generation
+            gens = primary._ckpt_store.generations()
+            assert shipper.shipped_generation == gens[-1]
+            snaps = [f for f in link.recv() if isinstance(f, SnapshotFrame)]
+            assert any(f.generation == gens[-1] for f in snaps)
+        finally:
+            primary.close(checkpoint=False)
+
+    def test_stopped_shipper_abandons_catch_up_between_batches(self, tmp_path):
+        # close() must be able to interrupt a deep WAL catch-up: the batch
+        # loop checks the stop event, so a stopping shipper never reads (or
+        # publishes) another batch into a transport being torn down
+        link = LoopbackLink()
+        primary = StreamingEngine(
+            BinaryAccuracy(),
+            buckets=(8, 32),
+            checkpoint=CheckpointConfig(directory=str(tmp_path / "p"), interval_s=3600.0, durable=False),
+            replication=ReplConfig(
+                role="primary", transport=link, ship_interval_s=3600.0,
+                heartbeat_interval_s=3600.0,
+            ),
+        )
+        try:
+            shipper = primary._shipper
+            shipper.tick()  # bootstrap: _need_snapshot consumed
+            _feed(primary, seed=61, n=20)
+            shipper._stop.set()
+            before = shipper.last_shipped_seq
+            shipper._ship_tail(time.time())
+            assert shipper.last_shipped_seq == before  # not one more batch
+        finally:
+            primary.close(checkpoint=False)
+
+    def test_backchannel_less_gap_heals_via_rewound_routine_ship(self, tmp_path):
+        # regression: on a socket-style link (no backchannel) a WAL batch lost
+        # in flight gap-parked the follower FOREVER under continuous traffic —
+        # the routine new-generation snapshot restored it to the checkpoint
+        # position, but the tail stayed at the live tip, so the records in
+        # between (consumed-and-dropped while gapped) never re-arrived and the
+        # very next frame re-gapped it. The heal is the tail REWIND under the
+        # routine ship: everything above the snapshot re-ships behind it.
+        from metrics_tpu.repl import WalFrame
+
+        class LossySocketLikeLink(LoopbackLink):
+            has_backchannel = False
+            drop_next_wal = False
+
+            def send(self, frames):
+                if self.drop_next_wal and any(isinstance(f, WalFrame) for f in frames):
+                    self.drop_next_wal = False
+                    return  # sendall returned; the connection died in flight
+                super().send(frames)
+
+        link = LossySocketLikeLink()
+        primary = StreamingEngine(
+            BinaryAccuracy(),
+            buckets=(8, 32),
+            checkpoint=CheckpointConfig(directory=str(tmp_path / "p"), interval_s=3600.0, durable=False),
+            replication=ReplConfig(
+                role="primary", transport=link, ship_interval_s=3600.0,
+                heartbeat_interval_s=3600.0,
+            ),
+        )
+        follower = _follower(link)
+        try:
+            shipper = primary._shipper
+            shipper.tick()  # empty bootstrap
+            _feed(primary, seed=70, n=15)
+            shipper.tick()  # delivered
+            assert follower._applier.await_seq(shipper.last_shipped_seq, timeout_s=15)
+            link.drop_next_wal = True
+            _feed(primary, seed=71, n=15)
+            shipper.tick()  # lost in flight: last_shipped advanced, follower didn't
+            _feed(primary, seed=72, n=15)
+            shipper.tick()  # delivered past the hole → the follower gaps
+            deadline = time.monotonic() + 10.0
+            while not follower._applier._gap and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert follower._applier._gap
+            # the checkpoint commits BEHIND the already-shipped tip (async
+            # snapshots race live traffic), so the routine ship must rewind
+            primary.checkpoint_now()
+            covered = primary._wal_seq
+            _feed(primary, seed=73, n=10)
+            shipper.tick()  # ships the remaining tail to the (gapped) follower
+            tip_before = shipper.last_shipped_seq
+            shipper._seen_generation = None  # surface the generation to this tick
+            shipper.tick()  # routine ship: snapshot + tail REWOUND under it
+            assert shipper.last_shipped_seq >= tip_before  # re-shipped through the tip
+            assert follower._applier.await_seq(primary._wal_seq, timeout_s=15)
+            assert not follower._applier._gap
+            assert follower._applier.applied_seq > covered
+            _assert_states_equal(primary, follower)
+        finally:
+            primary.close(checkpoint=False)
+            follower.close()
+
+    def test_routine_ships_suppressed_on_backchannel_links(self, tmp_path):
+        # a caught-up follower on a backchannel link DROPS routine snapshots,
+        # so shipping the full state every checkpoint interval was pure
+        # transport churn — on such links the follower asks when it needs one,
+        # and the routine ship is suppressed entirely
+        from metrics_tpu.repl import SnapshotFrame, WalFrame
+
+        link = LoopbackLink()
+        primary = StreamingEngine(
+            BinaryAccuracy(),
+            buckets=(8, 32),
+            checkpoint=CheckpointConfig(directory=str(tmp_path / "p"), interval_s=3600.0, durable=False),
+            replication=ReplConfig(
+                role="primary", transport=link, ship_interval_s=3600.0,
+                heartbeat_interval_s=3600.0,
+            ),
+        )
+        try:
+            shipper = primary._shipper
+            shipper.tick()  # attach-time bootstrap still ships
+            assert any(isinstance(f, SnapshotFrame) for f in link.recv())
+            _feed(primary, seed=74, n=10)
+            shipper.tick()  # tail shipped BEFORE the checkpoint rotates it away
+            link.recv()
+            primary.checkpoint_now()
+            _feed(primary, seed=75, n=10)
+            shipper.tick()
+            frames = link.recv()
+            assert not any(isinstance(f, SnapshotFrame) for f in frames)  # churn gone
+            assert any(isinstance(f, WalFrame) for f in frames)  # the tail still flows
+            # ... but an explicit follower request still gets one
+            link.request_snapshot()
+            shipper.tick()
+            assert any(isinstance(f, SnapshotFrame) for f in link.recv())
+        finally:
+            primary.close(checkpoint=False)
+
+    def test_snapshot_wal_history_hole_parks_bootstrap(self, tmp_path):
+        # the engine's own rotation can't create this (covered_seq is the MIN
+        # over retained generations, and unreadable meta blocks rotation), but
+        # external history loss can: the best VALID snapshot plus the retained
+        # WAL no longer form a chain. Shipping it anyway livelocks — the
+        # follower restores, gaps on the very next record, re-requests, and
+        # the pair exchanges the full state every tick without ever passing
+        # the hole. The shipper must PARK until a new generation commits.
+        import os as _os
+
+        from metrics_tpu.repl import SnapshotFrame
+
+        link = LoopbackLink()
+        primary = StreamingEngine(
+            BinaryAccuracy(),
+            buckets=(8, 32),
+            checkpoint=CheckpointConfig(directory=str(tmp_path / "p"), interval_s=3600.0, durable=False),
+            replication=ReplConfig(
+                role="primary", transport=link, ship_interval_s=3600.0,
+                heartbeat_interval_s=3600.0,
+            ),
+        )
+        try:
+            shipper = primary._shipper
+            for seed in (85, 86, 87):
+                _feed(primary, seed=seed, n=10)
+                primary.checkpoint_now()
+            _feed(primary, seed=88, n=10)  # live tail beyond the newest gen
+            gens = primary._ckpt_store.generations()
+            for g in gens[1:]:  # tear every generation newer than the oldest
+                path = primary._ckpt_store.path(g)
+                blob = open(path, "rb").read()
+                with open(path, "wb") as fh:
+                    fh.write(blob[: len(blob) // 2])
+            # external loss: the segment the oldest snapshot chains into dies
+            _os.remove(primary._journal._segments()[0][1])
+            shipper.tick()  # bootstrap attempt: valid gen + retained WAL = hole
+            assert not any(isinstance(f, SnapshotFrame) for f in link.recv())
+            holes = primary.telemetry_snapshot()["ship_history_holes"]
+            assert holes >= 1
+            shipper.tick()
+            shipper.tick()  # parked: no re-scan, no re-ship, no counter churn
+            assert primary.telemetry_snapshot()["ship_history_holes"] == holes
+            healed = primary.checkpoint_now()  # a fresh valid generation heals
+            shipper.tick()
+            snaps = [f for f in link.recv() if isinstance(f, SnapshotFrame)]
+            assert any(f.generation == healed for f in snaps)
+        finally:
+            primary.close(checkpoint=False)
+
+    def test_dead_link_surfaces_in_follower_health(self, tmp_path):
+        # regression: a follower whose ship link died kept reporting SERVING —
+        # the applier remembered the recv error in last_error but nothing
+        # surfaced it, so an unbounded-staleness replica served ever-staler
+        # reads with nominal health
+        import shutil
+
+        from metrics_tpu.repl import DirectoryTransport
+
+        spool = tmp_path / "spool"
+        follower = _follower(DirectoryTransport(str(spool), durable=False))
+        try:
+            assert follower.health()["state"] == "SERVING"
+            shutil.rmtree(spool)
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                health = follower.health()
+                if health["state"] == "DEGRADED" and health["replication"]["apply_error"]:
+                    break
+                time.sleep(0.02)
+            assert health["state"] == "DEGRADED"
+            assert "Error" in health["replication"]["apply_error"]
+            spool.mkdir()  # the link heals — and a clean batch that mends the
+            # chain (this follower never bootstrapped) clears the error; an
+            # empty idle poll must not
+            from metrics_tpu.repl import SnapshotFrame
+
+            DirectoryTransport(str(spool), durable=False).send(
+                [SnapshotFrame(0, -1, -1, None, time.time())]
+            )
+            deadline = time.monotonic() + 10.0
+            while follower.health()["state"] != "SERVING" and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert follower.health()["state"] == "SERVING"
+            assert follower.health()["replication"]["apply_error"] is None
+        finally:
+            follower.close()
+
+    def test_persistent_apply_failure_stays_visible_across_idle_polls(self):
+        # regression: the applier cleared last_error on every recv return —
+        # including empty idle polls — so a persistent frame failure (every
+        # shipped snapshot failing to decode, say) was wiped one poll interval
+        # after being recorded and the stuck replica reported nominal health;
+        # only a NON-EMPTY batch applying cleanly may heal the record
+        from metrics_tpu.repl import SnapshotFrame
+
+        link = LoopbackLink()
+        follower = _follower(link)
+        try:
+            link.send([SnapshotFrame(0, 0, 3, b"not a snapshot container", time.time())])
+            deadline = time.monotonic() + 10.0
+            while follower._applier.last_error is None and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert follower._applier.last_error is not None
+            time.sleep(0.1)  # ~10 idle polls at the 0.01s interval
+            assert follower._applier.last_error is not None  # idle must not heal
+            assert follower.health()["state"] == "DEGRADED"
+            # heartbeats are clean batches but must NOT clear while the chain
+            # is broken: a snapshot failing decode every checkpoint interval
+            # would otherwise read SERVING between failures
+            link.send([HeartbeatFrame(0, -1, time.time())])
+            time.sleep(0.1)
+            assert follower._applier.last_error is not None
+            assert follower.health()["state"] == "DEGRADED"
+            # only the snapshot that mends the chain lets a clean batch heal
+            link.send([SnapshotFrame(0, -1, -1, None, time.time())])
+            deadline = time.monotonic() + 10.0
+            while follower._applier.last_error is not None and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert follower._applier.last_error is None
+            assert follower.health()["state"] == "SERVING"
+        finally:
+            follower.close()
+
+    def test_promoted_node_not_degraded_by_dead_lineage_apply_error(self):
+        # regression: promote() parks the applier with whatever its last poll
+        # recorded (a frame torn by the dying primary, typically) frozen in
+        # last_error; health() folded that into the promoted primary's state,
+        # reporting the healthy new writer DEGRADED forever
+        from metrics_tpu.repl import SnapshotFrame
+
+        follower = _follower(LoopbackLink())
+        try:
+            applier = follower._applier
+            applier.stop()
+            applier.apply_frames([SnapshotFrame(0, -1, -1, None, time.time())])
+            applier.last_error = RuntimeError("frame torn by the dying primary")
+            with pytest.warns(RuntimeWarning):
+                follower.promote()
+            health = follower.health()
+            assert health["state"] == "SERVING"
+            # the record itself stays visible for post-mortems
+            assert "RuntimeError" in health["replication"]["apply_error"]
+        finally:
+            follower.close()
+
+    def test_graceful_close_ships_the_final_tail(self, tmp_path):
+        # regression: close() set the stop event and joined — the ship loop
+        # exited without a last tick, so records acked since the previous
+        # tick (up to a full ship interval's worth) plus the close-time
+        # snapshot never reached the follower despite an orderly shutdown;
+        # a follower promoted after the handoff was missing acked writes
+        link = LoopbackLink()
+        primary = StreamingEngine(
+            BinaryAccuracy(),
+            buckets=(8, 32),
+            checkpoint=CheckpointConfig(directory=str(tmp_path / "p"), interval_s=3600.0, durable=False),
+            replication=ReplConfig(
+                role="primary", transport=link, ship_interval_s=3600.0,
+                heartbeat_interval_s=3600.0,  # NOTHING ships until close()'s final tick
+            ),
+        )
+        follower = _follower(link)
+        try:
+            _feed(primary, seed=80, n=25)
+            final_seq = primary._wal_seq
+            primary.close()  # graceful: final checkpoint, then the final publish
+            assert follower._applier.await_seq(final_seq, timeout_s=15)
+            _assert_states_equal(primary, follower)
+        finally:
+            primary.close()
+            follower.close()
+
+    def test_restarted_primary_bumps_epoch_so_followers_rebootstrap(self, tmp_path):
+        # regression: a crash-recovered primary RE-USES WAL seqs its dead
+        # incarnation may already have shipped (a non-fsynced tail lost to
+        # power loss recovers behind records the shipper read from the page
+        # cache and published) — within one epoch the follower drops the
+        # re-used seqs as duplicates and silently diverges while reporting
+        # caught-up. Every resume therefore starts a new lineage epoch and
+        # followers re-bootstrap from the restart snapshot.
+        link = LoopbackLink()
+        first = _primary(tmp_path, link)
+        follower = _follower(link)
+        try:
+            _feed(first, seed=90, n=30)
+            assert follower._applier.await_seq(first._wal_seq, timeout_s=15)
+            first.close(checkpoint=False)  # the WAL tail carries the rest
+            restarted = _primary(tmp_path, link)  # same directory: resumed lineage
+            try:
+                assert restarted._repl_epoch == 1  # bumped past the dead incarnation
+                _feed(restarted, seed=91, n=20)
+                deadline = time.monotonic() + 20.0
+                while time.monotonic() < deadline:
+                    if (
+                        follower._applier.epoch == 1
+                        and follower._applier.applied_seq == restarted._wal_seq
+                        and not follower._applier._gap
+                    ):
+                        break
+                    time.sleep(0.02)
+                _assert_states_equal(restarted, follower)
+            finally:
+                restarted.close(checkpoint=False)
+        finally:
+            follower.close()
+
+
+class TestReadContract:
+    def test_follower_refuses_writes(self, tmp_path):
+        link = LoopbackLink()
+        follower = _follower(link)
+        try:
+            with pytest.raises(NotPrimaryError):
+                follower.submit("t", jnp.asarray([1]), jnp.asarray([1]))
+            with pytest.raises(NotPrimaryError):
+                follower.reset()
+        finally:
+            follower.close()
+
+    def test_reads_tagged_with_replica_lag(self, tmp_path):
+        link = LoopbackLink()
+        primary, follower = _primary(tmp_path, link), _follower(link)
+        try:
+            _feed(primary, seed=8, n=20)
+            assert follower._applier.await_seq(primary._wal_seq, timeout_s=15)
+            lag = follower.replica_lag()
+            assert isinstance(lag, ReplicaLag)
+            assert lag.seqs_behind == 0
+            assert lag.seconds_behind < 30.0
+            health = follower.health()["replication"]
+            assert health["role"] == "follower" and health["bootstrapped"]
+            assert health["lag_seqs"] == 0
+            # the primary reports its side too
+            assert primary.health()["replication"]["role"] == "primary"
+        finally:
+            primary.close(checkpoint=False)
+            follower.close()
+
+    def test_unbootstrapped_replica_refuses_bounded_reads(self):
+        follower = _follower(LoopbackLink(), max_staleness_s=1.0)
+        try:
+            with pytest.raises(StalenessExceeded):
+                follower.compute("t")
+            assert follower.telemetry_snapshot()["stale_read_refusals"] == 1
+        finally:
+            follower.close()
+
+    def test_read_refused_beyond_max_staleness_seconds(self, tmp_path):
+        link = LoopbackLink()
+        primary = _primary(tmp_path, link)
+        follower = _follower(link, max_staleness_s=0.2)
+        try:
+            _feed(primary, seed=9, n=20)
+            assert follower._applier.await_seq(primary._wal_seq, timeout_s=15)
+            follower.compute("t0")  # fresh: served
+            # silence the link: stop the primary's shipper → seconds_behind grows
+            primary._shipper.close()
+            time.sleep(0.4)
+            with pytest.raises(StalenessExceeded):
+                follower.compute("t0")
+        finally:
+            primary.close(checkpoint=False)
+            follower.close()
+
+    def test_read_refused_beyond_max_staleness_seqs(self, tmp_path):
+        follower = _follower(LoopbackLink(), max_staleness_seqs=2)
+        try:
+            applier = follower._applier
+            applier.stop()  # drive frames by hand
+            from metrics_tpu.repl import SnapshotFrame, WalFrame
+
+            applier.apply_frames([SnapshotFrame(0, -1, -1, None, time.time())])
+            # a heartbeat reveals the primary is 5 records ahead of our applied state
+            applier.apply_frames([HeartbeatFrame(0, 4, time.time())])
+            assert follower.replica_lag().seqs_behind == 5
+            with pytest.raises(StalenessExceeded):
+                follower.compute("t0")
+        finally:
+            follower.close()
+
+    def test_seconds_behind_stays_unbounded_while_chewing_backlog(self):
+        # applying backlog records must NOT refresh freshness: a replica that
+        # knows it is far behind serves old data however recently it applied
+        from metrics_tpu.repl import SnapshotFrame, WalFrame
+
+        follower = _follower(LoopbackLink())
+        try:
+            applier = follower._applier
+            applier.stop()
+            now = time.time()
+            applier.apply_frames([SnapshotFrame(0, -1, -1, None, now)])
+            applier.apply_frames([HeartbeatFrame(0, 100, now)])  # primary is at 100
+            # one eager 'R' record applied — still 99 behind
+            import pickle as _pickle
+            import struct as _struct
+
+            key_bytes = _pickle.dumps("t")
+            payload = b"R" + _struct.pack("<I", len(key_bytes)) + key_bytes + bytes((0,))
+            applier.apply_frames([WalFrame(0, 0, payload, now)])
+            assert applier.applied_seq == 0
+            lag = applier.lag()
+            assert lag.seqs_behind == 100
+            assert lag.seconds_behind == float("inf")  # never caught up yet
+        finally:
+            follower.close()
+
+    def test_replacement_primary_with_bumped_epoch_rebootstraps_follower(self, tmp_path):
+        # primary dies and is REPLACED on a fresh directory (seq numbering
+        # restarts): the bumped epoch tells the follower to re-bootstrap
+        # instead of dropping the new lineage's records as duplicates
+        link = LoopbackLink()
+        first = _primary(tmp_path, link)
+        follower = _follower(link)
+        try:
+            _feed(first, seed=20, n=60)
+            assert follower._applier.await_seq(first._wal_seq, timeout_s=15)
+            first.close(checkpoint=False)
+            replacement = StreamingEngine(
+                BinaryAccuracy(),
+                buckets=(8, 32),
+                checkpoint=CheckpointConfig(
+                    directory=str(tmp_path / "replacement"), interval_s=0.05, durable=False
+                ),
+                replication=ReplConfig(
+                    role="primary", transport=link, ship_interval_s=0.01,
+                    heartbeat_interval_s=0.05, epoch=1,
+                ),
+            )
+            try:
+                _feed(replacement, seed=21, n=40)
+                deadline = time.monotonic() + 20.0
+                while time.monotonic() < deadline:
+                    if (
+                        follower._applier.epoch == 1
+                        and follower._applier.applied_seq == replacement._wal_seq
+                        and not follower._applier._gap
+                    ):
+                        break
+                    time.sleep(0.02)
+                _assert_states_equal(replacement, follower)  # old mirror fully replaced
+            finally:
+                replacement.close(checkpoint=False)
+        finally:
+            follower.close()
+
+    def test_unbounded_staleness_always_serves(self, tmp_path):
+        link = LoopbackLink()
+        primary = _primary(tmp_path, link)
+        follower = _follower(link)  # no max_staleness: tag, never refuse
+        try:
+            _feed(primary, seed=10, n=20)
+            assert follower._applier.await_seq(primary._wal_seq, timeout_s=15)
+            primary._shipper.close()
+            time.sleep(0.2)
+            follower.compute("t0")  # stale but served
+        finally:
+            primary.close(checkpoint=False)
+            follower.close()
+
+
+class TestConfigValidation:
+    def test_follower_with_checkpoint_refused(self, tmp_path):
+        from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+        with pytest.raises(MetricsTPUUserError, match="promote_checkpoint"):
+            StreamingEngine(
+                BinaryAccuracy(),
+                checkpoint=CheckpointConfig(directory=str(tmp_path)),
+                replication=ReplConfig(role="follower", transport=LoopbackLink()),
+            )
+
+    def test_primary_without_wal_refused(self, tmp_path):
+        from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+        with pytest.raises(MetricsTPUUserError, match="wal"):
+            StreamingEngine(
+                BinaryAccuracy(),
+                checkpoint=CheckpointConfig(directory=str(tmp_path), wal=False),
+                replication=ReplConfig(role="primary", transport=LoopbackLink()),
+            )
+
+    def test_primary_without_checkpoint_refused(self):
+        from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+        with pytest.raises(MetricsTPUUserError, match="checkpoint"):
+            StreamingEngine(
+                BinaryAccuracy(),
+                replication=ReplConfig(role="primary", transport=LoopbackLink()),
+            )
+
+    def test_degenerate_intervals_refused(self):
+        # heartbeat_interval_s=0 would emit a heartbeat frame EVERY tick
+        # (an atomic spool write 20×/s at defaults) — same guard its sibling
+        # interval fields already had
+        with pytest.raises(ValueError, match="heartbeat_interval_s"):
+            ReplConfig(role="follower", transport=LoopbackLink(), heartbeat_interval_s=0)
+        with pytest.raises(ValueError, match="drain_timeout_s"):
+            ReplConfig(role="follower", transport=LoopbackLink(), drain_timeout_s=-1.0)
+
+    def test_bad_role_refused(self):
+        with pytest.raises(ValueError, match="role"):
+            ReplConfig(role="leader", transport=LoopbackLink())

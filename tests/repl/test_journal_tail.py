@@ -1,0 +1,283 @@
+"""RequestJournal.read_from — the follower/shipper tail-follow contract.
+
+The recovery read path (``replay``) assumes an exclusive reopen and truncates
+torn tails; a tail-follower must do neither. These tests pin: no truncation
+ever, correct yields under a live (buffered, mid-append) writer, cross-segment
+continuity, rotation tolerance, and — the satellite's property test — random
+interleavings of append/rotate/read where every read is a contiguous,
+content-exact run of the appended sequence.
+"""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from metrics_tpu.ckpt.store import RequestJournal
+
+
+@pytest.fixture
+def journal(tmp_path):
+    j = RequestJournal(str(tmp_path), durable=False)
+    yield j
+    j.close()
+
+
+def _payload(seq: int) -> bytes:
+    return f"record-{seq}".encode()
+
+
+class TestTailFollow:
+    def test_reads_from_cursor_across_segments(self, journal):
+        journal.append_many([_payload(i) for i in range(5)])
+        journal.rotate(covered_seq=-1)  # new segment, nothing dropped
+        journal.append_many([_payload(i) for i in range(5, 8)])
+        got = list(journal.read_from(2))
+        assert got == [(i, _payload(i)) for i in range(3, 8)]
+
+    def test_skips_fully_covered_segments_without_reading(self, journal):
+        journal.append_many([_payload(i) for i in range(4)])
+        journal.rotate(covered_seq=-1)
+        journal.append_many([_payload(i) for i in range(4, 6)])
+        assert [s for s, _ in journal.read_from(3)] == [4, 5]
+
+    def test_incremental_calls_resume_where_they_stopped(self, journal):
+        journal.append_many([_payload(0), _payload(1)])
+        cursor = -1
+        for seq, payload in journal.read_from(cursor):
+            assert payload == _payload(seq)
+            cursor = seq
+        assert cursor == 1
+        journal.append_many([_payload(2)])
+        assert list(journal.read_from(cursor)) == [(2, _payload(2))]
+
+    def test_one_cursor_read_never_spans_a_rotation_gap(self, journal):
+        # regression: the segment-hop inside cursor.read() could append
+        # post-gap records to the SAME returned batch (records[0] contiguous,
+        # jump mid-list) — a caller checking continuity only at records[0]
+        # (the shipper) would ship straight across the GC'd records
+        journal.append_many([_payload(i) for i in range(3)])
+        journal.rotate(covered_seq=-1)
+        journal.append_many([_payload(i) for i in range(3, 6)])
+        journal.rotate(covered_seq=-1)
+        journal.append_many([_payload(i) for i in range(6, 9)])
+        journal.flush()
+        cursor = journal.tail_cursor(-1)
+        os.remove(journal._segments()[1][1])  # GC the MIDDLE segment under it
+        seen = []
+        while True:
+            batch = cursor.read()
+            if not batch:
+                break
+            seqs = [s for s, _ in batch]
+            assert seqs == list(range(seqs[0], seqs[0] + len(seqs))), "gap inside one read()"
+            seen.extend(seqs)
+        assert seen == [0, 1, 2, 6, 7, 8]  # the jump lands BETWEEN reads
+
+    def test_live_writer_partial_tail_frame_ends_iteration_without_truncation(self, journal):
+        journal.append_many([_payload(0)])
+        journal.flush()
+        seg_path = journal._segments()[-1][1]
+        clean_size = os.path.getsize(seg_path)
+        # a writer mid-append: half a frame on disk after the intact record
+        with open(seg_path, "ab") as f:
+            f.write(b"\x40\x00\x00\x00\x12")
+        assert list(journal.read_from(-1)) == [(0, _payload(0))]
+        # the tail was NOT truncated — the primary's in-flight frame survives
+        assert os.path.getsize(seg_path) == clean_size + 5
+
+    def test_rotation_gap_is_visible_as_seq_jump(self, journal):
+        journal.append_many([_payload(i) for i in range(3)])
+        journal.rotate(covered_seq=2)  # drops the only segment
+        journal.append_many([_payload(i) for i in range(3, 5)])
+        got = list(journal.read_from(-1))
+        # records 0..2 are gone (snapshot-covered); the jump is the caller's
+        # re-bootstrap signal, never silently papered over
+        assert got == [(3, _payload(3)), (4, _payload(4))]
+
+    def test_read_does_not_disturb_writer_state(self, journal):
+        journal.append_many([_payload(0)])
+        list(journal.read_from(-1))
+        seqs = journal.append_many([_payload(1)])
+        assert seqs == [1]
+        assert list(journal.read_from(-1)) == [(0, _payload(0)), (1, _payload(1))]
+
+
+class TestTailCursor:
+    """JournalTailCursor: read_from semantics with an incremental position —
+    each poll reads only new tail bytes."""
+
+    def test_incremental_polls_match_read_from(self, journal):
+        cursor = journal.tail_cursor()
+        journal.append_many([_payload(i) for i in range(4)])
+        assert cursor.read() == list(journal.read_from(-1))
+        journal.append_many([_payload(i) for i in range(4, 7)])
+        assert cursor.read() == list(journal.read_from(3))
+        assert cursor.read() == []
+
+    def test_partial_tail_frame_resumes_when_completed(self, journal):
+        journal.append_many([_payload(0)])
+        journal.flush()
+        cursor = journal.tail_cursor()
+        assert [s for s, _ in cursor.read()] == [0]
+        seg_path = journal._segments()[-1][1]
+        frame = journal._frame(_payload(1))
+        with open(seg_path, "ab") as f:  # a live writer mid-append: half a frame
+            f.write(frame[: len(frame) // 2])
+            f.flush()
+        assert cursor.read() == []  # incomplete: no yield, no truncation
+        with open(seg_path, "ab") as f:
+            f.write(frame[len(frame) // 2 :])
+            f.flush()
+        journal.last_seq = 1  # keep the writer's numbering consistent
+        assert cursor.read() == [(1, _payload(1))]
+
+    def test_crosses_segments_and_survives_rotation(self, journal):
+        cursor = journal.tail_cursor()
+        journal.append_many([_payload(i) for i in range(3)])
+        journal.rotate(covered_seq=-1)
+        journal.append_many([_payload(i) for i in range(3, 5)])
+        assert [s for s, _ in cursor.read()] == [0, 1, 2, 3, 4]
+        journal.rotate(covered_seq=4)  # drops everything read so far
+        journal.append_many([_payload(5)])
+        assert cursor.read() == [(5, _payload(5))]
+
+    def test_rotation_gap_surfaces_as_seq_jump(self, journal):
+        journal.append_many([_payload(i) for i in range(3)])
+        cursor = journal.tail_cursor()
+        assert [s for s, _ in cursor.read()] == [0, 1, 2]
+        # simulate falling far behind: a fresh cursor at -1 after rotation
+        journal.rotate(covered_seq=2)
+        journal.append_many([_payload(3)])
+        behind = journal.tail_cursor(after_seq=-1)
+        assert [s for s, _ in behind.read()] == [3]  # jump visible to the caller
+
+    def test_mid_history_tear_hops_to_next_segment(self, journal):
+        # regression: unparseable bytes mid-history wedged the cursor forever
+        # — it treated every leftover as a live writer's in-flight frame, but
+        # once a NEWER segment exists the torn one is immutable (rotation
+        # closed its file first) and the bytes can never complete. A shipper
+        # rewound below the tear stopped shipping with no gap signal. The
+        # cursor now hops past the tear; the seq jump surfaces to the
+        # caller's contiguity check exactly like a rotation gap.
+        journal.append_many([_payload(i) for i in range(5)])
+        journal.rotate(covered_seq=-1)  # records 0-4 now immutable history
+        journal.append_many([_payload(i) for i in range(5, 8)])
+        first_path = journal._segments()[0][1]
+        size = os.path.getsize(first_path)
+        with open(first_path, "r+b") as f:
+            f.truncate(size - 5)  # tear record 4 mid-frame
+        cursor = journal.tail_cursor()
+        assert [s for s, _ in cursor.read()] == [0, 1, 2, 3]  # stops at the tear
+        assert [s for s, _ in cursor.read()] == [5, 6, 7]  # hops: jump visible
+        # and read_from's contiguity contract still ends at the discontinuity
+        assert [s for s, _ in journal.read_from(-1)] == [0, 1, 2, 3]
+
+    def test_max_records_bounds_one_poll(self, journal):
+        journal.append_many([_payload(i) for i in range(10)])
+        cursor = journal.tail_cursor()
+        assert [s for s, _ in cursor.read(max_records=4)] == [0, 1, 2, 3]
+        assert [s for s, _ in cursor.read(max_records=4)] == [4, 5, 6, 7]
+        assert [s for s, _ in cursor.read()] == [8, 9]
+
+
+class TestInterleavedProperty:
+    """Satellite: random append/rotate/read interleavings, content-exact reads."""
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_random_interleavings(self, tmp_path, seed):
+        rng = np.random.default_rng(seed)
+        journal = RequestJournal(str(tmp_path / f"j{seed}"), durable=False)
+        try:
+            appended = 0  # seqs 0..appended-1 exist
+            covered = -1  # rotate() may have dropped seqs <= covered
+            cursor = -1  # stateless tail-follower position (read_from)
+            tail = journal.tail_cursor()  # stateful follower, same contract
+
+            def check_run(got, at):
+                seqs = [s for s, _ in got]
+                # strictly ascending and contiguous within one call
+                assert seqs == list(range(seqs[0], seqs[0] + len(seqs)))
+                # a jump past at+1 only ever spans rotated records
+                if seqs[0] != at + 1:
+                    assert at + 1 <= covered, (
+                        f"gap {at + 1}..{seqs[0] - 1} without rotation coverage"
+                    )
+                    assert seqs[0] <= covered + 1
+                for seq, payload in got:
+                    assert payload == _payload(seq)
+                return seqs[-1]
+
+            for _ in range(200):
+                op = rng.integers(0, 10)
+                if op < 5:
+                    n = int(rng.integers(1, 6))
+                    seqs = journal.append_many([_payload(appended + i) for i in range(n)])
+                    assert seqs == list(range(appended, appended + n))
+                    appended += n
+                elif op < 7 and appended:
+                    new_covered = int(rng.integers(covered, appended))
+                    journal.rotate(new_covered)
+                    covered = max(covered, new_covered)
+                else:
+                    got = list(journal.read_from(cursor))
+                    if got:
+                        cursor = check_run(got, cursor)
+                    before = tail.seq
+                    inc = tail.read()
+                    if inc:
+                        assert check_run(inc, before) == tail.seq
+            # final reads drain to the end
+            for seq, payload in journal.read_from(cursor):
+                assert payload == _payload(seq)
+                cursor = seq
+            assert cursor == appended - 1 or cursor <= covered or appended == 0
+            before = tail.seq
+            inc = tail.read()
+            if inc:
+                check_run(inc, before)
+            assert tail.seq == appended - 1 or tail.seq <= covered or appended == 0
+        finally:
+            journal.close()
+
+    def test_threaded_smoke(self, tmp_path):
+        """Writer + rotator + reader on live threads: no crash, no corruption,
+        reader sees content-exact contiguous runs."""
+        journal = RequestJournal(str(tmp_path), durable=False)
+        stop = threading.Event()
+        errors = []
+
+        def writer():
+            n = 0
+            while not stop.is_set() and n < 2000:
+                journal.append_many([_payload(n + i) for i in range(5)])
+                n += 5
+
+        def rotator():
+            while not stop.is_set():
+                journal.rotate(covered_seq=max(-1, journal.last_seq - 50))
+                stop.wait(0.002)
+
+        def reader():
+            cursor = -1
+            while not stop.is_set():
+                try:
+                    for seq, payload in journal.read_from(cursor):
+                        if payload != _payload(seq):
+                            errors.append(f"content mismatch at {seq}")
+                        if seq <= cursor:
+                            errors.append(f"non-monotone seq {seq} after {cursor}")
+                        cursor = seq
+                except Exception as exc:  # noqa: BLE001
+                    errors.append(repr(exc))
+
+        threads = [threading.Thread(target=f) for f in (writer, rotator, reader)]
+        for t in threads:
+            t.start()
+        threads[0].join(timeout=30)
+        stop.set()
+        for t in threads[1:]:
+            t.join(timeout=10)
+        journal.close()
+        assert not errors, errors[:5]

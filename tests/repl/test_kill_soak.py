@@ -1,0 +1,23 @@
+"""Slow tier: the repl fuzz-soak surface (SIGKILLed shipping primary + faulted
+in-process pairs) run end to end as a pytest leg — CI's `repl-soak` job runs a
+wider seed range via ``tools/fuzz_soak.py --surfaces repl`` directly."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.mark.slow
+def test_repl_soak_surface_two_seeds():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "fuzz_soak.py"),
+         "--surfaces", "repl", "--seeds", "200:202"],
+        capture_output=True, text=True, timeout=900,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, f"stdout:\n{proc.stdout[-2000:]}\nstderr:\n{proc.stderr[-2000:]}"
+    assert "0 failures" in proc.stdout

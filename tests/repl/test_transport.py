@@ -1,0 +1,244 @@
+"""Replication links: frame ordering, fencing at the boundary, fault doubles."""
+
+import os
+import time
+
+import pytest
+
+from metrics_tpu.repl import (
+    DeadPeerLink,
+    DirectoryTransport,
+    FencedError,
+    FlakyLink,
+    HeartbeatFrame,
+    LoopbackLink,
+    ReplPeerLostError,
+    ReplTransportError,
+    SnapshotFrame,
+    SocketShipReceiver,
+    SocketShipSender,
+    StallLink,
+    WalFrame,
+)
+
+
+def _wal(seq, epoch=0, payload=b"r"):
+    return WalFrame(epoch, seq, payload, t_wall=1000.0 + seq)
+
+
+class TestLoopback:
+    def test_frames_arrive_in_ship_order(self):
+        link = LoopbackLink()
+        link.send([_wal(0), _wal(1)])
+        link.send([HeartbeatFrame(0, 1, 1002.0)])
+        frames = link.recv()
+        assert [type(f).__name__ for f in frames] == ["WalFrame", "WalFrame", "HeartbeatFrame"]
+        assert [f.seq for f in frames[:2]] == [0, 1]
+        assert link.recv() == []
+
+    def test_recv_waits_up_to_timeout(self):
+        link = LoopbackLink()
+        t0 = time.monotonic()
+        assert link.recv(timeout_s=0.05) == []
+        assert time.monotonic() - t0 >= 0.04
+
+    def test_send_side_fence_raises(self):
+        link = LoopbackLink()
+        link.fence(2)
+        with pytest.raises(FencedError):
+            link.send([_wal(0, epoch=1)])
+        link.send([_wal(0, epoch=2)])  # the promoted epoch still ships
+
+    def test_recv_side_fence_drops_already_enqueued_frames(self):
+        # frames shipped BEFORE the fence rose are still rejected at delivery:
+        # the receive-side check is authoritative
+        link = LoopbackLink()
+        link.send([_wal(0, epoch=0), _wal(1, epoch=0)])
+        link.fence(1)
+        assert link.recv() == []
+        assert link.fenced_rejected == 2
+
+    def test_fence_is_monotone(self):
+        link = LoopbackLink()
+        link.fence(3)
+        link.fence(1)
+        assert link.fenced_epoch == 3
+
+    def test_snapshot_request_backchannel(self):
+        link = LoopbackLink()
+        assert not link.take_snapshot_request()
+        link.request_snapshot()
+        assert link.take_snapshot_request()
+        assert not link.take_snapshot_request()  # consumed
+
+
+class TestDirectory:
+    def test_roundtrip_across_instances(self, tmp_path):
+        sender = DirectoryTransport(str(tmp_path))
+        receiver = DirectoryTransport(str(tmp_path))
+        sender.send([SnapshotFrame(0, 0, 5, b"snapbytes", 1.0)])
+        sender.send([_wal(6), _wal(7)])
+        frames = receiver.recv()
+        assert isinstance(frames[0], SnapshotFrame) and frames[0].data == b"snapbytes"
+        assert [f.seq for f in frames[1:]] == [6, 7]
+        assert receiver.recv() == []  # consumed files are deleted
+        assert not [n for n in os.listdir(tmp_path) if n.endswith(".frm")]
+
+    def test_spool_bounded_with_dead_consumer(self, tmp_path):
+        # regression: a permanently dead follower grew the spool without
+        # bound (one file per WAL batch, a full snapshot per interval) until
+        # the disk filled — and a shared filesystem would take the ckpt
+        # plane's writes down with it. Past the cap the OLDEST batches drop;
+        # a returning follower re-bootstraps off the seq gap, the protocol's
+        # normal heal path.
+        sender = DirectoryTransport(str(tmp_path), max_spool_files=5)
+        for i in range(20):
+            sender.send([_wal(i)])
+        assert len([n for n in os.listdir(tmp_path) if n.endswith(".frm")]) == 5
+        assert sender.spool_dropped == 15
+        got = DirectoryTransport(str(tmp_path)).recv()
+        assert [f.seq for f in got] == list(range(15, 20))  # newest survive
+
+    def test_fence_file_deposes_other_process_sender(self, tmp_path):
+        sender = DirectoryTransport(str(tmp_path))
+        other = DirectoryTransport(str(tmp_path))  # the promoted node's handle
+        other.fence(2)
+        with pytest.raises(FencedError):
+            sender.send([_wal(0, epoch=0)])
+
+    def test_recv_drops_fenced_spool_files(self, tmp_path):
+        sender = DirectoryTransport(str(tmp_path))
+        sender.send([_wal(0, epoch=0)])
+        receiver = DirectoryTransport(str(tmp_path))
+        receiver.fence(1)
+        assert receiver.recv() == []
+        assert receiver.fenced_rejected == 1
+
+    def test_corrupt_spool_file_is_skipped_not_fatal(self, tmp_path):
+        sender = DirectoryTransport(str(tmp_path))
+        sender.send([_wal(0)])
+        path = os.path.join(str(tmp_path), [n for n in os.listdir(tmp_path) if n.endswith(".frm")][0])
+        with open(path, "r+b") as f:
+            f.seek(6)
+            f.write(b"\xff\xff")
+        receiver = DirectoryTransport(str(tmp_path))
+        assert receiver.recv() == []
+
+    def test_snapshot_request_file(self, tmp_path):
+        follower = DirectoryTransport(str(tmp_path))
+        primary = DirectoryTransport(str(tmp_path))
+        follower.request_snapshot()
+        assert primary.take_snapshot_request()
+        assert not primary.take_snapshot_request()
+
+    def test_sender_serial_resumes_after_restart(self, tmp_path):
+        DirectoryTransport(str(tmp_path)).send([_wal(0)])
+        restarted = DirectoryTransport(str(tmp_path))  # as a restarted sender
+        restarted.send([_wal(1)])
+        receiver = DirectoryTransport(str(tmp_path))
+        assert [f.seq for f in receiver.recv()] == [0, 1]
+
+
+class TestSocket:
+    def test_roundtrip_over_tcp(self):
+        receiver = SocketShipReceiver()
+        sender = SocketShipSender("127.0.0.1", receiver.port)
+        try:
+            sender.send([_wal(0), _wal(1)])
+            deadline = time.monotonic() + 5.0
+            frames = []
+            while len(frames) < 2 and time.monotonic() < deadline:
+                frames += receiver.recv(timeout_s=0.1)
+            assert [f.seq for f in frames] == [0, 1]
+        finally:
+            sender.close()
+            receiver.close()
+
+    def test_receiver_side_fencing(self):
+        receiver = SocketShipReceiver()
+        sender = SocketShipSender("127.0.0.1", receiver.port)
+        try:
+            receiver.fence(1)
+            sender.send([_wal(0, epoch=0)])
+            time.sleep(0.2)
+            assert receiver.recv(timeout_s=0.2) == []
+        finally:
+            sender.close()
+            receiver.close()
+
+    def test_replacement_sender_preempts_zombie_connection(self):
+        # regression: the receiver served one connection forever — a live
+        # zombie primary holding the established TCP link starved a
+        # replacement primary out of the listen backlog indefinitely, and the
+        # follower silently kept tracking the dead lineage. Newest sender
+        # wins now: the takeover closes the zombie's socket.
+        receiver = SocketShipReceiver()
+        zombie = SocketShipSender("127.0.0.1", receiver.port)
+        replacement = SocketShipSender("127.0.0.1", receiver.port)
+        try:
+            zombie.send([_wal(0, epoch=0)])
+            deadline = time.monotonic() + 5.0
+            frames = []
+            while not frames and time.monotonic() < deadline:
+                frames += receiver.recv(timeout_s=0.1)
+            assert frames and frames[0].epoch == 0  # zombie holds the link
+            replacement.send([_wal(0, epoch=1)])  # bumped-epoch lineage
+            deadline = time.monotonic() + 5.0
+            got = []
+            while not any(f.epoch == 1 for f in got) and time.monotonic() < deadline:
+                got += receiver.recv(timeout_s=0.1)
+            assert any(f.epoch == 1 for f in got)  # not starved behind the zombie
+        finally:
+            zombie.close()
+            replacement.close()
+            receiver.close()
+
+    def test_send_to_dead_port_is_transport_error(self):
+        import socket as _socket
+
+        # a bound-but-never-listening socket refuses connections for as long
+        # as we hold it — deterministic, unlike a closed port, which the OS may
+        # hand to any other process between close and connect
+        blocker = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+        blocker.bind(("127.0.0.1", 0))
+        port = blocker.getsockname()[1]
+        try:
+            sender = SocketShipSender("127.0.0.1", port, connect_timeout_s=0.5)
+            with pytest.raises(ReplTransportError):
+                sender.send([_wal(0)])
+        finally:
+            blocker.close()
+
+
+class TestFaultDoubles:
+    def test_flaky_fails_then_delegates(self):
+        inner = LoopbackLink()
+        link = FlakyLink(inner, fail=2)
+        for _ in range(2):
+            with pytest.raises(ReplTransportError):
+                link.send([_wal(0)])
+        link.send([_wal(0)])
+        assert link.failures_injected == 2
+        assert [f.seq for f in inner.recv()] == [0]
+
+    def test_stall_delays_but_delivers(self):
+        inner = LoopbackLink()
+        link = StallLink(inner, stall_s=0.05, stalls=1)
+        t0 = time.monotonic()
+        link.send([_wal(0)])
+        assert time.monotonic() - t0 >= 0.04
+        link.send([_wal(1)])  # stall budget spent
+        assert [f.seq for f in inner.recv()] == [0, 1]
+
+    def test_dead_peer_always_fails(self):
+        link = DeadPeerLink()
+        with pytest.raises(ReplPeerLostError):
+            link.send([_wal(0)])
+
+    def test_doubles_forward_fence_and_backchannel(self):
+        inner = LoopbackLink()
+        link = FlakyLink(inner, fail=0)
+        link.fence(4)
+        assert inner.fenced_epoch == 4 and link.fenced_epoch == 4
+        link.request_snapshot()
+        assert link.take_snapshot_request()

@@ -1187,6 +1187,316 @@ def soak_guard(seeds) -> None:
                 recovered.close(checkpoint=False)
 
 
+# ---------------------------------------------------------------------- repl surface
+
+
+def repl_crash_child(dirpath, seed):
+    """Child half of the repl SIGKILL surface: a primary engine ships its
+    snapshot+WAL lineage over a DirectoryTransport spool while submitting a
+    deterministic stream, until the parent SIGKILLs it (possibly mid-write,
+    mid-ship, mid-rotate)."""
+    from metrics_tpu.classification import BinaryAccuracy
+    from metrics_tpu.engine import CheckpointConfig, ReplConfig, StreamingEngine
+    from metrics_tpu.repl import DirectoryTransport
+
+    stream = _ckpt_engine_stream(seed)
+    link = DirectoryTransport(os.path.join(dirpath, "spool"), durable=True)
+    cfg = CheckpointConfig(directory=os.path.join(dirpath, "ckpt"), interval_s=0.05,
+                           retain=3, durable=True, wal_flush="fsync")
+    engine = StreamingEngine(
+        BinaryAccuracy(), buckets=(8, 32), checkpoint=cfg,
+        replication=ReplConfig(role="primary", transport=link,
+                               ship_interval_s=0.01, heartbeat_interval_s=0.1),
+    )
+    print("READY", flush=True)
+    while True:  # cycle until killed
+        for key, p, t in stream:
+            engine.submit(key, jnp.asarray(p), jnp.asarray(t))
+
+
+def _verify_repl_prefix(engine, stream, seed, tag):
+    """Exactly-once order-preserving prefix check (the ckpt surface's twin
+    technique): for every key, the engine's state must equal a fresh metric fed
+    exactly the first `_update_count` rows of that key's (cycled) stream."""
+    from metrics_tpu.classification import BinaryAccuracy
+
+    metric = BinaryAccuracy()
+    per_key_rows: dict = {}
+    for key, p, t in stream:
+        per_key_rows.setdefault(key, []).extend(
+            (p[i : i + 1], t[i : i + 1]) for i in range(len(p))
+        )
+    for key in engine._keyed.keys:
+        state = jax.device_get(engine._keyed.state_of(key))
+        rows_applied = int(np.asarray(state["_update_count"]))
+        rows = per_key_rows.get(key, [])
+        if rows:
+            while rows_applied > len(rows):  # the child cycles its stream
+                rows = rows + per_key_rows[key]
+        elif rows_applied:
+            FAILS.append((seed, tag, f"key {key}: {rows_applied} rows but key never submitted"))
+            continue
+        oracle_state = metric.init_state()
+        for p_row, t_row in rows[:rows_applied]:
+            oracle_state = metric.update_state(oracle_state, jnp.asarray(p_row), jnp.asarray(t_row))
+        try:
+            jax.tree_util.tree_map(
+                lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+                state, jax.device_get(oracle_state),
+            )
+        except Exception as exc:  # noqa: BLE001
+            FAILS.append((seed, tag, f"key {key}: state != first-{rows_applied}-rows oracle: {repr(exc)[:120]}"))
+
+
+def _soak_repl_inprocess(seed):
+    """In-process leg: primary + follower over a (randomly faulted) loopback
+    link; follower kill + rejoin from a fresh snapshot; promotion mid-stream;
+    fenced zombie primary. The follower must be bit-identical to the primary at
+    every catch-up point, and the promoted node must serve exactly the acked
+    prefix, untouched by the zombie's late shipments."""
+    import tempfile
+    import threading
+    import time as _time
+
+    from metrics_tpu.classification import BinaryAccuracy
+    from metrics_tpu.engine import CheckpointConfig, ReplConfig, StreamingEngine
+    from metrics_tpu.repl import FlakyLink, LoopbackLink, StallLink
+
+    rng = np.random.default_rng(seed)
+    tag = f"repl/inprocess seed={seed}"
+    with tempfile.TemporaryDirectory() as d:
+        link = LoopbackLink()
+        fault = int(rng.integers(0, 3))
+        transport = (FlakyLink(link, fail=int(rng.integers(1, 5))) if fault == 0
+                     else StallLink(link, stall_s=0.03, stalls=int(rng.integers(1, 4))) if fault == 1
+                     else link)
+        primary = StreamingEngine(
+            BinaryAccuracy(), buckets=(8, 32), capacity=8, max_queue=512,
+            checkpoint=CheckpointConfig(directory=os.path.join(d, "p"), interval_s=0.05,
+                                        retain=3, durable=False),
+            replication=ReplConfig(role="primary", transport=transport,
+                                   ship_interval_s=0.01, heartbeat_interval_s=0.05),
+        )
+
+        def follower_engine():
+            return StreamingEngine(
+                BinaryAccuracy(), buckets=(8, 32), capacity=8,
+                replication=ReplConfig(
+                    role="follower", transport=link, poll_interval_s=0.01,
+                    promote_checkpoint=CheckpointConfig(
+                        directory=os.path.join(d, "f"), interval_s=0.1, durable=False),
+                ),
+            )
+
+        def burst(n):
+            for _ in range(n):
+                rows = int(rng.integers(1, 8))
+                primary.submit(f"k{rng.integers(0, 6)}",
+                               jnp.asarray(rng.integers(0, 2, rows)),
+                               jnp.asarray(rng.integers(0, 2, rows)))
+            primary.flush()
+
+        def states_of(engine):
+            return {k: jax.device_get(engine._keyed.state_of(k)) for k in engine._keyed.keys}
+
+        def assert_same(a, b, what):
+            try:
+                if set(a) != set(b):
+                    raise AssertionError(f"key sets differ: {sorted(a)} vs {sorted(b)}")
+                for k in a:
+                    jax.tree_util.tree_map(
+                        lambda x, y: np.testing.assert_array_equal(np.asarray(x), np.asarray(y)),
+                        a[k], b[k])
+            except Exception as exc:  # noqa: BLE001
+                FAILS.append((seed, tag, f"{what}: {repr(exc)[:140]}"))
+
+        follower = follower_engine()
+        try:
+            # phase A: traffic under the (possibly faulty) link; catch-up must
+            # converge and be bit-identical
+            burst(80)
+            if not follower._applier.await_seq(primary._wal_seq, timeout_s=30):
+                FAILS.append((seed, tag, "follower never caught up (phase A)"))
+            assert_same(states_of(primary), states_of(follower), "phase A bit-identity")
+
+            # phase B: follower dies; traffic continues; a fresh follower
+            # rejoins mid-stream from a freshly requested snapshot
+            follower.close()
+            burst(60)
+            primary.checkpoint_now()
+            follower = follower_engine()
+            burst(40)
+            if not follower._applier.await_seq(primary._wal_seq, timeout_s=30):
+                FAILS.append((seed, tag, "rejoined follower never caught up (phase B)"))
+            assert_same(states_of(primary), states_of(follower), "phase B rejoin bit-identity")
+
+            # phase C: promotion mid-stream. A background writer hammers one
+            # tenant while we promote: the promoted node must hold the fully
+            # synced pre-state for every other tenant EXACTLY, and for the
+            # hammered tenant exactly the pre-state advanced by SOME j-record
+            # prefix of the writer's stream, j <= what was submitted — the
+            # no-loss / no-double-apply acked-prefix contract, bit-for-bit.
+            burst(20)
+            if not follower._applier.await_seq(primary._wal_seq, timeout_s=30):
+                FAILS.append((seed, tag, "follower never caught up (pre-promotion)"))
+            pre = states_of(primary)
+            stop = threading.Event()
+            writer_sent = []
+
+            def background_writer():
+                while not stop.is_set():
+                    try:
+                        primary.submit("k0", jnp.asarray([1]), jnp.asarray([0]))
+                        writer_sent.append(1)
+                    except Exception:  # noqa: BLE001 — engine may be mid-close
+                        return
+                    _time.sleep(0.002)
+
+            writer = threading.Thread(target=background_writer)
+            writer.start()
+            _time.sleep(0.05)
+            follower.promote()
+            promoted = states_of(follower)
+            stop.set()
+            writer.join()
+            metric = BinaryAccuracy()
+            for key, before in pre.items():
+                if key == "k0":
+                    continue
+                if key not in promoted:
+                    FAILS.append((seed, tag, f"phase C: tenant {key} LOST across promotion"))
+                    continue
+                assert_same({key: before}, {key: promoted[key]},
+                            f"phase C: untouched tenant {key} moved across promotion")
+            base = pre.get("k0", jax.device_get(metric.init_state()))
+            state_j = jax.tree.map(jnp.asarray, base)
+            matched = None
+            for j in range(len(writer_sent) + 1):
+                try:
+                    jax.tree_util.tree_map(
+                        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+                        jax.device_get(state_j), promoted.get("k0", base))
+                    matched = j
+                    break
+                except AssertionError:
+                    state_j = metric.update_state(state_j, jnp.asarray([1]), jnp.asarray([0]))
+            if matched is None:
+                FAILS.append((seed, tag, f"phase C: promoted k0 state matches no "
+                              f"{len(writer_sent)}-bounded prefix of the writer stream"))
+            # zombie: the deposed primary keeps writing + shipping; the fence
+            # must reject it and the promoted state must not move
+            burst(30)
+            deadline = _time.monotonic() + 10.0
+            while not primary._shipper.fenced and _time.monotonic() < deadline:
+                _time.sleep(0.02)
+            if not primary._shipper.fenced:
+                FAILS.append((seed, tag, "zombie primary's shipper was never fenced"))
+            assert_same(promoted, states_of(follower), "zombie leak into promoted state")
+
+            # the promoted node is writable and durable: write, crash, recover
+            for _ in range(10):
+                follower.submit("k1", jnp.asarray([1, 1]), jnp.asarray([1, 0]))
+            follower.flush()
+            final = states_of(follower)
+            follower.close(checkpoint=False)
+            recovered = StreamingEngine(
+                BinaryAccuracy(), buckets=(8, 32),
+                checkpoint=CheckpointConfig(directory=os.path.join(d, "f"), durable=False),
+                start=False)
+            try:
+                assert_same(final, states_of(recovered), "promoted lineage recovery")
+            finally:
+                recovered.close(checkpoint=False)
+        except Exception as exc:  # noqa: BLE001 — record crash seeds, keep soaking
+            FAILS.append((seed, tag, "surface raised: " + repr(exc)[:160]))
+        finally:
+            primary.close(checkpoint=False)
+            try:
+                follower.close(checkpoint=False)
+            except Exception:  # noqa: BLE001 — may already be closed above
+                pass
+
+
+def _soak_repl_kill(seed):
+    """SIGKILL leg: the primary runs in a child process shipping over a
+    directory spool and is killed mid-write; the parent's follower consumes
+    whatever was shipped, promotes, and must hold an exactly-once
+    order-preserving prefix of the child's deterministic stream."""
+    import signal
+    import subprocess
+    import tempfile
+    import time as _time
+
+    from metrics_tpu.classification import BinaryAccuracy
+    from metrics_tpu.engine import CheckpointConfig, ReplConfig, StreamingEngine
+    from metrics_tpu.repl import DirectoryTransport
+
+    tag = f"repl/kill seed={seed}"
+    with tempfile.TemporaryDirectory() as d:
+        child = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--repl-child", d, str(seed)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        try:
+            line = child.stdout.readline()
+            if "READY" not in line:
+                err = child.stderr.read()[:200]
+                FAILS.append((seed, tag, f"child failed to start: {line!r} {err!r}"))
+                return
+            rng = np.random.default_rng(seed ^ 0x9E97)
+            _time.sleep(float(rng.uniform(0.1, 0.8)))
+            child.send_signal(signal.SIGKILL)
+            child.wait(timeout=30)
+        finally:
+            if child.poll() is None:
+                child.kill()
+                child.wait(timeout=30)
+        follower = StreamingEngine(
+            BinaryAccuracy(), buckets=(8, 32),
+            replication=ReplConfig(
+                role="follower",
+                transport=DirectoryTransport(os.path.join(d, "spool"), durable=False),
+                poll_interval_s=0.01,
+                promote_checkpoint=CheckpointConfig(
+                    directory=os.path.join(d, "promoted"), durable=False),
+            ),
+        )
+        try:
+            # drain: wait until the spool stops producing progress
+            applier = follower._applier
+            last, stable = -2, 0
+            deadline = _time.monotonic() + 30.0
+            while stable < 10 and _time.monotonic() < deadline:
+                _time.sleep(0.05)
+                now_seq = applier.applied_seq
+                stable = stable + 1 if now_seq == last else 0
+                last = now_seq
+            if not applier.bootstrapped:
+                if applier.known_seq >= 0:
+                    # the child shipped WAL frames but no bootstrap landed
+                    FAILS.append((seed, tag, "WAL frames arrived but no bootstrap snapshot"))
+                return  # killed before anything shipped: nothing to verify
+            follower.promote()
+            _verify_repl_prefix(follower, _ckpt_engine_stream(seed), seed, tag)
+        finally:
+            follower.close(checkpoint=False)
+
+
+def soak_repl(seeds) -> None:
+    """Replication-plane soak (ISSUE 6): primary + follower pairs under
+    composed faults — flaky/stalled ship links, follower kill + rejoin from a
+    fresh snapshot, promotion mid-stream with a fenced-off zombie primary, and
+    a SIGKILLed child primary shipping over a directory spool. The follower
+    must be bit-identical to the primary at every catch-up point, a promoted
+    follower must serve exactly the acked prefix (no loss, no double-apply),
+    and a zombie's late shipments must never leak past the fence. Self-oracled
+    — needs no reference checkout."""
+    for seed in seeds:
+        _soak_repl_inprocess(seed)
+        if seed % 2 == 0:
+            _soak_repl_kill(seed)
+
+
 SURFACES = {
     "classification": soak_classification,
     "regression_retrieval": soak_regression_retrieval,
@@ -1200,11 +1510,12 @@ SURFACES = {
     "engine": soak_engine,
     "ckpt": soak_ckpt,
     "guard": soak_guard,
+    "repl": soak_repl,
 }
 
 # surfaces that execute the reference as their oracle (everything except the
-# self-oracled engine, ckpt crash-recovery and guard chaos surfaces)
-_NEEDS_REF = {name for name in SURFACES if name not in ("engine", "ckpt", "guard")}
+# self-oracled engine, ckpt crash-recovery, guard chaos and repl surfaces)
+_NEEDS_REF = {name for name in SURFACES if name not in ("engine", "ckpt", "guard", "repl")}
 
 
 def main() -> None:
@@ -1213,11 +1524,17 @@ def main() -> None:
     parser.add_argument("--seeds", default="100:120", help="start:stop seed range")
     parser.add_argument("--ckpt-child", nargs=3, metavar=("MODE", "DIR", "SEED"),
                         help="internal: run the ckpt crash-surface child (killed by the parent)")
+    parser.add_argument("--repl-child", nargs=2, metavar=("DIR", "SEED"),
+                        help="internal: run the repl shipping-primary child (killed by the parent)")
     args = parser.parse_args()
 
     if args.ckpt_child is not None:
         mode, dirpath, seed = args.ckpt_child
         ckpt_crash_child(mode, dirpath, int(seed))
+        return
+    if args.repl_child is not None:
+        dirpath, seed = args.repl_child
+        repl_crash_child(dirpath, int(seed))
         return
 
     start, stop = (int(x) for x in args.seeds.split(":"))
