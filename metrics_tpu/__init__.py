@@ -26,6 +26,7 @@ from metrics_tpu import obs  # noqa: E402  (observability layer; not in referenc
 from metrics_tpu import comm  # noqa: E402  (collective sync plane; not in reference-parity __all__)
 from metrics_tpu import engine  # noqa: E402  (serving runtime; not in reference-parity __all__)
 from metrics_tpu import ckpt  # noqa: E402  (durable state plane; not in reference-parity __all__)
+from metrics_tpu import sketch  # noqa: E402  (sketch plane; not in reference-parity __all__)
 from metrics_tpu.aggregation import CatMetric, MaxMetric, MeanMetric, MinMetric, SumMetric  # noqa: E402
 from metrics_tpu.audio import (  # noqa: E402
     PermutationInvariantTraining,
@@ -57,6 +58,7 @@ from metrics_tpu.classification import (  # noqa: E402
     StatScores,
 )
 from metrics_tpu.collections import MetricCollection  # noqa: E402
+from metrics_tpu.sketch import CardinalitySketch, HeavyHittersSketch, QuantileSketch  # noqa: E402
 from metrics_tpu.image import (  # noqa: E402
     ErrorRelativeGlobalDimensionlessSynthesis,
     MultiScaleStructuralSimilarityIndexMeasure,
@@ -134,6 +136,7 @@ __all__ = [
     "BLEUScore",
     "BootStrapper",
     "CalibrationError",
+    "CardinalitySketch",
     "CatMetric",
     "ClasswiseWrapper",
     "CharErrorRate",
@@ -145,6 +148,7 @@ __all__ = [
     "CosineSimilarity",
     "CramersV",
     "Dice",
+    "HeavyHittersSketch",
     "TweedieDevianceScore",
     "ErrorRelativeGlobalDimensionlessSynthesis",
     "ExactMatch",
@@ -180,6 +184,7 @@ __all__ = [
     "Precision",
     "PrecisionRecallCurve",
     "PeakSignalNoiseRatio",
+    "QuantileSketch",
     "R2Score",
     "Recall",
     "RetrievalFallOut",

@@ -4,12 +4,13 @@ Planning happens once per abstract state signature (shape/dtype skeleton —
 the same identity jax's jit cache keys on) and is cached; execution happens
 every sync. The plan decides, per leaf:
 
-- **route** — reducible leaves (``sum``/``mean``/``max``/``min``, and the
-  ``_update_count`` special case) have identical shapes on every rank by
+- **route** — fixed-shape array leaves (``sum``/``mean``/``max``/``min``, the
+  ``_update_count`` special case, AND callable ``dist_reduce_fx`` — e.g. the
+  sketch plane's top-k ledger merge) have identical shapes on every rank by
   construction, so they *coalesce*: all their encoded payloads of one wire
-  dtype become a single flat buffer → one collective instead of N.
-  ``cat``/``None``/callable leaves are potentially ragged across ranks and go
-  through :func:`~metrics_tpu.comm.transport.gather_ragged` individually.
+  dtype become a single flat buffer → one collective instead of N. ``cat``/
+  ``None``/list leaves are potentially ragged across ranks and go through
+  :func:`~metrics_tpu.comm.transport.gather_ragged` individually.
 - **codec** — asked of the :class:`~metrics_tpu.comm.codec.CodecPolicy` with
   the leaf's name, reduction, dtype and byte size.
 - **chunking** — coalesced buffers larger than ``chunk_bytes`` split into
@@ -197,7 +198,12 @@ def build_plan(
             shape, dtype, nbytes = _leaf_meta(val)
         tag = _reduction_tag(reduction)
         codec_name = policy.choose(name, reduction, dtype, nbytes)
-        fixed_shape = tag in _REDUCIBLE and not is_list
+        # callable reductions on ARRAY leaves are fixed-shape by the same
+        # argument as the string ops (every rank registered the same default):
+        # they ride the coalesced flat-buffer gather and reduce per leaf after
+        # slicing (never the buffer-level fast reduce — see below). Only
+        # list/cat/None leaves are potentially ragged across ranks.
+        fixed_shape = (tag in _REDUCIBLE or tag == "callable") and not is_list
         route = "coalesce" if (fixed_shape and coalesce) else ("ragged" if not fixed_shape else "solo")
         # "solo" (coalescing off) still uses the fixed-shape direct path, as a
         # one-leaf coalesced buffer — keeps execution single-pathed
@@ -227,14 +233,31 @@ def build_plan(
                 chunk_elems = max(1, int(chunk_bytes) // max(1, np.dtype(d).itemsize))
                 slot = _PayloadSlot(lf.name, idx, 0, size, tuple(pshape))
                 chunks = tuple((s, min(s + chunk_elems, size)) for s in range(0, size, chunk_elems)) or ((0, 0),)
-                buffers.append(_CoalescedBuffer(d, lf.reduction_tag, size, (slot,), chunks, codec.lossless))
+                buffers.append(
+                    _CoalescedBuffer(
+                        d,
+                        lf.reduction_tag,
+                        size,
+                        (slot,),
+                        chunks,
+                        codec.lossless and lf.reduction_tag in _REDUCIBLE,
+                    )
+                )
     for (d, op), slot_pairs in by_key.items():
         total = offsets[(d, op)]
         chunk_elems = max(1, int(chunk_bytes) // max(1, np.dtype(d).itemsize))
         chunks = tuple((s, min(s + chunk_elems, total)) for s in range(0, total, chunk_elems)) or ((0, 0),)
         buffers.append(
             _CoalescedBuffer(
-                d, op, total, tuple(s for s, _ in slot_pairs), chunks, all(l for _, l in slot_pairs)
+                d,
+                op,
+                total,
+                tuple(s for s, _ in slot_pairs),
+                chunks,
+                # buffer-level single-op reduce only exists for the elementwise
+                # string ops; a "callable" buffer gathers coalesced but reduces
+                # per leaf (the callable sees rank-stacked leaf rows)
+                all(l for _, l in slot_pairs) and op in _REDUCIBLE,
             )
         )
 

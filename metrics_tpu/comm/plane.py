@@ -341,7 +341,21 @@ def _execute_plan(
         for start, stop in buf.chunks:
             rows = transport.allgather(flat[start:stop])
             for r in range(world):
-                rank_parts[r].append(np.asarray(rows[r]).ravel())
+                row = np.asarray(rows[r]).ravel()
+                if row.size != stop - start:
+                    # the coalesced route is only sound when every rank holds
+                    # identically-shaped leaves (true by construction for
+                    # registered fixed-shape states). A custom callable-reduced
+                    # state whose shape DIVERGES across ranks would otherwise
+                    # be sliced with local offsets and reduced silently wrong —
+                    # make it a loud transport failure instead.
+                    raise TransportError(
+                        f"coalesced sync: rank {r} gathered {row.size} elements for a "
+                        f"{stop - start}-element chunk of buffer ({buf.dtype}, {buf.op}) — "
+                        "a fixed-shape state's shape diverged across ranks (leaves "
+                        f"{[s.leaf for s in buf.slots]})"
+                    )
+                rank_parts[r].append(row)
         rank_flats = [
             parts[0] if len(parts) == 1 else np.concatenate(parts) for parts in rank_parts
         ]

@@ -1,0 +1,65 @@
+"""One-shot functional twins of the sketch metrics (:mod:`metrics_tpu.sketch`).
+
+Each function runs the same pure kernels the module metrics accumulate with,
+over a single batch — handy for ad-hoc analytics and for oracling the
+streaming path in tests (module metric fed the same stream must answer
+bit-identically).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.sketch import kernels
+
+__all__ = ["approx_count_distinct", "approx_quantiles", "approx_heavy_hitters"]
+
+
+def approx_quantiles(
+    value: Union[float, Array],
+    quantiles: Sequence[float] = (0.5, 0.9, 0.99),
+    *,
+    alpha: float = 0.01,
+    n_buckets: int = 2048,
+    min_trackable: float = 1e-8,
+) -> Array:
+    """DDSketch quantile estimates of one batch (relative error ≤ ``alpha``)."""
+    gamma, log_gamma, offset = kernels.ddsketch_params(alpha, min_trackable)
+    pos = jnp.zeros(int(n_buckets), jnp.int32)
+    neg = jnp.zeros(int(n_buckets), jnp.int32)
+    zero = jnp.zeros((), jnp.int32)
+    vmin = jnp.asarray(jnp.inf, jnp.float32)
+    vmax = jnp.asarray(-jnp.inf, jnp.float32)
+    pos, neg, zero, vmin, vmax = kernels.ddsketch_update(
+        pos, neg, zero, vmin, vmax, value, log_gamma=log_gamma, offset=offset
+    )
+    return kernels.ddsketch_quantiles(
+        pos, neg, zero, vmin, vmax, tuple(quantiles), gamma=gamma, offset=offset
+    )
+
+
+def approx_count_distinct(value: Union[float, Array], *, p: int = 12) -> Array:
+    """HyperLogLog distinct-count estimate of one batch (std err ≈ 1.04/√2^p)."""
+    if not 4 <= int(p) <= 16:
+        raise ValueError(f"`p` must be in [4, 16], got {p}")
+    registers = kernels.hll_update(jnp.zeros(1 << int(p), jnp.int32), value, p=int(p))
+    return kernels.hll_estimate(registers)
+
+
+def approx_heavy_hitters(
+    value: Union[int, Array], *, k: int = 32, depth: int = 4, width: int = 2048
+) -> Tuple[Array, Array]:
+    """Top-``k`` heavy hitters of one batch of non-negative int ids.
+
+    Returns ``(keys, counts)`` sorted by count-min estimate descending; unused
+    candidate slots are ``-1``/``0``.
+    """
+    counts = jnp.zeros((int(depth), int(width)), jnp.int32)
+    ledger = jnp.stack(
+        [jnp.full((int(k),), -1, jnp.int32), jnp.zeros((int(k),), jnp.int32)], axis=1
+    )
+    counts, ledger = kernels.cms_update(counts, ledger, value)
+    return kernels.hh_rank(counts, ledger)

@@ -1,0 +1,22 @@
+"""Sketch plane — mergeable quantile/cardinality/heavy-hitter metrics.
+
+Fixed-shape, jit-able sketch states with ``dist_reduce_fx``-style merges, so
+streaming-analytics workloads (per-tenant p50/p99, distinct counts, heavy
+hitters at millions of keys) compose for free with the serving stack: fused
+engine dispatch, window rings via ``merge_states``, coalesced lossless comm
+sync, bit-identical ckpt/WAL replay, and replica read scale-out.
+
+- :mod:`metrics_tpu.sketch.kernels` — the pure-functional kernel layer;
+- :class:`QuantileSketch` / :class:`CardinalitySketch` /
+  :class:`HeavyHittersSketch` — the ``Metric`` subclasses;
+- :mod:`metrics_tpu.functional.sketch` — one-shot functional twins.
+
+See ``docs/source/sketches.md`` for state layouts, error bounds and merge
+semantics, and ``examples/sketch_alerting.py`` for the per-tenant windowed
+p99-threshold alerting scenario.
+"""
+
+from metrics_tpu.sketch import kernels
+from metrics_tpu.sketch.metrics import CardinalitySketch, HeavyHittersSketch, QuantileSketch
+
+__all__ = ["CardinalitySketch", "HeavyHittersSketch", "QuantileSketch", "kernels"]

@@ -1,0 +1,375 @@
+"""Pure-functional mergeable sketch kernels — fixed-shape, jit-able, scatter/add/max.
+
+Why sketches (ROADMAP item 4): exact per-tenant quantiles, distinct counts and
+heavy hitters need a ragged ``cat`` state — unbounded memory, and exactly the
+shape the comm plane pays pad-to-max for. Every sketch here is a FIXED-SHAPE
+int/float array state with a mergeable reduction, so millions of keys ride the
+whole serving stack unchanged: the engine's masked-scan bucket kernels trace
+the updates, ``merge_states`` makes sliding windows cheap, the comm planner
+coalesces the sync (zero ragged routing), and ckpt/WAL replay is bit-identical
+because int adds/maxes are exact.
+
+Three families:
+
+- **DDSketch-style quantile sketch** — log-bucketed counters with a
+  relative-error guarantee α: bucket ``i`` covers ``(γ^(i-1-offset),
+  γ^(i-offset)]`` in ``|x|`` with ``γ = (1+a)/(1-a)`` and ``a`` slightly under
+  α, so the bucket-midpoint estimate ``2γ^(i-offset)/(γ+1)`` is within α of
+  every value in the bucket even after float32 boundary rounding. Separate
+  positive/negative stores plus an exact zero count and exact running
+  min/max (the min/max clamp makes q→0/1 exact). Update = scatter-add;
+  merge = elementwise sum (+ min/min, max/max).
+- **HyperLogLog** — dense ``m = 2^p`` register array, standard error
+  ``≈ 1.04/√m``, with the small-range linear-counting correction. Update =
+  scatter-max of leading-zero ranks; merge = elementwise max.
+- **Count-min + top-k candidate ledger** — ``depth×width`` counters (update
+  scatter-add, merge elementwise sum) plus a fixed ``(k, 2)`` ledger of
+  ``[key, cm_estimate]`` rows maintained by a ``lax.scan`` over the batch.
+  The ledger is a candidate SET: merge is union → per-key count sum →
+  deterministic top-k (ties broken by key, so the merge is order-independent
+  bit-for-bit), and final heavy-hitter counts are re-estimated against the
+  exactly-merged count-min table at compute time.
+
+Item identity is the 32-bit pattern of the value (floats hash by their float32
+bits, ints by their int32 value), mixed through the murmur3 finalizer. The
+ledger additionally stores keys verbatim, so heavy-hitter items must be
+NON-NEGATIVE int32 ids (``-1`` marks an empty ledger slot).
+
+All functions are pure ``(arrays, batch) -> arrays`` with static Python
+configuration — safe under ``jit``/``vmap``/``lax.scan``, including the
+engine's donated-buffer bucket kernels.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array, lax
+
+__all__ = [
+    "cms_query",
+    "cms_update",
+    "ddsketch_params",
+    "ddsketch_quantiles",
+    "ddsketch_update",
+    "hash32",
+    "hh_rank",
+    "hll_estimate",
+    "hll_update",
+    "topk_merge",
+]
+
+
+# --------------------------------------------------------------------- hashing
+
+_M1 = 0x85EBCA6B
+_M2 = 0xC2B2AE35
+_GOLD = 0x9E3779B9
+
+
+def _mix32_py(x: int) -> int:
+    """Host-side murmur3 finalizer (static seed derivation)."""
+    x &= 0xFFFFFFFF
+    x ^= x >> 16
+    x = (x * _M1) & 0xFFFFFFFF
+    x ^= x >> 13
+    x = (x * _M2) & 0xFFFFFFFF
+    x ^= x >> 16
+    return x
+
+
+def _mix32(x: Array) -> Array:
+    """murmur3 finalizer on uint32 lanes (multiplication wraps mod 2^32)."""
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(_M1)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(_M2)
+    return x ^ (x >> 16)
+
+
+def _as_uint32_bits(values: Array) -> Array:
+    """Canonical 32-bit identity of a value: float32 bit pattern for floats,
+    two's-complement int32 for ints/bools. Cross-dtype identity is by bit
+    pattern, not numeric value — hash ``1`` and ``1.0`` differently."""
+    x = jnp.asarray(values)
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        return lax.bitcast_convert_type(x.astype(jnp.float32), jnp.uint32)
+    return x.astype(jnp.uint32)
+
+
+def hash32(values: Array, seed: int = 0) -> Array:
+    """Well-mixed uint32 hash of each element (see :func:`_as_uint32_bits`)."""
+    return _mix32(_as_uint32_bits(values) ^ jnp.uint32(_mix32_py(seed ^ _GOLD)))
+
+
+def _clz32(x: Array) -> Array:
+    """Branchless count-leading-zeros of uint32 lanes (exact, no float log)."""
+    x = x.astype(jnp.uint32)
+    n = jnp.full(x.shape, 32, jnp.int32)
+    for s in (16, 8, 4, 2, 1):
+        y = x >> s
+        big = y != jnp.uint32(0)
+        n = jnp.where(big, n - s, n)
+        x = jnp.where(big, y, x)
+    return n - x.astype(jnp.int32)
+
+
+# --------------------------------------------------------------------- DDSketch
+
+
+def ddsketch_params(alpha: float, min_trackable: float = 1e-8) -> Tuple[float, float, int]:
+    """``(gamma, log_gamma, offset)`` for a target relative error ``alpha``.
+
+    ``gamma`` is derived from ``a = 0.995·alpha`` — the 0.5% shrink keeps the
+    bucket-midpoint estimate within the USER'S α even when float32 log rounding
+    lands a boundary value one bucket off. ``offset`` shifts bucket 0 to
+    ``min_trackable``: nonzero magnitudes below it collapse into bucket 0
+    (guarantee holds for ``|x| ∈ [min_trackable, min_trackable·γ^(B-1)]``).
+    """
+    if not 0.0 < alpha < 1.0:
+        raise ValueError(f"`alpha` must be in (0, 1), got {alpha}")
+    if not min_trackable > 0.0:
+        raise ValueError(f"`min_trackable` must be > 0, got {min_trackable}")
+    a = 0.995 * float(alpha)
+    gamma = (1.0 + a) / (1.0 - a)
+    log_gamma = math.log(gamma)
+    offset = -int(math.ceil(math.log(min_trackable) / log_gamma))
+    return gamma, log_gamma, offset
+
+
+def ddsketch_update(
+    pos: Array,
+    neg: Array,
+    zero: Array,
+    vmin: Array,
+    vmax: Array,
+    values: Array,
+    *,
+    log_gamma: float,
+    offset: int,
+) -> Tuple[Array, Array, Array, Array, Array]:
+    """Scatter one batch of values into the log-bucket stores.
+
+    NaNs contribute nothing (their sign tests and min/max are masked out);
+    exact zeros land in ``zero`` so the zero/nonzero split merges exactly.
+    """
+    v = jnp.ravel(jnp.asarray(values)).astype(jnp.float32)
+    if v.size == 0:
+        return pos, neg, zero, vmin, vmax
+    n_buckets = pos.shape[0]
+    absv = jnp.abs(v)
+    finite = jnp.isfinite(v)
+    nonzero = absv > 0  # False for 0 and NaN
+    # the log/cast below must only ever see finite positive magnitudes: an inf
+    # fed through ceil(...).astype(int32) is implementation-defined (it wraps
+    # differently per backend, breaking bit-identical replay) — ±inf instead
+    # lands deterministically in the TOP bucket of its sign store (it is
+    # larger than every trackable magnitude), with the exact min/max carrying
+    # the true ±inf so q→0/1 still answer it exactly
+    safe = jnp.where(nonzero & finite, absv, jnp.float32(1.0))
+    idx = jnp.ceil(jnp.log(safe) * jnp.float32(1.0 / log_gamma)).astype(jnp.int32) + offset
+    idx = jnp.clip(idx, 0, n_buckets - 1)
+    idx = jnp.where(finite, idx, n_buckets - 1)
+    one = jnp.ones_like(v, dtype=pos.dtype)
+    zilch = jnp.zeros_like(v, dtype=pos.dtype)
+    pos = pos.at[idx].add(jnp.where(v > 0, one, zilch))
+    neg = neg.at[idx].add(jnp.where(v < 0, one, zilch))
+    zero = zero + jnp.sum(jnp.where(v == 0, one, zilch))
+    finite = ~jnp.isnan(v)
+    vmin = jnp.minimum(vmin, jnp.min(jnp.where(finite, v, jnp.float32(jnp.inf))))
+    vmax = jnp.maximum(vmax, jnp.max(jnp.where(finite, v, jnp.float32(-jnp.inf))))
+    return pos, neg, zero, vmin, vmax
+
+
+def ddsketch_quantiles(
+    pos: Array,
+    neg: Array,
+    zero: Array,
+    vmin: Array,
+    vmax: Array,
+    quantiles: Sequence[float],
+    *,
+    gamma: float,
+    offset: int,
+) -> Array:
+    """Quantile estimates (one per ``q``) from the bucket stores.
+
+    Walks the value-ascending concatenation [reversed negative store, zero
+    bucket, positive store] by cumulative rank; the bucket-midpoint estimate is
+    clamped to the exact observed ``[vmin, vmax]`` so q→0/1 are exact. Empty
+    sketch → NaN per quantile.
+    """
+    n_buckets = pos.shape[0]
+    i = jnp.arange(n_buckets, dtype=jnp.float32)
+    # midpoint of bucket i's (γ^(i-1-offset), γ^(i-offset)] magnitude range
+    est = jnp.float32(2.0 / (gamma + 1.0)) * jnp.exp(
+        (i - jnp.float32(offset)) * jnp.float32(math.log(gamma))
+    )
+    counts = jnp.concatenate([neg[::-1], zero[None].astype(neg.dtype), pos])
+    values = jnp.concatenate([-est[::-1], jnp.zeros(1, jnp.float32), est])
+    cum = jnp.cumsum(counts)
+    total = cum[-1]
+    qs = jnp.asarray(tuple(quantiles), jnp.float32)
+    ranks = qs * (total - 1).astype(jnp.float32)
+    picked = jnp.searchsorted(cum, ranks, side="right")
+    out = values[jnp.clip(picked, 0, counts.shape[0] - 1)]
+    out = jnp.clip(out, vmin, vmax)
+    # q→0/1 answer the EXACT observed extremes (the min/max states exist for this)
+    out = jnp.where(qs <= 0.0, vmin, jnp.where(qs >= 1.0, vmax, out))
+    return jnp.where(total > 0, out, jnp.float32(jnp.nan))
+
+
+# --------------------------------------------------------------------- HyperLogLog
+
+
+def hll_update(registers: Array, values: Array, *, p: int) -> Array:
+    """Scatter-max each value's leading-zero rank into its register.
+
+    ``registers`` has shape ``(2^p,)``; the top ``p`` hash bits pick the
+    register, the remaining ``32-p`` bits give rank ``clz+1`` (capped at
+    ``32-p+1`` when they are all zero).
+    """
+    v = jnp.ravel(jnp.asarray(values))
+    if v.size == 0:
+        return registers
+    h = hash32(v)
+    idx = (h >> (32 - p)).astype(jnp.int32)
+    rank = jnp.minimum(_clz32(h << p) + 1, 32 - p + 1).astype(registers.dtype)
+    return registers.at[idx].max(rank)
+
+
+def hll_estimate(registers: Array) -> Array:
+    """Bias-corrected harmonic-mean estimate with linear-counting fallback."""
+    m = registers.shape[0]
+    if m == 16:
+        alpha = 0.673
+    elif m == 32:
+        alpha = 0.697
+    elif m == 64:
+        alpha = 0.709
+    else:
+        alpha = 0.7213 / (1.0 + 1.079 / m)
+    harm = jnp.sum(jnp.exp2(-registers.astype(jnp.float32)))
+    raw = jnp.float32(alpha * m * m) / harm
+    zeros = jnp.sum(registers == 0).astype(jnp.float32)
+    linear = jnp.float32(m) * jnp.log(jnp.float32(m) / jnp.maximum(zeros, 1.0))
+    return jnp.where((raw <= 2.5 * m) & (zeros > 0), linear, raw)
+
+
+# ----------------------------------------------------------- count-min + top-k
+
+
+def _row_seeds(depth: int) -> np.ndarray:
+    """Static per-row hash seeds (identical across processes by construction)."""
+    return np.asarray([_mix32_py((j + 1) * _GOLD) for j in range(depth)], np.uint32)
+
+
+def _cm_columns(ids: Array, depth: int, width: int) -> Array:
+    """Per-row column index of each id: shape ``(*ids.shape, depth)``."""
+    seeds = jnp.asarray(_row_seeds(depth))
+    h = _mix32(_as_uint32_bits(ids)[..., None] ^ seeds)
+    return (h % jnp.uint32(width)).astype(jnp.int32)
+
+
+def cms_update(counts: Array, ledger: Array, values: Array) -> Tuple[Array, Array]:
+    """One batch through the count-min table AND the top-k candidate ledger.
+
+    The ledger scan is sequential per item (a replacement decision depends on
+    the previous one) but fixed-shape — ``lax.scan`` keeps it inside the trace.
+    An item already in the ledger refreshes its count to the (monotone)
+    count-min estimate; otherwise it evicts the current minimum slot iff its
+    estimate exceeds that slot's count. Empty slots are ``[-1, 0]``, so they
+    are evicted first. Item ids must be non-negative int32.
+    """
+    depth, width = counts.shape
+    k = ledger.shape[0]
+    ids = jnp.ravel(jnp.asarray(values)).astype(jnp.int32)
+    if ids.size == 0:
+        return counts, ledger
+    rows = jnp.arange(depth, dtype=jnp.int32)
+    slot_iota = jnp.arange(k, dtype=jnp.int32)
+
+    def step(carry: Tuple[Array, Array], x: Array) -> Tuple[Tuple[Array, Array], None]:
+        counts, ledger = carry
+        # a negative id is INVALID (it would alias the -1 empty-slot marker:
+        # `keys == x` would match every empty slot and poison their counts,
+        # silently degrading insertion forever) — it must contribute nothing
+        valid = x >= 0
+        cols = _cm_columns(x, depth, width)  # (depth,)
+        counts = counts.at[rows, cols].add(jnp.where(valid, 1, 0))
+        est = jnp.min(counts[rows, cols])
+        keys, cnts = ledger[:, 0], ledger[:, 1]
+        present = (keys == x) & valid
+        cnts = jnp.where(present, jnp.maximum(cnts, est), cnts)
+        min_i = jnp.argmin(cnts)
+        evict = valid & (~jnp.any(present)) & (est > cnts[min_i])
+        sel = (slot_iota == min_i) & evict
+        keys = jnp.where(sel, x, keys)
+        cnts = jnp.where(sel, est, cnts)
+        return (counts, jnp.stack([keys, cnts], axis=1)), None
+
+    (counts, ledger), _ = lax.scan(step, (counts, ledger), ids)
+    return counts, ledger
+
+
+def cms_query(counts: Array, keys: Array) -> Array:
+    """Count-min point estimate per key (0 for the ``-1`` empty-slot marker).
+
+    Never underestimates a true count; overestimates by at most the usual
+    count-min bound (≈ e·N/width with probability 1 - e^-depth).
+    """
+    depth, width = counts.shape
+    ids = jnp.asarray(keys).astype(jnp.int32)
+    cols = _cm_columns(ids, depth, width)  # (..., depth)
+    est = jnp.min(counts[jnp.arange(depth, dtype=jnp.int32), cols], axis=-1)
+    return jnp.where(ids >= 0, est, jnp.zeros_like(est))
+
+
+def hh_rank(counts: Array, ledger: Array) -> Tuple[Array, Array]:
+    """The heavy-hitter ANSWER: every ledger candidate re-estimated against the
+    count-min table, sorted by estimate descending (ties broken by key, so the
+    order is total and deterministic). Returns ``(keys, counts)``; ``-1``/``0``
+    pad unused slots. The single source of truth for
+    ``HeavyHittersSketch.compute`` AND ``approx_heavy_hitters`` — the two are
+    contractually bit-identical on the same stream.
+    """
+    keys = ledger[:, 0]
+    est = cms_query(counts, keys)
+    score = jnp.where(keys >= 0, est, -1)
+    order = jnp.lexsort((keys, score))[::-1]
+    live = score[order] >= 0
+    return jnp.where(live, keys[order], -1), jnp.where(live, est[order], 0)
+
+
+def topk_merge(stacked: Array) -> Array:
+    """Merge ``(..., k, 2)`` stacked candidate ledgers into one ``(k, 2)`` ledger.
+
+    Union of candidates → per-key count SUM over every occurrence → top-k by
+    ``(count, key)`` descending. Keys are unique after the union, so the
+    (count, key) sort keys are distinct and the result is independent of
+    operand order — the merge is commutative bit-for-bit. Associativity is
+    exact while the union fits ``k`` slots; beyond that the k-truncation is
+    the standard candidate-set approximation (compute re-estimates counts
+    against the exactly-merged count-min table anyway).
+
+    This is the ``dist_reduce_fx`` the comm plane calls with ``(world, k, 2)``
+    and ``merge_states`` calls with ``(2, k, 2)``.
+    """
+    led = jnp.asarray(stacked)
+    k = led.shape[-2]
+    flat = led.reshape(-1, 2)
+    keys, cnts = flat[:, 0], flat[:, 1]
+    valid = keys >= 0
+    cnts = jnp.where(valid, cnts, 0)
+    same = (keys[:, None] == keys[None, :]) & valid[:, None] & valid[None, :]
+    tot = jnp.sum(jnp.where(same, cnts[None, :], 0), axis=1)
+    dup = jnp.tril(same, -1).any(axis=1)  # a later occurrence of an earlier key
+    score = jnp.where(valid & ~dup, tot, -1)
+    order = jnp.lexsort((keys, score))[::-1][:k]
+    live = score[order] > 0
+    out_keys = jnp.where(live, keys[order], -1)
+    out_cnts = jnp.where(live, score[order], 0)
+    return jnp.stack([out_keys, out_cnts], axis=1).astype(led.dtype)

@@ -1,0 +1,231 @@
+"""Mergeable sketch metrics — streaming-analytics workloads on the ``Metric`` core.
+
+Each sketch registers ONLY fixed-shape array states via ``add_state`` with a
+mergeable ``dist_reduce_fx`` (``sum``/``min``/``max`` strings, plus the
+:func:`~metrics_tpu.sketch.kernels.topk_merge` callable for the heavy-hitter
+candidate ledger), so the whole serving stack composes with no new machinery:
+
+- ``StreamingEngine`` serves them on the FUSED path — updates are pure
+  scatter/add/max ops that trace inside the masked-scan bucket kernels, one
+  compiled kernel per (signature, bucket, capacity) like any sum state;
+- sliding windows ride ``merge_states`` (mergeability is what makes window
+  rings cheap: segment fold = the same reduction the cross-rank sync uses);
+- the comm planner coalesces every leaf into flat same-shape buffers — a
+  sketch sync never touches the ragged pad-to-max path an exact ``cat`` state
+  of the same stream pays;
+- ckpt snapshots + per-chunk WAL replay and follower replication are
+  bit-identical because the states are integer adds/maxes (and exact float
+  min/max), which replay in any chunking without drift.
+
+Accuracy contracts (gated by ``tests/sketch/test_accuracy.py`` against exact
+oracles): :class:`QuantileSketch` relative error ≤ α within the trackable
+range; :class:`CardinalitySketch` standard error ≈ ``1.04/√(2^p)``;
+:class:`HeavyHittersSketch` never underestimates a count and recalls every
+item above its threshold share for adequate ``width``/``k``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.metric import Metric, zero_state
+from metrics_tpu.sketch import kernels
+from metrics_tpu.utils.prints import rank_zero_warn
+
+__all__ = ["CardinalitySketch", "HeavyHittersSketch", "QuantileSketch"]
+
+
+class QuantileSketch(Metric):
+    """DDSketch-style streaming quantiles with relative-error guarantee ``alpha``.
+
+    State is two ``n_buckets`` int32 log-bucket stores (positive/negative
+    magnitudes), an exact zero count, and exact running min/max — ~16KiB at
+    the default 2048 buckets, regardless of stream length. Quantile answers
+    are within ``alpha`` relative error for magnitudes in
+    ``[min_trackable, min_trackable·γ^(n_buckets-1)]`` (≈ ``1e-8 .. 5e9`` at
+    the defaults); smaller nonzero magnitudes collapse into the lowest bucket.
+
+    Args:
+        quantiles: which quantiles ``compute()`` returns, each in ``[0, 1]``.
+        alpha: relative-error target, e.g. ``0.01`` = 1%.
+        n_buckets: buckets per sign store (memory/range trade-off).
+        min_trackable: smallest magnitude tracked at full guarantee.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.sketch import QuantileSketch
+        >>> m = QuantileSketch(quantiles=(0.5,), alpha=0.01)
+        >>> m.update(jnp.arange(1.0, 101.0))
+        >>> bool(abs(m.compute() - 50.0) <= 1.0)  # a single quantile squeezes to a scalar
+        True
+    """
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = False
+
+    def __init__(
+        self,
+        quantiles: Sequence[float] = (0.5, 0.9, 0.99),
+        alpha: float = 0.01,
+        n_buckets: int = 2048,
+        min_trackable: float = 1e-8,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        qs = tuple(float(q) for q in quantiles)
+        if not qs or any(not 0.0 <= q <= 1.0 for q in qs):
+            raise ValueError(f"`quantiles` must be non-empty values in [0, 1], got {quantiles!r}")
+        if int(n_buckets) < 2:
+            raise ValueError(f"`n_buckets` must be >= 2, got {n_buckets}")
+        self.quantiles = qs
+        self.alpha = float(alpha)
+        self.n_buckets = int(n_buckets)
+        self.min_trackable = float(min_trackable)
+        self._gamma, self._log_gamma, self._offset = kernels.ddsketch_params(
+            self.alpha, self.min_trackable
+        )
+        # the trackable ceiling is min_trackable·γ^(B-1): with few buckets at a
+        # tight alpha it can silently land BELOW ordinary data (e.g. 2048→512
+        # buckets at α=0.01 drops the ceiling from ~5e9 to ~3e-4, clipping
+        # every value into the top bucket) — make that misconfiguration loud
+        max_trackable = self.min_trackable * self._gamma ** (self.n_buckets - 1)
+        if max_trackable < 1.0:
+            rank_zero_warn(
+                f"QuantileSketch(alpha={self.alpha}, n_buckets={self.n_buckets}, "
+                f"min_trackable={self.min_trackable}) only tracks magnitudes up to "
+                f"{max_trackable:.3g} at the α guarantee — larger values clip into the "
+                "top bucket. Raise `n_buckets`, `alpha`, or `min_trackable` so the "
+                "range covers your data.",
+                UserWarning,
+            )
+        self.add_state("pos_buckets", zero_state(self.n_buckets, jnp.int32), dist_reduce_fx="sum")
+        self.add_state("neg_buckets", zero_state(self.n_buckets, jnp.int32), dist_reduce_fx="sum")
+        self.add_state("zero_count", zero_state((), jnp.int32), dist_reduce_fx="sum")
+        self.add_state("min_value", jnp.asarray(jnp.inf, jnp.float32), dist_reduce_fx="min")
+        self.add_state("max_value", jnp.asarray(-jnp.inf, jnp.float32), dist_reduce_fx="max")
+
+    def update(self, value: Union[float, Array]) -> None:
+        (
+            self.pos_buckets,
+            self.neg_buckets,
+            self.zero_count,
+            self.min_value,
+            self.max_value,
+        ) = kernels.ddsketch_update(
+            self.pos_buckets,
+            self.neg_buckets,
+            self.zero_count,
+            self.min_value,
+            self.max_value,
+            value,
+            log_gamma=self._log_gamma,
+            offset=self._offset,
+        )
+
+    def compute(self) -> Array:
+        """One estimate per configured quantile (NaN before any update)."""
+        return kernels.ddsketch_quantiles(
+            self.pos_buckets,
+            self.neg_buckets,
+            self.zero_count,
+            self.min_value,
+            self.max_value,
+            self.quantiles,
+            gamma=self._gamma,
+            offset=self._offset,
+        )
+
+
+class CardinalitySketch(Metric):
+    """HyperLogLog distinct-count estimator over ``m = 2^p`` dense registers.
+
+    Standard error ≈ ``1.04/√m`` (≈1.6% at the default ``p=12``, 16KiB of
+    int32 registers). Identity is the 32-bit pattern of the value (float32
+    bits for floats, int32 for ints). Merge is elementwise register max —
+    exact, order-independent, idempotent (re-merging a replica is harmless).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.sketch import CardinalitySketch
+        >>> m = CardinalitySketch(p=10)
+        >>> m.update(jnp.arange(300))
+        >>> bool(abs(m.compute() - 300) <= 30)
+        True
+    """
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = False
+
+    def __init__(self, p: int = 12, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not 4 <= int(p) <= 16:
+            raise ValueError(f"`p` must be in [4, 16], got {p}")
+        self.p = int(p)
+        self.add_state("registers", zero_state(1 << self.p, jnp.int32), dist_reduce_fx="max")
+
+    def update(self, value: Union[float, Array]) -> None:
+        self.registers = kernels.hll_update(self.registers, value, p=self.p)
+
+    def compute(self) -> Array:
+        """Estimated number of distinct values seen (float32 scalar)."""
+        return kernels.hll_estimate(self.registers)
+
+
+class HeavyHittersSketch(Metric):
+    """Count-min heavy hitters with a top-``k`` candidate ledger.
+
+    State is a ``depth×width`` int32 count-min table (merge: sum, exact) and a
+    ``(k, 2)`` ``[key, count]`` candidate ledger (merge:
+    :func:`~metrics_tpu.sketch.kernels.topk_merge` — a CALLABLE
+    ``dist_reduce_fx`` on a fixed-shape leaf, which the comm planner coalesces
+    like any reducible state). Items must be non-negative int32 ids (hash
+    strings host-side first); ``-1`` marks an empty ledger slot.
+
+    ``compute()`` re-estimates every candidate against the (exactly merged)
+    count-min table, so estimates never undercount, and returns the
+    candidates sorted by estimated count descending.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.sketch import HeavyHittersSketch
+        >>> m = HeavyHittersSketch(k=4)
+        >>> m.update(jnp.asarray([7, 7, 7, 3, 3, 9]))
+        >>> keys, counts = m.compute()
+        >>> int(keys[0]), int(counts[0])
+        (7, 3)
+    """
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = False
+
+    def __init__(self, k: int = 32, depth: int = 4, width: int = 2048, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if int(k) < 1:
+            raise ValueError(f"`k` must be >= 1, got {k}")
+        if int(depth) < 1 or int(width) < 2:
+            raise ValueError(f"`depth` must be >= 1 and `width` >= 2, got {depth}x{width}")
+        self.k = int(k)
+        self.depth = int(depth)
+        self.width = int(width)
+        self.add_state(
+            "counts", zero_state((self.depth, self.width), jnp.int32), dist_reduce_fx="sum"
+        )
+        empty = jnp.stack(
+            [jnp.full((self.k,), -1, jnp.int32), jnp.zeros((self.k,), jnp.int32)], axis=1
+        )
+        self.add_state("ledger", empty, dist_reduce_fx=kernels.topk_merge)
+
+    def update(self, value: Union[int, Array]) -> None:
+        self.counts, self.ledger = kernels.cms_update(self.counts, self.ledger, value)
+
+    def compute(self) -> Tuple[Array, Array]:
+        """``(keys, counts)``: the candidate ids (``-1`` pads unused slots) and
+        their count-min estimates, sorted by count descending (key-id ties
+        broken deterministically)."""
+        return kernels.hh_rank(self.counts, self.ledger)

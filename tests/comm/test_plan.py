@@ -59,10 +59,35 @@ class TestRouting:
         plan = build_plan(state, {"vals": "cat"}, CodecPolicy())
         assert [lf.route for lf in plan.leaves] == ["skip", "coalesce"]
 
-    def test_callable_and_none_reductions_go_ragged(self):
-        state = {"a": jnp.zeros(4), "b": jnp.zeros(4)}
-        plan = build_plan(state, {"a": lambda g: g.sum(0), "b": None}, CodecPolicy())
+    def test_none_reductions_go_ragged(self):
+        state = {"a": jnp.zeros(4)}
+        plan = build_plan(state, {"a": None}, CodecPolicy())
         assert all(lf.route == "ragged" for lf in plan.leaves)
+
+    def test_callable_fixed_shape_coalesces(self):
+        # regression (ISSUE 7 satellite): a callable dist_reduce_fx on a
+        # fixed-shape array leaf used to route to the broadcast/ragged branch —
+        # per-leaf shape gathers + pad-to-max for a state whose shape is
+        # identical on every rank by construction. It must coalesce, and its
+        # buffer must NOT take the buffer-level fast reduce (the callable sees
+        # rank-stacked leaf rows, not a flat elementwise op).
+        state = {"ledger": jnp.zeros((8, 2), jnp.int32), "tot": jnp.zeros((), jnp.int32)}
+        plan = build_plan(state, {"ledger": lambda g: g.sum(0), "tot": "sum"}, CodecPolicy())
+        routes = {lf.name: lf.route for lf in plan.leaves}
+        assert routes["ledger"] == "coalesce"
+        assert routes["tot"] == "coalesce"
+        callable_buf = next(b for b in plan.buffers if b.op == "callable")
+        assert not callable_buf.fast
+        assert [s.leaf for s in callable_buf.slots] == ["ledger"]
+        # the string-op buffer keeps its fast path
+        sum_buf = next(b for b in plan.buffers if b.op == "sum")
+        assert sum_buf.fast
+
+    def test_callable_coalesce_off_still_not_fast(self):
+        state = {"ledger": jnp.zeros((8, 2), jnp.int32)}
+        plan = build_plan(state, {"ledger": lambda g: g.sum(0)}, CodecPolicy(), coalesce=False)
+        assert [lf.route for lf in plan.leaves] == ["coalesce"]
+        assert all(not b.fast for b in plan.buffers if b.op == "callable")
 
 
 class TestChunking:

@@ -145,6 +145,34 @@ class TestLosslessParity:
             assert int(out["_update_count"]) == 2
 
 
+class TestCoalescedShapeGuard:
+    """A 'fixed-shape' leaf whose shape actually diverges across ranks must
+    fail LOUDLY on the coalesced path (each rank plans from its local shape;
+    slicing a peer's differently-sized buffer with local offsets would reduce
+    garbage silently). Registered states can't hit this — a hand-built state
+    with a callable reduce can."""
+
+    def test_divergent_callable_leaf_raises_not_corrupts(self):
+        from metrics_tpu.comm import LoopbackWorld
+
+        states = [
+            {"w": jnp.zeros(10, jnp.float32)},
+            {"w": jnp.zeros(7, jnp.float32)},
+        ]
+        reds = {"w": lambda g: g.sum(0)}
+        lw = LoopbackWorld(2)
+        outs = lw.run(
+            [
+                lambda t, r=r: sync_pytree(states[r], reds, transport=t)
+                for r in range(2)
+            ]
+        )
+        # the loud failure is absorbed by the retry ladder, which exhausts and
+        # degrades to LOCAL state flagged stale — never a silently-wrong reduce
+        for r, out in enumerate(outs):
+            np.testing.assert_array_equal(np.asarray(out["w"]), np.asarray(states[r]["w"]))
+
+
 class TestUpdateCountGuard:
     """Satellite: ``_update_count`` listed in ``reductions`` must reduce ONCE."""
 

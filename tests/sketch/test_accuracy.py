@@ -1,0 +1,177 @@
+"""Accuracy gates vs exact oracles (ISSUE 7 satellite).
+
+Each gate is the sketch's published contract, checked against an exact
+computation on the same stream with FIXED seeds (the hash functions are
+deterministic, so these are regression gates, not flaky statistical tests):
+
+- DDSketch: every configured quantile within relative error ``alpha`` of the
+  exact rank-``floor(q·(n-1))`` element (``np.quantile(..., method="lower")``
+  — the rank convention the bucket walk targets);
+- HyperLogLog: ``|est - true| ≤ 3·1.04/√m · true`` (3σ of the published
+  standard error);
+- Count-min heavy hitters: every id above the threshold share is recalled,
+  estimates never undercount, and overcount stays within the count-min
+  ``ε·N`` envelope.
+
+The ``-m slow`` soak re-runs the gates at production-ish stream sizes through
+the MODULE metrics (accumulated across many update calls, not one-shot).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.functional.sketch import (
+    approx_count_distinct,
+    approx_heavy_hitters,
+    approx_quantiles,
+)
+from metrics_tpu.sketch import CardinalitySketch, HeavyHittersSketch, QuantileSketch
+
+
+def _dd_rel_err(est, vals, q):
+    oracle = float(np.quantile(vals, q, method="lower"))
+    return abs(float(est) - oracle) / max(abs(oracle), 1e-12)
+
+
+DD_STREAMS = [
+    # (name, generator, quantiles) — quantile targets keep |oracle| well away
+    # from zero (magnitudes below min_trackable collapse by design)
+    ("lognormal", lambda rng, n: rng.lognormal(0.0, 2.0, n), (0.01, 0.25, 0.5, 0.9, 0.99)),
+    ("uniform", lambda rng, n: rng.uniform(1.0, 1e4, n), (0.05, 0.5, 0.95)),
+    ("neg_lognormal", lambda rng, n: -rng.lognormal(1.0, 1.0, n), (0.1, 0.5, 0.9)),
+    ("mixed_sign", lambda rng, n: rng.standard_normal(n) * 100.0, (0.05, 0.2, 0.8, 0.95)),
+]
+
+
+class TestQuantileAccuracy:
+    @pytest.mark.parametrize("name,gen,qs", DD_STREAMS, ids=[s[0] for s in DD_STREAMS])
+    @pytest.mark.parametrize("seed", (0, 1, 2))
+    def test_rel_err_le_alpha(self, name, gen, qs, seed):
+        alpha = 0.01
+        rng = np.random.default_rng(seed)
+        vals = gen(rng, 20_000).astype(np.float32)
+        ests = approx_quantiles(jnp.asarray(vals), qs, alpha=alpha)
+        for q, est in zip(qs, np.asarray(ests)):
+            err = _dd_rel_err(est, vals, q)
+            assert err <= alpha, f"{name} seed={seed} q={q}: rel err {err:.5f} > {alpha}"
+
+    def test_coarser_alpha_still_bounded(self):
+        rng = np.random.default_rng(3)
+        vals = rng.lognormal(0, 1, 10_000).astype(np.float32)
+        for alpha in (0.05, 0.1):
+            ests = approx_quantiles(jnp.asarray(vals), (0.5, 0.99), alpha=alpha, n_buckets=512)
+            for q, est in zip((0.5, 0.99), np.asarray(ests)):
+                assert _dd_rel_err(est, vals, q) <= alpha
+
+    def test_extremes_exact(self):
+        rng = np.random.default_rng(4)
+        vals = rng.lognormal(0, 2, 5_000).astype(np.float32)
+        ests = np.asarray(approx_quantiles(jnp.asarray(vals), (0.0, 1.0)))
+        assert float(ests[0]) == float(vals.min())
+        assert float(ests[1]) == float(vals.max())
+
+
+class TestCardinalityAccuracy:
+    @pytest.mark.parametrize("true_n", (100, 3_000, 30_000))
+    @pytest.mark.parametrize("seed", (0, 1, 2))
+    def test_within_3_sigma(self, true_n, seed):
+        p = 12
+        tol = 3 * 1.04 / np.sqrt(1 << p)
+        rng = np.random.default_rng(seed)
+        ids = rng.choice(10_000_000, size=true_n, replace=False)
+        stream = rng.choice(ids, size=max(true_n * 2, 1_000))  # repeats don't count
+        stream = np.concatenate([ids, stream])  # every id seen at least once
+        est = float(approx_count_distinct(jnp.asarray(stream, jnp.int32), p=p))
+        assert abs(est - true_n) / true_n <= tol, f"n={true_n} seed={seed}: est {est:.0f}"
+
+    def test_small_range_linear_counting_tight(self):
+        est = float(approx_count_distinct(jnp.arange(50, dtype=jnp.int32), p=12))
+        assert abs(est - 50) <= 2
+
+
+def _hh_stream(rng, n_heavy=20, heavy_count=600, n_noise=15_000, id_space=100_000):
+    heavy_ids = rng.choice(np.arange(1000, 1000 + 10 * n_heavy), size=n_heavy, replace=False)
+    heavy = np.repeat(heavy_ids, heavy_count)
+    noise = rng.integers(10_000, 10_000 + id_space, n_noise)
+    stream = np.concatenate([heavy, noise]).astype(np.int32)
+    rng.shuffle(stream)
+    true_counts = {int(i): heavy_count for i in heavy_ids}
+    for i in noise:
+        true_counts[int(i)] = true_counts.get(int(i), 0) + 1
+    return stream, set(int(i) for i in heavy_ids), true_counts
+
+
+class TestHeavyHitterAccuracy:
+    @pytest.mark.parametrize("seed", (0, 1, 2))
+    def test_recall_and_count_envelope(self, seed):
+        rng = np.random.default_rng(seed)
+        width, depth = 2048, 4
+        stream, heavy_ids, true_counts = _hh_stream(rng)
+        keys, counts = approx_heavy_hitters(
+            jnp.asarray(stream), k=32, depth=depth, width=width
+        )
+        keys = np.asarray(keys)
+        counts = np.asarray(counts)
+        reported = {int(k): int(c) for k, c in zip(keys, counts) if k >= 0}
+        missed = heavy_ids - set(reported)
+        assert not missed, f"seed={seed}: heavy ids missed (recall < 1): {sorted(missed)[:5]}"
+        eps_n = np.e * len(stream) / width  # the classic count-min envelope
+        for hid in heavy_ids:
+            true = true_counts[hid]
+            est = reported[hid]
+            assert est >= true, f"seed={seed} id={hid}: undercount {est} < {true}"
+            assert est - true <= 2 * eps_n, f"seed={seed} id={hid}: overcount {est - true}"
+        # output is sorted by estimate descending
+        live = counts[keys >= 0]
+        assert (np.diff(live) <= 0).all()
+
+
+@pytest.mark.slow
+class TestLargeStreamSoak:
+    """Production-ish stream sizes through the MODULE metrics (many update
+    calls), so the accumulate path — not just the one-shot twins — holds the
+    published bounds."""
+
+    def test_quantile_million_values(self):
+        alpha = 0.01
+        rng = np.random.default_rng(10)
+        m = QuantileSketch(quantiles=(0.5, 0.9, 0.99, 0.999), alpha=alpha)
+        chunks = [rng.lognormal(0.0, 2.0, 10_000).astype(np.float32) for _ in range(100)]
+        for c in chunks:
+            m.update(jnp.asarray(c))
+        vals = np.concatenate(chunks)
+        for q, est in zip(m.quantiles, np.asarray(m.compute())):
+            err = _dd_rel_err(est, vals, q)
+            assert err <= alpha, f"q={q}: rel err {err:.5f} > {alpha}"
+
+    def test_cardinality_200k_distinct(self):
+        p = 14
+        tol = 3 * 1.04 / np.sqrt(1 << p)
+        rng = np.random.default_rng(11)
+        m = CardinalitySketch(p=p)
+        true_n = 200_000
+        ids = rng.choice(2**30, size=true_n, replace=False).astype(np.int32)
+        for lo in range(0, true_n, 20_000):
+            m.update(jnp.asarray(ids[lo : lo + 20_000]))
+            m.update(jnp.asarray(rng.choice(ids, 5_000).astype(np.int32)))  # repeats
+        est = float(m.compute())
+        assert abs(est - true_n) / true_n <= tol, f"est {est:.0f} vs {true_n}"
+
+    def test_heavy_hitters_200k_stream(self):
+        rng = np.random.default_rng(12)
+        width = 4096
+        m = HeavyHittersSketch(k=64, depth=4, width=width)
+        stream, heavy_ids, true_counts = _hh_stream(
+            rng, n_heavy=30, heavy_count=4_000, n_noise=80_000, id_space=500_000
+        )
+        for lo in range(0, len(stream), 10_000):
+            m.update(jnp.asarray(stream[lo : lo + 10_000]))
+        keys, counts = m.compute()
+        reported = {int(k): int(c) for k, c in zip(np.asarray(keys), np.asarray(counts)) if k >= 0}
+        missed = heavy_ids - set(reported)
+        assert not missed, f"heavy ids missed: {sorted(missed)[:5]}"
+        eps_n = np.e * len(stream) / width
+        for hid in heavy_ids:
+            assert reported[hid] >= true_counts[hid]
+            assert reported[hid] - true_counts[hid] <= 2 * eps_n

@@ -1,0 +1,81 @@
+"""In-trace sketch sync: ``Metric.sync_state`` / ``compute_from(axis_name=)``
+under ``shard_map`` on the CPU mesh — the fused-training-step path. The
+register max lowers to ``pmax``, the bucket/count sums to ``psum``, and the
+callable ledger merge to an ``all_gather`` + ``topk_merge`` over the
+world-stacked axis."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from metrics_tpu.sketch import CardinalitySketch, HeavyHittersSketch, QuantileSketch, kernels
+from tests.helpers.testers import mesh_world
+
+
+@pytest.fixture
+def mesh(devices):
+    world = mesh_world()
+    return Mesh(np.array(devices[:world]).reshape(world), ("dp",))
+
+
+def _per_rank_states(metric, batches):
+    return [metric.update_state(metric.init_state(), jnp.asarray(b)) for b in batches]
+
+
+def _sync_sharded(metric, states, mesh):
+    """Run metric.sync_state over the mesh axis with each rank holding its own
+    accumulated state (stacked along the leading axis)."""
+    world = len(states)
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *states)
+
+    def rank_fn(st):
+        squeezed = jax.tree_util.tree_map(lambda x: x[0], st)
+        return metric.sync_state(squeezed, "dp")
+
+    return shard_map(
+        rank_fn, mesh=mesh, in_specs=P("dp"), out_specs=P(), check_rep=False
+    )(stacked)
+
+
+def test_quantile_in_trace_sync_matches_centralized(mesh):
+    world = mesh_world()
+    rng = np.random.default_rng(0)
+    metric = QuantileSketch()
+    batches = [rng.lognormal(0, 1, 32).astype(np.float32) for _ in range(world)]
+    synced = _sync_sharded(metric, _per_rank_states(metric, batches), mesh)
+    oracle = metric.update_state(metric.init_state(), jnp.asarray(np.concatenate(batches)))
+    for name in metric._defaults:
+        np.testing.assert_array_equal(
+            np.asarray(synced[name]), np.asarray(oracle[name]), err_msg=name
+        )
+
+
+def test_cardinality_in_trace_sync_is_register_pmax(mesh):
+    world = mesh_world()
+    rng = np.random.default_rng(1)
+    metric = CardinalitySketch(p=6)
+    batches = [rng.integers(0, 500, 40).astype(np.int32) for _ in range(world)]
+    states = _per_rank_states(metric, batches)
+    synced = _sync_sharded(metric, states, mesh)
+    want = np.maximum.reduce([np.asarray(s["registers"]) for s in states])
+    np.testing.assert_array_equal(np.asarray(synced["registers"]), want)
+
+
+def test_heavy_hitter_ledger_in_trace_gather_merge(mesh):
+    world = mesh_world()
+    rng = np.random.default_rng(2)
+    metric = HeavyHittersSketch(k=8, depth=3, width=64)
+    batches = [rng.integers(0, 8, 40).astype(np.int32) for _ in range(world)]
+    states = _per_rank_states(metric, batches)
+    synced = _sync_sharded(metric, states, mesh)
+    np.testing.assert_array_equal(
+        np.asarray(synced["counts"]),
+        np.sum([np.asarray(s["counts"]) for s in states], axis=0),
+    )
+    want_ledger = np.asarray(kernels.topk_merge(jnp.stack([s["ledger"] for s in states])))
+    np.testing.assert_array_equal(np.asarray(synced["ledger"]), want_ledger)

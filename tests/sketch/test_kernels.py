@@ -1,0 +1,199 @@
+"""Unit tests for the pure sketch kernel layer (metrics_tpu/sketch/kernels.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.sketch import kernels
+from metrics_tpu.sketch.kernels import (
+    _clz32,
+    _mix32_py,
+    cms_query,
+    cms_update,
+    ddsketch_params,
+    ddsketch_quantiles,
+    ddsketch_update,
+    hash32,
+    hll_estimate,
+    hll_update,
+    topk_merge,
+)
+
+
+def _fresh_dd(n_buckets=512):
+    return (
+        jnp.zeros(n_buckets, jnp.int32),
+        jnp.zeros(n_buckets, jnp.int32),
+        jnp.zeros((), jnp.int32),
+        jnp.asarray(jnp.inf, jnp.float32),
+        jnp.asarray(-jnp.inf, jnp.float32),
+    )
+
+
+def _fresh_hh(k=8, depth=4, width=128):
+    counts = jnp.zeros((depth, width), jnp.int32)
+    ledger = jnp.stack([jnp.full((k,), -1, jnp.int32), jnp.zeros((k,), jnp.int32)], axis=1)
+    return counts, ledger
+
+
+class TestHashing:
+    def test_clz32_exact(self):
+        xs = np.asarray(
+            [0, 1, 2, 3, 7, 8, 255, 256, 0x7FFFFFFF, 0x80000000, 0xFFFFFFFF], np.uint32
+        )
+        got = np.asarray(_clz32(jnp.asarray(xs)))
+        want = [32 if x == 0 else 32 - int(x).bit_length() for x in xs]
+        np.testing.assert_array_equal(got, want)
+
+    def test_hash32_matches_host_mixer(self):
+        # device hash of int ids == the host murmur3 finalizer (seed folding included)
+        ids = np.asarray([0, 1, 2, 12345, 2**31 - 1], np.int64)
+        got = np.asarray(hash32(jnp.asarray(ids, jnp.int32)))
+        seed = _mix32_py(0 ^ 0x9E3779B9)
+        want = np.asarray([_mix32_py(int(x) ^ seed) for x in ids], np.uint32)
+        np.testing.assert_array_equal(got, want)
+
+    def test_floats_hash_by_float32_bits(self):
+        a = np.asarray(hash32(jnp.asarray([1.0, 1.0], jnp.float32)))
+        assert a[0] == a[1]
+        b = np.asarray(hash32(jnp.asarray([1.0000001], jnp.float32)))
+        assert b[0] != a[0]
+
+    def test_hash_is_well_spread(self):
+        h = np.asarray(hash32(jnp.arange(4096)))
+        assert len(np.unique(h)) == 4096
+        # top bits (HLL register index at p=8) should be near-uniform
+        idx, counts = np.unique(h >> 24, return_counts=True)
+        assert len(idx) == 256
+        assert counts.max() <= 4 * counts.mean()
+
+
+class TestDDSketch:
+    def test_bucket_guarantee_single_values(self):
+        gamma, log_gamma, offset = ddsketch_params(0.02)
+        for v in (1e-6, 0.5, 1.0, 3.14159, 1e4, 7.7e8):
+            st = ddsketch_update(*_fresh_dd(2048), jnp.asarray([v], jnp.float32),
+                                 log_gamma=log_gamma, offset=offset)
+            q = ddsketch_quantiles(*st, (0.5,), gamma=gamma, offset=offset)
+            # min==max==v, so the clamp makes single-value quantiles exact
+            np.testing.assert_allclose(float(q[0]), v, rtol=1e-6)
+
+    def test_signs_and_zero_routing(self):
+        gamma, log_gamma, offset = ddsketch_params(0.01)
+        st = ddsketch_update(*_fresh_dd(), jnp.asarray([2.0, -3.0, 0.0, 0.0], jnp.float32),
+                             log_gamma=log_gamma, offset=offset)
+        pos, neg, zero, vmin, vmax = st
+        assert int(pos.sum()) == 1 and int(neg.sum()) == 1 and int(zero) == 2
+        assert float(vmin) == -3.0 and float(vmax) == 2.0
+
+    def test_inf_lands_in_top_bucket_deterministically(self):
+        """±inf must NOT go through the float→int32 bucket cast
+        (implementation-defined, backend-divergent — it used to wrap into
+        bucket 0): it lands in the TOP bucket of its sign store, and the exact
+        min/max carry the true ±inf so q→0/1 answer it exactly."""
+        gamma, log_gamma, offset = ddsketch_params(0.01)
+        st = ddsketch_update(
+            *_fresh_dd(2048), jnp.asarray([jnp.inf, jnp.inf, -jnp.inf, 2.0], jnp.float32),
+            log_gamma=log_gamma, offset=offset,
+        )
+        pos, neg, zero, vmin, vmax = st
+        assert int(pos[-1]) == 2 and int(neg[-1]) == 1 and int(pos[0]) == int(neg[0]) == 0
+        assert float(vmin) == -np.inf and float(vmax) == np.inf
+        q = ddsketch_quantiles(*st, (0.0, 0.9, 1.0), gamma=gamma, offset=offset)
+        assert float(q[0]) == -np.inf and float(q[2]) == np.inf
+        assert float(q[1]) > 2.0  # inf outranks every finite value
+
+    def test_nan_contributes_nothing(self):
+        gamma, log_gamma, offset = ddsketch_params(0.01)
+        st = ddsketch_update(*_fresh_dd(), jnp.asarray([jnp.nan, 5.0], jnp.float32),
+                             log_gamma=log_gamma, offset=offset)
+        pos, neg, zero, vmin, vmax = st
+        assert int(pos.sum()) == 1 and int(neg.sum()) == 0 and int(zero) == 0
+        assert float(vmin) == 5.0 and float(vmax) == 5.0
+
+    def test_empty_sketch_is_nan(self):
+        gamma, log_gamma, offset = ddsketch_params(0.01)
+        q = ddsketch_quantiles(*_fresh_dd(), (0.5, 0.99), gamma=gamma, offset=offset)
+        assert np.isnan(np.asarray(q)).all()
+
+    def test_jit_and_vmap_trace(self):
+        gamma, log_gamma, offset = ddsketch_params(0.01)
+
+        @jax.jit
+        def upd(st, v):
+            return ddsketch_update(*st, v, log_gamma=log_gamma, offset=offset)
+
+        st = upd(_fresh_dd(), jnp.asarray([1.0, 2.0], jnp.float32))
+        q = jax.jit(lambda s: ddsketch_quantiles(*s, (0.5,), gamma=gamma, offset=offset))(st)
+        assert np.isfinite(float(q[0]))
+
+
+class TestHLL:
+    def test_registers_monotone_and_idempotent(self):
+        r0 = jnp.zeros(1 << 8, jnp.int32)
+        r1 = hll_update(r0, jnp.arange(100), p=8)
+        r2 = hll_update(r1, jnp.arange(100), p=8)  # same items: no change
+        np.testing.assert_array_equal(np.asarray(r1), np.asarray(r2))
+        assert (np.asarray(r1) >= 0).all() and (np.asarray(r1) <= 32 - 8 + 1).all()
+
+    def test_estimate_zero_when_empty(self):
+        assert float(hll_estimate(jnp.zeros(1 << 8, jnp.int32))) == 0.0
+
+
+class TestCountMinTopK:
+    def test_query_never_underestimates(self):
+        counts, ledger = _fresh_hh()
+        stream = np.asarray([5] * 10 + [7] * 3 + list(range(20, 40)), np.int32)
+        counts, ledger = cms_update(counts, ledger, jnp.asarray(stream))
+        est = np.asarray(cms_query(counts, jnp.asarray([5, 7], jnp.int32)))
+        assert est[0] >= 10 and est[1] >= 3
+
+    def test_empty_slot_queries_zero(self):
+        counts, _ = _fresh_hh()
+        assert int(cms_query(counts, jnp.asarray(-1, jnp.int32))) == 0
+
+    def test_negative_ids_contribute_nothing(self):
+        """A negative id aliases the -1 empty-slot marker: it must not touch
+        the count-min table NOR refresh empty slots' counts (which would stop
+        them being evicted-first and silently lose recall forever)."""
+        counts, ledger = _fresh_hh(k=4)
+        counts2, ledger2 = cms_update(counts, ledger, jnp.asarray([-1, -1, -7], jnp.int32))
+        np.testing.assert_array_equal(np.asarray(counts2), np.asarray(counts))
+        np.testing.assert_array_equal(np.asarray(ledger2), np.asarray(ledger))
+        # real items still insert normally afterwards
+        counts3, ledger3 = cms_update(counts2, ledger2, jnp.asarray([5], jnp.int32))
+        assert 5 in set(int(x) for x in np.asarray(ledger3[:, 0]))
+
+    def test_ledger_holds_all_keys_under_k(self):
+        counts, ledger = _fresh_hh(k=8)
+        counts, ledger = cms_update(counts, ledger, jnp.asarray([3, 1, 4, 1, 5, 9, 2, 6], jnp.int32))
+        keys = set(int(x) for x in np.asarray(ledger[:, 0]) if x >= 0)
+        assert keys == {3, 1, 4, 5, 9, 2, 6}
+
+    def test_topk_merge_dedupes_and_sums(self):
+        a = jnp.asarray([[7, 5], [3, 2], [-1, 0]], jnp.int32)
+        b = jnp.asarray([[7, 4], [9, 1], [-1, 0]], jnp.int32)
+        out = np.asarray(topk_merge(jnp.stack([a, b])))
+        # 7 -> 9, 3 -> 2, 9 -> 1, sorted desc
+        np.testing.assert_array_equal(out, [[7, 9], [3, 2], [9, 1]])
+
+    def test_topk_merge_is_order_independent(self):
+        rng = np.random.default_rng(0)
+        for _ in range(10):
+            a = jnp.asarray(
+                np.stack([rng.integers(0, 6, 5), rng.integers(1, 50, 5)], 1), jnp.int32
+            )
+            b = jnp.asarray(
+                np.stack([rng.integers(0, 6, 5), rng.integers(1, 50, 5)], 1), jnp.int32
+            )
+            ab = np.asarray(topk_merge(jnp.stack([a, b])))
+            ba = np.asarray(topk_merge(jnp.stack([b, a])))
+            np.testing.assert_array_equal(ab, ba)
+
+    def test_topk_merge_truncates_deterministically(self):
+        # 4 distinct keys into k=2 slots: keep the two largest totals
+        a = jnp.asarray([[1, 9], [2, 5]], jnp.int32)
+        b = jnp.asarray([[3, 7], [4, 6]], jnp.int32)
+        out = np.asarray(topk_merge(jnp.stack([a, b])))
+        np.testing.assert_array_equal(out, [[1, 9], [3, 7]])

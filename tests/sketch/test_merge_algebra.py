@@ -1,0 +1,170 @@
+"""Merge-algebra property tests (ISSUE 7 satellite).
+
+For each sketch family, over randomized streams:
+
+- ``merge(A, B) ≡ merge(B, A)`` bit-identically (commutativity);
+- ``merge(merge(A, B), C) ≡ merge(A, merge(B, C))`` bit-identically
+  (associativity — for the heavy-hitter ledger, exact while the candidate
+  union fits ``k`` slots, so those streams draw from ≤ k distinct ids);
+- the update/merge interchange
+  ``merge(update(A, x), update(B, y)) ≡ update(update(merge(A, B), x), y)``
+  bit-identically for the int (and exact float min/max) states. The
+  heavy-hitter LEDGER is the one documented exception: its per-touch count is
+  the local count-min estimate, which legitimately depends on merge order —
+  there the interchange asserts the count-min table bit-identically and the
+  candidate key SET exactly (≤ k distinct ids ⇒ every seen id is a candidate).
+
+Bit-identity (not allclose) is what makes ckpt/WAL replay, follower
+replication and window folds exact: int scatter-adds and register maxes
+commute with any chunking of the stream.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.sketch import CardinalitySketch, HeavyHittersSketch, QuantileSketch
+
+SEEDS = (0, 1, 2, 3, 4)
+
+
+def _assert_states_equal(a, b, msg=""):
+    assert set(a) == set(b), msg
+    for name in a:
+        np.testing.assert_array_equal(
+            np.asarray(a[name]), np.asarray(b[name]), err_msg=f"{msg}: state {name!r}"
+        )
+
+
+def _cases(seed):
+    rng = np.random.default_rng(seed)
+
+    def dd_batch():
+        kind = rng.integers(0, 3)
+        n = int(rng.integers(1, 40))
+        if kind == 0:
+            return jnp.asarray(rng.lognormal(0.0, 2.0, n).astype(np.float32))
+        if kind == 1:
+            return jnp.asarray((rng.standard_normal(n) * 100).astype(np.float32))
+        return jnp.asarray(np.concatenate([np.zeros(2), rng.uniform(-5, 5, n)]).astype(np.float32))
+
+    def hll_batch():
+        return jnp.asarray(rng.integers(0, 10_000, int(rng.integers(1, 40))), jnp.int32)
+
+    def hh_batch():
+        # <= k distinct ids: associativity (and key-set interchange) is exact
+        return jnp.asarray(rng.integers(0, 8, int(rng.integers(1, 40))), jnp.int32)
+
+    return [
+        (QuantileSketch(), dd_batch),
+        (CardinalitySketch(p=6), hll_batch),
+        (HeavyHittersSketch(k=8, depth=3, width=64), hh_batch),
+    ]
+
+
+def _accumulate(metric, batch_fn, n_batches):
+    state = metric.init_state()
+    for _ in range(n_batches):
+        state = metric.update_state(state, batch_fn())
+    return state
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_merge_commutative_bit_identical(seed):
+    for metric, batch_fn in _cases(seed):
+        a = _accumulate(metric, batch_fn, 5)
+        b = _accumulate(metric, batch_fn, 3)
+        _assert_states_equal(
+            jax.device_get(metric.merge_states(a, b)),
+            jax.device_get(metric.merge_states(b, a)),
+            f"{type(metric).__name__} commutativity seed={seed}",
+        )
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_merge_associative_bit_identical(seed):
+    for metric, batch_fn in _cases(seed):
+        a = _accumulate(metric, batch_fn, 4)
+        b = _accumulate(metric, batch_fn, 2)
+        c = _accumulate(metric, batch_fn, 3)
+        _assert_states_equal(
+            jax.device_get(metric.merge_states(metric.merge_states(a, b), c)),
+            jax.device_get(metric.merge_states(a, metric.merge_states(b, c))),
+            f"{type(metric).__name__} associativity seed={seed}",
+        )
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_update_merge_interchange(seed):
+    """merge(update(A,x), update(B,y)) ≡ update(update(merge(A,B),x), y)."""
+    for metric, batch_fn in _cases(seed):
+        a = _accumulate(metric, batch_fn, 3)
+        b = _accumulate(metric, batch_fn, 2)
+        x, y = batch_fn(), batch_fn()
+        lhs = jax.device_get(
+            metric.merge_states(metric.update_state(a, x), metric.update_state(b, y))
+        )
+        rhs = jax.device_get(
+            metric.update_state(metric.update_state(metric.merge_states(a, b), x), y)
+        )
+        if isinstance(metric, HeavyHittersSketch):
+            # the ledger's counts are local count-min estimates — merge-order
+            # dependent by design; the candidate KEY SET and the exactly-merged
+            # count-min table are the interchange contract
+            np.testing.assert_array_equal(lhs["counts"], rhs["counts"])
+            assert lhs["_update_count"] == rhs["_update_count"]
+            lhs_keys = {int(k) for k in lhs["ledger"][:, 0] if k >= 0}
+            rhs_keys = {int(k) for k in rhs["ledger"][:, 0] if k >= 0}
+            assert lhs_keys == rhs_keys, f"candidate sets diverged seed={seed}"
+        else:
+            _assert_states_equal(lhs, rhs, f"{type(metric).__name__} interchange seed={seed}")
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_merge_with_fresh_state_is_identity(seed):
+    """A fresh init state is the merge identity (what window rings rely on for
+    segments a tenant never touched). The heavy-hitter ledger compares in its
+    canonical (count, key)-sorted form: any merge re-sorts the candidate rows,
+    but the [key, count] CONTENT must be untouched."""
+    from metrics_tpu.sketch import kernels
+
+    for metric, batch_fn in _cases(seed):
+        a = dict(_accumulate(metric, batch_fn, 4))
+        merged = dict(metric.merge_states(a, metric.init_state()))
+        if isinstance(metric, HeavyHittersSketch):
+            a["ledger"] = kernels.topk_merge(a["ledger"][None])
+        _assert_states_equal(
+            jax.device_get(a),
+            jax.device_get(merged),
+            f"{type(metric).__name__} identity seed={seed}",
+        )
+
+
+def test_chunking_invariance():
+    """One 64-value update ≡ 64 single-value updates ≡ any split — the property
+    WAL chunk replay and engine row-scan dispatch rest on."""
+    rng = np.random.default_rng(7)
+    vals = rng.lognormal(0, 1, 64).astype(np.float32)
+    ids = rng.integers(0, 20, 64).astype(np.int32)
+    for metric, stream in [
+        (QuantileSketch(), vals),
+        (CardinalitySketch(p=6), ids),
+        (HeavyHittersSketch(k=8, depth=3, width=64), ids),
+    ]:
+        whole = metric.update_state(metric.init_state(), jnp.asarray(stream))
+        rows = metric.init_state()
+        for i in range(len(stream)):
+            rows = metric.update_state(rows, jnp.asarray(stream[i : i + 1]))
+        split = metric.init_state()
+        for lo in (0, 10, 37):
+            hi = {0: 10, 10: 37, 37: 64}[lo]
+            split = metric.update_state(split, jnp.asarray(stream[lo:hi]))
+        got_whole = jax.device_get(whole)
+        got_rows = jax.device_get(rows)
+        got_split = jax.device_get(split)
+        for name in got_whole:
+            if name == "_update_count":
+                continue
+            np.testing.assert_array_equal(got_whole[name], got_rows[name], err_msg=name)
+            np.testing.assert_array_equal(got_whole[name], got_split[name], err_msg=name)

@@ -1,0 +1,186 @@
+"""Metric-API behavior of the sketch metrics: stateful shell, functional twins,
+reset/clone/forward, save/restore round trips."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.functional.sketch import (
+    approx_count_distinct,
+    approx_heavy_hitters,
+    approx_quantiles,
+)
+from metrics_tpu.sketch import CardinalitySketch, HeavyHittersSketch, QuantileSketch
+
+
+def _assert_trees_equal(a, b):
+    jax.tree_util.tree_map(
+        lambda x, y: np.testing.assert_array_equal(np.asarray(x), np.asarray(y)), a, b
+    )
+
+
+class TestValidation:
+    def test_quantile_args(self):
+        with pytest.raises(ValueError):
+            QuantileSketch(quantiles=(1.5,))
+        with pytest.raises(ValueError):
+            QuantileSketch(quantiles=())
+        with pytest.raises(ValueError):
+            QuantileSketch(alpha=0.0)
+        with pytest.raises(ValueError):
+            QuantileSketch(n_buckets=1)
+        with pytest.raises(ValueError):
+            QuantileSketch(min_trackable=0.0)
+
+    def test_cardinality_args(self):
+        with pytest.raises(ValueError):
+            CardinalitySketch(p=3)
+        with pytest.raises(ValueError):
+            CardinalitySketch(p=17)
+
+    def test_quantile_narrow_range_warns(self):
+        """Few buckets at a tight alpha push the trackable ceiling below
+        ordinary data (everything clips into the top bucket) — that
+        misconfiguration must be loud at construction."""
+        with pytest.warns(UserWarning, match="only tracks magnitudes up to"):
+            QuantileSketch(n_buckets=256, alpha=0.01)
+        import warnings as _w
+
+        with _w.catch_warnings():
+            _w.simplefilter("error")  # defaults must NOT warn
+            QuantileSketch()
+            QuantileSketch(n_buckets=256, alpha=0.05, min_trackable=1e-3)
+
+    def test_heavy_hitter_args(self):
+        with pytest.raises(ValueError):
+            HeavyHittersSketch(k=0)
+        with pytest.raises(ValueError):
+            HeavyHittersSketch(depth=0)
+        with pytest.raises(ValueError):
+            HeavyHittersSketch(width=1)
+
+
+def _metrics():
+    return [
+        (QuantileSketch(), lambda rng, n: rng.lognormal(0, 1, n).astype(np.float32)),
+        (CardinalitySketch(p=8), lambda rng, n: rng.integers(0, 500, n).astype(np.int32)),
+        (
+            HeavyHittersSketch(k=8, depth=3, width=128),
+            lambda rng, n: rng.integers(0, 30, n).astype(np.int32),
+        ),
+    ]
+
+
+class TestStatefulShell:
+    def test_functional_twin_matches_module_stream(self):
+        """Module metric over a chunked stream == one-shot functional twin on
+        the concatenation, bit-for-bit (same kernels, mergeable states)."""
+        rng = np.random.default_rng(0)
+        chunks = [rng.lognormal(0, 1, 50).astype(np.float32) for _ in range(5)]
+        m = QuantileSketch()
+        for c in chunks:
+            m.update(jnp.asarray(c))
+        np.testing.assert_array_equal(
+            np.asarray(m.compute()),
+            np.asarray(approx_quantiles(jnp.asarray(np.concatenate(chunks)))),
+        )
+
+        ids = [rng.integers(0, 400, 60).astype(np.int32) for _ in range(4)]
+        c = CardinalitySketch()
+        for i in ids:
+            c.update(jnp.asarray(i))
+        assert float(c.compute()) == float(approx_count_distinct(jnp.asarray(np.concatenate(ids))))
+
+        h = HeavyHittersSketch(k=8, depth=3, width=128)
+        for i in ids:
+            h.update(jnp.asarray(i))
+        tw_keys, tw_counts = approx_heavy_hitters(
+            jnp.asarray(np.concatenate(ids)), k=8, depth=3, width=128
+        )
+        keys, counts = h.compute()
+        np.testing.assert_array_equal(np.asarray(keys), np.asarray(tw_keys))
+        np.testing.assert_array_equal(np.asarray(counts), np.asarray(tw_counts))
+
+    def test_reset_restores_defaults(self):
+        rng = np.random.default_rng(1)
+        for m, gen in _metrics():
+            fresh_state = jax.device_get(m.init_state())
+            m.update(jnp.asarray(gen(rng, 40)))
+            m.reset()
+            _assert_trees_equal(jax.device_get(m.init_state()), fresh_state)
+
+    def test_forward_returns_batch_value_and_accumulates(self):
+        rng = np.random.default_rng(2)
+        batch1 = rng.lognormal(0, 1, 100).astype(np.float32)
+        batch2 = rng.lognormal(0, 1, 100).astype(np.float32)
+        m = QuantileSketch(quantiles=(0.5,))
+        batch_val = m(jnp.asarray(batch1))
+        np.testing.assert_array_equal(
+            np.asarray(batch_val), np.asarray(approx_quantiles(jnp.asarray(batch1), (0.5,)))
+        )
+        m(jnp.asarray(batch2))
+        np.testing.assert_array_equal(
+            np.asarray(m.compute()),
+            np.asarray(approx_quantiles(jnp.asarray(np.concatenate([batch1, batch2])), (0.5,))),
+        )
+
+    def test_clone_is_independent(self):
+        rng = np.random.default_rng(3)
+        for m, gen in _metrics():
+            m.update(jnp.asarray(gen(rng, 30)))
+            twin = m.clone()
+            _assert_trees_equal(
+                {k: np.asarray(v) for k, v in m.metric_state.items()},
+                {k: np.asarray(v) for k, v in twin.metric_state.items()},
+            )
+            twin.update(jnp.asarray(gen(rng, 30)))
+            assert twin._update_count == m._update_count + 1
+
+    def test_jitted_update_state(self):
+        """The engine hook: the compiled pure updater is bit-identical to the
+        eager one for every sketch family."""
+        rng = np.random.default_rng(4)
+        for m, gen in _metrics():
+            batch = jnp.asarray(gen(rng, 16))
+            eager = m.update_state(m.init_state(), batch)
+            jitted = m.jitted_update_state(donate=False)(m.init_state(), batch)
+            _assert_trees_equal(jax.device_get(eager), jax.device_get(jitted))
+
+
+class TestPersistence:
+    def test_save_restore_round_trip(self, tmp_path):
+        rng = np.random.default_rng(5)
+        for i, (m, gen) in enumerate(_metrics()):
+            m.update(jnp.asarray(gen(rng, 50)))
+            m.update(jnp.asarray(gen(rng, 17)))
+            path = str(tmp_path / f"sketch-{i}.ckpt")
+            m.save(path)
+            fresh = type(m)(**_ctor_kwargs(m))
+            fresh.restore(path)
+            _assert_trees_equal(
+                {k: np.asarray(v) for k, v in m.metric_state.items()},
+                {k: np.asarray(v) for k, v in fresh.metric_state.items()},
+            )
+            _assert_trees_equal(jax.device_get(m.compute()), jax.device_get(fresh.compute()))
+
+    def test_state_dict_round_trip_persistent(self):
+        rng = np.random.default_rng(6)
+        m = QuantileSketch()
+        m.persistent(True)
+        m.update(jnp.asarray(rng.lognormal(0, 1, 64).astype(np.float32)))
+        sd = m.state_dict()
+        fresh = QuantileSketch()
+        fresh.load_state_dict(sd)
+        for name in m._defaults:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(m, name)), np.asarray(getattr(fresh, name))
+            )
+
+
+def _ctor_kwargs(m):
+    if isinstance(m, QuantileSketch):
+        return dict(quantiles=m.quantiles, alpha=m.alpha, n_buckets=m.n_buckets)
+    if isinstance(m, CardinalitySketch):
+        return dict(p=m.p)
+    return dict(k=m.k, depth=m.depth, width=m.width)

@@ -57,3 +57,9 @@ def test_plotting(tmp_path):
     # script compiles many small jax programs and shares cores with the suite
     _run("plotting.py", str(tmp_path), cwd=str(tmp_path), timeout=480)
     assert (tmp_path / "confusion_matrix.png").exists()
+
+
+def test_sketch_alerting():
+    out = _run("sketch_alerting.py")
+    assert "alerts fired for tenants: ['search']" in out
+    assert "fused=True" in out

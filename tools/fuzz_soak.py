@@ -1497,6 +1497,172 @@ def soak_repl(seeds) -> None:
             _soak_repl_kill(seed)
 
 
+# ---------------------------------------------------------------------- sketch surface
+
+
+def _sketch_case(seed):
+    """Deterministic (factory, stream) pair for the sketch crash surface —
+    seed rotates through the three sketch families. The stream is a list of
+    (key, values) submits; values are sketch-appropriate draws."""
+    from metrics_tpu.sketch import CardinalitySketch, HeavyHittersSketch, QuantileSketch
+
+    rng = np.random.default_rng(seed)
+    kind = seed % 3
+    if kind == 0:
+        factory = lambda: QuantileSketch()  # noqa: E731
+        draw = lambda n: rng.lognormal(0.0, 1.5, n).astype(np.float32)  # noqa: E731
+    elif kind == 1:
+        factory = lambda: CardinalitySketch(p=8)  # noqa: E731
+        draw = lambda n: rng.integers(0, 50_000, n).astype(np.int32)  # noqa: E731
+    else:
+        factory = lambda: HeavyHittersSketch(k=16, depth=3, width=256)  # noqa: E731
+        draw = lambda n: (rng.zipf(1.4, n) % 10_000).astype(np.int32)  # noqa: E731
+    stream = [
+        (f"k{rng.integers(0, 5)}", draw(int(rng.integers(1, 8)))) for _ in range(3_000)
+    ]
+    return factory, stream
+
+
+def sketch_crash_child(dirpath, seed):
+    """Child half of the sketch SIGKILL surface: an engine serving sketch
+    tenants checkpoints durably (fsync WAL) AND ships its lineage over a
+    directory spool, submitting the deterministic stream until killed —
+    possibly mid-write, mid-ship, mid-checkpoint."""
+    from metrics_tpu.engine import CheckpointConfig, ReplConfig, StreamingEngine
+    from metrics_tpu.repl import DirectoryTransport
+
+    factory, stream = _sketch_case(seed)
+    link = DirectoryTransport(os.path.join(dirpath, "spool"), durable=True)
+    cfg = CheckpointConfig(directory=os.path.join(dirpath, "ckpt"), interval_s=0.05,
+                           retain=3, durable=True, wal_flush="fsync")
+    engine = StreamingEngine(
+        factory(), buckets=(8, 32), checkpoint=cfg,
+        replication=ReplConfig(role="primary", transport=link,
+                               ship_interval_s=0.01, heartbeat_interval_s=0.1),
+    )
+    print("READY", flush=True)
+    while True:  # cycle until killed
+        for key, vals in stream:
+            engine.submit(key, jnp.asarray(vals))
+
+
+def _verify_sketch_prefix(engine, seed, tag):
+    """Exactly-once order-preserving prefix + bit-identical sketch answers:
+    for every tenant, the recovered/promoted state must equal a fresh sketch
+    fed exactly the first ``_update_count`` rows of that tenant's (cycled)
+    stream — full state bit-for-bit AND ``compute_from`` answers bit-for-bit
+    (the uninterrupted-twin contract for quantile/cardinality/heavy-hitter
+    queries)."""
+    factory, stream = _sketch_case(seed)
+    metric = factory()
+    per_key_rows: dict = {}
+    for key, vals in stream:
+        per_key_rows.setdefault(key, []).extend(vals[i : i + 1] for i in range(len(vals)))
+    for key in engine._keyed.keys:
+        state = jax.device_get(engine._keyed.state_of(key))
+        rows_applied = int(np.asarray(state["_update_count"]))
+        rows = per_key_rows.get(key, [])
+        if rows:
+            while rows_applied > len(rows):  # the child cycles its stream
+                rows = rows + per_key_rows[key]
+        elif rows_applied:
+            FAILS.append((seed, tag, f"key {key}: {rows_applied} rows but key never submitted"))
+            continue
+        oracle_state = metric.init_state()
+        for row in rows[:rows_applied]:
+            oracle_state = metric.update_state(oracle_state, jnp.asarray(row))
+        try:
+            jax.tree_util.tree_map(
+                lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+                state, jax.device_get(oracle_state),
+            )
+            jax.tree_util.tree_map(
+                lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+                jax.device_get(engine.compute(key)),
+                jax.device_get(metric.compute_from(oracle_state)),
+            )
+        except Exception as exc:  # noqa: BLE001
+            FAILS.append((seed, tag, f"key {key}: recovered sketch != first-{rows_applied}-rows twin: {repr(exc)[:140]}"))
+
+
+def soak_sketch(seeds) -> None:
+    """Sketch crash surface (ISSUE 7): a child engine serving sketch tenants
+    (family rotates by seed) is SIGKILLed mid-write. Odd seeds verify ckpt
+    RECOVERY of the child's durable lineage; even seeds attach a follower to
+    the child's ship spool, drain it and PROMOTE. Either way the surviving
+    state must be an exactly-once order-preserving prefix of the deterministic
+    stream and every sketch answer must match the uninterrupted twin
+    bit-identically. Self-oracled — needs no reference checkout."""
+    import signal
+    import subprocess
+    import tempfile
+    import time as _time
+
+    from metrics_tpu.engine import CheckpointConfig, ReplConfig, StreamingEngine
+
+    for seed in seeds:
+        promote = seed % 2 == 0
+        tag = f"sketch/{'promote' if promote else 'recover'} seed={seed}"
+        factory, _ = _sketch_case(seed)
+        with tempfile.TemporaryDirectory() as d:
+            child = subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__), "--sketch-child", d, str(seed)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            )
+            try:
+                line = child.stdout.readline()
+                if "READY" not in line:
+                    err = child.stderr.read()[:200]
+                    FAILS.append((seed, tag, f"child failed to start: {line!r} {err!r}"))
+                    continue
+                rng = np.random.default_rng(seed ^ 0x5E7C)
+                _time.sleep(float(rng.uniform(0.1, 0.8)))
+                child.send_signal(signal.SIGKILL)
+                child.wait(timeout=30)
+            finally:
+                if child.poll() is None:
+                    child.kill()
+                    child.wait(timeout=30)
+            if promote:
+                from metrics_tpu.repl import DirectoryTransport
+
+                follower = StreamingEngine(
+                    factory(), buckets=(8, 32),
+                    replication=ReplConfig(
+                        role="follower",
+                        transport=DirectoryTransport(os.path.join(d, "spool"), durable=False),
+                        poll_interval_s=0.01,
+                        promote_checkpoint=CheckpointConfig(
+                            directory=os.path.join(d, "promoted"), durable=False),
+                    ),
+                )
+                try:
+                    applier = follower._applier
+                    last, stable = -2, 0
+                    deadline = _time.monotonic() + 30.0
+                    while stable < 10 and _time.monotonic() < deadline:
+                        _time.sleep(0.05)
+                        now_seq = applier.applied_seq
+                        stable = stable + 1 if now_seq == last else 0
+                        last = now_seq
+                    if not applier.bootstrapped:
+                        if applier.known_seq >= 0:
+                            FAILS.append((seed, tag, "WAL frames arrived but no bootstrap snapshot"))
+                        continue  # killed before anything shipped: nothing to verify
+                    follower.promote()
+                    _verify_sketch_prefix(follower, seed, tag)
+                finally:
+                    follower.close(checkpoint=False)
+            else:
+                cfg = CheckpointConfig(directory=os.path.join(d, "ckpt"),
+                                       interval_s=3600.0, durable=False)
+                engine = StreamingEngine(factory(), buckets=(8, 32), checkpoint=cfg)
+                try:
+                    _verify_sketch_prefix(engine, seed, tag)
+                finally:
+                    engine.close(checkpoint=False)
+
+
 SURFACES = {
     "classification": soak_classification,
     "regression_retrieval": soak_regression_retrieval,
@@ -1511,11 +1677,14 @@ SURFACES = {
     "ckpt": soak_ckpt,
     "guard": soak_guard,
     "repl": soak_repl,
+    "sketch": soak_sketch,
 }
 
 # surfaces that execute the reference as their oracle (everything except the
-# self-oracled engine, ckpt crash-recovery, guard chaos and repl surfaces)
-_NEEDS_REF = {name for name in SURFACES if name not in ("engine", "ckpt", "guard", "repl")}
+# self-oracled engine, ckpt crash-recovery, guard chaos, repl and sketch surfaces)
+_NEEDS_REF = {
+    name for name in SURFACES if name not in ("engine", "ckpt", "guard", "repl", "sketch")
+}
 
 
 def main() -> None:
@@ -1526,6 +1695,8 @@ def main() -> None:
                         help="internal: run the ckpt crash-surface child (killed by the parent)")
     parser.add_argument("--repl-child", nargs=2, metavar=("DIR", "SEED"),
                         help="internal: run the repl shipping-primary child (killed by the parent)")
+    parser.add_argument("--sketch-child", nargs=2, metavar=("DIR", "SEED"),
+                        help="internal: run the sketch-serving engine child (killed by the parent)")
     args = parser.parse_args()
 
     if args.ckpt_child is not None:
@@ -1535,6 +1706,10 @@ def main() -> None:
     if args.repl_child is not None:
         dirpath, seed = args.repl_child
         repl_crash_child(dirpath, int(seed))
+        return
+    if args.sketch_child is not None:
+        dirpath, seed = args.sketch_child
+        sketch_crash_child(dirpath, int(seed))
         return
 
     start, stop = (int(x) for x in args.seeds.split(":"))
