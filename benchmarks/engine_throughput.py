@@ -132,6 +132,12 @@ def main() -> None:
                     "rides the coalesced fixed-shape path and costs a fraction of what a "
                     "CatMetric of the SAME stream pays on the ragged pad-to-max/broadcast "
                     "path (the ratio is reported and gated)")
+    ap.add_argument("--kernels", action="store_true",
+                    help="kernel-plane gates (ISSUE 8): with METRICS_TPU_KERNELS forced on, "
+                    "the fused engine (engine_masked_scan lowering) must stay fused with "
+                    "zero fallbacks, bit-identical per key, >=10x naive per-call, and no "
+                    "regression vs the jnp reference scan on CPU (median pair ratio >=0.95; "
+                    "the TPU roofline capture arbitrates actual wins)")
     ap.add_argument("--guard", action="store_true",
                     help="guard-plane gates (ISSUE 5): (a) well-behaved traffic with the "
                     "guard enabled loses <5%% throughput vs the plain pass; (b) under a "
@@ -303,6 +309,60 @@ def main() -> None:
              pair_ratios=[round(r, 4) for r in pair_ratios],
              checks={"ckpt_overhead_lt_5pct": ok})
         if not ok:
+            sys.exit(1)
+
+    # ---------------- kernel plane gates (ISSUE 8): with the registry forced on
+    # (the fused engine_masked_scan — on CPU the Pallas entries stay ineligible
+    # or interpretable, the fused scan is pure jnp), (a) the engine stays fused
+    # with zero fallbacks and bit-identical per-key results; (b) throughput is
+    # no worse than the jnp reference path (median pair ratio >= 0.95 — the
+    # no-regression bar at CI noise; the TPU capture arbitrates actual wins);
+    # (c) the >=10x fused-vs-naive gate holds with kernels forced.
+    if args.kernels:
+        from metrics_tpu.kernels import registry as _kreg
+
+        with _kreg.forced("force"):
+            verify = StreamingEngine(BinaryAccuracy(), buckets=buckets,
+                                     max_queue=2048, capacity=args.keys)
+            try:
+                for key, p, t in stream:
+                    verify.submit(key, p, t)
+                verify.flush()
+                kernel_mismatches = [
+                    key for key, oracle in oracles.items()
+                    if float(verify.compute(key)) != float(oracle.compute())
+                ]
+                vsnap = verify.telemetry_snapshot()
+            finally:
+                verify.close()
+        pair_ratios = []
+        fused_best = ref_best = 0.0
+        for i in range(6):
+            if i % 2 == 0:
+                with _kreg.forced("off"):
+                    r = run_engine_pass()
+                with _kreg.forced("force"):
+                    f = run_engine_pass()
+            else:
+                with _kreg.forced("force"):
+                    f = run_engine_pass()
+                with _kreg.forced("off"):
+                    r = run_engine_pass()
+            pair_ratios.append(f / r)
+            fused_best, ref_best = max(fused_best, f), max(ref_best, r)
+        ratio = float(np.median(pair_ratios))
+        checks = {
+            "fused_fallbacks_zero": vsnap["fused_fallbacks"] == 0,
+            "bit_identical_with_kernels": not kernel_mismatches,
+            "kernels_ge_jnp_within_noise": ratio >= 0.95,
+            "speedup_ge_10x_with_kernels": fused_best / naive_rps >= 10.0,
+        }
+        emit("engine kernels-vs-jnp ratio", ratio, "x",
+             fused_rps=round(fused_best, 1), jnp_rps=round(ref_best, 1),
+             pair_ratios=[round(x, 4) for x in pair_ratios],
+             fused_speedup_vs_naive=round(fused_best / naive_rps, 2),
+             checks=checks, mismatched_keys=kernel_mismatches[:4])
+        if not all(checks.values()):
             sys.exit(1)
 
     # ---------------- replication plane gates (ISSUE 6): (a) shipping adds <5%

@@ -1,0 +1,172 @@
+"""Scatter-vs-fused microbench per kernel-plane registry entry.
+
+One row per (entry, variant) appended to ``benchmarks/suite_runs.jsonl``
+(``experiment kernels/<entry>/<variant>``), per the STATUS.md convention: the
+CPU-measurable proxy records are committed (the scatter baseline everywhere,
+plus both sides of the pairs whose optimized lowering is plain jnp — the
+pair-count matmul and the fused engine scan), and the TPU row is the arbiter
+for the Pallas variants (``pallas`` rows only emit on a real TPU backend;
+interpret-mode timings are interpreter overhead, not kernel evidence, and are
+deliberately NOT recorded).
+
+Run on CPU for the proxy set, on the chip for the arbiter rows:
+
+    python benchmarks/experiments/kernel_microbench.py [--check-only]
+
+``--check-only`` asserts every variant pair agrees bit-identically (interpret
+mode on CPU) and skips all timing — the CI smoke hook.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS") == "cpu" or "--check-only" in sys.argv:
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.kernels import binned_curve, confmat, scatter
+from metrics_tpu.kernels.engine_scan import _fused_scan, _reference_scan
+from tools.jsonl_log import append_jsonl
+
+RUNS = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "suite_runs.jsonl")
+BACKEND = jax.devices()[0].platform
+ON_TPU = BACKEND == "tpu"
+
+
+def timed(fn, *args, steps=20):
+    out = jax.block_until_ready(fn(*args))  # warm/compile
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / steps * 1e3, out
+
+
+def emit(entry: str, variant: str, ms: float, config: dict) -> None:
+    row = {"metric": f"experiment kernels/{entry}/{variant}", "value": round(ms, 4),
+           "unit": "ms", "backend": BACKEND, "config": config}
+    print(json.dumps(row))
+    append_jsonl(RUNS, row)
+
+
+def main() -> None:
+    check_only = "--check-only" in sys.argv
+    rng = np.random.default_rng(23)
+    big = ON_TPU and not check_only
+    n = 1_000_000 if big else 100_000
+
+    # ---------------- pair_count: scatter vs MXU matmul vs Pallas fused
+    C = 100
+    r = jnp.asarray(rng.integers(0, C, n).astype(np.int32))
+    c = jnp.asarray(rng.integers(0, C, n).astype(np.int32))
+    variants = [
+        ("scatter", jax.jit(lambda a, b: confmat.pair_count_bincount(a, b, C, C))),
+        ("matmul", jax.jit(lambda a, b: confmat.pair_count_matmul(a, b, C, C))),
+    ]
+    if ON_TPU:
+        variants.append(("pallas", jax.jit(lambda a, b: confmat.pair_count_fused(a, b, C, C))))
+    outs = {}
+    for name, fn in variants:
+        if check_only:
+            outs[name] = np.asarray(fn(r, c))
+            continue
+        ms, _ = timed(fn, r, c)
+        emit("pair_count", name, ms, {"samples": n, "classes": C})
+    if check_only:
+        outs["pallas"] = np.asarray(confmat.pair_count_fused(r, c, C, C, interpret=True))
+        assert all((v == outs["scatter"]).all() for v in outs.values()), "pair_count variants disagree"
+
+    # ---------------- sketch scatters: jnp scatter baseline vs Pallas
+    B = 2048
+    bins = jnp.zeros(B, jnp.int32)
+    idx = jnp.asarray(rng.integers(0, B, n).astype(np.int32))
+    w = jnp.ones(n, jnp.int32)
+    vals = jnp.asarray(rng.integers(1, 21, n).astype(np.int32))
+    for entry, ref, pal, a3 in [
+        ("ddsketch_hist_add", scatter.hist_add_reference, scatter.hist_add_pallas, w),
+        ("hll_scatter_max", scatter.hist_max_reference, scatter.hist_max_pallas, vals),
+    ]:
+        if check_only:
+            want = np.asarray(ref(bins, idx, a3))
+            got = np.asarray(pal(bins, idx, a3, interpret=True))
+            assert (want == got).all(), f"{entry} variants disagree"
+            continue
+        ms, _ = timed(jax.jit(ref), bins, idx, a3)
+        emit(entry, "scatter", ms, {"n": n, "bins": B})
+        if ON_TPU:
+            ms, _ = timed(jax.jit(lambda b, i, v: pal(b, i, v)), bins, idx, a3)
+            emit(entry, "pallas", ms, {"n": n, "bins": B})
+
+    depth, width = 4, 2048
+    counts = jnp.zeros((depth, width), jnp.int32)
+    cols = jnp.asarray(rng.integers(0, width, (n, depth)).astype(np.int32))
+    valid = jnp.ones(n, bool)
+    if check_only:
+        want = np.asarray(scatter.cms_rows_add_reference(counts, cols, valid))
+        got = np.asarray(scatter.cms_rows_add_pallas(counts, cols, valid, interpret=True))
+        assert (want == got).all(), "cms_row_scatter variants disagree"
+    else:
+        ms, _ = timed(jax.jit(scatter.cms_rows_add_reference), counts, cols, valid)
+        emit("cms_row_scatter", "scatter", ms, {"n": n, "depth": depth, "width": width})
+        if ON_TPU:
+            ms, _ = timed(jax.jit(lambda a, b, v: scatter.cms_rows_add_pallas(a, b, v)),
+                          counts, cols, valid)
+            emit("cms_row_scatter", "pallas", ms, {"n": n, "depth": depth, "width": width})
+
+    # ---------------- binned curve: comparison matmul vs Pallas streaming
+    T = 100
+    preds = jnp.asarray(rng.uniform(size=n).astype(np.float32))
+    wts = jnp.ones(n, jnp.float32)
+    tw = jnp.asarray(rng.integers(0, 2, n).astype(np.float32))
+    thr = jnp.linspace(0, 1, T, dtype=jnp.float32)
+    if check_only:
+        a = binned_curve.reference_counts(preds, tw, wts, thr)
+        b = binned_curve.pallas_counts(preds, tw, wts, thr, interpret=True)
+        assert all((np.asarray(x) == np.asarray(y)).all() for x, y in zip(a, b)), \
+            "binned_curve variants disagree"
+    else:
+        ms, _ = timed(jax.jit(binned_curve.reference_counts), preds, tw, wts, thr)
+        emit("binned_curve_counts", "compare-matmul", ms, {"n": n, "thresholds": T})
+        if ON_TPU:
+            ms, _ = timed(jax.jit(lambda p, t, w_, th: binned_curve.pallas_counts(p, t, w_, th)),
+                          preds, tw, wts, thr)
+            emit("binned_curve_counts", "pallas", ms, {"n": n, "thresholds": T})
+
+    # ---------------- engine scan: where-select reference vs scratch-row fused
+    # (both jnp — the one pair fully measurable on CPU)
+    from metrics_tpu.classification import BinaryAccuracy
+
+    metric = BinaryAccuracy()
+    capacity, bucket = 8, 256
+    stacked = jax.tree.map(lambda x: jnp.stack([x] * capacity), metric.init_state())
+    key_ids = jnp.asarray(rng.integers(0, capacity, bucket).astype(np.int32))
+    mask = jnp.asarray(rng.integers(0, 2, bucket).astype(bool))
+    cols = (jnp.asarray(rng.integers(0, 2, (bucket, 1)).astype(np.int32)),
+            jnp.asarray(rng.integers(0, 2, (bucket, 1)).astype(np.int32)))
+    ref_fn = jax.jit(lambda s: _reference_scan(metric.update_state, s, key_ids, mask, cols))
+    fus_fn = jax.jit(lambda s: _fused_scan(metric.update_state, s, key_ids, mask, cols))
+    if check_only:
+        a = jax.tree.leaves(ref_fn(stacked))
+        b = jax.tree.leaves(fus_fn(stacked))
+        assert all((np.asarray(x) == np.asarray(y)).all() for x, y in zip(a, b)), \
+            "engine_masked_scan variants disagree"
+        print("all kernel variant pairs agree (check-only)")
+        return
+    ms, _ = timed(ref_fn, stacked)
+    emit("engine_masked_scan", "where-select", ms, {"bucket": bucket, "capacity": capacity})
+    ms, _ = timed(fus_fn, stacked)
+    emit("engine_masked_scan", "scratch-row-fused", ms, {"bucket": bucket, "capacity": capacity})
+
+
+if __name__ == "__main__":
+    main()

@@ -7,6 +7,15 @@ lowering builds the two (N, C) one-hots in bf16 (0/1 exact) and rides the MXU:
 ``cm = dot(oh_t.T, oh_p, preferred_element_type=f32)`` — every product is an
 exact 0/1 and the f32 accumulation is exact for any per-update N < 2**24.
 
+PROMOTED: this experiment's winning lowering is now **registry entry #0 of the
+kernel plane** (``metrics_tpu/kernels/confmat.py`` ``pair_count_matmul``; the
+production route — ``_multiclass_confusion_matrix_update``, the stat-scores
+fast path, and the nominal contingency table — dispatches through the plane,
+which additionally layers the Pallas fused streaming kernel
+``pair_count_fused`` above the matmul on TPU: one-hot tiles built on-chip, no
+(N, C) HBM operands). This file keeps the original A/B harness and adds the
+fused variant so the chip can arbitrate all three on one capture.
+
 Timing uses the same two-point chained-loop protocol as suite.py's
 ``timed_device`` (launch latency cancels in the k2-k1 difference; the loop body
 shifts inputs by the loop index so XLA cannot hoist it; jnp.max over the output
@@ -43,17 +52,21 @@ RUNS = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
 BACKEND = jax.devices()[0].platform
 
 
+# the lowerings under test live in the kernel plane now — the A/B runs the
+# exact production code paths, not local copies that could drift
+from metrics_tpu.kernels.confmat import pair_count_bincount, pair_count_fused, pair_count_matmul  # noqa: E402
+
+
 def cm_bincount(p, t, C):
-    bins = jnp.bincount(t * C + p, length=C * C)
-    return bins.reshape(C, C)
+    return pair_count_bincount(t, p, C, C)
 
 
 def cm_onehot_matmul(p, t, C):
-    oh_t = jax.nn.one_hot(t, C, dtype=jnp.bfloat16)
-    oh_p = jax.nn.one_hot(p, C, dtype=jnp.bfloat16)
-    cm = jax.lax.dot_general(oh_t, oh_p, (((0,), (0,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    return cm.astype(jnp.int32)
+    return pair_count_matmul(t, p, C, C)
+
+
+def cm_pallas_fused(p, t, C):
+    return pair_count_fused(t, p, C, C, interpret=jax.default_backend() != "tpu")
 
 
 def ss_via_cm(p, t, C):
@@ -91,6 +104,8 @@ def main() -> None:
     a = jax.jit(lambda p_, t_: cm_bincount(p_, t_, C))(p, t)
     b = jax.jit(lambda p_, t_: cm_onehot_matmul(p_, t_, C))(p, t)
     assert (np.asarray(a) == np.asarray(b)).all(), "lowerings disagree"
+    f = cm_pallas_fused(p, t, C)
+    assert (np.asarray(a) == np.asarray(f)).all(), "pallas fused lowering disagrees"
     sa = jax.jit(lambda p_, t_: ss_via_cm(p_, t_, C))(p, t)
     sb = jax.jit(lambda p_, t_: ss_elementwise(p_, t_, C))(p, t)
     assert (np.asarray(sa) == np.asarray(sb)).all(), "stat-score routes disagree"
@@ -98,10 +113,13 @@ def main() -> None:
         print("all variants agree (check-only)")
         return
 
-    for name, fn, k1, k2 in [("bincount-scatter", cm_bincount, 10, 50),
-                             ("onehot-mxu-matmul", cm_onehot_matmul, 100, 500),
-                             ("stat-scores-via-cm", ss_via_cm, 100, 500),
-                             ("stat-scores-elementwise", ss_elementwise, 50, 250)]:
+    variants = [("bincount-scatter", cm_bincount, 10, 50),
+                ("onehot-mxu-matmul", cm_onehot_matmul, 100, 500),
+                ("stat-scores-via-cm", ss_via_cm, 100, 500),
+                ("stat-scores-elementwise", ss_elementwise, 50, 250)]
+    if BACKEND == "tpu":  # interpret-mode timings are interpreter noise, not evidence
+        variants.insert(2, ("pallas-fused-streaming", cm_pallas_fused, 100, 500))
+    for name, fn, k1, k2 in variants:
         ms = timed_device(
             lambda i, acc, fn=fn: acc + jnp.max(fn((p + i) % C, (t + i) % C, C)),
             jnp.int32(0), k1, k2)

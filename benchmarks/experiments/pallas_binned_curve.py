@@ -1,121 +1,86 @@
-"""Streaming binned-curve counts: ``tp[t] = Σ_i w_i·y_i·[p_i ≥ thr_t]`` (and fp).
+"""Measurement harness for the binned-curve Pallas kernel (now a registry entry).
 
-The workhorse of every binned curve metric (PrecisionRecallCurve / ROC / AUROC /
-AveragePrecision with ``thresholds=int``, reference
-``functional/classification/precision_recall_curve.py:184-201``). The natural XLA
-formulation — a ``(T, N)`` comparison matrix contracted against the targets —
-materialises T·N intermediate values in HBM: at N=1M, T=200 that is ~3.5 ms/update
-on a v5e chip, pure HBM traffic.
+The kernel itself was PROMOTED into the kernel plane —
+``metrics_tpu/kernels/binned_curve.py``, registry entry ``binned_curve_counts``
+(production-routed: ``_binary_precision_recall_curve_update`` dispatches
+through it on accelerator backends) — after the v5e measurement showed it
+matching XLA's fused comparison-matmul at T<=200 (both at the T·N-compare
+roofline; numbers in benchmarks/README.md "Kernel experiments"). This file
+keeps the chained-timing A/B harness: run it on the chip to append
+``experiment binned_curve/*`` rows comparing the comparison-matmul reference
+against the Pallas streaming kernel at several threshold counts (the kernel's
+one-HBM-read-regardless-of-T advantage grows with T).
 
-The Pallas kernel streams the sample axis through VMEM in ``(BLOCK_ROWS, 128)``
-tiles and keeps a ``(T, 128)`` accumulator on-chip, so HBM traffic is one read of
-``preds``/``target``/``weights`` regardless of T. The TPU grid is sequential, which
-makes the accumulate-across-grid-steps pattern race-free (pallas_guide: grids are
-executed in order on TPU).
-
-Status: EXPERIMENT, not wired into the metric path. Measured on a v5e chip the
-kernel matches — but does not beat — XLA's fused comparison-matmul (both sit at
-the T·N-compare roofline; see benchmarks/README.md "Kernel experiments" for the
-numbers). Kept as a worked Pallas example with its measurement harness.
+Run: ``python benchmarks/experiments/pallas_binned_curve.py [--check-only]``
+(``--check-only`` forces CPU and just proves the two lowerings agree).
 """
 
 from __future__ import annotations
 
-import functools
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
 
 import jax
+
+if os.environ.get("JAX_PLATFORMS") == "cpu" or "--check-only" in sys.argv:
+    jax.config.update("jax_platforms", "cpu")
+
 import jax.numpy as jnp
-from jax import Array
+import numpy as np
 
-_WIDE = 1024  # samples per kernel row (8 lanes-groups of 128)
-_ROWS = 8  # rows per grid step -> 8192 samples/step
-# the (T, WIDE) f32 compare block must stay ≪ the ~16 MB VMEM budget
-MAX_PALLAS_THRESHOLDS = 1024
+from metrics_tpu.kernels.binned_curve import (  # noqa: F401  (re-exported: the old import site)
+    MAX_PALLAS_THRESHOLDS,
+    binned_curve_counts,
+    pallas_counts,
+    reference_counts,
+)
+from tools.chained_timing import timed_device
+from tools.jsonl_log import append_jsonl
 
-
-def _kernel(thr_ref, p_ref, t_ref, w_ref, tp_ref, fp_ref):
-    import jax.experimental.pallas as pl
-
-    i = pl.program_id(0)
-
-    @pl.when(i == 0)
-    def _init():
-        tp_ref[:] = jnp.zeros_like(tp_ref)
-        fp_ref[:] = jnp.zeros_like(fp_ref)
-
-    thr = thr_ref[:]  # (T, 1)
-
-    def body(k, carry):
-        tp_acc, fp_acc = carry
-        sl = pl.ds(k, 1)
-        p = p_ref[sl, :]  # (1, WIDE) — samples on the lane axis, no reshape needed
-        t = t_ref[sl, :]
-        w = w_ref[sl, :]
-        # (T, WIDE) compare on the VPU, then MXU matvecs for the weighted reductions
-        pred_pos = (p >= thr).astype(jnp.float32)  # (T,1)>= (1,WIDE) -> (T, WIDE)
-        tp_acc = tp_acc + jax.lax.dot_general(
-            pred_pos, t, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # (T, 1)
-        fp_acc = fp_acc + jax.lax.dot_general(
-            pred_pos, w - t, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        return tp_acc, fp_acc
-
-    zero = jnp.zeros(tp_ref.shape, jnp.float32)
-    tp, fp = jax.lax.fori_loop(0, _ROWS, body, (zero, zero))
-    tp_ref[:] += tp
-    fp_ref[:] += fp
+RUNS = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "suite_runs.jsonl")
+BACKEND = jax.devices()[0].platform
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def _pallas_counts(preds: Array, target_w: Array, w: Array, thresholds: Array, interpret: bool = False):
-    import jax.experimental.pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
+def main() -> None:
+    check_only = "--check-only" in sys.argv
+    rng = np.random.default_rng(19)
+    n = 20_000 if check_only else (1_000_000 if BACKEND != "cpu" else 200_000)
+    preds = jnp.asarray(rng.uniform(size=n).astype(np.float32))
+    w = jnp.asarray(rng.integers(0, 2, n).astype(np.float32))
+    target_w = jnp.asarray(rng.integers(0, 2, n).astype(np.float32)) * w
 
-    n = preds.shape[0]
-    len_t = thresholds.shape[0]
-    tile = _ROWS * _WIDE
-    n_pad = -(-n // tile) * tile
-    pad = n_pad - n
-    # zero-weight padding contributes nothing to either count
-    preds = jnp.pad(preds.astype(jnp.float32), (0, pad), constant_values=-jnp.inf).reshape(-1, _WIDE)
-    target_w = jnp.pad(target_w.astype(jnp.float32), (0, pad)).reshape(-1, _WIDE)
-    w = jnp.pad(w.astype(jnp.float32), (0, pad)).reshape(-1, _WIDE)
-    thr = thresholds.astype(jnp.float32).reshape(len_t, 1)
+    if check_only:
+        thr = jnp.linspace(0, 1, 57, dtype=jnp.float32)
+        a = reference_counts(preds, target_w, w, thr)
+        b = pallas_counts(preds, target_w, w, thr, interpret=True)
+        assert all((np.asarray(x) == np.asarray(y)).all() for x, y in zip(a, b)), \
+            "lowerings disagree"
+        print("both lowerings agree (check-only)")
+        return
 
-    grid = n_pad // tile
-    block = pl.BlockSpec((_ROWS, _WIDE), lambda i: (i, 0))
-    acc = pl.BlockSpec((len_t, 1), lambda i: (0, 0))
-    tp, fp = pl.pallas_call(
-        _kernel,
-        grid=(grid,),
-        in_specs=[pl.BlockSpec((len_t, 1), lambda i: (0, 0)), block, block, block],
-        out_specs=[acc, acc],
-        out_shape=[
-            jax.ShapeDtypeStruct((len_t, 1), jnp.float32),
-            jax.ShapeDtypeStruct((len_t, 1), jnp.float32),
-        ],
-        interpret=interpret,
-    )(thr, preds, target_w, w)
-    return tp[:, 0], fp[:, 0]
-
-
-def _reference_counts(preds: Array, target_w: Array, w: Array, thresholds: Array):
-    """The jnp comparison-matmul formulation (always correct, any backend)."""
-    preds_t = (preds[None, :] >= thresholds[:, None]).astype(jnp.float32) * w[None, :]
-    tp = preds_t @ target_w
-    fp = preds_t @ (w - target_w)
-    return tp, fp
+    for t_count in (100, 400, MAX_PALLAS_THRESHOLDS):
+        thr = jnp.linspace(0, 1, t_count, dtype=jnp.float32)
+        for name, fn in (("compare-matmul", reference_counts), ("pallas", pallas_counts)):
+            if name == "pallas" and BACKEND != "tpu":
+                continue  # interpret-mode timings are interpreter noise, not evidence
+            ms = timed_device(
+                lambda i, acc, fn=fn, thr=thr: acc + jnp.max(
+                    fn((preds + jnp.float32(i) * 1e-12) % 1.0, target_w, w, thr)[0]
+                ),
+                jnp.float32(0.0), 10, 50)
+            row = {"metric": f"experiment binned_curve/{name}",
+                   "value": None if ms is None else round(ms, 4),
+                   "unit": "ms", "backend": BACKEND,
+                   "config": {"samples": n, "thresholds": t_count}}
+            if ms is None:
+                row["invalid"] = "noise-dominated chained capture"
+            else:
+                row["samples_per_s"] = round(n / (ms / 1e3))
+            print(row)
+            append_jsonl(RUNS, row)
 
 
-def binned_curve_counts(preds: Array, target_w: Array, w: Array, thresholds: Array):
-    """``(tp, fp)`` of shape ``(T,)``: weighted counts of predictions ≥ each threshold.
-
-    ``target_w`` is the weighted positive indicator (``target * w``); ``w`` the sample
-    weights (1 where valid, 0 where masked). Uses the Pallas streaming kernel on TPU,
-    the jnp reference elsewhere.
-    """
-    on_tpu = preds.ndim == 1 and jax.default_backend() == "tpu"
-    if on_tpu and thresholds.shape[0] <= MAX_PALLAS_THRESHOLDS:
-        return _pallas_counts(preds, target_w, w, thresholds)
-    return _reference_counts(preds, target_w, w, thresholds)
+if __name__ == "__main__":
+    main()

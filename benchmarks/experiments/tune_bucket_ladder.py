@@ -1,0 +1,143 @@
+"""Emit an autotuned engine bucket ladder from a recorded request-size trace.
+
+The engine's default ladder is log2 (``DEFAULT_BUCKETS``) — a generic guess.
+Real traffic is rarely log-uniform: a deployment that records its request row
+counts (the engine's batch-occupancy telemetry measures exactly the padding
+this costs) can hand the trace to ``engine.bucketing.tune_buckets`` and get
+the padding-optimal ladder for the same compile-cache bound back.
+
+Trace input: ``--trace trace.jsonl`` with one ``{"rows": N}`` (or bare int)
+per line — e.g. scraped from engine telemetry or an access log. Without
+``--trace`` a synthetic production-shaped mix is generated (heavy head of
+small dashboard batches + a tail of bulk backfills) so the script demos
+end to end.
+
+Emits the ladder plus the padded-rows comparison vs the log2 default, appends
+an ``experiment bucket_ladder/tuned`` row to ``benchmarks/suite_runs.jsonl``,
+and — with ``--verify`` — replays the trace through two real engines (tuned
+vs log2 ladders) and reports each one's measured ``mean_batch_occupancy``.
+
+Run: ``python benchmarks/experiments/tune_bucket_ladder.py [--trace f.jsonl]
+[--max-buckets 6] [--verify]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+
+from metrics_tpu.engine.bucketing import DEFAULT_BUCKETS, tune_buckets
+from tools.jsonl_log import append_jsonl
+
+RUNS = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "suite_runs.jsonl"
+)
+
+
+def load_trace(path: str) -> list:
+    rows = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            rows.append(int(rec["rows"]) if isinstance(rec, dict) else int(rec))
+    return rows
+
+
+def synthetic_trace(n: int = 20000, seed: int = 17) -> list:
+    """Production-shaped mix: dashboard trickle + batch API + bulk backfill."""
+    rng = np.random.default_rng(seed)
+    kind = rng.choice(3, n, p=[0.7, 0.25, 0.05])
+    rows = np.where(
+        kind == 0,
+        rng.integers(1, 5, n),  # trickle: 1-4 rows
+        np.where(
+            kind == 1,
+            rng.integers(20, 28, n),  # batch API: ~24-row pages
+            rng.integers(190, 212, n),  # backfill: ~200-row chunks
+        ),
+    )
+    return [int(r) for r in rows]
+
+
+def padded_rows(trace: list, ladder: tuple) -> int:
+    top = ladder[-1]
+    total = 0
+    for r in trace:
+        while r > top:  # the engine splits oversized requests at the top bucket
+            total += 0
+            r -= top
+        total += min(b for b in ladder if b >= r) - r
+    return total
+
+
+def measured_occupancy(trace: list, ladder: tuple) -> float:
+    """Replay the trace through a real engine and read its occupancy telemetry."""
+    import jax.numpy as jnp
+
+    from metrics_tpu.classification import BinaryAccuracy
+    from metrics_tpu.engine import BucketConfig, StreamingEngine
+
+    engine = StreamingEngine(BinaryAccuracy(), buckets=BucketConfig(ladder=ladder))
+    ones = np.ones(max(trace), dtype=np.int32)
+    try:
+        for r in trace:
+            engine.submit("tenant", jnp.asarray(ones[:r]), jnp.asarray(ones[:r]))
+        engine.flush()
+        snap = engine.telemetry_snapshot()
+        return float(snap["mean_batch_occupancy"] or 0.0)
+    finally:
+        engine.close()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trace", default=None, help="jsonl request-size trace (one rows/int per line)")
+    ap.add_argument("--max-buckets", type=int, default=len(DEFAULT_BUCKETS))
+    ap.add_argument("--max-rows", type=int, default=DEFAULT_BUCKETS[-1])
+    ap.add_argument("--verify", action="store_true",
+                    help="replay the trace through real engines (tuned vs log2) and report occupancy")
+    args = ap.parse_args()
+
+    trace = load_trace(args.trace) if args.trace else synthetic_trace()
+    ladder = tune_buckets(trace, max_buckets=args.max_buckets, max_rows=args.max_rows)
+    pad_tuned = padded_rows(trace, ladder)
+    pad_log2 = padded_rows(trace, DEFAULT_BUCKETS)
+    row = {
+        "metric": "experiment bucket_ladder/tuned",
+        "value": pad_tuned,
+        "unit": "padded_rows",
+        "config": {
+            "requests": len(trace),
+            "source": args.trace or "synthetic",
+            "max_buckets": args.max_buckets,
+            "ladder": list(ladder),
+            "padded_rows_log2": pad_log2,
+            "reduction": round(pad_log2 / pad_tuned, 2) if pad_tuned else None,
+        },
+    }
+    if args.verify:
+        occ_tuned = measured_occupancy(trace[:2000], ladder)
+        occ_log2 = measured_occupancy(trace[:2000], DEFAULT_BUCKETS)
+        row["config"]["occupancy_tuned"] = round(occ_tuned, 4)
+        row["config"]["occupancy_log2"] = round(occ_log2, 4)
+    print(json.dumps(row))
+    append_jsonl(RUNS, row)
+    print(f"ladder: BucketConfig(ladder={ladder})")
+
+
+if __name__ == "__main__":
+    main()

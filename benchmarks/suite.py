@@ -97,14 +97,21 @@ from tools.chained_timing import timed_device  # noqa: E402 (needs the sys.path 
 def emit_chained(name, ms, disp_ms, config, samples=None, in_bytes=None,
                  flops=None, pixels=None):
     """One chained-device roofline row. ``ms=None`` (noise-dominated capture,
-    see tools/chained_timing.py) emits an explicitly invalid row with NO
-    derived rates, instead of a clamped fake-fast number — the first TPU
-    capture durably recorded 0.0 ms / 1e15 samples/s rows that way."""
-    extra = {"per_dispatch_ms": round(disp_ms, 4), "config": config}
-    if ms is None:
+    see tools/chained_timing.py) or a sub-resolution ``ms`` emits an
+    explicitly invalid row with NO derived rates, instead of a clamped
+    fake-fast number — the first TPU capture durably recorded 0.0 ms /
+    1e15 samples/s rows that way (the 3 INVALID ROOFLINE.md rows). Rows carry
+    ``protocol: "chained-v2"`` so the report can tell a v2 capture (in-region
+    block_until_ready + sub-resolution rejection + loop-length escalation)
+    from the pre-v2 rows it supersedes."""
+    extra = {"per_dispatch_ms": round(disp_ms, 4), "config": config,
+             "protocol": "chained-v2"}
+    if ms is None or ms <= 0.0:
+        reason = ("noise-dominated chained capture (diff below resolution after "
+                  "loop-length escalation)" if ms is None
+                  else f"sub-resolution chained capture ({ms} ms)")
         row = {"metric": name, "value": None, "unit": "ms", "backend": BACKEND,
-               "invalid": "noise-dominated chained capture (diff<=0 after retry)",
-               **extra}
+               "invalid": reason, **extra}
         print(json.dumps(row))
         append_jsonl(_RUNS_LOG, dict(row))
         return
@@ -117,7 +124,34 @@ def emit_chained(name, ms, disp_ms, config, samples=None, in_bytes=None,
         rates["achieved_gflop_s"] = round(flops / (ms / 1e3) / 1e9, 1)
     if pixels is not None:
         rates["mpixels_per_s"] = round(pixels / (ms / 1e3) / 1e6, 1)
+    _publish_kernel_occupancy(name, rates)
     emit(name, ms, timing="chained-device", **rates, **extra)
+
+
+# roofline row -> the kernel-plane entry whose occupancy it measures
+_ROOFLINE_KERNEL_ROWS = {
+    "roofline stat_scores update": "pair_count_fused",
+    "roofline confusion_matrix update": "pair_count_fused",
+    "roofline binned_curve update": "binned_curve_counts",
+}
+
+
+def _publish_kernel_occupancy(name: str, rates: dict) -> None:
+    """Mirror a kernel-mapped roofline row's fraction-of-ceiling to the obs
+    gauge (``metrics_tpu_kernel_occupancy_fraction``; no-op unless
+    ``obs.enable()`` — the house master-gate pattern). The CPU fraction is a
+    proxy like the row itself; the backend label keeps them apart."""
+    kernel = _ROOFLINE_KERNEL_ROWS.get(name)
+    if kernel is None:
+        return
+    from metrics_tpu.obs import instrument as _obs
+    from tools.roofline_report import CEILINGS
+
+    for field, ceiling, _label in CEILINGS[name]:
+        rate = rates.get(field)
+        if rate is not None and ceiling:
+            _obs.record_kernel_occupancy(kernel, rate / ceiling, BACKEND)
+            return
 
 
 def _rand_boxes(rng, n):

@@ -27,6 +27,7 @@ from metrics_tpu import comm  # noqa: E402  (collective sync plane; not in refer
 from metrics_tpu import engine  # noqa: E402  (serving runtime; not in reference-parity __all__)
 from metrics_tpu import ckpt  # noqa: E402  (durable state plane; not in reference-parity __all__)
 from metrics_tpu import sketch  # noqa: E402  (sketch plane; not in reference-parity __all__)
+from metrics_tpu import kernels  # noqa: E402  (Pallas TPU kernel plane; not in reference-parity __all__)
 from metrics_tpu.aggregation import CatMetric, MaxMetric, MeanMetric, MinMetric, SumMetric  # noqa: E402
 from metrics_tpu.audio import (  # noqa: E402
     PermutationInvariantTraining,

@@ -26,7 +26,14 @@ follower replay, bounded-staleness reads, epoch-fenced promotion — see
 docs/source/replication.md.
 """
 
-from metrics_tpu.engine.bucketing import DEFAULT_BUCKETS, choose_bucket, inspect_request, pad_micro_batch
+from metrics_tpu.engine.bucketing import (
+    DEFAULT_BUCKETS,
+    BucketConfig,
+    choose_bucket,
+    inspect_request,
+    pad_micro_batch,
+    tune_buckets,
+)
 from metrics_tpu.engine.runtime import (
     CheckpointConfig,
     EngineBackpressure,
@@ -53,6 +60,7 @@ from metrics_tpu.repl import (
 
 __all__ = [
     "DEFAULT_BUCKETS",
+    "BucketConfig",
     "CheckpointConfig",
     "DeadlineExceeded",
     "EagerKeyedState",
@@ -74,4 +82,5 @@ __all__ = [
     "choose_bucket",
     "inspect_request",
     "pad_micro_batch",
+    "tune_buckets",
 ]

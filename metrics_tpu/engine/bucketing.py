@@ -14,7 +14,8 @@ tenant's state — no reliance on the metric having a neutral input value.
 
 from __future__ import annotations
 
-from typing import Any, List, Sequence, Tuple
+from dataclasses import dataclass
+from typing import Any, Dict, Iterable, List, Mapping, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -31,12 +32,124 @@ DEFAULT_BUCKETS: Tuple[int, ...] = (8, 16, 32, 64, 128, 256)
 Signature = Tuple[Tuple[Tuple[int, ...], str], ...]
 
 
-def normalize_buckets(buckets: Sequence[int]) -> Tuple[int, ...]:
-    """Sorted, deduplicated, validated bucket sizes."""
+@dataclass(frozen=True)
+class BucketConfig:
+    """The engine's micro-batch ladder as an explicit config object.
+
+    ``ladder`` is the set of row sizes the bucket kernels compile for. The
+    default stays the log2 ladder (:data:`DEFAULT_BUCKETS`); a deployment with
+    a recorded request-size trace can hand :func:`tune_buckets` output here
+    instead (see ``benchmarks/experiments/tune_bucket_ladder.py``), trading
+    generic coverage for measured-traffic padding efficiency at the same
+    compile-cache bound.
+    """
+
+    ladder: Tuple[int, ...] = DEFAULT_BUCKETS
+
+    def normalized(self) -> Tuple[int, ...]:
+        return normalize_buckets(self.ladder)
+
+
+def normalize_buckets(buckets: Union[Sequence[int], BucketConfig]) -> Tuple[int, ...]:
+    """Sorted, deduplicated, validated bucket sizes (accepts a BucketConfig)."""
+    if isinstance(buckets, BucketConfig):
+        buckets = buckets.ladder
     sizes = sorted({int(b) for b in buckets})
     if not sizes or sizes[0] < 1:
         raise MetricsTPUUserError(f"`buckets` must be positive integers, got {buckets!r}")
     return tuple(sizes)
+
+
+def tune_buckets(
+    measured_occupancy: Union[Iterable[int], Mapping[int, float]],
+    *,
+    max_buckets: int = len(DEFAULT_BUCKETS),
+    max_rows: int = DEFAULT_BUCKETS[-1],
+) -> Tuple[int, ...]:
+    """Pick a bucket ladder from measured occupancy instead of log2 guessing.
+
+    ``measured_occupancy`` is a recorded request-size trace: an iterable of
+    observed per-request row counts (what the engine's batch-occupancy
+    telemetry measures — ``telemetry.snapshot()['rows']`` per request, or a
+    replayed submit log), or a pre-aggregated ``{rows: weight}`` mapping.
+
+    Minimizes total padded rows over the trace subject to at most
+    ``max_buckets`` kernel compilations, by exact dynamic programming over the
+    distinct observed sizes (bucket boundaries only ever pay off ON an
+    observed size): ``cost(i..j) = Σ w_k · (s_j − s_k)`` for sizes ``s_i..s_j``
+    assigned to bucket ``s_j``. Sizes above ``max_rows`` are clamped — the
+    engine splits oversized requests into ``max_rows`` chunks anyway
+    (:func:`split_rows`), so the ladder never needs a rung above the cap.
+    Returns the ladder ready for ``BucketConfig(ladder=...)``; empty traces
+    return :data:`DEFAULT_BUCKETS` unchanged.
+    """
+    if int(max_buckets) < 1:
+        raise MetricsTPUUserError(f"`max_buckets` must be >= 1, got {max_buckets}")
+    weights: Dict[int, float] = {}
+    if isinstance(measured_occupancy, Mapping):
+        items: Iterable[Tuple[int, float]] = measured_occupancy.items()
+    else:
+        items = ((int(r), 1.0) for r in measured_occupancy)
+    for rows, w in items:
+        rows = int(rows)
+        if rows < 1 or w <= 0:
+            continue
+        rows = min(rows, int(max_rows))
+        weights[rows] = weights.get(rows, 0.0) + float(w)
+    if not weights:
+        return DEFAULT_BUCKETS
+    sizes = sorted(weights)
+    # bound the DP: past ~512 distinct sizes, collapse to a WEIGHT-quantile
+    # grid — grid points are spent where the traffic mass is (a dominant size
+    # always lands on itself), not uniformly over the distinct-size range.
+    # Each size keeps its weight on the grid point at or above it, so the
+    # padding-cost model stays an upper bound of the true cost.
+    if len(sizes) > 512:
+        w_sorted = np.asarray([weights[s] for s in sizes], dtype=np.float64)
+        cum = np.cumsum(w_sorted)
+        picks = np.searchsorted(cum, np.linspace(0.0, cum[-1], 512), side="left")
+        grid = sorted({int(sizes[min(int(p), len(sizes) - 1)]) for p in picks} | {sizes[-1]})
+        collapsed: Dict[int, float] = {}
+        gi = 0
+        for s in sizes:
+            while grid[gi] < s:
+                gi += 1
+            collapsed[grid[gi]] = collapsed.get(grid[gi], 0.0) + weights[s]
+        weights = collapsed
+        sizes = sorted(weights)
+    m = len(sizes)
+    k_max = min(int(max_buckets), m)
+    w = np.asarray([weights[s] for s in sizes], dtype=np.float64)
+    s = np.asarray(sizes, dtype=np.float64)
+    # cost[i, j]: padded rows when sizes i..j all round up to bucket s_j
+    cum_w = np.concatenate([[0.0], np.cumsum(w)])
+    cum_ws = np.concatenate([[0.0], np.cumsum(w * s)])
+
+    def seg_cost(i: int, j: int) -> float:
+        return s[j] * (cum_w[j + 1] - cum_w[i]) - (cum_ws[j + 1] - cum_ws[i])
+
+    inf = float("inf")
+    dp = np.full((k_max + 1, m), inf)
+    parent = np.full((k_max + 1, m), -1, dtype=np.int64)
+    for j in range(m):
+        dp[1, j] = seg_cost(0, j)
+    for b in range(2, k_max + 1):
+        for j in range(b - 1, m):
+            for i in range(b - 2, j):
+                c = dp[b - 1, i] + seg_cost(i + 1, j)
+                if c < dp[b, j]:
+                    dp[b, j] = c
+                    parent[b, j] = i
+    # the top bucket must cover the largest observed size; fewer buckets than
+    # max_buckets win automatically when extra rungs stop paying
+    best_b = min(range(1, k_max + 1), key=lambda b: dp[b, m - 1])
+    ladder: List[int] = []
+    b, j = best_b, m - 1
+    while j >= 0 and b >= 1:
+        ladder.append(sizes[j])
+        j = int(parent[b, j])
+        b -= 1
+    return tuple(sorted(ladder))
 
 
 def inspect_request(args: Sequence[Any]) -> Tuple[int, Signature]:

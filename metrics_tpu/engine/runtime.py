@@ -55,7 +55,7 @@ import time
 import warnings
 from concurrent.futures import Future
 from dataclasses import dataclass
-from typing import Any, Callable, Dict, Hashable, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, Hashable, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -68,6 +68,7 @@ from metrics_tpu.ckpt.writer import AsyncCheckpointer
 from metrics_tpu.collections import MetricCollection
 from metrics_tpu.engine.bucketing import (
     DEFAULT_BUCKETS,
+    BucketConfig,
     Signature,
     choose_bucket,
     inspect_request,
@@ -281,8 +282,11 @@ class StreamingEngine:
     Args:
         metric_or_collection: the logical metric. The engine works on a private clone,
             so the caller's instance stays free for direct use.
-        buckets: micro-batch row sizes the kernels compile for (powers of two by
-            default). The compile cache after warmup is bounded by this set.
+        buckets: micro-batch row sizes the kernels compile for — a sequence or a
+            :class:`~metrics_tpu.engine.bucketing.BucketConfig` (powers of two by
+            default; ``BucketConfig(ladder=tune_buckets(trace))`` installs a
+            ladder autotuned from measured occupancy). The compile cache after
+            warmup is bounded by this set.
         max_queue: bound on queued (not yet dispatched) requests.
         policy: backpressure policy at a full queue — "block" | "drop" | "timeout".
         submit_timeout: seconds a "timeout"-policy submit waits for queue space.
@@ -309,7 +313,7 @@ class StreamingEngine:
         self,
         metric_or_collection: Any,
         *,
-        buckets: Sequence[int] = DEFAULT_BUCKETS,
+        buckets: Union[Sequence[int], BucketConfig] = DEFAULT_BUCKETS,
         max_queue: int = 1024,
         policy: str = "block",
         submit_timeout: float = 1.0,
@@ -1984,29 +1988,26 @@ class StreamingEngine:
         """One jitted micro-batch kernel: masked per-row scan over the stacked state.
 
         The scan body runs the metric's own ``update_state`` on the addressed tenant's
-        slice and `where`-selects the pre-update state for masked (padding) rows, then
-        scatters the slice back — sequential per-tenant semantics, one XLA dispatch for
-        the whole micro-batch across all tenants. The input stack is donated: the
-        engine owns it exclusively, so XLA can update the buffers in place.
+        slice — sequential per-tenant semantics, one XLA dispatch for the whole
+        micro-batch across all tenants. The body is the kernel plane's
+        ``engine_masked_scan`` entry (metrics_tpu/kernels/engine_scan.py): the
+        reference `where`-selects the pre-update state back for masked (padding)
+        rows before scattering; the fused lowering — selected per the registry
+        mode, statically per compiled kernel — folds the mask into the scatter
+        address instead (masked rows land in a scratch row sliced off at exit),
+        one pass over the tenant slice per row and bit-identical on real rows.
+        The input stack is donated: the engine owns it exclusively, so XLA can
+        update the buffers in place on the reference path.
         """
+        from metrics_tpu.kernels.engine_scan import masked_scan_update
+
         metric = self._metric
         telemetry = self.telemetry
 
         def kernel(stacked: Any, key_ids: jax.Array, mask: jax.Array, *columns: jax.Array) -> Any:
             # executes at trace time only — counts actual recompiles, not calls
             telemetry.count("compiles")
-
-            def step(carry: Any, xs: Tuple[Any, ...]) -> Tuple[Any, None]:
-                kid, mk = xs[0], xs[1]
-                rows = xs[2:]
-                per_key = jax.tree.map(lambda s: s[kid], carry)
-                new = metric.update_state(per_key, *rows)
-                new = jax.tree.map(lambda n, o: jnp.where(mk, n, o), new, per_key)
-                carry = jax.tree.map(lambda s, n: s.at[kid].set(n), carry, new)
-                return carry, None
-
-            carry, _ = lax.scan(step, stacked, (key_ids, mask, *columns))
-            return carry
+            return masked_scan_update(metric.update_state, stacked, key_ids, mask, columns)
 
         jitted = jax.jit(kernel, donate_argnums=0)
 

@@ -3,18 +3,22 @@
 Reference parity: src/torchmetrics/functional/classification/confusion_matrix.py
 (binary/multiclass/multilabel + ``_confusion_matrix_reduce`` normalisation).
 
-TPU notes: the multiclass count has two value-identical lowerings chosen at
-trace time per backend. On accelerators it is an MXU one-hot matmul —
-``one_hot(target).T @ one_hot(preds)`` in bf16 with f32 accumulation (0/1
-products are exact in bf16 and the f32 sums are exact for any per-call
-N < 2**24) — measured 33x faster than the scatter on a v5e (0.23 ms vs 7.7 ms
-at 1M samples x 100 classes, 44% of MXU bf16 peak; see
-benchmarks/experiments/onehot_confmat_tpu.py). On the host backend (and for
-N >= 2**24 per call) it is ``jnp.bincount(target*C + preds, length=C*C)``
-(static-shape scatter-add; deterministic on XLA — the reference needed a
-fallback loop for this, data.py:206-228), where the CPU's serial scatter beats
-materializing (N, C) one-hots. ``ignore_index`` routes ignored pairs to an
-overflow bucket (scatter) or zeroes the target row (matmul) instead of boolean
+TPU notes: the multiclass count is the kernel plane's registry-dispatched pair
+count (``metrics_tpu/kernels/confmat.py`` — registry contract and dispatch
+rules in docs/source/kernels.md). Value-identical lowerings, chosen at trace
+time: on accelerators the MXU one-hot matmul — ``one_hot(target).T @
+one_hot(preds)`` in bf16 with f32 accumulation (0/1 products are exact in bf16
+and the f32 sums are exact for any per-call N < 2**24) — measured 33x faster
+than the scatter on a v5e (0.23 ms vs 7.7 ms at 1M samples x 100 classes, 44%
+of MXU bf16 peak; see benchmarks/experiments/onehot_confmat_tpu.py); on TPU,
+where selected, the Pallas fused streaming kernel that builds the one-hot
+tiles on-chip instead of materializing the (N, C) operands in HBM (the
+``stat_scores update`` roofline row). On the host backend (and for N >= 2**24
+per call) it is ``jnp.bincount(target*C + preds, length=C*C)`` (static-shape
+scatter-add; deterministic on XLA — the reference needed a fallback loop for
+this, data.py:206-228), where the CPU's serial scatter beats materializing
+(N, C) one-hots. ``ignore_index`` routes ignored pairs to an overflow bucket
+(scatter) or zeroes the target row (one-hot paths) instead of boolean
 filtering.
 """
 
@@ -95,33 +99,20 @@ def binary_confusion_matrix(
     return _confusion_matrix_reduce(confmat, normalize)
 
 
-def _matmul_lowering_eligible(size: int, num_classes: int) -> bool:
-    """Single source of truth for the accelerator matmul-lowering guard (also
-    imported by stat_scores.py, which routes through the cm on eligibility).
-    2**24: f32-accumulation exactness bound. 2**29: cap the (N, C) bf16
-    one-hot operands at ~2 GiB — beyond that the O(N) scatter is the safer
-    lowering even though it is slower per element (OOM beats slow)."""
-    return size < 2**24 and size * num_classes <= 2**29
-
-
-def _onehot_count_matmul(row_idx: Array, col_idx: Array, num_rows: int, num_cols: int,
-                         row_mask: Optional[Array] = None) -> Array:
-    """(num_rows, num_cols) pair counts as a bf16 one-hot MXU matmul — the ONE
-    implementation of the lowering (exactness argument in the module
-    docstring), shared by the classification confusion matrix and the nominal
-    contingency table. Masked samples contribute an all-zero row one-hot;
-    out-of-range indices yield all-zero one-hots, i.e. the pair is dropped."""
-    oh_r = jax.nn.one_hot(row_idx, num_rows, dtype=jnp.bfloat16)
-    if row_mask is not None:
-        oh_r = oh_r * row_mask.astype(jnp.bfloat16)[:, None]
-    oh_c = jax.nn.one_hot(col_idx, num_cols, dtype=jnp.bfloat16)
-    counts = jax.lax.dot_general(oh_r, oh_c, (((0,), (0,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-    return counts.astype(jnp.int32)
+# Back-compat shims: the pair-count lowerings moved to the kernel plane
+# (metrics_tpu/kernels/confmat.py — registry entry #0 is the MXU matmul, with
+# the Pallas fused streaming kernel layered above it); nominal/utils.py and the
+# tests import these names from here.
+from metrics_tpu.kernels.confmat import (  # noqa: E402
+    matmul_eligible as _matmul_lowering_eligible,
+    pair_count as _pair_count,
+    pair_count_matmul as _onehot_count_matmul,
+)
 
 
 def _multiclass_confusion_matrix_matmul(p: Array, t: Array, mask: Array, num_classes: int) -> Array:
-    """(C, C) counts, rows = true class, via the shared one-hot matmul."""
+    """(C, C) counts, rows = true class, via the plane's one-hot matmul
+    (kernels/confmat.py entry #0) — kept for the lowering-parity tests."""
     return _onehot_count_matmul(t, p, num_classes, num_classes, row_mask=mask)
 
 
@@ -132,23 +123,18 @@ def _multiclass_confusion_matrix_update(
     """(C, C) counts, rows = true class (reference confusion_matrix.py multiclass
     update). Jitted at definition: fusing key construction + masking + the count
     beats the reference's eager C++ bincount (~2x on CPU, 33x on the v5e via the
-    matmul lowering). The backend branch is trace-time and both lowerings are
-    integer-exact with identical semantics — out-of-range class indices (only
-    reachable with validate_args=False, undefined in the reference) are DROPPED
-    by both, so a device/trace mismatch affects speed only."""
+    matmul lowering). The count itself is the kernel plane's registry-dispatched
+    pair count (metrics_tpu/kernels/confmat.py): Pallas fused streaming kernel
+    where selected, MXU one-hot matmul on accelerators, bincount scatter on the
+    host backend. Every lowering is integer-exact with identical semantics —
+    out-of-range class indices (only reachable with validate_args=False,
+    undefined in the reference) are DROPPED by all of them, so the trace-time
+    selection affects speed only."""
     mask = _ignore_mask(target, ignore_index)
     t = jnp.where(mask, target, 0).astype(jnp.int32)
     p = preds.astype(jnp.int32)
-    if jax.default_backend() != "cpu" and _matmul_lowering_eligible(p.size, num_classes):
-        return _multiclass_confusion_matrix_matmul(p.reshape(-1), t.reshape(-1),
-                                                   mask.reshape(-1), num_classes)
-    # ignored and out-of-range pairs go to an overflow bucket (index C*C) that
-    # is trimmed after counting (the one-hot path drops them as zero rows)
-    in_range = (p >= 0) & (p < num_classes) & (t >= 0) & (t < num_classes)
-    unique_mapping = jnp.where((mask & in_range).reshape(-1),
-                               (t * num_classes + p).reshape(-1), num_classes * num_classes)
-    bins = jnp.bincount(unique_mapping, length=num_classes * num_classes + 1)[: num_classes * num_classes]
-    return bins.reshape(num_classes, num_classes)
+    return _pair_count(t.reshape(-1), p.reshape(-1), num_classes, num_classes,
+                       row_mask=mask.reshape(-1))
 
 
 def multiclass_confusion_matrix(

@@ -240,10 +240,13 @@ def _binary_precision_recall_curve_update(
 ) -> Union[Array, Tuple[Array, Array]]:
     """Binned: (T,2,2) state (reference :184-201).
 
-    Two value-identical lowerings, chosen per backend (both integer-exact, so
-    the trace-time branch affects speed only): on TPU a (T, M) comparison +
-    two matvecs that ride the MXU; on the host backend the bucketized
-    histogram (no (T, M) intermediate — ~15x at 1M samples × 100 thresholds).
+    Value-identical lowerings, chosen per backend (all integer-exact, so the
+    trace-time branch affects speed only): on the host backend the bucketized
+    histogram (no (T, M) intermediate — ~15x at 1M samples × 100 thresholds);
+    on accelerators the kernel plane's ``binned_curve_counts`` entry
+    (metrics_tpu/kernels/binned_curve.py) — the Pallas streaming kernel with
+    an on-chip (T, 1) accumulator where the registry selects it, the (T, M)
+    comparison + two MXU matvecs reference otherwise.
     """
     if thresholds is None:
         return preds, target
@@ -258,10 +261,9 @@ def _binary_precision_recall_curve_update(
         )
         tp, fp = tp[:, 0].astype(jnp.float32), fp[:, 0].astype(jnp.float32)
     else:
-        # (T, M) boolean comparison, then two (T,M)@(M,) matvecs -> MXU
-        preds_t = (preds[None, :] >= thresholds[:, None]).astype(jnp.float32) * w[None, :]
-        tp = preds_t @ t
-        fp = preds_t @ (w - t)
+        from metrics_tpu.kernels.binned_curve import binned_curve_counts
+
+        tp, fp = binned_curve_counts(preds, t, w, thresholds)
     fn = pos - tp
     tn = neg - fp
     confmat = jnp.stack([jnp.stack([tn, fp], axis=-1), jnp.stack([fn, tp], axis=-1)], axis=-2)

@@ -304,12 +304,16 @@ def _multiclass_stat_scores_update(
     m = mask.astype(jnp.float32)
 
     # Fast path: with label preds, top_k=1 and a global reduce, every count
-    # derives from the (C, C) confusion matrix — on the host backend one O(N)
-    # masked bincount, on accelerators an MXU one-hot matmul (both picked
-    # inside _multiclass_confusion_matrix_update; the matmul measured 33x over
-    # the scatter on the v5e, benchmarks/experiments/onehot_confmat_tpu.py,
-    # and needs one (C,C)-product where the O(N*C) elementwise one-hot form
-    # this path previously used on accelerators needs four). Excluded:
+    # derives from the (C, C) confusion matrix, which routes through the
+    # kernel plane's pair count (metrics_tpu/kernels/confmat.py, via
+    # _multiclass_confusion_matrix_update): on the host backend one O(N)
+    # masked bincount, on accelerators the MXU one-hot matmul (33x over the
+    # scatter on the v5e, benchmarks/experiments/onehot_confmat_tpu.py, and
+    # one (C,C)-product where the O(N*C) elementwise one-hot form this path
+    # previously used on accelerators needs four), and on TPU — where the
+    # registry selects it — the Pallas fused streaming kernel that never
+    # materializes the (N, C) one-hot operands in HBM (the ROOFLINE.md
+    # `stat_scores update` 43.8%-of-HBM row this plane exists for). Excluded:
     # matmul-ineligible sizes on accelerators, where the cm update would fall
     # back to the TPU-slow scatter — the elementwise one-hot arithmetic below
     # is the better floor there.

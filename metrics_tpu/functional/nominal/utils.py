@@ -71,31 +71,18 @@ def _unable_to_compute_warning(metric: str) -> None:
 def _joint_confusion_matrix(preds: Array, target: Array, num_classes_preds: int, num_classes_target: int) -> Array:
     """(Cx, Cy) contingency counts, rows = preds categories.
 
-    Two value-identical lowerings, same design as the classification confusion
-    matrix (confusion_matrix.py module docstring): on accelerators a bf16
-    one-hot MXU matmul (0/1 products exact; f32 accumulation exact under the
-    shared `_matmul_lowering_eligible` bound — the scatter measured 33x slower
-    on a v5e), on the host backend a bincount scatter-add. Out-of-range
-    category values — reachable e.g. via raw integer labels containing -1, or
-    a negative ``nan_replace_value`` — are DROPPED by both: an out-of-range
-    one-hot row is all-zero, and the scatter routes them to a trimmed overflow
-    bucket (``jnp.bincount`` would otherwise CLIP a negative key to bin 0)."""
-    import jax
-
-    from metrics_tpu.functional.classification.confusion_matrix import (
-        _matmul_lowering_eligible,
-        _onehot_count_matmul,
-    )
+    Routed through the kernel plane's pair count
+    (``metrics_tpu/kernels/confmat.py``): on accelerators the bf16 one-hot MXU
+    matmul (0/1 products exact; f32 accumulation exact under the shared
+    ``matmul_eligible`` bound — the scatter measured 33x slower on a v5e), on
+    TPU the Pallas fused streaming kernel where selected, on the host backend
+    a bincount scatter-add. Out-of-range category values — reachable e.g. via
+    raw integer labels containing -1, or a negative ``nan_replace_value`` —
+    are DROPPED by every lowering: an out-of-range one-hot row is all-zero,
+    and the scatter routes them to a trimmed overflow bucket (``jnp.bincount``
+    would otherwise CLIP a negative key to bin 0)."""
+    from metrics_tpu.kernels.confmat import pair_count
 
     p = preds.reshape(-1).astype(jnp.int32)
     t = target.reshape(-1).astype(jnp.int32)
-    if jax.default_backend() != "cpu" and _matmul_lowering_eligible(
-        p.size, max(num_classes_preds, num_classes_target)
-    ):
-        return _onehot_count_matmul(p, t, num_classes_preds, num_classes_target)
-    size = num_classes_preds * num_classes_target
-    in_range = (p >= 0) & (p < num_classes_preds) & (t >= 0) & (t < num_classes_target)
-    mapping = jnp.where(in_range, p * num_classes_target + t, size)
-    return jnp.bincount(mapping, length=size + 1)[:size].reshape(
-        num_classes_preds, num_classes_target
-    )
+    return pair_count(p, t, num_classes_preds, num_classes_target)

@@ -1,0 +1,61 @@
+"""Hand-written TPU kernel plane — Pallas lowerings behind a safe dispatch registry.
+
+The "fast as the hardware allows" lane (ROADMAP item 3, SURVEY §0: XLA/Pallas
+IS this repo's native-code layer). Every entry in :mod:`.registry` pairs an
+optimized lowering with the jnp reference it is contract-bound to match
+**bit-identically on integer/count states**, selected only where it wins
+(env/flag-gated: ``METRICS_TPU_KERNELS=auto|off|force``; ``force`` runs Pallas
+under ``interpret=True`` off-TPU, which is how ``tests/kernels/`` proves every
+entry against its reference on CPU) and falling back to the reference on any
+kernel failure. Registry contract, dispatch rules, and how to add a kernel:
+``docs/source/kernels.md``; the measured motivation per entry:
+``benchmarks/ROOFLINE.md``.
+
+Entries (importing this package registers them all):
+
+- ``pair_count_matmul`` (entry #0) / ``pair_count_fused`` — the confusion-
+  matrix / stat-scores / contingency pair count: the production-routed bf16
+  one-hot MXU matmul (33x over the scatter on a v5e) and the Pallas streaming
+  kernel that stops materializing the (N, C) one-hot operands in HBM (the
+  ``stat_scores update`` 43.8%-of-HBM roofline row);
+- ``binned_curve_counts`` — streaming threshold counts with an on-chip (T, 1)
+  accumulator (promoted from ``benchmarks/experiments/pallas_binned_curve.py``);
+- ``ddsketch_hist_add`` / ``hll_scatter_max`` / ``cms_row_scatter`` — the
+  sketch plane's scatter-heavy updates as int32 streaming compare+reduce
+  kernels (PR 7 headroom item);
+- ``engine_masked_scan`` — the engine's bucket-masked scan dispatch with the
+  mask fused into the scatter address (one pass over the tenant slice per row).
+"""
+
+from metrics_tpu.kernels import registry
+from metrics_tpu.kernels.registry import (  # noqa: F401
+    REGISTRY,
+    KernelEntry,
+    configure,
+    dispatch,
+    forced,
+    get,
+    mode,
+    names,
+    register,
+    selected,
+)
+from metrics_tpu.kernels import binned_curve, confmat, engine_scan, scatter  # noqa: F401  (registration on import)
+
+__all__ = [
+    "REGISTRY",
+    "KernelEntry",
+    "binned_curve",
+    "confmat",
+    "configure",
+    "dispatch",
+    "engine_scan",
+    "forced",
+    "get",
+    "mode",
+    "names",
+    "register",
+    "registry",
+    "scatter",
+    "selected",
+]

@@ -1,0 +1,274 @@
+"""Pair-count kernels: the (R, C) co-occurrence count behind confusion matrices,
+stat-scores and nominal contingency tables.
+
+Three value-identical lowerings of ``counts[r, c] += mask`` over index pairs,
+ordered by how hard they lean on the hardware:
+
+- ``pair_count_bincount`` — the jnp reference: one O(N) ``jnp.bincount``
+  scatter-add over flattened pair keys (what the host backend runs; the
+  lowering the reference library needed a determinism-fallback loop for).
+- ``pair_count_matmul`` — **registry entry #0**: the bf16 one-hot MXU matmul
+  (``one_hot(r).T @ one_hot(c)`` with f32 accumulation) that measured **33x**
+  over the scatter on a v5e at 1M samples x 100 classes
+  (``benchmarks/experiments/onehot_confmat_tpu.py``) and has been
+  production-routed since round 5. Exact because 0/1 products are exact in
+  bf16 and f32 sums of integers are exact below 2**24.
+- ``pair_count_fused`` — the Pallas streaming kernel for the roofline gap the
+  matmul leaves (``benchmarks/ROOFLINE.md``: ``stat_scores update`` at 43.8%
+  of the HBM bound): the matmul route materializes TWO (N, C) bf16 one-hot
+  operands in HBM (~2·N·C bytes of write+read traffic for 8·N bytes of actual
+  input). The Pallas kernel streams the index pairs through VMEM in
+  ``(_ROWS, _WIDE)`` tiles, builds the one-hot tiles **on-chip** via iota
+  compares, and contracts them on the MXU into a resident (R, C) f32
+  accumulator — HBM traffic is one read of the index streams, period. The
+  TPU grid is sequential, so accumulate-across-grid-steps is race-free.
+
+All three drop out-of-range indices (a zero one-hot row/column ≡ an overflow
+bucket trimmed after counting) and treat ``row_mask`` as a 0/1 row weight, so
+they are bit-identical wherever the exactness bounds hold — which
+:func:`matmul_eligible` enforces before either optimized path is selected.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.kernels import registry
+from metrics_tpu.kernels.tiling import pad_to_tiles
+from metrics_tpu.obs import instrument as _obs
+
+_WIDE = 512  # index pairs per kernel row (4 lane-groups of 128)
+_ROWS = 8  # rows per grid step -> 4096 pairs/step
+# VMEM budget rails for the fused kernel: the (R, _WIDE)/(C, _WIDE) bf16
+# one-hot tiles cap each dimension (4096 -> 4 MB per tile), and the RESIDENT
+# (R, C) f32 accumulator caps the product (2^20 -> 4 MB; without this an
+# eligible 4096x4096 call would ask for a 64 MB accumulator and die at Mosaic
+# compile time — inside the caller's outer jit, beyond the dispatch fallback)
+MAX_FUSED_DIM = 4096
+MAX_FUSED_CELLS = 2**20
+
+
+def matmul_eligible(size: int, num_classes: int) -> bool:
+    """Single source of truth for the accelerator count-lowering guard.
+
+    2**24: f32-accumulation exactness bound (the bit-identity contract).
+    2**29: cap the (N, C) bf16 one-hot operands at ~2 GiB — beyond that the
+    O(N) scatter is the safer lowering even though it is slower per element
+    (OOM beats slow). The Pallas fused path never materializes the operands
+    but keeps the same exactness bound and inherits the cap as a sanity rail.
+    """
+    return size < 2**24 and size * num_classes <= 2**29
+
+
+# --------------------------------------------------------------------- reference
+
+
+def pair_count_bincount(
+    row_idx: Array,
+    col_idx: Array,
+    num_rows: int,
+    num_cols: int,
+    row_mask: Optional[Array] = None,
+) -> Array:
+    """(num_rows, num_cols) int32 pair counts via one flat scatter-add.
+
+    Ignored (masked) and out-of-range pairs go to an overflow bucket (index
+    ``num_rows * num_cols``) that is trimmed after counting.
+    """
+    r = jnp.ravel(row_idx).astype(jnp.int32)
+    c = jnp.ravel(col_idx).astype(jnp.int32)
+    valid = (r >= 0) & (r < num_rows) & (c >= 0) & (c < num_cols)
+    if row_mask is not None:
+        valid = valid & jnp.ravel(row_mask).astype(bool)
+    key = jnp.where(valid, r * num_cols + c, num_rows * num_cols)
+    bins = jnp.bincount(key, length=num_rows * num_cols + 1)[: num_rows * num_cols]
+    return bins.reshape(num_rows, num_cols).astype(jnp.int32)
+
+
+# --------------------------------------------------------------------- entry #0
+
+
+def pair_count_matmul(
+    row_idx: Array,
+    col_idx: Array,
+    num_rows: int,
+    num_cols: int,
+    row_mask: Optional[Array] = None,
+    *,
+    interpret: bool = False,  # jnp lowering: nothing to interpret
+) -> Array:
+    """(num_rows, num_cols) pair counts as a bf16 one-hot MXU matmul — the ONE
+    implementation of the matmul lowering (exactness argument in the module
+    docstring), shared by the classification confusion matrix and the nominal
+    contingency table. Masked samples contribute an all-zero row one-hot;
+    out-of-range indices yield all-zero one-hots, i.e. the pair is dropped."""
+    del interpret
+    r = jnp.ravel(row_idx).astype(jnp.int32)
+    c = jnp.ravel(col_idx).astype(jnp.int32)
+    oh_r = jax.nn.one_hot(r, num_rows, dtype=jnp.bfloat16)
+    if row_mask is not None:
+        oh_r = oh_r * jnp.ravel(row_mask).astype(jnp.bfloat16)[:, None]
+    oh_c = jax.nn.one_hot(c, num_cols, dtype=jnp.bfloat16)
+    counts = jax.lax.dot_general(
+        oh_r, oh_c, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    return counts.astype(jnp.int32)
+
+
+# --------------------------------------------------------------------- Pallas
+
+
+def _pair_count_kernel(r_ref, c_ref, w_ref, out_ref):
+    import jax.experimental.pallas as pl
+
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    rt, ct = out_ref.shape
+    row_ids = jax.lax.broadcasted_iota(jnp.int32, (rt, 1), 0)
+    col_ids = jax.lax.broadcasted_iota(jnp.int32, (ct, 1), 0)
+
+    def body(k, acc):
+        sl = pl.ds(k, 1)
+        r = r_ref[sl, :]  # (1, _WIDE) int32 — pairs on the lane axis
+        c = c_ref[sl, :]
+        w = w_ref[sl, :]  # (1, _WIDE) f32 0/1 row weights
+        # one-hot tiles built ON-CHIP (the whole point: no (N, C) HBM operand),
+        # then one MXU contraction over the lane axis per tile row
+        oh_r = (r == row_ids).astype(jnp.bfloat16) * w.astype(jnp.bfloat16)  # (rt, _WIDE)
+        oh_c = (c == col_ids).astype(jnp.bfloat16)  # (ct, _WIDE)
+        return acc + jax.lax.dot_general(
+            oh_r, oh_c, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    out_ref[:] += jax.lax.fori_loop(
+        0, _ROWS, body, jnp.zeros(out_ref.shape, jnp.float32)
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("num_rows", "num_cols", "interpret"))
+def _pair_count_pallas(
+    row_idx: Array,
+    col_idx: Array,
+    weights: Array,
+    num_rows: int,
+    num_cols: int,
+    interpret: bool = False,
+) -> Array:
+    import jax.experimental.pallas as pl
+
+    n = row_idx.shape[0]
+    # executes at trace time only — one fresh Pallas compile per shape
+    _obs.record_kernel_compile("pair_count_fused", f"n={n}|rows={num_rows}|cols={num_cols}")
+    # -1 padding matches no iota row/column -> contributes nothing (same drop
+    # semantics as the matmul's zero one-hots and the bincount's overflow bucket)
+    (r, c, w), n_pad = pad_to_tiles(
+        [row_idx.astype(jnp.int32), col_idx.astype(jnp.int32), weights.astype(jnp.float32)],
+        [-1, -1, 0.0], _ROWS, _WIDE,
+    )
+    # pad the accumulator to TPU tile multiples; slice the live block after
+    rt = -(-num_rows // 8) * 8
+    ct = -(-num_cols // 128) * 128
+    block = pl.BlockSpec((_ROWS, _WIDE), lambda i: (i, 0))
+    counts = pl.pallas_call(
+        _pair_count_kernel,
+        grid=(n_pad // (_ROWS * _WIDE),),
+        in_specs=[block, block, block],
+        out_specs=pl.BlockSpec((rt, ct), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((rt, ct), jnp.float32),
+        interpret=interpret,
+    )(r, c, w)
+    return counts[:num_rows, :num_cols].astype(jnp.int32)
+
+
+def pair_count_fused(
+    row_idx: Array,
+    col_idx: Array,
+    num_rows: int,
+    num_cols: int,
+    row_mask: Optional[Array] = None,
+    *,
+    interpret: bool = False,
+) -> Array:
+    r = jnp.ravel(row_idx)
+    c = jnp.ravel(col_idx)
+    w = (
+        jnp.ravel(row_mask).astype(jnp.float32)
+        if row_mask is not None
+        else jnp.ones(r.shape, jnp.float32)
+    )
+    return _pair_count_pallas(r, c, w, num_rows, num_cols, interpret=interpret)
+
+
+# --------------------------------------------------------------------- registry
+
+
+def _matmul_entry_eligible(row_idx, col_idx, num_rows, num_cols, row_mask=None) -> bool:
+    return matmul_eligible(int(jnp.size(row_idx)), max(num_rows, num_cols))
+
+
+def _fused_entry_eligible(row_idx, col_idx, num_rows, num_cols, row_mask=None) -> bool:
+    size = int(jnp.size(row_idx))
+    return (
+        size >= 1  # a zero-row grid has nothing to stream — the reference's zeros are free
+        and matmul_eligible(size, max(num_rows, num_cols))
+        and max(num_rows, num_cols) <= MAX_FUSED_DIM
+        and num_rows * num_cols <= MAX_FUSED_CELLS
+    )
+
+
+registry.register(
+    registry.KernelEntry(
+        name="pair_count_matmul",
+        reference=pair_count_bincount,
+        optimized=pair_count_matmul,
+        eligible=_matmul_entry_eligible,
+        requires_tpu=False,  # any accelerator backend profits; CPU keeps the scatter
+        doc="bf16 one-hot MXU matmul pair count (33x over the scatter on a v5e) — entry #0",
+    )
+)
+
+registry.register(
+    registry.KernelEntry(
+        name="pair_count_fused",
+        reference=pair_count_matmul,
+        optimized=pair_count_fused,
+        eligible=_fused_entry_eligible,
+        requires_tpu=True,
+        doc=(
+            "Pallas streaming pair count: on-chip one-hot tiles + resident (R, C) "
+            "accumulator — HBM traffic is one index-stream read (the stat_scores "
+            "roofline row), vs the matmul's 2*N*C one-hot operand traffic"
+        ),
+    )
+)
+
+
+def pair_count(
+    row_idx: Array,
+    col_idx: Array,
+    num_rows: int,
+    num_cols: int,
+    row_mask: Optional[Array] = None,
+) -> Array:
+    """The production pair-count: fused Pallas where selected, else the MXU
+    matmul where selected, else the bincount scatter — every step registry-
+    gated and falling back toward the reference on any failure."""
+    if (
+        registry.selected("pair_count_fused", row_idx, col_idx, num_rows, num_cols, row_mask)
+        == "optimized"
+    ):
+        return registry.dispatch(
+            "pair_count_fused", row_idx, col_idx, num_rows, num_cols, row_mask
+        )
+    return registry.dispatch(
+        "pair_count_matmul", row_idx, col_idx, num_rows, num_cols, row_mask
+    )

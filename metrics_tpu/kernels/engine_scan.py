@@ -1,0 +1,139 @@
+"""The engine's bucket-masked scan dispatch — reference and fused lowerings.
+
+``StreamingEngine``'s bucket kernels (engine/runtime.py ``_build_kernel``) scan
+the coalesced micro-batch rows over the stacked multi-tenant state, applying
+the metric's own ``update_state`` per row. The **reference** body makes two
+passes over the addressed tenant slice per row: compute the update, then
+``where``-select the pre-update state back for masked (padding) rows before
+scattering. The **fused** body folds the mask into the scatter *address*
+instead: the stacked state is extended by one scratch row at kernel entry, a
+masked row's (discarded) update lands there, and every real row scatters
+``update_state``'s result directly — one pass over the tenant slice per row,
+no per-leaf select. Real rows see bit-identical arithmetic (the same
+``update_state`` on the same carry in the same scan order; masked rows touch
+only the scratch row, which is sliced off at exit).
+
+The trade: the fused form pays the scratch-row extend/slice (two O(capacity)
+copies per dispatch, and it breaks XLA's in-place donation of the stack) to
+save a per-row O(state) select — profitable when the micro-batch is at least
+as tall as the tenant stack, which the registry eligibility encodes
+(``bucket >= capacity``; the engine compiles one kernel per (signature,
+bucket, capacity), so the choice is static per kernel). Selection rides the
+kernel-plane registry: ``auto`` keeps the reference on CPU (today's engine
+exactly) and fuses on accelerators; ``force`` fuses everywhere — how the
+``tests/kernels/`` integration test proves ``fused_fallbacks=0`` with
+bit-identical per-tenant state, and how ``benchmarks/engine_throughput.py
+--kernels`` gates no-regression on CPU.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from metrics_tpu.kernels import registry
+
+
+def _reference_scan(
+    update_state: Callable,
+    stacked: Any,
+    key_ids: jax.Array,
+    mask: jax.Array,
+    columns: Sequence[jax.Array],
+) -> Any:
+    """Two-pass body: update, where-select masked rows back, scatter."""
+
+    def step(carry: Any, xs: Tuple[Any, ...]) -> Tuple[Any, None]:
+        kid, mk = xs[0], xs[1]
+        rows = xs[2:]
+        per_key = jax.tree.map(lambda s: s[kid], carry)
+        new = update_state(per_key, *rows)
+        new = jax.tree.map(lambda n, o: jnp.where(mk, n, o), new, per_key)
+        carry = jax.tree.map(lambda s, n: s.at[kid].set(n), carry, new)
+        return carry, None
+
+    carry, _ = lax.scan(step, stacked, (key_ids, mask, *columns))
+    return carry
+
+
+def _fused_scan(
+    update_state: Callable,
+    stacked: Any,
+    key_ids: jax.Array,
+    mask: jax.Array,
+    columns: Sequence[jax.Array],
+    *,
+    interpret: bool = False,  # jnp lowering: nothing to interpret
+) -> Any:
+    """One-pass body: masked rows scatter into a scratch row sliced off at exit."""
+    capacity = jax.tree.leaves(stacked)[0].shape[0]
+    ext = jax.tree.map(
+        lambda s: jnp.concatenate([s, jnp.zeros_like(s[:1])], axis=0), stacked
+    )
+    # the mask becomes the scatter ADDRESS: real rows hit their tenant slot,
+    # padding rows hit the scratch slot (whose garbage never escapes the slice)
+    slots = jnp.where(mask, key_ids.astype(jnp.int32), jnp.int32(capacity))
+
+    def step(carry: Any, xs: Tuple[Any, ...]) -> Tuple[Any, None]:
+        slot = xs[0]
+        rows = xs[1:]
+        per_key = jax.tree.map(lambda s: s[slot], carry)
+        new = update_state(per_key, *rows)
+        carry = jax.tree.map(lambda s, n: s.at[slot].set(n), carry, new)
+        return carry, None
+
+    ext, _ = lax.scan(step, ext, (slots, *columns))
+    return jax.tree.map(lambda s: s[:capacity], ext)
+
+
+def _eligible(bucket: int, capacity: int) -> bool:
+    # the saved per-row selects must outweigh the scratch extend/slice copies
+    return bucket >= capacity
+
+
+def _entry_eligible(
+    update_state: Callable,
+    stacked: Any,
+    key_ids: jax.Array,
+    mask: jax.Array,
+    columns: Sequence[jax.Array],
+) -> bool:
+    """Registry-contract eligibility: same signature as the entry's callables
+    (so generic ``registry.dispatch`` works on this entry like any other),
+    deriving the static bucket/capacity facts from the call itself."""
+    return _eligible(int(key_ids.shape[0]), int(jax.tree.leaves(stacked)[0].shape[0]))
+
+
+registry.register(
+    registry.KernelEntry(
+        name="engine_masked_scan",
+        reference=_reference_scan,
+        optimized=_fused_scan,
+        eligible=_entry_eligible,
+        requires_tpu=False,  # jnp formulation; profitable on any accelerator
+        doc=(
+            "fused mask-select + per-row update: mask folded into the scatter "
+            "address via a scratch row — one pass over the tenant slice per row"
+        ),
+    )
+)
+
+
+def masked_scan_update(
+    update_state: Callable,
+    stacked: Any,
+    key_ids: jax.Array,
+    mask: jax.Array,
+    columns: Sequence[jax.Array],
+) -> Any:
+    """Run one micro-batch through the selected scan body — plain registry
+    dispatch (the choice is static per compiled engine kernel, so the obs
+    dispatch record counts compiles, not calls; an untraceable metric update
+    fails the fused attempt, is counted as a fallback, and then fails the
+    reference too — which is what routes the engine to its eager retry)."""
+    return registry.dispatch(
+        "engine_masked_scan", update_state, stacked, key_ids, mask, columns
+    )

@@ -1,0 +1,215 @@
+"""Safe dispatch registry for the hand-written TPU kernel plane.
+
+Every entry pairs an **optimized** lowering (a Pallas TPU kernel, or a
+jnp formulation that is only profitable on accelerators) with the existing
+**jnp reference** it must be value-identical to. Selection is structural and
+trace-time static:
+
+- mode ``"auto"`` (the default): Pallas entries run on a real TPU backend
+  only; jnp-optimized entries (e.g. the confusion-matrix MXU matmul) run on
+  any accelerator backend. Everything else gets the reference.
+- mode ``"off"``: every dispatch takes the reference — the escape hatch when
+  a kernel is suspected (``METRICS_TPU_KERNELS=off``).
+- mode ``"force"``: every eligible entry takes the optimized path, with
+  Pallas kernels running under ``interpret=True`` off-TPU. This is the CI
+  parity mode: ``tests/kernels/`` proves each entry bit-identical to its
+  reference on the CPU interpreter before any TPU ever runs it.
+
+The mode comes from the ``METRICS_TPU_KERNELS`` env var at import time and
+can be overridden programmatically with :func:`configure` / :func:`forced`.
+Because the callers are jitted, a mode change only affects traces compiled
+AFTER the change — set the env var before first use in serving processes
+(tests use :func:`forced`, which is fine because their shapes trace fresh).
+
+Contract (CI-enforced, ``tests/kernels/``): on integer/count states the
+optimized path must be **bit-identical** to the reference — same ints out for
+the same ints in, regardless of accumulation order. Entries whose inputs can
+carry arbitrary float weights document the weaker ``allclose`` contract for
+that case and the exact sub-case they are bit-identical on (0/1 weights).
+
+Failure safety: :func:`dispatch` wraps the optimized call; any exception
+(an unsupported shape reaching Mosaic, an interpreter gap) falls back to the
+reference and is counted (obs ``metrics_tpu_kernel_dispatch_total``
+``impl="fallback"``) — a kernel bug degrades speed, never correctness.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterator, Optional, Tuple
+
+import jax
+
+from metrics_tpu.obs import instrument as _obs
+
+_MODES = ("auto", "off", "force")
+
+_lock = threading.Lock()
+_configured: Optional[str] = None
+
+
+def _env_mode() -> str:
+    raw = os.environ.get("METRICS_TPU_KERNELS", "auto").strip().lower()
+    if raw in ("0", "false", "no"):
+        return "off"
+    if raw in ("1", "true", "yes", "interpret"):
+        return "force"
+    return raw if raw in _MODES else "auto"
+
+
+def mode() -> str:
+    """The active selection mode (``configure()`` override, else the env var)."""
+    return _configured if _configured is not None else _env_mode()
+
+
+def configure(new_mode: Optional[str]) -> None:
+    """Override the selection mode process-wide (``None`` restores the env var).
+
+    Only affects traces compiled after the call — jit caches keep whatever
+    lowering they traced (same caveat as the pre-existing backend branches).
+    """
+    global _configured
+    if new_mode is not None and new_mode not in _MODES:
+        raise ValueError(f"kernel mode must be one of {_MODES} or None, got {new_mode!r}")
+    with _lock:
+        _configured = new_mode
+
+
+@contextlib.contextmanager
+def forced(new_mode: str = "force") -> Iterator[None]:
+    """Scoped :func:`configure` — the test harness for exercising both paths."""
+    prev = _configured
+    configure(new_mode)
+    try:
+        yield
+    finally:
+        with _lock:
+            globals()["_configured"] = prev
+
+
+@dataclass(frozen=True)
+class KernelEntry:
+    """One registry entry: an optimized lowering bound to its jnp reference.
+
+    ``optimized`` must accept the same positional/keyword arguments as
+    ``reference`` plus a keyword-only ``interpret: bool`` (Pallas kernels pass
+    it to ``pallas_call``; jnp-optimized entries just ignore it).
+
+    ``eligible`` sees the call's ``(*args, **kwargs)`` and must decide from
+    trace-time-static information only (shapes, dtypes, Python config) — it
+    runs inside jit traces.
+
+    ``requires_tpu``: True for Pallas kernels (TPU, or interpret when forced);
+    False for jnp formulations that any accelerator backend profits from.
+    """
+
+    name: str
+    reference: Callable[..., Any]
+    optimized: Callable[..., Any]
+    eligible: Callable[..., bool] = field(default=lambda *a, **k: True)
+    requires_tpu: bool = True
+    contract: str = "bit-identical on integer/count states"
+    doc: str = ""
+
+
+REGISTRY: Dict[str, KernelEntry] = {}
+
+
+def register(entry: KernelEntry) -> KernelEntry:
+    """Install one entry (idempotent by name; re-registration replaces)."""
+    REGISTRY[entry.name] = entry
+    return entry
+
+
+def get(name: str) -> KernelEntry:
+    return REGISTRY[name]
+
+
+def names() -> Tuple[str, ...]:
+    return tuple(REGISTRY)
+
+
+def _on_tpu() -> bool:
+    """True only when the default backend is a REAL TPU. Checks the device
+    platform, not just the ``default_backend()`` string: a compiled (non-
+    interpret) Pallas kernel that reaches a CPU device fails at lowering time,
+    OUTSIDE the dispatch fallback's reach — so selection must be conservative
+    where the probe and the device can disagree (tests monkeypatching the
+    backend probe to exercise accelerator branches are the known case)."""
+    try:
+        return jax.default_backend() == "tpu" and jax.devices()[0].platform == "tpu"
+    except Exception:  # noqa: BLE001 — uninitialized backend: reference is always safe
+        return False
+
+
+def _in_axis_context() -> bool:
+    """True while tracing under bound axis names (shard_map / pmap).
+
+    ``pallas_call`` has no shard_map replication rule in this jax version, and
+    the failure surfaces when shard_map post-processes the traced jaxpr —
+    AFTER :func:`dispatch` has returned, beyond the fallback's reach. So a
+    Pallas entry must never be selected inside an axis context, in ANY mode
+    (interpret included: the primitive, not the execution, is what lacks the
+    rule). The probe is a private jax API; if it disappears, assume the common
+    no-axes case — single-device dispatch keeps working and the shard_map
+    caller gets jax's own workaround message (``check_rep=False``).
+    """
+    try:
+        from jax._src import core as _core
+
+        return bool(_core.get_axis_env().axis_sizes)
+    except Exception:  # noqa: BLE001 — probe API moved: assume the common case
+        return False
+
+
+def _select(entry: KernelEntry) -> Tuple[bool, bool]:
+    """``(use_optimized, interpret)`` for the current mode + backend."""
+    m = mode()
+    if m == "off":
+        return False, False
+    if entry.requires_tpu:
+        if _in_axis_context():
+            return False, False
+        if _on_tpu():
+            return True, False
+        return m == "force", True
+    return jax.default_backend() != "cpu" or m == "force", False
+
+
+def selected(name: str, *args: Any, **kwargs: Any) -> str:
+    """Which impl :func:`dispatch` would take: ``"optimized"`` | ``"reference"``.
+
+    For builder-style callers (the engine's scan kernel) that choose a code
+    path once per compiled kernel rather than per call.
+    """
+    entry = REGISTRY[name]
+    use, _ = _select(entry)
+    if use and entry.eligible(*args, **kwargs):
+        return "optimized"
+    return "reference"
+
+
+def dispatch(name: str, *args: Any, **kwargs: Any) -> Any:
+    """Run entry ``name`` on ``args``: optimized when selected + eligible,
+    reference otherwise; any optimized-path exception falls back to the
+    reference (counted — never raised past a working reference).
+
+    Callers are jitted: the selection branch and the obs dispatch record both
+    happen at trace time, so the counters count *compiled lowerings*, not
+    calls (exactly like the engine's ``compiles`` counter).
+    """
+    entry = REGISTRY[name]
+    use, interpret = _select(entry)
+    if use and entry.eligible(*args, **kwargs):
+        try:
+            out = entry.optimized(*args, interpret=interpret, **kwargs)
+            _obs.record_kernel_dispatch(name, "optimized", interpret=interpret)
+            return out
+        except Exception:  # noqa: BLE001 — a kernel bug must degrade speed, not correctness
+            _obs.record_kernel_dispatch(name, "fallback", interpret=interpret)
+    else:
+        _obs.record_kernel_dispatch(name, "reference")
+    return entry.reference(*args, **kwargs)

@@ -1,0 +1,34 @@
+"""Shared sample-axis tiling for the streaming Pallas kernels.
+
+Every kernel in this plane streams a flat sample axis through VMEM in
+``(rows, wide)`` blocks: pad the axis up to a whole number of ``rows * wide``
+tiles with a kernel-specific neutral fill (an index that matches no bin, a
+``-inf`` that passes no threshold, a zero weight), then fold it into 2-D.
+One implementation so the tiling protocol cannot drift between kernels.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+
+def pad_to_tiles(
+    arrays: Sequence[Array], fills: Sequence, rows: int, wide: int
+) -> Tuple[List[Array], int]:
+    """Pad each 1-D array to a multiple of ``rows * wide`` with its fill and
+    reshape to ``(-1, wide)``; returns ``(tiled_arrays, padded_length)``.
+    Dtypes are the caller's responsibility (cast before padding)."""
+    n = arrays[0].shape[0]
+    tile = rows * wide
+    n_pad = -(-n // tile) * tile
+    pad = n_pad - n
+    return (
+        [
+            jnp.pad(a, (0, pad), constant_values=f).reshape(-1, wide)
+            for a, f in zip(arrays, fills)
+        ],
+        n_pad,
+    )

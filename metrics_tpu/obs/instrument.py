@@ -576,6 +576,46 @@ def repl_span(name: str, **attrs: Any) -> Any:
     return TRACER.span(name, **attrs)
 
 
+# ---------------------------------------------------------------------- kernel plane
+
+KERNEL_DISPATCHES = REGISTRY.counter(
+    "metrics_tpu_kernel_dispatch_total",
+    "Kernel-plane registry dispatch decisions per entry and impl "
+    "(optimized|reference|fallback). Callers are jitted, so this counts "
+    "COMPILED LOWERINGS (one per trace), not per-call executions.",
+)
+KERNEL_OCCUPANCY = REGISTRY.gauge(
+    "metrics_tpu_kernel_occupancy_fraction",
+    "Most recently measured fraction-of-ceiling for a kernel-plane entry "
+    "(HBM or MXU roofline fraction, per the row's accounting), per entry and "
+    "backend — published by benchmarks/suite.py's chained roofline captures "
+    "for kernel-mapped rows (obs-gated; CPU fractions are proxy values). "
+    "Feeding it back into the bucket-ladder autotuner is ROADMAP headroom.",
+)
+
+
+def record_kernel_dispatch(name: str, impl: str, interpret: bool = False) -> None:
+    """Count one registry dispatch decision (at trace time — see the counter help)."""
+    if not OBS.enabled:
+        return
+    KERNEL_DISPATCHES.inc(1, kernel=name, impl=impl, interpret=str(bool(interpret)).lower())
+
+
+def record_kernel_compile(name: str, signature: str) -> None:
+    """Retrace attribution for a kernel-plane entry: one fresh Pallas/XLA
+    compile at ``kernels.<name>`` against the operand signature that caused it."""
+    if not OBS.enabled:
+        return
+    RETRACES.inc(1, site=f"kernels.{name}", signature=signature)
+
+
+def record_kernel_occupancy(name: str, fraction: float, backend: str) -> None:
+    """Publish a measured fraction-of-ceiling for one kernel entry (benchmark-side)."""
+    if not OBS.enabled:
+        return
+    KERNEL_OCCUPANCY.set(fraction, kernel=name, backend=backend)
+
+
 # ---------------------------------------------------------------------- engine hooks
 
 

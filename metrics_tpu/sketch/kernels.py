@@ -38,6 +38,18 @@ NON-NEGATIVE int32 ids (``-1`` marks an empty ledger slot).
 All functions are pure ``(arrays, batch) -> arrays`` with static Python
 configuration — safe under ``jit``/``vmap``/``lax.scan``, including the
 engine's donated-buffer bucket kernels.
+
+The scatter-heavy updates (DDSketch bucket scatter-add, HLL register
+scatter-max, count-min row scatter-adds) route through the kernel plane's
+registry (:mod:`metrics_tpu.kernels` — ``ddsketch_hist_add`` /
+``hll_scatter_max`` / ``cms_row_scatter``): on TPU, batches above the
+registry's size floor run the Pallas streaming compare+reduce kernels instead
+of XLA's serialized scatter, bit-identically (int32 end to end); everywhere
+else — including the tiny per-request slices inside the engine's scan
+kernels — the jnp scatters below are the dispatched reference. The top-k
+candidate ledger stays a ``lax.scan`` by construction: each replacement
+decision reads the count-min estimate *including its own item's increment*,
+a sequential dependency no batched scatter can honor.
 """
 
 from __future__ import annotations
@@ -49,8 +61,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax import Array, lax
 
+from metrics_tpu.kernels import registry as _kernel_registry
+
 __all__ = [
     "cms_query",
+    "cms_table_update",
     "cms_update",
     "ddsketch_params",
     "ddsketch_quantiles",
@@ -175,8 +190,10 @@ def ddsketch_update(
     idx = jnp.where(finite, idx, n_buckets - 1)
     one = jnp.ones_like(v, dtype=pos.dtype)
     zilch = jnp.zeros_like(v, dtype=pos.dtype)
-    pos = pos.at[idx].add(jnp.where(v > 0, one, zilch))
-    neg = neg.at[idx].add(jnp.where(v < 0, one, zilch))
+    # registry-dispatched scatter-add (Pallas streaming histogram on TPU for
+    # large batches; the jnp `.at[idx].add` scatter is the reference)
+    pos = _kernel_registry.dispatch("ddsketch_hist_add", pos, idx, jnp.where(v > 0, one, zilch))
+    neg = _kernel_registry.dispatch("ddsketch_hist_add", neg, idx, jnp.where(v < 0, one, zilch))
     zero = zero + jnp.sum(jnp.where(v == 0, one, zilch))
     finite = ~jnp.isnan(v)
     vmin = jnp.minimum(vmin, jnp.min(jnp.where(finite, v, jnp.float32(jnp.inf))))
@@ -238,7 +255,9 @@ def hll_update(registers: Array, values: Array, *, p: int) -> Array:
     h = hash32(v)
     idx = (h >> (32 - p)).astype(jnp.int32)
     rank = jnp.minimum(_clz32(h << p) + 1, 32 - p + 1).astype(registers.dtype)
-    return registers.at[idx].max(rank)
+    # registry-dispatched scatter-max (Pallas streaming register max on TPU
+    # for large batches; the jnp `.at[idx].max` scatter is the reference)
+    return _kernel_registry.dispatch("hll_scatter_max", registers, idx, rank)
 
 
 def hll_estimate(registers: Array) -> Array:
@@ -313,6 +332,26 @@ def cms_update(counts: Array, ledger: Array, values: Array) -> Tuple[Array, Arra
 
     (counts, ledger), _ = lax.scan(step, (counts, ledger), ids)
     return counts, ledger
+
+
+def cms_table_update(counts: Array, values: Array) -> Array:
+    """Bulk count-min TABLE update — no candidate ledger, one batched pass.
+
+    Bit-identical to the counts component of :func:`cms_update` on the same
+    batch (integer scatter-adds commute), but free of the ledger scan's
+    sequential dependency, so the row scatters route through the kernel
+    plane's ``cms_row_scatter`` registry entry (Pallas streaming histograms
+    per table row on TPU). Use it when candidates are tracked out of band —
+    or only :func:`cms_query` point estimates are needed — and the per-item
+    ledger walk would dominate the update.
+    """
+    ids = jnp.ravel(jnp.asarray(values)).astype(jnp.int32)
+    if ids.size == 0:
+        return counts
+    depth, width = counts.shape
+    cols = _cm_columns(ids, depth, width)  # (N, depth)
+    valid = ids >= 0  # negative ids are invalid (ledger sentinel) everywhere
+    return _kernel_registry.dispatch("cms_row_scatter", counts, cols, valid)
 
 
 def cms_query(counts: Array, keys: Array) -> Array:

@@ -104,3 +104,87 @@ def test_padded_rows_contribute_zero():
         assert int(state["tn"]) + int(state["fp"]) == 0
     finally:
         engine.close()
+
+
+# --------------------------------------------------------------- autotuned ladder
+
+
+def test_bucket_config_normalizes_like_a_sequence():
+    from metrics_tpu.engine.bucketing import BucketConfig
+
+    assert normalize_buckets(BucketConfig(ladder=(64, 8, 8))) == (8, 64)
+    assert BucketConfig().normalized() == normalize_buckets((8, 16, 32, 64, 128, 256))
+    with pytest.raises(MetricsTPUUserError):
+        normalize_buckets(BucketConfig(ladder=()))
+
+
+def test_tune_buckets_beats_log2_on_skewed_traffic():
+    from metrics_tpu.engine.bucketing import DEFAULT_BUCKETS, tune_buckets
+
+    rng = np.random.default_rng(0)
+    trace = [int(r) for r in rng.choice([3, 24, 200], 4000, p=[0.6, 0.3, 0.1])]
+    ladder = tune_buckets(trace, max_buckets=4)
+    assert ladder == (3, 24, 200)  # exact sizes: zero padding is optimal
+
+    def padded(lad):
+        return sum(min(b for b in lad if b >= r) - r for r in trace)
+
+    assert padded(ladder) == 0
+    assert padded(DEFAULT_BUCKETS) > 0
+
+
+def test_tune_buckets_respects_max_buckets_and_cap():
+    from metrics_tpu.engine.bucketing import tune_buckets
+
+    trace = {10: 100.0, 11: 90.0, 12: 80.0, 100: 10.0, 5000: 1.0}
+    ladder = tune_buckets(trace, max_buckets=2, max_rows=256)
+    assert len(ladder) <= 2
+    assert ladder[-1] == 256  # oversized sizes clamp to the split cap
+    assert all(b >= 1 for b in ladder)
+
+
+def test_tune_buckets_edge_cases():
+    from metrics_tpu.engine.bucketing import DEFAULT_BUCKETS, tune_buckets
+
+    assert tune_buckets([]) == DEFAULT_BUCKETS  # empty trace: keep the default
+    assert tune_buckets([7, 7, 7]) == (7,)  # single size: single bucket
+    assert tune_buckets({4: 0.0, -3: 5.0}) == DEFAULT_BUCKETS  # junk-only trace
+    with pytest.raises(MetricsTPUUserError):
+        tune_buckets([4], max_buckets=0)
+
+
+def test_tune_buckets_large_trace_collapses_to_grid():
+    from metrics_tpu.engine.bucketing import tune_buckets
+
+    rng = np.random.default_rng(1)
+    trace = [int(r) for r in rng.integers(1, 2000, 30000)]  # >512 distinct sizes
+    ladder = tune_buckets(trace, max_buckets=6, max_rows=2048)
+    assert 1 <= len(ladder) <= 6
+    assert ladder[-1] >= max(min(t, 2048) for t in trace) - 0  # top covers the trace
+
+
+def test_engine_accepts_bucket_config_and_tuned_ladder():
+    from metrics_tpu.engine.bucketing import BucketConfig, tune_buckets
+
+    ladder = tune_buckets([2, 2, 2, 6, 6, 30])
+    engine = StreamingEngine(BinaryAccuracy(), buckets=BucketConfig(ladder=ladder))
+    try:
+        assert engine._buckets == tuple(sorted(set(ladder)))
+        engine.submit("t", jnp.asarray([1, 0]), jnp.asarray([1, 1]))
+        engine.flush()
+        assert abs(float(engine.compute("t")) - 0.5) < 1e-6
+    finally:
+        engine.close()
+
+
+def test_tune_buckets_collapse_is_weight_aware():
+    """>512 distinct sizes: the grid must spend its points where the traffic
+    mass is — a dominant size lands on itself (zero padding for it), however
+    long the sparse tail of rare large sizes is."""
+    from metrics_tpu.engine.bucketing import tune_buckets
+
+    trace = {33: 1_000_000.0}
+    trace.update({1000 + i: 1.0 for i in range(600)})  # 601 distinct sizes
+    ladder = tune_buckets(trace, max_buckets=4, max_rows=2048)
+    assert 33 in ladder  # the dominant size pays zero padding
+    assert ladder[-1] >= 1599

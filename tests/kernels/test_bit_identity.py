@@ -1,0 +1,263 @@
+"""Per-entry bit-identity vs the jnp reference, under interpret=True on CPU.
+
+The registry contract (docs/source/kernels.md): every optimized lowering is
+bit-identical to its reference on integer/count states — the same ints out for
+the same ints in, regardless of accumulation order. Property-tested over
+dtypes, shapes (including non-tile-multiple sizes), and mask patterns with
+seeded generators; CI runs this file in the kernel-parity job before any TPU
+ever executes a kernel.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.kernels import binned_curve, confmat, registry, scatter
+
+
+@pytest.fixture(autouse=True)
+def _restore_mode():
+    yield
+    registry.configure(None)
+
+
+# ----------------------------------------------------------------- pair count
+
+
+@pytest.mark.parametrize("seed", range(4))
+@pytest.mark.parametrize("dtype", [np.int32, np.int64, np.uint8])
+def test_pair_count_fused_bit_identical(seed, dtype):
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(1, 3) * 4096 + rng.integers(0, 513))  # non-tile multiples
+    num_rows = int(rng.integers(2, 150))
+    num_cols = int(rng.integers(2, 150))
+    r = jnp.asarray(rng.integers(0, num_rows, n).astype(dtype))
+    c = jnp.asarray(rng.integers(0, num_cols, n).astype(dtype))
+    mask = jnp.asarray(rng.integers(0, 2, n).astype(bool)) if seed % 2 else None
+    want = confmat.pair_count_bincount(r, c, num_rows, num_cols, mask)
+    via_matmul = confmat.pair_count_matmul(r, c, num_rows, num_cols, mask)
+    via_pallas = confmat.pair_count_fused(r, c, num_rows, num_cols, mask, interpret=True)
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(via_matmul))
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(via_pallas))
+
+
+def test_pair_count_drops_out_of_range_pairs_identically():
+    rng = np.random.default_rng(11)
+    n = 4608
+    r = jnp.asarray(rng.integers(-3, 12, n).astype(np.int32))  # OOB both sides
+    c = jnp.asarray(rng.integers(-3, 12, n).astype(np.int32))
+    want = confmat.pair_count_bincount(r, c, 10, 10)
+    np.testing.assert_array_equal(
+        np.asarray(want), np.asarray(confmat.pair_count_matmul(r, c, 10, 10))
+    )
+    np.testing.assert_array_equal(
+        np.asarray(want),
+        np.asarray(confmat.pair_count_fused(r, c, 10, 10, interpret=True)),
+    )
+
+
+def test_pair_count_rectangular_contingency_shape():
+    rng = np.random.default_rng(5)
+    r = jnp.asarray(rng.integers(0, 7, 4100).astype(np.int32))
+    c = jnp.asarray(rng.integers(0, 23, 4100).astype(np.int32))
+    want = confmat.pair_count_bincount(r, c, 7, 23)
+    got = confmat.pair_count_fused(r, c, 7, 23, interpret=True)
+    assert got.shape == (7, 23)
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+
+
+def test_matmul_eligibility_bounds_unchanged():
+    # the shared exactness rails the whole plane leans on
+    assert confmat.matmul_eligible(2**24 - 1, 32)
+    assert not confmat.matmul_eligible(2**24, 2)  # f32 exactness bound
+    assert not confmat.matmul_eligible(2**20, 2**10)  # 2^30 > 2^29 operand cap
+    assert confmat.matmul_eligible(2**20, 2**9)
+
+
+# ----------------------------------------------------------------- scatters
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_hist_add_bit_identical(seed):
+    rng = np.random.default_rng(100 + seed)
+    n = int(rng.integers(1, 4) * 4096 + rng.integers(0, 777))
+    n_bins = int(rng.choice([3, 17, 100, 1000, 2048, 2500]))
+    bins = jnp.asarray(rng.integers(0, 50, n_bins).astype(np.int32))
+    idx = jnp.asarray(rng.integers(-5, n_bins + 5, n).astype(np.int32))  # incl. OOB
+    w = jnp.asarray(rng.integers(0, 2, n).astype(np.int32))  # 0/1 mask weights
+    want = scatter.hist_add_reference(bins, idx, w)
+    got = scatter.hist_add_pallas(bins, idx, w, interpret=True)
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_hist_max_bit_identical(seed):
+    rng = np.random.default_rng(200 + seed)
+    n = int(rng.integers(1, 4) * 4096 + rng.integers(0, 777))
+    n_bins = int(rng.choice([3, 17, 100, 1000, 2048, 4096]))
+    bins = jnp.asarray(rng.integers(0, 8, n_bins).astype(np.int32))
+    idx = jnp.asarray(rng.integers(-5, n_bins + 5, n).astype(np.int32))
+    vals = jnp.asarray(rng.integers(1, 22, n).astype(np.int32))
+    want = scatter.hist_max_reference(bins, idx, vals)
+    got = scatter.hist_max_pallas(bins, idx, vals, interpret=True)
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_cms_rows_add_bit_identical(seed):
+    rng = np.random.default_rng(300 + seed)
+    n = 4096 + int(rng.integers(0, 500))
+    depth, width = int(rng.integers(2, 6)), int(rng.choice([64, 512, 2048]))
+    counts = jnp.asarray(rng.integers(0, 9, (depth, width)).astype(np.int32))
+    cols = jnp.asarray(rng.integers(0, width, (n, depth)).astype(np.int32))
+    valid = jnp.asarray(rng.integers(0, 2, n).astype(bool))
+    want = scatter.cms_rows_add_reference(counts, cols, valid)
+    got = scatter.cms_rows_add_pallas(counts, cols, valid, interpret=True)
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+
+
+# ------------------------------------------------------- sketch-plane routing
+
+
+def test_ddsketch_update_routes_bit_identically():
+    from metrics_tpu.sketch.kernels import ddsketch_params, ddsketch_update
+
+    rng = np.random.default_rng(7)
+    values = jnp.asarray(
+        np.concatenate([rng.lognormal(0, 3, 2040), [0.0, np.nan, np.inf, -np.inf],
+                        -rng.lognormal(0, 2, 2040)]).astype(np.float32)
+    )
+    gamma, log_gamma, offset = ddsketch_params(0.01)
+    args = dict(log_gamma=log_gamma, offset=offset)
+    state = (
+        jnp.zeros(2048, jnp.int32), jnp.zeros(2048, jnp.int32), jnp.zeros((), jnp.int32),
+        jnp.asarray(np.inf, jnp.float32), jnp.asarray(-np.inf, jnp.float32),
+    )
+    with registry.forced("off"):
+        ref = ddsketch_update(*state, values, **args)
+    with registry.forced("force"):
+        opt = ddsketch_update(*state, values, **args)
+    for a, b in zip(ref, opt):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_hll_update_routes_bit_identically():
+    from metrics_tpu.sketch.kernels import hll_update
+
+    rng = np.random.default_rng(8)
+    values = jnp.asarray(rng.integers(0, 10**9, 5000).astype(np.int32))
+    registers = jnp.zeros(1 << 12, jnp.int32)
+    with registry.forced("off"):
+        ref = hll_update(registers, values, p=12)
+    with registry.forced("force"):
+        opt = hll_update(registers, values, p=12)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(opt))
+
+
+def test_cms_table_update_matches_cms_update_counts():
+    from metrics_tpu.sketch.kernels import cms_table_update, cms_update
+
+    rng = np.random.default_rng(9)
+    ids = jnp.asarray(rng.integers(0, 500, 1500).astype(np.int32))
+    counts = jnp.zeros((4, 512), jnp.int32)
+    ledger = jnp.stack([jnp.full(8, -1, jnp.int32), jnp.zeros(8, jnp.int32)], axis=1)
+    scanned, _ = cms_update(counts, ledger, ids)
+    with registry.forced("off"):
+        bulk_ref = cms_table_update(counts, ids)
+    with registry.forced("force"):
+        bulk_opt = cms_table_update(counts, ids)
+    # integer scatter-adds commute: the bulk table == the scanned table, and
+    # the Pallas route == the jnp route, all bit-for-bit
+    np.testing.assert_array_equal(np.asarray(scanned), np.asarray(bulk_ref))
+    np.testing.assert_array_equal(np.asarray(bulk_ref), np.asarray(bulk_opt))
+
+
+def test_cms_table_update_empty_and_negative_ids():
+    from metrics_tpu.sketch.kernels import cms_table_update
+
+    counts = jnp.asarray(np.arange(8, dtype=np.int32).reshape(2, 4))
+    np.testing.assert_array_equal(
+        np.asarray(cms_table_update(counts, jnp.zeros(0, jnp.int32))), np.asarray(counts)
+    )
+    with registry.forced("force"):
+        out = cms_table_update(counts, jnp.full(2048, -1, jnp.int32))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(counts))
+
+
+# ----------------------------------------------------------------- binned curve
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_binned_curve_counts_bit_identical_on_01_weights(seed):
+    rng = np.random.default_rng(400 + seed)
+    n = 8192 + int(rng.integers(0, 1000))
+    t_count = int(rng.choice([10, 100, 357]))
+    preds = jnp.asarray(rng.uniform(size=n).astype(np.float32))
+    w = jnp.asarray(rng.integers(0, 2, n).astype(np.float32))  # 0/1 mask weights
+    target_w = jnp.asarray(rng.integers(0, 2, n).astype(np.float32)) * w
+    thr = jnp.linspace(0, 1, t_count, dtype=jnp.float32)
+    tp_ref, fp_ref = binned_curve.reference_counts(preds, target_w, w, thr)
+    tp, fp = binned_curve.pallas_counts(preds, target_w, w, thr, interpret=True)
+    # 0/1 products, integral f32 sums below 2**24: exact in any order
+    np.testing.assert_array_equal(np.asarray(tp_ref), np.asarray(tp))
+    np.testing.assert_array_equal(np.asarray(fp_ref), np.asarray(fp))
+
+
+def test_binned_curve_counts_float_weights_allclose():
+    rng = np.random.default_rng(12)
+    n = 9000
+    preds = jnp.asarray(rng.uniform(size=n).astype(np.float32))
+    w = jnp.asarray(rng.uniform(0.1, 2.0, n).astype(np.float32))
+    target_w = jnp.asarray(rng.integers(0, 2, n).astype(np.float32)) * w
+    thr = jnp.linspace(0, 1, 50, dtype=jnp.float32)
+    tp_ref, fp_ref = binned_curve.reference_counts(preds, target_w, w, thr)
+    tp, fp = binned_curve.pallas_counts(preds, target_w, w, thr, interpret=True)
+    np.testing.assert_allclose(np.asarray(tp_ref), np.asarray(tp), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(fp_ref), np.asarray(fp), rtol=1e-5)
+
+
+def test_classification_confmat_identical_across_modes():
+    """End-to-end: the public multiclass confusion matrix is mode-invariant.
+
+    The update is jitted and the registry branch is trace-time, so each mode
+    gets a FRESH shape (fresh trace) and is compared against the bincount
+    oracle — same shapes across modes would silently reuse one cached trace.
+    """
+    from metrics_tpu.functional import confusion_matrix
+
+    rng = np.random.default_rng(13)
+    for mode, n in (("off", 6000), ("auto", 6001), ("force", 6002)):
+        preds = jnp.asarray(rng.integers(0, 13, n).astype(np.int32))
+        target = jnp.asarray(rng.integers(0, 13, n).astype(np.int32))
+        want = confmat.pair_count_bincount(target, preds, 13, 13)
+        with registry.forced(mode):
+            got = confusion_matrix(preds, target, task="multiclass", num_classes=13)
+        np.testing.assert_array_equal(np.asarray(want), np.asarray(got), err_msg=mode)
+
+
+def test_empty_batch_never_selects_pallas():
+    """A zero-sample batch has nothing to stream: eligibility must route it to
+    the reference WITHOUT attempting (and trace-failing) the Pallas kernel —
+    the fallback counter is the operators' kernel-bug signal and must stay
+    clean on ordinary empty updates."""
+    from metrics_tpu.kernels.binned_curve import _eligible as bc_eligible
+    from metrics_tpu.kernels.confmat import _fused_entry_eligible, pair_count
+
+    empty = jnp.zeros(0, jnp.int32)
+    assert not _fused_entry_eligible(empty, empty, 5, 5)
+    assert not bc_eligible(jnp.zeros(0, jnp.float32), jnp.zeros(0, jnp.float32),
+                           jnp.zeros(0, jnp.float32), jnp.linspace(0, 1, 10))
+    with registry.forced("force"):
+        out = pair_count(empty, empty, 5, 5)
+    np.testing.assert_array_equal(np.asarray(out), np.zeros((5, 5), np.int32))
+
+
+def test_pad_to_tiles_shapes_and_fills():
+    from metrics_tpu.kernels.tiling import pad_to_tiles
+
+    a = jnp.arange(5, dtype=jnp.int32)
+    b = jnp.ones(5, jnp.float32)
+    (ta, tb), n_pad = pad_to_tiles([a, b], [-1, 0.0], 2, 4)
+    assert n_pad == 8 and ta.shape == (2, 4) and tb.shape == (2, 4)
+    assert int(ta[1, 1]) == -1 and float(tb[1, 1]) == 0.0  # fills past n
+    assert int(ta[1, 0]) == 4 and float(tb[1, 0]) == 1.0  # last real element

@@ -1,0 +1,169 @@
+"""Fused-engine integration with the kernel plane enabled.
+
+The acceptance property (ISSUE 8): with kernels forced on, the engine stays on
+its fused path (``fused_fallbacks == 0``) and every tenant's state is
+bit-identical to a single-threaded per-tenant oracle — i.e. the fused
+``engine_masked_scan`` lowering (mask folded into the scatter address via the
+scratch row) changes nothing but the op count.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.classification import BinaryAccuracy, MulticlassConfusionMatrix
+from metrics_tpu.engine import StreamingEngine
+from metrics_tpu.kernels import registry
+from metrics_tpu.sketch import HeavyHittersSketch, QuantileSketch
+
+
+@pytest.fixture(autouse=True)
+def _restore_mode():
+    yield
+    registry.configure(None)
+
+
+def _oracle_states(metric, stream):
+    """Single-threaded per-tenant oracle, PER ROW in submit order — the
+    engine's documented dispatch semantics (each coalesced row is one
+    ``update_state`` on a (1, *trailing) slice)."""
+    states = {}
+    for key, args in stream:
+        state = states.get(key)
+        if state is None:
+            state = metric.init_state()
+        for i in range(int(args[0].shape[0])):
+            state = metric.update_state(state, *(a[i : i + 1] for a in args))
+        states[key] = state
+    return states
+
+
+def _run_engine(metric, stream, buckets=(4, 8, 32)):
+    engine = StreamingEngine(metric.clone(), buckets=buckets, capacity=4)
+    try:
+        for key, args in stream:
+            engine.submit(key, *args)
+        engine.flush()
+        snap = engine.telemetry_snapshot()
+        states = {key: engine._keyed.state_of(key) for key in engine._keyed.keys}
+        computes = {key: engine.compute(key) for key in engine._keyed.keys}
+    finally:
+        engine.close()
+    return snap, states, computes
+
+
+def _assert_states_bit_identical(oracle, got):
+    assert set(oracle) == set(got)
+    for key in oracle:
+        ref_leaves = jax.tree.leaves(oracle[key])
+        got_leaves = jax.tree.leaves(got[key])
+        assert len(ref_leaves) == len(got_leaves)
+        for a, b in zip(ref_leaves, got_leaves):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b), err_msg=str(key))
+
+
+def _classification_stream(n=60, seed=0):
+    rng = np.random.default_rng(seed)
+    stream = []
+    for _ in range(n):
+        rows = int(rng.integers(1, 12))  # forces every bucket + mask pattern
+        key = f"tenant-{int(rng.integers(0, 5))}"
+        preds = jnp.asarray(rng.integers(0, 2, rows).astype(np.int32))
+        target = jnp.asarray(rng.integers(0, 2, rows).astype(np.int32))
+        stream.append((key, (preds, target)))
+    return stream
+
+
+def test_fused_engine_bit_identical_with_kernels_forced():
+    metric = BinaryAccuracy()
+    stream = _classification_stream()
+    with registry.forced("force"):
+        snap, states, computes = _run_engine(metric, stream)
+    assert snap["fused"] is True
+    assert snap["fused_fallbacks"] == 0
+    assert snap["processed"] == len(stream)
+    oracle = _oracle_states(metric, stream)
+    _assert_states_bit_identical(oracle, states)
+    for key, state in oracle.items():
+        np.testing.assert_array_equal(
+            np.asarray(metric.compute_from(state)), np.asarray(computes[key])
+        )
+
+
+def test_fused_engine_states_identical_across_modes():
+    metric = MulticlassConfusionMatrix(7, validate_args=False)
+    rng = np.random.default_rng(3)
+    stream = []
+    for _ in range(40):
+        rows = int(rng.integers(1, 9))
+        key = f"t{int(rng.integers(0, 3))}"
+        stream.append((key, (
+            jnp.asarray(rng.integers(0, 7, rows).astype(np.int32)),
+            jnp.asarray(rng.integers(0, 7, rows).astype(np.int32)),
+        )))
+    with registry.forced("off"):
+        _, ref_states, _ = _run_engine(metric, stream)
+    with registry.forced("force"):
+        snap, fused_states, _ = _run_engine(metric, stream)
+    assert snap["fused_fallbacks"] == 0
+    _assert_states_bit_identical(ref_states, fused_states)
+
+
+def test_fused_engine_sketch_states_bit_identical():
+    """Sketch states (scatter add/max + the ledger scan) through the fused
+    scan with kernels forced: the whole plane composes bit-identically."""
+    metric = QuantileSketch(quantiles=(0.5, 0.99), n_buckets=256, min_trackable=1e-3)
+    rng = np.random.default_rng(5)
+    stream = []
+    for _ in range(30):
+        rows = int(rng.integers(1, 10))
+        key = f"q{int(rng.integers(0, 3))}"
+        stream.append((key, (jnp.asarray(rng.lognormal(0, 1, rows).astype(np.float32)),)))
+    with registry.forced("force"):
+        snap, states, _ = _run_engine(metric, stream)
+    assert snap["fused_fallbacks"] == 0
+    _assert_states_bit_identical(_oracle_states(metric, stream), states)
+
+
+def test_fused_engine_heavy_hitters_bit_identical():
+    metric = HeavyHittersSketch(k=8, depth=3, width=128)
+    rng = np.random.default_rng(6)
+    stream = []
+    for _ in range(25):
+        rows = int(rng.integers(1, 8))
+        key = f"h{int(rng.integers(0, 2))}"
+        stream.append((key, (jnp.asarray(rng.integers(0, 50, rows).astype(np.int32)),)))
+    with registry.forced("force"):
+        snap, states, _ = _run_engine(metric, stream)
+    assert snap["fused_fallbacks"] == 0
+    _assert_states_bit_identical(_oracle_states(metric, stream), states)
+
+
+def test_scratch_row_never_leaks_between_tenants():
+    """Adversarial mask pattern: single-row submits through the largest bucket
+    maximize padding rows; the scratch-row redirect must keep every padded
+    row's garbage out of all real slots."""
+    metric = BinaryAccuracy()
+    with registry.forced("force"):
+        engine = StreamingEngine(metric.clone(), buckets=(32,), capacity=4)
+        try:
+            engine.submit("a", jnp.array([1]), jnp.array([1]))
+            engine.flush()  # 1 real row, 31 padded rows in a 32-bucket
+            engine.submit("b", jnp.array([0]), jnp.array([1]))
+            engine.flush()
+            a = engine.compute("a")
+            b = engine.compute("b")
+            snap = engine.telemetry_snapshot()
+        finally:
+            engine.close()
+    assert snap["fused_fallbacks"] == 0
+    assert float(a) == 1.0
+    assert float(b) == 0.0
+
+
+def test_engine_scan_entry_eligibility_is_static():
+    from metrics_tpu.kernels.engine_scan import _eligible
+
+    assert _eligible(bucket=256, capacity=8)
+    assert not _eligible(bucket=8, capacity=256)

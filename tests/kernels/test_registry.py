@@ -1,0 +1,211 @@
+"""Kernel-plane registry: mode selection, eligibility gating, safe fallback.
+
+The dispatch rules under test are the plane's whole safety argument
+(docs/source/kernels.md): the optimized path runs only where selected AND
+eligible, and any optimized-path failure degrades to the reference — a kernel
+bug can cost speed, never correctness.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.kernels import registry
+
+
+@pytest.fixture(autouse=True)
+def _restore_mode():
+    yield
+    registry.configure(None)
+
+
+def _entry(name, *, boom=False, eligible=lambda *a, **k: True, requires_tpu=True):
+    calls = {"optimized": 0, "reference": 0}
+
+    def reference(x):
+        calls["reference"] += 1
+        return x + 1
+
+    def optimized(x, *, interpret=False):
+        calls["optimized"] += 1
+        if boom:
+            raise RuntimeError("kernel bug")
+        return x + 1
+
+    registry.register(
+        registry.KernelEntry(
+            name=name,
+            reference=reference,
+            optimized=optimized,
+            eligible=eligible,
+            requires_tpu=requires_tpu,
+        )
+    )
+    return calls
+
+
+def test_mode_resolution_env_and_configure(monkeypatch):
+    registry.configure(None)
+    monkeypatch.delenv("METRICS_TPU_KERNELS", raising=False)
+    assert registry.mode() == "auto"
+    for raw, want in [("off", "off"), ("0", "off"), ("false", "off"),
+                      ("force", "force"), ("1", "force"), ("interpret", "force"),
+                      ("auto", "auto"), ("garbage", "auto")]:
+        monkeypatch.setenv("METRICS_TPU_KERNELS", raw)
+        assert registry.mode() == want, raw
+    # programmatic override wins over the env var
+    registry.configure("force")
+    monkeypatch.setenv("METRICS_TPU_KERNELS", "off")
+    assert registry.mode() == "force"
+    registry.configure(None)
+    assert registry.mode() == "off"
+    with pytest.raises(ValueError):
+        registry.configure("sideways")
+
+
+def test_forced_context_scopes_and_restores():
+    assert registry.mode() in ("auto", "off", "force")
+    before = registry.mode()
+    with registry.forced("off"):
+        assert registry.mode() == "off"
+        with registry.forced("force"):
+            assert registry.mode() == "force"
+        assert registry.mode() == "off"
+    assert registry.mode() == before
+
+
+def test_auto_mode_keeps_pallas_entries_off_cpu():
+    calls = _entry("_test_auto_pallas", requires_tpu=True)
+    registry.configure("auto")
+    out = registry.dispatch("_test_auto_pallas", jnp.int32(1))
+    assert int(out) == 2
+    # on the CPU test backend a Pallas entry must take the reference
+    assert calls == {"optimized": 0, "reference": 1}
+    assert registry.selected("_test_auto_pallas", jnp.int32(1)) == "reference"
+
+
+def test_force_mode_takes_optimized_and_off_takes_reference():
+    calls = _entry("_test_force", requires_tpu=True)
+    with registry.forced("force"):
+        assert registry.selected("_test_force", jnp.int32(1)) == "optimized"
+        assert int(registry.dispatch("_test_force", jnp.int32(1))) == 2
+    assert calls == {"optimized": 1, "reference": 0}
+    with registry.forced("off"):
+        assert int(registry.dispatch("_test_force", jnp.int32(1))) == 2
+    assert calls == {"optimized": 1, "reference": 1}
+
+
+def test_ineligible_call_takes_reference_even_when_forced():
+    calls = _entry("_test_elig", eligible=lambda x: int(jnp.size(x)) >= 100)
+    with registry.forced("force"):
+        assert int(registry.dispatch("_test_elig", jnp.int32(1))) == 2
+        assert calls == {"optimized": 0, "reference": 1}
+        out = registry.dispatch("_test_elig", jnp.zeros(128, jnp.int32))
+        assert out.shape == (128,)
+        assert calls == {"optimized": 1, "reference": 1}
+
+
+def test_optimized_failure_falls_back_to_reference():
+    calls = _entry("_test_boom", boom=True)
+    with registry.forced("force"):
+        out = registry.dispatch("_test_boom", jnp.int32(41))
+    # the bug was absorbed: the reference answered, nothing raised
+    assert int(out) == 42
+    assert calls == {"optimized": 1, "reference": 1}
+
+
+def test_jnp_optimized_entries_select_off_cpu_only_unless_forced():
+    calls = _entry("_test_jnp", requires_tpu=False)
+    registry.configure("auto")
+    # CPU test backend: auto keeps today's behaviour (reference)
+    assert registry.selected("_test_jnp", jnp.int32(1)) == "reference"
+    with registry.forced("force"):
+        assert registry.selected("_test_jnp", jnp.int32(1)) == "optimized"
+    del calls
+
+
+def test_production_entries_registered():
+    # the plane's shipping surface — a rename here is an API break
+    for name in (
+        "pair_count_matmul",
+        "pair_count_fused",
+        "binned_curve_counts",
+        "ddsketch_hist_add",
+        "hll_scatter_max",
+        "cms_row_scatter",
+        "engine_masked_scan",
+    ):
+        assert name in registry.names()
+
+
+def test_dispatch_inside_jit_is_trace_time_static():
+    import jax
+
+    _entry("_test_jit", requires_tpu=True)
+    with registry.forced("force"):
+        out = jax.jit(lambda x: registry.dispatch("_test_jit", x))(jnp.int32(1))
+    assert int(out) == 2
+
+
+def test_pair_count_dispatch_matches_reference_under_force():
+    from metrics_tpu.kernels.confmat import pair_count, pair_count_bincount
+
+    rng = np.random.default_rng(3)
+    r = jnp.asarray(rng.integers(0, 9, 5000).astype(np.int32))
+    c = jnp.asarray(rng.integers(0, 9, 5000).astype(np.int32))
+    want = pair_count_bincount(r, c, 9, 9)
+    with registry.forced("force"):
+        got = pair_count(r, c, 9, 9)  # Pallas interpret on CPU
+    assert (np.asarray(got) == np.asarray(want)).all()
+    with registry.forced("off"):
+        got_off = pair_count(r, c, 9, 9)
+    assert (np.asarray(got_off) == np.asarray(want)).all()
+
+
+def test_pallas_compile_attribution_records_retrace():
+    """Tracing a Pallas kernel with obs enabled lands one retrace record at
+    kernels.<name> (trace-time, like the engine's compile counter)."""
+    from metrics_tpu import obs
+    from metrics_tpu.kernels import confmat
+    from metrics_tpu.obs.instrument import RETRACES
+
+    rng = np.random.default_rng(21)
+    r = jnp.asarray(rng.integers(0, 5, 4099).astype(np.int32))  # fresh shape
+    c = jnp.asarray(rng.integers(0, 5, 4099).astype(np.int32))
+    obs.enable()
+    try:
+        confmat.pair_count_fused(r, c, 5, 5, interpret=True)
+        recorded = {
+            key for key in RETRACES.collect() if "kernels.pair_count_fused" in str(key)
+        }
+        assert recorded, "no retrace attributed to kernels.pair_count_fused"
+    finally:
+        obs.disable()
+
+
+def test_pallas_entries_not_selected_inside_shard_map():
+    """pallas_call has no shard_map replication rule: inside an axis context a
+    Pallas entry must silently take the reference in EVERY mode — the failure
+    would otherwise surface after dispatch returns, beyond the fallback."""
+    import jax
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from metrics_tpu.classification import MulticlassAccuracy
+
+    acc = MulticlassAccuracy(num_classes=5, average="micro", validate_args=False)
+    rng = np.random.default_rng(31)
+    preds = jnp.asarray(rng.integers(0, 5, (8, 64)))
+    target = jnp.asarray(rng.integers(0, 5, (8, 64)))
+    mesh = Mesh(np.array(jax.devices()[:8]), ("dp",))
+
+    def step(pp, tt):
+        s = acc.update_state(acc.init_state(), pp[0], tt[0])
+        return acc.compute_from(s, axis_name="dp")
+
+    with registry.forced("force"):
+        out = jax.jit(
+            shard_map(step, mesh=mesh, in_specs=(P("dp"), P("dp")), out_specs=P())
+        )(preds, target)
+    union = float(np.mean(np.asarray(preds).ravel() == np.asarray(target).ravel()))
+    assert abs(float(out) - union) < 1e-6

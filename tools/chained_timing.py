@@ -5,7 +5,10 @@ sub-millisecond device op (the first round-5 roofline capture showed all seven
 rows pinned at 3-10 ms regardless of workload size). The protocol here runs the
 body k1 resp. k2 times inside ONE ``lax.fori_loop`` dispatch and reports
 ``(t_k2 - t_k1) / (k2 - k1)``: launch + tunnel round-trip appear in both
-timings and cancel in the difference.
+timings and cancel in the difference. ``jax.block_until_ready`` sits INSIDE
+the timed region on every run — an un-synced dispatch records enqueue time,
+which is how the round-5 capture durably landed three 0.0 ms / 1e15-rate rows
+(``benchmarks/ROOFLINE.md`` rejected them as INVALID).
 
 Requirements on ``body(i, carry) -> carry``:
 - depend on ``i`` (or the carry), or XLA's while-loop invariant code motion
@@ -26,21 +29,41 @@ from typing import Callable, Optional
 
 import jax
 
+# A capture is only trusted when the two loop lengths are separated by at least
+# this much wall time: below it the difference is timer/scheduler noise and the
+# derived per-iteration rate is garbage (a 0.0 ms row reads as above-ceiling
+# "success"). Sub-resolution captures re-run with longer loops instead.
+MIN_DIFF_S = 1e-3
 
-def timed_device(body: Callable, init_carry, k1: int, k2: int, reps: int = 3) -> Optional[float]:
+# Loop-length escalation ladder: a body too cheap to separate k2 - k1 at the
+# caller's sizes re-runs with 4x, then 16x the lengths ("0.0 ms => re-run with
+# a larger batch") before the capture is reported failed.
+SCALES = (1, 4, 16)
+
+
+def timed_device(
+    body: Callable,
+    init_carry,
+    k1: int,
+    k2: int,
+    reps: int = 3,
+    min_diff_s: float = MIN_DIFF_S,
+) -> Optional[float]:
     """Return ms per iteration, or ``None`` when the capture is noise-dominated.
 
     Best-of-reps PER LOOP LENGTH, then difference: min(t2 - t1) over paired
     reps is biased low under load noise (one lucky fast t2 against one slow t1
     reads as ~0), whereas each length's own minimum approximates its
     uncontended time and the launch floor still cancels in the difference.
-    A non-positive difference means the true per-iter cost is below the noise
-    floor for this k2 - k1; retry once with 4x the loop lengths, then report
-    the failure as ``None`` rather than clamping to a fake fast number.
+    A difference below ``min_diff_s`` means the true per-iter cost is beneath
+    the measurement floor for this k2 - k1 (non-positive differences are the
+    degenerate case); retry with 4x then 16x the loop lengths, then report the
+    failure as ``None`` rather than clamping to a fake fast number — the
+    caller records an explicitly invalid row with NO derived rates.
     """
     from jax import lax
 
-    for scale in (1, 4):
+    for scale in SCALES:
         ka, kb = k1 * scale, k2 * scale
         run1 = jax.jit(lambda c, ka=ka: lax.fori_loop(0, ka, body, c))
         run2 = jax.jit(lambda c, kb=kb: lax.fori_loop(0, kb, body, c))
@@ -48,13 +71,15 @@ def timed_device(body: Callable, init_carry, k1: int, k2: int, reps: int = 3) ->
         jax.block_until_ready(run2(init_carry))
         best1 = best2 = float("inf")
         for _ in range(reps):
+            # block_until_ready INSIDE the timed region (both lengths): the
+            # difference must compare completed device work, not enqueue time
             t0 = time.perf_counter()
             jax.block_until_ready(run2(init_carry))
             best2 = min(best2, time.perf_counter() - t0)
             t0 = time.perf_counter()
             jax.block_until_ready(run1(init_carry))
             best1 = min(best1, time.perf_counter() - t0)
-        diff = (best2 - best1) / (kb - ka)
-        if diff > 0:
-            return diff * 1e3
+        diff = best2 - best1
+        if diff >= min_diff_s:
+            return diff / (kb - ka) * 1e3
     return None
