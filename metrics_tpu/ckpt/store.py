@@ -241,6 +241,13 @@ class RequestJournal:
     index). Appends go through an internal lock; :meth:`append_many` batches
     one ``write`` for a drained engine batch. ``sync`` policy per append is
     the caller's call — :meth:`flush` exposes flush-only and fsync levels.
+
+    ``synced_seq`` is the highest seq known fsynced to stable storage —
+    advanced wherever a real fsync lands (durable ``flush(fsync=True)``,
+    rotation, close) and initialised to ``last_seq`` on reopen (whatever the
+    scan found on disk has, by definition, survived). The engine's
+    ``wal_fsync="commit"`` durability contract is exactly "a reopen never
+    resumes numbering below ``synced_seq``".
     """
 
     def __init__(self, root: str, *, name: str = "wal", rank: int = 0, durable: bool = True) -> None:
@@ -264,6 +271,7 @@ class RequestJournal:
                 with open(path, "r+b") as f:
                     f.truncate(clean_len)
             self.last_seq = first + records - 1
+        self.synced_seq = self.last_seq
 
     # ------------------------------------------------------------------ layout
 
@@ -320,6 +328,7 @@ class RequestJournal:
                 self._file.flush()
                 if fsync and self.durable:
                     os.fsync(self._file.fileno())
+                    self.synced_seq = self.last_seq
 
     def rotate(self, covered_seq: int) -> None:
         """Start a fresh segment; drop segments fully covered by ``covered_seq``
@@ -329,6 +338,7 @@ class RequestJournal:
                 self._file.flush()
                 if self.durable:
                     os.fsync(self._file.fileno())
+                    self.synced_seq = self.last_seq
                 self._file.close()
                 self._file = None
             segs = self._segments()
@@ -346,6 +356,7 @@ class RequestJournal:
                 self._file.flush()
                 if self.durable:
                     os.fsync(self._file.fileno())
+                    self.synced_seq = self.last_seq
                 self._file.close()
                 self._file = None
 

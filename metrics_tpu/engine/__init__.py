@@ -53,6 +53,7 @@ from metrics_tpu.guard import (
 )
 from metrics_tpu.repl import (
     NotPrimaryError,
+    NotPromotableError,
     ReplConfig,
     ReplicaLag,
     StalenessExceeded,
@@ -72,6 +73,7 @@ __all__ = [
     "GuardRejected",
     "KeyedState",
     "NotPrimaryError",
+    "NotPromotableError",
     "QuotaExceeded",
     "ReplConfig",
     "ReplicaLag",

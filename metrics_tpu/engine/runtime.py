@@ -87,13 +87,18 @@ from metrics_tpu.obs import instrument as _obs
 from metrics_tpu.obs.registry import OBS as _OBS
 from metrics_tpu.parallel.sync import sync_state_host
 from metrics_tpu.repl.config import ReplConfig, ReplicaLag
-from metrics_tpu.repl.errors import NotPrimaryError, StalenessExceeded
+from metrics_tpu.repl.errors import (
+    NotPrimaryError,
+    NotPromotableError,
+    StalenessExceeded,
+)
 from metrics_tpu.repl.replica import ReplicaApplier
 from metrics_tpu.repl.shipper import Shipper
 from metrics_tpu.utils.exceptions import MetricsTPUUserError
 
 _POLICIES = ("block", "drop", "timeout")
 _WAL_FLUSH = ("none", "flush", "fsync")
+_WAL_FSYNC = ("never", "commit", "interval")
 
 # WAL record encoding. Two record types, hand-rolled rather than pickled
 # because encoding rides the dispatcher's critical path and per-request
@@ -205,6 +210,14 @@ class CheckpointConfig:
     ``wal_flush``: per-drained-batch durability of the journal — ``"none"``
     (OS-buffered; flushed at rotation/close), ``"flush"`` (python-level flush,
     the default), ``"fsync"`` (fsync per batch — strongest, slowest).
+
+    ``wal_fsync``: an orthogonal fsync *policy* on top of ``wal_flush`` —
+    ``"never"`` (the default: fsync only where ``wal_flush``/rotation/close
+    already do), ``"commit"`` (fsync after every journal append: a committed
+    record survives power loss, not just process death), or ``"interval"``
+    (fsync at most every ``wal_fsync_interval_s`` seconds: bounds the
+    power-loss window without paying a sync per batch). ``wal_flush="fsync"``
+    already implies per-batch fsync, so it subsumes both.
     """
 
     directory: str
@@ -213,6 +226,8 @@ class CheckpointConfig:
     policy: Optional[Any] = None  # comm.CodecPolicy; None = lossless
     wal: bool = True
     wal_flush: str = "flush"
+    wal_fsync: str = "never"
+    wal_fsync_interval_s: float = 0.5
     resume: bool = True
     durable: bool = True
     rank: int = 0
@@ -446,6 +461,9 @@ class StreamingEngine:
         self._repl_follower = False
         self._repl_epoch = 0
         self._promote_lock = threading.Lock()
+        # cluster plane (metrics_tpu.cluster): the supervising ClusterNode
+        # registers itself here so health() can carry a `cluster` section
+        self._cluster: Optional[Any] = None
         # health-transition tracking (guard on_health_transition hook)
         self._last_health_state = "SERVING"
 
@@ -937,6 +955,9 @@ class StreamingEngine:
         }
         if self._repl_cfg is not None:
             out["replication"] = self._replication_health()
+        cluster = self._cluster
+        if cluster is not None:
+            out["cluster"] = cluster.health_view()
         if guard is not None:
             guard.publish_health(state)
         # health-transition observer (GuardConfig.on_health_transition): detect
@@ -1055,6 +1076,13 @@ class StreamingEngine:
     def _init_checkpoint(self, cfg: CheckpointConfig) -> None:
         if cfg.wal_flush not in _WAL_FLUSH:
             raise MetricsTPUUserError(f"`wal_flush` must be one of {_WAL_FLUSH}, got {cfg.wal_flush!r}")
+        if cfg.wal_fsync not in _WAL_FSYNC:
+            raise MetricsTPUUserError(f"`wal_fsync` must be one of {_WAL_FSYNC}, got {cfg.wal_fsync!r}")
+        if cfg.wal_fsync == "interval" and cfg.wal_fsync_interval_s <= 0:
+            raise MetricsTPUUserError(
+                f"`wal_fsync_interval_s` must be > 0 in interval mode, got {cfg.wal_fsync_interval_s!r}"
+            )
+        self._wal_last_fsync = time.monotonic()
         self._ckpt_cfg = cfg
         self._ckpt_store = SnapshotStore(
             cfg.directory, retain=cfg.retain, rank=cfg.rank, world=cfg.world, durable=cfg.durable
@@ -1115,8 +1143,11 @@ class StreamingEngine:
         try:
             seqs = self._journal.append_many(payloads)
             flush = self._ckpt_cfg.wal_flush
-            if flush != "none":
-                self._journal.flush(fsync=flush == "fsync")
+            fsync = flush == "fsync" or self._wal_fsync_due()
+            if flush != "none" or fsync:
+                self._journal.flush(fsync=fsync)
+                if fsync:
+                    self._wal_last_fsync = time.monotonic()
         except Exception as exc:  # noqa: BLE001
             self._wal_error = exc
             journal, self._journal = self._journal, None
@@ -1133,6 +1164,15 @@ class StreamingEngine:
         self._wal_seq = max(self._wal_seq, seqs[-1])
         self.telemetry.count("wal_records", len(payloads))
         return seqs
+
+    def _wal_fsync_due(self) -> bool:
+        """Does the ``wal_fsync`` policy demand a sync on this append?"""
+        policy = self._ckpt_cfg.wal_fsync
+        if policy == "commit":
+            return True
+        if policy == "interval":
+            return time.monotonic() - self._wal_last_fsync >= self._ckpt_cfg.wal_fsync_interval_s
+        return False
 
     def _journal_chunk(
         self,
@@ -1617,7 +1657,7 @@ class StreamingEngine:
                 f"exceeds max_staleness (seqs={cfg.max_staleness_seqs}, s={cfg.max_staleness_s})"
             )
 
-    def promote(self) -> None:
+    def promote(self, *, epoch: Optional[int] = None, ship: Optional[ReplConfig] = None) -> None:
         """Follower → primary hot failover.
 
         Drains the shipped tail (everything the deposed primary published is
@@ -1629,28 +1669,50 @@ class StreamingEngine:
         a synchronous pin snapshot, and starts a dispatcher — the engine is
         writable when this returns. Idempotent; triggered explicitly or by the
         guard hook (``GuardConfig(on_health_transition=repl.failover_hook(...))``).
+
+        ``epoch`` overrides the fencing epoch (must exceed the applied lineage
+        epoch) — the cluster plane passes its lease epoch here so *holding the
+        lease* and *writing into the lineage* are one fact. ``ship`` is a
+        ``role="primary"`` ReplConfig installed after promotion: the new
+        primary immediately re-ships its lineage (the cluster node hands it a
+        fan-out transport over the surviving peers).
         """
         cfg = self._repl_cfg
         if cfg is None or cfg.role != "follower":
             raise MetricsTPUUserError("promote() requires replication=ReplConfig(role='follower')")
+        if ship is not None and ship.role != "primary":
+            raise MetricsTPUUserError(
+                f"promote(ship=...) must be a role='primary' ReplConfig, got role={ship.role!r}"
+            )
         with self._promote_lock:
             if not self._repl_follower:
                 return  # already promoted (explicit call raced the failover hook)
             applier = self._applier
+            if applier is None:
+                raise NotPromotableError(
+                    "promote(): this node is a demoted, unattached follower — it has no "
+                    "ship link to drain a lineage from; re-attach it (demote(follower_cfg)) "
+                    "and retry once it bootstraps"
+                )
             if not applier.bootstrapped:
                 # a replica that never received its bootstrap snapshot holds
                 # FRESH INIT state: flipping it writable would pin empty state
                 # as the authoritative new lineage — every tenant's history
                 # silently replaced by zeros served as legitimate. Refuse;
-                # the guard failover hook absorbs the raise (the quarantined
-                # primary stays down, the follower keeps refusing bounded
-                # reads — conservative, loud, and retryable once a snapshot
-                # lands). An EMPTY-bootstrap replica is promotable: its
-                # primary genuinely had no state.
-                raise MetricsTPUUserError(
+                # retryable by contract (NotPromotableError): the guard hook
+                # and the cluster orchestrator back off and retry once a
+                # snapshot lands — conservative, loud, never lossy. An
+                # EMPTY-bootstrap replica is promotable: its primary genuinely
+                # had no state.
+                raise NotPromotableError(
                     "promote(): this follower never bootstrapped — promoting would pin "
                     "fresh-init state as the new durable lineage, losing all tenant "
                     "history; retry once a snapshot has been applied"
+                )
+            if epoch is not None and epoch <= applier.epoch:
+                raise MetricsTPUUserError(
+                    f"promote(epoch={epoch}): the fencing epoch must exceed the applied "
+                    f"lineage epoch ({applier.epoch}) — a stale lease cannot depose its successor"
                 )
             # 1. stop the poll thread, then drain what was already shipped;
             # park() makes the cutoff hard — stop()'s join can time out on a
@@ -1661,7 +1723,7 @@ class StreamingEngine:
             applier.drain(cfg.drain_timeout_s)
             applier.park()
             # 2. fence: from this instant the old epoch is dead at the boundary
-            new_epoch = applier.epoch + 1
+            new_epoch = applier.epoch + 1 if epoch is None else int(epoch)
             cfg.transport.fence(new_epoch)
             with self._lock:
                 self._repl_epoch = new_epoch
@@ -1690,6 +1752,29 @@ class StreamingEngine:
                     RuntimeWarning,
                     stacklevel=2,
                 )
+            # 3b. re-ship: the new primary publishes its lineage to the
+            # surviving peers over the transport the caller wired (fan-out in
+            # a cluster). Without a journal there is nothing to ship — the
+            # config is still installed so health() reports the role honestly.
+            if ship is not None:
+                self._repl_cfg = ship
+                if self._journal is not None:
+                    self._shipper = Shipper(
+                        ship,
+                        store=self._ckpt_store,
+                        journal=self._journal,
+                        telemetry=self.telemetry,
+                        engine_label=self.telemetry.engine_id,
+                        epoch=self._repl_epoch,
+                    )
+                else:
+                    warnings.warn(
+                        "promote(ship=...): no WAL journal after promotion (missing or "
+                        "failed promote_checkpoint lineage) — the promoted primary "
+                        "cannot ship to its followers",
+                        RuntimeWarning,
+                        stacklevel=2,
+                    )
             # 4. writable
             self.start()
         self.telemetry.count("promotions")
@@ -1722,6 +1807,99 @@ class StreamingEngine:
             self._wal_seq = int(self._journal.last_seq)
         self._ckpt_writer.checkpoint_sync(self._checkpoint_view)
 
+    def demote(self, replication: Optional[ReplConfig] = None) -> None:
+        """Primary → follower step-down: the mirror of :meth:`promote`.
+
+        The cluster plane calls this when a leader loses its lease (or a
+        follower must re-attach to a new leader's ship link). Order matters —
+        refuse new writes FIRST (submits raise
+        :class:`~metrics_tpu.repl.errors.NotPrimaryError` from the instant the
+        flag flips), then drain what was already accepted into the old lineage
+        (acked work is never dropped), stop the dispatcher and shipper, release
+        the durable plane (a follower does not own a lineage — the invariant
+        ``__init__`` enforces), and finally either attach the new follow link
+        (``replication`` = a ``role="follower"`` ReplConfig) or park read-only
+        and unattached (``replication=None`` — safe to call before a successor
+        even exists; the node rejoins a lineage via a later ``demote(cfg)``).
+
+        Safe on an engine that is already a follower: the write-path teardown
+        is skipped and only the link swap runs (re-attach). The old transport
+        is NOT fenced here — fencing belongs to the successor's promotion.
+        """
+        if replication is not None and replication.role != "follower":
+            raise MetricsTPUUserError(
+                f"demote() takes replication=None or a role='follower' ReplConfig, "
+                f"got role={replication.role!r}"
+            )
+        with self._promote_lock:
+            # 1. refuse new writes before anything else: a deposed leader that
+            # keeps accepting submits races its successor (they would die at
+            # the transport fence, but refusing them at the door is cheaper
+            # and honest to the caller)
+            with self._lock:
+                self._repl_follower = True
+                self._not_empty.notify_all()
+            # 2. drain accepted work into the old lineage, then retire the
+            # dispatcher (bounded: a step-down must not hang on a wedged engine)
+            drain_s = (
+                replication.drain_timeout_s
+                if replication is not None
+                else (self._repl_cfg.drain_timeout_s if self._repl_cfg is not None else 5.0)
+            )
+            worker = self._worker
+            if worker is not None and not self._quarantined:
+                try:
+                    self.flush(timeout=drain_s)
+                except TimeoutError:
+                    warnings.warn(
+                        f"demote(): drain did not complete within {drain_s}s — "
+                        "unfinished accepted work is abandoned with the old lineage",
+                        RuntimeWarning,
+                        stacklevel=2,
+                    )
+            if worker is not None:
+                with self._lock:
+                    self._worker_epoch += 1
+                    self._worker = None
+                    self._not_empty.notify_all()
+                if worker is not threading.current_thread():
+                    worker.join(timeout=5.0)
+            # 3. shipper: close() makes one final publish, so the drained tail
+            # reaches the followers before the link goes quiet (a fence by the
+            # successor is absorbed — its lineage already superseded ours)
+            if self._shipper is not None:
+                self._shipper.close()
+                self._shipper = None
+            # 4. old follow link, if any (re-attach replaces it wholesale)
+            if self._applier is not None:
+                self._applier.stop()
+                self._applier = None
+            # 5. release the durable plane: the lineage stays on disk for the
+            # successor's history, but this node no longer owns or extends it
+            if self._ckpt_writer is not None:
+                self._ckpt_writer.close()
+                self._ckpt_writer = None
+            if self._journal is not None:
+                self._journal.close()
+                self._journal = None
+            self._ckpt_store = None
+            self._ckpt_cfg = None
+            self._wal_seq = -1
+            self._wal_error = None
+            self._wal_slots_sent = set()
+            self._snapshot_seqs = {}
+            # 6. attach the new link, or park read-only/unattached
+            if replication is not None:
+                self._repl_cfg = replication
+                self._applier = ReplicaApplier(
+                    self,
+                    replication,
+                    telemetry=self.telemetry,
+                    engine_label=self.telemetry.engine_id,
+                )
+        self.telemetry.count("demotions")
+        self._publish_health()
+
     def _replication_health(self) -> Dict[str, Any]:
         info: Dict[str, Any] = {
             "role": "follower" if self._repl_follower else "primary",
@@ -1732,6 +1910,13 @@ class StreamingEngine:
             info["shipped_seq"] = shipper.last_shipped_seq
             info["shipped_generation"] = shipper.shipped_generation
             info["fenced"] = shipper.fenced
+            info["ship_failures"] = shipper.ship_failures
+            # a spooling transport (DirectoryTransport) that hit its spool cap
+            # dropped frames the follower must re-bootstrap past — surface it
+            # next to the failure count it usually explains
+            spool_dropped = getattr(shipper.transport, "spool_dropped", None)
+            if spool_dropped is not None:
+                info["spool_dropped"] = spool_dropped
             err = shipper.last_error
             info["ship_error"] = None if err is None else f"{type(err).__name__}: {err}"
         if applier is not None:

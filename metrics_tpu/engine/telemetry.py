@@ -85,6 +85,7 @@ _COUNTERS = (
     "apply_failures",       # follower frames that raised during apply (absorbed)
     "stale_read_refusals",  # follower reads refused beyond max_staleness
     "promotions",           # follower→primary promotions served by this engine
+    "demotions",            # primary→follower step-downs (lease loss / re-attach)
     "read_jit_fallbacks",   # compiled read path disabled (trace failure; eager from then on)
 )
 

@@ -576,6 +576,55 @@ def repl_span(name: str, **attrs: Any) -> Any:
     return TRACER.span(name, **attrs)
 
 
+# ---------------------------------------------------------------------- cluster plane
+
+CLUSTER_ROLE = REGISTRY.gauge(
+    "metrics_tpu_cluster_role",
+    "This node's role in the cluster control plane: 1 leader (holds the lease), "
+    "0 follower, per node.",
+)
+CLUSTER_FAILOVERS = REGISTRY.counter(
+    "metrics_tpu_cluster_failovers_total",
+    "Self-driving failovers completed by this node: lease won + promote() "
+    "succeeded at the lease epoch, per node.",
+)
+CLUSTER_LEASE_RENEWALS = REGISTRY.counter(
+    "metrics_tpu_cluster_lease_renewals_total",
+    "Leadership lease renewals (same epoch, deadline extended), per node.",
+)
+CLUSTER_SUSPICIONS = REGISTRY.counter(
+    "metrics_tpu_cluster_suspicions_total",
+    "Failure-detector suspicion edges: a peer's heartbeat went silent past the "
+    "suspect threshold (counted once per silence episode), per node.",
+)
+
+_ROLE_CODES = {"follower": 0, "leader": 1}
+
+
+def set_cluster_role(node: str, role: str) -> None:
+    if not OBS.enabled:
+        return
+    CLUSTER_ROLE.set(_ROLE_CODES.get(role, 0), node=node)
+
+
+def record_cluster_failover(node: str) -> None:
+    if not OBS.enabled:
+        return
+    CLUSTER_FAILOVERS.inc(1, node=node)
+
+
+def record_cluster_lease_renewal(node: str) -> None:
+    if not OBS.enabled:
+        return
+    CLUSTER_LEASE_RENEWALS.inc(1, node=node)
+
+
+def record_cluster_suspicion(node: str, peer: str) -> None:
+    if not OBS.enabled:
+        return
+    CLUSTER_SUSPICIONS.inc(1, node=node, peer=peer)
+
+
 # ---------------------------------------------------------------------- kernel plane
 
 KERNEL_DISPATCHES = REGISTRY.counter(

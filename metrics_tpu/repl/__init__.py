@@ -8,9 +8,9 @@ the replayer, and the guard plane's health transitions are the failover
 trigger. Topology is one primary (owns the write path and the durable
 lineage) plus ONE read replica per ship link — every transport here is a
 single-consumer stream (``recv`` consumes), so two followers must never share
-a link; an engine currently wires one transport, i.e. one follower per
-primary (multi-link fan-out is a transport-layer extension, not an engine
-change)::
+a link; a primary reaches N followers by wiring a
+:class:`~metrics_tpu.repl.transport.FanoutTransport` over N single-consumer
+links — the fan-out happens at the transport layer, not in the engine::
 
     from metrics_tpu.engine import CheckpointConfig, ReplConfig, StreamingEngine
     from metrics_tpu.repl import LoopbackLink
@@ -47,6 +47,7 @@ from metrics_tpu.repl.config import ReplConfig, ReplicaLag
 from metrics_tpu.repl.errors import (
     FencedError,
     NotPrimaryError,
+    NotPromotableError,
     ReplPeerLostError,
     ReplTransportError,
     StalenessExceeded,
@@ -56,6 +57,7 @@ from metrics_tpu.repl.shipper import Shipper
 from metrics_tpu.repl.transport import (
     DeadPeerLink,
     DirectoryTransport,
+    FanoutTransport,
     FlakyLink,
     HeartbeatFrame,
     LoopbackLink,
@@ -71,11 +73,13 @@ from metrics_tpu.repl.transport import (
 __all__ = [
     "DeadPeerLink",
     "DirectoryTransport",
+    "FanoutTransport",
     "FencedError",
     "FlakyLink",
     "HeartbeatFrame",
     "LoopbackLink",
     "NotPrimaryError",
+    "NotPromotableError",
     "ReplConfig",
     "ReplPeerLostError",
     "ReplTransport",
@@ -94,7 +98,14 @@ __all__ = [
 ]
 
 
-def failover_hook(follower_engine, *, on_state: str = "QUARANTINED"):
+def failover_hook(
+    follower_engine,
+    *,
+    on_state: str = "QUARANTINED",
+    retries: int = 20,
+    backoff_s: float = 0.05,
+    backoff_cap_s: float = 1.0,
+):
     """Build a ``GuardConfig(on_health_transition=...)`` observer that promotes
     ``follower_engine`` the moment the primary's health reaches ``on_state``.
 
@@ -102,10 +113,26 @@ def failover_hook(follower_engine, *, on_state: str = "QUARANTINED"):
     two engines share no locks, so the promotion runs inline — by the time the
     quarantined primary's callers see their failures, the follower is already
     writable.
+
+    :class:`~metrics_tpu.repl.errors.NotPromotableError` is retryable by
+    contract: the follower merely hasn't received its bootstrap snapshot yet
+    (the primary may have died mid-ship). The hook backs off with capped
+    exponential delays and retries up to ``retries`` times — if the snapshot
+    never lands, it gives up quietly and leaves the follower read-only (the
+    guard absorbs hook exceptions anyway; raising would change nothing).
     """
+    import time as _time
 
     def _hook(old: str, new: str) -> None:
-        if new == on_state and old != on_state:
-            follower_engine.promote()
+        if new != on_state or old == on_state:
+            return
+        for attempt in range(retries + 1):
+            try:
+                follower_engine.promote()
+                return
+            except NotPromotableError:
+                if attempt == retries:
+                    return
+                _time.sleep(min(backoff_s * (2.0 ** attempt), backoff_cap_s))
 
     return _hook

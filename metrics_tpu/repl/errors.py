@@ -19,6 +19,7 @@ from metrics_tpu.utils.exceptions import MetricsTPUUserError
 __all__ = [
     "FencedError",
     "NotPrimaryError",
+    "NotPromotableError",
     "ReplPeerLostError",
     "ReplTransportError",
     "StalenessExceeded",
@@ -36,6 +37,15 @@ class StalenessExceeded(MetricsTPUUserError):
     """A follower read was refused because its :class:`~metrics_tpu.repl.ReplicaLag`
     exceeded the configured ``max_staleness`` bound (or the replica has not
     bootstrapped yet, i.e. its staleness is unbounded)."""
+
+
+class NotPromotableError(MetricsTPUUserError):
+    """``promote()`` refused because this follower cannot safely become primary
+    *yet*: it never received its bootstrap snapshot, so flipping it writable
+    would pin fresh-init state as the authoritative lineage. Retryable by
+    contract — automation (the guard failover hook, the cluster orchestrator)
+    backs off and retries once a snapshot lands, instead of pattern-matching a
+    generic error."""
 
 
 class ReplTransportError(RuntimeError):

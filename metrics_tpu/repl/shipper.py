@@ -75,6 +75,7 @@ class Shipper:
         self._seen_generation: Optional[int] = None
         self.fenced = False
         self.journal_lost = False
+        self.ship_failures = 0  # transient tick failures (health() surfaces this)
         self.last_error: Optional[BaseException] = None
         self._need_snapshot = True  # first attach always bootstraps the follower
         self._final = False  # close()'s last publish: lets the tail loop run past _stop
@@ -129,6 +130,7 @@ class Shipper:
                 return
             except Exception as exc:  # noqa: BLE001 — transient: retry next tick
                 self.last_error = exc
+                self.ship_failures += 1
                 self._telemetry.count("ship_failures")
 
     # ------------------------------------------------------------------ ship loop
@@ -282,6 +284,19 @@ class Shipper:
             # outlive its join timeout publishing into a torn-down transport
             records = self._cursor.read(max_records=_WAL_BATCH)
             if not records:
+                if self._journal.last_seq > self.last_shipped_seq:
+                    # the cursor is dry but the journal is ahead: if rotation
+                    # GC'd the unshipped span (snapshot-covered) there is no
+                    # WAL frame left to trip the gap check below — on a
+                    # backchannel link the follower never gaps, never asks,
+                    # and the span is silently lost. Re-anchor via snapshot.
+                    # (A span still buffered in an unflushed segment keeps its
+                    # start at last_shipped+1 and does NOT trigger this.)
+                    segs = self._journal._segments()
+                    start = segs[0][0] if segs else self._journal.last_seq + 1
+                    if start > self.last_shipped_seq + 1:
+                        self._need_snapshot = True
+                        self._cursor = None
                 break
             if records[0][0] != self.last_shipped_seq + 1:
                 # rotation GC'd past us while we lagged: records between

@@ -50,6 +50,7 @@ from metrics_tpu.repl.errors import FencedError, ReplPeerLostError, ReplTranspor
 __all__ = [
     "DeadPeerLink",
     "DirectoryTransport",
+    "FanoutTransport",
     "FlakyLink",
     "HeartbeatFrame",
     "LoopbackLink",
@@ -633,3 +634,95 @@ class DeadPeerLink(FlakyLink):
 
     def send(self, frames: Sequence[ShipFrame]) -> None:
         raise ReplPeerLostError("follower left the membership")
+
+
+# -------------------------------------------------------------------- fan-out
+
+
+class FanoutTransport(ReplTransport):
+    """Ship-side fan-out: one primary publishing ONE lineage to N followers,
+    each over its own single-consumer link (the engine still wires one
+    transport — this is the "transport-layer extension" the one-follower
+    contract pointed at, used by the cluster plane's multi-follower topology).
+
+    Semantics:
+
+    - ``send`` publishes to every link. A :class:`FencedError` from ANY link
+      means a newer primary exists somewhere — the send raises it (the shipper
+      parks as deposed). Non-fence failures on a SUBSET of links are absorbed
+      (``partial_failures`` counts them): a dead follower must not stop
+      shipping to the live ones — it re-bootstraps off its own link's snapshot
+      path when it returns. Only when EVERY link fails does the send raise.
+    - ``fence`` propagates to every link (and the local floor).
+    - the backchannel aggregates: one follower's snapshot request triggers one
+      bootstrap ship, which ``send`` fans to everyone (a redundant bootstrap
+      at/behind a healthy follower's position is dropped by its applier).
+    - ``recv`` is not a thing: the primary's applier never reads a fan-out.
+    """
+
+    name = "fanout"
+
+    def __init__(self, links: Sequence[ReplTransport]) -> None:
+        super().__init__()
+        if not links:
+            raise ReplTransportError("FanoutTransport needs at least one link")
+        self._links = list(links)
+        # the shipper keys its routine-ship policy on this: with any
+        # backchannel-less link in the set, routine new-generation ships are
+        # the only gap-heal path that reaches it
+        self.has_backchannel = all(link.has_backchannel for link in self._links)
+        self.partial_failures = 0
+
+    @property
+    def links(self) -> List[ReplTransport]:
+        return list(self._links)
+
+    def send(self, frames: Sequence[ShipFrame]) -> None:
+        self._check_send_epoch(frames)
+        fenced: Optional[FencedError] = None
+        errors: List[BaseException] = []
+        for link in self._links:
+            try:
+                link.send(frames)
+            except FencedError as exc:
+                fenced = exc
+            except Exception as exc:  # noqa: BLE001 — per-link isolation
+                errors.append(exc)
+        if fenced is not None:
+            raise fenced
+        if errors:
+            if len(errors) == len(self._links):
+                raise errors[0] if isinstance(errors[0], ReplTransportError) else \
+                    ReplTransportError(f"every fan-out link failed: {errors[0]!r}")
+            self.partial_failures += len(errors)
+
+    def recv(self, timeout_s: float = 0.0) -> List[ShipFrame]:
+        raise ReplTransportError("FanoutTransport is ship-side only; followers receive on their own link")
+
+    def fence(self, epoch: int) -> None:
+        super().fence(epoch)
+        for link in self._links:
+            try:
+                link.fence(epoch)
+            except Exception:  # noqa: BLE001 — best effort; each receive side re-checks
+                pass
+
+    def request_snapshot(self) -> None:
+        pass  # ship-side only
+
+    def take_snapshot_request(self) -> bool:
+        pending = False
+        for link in self._links:
+            try:
+                if link.take_snapshot_request():
+                    pending = True
+            except Exception:  # noqa: BLE001
+                continue
+        return pending
+
+    def close(self) -> None:
+        for link in self._links:
+            try:
+                link.close()
+            except Exception:  # noqa: BLE001
+                pass

@@ -1,0 +1,124 @@
+"""The ``wal_fsync`` durability knob: ``synced_seq`` tracks what genuinely hit
+stable storage, and commit-mode's contract — a reopen after a torn tail never
+rewinds past a fsynced record — is exercised with a simulated crash."""
+
+import os
+
+import numpy as np
+import pytest
+
+from metrics_tpu.aggregation import SumMetric
+from metrics_tpu.ckpt import RequestJournal
+from metrics_tpu.engine import CheckpointConfig, StreamingEngine
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+
+class TestJournalSyncedSeq:
+    def test_fsync_advances_synced_seq(self, tmp_path):
+        j = RequestJournal(str(tmp_path))
+        j.append_many([b"a", b"b", b"c"])
+        assert j.synced_seq == -1  # appended, not yet synced
+        j.flush(fsync=True)
+        assert j.synced_seq == 2
+        j.append_many([b"d", b"e"])
+        assert j.last_seq == 4 and j.synced_seq == 2  # unsynced tail
+        j.close()
+
+    def test_flush_without_fsync_does_not_advance(self, tmp_path):
+        j = RequestJournal(str(tmp_path))
+        j.append(b"a")
+        j.flush()
+        assert j.synced_seq == -1
+        j.close()
+
+    def test_close_and_reopen_sync(self, tmp_path):
+        j = RequestJournal(str(tmp_path))
+        j.append_many([b"a", b"b"])
+        j.close()  # close fsyncs
+        assert j.synced_seq == 1
+        j2 = RequestJournal(str(tmp_path))
+        # whatever the reopen scan found has, by definition, survived
+        assert j2.synced_seq == j2.last_seq == 1
+        j2.close()
+
+    def test_torn_tail_reopen_never_rewinds_past_synced(self, tmp_path):
+        # the commit-mode durability contract, end to end: fsync a prefix,
+        # append an unsynced tail, tear the last record (crash mid-append),
+        # and the reopen must resume at or above every fsynced record
+        j = RequestJournal(str(tmp_path))
+        j.append_many([b"r0", b"r1", b"r2"])
+        j.flush(fsync=True)
+        synced = j.synced_seq
+        assert synced == 2
+        j.append_many([b"r3", b"r4"])
+        j.flush()  # bytes reach the file, no fsync
+        seg = j._segments()[0][1]
+        # crash: the final record's frame is torn mid-write
+        with open(seg, "r+b") as f:
+            f.truncate(os.path.getsize(seg) - 3)
+        j._file.close()  # abandon without close() (close would fsync)
+        j._file = None
+
+        j2 = RequestJournal(str(tmp_path))
+        assert j2.last_seq >= synced  # never rewinds past a fsynced record
+        assert j2.last_seq == 3  # the torn r4 is gone, the clean r3 survives
+        assert [p for _, p in j2.replay()] == [b"r0", b"r1", b"r2", b"r3"]
+        # appends after the reopen continue the unbroken chain
+        assert j2.append(b"r4-again") == 4
+        j2.close()
+
+    def test_non_durable_journal_never_syncs(self, tmp_path):
+        j = RequestJournal(str(tmp_path), durable=False)
+        j.append(b"a")
+        j.flush(fsync=True)  # durable=False: fsync is a no-op, and honestly so
+        assert j.synced_seq == -1
+        j.close()
+
+
+class TestEngineWalFsyncPolicy:
+    def _engine(self, tmp_path, **ckpt_kw):
+        return StreamingEngine(
+            SumMetric(),
+            checkpoint=CheckpointConfig(directory=str(tmp_path), interval_s=3600.0, **ckpt_kw),
+        )
+
+    def test_commit_mode_syncs_every_append(self, tmp_path):
+        eng = self._engine(tmp_path, wal_fsync="commit")
+        try:
+            eng.submit("k", np.array([1.0]))
+            eng.flush()
+            j = eng._journal
+            assert j.last_seq >= 0
+            assert j.synced_seq == j.last_seq
+        finally:
+            eng.close()
+
+    def test_never_mode_leaves_tail_unsynced(self, tmp_path):
+        eng = self._engine(tmp_path, wal_fsync="never", wal_flush="flush")
+        try:
+            eng.submit("k", np.array([1.0]))
+            eng.flush()
+            j = eng._journal
+            assert j.last_seq >= 0
+            assert j.synced_seq == -1
+        finally:
+            eng.close()
+
+    def test_interval_mode_syncs_once_elapsed(self, tmp_path):
+        # a tiny interval: the first append past it syncs
+        eng = self._engine(tmp_path, wal_fsync="interval", wal_fsync_interval_s=1e-9)
+        try:
+            eng.submit("k", np.array([1.0]))
+            eng.flush()
+            j = eng._journal
+            assert j.synced_seq == j.last_seq
+        finally:
+            eng.close()
+
+    def test_invalid_policy_rejected(self, tmp_path):
+        with pytest.raises(MetricsTPUUserError):
+            self._engine(tmp_path, wal_fsync="always")
+
+    def test_interval_mode_requires_positive_interval(self, tmp_path):
+        with pytest.raises(MetricsTPUUserError):
+            self._engine(tmp_path, wal_fsync="interval", wal_fsync_interval_s=0.0)

@@ -1,0 +1,126 @@
+"""Shared 3-node cluster rig: real engines over loopback links, a FakeCoordStore
+under a ManualClock, and nodes ticked by hand — every test fully deterministic
+in store time (wall time only passes while waiting on ship/apply threads)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from metrics_tpu.aggregation import SumMetric
+from metrics_tpu.engine import CheckpointConfig, ReplConfig, StreamingEngine
+from metrics_tpu.cluster import ClusterConfig, ClusterNode, FakeCoordStore, ManualClock
+from metrics_tpu.repl import FanoutTransport, LoopbackLink
+
+NODES = ("a", "b", "c")
+
+
+class TriCluster:
+    """Three engines ('a' primary, 'b'/'c' followers) + their ClusterNodes."""
+
+    def __init__(self, tmp_path):
+        self.clock = ManualClock(0.0)
+        self.store = FakeCoordStore(clock=self.clock)
+        self._links = {}
+        self.engines = {}
+        self.nodes = {}
+        self.fed = []  # every value acked by a leader, in order
+
+        self.engines["a"] = StreamingEngine(
+            SumMetric(),
+            checkpoint=CheckpointConfig(
+                directory=str(tmp_path / "a"), interval_s=0.05, wal_flush="fsync"
+            ),
+            replication=ReplConfig(
+                role="primary",
+                transport=FanoutTransport([self.link("a", "b"), self.link("a", "c")]),
+                ship_interval_s=0.01,
+                heartbeat_interval_s=0.05,
+            ),
+        )
+        for name in ("b", "c"):
+            self.engines[name] = StreamingEngine(
+                SumMetric(),
+                replication=ReplConfig(
+                    role="follower",
+                    transport=self.link("a", name),
+                    poll_interval_s=0.01,
+                    promote_checkpoint=CheckpointConfig(
+                        directory=str(tmp_path / name), interval_s=0.05, wal_flush="fsync"
+                    ),
+                ),
+            )
+        for name in NODES:
+            peers = tuple(n for n in NODES if n != name)
+            self.nodes[name] = ClusterNode(
+                self.engines[name],
+                ClusterConfig(
+                    node_id=name,
+                    peers=peers,
+                    store=self.store,
+                    link_factory=self.link,
+                    lease_ttl_s=3.0,
+                    heartbeat_interval_s=1.0,
+                    suspect_after_s=2.5,
+                    confirm_after_s=6.0,
+                    election_backoff_s=0.25,
+                    rng_seed=ord(name),
+                ),
+                start=False,
+            )
+
+    def link(self, src, dst):
+        key = (src, dst)
+        if key not in self._links:
+            self._links[key] = LoopbackLink()
+        return self._links[key]
+
+    def tick_all(self, order=NODES):
+        for name in order:
+            self.nodes[name].tick()
+
+    def writable(self):
+        return [n for n in NODES if not self.engines[n]._repl_follower]
+
+    def feed(self, leader, values, key="k"):
+        for v in values:
+            self.engines[leader].submit(key, np.array([float(v)]))
+        self.engines[leader].flush()
+        self.fed.extend(values)
+
+    def wait_caught_up(self, follower, leader, timeout=8.0):
+        """Wait until ``follower``'s applier has applied the leader's WAL tail."""
+        target = self.engines[leader]._wal_seq
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            applier = self.engines[follower]._applier
+            if applier is not None and applier.bootstrapped and applier.applied_seq >= target:
+                return
+            time.sleep(0.02)
+        applier = self.engines[follower]._applier
+        raise AssertionError(
+            f"{follower} never caught up to {leader}'s seq {target} "
+            f"(applied={getattr(applier, 'applied_seq', None)}, "
+            f"bootstrapped={getattr(applier, 'bootstrapped', None)})"
+        )
+
+    def form(self):
+        """Elect 'a', attach 'b'/'c', and verify the lease/epoch alignment."""
+        self.tick_all()
+        lease = self.store.read_lease()
+        assert lease is not None and lease.holder == "a"
+        assert self.engines["a"]._repl_epoch == lease.epoch
+        return lease
+
+    def close(self):
+        for node in self.nodes.values():
+            node.close(release=False)
+        for engine in self.engines.values():
+            engine.close()
+
+
+@pytest.fixture
+def tri(tmp_path):
+    cluster = TriCluster(tmp_path)
+    yield cluster
+    cluster.close()

@@ -1,0 +1,134 @@
+"""ClusterClient routing: leader resolution + caching, redirect-on-refusal,
+capped jittered backoff, replica read preference, NoLeaderError exhaustion."""
+
+import pytest
+
+from metrics_tpu.cluster import ClusterClient, FakeCoordStore, ManualClock, NoLeaderError
+from metrics_tpu.engine import EngineClosed
+from metrics_tpu.repl import NotPrimaryError, StalenessExceeded
+
+
+class StubNode:
+    def __init__(self, name, submit_exc=None, compute_exc=None):
+        self.name = name
+        self.submit_exc = submit_exc
+        self.compute_exc = compute_exc
+        self.submits = 0
+        self.computes = 0
+
+    def submit(self, key, *args, **kwargs):
+        self.submits += 1
+        if self.submit_exc is not None:
+            raise self.submit_exc
+        return f"submit@{self.name}"
+
+    def compute(self, key, **kwargs):
+        self.computes += 1
+        if self.compute_exc is not None:
+            raise self.compute_exc
+        return f"compute@{self.name}"
+
+
+def _cluster(leader="x", nodes=("x", "y"), ttl=100.0):
+    clock = ManualClock(0.0)
+    store = FakeCoordStore(clock=clock)
+    engines = {n: StubNode(n) for n in nodes}
+    if leader is not None:
+        store.acquire_lease(leader, ttl)
+    sleeps = []
+    client = ClusterClient(store, engines, sleep=sleeps.append, rng_seed=0)
+    return clock, store, engines, client, sleeps
+
+
+def test_routes_to_leader_and_caches_resolution():
+    _, store, engines, client, _ = _cluster()
+    assert client.submit("k", 1) == "submit@x"
+    assert client.leader_id() == "x"
+    # cached: a second submit does not re-read the store
+    store.partition("x")  # would raise if read again via x... the store read
+    store.heal("x")
+    assert client.submit("k", 2) == "submit@x"
+    assert engines["x"].submits == 2 and engines["y"].submits == 0
+
+
+def test_redirects_on_not_primary_to_new_leader():
+    clock, store, engines, client, sleeps = _cluster()
+    assert client.submit("k") == "submit@x"
+    # failover: x starts refusing, the lease moves to y
+    engines["x"].submit_exc = NotPrimaryError("stepped down")
+    store.release_lease("x")
+    store.acquire_lease("y", 100.0)
+    assert client.submit("k") == "submit@y"
+    assert client.redirects == 1
+    assert client.leader_id() == "y"
+    assert sleeps  # the redirect backed off before re-resolving
+
+
+def test_dead_leader_handle_redirects_like_a_refusal():
+    # a crashed node's handle raises EngineClosed (the in-process analogue of
+    # connection-refused) while its lease may live up to a TTL longer — the
+    # router must re-resolve and retry, not propagate, or it dies in the one
+    # window failover exists for
+    clock, store, engines, client, _ = _cluster()
+    assert client.submit("k") == "submit@x"
+    engines["x"].submit_exc = EngineClosed("crashed")
+    engines["x"].compute_exc = EngineClosed("crashed")
+    store.release_lease("x")
+    store.acquire_lease("y", 100.0)
+    assert client.submit("k") == "submit@y"
+    assert client.compute("k") == "compute@y"
+    assert client.redirects >= 1
+
+
+def test_headless_cluster_raises_no_leader_after_retries():
+    _, _, _, client, sleeps = _cluster(leader=None)
+    with pytest.raises(NoLeaderError):
+        client.submit("k")
+    assert len(sleeps) == client._retries + 1
+    # capped exponential: every delay within [0.5x, 1.5x] of the cap at most
+    assert max(sleeps) <= client._backoff_cap_s * 1.5
+
+
+def test_expired_lease_is_headless():
+    clock, _, _, client, _ = _cluster(ttl=5.0)
+    clock.advance(10.0)
+    assert client.leader_id(refresh=True) is None
+
+
+def test_unknown_holder_is_headless():
+    _, store, _, client, _ = _cluster(leader=None)
+    store.acquire_lease("stranger", 100.0)
+    assert client.leader_id() is None
+
+
+def test_replica_read_prefers_non_leader():
+    _, _, engines, client, _ = _cluster()
+    assert client.compute("k", prefer="replica") == "compute@y"
+    assert engines["x"].computes == 0
+
+
+def test_replica_staleness_falls_back_to_leader_inline():
+    _, _, engines, client, _ = _cluster()
+    engines["y"].compute_exc = StalenessExceeded("too stale")
+    assert client.compute("k", prefer="replica") == "compute@x"
+    assert client.redirects == 1
+
+
+def test_leader_read_default():
+    _, _, engines, client, _ = _cluster()
+    assert client.compute("k") == "compute@x"
+    assert engines["y"].computes == 0
+
+
+def test_all_reads_refused_raises_no_leader():
+    _, _, engines, client, _ = _cluster()
+    engines["x"].compute_exc = StalenessExceeded("stale")
+    engines["y"].compute_exc = StalenessExceeded("stale")
+    with pytest.raises(NoLeaderError):
+        client.compute("k", prefer="replica")
+
+
+def test_invalid_prefer_rejected():
+    _, _, _, client, _ = _cluster()
+    with pytest.raises(ValueError):
+        client.compute("k", prefer="nearest")

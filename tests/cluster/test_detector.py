@@ -1,0 +1,142 @@
+"""Failure detection: heartbeat publication, suspicion edges, confirmation,
+and the cluster section of health() — all under a manual store clock."""
+
+from metrics_tpu.cluster import ClusterConfig, ClusterNode, FakeCoordStore, ManualClock, Member
+
+
+class StubEngine:
+    """The engine surface ClusterNode reads, without a dispatcher/JAX in sight."""
+
+    def __init__(self, writable=True, state="SERVING"):
+        self._cluster = None
+        self._repl_follower = not writable
+        self._applier = None
+        self._repl_cfg = None
+        self._repl_epoch = 0
+        self.state = state
+
+    def health(self):
+        out = {"state": self.state}
+        if self._cluster is not None:
+            out["cluster"] = self._cluster.health_view()
+        return out
+
+
+def _node(store, node_id="a", peers=("b",), **kw):
+    defaults = dict(
+        lease_ttl_s=3.0,
+        heartbeat_interval_s=1.0,
+        suspect_after_s=2.5,
+        confirm_after_s=6.0,
+        rng_seed=7,
+    )
+    defaults.update(kw)
+    cfg = ClusterConfig(node_id=node_id, store=store, peers=peers, **defaults)
+    return ClusterNode(StubEngine(), cfg, start=False)
+
+
+def _beat(store, node, now, **kw):
+    defaults = dict(role="follower", health="SERVING", bootstrapped=True, lag_seqs=0)
+    defaults.update(kw)
+    store.heartbeat(Member(node_id=node, heartbeat=now, **defaults))
+
+
+def test_heartbeat_published_at_interval_cadence():
+    clock = ManualClock(0.0)
+    store = FakeCoordStore(clock=clock)
+    node = _node(store)
+    node.tick()
+    first = store.members()["a"].heartbeat
+    clock.advance(0.3)
+    node.tick()  # within the interval: no re-publish
+    assert store.members()["a"].heartbeat == first
+    clock.advance(1.0)
+    node.tick()
+    assert store.members()["a"].heartbeat > first
+
+
+def test_suspicion_counts_once_per_silence_episode():
+    clock = ManualClock(0.0)
+    store = FakeCoordStore(clock=clock)
+    node = _node(store)
+    _beat(store, "b", clock())
+    node.tick()
+    assert node.suspicions == 0
+    clock.advance(3.0)  # past suspect_after_s
+    node.tick()
+    node.tick()
+    node.tick()
+    assert node.suspicions == 1  # the edge, not the level
+    assert node.health_view()["suspected_peers"] == ["b"]
+
+
+def test_fresh_heartbeat_clears_suspicion_and_rearms():
+    clock = ManualClock(0.0)
+    store = FakeCoordStore(clock=clock)
+    node = _node(store)
+    _beat(store, "b", clock())
+    clock.advance(3.0)
+    node.tick()
+    assert node.health_view()["suspected_peers"] == ["b"]
+    _beat(store, "b", clock())  # b comes back
+    node.tick()
+    assert node.health_view()["suspected_peers"] == []
+    clock.advance(3.0)  # a SECOND silence episode counts again
+    node.tick()
+    assert node.suspicions == 2
+
+
+def test_confirmed_dead_peer_is_excluded_from_candidacy_ranking():
+    clock = ManualClock(0.0)
+    store = FakeCoordStore(clock=clock)
+    node = _node(store, node_id="z", peers=("a",))  # 'a' < 'z': a would win ties
+    _beat(store, "a", clock(), lag_seqs=0)
+    node.tick()
+    # a's record is fresher-ranked than z, so z is not the favourite...
+    assert node._is_favourite(clock(), 0) is False
+    # ...until a has been silent past confirm_after_s: dead peers don't rank
+    clock.advance(6.0)
+    assert node._is_favourite(clock(), 0) is True
+
+
+def test_health_view_shape():
+    clock = ManualClock(0.0)
+    store = FakeCoordStore(clock=clock)
+    node = _node(store)
+    node.tick()
+    view = node.health_view()
+    assert set(view) == {
+        "node_id",
+        "role",
+        "lease_epoch",
+        "lease_ttl_remaining_s",
+        "following",
+        "suspected_peers",
+        "failovers",
+        "lease_renewals",
+        "suspicions",
+    }
+    assert view["node_id"] == "a" and view["role"] == "leader"
+    # a writable stub engine self-elects on the first tick: the lease is live
+    assert view["lease_epoch"] == 1 and view["lease_ttl_remaining_s"] > 0
+
+
+def test_leader_renews_at_half_ttl_and_steps_down_on_loss():
+    clock = ManualClock(0.0)
+    store = FakeCoordStore(clock=clock)
+    node = _node(store)
+    node.tick()  # acquires the lease
+    assert node.role == "leader" and node.lease_renewals == 0
+    clock.advance(2.0)  # past half TTL
+    node.tick()
+    assert node.lease_renewals == 1
+    # store partitions the leader: renewal fails, but we are covered until OUR
+    # deadline passes — then the node assumes deposed
+    store.partition("a")
+    clock.advance(1.0)
+    node.tick()
+    assert node.role == "leader"  # deadline not yet passed
+    clock.advance(5.0)
+    node.tick()
+    assert node.role == "follower"
+    assert node.health_view()["lease_epoch"] is None

@@ -1,0 +1,146 @@
+"""At-most-one-writer: the deterministic election race the cluster plane's
+safety argument rests on. Two would-be leaders race an expired lease under a
+manual store clock — exactly one holds it at every interleaving, and the
+deposed leader's shipments die at the transport fence."""
+
+import time
+
+import numpy as np
+import pytest
+
+from metrics_tpu.repl import NotPrimaryError
+
+
+def _expire_leader(tri):
+    """Leader 'a' goes dark: cut from the store, lease allowed to expire.
+    3.5s of store time: past the lease TTL (3.0) and the suspect threshold
+    (2.5) but short of confirmation (6.0) — survivors still rank each other."""
+    tri.store.partition("a")
+    tri.clock.advance(3.5)
+
+
+@pytest.mark.parametrize("first", ["b", "c"])
+def test_exactly_one_survivor_wins_every_interleaving(tri, first):
+    tri.form()
+    tri.feed("a", range(10))
+    tri.wait_caught_up("b", "a")
+    tri.wait_caught_up("c", "a")
+    _expire_leader(tri)
+    second = "c" if first == "b" else "b"
+    # every prefix of every interleaving holds the invariant: never two
+    # writable engines among the survivors
+    for name in (first, second, first, second, first, second):
+        tri.nodes[name].tick()
+        survivors = [n for n in ("b", "c") if not tri.engines[n]._repl_follower]
+        assert len(survivors) <= 1
+    survivors = [n for n in ("b", "c") if not tri.engines[n]._repl_follower]
+    assert len(survivors) == 1
+    winner = survivors[0]
+    lease = tri.store.read_lease()
+    assert lease.holder == winner
+    # the lease epoch IS the fencing epoch
+    assert tri.engines[winner]._repl_epoch == lease.epoch
+    # the loser follows the winner's link
+    loser = "c" if winner == "b" else "b"
+    assert tri.nodes[loser]._following == winner
+    # the winner serves exactly the acked prefix
+    assert float(tri.engines[winner].compute("k")) == float(sum(tri.fed))
+
+
+def test_favourite_holds_back_one_round(tri):
+    # with both survivors equally caught up, 'b' (lower node id) is the
+    # favourite: 'c' ticking FIRST must defer rather than grab the lease
+    tri.form()
+    tri.feed("a", range(5))
+    tri.wait_caught_up("b", "a")
+    tri.wait_caught_up("c", "a")
+    # refresh member records so they reflect the caught-up followers (the
+    # form()-time records were published before bootstrap completed)
+    tri.clock.advance(1.0)
+    tri.tick_all()
+    _expire_leader(tri)
+    tri.nodes["c"].tick()
+    assert tri.store.read_lease().expired(tri.store.now())  # c held back
+    assert tri.engines["c"]._repl_follower
+    tri.nodes["b"].tick()
+    assert tri.store.read_lease().holder == "b"
+    assert not tri.engines["b"]._repl_follower
+
+
+def test_deposed_leader_shipments_die_at_the_fence(tri):
+    tri.form()
+    tri.feed("a", range(8))
+    tri.wait_caught_up("b", "a")
+    tri.wait_caught_up("c", "a")
+    _expire_leader(tri)
+    tri.nodes["b"].tick()  # b wins and promotes; its promote() fenced link a->b
+    tri.nodes["c"].tick()  # c re-attaches to b, fencing its old inbound a->c
+    assert tri.writable() == ["a", "b"]  # 'a' has not ticked: still locally writable
+    # the zombie leader accepts a local write — split-brain territory — but its
+    # shipment is rejected at the transport boundary, never the new lineage
+    tri.engines["a"].submit("k", np.array([999.0]))
+    tri.engines["a"].flush()
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and not tri.engines["a"]._shipper.fenced:
+        time.sleep(0.02)
+    assert tri.engines["a"]._shipper.fenced
+    assert tri.engines["a"].health()["state"] == "DEGRADED"  # loudly, not silently
+    # the fenced write never reaches the survivors' lineage
+    assert float(tri.engines["b"].compute("k")) == float(sum(tri.fed))
+    # ...and once the old leader's store connectivity heals, it steps down
+    tri.store.heal("a")
+    tri.nodes["a"].tick()
+    assert tri.writable() == ["b"]
+    with pytest.raises(NotPrimaryError):
+        tri.engines["a"].submit("k", np.array([1.0]))
+
+
+def test_ineligible_followers_never_elect(tmp_path):
+    # followers that never bootstrapped (their primary never existed): an
+    # election must NOT promote fresh-init state into a new lineage
+    from metrics_tpu.aggregation import SumMetric
+    from metrics_tpu.cluster import ClusterConfig, ClusterNode, FakeCoordStore, ManualClock
+    from metrics_tpu.engine import CheckpointConfig, ReplConfig, StreamingEngine
+    from metrics_tpu.repl import LoopbackLink
+
+    clock = ManualClock(0.0)
+    store = FakeCoordStore(clock=clock)
+    engines, nodes = {}, {}
+    links = {}
+
+    def link(src, dst):
+        return links.setdefault((src, dst), LoopbackLink())
+
+    for name in ("b", "c"):
+        engines[name] = StreamingEngine(
+            SumMetric(),
+            replication=ReplConfig(
+                role="follower",
+                transport=link("a", name),  # nothing ever ships on it
+                poll_interval_s=0.01,
+                promote_checkpoint=CheckpointConfig(directory=str(tmp_path / name)),
+            ),
+        )
+        nodes[name] = ClusterNode(
+            engines[name],
+            ClusterConfig(
+                node_id=name,
+                peers=tuple(p for p in ("b", "c") if p != name),
+                store=store,
+                link_factory=link,
+                rng_seed=3,
+            ),
+            start=False,
+        )
+    clock.advance(10.0)
+    try:
+        for _ in range(4):
+            nodes["b"].tick()
+            nodes["c"].tick()
+        assert store.read_lease() is None
+        assert engines["b"]._repl_follower and engines["c"]._repl_follower
+    finally:
+        for node in nodes.values():
+            node.close(release=False)
+        for engine in engines.values():
+            engine.close()

@@ -1,0 +1,142 @@
+"""Self-driving failover: the cluster loses its leader and heals itself —
+no operator promote(), no manual epoch bookkeeping — then the revived old
+leader rejoins the new lineage as a read-only follower."""
+
+import numpy as np
+import pytest
+
+from metrics_tpu.aggregation import SumMetric
+from metrics_tpu.cluster import ClusterConfig, ClusterNode, FakeCoordStore, ManualClock
+from metrics_tpu.engine import CheckpointConfig, ReplConfig, StreamingEngine
+from metrics_tpu.repl import LoopbackLink, NotPrimaryError, NotPromotableError
+
+
+def _refresh_members(tri):
+    tri.clock.advance(1.0)
+    tri.tick_all()
+
+
+def test_self_driving_failover_and_rejoin(tri):
+    lease0 = tri.form()
+    tri.feed("a", range(10))
+    tri.wait_caught_up("b", "a")
+    tri.wait_caught_up("c", "a")
+    _refresh_members(tri)
+
+    # leader dies: cut from the store, lease expires, survivors take over
+    tri.store.partition("a")
+    tri.clock.advance(3.5)
+    tri.nodes["b"].tick()
+    tri.nodes["c"].tick()
+
+    assert tri.nodes["b"].role == "leader"
+    assert tri.nodes["b"].failovers == 1
+    lease = tri.store.read_lease()
+    assert lease.holder == "b" and lease.epoch == lease0.epoch + 1
+    assert tri.engines["b"]._repl_epoch == lease.epoch
+    assert tri.nodes["c"]._following == "b"
+
+    # the new lineage serves writes and replicates them
+    tri.feed("b", range(10, 15))
+    tri.wait_caught_up("c", "b")
+    assert float(tri.engines["b"].compute("k")) == float(sum(tri.fed))
+
+    # the old leader revives: store connectivity heals, it finds the new
+    # lease, steps down, and re-attaches to the winner's link
+    tri.store.heal("a")
+    tri.nodes["a"].tick()
+    assert tri.nodes["a"].role == "follower"
+    assert tri.nodes["a"]._following == "b"
+    assert tri.writable() == ["b"]
+    with pytest.raises(NotPrimaryError):
+        tri.engines["a"].submit("k", np.array([1.0]))
+    # ...and bootstraps into the new lineage
+    tri.wait_caught_up("a", "b")
+
+    # health tells the whole story
+    view = tri.engines["b"].health()["cluster"]
+    assert view["role"] == "leader" and view["failovers"] == 1
+    assert view["lease_epoch"] == lease.epoch
+    old = tri.engines["a"].health()["cluster"]
+    assert old["role"] == "follower" and old["following"] == "b"
+
+
+def test_orchestrator_backs_off_on_not_promotable_then_promotes(tmp_path):
+    # the lease can land on a node whose bootstrap snapshot hasn't: promote()
+    # refuses (NotPromotableError), and the orchestrator must keep the lease,
+    # back off, and finish the promotion once the snapshot arrives
+    clock = ManualClock(0.0)
+    store = FakeCoordStore(clock=clock)
+    links = {}
+
+    def link(src, dst):
+        return links.setdefault((src, dst), LoopbackLink())
+
+    follower = StreamingEngine(
+        SumMetric(),
+        replication=ReplConfig(
+            role="follower",
+            transport=link("a", "b"),
+            poll_interval_s=0.01,
+            promote_checkpoint=CheckpointConfig(directory=str(tmp_path / "b")),
+        ),
+    )
+    node = ClusterNode(
+        follower,
+        ClusterConfig(
+            node_id="b", peers=("a",), store=store, link_factory=link, rng_seed=11
+        ),
+        start=False,
+    )
+    primary = None
+    try:
+        lease = store.acquire_lease("b", 100.0)  # the lease lands before the data
+        node.tick()
+        assert isinstance(node.last_error, NotPromotableError)
+        assert node.role == "follower"
+        assert node._lease is not None  # kept: releasing would help nobody
+        assert node._next_attempt > clock()  # backed off
+        node.tick()  # inside the backoff window: no second promote attempt
+        assert node.role == "follower"
+
+        # the missing primary appears and ships the bootstrap snapshot
+        primary = StreamingEngine(
+            SumMetric(),
+            checkpoint=CheckpointConfig(directory=str(tmp_path / "a"), wal_flush="fsync"),
+            replication=ReplConfig(
+                role="primary", transport=link("a", "b"), ship_interval_s=0.01
+            ),
+        )
+        primary.submit("k", np.array([7.0]))
+        primary.flush()
+        assert follower._applier.await_seq(primary._wal_seq, timeout_s=15)
+
+        clock.advance(5.0)  # past the promote backoff (and within the lease)
+        node.tick()
+        assert node.role == "leader"
+        assert node.failovers == 1
+        assert not follower._repl_follower
+        assert follower._repl_epoch == lease.epoch
+        assert float(follower.compute("k")) == 7.0
+    finally:
+        node.close(release=False)
+        follower.close()
+        if primary is not None:
+            primary.close()
+
+
+def test_partitioned_leader_steps_down_to_read_only(tri):
+    # a leader that cannot reach the store past its own lease deadline must
+    # assume a successor exists and stop taking writes — demote(None): no
+    # successor link to attach to yet, just the read-only refusal
+    tri.form()
+    tri.feed("a", range(3))
+    tri.wait_caught_up("b", "a")
+    tri.store.partition("a")
+    tri.clock.advance(4.0)  # past its own deadline
+    tri.nodes["a"].tick()
+    assert tri.nodes["a"].role == "follower"
+    assert tri.engines["a"]._repl_follower
+    assert tri.engines["a"].health()["cluster"]["lease_epoch"] is None
+    with pytest.raises(NotPrimaryError):
+        tri.engines["a"].submit("k", np.array([1.0]))

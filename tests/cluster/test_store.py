@@ -1,0 +1,192 @@
+"""CoordStore contract: CAS-with-TTL leases, renewal, release, membership —
+both backends (in-memory fake, shared directory)."""
+
+import os
+import threading
+
+import pytest
+
+from metrics_tpu.cluster import (
+    ClusterConfigError,
+    CoordStoreError,
+    DirectoryCoordStore,
+    FakeCoordStore,
+    Lease,
+    ManualClock,
+    Member,
+)
+
+
+def _member(node, **kw):
+    defaults = dict(role="follower", health="SERVING", bootstrapped=True, lag_seqs=0, heartbeat=0.0)
+    defaults.update(kw)
+    return Member(node_id=node, **defaults)
+
+
+# ---------------------------------------------------------------- fake backend
+
+
+class TestFakeCoordStore:
+    def test_first_grant_and_contention(self):
+        clock = ManualClock(100.0)
+        store = FakeCoordStore(clock=clock)
+        assert store.read_lease() is None
+        won = store.acquire_lease("a", 5.0)
+        assert won == Lease("a", 1, 105.0)
+        assert store.acquire_lease("b", 5.0) is None  # unexpired: CAS refuses
+        assert store.read_lease() == won
+
+    def test_renewal_keeps_epoch_extends_deadline(self):
+        clock = ManualClock(0.0)
+        store = FakeCoordStore(clock=clock)
+        first = store.acquire_lease("a", 5.0)
+        clock.advance(2.0)
+        renewed = store.acquire_lease("a", 5.0)
+        assert renewed.epoch == first.epoch
+        assert renewed.deadline == 7.0
+
+    def test_expiry_hands_over_at_bumped_epoch(self):
+        clock = ManualClock(0.0)
+        store = FakeCoordStore(clock=clock)
+        store.acquire_lease("a", 5.0)
+        clock.advance(5.0)  # deadline inclusive: now >= deadline is expired
+        won = store.acquire_lease("b", 5.0)
+        assert won.holder == "b" and won.epoch == 2
+
+    def test_renewal_never_resurrects_an_expired_lease(self):
+        # an expired holder re-acquiring goes through the fair CAS: new epoch,
+        # not a quiet same-epoch extension that could race a peer's fresh grant
+        clock = ManualClock(0.0)
+        store = FakeCoordStore(clock=clock)
+        store.acquire_lease("a", 5.0)
+        clock.advance(10.0)
+        again = store.acquire_lease("a", 5.0)
+        assert again.epoch == 2
+
+    def test_epoch_floor_aligns_first_grant(self):
+        store = FakeCoordStore(clock=ManualClock(0.0))
+        assert store.acquire_lease("a", 5.0, epoch_floor=7).epoch == 7
+
+    def test_release_expires_now(self):
+        clock = ManualClock(0.0)
+        store = FakeCoordStore(clock=clock)
+        store.acquire_lease("a", 5.0)
+        store.release_lease("a")
+        lease = store.read_lease()
+        assert lease.expired(store.now())
+        assert store.acquire_lease("b", 5.0).epoch == 2  # immediate handover
+
+    def test_release_by_non_holder_is_a_noop(self):
+        clock = ManualClock(0.0)
+        store = FakeCoordStore(clock=clock)
+        store.acquire_lease("a", 5.0)
+        store.release_lease("b")
+        assert not store.read_lease().expired(store.now())
+
+    def test_zero_ttl_rejected(self):
+        store = FakeCoordStore(clock=ManualClock(0.0))
+        with pytest.raises(ClusterConfigError):
+            store.acquire_lease("a", 0.0)
+
+    def test_partition_raises_heal_restores(self):
+        clock = ManualClock(0.0)
+        store = FakeCoordStore(clock=clock)
+        store.partition("a")
+        with pytest.raises(CoordStoreError):
+            store.acquire_lease("a", 5.0)
+        with pytest.raises(CoordStoreError):
+            store.heartbeat(_member("a"))
+        # everyone else is still served: that's the split the safety test races
+        assert store.acquire_lease("b", 5.0) is not None
+        store.heal("a")
+        store.heartbeat(_member("a"))
+        assert "a" in store.members()
+
+    def test_membership_roundtrip(self):
+        store = FakeCoordStore(clock=ManualClock(0.0))
+        store.heartbeat(_member("a", role="leader", lag_seqs=-1))
+        store.heartbeat(_member("b", heartbeat=3.0))
+        members = store.members()
+        assert set(members) == {"a", "b"}
+        assert members["a"].role == "leader" and members["a"].lag_seqs == -1
+        assert members["b"].heartbeat == 3.0
+
+
+# ----------------------------------------------------------- directory backend
+
+
+class TestDirectoryCoordStore:
+    def test_grant_contend_renew_cross_instance(self, tmp_path):
+        s1 = DirectoryCoordStore(str(tmp_path))
+        s2 = DirectoryCoordStore(str(tmp_path))  # second process, same directory
+        won = s1.acquire_lease("a", 30.0)
+        assert won.holder == "a" and won.epoch == 1
+        assert s2.acquire_lease("b", 30.0) is None
+        seen = s2.read_lease()
+        assert seen.holder == "a" and seen.epoch == 1
+        renewed = s1.acquire_lease("a", 30.0)
+        assert renewed.epoch == 1
+        assert s2.read_lease().deadline >= seen.deadline
+
+    def test_release_hands_over_immediately(self, tmp_path):
+        s1 = DirectoryCoordStore(str(tmp_path))
+        s2 = DirectoryCoordStore(str(tmp_path))
+        s1.acquire_lease("a", 30.0)
+        s1.release_lease("a")
+        assert s2.read_lease().expired(s2.now())
+        assert s2.acquire_lease("b", 30.0).epoch == 2
+
+    def test_epoch_floor(self, tmp_path):
+        store = DirectoryCoordStore(str(tmp_path))
+        assert store.acquire_lease("a", 30.0, epoch_floor=9).epoch == 9
+
+    def test_torn_lease_record_is_skipped(self, tmp_path):
+        store = DirectoryCoordStore(str(tmp_path))
+        store.acquire_lease("a", 30.0)
+        # a corrupt higher-epoch file (crashed foreign writer) must not wedge
+        # or depose the valid grant below it
+        with open(os.path.join(str(tmp_path), "lease-000000000009.rec"), "wb") as f:
+            f.write(b"\xff\xfftorn")
+        lease = store.read_lease()
+        assert lease.holder == "a" and lease.epoch == 1
+
+    def test_cas_race_exactly_one_winner(self, tmp_path):
+        stores = [DirectoryCoordStore(str(tmp_path)) for _ in range(8)]
+        barrier = threading.Barrier(8)
+        wins = []
+
+        def race(i):
+            barrier.wait()
+            got = stores[i].acquire_lease(f"n{i}", 30.0)
+            if got is not None:
+                wins.append(got)
+
+        threads = [threading.Thread(target=race, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(wins) == 1
+        assert stores[0].read_lease().holder == wins[0].holder
+
+    def test_membership_roundtrip(self, tmp_path):
+        s1 = DirectoryCoordStore(str(tmp_path))
+        s2 = DirectoryCoordStore(str(tmp_path))
+        s1.heartbeat(_member("a", role="leader", heartbeat=s1.now()))
+        s2.heartbeat(_member("b", bootstrapped=False, lag_seqs=-1, heartbeat=s2.now()))
+        members = s1.members()
+        assert set(members) == {"a", "b"}
+        assert members["b"].bootstrapped is False and members["b"].lag_seqs == -1
+
+    def test_concession_to_concurrent_higher_epoch(self, tmp_path, monkeypatch):
+        # floors make CAS targets non-adjacent: a candidate whose scan raced a
+        # concurrently-committed HIGHER live grant links its lower epoch file
+        # successfully, then must concede on the post-link re-scan
+        s1 = DirectoryCoordStore(str(tmp_path))
+        s2 = DirectoryCoordStore(str(tmp_path))
+        monkeypatch.setattr(s1, "read_lease", lambda: None)  # stale pre-link scan
+        assert s2.acquire_lease("b", 30.0, epoch_floor=5).epoch == 5
+        assert s1.acquire_lease("a", 30.0) is None  # linked lease-1, conceded
+        monkeypatch.undo()
+        lease = s1.read_lease()
+        assert lease.holder == "b" and lease.epoch == 5

@@ -1,0 +1,165 @@
+"""Golden structural contract for ``StreamingEngine.health()``: dashboards and
+the ops runbook key off these exact shapes, so a key appearing, vanishing, or
+changing type is an API break — this test is the tripwire."""
+
+import numpy as np
+import pytest
+
+from metrics_tpu.aggregation import SumMetric
+from metrics_tpu.cluster import ClusterConfig, ClusterNode, FakeCoordStore, ManualClock
+from metrics_tpu.engine import CheckpointConfig, ReplConfig, StreamingEngine
+from metrics_tpu.repl import DirectoryTransport, LoopbackLink
+
+BASE_KEYS = {
+    "state",
+    "closed",
+    "worker_alive",
+    "worker_restarts",
+    "zombie_workers",
+    "queue_depth",
+    "shedding",
+    "wal_disabled",
+    "breakers",
+    "quarantined_tenants",
+}
+
+PRIMARY_REPL_KEYS = {
+    "role",
+    "epoch",
+    "shipped_seq",
+    "shipped_generation",
+    "fenced",
+    "ship_failures",
+    "ship_error",
+}
+
+FOLLOWER_REPL_KEYS = {
+    "role",
+    "epoch",
+    "applied_seq",
+    "known_seq",
+    "bootstrapped",
+    "apply_error",
+    "lag_seqs",
+    "lag_seconds",
+}
+
+CLUSTER_KEYS = {
+    "node_id",
+    "role",
+    "lease_epoch",
+    "lease_ttl_remaining_s",
+    "following",
+    "suspected_peers",
+    "failovers",
+    "lease_renewals",
+    "suspicions",
+}
+
+
+@pytest.fixture
+def engine():
+    eng = StreamingEngine(SumMetric())
+    yield eng
+    eng.close()
+
+
+def test_base_schema_serving(engine):
+    engine.submit("k", np.array([1.0]))
+    engine.flush()
+    out = engine.health()
+    assert set(out) == BASE_KEYS
+    assert out["state"] == "SERVING"
+    assert out["closed"] is False and out["worker_alive"] is True
+    assert isinstance(out["breakers"], dict)
+    assert isinstance(out["quarantined_tenants"], dict)
+
+
+def test_base_schema_is_stable_across_all_states(engine):
+    # the key set must not morph with the state machine: a dashboard built
+    # against SERVING keeps working through an incident
+    assert engine.health()["state"] == "SERVING"
+    engine._degraded = True
+    out = engine.health()
+    assert out["state"] == "DEGRADED" and set(out) == BASE_KEYS
+    engine._quarantined = True
+    out = engine.health()
+    assert out["state"] == "QUARANTINED" and set(out) == BASE_KEYS
+
+
+def test_replication_primary_section_with_spooling_transport(tmp_path):
+    eng = StreamingEngine(
+        SumMetric(),
+        checkpoint=CheckpointConfig(directory=str(tmp_path / "ckpt"), wal_flush="fsync"),
+        replication=ReplConfig(
+            role="primary",
+            transport=DirectoryTransport(str(tmp_path / "spool")),
+            ship_interval_s=0.01,
+        ),
+    )
+    try:
+        eng.submit("k", np.array([1.0]))
+        eng.flush()
+        out = eng.health()
+        assert set(out) == BASE_KEYS | {"replication"}
+        repl = out["replication"]
+        # a spooling transport surfaces its drop counter next to ship_failures
+        assert set(repl) == PRIMARY_REPL_KEYS | {"spool_dropped"}
+        assert repl["role"] == "primary"
+        assert repl["spool_dropped"] == 0 and repl["ship_failures"] == 0
+        assert repl["fenced"] is False
+    finally:
+        eng.close()
+
+
+def test_replication_primary_section_without_spool(tmp_path):
+    eng = StreamingEngine(
+        SumMetric(),
+        checkpoint=CheckpointConfig(directory=str(tmp_path / "ckpt"), wal_flush="fsync"),
+        replication=ReplConfig(role="primary", transport=LoopbackLink(), ship_interval_s=0.01),
+    )
+    try:
+        repl = eng.health()["replication"]
+        # no spool, no counter: absent beats a forever-zero lie
+        assert set(repl) == PRIMARY_REPL_KEYS
+    finally:
+        eng.close()
+
+
+def test_replication_follower_section():
+    eng = StreamingEngine(
+        SumMetric(),
+        replication=ReplConfig(role="follower", transport=LoopbackLink(), poll_interval_s=0.01),
+    )
+    try:
+        out = eng.health()
+        repl = out["replication"]
+        assert set(repl) == FOLLOWER_REPL_KEYS
+        assert repl["role"] == "follower"
+        assert isinstance(repl["lag_seqs"], int)
+        assert isinstance(repl["lag_seconds"], float)
+    finally:
+        eng.close()
+
+
+def test_cluster_section():
+    eng = StreamingEngine(
+        SumMetric(),
+        replication=ReplConfig(role="follower", transport=LoopbackLink(), poll_interval_s=0.01),
+    )
+    store = FakeCoordStore(clock=ManualClock(0.0))
+    node = ClusterNode(
+        eng,
+        ClusterConfig(node_id="n1", store=store, peers=("n2",), rng_seed=5),
+        start=False,
+    )
+    try:
+        node.tick()
+        out = eng.health()
+        assert set(out) == BASE_KEYS | {"replication", "cluster"}
+        view = out["cluster"]
+        assert set(view) == CLUSTER_KEYS
+        assert view["node_id"] == "n1" and view["role"] == "follower"
+    finally:
+        node.close(release=False)
+        eng.close()

@@ -1,0 +1,95 @@
+"""``NotPromotableError``: the dedicated, retryable refusal for promoting a
+follower that never received its bootstrap snapshot — and the guard failover
+hook's back-off-and-retry loop built on top of it."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from metrics_tpu.aggregation import SumMetric
+from metrics_tpu.engine import CheckpointConfig, ReplConfig, StreamingEngine
+from metrics_tpu.repl import LoopbackLink, NotPromotableError, failover_hook
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+
+def _follower(link, tmp_path):
+    return StreamingEngine(
+        SumMetric(),
+        replication=ReplConfig(
+            role="follower",
+            transport=link,
+            poll_interval_s=0.01,
+            promote_checkpoint=CheckpointConfig(directory=str(tmp_path / "promoted")),
+        ),
+    )
+
+
+def _primary(link, tmp_path):
+    return StreamingEngine(
+        SumMetric(),
+        checkpoint=CheckpointConfig(directory=str(tmp_path / "primary"), wal_flush="fsync"),
+        replication=ReplConfig(role="primary", transport=link, ship_interval_s=0.01),
+    )
+
+
+def test_unbootstrapped_promote_raises_dedicated_retryable_error(tmp_path):
+    follower = _follower(LoopbackLink(), tmp_path)
+    try:
+        with pytest.raises(NotPromotableError):
+            follower.promote()
+        # a dedicated subclass, not a generic refusal: automation catches THIS
+        assert issubclass(NotPromotableError, MetricsTPUUserError)
+        # the engine is untouched by the refused attempt
+        assert follower._repl_follower
+        assert follower._applier is not None
+    finally:
+        follower.close()
+
+
+def test_hook_retries_until_bootstrap_lands_then_promotes(tmp_path):
+    # the real failover sequence with an unlucky start: the hook fires while
+    # the bootstrap snapshot is still in flight, retries on NotPromotableError,
+    # and completes the promotion once it lands — no operator involved
+    link = LoopbackLink()
+    follower = _follower(link, tmp_path)
+    primary = None
+    hook = failover_hook(follower, retries=200, backoff_s=0.01, backoff_cap_s=0.05)
+    try:
+        worker = threading.Thread(target=hook, args=("SERVING", "QUARANTINED"))
+        worker.start()
+        time.sleep(0.1)  # a few refused attempts happen first
+        assert follower._repl_follower  # still retrying, not promoted
+        primary = _primary(link, tmp_path)  # its bootstrap snapshot unblocks the hook
+        worker.join(timeout=15)
+        assert not worker.is_alive()
+        assert not follower._repl_follower  # the retry loop finished the job
+        follower.submit("k", np.array([5.0]))
+        follower.flush()
+        assert float(follower.compute("k")) == 5.0
+    finally:
+        if primary is not None:
+            primary.close()
+        follower.close()
+
+
+def test_hook_gives_up_quietly_when_retries_exhausted(tmp_path):
+    follower = _follower(LoopbackLink(), tmp_path)
+    hook = failover_hook(follower, retries=3, backoff_s=0.001)
+    try:
+        hook("SERVING", "QUARANTINED")  # must not raise into health()
+        assert follower._repl_follower  # gave up, still a follower
+    finally:
+        follower.close()
+
+
+def test_hook_fires_only_on_the_configured_edge(tmp_path):
+    follower = _follower(LoopbackLink(), tmp_path)
+    hook = failover_hook(follower, retries=0)
+    try:
+        hook("SERVING", "DEGRADED")  # wrong target state: no attempt
+        hook("QUARANTINED", "QUARANTINED")  # no edge: no attempt
+        assert follower._repl_follower
+    finally:
+        follower.close()

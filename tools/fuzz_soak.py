@@ -1663,6 +1663,204 @@ def soak_sketch(seeds) -> None:
                     engine.close(checkpoint=False)
 
 
+# ---------------------------------------------------------------------- cluster surface
+
+
+def _cluster_links(dirpath):
+    """Shared link factory: one directory spool per ordered (src, dst) pair —
+    the cross-process edition of the tests' memoized LoopbackLinks."""
+    from metrics_tpu.repl import DirectoryTransport
+
+    def link(src, dst):
+        return DirectoryTransport(os.path.join(dirpath, f"spool-{src}-{dst}"), durable=False)
+
+    return link
+
+
+def _cluster_node_cfg(name, dirpath, link, seed):
+    from metrics_tpu.cluster import ClusterConfig, DirectoryCoordStore
+
+    return ClusterConfig(
+        node_id=name,
+        peers=tuple(p for p in ("a", "b", "c") if p != name),
+        store=DirectoryCoordStore(os.path.join(dirpath, "coord"), durable=False),
+        link_factory=link,
+        lease_ttl_s=1.0,
+        heartbeat_interval_s=0.2,
+        suspect_after_s=0.8,
+        confirm_after_s=2.5,
+        tick_interval_s=0.05,
+        election_backoff_s=0.1,
+        rng_seed=seed + ord(name),
+    )
+
+
+def cluster_crash_child(dirpath, seed):
+    """Child half of the cluster SIGKILL surface: node 'a' — a durable primary
+    supervised by a ClusterNode that acquires the lease and aligns the fencing
+    epoch — submits the deterministic stream until the parent kills it."""
+    import time as _time
+
+    from metrics_tpu.classification import BinaryAccuracy
+    from metrics_tpu.cluster import ClusterNode
+    from metrics_tpu.engine import CheckpointConfig, ReplConfig, StreamingEngine
+    from metrics_tpu.repl import FanoutTransport
+
+    stream = _ckpt_engine_stream(seed)
+    link = _cluster_links(dirpath)
+    engine = StreamingEngine(
+        BinaryAccuracy(), buckets=(8, 32),
+        checkpoint=CheckpointConfig(directory=os.path.join(dirpath, "ckpt-a"),
+                                    interval_s=0.05, retain=3, durable=True,
+                                    wal_flush="fsync"),
+        replication=ReplConfig(role="primary",
+                               transport=FanoutTransport([link("a", "b"), link("a", "c")]),
+                               ship_interval_s=0.01, heartbeat_interval_s=0.1),
+    )
+    node = ClusterNode(engine, _cluster_node_cfg("a", dirpath, link, seed))
+    # a primary's node starts with role "leader"; what matters is the lease —
+    # the survivors must see "a" on record before the parent is told READY
+    deadline = _time.monotonic() + 30.0
+    while node._lease is None and _time.monotonic() < deadline:
+        _time.sleep(0.02)
+    print("READY" if node._lease is not None else "NOLEASE", flush=True)
+    while True:  # cycle until killed
+        for key, p, t in stream:
+            engine.submit(key, jnp.asarray(p), jnp.asarray(t))
+
+
+def soak_cluster(seeds) -> None:
+    """Cluster control-plane soak (ISSUE 10): a 3-node DirectoryCoordStore
+    cluster whose leader (a child process) is SIGKILLed mid-stream — possibly
+    mid-write, mid-ship, mid-lease-renewal. The surviving supervisors must
+    converge on EXACTLY ONE writable leader with NO manual promote() anywhere
+    (at most one writable engine at every observation on the way), the lease
+    must name the winner at the shipping epoch, the loser must re-attach to
+    the winner's link, and the winner's state must be an exactly-once
+    order-preserving prefix of the child's deterministic stream
+    (`_update_count` twin verification). Self-oracled — needs no reference
+    checkout."""
+    import signal
+    import subprocess
+    import tempfile
+    import time as _time
+
+    from metrics_tpu.classification import BinaryAccuracy
+    from metrics_tpu.cluster import ClusterNode, DirectoryCoordStore
+    from metrics_tpu.engine import CheckpointConfig, ReplConfig, StreamingEngine
+
+    for seed in seeds:
+        tag = f"cluster/failover seed={seed}"
+        with tempfile.TemporaryDirectory() as d:
+            link = _cluster_links(d)
+            child = subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__), "--cluster-child", d, str(seed)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            )
+            engines: dict = {}
+            nodes: dict = {}
+            try:
+                line = child.stdout.readline()
+                if "READY" not in line:
+                    err = child.stderr.read()[:200]
+                    FAILS.append((seed, tag, f"child failed to lead: {line!r} {err!r}"))
+                    continue
+                for name in ("b", "c"):
+                    engines[name] = StreamingEngine(
+                        BinaryAccuracy(), buckets=(8, 32),
+                        replication=ReplConfig(
+                            role="follower", transport=link("a", name), poll_interval_s=0.01,
+                            promote_checkpoint=CheckpointConfig(
+                                directory=os.path.join(d, f"promoted-{name}"),
+                                interval_s=0.1, durable=False),
+                        ),
+                    )
+                    nodes[name] = ClusterNode(engines[name], _cluster_node_cfg(name, d, link, seed))
+                # both survivors must bootstrap off the leader's spool before
+                # the kill, or there is nothing to fail over to
+                deadline = _time.monotonic() + 30.0
+                while _time.monotonic() < deadline and not all(
+                    engines[n]._applier is not None and engines[n]._applier.bootstrapped
+                    for n in ("b", "c")
+                ):
+                    _time.sleep(0.05)
+                if not all(
+                    engines[n]._applier is not None and engines[n]._applier.bootstrapped
+                    for n in ("b", "c")
+                ):
+                    FAILS.append((seed, tag, "survivors never bootstrapped off the leader"))
+                    continue
+                rng = np.random.default_rng(seed ^ 0xC1F5)
+                _time.sleep(float(rng.uniform(0.2, 0.8)))
+                child.send_signal(signal.SIGKILL)
+                child.wait(timeout=30)
+
+                # self-driving failover: NO promote() call appears anywhere in
+                # this parent — the supervisors must do the whole job, and at
+                # most one engine may be writable at every observation
+                winner = None
+                deadline = _time.monotonic() + 30.0
+                while _time.monotonic() < deadline:
+                    writable = [n for n in ("b", "c") if not engines[n]._repl_follower]
+                    if len(writable) > 1:
+                        FAILS.append((seed, tag, f"TWO writable leaders: {writable}"))
+                        break
+                    if writable:
+                        winner = writable[0]
+                        break
+                    _time.sleep(0.05)
+                if winner is None:
+                    FAILS.append((seed, tag, "survivors never elected a leader"))
+                    continue
+                loser = "c" if winner == "b" else "b"
+                # convergence: the lease names the winner at the shipping
+                # epoch, and the loser follows the winner's link
+                store = DirectoryCoordStore(os.path.join(d, "coord"), durable=False)
+                deadline = _time.monotonic() + 15.0
+                converged = False
+                while _time.monotonic() < deadline:
+                    lease = store.read_lease()
+                    if (
+                        lease is not None
+                        and lease.holder == winner
+                        and engines[winner]._repl_epoch == lease.epoch
+                        and nodes[loser]._following == winner
+                        and engines[loser]._repl_follower
+                    ):
+                        converged = True
+                        break
+                    _time.sleep(0.05)
+                if not converged:
+                    lease = store.read_lease()
+                    FAILS.append((seed, tag, f"no convergence: lease={lease} "
+                                  f"winner_epoch={engines[winner]._repl_epoch} "
+                                  f"loser_following={nodes[loser]._following}"))
+                # still exactly one writable after the dust settles
+                writable = [n for n in ("b", "c") if not engines[n]._repl_follower]
+                if writable != [winner]:
+                    FAILS.append((seed, tag, f"writable set drifted: {writable}"))
+                # the winner's state is an exactly-once order-preserving
+                # prefix of the child's stream (the `_update_count` twin)
+                _verify_repl_prefix(engines[winner], _ckpt_engine_stream(seed), seed, tag)
+                # ...and it genuinely serves writes on the new lineage
+                try:
+                    engines[winner].submit("probe", jnp.asarray([1]), jnp.asarray([1]))
+                    engines[winner].flush()
+                    float(engines[winner].compute("probe"))
+                except Exception as exc:  # noqa: BLE001
+                    FAILS.append((seed, tag, f"winner refused a probe write: {repr(exc)[:120]}"))
+            except Exception as exc:  # noqa: BLE001 — record crash seeds, keep soaking
+                FAILS.append((seed, tag, "surface raised: " + repr(exc)[:160]))
+            finally:
+                if child.poll() is None:
+                    child.kill()
+                    child.wait(timeout=30)
+                for node in nodes.values():
+                    node.close(release=False)
+                for engine in engines.values():
+                    engine.close(checkpoint=False)
+
+
 SURFACES = {
     "classification": soak_classification,
     "regression_retrieval": soak_regression_retrieval,
@@ -1678,12 +1876,15 @@ SURFACES = {
     "guard": soak_guard,
     "repl": soak_repl,
     "sketch": soak_sketch,
+    "cluster": soak_cluster,
 }
 
 # surfaces that execute the reference as their oracle (everything except the
-# self-oracled engine, ckpt crash-recovery, guard chaos, repl and sketch surfaces)
+# self-oracled engine, ckpt crash-recovery, guard chaos, repl, sketch and
+# cluster surfaces)
 _NEEDS_REF = {
-    name for name in SURFACES if name not in ("engine", "ckpt", "guard", "repl", "sketch")
+    name for name in SURFACES
+    if name not in ("engine", "ckpt", "guard", "repl", "sketch", "cluster")
 }
 
 
@@ -1697,6 +1898,8 @@ def main() -> None:
                         help="internal: run the repl shipping-primary child (killed by the parent)")
     parser.add_argument("--sketch-child", nargs=2, metavar=("DIR", "SEED"),
                         help="internal: run the sketch-serving engine child (killed by the parent)")
+    parser.add_argument("--cluster-child", nargs=2, metavar=("DIR", "SEED"),
+                        help="internal: run the cluster leader child (killed by the parent)")
     args = parser.parse_args()
 
     if args.ckpt_child is not None:
@@ -1710,6 +1913,10 @@ def main() -> None:
     if args.sketch_child is not None:
         dirpath, seed = args.sketch_child
         sketch_crash_child(dirpath, int(seed))
+        return
+    if args.cluster_child is not None:
+        dirpath, seed = args.cluster_child
+        cluster_crash_child(dirpath, int(seed))
         return
 
     start, stop = (int(x) for x in args.seeds.split(":"))
