@@ -144,6 +144,18 @@ def main() -> None:
                     "and failure detection on its own tick thread — adds <5%% to the "
                     "primary's write path vs the same unsupervised engine (paired "
                     "alternating runs, median pair ratio)")
+    ap.add_argument("--shard", action="store_true",
+                    help="shard-plane gates (ISSUE 11): (a) tenant-sharded parallel "
+                    "dispatch scales — 8 shards sustain >= --shard-speedup-floor x one "
+                    "shard's throughput on a skewed multi-tenant mix (paired alternating "
+                    "runs, median pair ratio); (b) the sharding layer itself is free: "
+                    "shards=1 loses <5%% vs the bare engine on the same mix; (c) "
+                    "per-tenant results stay bit-identical to the oracle")
+    ap.add_argument("--shard-speedup-floor", type=float, default=4.0,
+                    help="floor for the 8-shard-vs-1 median pair ratio. The default (4.0) "
+                    "is the ISSUE-11 acceptance bar and assumes >=8 usable cores; the "
+                    "ratio measures real core-level parallelism, so a constrained runner "
+                    "must lower it explicitly rather than the gate silently passing")
     ap.add_argument("--guard", action="store_true",
                     help="guard-plane gates (ISSUE 5): (a) well-behaved traffic with the "
                     "guard enabled loses <5%% throughput vs the plain pass; (b) under a "
@@ -738,6 +750,150 @@ def main() -> None:
              checks={"sketch_wire_ge_2x_cheaper": ok_wire,
                      "sketch_plan_no_ragged": True})
         if not (all(sk_checks.values()) and ok_wire):
+            sys.exit(1)
+
+    # ---------------- shard plane gates (ISSUE 11): (a) tenant-sharded dispatch
+    # scales — 8 shards over the device mesh sustain >= --shard-speedup-floor x
+    # ONE shard on a skewed multi-tenant mix (paired alternating runs, median
+    # pair ratio — PR 5 methodology); (b) the sharding layer is free where it
+    # can't help: shards=1 runs the identical submit path (stripe lock + ring
+    # lookup) and must lose <5% vs the bare engine; (c) results bit-identical.
+    if args.shard:
+        from metrics_tpu.shard import ShardConfig, ShardedEngine
+
+        sh_rng = np.random.default_rng(3)
+        sh_keys = 32
+        # skewed mix: 4 heavy tenants own ~75% of all rows (64-row requests),
+        # 8 mid tenants submit 8-row requests, 20 light tenants batch-1 — the
+        # single-dispatcher serialization regime sharding exists to break,
+        # while the heavies still land on distinct shards so the load is
+        # parallelizable
+        sh_stream = []
+        for _ in range(args.requests):
+            idx = int(sh_rng.integers(0, sh_keys))
+            rows = 64 if idx < 4 else (8 if idx < 12 else 1)
+            sh_stream.append((f"tenant-{idx}",
+                              jnp.asarray(sh_rng.integers(0, 2, rows)),
+                              jnp.asarray(sh_rng.integers(0, 2, rows))))
+
+        def _timed_shard_region(engine):
+            gc.collect()
+            gc.disable()
+            t0 = time.perf_counter()
+
+            def client(tid: int) -> None:
+                for i in range(tid, len(sh_stream), args.threads):
+                    key, p, t = sh_stream[i]
+                    engine.submit(key, p, t)
+
+            threads = [threading.Thread(target=client, args=(tid,))
+                       for tid in range(args.threads)]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+            engine.flush()
+            return len(sh_stream) / (time.perf_counter() - t0)
+
+        def _warm_shard_ladder(engine):
+            # cover the bucket ladder on EVERY shard's compile cache, and touch
+            # every tenant once so slot allocation is out of the timed region —
+            # the bare pass below runs the identical warmup for symmetry
+            for k in range(sh_keys):
+                engine.submit(f"tenant-{k}", jnp.asarray([1]), jnp.asarray([1]))
+            engine.flush()
+            for rows in buckets:
+                for k in range(sh_keys):
+                    engine.submit(f"tenant-{k}", jnp.asarray(sh_rng.integers(0, 2, rows)),
+                                  jnp.asarray(sh_rng.integers(0, 2, rows)))
+                engine.flush()  # per-rung: coalescing must not skip a bucket compile
+            engine.reset()
+
+        def sharded_pass(n_shards):
+            engine = ShardedEngine(BinaryAccuracy(), config=ShardConfig(shards=n_shards),
+                                   buckets=buckets, max_queue=2048, capacity=sh_keys)
+            try:
+                _warm_shard_ladder(engine)
+                return _timed_shard_region(engine)
+            finally:
+                gc.enable()
+                engine.close()
+
+        def bare_pass():
+            engine = StreamingEngine(BinaryAccuracy(), buckets=buckets,
+                                     max_queue=2048, capacity=sh_keys)
+            try:
+                _warm_shard_ladder(engine)
+                return _timed_shard_region(engine)
+            finally:
+                gc.enable()
+                engine.close()
+
+        pair_ratios = []
+        one_best = eight_best = 0.0
+        for i in range(6):
+            if i % 2 == 0:
+                one = sharded_pass(1)
+                eight = sharded_pass(8)
+            else:
+                eight = sharded_pass(8)
+                one = sharded_pass(1)
+            pair_ratios.append(eight / one)
+            one_best, eight_best = max(one_best, one), max(eight_best, eight)
+        scale = float(np.median(pair_ratios))
+        ok_scale = scale >= args.shard_speedup_floor
+        emit("shard 8-way dispatch speedup", scale, "x",
+             one_shard_rps=round(one_best, 1), eight_shard_rps=round(eight_best, 1),
+             pair_ratios=[round(r, 4) for r in pair_ratios],
+             floor=args.shard_speedup_floor,
+             config={"metric": "BinaryAccuracy", "n": len(sh_stream),
+                     "threads": args.threads, "keys": sh_keys},
+             checks={"eight_shards_ge_floor_x_one": ok_scale})
+
+        over_ratios = []
+        bare_best = s1_best = 0.0
+        for i in range(6):
+            if i % 2 == 0:
+                b = bare_pass()
+                s1 = sharded_pass(1)
+            else:
+                s1 = sharded_pass(1)
+                b = bare_pass()
+            over_ratios.append(b / s1)
+            bare_best, s1_best = max(bare_best, b), max(s1_best, s1)
+        sh_overhead = float(np.median(over_ratios)) - 1.0
+        ok_sh_overhead = sh_overhead < 0.05
+        emit("shard layer overhead at shards=1", sh_overhead * 100.0, "%",
+             bare_rps=round(bare_best, 1), one_shard_rps=round(s1_best, 1),
+             pair_ratios=[round(r, 4) for r in over_ratios],
+             checks={"shard1_overhead_lt_5pct": ok_sh_overhead})
+
+        # ---- acceptance: per-tenant results across the 8-shard mesh must be
+        # bit-identical to the single-threaded oracle, with every request
+        # accounted for
+        verify = ShardedEngine(BinaryAccuracy(), config=ShardConfig(shards=8),
+                               buckets=buckets, max_queue=2048, capacity=sh_keys)
+        try:
+            for key, p, t in sh_stream:
+                verify.submit(key, p, t)
+            verify.flush()
+            sh_oracles = {}
+            for key, p, t in sh_stream:
+                sh_oracles.setdefault(key, BinaryAccuracy()).update(p, t)
+            sh_mismatches = [
+                key for key, oracle in sh_oracles.items()
+                if float(verify.compute(key)) != float(oracle.compute())
+            ]
+            processed_ok = verify.telemetry_snapshot()["processed"] == len(sh_stream)
+        finally:
+            verify.close()
+        sh_checks = {
+            "bit_identical_to_oracle": not sh_mismatches,
+            "all_requests_processed": processed_ok,
+        }
+        emit("shard acceptance", float(all(sh_checks.values())), "bool",
+             checks=sh_checks, mismatched_keys=sh_mismatches[:4])
+        if not (ok_scale and ok_sh_overhead and all(sh_checks.values())):
             sys.exit(1)
 
     # ---------------- guard plane gates (ISSUE 5): (a) the admission/fairness

@@ -338,6 +338,8 @@ class StreamingEngine:
         checkpoint: Optional[CheckpointConfig] = None,
         guard: Optional[GuardConfig] = None,
         replication: Optional[ReplConfig] = None,
+        device: Optional[Any] = None,
+        telemetry_labels: Optional[Dict[str, str]] = None,
         start: bool = True,
     ) -> None:
         if not isinstance(metric_or_collection, (Metric, MetricCollection)):
@@ -383,7 +385,13 @@ class StreamingEngine:
         self._max_queue = int(max_queue)
         self._policy = policy
         self._submit_timeout = float(submit_timeout)
-        self.telemetry = EngineTelemetry(latency_window=telemetry_window)
+        self.telemetry = EngineTelemetry(
+            latency_window=telemetry_window, labels=telemetry_labels
+        )
+        # optional device pin: every stacked leaf is committed here, so jit
+        # dispatches follow it — the shard plane places one engine per mesh
+        # device to get true multi-device parallelism
+        self._device = device
 
         # Fused eligibility is structural: every component metric must hold only
         # fixed-shape array states (ragged "cat" lists cannot stack along a key axis)
@@ -395,7 +403,7 @@ class StreamingEngine:
             for m in _component_metrics(self._metric)
         )
         self._keyed = (
-            KeyedState(self._metric, capacity=capacity, window=window)
+            KeyedState(self._metric, capacity=capacity, window=window, device=device)
             if self._fused
             else EagerKeyedState(self._metric, window=window)
         )
@@ -1348,7 +1356,10 @@ class StreamingEngine:
         if tree["mode"] == "fused":
             if not isinstance(self._keyed, KeyedState):
                 raise ValueError("fused snapshot but the live engine serves eagerly")
-            keyed = KeyedState(self._metric, capacity=tree["capacity"], window=self._window)
+            keyed = KeyedState(
+                self._metric, capacity=tree["capacity"], window=self._window,
+                device=self._device,
+            )
             keyed.capacity = int(tree["capacity"])
             keyed.stacked = jax.tree.map(jnp.asarray, tree["stacked"])
             keyed._slots = dict(tree["slots"])
@@ -1427,6 +1438,7 @@ class StreamingEngine:
             max_id = int(key_ids.max()) + 1 if len(key_ids) else 0
             if keyed.ensure_capacity(min_slots=max_id):
                 self.telemetry.count("key_growths")
+                self.telemetry.observe_resize(keyed.last_resize_s)
             try:
                 kernel = self._get_kernel(
                     self._chunk_signature(columns), int(len(key_ids)), keyed.capacity
@@ -1567,7 +1579,8 @@ class StreamingEngine:
         with self._dispatch_lock:
             if isinstance(self._keyed, KeyedState):
                 self._keyed = KeyedState(
-                    self._metric, capacity=self._keyed.capacity, window=self._window
+                    self._metric, capacity=self._keyed.capacity, window=self._window,
+                    device=self._device,
                 )
             else:
                 self._keyed = EagerKeyedState(self._metric, window=self._window)
@@ -2027,6 +2040,7 @@ class StreamingEngine:
             self._check_epoch(epoch)
             if self._keyed.ensure_capacity():
                 self.telemetry.count("key_growths")
+                self.telemetry.observe_resize(self._keyed.last_resize_s)
             for signature, reqs in self._signature_groups(batch):
                 self._dispatch_group(signature, reqs)
 

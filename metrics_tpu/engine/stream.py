@@ -18,14 +18,33 @@ the ring's maxlen — no timestamps, no per-row bookkeeping.
 
 from __future__ import annotations
 
+import time
 from collections import deque
-from typing import Any, Deque, Dict, Hashable, Optional, Tuple
+from functools import partial
+from typing import Any, Deque, Dict, Hashable, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+
+@partial(jax.jit, static_argnums=2, donate_argnums=0)
+def _grow_group(leaves: Tuple[Any, ...], inits: Tuple[Any, ...], pad_rows: int) -> Tuple[Any, ...]:
+    """Grow every leaf of one dtype group in ONE compiled dispatch.
+
+    The old leaves are donated: XLA frees (or reuses) each source buffer as its
+    concat completes, so a resize under load never holds two full copies of the
+    whole slab — peak transient memory is one dtype group, not the tree. The
+    init pads are broadcast inside the trace (free at the XLA level), not
+    materialised on the host. jit's own cache bounds compiles: capacity doubles
+    log₂(K) times and each (shapes, pad_rows) pair compiles once.
+    """
+    return tuple(
+        jnp.concatenate([leaf, jnp.broadcast_to(init, (pad_rows,) + init.shape)], axis=0)
+        for leaf, init in zip(leaves, inits)
+    )
 
 
 def _validate_window(window: Optional[int]) -> Optional[int]:
@@ -40,9 +59,33 @@ def _validate_window(window: Optional[int]) -> Optional[int]:
 class KeyedState:
     """Stacked per-key state for the fused dispatch path."""
 
-    def __init__(self, metric: Any, capacity: int = 8, window: Optional[int] = None) -> None:
+    def __init__(
+        self,
+        metric: Any,
+        capacity: int = 8,
+        window: Optional[int] = None,
+        device: Optional[Any] = None,
+    ) -> None:
         self._metric = metric
         self._init = metric.init_state()
+        self._device = device
+        # strong-typed init leaves, grouped by dtype ONCE: ensure_capacity's
+        # donated per-dtype-group grow and _tiled both read these (weak-typed
+        # scalar inits would make every post-grow dispatch a jit-cache miss)
+        init_leaves, self._treedef = jax.tree_util.tree_flatten(self._init)
+        self._init_leaves: List[Any] = []
+        for leaf in init_leaves:
+            arr = jnp.asarray(leaf)
+            arr = lax.convert_element_type(arr, arr.dtype)
+            if device is not None:
+                arr = jax.device_put(arr, device)
+            self._init_leaves.append(arr)
+        self._dtype_groups: List[List[int]] = []
+        by_dtype: Dict[Any, List[int]] = {}
+        for idx, leaf in enumerate(self._init_leaves):
+            by_dtype.setdefault(jnp.asarray(leaf).dtype, []).append(idx)
+        self._dtype_groups = list(by_dtype.values())
+        self.last_resize_s = 0.0  # wall time of the most recent capacity growth
         self.capacity = 1
         while self.capacity < max(1, int(capacity)):
             self.capacity *= 2
@@ -62,13 +105,12 @@ class KeyedState:
         # strong-typed leaves: scalar init values come in weak-typed, while the
         # kernel's outputs are strong-typed — mixing the two makes the first
         # dispatch after every reset/rotate a fresh jit-cache miss (a silent
-        # ~100ms XLA recompile per bucket)
-        def tile(x: Any) -> Any:
-            arr = jnp.asarray(x)
-            arr = lax.convert_element_type(arr, arr.dtype)
-            return jnp.broadcast_to(arr, (k,) + arr.shape)
-
-        return jax.tree.map(tile, self._init)
+        # ~100ms XLA recompile per bucket). The leaves were strong-typed (and
+        # committed to this shard's device, when one was given) at __init__.
+        return jax.tree_util.tree_unflatten(
+            self._treedef,
+            [jnp.broadcast_to(arr, (k,) + arr.shape) for arr in self._init_leaves],
+        )
 
     @property
     def keys(self) -> Tuple[Hashable, ...]:
@@ -119,9 +161,28 @@ class KeyedState:
         new_cap = self.capacity
         while new_cap < need:
             new_cap *= 2
-        pad = self._tiled(new_cap - self.capacity)
-        self.stacked = jax.tree.map(lambda s, p: jnp.concatenate([s, p], axis=0), self.stacked, pad)
+        # ONE donated-buffer concat dispatch per dtype group (not per leaf): the
+        # leaves of a group go through a single compiled call that pads each
+        # with broadcast init rows, so a resize under load costs one device
+        # dispatch per dtype instead of re-materialising the slab leaf-by-leaf.
+        t0 = time.perf_counter()
+        pad_rows = new_cap - self.capacity
+        leaves = jax.tree_util.tree_flatten(self.stacked)[0]
+        out = list(leaves)
+        for idxs in self._dtype_groups:
+            grown = _grow_group(
+                tuple(leaves[i] for i in idxs),
+                tuple(self._init_leaves[i] for i in idxs),
+                pad_rows,
+            )
+            for i, leaf in zip(idxs, grown):
+                out[i] = leaf
+        self.stacked = jax.tree_util.tree_unflatten(self._treedef, out)
+        # block for an honest wall-time figure (metrics_tpu_engine_resize_seconds);
+        # growth happens log₂(K) times per tenant population, so the sync is noise
+        jax.block_until_ready(self.stacked)
         self.capacity = new_cap
+        self.last_resize_s = time.perf_counter() - t0
         return True
 
     # ------------------------------------------------------------------ reads
@@ -139,6 +200,30 @@ class KeyedState:
         self.ensure_capacity()
         slot = self._slots[key]
         self.stacked = jax.tree.map(lambda s, n: s.at[slot].set(n), self.stacked, state)
+
+    def evict(self, key: Hashable) -> None:
+        """Drop a tenant's tenancy: forget its slot, scrub its live row to init.
+
+        The slot id stays burned — the watermark allocator never reuses ids
+        (WAL/ship replay installs ids positionally, and a reused id would share
+        one accumulator row between two tenants' journals). Ring segments are
+        NOT scrubbed: ring reads are slot-addressed through ``_slots``, so a
+        popped key's old rows are unreachable, and a re-registered key gets a
+        fresh slot above the watermark. Rebalance migration (metrics_tpu.shard)
+        is the caller: the tenant's state has already been copied out.
+        """
+        slot = self._slots.pop(key, None)
+        if slot is None or slot >= self.capacity:
+            return
+        self.stacked = jax.tree_util.tree_unflatten(
+            self._treedef,
+            [
+                leaf.at[slot].set(init)
+                for leaf, init in zip(
+                    jax.tree_util.tree_flatten(self.stacked)[0], self._init_leaves
+                )
+            ],
+        )
 
     # ------------------------------------------------------------------ windowing
 
@@ -180,6 +265,7 @@ class EagerKeyedState:
 
     def __init__(self, metric: Any, window: Optional[int] = None) -> None:
         self._metric = metric
+        self.last_resize_s = 0.0  # interface parity with KeyedState (never grows)
         self._states: Dict[Hashable, Any] = {}
         self.window = _validate_window(window)
         self._ring: Optional[Deque[Dict[Hashable, Any]]] = (
@@ -202,6 +288,16 @@ class EagerKeyedState:
 
     def set_state(self, key: Hashable, state: Any) -> None:
         self._states[key] = state
+
+    def evict(self, key: Hashable) -> None:
+        """Drop a tenant everywhere. Unlike the stacked regime (slot-addressed,
+        unreachable once the slot mapping is popped), eager ring segments are
+        KEY-addressed — a re-registered key would resurrect its old window
+        contributions, so the ring is scrubbed too."""
+        self._states.pop(key, None)
+        if self._ring is not None:
+            for seg in self._ring:
+                seg.pop(key, None)
 
     def update(self, key: Hashable, *args: Any) -> None:
         self._states[key] = self._metric.update_state(

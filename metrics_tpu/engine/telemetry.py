@@ -96,11 +96,19 @@ _ENGINE_IDS = itertools.count()
 class EngineTelemetry:
     """Registry-backed counters + histograms for one :class:`StreamingEngine`."""
 
-    def __init__(self, latency_window: int = 2048, registry: Optional[Registry] = None) -> None:
+    def __init__(
+        self,
+        latency_window: int = 2048,
+        registry: Optional[Registry] = None,
+        labels: Optional[Dict[str, str]] = None,
+    ) -> None:
         reg = registry if registry is not None else REGISTRY
         self._registry = reg
         self.engine_id = str(next(_ENGINE_IDS))
-        self._label = {"engine": self.engine_id}
+        # extra labels ride on EVERY series of this engine — the shard plane
+        # passes {"shard": "<i>"} so queue depth / occupancy / compiles are
+        # filterable per shard in one Prometheus scrape
+        self._label = {"engine": self.engine_id, **(labels or {})}
 
         self._events = reg.counter(
             "metrics_tpu_engine_events_total", "StreamingEngine request/dispatch lifecycle events."
@@ -118,6 +126,13 @@ class EngineTelemetry:
             "submit()→commit latency, backpressure stalls included.",
             buckets=_LATENCY_EDGES,
         )
+        self._resize_seconds = reg.counter(
+            "metrics_tpu_engine_resize_seconds",
+            "Cumulative wall time spent growing the stacked tenant slab "
+            "(capacity doublings: one donated concat dispatch per dtype group).",
+        )
+        self._resize_key = self._resize_seconds.label_key(**self._label)
+        self._resize_seconds.inc_key(self._resize_key, 0)
 
         # closed counter-name set, in declaration order (snapshot key order);
         # label identities are precomputed ONCE so the per-request hot path
@@ -177,6 +192,10 @@ class EngineTelemetry:
         )
         self._occupancy.observe_key(self._occupancy_key, frac)
 
+    def observe_resize(self, seconds: float) -> None:
+        """Add one slab-growth's wall time to ``metrics_tpu_engine_resize_seconds``."""
+        self._resize_seconds.inc_key(self._resize_key, float(seconds))
+
     def observe_latency(self, seconds: float) -> None:
         self._latency.observe_key(self._latency_key, seconds)
         with self._ring_lock:
@@ -195,6 +214,7 @@ class EngineTelemetry:
             name: int(events.get(self._event_keys[name], 0)) for name in self._allowed
         }
         out["queue_depth"] = int(self._depth.value(**self._label))
+        out["resize_seconds"] = float(self._resize_seconds.value(**self._label))
         occ = self._occupancy.bucket_counts(**self._label)
         out["batch_occupancy_hist"] = {f"<={edge}": occ[edge] for edge in _OCCUPANCY_EDGES}
         with self._ring_lock:
@@ -238,5 +258,6 @@ class EngineTelemetry:
         series. Recording after ``retire()`` is harmless: the series simply
         rematerialise.
         """
-        for inst in (self._events, self._depth, self._occupancy, self._latency):
+        for inst in (self._events, self._depth, self._occupancy, self._latency,
+                     self._resize_seconds):
             inst.drop_labels(**self._label)

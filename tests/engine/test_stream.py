@@ -158,3 +158,91 @@ def test_engine_reset_clears_all_tenants():
         assert float(engine.compute("a")) == 0.5
     finally:
         engine.close()
+
+
+# --------------------------------------------------- batched growth (ISSUE 11)
+
+
+def test_grow_batches_per_dtype_group_and_matches_per_leaf_reference():
+    """Mixed-dtype state (MSE float32 sums + int32 update count): the grouped
+    donated-concat growth must produce exactly what a per-leaf re-materialise
+    would — same values, same dtypes, init padding in the new rows."""
+    m = MeanSquaredError()
+    ks = KeyedState(m, capacity=2)
+    import jax
+
+    leaves_before = jax.tree_util.tree_flatten(ks.stacked)[0]
+    assert len({leaf.dtype for leaf in leaves_before}) >= 2  # really mixed dtypes
+    ks.slot_for("a")
+    ks.set_state("a", m.update_state(m.init_state(), jnp.asarray([1.0, 3.0]), jnp.asarray([0.0, 0.0])))
+    reference = {k: jax.device_get(ks.state_of(k)) for k in ks.keys}
+    for i in range(5):
+        ks.slot_for(f"extra-{i}")
+    assert ks.ensure_capacity() is True
+    assert ks.capacity == 8
+    # old rows bit-identical, new rows are init
+    got = jax.device_get(ks.state_of("a"))
+    for name in reference["a"]:
+        assert np.array_equal(np.asarray(got[name]), np.asarray(reference["a"][name])), name
+    init = jax.device_get(m.init_state())
+    fresh = jax.device_get(ks.state_of("extra-4"))
+    for name in init:
+        assert np.array_equal(np.asarray(fresh[name]), np.asarray(init[name])), name
+    # dtypes survive the grouped concat (weak-typing would recompile every kernel)
+    for leaf, before in zip(jax.tree_util.tree_flatten(ks.stacked)[0], leaves_before):
+        assert leaf.dtype == before.dtype
+        assert leaf.shape[0] == 8
+
+
+def test_grow_records_wall_time_and_engine_telemetry_counts_it():
+    m = BinaryAccuracy()
+    ks = KeyedState(m, capacity=1)
+    assert ks.last_resize_s == 0.0
+    ks.slot_for("a"); ks.slot_for("b")
+    assert ks.ensure_capacity()
+    assert ks.last_resize_s > 0.0
+
+    engine = StreamingEngine(BinaryAccuracy(), buckets=(8,), capacity=1)
+    try:
+        for i in range(4):
+            engine.submit(f"k{i}", jnp.asarray([1]), jnp.asarray([1]))
+        engine.flush()
+        snap = engine.telemetry_snapshot()
+        assert snap["key_growths"] >= 1
+        assert snap["resize_seconds"] > 0.0  # the new satellite counter
+    finally:
+        engine.close()
+
+
+def test_keyed_state_evict_scrubs_live_row_and_burns_slot():
+    m = BinaryAccuracy()
+    ks = KeyedState(m, capacity=4)
+    slot_a = ks.slot_for("a")
+    ks.set_state("a", m.update_state(m.init_state(), jnp.asarray([1]), jnp.asarray([1])))
+    ks.evict("a")
+    assert "a" not in ks.keys
+    # the row itself was scrubbed to init (no ghost contribution at this slot)
+    import jax
+
+    row = jax.tree_util.tree_map(lambda x: x[slot_a], ks.stacked)
+    assert int(row["tp"]) == 0 and int(row["_update_count"]) == 0
+    # re-registering allocates a FRESH slot: ids are never reused (WAL replay
+    # addresses rows by id — a reused id would share a row between journals)
+    assert ks.slot_for("a") != slot_a
+    ks.evict("never-registered")  # unknown key is a no-op
+
+
+def test_eager_keyed_state_evict_scrubs_window_ring():
+    from metrics_tpu.engine import EagerKeyedState
+
+    m = BinaryAUROC(thresholds=None)
+    ks = EagerKeyedState(m, window=3)
+    ks.slot_for("a")
+    ks.update("a", jnp.asarray([0.8, 0.2]), jnp.asarray([1, 0]))
+    ks.rotate()
+    ks.update("a", jnp.asarray([0.6]), jnp.asarray([1]))
+    ks.evict("a")
+    assert "a" not in ks.keys
+    # eager rings are key-addressed: a re-registered key must NOT resurrect old
+    # window contributions
+    assert all("a" not in seg for seg in ks._ring)

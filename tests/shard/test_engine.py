@@ -1,0 +1,210 @@
+"""ShardedEngine correctness: bit-identity to a single-engine oracle across
+shard counts, windowing, dispatcher death on one shard, and eager metrics.
+
+Single-thread submission order per tenant, so even float accumulation must be
+bit-identical (the sharded router changes WHERE a tenant's updates run, never
+their order or their arithmetic).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from metrics_tpu import MeanSquaredError
+from metrics_tpu.classification import BinaryAccuracy
+from metrics_tpu.engine import StreamingEngine
+from metrics_tpu.guard.faults import kill_dispatcher
+from metrics_tpu.shard import ShardConfig, ShardedEngine
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+
+def _traffic(rng, n_keys=16, n_requests=60, rows=8):
+    keys = [f"tenant-{i}" for i in range(n_keys)]
+    out = []
+    for _ in range(n_requests):
+        k = keys[int(rng.integers(n_keys))]
+        p = rng.integers(0, 2, size=rows).astype(np.float32)
+        t = rng.integers(0, 2, size=rows).astype(np.int32)
+        out.append((k, p, t))
+    return out
+
+
+def _drive(engine, traffic):
+    futures = [engine.submit(k, p, t) for k, p, t in traffic]
+    engine.flush()
+    # non-vacuity: every update must have COMMITTED (a dtype-rejected request
+    # would fail on both engines and make any parity check trivially true)
+    for fut in futures:
+        assert fut.exception(timeout=30) is None
+    return futures
+
+
+@pytest.mark.parametrize("shards", [1, 2, 4, 8])
+def test_bit_identical_to_single_engine_oracle(shards):
+    traffic = _traffic(np.random.default_rng(shards))
+    sharded = ShardedEngine(
+        BinaryAccuracy(), config=ShardConfig(shards=shards, place_on_mesh=False)
+    )
+    oracle = StreamingEngine(BinaryAccuracy())
+    try:
+        _drive(sharded, traffic)
+        _drive(oracle, traffic)
+        got, want = sharded.compute_all(), oracle.compute_all()
+        assert set(got) == set(want)
+        for key in want:
+            assert float(got[key]) == float(want[key]), key
+    finally:
+        sharded.close()
+        oracle.close()
+
+
+def test_float_metric_bit_identical():
+    """MSE carries float accumulation: same per-tenant order → same bits."""
+    rng = np.random.default_rng(7)
+    keys = [f"t{i}" for i in range(10)]
+    sharded = ShardedEngine(
+        MeanSquaredError(), config=ShardConfig(shards=4, place_on_mesh=False)
+    )
+    oracle = StreamingEngine(MeanSquaredError())
+    try:
+        for _ in range(40):
+            k = keys[int(rng.integers(len(keys)))]
+            p = rng.normal(size=8).astype(np.float32)
+            t = rng.normal(size=8).astype(np.float32)
+            sharded.submit(k, p, t)
+            oracle.submit(k, p, t)
+        sharded.flush(); oracle.flush()
+        got, want = sharded.compute_all(), oracle.compute_all()
+        for key in want:
+            assert np.float32(got[key]) == np.float32(want[key]), key
+    finally:
+        sharded.close()
+        oracle.close()
+
+
+def test_windowed_parity_through_rotations():
+    rng = np.random.default_rng(3)
+    sharded = ShardedEngine(
+        BinaryAccuracy(), config=ShardConfig(shards=4, place_on_mesh=False), window=3
+    )
+    oracle = StreamingEngine(BinaryAccuracy(), window=3)
+    try:
+        for _ in range(5):  # > window: oldest segments must expire identically
+            _drive(sharded, _traffic(rng, n_requests=20))
+            sharded.rotate_window()
+        # identical traffic for the oracle: replay the rng stream
+        rng = np.random.default_rng(3)
+        for _ in range(5):
+            _drive(oracle, _traffic(rng, n_requests=20))
+            oracle.rotate_window()
+        got = sharded.compute_all(window=True)
+        want = oracle.compute_all(window=True)
+        assert set(got) == set(want)
+        for key in want:
+            assert float(got[key]) == float(want[key]), key
+    finally:
+        sharded.close()
+        oracle.close()
+
+
+def test_one_shard_dispatcher_death_is_contained_and_replayed():
+    """Killing one shard's dispatcher mid-stream: that shard degrades to inline
+    (its worker-death ladder replays accepted work exactly-once), the OTHER
+    shards stay SERVING, and every tenant's result still matches the oracle."""
+    traffic = _traffic(np.random.default_rng(11), n_requests=80)
+    sharded = ShardedEngine(
+        BinaryAccuracy(), config=ShardConfig(shards=4, place_on_mesh=False)
+    )
+    oracle = StreamingEngine(BinaryAccuracy())
+    try:
+        half = len(traffic) // 2
+        for k, p, t in traffic[:half]:
+            sharded.submit(k, p, t)
+        sharded.flush()
+        kill_dispatcher(sharded.engines[1])
+        for k, p, t in traffic[half:]:
+            sharded.submit(k, p, t)
+        sharded.flush()
+        assert sharded.engines[1].degraded
+        assert not sharded.engines[0].degraded
+        assert sharded.health()["state"] == "DEGRADED"
+        _drive(oracle, traffic)
+        got, want = sharded.compute_all(), oracle.compute_all()
+        for key in want:
+            assert float(got[key]) == float(want[key]), key
+    finally:
+        sharded.close()
+        oracle.close()
+
+
+def test_eager_metric_shards_too():
+    """A ragged 'cat'-state metric (eager regime) shards identically — the
+    router is regime-agnostic."""
+    from metrics_tpu.classification import BinaryAUROC
+
+    sharded = ShardedEngine(
+        BinaryAUROC(thresholds=None), config=ShardConfig(shards=3, place_on_mesh=False)
+    )
+    oracle = StreamingEngine(BinaryAUROC(thresholds=None))
+    try:
+        assert not sharded.engines[0].fused  # list states → eager regime
+        rng = np.random.default_rng(5)
+        for _ in range(30):
+            k = f"t{int(rng.integers(8))}"
+            p = rng.random(5, dtype=np.float32)
+            t = rng.integers(0, 2, 5).astype(np.int32)
+            sharded.submit(k, p, t)
+            oracle.submit(k, p, t)
+        sharded.flush(); oracle.flush()
+        got, want = sharded.compute_all(), oracle.compute_all()
+        assert set(got) == set(want)
+        for key in want:
+            assert float(got[key]) == float(want[key]), key
+    finally:
+        sharded.close()
+        oracle.close()
+
+
+def test_routing_is_ring_stable_and_tenants_are_disjoint():
+    sharded = ShardedEngine(
+        BinaryAccuracy(), config=ShardConfig(shards=4, place_on_mesh=False)
+    )
+    try:
+        _drive(sharded, _traffic(np.random.default_rng(2)))
+        seen = {}
+        for index, engine in enumerate(sharded.engines):
+            for key in engine._keyed.keys:
+                assert key not in seen, f"{key!r} registered on two shards"
+                seen[key] = index
+                assert sharded.shard_of(key) == index
+    finally:
+        sharded.close()
+
+
+def test_shard_count_validation_and_close_idempotent():
+    with pytest.raises(MetricsTPUUserError):
+        ShardedEngine(BinaryAccuracy(), config=ShardConfig(shards=0))
+    engine = ShardedEngine(BinaryAccuracy(), config=ShardConfig(shards=2, place_on_mesh=False))
+    engine.close()
+    engine.close()  # second close is a no-op
+    with pytest.raises(MetricsTPUUserError):
+        engine.resize(4)
+
+
+def test_telemetry_snapshot_aggregates_and_labels():
+    engine = ShardedEngine(
+        BinaryAccuracy(), config=ShardConfig(shards=2, place_on_mesh=False)
+    )
+    try:
+        _drive(engine, _traffic(np.random.default_rng(9), n_requests=20))
+        snap = engine.telemetry_snapshot()
+        assert snap["processed"] == 20
+        assert set(snap["shards"]) == {"0", "1"}
+        per_shard = sum(s["processed"] for s in snap["shards"].values())
+        assert per_shard == 20
+        # per-shard label rides on the registry series
+        assert engine.engines[0].telemetry._label["shard"] == "0"
+        assert engine.engines[1].telemetry._label["shard"] == "1"
+    finally:
+        engine.close()

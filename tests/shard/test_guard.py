@@ -1,0 +1,130 @@
+"""Shard-local guard semantics: quotas, quarantine, and degradation follow the
+tenant to its shard — poisoning or throttling one tenant never touches another
+shard's tenants (the ISSUE 11 isolation acceptance criterion).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from metrics_tpu.classification import BinaryAccuracy
+from metrics_tpu.engine import GuardConfig
+from metrics_tpu.guard.errors import QuotaExceeded, TenantQuarantined
+from metrics_tpu.guard.faults import ManualClock, poison_args
+from metrics_tpu.shard import ShardConfig, ShardedEngine
+
+
+def _good(rows=4):
+    return (
+        np.ones(rows, np.float32),
+        np.ones(rows, np.int32),
+    )
+
+
+def _keys_on_distinct_shards(engine, n=2):
+    """First n keys the ring places on n distinct shards."""
+    picked, shards = [], set()
+    i = 0
+    while len(picked) < n:
+        key = f"tenant-{i}"
+        shard = engine.shard_of(key)
+        if shard not in shards:
+            shards.add(shard)
+            picked.append(key)
+        i += 1
+    return picked
+
+
+def test_quarantine_is_shard_local():
+    """Drive one tenant to quarantine: its OWN shard quarantines it, every
+    other shard's guard has never heard of it, and a tenant on another shard
+    serves unimpeded."""
+    guard = GuardConfig(quarantine_threshold=2, clock=ManualClock())
+    engine = ShardedEngine(
+        BinaryAccuracy(),
+        config=ShardConfig(shards=4, place_on_mesh=False),
+        guard=guard,
+    )
+    try:
+        victim, bystander = _keys_on_distinct_shards(engine, 2)
+        p, t = poison_args()
+        for _ in range(2):
+            assert engine.submit(victim, p, t).exception(timeout=30) is not None
+            engine.flush()
+        with pytest.raises(TenantQuarantined):
+            engine.submit(victim, *_good())
+        # the victim's shard carries the quarantine; no other shard does
+        victim_shard = engine.shard_of(victim)
+        for index, shard_engine in enumerate(engine.engines):
+            quarantined = shard_engine.health()["quarantined_tenants"]
+            if index == victim_shard:
+                assert victim in quarantined
+            else:
+                assert not quarantined, f"shard {index} quarantined {quarantined}"
+        # the bystander (different shard) is entirely unaffected
+        assert engine.submit(bystander, *_good()).exception(timeout=30) is None
+        engine.flush()
+        assert float(engine.compute(bystander)) == 1.0
+        assert engine.engines[engine.shard_of(bystander)].health()["state"] == "SERVING"
+    finally:
+        engine.close()
+
+
+def test_quota_buckets_are_per_tenant_per_shard():
+    """A throttled tenant exhausts ITS token bucket on ITS shard; a tenant on a
+    different shard (and even on the same shard) keeps its own allowance."""
+    clock = ManualClock()
+    guard = GuardConfig(
+        clock=clock, quota_rows_per_s=2.0, quota_burst_rows=4.0
+    )
+    engine = ShardedEngine(
+        BinaryAccuracy(),
+        config=ShardConfig(shards=4, place_on_mesh=False),
+        guard=guard,
+    )
+    try:
+        greedy, modest = _keys_on_distinct_shards(engine, 2)
+        assert engine.submit(greedy, *_good(4)).exception(timeout=30) is None
+        with pytest.raises(QuotaExceeded):
+            engine.submit(greedy, *_good(4))
+        # different shard, untouched bucket
+        assert engine.submit(modest, *_good(4)).exception(timeout=30) is None
+        engine.flush()
+    finally:
+        engine.close()
+
+
+def test_poisoned_tenant_never_degrades_other_shards_throughput():
+    """The acceptance phrasing verbatim: after poisoning one tenant into
+    quarantine, every OTHER shard's tenants still commit every request and
+    compute exact values."""
+    guard = GuardConfig(quarantine_threshold=2, clock=ManualClock())
+    engine = ShardedEngine(
+        BinaryAccuracy(),
+        config=ShardConfig(shards=4, place_on_mesh=False),
+        guard=guard,
+    )
+    try:
+        victim = _keys_on_distinct_shards(engine, 1)[0]
+        p, t = poison_args()
+        for _ in range(2):
+            engine.submit(victim, p, t).exception(timeout=30)
+            engine.flush()
+        victim_shard = engine.shard_of(victim)
+        others = [f"bystander-{i}" for i in range(16)]
+        rng = np.random.default_rng(0)
+        futures = []
+        for key in others:
+            for _ in range(3):
+                preds = rng.integers(0, 2, 4).astype(np.float32)
+                target = rng.integers(0, 2, 4).astype(np.int32)
+                futures.append(engine.submit(key, preds, target))
+        engine.flush()
+        assert all(f.exception(timeout=30) is None for f in futures)
+        for index, shard_engine in enumerate(engine.engines):
+            if index != victim_shard:
+                snap = shard_engine.telemetry.snapshot()
+                assert snap["failed"] == 0 and snap["quarantine_rejections"] == 0
+    finally:
+        engine.close()

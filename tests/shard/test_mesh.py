@@ -1,0 +1,108 @@
+"""Device-mesh placement: each shard's slab committed to its own device, the
+NamedSharding introspection surface, and oracle parity on the 8-device virtual
+CPU mesh (tests/conftest.py forces ``xla_force_host_platform_device_count=8``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from metrics_tpu.classification import BinaryAccuracy
+from metrics_tpu.engine import StreamingEngine
+from metrics_tpu.shard import ShardConfig, ShardedEngine
+
+
+def _drive(engine, rng, n=40, n_keys=16):
+    futures = []
+    for _ in range(n):
+        k = f"tenant-{int(rng.integers(n_keys))}"
+        p = rng.integers(0, 2, 8).astype(np.float32)
+        t = rng.integers(0, 2, 8).astype(np.int32)
+        futures.append(engine.submit(k, p, t))
+    engine.flush()
+    assert all(f.exception(timeout=30) is None for f in futures)
+
+
+def test_shards_commit_to_distinct_devices(devices):
+    engine = ShardedEngine(BinaryAccuracy(), config=ShardConfig(shards=8))
+    try:
+        _drive(engine, np.random.default_rng(0))
+        placed = []
+        for shard_engine in engine.engines:
+            leaves = [
+                leaf
+                for leaf in __import__("jax").tree_util.tree_leaves(
+                    shard_engine._keyed.stacked
+                )
+            ]
+            shard_devices = {next(iter(leaf.devices())) for leaf in leaves}
+            assert len(shard_devices) == 1, "one shard's slab must live on ONE device"
+            placed.append(next(iter(shard_devices)))
+        assert len(set(placed)) == 8, f"8 shards must span 8 devices, got {placed}"
+        assert set(placed) == set(devices)
+    finally:
+        engine.close()
+
+
+def test_named_sharding_introspection(devices):
+    engine = ShardedEngine(BinaryAccuracy(), config=ShardConfig(shards=4))
+    try:
+        from jax.sharding import NamedSharding
+
+        assert isinstance(engine.sharding, NamedSharding)
+        assert engine.mesh.axis_names == ("shard",)
+        assert engine.mesh.devices.size == len(devices)
+        assert engine.sharding.spec == __import__("jax").sharding.PartitionSpec("shard")
+    finally:
+        engine.close()
+
+
+def test_mesh_placement_preserves_oracle_parity(devices):
+    """Placement must be invisible to results: 8 shards on 8 devices compute
+    the same per-tenant values as one engine on the default device."""
+    sharded = ShardedEngine(BinaryAccuracy(), config=ShardConfig(shards=8))
+    oracle = StreamingEngine(BinaryAccuracy())
+    try:
+        rng = np.random.default_rng(2)
+        traffic = []
+        for _ in range(60):
+            k = f"tenant-{int(rng.integers(16))}"
+            p = rng.integers(0, 2, 8).astype(np.float32)
+            t = rng.integers(0, 2, 8).astype(np.int32)
+            traffic.append((k, p, t))
+        for k, p, t in traffic:
+            sharded.submit(k, p, t)
+            oracle.submit(k, p, t)
+        sharded.flush(); oracle.flush()
+        got, want = sharded.compute_all(), oracle.compute_all()
+        for key in want:
+            assert float(got[key]) == float(want[key]), key
+    finally:
+        sharded.close()
+        oracle.close()
+
+
+def test_resize_places_new_shards_on_devices(devices):
+    engine = ShardedEngine(BinaryAccuracy(), config=ShardConfig(shards=2))
+    try:
+        _drive(engine, np.random.default_rng(5), n=20)
+        engine.resize(4)
+        _drive(engine, np.random.default_rng(6), n=20)
+        import jax
+
+        for index, shard_engine in enumerate(engine.engines):
+            leaf = jax.tree_util.tree_leaves(shard_engine._keyed.stacked)[0]
+            assert next(iter(leaf.devices())) == devices[index % len(devices)]
+    finally:
+        engine.close()
+
+
+def test_place_on_mesh_off_uses_default_device():
+    engine = ShardedEngine(
+        BinaryAccuracy(), config=ShardConfig(shards=2, place_on_mesh=False)
+    )
+    try:
+        assert engine.mesh is None and engine.sharding is None
+        assert all(e._keyed._device is None for e in engine.engines)
+    finally:
+        engine.close()

@@ -1,0 +1,187 @@
+"""Shard rebalancing: resize() migrates exactly the ring-moved tenants through
+the ckpt snapshot container, bit-identically — live segment and window ring
+rows included — under live traffic, and the monotone-growth bound holds.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import pytest
+
+from metrics_tpu import MeanSquaredError
+from metrics_tpu.classification import BinaryAccuracy
+from metrics_tpu.engine import StreamingEngine
+from metrics_tpu.shard import ShardConfig, ShardedEngine
+
+
+def _drive_pair(sharded, oracle, rng, n=40, n_keys=12):
+    futures = []
+    for _ in range(n):
+        k = f"tenant-{int(rng.integers(n_keys))}"
+        p = rng.integers(0, 2, 8).astype(np.float32)
+        t = rng.integers(0, 2, 8).astype(np.int32)
+        futures.append(sharded.submit(k, p, t))
+        oracle.submit(k, p, t)
+    sharded.flush(); oracle.flush()
+    assert all(f.exception(timeout=30) is None for f in futures)
+
+
+def _assert_parity(sharded, oracle, window=False):
+    got = sharded.compute_all(window=window)
+    want = oracle.compute_all(window=window)
+    assert set(got) == set(want)
+    for key in want:
+        assert float(got[key]) == float(want[key]), key
+
+
+def test_resize_moves_only_ring_moved_tenants_to_new_shards():
+    sharded = ShardedEngine(
+        BinaryAccuracy(), config=ShardConfig(shards=2, place_on_mesh=False)
+    )
+    oracle = StreamingEngine(BinaryAccuracy())
+    try:
+        rng = np.random.default_rng(0)
+        _drive_pair(sharded, oracle, rng)
+        before = {k: sharded.shard_of(k) for k in sharded.keys}
+        moved = sharded.resize(4)
+        for key, (src, dst) in moved.items():
+            assert before[key] == src
+            assert dst >= 2, f"{key!r} moved old→old: growth must be monotone"
+            assert sharded.shard_of(key) == dst
+        # unmoved tenants stayed exactly where they were
+        for key, shard in before.items():
+            if key not in moved:
+                assert sharded.shard_of(key) == shard
+        _assert_parity(sharded, oracle)
+    finally:
+        sharded.close()
+        oracle.close()
+
+
+def test_resize_preserves_window_ring_bit_identically():
+    """A migrated tenant carries its per-segment window contributions: windowed
+    computes agree with the oracle across a resize that lands mid-window."""
+    sharded = ShardedEngine(
+        BinaryAccuracy(), config=ShardConfig(shards=2, place_on_mesh=False), window=3
+    )
+    oracle = StreamingEngine(BinaryAccuracy(), window=3)
+    try:
+        rng = np.random.default_rng(4)
+        for _ in range(2):
+            _drive_pair(sharded, oracle, rng, n=25)
+            sharded.rotate_window(); oracle.rotate_window()
+        _drive_pair(sharded, oracle, rng, n=25)  # live segment has content too
+        sharded.resize(6)
+        _assert_parity(sharded, oracle, window=True)
+        # post-resize traffic keeps accumulating correctly on the new owners
+        _drive_pair(sharded, oracle, rng, n=25)
+        sharded.rotate_window(); oracle.rotate_window()
+        _assert_parity(sharded, oracle, window=True)
+    finally:
+        sharded.close()
+        oracle.close()
+
+
+def test_resize_float_states_bit_identical():
+    sharded = ShardedEngine(
+        MeanSquaredError(), config=ShardConfig(shards=2, place_on_mesh=False)
+    )
+    oracle = StreamingEngine(MeanSquaredError())
+    try:
+        rng = np.random.default_rng(9)
+        keys = [f"t{i}" for i in range(10)]
+        for _ in range(50):
+            k = keys[int(rng.integers(len(keys)))]
+            p = rng.normal(size=8).astype(np.float32)
+            t = rng.normal(size=8).astype(np.float32)
+            sharded.submit(k, p, t); oracle.submit(k, p, t)
+        sharded.flush(); oracle.flush()
+        sharded.resize(8)
+        got, want = sharded.compute_all(), oracle.compute_all()
+        for key in want:
+            assert np.float32(got[key]) == np.float32(want[key]), key
+    finally:
+        sharded.close()
+        oracle.close()
+
+
+def test_resize_under_concurrent_submitters():
+    """Submitter threads race a resize: the stripe quiesce means every update
+    lands exactly once on whichever ring routed it — totals match the oracle.
+    BinaryAccuracy's integer states are order-commutative, so bit-identity
+    holds under any interleaving."""
+    sharded = ShardedEngine(
+        BinaryAccuracy(), config=ShardConfig(shards=2, place_on_mesh=False)
+    )
+    oracle = StreamingEngine(BinaryAccuracy())
+    errors = []
+    try:
+        rng = np.random.default_rng(1)
+        keys = [f"tenant-{i}" for i in range(10)]
+        plan = []
+        for _ in range(120):
+            k = keys[int(rng.integers(len(keys)))]
+            p = rng.integers(0, 2, 4).astype(np.float32)
+            t = rng.integers(0, 2, 4).astype(np.int32)
+            plan.append((k, p, t))
+
+        def submitter(slice_):
+            try:
+                for k, p, t in slice_:
+                    sharded.submit(k, p, t)
+            except Exception as exc:  # pragma: no cover - surfaced below
+                errors.append(exc)
+
+        threads = [
+            threading.Thread(target=submitter, args=(plan[i::3],)) for i in range(3)
+        ]
+        for th in threads:
+            th.start()
+        sharded.resize(4)
+        for th in threads:
+            th.join(timeout=60)
+        assert not errors
+        sharded.flush()
+        for k, p, t in plan:
+            oracle.submit(k, p, t)
+        oracle.flush()
+        _assert_parity(sharded, oracle)
+    finally:
+        sharded.close()
+        oracle.close()
+
+
+def test_resize_validations():
+    sharded = ShardedEngine(
+        BinaryAccuracy(), config=ShardConfig(shards=2, place_on_mesh=False)
+    )
+    try:
+        from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+        with pytest.raises(MetricsTPUUserError):
+            sharded.resize(2)
+        with pytest.raises(MetricsTPUUserError):
+            sharded.resize(1)
+    finally:
+        sharded.close()
+
+
+def test_double_resize_accumulates_correctly():
+    sharded = ShardedEngine(
+        BinaryAccuracy(), config=ShardConfig(shards=1, place_on_mesh=False)
+    )
+    oracle = StreamingEngine(BinaryAccuracy())
+    try:
+        rng = np.random.default_rng(6)
+        _drive_pair(sharded, oracle, rng)
+        sharded.resize(2)
+        _drive_pair(sharded, oracle, rng)
+        sharded.resize(4)
+        _drive_pair(sharded, oracle, rng)
+        assert sharded.shards == 4
+        _assert_parity(sharded, oracle)
+    finally:
+        sharded.close()
+        oracle.close()

@@ -1,0 +1,115 @@
+"""Property tests for the consistent-hash ring (ISSUE 11 satellite 2).
+
+Three load-bearing properties: balance (max/mean tenant load ≤ 1.3 at 1k
+tenants × 8 shards), monotone moves on growth (keys only relocate to NEW
+shards, each new shard steals ≲1.3·K/M), and cross-process determinism (no
+``hash()`` randomization — the ring must place identically under a different
+PYTHONHASHSEED, or WAL recovery routes tenants away from their journals).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from metrics_tpu.shard import DEFAULT_VNODES, HashRing, hash_bytes, stable_key_bytes
+
+KEYS_1K = [f"tenant-{i}" for i in range(1000)]
+
+
+def _loads(ring: HashRing, keys) -> list:
+    counts = [0] * ring.shards
+    for key in keys:
+        counts[ring.shard_for(key)] += 1
+    return counts
+
+
+def test_balance_envelope_1k_tenants_8_shards():
+    ring = HashRing(8)
+    counts = _loads(ring, KEYS_1K)
+    assert sum(counts) == 1000 and min(counts) > 0
+    assert max(counts) / (1000 / 8) <= 1.3, counts
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_balance_envelope_holds_across_ring_seeds(seed):
+    counts = _loads(HashRing(8, seed=seed), KEYS_1K)
+    assert max(counts) / (1000 / 8) <= 1.3, (seed, counts)
+
+
+def test_growth_is_monotone_and_bounded():
+    """Doubling 4 → 8: every moved key lands on a NEW shard (old shards never
+    trade tenants), each new shard steals ≤ 1.3·K/8, and the total moved is
+    ~K/2, never more than 1.3·K/2 — the bound that prices a rebalance."""
+    old, new = HashRing(4), HashRing(4).grown(8)
+    moved = 0
+    stolen = [0] * 8
+    for key in KEYS_1K:
+        a, b = old.shard_for(key), new.shard_for(key)
+        if a != b:
+            assert b >= 4, f"{key!r} moved old→old ({a}→{b}): growth is not monotone"
+            moved += 1
+            stolen[b] += 1
+    assert moved <= 1.3 * 1000 / 2, moved
+    assert max(stolen[4:]) <= 1.3 * 1000 / 8, stolen
+
+
+def test_single_shard_growth_moves_about_k_over_m():
+    old, new = HashRing(8), HashRing(8).grown(9)
+    moved = [key for key in KEYS_1K if old.shard_for(key) != new.shard_for(key)]
+    assert all(new.shard_for(k) == 8 for k in moved)
+    assert len(moved) <= 1.3 * 1000 / 9, len(moved)
+
+
+def test_grown_requires_strictly_more_shards():
+    with pytest.raises(ValueError):
+        HashRing(4).grown(4)
+    with pytest.raises(ValueError):
+        HashRing(4).grown(2)
+
+
+def test_assignment_matches_shard_for():
+    ring = HashRing(3)
+    assign = ring.assignment(KEYS_1K[:50])
+    assert assign == {k: ring.shard_for(k) for k in KEYS_1K[:50]}
+
+
+def test_key_types_are_distinct_and_placed():
+    ring = HashRing(8)
+    keys = ["1", 1, 1.0, b"1", True, None, ("a", 1), ("a", (1, 2.0))]
+    blobs = [stable_key_bytes(k) for k in keys]
+    assert len(set(blobs)) == len(blobs), "type-tagging must keep 1/'1'/1.0/b'1' distinct"
+    for key in keys:
+        assert 0 <= ring.shard_for(key) < 8
+
+
+def test_hash_bytes_length_finalized():
+    # murmur3 tail defence: a trailing zero byte must change the hash
+    assert hash_bytes(b"a") != hash_bytes(b"a\x00")
+    assert hash_bytes(b"") != hash_bytes(b"\x00")
+
+
+def test_placement_deterministic_across_processes():
+    """The whole point of not using ``hash()``: a child interpreter with a
+    different PYTHONHASHSEED must compute the identical assignment."""
+    prog = (
+        "from metrics_tpu.shard import HashRing\n"
+        "r = HashRing(8)\n"
+        "print([r.shard_for(f'tenant-{i}') for i in range(64)])\n"
+    )
+    parent = [HashRing(8).shard_for(f"tenant-{i}") for i in range(64)]
+    for hashseed in ("0", "12345"):
+        env = dict(os.environ, PYTHONHASHSEED=hashseed, JAX_PLATFORMS="cpu")
+        out = subprocess.run(
+            [sys.executable, "-c", prog],
+            capture_output=True, text=True, env=env, check=True, timeout=120,
+            cwd=os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+        )
+        assert eval(out.stdout.strip()) == parent, f"PYTHONHASHSEED={hashseed} diverged"
+
+
+def test_default_vnodes_exported():
+    assert HashRing(2).vnodes == DEFAULT_VNODES == 256

@@ -1861,6 +1861,133 @@ def soak_cluster(seeds) -> None:
                     engine.close(checkpoint=False)
 
 
+def soak_shard(seeds) -> None:
+    """Sharded-engine surface (ISSUE 11): a ShardedEngine under randomized
+    concurrent submit interleavings vs a single-engine twin, with one shard's
+    dispatcher killed mid-stream every seed (worker-death ladder: inline
+    replay, exactly-once) and a mid-stream shard-count resize on even seeds.
+    BinaryAccuracy's integer states are order-commutative, so every tenant's
+    recovered state must be BIT-IDENTICAL — verified with the `_update_count`
+    twin technique: the full state tree (update count included) is compared
+    against a fresh metric fed that tenant's rows. Self-oracled — needs no
+    reference checkout."""
+    import threading
+
+    from metrics_tpu.classification import BinaryAccuracy
+    from metrics_tpu.engine import StreamingEngine
+    from metrics_tpu.guard.faults import kill_dispatcher
+    from metrics_tpu.shard import HashRing, ShardConfig, ShardedEngine
+
+    for seed in seeds:
+        rng = np.random.default_rng(seed)
+        n_requests = int(rng.integers(300, 700))
+        n_keys = int(rng.integers(8, 25))
+        shards = int(rng.choice([2, 4, 8]))
+        resize_mid_stream = seed % 2 == 0
+        stream = []
+        for _ in range(n_requests):
+            rows = int(rng.integers(1, 9))
+            stream.append((f"k{rng.integers(0, n_keys)}",
+                           rng.integers(0, 2, rows).astype(np.float32),
+                           rng.integers(0, 2, rows).astype(np.int32)))
+        tag = f"shard/BinaryAccuracy shards={shards} keys={n_keys} resize={resize_mid_stream}"
+        engine = ShardedEngine(
+            BinaryAccuracy(),
+            config=ShardConfig(shards=shards, place_on_mesh=False),
+            max_queue=256, submit_timeout=30.0,
+        )
+        twin = StreamingEngine(BinaryAccuracy(), max_queue=256, submit_timeout=30.0)
+        try:
+            client_errors: list = []
+            release = threading.Barrier(5)  # 4 clients + the fault injector
+
+            def client(tid, n_threads=4):
+                release.wait(timeout=30)
+                for i in range(tid, len(stream), n_threads):
+                    key, p, t = stream[i]
+                    try:
+                        engine.submit(key, jnp.asarray(p), jnp.asarray(t))
+                    except Exception as exc:  # noqa: BLE001
+                        client_errors.append((type(exc).__name__, repr(exc)[:100]))
+
+            threads = [threading.Thread(target=client, args=(tid,)) for tid in range(4)]
+            for th in threads:
+                th.start()
+            release.wait(timeout=30)
+            # mid-stream faults: kill one shard's dispatcher (the death ladder
+            # demotes that engine to exactly-once inline processing), and grow
+            # the ring under the racing submitters
+            killed = int(rng.integers(shards))
+            kill_dispatcher(engine.engines[killed])
+            if resize_mid_stream:
+                engine.resize(shards + int(rng.integers(1, shards + 1)))
+            for th in threads:
+                th.join()
+            engine.flush()
+            if client_errors:
+                FAILS.append((seed, tag, f"client submit raised: {client_errors[0][1]} (+{len(client_errors) - 1} more)"))
+                continue
+            # _update_count twin: every tenant's recovered state tree compared
+            # leaf-for-leaf against a fresh metric fed exactly its rows. The
+            # fused scan applies update_state per ROW (`_update_count` counts
+            # applications), so the twin replays per row. Tenants routed
+            # through the KILLED shard took the documented demotion path
+            # (whole-request update_state) for part of the stream — their
+            # accumulator leaves must still be bit-identical, and their
+            # `_update_count` must sit inside the exactly-once envelope
+            # [requests, rows] (below it ⇒ lost updates, above it ⇒ replays).
+            metric = BinaryAccuracy()
+            per_key: dict = {}
+            for key, p, t in stream:
+                per_key.setdefault(key, []).append((p, t))
+            pre_resize_ring = HashRing(shards)
+            seen = set()
+            for shard_index, shard_engine in enumerate(engine.engines):
+                for key in shard_engine._keyed.keys:
+                    if key in seen:
+                        FAILS.append((seed, tag, f"key {key} registered on two shards"))
+                        continue
+                    seen.add(key)
+                    if engine.shard_of(key) != shard_index:
+                        FAILS.append((seed, tag, f"key {key} on shard {shard_index}, ring says {engine.shard_of(key)}"))
+                    state = jax.device_get(shard_engine._keyed.state_of(key))
+                    oracle_state = metric.init_state()
+                    for p, t in per_key.get(key, []):
+                        for i in range(len(p)):
+                            oracle_state = metric.update_state(
+                                oracle_state, jnp.asarray(p[i:i + 1]), jnp.asarray(t[i:i + 1])
+                            )
+                    oracle_tree = jax.device_get(oracle_state)
+                    degraded_path = pre_resize_ring.shard_for(key) == killed or shard_index == killed
+                    for name in oracle_tree:
+                        if name == "_update_count" and degraded_path:
+                            continue
+                        if not np.array_equal(np.asarray(state[name]), np.asarray(oracle_tree[name])):
+                            FAILS.append((seed, tag, f"key {key} leaf {name}: {np.asarray(state[name])} != twin {np.asarray(oracle_tree[name])}"))
+                    if degraded_path:
+                        uc = int(np.asarray(state["_update_count"]))
+                        n_reqs = len(per_key.get(key, []))
+                        n_rows = sum(len(p) for p, _ in per_key.get(key, []))
+                        if not n_reqs <= uc <= n_rows:
+                            FAILS.append((seed, tag, f"key {key}: _update_count {uc} outside exactly-once envelope [{n_reqs}, {n_rows}]"))
+            if seen != set(per_key):
+                FAILS.append((seed, tag, f"tenant sets diverge: missing {set(per_key) - seen}"))
+            # single-engine twin on the same stream: computed values must agree
+            for key, p, t in stream:
+                twin.submit(key, jnp.asarray(p), jnp.asarray(t))
+            twin.flush()
+            got, want = engine.compute_all(), twin.compute_all()
+            for key in want:
+                if float(got[key]) != float(want[key]):
+                    FAILS.append((seed, tag, f"key {key}: sharded {float(got[key])} vs twin {float(want[key])}"))
+            snap = engine.telemetry_snapshot()
+            if snap["processed"] != len(stream):
+                FAILS.append((seed, tag, f"processed {snap['processed']} != submitted {len(stream)}"))
+        finally:
+            engine.close()
+            twin.close()
+
+
 SURFACES = {
     "classification": soak_classification,
     "regression_retrieval": soak_regression_retrieval,
@@ -1877,14 +2004,15 @@ SURFACES = {
     "repl": soak_repl,
     "sketch": soak_sketch,
     "cluster": soak_cluster,
+    "shard": soak_shard,
 }
 
 # surfaces that execute the reference as their oracle (everything except the
-# self-oracled engine, ckpt crash-recovery, guard chaos, repl, sketch and
-# cluster surfaces)
+# self-oracled engine, ckpt crash-recovery, guard chaos, repl, sketch,
+# cluster and shard surfaces)
 _NEEDS_REF = {
     name for name in SURFACES
-    if name not in ("engine", "ckpt", "guard", "repl", "sketch", "cluster")
+    if name not in ("engine", "ckpt", "guard", "repl", "sketch", "cluster", "shard")
 }
 
 
