@@ -36,10 +36,15 @@ Rebalancing: ``resize(new_shards)`` grows the hash ring monotonically (old
 shards never trade tenants — only new shards steal ~K/M each), migrates exactly
 the stolen tenants through the PR 4 ckpt snapshot container (bit-identical
 round trip, window ring segments included), and evicts them from their old
-shard. With checkpointing configured, the migration is crash-safe: the
-destination shard snapshots BEFORE the source evicts, and the recovery sweep on
-resume evicts any tenant found on a shard the ring no longer routes it to (the
-double-copy a crash between those two points leaves behind).
+shard. With checkpointing configured, the migration commits in write-ahead
+order: destination shards snapshot their installed copies, THEN the new-count
+ring manifest is written, and only then are the source copies evicted (in
+memory and via the sources' post-evict snapshots). A crash before the manifest
+commit restarts under the old ring with every source copy intact; a crash
+after it restarts under the new ring, where the recovery sweep evicts any
+tenant found on a shard the ring no longer routes it to (the double copy the
+remaining window leaves behind). No ordering leaves a tenant's only copy on a
+shard the manifest does not construct.
 """
 
 from __future__ import annotations
@@ -122,6 +127,7 @@ class ShardedEngine:
         self._metric_template = metric_or_collection
         self._engine_kwargs = dict(engine_kwargs)
         self._ckpt_cfg = checkpoint
+        self._start = start
         self.engine_id = str(next(_SHARDED_IDS))
 
         self._ring = HashRing(
@@ -225,12 +231,12 @@ class ShardedEngine:
     def _recovery_sweep(self) -> None:
         """Evict recovered tenants from shards the ring does not route them to.
 
-        Two sources: a crash mid-``resize`` after the destination checkpointed
-        but before the source's post-evict checkpoint committed (tenant present
-        on BOTH shards — the ring says the destination owns it, so the stale
-        source copy must go), and operator error re-homing a checkpoint tree.
-        The ring's copy is authoritative; the stale copy is dropped, not merged
-        (migration copied the full state, so merging would double-count).
+        Two sources: a crash mid-``resize`` after the new-count manifest
+        committed but before the sources' post-evict checkpoints did (tenant
+        present on BOTH shards — the ring says the destination owns it, so the
+        stale source copy must go), and operator error re-homing a checkpoint
+        tree. The ring's copy is authoritative; the stale copy is dropped, not
+        merged (migration copied the full state, so merging would double-count).
         """
         for index, engine in enumerate(self._engines):
             with engine._dispatch_lock:
@@ -257,15 +263,17 @@ class ShardedEngine:
 
     def shard_of(self, key: Hashable) -> int:
         """The shard index the ring currently routes ``key`` to."""
-        return self._ring.shard_for(key)
+        with self._admin_lock:
+            return self._ring.shard_for(key)
 
     @property
     def keys(self) -> Tuple[Hashable, ...]:
         """Every registered tenant, shard-index order then per-shard insertion order."""
-        out: List[Hashable] = []
-        for engine in self._engines:
-            out.extend(engine._keyed.keys)
-        return tuple(out)
+        with self._admin_lock:
+            out: List[Hashable] = []
+            for engine in self._engines:
+                out.extend(engine._keyed.keys)
+            return tuple(out)
 
     # ------------------------------------------------------------------- writes
 
@@ -298,9 +306,15 @@ class ShardedEngine:
             )
 
     def flush(self, timeout: Optional[float] = None) -> None:
-        """Block until every accepted request on every shard has committed."""
-        for engine in self._engines:
-            engine.flush(timeout=timeout)
+        """Block until every accepted request on every shard has committed.
+
+        Serializes with ``resize`` on the admin lock: a flush that overlapped a
+        rebalance could otherwise return while newly born shards still held
+        unflushed migrated work.
+        """
+        with self._admin_lock:
+            for engine in self._engines:
+                engine.flush(timeout=timeout)
 
     # -------------------------------------------------------------------- reads
 
@@ -333,18 +347,30 @@ class ShardedEngine:
 
     def health(self) -> Dict[str, Any]:
         """Aggregate state (worst shard wins) + the per-shard health dicts."""
-        per_shard = [engine.health() for engine in self._engines]
+        with self._admin_lock:
+            per_shard = [engine.health() for engine in self._engines]
+            ring_repr = repr(self._ring)
         order = {"SERVING": 0, "DEGRADED": 1, "QUARANTINED": 2}
         worst = max((h["state"] for h in per_shard), key=lambda s: order.get(s, 2))
-        return {"state": worst, "shards": per_shard, "ring": repr(self._ring)}
+        return {"state": worst, "shards": per_shard, "ring": ring_repr}
 
     def telemetry_snapshot(self) -> Dict[str, Any]:
-        """Counter sums across shards + the per-shard snapshots (keyed by index)."""
-        shards = {str(i): e.telemetry.snapshot() for i, e in enumerate(self._engines)}
+        """Additive sums across shards + the per-shard snapshots (keyed by index).
+
+        Only additive series are summed into the top level: the integer event
+        counters and gauges (``processed``, ``queue_depth``, ...) plus the
+        ``resize_seconds`` wall-time counter. Non-additive series — latency
+        quantiles, occupancy histograms, mean ratios — appear only under the
+        per-shard sub-dicts (the sum of eight per-shard p50s is not a p50).
+        """
+        with self._admin_lock:
+            shards = {str(i): e.telemetry.snapshot() for i, e in enumerate(self._engines)}
         totals: Dict[str, Any] = {}
         for snap in shards.values():
             for name, val in snap.items():
-                if isinstance(val, (int, float)) and not isinstance(val, bool):
+                if isinstance(val, bool) or not isinstance(val, (int, float)):
+                    continue
+                if isinstance(val, int) or name == "resize_seconds":
                     totals[name] = totals.get(name, 0) + val
         totals["shards"] = shards
         return totals
@@ -392,10 +418,17 @@ class ShardedEngine:
         duration (all stripes held); in-flight work is flushed first so the
         copied state is complete. Returns ``{key: (from_shard, to_shard)}``.
 
-        Crash safety (checkpointing on): destination shards checkpoint after
-        installing their stolen tenants, BEFORE sources evict + checkpoint; a
-        crash between the two leaves a double copy that the construction-time
-        recovery sweep resolves in the ring's (destination's) favor.
+        Crash safety (checkpointing on) is write-ahead ordering: copies are
+        installed on the destinations WITHOUT evicting the sources, the
+        destination (born) shards checkpoint, the new-count ring manifest
+        commits, and only then are the source copies evicted and the sources'
+        post-evict checkpoints taken. A crash before the manifest commit
+        restarts under the old ring with every source copy intact (the born
+        directories hold only stale bytes, dropped by the next resize); a
+        crash after it restarts under the new ring, whose recovery sweep
+        resolves the double copies in the destination's favor. At no point is
+        a tenant's only durable copy on a shard the manifest does not
+        construct.
         """
         with self._admin_lock:
             if self._closed:
@@ -405,11 +438,24 @@ class ShardedEngine:
                     f"resize() only grows: {new_shards} <= current {len(self._engines)}"
                 )
             new_ring = self._ring.grown(new_shards)
-            # build (and start) the new shards before quiescing submits — the
-            # stripe hold should cover migration only, not engine construction
+            # build the new shards before quiescing submits — the stripe hold
+            # should cover migration only, not engine construction. They run
+            # (or not) under the same lifecycle flag as the original shards.
             born = [
-                self._build_shard(i) for i in range(len(self._engines), new_shards)
+                self._build_shard(i, start=self._start)
+                for i in range(len(self._engines), new_shards)
             ]
+            # A born shard may reuse a shard-NNN directory left by a resize
+            # that crashed before its manifest committed, and resume=True will
+            # have recovered that leftover state. It is stale by construction:
+            # the old-count manifest means the original shards recovered every
+            # authoritative copy (sources are never durably evicted ahead of
+            # the manifest). Drop it all before migration installs fresh
+            # copies, or resurrected tenants would duplicate live ones.
+            for engine in born:
+                with engine._dispatch_lock:
+                    for key in list(engine._keyed.keys):
+                        engine._keyed.evict(key)
             for stripe in self._stripes:
                 stripe.acquire()
             try:
@@ -424,22 +470,41 @@ class ShardedEngine:
                         dst_idx = new_ring.shard_for(key)
                         if dst_idx == src_idx:
                             continue
-                        self._migrate_tenant(src, engines[dst_idx], key)
+                        self._copy_tenant(src, engines[dst_idx], key)
                         moved[key] = (src_idx, dst_idx)
                 if self._ckpt_cfg is not None:
-                    # destination durability first; see the docstring's crash argument
-                    for engine in born:
-                        engine.checkpoint_now()
+                    # destination durability, then the ring that routes to it,
+                    # then source eviction — see the docstring's crash argument
+                    if any(engine.checkpoint_now() is None for engine in born):
+                        for engine in born:
+                            engine.close(flush=False, checkpoint=False)
+                        raise RuntimeError(
+                            "resize() aborted: a destination shard failed to "
+                            "checkpoint its migrated tenants; the old ring and "
+                            "every source copy are intact"
+                        )
+                    try:
+                        self._write_manifest(
+                            self._ckpt_cfg.directory,
+                            {
+                                "shards": new_shards,
+                                "vnodes": self._config.vnodes,
+                                "seed": self._config.seed,
+                            },
+                        )
+                    except BaseException:
+                        # abort pre-commit: the old ring and every source copy
+                        # are untouched; only the born engines need unwinding
+                        for engine in born:
+                            engine.close(flush=False, checkpoint=False)
+                        raise
+                for key, (src_idx, _) in moved.items():
+                    src = self._engines[src_idx]
+                    with src._dispatch_lock:
+                        src._keyed.evict(key)
+                if self._ckpt_cfg is not None:
                     for engine in self._engines:
                         engine.checkpoint_now()
-                    self._write_manifest(
-                        self._ckpt_cfg.directory,
-                        {
-                            "shards": new_shards,
-                            "vnodes": self._config.vnodes,
-                            "seed": self._config.seed,
-                        },
-                    )
                 self._engines = engines
                 self._ring = new_ring
                 self._route_cache.clear()
@@ -451,15 +516,17 @@ class ShardedEngine:
         self._publish_tenant_gauges()
         return moved
 
-    def _migrate_tenant(self, src: StreamingEngine, dst: StreamingEngine, key: Hashable) -> None:
-        """Move one tenant src → dst, bit-identically, through the ckpt container."""
+    def _copy_tenant(self, src: StreamingEngine, dst: StreamingEngine, key: Hashable) -> None:
+        """Copy one tenant src → dst, bit-identically, through the ckpt container.
+
+        The source copy is left in place: ``resize`` evicts it only once the
+        destination copy and the ring routing to it are both durable.
+        """
         with src._dispatch_lock:
             blob = ckpt_format.dumps(self._export_tenant(src._keyed, key))
         tree = ckpt_format.loads(blob).tree
         with dst._dispatch_lock:
             self._install_tenant(dst._keyed, key, tree)
-        with src._dispatch_lock:
-            src._keyed.evict(key)
 
     @staticmethod
     def _export_tenant(keyed: Any, key: Hashable) -> Dict[str, Any]:

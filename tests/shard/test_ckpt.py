@@ -5,6 +5,7 @@ the crash-mid-rebalance recovery sweep.
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 
@@ -13,6 +14,7 @@ import pytest
 
 from metrics_tpu.classification import BinaryAccuracy
 from metrics_tpu.engine import CheckpointConfig
+from metrics_tpu.engine.runtime import StreamingEngine
 from metrics_tpu.shard import ShardConfig, ShardedEngine
 from metrics_tpu.utils.exceptions import MetricsTPUUserError
 
@@ -150,5 +152,77 @@ def test_crash_mid_rebalance_double_copy_is_swept(tmp_path):
         assert got == want
         assert victim not in second.engines[wrong]._keyed.keys
         assert victim in second.engines[owner]._keyed.keys
+    finally:
+        second.close()
+
+
+def test_crash_before_manifest_commit_loses_nothing(tmp_path, monkeypatch):
+    """Torn resize at the worst point: destinations already checkpointed their
+    copies, the new-count manifest NOT yet committed. The manifest still names
+    the old ring, so a restart must come up with every source copy intact, and
+    rerunning the resize must converge to the same totals with exactly one
+    live copy per tenant."""
+    ck = _cfg(tmp_path)
+    cfg = ShardConfig(shards=2, place_on_mesh=False)
+    first = ShardedEngine(BinaryAccuracy(), config=cfg, checkpoint=ck)
+    _drive(first, np.random.default_rng(11))
+    want = {k: float(v) for k, v in first.compute_all().items()}
+
+    def torn(directory, manifest):
+        raise RuntimeError("simulated crash before manifest commit")
+
+    monkeypatch.setattr(ShardedEngine, "_write_manifest", staticmethod(torn))
+    with pytest.raises(RuntimeError):
+        first.resize(4)
+    first.close(checkpoint=False)  # crash simulation: sources keep WAL only
+    monkeypatch.undo()
+
+    with open(os.path.join(ck.directory, "shard_manifest.json")) as fh:
+        assert json.load(fh)["shards"] == 2  # the old ring is still committed
+    second = ShardedEngine(BinaryAccuracy(), config=cfg, checkpoint=ck)
+    try:
+        assert {k: float(v) for k, v in second.compute_all().items()} == want
+        # the rerun reuses the born shard-00N directories the crash left
+        # behind; their stale recovered copies must be dropped, not merged
+        second.resize(4)
+        assert {k: float(v) for k, v in second.compute_all().items()} == want
+        all_keys = [k for e in second.engines for k in e._keyed.keys]
+        assert len(all_keys) == len(set(all_keys))  # one live copy per tenant
+    finally:
+        second.close()
+
+
+def test_born_shard_drops_stale_recovered_state(tmp_path):
+    """resize() reusing a shard-NNN directory with leftover durable state (a
+    crashed previous resize, or an operator re-homing mistake) must not
+    resurrect what the born shard auto-recovers: the old-count manifest means
+    the original shards hold every authoritative copy."""
+    ck = _cfg(tmp_path)
+    cfg = ShardConfig(shards=2, place_on_mesh=False)
+    engine = ShardedEngine(BinaryAccuracy(), config=cfg, checkpoint=ck)
+    _drive(engine, np.random.default_rng(13))
+    want = {k: float(v) for k, v in engine.compute_all().items()}
+
+    # plant a stale tenant in the directory the resize below reuses for shard 2
+    stale_ck = dataclasses.replace(ck, directory=os.path.join(ck.directory, "shard-002"))
+    stale = StreamingEngine(BinaryAccuracy(), checkpoint=stale_ck)
+    stale.submit("ghost", np.ones(4, np.float32), np.ones(4, np.int32))
+    stale.flush()
+    stale.close()  # clean close: "ghost" is durably snapshotted in shard-002
+
+    engine.resize(4)
+    try:
+        assert "ghost" not in engine.keys
+        assert {k: float(v) for k, v in engine.compute_all().items()} == want
+    finally:
+        engine.close()
+
+    # the drop is durable: a restart at the new count must not see it either
+    second = ShardedEngine(
+        BinaryAccuracy(), config=ShardConfig(shards=4, place_on_mesh=False), checkpoint=ck
+    )
+    try:
+        assert "ghost" not in second.keys
+        assert {k: float(v) for k, v in second.compute_all().items()} == want
     finally:
         second.close()
