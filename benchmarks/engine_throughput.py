@@ -156,6 +156,13 @@ def main() -> None:
                     "is the ISSUE-11 acceptance bar and assumes >=8 usable cores; the "
                     "ratio measures real core-level parallelism, so a constrained runner "
                     "must lower it explicitly rather than the gate silently passing")
+    ap.add_argument("--comm", action="store_true",
+                    help="comm membership gate (ISSUE 12): partition-tolerant membership "
+                    "bookkeeping (liveness accounting, agree-on-demand arming, peer-live "
+                    "publication) must add <5%% to a happy-path full-world lossless sync "
+                    "over a 4-rank loopback world vs the same sync with membership off — "
+                    "the zero-extra-collectives-when-healthy claim (paired alternating "
+                    "runs, median pair ratio)")
     ap.add_argument("--guard", action="store_true",
                     help="guard-plane gates (ISSUE 5): (a) well-behaved traffic with the "
                     "guard enabled loses <5%% throughput vs the plain pass; (b) under a "
@@ -894,6 +901,86 @@ def main() -> None:
         emit("shard acceptance", float(all(sh_checks.values())), "bool",
              checks=sh_checks, mismatched_keys=sh_mismatches[:4])
         if not (ok_scale and ok_sh_overhead and all(sh_checks.values())):
+            sys.exit(1)
+
+    # ---------------- comm membership gate (ISSUE 12): the membership layer's
+    # happy path does NO extra collectives — agreement only arms when a view
+    # has losses or a collective fails attributed — so a healthy full-world
+    # lossless sync with membership on must cost within 5% of the same sync
+    # with membership off (paired alternating runs, median pair ratio).
+    if args.comm:
+        import threading as _threading
+        from dataclasses import replace as _dc_replace
+
+        from metrics_tpu.comm import CommConfig, LoopbackWorld, sync_pytree
+
+        C_WORLD, C_ROUNDS = 4, 30
+        c_rng = np.random.default_rng(11)
+        comm_states = {
+            r: {
+                "total": jnp.asarray(c_rng.standard_normal(), jnp.float32),
+                "hits": jnp.asarray(c_rng.integers(0, 100, 64), jnp.int32),
+                "avg": jnp.asarray(c_rng.standard_normal(128), jnp.float32),
+                "preds": jnp.asarray(c_rng.standard_normal((64, 2)), jnp.float32),
+                "_update_count": jnp.asarray(3),
+            }
+            for r in range(C_WORLD)
+        }
+        comm_reds = {"total": "sum", "hits": "sum", "avg": "mean", "preds": "cat"}
+        dirty_reports = []
+
+        def comm_pass(membership):
+            world = LoopbackWorld(C_WORLD, timeout=30.0)
+            cfg = CommConfig(timeout_s=30.0, max_retries=0, membership=membership)
+            if membership:
+                cfg = _dc_replace(cfg, on_report=lambda rep: (
+                    dirty_reports.append(rep) if rep.degraded_step != "none" or rep.stale else None))
+            transports = {r: world.transport(r) for r in range(C_WORLD)}
+
+            def rank_fn(r):
+                for _ in range(C_ROUNDS):
+                    sync_pytree(comm_states[r], comm_reds, transport=transports[r],
+                                config=cfg, site="bench.comm")
+
+            threads = [_threading.Thread(target=rank_fn, args=(r,)) for r in range(C_WORLD)]
+            gc.collect()
+            gc.disable()
+            try:
+                t0 = time.perf_counter()
+                for th in threads:
+                    th.start()
+                for th in threads:
+                    th.join()
+                return C_ROUNDS / (time.perf_counter() - t0)
+            finally:
+                gc.enable()
+
+        comm_pass(True)  # warmup: compile the stacked-reduce kernels once
+        comm_ratios = []
+        on_best = off_best = 0.0
+        for i in range(6):
+            if i % 2 == 0:
+                off = comm_pass(False)
+                on = comm_pass(True)
+            else:
+                on = comm_pass(True)
+                off = comm_pass(False)
+            comm_ratios.append(off / on)
+            on_best, off_best = max(on_best, on), max(off_best, off)
+        comm_overhead = float(np.median(comm_ratios)) - 1.0
+        comm_checks = {
+            "membership_overhead_lt_5pct": comm_overhead < 0.05,
+            # a healthy world must never degrade or go stale: any non-clean
+            # report under membership means the happy path armed agreement
+            "happy_path_stayed_clean": not dirty_reports,
+        }
+        emit("comm membership overhead on happy-path sync", comm_overhead * 100.0, "%",
+             membership_rounds_per_s=round(on_best, 1),
+             bare_rounds_per_s=round(off_best, 1),
+             pair_ratios=[round(r, 4) for r in comm_ratios],
+             config={"world": C_WORLD, "rounds": C_ROUNDS},
+             checks=comm_checks)
+        if not all(comm_checks.values()):
             sys.exit(1)
 
     # ---------------- guard plane gates (ISSUE 5): (a) the admission/fairness

@@ -2,8 +2,8 @@
 
 from __future__ import annotations
 
-from dataclasses import dataclass
-from typing import Callable, Optional, Sequence
+from dataclasses import dataclass, field
+from typing import Callable, Mapping, Optional, Sequence
 
 from metrics_tpu.cluster.errors import ClusterConfigError
 from metrics_tpu.cluster.store import CoordStore
@@ -36,6 +36,14 @@ class ClusterConfig:
       (irrelevant under manual ticking in tests).
     - ``election_backoff_s`` / ``backoff_cap_s`` — jittered exponential
       backoff base/cap for promote retries and non-favourite candidacy.
+
+    ``comm_view`` / ``peer_ranks`` wire the comm plane's membership signal
+    into failure detection: pass the transport's
+    :class:`~metrics_tpu.comm.membership.WorldView` (``comm.view_for(t)``)
+    plus the peer-id → comm-rank mapping, and every *attributed* collective
+    failure against a peer counts as a suspicion edge — typically seconds
+    ahead of heartbeat silence, since a sync fails the moment a peer stalls
+    while heartbeats must first go quiet for ``suspect_after_s``.
     """
 
     node_id: str
@@ -52,6 +60,8 @@ class ClusterConfig:
     drain_timeout_s: float = 5.0
     rng_seed: Optional[int] = None
     on_transition: Optional[Callable[[str, str], None]] = None
+    comm_view: Optional[object] = None  # a metrics_tpu.comm WorldView (duck-typed)
+    peer_ranks: Mapping[str, int] = field(default_factory=dict)
 
     def __post_init__(self) -> None:
         if not self.node_id:
@@ -67,3 +77,8 @@ class ClusterConfig:
                 f"suspect_after_s ({self.suspect_after_s}) must not exceed "
                 f"confirm_after_s ({self.confirm_after_s})"
             )
+        if self.comm_view is not None and not self.peer_ranks:
+            raise ClusterConfigError("comm_view requires peer_ranks (peer id -> comm rank)")
+        unknown = [p for p in self.peer_ranks if p != self.node_id and p not in self.peers]
+        if unknown:
+            raise ClusterConfigError(f"peer_ranks names unknown peers: {unknown}")

@@ -71,6 +71,7 @@ class ClusterNode:
         self.suspicions = 0
         self.last_error: Optional[BaseException] = None
         self._suspected: Dict[str, float] = {}  # peer -> suspected-since (store time)
+        self._comm_susp_seen: Dict[int, int] = {}  # comm rank -> consumed suspicion level
         self._last_heartbeat = float("-inf")
         self._election_backoff = 0.0
         self._next_attempt = float("-inf")  # candidacy/promote backoff gate (store time)
@@ -184,6 +185,36 @@ class ClusterNode:
                     _obs.record_cluster_suspicion(self.cfg.node_id, peer)
             elif rec is not None:
                 self._suspected.pop(peer, None)
+        self._consume_comm_suspicion(now)
+
+    def _consume_comm_suspicion(self, now: float) -> None:
+        """Fold the comm plane's attributed-failure signal into detection.
+
+        ``WorldView.suspicion()`` is a cumulative per-rank counter; we consume
+        *edges* (the count moved since our last tick), so one bad collective
+        suspects a peer exactly once — typically seconds before its heartbeats
+        go silent. A fresh heartbeat un-suspects on the NEXT tick (the loop
+        above runs first), so a peer with a broken comm path but a live
+        process oscillates visibly instead of being silently trusted.
+        """
+        view = self.cfg.comm_view
+        if view is None or not self.cfg.peer_ranks:
+            return
+        try:
+            counts = view.suspicion()
+        except Exception as exc:  # noqa: BLE001 — a comm-plane hiccup must not kill the tick
+            self.last_error = exc
+            return
+        for peer, comm_rank in self.cfg.peer_ranks.items():
+            if peer == self.cfg.node_id or peer not in self.cfg.peers:
+                continue
+            level = int(counts.get(int(comm_rank), 0))
+            if level > self._comm_susp_seen.get(int(comm_rank), 0):
+                self._comm_susp_seen[int(comm_rank)] = level
+                if peer not in self._suspected:
+                    self._suspected[peer] = now
+                    self.suspicions += 1
+                    _obs.record_cluster_suspicion(self.cfg.node_id, peer)
 
     def _confirmed_dead(self, now: float, rec: Optional[Member]) -> bool:
         return rec is None or now - rec.heartbeat >= self.cfg.confirm_after_s
@@ -440,4 +471,16 @@ class ClusterNode:
             "failovers": self.failovers,
             "lease_renewals": self.lease_renewals,
             "suspicions": self.suspicions,
+            "comm_lost_peers": self._comm_lost_peers(),
         }
+
+    def _comm_lost_peers(self) -> List[str]:
+        """Peer ids the comm plane's agreed live set currently excludes."""
+        view = self.cfg.comm_view
+        if view is None or not self.cfg.peer_ranks:
+            return []
+        try:
+            lost = set(view.lost())
+        except Exception:  # noqa: BLE001 — health must stay readable
+            return []
+        return sorted(p for p, r in self.cfg.peer_ranks.items() if int(r) in lost and p != self.cfg.node_id)

@@ -27,6 +27,13 @@ Three layers (see docs/source/comm.md):
   (``multihost_utils``, an in-process :class:`LoopbackWorld`, or injected
   fakes) and the failure vocabulary the retry → degradation ladder in
   :mod:`~metrics_tpu.comm.plane` consumes.
+
+Plus the membership layer (:mod:`~metrics_tpu.comm.membership`): a per-process
+:class:`WorldView` fed by attributed collective failures and a two-phase
+live-set agreement, which give the ladder its ``live_subset`` rung — survivors
+agree on the live sub-world and complete the sync over it (exact for
+cumulative mergeable state), and a returning rank rejoins automatically on the
+next round.
 """
 
 from metrics_tpu.comm.codec import (
@@ -39,6 +46,7 @@ from metrics_tpu.comm.codec import (
     get_codec,
     register_codec,
 )
+from metrics_tpu.comm.membership import MembershipError, WorldView, agree_live_set, view_for
 from metrics_tpu.comm.plan import TransferPlan, build_plan, clear_plan_cache, plan_cache_info
 from metrics_tpu.comm.plane import (
     CommConfig,
@@ -82,6 +90,7 @@ __all__ = [
     "LocalTransport",
     "LoopbackWorld",
     "LosslessCodec",
+    "MembershipError",
     "MultihostTransport",
     "PeerLostError",
     "ReplicaFakeTransport",
@@ -92,6 +101,8 @@ __all__ = [
     "Transport",
     "TransportError",
     "TransportTimeout",
+    "WorldView",
+    "agree_live_set",
     "build_plan",
     "clear_plan_cache",
     "configure",
@@ -108,4 +119,5 @@ __all__ = [
     "sync_state",
     "sync_with_gather_fn",
     "use_config",
+    "view_for",
 ]

@@ -25,7 +25,7 @@ from __future__ import annotations
 
 import threading
 from dataclasses import dataclass
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -134,7 +134,7 @@ def _signature(state: Dict[str, Any], reductions: Dict[str, Any]) -> str:
     return "|".join(parts)
 
 
-_PLAN_CACHE: Dict[Tuple[str, CodecPolicy, int, bool], TransferPlan] = {}
+_PLAN_CACHE: Dict[Tuple[str, CodecPolicy, int, bool, Any], TransferPlan] = {}
 _PLAN_LOCK = threading.Lock()
 _PLAN_CACHE_MAX = 256
 _cache_hits = 0
@@ -161,11 +161,19 @@ def build_plan(
     *,
     chunk_bytes: int = 4 << 20,
     coalesce: bool = True,
+    world: Optional[int] = None,
 ) -> TransferPlan:
-    """Plan (cached on the state's abstract signature) the transfers for one sync."""
+    """Plan (cached on the state's abstract signature) the transfers for one sync.
+
+    ``world`` joins the cache key so sub-world plans (the ``live_subset``
+    ladder rung executing over an agreed surviving subset) cache exactly like
+    full-world plans — the layout itself is world-agnostic (execution sizes
+    gathers off ``transport.world_size()``), so each distinct world size costs
+    one cache entry, never a rebuild per sync.
+    """
     global _cache_hits, _cache_misses
     sig = _signature(state, reductions)
-    key = (sig, policy, int(chunk_bytes), bool(coalesce))
+    key = (sig, policy, int(chunk_bytes), bool(coalesce), None if world is None else int(world))
     with _PLAN_LOCK:
         plan = _PLAN_CACHE.get(key)
         if plan is not None:

@@ -17,13 +17,21 @@ Fault tolerance (Prime PCCL-style, arxiv 2505.14065): each host collective runs
 under the configured deadline; a failed attempt retries with bounded
 exponential backoff, then the sync *degrades* down a ladder —
 
-    full sync (policy codecs) → lossless-only → local state + staleness flag
+    full sync (policy codecs) → lossless-only → live-subset → local state + staleness flag
 
-— with every rung visible in obs (``metrics_tpu_comm_retries_total``,
-``_timeouts_total``, ``_degradations_total``, ``_stale_state``) and in the
-:class:`SyncReport` returned by :func:`last_report`. Reduction order is
-deterministic across retries: the plan fixes leaf order, ranks always reduce
-in rank order, and backoff is jitter-free.
+where **live-subset** (membership-capable transports only) runs the two-phase
+live-set agreement from :mod:`metrics_tpu.comm.membership`: every survivor
+commits to the same agreed sub-world and the plan re-executes over it —
+exact for cumulative mergeable state, so one dead host shrinks the aggregate
+instead of shattering it into N local answers. Rejoin is automatic: a
+returning rank's deposit is picked up by the next agreement round and the
+following sync is full-world again. Every rung is visible in obs
+(``metrics_tpu_comm_retries_total``, ``_timeouts_total``,
+``_degradations_total``, ``_partial_syncs_total``, ``_peer_live``,
+``_stale_state``) and in the :class:`SyncReport` returned by
+:func:`last_report`. Reduction order is deterministic across retries: the plan
+fixes leaf order, ranks always reduce in rank order, and backoff jitter is
+deterministic (rank-seeded decorrelation, no wall-clock randomness).
 """
 
 from __future__ import annotations
@@ -35,7 +43,9 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from metrics_tpu.comm import membership as _membership
 from metrics_tpu.comm.codec import CodecPolicy, EncodedLeaf, get_codec
+from metrics_tpu.comm.membership import MembershipError, WorldView, view_for
 from metrics_tpu.comm.plan import TransferPlan, build_plan
 from metrics_tpu.comm.transport import (
     LocalTransport,
@@ -45,6 +55,7 @@ from metrics_tpu.comm.transport import (
     TransportError,
     TransportTimeout,
     gather_ragged,
+    set_call_cancel_event,
 )
 from metrics_tpu.obs import instrument as _obs
 from metrics_tpu.obs.registry import OBS as _OBS
@@ -83,10 +94,20 @@ class CommConfig:
     backoff_max_s: float = 2.0
     degrade: bool = True
     transport: Optional[Transport] = None
+    # membership / live-subset rung: on membership-capable transports, a sync
+    # that loses peers agrees on the surviving live set and completes over it
+    # instead of falling to local state — as long as at least
+    # max(2, min_quorum) ranks survive. membership_deadline_s bounds each
+    # agreement phase (defaults to timeout_s, else 1s). The happy path pays
+    # only attr-loads: no agreement round runs while the view is all-live.
+    membership: bool = True
+    min_quorum: int = 2
+    membership_deadline_s: Optional[float] = None
     # observer hook: called with every published SyncReport (success, degraded
     # or stale) — how health machinery (e.g. the engine's comm circuit breaker,
     # metrics_tpu.guard) watches sync outcomes without polling last_report().
-    # Exceptions are swallowed: observation must never fail a sync.
+    # Exceptions are absorbed + rank_zero_warn'ed (the guard plane's
+    # on_health_transition contract): observation must never fail a sync.
     on_report: Optional[Callable[["SyncReport"], None]] = None
 
 
@@ -150,8 +171,16 @@ class SyncReport:
     wire_bytes: int = 0
     retries: int = 0
     timeouts: int = 0
-    degraded_step: str = "none"  # none | lossless_only | local_state
+    degraded_step: str = "none"  # none | lossless_only | live_subset | local_state
     stale: bool = False
+    # membership outcome: which ranks the agreed live set excluded, and how
+    # many ranks actually contributed state (== world on a full-world sync)
+    peers_lost: Tuple[int, ...] = ()
+    world_live: int = 0
+
+    @property
+    def world_size(self) -> int:
+        return self.world
 
     @property
     def compression_ratio(self) -> float:
@@ -176,8 +205,13 @@ def _publish(report: SyncReport, config: Optional[CommConfig] = None) -> None:
     if hook is not None:
         try:
             hook(report)
-        except Exception:  # noqa: BLE001 — observation must never fail a sync
-            pass
+        except Exception as exc:  # noqa: BLE001 — observation must never fail a sync
+            from metrics_tpu.utils import rank_zero_warn
+
+            rank_zero_warn(
+                f"comm on_report observer raised {type(exc).__name__}: {exc} — "
+                "report absorbed; a buggy observer must not take the sync path down"
+            )
 
 
 # ----------------------------------------------------------------- transport wrappers
@@ -186,14 +220,26 @@ def _publish(report: SyncReport, config: Optional[CommConfig] = None) -> None:
 class _TimeoutTransport(Transport):
     """Run each collective under a deadline in a worker thread.
 
-    The underlying call cannot be cancelled (a real multihost collective has no
-    abort); on timeout the thread is abandoned and the caller gets
-    :class:`TransportTimeout` — which is exactly what the retry ladder needs.
+    The underlying call cannot be cancelled outright (a real multihost
+    collective has no abort); on timeout the worker is *abandoned safely*:
+
+    - every call is stamped with a generation; a timeout bumps it, so a late
+      completion can never publish its result into a later attempt's hands;
+    - the worker's cooperative cancel event is set — in-process transports
+      check it before touching shared barriers, so a late-running abandoned
+      call cannot deposit into a fresh round;
+    - the inner transport is ``reset()`` (when it supports it) so an abandoned
+      waiter cannot keep occupying a barrier seat.
+
+    One instance is shared across a sync's retries — that is what makes the
+    generation stamp meaningful.
     """
 
     def __init__(self, inner: Transport, timeout_s: Optional[float]) -> None:
         self._inner = inner
         self._timeout_s = timeout_s
+        self._gen = 0
+        self._gen_lock = threading.Lock()
 
     @property
     def name(self) -> str:  # type: ignore[override]
@@ -213,22 +259,42 @@ class _TimeoutTransport(Transport):
     def _call(self, fn: Callable, *args: Any) -> Any:
         if not self._timeout_s:
             return fn(*args)
-        box: List[Any] = [None, None]
+        with self._gen_lock:
+            self._gen += 1
+            gen = self._gen
+        box: List[Any] = [None, None, False]
+        done = threading.Event()
+        cancel = threading.Event()
 
         def _run() -> None:
+            set_call_cancel_event(cancel)
             try:
-                box[0] = fn(*args)
-            except BaseException as exc:  # noqa: BLE001 — re-raised below
-                box[1] = exc
+                out, exc = fn(*args), None
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                out, exc = None, e
+            finally:
+                set_call_cancel_event(None)
+            with self._gen_lock:
+                if self._gen == gen:
+                    box[0], box[1], box[2] = out, exc, True
+            done.set()
 
         t = threading.Thread(target=_run, daemon=True)
         t.start()
-        t.join(self._timeout_s)
-        if t.is_alive():
-            raise TransportTimeout(f"collective exceeded {self._timeout_s}s deadline")
-        if box[1] is not None:
-            raise box[1]
-        return box[0]
+        done.wait(self._timeout_s)
+        with self._gen_lock:
+            landed = box[2]
+            if not landed:
+                self._gen += 1  # stamp the call abandoned before the worker can land
+        if landed:
+            if box[1] is not None:
+                raise box[1]
+            return box[0]
+        cancel.set()
+        reset = getattr(self._inner, "reset", None)
+        if reset is not None:
+            reset()
+        raise TransportTimeout(f"collective exceeded {self._timeout_s}s deadline")
 
     def allgather(self, x: np.ndarray) -> List[np.ndarray]:
         return self._call(self._inner.allgather, x)
@@ -431,6 +497,25 @@ def _plan_has_lossy(plan: TransferPlan) -> bool:
     return any(not get_codec(lf.codec_name).lossless for lf in plan.leaves if lf.route != "skip")
 
 
+def _backoff_s(cfg: CommConfig, attempt: int, rank: int) -> float:
+    """Deterministic rank-seeded decorrelated backoff jitter.
+
+    N ranks that lost the same peer fail the same collective at the same
+    instant; a jitter-free ladder would retry them in lockstep. Seeding the
+    jitter from ``(rank, attempt)`` de-synchronises the retry storm while
+    staying bit-reproducible in tests — no wall-clock randomness.
+    """
+    base = cfg.backoff_base_s * (2**attempt)
+    rng = np.random.default_rng(int(rank + 1) * 1_000_003 + int(attempt))
+    return float(min(cfg.backoff_max_s, base * (0.5 + rng.random())))
+
+
+def _record_peer_liveness(view: WorldView) -> None:
+    lost = set(view.lost())
+    for peer in range(view.world):
+        _obs.record_comm_peer_live(peer, peer not in lost)
+
+
 def sync_pytree(
     state: Dict[str, Any],
     reductions: Dict[str, Any],
@@ -448,45 +533,111 @@ def sync_pytree(
     """
     cfg = config or get_config()
     tr = transport or cfg.transport or default_transport()
-    report = SyncReport(site=site, world=tr.world_size())
+    world_full = tr.world_size()
+    report = SyncReport(site=site, world=world_full)
 
-    plan = build_plan(state, reductions, cfg.policy, chunk_bytes=cfg.chunk_bytes, coalesce=cfg.coalesce)
+    # membership engages only on capable transports with a real world — the
+    # happy path's whole cost is these attr-loads plus one has_lost() check
+    mview: Optional[WorldView] = None
+    if cfg.membership and world_full > 1 and getattr(tr, "supports_membership", False):
+        mview = view_for(tr)
+
+    plan = build_plan(
+        state, reductions, cfg.policy, chunk_bytes=cfg.chunk_bytes, coalesce=cfg.coalesce, world=world_full
+    )
     steps: List[Tuple[str, CodecPolicy]] = [("full", cfg.policy)]
     if _plan_has_lossy(plan):
         steps.append(("lossless_only", cfg.policy.all_lossless()))
 
+    rank = getattr(tr, "rank", None) or 0
+    quorum = max(2, int(cfg.min_quorum))
+    agree_deadline = cfg.membership_deadline_s or cfg.timeout_s or 1.0
+    subset_recorded = False
+
     with _obs.comm_span("comm.sync", site=site, world=report.world):
-        for step_idx, (step_name, policy) in enumerate(steps):
-            step_plan = (
-                plan
-                if step_name == "full"
-                else build_plan(state, reductions, policy, chunk_bytes=cfg.chunk_bytes, coalesce=cfg.coalesce)
-            )
-            for attempt in range(cfg.max_retries + 1):
-                metered = _MeteredTransport(_TimeoutTransport(tr, cfg.timeout_s))
+        # bounded (agreement + execution) passes: a degraded episode's live set
+        # can only shrink, so the ladder always terminates
+        for _pass in range(world_full + cfg.max_retries + 2):
+            agreed: Optional[Tuple[int, ...]] = None
+            if mview is not None and mview.has_lost():
+                # known-lost peers: agree BEFORE payload, so the sync never
+                # stalls a full-world deadline on a peer it knows is gone —
+                # and a rejoiner's board deposit gets picked up right here
                 try:
-                    synced, raw = _execute_plan(step_plan, state, reductions, metered)
-                except PeerLostError:
-                    break  # membership broke: same-step retries cannot succeed
-                except TransportTimeout:
-                    report.timeouts += 1
-                    _obs.record_comm_timeout(site)
-                except TransportError:
-                    pass
-                else:
-                    report.raw_bytes = raw
-                    report.wire_bytes = metered.sent_bytes
-                    _obs.record_comm_payload(site, raw, metered.sent_bytes)
-                    _obs.set_comm_stale(site, False)
-                    _publish(report, cfg)
-                    return synced
-                if attempt < cfg.max_retries:
-                    report.retries += 1
-                    _obs.record_comm_retry(site)
-                    time.sleep(min(cfg.backoff_max_s, cfg.backoff_base_s * (2**attempt)))
-            if step_idx + 1 < len(steps):
-                report.degraded_step = steps[step_idx + 1][0]
-                _obs.record_comm_degradation(site, steps[step_idx + 1][0])
+                    agreed = _membership.agree_live_set(tr, mview, deadline_s=agree_deadline)
+                except MembershipError:
+                    break
+                _record_peer_liveness(mview)
+                if len(agreed) < quorum:
+                    break
+            subset_mode = agreed is not None and len(agreed) < world_full
+            exec_tr: Transport = tr.subset(agreed) if subset_mode else tr  # type: ignore[attr-defined]
+            if subset_mode and not subset_recorded:
+                subset_recorded = True
+                _obs.record_comm_degradation(site, "live_subset")
+                _obs.record_comm_partial_sync(site)
+            # the live_subset rung sits between lossless_only and local_state:
+            # subset execution is lossless-only by construction
+            pass_steps = [("live_subset", cfg.policy.all_lossless())] if subset_mode else steps
+            # ONE deadline wrapper per pass: its generation stamp spans retries,
+            # so an abandoned attempt's late completion is always discarded
+            deadline_tr = _TimeoutTransport(exec_tr, cfg.timeout_s)
+            failure: Optional[BaseException] = None
+            for step_idx, (step_name, policy) in enumerate(pass_steps):
+                step_plan = (
+                    plan
+                    if step_name == "full"
+                    else build_plan(
+                        state,
+                        reductions,
+                        policy,
+                        chunk_bytes=cfg.chunk_bytes,
+                        coalesce=cfg.coalesce,
+                        world=exec_tr.world_size(),
+                    )
+                )
+                for attempt in range(cfg.max_retries + 1):
+                    metered = _MeteredTransport(deadline_tr)
+                    try:
+                        synced, raw = _execute_plan(step_plan, state, reductions, metered)
+                    except PeerLostError as exc:
+                        failure = exc
+                        if mview is not None and exc.peers:
+                            mview.mark_lost(exc.peers)
+                            _record_peer_liveness(mview)
+                        break  # membership broke: same-step retries cannot succeed
+                    except TransportTimeout as exc:
+                        failure = exc
+                        report.timeouts += 1
+                        _obs.record_comm_timeout(site)
+                    except TransportError as exc:
+                        failure = exc
+                    else:
+                        if subset_mode:
+                            report.degraded_step = "live_subset"
+                            report.peers_lost = tuple(r for r in range(world_full) if r not in agreed)
+                            report.world_live = len(agreed)
+                        else:
+                            report.world_live = world_full
+                            if agreed is not None:
+                                report.degraded_step = "none"  # world fully restored
+                        report.raw_bytes = raw
+                        report.wire_bytes = metered.sent_bytes
+                        _obs.record_comm_payload(site, raw, metered.sent_bytes)
+                        _obs.set_comm_stale(site, False)
+                        _publish(report, cfg)
+                        return synced
+                    if attempt < cfg.max_retries:
+                        report.retries += 1
+                        _obs.record_comm_retry(site)
+                        time.sleep(_backoff_s(cfg, attempt, rank))
+                if isinstance(failure, PeerLostError) and mview is not None:
+                    break  # live_subset is the next rung: go re-agree
+                if step_idx + 1 < len(pass_steps):
+                    report.degraded_step = pass_steps[step_idx + 1][0]
+                    _obs.record_comm_degradation(site, pass_steps[step_idx + 1][0])
+            if mview is None or not mview.has_lost():
+                break  # no membership signal to act on: the ladder is exhausted
 
     # ladder exhausted: serve local state, flagged stale
     if not cfg.degrade:
@@ -494,6 +645,8 @@ def sync_pytree(
         raise TransportError(f"comm sync at {site!r} failed after the full retry ladder (degrade=False)")
     report.degraded_step = "local_state"
     report.stale = True
+    if mview is not None:
+        report.peers_lost = mview.lost()
     _obs.record_comm_degradation(site, "local_state")
     _obs.set_comm_stale(site, True)
     _publish(report, cfg)

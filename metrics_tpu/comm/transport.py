@@ -24,15 +24,26 @@ Concrete transports:
 Failure vocabulary: :class:`TransportError` (transient collective failure),
 :class:`TransportTimeout` (a peer stalled past the deadline),
 :class:`PeerLostError` (membership broke — retrying the same world cannot
-succeed). The plane's ladder treats them uniformly except that a lost peer
+succeed; carries the *attributed* straggler ranks when the transport knows
+them). The plane's ladder treats them uniformly except that a lost peer
 skips straight past same-step retries.
+
+Membership-capable transports (``supports_membership = True``) additionally
+expose the primitives :mod:`metrics_tpu.comm.membership` builds its two-phase
+live-set agreement on: ``membership_exchange`` (a deadlined, watermarked
+deposit/collect board that cannot deadlock on dead peers), ``subset(ranks)``
+(a transport over an agreed sub-world), and ``reset()`` (repair a world whose
+barriers an aborted round broke). :class:`LoopbackWorld` implements all three;
+the real :class:`MultihostTransport` does not (agreement over a multi-controller
+job needs an out-of-band store), so the plane's ``live_subset`` rung simply
+does not engage there.
 """
 
 from __future__ import annotations
 
 import threading
 import time
-from typing import Any, Callable, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -62,7 +73,16 @@ class TransportTimeout(TransportError):
 
 
 class PeerLostError(TransportError):
-    """Membership degraded — a peer is gone; retrying the same world cannot succeed."""
+    """Membership degraded — a peer is gone; retrying the same world cannot succeed.
+
+    ``peers`` carries the attributed straggler/dead ranks when the transport can
+    name them (empty when it can't) — the membership layer's suspicion counters
+    feed on exactly this attribution.
+    """
+
+    def __init__(self, message: str = "peer left the membership", peers: Sequence[int] = ()) -> None:
+        super().__init__(message)
+        self.peers: Tuple[int, ...] = tuple(sorted(int(p) for p in peers))
 
 
 class Transport:
@@ -70,6 +90,7 @@ class Transport:
 
     name = "transport"
     supports_broadcast = False
+    supports_membership = False
 
     def world_size(self) -> int:
         raise NotImplementedError
@@ -132,6 +153,27 @@ class MultihostTransport(Transport):
         return np.asarray(multihost_utils.broadcast_one_to_all(payload, is_source=is_source))
 
 
+# --------------------------------------------------------------- call cancellation
+
+# Cooperative abandonment channel for deadlined collectives: the plane's
+# deadline wrapper runs each collective in a worker thread and, on timeout,
+# sets the worker's cancel event. A real multihost collective cannot observe
+# it (no abort exists), but the in-process transports check it before touching
+# shared barriers — so a late-completing abandoned call can never deposit into
+# a fresh attempt's round.
+_CALL_CANCEL = threading.local()
+
+
+def set_call_cancel_event(event: Optional[threading.Event]) -> None:
+    """Install (or clear) the current thread's collective-cancel event."""
+    _CALL_CANCEL.event = event
+
+
+def current_call_cancelled() -> bool:
+    event = getattr(_CALL_CANCEL, "event", None)
+    return event is not None and event.is_set()
+
+
 # --------------------------------------------------------------------- loopback world
 
 
@@ -142,7 +184,11 @@ class LoopbackWorld:
 
     Every rank must make the same sequence of collective calls; a rank that
     falls behind past ``timeout`` breaks the barrier and every participant
-    raises :class:`TransportTimeout` instead of deadlocking.
+    raises an *attributed* :class:`PeerLostError` naming the rank(s) that fell
+    behind (or :class:`TransportTimeout` when no straggler can be named)
+    instead of deadlocking. :meth:`reset` repairs the broken barriers so the
+    world survives an aborted round, and the world carries the membership
+    primitives (deposit board, sub-world groups) the agreement protocol needs.
     """
 
     def __init__(self, world: int, timeout: float = 30.0) -> None:
@@ -153,6 +199,94 @@ class LoopbackWorld:
         self._deposit_barrier = threading.Barrier(world)
         self._read_barrier = threading.Barrier(world)
         self._slots: List[Optional[np.ndarray]] = [None] * world
+        # monotonic per-rank collective-entry counters: after a barrier abort,
+        # the ranks with strictly fewer arrivals than the observer are the ones
+        # that never showed up — that's the straggler attribution
+        self._arrivals = [0] * world
+        self._generation = 0
+        self._state_lock = threading.Lock()
+        # membership board: phase -> per-rank (seq, payload) cells, under one
+        # condition; seq is a global monotonic stamp so readers can tell a
+        # fresh deposit from last round's leftovers via per-reader watermarks
+        self._mb_cond = threading.Condition()
+        self._mb_seq = 0
+        self._mb_cells: Dict[str, List[Optional[Tuple[int, Any]]]] = {}
+        self._subgroups: Dict[Tuple[int, ...], "_SubGroup"] = {}
+
+    def reset(self) -> None:
+        """Repair the world after an aborted or abandoned round.
+
+        Both barriers are reset unconditionally (kicking any abandoned waiter a
+        deadline-expired collective left behind — it raises instead of
+        occupying a barrier seat in the next round), slots are cleared, and the
+        world generation is bumped so an exchange that straddles the reset
+        fails loudly instead of pairing with the next round's deposits.
+        """
+        with self._state_lock:
+            self._generation += 1
+            self._deposit_barrier.reset()
+            self._read_barrier.reset()
+            self._slots = [None] * self.world
+            groups = list(self._subgroups.values())
+        for g in groups:
+            g.repair()
+
+    # ---------------------------------------------------------- membership board
+
+    def deposit_membership(self, rank: int, phase: str, payload: Any) -> int:
+        with self._mb_cond:
+            self._mb_seq += 1
+            cells = self._mb_cells.setdefault(phase, [None] * self.world)
+            cells[rank] = (self._mb_seq, payload)
+            self._mb_cond.notify_all()
+            return self._mb_seq
+
+    def collect_membership(
+        self,
+        rank: int,
+        phase: str,
+        expected: Sequence[int],
+        deadline_s: float,
+        watermarks: Dict[int, int],
+        grace_s: float = 0.0,
+    ) -> Dict[int, Tuple[int, Any]]:
+        """Wait until every ``expected`` rank has a deposit fresher than its
+        watermark (holding a further ``grace_s`` for opportunistic deposits from
+        ranks *outside* ``expected`` — that is how rejoiners get noticed), or
+        ``deadline_s`` expires; return every fresh deposit seen, by rank."""
+        start = time.monotonic()
+        deadline = start + deadline_s
+        grace_end = start + min(grace_s, deadline_s)
+        expected = [int(r) for r in expected]
+        with self._mb_cond:
+            while True:
+                cells = self._mb_cells.get(phase) or []
+                fresh = {
+                    r: cell
+                    for r, cell in enumerate(cells)
+                    if cell is not None and cell[0] > watermarks.get(r, -1)
+                }
+                now = time.monotonic()
+                have_expected = all(r in fresh or r == rank for r in expected)
+                if have_expected and now >= grace_end:
+                    return fresh
+                if now >= deadline:
+                    return fresh
+                horizon = grace_end if have_expected else deadline
+                self._mb_cond.wait(timeout=max(1e-4, horizon - now))
+
+    # ---------------------------------------------------------- sub-world groups
+
+    def subgroup(self, members: Tuple[int, ...]) -> "_SubGroup":
+        members = tuple(sorted(int(m) for m in members))
+        if not members or any(not 0 <= m < self.world for m in members):
+            raise ValueError(f"subgroup members {members} outside world {self.world}")
+        with self._state_lock:
+            group = self._subgroups.get(members)
+            if group is None:
+                group = _SubGroup(self, members)
+                self._subgroups[members] = group
+            return group
 
     def transport(self, rank: int) -> "_LoopbackTransport":
         if not 0 <= rank < self.world:
@@ -186,7 +320,74 @@ class LoopbackWorld:
         return results
 
     def _exchange(self, rank: int, x: Optional[np.ndarray]) -> List[Optional[np.ndarray]]:
+        if current_call_cancelled():
+            raise TransportError(f"loopback rank {rank}: abandoned deadline-expired collective discarded")
+        with self._state_lock:
+            self._arrivals[rank] += 1
+            gen = self._generation
         self._slots[rank] = None if x is None else np.asarray(x)
+        try:
+            self._deposit_barrier.wait(self.timeout)
+            out = list(self._slots)
+            self._read_barrier.wait(self.timeout)
+        except threading.BrokenBarrierError:
+            with self._state_lock:
+                same_gen = self._generation == gen
+                me = self._arrivals[rank]
+                stragglers = [r for r in range(self.world) if r != rank and self._arrivals[r] < me]
+            if same_gen:
+                # only break the round we were actually part of — if a reset
+                # already repaired the world, the fresh barriers stay usable
+                self._deposit_barrier.abort()
+                self._read_barrier.abort()
+            if stragglers:
+                raise PeerLostError(
+                    f"loopback rank {rank}: peers {stragglers} fell behind mid-collective",
+                    peers=stragglers,
+                ) from None
+            raise TransportTimeout(f"loopback rank {rank}: a peer stalled or died mid-collective") from None
+        if self._generation != gen:
+            raise TransportError(f"loopback rank {rank}: world reset mid-collective (stale exchange discarded)")
+        return out
+
+
+class _SubGroup:
+    """A sub-world of a :class:`LoopbackWorld`: its own barrier pair and slots
+    over a fixed member tuple, so an agreed live subset can run the real wire
+    protocols without the dead ranks' barrier seats. Cached per member tuple on
+    the parent world — every survivor computes the same agreed set, so every
+    survivor lands on the same group object."""
+
+    def __init__(self, world: LoopbackWorld, members: Tuple[int, ...]) -> None:
+        self.members = members
+        self.timeout = world.timeout
+        self._index = {g: i for i, g in enumerate(members)}
+        n = len(members)
+        self._deposit_barrier = threading.Barrier(n)
+        self._read_barrier = threading.Barrier(n)
+        self._slots: List[Optional[np.ndarray]] = [None] * n
+        self._arrivals = [0] * n
+        self._lock = threading.Lock()
+
+    def repair(self) -> None:
+        with self._lock:
+            self._deposit_barrier.reset()
+            self._read_barrier.reset()
+            self._slots = [None] * len(self.members)
+
+    def transport(self, global_rank: int) -> "_LoopbackSubTransport":
+        if global_rank not in self._index:
+            raise ValueError(f"rank {global_rank} is not a member of subgroup {self.members}")
+        return _LoopbackSubTransport(self, global_rank)
+
+    def _exchange(self, idx: int, x: Optional[np.ndarray]) -> List[Optional[np.ndarray]]:
+        if current_call_cancelled():
+            raise TransportError(
+                f"loopback subgroup {self.members}: abandoned deadline-expired collective discarded"
+            )
+        with self._lock:
+            self._arrivals[idx] += 1
+        self._slots[idx] = None if x is None else np.asarray(x)
         try:
             self._deposit_barrier.wait(self.timeout)
             out = list(self._slots)
@@ -194,13 +395,61 @@ class LoopbackWorld:
         except threading.BrokenBarrierError:
             self._deposit_barrier.abort()
             self._read_barrier.abort()
-            raise TransportTimeout(f"loopback rank {rank}: a peer stalled or died mid-collective") from None
+            with self._lock:
+                me = self._arrivals[idx]
+                stragglers = [self.members[i] for i in range(len(self.members)) if i != idx and self._arrivals[i] < me]
+            if stragglers:
+                raise PeerLostError(
+                    f"loopback subgroup {self.members}: peers {stragglers} fell behind mid-collective",
+                    peers=stragglers,
+                ) from None
+            raise TransportTimeout(
+                f"loopback subgroup {self.members}: a peer stalled or died mid-collective"
+            ) from None
         return out
+
+
+class _LoopbackSubTransport(Transport):
+    """Transport over an agreed sub-world: global ranks map to dense subset
+    indices, ``world_size()`` is the subset size, and plan execution runs
+    unchanged (plans are laid out against ``transport.world_size()``)."""
+
+    name = "loopback_subset"
+    supports_broadcast = True
+
+    def __init__(self, group: _SubGroup, global_rank: int) -> None:
+        self._group = group
+        self.global_rank = global_rank
+        self.rank = group._index[global_rank]  # subset index: what plan roots mean
+
+    @property
+    def members(self) -> Tuple[int, ...]:
+        return self._group.members
+
+    def reset(self) -> None:
+        self._group.repair()
+
+    def world_size(self) -> int:
+        return len(self._group.members)
+
+    def allgather(self, x: np.ndarray) -> List[np.ndarray]:
+        rows = self._group._exchange(self.rank, np.asarray(x))
+        if any(r is None for r in rows):
+            raise TransportError(f"loopback subgroup {self.members}: a peer deposited nothing")
+        return [np.asarray(r) for r in rows]
+
+    def broadcast_from(self, x: Optional[np.ndarray], root: int, shape: Any, dtype: Any) -> np.ndarray:
+        rows = self._group._exchange(self.rank, x if self.rank == root else None)
+        got = rows[root]
+        if got is None:
+            raise TransportError(f"loopback subgroup {self.members}: root {root} deposited nothing")
+        return np.asarray(got)
 
 
 class _LoopbackTransport(Transport):
     name = "loopback"
     supports_broadcast = True
+    supports_membership = True
 
     def __init__(self, world: LoopbackWorld, rank: int) -> None:
         self._world = world
@@ -221,6 +470,33 @@ class _LoopbackTransport(Transport):
         if got is None:
             raise TransportError(f"loopback rank {self.rank}: root {root} deposited nothing")
         return np.asarray(got)
+
+    # ------------------------------------------------------ membership primitives
+
+    def reset(self) -> None:
+        self._world.reset()
+
+    def membership_exchange(
+        self,
+        phase: str,
+        payload: Any,
+        *,
+        deadline_s: float,
+        expected: Sequence[int],
+        watermarks: Dict[int, int],
+        grace_s: float = 0.0,
+    ) -> Dict[int, Tuple[int, Any]]:
+        """Deposit ``payload`` on the world's membership board under ``phase``
+        and collect every fresh deposit (see ``collect_membership``). Bounded by
+        ``deadline_s`` — a dead peer costs the deadline, never a deadlock."""
+        self._world.deposit_membership(self.rank, phase, payload)
+        return self._world.collect_membership(self.rank, phase, expected, deadline_s, watermarks, grace_s)
+
+    def subset(self, ranks: Sequence[int]) -> Transport:
+        members = tuple(sorted(int(r) for r in ranks))
+        if members == tuple(range(self._world.world)):
+            return self
+        return self._world.subgroup(members).transport(self.rank)
 
 
 # --------------------------------------------------------------------- test fakes
@@ -275,7 +551,29 @@ class ScriptedFakeTransport(Transport):
         return rows
 
 
-class FlakyTransport(Transport):
+class _MembershipPassthrough:
+    """Mixin for wrappers: forward the membership primitives to the wrapped
+    transport so fault injection composes with the agreement protocol."""
+
+    _inner: Transport
+
+    @property
+    def supports_membership(self) -> bool:  # type: ignore[override]
+        return getattr(self._inner, "supports_membership", False)
+
+    def reset(self) -> None:
+        reset = getattr(self._inner, "reset", None)
+        if reset is not None:
+            reset()
+
+    def membership_exchange(self, phase: str, payload: Any, **kwargs: Any) -> Dict[int, Tuple[int, Any]]:
+        return self._inner.membership_exchange(phase, payload, **kwargs)  # type: ignore[attr-defined]
+
+    def subset(self, ranks: Sequence[int]) -> Transport:
+        return self._inner.subset(ranks)  # type: ignore[attr-defined]
+
+
+class FlakyTransport(_MembershipPassthrough, Transport):
     """Raise on the first ``fail`` collective calls, then delegate — the
     transient-failure injector for retry tests."""
 
@@ -313,9 +611,12 @@ class FlakyTransport(Transport):
         return self._inner.broadcast_from(x, root, shape, dtype)
 
 
-class StallTransport(Transport):
+class StallTransport(_MembershipPassthrough, Transport):
     """Sleep ``stall_s`` before the first ``stalls`` collectives complete — what a
-    wedged peer looks like to the plane's deadline."""
+    wedged peer looks like to the plane's deadline. The stalled collective DOES
+    eventually run against the inner transport, which is exactly the
+    late-completion hazard the plane's generation-stamped deadline wrapper must
+    survive."""
 
     name = "stall"
 

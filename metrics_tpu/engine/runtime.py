@@ -1049,7 +1049,11 @@ class StreamingEngine:
             report = _comm_plane.last_report()
             if report is not None and report is not prev and report.site == "engine.compute":
                 conclusive = True
-                if report.stale or report.degraded_step != "none":
+                # live_subset is a SUCCESSFUL sync over the agreed surviving
+                # ranks — exact for cumulative state, not stale. Tripping the
+                # breaker on it would pin sync=False and turn one dead peer
+                # into N disjoint local aggregates, which is strictly worse.
+                if report.stale or report.degraded_step not in ("none", "live_subset"):
                     degraded = True
             prev = report
 

@@ -343,11 +343,19 @@ COMM_TIMEOUTS = REGISTRY.counter(
 )
 COMM_DEGRADATIONS = REGISTRY.counter(
     "metrics_tpu_comm_degradations_total",
-    "Degradation-ladder rungs taken (step=lossless_only|local_state), per site.",
+    "Degradation-ladder rungs taken (step=lossless_only|live_subset|local_state), per site.",
 )
 COMM_STALE = REGISTRY.gauge(
     "metrics_tpu_comm_stale_state",
     "1 while the most recent sync at this site served LOCAL state (ladder bottom), else 0.",
+)
+COMM_PEER_LIVE = REGISTRY.gauge(
+    "metrics_tpu_comm_peer_live",
+    "1 while this process's WorldView believes the labeled peer rank is live, else 0.",
+)
+COMM_PARTIAL_SYNCS = REGISTRY.counter(
+    "metrics_tpu_comm_partial_syncs_total",
+    "Syncs completed over an agreed live subset of the world (the live_subset rung), per site.",
 )
 
 
@@ -382,6 +390,18 @@ def set_comm_stale(site: str, stale: bool) -> None:
     if not OBS.enabled:
         return
     COMM_STALE.set(1.0 if stale else 0.0, site=site)
+
+
+def record_comm_peer_live(peer: int, live: bool) -> None:
+    if not OBS.enabled:
+        return
+    COMM_PEER_LIVE.set(1.0 if live else 0.0, peer=str(peer))
+
+
+def record_comm_partial_sync(site: str) -> None:
+    if not OBS.enabled:
+        return
+    COMM_PARTIAL_SYNCS.inc(1, site=site)
 
 
 def comm_span(name: str, **attrs: Any) -> Any:

@@ -115,10 +115,58 @@ def test_health_view_shape():
         "failovers",
         "lease_renewals",
         "suspicions",
+        "comm_lost_peers",
     }
     assert view["node_id"] == "a" and view["role"] == "leader"
     # a writable stub engine self-elects on the first tick: the lease is live
     assert view["lease_epoch"] == 1 and view["lease_ttl_remaining_s"] > 0
+
+
+def test_comm_suspicion_edge_suspects_peer_before_heartbeat_silence():
+    from metrics_tpu.comm import WorldView
+
+    clock = ManualClock(0.0)
+    store = FakeCoordStore(clock=clock)
+    view = WorldView(2, rank=0)
+    node = _node(store, comm_view=view, peer_ranks={"a": 0, "b": 1})
+    _beat(store, "b", clock())
+    node.tick()
+    assert node.suspicions == 0
+    # an attributed collective failure lands seconds before heartbeats go
+    # silent: the very next tick suspects the peer, heartbeat still fresh
+    view.mark_lost([1])
+    node.tick()
+    assert node.suspicions == 1
+    assert node.health_view()["suspected_peers"] == ["b"]
+    assert node.health_view()["comm_lost_peers"] == ["b"]
+    # the counter is consumed as an edge: the level alone never re-counts
+    node.tick()
+    assert node.suspicions == 1
+    # a committed full-world agreement clears the lost set in health...
+    view.commit([0, 1])
+    _beat(store, "b", clock())
+    node.tick()
+    assert node.health_view()["comm_lost_peers"] == []
+    assert node.health_view()["suspected_peers"] == []
+    # ...and a NEW attributed failure is a new edge
+    view.mark_lost([1])
+    node.tick()
+    assert node.suspicions == 2
+
+
+def test_comm_view_requires_peer_ranks():
+    import pytest
+
+    from metrics_tpu.cluster import ClusterConfigError
+    from metrics_tpu.comm import WorldView
+
+    store = FakeCoordStore(clock=ManualClock(0.0))
+    with pytest.raises(ClusterConfigError):
+        ClusterConfig(node_id="a", store=store, peers=("b",), comm_view=WorldView(2, 0))
+    with pytest.raises(ClusterConfigError):
+        ClusterConfig(
+            node_id="a", store=store, peers=("b",), peer_ranks={"zz": 1}
+        )
 
 
 def test_leader_renews_at_half_ttl_and_steps_down_on_loss():

@@ -317,6 +317,58 @@ class TestFaultLadder:
         _assert_tree_bit_identical(clean, flaky)
 
 
+class TestBackoffJitter:
+    def test_deterministic_per_rank_and_attempt(self):
+        from metrics_tpu.comm.plane import _backoff_s
+
+        cfg = CommConfig(backoff_base_s=0.05, backoff_max_s=2.0)
+        assert _backoff_s(cfg, 1, 3) == _backoff_s(cfg, 1, 3)  # no wall-clock randomness
+
+    def test_ranks_desynchronised_within_bounds(self):
+        from metrics_tpu.comm.plane import _backoff_s
+
+        cfg = CommConfig(backoff_base_s=0.05, backoff_max_s=2.0)
+        vals = {_backoff_s(cfg, 0, r) for r in range(8)}
+        assert len(vals) == 8  # a retry storm never thunders in lockstep
+        for attempt in range(3):
+            for r in range(4):
+                base = 0.05 * 2**attempt
+                b = _backoff_s(cfg, attempt, r)
+                assert 0.5 * base <= b <= min(2.0, 1.5 * base)
+
+    def test_cap_applies(self):
+        from metrics_tpu.comm.plane import _backoff_s
+
+        cfg = CommConfig(backoff_base_s=1.0, backoff_max_s=0.3)
+        assert _backoff_s(cfg, 5, 2) == 0.3
+
+
+class TestOnReportHook:
+    def test_hook_sees_every_published_report(self):
+        seen = []
+        cfg = CommConfig(on_report=seen.append)
+        sync_pytree({"x": jnp.asarray(1.0)}, {"x": "sum"}, transport=ReplicaFakeTransport(2), config=cfg)
+        assert len(seen) == 1 and seen[0].degraded_step == "none"
+        cfg2 = CommConfig(on_report=seen.append, max_retries=0, backoff_base_s=0.001)
+        sync_pytree({"x": jnp.asarray(1.0)}, {"x": "sum"}, transport=DeadPeerTransport(2), config=cfg2)
+        assert len(seen) == 2 and seen[1].stale
+
+    def test_hook_exception_absorbed_and_warned(self):
+        calls = []
+
+        def bad(rep):
+            calls.append(rep)
+            raise RuntimeError("observer bug")
+
+        cfg = CommConfig(on_report=bad)
+        with pytest.warns(UserWarning, match="on_report"):
+            out = sync_pytree(
+                {"x": jnp.asarray(1.0)}, {"x": "sum"}, transport=ReplicaFakeTransport(2), config=cfg
+            )
+        # the sync itself is untouched by the observer crash
+        assert float(out["x"]) == 2.0 and len(calls) == 1
+
+
 class TestConfig:
     def test_use_config_scopes_and_restores(self):
         base = comm.get_config()

@@ -42,7 +42,7 @@ class TestLoopbackWorld:
 
         assert world.run([fn, fn]) == [[0.0, 10.0], [0.0, 10.0]]
 
-    def test_straggler_breaks_barrier_not_deadlock(self):
+    def test_straggler_breaks_barrier_attributed_not_deadlock(self):
         world = LoopbackWorld(2, timeout=0.2)
 
         def fast(t):
@@ -52,8 +52,50 @@ class TestLoopbackWorld:
             time.sleep(1.0)
             return None
 
-        with pytest.raises(TransportTimeout):
+        # the dead rank never arrives at the collective, so the survivor's
+        # barrier break is *attributed*: PeerLostError naming rank 1, not a
+        # bare timeout — that attribution is what feeds WorldView suspicion
+        with pytest.raises(PeerLostError) as ei:
             world.run([fast, dead])
+        assert ei.value.peers == (1,)
+
+    def test_reset_repairs_world_after_aborted_round(self):
+        world = LoopbackWorld(2, timeout=0.2)
+
+        def fast(t):
+            return t.allgather(np.zeros(1))
+
+        def dead(t):
+            time.sleep(0.6)
+            return None
+
+        with pytest.raises(PeerLostError):
+            world.run([fast, dead])
+        world.reset()
+        out = world.run([lambda t: t.allgather(np.full(1, t.rank)) for _ in range(2)])
+        for rows in out:
+            assert [float(r[0]) for r in rows] == [0.0, 1.0]
+
+    def test_reset_mid_collective_discards_stale_exchange(self):
+        world = LoopbackWorld(2, timeout=2.0)
+        entered = threading.Event()
+        failures = []
+
+        def waiter(t):
+            entered.set()
+            try:
+                t.allgather(np.zeros(1))
+            except TransportError as exc:
+                failures.append(exc)
+
+        th = threading.Thread(target=waiter, args=(world.transport(0),), daemon=True)
+        th.start()
+        entered.wait(1.0)
+        time.sleep(0.05)  # let rank 0 reach the barrier
+        world.reset()  # kick the waiter off its seat
+        th.join(2.0)
+        assert not th.is_alive()
+        assert len(failures) == 1  # raised, did not deadlock and did not return peer data
 
 
 class TestFaultInjectors:

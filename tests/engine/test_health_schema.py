@@ -54,6 +54,7 @@ CLUSTER_KEYS = {
     "failovers",
     "lease_renewals",
     "suspicions",
+    "comm_lost_peers",
 }
 
 

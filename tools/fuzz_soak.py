@@ -1988,6 +1988,274 @@ def soak_shard(seeds) -> None:
             twin.close()
 
 
+# ---------------------------------------------------------------------- comm surface
+
+
+def _comm_oracle(states, reductions):
+    """Centralized reduce over exactly the given rank states — what a correct
+    sync over that member set must equal, bit for bit."""
+    from metrics_tpu.utils.data import dim_zero_cat
+
+    out = {}
+    names = set()
+    for st in states:
+        names |= set(st)
+    for name in names:
+        red = reductions.get(name, "sum" if name == "_update_count" else None)
+        rows = []
+        for st in states:
+            v = st[name]
+            rows.append(dim_zero_cat(v) if isinstance(v, list) else jnp.asarray(v))
+        if name == "_update_count" and "_update_count" not in reductions:
+            out[name] = jnp.sum(jnp.stack(rows), axis=0)
+        elif red in ("sum", "mean", "max", "min"):
+            op = {"sum": jnp.sum, "mean": jnp.mean, "max": jnp.max, "min": jnp.min}[red]
+            out[name] = op(jnp.stack(rows), axis=0)
+        elif red == "cat":
+            cat = jnp.concatenate(rows, axis=0)
+            out[name] = [cat] if isinstance(states[0][name], list) else cat
+        elif callable(red):
+            out[name] = red(jnp.stack(rows))
+        else:
+            out[name] = jnp.stack(rows)
+    return out
+
+
+_COMM_REDS = {
+    "total": "sum",
+    "hits": "sum",
+    "avg": "mean",
+    "peak": "max",
+    "floor": "min",
+    "preds": "cat",  # ragged across ranks
+    "vals": "cat",  # list ('cat') state
+    "snap": None,  # stack
+    # mergeable-ledger callable (the sketch plane's merge contract)
+    "ledger": lambda g: jnp.max(g, axis=0) + jnp.sum(g, axis=0) * 0.0,
+}
+
+
+def _comm_state(rng):
+    return {
+        "total": jnp.asarray(rng.standard_normal(), jnp.float32),
+        "hits": jnp.asarray(rng.integers(0, 100, 5), jnp.int32),
+        "avg": jnp.asarray(rng.standard_normal(3), jnp.float32),
+        "peak": jnp.asarray(rng.standard_normal(4), jnp.float32),
+        "floor": jnp.asarray(rng.standard_normal(4), jnp.float32),
+        "preds": jnp.asarray(rng.standard_normal((int(rng.integers(1, 6)), 2)), jnp.float32),
+        "vals": [jnp.asarray(rng.standard_normal(int(rng.integers(1, 4))), jnp.float32)],
+        "snap": jnp.asarray(rng.standard_normal(2), jnp.float32),
+        "ledger": jnp.asarray(rng.standard_normal(6), jnp.float32),
+        "_update_count": jnp.asarray(int(rng.integers(1, 5))),
+    }
+
+
+def _comm_tree_equal(a, b):
+    if set(a) != set(b):
+        raise AssertionError(f"key sets differ: {sorted(a)} vs {sorted(b)}")
+    for k in a:
+        va, vb = a[k], b[k]
+        if isinstance(va, list):
+            assert isinstance(vb, list) and len(va) == len(vb), f"{k}: list arity"
+            for xa, xb in zip(va, vb):
+                np.testing.assert_array_equal(np.asarray(xa), np.asarray(xb))
+        else:
+            np.testing.assert_array_equal(np.asarray(va), np.asarray(vb))
+
+
+def soak_comm(seeds) -> None:
+    """Partition-chaos soak for the comm membership plane (ISSUE 12): an
+    N-rank LoopbackWorld where a random subset of ranks is dead and one may
+    stall past every deadline mid-round. The surviving ranks must agree on the
+    SAME live set, complete the round at ``live_subset`` bit-identical to a
+    centralized oracle over exactly the survivors (every reduction family:
+    sum/mean/max/min/ragged cat/list cat/stack/callable ledger merge), report
+    matching ``peers_lost``, and never deadlock; ``local_state`` may appear
+    only below ``min_quorum`` (every third seed raises the quorum above the
+    survivor count and demands exactly that honest refusal). The heal round
+    readmits everyone — dead ranks rejoin via ``suspect_all`` like a restarted
+    process — and must equal the full-world oracle over the CUMULATIVE states:
+    rejoin with no double count and no loss. Self-oracled — needs no reference
+    checkout."""
+    import threading
+    from dataclasses import replace
+
+    from metrics_tpu.comm import (
+        CommConfig,
+        LoopbackWorld,
+        StallTransport,
+        sync_pytree,
+        view_for,
+    )
+
+    def run_ranks(fns, tag, seed, join_s=30.0):
+        results, errors = {}, {}
+
+        def _runner(r, fn):
+            try:
+                results[r] = fn()
+            except BaseException as exc:  # noqa: BLE001 — judged by the caller
+                errors[r] = exc
+
+        threads = {r: threading.Thread(target=_runner, args=(r, fn), daemon=True)
+                   for r, fn in fns.items()}
+        for t in threads.values():
+            t.start()
+        for t in threads.values():
+            t.join(join_s)
+        stuck = [r for r, t in threads.items() if t.is_alive()]
+        if stuck:
+            FAILS.append((seed, tag, f"DEADLOCK: ranks {stuck} never returned"))
+        return results, errors
+
+    for seed in seeds:
+        rng = np.random.default_rng(seed)
+        world_n = int(rng.integers(3, 6))
+        quorum_leg = seed % 3 == 0
+        if quorum_leg:
+            dead = {int(rng.integers(0, world_n))}
+            stall = None  # quorum refusal is the point; keep the draw clean
+        else:
+            dead = set(int(x) for x in rng.choice(world_n, size=int(rng.integers(0, 2)), replace=False))
+            can_stall = world_n - len(dead) > 2  # keep >= 2 true survivors
+            stall = (int(rng.choice([r for r in range(world_n) if r not in dead]))
+                     if can_stall and rng.integers(0, 2) else None)
+        lost = tuple(sorted(dead | ({stall} if stall is not None else set())))
+        survivors = [r for r in range(world_n) if r not in lost]
+        min_q = len(survivors) + 1 if quorum_leg else 2
+        tag = (f"comm/{'quorum' if quorum_leg else 'chaos'} world={world_n} "
+               f"dead={sorted(dead)} stall={stall} seed={seed}")
+
+        world = LoopbackWorld(world_n, timeout=0.25)
+        base = CommConfig(timeout_s=0.6, max_retries=1, backoff_base_s=0.02,
+                          backoff_max_s=0.1, membership_deadline_s=0.6, min_quorum=min_q)
+        heal_cfg = replace(base, min_quorum=2)
+        round1 = {r: _comm_state(rng) for r in range(world_n)}
+        # cumulative growth between rounds: state only accumulates, so the heal
+        # round syncing full CUMULATIVE state is what makes rejoin exact
+        round2 = {
+            r: {k: ([v[0] + 1.0] if isinstance(v, list) else jnp.asarray(v) + 1)
+                for k, v in round1[r].items()}
+            for r in range(world_n)
+        }
+        transports = {}
+        for r in range(world_n):
+            t = world.transport(r)
+            transports[r] = StallTransport(t, stall_s=1.5, stalls=1) if r == stall else t
+        reports: dict = {}
+        clean: dict = {}
+        HEAL_ROUNDS = 6
+        gate = threading.Barrier(world_n)
+
+        def run_rank(r):
+            out = {"heal": []}
+            cfg1 = replace(base, on_report=lambda rep, r=r: reports.__setitem__(("r1", r), rep))
+            if r not in dead:
+                out["r1"] = sync_pytree(round1[r], _COMM_REDS, transport=transports[r],
+                                        config=cfg1, site="soak.comm")
+            gate.wait(timeout=30)
+            if r in dead:
+                view_for(transports[r]).suspect_all()  # a restarted process trusts nobody
+            # heal: cumulative state makes re-syncing idempotent, so every rank
+            # keeps syncing in lockstep until ALL ranks complete a clean
+            # full-world round (a rejoiner is only guaranteed admission at a
+            # SUBSEQUENT round boundary, not the one it reappears in)
+            for _ in range(HEAL_ROUNDS):
+                holder = {}
+                cfg = replace(heal_cfg, on_report=lambda rep, h=holder: h.__setitem__("rep", rep))
+                res = sync_pytree(round2[r], _COMM_REDS, transport=transports[r],
+                                  config=cfg, site="soak.comm")
+                out["heal"].append((holder.get("rep"), res))
+                clean[r] = holder.get("rep") is not None and holder["rep"].degraded_step == "none"
+                gate.wait(timeout=30)
+                done = all(clean.get(x, False) for x in range(world_n))
+                gate.wait(timeout=30)  # everyone reads `done` before the next round writes
+                if done:
+                    break
+            return out
+
+        results, errors = run_ranks({r: (lambda r=r: run_rank(r)) for r in range(world_n)}, tag, seed)
+        for r, exc in errors.items():
+            FAILS.append((seed, tag, f"rank {r} raised: {repr(exc)[:140]}"))
+        if errors or len(results) != world_n:
+            continue
+
+        def check_exact(rep, res, states, what, r):
+            """A successful (non-stale) report must be bit-equal to the
+            centralized oracle over exactly the member set it claims — the
+            exactness contract that must hold on EVERY rung above local."""
+            live = tuple(x for x in range(world_n) if x not in rep.peers_lost)
+            if rep.stale:
+                FAILS.append((seed, tag, f"rank {r} {what}: successful rung flagged stale"))
+            try:
+                _comm_tree_equal(res, _comm_oracle([states[x] for x in live], _COMM_REDS))
+            except AssertionError as exc:
+                FAILS.append((seed, tag, f"rank {r} {what} != oracle over {live}: {repr(exc)[:140]}"))
+            return live
+
+        # round 1: dead ranks never deposited and the stalled rank slept
+        # through every deadline — neither may appear in any agreed set, no
+        # rank may claim a clean full world, and whatever set WAS agreed must
+        # be synced exactly; local_state is allowed only as an honest (stale)
+        # refusal — and on the quorum leg it is REQUIRED of every survivor
+        for r in range(world_n):
+            if r in dead:
+                continue
+            rep = reports.get(("r1", r))
+            if rep is None:
+                FAILS.append((seed, tag, f"rank {r} published no round-1 report"))
+                continue
+            if rep.degraded_step == "local_state":
+                if not rep.stale:
+                    FAILS.append((seed, tag, f"rank {r} round-1 local_state not flagged stale"))
+                continue
+            if quorum_leg:
+                FAILS.append((seed, tag, f"rank {r} synced at {rep.degraded_step!r} below min_quorum={min_q}"))
+                continue
+            if lost and rep.degraded_step == "none":
+                FAILS.append((seed, tag, f"rank {r} claims a clean full world with {lost} down"))
+                continue
+            live = check_exact(rep, results[r]["r1"], round1, "round 1", r)
+            for l in lost:
+                if l in live:
+                    FAILS.append((seed, tag, f"rank {r} round-1 agreed set includes absent rank {l}"))
+        if not quorum_leg and len(survivors) >= 2:
+            ok = sum(1 for r in survivors
+                     if reports.get(("r1", r)) is not None
+                     and reports[("r1", r)].degraded_step in ("none", "live_subset"))
+            if ok < 2:
+                FAILS.append((seed, tag, f"only {ok} survivor(s) completed round 1 above local_state"))
+        if stall is not None:
+            rep = reports.get(("r1", stall))
+            if rep is None or rep.degraded_step != "local_state" or not rep.stale:
+                FAILS.append((seed, tag, f"stalled rank report {rep!r}, expected stale local_state"))
+
+        # heal rounds: every intermediate round is exact over its agreed set
+        # (split-brain subsets each exact over themselves, honestly reported);
+        # the FINAL round must be a clean full-world sync on every rank, equal
+        # to the cumulative full-world oracle — rejoin with no double count
+        oracle2 = _comm_oracle([round2[r] for r in range(world_n)], _COMM_REDS)
+        for r in range(world_n):
+            rounds = results[r]["heal"]
+            for i, (rep, res) in enumerate(rounds[:-1]):
+                if rep is None:
+                    FAILS.append((seed, tag, f"rank {r} heal round {i} published no report"))
+                elif rep.degraded_step == "local_state":
+                    if not rep.stale:
+                        FAILS.append((seed, tag, f"rank {r} heal round {i} local_state not stale"))
+                else:
+                    check_exact(rep, res, round2, f"heal round {i}", r)
+            rep, res = rounds[-1]
+            if rep is None or rep.degraded_step != "none" or rep.stale or rep.peers_lost != ():
+                FAILS.append((seed, tag, f"rank {r} never healed to a clean full world "
+                              f"in {len(rounds)} rounds: {rep!r}"))
+                continue
+            try:
+                _comm_tree_equal(res, oracle2)
+            except AssertionError as exc:
+                FAILS.append((seed, tag, f"rank {r} healed round != full-world oracle: {repr(exc)[:140]}"))
+
+
 SURFACES = {
     "classification": soak_classification,
     "regression_retrieval": soak_regression_retrieval,
@@ -2005,14 +2273,15 @@ SURFACES = {
     "sketch": soak_sketch,
     "cluster": soak_cluster,
     "shard": soak_shard,
+    "comm": soak_comm,
 }
 
 # surfaces that execute the reference as their oracle (everything except the
 # self-oracled engine, ckpt crash-recovery, guard chaos, repl, sketch,
-# cluster and shard surfaces)
+# cluster, shard and comm surfaces)
 _NEEDS_REF = {
     name for name in SURFACES
-    if name not in ("engine", "ckpt", "guard", "repl", "sketch", "cluster", "shard")
+    if name not in ("engine", "ckpt", "guard", "repl", "sketch", "cluster", "shard", "comm")
 }
 
 
