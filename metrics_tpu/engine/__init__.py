@@ -23,7 +23,10 @@ deadlines + shedding, circuit breakers, quarantine, watchdog, and
 failover are the replication plane (``replication=ReplConfig(...)``,
 :mod:`metrics_tpu.repl`): WAL shipping off the write path, bit-identical
 follower replay, bounded-staleness reads, epoch-fenced promotion — see
-docs/source/replication.md.
+docs/source/replication.md. Million-tenant residency is the tier plane
+(``tier=TierConfig(...)``, :mod:`metrics_tpu.tier`): HBM-hot / host-RAM-warm /
+disk-cold state tiering with journaled residency records and bit-identical
+readmission — see docs/source/tiering.md.
 """
 
 from metrics_tpu.engine.bucketing import (
@@ -58,6 +61,7 @@ from metrics_tpu.repl import (
     ReplicaLag,
     StalenessExceeded,
 )
+from metrics_tpu.tier import TierConfig
 
 __all__ = [
     "DEFAULT_BUCKETS",
@@ -81,6 +85,7 @@ __all__ = [
     "StalenessExceeded",
     "StreamingEngine",
     "TenantQuarantined",
+    "TierConfig",
     "choose_bucket",
     "inspect_request",
     "pad_micro_batch",

@@ -94,6 +94,14 @@ from metrics_tpu.repl.errors import (
 )
 from metrics_tpu.repl.replica import ReplicaApplier
 from metrics_tpu.repl.shipper import Shipper
+from metrics_tpu.tier.config import TierConfig
+from metrics_tpu.tier.residency import (
+    HOT,
+    TierManager,
+    capture_entry,
+    peek_state,
+    restore_entry,
+)
 from metrics_tpu.utils.exceptions import MetricsTPUUserError
 
 _POLICIES = ("block", "drop", "timeout")
@@ -118,6 +126,16 @@ _WAL_FSYNC = ("never", "commit", "interval")
 #   transitions that are not submits: without them a recovery (or a follower)
 #   would replay post-reset/post-rotation requests onto pre-transition state
 #   and silently diverge from the engine that journaled them.
+# - b"D" DEMOTE / b"T" RETIRE / b"P" PROMOTE records — the tier plane's
+#   residency transitions. Slot ids in chunk records are only meaningful
+#   relative to the retire/reuse history, so every transition that frees or
+#   re-fills a slot is journaled IN ORDER with the chunks around it:
+#   D (slot + key) demotes a tenant out of the slab (replay re-captures the
+#   row from the replayed slab — bit-identical by construction — and parks it
+#   in the warm mirror); T (slot + key) retires a tenant entirely; P (slot +
+#   key + embedded MTCKPT1 entry blob) readmits one — the blob makes replay
+#   independent of the cold spill file's lifetime, so the live engine may
+#   delete the file the moment the P record is journaled.
 
 _WAL_U32 = struct.Struct("<I")
 
@@ -184,6 +202,34 @@ def _encode_chunk_record(
     for col in columns:
         _enc_array(parts, col)
     return b"".join(parts)
+
+
+def _encode_tier_record(kind: bytes, slot: int, key_bytes: bytes, blob: bytes = b"") -> bytes:
+    """One residency-transition WAL record (kind is b"D" / b"T" / b"P").
+
+    ``blob`` rides only on promote records: the readmitted entry as an
+    ``MTCKPT1`` container (empty for a cold-registered tenant that never had
+    state — replay then installs a fresh init row)."""
+    parts = [kind, _WAL_U32.pack(slot), _WAL_U32.pack(len(key_bytes)), key_bytes]
+    if kind == b"P":
+        parts.append(_WAL_U32.pack(len(blob)))
+        parts.append(blob)
+    return b"".join(parts)
+
+
+def _decode_tier_record(payload: bytes) -> Tuple[int, Hashable, Optional[bytes]]:
+    (slot,) = _WAL_U32.unpack_from(payload, 1)
+    (klen,) = _WAL_U32.unpack_from(payload, 5)
+    off = 9
+    key = pickle.loads(payload[off : off + klen])
+    off += klen
+    blob: Optional[bytes] = None
+    if payload[:1] == b"P":
+        (blen,) = _WAL_U32.unpack_from(payload, off)
+        off += 4
+        blob = payload[off : off + blen]
+    return slot, key, blob
+
 
 # Engine snapshot payload schema. Engine snapshots are operational (serving
 # continuity), not archival: a version bump invalidates old generations — the
@@ -338,6 +384,7 @@ class StreamingEngine:
         checkpoint: Optional[CheckpointConfig] = None,
         guard: Optional[GuardConfig] = None,
         replication: Optional[ReplConfig] = None,
+        tier: Optional[TierConfig] = None,
         device: Optional[Any] = None,
         telemetry_labels: Optional[Dict[str, str]] = None,
         start: bool = True,
@@ -388,6 +435,8 @@ class StreamingEngine:
         self.telemetry = EngineTelemetry(
             latency_window=telemetry_window, labels=telemetry_labels
         )
+        # shard planes label their engines; the slab-bytes gauge carries it
+        self._shard_label = str((telemetry_labels or {}).get("shard", ""))
         # optional device pin: every stacked leaf is committed here, so jit
         # dispatches follow it — the shard plane places one engine per mesh
         # device to get true multi-device parallelism
@@ -408,6 +457,18 @@ class StreamingEngine:
             else EagerKeyedState(self._metric, window=window)
         )
         self._window = window
+
+        # tier plane (metrics_tpu.tier): residency-aware HBM/host-RAM/disk
+        # state tiering. None-checked on every hot path — an untiered engine
+        # pays one attribute test per drained batch and nothing per request.
+        # _tier_policy distinguishes a user-configured tier (eviction pass
+        # runs) from one materialised lazily by replay/restore of residency
+        # records (mechanics only: state is kept readmittable, nothing is
+        # proactively demoted until the operator configures a policy).
+        self._tier: Optional[TierManager] = (
+            TierManager(tier, self._metric) if tier is not None else None
+        )
+        self._tier_policy = tier is not None
 
         # (signature, bucket, capacity) -> jitted kernel
         self._kernels: Dict[Tuple[Signature, int, int], Callable] = {}
@@ -724,11 +785,24 @@ class StreamingEngine:
         # replica serve dashboard traffic at multiples of the primary's read
         # rate (benchmarks/engine_throughput.py --replica).
         cold_read = None
+        resident = True
         with self._dispatch_lock:
             keyed = self._keyed
-            if key not in keyed.keys:
-                raise KeyError(f"unknown tenant key {key!r}")
-            if (
+            if not self._is_resident(key):
+                tier = self._tier
+                if tier is None or not tier.has(key):
+                    raise KeyError(f"unknown tenant key {key!r}")
+                # non-resident tenant: host-side peek of its warm/cold entry —
+                # no readmission, no slab writes. Reads must not change
+                # residency (a follower's reads may not mutate state at all,
+                # and a dashboard sweep over a million cold tenants must not
+                # thrash the hot set). Runs under the dispatch lock so the
+                # rotation counter and the entry are read consistently.
+                resident = False
+                state = peek_state(
+                    self._metric, keyed, tier.peek_entry(key) or {}, window=window
+                )
+            elif (
                 not window
                 and not sync
                 and self._read_jit_ok
@@ -755,7 +829,7 @@ class StreamingEngine:
                     cold_read = (
                         jax.tree.map(jnp.copy, keyed.stacked), slot, keyed.capacity
                     )
-            if cold_read is None:
+            if resident and cold_read is None:
                 state = keyed.merged_state(key) if window else keyed.state_of(key)
         if cold_read is not None:
             stacked_copy, slot, capacity = cold_read
@@ -824,6 +898,15 @@ class StreamingEngine:
                 key: self._keyed.merged_state(key) if window else self._keyed.state_of(key)
                 for key in self._keyed.keys
             }
+            tier = self._tier
+            if tier is not None:
+                # non-resident tenants read host-side, no readmission: a
+                # full-fleet sweep must not evict the live hot set to serve it
+                for key in tier.keys():
+                    if key not in states:
+                        states[key] = peek_state(
+                            self._metric, self._keyed, tier.peek_entry(key) or {}, window=window
+                        )
         out: Dict[Hashable, Any] = {}
         for key, state in states.items():
             if sync:
@@ -857,10 +940,18 @@ class StreamingEngine:
         self._check_quarantined("reset")
         self._check_writable("reset")
         self.flush()
+        orphans: List[str] = []
         with self._dispatch_lock:
             if self._journal is not None:
                 self._journal_append([b"Z"])
             self._keyed.reset()
+            if self._tier is not None:
+                # every non-resident tenant becomes cold-with-init; their spill
+                # files are orphans once the reset is journaled
+                orphans = self._tier.reset()
+        if self._tier is not None and self._tier.store is not None:
+            for name in orphans:
+                self._tier.store.delete(name)
 
     def _check_writable(self, op: str) -> None:
         if self._repl_follower:
@@ -1005,6 +1096,17 @@ class StreamingEngine:
         snap["degraded"] = self._degraded
         snap["quarantined"] = self._quarantined
         snap["tenants"] = len(self._keyed.keys)
+        tier = self._tier
+        if tier is not None:
+            snap["tenants"] += len(tier.warm) + len(tier.cold)
+            snap["tier"] = {
+                "hot": len(self._keyed.keys),
+                "warm": len(tier.warm),
+                "cold": len(tier.cold),
+                "pinned": len(tier.pinned),
+            }
+        if isinstance(self._keyed, KeyedState):
+            snap["slab_bytes"] = sum(self._slab_bytes().values())
         if self._ckpt_writer is not None:
             snap["ckpt_generation"] = self._ckpt_writer.last_generation
             snap["wal_seq"] = self._wal_seq
@@ -1013,7 +1115,22 @@ class StreamingEngine:
     # ------------------------------------------------------------------ internals
 
     def _alloc_slot(self, key: Hashable) -> Optional[int]:
+        tier = self._tier
+        if tier is not None:
+            if not self._is_resident(key) and tier.has(key):
+                # non-resident tenant: leave the slot unresolved — the
+                # dispatcher readmits it (one device_put-backed slot install)
+                # right before the micro-batch that needs the row, under the
+                # dispatch lock. Doing it here would put disk IO and slab
+                # scatters on the caller's submit path.
+                return None
         return self._keyed.slot_for(key)
+
+    def _is_resident(self, key: Hashable) -> bool:
+        """O(1) hot-tier membership (``keyed.keys`` materialises a tuple)."""
+        keyed = self._keyed
+        table = keyed._slots if isinstance(keyed, KeyedState) else keyed._states
+        return key in table
 
     def _sync_state(self, state: Any) -> Any:
         # one collective sync at a time per process (_sync_state_lock): every
@@ -1082,6 +1199,383 @@ class StreamingEngine:
             else:
                 breaker.abandon_probe()
         return synced
+
+    # -------------------------------------------------------------- tier plane
+
+    def _ensure_tier(self) -> TierManager:
+        """The residency manager — materialised lazily when replayed residency
+        records or a tiered snapshot arrive on an engine built without
+        ``tier=``. A lazy manager is mechanics only (demoted state stays
+        readmittable); the eviction pass never runs without an operator-
+        configured policy."""
+        if self._tier is None:
+            self._tier = TierManager(TierConfig(), self._metric)
+            self._tier_policy = False
+        return self._tier
+
+    def _resolve_slot(self, key: Hashable) -> Optional[int]:
+        """Slot for ``key``, readmitting it first if it lives in a lower tier
+        (caller holds the dispatch lock). The tier check comes BEFORE the slot
+        table: a submit can race a demotion and allocate a fresh slot for a
+        key whose state sits in the warm mirror — promotion restores that
+        state over the freshly-init row before any update touches it."""
+        tier = self._tier
+        if tier is not None and tier.has(key):
+            return self._promote_tenant(key)
+        keyed = self._keyed
+        if isinstance(keyed, KeyedState):
+            slot = keyed._slots.get(key)
+            return slot if slot is not None else keyed.slot_for(key)
+        return keyed.slot_for(key)
+
+    def _promote_tenant(self, key: Hashable) -> Optional[int]:
+        """Readmit one non-resident tenant into the slab (dispatch lock held).
+
+        Warm path: one host→device scatter per captured row. Cold path: the
+        spill blob restores through the MTCKPT1 container (bit-identical), and
+        the file is deleted only AFTER the promote record — which embeds the
+        entry — is journaled, so recovery never dereferences a dead pointer.
+        """
+        tier = self._tier
+        src = tier.tier_of(key)
+        with _obs.engine_span("engine.tier_promote", source=src or HOT):
+            entry, _ = tier.pop_entry(key)
+            keyed = self._keyed
+            slot = keyed.slot_for(key)
+            keyed.ensure_capacity()
+            spill = entry.pop("_spill_file", None) if entry is not None else None
+            if self._journal is not None:
+                blob = b"" if entry is None else ckpt_format.dumps(
+                    entry, meta={"kind": "tier-promote"}
+                )
+                self._journal_append(
+                    [_encode_tier_record(b"P", int(slot or 0), self._key_bytes(key), blob)]
+                )
+                if slot is not None:
+                    self._wal_slots_sent.add(slot)
+            if entry is not None:
+                restore_entry(keyed, key, entry)
+            if spill is not None and tier.store is not None:
+                tier.store.delete(spill)
+        self.telemetry.count("tier_promotions")
+        _obs.record_tier_promotion(self.telemetry.engine_id, src or "unknown")
+        return slot
+
+    def _demote_tenant(self, key: Hashable) -> bool:
+        """Demote one hot tenant to the warm mirror (dispatch lock held).
+
+        Capture → journal → evict → release: the demote record lands before
+        the slot becomes reusable, so replay reproduces retire-then-reuse in
+        commit order and a recovered engine never aliases the freed row."""
+        keyed = self._keyed
+        if not self._is_resident(key):
+            return False
+        with _obs.engine_span("engine.tier_demote"):
+            entry = capture_entry(keyed, key)
+            if self._journal is not None:
+                slot = keyed._slots.get(key, 0) if isinstance(keyed, KeyedState) else 0
+                self._journal_append(
+                    [_encode_tier_record(b"D", int(slot), self._key_bytes(key))]
+                )
+            slot = keyed.evict(key)
+            keyed.release_slot(slot)
+            if slot is not None:
+                self._wal_slots_sent.discard(slot)
+            tier = self._tier
+            tier.warm[key] = entry
+            tier.forget_heat(key)
+        self.telemetry.count("tier_demotions")
+        _obs.record_tier_demotion(self.telemetry.engine_id)
+        return True
+
+    def _maybe_tier(self) -> None:
+        """The between-batches eviction pass (dispatcher thread, like
+        ``_maybe_checkpoint``): demote the coldest hot tenants down to
+        ``hot_capacity`` (quarantined first, pinned never), then push warm
+        overflow to disk. Spill IO runs OFF the dispatch lock — only the
+        manifest flip retakes it — so readmissions never queue behind a disk
+        write."""
+        tier = self._tier
+        if tier is None or not self._tier_policy:
+            return
+        keyed = self._keyed
+        hot_count = len(keyed._slots) if isinstance(keyed, KeyedState) else len(keyed._states)
+        if not tier.due(hot_count):
+            return
+        guard = self._guard
+        quarantined = (
+            set(guard.quarantine.active()) if guard is not None else set()
+        )
+        with self._dispatch_lock:
+            hot_keys = keyed.keys
+            for key in tier.victims(hot_keys, len(hot_keys) - tier.cfg.hot_capacity, quarantined):
+                self._demote_tenant(key)
+        store = tier.store
+        if store is not None:
+            for key in tier.spill_victims():
+                with self._dispatch_lock:
+                    entry = tier.warm.get(key)
+                if entry is None:
+                    continue  # promoted between passes
+                try:
+                    name, blob = store.spill(key, entry)
+                except Exception:  # noqa: BLE001 — disk trouble: stay warm, stay serving
+                    self.telemetry.count("tier_spill_failures")
+                    break
+                with self._dispatch_lock:
+                    flipped = tier.warm.get(key) is entry
+                    if flipped:
+                        del tier.warm[key]
+                        tier.cold[key] = name
+                if not flipped:
+                    store.delete(name)  # promoted while we wrote: orphaned file
+                    continue
+                self.telemetry.count("tier_spills")
+                _obs.record_tier_spill(self.telemetry.engine_id, len(blob))
+        self._publish_tier_gauges()
+
+    def _slab_bytes(self) -> Dict[str, int]:
+        """Device bytes held by the stacked slab (live + ring), per dtype."""
+        keyed = self._keyed
+        out: Dict[str, int] = {}
+        if not isinstance(keyed, KeyedState):
+            return out
+        trees = [keyed.stacked] + [snap for _, snap in (keyed._ring or [])]
+        for tree in trees:
+            for leaf in jax.tree_util.tree_flatten(tree)[0]:
+                dtype = np.dtype(leaf.dtype)
+                out[dtype.name] = out.get(dtype.name, 0) + int(leaf.size) * dtype.itemsize
+        return out
+
+    def _publish_tier_gauges(self) -> None:
+        if not _OBS.enabled:
+            return
+        eid = self.telemetry.engine_id
+        tier = self._tier
+        if tier is not None:
+            hot = len(self._keyed._slots) if isinstance(self._keyed, KeyedState) else len(self._keyed._states)
+            _obs.set_tier_residency(eid, hot, len(tier.warm), len(tier.cold))
+        for dtype, nbytes in self._slab_bytes().items():
+            _obs.set_engine_slab_bytes(eid, dtype, nbytes, shard=self._shard_label)
+
+    def register_tenants(self, keys: Sequence[Hashable]) -> int:
+        """Register tenants as COLD residents — one manifest entry each, no
+        slab growth, no spill file. This is the million-tenant entry point: a
+        registered-but-silent tenant costs nothing on the device until its
+        first submit readmits it. Returns how many keys were newly registered
+        (already-known keys, hot or tiered, are left untouched)."""
+        tier = self._tier
+        if tier is None:
+            raise MetricsTPUUserError(
+                "register_tenants() requires the engine to be built with tier=TierConfig(...)"
+            )
+        self._check_writable("register_tenants")
+        keyed = self._keyed
+        table = keyed._slots if isinstance(keyed, KeyedState) else keyed._states
+        added = 0
+        with self._dispatch_lock:
+            for key in keys:
+                if key in table:
+                    continue
+                if tier.register_cold(key):
+                    added += 1
+        return added
+
+    def pin_tenant(self, key: Hashable) -> None:
+        """Exempt ``key`` from tier eviction; a non-resident pinned tenant is
+        readmitted immediately (pinning promises slab residency)."""
+        tier = self._tier
+        if tier is None:
+            raise MetricsTPUUserError(
+                "pin_tenant() requires the engine to be built with tier=TierConfig(...)"
+            )
+        self._check_writable("pin_tenant")
+        with self._dispatch_lock:
+            tier.pinned.add(key)
+            if not self._is_resident(key) and tier.has(key):
+                self._promote_tenant(key)
+
+    def unpin_tenant(self, key: Hashable) -> None:
+        if self._tier is not None:
+            with self._dispatch_lock:
+                self._tier.pinned.discard(key)
+
+    def demote_tenant(self, key: Hashable) -> bool:
+        """Demote one tenant to the warm mirror now (ops hook; flushes first).
+        Returns False if the key is unknown or already non-resident."""
+        tier = self._tier
+        if tier is None:
+            raise MetricsTPUUserError(
+                "demote_tenant() requires the engine to be built with tier=TierConfig(...)"
+            )
+        self._check_quarantined("demote_tenant")
+        self._check_writable("demote_tenant")
+        self.flush()
+        with self._dispatch_lock:
+            if key in tier.pinned:
+                return False
+            return self._demote_tenant(key)
+
+    def evict_tenant(self, key: Hashable) -> bool:
+        """Forget ``key`` entirely — state, window history, residency records.
+
+        The retirement is journaled (``b"T"``) BEFORE the slot id returns to
+        the free-list, so WAL replay reproduces retire-then-reuse in commit
+        order and a recovered engine never aliases the freed accumulator row
+        onto whichever new tenant reused it. Works on untiered engines too
+        (the slot still recycles instead of burning watermark)."""
+        self._check_quarantined("evict_tenant")
+        self._check_writable("evict_tenant")
+        self.flush()
+        with self._dispatch_lock:
+            keyed = self._keyed
+            resident = self._is_resident(key)
+            tiered = self._tier is not None and self._tier.has(key)
+            if not resident and not tiered:
+                return False
+            if self._journal is not None:
+                slot = keyed._slots.get(key, 0) if isinstance(keyed, KeyedState) else 0
+                self._journal_append(
+                    [_encode_tier_record(b"T", int(slot), self._key_bytes(key))]
+                )
+            if self._tier is not None:
+                self._tier.discard(key)
+                self._tier.forget_heat(key)
+                self._tier.pinned.discard(key)
+            if resident:
+                slot = keyed.evict(key)
+                keyed.release_slot(slot)
+                if slot is not None:
+                    self._wal_slots_sent.discard(slot)
+        self.telemetry.count("tier_evictions")
+        return True
+
+    def tenant_tier(self, key: Hashable) -> Optional[str]:
+        """Which tier ``key`` currently occupies: "hot" / "warm" / "cold",
+        or ``None`` for an unknown tenant."""
+        with self._dispatch_lock:
+            if self._is_resident(key):
+                return HOT
+            return self._tier.tier_of(key) if self._tier is not None else None
+
+    def tier_stats(self) -> Dict[str, Any]:
+        """Residency counts + device slab footprint, one plain dict."""
+        with self._dispatch_lock:
+            keyed = self._keyed
+            hot = len(keyed._slots) if isinstance(keyed, KeyedState) else len(keyed._states)
+            out: Dict[str, Any] = {
+                "hot": hot,
+                "warm": 0,
+                "cold": 0,
+                "pinned": 0,
+                "slab_bytes": sum(self._slab_bytes().values()),
+            }
+            tier = self._tier
+            if tier is not None:
+                out["warm"] = len(tier.warm)
+                out["cold"] = len(tier.cold)
+                out["pinned"] = len(tier.pinned)
+                if self._tier_policy:
+                    out["hot_capacity"] = tier.cfg.hot_capacity
+        return out
+
+    def export_tenant(
+        self, key: Hashable, *, retire: bool = True
+    ) -> Optional[Dict[str, Any]]:
+        """Capture one tenant's full entry, whatever tier it occupies — the
+        shard plane's migration source. Returns ``None`` for an unknown key.
+
+        With ``retire=True`` the tenant is also forgotten here, journaled like
+        :meth:`evict_tenant` so a recovered engine agrees it left. With
+        ``retire=False`` the capture is a pure read (no journal record, no
+        eviction) — the caller retires the source copy itself once the
+        destination copy is durable (that's the shard resize write-ahead
+        ordering)."""
+        self._check_quarantined("export_tenant")
+        with self._dispatch_lock:
+            keyed = self._keyed
+            entry: Optional[Dict[str, Any]] = None
+            if self._is_resident(key):
+                entry = capture_entry(keyed, key)
+            elif self._tier is not None and self._tier.has(key):
+                peeked = self._tier.peek_entry(key)
+                entry = dict(peeked) if peeked is not None else {
+                    "state": None, "ring": [], "rot": int(keyed.rotations)
+                }
+            else:
+                return None
+            entry.pop("_spill_file", None)
+            if not retire:
+                return entry
+            if self._journal is not None:
+                slot = keyed._slots.get(key, 0) if isinstance(keyed, KeyedState) else 0
+                self._journal_append(
+                    [_encode_tier_record(b"T", int(slot), self._key_bytes(key))]
+                )
+            if self._tier is not None:
+                self._tier.discard(key)
+                self._tier.forget_heat(key)
+                self._tier.pinned.discard(key)
+            if self._is_resident(key):
+                slot = keyed.evict(key)
+                keyed.release_slot(slot)
+                if slot is not None:
+                    self._wal_slots_sent.discard(slot)
+        self.telemetry.count("tier_evictions")
+        return entry
+
+    def import_tenant(self, key: Hashable, entry: Optional[Dict[str, Any]]) -> None:
+        """Install an exported tenant entry — the migration sink.
+
+        Exports are captured live, so their ring rows occupy the last
+        ``len(ring)`` source segments; re-stamping the entry with THIS
+        engine's rotation counter places them in the same positions relative
+        to the destination window (the two engines' absolute counters need
+        not agree — a shard born mid-resize starts from zero, so its empty
+        ring is padded with init segments first to give the rows somewhere to
+        land). An entry with no state at all (a registered-but-silent cold
+        tenant) stays off the slab when this engine is tiered — it lands as a
+        cold registration, not a hot row."""
+        self._check_quarantined("import_tenant")
+        self._check_writable("import_tenant")
+        with self._dispatch_lock:
+            keyed = self._keyed
+            rows: List[Any] = []
+            if entry is not None:
+                entry = dict(entry)
+                entry.pop("_spill_file", None)
+                entry["rot"] = int(keyed.rotations)
+                rows = list(entry.get("ring") or [])
+            empty = entry is None or (
+                entry.get("state") is None and not any(r is not None for r in rows)
+            )
+            if empty and self._tier is not None and not self._is_resident(key):
+                self._tier.discard(key)
+                self._tier.register_cold(key)
+                return
+            ring = keyed._ring
+            if rows and ring is not None and len(ring) < len(rows):
+                if isinstance(keyed, KeyedState):
+                    while len(ring) < len(rows):
+                        ring.append((keyed.capacity, keyed._tiled(keyed.capacity)))
+                else:
+                    while len(ring) < len(rows):
+                        ring.append({})
+            slot = keyed.slot_for(key)
+            keyed.ensure_capacity()
+            if self._journal is not None:
+                blob = b"" if entry is None else ckpt_format.dumps(
+                    entry, meta={"kind": "tier-promote"}
+                )
+                self._journal_append(
+                    [_encode_tier_record(b"P", int(slot or 0), self._key_bytes(key), blob)]
+                )
+                if slot is not None:
+                    self._wal_slots_sent.add(slot)
+            if self._tier is not None:
+                self._tier.discard(key)
+            if entry is not None:
+                restore_entry(keyed, key, entry)
 
     # ---------------------------------------------------- durable state plane
 
@@ -1246,6 +1740,14 @@ class StreamingEngine:
         with self._dispatch_lock:
             keyed = self._keyed
             tree: Dict[str, Any] = {"kind": "engine", "seq": int(self._wal_seq)}
+            # additive (schema v1 readers tolerate absence): the rotation
+            # counter anchors demoted entries' absolute ring indices, and the
+            # tier section makes the snapshot cover a PARTIALLY-RESIDENT
+            # engine — warm entries by value, cold tenants by manifest pointer
+            # (their spill files are already durable containers on disk)
+            tree["rotations"] = int(keyed.rotations)
+            if self._tier is not None:
+                tree["tier"] = self._tier.snapshot_view()
             if isinstance(keyed, KeyedState):
                 tree["mode"] = "fused"
                 tree["capacity"] = int(keyed.capacity)
@@ -1267,7 +1769,10 @@ class StreamingEngine:
                     }
                     for seg in (keyed._ring or [])
                 ]
-        meta = {"tenants": len(keyed.keys), "seq": tree["seq"]}
+        tenants = len(keyed.keys)
+        if self._tier is not None:
+            tenants += len(self._tier.warm) + len(self._tier.cold)
+        meta = {"tenants": tenants, "seq": tree["seq"]}
         if self._repl_cfg is not None:
             # the lineage's fencing token: a recovered promoted node knows which
             # epoch it owns without re-walking the promotion
@@ -1326,6 +1831,9 @@ class StreamingEngine:
             raise ValueError(f"engine snapshot schema v{snap.schema_version} != v{_ENGINE_SCHEMA_VERSION}")
         if not isinstance(tree, dict) or tree.get("kind") != "engine":
             raise ValueError("not an engine snapshot")
+        tier_view = tree.get("tier")
+        if tier_view is not None and not isinstance(tier_view, dict):
+            raise ValueError("engine snapshot tier section is not a mapping")
         mode = tree.get("mode")
         ref_leaves, ref_def = jax.tree_util.tree_flatten(self._metric.init_state())
         if mode == "fused":
@@ -1376,6 +1884,7 @@ class StreamingEngine:
                     keyed._ring.append(
                         (int(entry["capacity"]), jax.tree.map(jnp.asarray, entry["stacked"]))
                     )
+            keyed.rotations = int(tree.get("rotations", 0))
             self._keyed = keyed
         else:
             # an eager snapshot (e.g. the crashed engine had demoted) restores
@@ -1389,7 +1898,17 @@ class StreamingEngine:
             if keyed._ring is not None:
                 for entry in tree.get("ring", []):
                     keyed._ring.append(dict(zip(entry["keys"]["values"], entry["states"])))
+            keyed.rotations = int(tree.get("rotations", 0))
             self._keyed = keyed
+        # residency map: a snapshot with a tier section restores into a
+        # partially-resident engine (follower bootstrap inherits it the same
+        # way); one without clears any stale local map — old fully-hot
+        # snapshots restore exactly as before the tier plane existed
+        view = tree.get("tier")
+        if view:
+            self._ensure_tier().restore_view(view)
+        elif self._tier is not None:
+            self._tier.restore_view({})
 
     @staticmethod
     def _chunk_signature(columns: Sequence[np.ndarray]) -> Signature:
@@ -1481,6 +2000,19 @@ class StreamingEngine:
         the eager/inline paths that produce these records applied it (fused
         work replays through chunk records instead), so float accumulation
         rounds identically to the lost process."""
+        if (
+            self._tier is not None
+            and not self._is_resident(key)
+            and self._tier.has(key)
+        ):
+            # defensive: the primary journals a P record before any R for a
+            # non-resident tenant, but an older snapshot's tier section can
+            # still mark the key non-resident at this point in the replay
+            entry, _ = self._tier.pop_entry(key)
+            self._keyed.slot_for(key)
+            self._keyed.ensure_capacity()
+            if entry is not None:
+                restore_entry(self._keyed, key, entry)
         if isinstance(self._keyed, EagerKeyedState):
             self._keyed.slot_for(key)
             self._keyed.update(key, *args)
@@ -1489,6 +2021,58 @@ class StreamingEngine:
             self._keyed.ensure_capacity()
             state = self._keyed.state_of(key)
             self._keyed.set_state(key, self._metric.update_state(state, *args))
+
+    # -------------------------------------------- tier residency-record replay
+
+    def _replay_demote(self, payload: bytes) -> None:
+        """Replay one b"D" record: capture the tenant's row from the REPLAYED
+        slab (bit-identical to what the journaling engine captured, because
+        replay is bit-identical up to this record), park it warm, free the
+        slot. The live engine may later have spilled the entry to disk —
+        content is what matters; tier placement is local policy."""
+        _, key, _ = _decode_tier_record(payload)
+        if not self._is_resident(key):
+            return  # snapshot already reflects the demotion
+        tier = self._ensure_tier()
+        entry = capture_entry(self._keyed, key)
+        slot = self._keyed.evict(key)
+        self._keyed.release_slot(slot)
+        if slot is not None:
+            self._replay_slot_keys.pop(slot, None)
+            self._wal_slots_sent.discard(slot)
+        tier.warm[key] = entry
+        tier.forget_heat(key)
+
+    def _replay_retire(self, payload: bytes) -> None:
+        """Replay one b"T" record: forget the tenant in every tier."""
+        _, key, _ = _decode_tier_record(payload)
+        if self._tier is not None:
+            self._tier.discard(key)
+            self._tier.forget_heat(key)
+        if self._is_resident(key):
+            slot = self._keyed.evict(key)
+            self._keyed.release_slot(slot)
+            if slot is not None:
+                self._replay_slot_keys.pop(slot, None)
+                self._wal_slots_sent.discard(slot)
+
+    def _replay_promote(self, payload: bytes) -> None:
+        """Replay one b"P" record: install the journaling engine's slot id and
+        restore the embedded entry blob through the MTCKPT1 path — never the
+        spill file, which the live engine deleted the moment this record was
+        durable."""
+        slot, key, blob = _decode_tier_record(payload)
+        keyed = self._keyed
+        if isinstance(keyed, KeyedState):
+            keyed.install_slot(key, slot)
+            self._replay_slot_keys[slot] = key
+            keyed.ensure_capacity(min_slots=slot + 1)
+        else:
+            keyed.slot_for(key)
+        if blob:
+            restore_entry(keyed, key, ckpt_format.loads(blob).tree)
+        if self._tier is not None:
+            self._tier.discard(key)
 
     def _recover(self) -> None:
         """Restart path: newest valid snapshot + exactly-once WAL replay."""
@@ -1589,6 +2173,8 @@ class StreamingEngine:
             else:
                 self._keyed = EagerKeyedState(self._metric, window=self._window)
             self._replay_slot_keys = {}
+            if self._tier is not None:
+                self._tier.restore_view({})
 
     def _repl_restore_snapshot(self, data: bytes) -> int:
         """Applier callback: bootstrap/rebootstrap from one shipped snapshot via
@@ -1611,8 +2197,18 @@ class StreamingEngine:
             self._replay_chunk(payload)
         elif kind == b"Z":
             self._keyed.reset()
+            if self._tier is not None:
+                for name in self._tier.reset():
+                    if self._tier.store is not None:
+                        self._tier.store.delete(name)
         elif kind == b"W":
             self._keyed.rotate()
+        elif kind == b"D":
+            self._replay_demote(payload)
+        elif kind == b"T":
+            self._replay_retire(payload)
+        elif kind == b"P":
+            self._replay_promote(payload)
         else:
             self._replay_request(*_decode_request_record(payload))
 
@@ -2003,6 +2599,7 @@ class StreamingEngine:
                     self._inflight = 0
                     self._idle.notify_all()
                 self._maybe_checkpoint()
+                self._maybe_tier()
                 if detector is not None:
                     detector.mark_idle()
             except _WorkerSuperseded:
@@ -2042,6 +2639,38 @@ class StreamingEngine:
             # re-validate the generation under the lock a hang takeover must
             # acquire before replaying: a superseded worker never dispatches
             self._check_epoch(epoch)
+            if self._tier is not None:
+                # slot revalidation: a request's slot was resolved at submit
+                # time, outside this lock — the tenant may have been demoted
+                # (slot freed, possibly reused) or was non-resident to begin
+                # with (slot None). Re-resolve every slot here, readmitting
+                # non-resident tenants right before the micro-batch that
+                # needs their rows. The lower-tier check comes BEFORE the slot
+                # table, same as _resolve_slot: a submit racing a demotion can
+                # allocate a fresh slot for a key whose captured state sits in
+                # the warm mirror, and promotion must restore that state over
+                # the freshly-init row. The loop runs once per dispatched
+                # request with the whole engine waiting on it (the tier <5%
+                # overhead gate), hence local bindings instead of method calls.
+                tier = self._tier
+                warm, cold = tier.warm, tier.cold
+                keyed = self._keyed
+                slots = keyed._slots if isinstance(keyed, KeyedState) else None
+                heat = tier._heat if self._tier_policy else None
+                clock = tier.cfg.clock
+                for req in batch:
+                    if req.future.done():
+                        continue
+                    key = req.key
+                    if key in warm or key in cold:
+                        req.slot = self._promote_tenant(key)
+                    elif slots is not None:
+                        slot = slots.get(key)
+                        req.slot = slot if slot is not None else keyed.slot_for(key)
+                    else:
+                        req.slot = keyed.slot_for(key)
+                    if heat is not None:
+                        heat[key] = clock()
             if self._keyed.ensure_capacity():
                 self.telemetry.count("key_growths")
                 self.telemetry.observe_resize(self._keyed.last_resize_s)
@@ -2246,6 +2875,7 @@ class StreamingEngine:
                         if slot < cap:
                             seg[key] = jax.tree.map(lambda x: x[slot], snap)
                     eager._ring.append(seg)
+            eager.rotations = old.rotations  # demoted entries still align by absolute index
             self._keyed = eager
             self._fused = False
             self._kernels.clear()
@@ -2274,6 +2904,13 @@ class StreamingEngine:
             with _obs.engine_span("engine.inline", rows=req.rows), self._dispatch_lock:
                 if req.future.done() or (req.rows > 0 and req.rows_done >= req.rows):
                     return
+                if self._tier is not None:
+                    # readmit a non-resident tenant before touching its state;
+                    # journaled (P) before the request record below, so replay
+                    # restores then applies in the same order
+                    self._resolve_slot(req.key)
+                    if self._tier_policy:
+                        self._tier.touch(req.key)
                 # journal INSIDE the dispatch lock: a snapshot (same lock)
                 # must never record WAL coverage of a not-yet-applied request.
                 # Trimmed args keep rows already committed (and chunk-

@@ -18,6 +18,7 @@ the ring's maxlen — no timestamps, no per-row bookkeeping.
 
 from __future__ import annotations
 
+import threading
 import time
 from collections import deque
 from functools import partial
@@ -92,6 +93,18 @@ class KeyedState:
         self.stacked = self._tiled(self.capacity)
         self._slots: Dict[Hashable, int] = {}
         self._max_slot = -1  # highest installed id (ids can be gapped — see slot_for)
+        # retired slot ids eligible for reuse by NEW tenants. A slot only lands
+        # here via release_slot(), which the engine calls AFTER journaling a
+        # retire/demote record — WAL replay addresses rows by id, so an
+        # unjournaled reuse would alias an old tenant's accumulator row.
+        self._free_slots: List[int] = []
+        self._free_set: set = set()
+        # allocation is no longer single-threaded once the tier plane exists:
+        # submit threads allocate under the engine lock while the dispatcher
+        # promotes readmitted tenants under the dispatch lock — two locks, one
+        # watermark. This micro-lock covers only the id handout (ns-scale).
+        self._alloc_lock = threading.Lock()
+        self.rotations = 0  # total rotate() calls — aligns demoted ring rows on readmit
         self.window = _validate_window(window)
         # ring entries are (capacity_at_snapshot, stacked_snapshot): a key allocated
         # after a snapshot was taken simply has no contribution in that segment
@@ -121,7 +134,9 @@ class KeyedState:
 
         Callers serialize allocation (the engine holds its submit lock); the slot may
         temporarily exceed ``capacity`` until the dispatcher calls ``ensure_capacity``.
-        Allocation is ``max(installed ids) + 1``, not ``len(slots)``: WAL/ship
+        Retired slots (``release_slot``) are reused first — their retirement was
+        journaled, so replay reproduces retire-then-reuse in order. Fresh
+        allocation is ``max(installed ids) + 1``, not ``len(slots)``: WAL/ship
         replay installs the PRIMARY'S slot ids, which can arrive gapped (chunk
         commit order is not slot assignment order) — a length-based allocator
         would eventually hand a new tenant an id inside such a gap's occupied
@@ -129,9 +144,16 @@ class KeyedState:
         """
         slot = self._slots.get(key)
         if slot is None:
-            slot = self._max_slot + 1
-            self._slots[key] = slot
-            self._max_slot = slot
+            with self._alloc_lock:
+                slot = self._slots.get(key)
+                if slot is None:
+                    if self._free_slots:
+                        slot = self._free_slots.pop()
+                        self._free_set.discard(slot)
+                    else:
+                        slot = self._max_slot + 1
+                        self._max_slot = slot
+                    self._slots[key] = slot
         return slot
 
     def install_slot(self, key: Hashable, slot: int) -> int:
@@ -139,8 +161,15 @@ class KeyedState:
         WAL/ship replay's ``setdefault``, kept here so the max-id watermark that
         :meth:`slot_for` allocates above stays in sync. Returns the effective id
         (the existing one if ``key`` was already installed)."""
-        existing = self._slots.setdefault(key, int(slot))
-        self._max_slot = max(self._max_slot, existing)
+        with self._alloc_lock:
+            existing = self._slots.setdefault(key, int(slot))
+            self._max_slot = max(self._max_slot, existing)
+            if existing in self._free_set:
+                # replay handed us an id the primary reused after a journaled
+                # retire: pull it off the local free-list so slot_for can't
+                # double-allocate the row
+                self._free_set.discard(existing)
+                self._free_slots.remove(existing)
         return existing
 
     def ensure_capacity(self, min_slots: Optional[int] = None) -> bool:
@@ -201,20 +230,25 @@ class KeyedState:
         slot = self._slots[key]
         self.stacked = jax.tree.map(lambda s, n: s.at[slot].set(n), self.stacked, state)
 
-    def evict(self, key: Hashable) -> None:
+    def evict(self, key: Hashable) -> Optional[int]:
         """Drop a tenant's tenancy: forget its slot, scrub its live row to init.
 
-        The slot id stays burned — the watermark allocator never reuses ids
-        (WAL/ship replay installs ids positionally, and a reused id would share
-        one accumulator row between two tenants' journals). Ring segments are
-        NOT scrubbed: ring reads are slot-addressed through ``_slots``, so a
-        popped key's old rows are unreachable, and a re-registered key gets a
-        fresh slot above the watermark. Rebalance migration (metrics_tpu.shard)
-        is the caller: the tenant's state has already been copied out.
+        Returns the freed slot id (or ``None`` if the key was unknown). The id
+        is NOT immediately reusable — the caller must journal a retire/demote
+        record first and then hand the id to :meth:`release_slot`, because
+        WAL/ship replay installs ids positionally and an unjournaled reuse
+        would share one accumulator row between two tenants' journals. Ring
+        segments are NOT scrubbed here: ring reads are slot-addressed through
+        ``_slots``, so a popped key's old rows are unreachable until the slot
+        is reused — :meth:`release_slot` scrubs them before the id becomes
+        reusable, so a NEW tenant landing on the id never inherits the old
+        tenant's window contributions.
         """
         slot = self._slots.pop(key, None)
-        if slot is None or slot >= self.capacity:
-            return
+        if slot is None:
+            return None
+        if slot >= self.capacity:
+            return slot
         self.stacked = jax.tree_util.tree_unflatten(
             self._treedef,
             [
@@ -224,6 +258,41 @@ class KeyedState:
                 )
             ],
         )
+        return slot
+
+    def release_slot(self, slot: Optional[int]) -> None:
+        """Return a retired slot id to the free-list for reuse by NEW tenants.
+
+        Callers gate this on a journaled retire record (runtime's ``b"T"`` /
+        ``b"D"`` WAL kinds) so recovery replays retire-then-reuse in commit
+        order and never aliases a dead tenant's row onto a live one. Window
+        ring rows for the slot are scrubbed to init here — merged reads are
+        slot-addressed, so without the scrub a new tenant reusing the id would
+        inherit the retired tenant's window contributions. Callers hold the
+        dispatch lock (ring segments are dispatch-locked state).
+        """
+        if slot is None:
+            return
+        slot = int(slot)
+        with self._alloc_lock:
+            if slot in self._free_set:
+                return
+            self._free_slots.append(slot)
+            self._free_set.add(slot)
+        if self._ring:
+            for j, (cap, snap) in enumerate(self._ring):
+                if slot >= cap:
+                    continue
+                snap = jax.tree_util.tree_unflatten(
+                    self._treedef,
+                    [
+                        leaf.at[slot].set(init)
+                        for leaf, init in zip(
+                            jax.tree_util.tree_flatten(snap)[0], self._init_leaves
+                        )
+                    ],
+                )
+                self._ring[j] = (cap, snap)
 
     # ------------------------------------------------------------------ windowing
 
@@ -239,6 +308,7 @@ class KeyedState:
         if self._ring is not None:
             self._ring.append((self.capacity, self.stacked))
         self.stacked = self._tiled(self.capacity)
+        self.rotations += 1
 
     def merged_state(self, key: Hashable) -> Any:
         """Window view: ring segments merged (oldest first) into the live segment."""
@@ -266,6 +336,7 @@ class EagerKeyedState:
     def __init__(self, metric: Any, window: Optional[int] = None) -> None:
         self._metric = metric
         self.last_resize_s = 0.0  # interface parity with KeyedState (never grows)
+        self.rotations = 0  # interface parity — aligns demoted ring rows on readmit
         self._states: Dict[Hashable, Any] = {}
         self.window = _validate_window(window)
         self._ring: Optional[Deque[Dict[Hashable, Any]]] = (
@@ -289,7 +360,7 @@ class EagerKeyedState:
     def set_state(self, key: Hashable, state: Any) -> None:
         self._states[key] = state
 
-    def evict(self, key: Hashable) -> None:
+    def evict(self, key: Hashable) -> Optional[int]:
         """Drop a tenant everywhere. Unlike the stacked regime (slot-addressed,
         unreachable once the slot mapping is popped), eager ring segments are
         KEY-addressed — a re-registered key would resurrect its old window
@@ -298,6 +369,10 @@ class EagerKeyedState:
         if self._ring is not None:
             for seg in self._ring:
                 seg.pop(key, None)
+        return None
+
+    def release_slot(self, slot: Optional[int]) -> None:
+        """Interface parity with KeyedState — eager states have no slots."""
 
     def update(self, key: Hashable, *args: Any) -> None:
         self._states[key] = self._metric.update_state(
@@ -310,6 +385,7 @@ class EagerKeyedState:
         if self._ring is not None:
             self._ring.append(self._states)
         self._states = {k: self._metric.init_state() for k in self._states}
+        self.rotations += 1
 
     def merged_state(self, key: Hashable) -> Any:
         state = self.state_of(key)

@@ -87,6 +87,13 @@ _COUNTERS = (
     "promotions",           # follower→primary promotions served by this engine
     "demotions",            # primary→follower step-downs (lease loss / re-attach)
     "read_jit_fallbacks",   # compiled read path disabled (trace failure; eager from then on)
+    # tier plane (zero unless the engine was built with tier=; see
+    # metrics_tpu/tier/ and docs/source/tiering.md)
+    "tier_promotions",      # readmissions into the device slab (warm/cold -> hot)
+    "tier_demotions",       # demotions out of the slab (hot -> warm mirror)
+    "tier_spills",          # warm entries pushed to disk (warm -> cold)
+    "tier_spill_failures",  # spill write failures absorbed (tenant stays warm)
+    "tier_evictions",       # journaled tenant retirements (evict/export)
 )
 
 # distinguishes engines within one process; monotone so labels never collide

@@ -671,6 +671,67 @@ def record_shard_rebalance(engine: str) -> None:
     SHARD_REBALANCES.inc(1, engine=engine)
 
 
+# ---------------------------------------------------------------------- tier plane
+
+TIER_RESIDENCY = REGISTRY.gauge(
+    "metrics_tpu_tier_residency",
+    "Tenants resident in each tier of a tiered StreamingEngine (hot = stacked "
+    "device slab, warm = host-RAM mirror, cold = disk spill manifest), per "
+    "engine and tier.",
+)
+TIER_PROMOTIONS = REGISTRY.counter(
+    "metrics_tpu_tier_promotions_total",
+    "Tenant readmissions into the device slab, per engine and source tier "
+    "(warm = host mirror restore, cold = MTCKPT1 spill-file restore).",
+)
+TIER_DEMOTIONS = REGISTRY.counter(
+    "metrics_tpu_tier_demotions_total",
+    "Tenant demotions out of the device slab into the host-RAM mirror, "
+    "per engine.",
+)
+TIER_SPILL_BYTES = REGISTRY.counter(
+    "metrics_tpu_tier_spill_bytes_total",
+    "Bytes written to cold-tier spill files (MTCKPT1 containers), per engine.",
+)
+ENGINE_SLAB_BYTES = REGISTRY.gauge(
+    "metrics_tpu_engine_slab_bytes",
+    "Device bytes held by the stacked tenant slab (live segment + window "
+    "ring), per engine, dtype group and shard (empty shard label = unsharded).",
+)
+
+
+def set_tier_residency(engine: str, hot: int, warm: int, cold: int) -> None:
+    if not OBS.enabled:
+        return
+    TIER_RESIDENCY.set(hot, engine=engine, tier="hot")
+    TIER_RESIDENCY.set(warm, engine=engine, tier="warm")
+    TIER_RESIDENCY.set(cold, engine=engine, tier="cold")
+
+
+def record_tier_promotion(engine: str, source: str) -> None:
+    if not OBS.enabled:
+        return
+    TIER_PROMOTIONS.inc(1, engine=engine, source=source)
+
+
+def record_tier_demotion(engine: str) -> None:
+    if not OBS.enabled:
+        return
+    TIER_DEMOTIONS.inc(1, engine=engine)
+
+
+def record_tier_spill(engine: str, nbytes: int) -> None:
+    if not OBS.enabled:
+        return
+    TIER_SPILL_BYTES.inc(nbytes, engine=engine)
+
+
+def set_engine_slab_bytes(engine: str, dtype: str, nbytes: int, shard: str = "") -> None:
+    if not OBS.enabled:
+        return
+    ENGINE_SLAB_BYTES.set(nbytes, engine=engine, dtype=dtype, shard=shard)
+
+
 # ---------------------------------------------------------------------- kernel plane
 
 KERNEL_DISPATCHES = REGISTRY.counter(

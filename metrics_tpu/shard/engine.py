@@ -180,6 +180,17 @@ class ShardedEngine:
             self._devices[index % len(self._devices)] if self._devices else None
         )
         kwargs["telemetry_labels"] = {"shard": str(index)}
+        # tiered shards spill to per-shard subdirectories: shard indexes are
+        # stable across restarts (the manifest pins the ring), so a recovered
+        # shard finds exactly its own cold files
+        tier_cfg = kwargs.get("tier")
+        if tier_cfg is not None and tier_cfg.spill_directory:
+            kwargs["tier"] = dataclasses.replace(
+                tier_cfg,
+                spill_directory=os.path.join(
+                    tier_cfg.spill_directory, f"shard-{index:03d}"
+                ),
+            )
         if self._ckpt_cfg is not None:
             kwargs["checkpoint"] = dataclasses.replace(
                 self._ckpt_cfg,
@@ -239,12 +250,23 @@ class ShardedEngine:
         merged (migration copied the full state, so merging would double-count).
         """
         for index, engine in enumerate(self._engines):
-            with engine._dispatch_lock:
-                stale = [
-                    key for key in engine._keyed.keys if self._ring.shard_for(key) != index
-                ]
-                for key in stale:
-                    engine._keyed.evict(key)
+            stale = [
+                key
+                for key in self._shard_keys(engine)
+                if self._ring.shard_for(key) != index
+            ]
+            for key in stale:
+                # journaled retire: releases the slot to the free-list (or drops
+                # the tier entry + spill file) and makes the NEXT recovery agree
+                engine.evict_tenant(key)
+
+    @staticmethod
+    def _shard_keys(engine: StreamingEngine) -> List[Hashable]:
+        """Every tenant one shard knows: slab-resident plus warm/cold tiered."""
+        keys = list(engine._keyed.keys)
+        if engine._tier is not None:
+            keys.extend(engine._tier.keys())
+        return keys
 
     # ------------------------------------------------------------------ routing
 
@@ -272,7 +294,7 @@ class ShardedEngine:
         with self._admin_lock:
             out: List[Hashable] = []
             for engine in self._engines:
-                out.extend(engine._keyed.keys)
+                out.extend(self._shard_keys(engine))
             return tuple(out)
 
     # ------------------------------------------------------------------- writes
@@ -344,6 +366,38 @@ class ShardedEngine:
             for engine in self._engines:
                 out.update(engine.compute_all(window=window, sync=sync))
             return out
+
+    def register_tenants(self, keys: Sequence[Hashable]) -> int:
+        """Register tenants as cold residents on their ring-routed shards.
+
+        Requires the shards to be built with ``tier=TierConfig(...)``. Routes
+        each key once and batches per shard; returns how many were new."""
+        with self._admin_lock:
+            buckets: Dict[int, List[Hashable]] = {}
+            for key in keys:
+                buckets.setdefault(self._ring.shard_for(key), []).append(key)
+            added = 0
+            for index, batch in buckets.items():
+                added += self._engines[index].register_tenants(batch)
+        self._publish_tenant_gauges()
+        return added
+
+    def tenant_tier(self, key: Hashable) -> Optional[str]:
+        """Which tier ``key`` occupies on its shard (None = unknown tenant)."""
+        with self._admin_lock:
+            return self._engines[self._ring.shard_for(key)].tenant_tier(key)
+
+    def tier_stats(self) -> Dict[str, Any]:
+        """Summed residency counts + slab bytes, with the per-shard stats under
+        ``"shards"`` (index order)."""
+        with self._admin_lock:
+            per_shard = [engine.tier_stats() for engine in self._engines]
+        out: Dict[str, Any] = {
+            field: sum(stats[field] for stats in per_shard)
+            for field in ("hot", "warm", "cold", "pinned", "slab_bytes")
+        }
+        out["shards"] = per_shard
+        return out
 
     def health(self) -> Dict[str, Any]:
         """Aggregate state (worst shard wins) + the per-shard health dicts."""
@@ -453,9 +507,8 @@ class ShardedEngine:
             # the manifest). Drop it all before migration installs fresh
             # copies, or resurrected tenants would duplicate live ones.
             for engine in born:
-                with engine._dispatch_lock:
-                    for key in list(engine._keyed.keys):
-                        engine._keyed.evict(key)
+                for key in self._shard_keys(engine):
+                    engine.evict_tenant(key)
             for stripe in self._stripes:
                 stripe.acquire()
             try:
@@ -466,7 +519,10 @@ class ShardedEngine:
                     engine.flush()
                 moved: Dict[Hashable, Tuple[int, int]] = {}
                 for src_idx, src in enumerate(self._engines):
-                    for key in list(src._keyed.keys):
+                    # every tenant the shard knows migrates, whatever tier it
+                    # occupies: hot rows copy from the slab, warm/cold entries
+                    # copy without readmission (no slab churn during a resize)
+                    for key in self._shard_keys(src):
                         dst_idx = new_ring.shard_for(key)
                         if dst_idx == src_idx:
                             continue
@@ -499,9 +555,7 @@ class ShardedEngine:
                             engine.close(flush=False, checkpoint=False)
                         raise
                 for key, (src_idx, _) in moved.items():
-                    src = self._engines[src_idx]
-                    with src._dispatch_lock:
-                        src._keyed.evict(key)
+                    self._engines[src_idx].evict_tenant(key)
                 if self._ckpt_cfg is not None:
                     for engine in self._engines:
                         engine.checkpoint_now()
@@ -519,14 +573,15 @@ class ShardedEngine:
     def _copy_tenant(self, src: StreamingEngine, dst: StreamingEngine, key: Hashable) -> None:
         """Copy one tenant src → dst, bit-identically, through the ckpt container.
 
-        The source copy is left in place: ``resize`` evicts it only once the
-        destination copy and the ring routing to it are both durable.
+        The source copy is left in place (``retire=False``): ``resize`` evicts
+        it only once the destination copy and the ring routing to it are both
+        durable. The engine-level export/import pair handles every tier — a
+        warm or cold tenant migrates without ever touching either slab, and a
+        registered-but-silent one moves as a cold registration.
         """
-        with src._dispatch_lock:
-            blob = ckpt_format.dumps(self._export_tenant(src._keyed, key))
-        tree = ckpt_format.loads(blob).tree
-        with dst._dispatch_lock:
-            self._install_tenant(dst._keyed, key, tree)
+        entry = src.export_tenant(key, retire=False)
+        blob = ckpt_format.dumps(entry)
+        dst.import_tenant(key, ckpt_format.loads(blob).tree)
 
     @staticmethod
     def _export_tenant(keyed: Any, key: Hashable) -> Dict[str, Any]:
@@ -611,7 +666,9 @@ class ShardedEngine:
 
     def _publish_tenant_gauges(self) -> None:
         for index, engine in enumerate(self._engines):
-            _obs.set_shard_tenants(self.engine_id, index, len(engine._keyed.keys))
+            _obs.set_shard_tenants(
+                self.engine_id, index, len(self._shard_keys(engine))
+            )
 
     def publish_tenant_gauges(self) -> None:
         """Refresh ``metrics_tpu_shard_tenants`` from the live slot maps (obs-gated)."""

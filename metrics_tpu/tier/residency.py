@@ -1,0 +1,345 @@
+"""Residency manager: which tenants live where, and who gets demoted next.
+
+Mechanics and policy for the three-tier slab. A non-resident tenant is one
+*entry* — ``{"state": host tree | None, "ring": [row | None, ...], "rot": N}``
+— captured from the stacked slab at demotion time. ``rot`` is the engine's
+rotation counter when the entry was captured: window ring segments age out by
+rotation, so readmission (and host-side peeks) place each captured row at its
+*absolute* segment index rather than positionally, which is what makes a
+demote→readmit round trip bit-identical to a never-demoted twin even when
+rotations happened in between.
+
+The manager itself holds no locks: every mutating call happens on the engine's
+dispatcher thread or under the engine's dispatch lock (the same discipline the
+slab itself uses). Idleness is a per-tenant last-active stamp: ``touch``
+records the clock, seconds since the stamp (saturating at ``idle_demote_s``)
+is the coldness ordering, and a tenant with no stamp counts as fully idle.
+``touch`` runs once per dispatched request on the hot path, which is why it is
+a bare dict write rather than anything with a lock in it (the tier <5%
+overhead gate in benchmarks/engine_throughput.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Hashable, List, Optional, Set, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.engine.stream import KeyedState
+from metrics_tpu.tier.coldstore import ColdStore
+from metrics_tpu.tier.config import TierConfig
+
+HOT = "hot"
+WARM = "warm"
+COLD = "cold"
+
+
+# --------------------------------------------------------------------- mechanics
+
+
+def capture_entry(keyed: Any, key: Hashable) -> Dict[str, Any]:
+    """One tenant's full state as a host entry (live + ring rows + rotation stamp).
+
+    Does not mutate the slab — the caller evicts separately so the capture /
+    journal / evict order stays explicit at the call site.
+    """
+    state = keyed.state_of(key)
+    ring_rows: List[Any] = []
+    if isinstance(keyed, KeyedState):
+        slot = keyed._slots[key]
+        if keyed._ring is not None:
+            for cap, snap in keyed._ring:
+                if slot >= cap:
+                    ring_rows.append(None)
+                else:
+                    ring_rows.append(jax.tree.map(lambda x: x[slot], snap))
+    else:
+        if keyed._ring is not None:
+            for seg in keyed._ring:
+                ring_rows.append(seg.get(key))
+    entry = jax.device_get({"state": state, "ring": ring_rows})
+    entry["rot"] = int(keyed.rotations)
+    return entry
+
+
+def _scatter_ring_row(keyed: KeyedState, slot: int, pos: int, row: Any) -> None:
+    ring = keyed._ring
+    cap, snap = ring[pos]
+    if slot >= cap:
+        # the segment snapshot predates this slot: grow it so the readmitted
+        # contribution has a row to land in
+        leaves, treedef = jax.tree_util.tree_flatten(snap)
+        grown = [
+            jnp.concatenate(
+                [leaf, jnp.broadcast_to(init, (keyed.capacity - cap,) + init.shape)],
+                axis=0,
+            )
+            for leaf, init in zip(leaves, keyed._init_leaves)
+        ]
+        snap = jax.tree_util.tree_unflatten(treedef, grown)
+        cap = keyed.capacity
+    snap = jax.tree.map(lambda s, r: s.at[slot].set(jnp.asarray(r)), snap, row)
+    ring[pos] = (cap, snap)
+
+
+def restore_entry(keyed: Any, key: Hashable, entry: Dict[str, Any]) -> None:
+    """Readmit a captured entry into an already-allocated slot.
+
+    Each captured ring row lands at its absolute segment index (rows whose
+    segment aged out of the window are dropped); the captured live state lands
+    in the slab if no rotation happened since capture, otherwise in the ring
+    segment the live segment became — exactly where a never-demoted twin's
+    contribution would sit.
+    """
+    rot = int(entry.get("rot", keyed.rotations))
+    shift = keyed.rotations - rot
+    rows = list(entry.get("ring") or [])
+    state = entry.get("state")
+    if isinstance(keyed, KeyedState):
+        keyed.ensure_capacity()
+        slot = keyed._slots[key]
+        ring = keyed._ring
+        cur_len = len(ring) if ring is not None else 0
+        base = keyed.rotations - cur_len  # absolute index of ring[0]
+        for j, row in enumerate(rows):
+            if row is None:
+                continue
+            pos = (rot - len(rows) + j) - base
+            if 0 <= pos < cur_len:
+                _scatter_ring_row(keyed, slot, pos, row)
+        if state is not None:
+            if shift == 0:
+                keyed.set_state(key, jax.tree.map(jnp.asarray, state))
+            else:
+                pos = rot - base
+                if 0 <= pos < cur_len:
+                    _scatter_ring_row(keyed, slot, pos, state)
+    else:
+        ring = keyed._ring
+        cur_len = len(ring) if ring is not None else 0
+        base = keyed.rotations - cur_len
+        for j, row in enumerate(rows):
+            if row is None:
+                continue
+            pos = (rot - len(rows) + j) - base
+            if 0 <= pos < cur_len:
+                ring[pos][key] = row
+        if state is not None and shift == 0:
+            keyed.set_state(key, state)
+        else:
+            keyed.slot_for(key)  # ensure an init live state exists
+            if state is not None and shift > 0:
+                pos = rot - base
+                if 0 <= pos < cur_len:
+                    ring[pos][key] = state
+
+
+def peek_state(metric: Any, keyed: Any, entry: Dict[str, Any], *, window: bool) -> Any:
+    """Host-side read of a non-resident entry — no readmission, no slab writes.
+
+    Returns what ``state_of`` (``window=False``) or ``merged_state``
+    (``window=True``) would return had the tenant been readmitted first.
+    """
+    rot = int(entry.get("rot", keyed.rotations))
+    shift = keyed.rotations - rot
+    state = entry.get("state")
+    live = state if (state is not None and shift == 0) else None
+    ring = getattr(keyed, "_ring", None)
+    if not window or not ring:
+        return live if live is not None else metric.init_state()
+    base = keyed.rotations - len(ring)
+    rows = list(entry.get("ring") or [])
+    contributions: List[Tuple[int, Any]] = []
+    for j, row in enumerate(rows):
+        if row is None:
+            continue
+        abs_idx = rot - len(rows) + j
+        if abs_idx >= base:
+            contributions.append((abs_idx, row))
+    if state is not None and shift > 0 and rot >= base:
+        contributions.append((rot, state))
+    contributions.sort(key=lambda t: t[0])
+    merged = None
+    for _, row in contributions:
+        merged = row if merged is None else metric.merge_states(merged, row)
+    if live is not None:
+        merged = live if merged is None else metric.merge_states(merged, live)
+    return merged if merged is not None else metric.init_state()
+
+
+# ------------------------------------------------------------------------ policy
+
+
+class TierManager:
+    """Warm mirror + cold manifest + eviction policy for one engine."""
+
+    def __init__(self, cfg: TierConfig, metric: Any) -> None:
+        self.cfg = cfg
+        self.metric = metric
+        self.warm: Dict[Hashable, Dict[str, Any]] = {}
+        self.cold: Dict[Hashable, Optional[str]] = {}  # key -> spill file, None = init
+        self.pinned: Set[Hashable] = set()
+        self.store: Optional[ColdStore] = (
+            ColdStore(cfg.spill_directory, durable=cfg.durable)
+            if cfg.spill_directory
+            else None
+        )
+        self._heat: Dict[Hashable, float] = {}  # key -> last-active clock stamp
+        self._next_check = 0.0
+
+    # -------------------------------------------------------------- residency map
+
+    def has(self, key: Hashable) -> bool:
+        return key in self.warm or key in self.cold
+
+    def tier_of(self, key: Hashable) -> Optional[str]:
+        if key in self.warm:
+            return WARM
+        if key in self.cold:
+            return COLD
+        return None
+
+    def keys(self) -> Tuple[Hashable, ...]:
+        return tuple(self.warm) + tuple(self.cold)
+
+    def register_cold(self, key: Hashable) -> bool:
+        """Register a tenant with no state yet: a cold, init-valued resident.
+
+        Costs one dict entry — this is what lets a million registered tenants
+        coexist with a bounded slab.
+        """
+        if key in self.warm or key in self.cold:
+            return False
+        self.cold[key] = None
+        return True
+
+    def discard(self, key: Hashable) -> None:
+        """Drop any non-resident record for ``key`` (it went hot, or was evicted)."""
+        self.warm.pop(key, None)
+        name = self.cold.pop(key, None)
+        if name and self.store is not None:
+            self.store.delete(name)
+
+    def pop_entry(self, key: Hashable) -> Tuple[Optional[Dict[str, Any]], Optional[str]]:
+        """Remove and return (entry, source_tier) for a non-resident tenant.
+
+        A cold tenant's blob is read back through the ``MTCKPT1`` restore path;
+        its spill file is NOT deleted here — the caller deletes only after the
+        promotion is journaled, so recovery can never dangle on a pointer whose
+        promote record hasn't landed.
+        """
+        entry = self.warm.pop(key, None)
+        if entry is not None:
+            return entry, WARM
+        if key in self.cold:
+            name = self.cold.pop(key)
+            if name is None:
+                return None, COLD
+            assert self.store is not None
+            entry = self.store.load(name)
+            entry["_spill_file"] = name
+            return entry, COLD
+        return None, None
+
+    def peek_entry(self, key: Hashable) -> Optional[Dict[str, Any]]:
+        """Read a non-resident tenant's entry without changing its residency."""
+        entry = self.warm.get(key)
+        if entry is not None:
+            return entry
+        if key in self.cold:
+            name = self.cold[key]
+            if name is None:
+                return None
+            assert self.store is not None
+            return self.store.load(name)
+        return None
+
+    # ------------------------------------------------------------------- idleness
+
+    def touch(self, key: Hashable) -> None:
+        """Record activity: stamp the tenant's last-active instant."""
+        self._heat[key] = self.cfg.clock()
+
+    def idleness(self, key: Hashable) -> float:
+        """Seconds since last touch, saturating at ``idle_demote_s``."""
+        stamp = self._heat.get(key)
+        if stamp is None:
+            return self.cfg.idle_demote_s
+        idle = self.cfg.clock() - stamp
+        cap = self.cfg.idle_demote_s
+        return cap if idle > cap else (idle if idle > 0 else 0.0)
+
+    def forget_heat(self, key: Hashable) -> None:
+        self._heat.pop(key, None)
+
+    # --------------------------------------------------------------------- policy
+
+    def due(self, hot_count: int) -> bool:
+        """Cheap gate for the between-batches pass: over cap, or cadence elapsed."""
+        if hot_count > self.cfg.hot_capacity:
+            return True
+        now = self.cfg.clock()
+        if now >= self._next_check:
+            self._next_check = now + self.cfg.check_interval_s
+            return True
+        return False
+
+    def victims(
+        self, hot_keys: Any, need: int, quarantined: Set[Hashable]
+    ) -> List[Hashable]:
+        """Pick ``need`` demotion victims: quarantined first, then coldest."""
+        if need <= 0:
+            return []
+        scored = []
+        for i, key in enumerate(hot_keys):
+            if key in self.pinned:
+                continue
+            scored.append((key in quarantined, self.idleness(key), -i, key))
+        scored.sort(key=lambda t: (t[0], t[1], t[2]), reverse=True)
+        return [t[3] for t in scored[:need]]
+
+    def spill_victims(self) -> List[Hashable]:
+        """Warm tenants to push to disk (oldest demotions first)."""
+        if self.cfg.warm_capacity is None or self.store is None:
+            return []
+        excess = len(self.warm) - self.cfg.warm_capacity
+        if excess <= 0:
+            return []
+        return list(self.warm)[:excess]
+
+    # --------------------------------------------------------------- reset / views
+
+    def reset(self) -> List[str]:
+        """Zero every non-resident tenant (engine ``reset()``): all become
+        cold-with-init. Returns the orphaned spill file names for the caller
+        to delete (after the reset is journaled)."""
+        orphans = [name for name in self.cold.values() if name]
+        for key in list(self.warm):
+            self.cold[key] = None
+        self.warm.clear()
+        for key in list(self.cold):
+            self.cold[key] = None
+        self._heat.clear()
+        return orphans
+
+    def snapshot_view(self) -> Dict[str, Any]:
+        """The snapshot section for a partially-resident engine: the warm
+        mirror rides in the snapshot by value, cold tenants by manifest
+        pointer (the spill files are already durable containers)."""
+        return {
+            "warm": [[key, entry] for key, entry in self.warm.items()],
+            "cold": [[key, name] for key, name in self.cold.items()],
+            "pinned": list(self.pinned),
+            "spill_directory": self.store.directory if self.store else None,
+        }
+
+    def restore_view(self, view: Dict[str, Any]) -> None:
+        """Inherit a residency map (recovery, follower bootstrap, promotion)."""
+        self.warm = {key: entry for key, entry in view.get("warm") or []}
+        self.cold = {key: name for key, name in view.get("cold") or []}
+        self.pinned = set(view.get("pinned") or [])
+        self._heat.clear()
+        spill_dir = view.get("spill_directory")
+        if self.store is None and spill_dir:
+            self.store = ColdStore(spill_dir, durable=self.cfg.durable)

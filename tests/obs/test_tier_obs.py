@@ -1,0 +1,122 @@
+"""Tier-plane series exposure: master-gated residency/promotion/demotion/spill
+series plus the ``metrics_tpu_engine_slab_bytes`` gauge (per dtype group, with
+the shard label riding along on sharded engines) — and complete silence when
+``obs`` is disabled."""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from metrics_tpu import obs
+from metrics_tpu.classification import BinaryAccuracy
+from metrics_tpu.engine import StreamingEngine, TierConfig
+from metrics_tpu.shard import ShardConfig, ShardedEngine
+
+from tests.obs.prom_grammar import parse as parse_prometheus
+
+_FAMILIES = (
+    "metrics_tpu_tier_residency",
+    "metrics_tpu_tier_promotions_total",
+    "metrics_tpu_tier_demotions_total",
+    "metrics_tpu_tier_spill_bytes_total",
+    "metrics_tpu_engine_slab_bytes",
+)
+
+
+def _activity(tmp_path, enabled: bool) -> StreamingEngine:
+    if enabled:
+        obs.enable()
+    engine = StreamingEngine(
+        BinaryAccuracy(),
+        buckets=(8,),
+        tier=TierConfig(
+            hot_capacity=2,
+            warm_capacity=0,  # demotions spill straight to disk
+            spill_directory=str(tmp_path / "spill"),
+            idle_demote_s=0.01,
+            check_interval_s=0.0,
+        ),
+    )
+    try:
+        for i in range(6):
+            engine.submit(f"t{i}", np.ones(3, np.int32), np.ones(3, np.int32))
+        engine.flush()
+        for _ in range(3):
+            time.sleep(0.03)
+            engine.submit("hot", np.ones(2, np.int32), np.ones(2, np.int32))
+            engine.flush()
+        # readmit one spilled tenant so promotions_total fires too
+        engine.submit("t0", np.ones(1, np.int32), np.ones(1, np.int32))
+        engine.flush()
+        snap = engine.telemetry.snapshot()
+        assert snap["tier_demotions"] > 0 and snap["tier_promotions"] > 0
+        assert snap["tier_spills"] > 0
+        return engine
+    except BaseException:
+        engine.close()
+        raise
+
+
+def test_tier_series_render_when_enabled(tmp_path):
+    engine = _activity(tmp_path, enabled=True)
+    try:
+        text = obs.render_prometheus()
+        parse_prometheus(text)
+        for family in _FAMILIES:
+            assert f"# TYPE {family}" in text, family
+        # residency carries the tier label; slab bytes carries dtype + shard
+        assert 'tier="hot"' in text and 'tier="warm"' in text
+        assert "metrics_tpu_engine_slab_bytes{" in text
+        slab_line = next(
+            line for line in text.splitlines()
+            if line.startswith("metrics_tpu_engine_slab_bytes{")
+        )
+        assert "dtype=" in slab_line and "shard=" in slab_line
+    finally:
+        engine.close()
+
+
+def test_tier_series_silent_when_disabled(tmp_path):
+    engine = _activity(tmp_path, enabled=False)
+    try:
+        snap = obs.snapshot()
+        for family in _FAMILIES:
+            assert snap[family]["values"] == {}, family
+        text = obs.render_prometheus()
+        for family in _FAMILIES:
+            # TYPE/HELP headers always render for registered families; what
+            # must not appear is a recorded sample line
+            assert family + "{" not in text, f"{family} leaked with obs disabled"
+    finally:
+        engine.close()
+
+
+def test_slab_bytes_carries_shard_label(tmp_path):
+    obs.enable()
+    engine = ShardedEngine(
+        BinaryAccuracy(),
+        config=ShardConfig(shards=2, place_on_mesh=False),
+        buckets=(8,),
+        tier=TierConfig(hot_capacity=2, idle_demote_s=0.01, check_interval_s=0.0),
+    )
+    try:
+        for i in range(8):
+            engine.submit(f"t{i}", np.ones(2, np.int32), np.ones(2, np.int32))
+        engine.flush()
+        for _ in range(3):
+            time.sleep(0.03)
+            for i in range(2):
+                engine.submit(f"t{i}", np.ones(1, np.int32), np.ones(1, np.int32))
+            engine.flush()
+        text = obs.render_prometheus()
+        parse_prometheus(text)
+        shard_labels = {
+            seg.split("shard=")[1].split('"')[1]
+            for seg in text.splitlines()
+            if seg.startswith("metrics_tpu_engine_slab_bytes{") and "shard=" in seg
+        }
+        assert {"0", "1"} <= shard_labels
+    finally:
+        engine.close()

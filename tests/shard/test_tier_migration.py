@@ -1,0 +1,153 @@
+"""Tier-aware shard migration: resize() moves a tenant from whatever tier it
+occupies, cold registrations travel as registrations, and per-shard spill
+directories keep cold files separable across shards."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from metrics_tpu.classification import BinaryAccuracy
+from metrics_tpu.engine import CheckpointConfig, StreamingEngine, TierConfig
+from metrics_tpu.shard import ShardConfig, ShardedEngine
+from metrics_tpu.tier import COLD, HOT
+
+
+def _tier_cfg(tmp_path, **kw):
+    kw.setdefault("hot_capacity", 3)
+    kw.setdefault("warm_capacity", 2)
+    kw.setdefault("spill_directory", str(tmp_path / "spill"))
+    kw.setdefault("idle_demote_s", 0.01)
+    kw.setdefault("check_interval_s", 0.0)
+    return TierConfig(**kw)
+
+
+def _mk(tmp_path, shards=2, **kw):
+    return ShardedEngine(
+        BinaryAccuracy(),
+        config=ShardConfig(shards=shards, place_on_mesh=False),
+        buckets=(8,),
+        tier=_tier_cfg(tmp_path),
+        **kw,
+    )
+
+
+def _spread(engine, n=12, window=False):
+    rng = np.random.default_rng(0)
+    expect = {}
+    for i in range(n):
+        preds = rng.integers(0, 2, 5)
+        target = rng.integers(0, 2, 5)
+        engine.submit(f"k{i}", preds, target)
+        expect[f"k{i}"] = float((preds == target).mean())
+    engine.flush()
+    for _ in range(3):
+        time.sleep(0.03)
+        engine.submit("k0", np.empty(0, np.int32), np.empty(0, np.int32))
+        engine.flush()
+    return expect
+
+
+def test_resize_migrates_every_tier(tmp_path):
+    engine = _mk(tmp_path)
+    try:
+        expect = _spread(engine)
+        engine.register_tenants([f"silent{i}" for i in range(50)])
+        tiers_before = {key: engine.tenant_tier(key) for key in expect}
+        assert set(tiers_before.values()) > {HOT}  # mixed tiers going in
+        moved = engine.resize(4)
+        assert moved  # something actually migrated
+        for key, want in expect.items():
+            assert float(engine.compute(key)) == pytest.approx(want), key
+        # cold registrations moved as registrations, not slab rows
+        stats = engine.tier_stats()
+        assert stats["cold"] >= 50
+        for i in range(50):
+            assert engine.tenant_tier(f"silent{i}") == COLD
+        assert len(engine.keys) == len(expect) + 50
+    finally:
+        engine.close()
+
+
+def test_resize_preserves_window_history_across_tiers(tmp_path):
+    engine = ShardedEngine(
+        BinaryAccuracy(),
+        config=ShardConfig(shards=2, place_on_mesh=False),
+        buckets=(8,),
+        window=3,
+        tier=_tier_cfg(tmp_path),
+    )
+    try:
+        rng = np.random.default_rng(1)
+        totals = {f"k{i}": [0, 0] for i in range(8)}
+        for _ in range(2):
+            for key in totals:
+                preds = rng.integers(0, 2, 4)
+                target = rng.integers(0, 2, 4)
+                engine.submit(key, preds, target)
+                totals[key][0] += int((preds == target).sum())
+                totals[key][1] += 4
+            engine.flush()
+            engine.rotate_window()
+        for _ in range(3):
+            time.sleep(0.03)
+            engine.submit("k0", np.empty(0, np.int32), np.empty(0, np.int32))
+            engine.flush()
+        engine.resize(4)
+        for key, (hit, n) in totals.items():
+            assert float(engine.compute(key, window=True)) == pytest.approx(hit / n), key
+    finally:
+        engine.close()
+
+
+def test_per_shard_spill_directories(tmp_path):
+    engine = _mk(tmp_path)
+    try:
+        _spread(engine)
+        spill_root = str(tmp_path / "spill")
+        subdirs = sorted(d for d in os.listdir(spill_root) if d.startswith("shard-"))
+        assert subdirs == ["shard-000", "shard-001"]
+        # at least one shard actually spilled a cold file
+        files = [
+            name
+            for sub in subdirs
+            for name in os.listdir(os.path.join(spill_root, sub))
+        ]
+        assert any(name.endswith(".mtckpt") for name in files)
+    finally:
+        engine.close()
+
+
+def test_recovery_sweep_evicts_stale_tiered_copies(tmp_path):
+    ckpt = CheckpointConfig(directory=str(tmp_path / "ckpt"), interval_s=3600.0)
+    engine = _mk(tmp_path, checkpoint=ckpt)
+    expect = _spread(engine)
+    engine.checkpoint_now()
+    engine.resize(4)
+    engine.checkpoint_now()
+    engine.close(checkpoint=True)
+
+    # restart under the post-resize ring: the sweep must keep exactly one copy
+    # per tenant (hot or tiered), never a double
+    recovered = _mk(tmp_path, shards=4, checkpoint=ckpt)
+    try:
+        seen = list(recovered.keys)
+        assert len(seen) == len(set(seen))  # no tenant appears on two shards
+        for key, want in expect.items():
+            assert float(recovered.compute(key)) == pytest.approx(want), key
+    finally:
+        recovered.close()
+
+
+def test_tier_stats_and_gauges_cover_all_shards(tmp_path):
+    engine = _mk(tmp_path)
+    try:
+        expect = _spread(engine)
+        engine.register_tenants(["s1", "s2"])
+        stats = engine.tier_stats()
+        assert len(stats["shards"]) == 2
+        assert stats["hot"] + stats["warm"] + stats["cold"] == len(expect) + 2
+        assert stats["slab_bytes"] > 0
+    finally:
+        engine.close()

@@ -1,0 +1,100 @@
+"""Slot free-list: evicted slots recycle instead of burning the watermark,
+gated on a journaled retire record so WAL replay can never alias an old
+tenant's accumulator row onto the new tenant that reused its slot."""
+
+import numpy as np
+import pytest
+
+from metrics_tpu.classification import BinaryAccuracy
+from metrics_tpu.engine import CheckpointConfig, StreamingEngine
+
+
+def _mk(tmp_path):
+    return StreamingEngine(
+        BinaryAccuracy(),
+        buckets=(8,),
+        checkpoint=CheckpointConfig(directory=str(tmp_path / "ckpt"), interval_s=3600.0),
+    )
+
+
+def test_evicted_slot_is_reused_not_burned(tmp_path):
+    engine = _mk(tmp_path)
+    try:
+        for i in range(4):
+            engine.submit(f"k{i}", np.ones(2, np.int32), np.ones(2, np.int32))
+        engine.flush()
+        freed = engine._keyed._slots["k1"]
+        cap_before = engine._keyed.capacity
+        assert engine.evict_tenant("k1")
+        engine.submit("fresh", np.zeros(2, np.int32), np.ones(2, np.int32))
+        engine.flush()
+        # the new tenant landed in the freed slot, capacity did not grow
+        assert engine._keyed._slots["fresh"] == freed
+        assert engine._keyed.capacity == cap_before
+        # and the freed row was scrubbed: no inherited accumulator values
+        assert float(engine.compute("fresh")) == 0.0
+    finally:
+        engine.close()
+
+
+def test_churn_does_not_grow_the_slab(tmp_path):
+    engine = _mk(tmp_path)
+    try:
+        engine.submit("seed", np.ones(1, np.int32), np.ones(1, np.int32))
+        engine.flush()
+        cap = engine._keyed.capacity
+        for i in range(3 * cap):
+            key = f"churn{i}"
+            engine.submit(key, np.ones(1, np.int32), np.ones(1, np.int32))
+            engine.flush()
+            assert engine.evict_tenant(key)
+        assert engine._keyed.capacity == cap  # N evict+add cycles, zero growth
+    finally:
+        engine.close()
+
+
+def test_replay_of_retire_then_reuse_does_not_alias(tmp_path):
+    engine = _mk(tmp_path)
+    old = engine._keyed  # keep a handle; engine may be "crashed" below
+    engine.submit("victim", np.ones(6, np.int32), np.ones(6, np.int32))
+    engine.flush()
+    assert engine.evict_tenant("victim")
+    # the reuser takes victim's exact slot, with DIFFERENT data
+    engine.submit("reuser", np.zeros(3, np.int32), np.ones(3, np.int32))
+    engine.flush()
+    assert float(engine.compute("reuser")) == 0.0
+    engine._closed = True  # crash: recovery must replay retire + reuse in order
+
+    recovered = _mk(tmp_path)
+    try:
+        assert recovered.tenant_tier("victim") is None
+        # no aliasing: reuser's row holds only reuser's history — had replay
+        # skipped the retire record, victim's 6 correct rows would leak in
+        assert float(recovered.compute("reuser")) == 0.0
+        recovered.submit("reuser", np.ones(1, np.int32), np.ones(1, np.int32))
+        recovered.flush()
+        assert float(recovered.compute("reuser")) == pytest.approx(1 / 4)
+    finally:
+        recovered.close()
+
+
+def test_reused_slot_gets_fresh_wal_intro(tmp_path):
+    """A reused slot must re-introduce its (slot, key) pair to the WAL: the
+    chunk intro cache is keyed by slot, and a stale entry would make replay
+    attribute the new tenant's chunks to the retired key."""
+    engine = _mk(tmp_path)
+    engine.submit("a", np.ones(2, np.int32), np.ones(2, np.int32))
+    engine.submit("b", np.zeros(2, np.int32), np.ones(2, np.int32))
+    engine.flush()
+    assert engine.evict_tenant("a")
+    engine.submit("c", np.ones(4, np.int32), np.ones(4, np.int32))
+    engine.flush()
+    engine._closed = True
+
+    recovered = _mk(tmp_path)
+    try:
+        assert recovered.tenant_tier("a") is None
+        assert float(recovered.compute("b")) == 0.0
+        assert float(recovered.compute("c")) == 1.0
+    finally:
+        recovered.close()

@@ -1,0 +1,260 @@
+"""Tier plane residency: state machine, eviction policy, public API contract."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from metrics_tpu.classification import BinaryAccuracy
+from metrics_tpu.engine import StreamingEngine, TierConfig
+from metrics_tpu.tier import COLD, HOT, WARM, TierManager
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+
+def _tier_cfg(tmp_path, **kw):
+    kw.setdefault("hot_capacity", 2)
+    kw.setdefault("warm_capacity", 2)
+    kw.setdefault("spill_directory", str(tmp_path / "spill"))
+    kw.setdefault("idle_demote_s", 0.01)
+    kw.setdefault("check_interval_s", 0.0)
+    return TierConfig(**kw)
+
+
+def _engine(tmp_path, **kw):
+    return StreamingEngine(BinaryAccuracy(), buckets=(8,), tier=_tier_cfg(tmp_path, **kw))
+
+
+def _feed(engine, key, seed):
+    rng = np.random.default_rng(seed)
+    preds = rng.integers(0, 2, 6)
+    target = rng.integers(0, 2, 6)
+    engine.submit(key, preds, target)
+    return float((preds == target).mean())
+
+
+def _settle(engine, n=3):
+    """A few dispatcher turns so the between-batches eviction pass runs."""
+    for _ in range(n):
+        engine.flush()
+        time.sleep(0.03)
+        engine.submit("_settle", np.array([1]), np.array([1]))
+        engine.flush()
+
+
+class TestConfig:
+    def test_rejects_bad_values(self, tmp_path):
+        with pytest.raises(MetricsTPUUserError):
+            TierConfig(hot_capacity=0)
+        with pytest.raises(MetricsTPUUserError):
+            TierConfig(idle_demote_s=0.0)
+        with pytest.raises(MetricsTPUUserError):
+            TierConfig(check_interval_s=-1.0)
+        with pytest.raises(MetricsTPUUserError):
+            TierConfig(warm_capacity=-1)
+        # a warm cap without a spill directory has nowhere to push overflow
+        with pytest.raises(MetricsTPUUserError):
+            TierConfig(warm_capacity=4)
+
+    def test_untiered_engine_refuses_tier_apis(self):
+        engine = StreamingEngine(BinaryAccuracy(), buckets=(8,))
+        try:
+            with pytest.raises(MetricsTPUUserError):
+                engine.register_tenants(["a"])
+            with pytest.raises(MetricsTPUUserError):
+                engine.pin_tenant("a")
+            with pytest.raises(MetricsTPUUserError):
+                engine.demote_tenant("a")
+            # read-side surfaces still answer on an untiered engine
+            engine.submit("a", np.array([1]), np.array([1]))
+            engine.flush()
+            assert engine.tenant_tier("a") == HOT
+            assert engine.tier_stats()["hot"] == 1
+        finally:
+            engine.close()
+
+
+class TestStateMachine:
+    def test_hot_set_stays_bounded(self, tmp_path):
+        engine = _engine(tmp_path, hot_capacity=3, warm_capacity=None, spill_directory=None)
+        try:
+            expect = {f"k{i}": _feed(engine, f"k{i}", i) for i in range(10)}
+            _settle(engine)
+            stats = engine.tier_stats()
+            assert stats["hot"] <= 3
+            assert stats["hot"] + stats["warm"] + stats["cold"] >= 10
+            # every tenant still answers, resident or not
+            for key, want in expect.items():
+                assert float(engine.compute(key)) == pytest.approx(want)
+        finally:
+            engine.close()
+
+    def test_warm_overflow_spills_to_disk(self, tmp_path):
+        engine = _engine(tmp_path, hot_capacity=2, warm_capacity=1)
+        try:
+            expect = {f"k{i}": _feed(engine, f"k{i}", i) for i in range(8)}
+            _settle(engine)
+            stats = engine.tier_stats()
+            assert stats["cold"] >= 1
+            spill_dir = str(tmp_path / "spill")
+            assert any(n.endswith(".mtckpt") for n in os.listdir(spill_dir))
+            for key, want in expect.items():
+                assert float(engine.compute(key)) == pytest.approx(want)
+        finally:
+            engine.close()
+
+    def test_submit_readmits_transparently(self, tmp_path):
+        engine = _engine(tmp_path)
+        try:
+            _feed(engine, "a", 1)
+            engine.flush()
+            assert engine.demote_tenant("a")
+            assert engine.tenant_tier("a") == WARM
+            before = engine.telemetry.snapshot()["tier_promotions"]
+            # 4 correct rows on top of whatever seed 1 produced
+            engine.submit("a", np.ones(4, np.int32), np.ones(4, np.int32))
+            engine.flush()
+            assert engine.tenant_tier("a") == HOT
+            assert engine.telemetry.snapshot()["tier_promotions"] == before + 1
+        finally:
+            engine.close()
+
+    def test_compute_peeks_without_readmission(self, tmp_path):
+        engine = _engine(tmp_path)
+        try:
+            want = _feed(engine, "a", 2)
+            engine.flush()
+            engine.demote_tenant("a")
+            assert float(engine.compute("a")) == pytest.approx(want)
+            # the read did not change residency or burn a promotion
+            assert engine.tenant_tier("a") == WARM
+            assert engine.telemetry.snapshot()["tier_promotions"] == 0
+        finally:
+            engine.close()
+
+    def test_compute_all_covers_every_tier(self, tmp_path):
+        engine = _engine(tmp_path, hot_capacity=2, warm_capacity=1)
+        try:
+            expect = {f"k{i}": _feed(engine, f"k{i}", i) for i in range(6)}
+            _settle(engine)
+            engine.register_tenants(["silent"])
+            out = engine.compute_all()
+            for key, want in expect.items():
+                assert float(out[key]) == pytest.approx(want)
+            assert "silent" in out  # registered-but-silent answers its init value
+        finally:
+            engine.close()
+
+    def test_reset_zeroes_all_tiers(self, tmp_path):
+        engine = _engine(tmp_path, hot_capacity=2, warm_capacity=1)
+        try:
+            for i in range(6):
+                _feed(engine, f"k{i}", i)
+            _settle(engine)
+            engine.reset()
+            stats = engine.tier_stats()
+            # resident tenants stay hot with zeroed state (engine reset
+            # semantics); non-resident ones all become cold-with-init
+            assert stats["warm"] == 0
+            assert engine.tenant_tier("k0") in (HOT, COLD)
+            for i in range(6):
+                assert float(engine.compute(f"k{i}")) == 0.0
+            # orphaned spill files were deleted
+            spill_dir = str(tmp_path / "spill")
+            assert not any(n.endswith(".mtckpt") for n in os.listdir(spill_dir))
+        finally:
+            engine.close()
+
+
+class TestPolicy:
+    def test_pinned_never_demoted(self, tmp_path):
+        engine = _engine(tmp_path, hot_capacity=2, warm_capacity=None, spill_directory=None)
+        try:
+            _feed(engine, "vip", 1)
+            engine.flush()
+            engine.pin_tenant("vip")
+            for i in range(8):
+                _feed(engine, f"k{i}", i)
+            _settle(engine)
+            assert engine.tenant_tier("vip") == HOT
+            assert not engine.demote_tenant("vip")  # explicit demote refuses too
+            engine.unpin_tenant("vip")
+            assert engine.demote_tenant("vip")
+        finally:
+            engine.close()
+
+    def test_pin_readmits_nonresident(self, tmp_path):
+        engine = _engine(tmp_path)
+        try:
+            want = _feed(engine, "a", 3)
+            engine.flush()
+            engine.demote_tenant("a")
+            engine.pin_tenant("a")
+            assert engine.tenant_tier("a") == HOT
+            assert float(engine.compute("a")) == pytest.approx(want)
+        finally:
+            engine.close()
+
+    def test_victims_order_quarantined_then_coldest(self):
+        t = [0.0]
+        mgr = TierManager(
+            TierConfig(hot_capacity=1, idle_demote_s=100.0, clock=lambda: t[0]),
+            BinaryAccuracy(),
+        )
+        for key in ("a", "b", "c", "d"):
+            mgr.touch(key)
+            t[0] += 10.0  # a is idlest, d hottest
+        mgr.pinned.add("a")
+        victims = mgr.victims(("a", "b", "c", "d"), 2, quarantined={"d"})
+        # quarantined d leads even though it is the hottest; pinned a never shows
+        assert victims == ["d", "b"]
+        assert mgr.victims(("a", "b"), 0, set()) == []
+
+    def test_explicit_demote_and_export_import_roundtrip(self, tmp_path):
+        src = _engine(tmp_path, hot_capacity=8)
+        dst = StreamingEngine(BinaryAccuracy(), buckets=(8,))
+        try:
+            want = _feed(src, "a", 5)
+            src.flush()
+            entry = src.export_tenant("a")  # retires from src
+            assert src.tenant_tier("a") is None
+            dst.import_tenant("a", entry)
+            assert float(dst.compute("a")) == pytest.approx(want)
+            assert src.export_tenant("missing") is None
+        finally:
+            src.close()
+            dst.close()
+
+
+class TestRegistration:
+    def test_register_is_cheap_and_promotes_on_first_submit(self, tmp_path):
+        engine = _engine(tmp_path, hot_capacity=4)
+        try:
+            slab_before = engine.tier_stats()["slab_bytes"]
+            assert engine.register_tenants([f"t{i}" for i in range(5000)]) == 5000
+            assert engine.register_tenants(["t0", "t1"]) == 0  # idempotent
+            stats = engine.tier_stats()
+            assert stats["cold"] >= 5000
+            assert stats["slab_bytes"] == slab_before  # no slab growth
+            assert engine.tenant_tier("t17") == COLD
+            engine.submit("t17", np.ones(3, np.int32), np.ones(3, np.int32))
+            engine.flush()
+            assert engine.tenant_tier("t17") == HOT
+            assert float(engine.compute("t17")) == 1.0
+        finally:
+            engine.close()
+
+    def test_evict_tenant_forgets_everywhere(self, tmp_path):
+        engine = _engine(tmp_path)
+        try:
+            _feed(engine, "a", 1)
+            _feed(engine, "b", 2)
+            engine.flush()
+            engine.demote_tenant("b")
+            assert engine.evict_tenant("a")
+            assert engine.evict_tenant("b")
+            assert not engine.evict_tenant("never-seen")
+            assert engine.tenant_tier("a") is None
+            assert engine.tenant_tier("b") is None
+        finally:
+            engine.close()

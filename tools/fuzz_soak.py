@@ -1988,6 +1988,203 @@ def soak_shard(seeds) -> None:
             twin.close()
 
 
+# ---------------------------------------------------------------------- tier surface
+
+
+def _tier_stream(seed, n=4000, n_keys=24):
+    """Skewed tenant mix: a few whales plus a long idle tail, so the tier
+    policy keeps demote/spill/promote cycles continuously in flight while the
+    child runs. The child cycles this list until killed."""
+    rng = np.random.default_rng(seed)
+    weights = 1.0 / np.arange(1, n_keys + 1) ** 1.4
+    weights /= weights.sum()
+    return [(f"k{int(rng.choice(n_keys, p=weights))}",
+             rng.integers(0, 2, 3), rng.integers(0, 2, 3))
+            for _ in range(n)]
+
+
+def _tier_cfgs(dirpath, recovery=False):
+    """Child runs an aggressive policy (tiny hot set, near-zero idle
+    threshold, fsync WAL); recovery runs the same topology passively so
+    nothing demotes underneath the verification reads."""
+    from metrics_tpu.engine import CheckpointConfig, TierConfig
+
+    tier = TierConfig(
+        hot_capacity=4,
+        warm_capacity=2,
+        spill_directory=os.path.join(dirpath, "spill"),
+        idle_demote_s=3600.0 if recovery else 0.01,
+        check_interval_s=3600.0 if recovery else 0.0,
+    )
+    if recovery:
+        ckpt = CheckpointConfig(directory=os.path.join(dirpath, "ckpt"),
+                                interval_s=3600.0, durable=False)
+    else:
+        ckpt = CheckpointConfig(directory=os.path.join(dirpath, "ckpt"),
+                                interval_s=0.02, retain=3, durable=True,
+                                wal_flush="fsync")
+    return tier, ckpt
+
+
+def tier_crash_child(dirpath, seed):
+    """Child half of the tier crash surface: a tiered engine under the skewed
+    stream, cycling until the parent SIGKILLs it — possibly mid-spill or
+    mid-promote. Even seeds run a ShardedEngine and grow the ring mid-stream
+    so the kill can also land mid-``resize()``."""
+    from metrics_tpu.classification import BinaryAccuracy
+    from metrics_tpu.engine import StreamingEngine
+    from metrics_tpu.shard import ShardConfig, ShardedEngine
+
+    stream = _tier_stream(seed)
+    tier, ckpt = _tier_cfgs(dirpath)
+    rng = np.random.default_rng(seed ^ 0x7137)
+    if seed % 2 == 0:
+        engine = ShardedEngine(
+            BinaryAccuracy(),
+            config=ShardConfig(shards=2, place_on_mesh=False),
+            buckets=(8, 32), checkpoint=ckpt, tier=tier,
+        )
+        resize_at = int(rng.integers(200, 1200))
+    else:
+        engine = StreamingEngine(BinaryAccuracy(), buckets=(8, 32),
+                                 checkpoint=ckpt, tier=tier)
+        resize_at = None
+    # a cold long tail that never submits: registrations are snapshot-durable
+    engine.register_tenants([f"cold{i}" for i in range(64)])
+    print("READY", flush=True)
+    i = 0
+    while True:  # cycle until killed
+        for key, p, t in stream:
+            engine.submit(key, jnp.asarray(p), jnp.asarray(t))
+            i += 1
+            if resize_at is not None and i == resize_at:
+                engine.resize(3)
+
+
+def _tier_recovered_engines(dirpath, seed):
+    """(wrapper, [sub-engines]) recovered from the crash artifacts. The
+    sharded leg re-launches at the manifest's recorded shard count — the
+    documented operator flow after a crash that may have straddled a
+    resize."""
+    import json as _json
+
+    from metrics_tpu.classification import BinaryAccuracy
+    from metrics_tpu.engine import StreamingEngine
+    from metrics_tpu.shard import ShardConfig, ShardedEngine
+
+    tier, ckpt = _tier_cfgs(dirpath, recovery=True)
+    if seed % 2 == 0:
+        with open(os.path.join(dirpath, "ckpt", "shard_manifest.json")) as fh:
+            shards = int(_json.load(fh)["shards"])
+        engine = ShardedEngine(
+            BinaryAccuracy(),
+            config=ShardConfig(shards=shards, place_on_mesh=False),
+            buckets=(8, 32), checkpoint=ckpt, tier=tier,
+        )
+        return engine, list(engine.engines)
+    engine = StreamingEngine(BinaryAccuracy(), buckets=(8, 32),
+                             checkpoint=ckpt, tier=tier)
+    return engine, [engine]
+
+
+def _verify_tier_kill(dirpath, seed, tag):
+    from metrics_tpu.classification import BinaryAccuracy
+    from metrics_tpu.tier import HOT
+
+    stream = _tier_stream(seed)
+    per_key_pass: dict = {}
+    for key, p, t in stream:
+        per_key_pass.setdefault(key, []).extend(
+            (p[i : i + 1], t[i : i + 1]) for i in range(len(p))
+        )
+    engine, subs = _tier_recovered_engines(dirpath, seed)
+    try:
+        metric = BinaryAccuracy()
+        seen = set()
+        for shard_index, sub in enumerate(subs):
+            keys = list(sub._keyed.keys)
+            if sub._tier is not None:
+                keys.extend(sub._tier.keys())
+            for key in keys:
+                if key in seen:
+                    FAILS.append((seed, tag, f"tenant {key} recovered on two shards"))
+                    continue
+                seen.add(key)
+                if len(subs) > 1 and engine.shard_of(key) != shard_index:
+                    FAILS.append((seed, tag, f"tenant {key} on shard {shard_index}, ring says {engine.shard_of(key)}"))
+                before = sub.tenant_tier(key)
+                try:
+                    # every tenant must readmit, whatever tier the crash left it in
+                    sub.pin_tenant(key)
+                except Exception as exc:  # noqa: BLE001
+                    FAILS.append((seed, tag, f"tenant {key} (was {before}) failed to readmit: {repr(exc)[:140]}"))
+                    continue
+                if sub.tenant_tier(key) != HOT:
+                    FAILS.append((seed, tag, f"tenant {key} pinned but sits in {sub.tenant_tier(key)}"))
+                    continue
+                state = jax.device_get(sub._keyed.state_of(key))
+                rows_applied = int(np.asarray(state["_update_count"]))
+                one_pass = per_key_pass.get(key, [])
+                if not one_pass:
+                    if rows_applied:
+                        FAILS.append((seed, tag, f"tenant {key}: {rows_applied} rows recovered for a never-submitted tenant"))
+                    continue
+                # the child cycles the stream, so a tenant's submitted order is
+                # its per-pass row sequence repeated
+                rows = one_pass * (rows_applied // len(one_pass) + 1)
+                oracle_state = metric.init_state()
+                for p_row, t_row in rows[:rows_applied]:
+                    oracle_state = metric.update_state(oracle_state, jnp.asarray(p_row), jnp.asarray(t_row))
+                try:
+                    jax.tree_util.tree_map(
+                        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+                        state, jax.device_get(oracle_state),
+                    )
+                except Exception as exc:  # noqa: BLE001
+                    FAILS.append((seed, tag, f"tenant {key} (was {before}): recovered state != first-{rows_applied}-rows oracle: {repr(exc)[:120]}"))
+    finally:
+        engine.close(checkpoint=False)
+
+
+def soak_tier(seeds) -> None:
+    """Tier-plane crash surface (ISSUE 13): a tiered child engine with a tiny
+    hot set and a skewed tenant mix keeps demote/spill/promote cycles in
+    flight and is SIGKILLed at a random moment — possibly mid-spill or
+    mid-promote, with a mid-``resize()`` leg on even seeds (ShardedEngine,
+    recovered at the manifest's recorded shard count). The parent proves the
+    recovered state is an exactly-once, order-preserving prefix of the
+    submitted stream for every tenant, and that every tenant is readmittable
+    (pins to HOT) whatever tier the crash left it in. Self-oracled — needs no
+    reference checkout."""
+    import signal
+    import subprocess
+    import tempfile
+    import time as _time
+
+    for seed in seeds:
+        tag = f"tier/{'sharded' if seed % 2 == 0 else 'single'}"
+        with tempfile.TemporaryDirectory() as d:
+            child = subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__), "--tier-child", d, str(seed)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            )
+            try:
+                line = child.stdout.readline()
+                if "READY" not in line:
+                    err = child.stderr.read()[:200]
+                    FAILS.append((seed, tag, f"child failed to start: {line!r} {err!r}"))
+                    continue
+                rng = np.random.default_rng(seed ^ 0x71E4)
+                _time.sleep(float(rng.uniform(0.05, 0.6)))
+                child.send_signal(signal.SIGKILL)
+                child.wait(timeout=30)
+            finally:
+                if child.poll() is None:
+                    child.kill()
+                    child.wait(timeout=30)
+            _verify_tier_kill(d, seed, tag)
+
+
 # ---------------------------------------------------------------------- comm surface
 
 
@@ -2274,14 +2471,15 @@ SURFACES = {
     "cluster": soak_cluster,
     "shard": soak_shard,
     "comm": soak_comm,
+    "tier": soak_tier,
 }
 
 # surfaces that execute the reference as their oracle (everything except the
 # self-oracled engine, ckpt crash-recovery, guard chaos, repl, sketch,
-# cluster, shard and comm surfaces)
+# cluster, shard, comm and tier surfaces)
 _NEEDS_REF = {
     name for name in SURFACES
-    if name not in ("engine", "ckpt", "guard", "repl", "sketch", "cluster", "shard", "comm")
+    if name not in ("engine", "ckpt", "guard", "repl", "sketch", "cluster", "shard", "comm", "tier")
 }
 
 
@@ -2297,6 +2495,8 @@ def main() -> None:
                         help="internal: run the sketch-serving engine child (killed by the parent)")
     parser.add_argument("--cluster-child", nargs=2, metavar=("DIR", "SEED"),
                         help="internal: run the cluster leader child (killed by the parent)")
+    parser.add_argument("--tier-child", nargs=2, metavar=("DIR", "SEED"),
+                        help="internal: run the tiered-engine child (killed by the parent)")
     args = parser.parse_args()
 
     if args.ckpt_child is not None:
@@ -2314,6 +2514,10 @@ def main() -> None:
     if args.cluster_child is not None:
         dirpath, seed = args.cluster_child
         cluster_crash_child(dirpath, int(seed))
+        return
+    if args.tier_child is not None:
+        dirpath, seed = args.tier_child
+        tier_crash_child(dirpath, int(seed))
         return
 
     start, stop = (int(x) for x in args.seeds.split(":"))
