@@ -111,13 +111,24 @@ def main() -> None:
     baseline_update(preds, target)
     baseline_update(preds, target)
 
+    # the enabled pass runs with the WHOLE observability plane live — tracing,
+    # the flight recorder's edge ring (one recorded edge per round keeps it
+    # warm), a populated fleet aggregator, and an ambient trace context — so
+    # the <15% gate covers the full PR-14 surface, not just the span path
+    from metrics_tpu.obs.context import activate, mint
+    from metrics_tpu.obs.fleet import AGGREGATOR, node_snapshot
+    from metrics_tpu.obs.flight import FLIGHT
+
     best = {"baseline": float("inf"), "disabled": float("inf"), "enabled": float("inf")}
-    for _ in range(max(1, args.repeats)):
+    for i in range(max(1, args.repeats)):
         obs.disable()
         best["baseline"] = min(best["baseline"], time_round(baseline_update, (preds, target), args.updates))
         best["disabled"] = min(best["disabled"], time_round(stock.update, (preds, target), args.updates))
         obs.enable()
-        best["enabled"] = min(best["enabled"], time_round(stock.update, (preds, target), args.updates))
+        FLIGHT.record("bench_round", round=i)
+        AGGREGATOR.ingest(node_snapshot("bench"))
+        with activate(mint()):
+            best["enabled"] = min(best["enabled"], time_round(stock.update, (preds, target), args.updates))
     obs.disable()
 
     overhead_disabled = best["disabled"] / best["baseline"] - 1.0
@@ -133,19 +144,30 @@ def main() -> None:
     trace_path = os.path.join(args.out_dir, "obs_trace.json")
     prom_path = os.path.join(args.out_dir, "obs_metrics.prom")
     registry_path = os.path.join(args.out_dir, "obs_registry.jsonl")
+    fleet_path = os.path.join(args.out_dir, "obs_fleet.prom")
     obs.export_chrome_trace(trace_path)
     with open(prom_path, "w") as fh:
         fh.write(obs.render_prometheus())
     obs.emit(registry_path, run="obs_overhead")
+    with open(fleet_path, "w") as fh:
+        fh.write(AGGREGATOR.render_prometheus())
+    # one sample flight bundle, dumped through the real trigger machinery
+    obs.enable()
+    FLIGHT.configure(directory=args.out_dir)
+    bundle = FLIGHT.dump("guard_quarantine", source="obs_overhead_sample")
+    obs.disable()
+    bundle_path = bundle.get("path") if bundle else None
 
     checks = {
         "disabled_overhead_lt_gate": overhead_disabled < args.gate_disabled,
         "enabled_overhead_lt_gate": overhead_enabled < args.gate_enabled,
         "trace_exported": os.path.getsize(trace_path) > 2,
         "prometheus_exported": os.path.getsize(prom_path) > 0,
+        "fleet_exported": os.path.getsize(fleet_path) > 0,
+        "flight_bundle_written": bool(bundle_path) and os.path.getsize(bundle_path) > 2,
     }
     emit("obs overhead acceptance", float(all(checks.values())), "bool", checks=checks,
-         artifacts=[trace_path, prom_path, registry_path])
+         artifacts=[trace_path, prom_path, registry_path, fleet_path, bundle_path])
     if not all(checks.values()):
         sys.exit(1)
 

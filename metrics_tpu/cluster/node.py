@@ -38,6 +38,8 @@ from metrics_tpu.cluster.config import ClusterConfig
 from metrics_tpu.cluster.errors import ClusterConfigError, CoordStoreError
 from metrics_tpu.cluster.store import Lease, Member
 from metrics_tpu.obs import instrument as _obs
+from metrics_tpu.obs.fleet import AGGREGATOR, node_snapshot
+from metrics_tpu.obs.registry import OBS as _OBS
 from metrics_tpu.repl.errors import NotPromotableError
 from metrics_tpu.repl.transport import FanoutTransport
 from metrics_tpu.utils.exceptions import MetricsTPUUserError
@@ -154,6 +156,15 @@ class ClusterNode:
     def _publish_heartbeat(self, now: float, health: str, bootstrapped: bool, lag_seqs: int) -> None:
         if now - self._last_heartbeat < self.cfg.heartbeat_interval_s:
             return
+        fleet = None
+        if _OBS.enabled:
+            try:
+                # piggyback this node's telemetry snapshot on the membership
+                # record it already publishes; the leader merges every node's
+                # into the fleet view on its next _lead() pass
+                fleet = node_snapshot(self.cfg.node_id)
+            except Exception:  # noqa: BLE001 — telemetry must not break membership
+                fleet = None
         member = Member(
             node_id=self.cfg.node_id,
             role=self.role,
@@ -161,6 +172,7 @@ class ClusterNode:
             bootstrapped=bootstrapped,
             lag_seqs=lag_seqs,
             heartbeat=now,
+            fleet=fleet,
         )
         try:
             self._store.heartbeat(member)
@@ -174,6 +186,11 @@ class ClusterNode:
         except CoordStoreError as exc:
             self.last_error = exc
             return
+        if _OBS.enabled and self.role == "leader":
+            # the leader is the fleet's merge point: fold every member's
+            # piggybacked telemetry snapshot into the process aggregator off
+            # the member table this pass already fetched (zero extra store IO)
+            AGGREGATOR.ingest_members(members.values())
         for peer in self.cfg.peers:
             rec = members.get(peer)
             silent = now - rec.heartbeat if rec is not None else float("inf")
@@ -334,6 +351,9 @@ class ClusterNode:
             self.last_error = exc
             return
         if won is None:
+            # a real lost election: we were eligible, favoured, and attempted
+            # the CAS during an actual leader vacancy — another candidate won
+            _obs.record_cluster_election_failed(cfg.node_id)
             self._next_attempt = now + self._jitter(cfg.election_backoff_s)
             return
         self._lease = won

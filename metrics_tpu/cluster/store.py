@@ -40,7 +40,7 @@ import threading
 import time
 import zlib
 from dataclasses import dataclass
-from typing import Callable, Dict, List, Optional, Set, Tuple
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 from metrics_tpu.ckpt.store import atomic_write
 from metrics_tpu.cluster.errors import ClusterConfigError, CoordStoreError
@@ -84,6 +84,10 @@ class Member:
     bootstrapped: bool
     lag_seqs: int
     heartbeat: float  # store-clock instant of this record
+    # piggybacked telemetry snapshot (metrics_tpu.obs.fleet.node_snapshot):
+    # None unless obs is enabled on the publishing node — the leader merges
+    # these into the fleet-wide Prometheus view; never used for ranking
+    fleet: Optional[Dict[str, Any]] = None
 
 
 class CoordStore:
@@ -390,6 +394,8 @@ class DirectoryCoordStore(CoordStore):
             "lag_seqs": int(member.lag_seqs),
             "heartbeat": float(member.heartbeat),
         }
+        if member.fleet is not None:
+            doc["fleet"] = member.fleet
         try:
             atomic_write(self._member_path(member.node_id), _frame_record(doc), durable=False)
         except OSError as exc:
@@ -414,5 +420,6 @@ class DirectoryCoordStore(CoordStore):
                 bootstrapped=bool(doc["bootstrapped"]),
                 lag_seqs=int(doc["lag_seqs"]),
                 heartbeat=float(doc["heartbeat"]),
+                fleet=doc.get("fleet"),
             )
         return out

@@ -32,6 +32,8 @@ from __future__ import annotations
 import threading
 from typing import Any, Dict, Optional, Sequence, Tuple
 
+from metrics_tpu.obs import instrument as _obs
+
 __all__ = [
     "MembershipError",
     "WorldView",
@@ -129,10 +131,14 @@ class WorldView:
     def commit(self, agreed: Sequence[int]) -> Tuple[int, ...]:
         agreed_t = tuple(sorted(int(r) for r in agreed))
         with self._lock:
+            previous = self.last_agreed
             self._lost = set(range(self.world)) - set(agreed_t)
             self._lost.discard(self.rank)
             self.epoch += 1
             self.last_agreed = agreed_t
+        # flight-recorder evidence (+ bundle dump when the live set SHRANK):
+        # outside the lock — the dump walks registry/tracer state
+        _obs.record_comm_live_set(f"rank{self.rank}", previous, agreed_t)
         return agreed_t
 
     def watermarks(self, phase: str) -> Dict[int, int]:

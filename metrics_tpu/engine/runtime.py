@@ -83,8 +83,12 @@ from metrics_tpu.guard.errors import EngineQuarantined
 from metrics_tpu.guard.plane import GuardPlane
 from metrics_tpu.guard.watchdog import HangDetector, Watchdog
 from metrics_tpu.metric import Metric
+from metrics_tpu.obs import context as _obs_ctx
 from metrics_tpu.obs import instrument as _obs
+from metrics_tpu.obs.context import TraceContext as _TraceContext
+from metrics_tpu.obs.flight import FLIGHT as _FLIGHT
 from metrics_tpu.obs.registry import OBS as _OBS
+from metrics_tpu.obs.trace import TRACER as _TRACER
 from metrics_tpu.parallel.sync import sync_state_host
 from metrics_tpu.repl.config import ReplConfig, ReplicaLag
 from metrics_tpu.repl.errors import (
@@ -165,14 +169,24 @@ def _dec_array(payload: bytes, off: int) -> Tuple[np.ndarray, int]:
     return arr, off + count * dtype.itemsize
 
 
-def _encode_request_record(key_bytes: bytes, args: Tuple[Any, ...]) -> bytes:
+def _encode_request_record(
+    key_bytes: bytes, args: Tuple[Any, ...], ctx: Optional[_TraceContext] = None
+) -> bytes:
     parts = [b"R", _WAL_U32.pack(len(key_bytes)), key_bytes, bytes((len(args),))]
     for a in args:
         _enc_array(parts, np.asarray(a))
+    if ctx is not None:
+        # optional trace-context trailer: fixed 17 bytes after the positional
+        # body. Decoders test remaining length, so pre-tracing records (and
+        # obs-off writers) replay unchanged — the cross-host propagation
+        # carrier for WAL replay and repl shipment.
+        parts.append(ctx.to_bytes())
     return b"".join(parts)
 
 
-def _decode_request_record(payload: bytes) -> Tuple[Hashable, Tuple[Any, ...]]:
+def _decode_request_record(
+    payload: bytes,
+) -> Tuple[Hashable, Tuple[Any, ...], Optional[_TraceContext]]:
     (klen,) = _WAL_U32.unpack_from(payload, 1)
     off = 1 + _WAL_U32.size + klen
     key = pickle.loads(payload[1 + _WAL_U32.size : off])
@@ -182,7 +196,12 @@ def _decode_request_record(payload: bytes) -> Tuple[Hashable, Tuple[Any, ...]]:
     for _ in range(nargs):
         arr, off = _dec_array(payload, off)
         args.append(arr)
-    return key, tuple(args)
+    ctx = (
+        _TraceContext.from_bytes(payload, off)
+        if off + _obs_ctx.WIRE_SIZE <= len(payload)
+        else None
+    )
+    return key, tuple(args), ctx
 
 
 def _encode_chunk_record(
@@ -190,6 +209,7 @@ def _encode_chunk_record(
     key_ids: np.ndarray,
     mask: np.ndarray,
     columns: Sequence[np.ndarray],
+    ctxs: Sequence[_TraceContext] = (),
 ) -> bytes:
     parts = [b"C", struct.pack("<H", len(new_slots))]
     for slot, key_bytes in new_slots:
@@ -201,7 +221,46 @@ def _encode_chunk_record(
     _enc_array(parts, mask)
     for col in columns:
         _enc_array(parts, col)
+    # optional trailer: one wire block per request the chunk coalesced (same
+    # remaining-length convention as request records)
+    for ctx in ctxs:
+        parts.append(ctx.to_bytes())
     return b"".join(parts)
+
+
+def _record_trace_hexes(payload: bytes) -> str:
+    """Comma-joined trace ids from a WAL record's optional trace trailer.
+
+    Re-walks the positional structure with offset arithmetic only (zero-copy
+    ``frombuffer`` views, nothing materialised) to find where the trailer
+    starts; records without one — pre-tracing journals, obs-off writers,
+    non-request kinds — yield ``""``.
+    """
+    kind = payload[:1]
+    try:
+        if kind == b"R":
+            (klen,) = _WAL_U32.unpack_from(payload, 1)
+            off = 1 + _WAL_U32.size + klen
+            nargs = payload[off]
+            off += 1
+            for _ in range(nargs):
+                _, off = _dec_array(payload, off)
+        elif kind == b"C":
+            (n_new,) = struct.unpack_from("<H", payload, 1)
+            off = 3
+            for _ in range(n_new):
+                off += _WAL_U32.size
+                (klen,) = _WAL_U32.unpack_from(payload, off)
+                off += _WAL_U32.size + klen
+            ncols = payload[off]
+            off += 1
+            for _ in range(2 + ncols):  # key_ids, mask, columns
+                _, off = _dec_array(payload, off)
+        else:
+            return ""
+        return ",".join(c.trace_hex for c in _obs_ctx.iter_wire_blocks(payload, off))
+    except Exception:  # noqa: BLE001 — attribution is best-effort; replay decides validity
+        return ""
 
 
 def _encode_tier_record(kind: bytes, slot: int, key_bytes: bytes, blob: bytes = b"") -> bytes:
@@ -300,12 +359,14 @@ class _WorkerSuperseded(BaseException):
 
 class _Request:
     __slots__ = ("key", "slot", "args", "rows", "signature", "future", "t_submit",
-                 "rows_done", "seq", "deadline", "priority", "t_enqueue", "is_probe")
+                 "rows_done", "seq", "deadline", "priority", "t_enqueue", "is_probe",
+                 "ctx", "t_admitted", "t_drain")
 
     def __init__(self, key: Hashable, slot: Optional[int], args: Tuple[Any, ...],
                  rows: int, signature: Signature, future: "Future", t_submit: float,
                  deadline: Optional[float] = None, priority: int = 0,
-                 t_enqueue: float = 0.0, is_probe: bool = False) -> None:
+                 t_enqueue: float = 0.0, is_probe: bool = False,
+                 ctx: Optional[_TraceContext] = None, t_admitted: float = 0.0) -> None:
         self.key = key
         self.slot = slot
         self.args = args
@@ -329,6 +390,13 @@ class _Request:
         self.priority = priority
         self.t_enqueue = t_enqueue
         self.is_probe = is_probe
+        # obs plane: the cross-host trace context minted (or adopted) at
+        # submit, plus the segment stamps the per-request span is assembled
+        # from at resolution time. None/0.0 with obs off — the hot path pays
+        # two extra slot writes, no calls.
+        self.ctx = ctx
+        self.t_admitted = t_admitted
+        self.t_drain = 0.0
 
 
 def _component_metrics(metric: Any) -> List[Metric]:
@@ -547,6 +615,12 @@ class StreamingEngine:
         if replication is not None:
             self._init_replication(replication)
 
+        # flight-recorder context provider: dump() may run while a trigger
+        # site holds guard/engine locks, so this reads bare attributes only —
+        # NEVER health() (which takes self._lock and could deadlock the dump)
+        self._flight_provider_name = f"engine:{self.telemetry.engine_id}"
+        _FLIGHT.register_provider(self._flight_provider_name, self._flight_context)
+
         self._worker: Optional[threading.Thread] = None
         if start and not self._repl_follower:
             # a follower has no dispatcher: the applier thread owns its state
@@ -616,10 +690,33 @@ class StreamingEngine:
                     stacklevel=2,
                 )
         self._publish_health()
+        _FLIGHT.unregister_provider(self._flight_provider_name)
         if self._ckpt_writer is not None:
             self._ckpt_writer.close()
         if self._journal is not None:
             self._journal.close()
+
+    def _flight_context(self) -> Dict[str, Any]:
+        """Post-mortem context for flight-recorder bundles.
+
+        Lock-free by contract: bundles are dumped synchronously at trigger
+        sites that may already hold guard/engine locks, so everything here is
+        a bare attribute read (int/bool/len on a list reference) — slightly
+        racy values beat a deadlocked dump.
+        """
+        return {
+            "engine": self.telemetry.engine_id,
+            "wal_seq": self._wal_seq,
+            "health_state": self._last_health_state,
+            "queue_depth": len(self._queue),
+            "worker_restarts": self._worker_restarts,
+            "zombie_workers": self._zombie_workers,
+            "degraded": self._degraded,
+            "quarantined": self._quarantined,
+            "closed": self._closed,
+            "repl_follower": self._repl_follower,
+            "repl_epoch": self._repl_epoch,
+        }
 
     def __enter__(self) -> "StreamingEngine":
         return self
@@ -657,6 +754,10 @@ class StreamingEngine:
                 "bounded-staleness reads until promote() flips it writable"
             )
         t_submit = time.perf_counter()
+        # trace context: adopt the ambient one (a ShardedEngine delegation or a
+        # caller's activate()) or mint a fresh root — obs-off submits carry None
+        # after one attribute test
+        ctx = _obs_ctx.mint_or_current() if _OBS.enabled else None
         rows, signature = inspect_request(args)
         guard = self._guard
         abs_deadline: Optional[float] = None
@@ -675,6 +776,9 @@ class StreamingEngine:
             if guard.stamp_enqueue:
                 # the default guard clock IS perf_counter: reuse the entry stamp
                 t_enqueue = t_submit if guard.clock is time.perf_counter else guard.clock()
+        # admission segment boundary for the traced request (the guard checks
+        # above are everything between the two stamps)
+        t_admitted = time.perf_counter() if ctx is not None else 0.0
         try:
             future: Future = Future()
             with self._not_full:
@@ -687,7 +791,8 @@ class StreamingEngine:
                 if self._degraded or self._worker is None:
                     # synchronous per-call dispatch (dispatcher dead or never started)
                     req = _Request(key, self._alloc_slot(key), tuple(args), rows, signature,
-                                   future, t_submit, abs_deadline, priority, t_enqueue, is_probe)
+                                   future, t_submit, abs_deadline, priority, t_enqueue, is_probe,
+                                   ctx, t_admitted)
                     self.telemetry.count("submitted")
                     self._apply_inline(req)
                     return future
@@ -715,12 +820,14 @@ class StreamingEngine:
                         )
                     if self._degraded:
                         req = _Request(key, self._alloc_slot(key), tuple(args), rows, signature,
-                                       future, t_submit, abs_deadline, priority, t_enqueue, is_probe)
+                                       future, t_submit, abs_deadline, priority, t_enqueue, is_probe,
+                                       ctx, t_admitted)
                         self.telemetry.count("submitted")
                         self._apply_inline(req)
                         return future
                 req = _Request(key, self._alloc_slot(key), tuple(args), rows, signature,
-                               future, t_submit, abs_deadline, priority, t_enqueue, is_probe)
+                               future, t_submit, abs_deadline, priority, t_enqueue, is_probe,
+                               ctx, t_admitted)
                 self._queue.append(req)
                 self.telemetry.count("submitted")
                 self.telemetry.gauge_queue_depth(len(self._queue))
@@ -1067,6 +1174,10 @@ class StreamingEngine:
             if state != self._last_health_state:
                 hook_args = (self._last_health_state, state)
                 self._last_health_state = state
+        if hook_args is not None and _OBS.enabled:
+            # flight-recorder evidence trail (+ bundle dump on QUARANTINED):
+            # fires on the same once-per-edge detection the user hook rides
+            _obs.record_health_transition(self.telemetry.engine_id, *hook_args)
         if hook_args is not None and guard is not None and guard.cfg.on_health_transition is not None:
             try:
                 guard.cfg.on_health_transition(*hook_args)
@@ -1686,6 +1797,7 @@ class StreamingEngine:
         key_ids: Any,
         mask: Any,
         columns: Sequence[Any],
+        ctxs: Sequence[_TraceContext] = (),
     ) -> None:
         """Journal one committed fused micro-batch as a single chunk record.
 
@@ -1705,7 +1817,8 @@ class StreamingEngine:
                 self._wal_slots_sent.add(req.slot)
                 new_slots.append((req.slot, self._key_bytes(req.key)))
         record = _encode_chunk_record(
-            new_slots, np.asarray(key_ids), np.asarray(mask), [np.asarray(c) for c in columns]
+            new_slots, np.asarray(key_ids), np.asarray(mask),
+            [np.asarray(c) for c in columns], ctxs,
         )
         self._journal_append([record])
 
@@ -1721,7 +1834,9 @@ class StreamingEngine:
             return
         payloads = [
             _encode_request_record(
-                self._key_bytes(req.key), req.args if args_override is None else args_override
+                self._key_bytes(req.key),
+                req.args if args_override is None else args_override,
+                req.ctx,
             )
             for req in todo
         ]
@@ -1995,7 +2110,12 @@ class StreamingEngine:
                 rows = tuple(col[i] for col in columns)
                 keyed.update(key, *rows)
 
-    def _replay_request(self, key: Hashable, args: Tuple[Any, ...]) -> None:
+    def _replay_request(
+        self,
+        key: Hashable,
+        args: Tuple[Any, ...],
+        ctx: Optional[_TraceContext] = None,
+    ) -> None:
         """Re-apply one 'R' record as ONE whole-request update — exactly how
         the eager/inline paths that produce these records applied it (fused
         work replays through chunk records instead), so float accumulation
@@ -2191,7 +2311,24 @@ class StreamingEngine:
         return int(snap.tree.get("seq", -1))
 
     def _apply_wal_payload(self, payload: bytes) -> None:
-        """Dispatch one WAL record to its replayer (caller holds the dispatch lock)."""
+        """Dispatch one WAL record to its replayer (caller holds the dispatch lock).
+
+        With obs on, each replayed record runs inside an ``engine.replay`` span
+        carrying the trace ids the PRIMARY submit stamped into the record —
+        the cross-host/cross-incarnation link: a follower's apply (via
+        ``_repl_apply_record``) and a crash recovery's replay both land here,
+        so their spans name the original trace_id."""
+        if _OBS.enabled:
+            attrs: Dict[str, Any] = {"kind": payload[:1].decode("latin1")}
+            traces = _record_trace_hexes(payload)
+            if traces:
+                attrs["traces"] = traces
+            with _obs.engine_span("engine.replay", **attrs):
+                self._apply_wal_payload_inner(payload)
+            return
+        self._apply_wal_payload_inner(payload)
+
+    def _apply_wal_payload_inner(self, payload: bytes) -> None:
         kind = payload[:1]
         if kind == b"C":
             self._replay_chunk(payload)
@@ -2577,6 +2714,13 @@ class StreamingEngine:
                     self._idle.notify_all()
             if detector is not None:
                 detector.mark_busy()
+            if _OBS.enabled and batch:
+                # backlog segment boundary for traced requests: the instant
+                # the drain pulled them out of queue/backlog residency
+                t_drain = time.perf_counter()
+                for req in batch:
+                    if req.ctx is not None:
+                        req.t_drain = t_drain
             # fail expired/shed requests fast, outside the engine lock (future
             # callbacks run arbitrary user code)
             for req, exc in rejected:
@@ -2746,20 +2890,42 @@ class StreamingEngine:
             # with its own latency.
             self._apply_chunk_eager(units)
             return
-        kernel = self._get_kernel(signature, bucket, self._keyed.capacity)
-        columns, key_ids, mask = pad_micro_batch(
-            [(req.slot, chunk_args, rows) for req, chunk_args, rows, _ in units], bucket
-        )
-        with _obs.engine_span("engine.dispatch", bucket=bucket, rows=total_rows):
-            self._keyed.stacked = kernel(self._keyed.stacked, key_ids, mask, *columns)
-            # commit before completing futures: surfaces device-side errors here and
-            # makes the receipt mean "your rows are in the state", not "your rows are
-            # enqueued"
-            jax.block_until_ready(self._keyed.stacked)
-        # WAL after commit, before acks: an acknowledged chunk is always
-        # replayable, and a chunk whose trace failed is never journaled
-        if self._journal is not None:
-            self._journal_chunk(units, key_ids, mask, columns)
+        # traced contexts this micro-batch coalesced (deduped — a request split
+        # into several row-chunks packed into one micro-batch links once)
+        traced: List[_TraceContext] = []
+        if _OBS.enabled:
+            seen_spans: set = set()
+            for req, _, _, _ in units:
+                rctx = req.ctx
+                if rctx is not None and rctx.span_id not in seen_spans:
+                    seen_spans.add(rctx.span_id)
+                    traced.append(rctx)
+        with _obs.engine_span(
+            "engine.batch", bucket=bucket, rows=total_rows, n_units=len(units)
+        ) as bspan:
+            if traced:
+                # THE batch↔request link: one batch span naming every request
+                # context it coalesced (trace ids, comma-joined)
+                bspan.set_attr(
+                    traces=",".join(c.trace_hex for c in traced), linked=len(traced)
+                )
+            kernel = self._get_kernel(signature, bucket, self._keyed.capacity)
+            columns, key_ids, mask = pad_micro_batch(
+                [(req.slot, chunk_args, rows) for req, chunk_args, rows, _ in units], bucket
+            )
+            t_k0 = time.perf_counter() if traced else 0.0
+            with _obs.engine_span("engine.dispatch", bucket=bucket, rows=total_rows):
+                self._keyed.stacked = kernel(self._keyed.stacked, key_ids, mask, *columns)
+                # commit before completing futures: surfaces device-side errors here and
+                # makes the receipt mean "your rows are in the state", not "your rows are
+                # enqueued"
+                jax.block_until_ready(self._keyed.stacked)
+            t_k1 = time.perf_counter() if traced else 0.0
+            # WAL after commit, before acks: an acknowledged chunk is always
+            # replayable, and a chunk whose trace failed is never journaled
+            if self._journal is not None:
+                self._journal_chunk(units, key_ids, mask, columns, traced)
+            t_j = time.perf_counter() if traced else 0.0
         self.telemetry.observe_batch(total_rows, bucket)
         now = time.perf_counter()
         for req, _, rows, is_last in units:
@@ -2768,10 +2934,41 @@ class StreamingEngine:
                 continue
             self.telemetry.count("processed")
             self.telemetry.observe_latency(now - req.t_submit)
+            if traced and req.ctx is not None:
+                self._emit_request_span(req, bucket, t_k0, t_k1, t_j, now)
             req.future.set_result({"key": req.key, "rows": req.rows, "bucket": bucket})
             if self._guard is not None and self._guard._quarantine_entries:
                 # successes only matter to tenants with a live failure ledger
                 self._guard.on_request_outcome(req.key, True)
+
+    def _emit_request_span(
+        self, req: _Request, bucket: int, t_k0: float, t_k1: float, t_j: float, now: float
+    ) -> None:
+        """One retrospective ``engine.request`` span per traced request,
+        emitted at resolution time: its duration is the client-observed
+        latency (submit entry → future resolution) and its attrs decompose it
+        into admission/backlog/dispatch/kernel/journal segments that partition
+        submit→journal-end exactly — the residue vs the span's own duration is
+        just the resolution loop itself (the ≥95% trace-test criterion)."""
+        ctx = req.ctx
+        t_admitted = req.t_admitted or req.t_submit
+        t_drain = req.t_drain or t_admitted
+        _TRACER.record_span(
+            "engine.request",
+            int(req.t_submit * 1e9),
+            int((now - req.t_submit) * 1e9),
+            parent="engine.batch",
+            trace=ctx.trace_hex,
+            span=ctx.span_hex,
+            bucket=bucket,
+            rows=req.rows,
+            admission_s=t_admitted - req.t_submit,
+            backlog_s=t_drain - t_admitted,
+            dispatch_s=t_k0 - t_drain,
+            kernel_s=t_k1 - t_k0,
+            journal_s=t_j - t_k1,
+            total_s=now - req.t_submit,
+        )
 
     def _apply_chunk_eager(self, units: List[Tuple[_Request, Tuple[Any, ...], int, bool]]) -> None:
         """Apply one chunk's rows eagerly under the dispatch lock (compile breaker
@@ -2784,7 +2981,7 @@ class StreamingEngine:
             try:
                 if self._journal is not None:
                     self._journal_append(
-                        [_encode_request_record(self._key_bytes(req.key), chunk_args)]
+                        [_encode_request_record(self._key_bytes(req.key), chunk_args, req.ctx)]
                     )
                 self._keyed.ensure_capacity()
                 state = self._keyed.state_of(req.key)

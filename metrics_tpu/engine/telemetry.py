@@ -99,6 +99,10 @@ _COUNTERS = (
 # distinguishes engines within one process; monotone so labels never collide
 _ENGINE_IDS = itertools.count()
 
+# observations between exported-quantile refreshes: the nearest-rank pass over
+# the ring is O(window log window) — amortised to noise at this cadence
+_QUANTILE_REFRESH = 64
+
 
 class EngineTelemetry:
     """Registry-backed counters + histograms for one :class:`StreamingEngine`."""
@@ -156,6 +160,20 @@ class EngineTelemetry:
         self._occupancy_key = self._occupancy.label_key(**self._label)
         self._latency_key = self._latency.label_key(**self._label)
 
+        # exact percentiles as scrapeable gauges: the bucketed histogram only
+        # bounds quantiles to an edge pair, but the ring below holds exact
+        # recent samples — export nearest-rank p50/p99 from it, refreshed every
+        # _QUANTILE_REFRESH observations (the np.percentile pass is too costly
+        # per-request) and on every snapshot()
+        self._quantile = reg.gauge(
+            "metrics_tpu_engine_latency_quantile_seconds",
+            "Exact nearest-rank submit()→commit latency percentiles over the "
+            "telemetry ring window (recent requests, not lifetime).",
+        )
+        self._quantile_keys = {
+            q: self._quantile.label_key(quantile=q, **self._label) for q in ("0.5", "0.99")
+        }
+
         # latency ring: fixed-size, overwritten oldest-first — exact-percentile
         # quality degrades gracefully under sustained load instead of growing
         # without bound (the registry histogram keeps only bucketed counts)
@@ -208,6 +226,20 @@ class EngineTelemetry:
         with self._ring_lock:
             self._latencies[self._lat_count % len(self._latencies)] = seconds
             self._lat_count += 1
+            refresh = self._lat_count % _QUANTILE_REFRESH == 0
+        if refresh:
+            self._refresh_quantiles()
+
+    def _refresh_quantiles(self) -> None:
+        """Recompute the exported p50/p99 gauges from the latency ring."""
+        with self._ring_lock:
+            n = min(self._lat_count, len(self._latencies))
+            lat = np.array(self._latencies[:n]) if n else None
+        if lat is None:
+            return
+        p50, p99 = np.percentile(lat, [50, 99], method="nearest")
+        self._quantile.set_key(self._quantile_keys["0.5"], float(p50))
+        self._quantile.set_key(self._quantile_keys["0.99"], float(p99))
 
     # ------------------------------------------------------------------ reading
 
@@ -233,6 +265,10 @@ class EngineTelemetry:
             # truncation made it unreachable below n=100 and degraded badly on a
             # partially-filled ring), and n=1 / wrapped-ring cases are exact
             p50, p99 = np.percentile(lat, [50, 99], method="nearest")
+            # a snapshot is also a scrape point: publish fresh gauges so the
+            # exported quantiles are never staler than the last snapshot
+            self._quantile.set_key(self._quantile_keys["0.5"], float(p50))
+            self._quantile.set_key(self._quantile_keys["0.99"], float(p99))
             out["latency_s"] = {
                 "count": int(total),
                 "p50": float(p50),
@@ -266,5 +302,5 @@ class EngineTelemetry:
         rematerialise.
         """
         for inst in (self._events, self._depth, self._occupancy, self._latency,
-                     self._resize_seconds):
+                     self._resize_seconds, self._quantile):
             inst.drop_labels(**self._label)

@@ -43,6 +43,9 @@ from metrics_tpu.obs.registry import (
     Registry,
 )
 from metrics_tpu.obs.trace import TRACER, Tracer
+from metrics_tpu.obs.context import TraceContext, activate, current, mint
+from metrics_tpu.obs.fleet import AGGREGATOR, FleetAggregator, node_snapshot
+from metrics_tpu.obs.flight import FLIGHT, FlightRecorder, load_bundle
 from metrics_tpu.obs import instrument  # noqa: F401  (registers the hook instruments)
 
 
@@ -98,14 +101,21 @@ def emit(path: str, **extra: Any) -> Dict[str, Any]:
 
 
 def reset() -> None:
-    """Disable and clear all recorded values/spans, keeping registered
-    instruments (and references held to them) valid. Test-isolation hook."""
+    """Disable and clear all recorded values/spans/flight evidence/fleet state,
+    keeping registered instruments (and references held to them) valid.
+    Test-isolation hook."""
     disable()
     REGISTRY.clear_values()
     TRACER.clear()
+    FLIGHT.clear()
+    AGGREGATOR.clear()
 
 
 __all__ = [
+    "AGGREGATOR",
+    "FLIGHT",
+    "FleetAggregator",
+    "FlightRecorder",
     "OBS",
     "REGISTRY",
     "TRACER",
@@ -114,9 +124,12 @@ __all__ = [
     "Histogram",
     "ObsGate",
     "Registry",
+    "TraceContext",
     "Tracer",
+    "activate",
     "append_jsonl",
     "counter",
+    "current",
     "disable",
     "emit",
     "enable",
@@ -125,6 +138,9 @@ __all__ = [
     "gauge",
     "histogram",
     "instrument",
+    "load_bundle",
+    "mint",
+    "node_snapshot",
     "render_prometheus",
     "reset",
     "snapshot",

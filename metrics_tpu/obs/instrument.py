@@ -30,6 +30,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from metrics_tpu.obs.flight import FLIGHT
 from metrics_tpu.obs.registry import OBS, REGISTRY
 from metrics_tpu.obs.trace import _NULL_SPAN, TRACER
 
@@ -404,6 +405,27 @@ def record_comm_partial_sync(site: str) -> None:
     COMM_PARTIAL_SYNCS.inc(1, site=site)
 
 
+def record_comm_live_set(site: str, previous: Any, agreed: Any) -> None:
+    """One committed ``agree_live_set`` outcome: the membership edge lands in
+    the flight ring, and an agreed set that LOST ranks relative to the
+    previous commit (a real partition/death, not a rejoin) dumps a bundle."""
+    if not OBS.enabled:
+        return
+    prev = set(previous) if previous is not None else None
+    now_set = set(agreed)
+    FLIGHT.record(
+        "comm_live_set",
+        site=site,
+        previous=sorted(prev) if prev is not None else None,
+        agreed=sorted(now_set),
+    )
+    if prev is not None and (prev - now_set):
+        FLIGHT.dump(
+            "live_set_shrink", site=site, lost=sorted(prev - now_set),
+            agreed=sorted(now_set),
+        )
+
+
 def comm_span(name: str, **attrs: Any) -> Any:
     """Trace span for comm-plane internals (sync, gather, encode/decode)."""
     if not OBS.enabled:
@@ -512,22 +534,48 @@ _HEALTH_CODES = {"SERVING": 0, "DEGRADED": 1, "QUARANTINED": 2}
 
 def record_guard_event(engine: str, kind: str, n: int = 1) -> None:
     """Count one guard decision (kind in shed|quota_rejections|deadline_expired|
-    watchdog_restarts|quarantines) against its engine label."""
+    watchdog_restarts|quarantines) against its engine label.
+
+    Tenant quarantines and watchdog restarts are flight-recorder triggering
+    edges (the guard fires this exactly once per edge): each dumps one
+    post-mortem bundle on top of the counter."""
     if not OBS.enabled:
         return
     _GUARD_EVENT_COUNTERS[kind].inc(n, engine=engine)
+    if kind == "quarantines":
+        FLIGHT.record("guard_quarantine", engine=engine)
+        FLIGHT.dump("guard_quarantine", engine=engine)
+    elif kind == "watchdog_restarts":
+        FLIGHT.record("watchdog_restart", engine=engine)
+        FLIGHT.dump("watchdog_restart", engine=engine)
 
 
 def set_guard_breaker_state(engine: str, breaker: str, state_code: int) -> None:
     if not OBS.enabled:
         return
     GUARD_BREAKER_STATE.set(state_code, engine=engine, breaker=breaker)
+    # the flight recorder dedups gauge refreshes into edges and dumps one
+    # bundle on the transition INTO open (2)
+    FLIGHT.record_breaker_state(engine, breaker, state_code)
 
 
 def set_guard_health(engine: str, state: str) -> None:
     if not OBS.enabled:
         return
     GUARD_HEALTH_STATE.set(_HEALTH_CODES[state], engine=engine)
+
+
+def record_health_transition(engine: str, old: str, new: str) -> None:
+    """One engine health-state edge (fired beside the user's
+    ``on_health_transition`` observer — exactly once per transition, outside
+    the engine's locks). Entering QUARANTINED dumps a flight bundle: the
+    engine just declared itself unable to serve safely, which is precisely
+    when the run-up evidence matters."""
+    if not OBS.enabled:
+        return
+    FLIGHT.record("health_transition", engine=engine, old=old, new=new)
+    if new == "QUARANTINED":
+        FLIGHT.dump("engine_quarantine", engine=engine, old=old)
 
 
 def guard_span(name: str, **attrs: Any) -> Any:
@@ -587,6 +635,7 @@ def record_repl_promotion(engine: str) -> None:
     if not OBS.enabled:
         return
     REPL_PROMOTIONS.inc(1, engine=engine)
+    FLIGHT.record("repl_promotion", engine=engine)
 
 
 def repl_span(name: str, **attrs: Any) -> Any:
@@ -631,6 +680,7 @@ def record_cluster_failover(node: str) -> None:
     if not OBS.enabled:
         return
     CLUSTER_FAILOVERS.inc(1, node=node)
+    FLIGHT.record("cluster_failover", node=node)
 
 
 def record_cluster_lease_renewal(node: str) -> None:
@@ -643,6 +693,18 @@ def record_cluster_suspicion(node: str, peer: str) -> None:
     if not OBS.enabled:
         return
     CLUSTER_SUSPICIONS.inc(1, node=node, peer=peer)
+    FLIGHT.record("cluster_suspicion", node=node, peer=peer)
+
+
+def record_cluster_election_failed(node: str) -> None:
+    """One lost election: this node was eligible, past its backoff, raced the
+    lease CAS during an actual leader vacancy — and lost. Routine contention
+    against a LIVE leader never reaches this hook, so each firing is a real
+    failover-stalled edge worth a bundle."""
+    if not OBS.enabled:
+        return
+    FLIGHT.record("election_failed", node=node)
+    FLIGHT.dump("election_failed", node=node)
 
 
 # ---------------------------------------------------------------------- shard plane

@@ -135,6 +135,30 @@ class Tracer:
         stack = self._stack()
         return stack[-1] if stack else None
 
+    def record_span(
+        self,
+        name: str,
+        start_ns: int,
+        dur_ns: int,
+        parent: Optional[str] = None,
+        **attrs: Any,
+    ) -> None:
+        """Record one ALREADY-MEASURED span directly into the ring.
+
+        For retrospective spans whose boundaries were stamped elsewhere — the
+        engine's per-request lifetime span is assembled at future-resolution
+        time from timestamps collected across submit/drain/kernel/journal.
+        ``start_ns`` is on the ``time.perf_counter_ns`` clock (same epoch the
+        live spans use, so exported traces interleave correctly).
+        """
+        if not OBS.enabled:
+            return
+        thread = threading.current_thread()
+        self._record(
+            (name, int(start_ns), max(0, int(dur_ns)), thread.ident or 0,
+             thread.name, parent, attrs)
+        )
+
     # ------------------------------------------------------------------ reading
 
     @property

@@ -31,6 +31,7 @@ import time
 from typing import Any, List, Optional
 
 from metrics_tpu.obs import instrument as _obs
+from metrics_tpu.obs.fleet import AGGREGATOR
 from metrics_tpu.repl.config import ReplConfig, ReplicaLag
 from metrics_tpu.repl.transport import HeartbeatFrame, ShipFrame, SnapshotFrame, WalFrame
 
@@ -137,6 +138,9 @@ class ReplicaApplier:
                     elif isinstance(frame, SnapshotFrame):
                         self._apply_snapshot(frame)
                     elif isinstance(frame, HeartbeatFrame):
+                        fleet = getattr(frame, "fleet", None)  # old pickles lack the slot
+                        if fleet is not None:
+                            AGGREGATOR.ingest(fleet)
                         self._learn_known(frame.epoch, frame.last_seq)
                         if (
                             self.bootstrapped

@@ -36,6 +36,8 @@ from typing import Any, Optional
 
 from metrics_tpu.ckpt import format as ckpt_format
 from metrics_tpu.obs import instrument as _obs
+from metrics_tpu.obs.fleet import node_snapshot
+from metrics_tpu.obs.registry import OBS as _OBS
 from metrics_tpu.repl.config import ReplConfig
 from metrics_tpu.repl.errors import FencedError
 from metrics_tpu.repl.transport import HeartbeatFrame, SnapshotFrame, WalFrame
@@ -167,8 +169,17 @@ class Shipper:
         # only ORDERS advancements on the follower, never ages them)
         now_mono = time.monotonic()
         if now_mono - self._last_heartbeat >= self.cfg.heartbeat_interval_s:
+            # piggyback the primary's telemetry snapshot on the heartbeat it
+            # already sends — the follower's aggregator merges it into the
+            # fleet view with zero new transport surface
+            fleet = None
+            if _OBS.enabled:
+                try:
+                    fleet = node_snapshot(f"primary:{self._engine_label}")
+                except Exception:  # noqa: BLE001 — telemetry must not break shipping
+                    fleet = None
             self.transport.send(
-                [HeartbeatFrame(self.epoch, int(self._journal.last_seq), t_wall)]
+                [HeartbeatFrame(self.epoch, int(self._journal.last_seq), t_wall, fleet)]
             )
             self._last_heartbeat = now_mono
 

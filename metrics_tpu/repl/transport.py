@@ -42,7 +42,7 @@ import threading
 import time
 import zlib
 from collections import deque
-from typing import Callable, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from metrics_tpu.ckpt.store import atomic_write
 from metrics_tpu.repl.errors import FencedError, ReplPeerLostError, ReplTransportError
@@ -119,13 +119,27 @@ class WalFrame(ShipFrame):
 
 class HeartbeatFrame(ShipFrame):
     """Primary liveness + position: lets a caught-up follower keep its
-    ``seconds_behind`` near zero even when no traffic flows."""
+    ``seconds_behind`` near zero even when no traffic flows.
 
-    __slots__ = ("last_seq",)
+    ``fleet`` piggybacks the primary's telemetry snapshot
+    (:func:`metrics_tpu.obs.fleet.node_snapshot`) on the channel the pair
+    already owns — None unless obs is enabled on the sender. Frames pickled by
+    an older build restore without the slot; read it with
+    ``getattr(frame, "fleet", None)``.
+    """
 
-    def __init__(self, epoch: int, last_seq: int, t_wall: float) -> None:
+    __slots__ = ("last_seq", "fleet")
+
+    def __init__(
+        self,
+        epoch: int,
+        last_seq: int,
+        t_wall: float,
+        fleet: Optional[Dict[str, Any]] = None,
+    ) -> None:
         super().__init__(epoch, t_wall)
         self.last_seq = int(last_seq)
+        self.fleet = fleet
 
 
 # ----------------------------------------------------------------------- contract

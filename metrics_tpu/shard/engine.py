@@ -62,7 +62,9 @@ import numpy as np
 from metrics_tpu.ckpt import format as ckpt_format
 from metrics_tpu.engine.runtime import CheckpointConfig, StreamingEngine
 from metrics_tpu.engine.stream import EagerKeyedState, KeyedState
+from metrics_tpu.obs import context as _obs_ctx
 from metrics_tpu.obs import instrument as _obs
+from metrics_tpu.obs.registry import OBS as _OBS
 from metrics_tpu.shard.ring import DEFAULT_VNODES, HashRing
 from metrics_tpu.utils.exceptions import MetricsTPUUserError
 
@@ -314,6 +316,11 @@ class ShardedEngine:
         concurrently, and the per-shard queues/backpressure they land in are
         independent.
         """
+        # mint (or adopt) the trace context HERE so the traced request id is
+        # the one the caller saw at the sharded front door, then activate it
+        # around the delegated submit: the shard's inner engine adopts the
+        # ambient context instead of minting a second, unlinked trace
+        ctx = _obs_ctx.mint_or_current() if _OBS.enabled else None
         stripe = getattr(self._stripe_local, "lock", None)
         if stripe is None:
             stripe = self._stripes[next(self._stripe_counter) % _N_STRIPES]
@@ -323,9 +330,10 @@ class ShardedEngine:
             if index is None:
                 index = self._ring.shard_for(key)
                 self._route_cache[key] = index
-            return self._engines[index].submit(
-                key, *args, deadline=deadline, priority=priority
-            )
+            with _obs_ctx.activate(ctx):
+                return self._engines[index].submit(
+                    key, *args, deadline=deadline, priority=priority
+                )
 
     def flush(self, timeout: Optional[float] = None) -> None:
         """Block until every accepted request on every shard has committed.

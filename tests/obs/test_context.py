@@ -1,0 +1,110 @@
+"""TraceContext: wire format, lineage, thread-local propagation (ISSUE 14)."""
+
+from __future__ import annotations
+
+import threading
+
+from metrics_tpu import obs
+from metrics_tpu.obs.context import (
+    WIRE_SIZE,
+    TraceContext,
+    activate,
+    current,
+    iter_wire_blocks,
+    mint,
+    mint_or_current,
+    trace_attrs,
+)
+
+
+class TestWireFormat:
+    def test_round_trip(self):
+        ctx = TraceContext(0x1234_5678_9ABC_DEF0, 0xFEDC_BA98_7654_3210, True)
+        raw = ctx.to_bytes()
+        assert len(raw) == WIRE_SIZE == 17
+        assert TraceContext.from_bytes(raw) == ctx
+
+    def test_round_trip_unsampled(self):
+        ctx = TraceContext(7, 9, False)
+        assert TraceContext.from_bytes(ctx.to_bytes()) == ctx
+
+    def test_offset_decoding(self):
+        ctx = mint()
+        payload = b"prefix-bytes" + ctx.to_bytes()
+        assert TraceContext.from_bytes(payload, len(b"prefix-bytes")) == ctx
+
+    def test_iter_wire_blocks_decodes_consecutive_trailer(self):
+        a, b, c = mint(), mint(), mint()
+        payload = b"positional" + a.to_bytes() + b.to_bytes() + c.to_bytes()
+        assert list(iter_wire_blocks(payload, len(b"positional"))) == [a, b, c]
+
+    def test_iter_wire_blocks_empty_trailer(self):
+        # an old record (or an obs-off writer): positional decode consumed it all
+        assert list(iter_wire_blocks(b"positional", len(b"positional"))) == []
+
+    def test_iter_wire_blocks_ignores_short_remainder(self):
+        ctx = mint()
+        payload = ctx.to_bytes() + b"\x00" * (WIRE_SIZE - 1)  # torn/garbage tail
+        assert list(iter_wire_blocks(payload, 0)) == [ctx]
+
+
+class TestLineage:
+    def test_child_keeps_trace_id(self):
+        root = mint()
+        kid = root.child()
+        assert kid.trace_id == root.trace_id
+        assert kid.span_id != root.span_id
+        assert kid.sampled == root.sampled
+
+    def test_mint_ids_nonzero_and_distinct(self):
+        seen = {mint().trace_id for _ in range(64)}
+        assert 0 not in seen
+        assert len(seen) == 64
+
+    def test_hex_display(self):
+        ctx = TraceContext(0xAB, 0xCD)
+        assert ctx.trace_hex == f"{0xAB:016x}"
+        assert ctx.span_hex == f"{0xCD:016x}"
+        assert trace_attrs(ctx) == {"trace": ctx.trace_hex, "span": ctx.span_hex}
+        assert trace_attrs(None) == {}
+
+
+class TestAmbientPropagation:
+    def test_current_none_by_default(self):
+        assert current() is None
+
+    def test_activate_installs_and_restores(self):
+        ctx = mint()
+        with activate(ctx):
+            assert current() is ctx
+            inner = mint()
+            with activate(inner):
+                assert current() is inner
+            assert current() is ctx
+        assert current() is None
+
+    def test_activate_none_is_valid_shadow(self):
+        with activate(None):
+            assert current() is None
+
+    def test_thread_isolation(self):
+        ctx = mint()
+        seen = {}
+
+        def probe():
+            seen["other"] = current()
+
+        with activate(ctx):
+            t = threading.Thread(target=probe)
+            t.start()
+            t.join()
+        assert seen["other"] is None
+
+    def test_mint_or_current_gates_on_obs(self):
+        assert mint_or_current() is None  # conftest left obs disabled
+        obs.enable()
+        fresh = mint_or_current()
+        assert fresh is not None
+        ambient = mint()
+        with activate(ambient):
+            assert mint_or_current() is ambient

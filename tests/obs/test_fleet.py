@@ -1,0 +1,233 @@
+"""Fleet telemetry aggregation: lossless snapshots, staleness, retirement,
+and the piggyback channels (repl heartbeats + CoordStore membership) — ISSUE 14.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax.numpy as jnp
+import pytest
+
+from metrics_tpu import obs
+from metrics_tpu.classification import BinaryAccuracy
+from metrics_tpu.cluster import ClusterConfig, ClusterNode, FakeCoordStore, ManualClock
+from metrics_tpu.cluster.store import DirectoryCoordStore, Member
+from metrics_tpu.engine import CheckpointConfig, StreamingEngine
+from metrics_tpu.obs.fleet import (
+    SNAPSHOT_KIND,
+    AGGREGATOR,
+    FleetAggregator,
+    node_snapshot,
+)
+from metrics_tpu.repl import LoopbackLink, ReplConfig
+
+from tests.obs.prom_grammar import parse as parse_prometheus
+
+
+class _FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _seed_series():
+    """Put some real series (with awkward label values) into the registry."""
+    obs.counter("metrics_tpu_retraces_total").inc(
+        3, site="update", signature="f32[8,2],i32[]"  # label value contains , and [
+    )
+    obs.gauge("metrics_tpu_engine_queue_depth").set(5, engine="0")
+    obs.histogram("metrics_tpu_test_fleet_hist", buckets=(0.1, 1.0)).observe(0.5, k="v")
+
+
+class TestNodeSnapshot:
+    def test_snapshot_is_lossless_on_awkward_labels(self):
+        obs.enable()
+        _seed_series()
+        snap = node_snapshot("host-1")
+        assert snap["kind"] == SNAPSHOT_KIND
+        fam = snap["families"]["metrics_tpu_retraces_total"]
+        [(pairs, value)] = fam["samples"]
+        assert dict(pairs)["signature"] == "f32[8,2],i32[]"  # exact, not parsed back
+        assert value == 3
+
+    def test_histogram_sample_shape(self):
+        obs.enable()
+        _seed_series()
+        fam = node_snapshot("h")["families"]["metrics_tpu_test_fleet_hist"]
+        [(pairs, sample)] = fam["samples"]
+        assert sample["edges"] == [0.1, 1.0]
+        assert sample["buckets"] == [0, 1, 0]  # non-cumulative rows + overflow
+        assert sample["count"] == 1
+
+
+class TestAggregator:
+    def test_latest_wins_and_garbage_ignored(self):
+        obs.enable()
+        clock = _FakeClock()
+        agg = FleetAggregator(stale_after_s=10, retire_after_s=60, clock=clock)
+        _seed_series()
+        agg.ingest(node_snapshot("n1"))
+        agg.ingest(node_snapshot("n1"))  # replaces, no duplicate node
+        agg.ingest({"kind": "something-else"})  # shared channel garbage
+        agg.ingest("not even a dict")
+        assert list(agg.nodes()) == ["n1"]
+
+    def test_stale_then_retired(self):
+        obs.enable()
+        clock = _FakeClock()
+        agg = FleetAggregator(stale_after_s=10, retire_after_s=60, clock=clock)
+        _seed_series()
+        agg.ingest(node_snapshot("n1"))
+        agg.ingest(node_snapshot("n2"))
+        clock.t = 5.0
+        agg.ingest(node_snapshot("n2"))  # n2 keeps reporting; n1 goes silent
+        clock.t = 12.0
+        nodes = agg.nodes()
+        assert nodes["n1"]["stale"] is True
+        assert nodes["n2"]["stale"] is False
+        text = agg.render_prometheus()
+        assert 'metrics_tpu_fleet_node_stale{node="n1"} 1' in text
+        assert 'metrics_tpu_fleet_node_stale{node="n2"} 0' in text
+        # silent past retire_after_s: n1's series leave the page entirely
+        # (n2 keeps reporting and stays)
+        clock.t = 65.0
+        agg.ingest(node_snapshot("n2"))
+        clock.t = 70.0
+        text = agg.render_prometheus()
+        assert 'node="n1"' not in text
+        assert agg.retired() == ["n1"]
+        assert "metrics_tpu_fleet_nodes 1" in text
+
+    def test_retire_shorter_than_stale_rejected(self):
+        with pytest.raises(ValueError):
+            FleetAggregator(stale_after_s=10, retire_after_s=5)
+
+    def test_merged_render_is_grammar_valid_with_node_labels(self):
+        obs.enable()
+        _seed_series()
+        agg = FleetAggregator(clock=_FakeClock())
+        agg.ingest(node_snapshot("alpha"))
+        agg.ingest(node_snapshot("beta"))
+        text = agg.render_prometheus()
+        parse_prometheus(text)
+        assert 'metrics_tpu_engine_queue_depth{node="alpha",engine="0"} 5' in text
+        assert 'metrics_tpu_engine_queue_depth{node="beta",engine="0"} 5' in text
+        # histograms re-render cumulatively under the node label
+        assert 'metrics_tpu_test_fleet_hist_bucket{node="alpha",k="v",le="1"} 1' in text
+        assert 'metrics_tpu_test_fleet_hist_count{node="alpha",k="v"} 1' in text
+
+    def test_fleet_node_label_overrides_sample_node_label(self):
+        obs.enable()
+        obs.gauge("metrics_tpu_cluster_role").set(2, node="self-reported")
+        agg = FleetAggregator(clock=_FakeClock())
+        agg.ingest(node_snapshot("authoritative"))
+        text = agg.render_prometheus()
+        assert 'metrics_tpu_cluster_role{node="authoritative"} 2' in text
+        assert "self-reported" not in text
+
+
+class TestMembershipPiggyback:
+    def test_member_fleet_round_trips_through_directory_store(self, tmp_path):
+        obs.enable()
+        _seed_series()
+        store = DirectoryCoordStore(str(tmp_path))
+        store.heartbeat(
+            Member("n1", "follower", "SERVING", True, 0, store.now(),
+                   fleet=node_snapshot("n1"))
+        )
+        store.heartbeat(Member("n2", "follower", "SERVING", True, 0, store.now()))
+        members = store.members()
+        assert members["n1"].fleet["kind"] == SNAPSHOT_KIND
+        assert members["n2"].fleet is None
+        agg = FleetAggregator(clock=_FakeClock())
+        assert agg.ingest_members(members.values()) == 1
+        assert list(agg.nodes()) == ["n1"]
+
+    def test_cluster_node_attaches_fleet_and_leader_ingests(self):
+        obs.enable()
+        _seed_series()
+        clock = ManualClock(0.0)
+        store = FakeCoordStore(clock=clock)
+
+        class _Stub:
+            def __init__(self):
+                self._cluster = None
+                self._repl_follower = False
+                self._applier = None
+                self._repl_cfg = None
+                self._repl_epoch = 0
+
+            def health(self):
+                return {"state": "SERVING"}
+
+        cfg = ClusterConfig(
+            node_id="a", store=store, peers=(), lease_ttl_s=30.0,
+            heartbeat_interval_s=1.0, suspect_after_s=5.0, confirm_after_s=10.0,
+            rng_seed=7,
+        )
+        node = ClusterNode(_Stub(), cfg, start=False)
+        node.tick()  # publishes heartbeat (with fleet), leads, ingests members
+        assert store.members()["a"].fleet["node"] == "a"
+        assert node.role == "leader"
+        clock.advance(2.0)
+        node.tick()
+        assert "a" in AGGREGATOR.nodes()
+
+    def test_heartbeat_carries_no_fleet_when_disabled(self):
+        assert not obs.enabled()
+        clock = ManualClock(0.0)
+        store = FakeCoordStore(clock=clock)
+
+        class _Stub:
+            _cluster = None
+            _repl_follower = False
+            _applier = None
+            _repl_cfg = None
+            _repl_epoch = 0
+
+            def health(self):
+                return {"state": "SERVING"}
+
+        cfg = ClusterConfig(
+            node_id="a", store=store, peers=(), lease_ttl_s=30.0,
+            heartbeat_interval_s=1.0, suspect_after_s=5.0, confirm_after_s=10.0,
+            rng_seed=7,
+        )
+        ClusterNode(_Stub(), cfg, start=False).tick()
+        assert store.members()["a"].fleet is None
+
+
+class TestReplPiggyback:
+    def test_primary_heartbeat_snapshot_reaches_follower_aggregator(self, tmp_path):
+        obs.enable()
+        link = LoopbackLink()
+        primary = StreamingEngine(
+            BinaryAccuracy(), buckets=(8,),
+            checkpoint=CheckpointConfig(
+                directory=str(tmp_path / "p"), interval_s=0.05, durable=False
+            ),
+            replication=ReplConfig(
+                role="primary", transport=link,
+                ship_interval_s=0.01, heartbeat_interval_s=0.02,
+            ),
+        )
+        follower = StreamingEngine(
+            BinaryAccuracy(), buckets=(8,),
+            replication=ReplConfig(role="follower", transport=link, poll_interval_s=0.01),
+        )
+        try:
+            primary.submit("t", jnp.asarray([1, 0]), jnp.asarray([1, 1])).result(timeout=10)
+            primary.flush()
+            deadline = time.monotonic() + 10
+            want = f"primary:{primary.telemetry.engine_id}"
+            while want not in AGGREGATOR.nodes() and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert want in AGGREGATOR.nodes()
+            text = AGGREGATOR.render_prometheus()
+            assert f'node="{want}"' in text
+        finally:
+            primary.close()
+            follower.close()

@@ -1,0 +1,211 @@
+"""Flight recorder: exactly-once bundle dumps per triggering edge (ISSUE 14).
+
+Each trigger is driven through its real plane via the existing fault doubles
+(poison tenants, dispatcher kills, breaker failures, live-set agreement,
+a contested lease CAS) — never by calling ``FLIGHT.dump`` directly — and the
+exactly-once contract is asserted on ``FLIGHT.dump_counts()``: one bundle per
+*edge*, however many times the underlying gauge/state is refreshed.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax.numpy as jnp
+
+from metrics_tpu import obs
+from metrics_tpu.classification import BinaryAccuracy
+from metrics_tpu.cluster import ClusterConfig, ClusterNode, FakeCoordStore, ManualClock
+from metrics_tpu.comm.membership import WorldView
+from metrics_tpu.engine import GuardConfig, StreamingEngine
+from metrics_tpu.guard.faults import kill_dispatcher, poison_args
+from metrics_tpu.obs.flight import BUNDLE_KIND, FLIGHT, load_bundle
+
+
+class _StubEngine:
+    """The engine surface ClusterNode reads (same double as tests/cluster)."""
+
+    def __init__(self):
+        self._cluster = None
+        self._repl_follower = False
+        self._applier = None
+        self._repl_cfg = None
+        self._repl_epoch = 0
+
+    def health(self):
+        return {"state": "SERVING"}
+
+
+class TestGuardTriggers:
+    def test_quarantine_dumps_exactly_once(self):
+        obs.enable()
+        guard = GuardConfig(quarantine_threshold=2)
+        engine = StreamingEngine(BinaryAccuracy(), buckets=(8,), capacity=4, guard=guard)
+        try:
+            p, t = poison_args()
+            for _ in range(2):
+                engine.submit("poison", jnp.asarray(p), jnp.asarray(t)).exception(timeout=10)
+                engine.flush()
+            counts = FLIGHT.dump_counts()
+            assert counts.get("guard_quarantine") == 1
+            # further submits from the quarantined tenant are rejected at
+            # entry: no new quarantine edge, no second bundle
+            bundle = FLIGHT.bundles()[-1]
+            assert bundle["trigger"] == "guard_quarantine"
+            assert any(e["kind"] == "guard_quarantine" for e in bundle["events"])
+        finally:
+            engine.close()
+
+    def test_watchdog_restart_dumps_exactly_once(self):
+        obs.enable()
+        engine = StreamingEngine(
+            BinaryAccuracy(), buckets=(8,), capacity=4, guard=GuardConfig()
+        )
+        try:
+            kill_dispatcher(engine)
+            engine.submit("k", jnp.asarray([1]), jnp.asarray([1])).result(timeout=10)
+            deadline = time.monotonic() + 10
+            while (
+                engine.telemetry_snapshot()["watchdog_restarts"] < 1
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.01)
+            assert FLIGHT.dump_counts().get("watchdog_restart") == 1
+        finally:
+            engine.close()
+
+    def test_breaker_open_edge_not_gauge_refresh(self):
+        obs.enable()
+        engine = StreamingEngine(
+            BinaryAccuracy(), buckets=(8,), capacity=4,
+            guard=GuardConfig(breaker_failure_threshold=2),
+        )
+        try:
+            breaker = engine._guard.comm_breaker
+            breaker.record_failure()
+            breaker.record_failure()  # -> open (state 2): ONE bundle
+            engine.health()  # re-publishes the (unchanged) gauge
+            engine.health()
+            assert FLIGHT.dump_counts().get("breaker_open") == 1
+            # close and re-open: a NEW edge, a second bundle
+            breaker.record_success()
+            breaker.record_failure()
+            breaker.record_failure()
+            assert FLIGHT.dump_counts().get("breaker_open") == 2
+        finally:
+            engine.close()
+
+
+class TestCommTrigger:
+    def test_live_set_shrink_dumps_once_growth_does_not(self):
+        obs.enable()
+        view = WorldView(rank=0, world=4)
+        view.commit((0, 1, 2))  # lost rank 3: shrink edge
+        assert FLIGHT.dump_counts().get("live_set_shrink") == 1
+        view.commit((0, 1, 2, 3))  # rank 3 rejoined: growth, no dump
+        assert FLIGHT.dump_counts().get("live_set_shrink") == 1
+        bundle = FLIGHT.bundles()[-1]
+        assert bundle["trigger_attrs"]["lost"] == [3]
+        # the bundle carries the live-set history the ring retained
+        assert [e["agreed"] for e in bundle["live_set_history"]] == [[0, 1, 2]]
+
+
+class TestClusterTrigger:
+    def test_contested_election_loss_dumps_once(self):
+        obs.enable()
+        clock = ManualClock(0.0)
+        store = FakeCoordStore(clock=clock)
+        cfg = ClusterConfig(
+            node_id="a", store=store, peers=(),
+            lease_ttl_s=3.0, heartbeat_interval_s=1.0,
+            suspect_after_s=2.5, confirm_after_s=6.0, rng_seed=7,
+        )
+        node = ClusterNode(_StubEngine(), cfg, start=False)
+        # fault double: a rival wins the CAS just ahead of us, every time
+        real_acquire = store.acquire_lease
+
+        def contested(node_id, ttl_s, *, epoch_floor=0):
+            real_acquire("rival", ttl_s, epoch_floor=epoch_floor)
+            return real_acquire(node_id, ttl_s, epoch_floor=epoch_floor)
+
+        store.acquire_lease = contested
+        # a writable engine starts as leader: its first tick loses the renewal
+        # CAS and steps down — a deposed lead, NOT a failed election
+        node.tick()
+        assert node.role == "follower"
+        assert FLIGHT.dump_counts().get("election_failed") is None
+        # the rival's lease lapses: a real vacancy, and we lose the CAS again
+        clock.advance(10.0)
+        node.tick()
+        assert FLIGHT.dump_counts().get("election_failed") == 1
+        node.tick()  # rival now holds a live lease: no election attempted
+        assert FLIGHT.dump_counts().get("election_failed") == 1
+
+
+class TestBundleContents:
+    def test_bundle_round_trips_through_disk(self, tmp_path):
+        obs.enable()
+        FLIGHT.configure(directory=str(tmp_path))
+        try:
+            with obs.span("incident.precursor", detail="x"):
+                pass
+            FLIGHT.record("health_transition", engine="9", old="SERVING", new="DEGRADED")
+            bundle = FLIGHT.dump("breaker_open", engine="9", breaker="comm")
+            assert bundle["path"] is not None
+            loaded = load_bundle(bundle["path"])
+            assert loaded["bundle"] == BUNDLE_KIND
+            assert loaded["trigger"] == "breaker_open"
+            assert [e["kind"] for e in loaded["events"]] == ["health_transition"]
+            span_names = [
+                e["name"] for e in loaded["trace"]["traceEvents"] if e.get("ph") == "X"
+            ]
+            assert "incident.precursor" in span_names
+            assert isinstance(loaded["registry"], dict)
+        finally:
+            FLIGHT.configure(directory=None)
+
+    def test_provider_failure_is_evidence_not_error(self):
+        obs.enable()
+
+        def broken():
+            raise RuntimeError("provider died")
+
+        FLIGHT.register_provider("broken", broken)
+        try:
+            bundle = FLIGHT.dump("guard_quarantine", engine="x")
+            assert "provider_error" in bundle["contexts"]["broken"]
+        finally:
+            FLIGHT.unregister_provider("broken")
+
+    def test_engine_registers_lockfree_provider(self):
+        obs.enable()
+        engine = StreamingEngine(BinaryAccuracy(), buckets=(8,), capacity=4)
+        name = f"engine:{engine.telemetry.engine_id}"
+        try:
+            bundle = FLIGHT.dump("guard_quarantine", engine=engine.telemetry.engine_id)
+            ctx = bundle["contexts"][name]
+            assert ctx["health_state"] == "SERVING"
+            assert ctx["quarantined"] is False
+            assert "wal_seq" in ctx and "queue_depth" in ctx
+        finally:
+            engine.close()
+        # close() unregisters: the dead engine stops appearing in new bundles
+        bundle = FLIGHT.dump("guard_quarantine", engine="post-close")
+        assert name not in bundle["contexts"]
+
+    def test_disabled_records_and_dumps_nothing(self):
+        assert not obs.enabled()
+        FLIGHT.record("health_transition", engine="0", old="SERVING", new="DEGRADED")
+        assert FLIGHT.dump("guard_quarantine", engine="0") is None
+        assert FLIGHT.events() == []
+        assert FLIGHT.dump_counts() == {}
+
+    def test_bundle_is_json_serializable(self):
+        obs.enable()
+        FLIGHT.register_provider("odd", lambda: {"obj": object()})
+        try:
+            bundle = FLIGHT.dump("live_set_shrink", site="rank0", lost=[2])
+            json.dumps(bundle)  # reprs everywhere, no TypeError
+        finally:
+            FLIGHT.unregister_provider("odd")

@@ -1,0 +1,105 @@
+"""Smoke tests for the ``tools/obs_dump.py`` post-mortem CLI (ISSUE 14 sat. a).
+
+The bundles it renders come from the REAL flight recorder (dumped through
+``FLIGHT``), so these tests also pin the bundle schema the CLI depends on.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from metrics_tpu import obs
+from metrics_tpu.obs.flight import FLIGHT
+
+import tools.obs_dump as obs_dump
+
+
+@pytest.fixture
+def bundle_path(tmp_path):
+    """A real on-disk bundle with edges, a span, and a live-set agreement."""
+    obs.enable()
+    FLIGHT.configure(directory=str(tmp_path))
+    try:
+        with obs.span("incident.precursor", engine="7"):
+            pass
+        FLIGHT.record("health_transition", engine="7", old="SERVING", new="DEGRADED")
+        FLIGHT.record(
+            "comm_live_set", site="rank0", previous=[0, 1, 2, 3], agreed=[0, 1, 2]
+        )
+        bundle = FLIGHT.dump("live_set_shrink", site="rank0", lost=[3])
+        return bundle["path"]
+    finally:
+        FLIGHT.configure(directory=None)
+
+
+class TestRenderTimeline:
+    def test_timeline_contains_the_story(self, bundle_path):
+        text = obs_dump.render_timeline(obs_dump._load_bundle(bundle_path))
+        assert "trigger=live_set_shrink" in text
+        assert "lost=[3]" in text
+        assert "health_transition" in text
+        assert "causal run-up" in text
+        assert "[0, 1, 2, 3] -> [0, 1, 2]" in text  # live-set history line
+        assert "embedded trace: 1 spans" in text
+
+    def test_empty_ring_renders(self):
+        text = obs_dump.render_timeline({"bundle": obs_dump.BUNDLE_KIND, "trigger": "x"})
+        assert "causal run-up: (empty ring)" in text
+
+    def test_kind_constant_mirrors_library(self):
+        from metrics_tpu.obs.flight import BUNDLE_KIND
+
+        assert obs_dump.BUNDLE_KIND == BUNDLE_KIND
+
+
+class TestMain:
+    def test_renders_bundle_and_writes_perfetto_trace(self, bundle_path, tmp_path, capsys):
+        out = str(tmp_path / "perfetto.json")
+        assert obs_dump.main([bundle_path, "--trace", out]) == 0
+        stdout = capsys.readouterr().out
+        assert "FLIGHT BUNDLE" in stdout
+        assert "trigger=live_set_shrink" in stdout
+        doc = json.load(open(out))
+        names = [e["name"] for e in doc["traceEvents"] if e.get("ph") == "X"]
+        assert "incident.precursor" in names
+
+    def test_live_mode(self, tmp_path, capsys):
+        obs.enable()
+        with obs.span("live.work"):
+            pass
+        out = str(tmp_path / "live.json")
+        assert obs_dump.main(["--live", "--trace", out]) == 0
+        assert "trigger=live" in capsys.readouterr().out
+        assert any(
+            e.get("name") == "live.work" for e in json.load(open(out))["traceEvents"]
+        )
+
+    def test_not_a_bundle_exits_2(self, tmp_path, capsys):
+        bad = tmp_path / "bad.json"
+        bad.write_text('{"bundle": "something-else"}')
+        assert obs_dump.main([str(bad)]) == 2
+        assert "error:" in capsys.readouterr().err
+
+    def test_missing_file_exits_2(self, tmp_path):
+        assert obs_dump.main([str(tmp_path / "nope.json")]) == 2
+
+    def test_cli_subprocess_needs_no_library(self, bundle_path, tmp_path):
+        """Bundle rendering is stdlib-only: run the script with the repo OFF
+        sys.path so any metrics_tpu (or jax) import would blow up."""
+        script = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+            "tools", "obs_dump.py",
+        )
+        proc = subprocess.run(
+            [sys.executable, script, bundle_path],
+            capture_output=True, text=True, timeout=60,
+            cwd=str(tmp_path),  # not the repo root
+            env={"PATH": "/usr/bin:/bin", "PYTHONPATH": ""},
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "FLIGHT BUNDLE" in proc.stdout

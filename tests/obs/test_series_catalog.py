@@ -1,0 +1,101 @@
+"""Series-catalog drift guard (ISSUE 14 sat. c).
+
+Every ``metrics_tpu_*`` series the library can emit must be documented in the
+catalog table in ``docs/source/observability.md``, and every row there must
+correspond to a series that still exists in code. Rename or add a series →
+update the catalog in the same change, or this test names the drift exactly.
+
+Code-side names are collected by scanning the package source for
+
+- quoted series literals (``"metrics_tpu_..."`` — how every registry
+  registration spells its name), and
+- ``# HELP`` / ``# TYPE`` exposition lines (how the fleet renderer spells its
+  synthesized meta-series).
+
+The scan is static so the guard covers planes a unit test doesn't drive
+(kernel roofline captures, tier spills, cluster failovers, ...).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_DOC = os.path.join(_ROOT, "docs", "source", "observability.md")
+
+_QUOTED = re.compile(r'"(metrics_tpu_[a-z0-9_]+)"')
+_EXPOSITION = re.compile(r"# (?:HELP|TYPE) (metrics_tpu_[a-z0-9_]+)")
+# a catalog row: | `metrics_tpu_foo` | kind | labels | what |
+_CATALOG_ROW = re.compile(r"^\| `(metrics_tpu_[a-z0-9_]+)` \|", re.MULTILINE)
+
+
+def _series_in_code():
+    names = set()
+    pkg = os.path.join(_ROOT, "metrics_tpu")
+    for dirpath, _dirnames, filenames in os.walk(pkg):
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            with open(os.path.join(dirpath, fn)) as fh:
+                src = fh.read()
+            names.update(_QUOTED.findall(src))
+            names.update(_EXPOSITION.findall(src))
+    return names
+
+
+def _series_in_catalog():
+    with open(_DOC) as fh:
+        doc = fh.read()
+    assert "## Series catalog" in doc, "catalog section missing from the doc"
+    catalog = doc.split("## Series catalog", 1)[1].split("\n## ", 1)[0]
+    return set(_CATALOG_ROW.findall(catalog)), catalog
+
+
+class TestSeriesCatalog:
+    def test_scan_finds_a_sane_number_of_series(self):
+        # guards the guard: if the regexes rot, this fails loudly rather than
+        # the set comparisons passing vacuously on two empty sets
+        assert len(_series_in_code()) >= 50
+
+    def test_every_code_series_is_documented(self):
+        code = _series_in_code()
+        documented, _ = _series_in_catalog()
+        missing = sorted(code - documented)
+        assert not missing, (
+            f"series exist in code but not in the observability.md catalog: {missing}"
+        )
+
+    def test_every_documented_series_exists_in_code(self):
+        code = _series_in_code()
+        documented, _ = _series_in_catalog()
+        stale = sorted(documented - code)
+        assert not stale, (
+            f"catalog rows name series no longer present in code: {stale}"
+        )
+
+    def test_catalog_rows_are_well_formed(self):
+        _, catalog = _series_in_catalog()
+        for line in catalog.splitlines():
+            if line.startswith("| `metrics_tpu_"):
+                # split on unescaped pipes only (cells use \| for literal bars)
+                cells = [c for c in re.split(r"(?<!\\)\|", line) if c.strip()]
+                assert len(cells) == 4, f"catalog row needs 4 cells: {line!r}"
+
+    def test_registry_registrations_all_resolve(self):
+        """Importing the instrument module registers the eager families; every
+        one of those must be in the static scan (sanity that the scan sees at
+        least what the registry sees at import time)."""
+        from metrics_tpu.obs.registry import REGISTRY
+
+        import metrics_tpu.obs.instrument  # noqa: F401  (side-effect import)
+
+        live = {
+            name
+            for name in REGISTRY.names()
+            # other tests in the session mint throwaway metrics_tpu_test_*
+            # families; only library-owned names are held to the catalog
+            if name.startswith("metrics_tpu_")
+            and not name.startswith("metrics_tpu_test_")
+        }
+        assert live <= _series_in_code()

@@ -2497,6 +2497,9 @@ def main() -> None:
                         help="internal: run the cluster leader child (killed by the parent)")
     parser.add_argument("--tier-child", nargs=2, metavar=("DIR", "SEED"),
                         help="internal: run the tiered-engine child (killed by the parent)")
+    parser.add_argument("--flight-dir", default=None, metavar="DIR",
+                        help="dump a flight-recorder post-mortem bundle here if any "
+                             "surface fails (CI uploads it as an artifact)")
     args = parser.parse_args()
 
     if args.ckpt_child is not None:
@@ -2540,6 +2543,28 @@ def main() -> None:
     print(f"soak complete: {len(seeds)} seeds x {len(names)} surfaces, {len(FAILS)} failures")
     for f in FAILS[:25]:
         print(f)
+    if FAILS and args.flight_dir is not None:
+        # post-mortem evidence for CI: one flight bundle carrying the obs
+        # rings + registry + provider contexts as they stood at soak end.
+        # Obs may have been off for the run — flip it on just long enough to
+        # dump (the rings hold whatever the failing surfaces recorded).
+        from metrics_tpu import obs as _obs_pkg
+
+        was_enabled = _obs_pkg.enabled()
+        _obs_pkg.enable()
+        try:
+            _obs_pkg.FLIGHT.configure(directory=args.flight_dir)
+            bundle = _obs_pkg.FLIGHT.dump(
+                "soak_failure",
+                source="fuzz_soak",
+                failures=len(FAILS),
+                first_failures=[repr(f)[:200] for f in FAILS[:10]],
+            )
+            if bundle is not None and bundle.get("path"):
+                print(f"flight bundle written: {bundle['path']}")
+        finally:
+            if not was_enabled:
+                _obs_pkg.disable()
     sys.exit(1 if FAILS else 0)
 
 

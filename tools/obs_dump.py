@@ -1,0 +1,183 @@
+"""Render flight-recorder bundles (or this process's live obs rings) for humans.
+
+Two outputs from one bundle:
+
+- a **causal timeline** on stdout: the trigger, then the recorded edge ring in
+  sequence order with wall-clock offsets, then each context provider's view —
+  the "what led up to this" read an operator does first;
+- a **Perfetto-loadable trace** (``--trace out.json``): the bundle's embedded
+  Chrome trace-event document extracted verbatim, ready for
+  https://ui.perfetto.dev or ``chrome://tracing``.
+
+Usage::
+
+    python tools/obs_dump.py flight-0001-guard_quarantine.json
+    python tools/obs_dump.py flight-*.json --trace trace.json
+    python tools/obs_dump.py --live --trace live.json   # this process's rings
+
+Bundle rendering is stdlib-only (no metrics_tpu import, no jax): bundles are
+self-describing JSON, so this tool works on a machine that never installed the
+library. ``--live`` imports :mod:`metrics_tpu.obs` lazily to snapshot the
+current process's FLIGHT/TRACER rings — useful under a debugger or in a REPL
+attached to a serving process.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+BUNDLE_KIND = "metrics_tpu-flight"  # mirrors metrics_tpu.obs.flight.BUNDLE_KIND
+
+_SKIP_ATTRS = {"seq", "t_wall", "kind"}
+
+
+def _fmt_wall(t: Optional[float]) -> str:
+    if not isinstance(t, (int, float)):
+        return "?"
+    return time.strftime("%H:%M:%S", time.localtime(t)) + f".{int((t % 1) * 1000):03d}"
+
+
+def _fmt_attrs(event: Dict[str, Any]) -> str:
+    parts = [f"{k}={event[k]!r}" for k in sorted(event) if k not in _SKIP_ATTRS]
+    return " ".join(parts)
+
+
+def render_timeline(bundle: Dict[str, Any]) -> str:
+    """One bundle as a human-readable causal timeline (pure function for tests)."""
+    lines: List[str] = []
+    trigger = bundle.get("trigger", "?")
+    t0 = bundle.get("t_wall")
+    lines.append("=" * 72)
+    lines.append(
+        f"FLIGHT BUNDLE #{bundle.get('serial', '?')}  trigger={trigger}  "
+        f"at {_fmt_wall(t0)}  pid={bundle.get('pid', '?')}"
+    )
+    trig_attrs = bundle.get("trigger_attrs") or {}
+    if trig_attrs:
+        lines.append("  " + " ".join(f"{k}={v!r}" for k, v in sorted(trig_attrs.items())))
+    if bundle.get("write_error"):
+        lines.append(f"  (write_error: {bundle['write_error']})")
+    lines.append("-" * 72)
+
+    events = bundle.get("events") or []
+    if events:
+        lines.append(f"causal run-up ({len(events)} edges, oldest first):")
+        for ev in events:
+            dt = ""
+            if isinstance(t0, (int, float)) and isinstance(ev.get("t_wall"), (int, float)):
+                dt = f"  T{ev['t_wall'] - t0:+8.3f}s"
+            lines.append(
+                f"  [{ev.get('seq', '?'):>5}]{dt}  {ev.get('kind', '?'):<22} "
+                f"{_fmt_attrs(ev)}"
+            )
+    else:
+        lines.append("causal run-up: (empty ring)")
+
+    history = bundle.get("live_set_history") or []
+    if history:
+        lines.append(f"live-set history ({len(history)} agreements):")
+        for ev in history:
+            lines.append(
+                f"  [{ev.get('seq', '?'):>5}]  {ev.get('site', '?')}: "
+                f"{ev.get('previous')} -> {ev.get('agreed')}"
+            )
+
+    contexts = bundle.get("contexts") or {}
+    if contexts:
+        lines.append("context providers:")
+        for name in sorted(contexts):
+            lines.append(f"  {name}:")
+            body = json.dumps(contexts[name], indent=2, sort_keys=True, default=repr)
+            lines.extend("    " + ln for ln in body.splitlines())
+
+    trace = bundle.get("trace") or {}
+    n_spans = sum(1 for e in trace.get("traceEvents", []) if e.get("ph") == "X")
+    registry = bundle.get("registry") or {}
+    lines.append(
+        f"embedded trace: {n_spans} spans; registry snapshot: "
+        f"{len(registry)} series families"
+    )
+    lines.append("=" * 72)
+    return "\n".join(lines)
+
+
+def _load_bundle(path: str) -> Dict[str, Any]:
+    with open(path) as fh:
+        bundle = json.load(fh)
+    if bundle.get("bundle") != BUNDLE_KIND:
+        raise ValueError(f"{path!r} is not a {BUNDLE_KIND} bundle")
+    return bundle
+
+
+def _live_bundle() -> Dict[str, Any]:
+    """This process's obs rings packaged as one synthetic bundle (lazy import:
+    --live is the only path that needs the library at all)."""
+    from metrics_tpu.obs.flight import FLIGHT
+    from metrics_tpu.obs.registry import REGISTRY
+    from metrics_tpu.obs.trace import TRACER
+
+    events = FLIGHT.events()
+    return {
+        "bundle": BUNDLE_KIND,
+        "version": 1,
+        "serial": 0,
+        "trigger": "live",
+        "trigger_attrs": {},
+        "t_wall": time.time(),
+        "pid": __import__("os").getpid(),
+        "events": events,
+        "live_set_history": [e for e in events if e.get("kind") == "comm_live_set"],
+        "trace": TRACER.export_chrome_trace(),
+        "registry": REGISTRY.snapshot(),
+        "contexts": {},
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Render metrics_tpu flight bundles into a causal timeline "
+        "and a Perfetto-loadable trace."
+    )
+    parser.add_argument("bundles", nargs="*", help="flight-*.json bundle files")
+    parser.add_argument(
+        "--live", action="store_true",
+        help="render this process's live FLIGHT/TRACER rings instead of files",
+    )
+    parser.add_argument(
+        "--trace", metavar="OUT",
+        help="write the (last) bundle's Chrome trace document here "
+        "(load in https://ui.perfetto.dev)",
+    )
+    args = parser.parse_args(argv)
+
+    if not args.bundles and not args.live:
+        parser.error("give bundle files or --live")
+
+    bundles: List[Dict[str, Any]] = []
+    for path in args.bundles:
+        try:
+            bundles.append(_load_bundle(path))
+        except (OSError, ValueError, json.JSONDecodeError) as exc:
+            print(f"error: {exc}", file=sys.stderr)
+            return 2
+    if args.live:
+        bundles.append(_live_bundle())
+
+    for bundle in bundles:
+        print(render_timeline(bundle))
+
+    if args.trace:
+        doc = bundles[-1].get("trace") or {"traceEvents": []}
+        with open(args.trace, "w") as fh:
+            json.dump(doc, fh)
+        n = len(doc.get("traceEvents", []))
+        print(f"wrote {n} trace events to {args.trace}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
