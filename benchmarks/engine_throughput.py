@@ -103,6 +103,71 @@ def _replica_reader_child(spool: str, seconds: float) -> None:
         follower.close()
 
 
+def _part_host_child(seed: int, npart: int, requests: int) -> None:
+    """Child half of the --part scaling gate: ONE loopback 'host' — a real
+    PartitionedNode holding ``npart`` named leases on its coordination store,
+    supervisor ticking live at the aggressive bench cadence — that pumps its
+    share of the write load when the parent says GO. Prints READY once every
+    lease is held, then a JSON line with its sustained rate."""
+    from metrics_tpu.cluster import FakeCoordStore
+    from metrics_tpu.part import PartConfig, PartitionedNode
+
+    rng_child = np.random.default_rng(seed)
+    engines = {
+        pid: StreamingEngine(BinaryAccuracy(), buckets=(8,), max_queue=2048, capacity=8)
+        for pid in range(npart)
+    }
+    node = PartitionedNode(engines, PartConfig(
+        node_id="host", peers=(), store=FakeCoordStore(), partitions=npart,
+        lease_ttl_s=1.0, heartbeat_interval_s=0.2, suspect_after_s=0.8,
+        confirm_after_s=2.5, tick_interval_s=0.05, rng_seed=seed))
+    try:
+        deadline = time.perf_counter() + 30.0
+        while len(node.owned()) < npart and time.perf_counter() < deadline:
+            time.sleep(0.01)
+        per = requests // npart
+        # per-partition batch-1 streams, interleaved so every client thread
+        # touches every partition — the multi-tenant ingress shape
+        streams = {
+            pid: [(f"t{pid}-{rng_child.integers(0, 8)}",
+                   jnp.asarray(rng_child.integers(0, 2, 1)),
+                   jnp.asarray(rng_child.integers(0, 2, 1)))
+                  for _ in range(per)]
+            for pid in range(npart)
+        }
+        flat = [(pid, *streams[pid][i]) for i in range(per) for pid in range(npart)]
+        for pid in range(npart):  # warm: slots allocated, bucket compiled
+            for k in range(8):
+                engines[pid].submit(f"t{pid}-{k}", jnp.asarray([1]), jnp.asarray([1]))
+            engines[pid].flush()
+            engines[pid].reset()
+        print("READY" if len(node.owned()) == npart else "NOLEASE", flush=True)
+        sys.stdin.readline()  # GO
+        gc.collect()
+        gc.disable()
+        t0 = time.perf_counter()
+
+        def client(tid: int) -> None:
+            for i in range(tid, len(flat), 4):
+                pid, key, p, t = flat[i]
+                engines[pid].submit(key, p, t)
+
+        threads = [threading.Thread(target=client, args=(tid,)) for tid in range(4)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        for pid in range(npart):
+            engines[pid].flush()
+        wall = time.perf_counter() - t0
+        print(json.dumps({"rps": len(flat) / wall, "wall": wall}), flush=True)
+    finally:
+        gc.enable()
+        node.close(release=False)
+        for e in engines.values():
+            e.close()
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--requests", type=int, default=8000, help="engine-side request count")
@@ -171,6 +236,24 @@ def main() -> None:
                     "10k-tenant footprint — a 12k-distinct-tenant sweep over the hot "
                     "cap must not grow the slab past it; (c) warm readmission p99 is "
                     "under one dispatch interval (the dispatcher's 0.1s idle tick)")
+    ap.add_argument("--part", action="store_true",
+                    help="partition-plane gates (ISSUE 15): (a) multi-leader WRITE "
+                    "scaling — 4 loopback hosts (separate processes, as separate hosts "
+                    "are) each leading 2 of 8 partitions sustain >= --part-scale-floor x "
+                    "the aggregate throughput of ONE host leading all 8 on the same "
+                    "total load (paired alternating runs, median pair ratio); (b) the "
+                    "partition layer is free where it can't help: a partitions=1 "
+                    "PartitionedNode supervising the shipping primary loses <5%% vs "
+                    "the plain ClusterNode it generalizes")
+    ap.add_argument("--part-scale-floor", type=float, default=3.2,
+                    help="floor for the 4-host-vs-1 median pair ratio. The default (3.2 "
+                    "= 0.8 x 4 hosts) is the ISSUE-15 acceptance bar and assumes >=4 "
+                    "usable cores; the ratio measures real host-level parallelism, so a "
+                    "constrained runner must lower it explicitly rather than the gate "
+                    "silently passing")
+    ap.add_argument("--part-host", nargs=3, metavar=("SEED", "NPART", "REQUESTS"),
+                    help="internal: run one loopback host for --part (leads NPART "
+                    "partitions, pumps REQUESTS writes on GO, prints its rate)")
     ap.add_argument("--guard", action="store_true",
                     help="guard-plane gates (ISSUE 5): (a) well-behaved traffic with the "
                     "guard enabled loses <5%% throughput vs the plain pass; (b) under a "
@@ -181,6 +264,9 @@ def main() -> None:
 
     if args.replica_reader is not None:
         _replica_reader_child(args.replica_reader[0], float(args.replica_reader[1]))
+        return
+    if args.part_host is not None:
+        _part_host_child(*(int(x) for x in args.part_host))
         return
 
     if args.obs:
@@ -1268,6 +1354,133 @@ def main() -> None:
              checks={"guarded_le_2x_solo": ok_guarded,
                      "unguarded_gt_10x_solo": ok_unguarded})
         if not (ok_overhead and ok_guarded and ok_unguarded):
+            sys.exit(1)
+
+    # ---------------- partition plane gates (ISSUE 15): (a) multi-leader WRITE
+    # scaling — N=4 loopback hosts (separate processes, because separate hosts
+    # are) each leading P/N=2 partitions sustain >= --part-scale-floor x ONE
+    # host leading all P=8 partitions on the same total load (paired
+    # alternating runs, median pair ratio — PR 5 methodology; aggregate =
+    # total requests over the slowest host's wall, so non-overlap is charged,
+    # never credited); (b) the partition layer is free where it can't help: a
+    # partitions=1 PartitionedNode supervising the shipping primary loses <5%
+    # vs the plain ClusterNode it generalizes, same drained-loopback harness.
+    if args.part:
+        import subprocess
+        import tempfile
+
+        from metrics_tpu.cluster import ClusterConfig, ClusterNode, FakeCoordStore
+        from metrics_tpu.engine import CheckpointConfig, ReplConfig
+        from metrics_tpu.part import PartConfig, PartitionedNode
+        from metrics_tpu.repl import LoopbackLink
+
+        P_TOTAL, N_HOSTS = 8, 4
+
+        def part_scale_pass(n_hosts):
+            per_host = args.requests // n_hosts
+            npart = P_TOTAL // n_hosts
+            children = [
+                subprocess.Popen(
+                    [sys.executable, os.path.abspath(__file__), "--part-host",
+                     str(11 + i), str(npart), str(per_host)],
+                    stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True)
+                for i in range(n_hosts)
+            ]
+            try:
+                for ch in children:
+                    line = ch.stdout.readline()
+                    if "READY" not in line:
+                        raise RuntimeError(f"part host failed to lead: {line!r}")
+                for ch in children:  # all hosts start together
+                    ch.stdin.write("GO\n")
+                    ch.stdin.flush()
+                done = [json.loads(ch.stdout.readline()) for ch in children]
+                total = n_hosts * (per_host // npart) * npart
+                return total / max(d["wall"] for d in done)
+            finally:
+                for ch in children:
+                    if ch.poll() is None:
+                        ch.kill()
+                    ch.wait()
+
+        pair_ratios = []
+        one_best = four_best = 0.0
+        # 4 pairs, not 6: each pass spawns whole interpreters, and spawn cost
+        # dwarfs run-to-run jitter here
+        for i in range(4):
+            if i % 2 == 0:
+                one = part_scale_pass(1)
+                four = part_scale_pass(N_HOSTS)
+            else:
+                four = part_scale_pass(N_HOSTS)
+                one = part_scale_pass(1)
+            pair_ratios.append(four / one)
+            one_best, four_best = max(one_best, one), max(four_best, four)
+        scale = float(np.median(pair_ratios))
+        ok_scale = scale >= args.part_scale_floor
+        emit("part 4-host aggregate write scaling", scale, "x",
+             one_host_rps=round(one_best, 1), four_host_rps=round(four_best, 1),
+             pair_ratios=[round(r, 4) for r in pair_ratios],
+             floor=args.part_scale_floor,
+             config={"partitions": P_TOTAL, "hosts": N_HOSTS,
+                     "requests": args.requests},
+             checks={"four_hosts_ge_floor_x_one": ok_scale})
+
+        def part_supervised_pass(partitioned):
+            with tempfile.TemporaryDirectory() as d:
+                link = LoopbackLink()
+                stop_drain = threading.Event()
+
+                def drain():
+                    while not stop_drain.is_set():
+                        link.recv(timeout_s=0.05)
+
+                def supervise(engine):
+                    # identical cadence to the --cluster gate: the only delta
+                    # between the two passes is WHICH supervisor ticks
+                    if partitioned:
+                        return PartitionedNode({0: engine}, PartConfig(
+                            node_id="bench-a", peers=("bench-b",),
+                            store=FakeCoordStore(), partitions=1,
+                            lease_ttl_s=1.0, heartbeat_interval_s=0.2,
+                            suspect_after_s=0.8, confirm_after_s=2.5,
+                            tick_interval_s=0.05, rng_seed=0))
+                    return ClusterNode(engine, ClusterConfig(
+                        node_id="bench-a", peers=("bench-b",),
+                        store=FakeCoordStore(), lease_ttl_s=1.0,
+                        heartbeat_interval_s=0.2, suspect_after_s=0.8,
+                        confirm_after_s=2.5, tick_interval_s=0.05, rng_seed=0))
+
+                drainer = threading.Thread(target=drain)
+                drainer.start()
+                try:
+                    return run_engine_pass(
+                        checkpoint=CheckpointConfig(directory=d, interval_s=0.25),
+                        replication=ReplConfig(role="primary", transport=link,
+                                               ship_interval_s=0.02),
+                        supervise=supervise)
+                finally:
+                    stop_drain.set()
+                    drainer.join()
+
+        over_ratios = []
+        cl_best = pt_best = 0.0
+        for i in range(6):
+            if i % 2 == 0:
+                c = part_supervised_pass(False)
+                p1 = part_supervised_pass(True)
+            else:
+                p1 = part_supervised_pass(True)
+                c = part_supervised_pass(False)
+            over_ratios.append(c / p1)
+            cl_best, pt_best = max(cl_best, c), max(pt_best, p1)
+        part_overhead = float(np.median(over_ratios)) - 1.0
+        ok_part_overhead = part_overhead < 0.05
+        emit("part layer overhead at partitions=1", part_overhead * 100.0, "%",
+             cluster_rps=round(cl_best, 1), part1_rps=round(pt_best, 1),
+             pair_ratios=[round(r, 4) for r in over_ratios],
+             checks={"part1_overhead_lt_5pct": ok_part_overhead})
+        if not (ok_scale and ok_part_overhead):
             sys.exit(1)
 
 

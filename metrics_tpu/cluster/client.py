@@ -20,7 +20,7 @@ import time
 from typing import Any, Callable, Dict, Mapping, Optional
 
 from metrics_tpu.cluster.errors import CoordStoreError, NoLeaderError
-from metrics_tpu.cluster.store import CoordStore
+from metrics_tpu.cluster.store import CoordStore, Lease
 from metrics_tpu.engine.runtime import EngineClosed
 from metrics_tpu.repl.errors import NotPrimaryError, StalenessExceeded
 
@@ -48,6 +48,11 @@ class ClusterClient:
         retries: redirect/backoff attempts before :class:`NoLeaderError`.
         backoff_s / backoff_cap_s: capped exponential backoff (jittered ±50%).
         sleep: injectable for tests (defaults to ``time.sleep``).
+        lease_reread_s: once a refresh read confirms the lease record is
+            *unchanged* (same epoch), further refreshes within this window
+            return the memo without touching the store — a flapping leader
+            (refusing writes while still renewing its lease) would otherwise
+            turn every redirect into a ``read_lease`` call.
     """
 
     def __init__(
@@ -60,6 +65,7 @@ class ClusterClient:
         backoff_cap_s: float = 0.5,
         sleep: Callable[[float], None] = time.sleep,
         rng_seed: Optional[int] = None,
+        lease_reread_s: float = 0.25,
     ) -> None:
         self._store = store
         self._engines = dict(engines)
@@ -69,6 +75,12 @@ class ClusterClient:
         self._sleep = sleep
         self._rng = random.Random(rng_seed)
         self._cached_leader: Optional[str] = None
+        self._lease_reread_s = float(lease_reread_s)
+        # lease-epoch memo: the last lease we read, whether a refresh has
+        # already confirmed its epoch unchanged, and when the skip window ends
+        self._memo_lease: Optional[Lease] = None
+        self._memo_validated = False
+        self._memo_next_read_at = 0.0
         self.redirects = 0  # NotPrimary/Staleness bounces absorbed by routing
 
     # ------------------------------------------------------------------ resolve
@@ -77,18 +89,42 @@ class ClusterClient:
         """The current lease holder's node id (None while headless)."""
         if self._cached_leader is not None and not refresh:
             return self._cached_leader
+        if self._memo_lease is not None and self._memo_validated:
+            # the record was already re-read once for this epoch and had not
+            # moved; while it is unexpired there is nothing new to learn from
+            # the store — retry the memoized holder (redirect storms under a
+            # flapping-but-lease-holding leader must not hammer read_lease)
+            try:
+                now = self._store.now()
+            except CoordStoreError:
+                return None
+            if not self._memo_lease.expired(now) and now < self._memo_next_read_at:
+                self._cached_leader = self._memo_lease.holder
+                return self._memo_lease.holder
         try:
             lease = self._store.read_lease()
         except CoordStoreError:
             return None
-        if lease is None or lease.expired(self._store.now()):
+        if (
+            lease is None
+            or lease.expired(self._store.now())
+            or lease.holder not in self._engines
+        ):
+            self._memo_lease = None
+            self._memo_validated = False
             return None
-        if lease.holder not in self._engines:
-            return None
+        if self._memo_lease is not None and lease.epoch == self._memo_lease.epoch:
+            self._memo_validated = True
+            self._memo_next_read_at = self._store.now() + self._lease_reread_s
+        else:
+            self._memo_validated = False
+        self._memo_lease = lease
         self._cached_leader = lease.holder
         return lease.holder
 
     def _invalidate(self) -> None:
+        # drops the fast-path cache but keeps the epoch memo: the next
+        # leader_id(refresh=True) decides whether the store needs a re-read
         self._cached_leader = None
 
     def _backoff(self, attempt: int) -> None:

@@ -29,6 +29,13 @@ Two backends, one contract:
 Epoch monotonicity: a fresh grant's epoch is ``max(current + 1, epoch_floor)``
 — the floor lets the first leader align the lease epoch with its existing
 repl lineage epoch, after which grants advance strictly by CAS.
+
+Named leases: every lease call takes ``name=""`` (the cluster-wide default
+lease, bit-for-bit the pre-partition behaviour and file layout). A non-empty
+name scopes an *independent* lease — its own holder, epoch chain, and CAS —
+which is how the partition plane (``metrics_tpu.part``) runs P concurrent
+leaderships over ONE membership record set: lease ``p0003`` moving never
+touches lease ``p0005``.
 """
 
 from __future__ import annotations
@@ -88,6 +95,11 @@ class Member:
     # None unless obs is enabled on the publishing node — the leader merges
     # these into the fleet-wide Prometheus view; never used for ranking
     fleet: Optional[Dict[str, Any]] = None
+    # per-partition election inputs (metrics_tpu.part): partition name →
+    # {"bootstrapped": bool, "lag": int, "role": str}. None outside the
+    # partition plane; ``lag_seqs``/``bootstrapped`` above stay the
+    # whole-node view the single-lease election ranks on
+    parts: Optional[Dict[str, Any]] = None
 
 
 class CoordStore:
@@ -102,22 +114,27 @@ class CoordStore:
         """The store's clock: the ONE clock all lease math uses."""
         raise NotImplementedError
 
-    def read_lease(self) -> Optional[Lease]:
+    def read_lease(self, name: str = "") -> Optional[Lease]:
         """The current (possibly already expired) lease, or None before the
-        first grant. Expired leases stay visible: candidates need the epoch."""
+        first grant. Expired leases stay visible: candidates need the epoch.
+        ``name`` selects an independent named lease ("" = cluster-wide)."""
         raise NotImplementedError
 
-    def acquire_lease(self, node_id: str, ttl_s: float, *, epoch_floor: int = 0) -> Optional[Lease]:
+    def acquire_lease(
+        self, node_id: str, ttl_s: float, *, epoch_floor: int = 0, name: str = ""
+    ) -> Optional[Lease]:
         """CAS grant/renewal; returns the held lease, or None if lost.
 
         - current holder, unexpired: renewal — same epoch, deadline extended;
         - no lease / expired lease: fresh grant at
           ``max(current epoch + 1, epoch_floor)`` — at most one caller wins;
         - someone else's unexpired lease: None.
+
+        Each ``name`` is its own independent grant/epoch chain.
         """
         raise NotImplementedError
 
-    def release_lease(self, node_id: str) -> None:
+    def release_lease(self, node_id: str, name: str = "") -> None:
         """Voluntary step-down: expire the lease NOW iff ``node_id`` holds it
         (best effort — absorbing store failures is the caller's contract)."""
         raise NotImplementedError
@@ -150,7 +167,7 @@ class FakeCoordStore(CoordStore):
     def __init__(self, clock: Optional[Callable[[], float]] = None) -> None:
         self._clock = clock if clock is not None else time.monotonic
         self._lock = threading.Lock()
-        self._lease: Optional[Lease] = None
+        self._leases: Dict[str, Lease] = {}  # lease name ("" = cluster-wide) → grant
         self._members: Dict[str, Member] = {}
         self._partitioned: Set[str] = set()
 
@@ -169,17 +186,19 @@ class FakeCoordStore(CoordStore):
         if node_id in self._partitioned:
             raise CoordStoreError(f"node {node_id!r} is partitioned from the coordination store")
 
-    def read_lease(self) -> Optional[Lease]:
+    def read_lease(self, name: str = "") -> Optional[Lease]:
         with self._lock:
-            return self._lease
+            return self._leases.get(name)
 
-    def acquire_lease(self, node_id: str, ttl_s: float, *, epoch_floor: int = 0) -> Optional[Lease]:
+    def acquire_lease(
+        self, node_id: str, ttl_s: float, *, epoch_floor: int = 0, name: str = ""
+    ) -> Optional[Lease]:
         if ttl_s <= 0:
             raise ClusterConfigError(f"lease ttl must be > 0, got {ttl_s}")
         now = self.now()
         with self._lock:
             self._check_reachable(node_id)
-            cur = self._lease
+            cur = self._leases.get(name)
             if cur is not None and cur.holder == node_id and not cur.expired(now):
                 granted = Lease(node_id, cur.epoch, now + ttl_s)  # renewal: epoch pinned
             elif cur is None or cur.expired(now):
@@ -187,16 +206,16 @@ class FakeCoordStore(CoordStore):
                 granted = Lease(node_id, epoch, now + ttl_s)
             else:
                 return None
-            self._lease = granted
+            self._leases[name] = granted
             return granted
 
-    def release_lease(self, node_id: str) -> None:
+    def release_lease(self, node_id: str, name: str = "") -> None:
         now = self.now()
         with self._lock:
             self._check_reachable(node_id)
-            cur = self._lease
+            cur = self._leases.get(name)
             if cur is not None and cur.holder == node_id and not cur.expired(now):
-                self._lease = Lease(cur.holder, cur.epoch, now)
+                self._leases[name] = Lease(cur.holder, cur.epoch, now)
 
     def heartbeat(self, member: Member) -> None:
         with self._lock:
@@ -273,53 +292,77 @@ class DirectoryCoordStore(CoordStore):
 
     # ------------------------------------------------------------ lease files
 
-    def _lease_path(self, epoch: int) -> str:
-        return os.path.join(self.root, f"{_LEASE_PREFIX}{epoch:012d}{_REC_SUFFIX}")
+    @staticmethod
+    def _check_name(name: str) -> str:
+        # "" is the cluster-wide lease (legacy filenames, no scope segment).
+        # Non-empty names become a filename segment between the prefix and the
+        # 12-digit epoch, so they must not contain "-" (the epoch separator)
+        # or anything a filesystem dislikes
+        if name and not all(c.isalnum() or c == "_" for c in name):
+            raise ClusterConfigError(
+                f"lease name must be alphanumeric/underscore, got {name!r}"
+            )
+        return name
 
-    def _renew_path(self, epoch: int) -> str:
-        return os.path.join(self.root, f"{_RENEW_PREFIX}{epoch:012d}{_REC_SUFFIX}")
+    def _scope(self, name: str) -> str:
+        return f"{self._check_name(name)}-" if name else ""
 
-    def _lease_epochs(self) -> List[int]:
+    def _lease_path(self, epoch: int, name: str = "") -> str:
+        return os.path.join(
+            self.root, f"{_LEASE_PREFIX}{self._scope(name)}{epoch:012d}{_REC_SUFFIX}"
+        )
+
+    def _renew_path(self, epoch: int, name: str = "") -> str:
+        return os.path.join(
+            self.root, f"{_RENEW_PREFIX}{self._scope(name)}{epoch:012d}{_REC_SUFFIX}"
+        )
+
+    def _lease_epochs(self, name: str = "") -> List[int]:
         try:
             names = os.listdir(self.root)
         except OSError as exc:
             raise CoordStoreError(f"coordination directory unreadable: {exc}") from exc
+        prefix = _LEASE_PREFIX + self._scope(name)
         out = []
-        for name in names:
-            if name.startswith(_LEASE_PREFIX) and name.endswith(_REC_SUFFIX):
+        for fn in names:
+            if fn.startswith(prefix) and fn.endswith(_REC_SUFFIX):
                 try:
-                    out.append(int(name[len(_LEASE_PREFIX) : -len(_REC_SUFFIX)]))
+                    # for name="" a named grant ("p3-000000000001") fails the
+                    # int() parse and is skipped — scopes never bleed together
+                    out.append(int(fn[len(prefix) : -len(_REC_SUFFIX)]))
                 except ValueError:
                     continue
         return sorted(out)
 
-    def _load_lease(self, epoch: int) -> Optional[Lease]:
-        doc = _read_record(self._lease_path(epoch))
+    def _load_lease(self, epoch: int, name: str = "") -> Optional[Lease]:
+        doc = _read_record(self._lease_path(epoch, name))
         if doc is None:
             return None
         deadline = float(doc["granted_at"]) + float(doc["ttl_s"])
-        renew = _read_record(self._renew_path(epoch))
+        renew = _read_record(self._renew_path(epoch, name))
         if renew is not None and int(renew.get("epoch", -1)) == epoch:
             deadline = max(deadline, float(renew["deadline"])) if renew.get("extend", True) \
                 else float(renew["deadline"])
         return Lease(str(doc["holder"]), epoch, deadline)
 
-    def read_lease(self) -> Optional[Lease]:
+    def read_lease(self, name: str = "") -> Optional[Lease]:
         # newest-first scan, skipping torn grants — same shape as the snapshot
         # store's latest_valid(): a candidate that crashed mid-commit must not
         # wedge the cluster (its linked file is complete by construction, but a
         # half-written legacy/foreign file must not either)
-        for epoch in reversed(self._lease_epochs()):
-            lease = self._load_lease(epoch)
+        for epoch in reversed(self._lease_epochs(name)):
+            lease = self._load_lease(epoch, name)
             if lease is not None:
                 return lease
         return None
 
-    def acquire_lease(self, node_id: str, ttl_s: float, *, epoch_floor: int = 0) -> Optional[Lease]:
+    def acquire_lease(
+        self, node_id: str, ttl_s: float, *, epoch_floor: int = 0, name: str = ""
+    ) -> Optional[Lease]:
         if ttl_s <= 0:
             raise ClusterConfigError(f"lease ttl must be > 0, got {ttl_s}")
         now = self.now()
-        cur = self.read_lease()
+        cur = self.read_lease(name)
         if cur is not None and cur.holder == node_id and not cur.expired(now):
             # renewal: only the holder writes renew-<epoch>, atomic rename —
             # and a renewal never resurrects an EXPIRED lease (that path falls
@@ -327,7 +370,7 @@ class DirectoryCoordStore(CoordStore):
             granted = Lease(node_id, cur.epoch, now + ttl_s)
             try:
                 atomic_write(
-                    self._renew_path(cur.epoch),
+                    self._renew_path(cur.epoch, name),
                     _frame_record({"epoch": cur.epoch, "deadline": granted.deadline}),
                     durable=self.durable,
                 )
@@ -337,8 +380,10 @@ class DirectoryCoordStore(CoordStore):
         if cur is not None and not cur.expired(now):
             return None
         target = max((cur.epoch if cur is not None else 0) + 1, int(epoch_floor))
-        path = self._lease_path(target)
-        tmp = os.path.join(self.root, f"{_TMP_PREFIX}{node_id}-{target}-{os.getpid()}")
+        path = self._lease_path(target, name)
+        tmp = os.path.join(
+            self.root, f"{_TMP_PREFIX}{node_id}-{self._scope(name)}{target}-{os.getpid()}"
+        )
         try:
             with open(tmp, "wb") as f:
                 f.write(_frame_record({"holder": node_id, "granted_at": now, "ttl_s": float(ttl_s)}))
@@ -359,21 +404,21 @@ class DirectoryCoordStore(CoordStore):
         # floors can make targets non-adjacent: if a concurrent candidate
         # committed a HIGHER epoch between our scan and our link, the higher
         # grant wins (read_lease returns it) — concede rather than split-brain
-        for epoch in reversed(self._lease_epochs()):
+        for epoch in reversed(self._lease_epochs(name)):
             if epoch <= target:
                 break
-            higher = self._load_lease(epoch)
+            higher = self._load_lease(epoch, name)
             if higher is not None and not higher.expired(now):
                 return None
         return Lease(node_id, target, now + ttl_s)
 
-    def release_lease(self, node_id: str) -> None:
+    def release_lease(self, node_id: str, name: str = "") -> None:
         now = self.now()
-        cur = self.read_lease()
+        cur = self.read_lease(name)
         if cur is not None and cur.holder == node_id and not cur.expired(now):
             try:
                 atomic_write(
-                    self._renew_path(cur.epoch),
+                    self._renew_path(cur.epoch, name),
                     _frame_record({"epoch": cur.epoch, "deadline": now, "extend": False}),
                     durable=self.durable,
                 )
@@ -396,6 +441,8 @@ class DirectoryCoordStore(CoordStore):
         }
         if member.fleet is not None:
             doc["fleet"] = member.fleet
+        if member.parts is not None:
+            doc["parts"] = member.parts
         try:
             atomic_write(self._member_path(member.node_id), _frame_record(doc), durable=False)
         except OSError as exc:
@@ -421,5 +468,6 @@ class DirectoryCoordStore(CoordStore):
                 lag_seqs=int(doc["lag_seqs"]),
                 heartbeat=float(doc["heartbeat"]),
                 fleet=doc.get("fleet"),
+                parts=doc.get("parts"),
             )
         return out

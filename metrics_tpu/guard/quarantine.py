@@ -28,13 +28,14 @@ ALLOW, PROBE, DENY = "allow", "probe", "deny"
 
 
 class _Entry:
-    __slots__ = ("consecutive", "offenses", "quarantined_until", "probing")
+    __slots__ = ("consecutive", "offenses", "quarantined_until", "probing", "held")
 
     def __init__(self) -> None:
         self.consecutive = 0
         self.offenses = 0  # quarantines served without an intervening success
         self.quarantined_until: Optional[float] = None
         self.probing = False
+        self.held = False  # administrative hold (migration guard): only release() clears
 
 
 class TenantQuarantine:
@@ -86,9 +87,15 @@ class TenantQuarantine:
             return False  # hot path: nothing to forgive, no lock
         with self._lock:
             if ok:
+                held = self._entries.get(key)
+                if held is not None and held.held:
+                    held.consecutive = 0  # a straggler's success never lifts a hold
+                    return False
                 self._entries.pop(key, None)  # forgiveness resets the ladder
                 return False
             entry = self._entries.setdefault(key, _Entry())
+            if entry.held:
+                return False  # the hold already denies harder than any breaker would
             entry.consecutive += 1
             failed_probe = entry.probing
             entry.probing = False
@@ -98,6 +105,24 @@ class TenantQuarantine:
                 entry.consecutive = 0
                 return True
             return False
+
+    def hold(self, key: Hashable) -> None:
+        """Administratively quarantine ``key`` until :meth:`release` — no
+        probation expiry, no probe. The partition plane holds a tenant on its
+        migration *source* so stale-routed writes refuse loudly instead of
+        silently re-creating evicted state at init."""
+        with self._lock:
+            entry = self._entries.setdefault(key, _Entry())
+            entry.held = True
+            entry.quarantined_until = float("inf")
+            entry.probing = False
+
+    def release(self, key: Hashable) -> None:
+        """Lift an administrative hold (no-op for breaker-owned entries)."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None and entry.held:
+                del self._entries[key]
 
     def abandon(self, key: Hashable) -> None:
         """The admitted probe never ran (e.g. the submit was rejected further

@@ -707,6 +707,54 @@ def record_cluster_election_failed(node: str) -> None:
     FLIGHT.dump("election_failed", node=node)
 
 
+# ------------------------------------------------------------------- partition plane
+
+PART_ROLE = REGISTRY.gauge(
+    "metrics_tpu_part_role",
+    "This node's role for one keyspace partition: 1 leader (holds the named "
+    "lease), 0 follower, per node and partition.",
+)
+PART_FAILOVERS = REGISTRY.counter(
+    "metrics_tpu_part_failovers_total",
+    "Per-partition failovers completed by this node: named lease won + "
+    "promote() succeeded at the lease epoch, per node and partition.",
+)
+PART_MIGRATIONS = REGISTRY.counter(
+    "metrics_tpu_part_migrations_total",
+    "Live tenant migrations completed between partitions (quarantine + "
+    "snapshot handoff + destination-first commit), per node.",
+)
+
+
+def set_part_role(node: str, partition: str, role: str) -> None:
+    if not OBS.enabled:
+        return
+    PART_ROLE.set(_ROLE_CODES.get(role, 0), node=node, partition=partition)
+
+
+def record_part_failover(node: str, partition: str) -> None:
+    if not OBS.enabled:
+        return
+    PART_FAILOVERS.inc(1, node=node, partition=partition)
+    FLIGHT.record("part_failover", node=node, partition=partition)
+
+
+def record_part_lease_lost(node: str, partition: str) -> None:
+    """A held partition lease was lost (expired or conceded) and the partition
+    stepped down — the per-partition analogue of the cluster plane's failover
+    edge, always worth a flight-recorder mark."""
+    if not OBS.enabled:
+        return
+    FLIGHT.record("part_lease_lost", node=node, partition=partition)
+
+
+def record_part_migration(node: str) -> None:
+    if not OBS.enabled:
+        return
+    PART_MIGRATIONS.inc(1, node=node)
+    FLIGHT.record("part_migration", node=node)
+
+
 # ---------------------------------------------------------------------- shard plane
 
 SHARD_TENANTS = REGISTRY.gauge(

@@ -1,0 +1,144 @@
+"""Live tenant migration between partitions — destination-first, crash-safe.
+
+Moving a tenant between partitions is the shard plane's ``resize()`` copy
+discipline applied to ONE tenant while both partitions keep serving:
+
+1. **Quarantine the source.** The migration guard *holds* the tenant on the
+   source engine (:meth:`TenantQuarantine.hold`) so writes routed by a stale
+   map refuse loudly (:class:`TenantQuarantined`) instead of mutating state
+   that is about to move — the snapshot taken next is the final word.
+2. **Snapshot through the checkpoint container.** ``export_tenant(retire=
+   False)`` → ``ckpt_format.dumps`` → ``loads`` → ``import_tenant``: the
+   same bytes a crash-recovery would restore, so the destination copy is
+   bit-identical by construction — live segment AND window ring rows.
+3. **Destination durability, then routing, then source eviction.** The
+   destination checkpoints first; only then does the partition map commit
+   the override (+ a bumped epoch floor for the destination partition) —
+   THE commit point — and only after that does the source evict and
+   checkpoint. A crash at any prefix leaves either (a) no routing change
+   and an intact source (the hold is in-memory and dies with the process),
+   or (b) committed routing and a possibly-surviving double copy, which
+   :func:`sweep_partitions` resolves in the destination's favour on
+   recovery — exactly the shard ``resize()`` argument.
+
+The epoch-floor bump closes the fencing seam: the destination partition's
+next election must land strictly above the epoch the handoff happened in, so
+no pre-migration frame of the destination lineage can be confused with the
+migrated tenant's post-migration writes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Hashable, Mapping
+
+from metrics_tpu.ckpt import format as ckpt_format
+from metrics_tpu.obs import instrument as _obs
+from metrics_tpu.part.pmap import PartitionMap
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+__all__ = ["migrate_tenant", "sweep_partitions"]
+
+
+def _quarantine_of(engine: Any):
+    guard = getattr(engine, "_guard", None)
+    return getattr(guard, "quarantine", None) if guard is not None else None
+
+
+def migrate_tenant(
+    key: Hashable,
+    dst_pid: int,
+    *,
+    pmap: PartitionMap,
+    src_engine: Any,
+    dst_engine: Any,
+    node_id: str = "",
+) -> bool:
+    """Move tenant ``key`` to partition ``dst_pid``, live and bit-identically.
+
+    ``src_engine`` / ``dst_engine`` are the writable *leaders* of the tenant's
+    current and destination partitions (callers resolve leadership; this
+    function enforces the copy/commit ordering). Returns False if the tenant
+    already routes to ``dst_pid`` (no-op), True on a completed migration.
+    Raises :class:`MetricsTPUUserError` if the source does not know the
+    tenant. On failure before the map commit, the source hold is released and
+    nothing has changed durably.
+    """
+    dst_pid = int(dst_pid)
+    src_pid = pmap.partition_of(key)
+    if src_pid == dst_pid:
+        return False
+    pmap.name_of(dst_pid)  # range check before any side effect
+
+    quarantine = _quarantine_of(src_engine)
+    if quarantine is not None:
+        quarantine.hold(key)
+    try:
+        # everything accepted so far lands in the exported state
+        src_engine.flush()
+        entry = src_engine.export_tenant(key, retire=False)
+        if entry is None:
+            raise MetricsTPUUserError(
+                f"tenant {key!r} is unknown to its partition p{src_pid} leader — "
+                "nothing to migrate"
+            )
+        # bit-identical by construction: the exact bytes recovery would restore
+        blob = ckpt_format.dumps(entry)
+        dst_engine.import_tenant(key, ckpt_format.loads(blob).tree)
+        if getattr(dst_engine, "_ckpt_writer", None) is not None:
+            if dst_engine.checkpoint_now() is None:
+                raise MetricsTPUUserError(
+                    f"destination partition p{dst_pid} checkpoint failed — "
+                    "migration aborted before the routing commit"
+                )
+        # fencing seam: the destination's next election must outrank the epoch
+        # this handoff happened under
+        floor = int(getattr(dst_engine, "_repl_epoch", 0)) + 1
+        pmap.set_epoch_floor(dst_pid, floor)
+        pmap.set_override(key, dst_pid)
+        if pmap.directory is not None:
+            pmap.commit()  # THE commit point: routing now names the destination
+    except BaseException:
+        # pre-commit failure: un-hold so the source keeps serving untouched
+        if quarantine is not None:
+            quarantine.release(key)
+        raise
+    # post-commit: the destination owns the tenant; retire the source copy.
+    # A crash in here leaves a routed-away double copy for sweep_partitions.
+    src_engine.evict_tenant(key)
+    if getattr(src_engine, "_ckpt_writer", None) is not None:
+        src_engine.checkpoint_now()
+    # the hold STAYS on the source: a client still routing on a stale map
+    # must refuse loudly (TenantQuarantined -> map reload) rather than
+    # silently re-create the evicted tenant at init state. One held entry per
+    # migrated-away tenant is the price of that refusal.
+    shipper = getattr(dst_engine, "_shipper", None)
+    if shipper is not None:
+        # followers of the destination partition re-bootstrap so the imported
+        # tenant reaches the replica set as a snapshot, not a mid-stream gap
+        shipper._need_snapshot = True
+    _obs.record_part_migration(node_id)
+    return True
+
+
+def sweep_partitions(pmap: PartitionMap, engines: Mapping[int, Any]) -> int:
+    """Evict tenants that no longer route to the partition holding them.
+
+    The recovery half of the migration crash argument: if the process died
+    between the map commit and the source eviction, the source WAL still
+    replays the migrated tenant. The committed map is the truth — any tenant
+    whose :meth:`PartitionMap.partition_of` disagrees with its resident
+    partition is a superseded double copy and is evicted (the destination's
+    copy was durable before the commit, by ordering). Run over writable
+    engines after recovery. Returns the number of evictions.
+    """
+    evicted = 0
+    for pid, engine in engines.items():
+        keys = list(engine._keyed.keys)
+        tier = getattr(engine, "_tier", None)
+        if tier is not None:
+            keys.extend(tier.keys())
+        for key in keys:
+            if pmap.partition_of(key) != pid:
+                engine.evict_tenant(key)
+                evicted += 1
+    return evicted

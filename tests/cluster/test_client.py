@@ -132,3 +132,65 @@ def test_invalid_prefer_rejected():
     _, _, _, client, _ = _cluster()
     with pytest.raises(ValueError):
         client.compute("k", prefer="nearest")
+
+
+class ReadCountingStore(FakeCoordStore):
+    """FakeCoordStore that counts read_lease calls (lease-epoch memo guard)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.lease_reads = 0
+
+    def read_lease(self, *args, **kwargs):
+        self.lease_reads += 1
+        return super().read_lease(*args, **kwargs)
+
+
+def _counting_cluster():
+    clock = ManualClock(0.0)
+    store = ReadCountingStore(clock=clock)
+    engines = {n: StubNode(n) for n in ("x", "y")}
+    store.acquire_lease("x", 100.0)
+    client = ClusterClient(store, engines, sleep=lambda _s: None, rng_seed=0)
+    return clock, store, engines, client
+
+
+def test_redirect_storm_under_flapping_leader_memoizes_lease_reads():
+    # a leader that refuses writes while still holding (and renewing) its
+    # lease must not turn every redirect into a CoordStore.read_lease — the
+    # first refresh validates the epoch is unchanged, the rest reuse the memo
+    _, store, engines, client = _counting_cluster()
+    engines["x"].submit_exc = NotPrimaryError("flapping")
+    with pytest.raises(NoLeaderError):
+        client.submit("k")
+    assert engines["x"].submits == client._retries + 1  # kept retrying the holder
+    assert store.lease_reads == 2  # initial resolve + one validating re-read
+
+def test_memo_rereads_after_interval_and_follows_epoch_change():
+    clock, store, engines, client = _counting_cluster()
+    assert client.submit("k") == "submit@x"
+    engines["x"].submit_exc = NotPrimaryError("stepping down")
+    with pytest.raises(NoLeaderError):
+        client.submit("k")
+    assert store.lease_reads == 2  # memo validated, storm absorbed
+    # the lease actually moves; once the re-read window lapses the next
+    # redirect discovers the new epoch in exactly one store read
+    store.release_lease("x")
+    store.acquire_lease("y", 100.0)
+    clock.advance(client._lease_reread_s)
+    assert client.submit("k") == "submit@y"
+    assert store.lease_reads == 3
+
+
+def test_memo_expiry_forces_reread():
+    clock, store, engines, client = _counting_cluster()
+    engines["x"].submit_exc = NotPrimaryError("flapping")
+    with pytest.raises(NoLeaderError):
+        client.submit("k")
+    reads = store.lease_reads
+    # expired memo may not be served even inside the re-read window
+    store.release_lease("x")
+    clock.advance(1000.0)
+    store.acquire_lease("y", 100.0)
+    assert client.submit("k") == "submit@y"
+    assert store.lease_reads > reads

@@ -184,9 +184,67 @@ class TestDirectoryCoordStore:
         # successfully, then must concede on the post-link re-scan
         s1 = DirectoryCoordStore(str(tmp_path))
         s2 = DirectoryCoordStore(str(tmp_path))
-        monkeypatch.setattr(s1, "read_lease", lambda: None)  # stale pre-link scan
+        monkeypatch.setattr(s1, "read_lease", lambda name="": None)  # stale pre-link scan
         assert s2.acquire_lease("b", 30.0, epoch_floor=5).epoch == 5
         assert s1.acquire_lease("a", 30.0) is None  # linked lease-1, conceded
         monkeypatch.undo()
         lease = s1.read_lease()
         assert lease.holder == "b" and lease.epoch == 5
+
+
+# -------------------------------------------------------------- named leases
+
+
+class TestNamedLeases:
+    """Each lease name is an independent grant/epoch chain — the partition
+    plane's P concurrent leaderships over one membership record set."""
+
+    def _stores(self, tmp_path):
+        clock = ManualClock(0.0)
+        return clock, FakeCoordStore(clock=clock), DirectoryCoordStore(str(tmp_path))
+
+    def test_names_are_independent_chains(self, tmp_path):
+        _, fake, disk = self._stores(tmp_path)
+        for store in (fake, disk):
+            assert store.acquire_lease("a", 30.0, name="p0").epoch == 1
+            assert store.acquire_lease("b", 30.0, name="p1").epoch == 1  # no contention
+            assert store.acquire_lease("b", 30.0, name="p0") is None  # p0 held by a
+            assert store.read_lease("p0").holder == "a"
+            assert store.read_lease("p1").holder == "b"
+            assert store.read_lease() is None  # the "" lease is yet another chain
+
+    def test_release_is_name_scoped(self, tmp_path):
+        _, fake, disk = self._stores(tmp_path)
+        for store in (fake, disk):
+            store.acquire_lease("a", 30.0, name="p0")
+            store.acquire_lease("a", 30.0, name="p1")
+            store.release_lease("a", name="p0")
+            assert store.read_lease("p0").expired(store.now())
+            assert not store.read_lease("p1").expired(store.now())
+            assert store.acquire_lease("b", 30.0, name="p0").epoch == 2
+
+    def test_default_lease_does_not_see_named_grants(self, tmp_path):
+        _, fake, disk = self._stores(tmp_path)
+        for store in (fake, disk):
+            store.acquire_lease("a", 30.0, name="p7")
+            won = store.acquire_lease("b", 30.0)
+            assert won is not None and won.epoch == 1
+
+    def test_named_epoch_floor_and_renewal(self, tmp_path):
+        _, fake, disk = self._stores(tmp_path)
+        for store in (fake, disk):
+            won = store.acquire_lease("a", 30.0, name="p3", epoch_floor=6)
+            assert won.epoch == 6
+            renewed = store.acquire_lease("a", 30.0, name="p3")
+            assert renewed.epoch == 6 and renewed.deadline >= won.deadline
+
+    def test_directory_rejects_ambiguous_names(self, tmp_path):
+        store = DirectoryCoordStore(str(tmp_path))
+        with pytest.raises(ClusterConfigError):
+            store.acquire_lease("a", 30.0, name="p-3")
+
+    def test_member_parts_roundtrip(self, tmp_path):
+        parts = {"p0": {"bootstrapped": True, "lag": 2, "role": "leader"}}
+        for store in (FakeCoordStore(clock=ManualClock(0.0)), DirectoryCoordStore(str(tmp_path))):
+            store.heartbeat(_member("a", parts=parts))
+            assert store.members()["a"].parts == parts

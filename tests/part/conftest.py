@@ -1,0 +1,179 @@
+"""Shared partitioned-cluster rig: 3 nodes x 4 partitions of real engines over
+per-partition loopback links, a FakeCoordStore under a ManualClock, nodes
+ticked by hand — deterministic in store time, like the cluster plane's rig.
+
+Formation is made deterministic by pre-acquiring every partition's named lease
+for its designated home before the first tick: the home node's first
+``_lead_part`` is then a renewal (epoch pinned), every other node attaches,
+and no follower ever sees a vacancy to race."""
+
+import time
+
+import numpy as np
+import pytest
+
+from metrics_tpu.aggregation import SumMetric
+from metrics_tpu.cluster import FakeCoordStore, ManualClock
+from metrics_tpu.engine import CheckpointConfig, ReplConfig, StreamingEngine
+from metrics_tpu.part import PartConfig, PartitionMap, PartitionedNode, partition_name
+from metrics_tpu.repl import FanoutTransport, LoopbackLink
+
+NODES = ("a", "b", "c")
+P = 4
+
+
+def home_of(pid):
+    """Designated initial leader: a->p0,p3  b->p1  c->p2."""
+    return NODES[pid % len(NODES)]
+
+
+class PartCluster:
+    """Three PartitionedNodes over P=4 partitions (a leads two)."""
+
+    def __init__(self, tmp_path):
+        self.clock = ManualClock(0.0)
+        self.store = FakeCoordStore(clock=self.clock)
+        self.pmap = PartitionMap(P, seed=7)
+        self._links = {}
+        self.engines = {n: {} for n in NODES}  # node -> pid -> engine
+        self.nodes = {}
+        self.fed = {pid: [] for pid in range(P)}  # acked values per partition
+
+        for pid in range(P):
+            pname = partition_name(pid)
+            leader = home_of(pid)
+            followers = tuple(n for n in NODES if n != leader)
+            self.engines[leader][pid] = StreamingEngine(
+                SumMetric(),
+                checkpoint=CheckpointConfig(
+                    directory=str(tmp_path / leader / pname),
+                    interval_s=0.05,
+                    wal_flush="fsync",
+                ),
+                replication=ReplConfig(
+                    role="primary",
+                    transport=FanoutTransport(
+                        [self.link(leader, f, pname) for f in followers]
+                    ),
+                    ship_interval_s=0.01,
+                    heartbeat_interval_s=0.05,
+                    # matches the pre-acquired lease epoch below: followers may
+                    # tick (and fence at epoch 1) before this leader's first
+                    # alignment tick, and epoch-0 frames would die at that fence
+                    epoch=1,
+                ),
+            )
+            for name in followers:
+                self.engines[name][pid] = StreamingEngine(
+                    SumMetric(),
+                    replication=ReplConfig(
+                        role="follower",
+                        transport=self.link(leader, name, pname),
+                        poll_interval_s=0.01,
+                        promote_checkpoint=CheckpointConfig(
+                            directory=str(tmp_path / name / pname),
+                            interval_s=0.05,
+                            wal_flush="fsync",
+                        ),
+                    ),
+                )
+            # deterministic formation: the home holds its lease before tick 1
+            granted = self.store.acquire_lease(leader, 3.0, name=pname)
+            assert granted is not None
+
+        for name in NODES:
+            peers = tuple(n for n in NODES if n != name)
+            self.nodes[name] = PartitionedNode(
+                self.engines[name],
+                PartConfig(
+                    node_id=name,
+                    peers=peers,
+                    store=self.store,
+                    partitions=P,
+                    link_factory=self.link,
+                    seed=7,
+                    lease_ttl_s=3.0,
+                    heartbeat_interval_s=1.0,
+                    suspect_after_s=2.5,
+                    confirm_after_s=6.0,
+                    election_backoff_s=0.25,
+                    rng_seed=ord(name),
+                ),
+                pmap=self.pmap,
+                start=False,
+            )
+
+    def link(self, src, dst, partition):
+        key = (src, dst, partition)
+        if key not in self._links:
+            self._links[key] = LoopbackLink()
+        return self._links[key]
+
+    def tick_all(self, order=NODES):
+        for name in order:
+            self.nodes[name].tick()
+
+    def leaders(self):
+        """Partition id -> current unexpired lease holder (None if vacant)."""
+        now = self.store.now()
+        out = {}
+        for pid in range(P):
+            lease = self.store.read_lease(partition_name(pid))
+            out[pid] = lease.holder if lease is not None and not lease.expired(now) else None
+        return out
+
+    def writable(self, pid):
+        return [n for n in NODES if not self.engines[n][pid]._repl_follower]
+
+    def feed(self, node, pid, values, key=None):
+        key = key if key is not None else f"k{pid}"
+        for v in values:
+            self.engines[node][pid].submit(key, np.array([float(v)]))
+        self.engines[node][pid].flush()
+        self.fed[pid].extend(values)
+
+    def wait_caught_up(self, follower, leader, pid, timeout=8.0):
+        target = self.engines[leader][pid]._wal_seq
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            applier = self.engines[follower][pid]._applier
+            if applier is not None and applier.bootstrapped and applier.applied_seq >= target:
+                return
+            time.sleep(0.02)
+        applier = self.engines[follower][pid]._applier
+        raise AssertionError(
+            f"{follower}/p{pid} never caught up to {leader}'s seq {target} "
+            f"(applied={getattr(applier, 'applied_seq', None)}, "
+            f"bootstrapped={getattr(applier, 'bootstrapped', None)})"
+        )
+
+    def wait_all_caught_up(self, pid, leader=None, timeout=8.0):
+        leader = leader if leader is not None else home_of(pid)
+        for name in NODES:
+            if name != leader:
+                self.wait_caught_up(name, leader, pid, timeout=timeout)
+
+    def form(self):
+        """Tick everyone once and verify the designed assignment holds."""
+        self.tick_all()
+        got = self.leaders()
+        assert got == {pid: home_of(pid) for pid in range(P)}, got
+        for pid in range(P):
+            lease = self.store.read_lease(partition_name(pid))
+            assert self.engines[home_of(pid)][pid]._repl_epoch == lease.epoch
+            assert self.writable(pid) == [home_of(pid)]
+        return got
+
+    def close(self):
+        for node in self.nodes.values():
+            node.close(release=False)
+        for per_pid in self.engines.values():
+            for engine in per_pid.values():
+                engine.close()
+
+
+@pytest.fixture
+def pc(tmp_path):
+    cluster = PartCluster(tmp_path)
+    yield cluster
+    cluster.close()

@@ -1,0 +1,178 @@
+"""PartitionedClient: tenant-routed writes, per-partition re-resolution (no
+whole-map refresh storms), and the stale-map -> quarantine -> reload retry."""
+
+import numpy as np
+import pytest
+
+from metrics_tpu.aggregation import SumMetric
+from metrics_tpu.cluster import FakeCoordStore, ManualClock
+from metrics_tpu.cluster.errors import NoLeaderError
+from metrics_tpu.engine import CheckpointConfig, StreamingEngine
+from metrics_tpu.guard import GuardConfig
+from metrics_tpu.guard.errors import TenantQuarantined
+from metrics_tpu.part import PartitionMap, PartitionedClient, migrate_tenant
+from tests.part.conftest import P, home_of
+
+
+def _client(pc, **kw):
+    return PartitionedClient(
+        pc.store, pc.engines, pmap=pc.pmap, sleep=lambda s: None, rng_seed=0, **kw
+    )
+
+
+def _keys_per_partition(pmap, count=1):
+    out = {pid: [] for pid in range(pmap.partitions)}
+    i = 0
+    while any(len(v) < count for v in out.values()):
+        key = f"tenant-{i}"
+        pid = pmap.partition_of(key)
+        if len(out[pid]) < count:
+            out[pid].append(key)
+        i += 1
+    return out
+
+
+class TestRouting:
+    def test_submits_land_on_each_partitions_leader(self, pc):
+        pc.form()
+        client = _client(pc)
+        keys = _keys_per_partition(pc.pmap)
+        for pid in range(P):
+            key = keys[pid][0]
+            client.submit(key, np.array([float(pid + 1)]))
+            pc.engines[home_of(pid)][pid].flush()
+            # the write landed on pid's leader, not anywhere else
+            assert float(pc.engines[home_of(pid)][pid].compute(key)) == float(pid + 1)
+        table = client.routing_table()
+        assert table == {f"p{pid}": home_of(pid) for pid in range(P)}
+
+    def test_reads_route_within_the_partition(self, pc):
+        pc.form()
+        client = _client(pc)
+        keys = _keys_per_partition(pc.pmap)
+        for pid in range(P):
+            key = keys[pid][0]
+            client.submit(key, np.array([7.0]))
+            pc.engines[home_of(pid)][pid].flush()
+            assert float(client.compute(key)) == 7.0
+
+    def test_failover_rerouting_is_per_partition(self, pc):
+        """p0 fails over a->b: the client's p0 router re-resolves; the other
+        partitions' cached routes survive untouched (their leaders never
+        changed and their stores were never re-read in anger)."""
+        pc.form()
+        client = _client(pc)
+        keys = _keys_per_partition(pc.pmap)
+        for pid in range(P):
+            client.submit(keys[pid][0], np.array([1.0]))
+        pc.engines["a"][0].flush()
+        pc.wait_all_caught_up(0, leader="a")
+        # p0's lease moves to b (store-side release + b's election), and 'a'
+        # observes the loss across two renewal windows: it demotes p0 ONLY
+        pc.store.release_lease("a", name="p0")
+        pc.nodes["b"].tick()
+        pc.nodes["c"].tick()
+        pc.clock.advance(1.6)
+        pc.tick_all(order=("b", "c", "a"))
+        pc.clock.advance(1.5)
+        pc.nodes["a"].tick()
+        assert pc.nodes["a"].owned() == (3,)
+        # the deposed leader's engine refuses; the client redirects b-ward
+        before = client.redirects
+        client.submit(keys[0][0], np.array([10.0]))
+        pc.engines["b"][0].flush()
+        assert client.redirects > before
+        assert client.leader_of(0) == "b"
+        got = float(pc.engines["b"][0].compute(keys[0][0]))
+        assert got == 11.0  # 1.0 replicated + 10.0 redirected
+        # other partitions: cached leaders intact, zero new redirects
+        assert client.routing_table()["p1"] == "b"
+        assert client.routing_table()["p2"] == "c"
+        assert client.routing_table()["p3"] == "a"
+        for pid in (1, 2, 3):
+            assert client.router(pid).redirects == 0
+
+    def test_headless_partition_raises_no_leader(self, pc):
+        pc.form()
+        client = _client(pc, retries=2)
+        keys = _keys_per_partition(pc.pmap)
+        # p2 goes headless: lease released, nobody ticks an election
+        pc.store.release_lease("c", name="p2")
+        with pytest.raises(NoLeaderError):
+            client.submit(keys[2][0], np.array([1.0]))
+        # a partition with a live leader is unaffected by p2's outage
+        client.submit(keys[1][0], np.array([2.0]))
+
+
+class TestMigrationWindow:
+    @pytest.fixture
+    def duo(self, tmp_path):
+        """Two single-partition 'nodes' (s leads p0, d leads p1) + a
+        manifest-backed map — the minimal stale-route migration setup."""
+        clock = ManualClock(0.0)
+        store = FakeCoordStore(clock=clock)
+        assert store.acquire_lease("s", 1e6, name="p0") is not None
+        assert store.acquire_lease("d", 1e6, name="p1") is not None
+        src = StreamingEngine(
+            SumMetric(),
+            guard=GuardConfig(shed=False),
+            checkpoint=CheckpointConfig(directory=str(tmp_path / "p0"), wal_flush="fsync"),
+        )
+        dst = StreamingEngine(
+            SumMetric(),
+            checkpoint=CheckpointConfig(directory=str(tmp_path / "p1"), wal_flush="fsync"),
+        )
+        pmap_dir = str(tmp_path / "pmap")
+        PartitionMap(2, seed=1, directory=pmap_dir)  # write the manifest
+        yield store, src, dst, pmap_dir
+        src.close()
+        dst.close()
+
+    def test_stale_map_write_reloads_and_retries_at_new_home(self, duo):
+        store, src, dst, pmap_dir = duo
+        client = PartitionedClient(
+            store,
+            {"s": {0: src}, "d": {1: dst}},
+            pmap=PartitionMap(2, seed=1, directory=pmap_dir),
+            sleep=lambda s: None,
+        )
+        key = next(
+            f"tenant-{i}" for i in range(1000)
+            if client.pmap.partition_of(f"tenant-{i}") == 0
+        )
+        client.submit(key, np.array([5.0]))
+        src.flush()
+        # a coordinator (its own map instance) migrates the tenant p0 -> p1
+        coordinator = PartitionMap(2, seed=1, directory=pmap_dir)
+        assert migrate_tenant(key, 1, pmap=coordinator, src_engine=src, dst_engine=dst)
+        # the client's map is now stale: its write hits the source's hold,
+        # reloads the committed map, and retries at the new home — one hop
+        client.submit(key, np.array([2.0]))
+        dst.flush()
+        assert client.pmap.partition_of(key) == 1
+        assert float(dst.compute(key)) == 7.0
+        assert float(client.compute(key)) == 7.0
+
+    def test_mid_migration_quarantine_propagates_when_map_unchanged(self, duo):
+        store, src, dst, pmap_dir = duo
+        client = PartitionedClient(
+            store,
+            {"s": {0: src}, "d": {1: dst}},
+            pmap=PartitionMap(2, seed=1, directory=pmap_dir),
+            sleep=lambda s: None,
+        )
+        key = next(
+            f"tenant-{i}" for i in range(1000)
+            if client.pmap.partition_of(f"tenant-{i}") == 0
+        )
+        client.submit(key, np.array([5.0]))
+        src.flush()
+        # mid-migration: the hold is on, the routing commit has NOT happened
+        src._guard.quarantine.hold(key)
+        with pytest.raises(TenantQuarantined):
+            client.submit(key, np.array([2.0]))
+        # once the hold lifts (migration aborted), writes flow again
+        src._guard.quarantine.release(key)
+        client.submit(key, np.array([2.0]))
+        src.flush()
+        assert float(src.compute(key)) == 7.0

@@ -1,0 +1,240 @@
+"""Deterministic per-partition election races: two nodes CAS-racing disjoint
+and overlapping partition subsets under a manual clock, every interleaving
+hand-ticked. Engines are stubs (lease/role-level assertions) — the full
+engine-level zombie fencing runs in test_node.py over real engines."""
+
+from types import SimpleNamespace
+
+import pytest
+
+from metrics_tpu.cluster import FakeCoordStore, ManualClock
+from metrics_tpu.part import PartConfig, PartitionMap, PartitionedNode, partition_name
+from metrics_tpu.repl.errors import NotPromotableError
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+P = 4
+
+
+class _StubApplier:
+    def __init__(self, *, epoch=0, lag=0, bootstrapped=True):
+        self.epoch = epoch
+        self.bootstrapped = bootstrapped
+        self._gap = False
+        self.applied_seq = 0
+        self._lag = lag
+
+    def lag(self):
+        return SimpleNamespace(seqs_behind=self._lag)
+
+
+class _StubEngine:
+    """The engine surface PartitionedNode supervises, minus the machinery."""
+
+    def __init__(self, *, writable=False, bootstrapped=True, lag=0, health="SERVING"):
+        self._repl_follower = not writable
+        self._repl_cfg = None
+        self._repl_epoch = 0
+        self._cluster = None
+        self._applier = None if writable else _StubApplier(lag=lag, bootstrapped=bootstrapped)
+        self._health = health
+        self.promote_calls = []
+        self.promote_raises = []  # exceptions popped one per promote() call
+
+    def health(self):
+        return {"state": self._health}
+
+    def promote(self, *, epoch=None, ship=None):
+        if self.promote_raises:
+            raise self.promote_raises.pop(0)
+        self.promote_calls.append(epoch)
+        self._repl_follower = False
+        self._repl_epoch = epoch
+        self._applier = None
+
+    def demote(self, replication=None):
+        self._repl_follower = True
+
+
+def _node(name, store, engines, *, peers, pmap=None, rng_seed=0):
+    return PartitionedNode(
+        engines,
+        PartConfig(
+            node_id=name,
+            peers=peers,
+            store=store,
+            partitions=P,
+            lease_ttl_s=3.0,
+            heartbeat_interval_s=1.0,
+            suspect_after_s=2.5,
+            confirm_after_s=6.0,
+            election_backoff_s=0.25,
+            rng_seed=rng_seed,
+        ),
+        pmap=pmap,
+        start=False,
+    )
+
+
+def _owners(store, now):
+    out = {}
+    for pid in range(P):
+        lease = store.read_lease(partition_name(pid))
+        out[pid] = lease.holder if lease is not None and not lease.expired(now) else None
+    return out
+
+
+@pytest.mark.parametrize("first", ["n1", "n2"])
+def test_disjoint_subsets_never_collide(first):
+    """n1 is bootstrapped only on p0/p1, n2 only on p2/p3: whatever the tick
+    interleaving, each node wins exactly its eligible partitions and neither
+    ever holds a lease in the other's subset."""
+    clock = ManualClock(0.0)
+    store = FakeCoordStore(clock=clock)
+    n1 = _node("n1", store, {
+        pid: _StubEngine(bootstrapped=pid in (0, 1)) for pid in range(P)
+    }, peers=("n2",))
+    n2 = _node("n2", store, {
+        pid: _StubEngine(bootstrapped=pid in (2, 3)) for pid in range(P)
+    }, peers=("n1",))
+    nodes = {"n1": n1, "n2": n2}
+    second = "n2" if first == "n1" else "n1"
+    try:
+        for name in (first, second, first, second):
+            nodes[name].tick()
+            owners = _owners(store, store.now())
+            for pid in (0, 1):
+                assert owners[pid] in (None, "n1")
+            for pid in (2, 3):
+                assert owners[pid] in (None, "n2")
+        assert n1.owned() == (0, 1)
+        assert n2.owned() == (2, 3)
+    finally:
+        n1.close(release=False)
+        n2.close(release=False)
+
+
+@pytest.mark.parametrize("order", [("n1", "n2"), ("n2", "n1")])
+def test_overlapping_subsets_cas_keeps_one_winner_each(order):
+    """Both nodes eligible on EVERY partition, no member records to rank by:
+    the CAS is the only arbiter, and at every prefix of every interleaving
+    each partition has at most one unexpired holder."""
+    clock = ManualClock(0.0)
+    store = FakeCoordStore(clock=clock)
+    n1 = _node("n1", store, {pid: _StubEngine() for pid in range(P)}, peers=("n2",))
+    n2 = _node("n2", store, {pid: _StubEngine() for pid in range(P)}, peers=("n1",))
+    nodes = {"n1": n1, "n2": n2}
+    try:
+        seen = []
+        for name in order * 3:
+            nodes[name].tick()
+            owners = _owners(store, store.now())
+            seen.append(dict(owners))
+            roles = {
+                pid: [n for n in ("n1", "n2")
+                      if nodes[n]._slots[pid].role == "leader"]
+                for pid in range(P)
+            }
+            for pid in range(P):
+                assert len(roles[pid]) <= 1, (pid, roles)
+                if roles[pid]:
+                    assert owners[pid] == roles[pid][0]
+        # converged: every partition owned, the first ticker swept the board
+        # (no records existed to defer to), epochs aligned per partition
+        final = seen[-1]
+        assert all(final[pid] == order[0] for pid in range(P))
+        winner = nodes[order[0]]
+        for pid in range(P):
+            lease = store.read_lease(partition_name(pid))
+            assert winner.engine_for(pid)._repl_epoch == lease.epoch
+    finally:
+        n1.close(release=False)
+        n2.close(release=False)
+
+
+def test_overlapping_subsets_rank_by_per_partition_lag():
+    """With member records published, candidacy defers PER PARTITION: n2 is
+    fresher on p2/p3 and n1 on p0/p1, so each wins its half even when the
+    other ticks first — the loser holds back a jittered round per partition."""
+    clock = ManualClock(0.0)
+    store = FakeCoordStore(clock=clock)
+    # a ghost leader holds everything, so the first ticks only publish records
+    for pid in range(P):
+        assert store.acquire_lease("ghost", 4.0, name=partition_name(pid)) is not None
+    n1 = _node("n1", store, {
+        pid: _StubEngine(lag=0 if pid in (0, 1) else 9) for pid in range(P)
+    }, peers=("n2",))
+    n2 = _node("n2", store, {
+        pid: _StubEngine(lag=0 if pid in (2, 3) else 9) for pid in range(P)
+    }, peers=("n1",))
+    try:
+        n1.tick()
+        n2.tick()
+        clock.advance(1.0)
+        # refresh both records while the ghost still holds every lease, so the
+        # elections below rank against live (non-confirmed-dead) peers
+        n1.tick()
+        n2.tick()
+        clock.advance(3.1)  # ghost's leases expire; both records within confirm_after
+        # n2 ticks FIRST: it must defer on p0/p1 (n1's lag is lower) while
+        # taking p2/p3 where it is the favourite
+        n2.tick()
+        owners = _owners(store, store.now())
+        assert owners[0] is None and owners[1] is None  # deference, per partition
+        assert owners[2] == "n2" and owners[3] == "n2"
+        n1.tick()
+        owners = _owners(store, store.now())
+        assert owners[0] == "n1" and owners[1] == "n1"
+        assert n1.owned() == (0, 1)
+        assert n2.owned() == (2, 3)
+    finally:
+        n1.close(release=False)
+        n2.close(release=False)
+
+
+def test_epoch_floor_gates_one_partition_only():
+    """A migration-bumped epoch floor on p2 forces p2's next lease to start at
+    the floor; the other partitions' epochs are untouched."""
+    clock = ManualClock(0.0)
+    store = FakeCoordStore(clock=clock)
+    pmap = PartitionMap(P)
+    pmap.set_epoch_floor(2, 10)
+    n1 = _node("n1", store, {pid: _StubEngine() for pid in range(P)}, peers=(), pmap=pmap)
+    try:
+        n1.tick()
+        assert store.read_lease(partition_name(2)).epoch == 10
+        assert store.read_lease(partition_name(0)).epoch == 1
+        assert n1.engine_for(2)._repl_epoch == 10
+        assert n1.engine_for(0)._repl_epoch == 1
+    finally:
+        n1.close(release=False)
+
+
+def test_promote_refusals_are_per_partition():
+    """p0's promote keeps NotPromotableError retryable (lease held, backoff),
+    p1's MetricsTPUUserError releases p1's lease only — and p2/p3 promote
+    cleanly in the same tick."""
+    clock = ManualClock(0.0)
+    store = FakeCoordStore(clock=clock)
+    engines = {pid: _StubEngine() for pid in range(P)}
+    engines[0].promote_raises = [NotPromotableError("snapshot not landed")]
+    engines[1].promote_raises = [MetricsTPUUserError("will never promote")]
+    n1 = _node("n1", store, engines, peers=())
+    try:
+        n1.tick()
+        now = store.now()
+        # p0: lease kept, promotion pending retry
+        lease0 = store.read_lease("p0")
+        assert lease0 is not None and lease0.holder == "n1" and not lease0.expired(now)
+        assert n1._slots[0].role == "follower"
+        # p1: lease released (expired NOW), not wedged until TTL
+        lease1 = store.read_lease("p1")
+        assert lease1 is None or lease1.expired(now) or lease1.holder != "n1"
+        # p2/p3: promoted in the same tick, unbothered
+        assert n1.owned() == (2, 3)
+        # the retryable one completes once its backoff elapses
+        clock.advance(1.0)
+        n1.tick()
+        assert 0 in n1.owned()
+        assert engines[0].promote_calls == [lease0.epoch]
+    finally:
+        n1.close(release=False)

@@ -1,0 +1,169 @@
+"""Live tenant migration: bit-identity through the checkpoint container
+(window rings included), destination-first crash ordering at every failure
+point, quarantine-hold semantics, and the recovery sweep."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from metrics_tpu.aggregation import SumMetric
+from metrics_tpu.engine import CheckpointConfig, StreamingEngine
+from metrics_tpu.guard import GuardConfig
+from metrics_tpu.guard.errors import TenantQuarantined
+from metrics_tpu.part import PartitionMap, migrate_tenant, sweep_partitions
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+
+def _key_on_partition(pmap, pid):
+    for i in range(1000):
+        key = f"tenant-{i}"
+        if pmap.partition_of(key) == pid:
+            return key
+    raise AssertionError("no key found")
+
+
+def _leaves(tree):
+    return jax.tree_util.tree_leaves(tree)
+
+
+@pytest.fixture
+def rig(tmp_path):
+    pmap = PartitionMap(2, seed=1, directory=str(tmp_path / "pmap"))
+    src = StreamingEngine(
+        SumMetric(),
+        window=3,
+        guard=GuardConfig(shed=False),
+        checkpoint=CheckpointConfig(directory=str(tmp_path / "p0"), wal_flush="fsync"),
+    )
+    dst = StreamingEngine(
+        SumMetric(),
+        window=3,
+        checkpoint=CheckpointConfig(directory=str(tmp_path / "p1"), wal_flush="fsync"),
+    )
+    yield pmap, src, dst
+    src.close()
+    dst.close()
+
+
+def _feed(engine, key, rounds=((1.0, 2.0), (3.0,), (4.0, 5.0))):
+    """Populate live segment AND window ring rows (rotations between rounds)."""
+    for i, values in enumerate(rounds):
+        if i:
+            engine.rotate_window()
+        for v in values:
+            engine.submit(key, np.array([v]))
+        engine.flush()
+
+
+class TestMigrate:
+    def test_bit_identical_through_ckpt_container(self, rig):
+        pmap, src, dst = rig
+        key = _key_on_partition(pmap, 0)
+        _feed(src, key)
+        before = src.export_tenant(key, retire=False)
+        val_before = float(src.compute(key))
+
+        assert migrate_tenant(key, 1, pmap=pmap, src_engine=src, dst_engine=dst)
+
+        # routing moved, durably: a fresh map view from disk agrees
+        assert pmap.partition_of(key) == 1
+        fresh = PartitionMap(2, seed=1, directory=pmap.directory)
+        assert fresh.partition_of(key) == 1
+        # the destination partition's next election is floored past the handoff
+        assert fresh.epoch_floor(1) >= 1
+
+        # bit-identical: live segment AND every window ring row (``rot`` is
+        # re-stamped by design — it is the destination's rotation counter)
+        after = dst.export_tenant(key, retire=False)
+        assert len(_leaves(before["state"])) == len(_leaves(after["state"]))
+        for a, b in zip(_leaves(before["state"]), _leaves(after["state"])):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+        assert len(before["ring"]) == len(after["ring"])
+        for row_a, row_b in zip(before["ring"], after["ring"]):
+            assert (row_a is None) == (row_b is None)
+            for a, b in zip(_leaves(row_a), _leaves(row_b)):
+                assert np.array_equal(np.asarray(a), np.asarray(b))
+        assert float(dst.compute(key)) == val_before
+
+        # the source copy is gone — state, ring, residency
+        assert key not in list(src._keyed.keys)
+        # ...and the hold STAYS: a stale-routed write refuses loudly instead
+        # of silently re-creating the evicted tenant at init state
+        with pytest.raises(TenantQuarantined):
+            src.submit(key, np.array([1.0]))
+
+    def test_same_partition_is_a_noop(self, rig):
+        pmap, src, dst = rig
+        key = _key_on_partition(pmap, 0)
+        _feed(src, key)
+        assert migrate_tenant(key, 0, pmap=pmap, src_engine=src, dst_engine=dst) is False
+        assert key in list(src._keyed.keys)
+
+    def test_unknown_tenant_raises_and_releases_hold(self, rig):
+        pmap, src, dst = rig
+        key = _key_on_partition(pmap, 0)
+        with pytest.raises(MetricsTPUUserError, match="unknown"):
+            migrate_tenant(key, 1, pmap=pmap, src_engine=src, dst_engine=dst)
+        # the aborted migration must not leave the tenant administratively dead
+        src.submit(key, np.array([1.0]))
+        src.flush()
+        assert float(src.compute(key)) == 1.0
+
+    def test_hold_refuses_stale_writes_until_release(self, rig):
+        """The migration window's write-refusal contract: a held tenant's
+        submits fail fast, a success never lifts the hold, release restores."""
+        pmap, src, _ = rig
+        key = _key_on_partition(pmap, 0)
+        _feed(src, key)
+        src._guard.quarantine.hold(key)
+        with pytest.raises(TenantQuarantined):
+            src.submit(key, np.array([99.0]))
+        # a straggler's recorded success must NOT lift an administrative hold
+        src._guard.quarantine.record(key, True)
+        with pytest.raises(TenantQuarantined):
+            src.submit(key, np.array([99.0]))
+        src._guard.quarantine.release(key)
+        src.submit(key, np.array([1.0]))
+        src.flush()
+
+    def test_failure_before_commit_leaves_source_intact(self, rig):
+        pmap, src, dst = rig
+        key = _key_on_partition(pmap, 0)
+        _feed(src, key)
+        val = float(src.compute(key))
+        boom = RuntimeError("dst died mid-import")
+        dst.import_tenant = lambda *a, **kw: (_ for _ in ()).throw(boom)
+        with pytest.raises(RuntimeError, match="mid-import"):
+            migrate_tenant(key, 1, pmap=pmap, src_engine=src, dst_engine=dst)
+        # nothing durable changed: routing still names the source...
+        assert pmap.partition_of(key) == 0
+        assert PartitionMap(2, seed=1, directory=pmap.directory).partition_of(key) == 0
+        # ...the source still serves the tenant, hold lifted
+        assert float(src.compute(key)) == val
+        src.submit(key, np.array([1.0]))
+        src.flush()
+
+    def test_crash_after_commit_is_swept_in_destinations_favour(self, rig):
+        """Process dies between the routing commit and the source eviction:
+        both copies exist, the committed map names the destination, and the
+        recovery sweep evicts the superseded source copy."""
+        pmap, src, dst = rig
+        key = _key_on_partition(pmap, 0)
+        _feed(src, key)
+        val = float(src.compute(key))
+        real_evict = src.evict_tenant
+        src.evict_tenant = lambda *a, **kw: (_ for _ in ()).throw(RuntimeError("crash"))
+        with pytest.raises(RuntimeError, match="crash"):
+            migrate_tenant(key, 1, pmap=pmap, src_engine=src, dst_engine=dst)
+        src.evict_tenant = real_evict
+        # the double copy: both engines hold the tenant, the map names dst
+        assert key in list(src._keyed.keys)
+        assert key in list(dst._keyed.keys)
+        assert pmap.partition_of(key) == 1
+        # recovery: the committed map is the truth, the source copy is evicted
+        assert sweep_partitions(pmap, {0: src, 1: dst}) == 1
+        assert key not in list(src._keyed.keys)
+        assert float(dst.compute(key)) == val
+        # a consistent layout sweeps to nothing
+        assert sweep_partitions(pmap, {0: src, 1: dst}) == 0

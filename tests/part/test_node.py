@@ -1,0 +1,169 @@
+"""PartitionedNode over real engines: formation, k independent failovers when
+a multi-lease host dies, and per-partition zombie fencing — the partition
+plane's core safety claims, deterministic in store time."""
+
+import time
+
+import numpy as np
+import pytest
+
+from metrics_tpu.repl import NotPrimaryError
+from tests.part.conftest import NODES, P, home_of
+
+
+def _settle(pc):
+    """Feed every partition, catch every follower up, refresh member records."""
+    for pid in range(P):
+        pc.feed(home_of(pid), pid, range(5 + pid))
+        pc.wait_all_caught_up(pid)
+    pc.clock.advance(1.0)
+    pc.tick_all()
+
+
+class TestFormation:
+    def test_every_partition_has_exactly_one_leader(self, pc):
+        pc.form()
+        for pid in range(P):
+            assert len(pc.writable(pid)) == 1
+
+    def test_multi_leader_spread(self, pc):
+        # node 'a' leads two partitions concurrently; 'b' and 'c' one each —
+        # leadership is a per-partition fact, not a node-level one
+        pc.form()
+        assert pc.nodes["a"].owned() == (0, 3)
+        assert pc.nodes["b"].owned() == (1,)
+        assert pc.nodes["c"].owned() == (2,)
+
+    def test_writes_replicate_per_partition(self, pc):
+        pc.form()
+        _settle(pc)
+        for pid in range(P):
+            for name in NODES:
+                got = float(pc.engines[name][pid].compute(f"k{pid}"))
+                assert got == float(sum(pc.fed[pid]))
+
+    def test_health_view_names_partitions(self, pc):
+        pc.form()
+        view = pc.nodes["a"].health_view()
+        assert view["owned"] == [0, 3]
+        assert view["partitions"]["p0"]["role"] == "leader"
+        assert view["partitions"]["p1"]["role"] == "follower"
+        assert view["partitions"]["p0"]["lease_epoch"] == 1
+
+    def test_engines_must_cover_all_partitions(self, pc):
+        from metrics_tpu.cluster.errors import ClusterConfigError
+        from metrics_tpu.part import PartConfig, PartitionedNode
+
+        with pytest.raises(ClusterConfigError, match="cover exactly"):
+            PartitionedNode(
+                {0: pc.engines["a"][0]},
+                PartConfig(node_id="z", store=pc.store, partitions=2),
+                start=False,
+            )
+
+
+class TestDeadHostFailsOverPerPartition:
+    def test_k_leases_mean_k_independent_failovers(self, pc):
+        """Host 'a' dies holding TWO leases (p0, p3): each triggers its own
+        ranked election, each partition fails over independently, and the
+        partitions 'a' never led keep their leaders and epochs untouched."""
+        pc.form()
+        _settle(pc)
+        epoch_before = {
+            pid: pc.store.read_lease(pc.pmap.name_of(pid)).epoch for pid in range(P)
+        }
+        pc.store.partition("a")  # SIGKILL-equivalent for the supervisor
+        pc.clock.advance(3.5)  # past every TTL and the suspect threshold
+
+        # every prefix of the survivor interleaving keeps at-most-one-writer
+        # PER PARTITION among the survivors
+        for name in ("b", "c", "b", "c", "b", "c"):
+            pc.nodes[name].tick()
+            for pid in range(P):
+                survivors = [
+                    n for n in ("b", "c") if not pc.engines[n][pid]._repl_follower
+                ]
+                assert len(survivors) <= 1, (pid, survivors)
+
+        leaders = pc.leaders()
+        # a's two partitions each elected a new (bootstrapped, SERVING) leader
+        for pid in (0, 3):
+            assert leaders[pid] in ("b", "c")
+            lease = pc.store.read_lease(pc.pmap.name_of(pid))
+            assert lease.epoch > epoch_before[pid]
+            # the new leader's fencing epoch IS its lease epoch
+            assert pc.engines[leaders[pid]][pid]._repl_epoch == lease.epoch
+            # ...and it serves exactly the acked prefix: no loss, no dupes
+            got = float(pc.engines[leaders[pid]][pid].compute(f"k{pid}"))
+            assert got == float(sum(pc.fed[pid]))
+        # the partitions a never led kept their leaders (epoch may renew but
+        # leadership never moved)
+        assert leaders[1] == "b" and leaders[2] == "c"
+        # failovers counted per partition, k of them in total
+        per_slot = {
+            pid: pc.nodes[n]._slots[pid].failovers for n in ("b", "c") for pid in range(P)
+            if pc.nodes[n]._slots[pid].failovers
+        }
+        assert sum(per_slot.values()) == 2
+
+    def test_revived_host_rejoins_each_partition_as_follower(self, pc):
+        pc.form()
+        _settle(pc)
+        pc.store.partition("a")
+        pc.clock.advance(3.5)
+        for name in ("b", "c", "b", "c"):
+            pc.nodes[name].tick()
+        leaders = pc.leaders()
+        # 'a' heals: it must step down BOTH its zombie leaderships and attach
+        # to each partition's new leader — per-partition, in one tick
+        pc.store.heal("a")
+        pc.nodes["a"].tick()
+        assert pc.nodes["a"].owned() == ()
+        for pid in (0, 3):
+            assert pc.engines["a"][pid]._repl_follower
+            assert pc.nodes["a"]._slots[pid].following == leaders[pid]
+            with pytest.raises(NotPrimaryError):
+                pc.engines["a"][pid].submit(f"k{pid}", np.array([1.0]))
+
+
+class TestZombiePartialFencing:
+    def test_zombie_fenced_per_partition_while_others_keep_serving(self, pc):
+        """'a' loses ONE of its two leases (p0) without noticing: its p0
+        shipments die at p0's transport fence while its still-held p3 keeps
+        replicating normally — fencing granularity is the partition."""
+        pc.form()
+        _settle(pc)
+        # p0's lease vanishes from under 'a' (store-side release); b elects
+        pc.store.release_lease("a", name="p0")
+        pc.nodes["b"].tick()
+        pc.nodes["c"].tick()
+        leaders = pc.leaders()
+        assert leaders[0] == "b" and leaders[3] == "a"
+        # 'a' has not ticked: locally still writable on p0 (zombie) AND p3 (legit)
+        assert not pc.engines["a"][0]._repl_follower
+        assert not pc.engines["a"][3]._repl_follower
+
+        # the zombie p0 write is accepted locally but fenced at the boundary
+        pc.engines["a"][0].submit("k0", np.array([999.0]))
+        pc.engines["a"][0].flush()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and not pc.engines["a"][0]._shipper.fenced:
+            time.sleep(0.02)
+        assert pc.engines["a"][0]._shipper.fenced
+        assert pc.engines["a"][0].health()["state"] == "DEGRADED"
+        assert float(pc.engines["b"][0].compute("k0")) == float(sum(pc.fed[0]))
+
+        # meanwhile the SAME host's still-owned p3 replicates new writes fine
+        pc.feed("a", 3, [70, 71])
+        pc.wait_all_caught_up(3, leader="a")
+        for name in NODES:
+            assert float(pc.engines[name][3].compute("k3")) == float(sum(pc.fed[3]))
+
+        # once 'a' observes the store again it steps down p0 ONLY
+        pc.clock.advance(1.6)  # renewal window: a re-reads, sees b's lease
+        pc.tick_all(order=("b", "c", "a"))
+        pc.clock.advance(0.5)  # a's own p0 deadline (t=3.0) passes
+        pc.nodes["a"].tick()
+        assert pc.nodes["a"].owned() == (3,)
+        assert pc.engines["a"][0]._repl_follower
+        assert pc.nodes["a"]._slots[0].following == "b"

@@ -2453,6 +2453,245 @@ def soak_comm(seeds) -> None:
                 FAILS.append((seed, tag, f"rank {r} healed round != full-world oracle: {repr(exc)[:140]}"))
 
 
+# ------------------------------------------------------------------ part surface
+
+_PART_P = 8
+
+
+def _part_links(dirpath):
+    """One directory spool per ordered (src, dst, partition) triple — fencing
+    one partition's link never touches another's."""
+    from metrics_tpu.repl import DirectoryTransport
+
+    def link(src, dst, partition):
+        return DirectoryTransport(
+            os.path.join(dirpath, f"spool-{src}-{dst}-{partition}"), durable=False)
+
+    return link
+
+
+def _part_node_cfg(name, dirpath, link, seed):
+    from metrics_tpu.cluster import DirectoryCoordStore
+    from metrics_tpu.part import PartConfig
+
+    return PartConfig(
+        node_id=name,
+        peers=tuple(p for p in ("a", "b", "c") if p != name),
+        store=DirectoryCoordStore(os.path.join(dirpath, "coord"), durable=False),
+        partitions=_PART_P,
+        link_factory=link,
+        lease_ttl_s=1.0,
+        heartbeat_interval_s=0.2,
+        suspect_after_s=0.8,
+        confirm_after_s=2.5,
+        tick_interval_s=0.05,
+        election_backoff_s=0.1,
+        rng_seed=seed + ord(name),
+    )
+
+
+def _part_stream(seed, pid, n=1500):
+    rng = np.random.default_rng((seed << 4) ^ pid)
+    return [(f"p{pid}k{rng.integers(0, 4)}", rng.integers(0, 2, 3), rng.integers(0, 2, 3))
+            for _ in range(n)]
+
+
+def part_crash_child(dirpath, seed):
+    """Child half of the partition SIGKILL surface: node 'a' leads ALL 8
+    partitions — 8 independent named leases, 8 durable lineages — and submits
+    every partition's deterministic stream round-robin until killed."""
+    import time as _time
+
+    from metrics_tpu.classification import BinaryAccuracy
+    from metrics_tpu.engine import CheckpointConfig, ReplConfig, StreamingEngine
+    from metrics_tpu.part import PartitionedNode, partition_name
+    from metrics_tpu.repl import FanoutTransport
+
+    link = _part_links(dirpath)
+    engines = {}
+    for pid in range(_PART_P):
+        pname = partition_name(pid)
+        engines[pid] = StreamingEngine(
+            BinaryAccuracy(), buckets=(8,),
+            checkpoint=CheckpointConfig(directory=os.path.join(dirpath, f"ckpt-a-{pname}"),
+                                        interval_s=0.05, retain=3, durable=True,
+                                        wal_flush="fsync"),
+            replication=ReplConfig(role="primary",
+                                   transport=FanoutTransport([link("a", "b", pname),
+                                                              link("a", "c", pname)]),
+                                   ship_interval_s=0.01, heartbeat_interval_s=0.1),
+        )
+    node = PartitionedNode(engines, _part_node_cfg("a", dirpath, link, seed))
+    # the parent is told READY only once 'a' holds every named lease — the
+    # kill must depose a host that genuinely owns several leaderships
+    deadline = _time.monotonic() + 60.0
+    while len(node.owned()) < _PART_P and _time.monotonic() < deadline:
+        _time.sleep(0.02)
+    print("READY" if len(node.owned()) == _PART_P else "NOLEASE", flush=True)
+    streams = [_part_stream(seed, pid) for pid in range(_PART_P)]
+    i = 0
+    while True:  # cycle every partition until killed
+        for pid in range(_PART_P):
+            key, p, t = streams[pid][i % len(streams[pid])]
+            engines[pid].submit(key, jnp.asarray(p), jnp.asarray(t))
+        i += 1
+
+
+def soak_part(seeds) -> None:
+    """Partition-plane soak (ISSUE 15): a 3-node DirectoryCoordStore cluster
+    partitioned P=8 ways whose single host 'a' — owner of ALL EIGHT named
+    leases — is SIGKILLed mid-stream, possibly mid-write, mid-ship, or
+    mid-renewal on any subset of its partitions. The survivors must run eight
+    INDEPENDENT ranked elections with NO manual promote() anywhere: at every
+    observation each partition has at most one writable engine among the
+    survivors, every partition converges on a leader whose lease epoch IS its
+    shipping epoch, the loser of each election follows that partition's
+    winner, and every winner's state is an exactly-once order-preserving
+    prefix of that partition's deterministic stream (`_update_count` twin).
+    Self-oracled — needs no reference checkout."""
+    import signal
+    import subprocess
+    import tempfile
+    import time as _time
+
+    from metrics_tpu.classification import BinaryAccuracy
+    from metrics_tpu.cluster import DirectoryCoordStore
+    from metrics_tpu.engine import CheckpointConfig, ReplConfig, StreamingEngine
+    from metrics_tpu.part import PartitionedNode, partition_name
+
+    for seed in seeds:
+        tag = f"part/failover seed={seed}"
+        with tempfile.TemporaryDirectory() as d:
+            link = _part_links(d)
+            child = subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__), "--part-child", d, str(seed)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            )
+            engines: dict = {}
+            nodes: dict = {}
+            try:
+                line = child.stdout.readline()
+                if "READY" not in line:
+                    err = child.stderr.read()[:200]
+                    FAILS.append((seed, tag, f"child failed to lead all partitions: {line!r} {err!r}"))
+                    continue
+                for name in ("b", "c"):
+                    engines[name] = {}
+                    for pid in range(_PART_P):
+                        pname = partition_name(pid)
+                        engines[name][pid] = StreamingEngine(
+                            BinaryAccuracy(), buckets=(8,),
+                            replication=ReplConfig(
+                                role="follower", transport=link("a", name, pname),
+                                poll_interval_s=0.01,
+                                promote_checkpoint=CheckpointConfig(
+                                    directory=os.path.join(d, f"promoted-{name}-{pname}"),
+                                    interval_s=0.1, durable=False),
+                            ),
+                        )
+                    nodes[name] = PartitionedNode(engines[name], _part_node_cfg(name, d, link, seed))
+
+                def bootstrapped(name, pid):
+                    applier = engines[name][pid]._applier
+                    return applier is not None and applier.bootstrapped
+
+                # every survivor must bootstrap off every partition's spool
+                # before the kill, or some partition has nothing to fail over to
+                deadline = _time.monotonic() + 60.0
+                while _time.monotonic() < deadline and not all(
+                    bootstrapped(n, pid) for n in ("b", "c") for pid in range(_PART_P)
+                ):
+                    _time.sleep(0.05)
+                if not all(bootstrapped(n, pid) for n in ("b", "c") for pid in range(_PART_P)):
+                    missing = [(n, pid) for n in ("b", "c") for pid in range(_PART_P)
+                               if not bootstrapped(n, pid)]
+                    FAILS.append((seed, tag, f"survivors never bootstrapped: {missing[:6]}"))
+                    continue
+                rng = np.random.default_rng(seed ^ 0x9A27)
+                _time.sleep(float(rng.uniform(0.2, 0.8)))
+                child.send_signal(signal.SIGKILL)
+                child.wait(timeout=30)
+
+                # eight independent self-driving failovers: at most one
+                # writable engine PER PARTITION at every observation on the way
+                winners: dict = {}
+                safety_broken = False
+                deadline = _time.monotonic() + 60.0
+                while _time.monotonic() < deadline and len(winners) < _PART_P:
+                    for pid in range(_PART_P):
+                        writable = [n for n in ("b", "c")
+                                    if not engines[n][pid]._repl_follower]
+                        if len(writable) > 1:
+                            FAILS.append((seed, tag, f"p{pid}: TWO writable leaders: {writable}"))
+                            safety_broken = True
+                            break
+                        if writable and pid not in winners:
+                            winners[pid] = writable[0]
+                    if safety_broken:
+                        break
+                    _time.sleep(0.05)
+                if safety_broken:
+                    continue
+                if len(winners) < _PART_P:
+                    missing = sorted(set(range(_PART_P)) - set(winners))
+                    FAILS.append((seed, tag, f"partitions never elected a leader: {missing}"))
+                    continue
+                # convergence per partition: the named lease holds the winner
+                # at the shipping epoch, and the loser follows that winner
+                store = DirectoryCoordStore(os.path.join(d, "coord"), durable=False)
+                deadline = _time.monotonic() + 30.0
+                pending = set(range(_PART_P))
+                while _time.monotonic() < deadline and pending:
+                    for pid in sorted(pending):
+                        pname = partition_name(pid)
+                        winner = winners[pid]
+                        loser = "c" if winner == "b" else "b"
+                        lease = store.read_lease(pname)
+                        if (
+                            lease is not None
+                            and lease.holder == winner
+                            and engines[winner][pid]._repl_epoch == lease.epoch
+                            and nodes[loser]._slots[pid].following == winner
+                            and engines[loser][pid]._repl_follower
+                        ):
+                            pending.discard(pid)
+                    _time.sleep(0.05)
+                for pid in sorted(pending):
+                    lease = store.read_lease(partition_name(pid))
+                    FAILS.append((seed, tag, f"p{pid} no convergence: lease={lease} "
+                                  f"winner={winners[pid]} "
+                                  f"winner_epoch={engines[winners[pid]][pid]._repl_epoch}"))
+                # leaderships survived as a SET: still exactly one writable per
+                # partition after the dust settles, and each winner serves an
+                # exactly-once order-preserving prefix of ITS stream
+                for pid in range(_PART_P):
+                    writable = [n for n in ("b", "c") if not engines[n][pid]._repl_follower]
+                    if writable != [winners[pid]]:
+                        FAILS.append((seed, tag, f"p{pid} writable set drifted: {writable}"))
+                        continue
+                    _verify_repl_prefix(engines[winners[pid]][pid], _part_stream(seed, pid),
+                                        seed, f"{tag} p{pid}")
+                    try:
+                        engines[winners[pid]][pid].submit(
+                            "probe", jnp.asarray([1]), jnp.asarray([1]))
+                        engines[winners[pid]][pid].flush()
+                        float(engines[winners[pid]][pid].compute("probe"))
+                    except Exception as exc:  # noqa: BLE001
+                        FAILS.append((seed, tag, f"p{pid} winner refused a probe write: "
+                                      f"{repr(exc)[:120]}"))
+            except Exception as exc:  # noqa: BLE001 — record crash seeds, keep soaking
+                FAILS.append((seed, tag, "surface raised: " + repr(exc)[:160]))
+            finally:
+                if child.poll() is None:
+                    child.kill()
+                    child.wait(timeout=30)
+                for node in nodes.values():
+                    node.close(release=False)
+                for per_pid in engines.values():
+                    for engine in per_pid.values():
+                        engine.close(checkpoint=False)
+
+
 SURFACES = {
     "classification": soak_classification,
     "regression_retrieval": soak_regression_retrieval,
@@ -2472,14 +2711,16 @@ SURFACES = {
     "shard": soak_shard,
     "comm": soak_comm,
     "tier": soak_tier,
+    "part": soak_part,
 }
 
 # surfaces that execute the reference as their oracle (everything except the
 # self-oracled engine, ckpt crash-recovery, guard chaos, repl, sketch,
-# cluster, shard, comm and tier surfaces)
+# cluster, shard, comm, tier and part surfaces)
 _NEEDS_REF = {
     name for name in SURFACES
-    if name not in ("engine", "ckpt", "guard", "repl", "sketch", "cluster", "shard", "comm", "tier")
+    if name not in ("engine", "ckpt", "guard", "repl", "sketch", "cluster", "shard",
+                    "comm", "tier", "part")
 }
 
 
@@ -2497,6 +2738,8 @@ def main() -> None:
                         help="internal: run the cluster leader child (killed by the parent)")
     parser.add_argument("--tier-child", nargs=2, metavar=("DIR", "SEED"),
                         help="internal: run the tiered-engine child (killed by the parent)")
+    parser.add_argument("--part-child", nargs=2, metavar=("DIR", "SEED"),
+                        help="internal: run the all-partitions leader child (killed by the parent)")
     parser.add_argument("--flight-dir", default=None, metavar="DIR",
                         help="dump a flight-recorder post-mortem bundle here if any "
                              "surface fails (CI uploads it as an artifact)")
@@ -2521,6 +2764,10 @@ def main() -> None:
     if args.tier_child is not None:
         dirpath, seed = args.tier_child
         tier_crash_child(dirpath, int(seed))
+        return
+    if args.part_child is not None:
+        dirpath, seed = args.part_child
+        part_crash_child(dirpath, int(seed))
         return
 
     start, stop = (int(x) for x in args.seeds.split(":"))
