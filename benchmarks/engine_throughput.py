@@ -254,6 +254,23 @@ def main() -> None:
     ap.add_argument("--part-host", nargs=3, metavar=("SEED", "NPART", "REQUESTS"),
                     help="internal: run one loopback host for --part (leads NPART "
                     "partitions, pumps REQUESTS writes on GO, prints its rate)")
+    ap.add_argument("--pilot", action="store_true",
+                    help="autopilot-plane gates (ISSUE 16): (a) zipf-storm self-heal — "
+                    "a 4-partition fleet with every hot tenant packed onto p0 must, "
+                    "under a live AutoPilot and NO operator input, spread the hot set "
+                    "across >=3 partitions and then sustain >= --pilot-recovery-floor x "
+                    "the throughput of the same fleet hand-balanced from the start "
+                    "(paired alternating runs, median pair ratio); (b) the controller "
+                    "is near-free when there is nothing to do: a quiet balanced fleet "
+                    "with a live (lease-holding, evaluating, journaling) pilot at its "
+                    "default reconcile cadence loses <1%% vs the same fleet with no "
+                    "pilot (paired alternating runs, median pair ratio)")
+    ap.add_argument("--pilot-recovery-floor", type=float, default=0.9,
+                    help="floor for the healed-vs-hand-balanced median pair ratio. The "
+                    "default (0.9) is the ISSUE-16 acceptance bar and assumes the "
+                    "pilot's migrations converge before the timed window on an "
+                    "unloaded machine; a constrained runner must lower it explicitly "
+                    "rather than the gate silently passing")
     ap.add_argument("--guard", action="store_true",
                     help="guard-plane gates (ISSUE 5): (a) well-behaved traffic with the "
                     "guard enabled loses <5%% throughput vs the plain pass; (b) under a "
@@ -1481,6 +1498,248 @@ def main() -> None:
              pair_ratios=[round(r, 4) for r in over_ratios],
              checks={"part1_overhead_lt_5pct": ok_part_overhead})
         if not (ok_scale and ok_part_overhead):
+            sys.exit(1)
+
+    if args.pilot:
+        import tempfile
+
+        from metrics_tpu import obs as obs_pkg
+        from metrics_tpu.cluster import FakeCoordStore
+        from metrics_tpu.guard import GuardConfig
+        from metrics_tpu.guard.errors import TenantQuarantined
+        from metrics_tpu.part import PartConfig, PartitionedNode
+        from metrics_tpu.pilot import AutoPilot, PilotConfig
+
+        P_PILOT, N_HOT = 4, 8
+
+        def pilot_fleet(seed):
+            """One single-host 4-partition fleet, telemetry freshly zeroed:
+            the pilot rates on counter DELTAS keyed by (node, partition), so a
+            previous pass's series under the same labels would corrupt them."""
+            obs_pkg.reset()
+            obs_pkg.enable()  # engine telemetry is the pilot's only input
+            store = FakeCoordStore()
+            engines = {
+                pid: StreamingEngine(
+                    BinaryAccuracy(), buckets=(64,), max_queue=2048, capacity=64,
+                    # the guard plane carries the migration quarantine hold; a
+                    # refused row is retried by the pump, never dropped
+                    guard=GuardConfig(shed=False))
+                for pid in range(P_PILOT)
+            }
+            node = PartitionedNode(engines, PartConfig(
+                node_id="bench-pilot", store=store, partitions=P_PILOT,
+                lease_ttl_s=5.0, heartbeat_interval_s=0.2, suspect_after_s=2.0,
+                confirm_after_s=5.0, tick_interval_s=0.05, rng_seed=seed))
+            deadline = time.monotonic() + 30.0
+            while len(node.owned()) < P_PILOT:
+                if time.monotonic() > deadline:
+                    raise RuntimeError("pilot bench: fleet failed to lead all partitions")
+                time.sleep(0.01)
+            return store, engines, node
+
+        def keys_on(pmap, pid, prefix, n):
+            out, i = [], 0
+            while len(out) < n:
+                key = f"{prefix}-{i}"
+                if pmap.partition_of(key) == pid:
+                    out.append(key)
+                i += 1
+            return out
+
+        def pilot_storm(rng_p, hot, bg, n, hot_frac):
+            """Batch-1 request list: ``hot_frac`` of traffic zipf-weighted over
+            ``hot``, the rest uniform over ``bg`` (hot_frac=0 -> uniform mix)."""
+            keys = []
+            if hot:
+                w = 1.0 / np.arange(1, len(hot) + 1) ** 1.2
+                w /= w.sum()
+                hot_picks = rng_p.choice(len(hot), size=n, p=w)
+            hot_mask = rng_p.random(n) < hot_frac
+            bg_picks = rng_p.integers(0, len(bg), size=n)
+            for j in range(n):
+                keys.append(hot[hot_picks[j]] if hot and hot_mask[j] else bg[bg_picks[j]])
+            return [(k, jnp.asarray(rng_p.integers(0, 2, 1)),
+                     jnp.asarray(rng_p.integers(0, 2, 1))) for k in keys]
+
+        def pilot_pump(node, engines, storm):
+            """Timed: route every request through the live partition map."""
+            gc.collect()
+            gc.disable()
+            try:
+                t0 = time.perf_counter()
+
+                def client(tid: int) -> None:
+                    for i in range(tid, len(storm), args.threads):
+                        key, p, t = storm[i]
+                        while True:
+                            try:
+                                engines[node.pmap.partition_of(key)].submit(key, p, t)
+                                break
+                            except TenantQuarantined:
+                                # mid-migration hold: the map names the
+                                # destination at commit — re-route, never drop
+                                time.sleep(0.002)
+
+                threads = [threading.Thread(target=client, args=(tid,))
+                           for tid in range(args.threads)]
+                for th in threads:
+                    th.start()
+                for th in threads:
+                    th.join()
+                for eng in engines.values():
+                    eng.flush()
+                return len(storm) / (time.perf_counter() - t0)
+            finally:
+                gc.enable()
+
+        def pilot_heal_pass(seed, healed):
+            """Zipf storm against a fleet whose hot set all starts on p0.
+            ``healed``: a live AutoPilot must spread it (no operator input);
+            otherwise the layout is hand-balanced up front — the reference."""
+            with tempfile.TemporaryDirectory() as d:
+                store, engines, node = pilot_fleet(seed)
+                pilot = None
+                try:
+                    rng_p = np.random.default_rng(seed)
+                    hot = keys_on(node.pmap, 0, "hot", N_HOT)
+                    bg = [k for pid in range(1, P_PILOT)
+                          for k in keys_on(node.pmap, pid, "bg", 2)]
+                    if not healed:
+                        for i, key in enumerate(hot):  # the operator's layout
+                            node.pmap.set_override(key, i % P_PILOT)
+                    # every tenant resident before the storm: migration needs a
+                    # known source, and first-touch alloc stays out of the timing
+                    for key in hot + bg:
+                        engines[node.pmap.partition_of(key)].submit(
+                            key, jnp.asarray([0]), jnp.asarray([0]))
+                    for eng in engines.values():
+                        eng.flush()
+                    storm = pilot_storm(rng_p, hot, bg, args.requests, 0.85)
+                    if healed:
+                        pilot = AutoPilot(node, PilotConfig(
+                            node_id="bench-pilot", store=store,
+                            lease_ttl_s=2.0, tick_interval_s=0.05,
+                            evaluate_interval_s=0.25, ewma_alpha=0.6,
+                            min_observations=2, min_rate=5.0,
+                            migration_budget=4, budget_window_s=0.5,
+                            tenant_cooldown_s=120.0,
+                            journal_directory=os.path.join(d, "journal")))
+                        # warm storm until the pilot has spread the hot set —
+                        # past this point NOTHING but the controller acts.
+                        # Throttled: detection needs relative skew, not an
+                        # absolute crush that starves the pilot thread.
+                        deadline = time.monotonic() + 90.0
+                        i = 0
+                        while len({node.pmap.partition_of(k) for k in hot}) < 3:
+                            if time.monotonic() > deadline:
+                                break  # gate fails on the spread check below
+                            key, p, t = storm[i % len(storm)]
+                            try:
+                                engines[node.pmap.partition_of(key)].submit(key, p, t)
+                            except TenantQuarantined:
+                                pass  # warm phase: the next lap re-routes
+                            i += 1
+                            time.sleep(0.0005)
+                        pilot.pause()  # freeze actuation for the timed window
+                        time.sleep(0.3)  # let an in-flight cycle finish
+                    rps = pilot_pump(node, engines, storm)
+                    spread = len({node.pmap.partition_of(k) for k in hot})
+                    executed = pilot.actuator.executed if pilot is not None else 0
+                    return rps, spread, executed
+                finally:
+                    if pilot is not None:
+                        pilot.close()
+                    node.close(release=False)
+                    for eng in engines.values():
+                        eng.close()
+
+        heal_ratios, spread_ok, migrations = [], True, 0
+        healed_best = balanced_best = 0.0
+        # 2 pairs: each healed pass pays a multi-second convergence warmup,
+        # and pairing on the same seed removes the stream as a variable
+        for i in range(2):
+            if i % 2 == 0:
+                healed, spread, executed = pilot_heal_pass(21 + i, True)
+                balanced, _, _ = pilot_heal_pass(21 + i, False)
+            else:
+                balanced, _, _ = pilot_heal_pass(21 + i, False)
+                healed, spread, executed = pilot_heal_pass(21 + i, True)
+            heal_ratios.append(healed / balanced)
+            spread_ok = spread_ok and spread >= 3
+            migrations = max(migrations, executed)
+            healed_best = max(healed_best, healed)
+            balanced_best = max(balanced_best, balanced)
+        recovery = float(np.median(heal_ratios))
+        ok_recovery = (recovery >= args.pilot_recovery_floor
+                       and spread_ok and migrations > 0)
+        emit("pilot zipf-storm self-heal vs hand-balanced", recovery, "x",
+             healed_rps=round(healed_best, 1), balanced_rps=round(balanced_best, 1),
+             pair_ratios=[round(r, 4) for r in heal_ratios],
+             floor=args.pilot_recovery_floor, migrations_executed=migrations,
+             config={"partitions": P_PILOT, "hot_tenants": N_HOT,
+                     "requests": args.requests},
+             checks={"healed_ge_floor_x_balanced": recovery >= args.pilot_recovery_floor,
+                     "hot_set_spread_ge_3_partitions_no_operator": spread_ok,
+                     "pilot_executed_migrations": migrations > 0})
+
+        def pilot_idle_pass(seed, with_pilot):
+            """Uniform quiet mix on a balanced fleet: the pilot holds the
+            lease, evaluates at its DEFAULT cadence, journals every cycle —
+            and must find nothing to do. The only delta vs the off pass is
+            the controller itself."""
+            with tempfile.TemporaryDirectory() as d:
+                store, engines, node = pilot_fleet(seed)
+                pilot = None
+                try:
+                    rng_p = np.random.default_rng(seed)
+                    keys = [k for pid in range(P_PILOT)
+                            for k in keys_on(node.pmap, pid, "tenant", 2)]
+                    for key in keys:
+                        engines[node.pmap.partition_of(key)].submit(
+                            key, jnp.asarray([0]), jnp.asarray([0]))
+                    for eng in engines.values():
+                        eng.flush()
+                    storm = pilot_storm(rng_p, [], keys, args.requests, 0.0)
+                    if with_pilot:
+                        pilot = AutoPilot(node, PilotConfig(
+                            node_id="bench-pilot", store=store,
+                            journal_directory=os.path.join(d, "journal")))
+                        deadline = time.monotonic() + 10.0
+                        while pilot.role != "pilot":  # timing starts as holder
+                            if time.monotonic() > deadline:
+                                raise RuntimeError("pilot bench: lease never won")
+                            time.sleep(0.01)
+                    return pilot_pump(node, engines, storm)
+                finally:
+                    if pilot is not None:
+                        pilot.close()
+                    node.close(release=False)
+                    for eng in engines.values():
+                        eng.close()
+
+        idle_ratios = []
+        off_best = on_best = 0.0
+        for i in range(6):
+            if i % 2 == 0:
+                off = pilot_idle_pass(31 + i, False)
+                on = pilot_idle_pass(31 + i, True)
+            else:
+                on = pilot_idle_pass(31 + i, True)
+                off = pilot_idle_pass(31 + i, False)
+            idle_ratios.append(off / on)
+            off_best, on_best = max(off_best, off), max(on_best, on)
+        idle_cost = float(np.median(idle_ratios)) - 1.0
+        ok_idle = idle_cost < 0.01
+        emit("pilot controller idle cost on a balanced fleet", idle_cost * 100.0, "%",
+             no_pilot_rps=round(off_best, 1), pilot_rps=round(on_best, 1),
+             pair_ratios=[round(r, 4) for r in idle_ratios],
+             checks={"pilot_idle_cost_lt_1pct": ok_idle})
+
+        obs_pkg.reset()
+        if args.obs:
+            obs_pkg.enable()
+        if not (ok_recovery and ok_idle):
             sys.exit(1)
 
 

@@ -79,7 +79,7 @@ from metrics_tpu.engine.bucketing import (
 from metrics_tpu.engine.stream import EagerKeyedState, KeyedState
 from metrics_tpu.engine.telemetry import EngineTelemetry
 from metrics_tpu.guard.config import GuardConfig
-from metrics_tpu.guard.errors import EngineQuarantined
+from metrics_tpu.guard.errors import EngineQuarantined, TenantQuarantined
 from metrics_tpu.guard.plane import GuardPlane
 from metrics_tpu.guard.watchdog import HangDetector, Watchdog
 from metrics_tpu.metric import Metric
@@ -788,6 +788,14 @@ class StreamingEngine:
                     raise EngineQuarantined(
                         "submit() on a quarantined StreamingEngine (dispatcher wedged in a device call)"
                     )
+                if guard is not None and guard.quarantine.is_held(key):
+                    # a migration hold landed between admission and here: refuse
+                    # SYNCHRONOUSLY, or this row would commit on the source
+                    # after the drain barrier exported the tenant — lost state
+                    raise TenantQuarantined(
+                        f"tenant {key!r} is held (migration in flight); "
+                        "reload the partition map and resubmit"
+                    )
                 if self._degraded or self._worker is None:
                     # synchronous per-call dispatch (dispatcher dead or never started)
                     req = _Request(key, self._alloc_slot(key), tuple(args), rows, signature,
@@ -825,6 +833,13 @@ class StreamingEngine:
                         self.telemetry.count("submitted")
                         self._apply_inline(req)
                         return future
+                if guard is not None and guard.quarantine.is_held(key):
+                    # the backpressure wait released the lock — a hold may have
+                    # landed while this request sat out a full queue
+                    raise TenantQuarantined(
+                        f"tenant {key!r} is held (migration in flight); "
+                        "reload the partition map and resubmit"
+                    )
                 req = _Request(key, self._alloc_slot(key), tuple(args), rows, signature,
                                future, t_submit, abs_deadline, priority, t_enqueue, is_probe,
                                ctx, t_admitted)
@@ -867,6 +882,38 @@ class StreamingEngine:
                     if remaining <= 0:
                         raise TimeoutError("StreamingEngine.flush timed out")
                     self._idle.wait(remaining)
+
+    def drain_tenant(self, key: Hashable, timeout: Optional[float] = None) -> None:
+        """Block until no accepted-but-uncommitted request references ``key``.
+
+        The migration barrier: once the caller holds ``key`` (quarantine hold)
+        nothing new for it can be accepted, so waiting out the requests already
+        resident in the arrival queue, the guard backlog, and the active batch
+        is enough — unlike :meth:`flush`, whose whole-engine barrier never
+        clears while neighbouring tenants keep the engine busy, and a live
+        migration must not require a quiet engine.
+
+        ``_idle`` only fires on a FULL drain, so this poll-waits on it: a busy
+        engine still releases per-tenant waiters within one poll interval.
+        """
+        deadline = None if timeout is None else time.monotonic() + timeout
+        backlog = self._guard.backlog if self._guard is not None else None
+        with self._idle:
+            while True:
+                pending = any(req.key == key for req in self._queue)
+                if not pending and self._active_batch is not None:
+                    pending = any(req.key == key for req in self._active_batch)
+                if not pending and backlog is not None and backlog.count:
+                    pending = backlog.pending_for(key) > 0
+                if not pending and self._inflight and self._active_batch is None:
+                    # worker-death / hang-takeover replay: the pending list
+                    # lives off-structure and may hold our key — wait it out
+                    pending = True
+                if not pending:
+                    return
+                if deadline is not None and time.monotonic() >= deadline:
+                    raise TimeoutError(f"drain_tenant({key!r}) timed out")
+                self._idle.wait(0.05)
 
     def compute(self, key: Hashable, *, window: bool = False, sync: bool = False) -> Any:
         """Final metric value for tenant ``key`` (flushes first).
@@ -1537,7 +1584,10 @@ class StreamingEngine:
         (the slot still recycles instead of burning watermark)."""
         self._check_quarantined("evict_tenant")
         self._check_writable("evict_tenant")
-        self.flush()
+        # per-tenant barrier, not flush(): only THIS key's accepted rows must
+        # commit before the retirement record — waiting for the whole engine
+        # to go idle would wedge eviction under sustained neighbour traffic
+        self.drain_tenant(key)
         with self._dispatch_lock:
             keyed = self._keyed
             resident = self._is_resident(key)

@@ -181,6 +181,58 @@ class EngineTelemetry:
         self._latencies = np.zeros(max(8, int(latency_window)), dtype=np.float64)
         self._lat_count = 0
 
+    # ------------------------------------------------------------------ labeling
+
+    def add_labels(self, **labels: str) -> None:
+        """Stamp extra labels onto EVERY series of this engine, in place.
+
+        The partition plane calls this at engine adoption
+        (``partition="p<N>"``) so write-rate/backlog/latency attribution
+        needs no client-side joins — the same contract the shard plane gets
+        by passing ``telemetry_labels={"shard": ...}`` at construction, made
+        retrofittable for engines built before their supervisor existed.
+
+        Counter totals carry over to the relabeled series (cumulative-rate
+        consumers like the autopilot see a rename, not a reset); histogram
+        and quantile history restarts (bucket rows are not relabel-safe to
+        merge). Keys already present with the same value are no-ops; a
+        CONFLICTING value raises — two owners disagreeing about an engine's
+        identity is a wiring bug, not a relabel.
+        """
+        new = {k: str(v) for k, v in labels.items() if self._label.get(k) != str(v)}
+        for key in new:
+            if key in self._label:
+                raise ValueError(
+                    f"telemetry label {key!r} is already {self._label[key]!r}; "
+                    f"refusing to relabel to {new[key]!r} — one engine, one identity"
+                )
+        if not new:
+            return
+        old_label = dict(self._label)
+        old_events = self._events.collect()
+        carried = {
+            name: float(old_events.get(key, 0.0)) for name, key in self._event_keys.items()
+        }
+        carried_resize = float(self._resize_seconds.value(**old_label))
+        for inst in (self._events, self._depth, self._occupancy, self._latency,
+                     self._resize_seconds, self._quantile):
+            inst.drop_labels(**old_label)
+        self._label = {**old_label, **new}
+        self._resize_key = self._resize_seconds.label_key(**self._label)
+        self._resize_seconds.inc_key(self._resize_key, carried_resize)
+        self._event_keys = {
+            name: self._events.label_key(event=name, **self._label) for name in self._allowed
+        }
+        for name, key in self._event_keys.items():
+            self._events.inc_key(key, carried.get(name, 0))
+        self._depth_key = self._depth.label_key(**self._label)
+        self._depth.set_key(self._depth_key, 0)
+        self._occupancy_key = self._occupancy.label_key(**self._label)
+        self._latency_key = self._latency.label_key(**self._label)
+        self._quantile_keys = {
+            q: self._quantile.label_key(quantile=q, **self._label) for q in ("0.5", "0.99")
+        }
+
     # ------------------------------------------------------------------ recording
 
     def register_counter(self, name: str) -> None:

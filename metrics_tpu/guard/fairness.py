@@ -89,6 +89,12 @@ class FairBacklog:
                     newest = stamp
         return newest
 
+    def pending_for(self, key: Hashable) -> int:
+        """Backlogged requests for ONE tenant — the per-tenant drain barrier's
+        probe (:meth:`StreamingEngine.drain_tenant`). O(1)."""
+        q = self._queues.get(key)
+        return len(q) if q else 0
+
     # ------------------------------------------------------------------ selection
 
     def _service_order(self) -> List[Hashable]:
@@ -256,6 +262,11 @@ class FifoBacklog:
 
     def newest_enqueue(self) -> Optional[float]:
         return self._queue[-1].t_enqueue if self._queue else None
+
+    def pending_for(self, key: Hashable) -> int:
+        """Backlogged requests for ONE tenant. O(backlog) here — the FIFO
+        keeps no per-tenant index, and this only runs inside a drain barrier."""
+        return sum(1 for req in self._queue if req.key == key)
 
     def select(
         self,

@@ -132,6 +132,18 @@ class TenantQuarantine:
             if entry is not None:
                 entry.probing = False
 
+    def is_held(self, key: Hashable) -> bool:
+        """True while an administrative :meth:`hold` is in force for ``key``.
+
+        The migration drain barrier's second gate: a submit that passed
+        admission BEFORE the hold landed re-checks here under the engine
+        lock, so no row can slip in behind the source drain."""
+        if not self._entries:
+            return False  # same lock-free hot path as check()
+        with self._lock:
+            entry = self._entries.get(key)
+            return entry is not None and entry.held
+
     def is_quarantined(self, key: Hashable) -> bool:
         with self._lock:
             entry = self._entries.get(key)

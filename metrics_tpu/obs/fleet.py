@@ -150,6 +150,13 @@ class FleetAggregator:
             for node, _, age, stale in self._sweep(self._clock())
         }
 
+    def rows(self) -> List[Tuple[str, Dict[str, Any], float, bool]]:
+        """Live ``(node, snapshot, age_s, stale)`` rows, post-sweep — the
+        consumer-side view (the autopilot's signal source): retired nodes are
+        gone, stale ones are flagged so a reader can exclude rather than
+        extrapolate."""
+        return self._sweep(self._clock())
+
     def retired(self) -> List[str]:
         """Nodes whose series were retired for silence, in retirement order."""
         with self._lock:

@@ -51,6 +51,7 @@ TRIGGERS = (
     "watchdog_restart",
     "election_failed",
     "live_set_shrink",
+    "pilot_action_failed",
 )
 
 

@@ -842,6 +842,70 @@ def set_engine_slab_bytes(engine: str, dtype: str, nbytes: int, shard: str = "")
     ENGINE_SLAB_BYTES.set(nbytes, engine=engine, dtype=dtype, shard=shard)
 
 
+# ---------------------------------------------------------------------- pilot plane
+
+PILOT_DECISIONS = REGISTRY.counter(
+    "metrics_tpu_pilot_decisions_total",
+    "Autopilot reconcile decisions journaled, per node and decision kind "
+    "(partition_hot, rebalance_planned, tier_retune, ...) — flag edges and "
+    "refusals-to-act count too, so a silent controller is visibly deciding "
+    "nothing rather than dead.",
+)
+PILOT_MIGRATIONS = REGISTRY.counter(
+    "metrics_tpu_pilot_migrations_total",
+    "Tenant migrations the autopilot EXECUTED (a subset of "
+    "metrics_tpu_part_migrations_total, which also counts operator-driven "
+    "moves), per node.",
+)
+PILOT_PAUSED = REGISTRY.gauge(
+    "metrics_tpu_pilot_paused",
+    "1 while this node's autopilot actuation is frozen (pause() or "
+    "enabled=False) — the kill switch, scrapeable.",
+)
+
+
+def record_pilot_decision(node: str, kind: str) -> None:
+    if not OBS.enabled:
+        return
+    PILOT_DECISIONS.inc(1, node=node, kind=kind)
+
+
+def record_pilot_migration(node: str) -> None:
+    if not OBS.enabled:
+        return
+    PILOT_MIGRATIONS.inc(1, node=node)
+    FLIGHT.record("pilot_migration", node=node)
+
+
+def set_pilot_paused(node: str, paused: bool) -> None:
+    if not OBS.enabled:
+        return
+    PILOT_PAUSED.set(1 if paused else 0, node=node)
+
+
+def record_pilot_lease_won(node: str, epoch: int) -> None:
+    """This node became the fleet's controller (won the pilot named lease)."""
+    if not OBS.enabled:
+        return
+    FLIGHT.record("pilot_lease_won", node=node, epoch=epoch)
+
+
+def record_pilot_lease_lost(node: str) -> None:
+    if not OBS.enabled:
+        return
+    FLIGHT.record("pilot_lease_lost", node=node)
+
+
+def record_pilot_action_failed(node: str, kind: str) -> None:
+    """An actuator action raised — always a bundle-worthy edge: the journal
+    says what was attempted, the bundle preserves the fleet state it was
+    attempted against."""
+    if not OBS.enabled:
+        return
+    FLIGHT.record("pilot_action_failed", node=node, action=kind)
+    FLIGHT.dump("pilot_action_failed", node=node, action=kind)
+
+
 # ---------------------------------------------------------------------- kernel plane
 
 KERNEL_DISPATCHES = REGISTRY.counter(

@@ -29,7 +29,7 @@ migrated tenant's post-migration writes.
 
 from __future__ import annotations
 
-from typing import Any, Hashable, Mapping
+from typing import Any, Dict, Hashable, Mapping, Union
 
 from metrics_tpu.ckpt import format as ckpt_format
 from metrics_tpu.obs import instrument as _obs
@@ -44,6 +44,60 @@ def _quarantine_of(engine: Any):
     return getattr(guard, "quarantine", None) if guard is not None else None
 
 
+def _checkpoint_engine(engine: Any):
+    """Snapshot WITHOUT ``checkpoint_now()``'s whole-engine flush barrier.
+
+    The migration's durable artifacts — the destination's ``b"P"`` import
+    record and the source's ``b"T"`` retirement record — are WAL-journaled
+    synchronously under the dispatch lock, so ``_checkpoint_view`` taken right
+    after them is consistent and already reflects the move. A full flush here
+    would wait for every NEIGHBOURING tenant's traffic to drain, which never
+    happens on a partition under sustained load. Returns the generation, or
+    ``None`` when checkpointing is off / quarantined / the write failed.
+    """
+    writer = getattr(engine, "_ckpt_writer", None)
+    if writer is None or getattr(engine, "_quarantined", False):
+        return None
+    return writer.checkpoint_sync(engine._checkpoint_view)
+
+
+def _engine_knows(engine: Any, key: Hashable) -> bool:
+    """Whether ``key`` is resident on ``engine`` (slab or any tier) — the
+    same membership test :func:`sweep_partitions` uses, no export needed."""
+    if key in engine._keyed.keys:
+        return True
+    tier = getattr(engine, "_tier", None)
+    return tier is not None and key in set(tier.keys())
+
+
+def _plan_doc(
+    key: Hashable,
+    src_pid: int,
+    dst_pid: int,
+    *,
+    pmap: PartitionMap,
+    src_engine: Any,
+    dst_engine: Any,
+) -> Dict[str, Any]:
+    """The validated migration plan, journal-shaped (what WOULD happen)."""
+    return {
+        "what": "migration_plan",
+        "tenant": repr(key),
+        "src_pid": src_pid,
+        "dst_pid": dst_pid,
+        "src_writable": not getattr(src_engine, "_repl_follower", False),
+        "dst_writable": not getattr(dst_engine, "_repl_follower", False),
+        "tenant_known_to_source": _engine_knows(src_engine, key),
+        "quarantine_hold": _quarantine_of(src_engine) is not None,
+        "dst_checkpointed_first": getattr(dst_engine, "_ckpt_writer", None) is not None,
+        # the floor the commit would record: strictly above the epoch the
+        # handoff would happen under, so no later dst election can promote
+        # at-or-below it
+        "epoch_floor": int(getattr(dst_engine, "_repl_epoch", 0)) + 1,
+        "commit": "manifest" if pmap.directory is not None else "memory",
+    }
+
+
 def migrate_tenant(
     key: Hashable,
     dst_pid: int,
@@ -52,7 +106,8 @@ def migrate_tenant(
     src_engine: Any,
     dst_engine: Any,
     node_id: str = "",
-) -> bool:
+    dry_run: bool = False,
+) -> Union[bool, Dict[str, Any]]:
     """Move tenant ``key`` to partition ``dst_pid``, live and bit-identically.
 
     ``src_engine`` / ``dst_engine`` are the writable *leaders* of the tenant's
@@ -62,19 +117,55 @@ def migrate_tenant(
     Raises :class:`MetricsTPUUserError` if the source does not know the
     tenant. On failure before the map commit, the source hold is released and
     nothing has changed durably.
+
+    ``dry_run=True`` validates the full plan — source/destination
+    writability, tenant residency, quarantine hold availability, the epoch
+    floor the commit would record, and where the routing would commit — and
+    returns it as a dict WITHOUT executing anything (no hold is taken, no
+    state moves). A ``plan["valid"]`` of True means the same call without
+    ``dry_run`` would proceed past every precondition; the autopilot journals
+    exactly this document before acting, and operators get a free "what would
+    move" probe.
     """
     dst_pid = int(dst_pid)
     src_pid = pmap.partition_of(key)
     if src_pid == dst_pid:
+        if dry_run:
+            return {
+                "what": "migration_plan", "tenant": repr(key),
+                "src_pid": src_pid, "dst_pid": dst_pid,
+                "noop": True, "valid": False,
+                "why": "tenant already routes to the destination partition",
+            }
         return False
     pmap.name_of(dst_pid)  # range check before any side effect
+
+    if dry_run:
+        plan = _plan_doc(key, src_pid, dst_pid, pmap=pmap,
+                         src_engine=src_engine, dst_engine=dst_engine)
+        plan["noop"] = False
+        plan["valid"] = bool(
+            plan["src_writable"] and plan["dst_writable"]
+            and plan["tenant_known_to_source"]
+        )
+        if not plan["valid"]:
+            plan["why"] = (
+                "source is not writable" if not plan["src_writable"]
+                else "destination is not writable" if not plan["dst_writable"]
+                else "tenant is unknown to its partition leader"
+            )
+        return plan
 
     quarantine = _quarantine_of(src_engine)
     if quarantine is not None:
         quarantine.hold(key)
     try:
-        # everything accepted so far lands in the exported state
-        src_engine.flush()
+        # everything accepted so far FOR THIS TENANT lands in the exported
+        # state. The hold above stops new rows for the key, so a per-tenant
+        # drain suffices — a whole-engine flush() barrier never clears while
+        # neighbouring tenants keep the source busy, and a live migration
+        # must not require a quiet engine.
+        src_engine.drain_tenant(key)
         entry = src_engine.export_tenant(key, retire=False)
         if entry is None:
             raise MetricsTPUUserError(
@@ -85,7 +176,7 @@ def migrate_tenant(
         blob = ckpt_format.dumps(entry)
         dst_engine.import_tenant(key, ckpt_format.loads(blob).tree)
         if getattr(dst_engine, "_ckpt_writer", None) is not None:
-            if dst_engine.checkpoint_now() is None:
+            if _checkpoint_engine(dst_engine) is None:
                 raise MetricsTPUUserError(
                     f"destination partition p{dst_pid} checkpoint failed — "
                     "migration aborted before the routing commit"
@@ -105,8 +196,7 @@ def migrate_tenant(
     # post-commit: the destination owns the tenant; retire the source copy.
     # A crash in here leaves a routed-away double copy for sweep_partitions.
     src_engine.evict_tenant(key)
-    if getattr(src_engine, "_ckpt_writer", None) is not None:
-        src_engine.checkpoint_now()
+    _checkpoint_engine(src_engine)
     # the hold STAYS on the source: a client still routing on a stale map
     # must refuse loudly (TenantQuarantined -> map reload) rather than
     # silently re-create the evicted tenant at init state. One held entry per

@@ -42,6 +42,8 @@ from typing import Any, Dict, List, Mapping, Optional, Tuple
 from metrics_tpu.cluster.errors import ClusterConfigError, CoordStoreError
 from metrics_tpu.cluster.store import Lease, Member
 from metrics_tpu.obs import instrument as _obs
+from metrics_tpu.obs.fleet import AGGREGATOR, node_snapshot
+from metrics_tpu.obs.registry import OBS as _OBS
 from metrics_tpu.part.config import PartConfig
 from metrics_tpu.part.pmap import PartitionMap
 from metrics_tpu.repl.errors import NotPromotableError
@@ -131,6 +133,12 @@ class PartitionedNode:
             slot = _PartSlot(pid, self.pmap.name_of(pid), role)
             self._slots[pid] = slot
             self._engines[pid]._cluster = self
+            # hot-spot attribution without client-side joins: the adopted
+            # engine's write-rate/backlog/latency series all carry its
+            # partition name from here on (the shard plane's shard= parity)
+            telemetry = getattr(self._engines[pid], "telemetry", None)
+            if telemetry is not None:
+                telemetry.add_labels(partition=slot.name)
             _obs.set_part_role(cfg.node_id, slot.name, role)
 
         self.suspicions = 0
@@ -236,6 +244,15 @@ class PartitionedNode:
         healths = [v[0] for v in views.values()]
         worst = next((h for h in healths if h != "SERVING"), "SERVING")
         lags = [v[2] for v in views.values()]
+        fleet = None
+        if _OBS.enabled:
+            try:
+                # piggyback this node's telemetry snapshot on the membership
+                # record it already publishes (cluster-plane parity) — the
+                # autopilot reads these off the member table to observe
+                fleet = node_snapshot(self.cfg.node_id)
+            except Exception:  # noqa: BLE001 — telemetry must not break membership
+                fleet = None
         member = Member(
             node_id=self.cfg.node_id,
             role="leader" if any(s.role == "leader" for s in self._slots.values()) else "follower",
@@ -244,6 +261,7 @@ class PartitionedNode:
             lag_seqs=-1 if any(l < 0 for l in lags) else max(lags, default=0),
             heartbeat=now,
             parts=parts,
+            fleet=fleet,
         )
         try:
             self._store.heartbeat(member)
@@ -257,6 +275,11 @@ class PartitionedNode:
         except CoordStoreError as exc:
             self.last_error = exc
             return
+        if _OBS.enabled and any(s.role == "leader" for s in self._slots.values()):
+            # any partition leader is a fleet merge point (cluster-plane
+            # parity): fold peers' piggybacked snapshots off the member table
+            # this pass already fetched — zero extra store IO
+            AGGREGATOR.ingest_members(members.values())
         for peer in self.cfg.peers:
             rec = members.get(peer)
             silent = now - rec.heartbeat if rec is not None else float("inf")

@@ -167,3 +167,115 @@ class TestMigrate:
         assert float(dst.compute(key)) == val
         # a consistent layout sweeps to nothing
         assert sweep_partitions(pmap, {0: src, 1: dst}) == 0
+
+    def test_migrates_while_a_sibling_tenant_keeps_the_source_busy(self, rig):
+        """The migration barrier is per-tenant, not per-engine: a sustained
+        storm on a NEIGHBOURING tenant must not livelock the drain (a full
+        ``flush()`` here would wait for a quiet engine that never comes)."""
+        import threading
+
+        pmap, src, dst = rig
+        key = _key_on_partition(pmap, 0)
+        sibling = next(
+            k for i in range(1000)
+            if pmap.partition_of(k := f"noisy-{i}") == 0 and k != key
+        )
+        _feed(src, key)
+        val = float(src.compute(key))
+
+        stop = threading.Event()
+
+        def storm():
+            while not stop.is_set():
+                src.submit(sibling, np.array([1.0]))
+
+        feeder = threading.Thread(target=storm, daemon=True)
+        feeder.start()
+        try:
+            assert migrate_tenant(key, 1, pmap=pmap, src_engine=src,
+                                  dst_engine=dst)
+        finally:
+            stop.set()
+            feeder.join(timeout=10.0)
+        assert pmap.partition_of(key) == 1
+        assert float(dst.compute(key)) == val
+        assert key not in list(src._keyed.keys)
+        # the noisy neighbour was never disturbed
+        src.flush()
+        assert float(src.compute(sibling)) > 0.0
+
+
+class TestDryRun:
+    """``migrate_tenant(dry_run=True)``: the full plan, validated, executed
+    never — the pilot planner's probe and the operator's free what-would-move."""
+
+    def test_valid_plan_and_nothing_moves(self, rig):
+        pmap, src, dst = rig
+        key = _key_on_partition(pmap, 0)
+        _feed(src, key)
+        val = float(src.compute(key))
+
+        plan = migrate_tenant(key, 1, pmap=pmap, src_engine=src, dst_engine=dst,
+                              dry_run=True)
+        assert plan["valid"] is True and plan["noop"] is False
+        assert plan["src_pid"] == 0 and plan["dst_pid"] == 1
+        assert plan["tenant_known_to_source"] is True
+        assert plan["quarantine_hold"] is True  # src was built with guard=
+        assert plan["dst_checkpointed_first"] is True
+        # the floor the real commit would record: strictly above the current
+        # destination epoch
+        assert plan["epoch_floor"] == int(getattr(dst, "_repl_epoch", 0)) + 1
+        assert plan["commit"] == "manifest"
+
+        # NOTHING executed: routing, residency, and writability all unchanged
+        assert pmap.partition_of(key) == 0
+        assert key in list(src._keyed.keys)
+        assert key not in list(dst._keyed.keys)
+        assert float(src.compute(key)) == val
+        # no hold was taken — the source keeps serving the tenant
+        src.submit(key, np.array([1.0]))
+        src.flush()
+
+        # ...and the same call without dry_run proceeds exactly as planned
+        assert migrate_tenant(key, 1, pmap=pmap, src_engine=src, dst_engine=dst)
+        assert pmap.partition_of(key) == 1
+        assert pmap.epoch_floor(1) == plan["epoch_floor"]
+
+    def test_unknown_tenant_invalid_not_raising(self, rig):
+        pmap, src, dst = rig
+        key = _key_on_partition(pmap, 0)
+        plan = migrate_tenant(key, 1, pmap=pmap, src_engine=src, dst_engine=dst,
+                              dry_run=True)
+        assert plan["valid"] is False
+        assert plan["tenant_known_to_source"] is False
+        assert "unknown" in plan["why"]
+
+    def test_same_partition_plan_is_noop(self, rig):
+        pmap, src, dst = rig
+        key = _key_on_partition(pmap, 0)
+        _feed(src, key)
+        plan = migrate_tenant(key, 0, pmap=pmap, src_engine=src, dst_engine=dst,
+                              dry_run=True)
+        assert plan["noop"] is True and plan["valid"] is False
+
+    def test_out_of_range_destination_still_raises(self, rig):
+        pmap, src, dst = rig
+        key = _key_on_partition(pmap, 0)
+        _feed(src, key)
+        with pytest.raises(MetricsTPUUserError, match="out of range"):
+            migrate_tenant(key, 9, pmap=pmap, src_engine=src, dst_engine=dst,
+                           dry_run=True)
+
+    def test_follower_destination_invalid(self, rig):
+        pmap, src, dst = rig
+        key = _key_on_partition(pmap, 0)
+        _feed(src, key)
+        dst._repl_follower = True
+        try:
+            plan = migrate_tenant(key, 1, pmap=pmap, src_engine=src,
+                                  dst_engine=dst, dry_run=True)
+        finally:
+            dst._repl_follower = False
+        assert plan["valid"] is False
+        assert plan["dst_writable"] is False
+        assert "destination" in plan["why"]

@@ -1,0 +1,122 @@
+"""Shared autopilot rig: one PartitionedNode of real engines under a
+ManualClock'd FakeCoordStore, a FleetAggregator on the same clock, and
+snapshot-crafting helpers so signal tests control wall time exactly.
+
+The pilot's signal source is crafted fleet snapshots ingested under worker
+node ids; the pilot's OWN self-snapshot (real registry, real wall clock)
+rides along under its own node id and contributes ~zero rate — latest-wins
+per node keeps the two from colliding, so every test is deterministic in
+store/aggregator time with zero sleeps."""
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+import pytest
+
+from metrics_tpu.aggregation import SumMetric
+from metrics_tpu.cluster import FakeCoordStore, ManualClock
+from metrics_tpu.engine import StreamingEngine
+from metrics_tpu.obs.fleet import SNAPSHOT_KIND, SNAPSHOT_VERSION, FleetAggregator
+from metrics_tpu.part import PartConfig, PartitionedNode
+
+P = 4
+
+
+def make_snapshot(
+    node: str,
+    t_wall: float,
+    *,
+    submitted: Optional[Dict[str, float]] = None,
+    depth: Optional[Dict[str, float]] = None,
+    p99: Optional[Dict[str, float]] = None,
+    tier_hot: Optional[Dict[str, float]] = None,
+) -> Dict[str, Any]:
+    """A hand-built node_snapshot document with exact values and wall time."""
+    families: Dict[str, Any] = {}
+    if submitted:
+        families["metrics_tpu_engine_events_total"] = {
+            "type": "counter", "help": "", "samples": [
+                [[["engine", "9"], ["partition", part], ["event", "submitted"]], v]
+                for part, v in submitted.items()
+            ],
+        }
+    if depth:
+        families["metrics_tpu_engine_queue_depth"] = {
+            "type": "gauge", "help": "", "samples": [
+                [[["engine", "9"], ["partition", part]], v] for part, v in depth.items()
+            ],
+        }
+    if p99:
+        families["metrics_tpu_engine_latency_quantile_seconds"] = {
+            "type": "gauge", "help": "", "samples": [
+                [[["engine", "9"], ["partition", part], ["quantile", "0.99"]], v]
+                for part, v in p99.items()
+            ],
+        }
+    if tier_hot:
+        families["metrics_tpu_tier_residency"] = {
+            "type": "gauge", "help": "", "samples": [
+                [[["engine", eid], ["tier", "hot"]], v] for eid, v in tier_hot.items()
+            ],
+        }
+    return {
+        "kind": SNAPSHOT_KIND,
+        "version": SNAPSHOT_VERSION,
+        "node": node,
+        "t_wall": float(t_wall),
+        "families": families,
+    }
+
+
+class PilotRig:
+    """One host leading all P partitions, plus the pilot's clockwork."""
+
+    def __init__(self, tmp_path, node_id: str = "a"):
+        self.clock = ManualClock(0.0)
+        self.store = FakeCoordStore(clock=self.clock)
+        self.aggregator = FleetAggregator(
+            stale_after_s=10.0, retire_after_s=600.0, clock=self.clock
+        )
+        self.engines = {pid: StreamingEngine(SumMetric()) for pid in range(P)}
+        self.node = PartitionedNode(
+            self.engines,
+            PartConfig(node_id=node_id, peers=(), store=self.store, partitions=P,
+                       seed=7, lease_ttl_s=30.0, heartbeat_interval_s=1.0,
+                       rng_seed=1),
+            start=False,
+        )
+        for _ in range(12):  # election backoff gates candidacy per partition
+            self.node.tick()
+            if len(self.node.owned()) == P:
+                break
+            self.clock.advance(0.5)
+        assert self.node.owned() == tuple(range(P))
+
+    def keys_on(self, pid: int, n: int) -> List[str]:
+        out = []
+        for i in range(5000):
+            key = f"tenant-{i}"
+            if self.node.pmap.partition_of(key) == pid:
+                out.append(key)
+                if len(out) == n:
+                    return out
+        raise AssertionError(f"not enough keys hashing to p{pid}")
+
+    def feed(self, pid: int, keys, reps: int = 1):
+        one = np.asarray([1.0])
+        for key in keys:
+            for _ in range(reps):
+                self.engines[pid].submit(key, one)
+        self.engines[pid].flush()
+
+    def close(self):
+        self.node.close(release=False)
+        for eng in self.engines.values():
+            eng.close()
+
+
+@pytest.fixture
+def rig(tmp_path):
+    r = PilotRig(tmp_path)
+    yield r
+    r.close()

@@ -1,0 +1,150 @@
+"""Actuator gates: budget windows, cooldowns, locality, dry-run, failure edges."""
+
+import dataclasses
+
+import pytest
+
+from metrics_tpu.pilot import Actuator, MigrateTenant, PilotConfig, ResizeShards, RetuneTier
+from metrics_tpu.tier.config import TierConfig
+
+from tests.pilot.conftest import PilotRig
+
+
+@pytest.fixture
+def rig(tmp_path):
+    r = PilotRig(tmp_path)
+    yield r
+    r.close()
+
+
+def make_actuator(rig, sharded=None, **kw):
+    cfg = PilotConfig(node_id="a", store=rig.store, **kw)
+    return Actuator(cfg, rig.node, sharded=sharded)
+
+
+def other_pid(rig, key):
+    return (rig.node.pmap.partition_of(key) + 1) % 4
+
+
+class TestMigrationGates:
+    def test_budget_window_refuses_then_slides_open(self, rig):
+        act = make_actuator(rig, migration_budget=2, budget_window_s=10.0)
+        keys = rig.keys_on(0, 3)
+        rig.feed(0, keys)
+        plan = [MigrateTenant(k, 0, 1) for k in keys]
+        outcomes = act.execute(plan, now=100.0)
+        assert [o["outcome"] for o in outcomes] == ["ok", "ok", "refused_budget"]
+        assert act.executed == 2 and act.refused == 1
+        assert act.budget_left(100.0) == 0
+        # the window slid past both stamps: budget is whole again
+        assert act.budget_left(111.0) == 2
+        outcomes = act.execute([MigrateTenant(keys[2], 0, 1)], now=111.0)
+        assert outcomes[0]["outcome"] == "ok"
+
+    def test_tenant_cooldown_blocks_rapid_retouch(self, rig):
+        act = make_actuator(rig, tenant_cooldown_s=30.0, migration_budget=8)
+        (key,) = rig.keys_on(0, 1)
+        rig.feed(0, [key])
+        assert act.execute([MigrateTenant(key, 0, 1)], now=0.0)[0]["outcome"] == "ok"
+        out = act.execute([MigrateTenant(key, 1, 2)], now=5.0)[0]
+        assert out["outcome"] == "refused_cooldown"
+        # past the cooldown the tenant is movable again
+        out = act.execute([MigrateTenant(key, 1, 2)], now=31.0)[0]
+        assert out["outcome"] == "ok"
+        assert rig.node.pmap.partition_of(key) == 2
+
+    def test_not_local_when_either_engine_is_a_follower(self, rig):
+        act = make_actuator(rig)
+        (key,) = rig.keys_on(0, 1)
+        rig.feed(0, [key])
+        rig.engines[1]._repl_follower = True
+        try:
+            out = act.execute([MigrateTenant(key, 0, 1)], now=0.0)[0]
+            assert out["outcome"] == "not_local"
+            assert out["src_writable"] and not out["dst_writable"]
+            assert act.refused == 1 and act.executed == 0
+            # a refused-for-locality action charges neither budget nor cooldown
+            assert act.budget_left(0.0) == act.cfg.migration_budget
+        finally:
+            rig.engines[1]._repl_follower = False
+
+    def test_dry_run_journals_the_validated_plan_and_moves_nothing(self, rig):
+        act = make_actuator(rig, dry_run=True)
+        (key,) = rig.keys_on(0, 1)
+        rig.feed(0, [key])
+        out = act.execute([MigrateTenant(key, 0, 1)], now=0.0)[0]
+        assert out["outcome"] == "dry_run"
+        assert out["plan"]["valid"] is True
+        assert out["plan"]["tenant_known_to_source"] is True
+        assert rig.node.pmap.partition_of(key) == 0  # nothing moved
+        assert key in rig.engines[0]._keyed.keys
+        assert act.executed == 0
+
+    def test_unknown_tenant_is_a_counted_failure_not_a_crash(self, rig):
+        act = make_actuator(rig)
+        key = rig.keys_on(0, 1)[0]  # never fed: unknown to its leader
+        out = act.execute([MigrateTenant(key, 0, 1)], now=0.0)[0]
+        assert out["outcome"] == "error"
+        assert "unknown" in out["error"]
+        assert act.failures == 1 and act.executed == 0
+        # failed attempts still charge the budget: an error storm is
+        # rate-limited exactly like a success storm
+        assert act.budget_left(0.0) == act.cfg.migration_budget - 1
+
+
+class TestRetuneAndResize:
+    def test_retune_without_a_tier_is_refused(self, rig):
+        act = make_actuator(rig)
+        out = act.execute([RetuneTier(pid=0, hot_capacity=64)], now=0.0)[0]
+        assert out["outcome"] == "no_tier"
+        assert act.refused == 1
+
+    def test_retune_replaces_the_frozen_config(self, rig):
+        class FakeTier:
+            cfg = TierConfig(hot_capacity=8)
+
+        rig.engines[2]._tier = FakeTier()
+        try:
+            act = make_actuator(rig)
+            out = act.execute([RetuneTier(pid=2, hot_capacity=16)], now=0.0)[0]
+            assert out == {"kind": "retune_tier", "pid": 2, "hot_capacity": 16,
+                           "outcome": "ok", "was": 8}
+            assert rig.engines[2]._tier.cfg.hot_capacity == 16
+            assert dataclasses.is_dataclass(rig.engines[2]._tier.cfg)
+        finally:
+            del rig.engines[2]._tier
+
+    def test_retune_dry_run(self, rig):
+        class FakeTier:
+            cfg = TierConfig(hot_capacity=8)
+
+        rig.engines[2]._tier = FakeTier()
+        try:
+            act = make_actuator(rig, dry_run=True)
+            out = act.execute([RetuneTier(pid=2, hot_capacity=16)], now=0.0)[0]
+            assert out["outcome"] == "dry_run"
+            assert rig.engines[2]._tier.cfg.hot_capacity == 8
+        finally:
+            del rig.engines[2]._tier
+
+    def test_resize_without_a_sharded_engine_is_refused(self, rig):
+        act = make_actuator(rig)
+        out = act.execute([ResizeShards(new_shards=8)], now=0.0)[0]
+        assert out["outcome"] == "no_sharded"
+        assert act.refused == 1
+
+    def test_resize_reports_moved_tenants(self, rig):
+        class FakeSharded:
+            _engines = [object(), object()]
+            resized_to = None
+
+            def resize(self, n):
+                self.resized_to = n
+                return {"k1": (0, 2), "k2": (1, 3)}
+
+        sharded = FakeSharded()
+        act = make_actuator(rig, sharded=sharded)
+        out = act.execute([ResizeShards(new_shards=4)], now=0.0)[0]
+        assert out["outcome"] == "ok" and out["tenants_moved"] == 2
+        assert sharded.resized_to == 4
+        assert act.executed == 1

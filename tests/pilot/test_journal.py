@@ -1,0 +1,89 @@
+"""Decision journal: CRC framing, torn tails, sequence resume."""
+
+import os
+import zlib
+
+import pytest
+
+from metrics_tpu.pilot import DecisionJournal, read_journal
+from metrics_tpu.pilot.journal import _CRC
+
+
+def test_roundtrip_in_order(tmp_path):
+    journal = DecisionJournal(str(tmp_path))
+    for i in range(5):
+        seq = journal.append({"t": float(i), "decisions": [{"what": "noop", "i": i}]})
+        assert seq == i
+    docs = read_journal(str(tmp_path))
+    assert [d["seq"] for d in docs] == [0, 1, 2, 3, 4]
+    assert [d["t"] for d in docs] == [0.0, 1.0, 2.0, 3.0, 4.0]
+    assert docs[3]["decisions"] == [{"what": "noop", "i": 3}]
+
+
+def test_limit_and_missing_file(tmp_path):
+    assert read_journal(str(tmp_path)) == []
+    journal = DecisionJournal(str(tmp_path))
+    for i in range(4):
+        journal.append({"i": i})
+    assert [d["i"] for d in read_journal(str(tmp_path), limit=2)] == [0, 1]
+
+
+def test_torn_tail_is_dropped(tmp_path):
+    journal = DecisionJournal(str(tmp_path))
+    for i in range(3):
+        journal.append({"i": i})
+    size = os.path.getsize(journal.path)
+    # simulate a crash mid-append: truncate inside the final record
+    with open(journal.path, "r+b") as fh:
+        fh.truncate(size - 3)
+    docs = read_journal(str(tmp_path))
+    assert [d["i"] for d in docs] == [0, 1]
+
+
+def test_corrupt_payload_ends_the_read(tmp_path):
+    journal = DecisionJournal(str(tmp_path))
+    for i in range(3):
+        journal.append({"i": i})
+    with open(journal.path, "rb") as fh:
+        data = bytearray(fh.read())
+    # flip one byte inside the SECOND record's payload
+    length0, _ = _CRC.unpack_from(data, 0)
+    second_payload = _CRC.size + length0 + _CRC.size
+    data[second_payload] ^= 0xFF
+    with open(journal.path, "wb") as fh:
+        fh.write(bytes(data))
+    docs = read_journal(str(tmp_path))
+    assert [d["i"] for d in docs] == [0]
+    assert zlib.crc32(b"") == 0  # sanity: zlib present
+
+
+def test_sequence_resumes_across_instances(tmp_path):
+    first = DecisionJournal(str(tmp_path))
+    assert first.append({"node": "a"}) == 0
+    assert first.append({"node": "a"}) == 1
+    # the pilot lease moved: a new journal over the same directory continues
+    second = DecisionJournal(str(tmp_path))
+    assert second.append({"node": "b"}) == 2
+    docs = read_journal(str(tmp_path))
+    assert [(d["seq"], d["node"]) for d in docs] == [(0, "a"), (1, "a"), (2, "b")]
+
+
+def test_resume_truncates_a_torn_tail_so_new_appends_are_readable(tmp_path):
+    journal = DecisionJournal(str(tmp_path))
+    for i in range(3):
+        journal.append({"i": i})
+    with open(journal.path, "r+b") as fh:
+        fh.truncate(os.path.getsize(journal.path) - 3)  # crash mid-append
+    # the failover journal must not append BEHIND the torn frame — records
+    # after an un-truncated tear would be unreachable forever
+    survivor = DecisionJournal(str(tmp_path))
+    assert survivor.append({"i": "post-crash"}) == 2
+    docs = read_journal(str(tmp_path))
+    assert [d["i"] for d in docs] == [0, 1, "post-crash"]
+
+
+def test_unserializable_values_fall_back_to_repr(tmp_path):
+    journal = DecisionJournal(str(tmp_path))
+    journal.append({"key": ("tenant", 7), "obj": object()})
+    (doc,) = read_journal(str(tmp_path))
+    assert "object object" in doc["obj"]

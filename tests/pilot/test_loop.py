@@ -1,0 +1,197 @@
+"""AutoPilot: lease-fenced cycles, failover, kill switch, journaled reconcile."""
+
+import pytest
+
+from metrics_tpu.pilot import PILOT_LEASE, AutoPilot, PilotConfig, read_journal
+
+from tests.pilot.conftest import PilotRig, make_snapshot
+
+
+@pytest.fixture
+def rig(tmp_path):
+    r = PilotRig(tmp_path)
+    yield r
+    r.close()
+
+
+def make_pilot(rig, node_id="a", **kw):
+    kw.setdefault("ewma_alpha", 1.0)
+    kw.setdefault("evaluate_interval_s", 1.0)
+    kw.setdefault("lease_ttl_s", 3.0)
+    kw.setdefault("migration_budget", 8)
+    cfg = PilotConfig(node_id=node_id, store=rig.store, **kw)
+    return AutoPilot(rig.node, cfg, aggregator=rig.aggregator, start=False)
+
+
+def storm(rig, pilot, t0=1000.0, hot="p0", cycles=3, rate=600.0):
+    """Feed crafted worker snapshots that make one partition run hot, ticking
+    the pilot once per snapshot. Depth samples seed the readings so the
+    partitions mature on schedule (rates need two stamps)."""
+    quiet = {p: 10.0 for p in ("p0", "p1", "p2", "p3")}
+    for i in range(cycles):
+        submitted = {p: i * v for p, v in quiet.items()}
+        submitted[hot] = i * rate
+        rig.aggregator.ingest(make_snapshot(
+            "worker", t0 + i, submitted=submitted, depth={p: 0.0 for p in quiet},
+        ))
+        pilot.tick()
+        rig.clock.advance(1.5)
+
+
+class TestLease:
+    def test_holder_cycles_standby_waits(self, rig):
+        a = make_pilot(rig, "a")
+        b = make_pilot(rig, "b")
+        a.tick()
+        b.tick()
+        assert a.role == "pilot" and b.role == "standby"
+        assert a.cycles == 1 and b.cycles == 0
+        assert a.health()["lease_epoch"] is not None
+        assert b.health()["lease_epoch"] is None
+        a.close(release=False)
+        b.close(release=False)
+
+    def test_evaluate_interval_gates_cycles_not_renewal(self, rig):
+        a = make_pilot(rig, "a", evaluate_interval_s=5.0)
+        a.tick()
+        rig.clock.advance(2.0)
+        a.tick()  # renews the lease but is inside the evaluate interval
+        assert a.cycles == 1
+        assert a.role == "pilot"
+        rig.clock.advance(4.0)
+        a.tick()
+        assert a.cycles == 2
+        a.close(release=False)
+
+    def test_released_lease_fails_over_immediately(self, rig):
+        a = make_pilot(rig, "a")
+        b = make_pilot(rig, "b")
+        a.tick()
+        b.tick()
+        a.close(release=True)  # clean shutdown concedes
+        b.tick()
+        assert b.role == "pilot" and b.cycles == 1
+        b.close(release=False)
+
+    def test_dead_holder_fails_over_within_one_ttl(self, rig):
+        a = make_pilot(rig, "a", lease_ttl_s=3.0)
+        b = make_pilot(rig, "b", lease_ttl_s=3.0)
+        a.tick()
+        b.tick()
+        assert b.role == "standby"
+        rig.clock.advance(4.0)  # "a" dies silently; its lease runs out
+        b.tick()
+        assert b.role == "pilot"
+        assert a.role == "standby"  # a's lease view expired too
+        a.close(release=False)
+        b.close(release=False)
+
+    def test_disabled_pilot_is_inert(self, rig):
+        a = make_pilot(rig, "a", enabled=False)
+        a.tick()
+        assert a.cycles == 0 and a.role == "standby"
+        assert a.health()["enabled"] is False
+        # the lease was never touched: another pilot takes it instantly
+        b = make_pilot(rig, "b")
+        b.tick()
+        assert b.role == "pilot"
+        a.close(release=False)
+        b.close(release=False)
+
+
+class TestKillSwitch:
+    def test_pause_keeps_lease_stops_actions(self, rig, tmp_path):
+        journal_dir = str(tmp_path / "journal")
+        pilot = make_pilot(rig, journal_directory=journal_dir)
+        rig.feed(0, rig.keys_on(0, 8))
+        pilot.pause()
+        storm(rig, pilot)
+        assert pilot.role == "pilot"  # paused ≠ conceded
+        assert pilot.health()["paused"] is True
+        assert pilot.actuator.executed == 0
+        records = read_journal(journal_dir)
+        assert len(records) == 3
+        assert all(r["paused"] for r in records)
+        assert all(r["decisions"] == [{"what": "paused"}] for r in records)
+
+        pilot.resume()
+        assert pilot.health()["paused"] is False
+        storm(rig, pilot, t0=2000.0)  # traffic continues; now the pilot acts
+        assert pilot.actuator.executed > 0
+        pilot.close(release=False)
+
+    def test_dry_run_validates_but_never_moves(self, rig, tmp_path):
+        journal_dir = str(tmp_path / "journal")
+        pilot = make_pilot(rig, dry_run=True, journal_directory=journal_dir)
+        keys = rig.keys_on(0, 8)
+        rig.feed(0, keys)
+        storm(rig, pilot)
+        outcomes = [o for r in read_journal(journal_dir) for o in r["outcomes"]]
+        dry = [o for o in outcomes if o["outcome"] == "dry_run"]
+        assert dry and all(o["plan"]["valid"] for o in dry)
+        assert pilot.actuator.executed == 0
+        assert all(rig.node.pmap.partition_of(k) == 0 for k in keys)
+        pilot.close(release=False)
+
+
+class TestReconcile:
+    def test_storm_is_detected_and_rebalanced(self, rig, tmp_path):
+        journal_dir = str(tmp_path / "journal")
+        pilot = make_pilot(rig, journal_directory=journal_dir)
+        keys = rig.keys_on(0, 8)
+        rig.feed(0, keys)
+        # two cycles: one to mature the readings, one to detect + rebalance
+        storm(rig, pilot, cycles=2)
+
+        assert "p0" in pilot.policy.hot
+        moved = [k for k in keys if rig.node.pmap.partition_of(k) != 0]
+        # fair share keeps 2 of 8 home (4 mature partitions); the rest move
+        assert len(moved) == 6
+        for key in moved:
+            dst = rig.node.pmap.partition_of(key)
+            assert key in rig.engines[dst]._keyed.keys
+            assert key not in rig.engines[0]._keyed.keys
+        assert pilot.actuator.executed == 6
+        assert pilot.health()["hot_partitions"] == ["p0"]
+        pilot.close(release=False)
+
+        # ---- post-mortem from the journal ALONE: which tenants moved where,
+        # and what the pilot saw when it decided
+        records = read_journal(journal_dir)
+        assert [r["seq"] for r in records] == list(range(len(records)))
+        hot_edges = [d for r in records for d in r["decisions"]
+                     if d["what"] == "partition_hot"]
+        assert hot_edges and hot_edges[0]["partition"] == "p0"
+        assert hot_edges[0]["rate"] > hot_edges[0]["fleet_mean"]
+        journaled_moves = {
+            (o["tenant"], o["src_pid"], o["dst_pid"])
+            for r in records for o in r["outcomes"] if o["outcome"] == "ok"
+        }
+        assert journaled_moves == {
+            (repr(k), 0, rig.node.pmap.partition_of(k)) for k in moved
+        }
+        # every record carries the observations that justified it
+        assert all("observations" in r and r["lease_epoch"] is not None
+                   for r in records)
+
+    def test_stale_workers_are_excluded_not_guessed(self, rig):
+        pilot = make_pilot(rig)
+        rig.aggregator.ingest(make_snapshot(
+            "lagger", 500.0, submitted={"p1": 0.0}, depth={"p1": 999.0}))
+        rig.clock.advance(60.0)  # past stale_after_s=10: lagger goes stale
+        pilot.tick()
+        assert "lagger" in pilot.signals.excluded_stale
+        assert pilot.health()["excluded_stale"] == ["lagger"]
+        assert pilot.signals.backlog_total == pytest.approx(0.0)
+        pilot.close(release=False)
+
+    def test_journal_seq_survives_failover(self, rig, tmp_path):
+        journal_dir = str(tmp_path / "journal")
+        a = make_pilot(rig, "a", journal_directory=journal_dir)
+        a.tick()
+        a.close(release=True)
+        b = make_pilot(rig, "b", journal_directory=journal_dir)
+        b.tick()
+        b.close(release=False)
+        records = read_journal(journal_dir)
+        assert [(r["seq"], r["node"]) for r in records] == [(0, "a"), (1, "b")]

@@ -1,0 +1,222 @@
+"""Policy: hysteresis bands, fair-share rebalance plans, grow-only retunes."""
+
+import pytest
+
+from metrics_tpu.cluster import FakeCoordStore, ManualClock
+from metrics_tpu.cluster.errors import ClusterConfigError
+from metrics_tpu.pilot import (
+    MigrateTenant, PilotConfig, Policy, Reading, ResizeShards, RetuneTier,
+)
+
+PART_OF = {"p0": 0, "p1": 1, "p2": 2, "p3": 3}
+
+
+def make_cfg(**kw):
+    store = FakeCoordStore(clock=ManualClock(0.0))
+    return PilotConfig(node_id="a", store=store, **kw)
+
+
+def readings(rates, observations=2):
+    return {p: Reading(rate=r, observations=observations) for p, r in rates.items()}
+
+
+def whats(decisions):
+    return [d["what"] for d in decisions]
+
+
+class TestConfigValidation:
+    def test_band_gap_required(self):
+        with pytest.raises(ClusterConfigError, match="hysteresis gap"):
+            make_cfg(hot_ratio_high=1.5, hot_ratio_low=1.5)
+
+    def test_hot_ratio_low_floor(self):
+        with pytest.raises(ClusterConfigError, match="fleet mean"):
+            make_cfg(hot_ratio_high=1.2, hot_ratio_low=0.5)
+
+    def test_alpha_range(self):
+        with pytest.raises(ClusterConfigError, match="ewma_alpha"):
+            make_cfg(ewma_alpha=0.0)
+
+
+class TestHotBand:
+    def test_flags_above_high_and_holds_between_bands(self):
+        policy = Policy(make_cfg())  # high=2.0, low=1.25
+        r = readings({"p0": 100.0, "p1": 10.0, "p2": 10.0, "p3": 10.0})
+        decisions, _ = policy.plan(r, partition_of=PART_OF, owned=(),
+                                   tenants_of={}, tier_view={})
+        assert policy.hot == ("p0",)  # ratio 100/32.5 ≈ 3.1 >= 2.0
+        assert "partition_hot" in whats(decisions)
+
+        # cooled to 1.6x the mean: inside the band — flag holds, no new edge
+        r = readings({"p0": 52.0, "p1": 26.0, "p2": 26.0, "p3": 26.0})
+        decisions, _ = policy.plan(r, partition_of=PART_OF, owned=(),
+                                   tenants_of={}, tier_view={})
+        assert policy.hot == ("p0",)
+        assert "partition_hot" not in whats(decisions)
+        assert "partition_cooled" not in whats(decisions)
+
+        # under the low edge: unflag
+        r = readings({"p0": 30.0, "p1": 26.0, "p2": 26.0, "p3": 26.0})
+        decisions, _ = policy.plan(r, partition_of=PART_OF, owned=(),
+                                   tenants_of={}, tier_view={})
+        assert policy.hot == ()
+        assert "partition_cooled" in whats(decisions)
+
+    def test_immature_partitions_are_not_actionable(self):
+        policy = Policy(make_cfg(min_observations=3))
+        r = readings({"p0": 100.0, "p1": 1.0}, observations=2)
+        decisions, actions = policy.plan(r, partition_of=PART_OF, owned=(0,),
+                                         tenants_of={0: ["t"]}, tier_view={})
+        assert policy.hot == ()
+        assert decisions == [] and actions == []
+
+    def test_unlabeled_partitions_are_ignored(self):
+        policy = Policy(make_cfg())
+        r = readings({"p0": 100.0, "mystery": 1.0, "p1": 0.0})
+        policy.plan(r, partition_of=PART_OF, owned=(),
+                    tenants_of={}, tier_view={})
+        assert policy.hot == ("p0",)
+
+    def test_idle_fleet_clears_every_flag(self):
+        policy = Policy(make_cfg(min_rate=5.0))
+        r = readings({"p0": 100.0, "p1": 1.0, "p2": 1.0, "p3": 1.0})
+        policy.plan(r, partition_of=PART_OF, owned=(), tenants_of={},
+                    tier_view={})
+        assert policy.hot == ("p0",)
+        r = readings({"p0": 0.5, "p1": 0.0, "p2": 0.0, "p3": 0.0})
+        decisions, _ = policy.plan(r, partition_of=PART_OF, owned=(),
+                                   tenants_of={}, tier_view={})
+        assert policy.hot == ()
+        assert whats(decisions) == ["partition_cooled"]
+
+
+class TestRebalancePlan:
+    def test_fair_share_moves_round_robin_to_coldest(self):
+        policy = Policy(make_cfg())
+        r = readings({"p0": 100.0, "p1": 5.0, "p2": 1.0, "p3": 3.0})
+        tenants = [f"t{i}" for i in range(8)]
+        decisions, actions = policy.plan(
+            r, partition_of=PART_OF, owned=(0, 1, 2, 3),
+            tenants_of={0: tenants}, tier_view={},
+        )
+        # fair share = 8 tenants // 4 mature partitions = 2 stay home
+        assert [d for d in decisions if d["what"] == "rebalance_planned"][0][
+            "fair_share"] == 2
+        assert all(isinstance(a, MigrateTenant) for a in actions)
+        assert [a.key for a in actions] == tenants[2:]
+        # destinations cycle the cold list coldest-first: p2 (1.0) then p3, p1
+        assert [a.dst_pid for a in actions] == [2, 3, 1, 2, 3, 1]
+        assert all(a.src_pid == 0 for a in actions)
+
+    def test_hot_but_not_local_plans_nothing(self):
+        policy = Policy(make_cfg())
+        r = readings({"p0": 100.0, "p1": 1.0, "p2": 1.0, "p3": 1.0})
+        decisions, actions = policy.plan(
+            r, partition_of=PART_OF, owned=(1, 2, 3),
+            tenants_of={1: ["x"]}, tier_view={},
+        )
+        assert actions == []
+        assert "hot_but_not_local" in whats(decisions)
+
+    def test_nothing_to_move_at_or_under_fair_share(self):
+        policy = Policy(make_cfg())
+        r = readings({"p0": 100.0, "p1": 1.0, "p2": 1.0, "p3": 1.0})
+        decisions, actions = policy.plan(
+            r, partition_of=PART_OF, owned=(0,),
+            tenants_of={0: ["only"]}, tier_view={},
+        )
+        assert actions == []
+        assert "nothing_to_move" in whats(decisions)
+
+    def test_no_cold_destination(self):
+        # min_rate=0 keeps a prior flag alive through a cycle where nothing
+        # is mature — and with no mature partitions there is nowhere to move
+        policy = Policy(make_cfg(min_rate=0.0))
+        policy._hot.add("p0")
+        decisions, actions = policy.plan(
+            {}, partition_of=PART_OF, owned=(0,),
+            tenants_of={0: ["a", "b"]}, tier_view={},
+        )
+        assert actions == []
+        assert whats(decisions) == ["no_cold_destination"]
+
+    def test_per_cycle_action_cap(self):
+        policy = Policy(make_cfg(max_actions_per_cycle=3))
+        r = readings({"p0": 100.0, "p1": 1.0, "p2": 1.0, "p3": 1.0})
+        decisions, actions = policy.plan(
+            r, partition_of=PART_OF, owned=(0,),
+            tenants_of={0: [f"t{i}" for i in range(40)]}, tier_view={},
+        )
+        assert len(actions) == 3
+        assert [d for d in decisions if d["what"] == "rebalance_planned"][0][
+            "planned_moves"] == 3
+
+
+class TestTierRetune:
+    def test_grows_once_per_arming(self):
+        policy = Policy(make_cfg())  # occupancy band .9/.5, factor 2.0
+        view = {0: ("e0", 100, 95.0)}
+        decisions, actions = policy.plan({}, partition_of=PART_OF, owned=(0,),
+                                         tenants_of={}, tier_view=view)
+        assert actions == [RetuneTier(pid=0, hot_capacity=200)]
+        assert whats(decisions) == ["tier_retune"]
+        # still past the band but armed: no second retune until it disarms
+        _, actions = policy.plan({}, partition_of=PART_OF, owned=(0,),
+                                 tenants_of={}, tier_view=view)
+        assert actions == []
+        # occupancy fell under the low edge (capacity grew): disarm…
+        _, actions = policy.plan({}, partition_of=PART_OF, owned=(0,),
+                                 tenants_of={}, tier_view={0: ("e0", 200, 90.0)})
+        assert actions == []
+        # …so the NEXT fill-up arms again from the grown capacity
+        _, actions = policy.plan({}, partition_of=PART_OF, owned=(0,),
+                                 tenants_of={}, tier_view={0: ("e0", 200, 190.0)})
+        assert actions == [RetuneTier(pid=0, hot_capacity=400)]
+
+    def test_capacity_ceiling(self):
+        policy = Policy(make_cfg(tier_capacity_max=150))
+        _, actions = policy.plan({}, partition_of=PART_OF, owned=(0,),
+                                 tenants_of={}, tier_view={0: ("e0", 100, 99.0)})
+        assert actions == [RetuneTier(pid=0, hot_capacity=150)]
+        _, actions = policy.plan({}, partition_of=PART_OF, owned=(0,),
+                                 tenants_of={}, tier_view={0: ("e0", 150, 149.0)})
+        assert actions == []  # already at the ceiling
+
+    def test_unobserved_residency_never_retunes(self):
+        policy = Policy(make_cfg())
+        _, actions = policy.plan({}, partition_of=PART_OF, owned=(0,),
+                                 tenants_of={}, tier_view={0: ("e0", 100, None)})
+        assert actions == []
+
+
+class TestShardGrowth:
+    def test_doubles_once_per_arming(self):
+        policy = Policy(make_cfg())  # backlog band 64/8
+        _, actions = policy.plan({}, partition_of=PART_OF, owned=(),
+                                 tenants_of={}, tier_view={},
+                                 shard_view=(4, 100.0))
+        assert actions == [ResizeShards(new_shards=8)]
+        _, actions = policy.plan({}, partition_of=PART_OF, owned=(),
+                                 tenants_of={}, tier_view={},
+                                 shard_view=(8, 100.0))
+        assert actions == []  # armed
+        _, actions = policy.plan({}, partition_of=PART_OF, owned=(),
+                                 tenants_of={}, tier_view={},
+                                 shard_view=(8, 4.0))
+        assert actions == []  # disarmed under the low edge
+        _, actions = policy.plan({}, partition_of=PART_OF, owned=(),
+                                 tenants_of={}, tier_view={},
+                                 shard_view=(8, 200.0))
+        assert actions == [ResizeShards(new_shards=16)]
+
+    def test_max_shards_cap(self):
+        policy = Policy(make_cfg(max_shards=6))
+        _, actions = policy.plan({}, partition_of=PART_OF, owned=(),
+                                 tenants_of={}, tier_view={},
+                                 shard_view=(4, 100.0))
+        assert actions == [ResizeShards(new_shards=6)]
+        policy = Policy(make_cfg(max_shards=4))
+        _, actions = policy.plan({}, partition_of=PART_OF, owned=(),
+                                 tenants_of={}, tier_view={},
+                                 shard_view=(4, 100.0))
+        assert actions == []

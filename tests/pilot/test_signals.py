@@ -1,0 +1,145 @@
+"""SignalBook: counter-delta rates, EWMA, reset clamps, stale exclusion."""
+
+import pytest
+
+from metrics_tpu.cluster import ManualClock
+from metrics_tpu.obs.fleet import FleetAggregator
+from metrics_tpu.pilot import SignalBook
+
+from tests.pilot.conftest import make_snapshot
+
+
+def make_agg(clock, stale_after_s=10.0):
+    return FleetAggregator(stale_after_s=stale_after_s, retire_after_s=600.0,
+                           clock=clock)
+
+
+def test_alpha_validation():
+    with pytest.raises(ValueError):
+        SignalBook(0.0)
+    with pytest.raises(ValueError):
+        SignalBook(1.5)
+
+
+def test_rate_from_counter_deltas():
+    clock = ManualClock(0.0)
+    agg = make_agg(clock)
+    book = SignalBook(alpha=1.0)
+
+    agg.ingest(make_snapshot("w", 100.0, submitted={"p0": 0.0},
+                             depth={"p0": 0.0}))
+    book.ingest(agg)
+    # first sighting: an interval needs two stamps; no rate yet
+    assert book.readings()["p0"].rate == 0.0
+    assert book.readings()["p0"].observations == 1
+
+    agg.ingest(make_snapshot("w", 102.0, submitted={"p0": 300.0},
+                             depth={"p0": 0.0}))
+    book.ingest(agg)
+    r = book.readings()["p0"]
+    assert r.rate == pytest.approx(150.0)  # 300 events over 2s of wall time
+    assert r.observations == 2
+
+
+def test_rates_sum_across_nodes():
+    clock = ManualClock(0.0)
+    agg = make_agg(clock)
+    book = SignalBook(alpha=1.0)
+    for node in ("w1", "w2"):
+        agg.ingest(make_snapshot(node, 10.0, submitted={"p0": 0.0}))
+    book.ingest(agg)
+    for node, v in (("w1", 100.0), ("w2", 50.0)):
+        agg.ingest(make_snapshot(node, 11.0, submitted={"p0": v}))
+    book.ingest(agg)
+    assert book.readings()["p0"].rate == pytest.approx(150.0)
+
+
+def test_counter_reset_reads_as_quiet_never_negative():
+    clock = ManualClock(0.0)
+    agg = make_agg(clock)
+    book = SignalBook(alpha=1.0)
+    agg.ingest(make_snapshot("w", 10.0, submitted={"p0": 500.0}))
+    book.ingest(agg)
+    # engine restarted: cumulative counter fell to 3
+    agg.ingest(make_snapshot("w", 11.0, submitted={"p0": 3.0}))
+    book.ingest(agg)
+    assert book.readings()["p0"].rate == 0.0
+
+
+def test_same_snapshot_reingested_keeps_the_older_stamp():
+    clock = ManualClock(0.0)
+    agg = make_agg(clock)
+    book = SignalBook(alpha=1.0)
+    agg.ingest(make_snapshot("w", 10.0, submitted={"p0": 0.0}))
+    book.ingest(agg)
+    book.ingest(agg)  # aggregator still holds the SAME snapshot (dt == 0)
+    agg.ingest(make_snapshot("w", 12.0, submitted={"p0": 100.0}))
+    book.ingest(agg)
+    # the interval rates over the full 2s, not a zero-width window
+    assert book.readings()["p0"].rate == pytest.approx(50.0)
+
+
+def test_ewma_smoothing():
+    clock = ManualClock(0.0)
+    agg = make_agg(clock)
+    book = SignalBook(alpha=0.5)
+    agg.ingest(make_snapshot("w", 10.0, submitted={"p0": 0.0}))
+    book.ingest(agg)
+    agg.ingest(make_snapshot("w", 11.0, submitted={"p0": 100.0}))
+    book.ingest(agg)
+    # EWMA from 0 toward raw 100/s at alpha .5 — but the Reading started at 0
+    # with one rateless observation folded in first
+    first = book.readings()["p0"].rate
+    assert first == pytest.approx(50.0)
+    agg.ingest(make_snapshot("w", 12.0, submitted={"p0": 200.0}))
+    book.ingest(agg)
+    assert book.readings()["p0"].rate == pytest.approx(75.0)  # 50 + .5*(100-50)
+
+
+def test_stale_node_contributes_nothing_and_is_named():
+    clock = ManualClock(0.0)
+    agg = make_agg(clock, stale_after_s=5.0)
+    book = SignalBook(alpha=1.0)
+    agg.ingest(make_snapshot("fresh", 10.0, submitted={"p0": 0.0},
+                             depth={"p0": 2.0}))
+    agg.ingest(make_snapshot("lagger", 10.0, submitted={"p0": 0.0},
+                             depth={"p0": 100.0}))
+    book.ingest(agg)
+    assert book.readings()["p0"].backlog == pytest.approx(102.0)
+
+    clock.advance(6.0)  # lagger never snapshots again
+    agg.ingest(make_snapshot("fresh", 16.0, submitted={"p0": 60.0},
+                             depth={"p0": 2.0}))
+    book.ingest(agg)
+    assert book.excluded_stale == ["lagger"]
+    r = book.readings()["p0"]
+    assert r.rate == pytest.approx(10.0)  # fresh's 60/6s only
+    assert r.backlog == pytest.approx(2.0)  # lagger's 100 gone, not held over
+    assert book.as_doc()["excluded_stale"] == ["lagger"]
+
+
+def test_p99_is_worst_across_nodes_and_tier_hot_ewma():
+    clock = ManualClock(0.0)
+    agg = make_agg(clock)
+    book = SignalBook(alpha=1.0)
+    agg.ingest(make_snapshot("w1", 10.0, p99={"p0": 0.010},
+                             tier_hot={"e1": 40.0}))
+    agg.ingest(make_snapshot("w2", 10.0, p99={"p0": 0.250}))
+    book.ingest(agg)
+    assert book.readings()["p0"].p99_s == pytest.approx(0.250)
+    assert book.tier_hot("e1") == pytest.approx(40.0)
+    assert book.tier_hot("unseen") is None
+
+
+def test_backlog_total_spans_the_fleet():
+    clock = ManualClock(0.0)
+    agg = make_agg(clock)
+    book = SignalBook(alpha=1.0)
+    agg.ingest(make_snapshot("w1", 10.0, depth={"p0": 30.0, "p1": 20.0}))
+    agg.ingest(make_snapshot("w2", 10.0, depth={"p0": 50.0}))
+    book.ingest(agg)
+    assert book.backlog_total == pytest.approx(100.0)
+    doc = book.as_doc()
+    assert doc["backlog_total"] == pytest.approx(100.0)
+    assert set(doc["partitions"]) == {"p0", "p1"}
+    assert doc["observations"] == 1

@@ -2692,6 +2692,312 @@ def soak_part(seeds) -> None:
                         engine.close(checkpoint=False)
 
 
+# ---------------------------------------------------------------------------
+# autopilot surface (ISSUE 16)
+
+_PILOT_P = 4
+_PILOT_HOT_KEYS = 6
+
+
+def _pilot_keys(seed):
+    """Deterministic tenant set derived from the ring parameters alone, so the
+    parent and child compute the identical set: `_PILOT_HOT_KEYS` tenants that
+    route to p0 (the storm's target) plus one background tenant per other
+    partition."""
+    from metrics_tpu.part import PartitionMap
+
+    pmap = PartitionMap(_PILOT_P, seed=seed)
+    hot: list = []
+    background: dict = {}
+    i = 0
+    while len(hot) < _PILOT_HOT_KEYS or len(background) < _PILOT_P - 1:
+        key = f"zipf-{i}"
+        pid = pmap.partition_of(key)
+        if pid == 0 and len(hot) < _PILOT_HOT_KEYS:
+            hot.append(key)
+        elif pid != 0 and pid not in background:
+            background[pid] = key
+        i += 1
+    return hot, [background[pid] for pid in sorted(background)]
+
+
+def _pilot_stream(seed, n=4000):
+    """The zipf storm schedule: ~85% of rows hammer p0's tenants (harmonic
+    weights within the hot set), the rest keep the other partitions warm
+    enough to be mature cold destinations."""
+    hot, cold = _pilot_keys(seed)
+    keys = hot + cold
+    weights = np.asarray([1.0 / (i + 1) for i in range(len(hot))] + [0.15] * len(cold))
+    weights = weights / weights.sum()
+    rng = np.random.default_rng((seed << 5) ^ 0x51C7)
+    return [
+        (keys[int(rng.choice(len(keys), p=weights))],
+         rng.integers(0, 2, 3), rng.integers(0, 2, 3))
+        for _ in range(n)
+    ]
+
+
+def _pilot_node_cfg(name, dirpath, link, seed):
+    from metrics_tpu.cluster import DirectoryCoordStore
+    from metrics_tpu.part import PartConfig
+
+    return PartConfig(
+        node_id=name,
+        peers=tuple(p for p in ("a", "b") if p != name),
+        store=DirectoryCoordStore(os.path.join(dirpath, "coord"), durable=False),
+        partitions=_PILOT_P,
+        link_factory=link,
+        manifest_directory=os.path.join(dirpath, "manifest"),
+        # generous TTL relative to the 0.05s tick: the storm's fsync-per-row
+        # WAL load can starve the child's renewal thread past a second, and a
+        # hair-trigger lease would hand a partition to the standby while the
+        # leader is still alive (its engine demotes mid-storm -> NotPrimary)
+        lease_ttl_s=3.0,
+        heartbeat_interval_s=0.2,
+        suspect_after_s=1.5,
+        confirm_after_s=2.5,
+        tick_interval_s=0.05,
+        election_backoff_s=0.1,
+        rng_seed=seed + ord(name),
+    )
+
+
+def pilot_crash_child(dirpath, seed):
+    """Child half of the autopilot SIGKILL surface: node 'a' leads ALL
+    partitions, its AutoPilot holds the `pilot` lease, and the main thread
+    serves a zipf storm aimed at p0's tenants. The pilot flags p0 hot and
+    starts budgeted migrations; the parent kills the process mid-migration.
+    Rows refused by a migration's quarantine hold are retried (never skipped)
+    so every tenant's stream stays an in-order prefix."""
+    import faulthandler
+    import signal as _signal
+    import time as _time
+
+    faulthandler.register(_signal.SIGUSR1)  # live thread dump for soak debugging
+
+    from metrics_tpu import obs as _obs_pkg
+    from metrics_tpu.classification import BinaryAccuracy
+    from metrics_tpu.engine import CheckpointConfig, ReplConfig, StreamingEngine
+    from metrics_tpu.guard import GuardConfig
+    from metrics_tpu.guard.errors import TenantQuarantined
+    from metrics_tpu.part import PartitionedNode, partition_name
+    from metrics_tpu.pilot import AutoPilot, PilotConfig
+    from metrics_tpu.repl.errors import NotPrimaryError
+
+    _obs_pkg.enable()  # engine telemetry is the pilot's only input
+    link = _part_links(dirpath)
+    engines = {}
+    for pid in range(_PILOT_P):
+        pname = partition_name(pid)
+        engines[pid] = StreamingEngine(
+            BinaryAccuracy(), buckets=(8,),
+            # the guard plane is LOAD-BEARING for migration: without it there
+            # is no quarantine hold, so rows accepted during the export window
+            # die with the source eviction (shed=False: a dropped storm row
+            # would also break the per-key prefix oracle)
+            guard=GuardConfig(shed=False),
+            # buffered WAL + relaxed interval: the survivor bootstraps from
+            # REPLICATION snapshots, never from this host's disk, and per-row
+            # fsync under the storm starves the pilot's reconcile cycle
+            checkpoint=CheckpointConfig(directory=os.path.join(dirpath, f"ckpt-a-{pname}"),
+                                        interval_s=0.2, retain=3, durable=True),
+            replication=ReplConfig(role="primary", transport=link("a", "b", pname),
+                                   ship_interval_s=0.01, heartbeat_interval_s=0.1),
+        )
+    cfg = _pilot_node_cfg("a", dirpath, link, seed)
+    node = PartitionedNode(engines, cfg)
+    deadline = _time.monotonic() + 60.0
+    while len(node.owned()) < _PILOT_P and _time.monotonic() < deadline:
+        _time.sleep(0.02)
+    pilot = AutoPilot(node, PilotConfig(
+        node_id="a", store=cfg.store,
+        lease_ttl_s=1.0, tick_interval_s=0.05, evaluate_interval_s=0.2,
+        ewma_alpha=0.6, min_observations=2, min_rate=5.0,
+        migration_budget=2, budget_window_s=0.5, tenant_cooldown_s=30.0,
+        journal_directory=os.path.join(dirpath, "journal"),
+    ))
+    print("READY" if len(node.owned()) == _PILOT_P else "NOLEASE", flush=True)
+    stream = _pilot_stream(seed)
+    i = 0
+    while True:
+        key, p, t = stream[i % len(stream)]
+        while True:
+            pid = node.pmap.partition_of(key)
+            try:
+                engines[pid].submit(key, jnp.asarray(p), jnp.asarray(t))
+                break
+            except TenantQuarantined:
+                _time.sleep(0.002)  # mid-migration hold: wait out the commit
+            except NotPrimaryError:
+                # lease flicker under fsync starvation: the row must still
+                # land exactly once, so wait for re-acquisition — never skip
+                _time.sleep(0.01)
+        i += 1
+        # throttle: hot-ratio detection needs relative skew, not an absolute
+        # crush — full blast starves the pilot/ckpt/shipper threads of the
+        # GIL and disk, and the first reconcile cycle must finish in seconds
+        _time.sleep(0.0005)
+
+
+def soak_pilot(seeds) -> None:
+    """Autopilot SIGKILL surface (ISSUE 16): one host leads every partition
+    and its live AutoPilot — holder of the `pilot` lease — is mid-way through
+    rebalancing a zipf storm when the host dies. The survivor must, with no
+    manual promote() anywhere: win every partition lease at the shipping
+    epoch, win the `pilot` lease and RESUME the decision journal's sequence,
+    resolve any migration double copies via `sweep_partitions` against the
+    COMMITTED partition map, and serve an exactly-once order-preserving
+    prefix per surviving tenant (the `_update_count` twin). Self-oracled —
+    needs no reference checkout."""
+    import signal
+    import subprocess
+    import tempfile
+    import time as _time
+
+    from metrics_tpu.classification import BinaryAccuracy
+    from metrics_tpu.engine import CheckpointConfig, ReplConfig, StreamingEngine
+    from metrics_tpu.obs.fleet import FleetAggregator
+    from metrics_tpu.part import PartitionedNode, partition_name
+    from metrics_tpu.part.migrate import sweep_partitions
+    from metrics_tpu.pilot import AutoPilot, PilotConfig, read_journal
+
+    for seed in seeds:
+        tag = f"pilot/failover seed={seed}"
+        with tempfile.TemporaryDirectory() as d:
+            journal_dir = os.path.join(d, "journal")
+            link = _part_links(d)
+            child = subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__), "--pilot-child", d, str(seed)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            )
+            engines: dict = {}
+            node = None
+            pilot = None
+            try:
+                line = child.stdout.readline()
+                if "READY" not in line:
+                    err = child.stderr.read()[:200]
+                    FAILS.append((seed, tag, f"child failed to lead: {line!r} {err!r}"))
+                    continue
+                for pid in range(_PILOT_P):
+                    pname = partition_name(pid)
+                    engines[pid] = StreamingEngine(
+                        BinaryAccuracy(), buckets=(8,),
+                        replication=ReplConfig(
+                            role="follower", transport=link("a", "b", pname),
+                            poll_interval_s=0.01,
+                            promote_checkpoint=CheckpointConfig(
+                                directory=os.path.join(d, f"promoted-b-{pname}"),
+                                interval_s=0.1, durable=False),
+                        ),
+                    )
+                node = PartitionedNode(engines, _pilot_node_cfg("b", d, link, seed))
+
+                def bootstrapped(pid):
+                    applier = engines[pid]._applier
+                    return applier is not None and applier.bootstrapped
+
+                deadline = _time.monotonic() + 60.0
+                while _time.monotonic() < deadline and not all(
+                    bootstrapped(pid) for pid in range(_PILOT_P)
+                ):
+                    _time.sleep(0.05)
+                if not all(bootstrapped(pid) for pid in range(_PILOT_P)):
+                    FAILS.append((seed, tag, "survivor never bootstrapped every partition"))
+                    continue
+
+                # the kill must land MID-rebalance: wait until the child's
+                # pilot has journaled its first migration outcome, then strike
+                # within a fraction of its budget window
+                def migration_started():
+                    return any(
+                        o.get("kind") == "migrate_tenant" and "outcome" in o
+                        for rec in read_journal(journal_dir)
+                        for o in rec.get("outcomes", ())
+                    )
+
+                deadline = _time.monotonic() + 60.0
+                while _time.monotonic() < deadline and not migration_started():
+                    _time.sleep(0.02)
+                if not migration_started():
+                    FAILS.append((seed, tag, "child pilot never started a migration"))
+                    continue
+                rng = np.random.default_rng(seed ^ 0x9170)
+                _time.sleep(float(rng.uniform(0.02, 0.3)))
+                child.send_signal(signal.SIGKILL)
+                child.wait(timeout=30)
+
+                # every partition lease must fail over to the survivor at the
+                # shipping epoch, with never two writable engines on the way
+                deadline = _time.monotonic() + 60.0
+                while _time.monotonic() < deadline and len(node.owned()) < _PILOT_P:
+                    _time.sleep(0.05)
+                if len(node.owned()) < _PILOT_P:
+                    missing = sorted(set(range(_PILOT_P)) - set(node.owned()))
+                    FAILS.append((seed, tag, f"partitions never failed over: {missing}"))
+                    continue
+
+                # residency repair first, while nothing else mutates: the
+                # COMMITTED map is the truth; any tenant the map routes away
+                # from its resident partition is a superseded double copy
+                node.pmap.reload()
+                sweep_partitions(node.pmap, engines)
+                stream = _pilot_stream(seed)
+                for key in {k for k, _, _ in stream}:
+                    resident = [pid for pid in range(_PILOT_P)
+                                if key in engines[pid]._keyed.keys]
+                    if len(resident) > 1:
+                        FAILS.append((seed, tag, f"tenant {key} double-resident "
+                                      f"after sweep: {resident}"))
+                    elif resident and resident[0] != node.pmap.partition_of(key):
+                        FAILS.append((seed, tag, f"tenant {key} resident on "
+                                      f"p{resident[0]} but routed to "
+                                      f"p{node.pmap.partition_of(key)}"))
+                # exactly-once order-preserving prefix per surviving tenant
+                for pid in range(_PILOT_P):
+                    _verify_repl_prefix(engines[pid], stream, seed, f"{tag} p{pid}")
+
+                # the controller itself fails over: a standby pilot on the
+                # survivor wins the `pilot` lease once the dead holder's TTL
+                # runs out, and the journal's sequence RESUMES, never restarts
+                # (dry_run: the convergence check must not move state)
+                pilot = AutoPilot(node, PilotConfig(
+                    node_id="b", store=node.cfg.store, dry_run=True,
+                    lease_ttl_s=1.0, tick_interval_s=0.05,
+                    evaluate_interval_s=0.1,
+                    journal_directory=journal_dir,
+                ), aggregator=FleetAggregator(stale_after_s=5.0, retire_after_s=60.0),
+                    start=False)
+                before = read_journal(journal_dir)
+                deadline = _time.monotonic() + 30.0
+                while _time.monotonic() < deadline and pilot.role != "pilot":
+                    pilot.tick()
+                    _time.sleep(0.05)
+                if pilot.role != "pilot":
+                    FAILS.append((seed, tag, "survivor pilot never won the lease"))
+                    continue
+                pilot.tick()
+                records = read_journal(journal_dir)
+                seqs = [rec["seq"] for rec in records]
+                if seqs != sorted(seqs) or len(set(seqs)) != len(seqs):
+                    FAILS.append((seed, tag, f"journal seqs not strictly increasing: {seqs}"))
+                if len(records) <= len(before) or records[-1]["node"] != "b":
+                    FAILS.append((seed, tag, "survivor pilot never journaled a cycle "
+                                  f"({len(before)} -> {len(records)} records)"))
+            except Exception as exc:  # noqa: BLE001 — record crash seeds, keep soaking
+                FAILS.append((seed, tag, "surface raised: " + repr(exc)[:160]))
+            finally:
+                if child.poll() is None:
+                    child.kill()
+                    child.wait(timeout=30)
+                if pilot is not None:
+                    pilot.close(release=False)
+                if node is not None:
+                    node.close(release=False)
+                for engine in engines.values():
+                    engine.close(checkpoint=False)
+
+
 SURFACES = {
     "classification": soak_classification,
     "regression_retrieval": soak_regression_retrieval,
@@ -2712,15 +3018,16 @@ SURFACES = {
     "comm": soak_comm,
     "tier": soak_tier,
     "part": soak_part,
+    "pilot": soak_pilot,
 }
 
 # surfaces that execute the reference as their oracle (everything except the
 # self-oracled engine, ckpt crash-recovery, guard chaos, repl, sketch,
-# cluster, shard, comm, tier and part surfaces)
+# cluster, shard, comm, tier, part and pilot surfaces)
 _NEEDS_REF = {
     name for name in SURFACES
     if name not in ("engine", "ckpt", "guard", "repl", "sketch", "cluster", "shard",
-                    "comm", "tier", "part")
+                    "comm", "tier", "part", "pilot")
 }
 
 
@@ -2740,6 +3047,8 @@ def main() -> None:
                         help="internal: run the tiered-engine child (killed by the parent)")
     parser.add_argument("--part-child", nargs=2, metavar=("DIR", "SEED"),
                         help="internal: run the all-partitions leader child (killed by the parent)")
+    parser.add_argument("--pilot-child", nargs=2, metavar=("DIR", "SEED"),
+                        help="internal: run the autopilot-holder child (killed by the parent)")
     parser.add_argument("--flight-dir", default=None, metavar="DIR",
                         help="dump a flight-recorder post-mortem bundle here if any "
                              "surface fails (CI uploads it as an artifact)")
@@ -2768,6 +3077,10 @@ def main() -> None:
     if args.part_child is not None:
         dirpath, seed = args.part_child
         part_crash_child(dirpath, int(seed))
+        return
+    if args.pilot_child is not None:
+        dirpath, seed = args.pilot_child
+        pilot_crash_child(dirpath, int(seed))
         return
 
     start, stop = (int(x) for x in args.seeds.split(":"))
