@@ -161,10 +161,31 @@ class ClusterClient:
         ``prefer="replica"`` tries a non-leader first (read scale-out) and
         redirects to the leader only when the replica refuses the staleness
         bound."""
+        value, _node, _is_leader = self.call("compute", key, prefer=prefer, **kwargs)
+        return value
+
+    def call(
+        self,
+        op: str,
+        *args: Any,
+        prefer: str = "leader",
+        retries: Optional[int] = None,
+        **kwargs: Any,
+    ) -> "tuple[Any, str, bool]":
+        """Route one read-shaped method call under the same redirect ladder as
+        :meth:`compute`, returning ``(result, node_id, served_by_leader)``.
+
+        The provenance pair is what the query plane's honesty contract needs:
+        a global rollup reports WHICH node served each partition and whether
+        the read ever touched the write leader. ``retries`` overrides the
+        router's budget (``0`` = one attempt) — cache-revalidation probes
+        fall back to a re-merge rather than inherit the write path's patience.
+        """
         if prefer not in ("leader", "replica"):
             raise ValueError(f"prefer must be 'leader' or 'replica', got {prefer!r}")
+        budget = self._retries if retries is None else int(retries)
         last: Optional[BaseException] = None
-        for attempt in range(self._retries + 1):
+        for attempt in range(budget + 1):
             leader = self.leader_id(refresh=attempt > 0)
             target = leader
             if prefer == "replica":
@@ -175,13 +196,13 @@ class ClusterClient:
                 self._backoff(attempt)
                 continue
             try:
-                return self._engines[target].compute(key, **kwargs)
+                return getattr(self._engines[target], op)(*args, **kwargs), target, target == leader
             except StalenessExceeded as exc:
                 last = exc
                 self.redirects += 1
                 if prefer == "replica" and leader is not None:
                     try:
-                        return self._engines[leader].compute(key, **kwargs)
+                        return getattr(self._engines[leader], op)(*args, **kwargs), leader, True
                     except _REDIRECTS as exc2:
                         last = exc2
                 self._invalidate()
@@ -192,6 +213,6 @@ class ClusterClient:
                 self._invalidate()
                 self._backoff(attempt)
         raise NoLeaderError(
-            f"no engine could serve the read after {self._retries + 1} attempts "
+            f"no engine could serve {op}() after {budget + 1} attempts "
             f"(last refusal: {type(last).__name__ if last else 'none resolved'})"
         )

@@ -647,6 +647,16 @@ def sync_pytree(
     report.stale = True
     if mview is not None:
         report.peers_lost = mview.lost()
+        # A rank that fell all the way to local state learned nothing reliable
+        # about the world: only *attributed* failures (PeerLostError.peers)
+        # marked peers lost, and a rank whose collectives all died as
+        # unattributed timeouts exits with an EMPTY lost set — its next sync
+        # would then skip agreement and stall a full-world collective while
+        # the peers that DID attribute the failure agree on a subset without
+        # it. Poison the view (the restarting-process contract of
+        # suspect_all) so the next sync re-agrees from the board regardless
+        # of which side of the attribution race this rank landed on.
+        mview.suspect_all()
     _obs.record_comm_degradation(site, "local_state")
     _obs.set_comm_stale(site, True)
     _publish(report, cfg)

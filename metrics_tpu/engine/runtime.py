@@ -1069,6 +1069,129 @@ class StreamingEngine:
                 out[key] = self._read_metric.compute_from(state)
         return out
 
+    def wal_watermark(self) -> Tuple[int, int]:
+        """``(epoch, seq)`` — this engine's WAL position, the query plane's cache stamp.
+
+        On a primary this is the last journaled seq in the current lineage
+        epoch, read under the promote lock so a concurrent role flip cannot
+        tear a (new epoch, old seq) pair. On a follower it is the applier's
+        applied position, behind the same bounded-staleness gate as every
+        other follower read — a replica too stale to serve a rollup is also
+        too stale to vouch for a cached one. ``seq`` is ``-1`` for an engine
+        with no journaled write yet (or no durable plane at all), which the
+        cache treats as never-valid rather than never-changing.
+        """
+        if self._closed:
+            raise EngineClosed("wal_watermark() on a closed StreamingEngine")
+        self._check_quarantined("wal_watermark")
+        self._check_staleness()
+        applier = self._applier
+        if self._repl_follower and applier is not None:
+            wm = applier.watermark()
+        else:
+            with self._promote_lock:
+                wm = (int(self._repl_epoch), int(self._wal_seq))
+        partition = self.telemetry.label("partition")
+        if partition:
+            _obs.set_part_wal_seq(self.telemetry.engine_id, partition, wm[1])
+        return wm
+
+    def rollup(self, *, window: bool = False) -> Any:
+        """Fold EVERY local tenant into one mergeable state, stamped for the cache.
+
+        The global-query read primitive (:mod:`metrics_tpu.query`): one
+        watermark-stamped :class:`~metrics_tpu.query.rollup.PartitionRollup`
+        per partition replaces a per-tenant scatter. Served by followers too
+        (under the staleness gate) — the rollup fold itself never mutates
+        state, never changes tier residency, and never touches the write path
+        beyond the same flush ``compute`` pays.
+
+        The watermark is read BEFORE the state snapshot: anything journaled
+        in between is in the fold but not the stamp, so a cached result can
+        only ever UNDER-claim its coverage — revalidation then invalidates
+        early, never serves a stamp the state doesn't back. (Reading it after
+        would claim seqs the snapshot may lack; nesting the promote lock
+        inside the dispatch lock would invert ``promote()``'s order.)
+        """
+        from metrics_tpu.query.rollup import PartitionRollup, fold_slab, fold_states, merge_folds
+
+        if window and self._window is None:
+            raise MetricsTPUUserError("rollup(window=True) requires the engine to be built with `window=`")
+        self._check_quarantined("rollup")
+        self._check_staleness()
+        if self._closed:
+            raise EngineClosed("rollup() on a closed StreamingEngine")
+        self.flush()
+        t0 = time.monotonic()
+        watermark = self.wal_watermark()
+        slab = None
+        ring: List[Tuple[Any, Any]] = []  # (snapshot pytree, live-slot gather index)
+        eager: List[Any] = []
+        peeked: List[Any] = []
+        with self._dispatch_lock:
+            keyed = self._keyed
+            tenants = len(keyed.keys)
+            if isinstance(keyed, KeyedState):
+                # refs only, folded off-lock: the slab is functionally replaced
+                # by dispatches, so a grabbed ref is an immutable snapshot
+                slab = keyed.stacked
+                if window and keyed._ring:
+                    # gather live slots only: a demoted tenant's ring rows
+                    # survive until release_slot scrubs them (see
+                    # KeyedState.evict), and its history already lives in its
+                    # tier entry — whole-segment folds would double-count it
+                    slots = sorted(s for s in keyed._slots.values() if s < keyed.capacity)
+                    for cap, snap in keyed._ring:
+                        idx = [s for s in slots if s < cap]
+                        if idx:
+                            ring.append((snap, jnp.asarray(idx, jnp.int32)))
+            else:
+                eager = [
+                    keyed.merged_state(key) if window else keyed.state_of(key)
+                    for key in keyed.keys
+                ]
+            tier = self._tier
+            if tier is not None:
+                resident = set(keyed.keys)
+                for key in tier.keys():
+                    if key in resident:
+                        continue
+                    tenants += 1
+                    entry = tier.peek_entry(key)
+                    # a registered-but-silent cold tenant has no entry at all;
+                    # peek_state would hand back init_state() — the fold
+                    # identity — so it counts toward coverage and contributes
+                    # nothing. Skipping it keeps a million-registered-tenant
+                    # rollup O(tenants with state), not O(registered).
+                    if entry:
+                        peeked.append(
+                            peek_state(self._metric, keyed, entry, window=window)
+                        )
+        folds: List[Any] = []
+        for snap, idx in ring:  # oldest segment first, matching merged_state
+            folds.append(fold_slab(self._metric, jax.tree.map(lambda x: x[idx], snap)))
+        if slab is not None:
+            # free + never-dispatched rows hold init values — the reduction
+            # identities — so the whole-slab fold needs no residency mask
+            folds.append(fold_slab(self._metric, slab))
+        if eager:
+            folds.append(fold_states(self._metric, eager))
+        if peeked:
+            folds.append(fold_states(self._metric, peeked))
+        state = merge_folds(self._metric, folds)
+        lag = self.replica_lag()
+        _obs.record_query_rollup_seconds(self.telemetry.engine_id, time.monotonic() - t0)
+        return PartitionRollup(
+            partition=self.telemetry.label("partition"),
+            state=state,
+            watermark=watermark,
+            tenants=tenants,
+            follower=self._repl_follower,
+            node=self.telemetry.engine_id,
+            staleness_seqs=None if lag is None else lag.seqs_behind,
+            staleness_s=None if lag is None else lag.seconds_behind,
+        )
+
     def _check_quarantined(self, op: str) -> None:
         """Fail fast instead of deadlocking on a dispatch lock a wedged worker holds."""
         if self._quarantined:

@@ -233,6 +233,10 @@ class EngineTelemetry:
             q: self._quantile.label_key(quantile=q, **self._label) for q in ("0.5", "0.99")
         }
 
+    def label(self, name: str, default: str = "") -> str:
+        """One stamped label's value (e.g. ``partition`` after adoption)."""
+        return self._label.get(name, default)
+
     # ------------------------------------------------------------------ recording
 
     def register_counter(self, name: str) -> None:

@@ -159,3 +159,29 @@ class PartitionedClient:
             if new_pid == pid:
                 raise
             return self._routers[new_pid].compute(key, prefer=prefer, **kwargs)
+
+    # ------------------------------------------------------------------ query plane
+
+    def rollup(
+        self, pid: int, *, prefer: str = "replica", window: bool = False
+    ) -> "tuple[Any, str, bool]":
+        """One partition's every-tenant fold (the global query's per-partition
+        read), via the partition's redirect ladder. Returns the stamped
+        :class:`~metrics_tpu.query.rollup.PartitionRollup` with its partition
+        name and serving node filled in, plus ``(node, served_by_leader)``
+        provenance."""
+        from dataclasses import replace as _dc_replace
+
+        ru, node, is_leader = self._routers[pid].call("rollup", prefer=prefer, window=window)
+        # the engine stamps what it knows locally; the router knows the
+        # cluster-level identity this rollup must be reported under
+        ru = _dc_replace(ru, partition=self.pmap.name_of(pid), node=node)
+        return ru, node, is_leader
+
+    def wal_watermark(
+        self, pid: int, *, prefer: str = "replica", retries: Optional[int] = None
+    ) -> "tuple[tuple[int, int], str, bool]":
+        """One partition's ``(epoch, seq)`` WAL watermark — the cache
+        revalidation probe. Two ints over the read path, follower-servable,
+        behind the same staleness gate as the rollup it vouches for."""
+        return self._routers[pid].call("wal_watermark", prefer=prefer, retries=retries)

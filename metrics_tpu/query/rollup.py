@@ -1,0 +1,129 @@
+"""Partition rollups — every local tenant folded into ONE mergeable state.
+
+The fold is the vectorised analogue of repeated
+:meth:`~metrics_tpu.metric.Metric.merge_states` over all tenants, computed as
+one slab-axis reduction per leaf instead of K-1 pairwise tree ops:
+
+- ``sum`` states reduce with ``jnp.sum`` over the tenant axis — bit-identical
+  to any pairwise merge order for the integer states every sketch family
+  carries (DDSketch buckets, HLL registers, CMS tables are all int32);
+- ``min`` / ``max`` states reduce elementwise — exact in any order;
+- ``mean`` states reduce as one ``_update_count``-weighted sum (the same
+  formula ``merge_states`` applies pairwise; for floating-point states the
+  single weighted sum and a nested pairwise merge can differ in rounding —
+  both are within each other's accumulation error);
+- callable reductions take the WHOLE ``(K, ...)`` stack in one call — the
+  :func:`~metrics_tpu.sketch.kernels.topk_merge` contract, whose merge is
+  commutative bit-for-bit and exactly associative while the candidate union
+  fits the ledger.
+
+Free and never-dispatched slab rows hold init values, which are the identity
+elements of their reductions (zero counts, ``+inf`` mins, ``-inf`` maxes,
+``-1``-keyed empty ledgers, zero ``_update_count``), so the fold runs over
+the whole slab without masking: an evicted row contributes nothing, and an
+empty partition's rollup is exactly the merge identity.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import reduce as _reduce
+from typing import Any, Dict, Iterable, Optional, Tuple
+
+import jax.numpy as jnp
+
+from metrics_tpu.query.errors import RollupUnsupported
+
+__all__ = ["PartitionRollup", "fold_slab", "fold_states", "merge_folds"]
+
+
+@dataclass(frozen=True)
+class PartitionRollup:
+    """One partition's tenants folded into one state, stamped for the cache.
+
+    ``watermark`` is the serving engine's ``(epoch, seq)`` WAL position at
+    the instant the slab snapshot was captured (same dispatch-lock window),
+    so the rollup is exactly "the fold of everything journaled through seq,
+    in lineage epoch". ``follower`` / ``staleness_*`` record WHERE it was
+    served — the bounded-staleness evidence the query report surfaces
+    per-partition.
+    """
+
+    partition: str
+    state: Dict[str, Any]
+    watermark: Tuple[int, int]
+    tenants: int
+    follower: bool = False
+    node: str = ""
+    staleness_seqs: Optional[int] = None
+    staleness_s: Optional[float] = None
+
+
+def _fold_leaf(name: str, reduction: Any, rows: Any, weights: Any, total: Any) -> Any:
+    if reduction == "sum":
+        return jnp.sum(rows, axis=0)
+    if reduction == "max":
+        return jnp.max(rows, axis=0)
+    if reduction == "min":
+        return jnp.min(rows, axis=0)
+    if reduction == "mean":
+        w = jnp.reshape(weights, weights.shape + (1,) * (rows.ndim - 1))
+        return jnp.sum(w * rows, axis=0) / jnp.maximum(total, 1)
+    if callable(reduction):
+        # singleton pass-through, exactly like a pairwise reduce over one
+        # state: reduction callables may canonicalize representation (e.g.
+        # topk_merge re-sorts the ledger), and a fold of ONE state must be
+        # that state bit-for-bit to stay interchangeable with merge_states
+        return rows[0] if rows.shape[0] == 1 else reduction(rows)
+    raise RollupUnsupported(
+        f"state {name!r} has dist_reduce_fx={reduction!r}: a rollup is a fixed-size "
+        "mergeable summary, and 'cat'/None states grow with the stream — use a "
+        "sketch-family metric or a reducible scalar state"
+    )
+
+
+def fold_slab(metric: Any, slab: Dict[str, Any]) -> Dict[str, Any]:
+    """Fold a stacked ``(K, ...)``-per-leaf state slab into one state pytree."""
+    counts = jnp.asarray(slab["_update_count"])
+    total = jnp.sum(counts)
+    out: Dict[str, Any] = {}
+    for name, reduction in metric._reductions.items():
+        rows = slab[name]
+        if isinstance(rows, list):
+            raise RollupUnsupported(
+                f"state {name!r} is a list state: not foldable into a rollup"
+            )
+        out[name] = _fold_leaf(name, reduction, jnp.asarray(rows), counts, total)
+    out["_update_count"] = total
+    return out
+
+
+def fold_states(metric: Any, states: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold individually-held state pytrees (eager / tiered tenants) by
+    stacking them into a transient slab and reducing it exactly as
+    :func:`fold_slab` does — one semantics for both storage regimes."""
+    states = list(states)
+    if not states:
+        return metric.init_state()
+    for name in metric._reductions:
+        if any(isinstance(s[name], list) for s in states):
+            raise RollupUnsupported(
+                f"state {name!r} is a list state: not foldable into a rollup"
+            )
+    slab: Dict[str, Any] = {
+        name: jnp.stack([jnp.asarray(s[name]) for s in states])
+        for name in metric._reductions
+    }
+    slab["_update_count"] = jnp.stack(
+        [jnp.asarray(s.get("_update_count", 0)) for s in states]
+    )
+    return fold_slab(metric, slab)
+
+
+def merge_folds(metric: Any, folds: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
+    """Merge already-folded states left-to-right (ring segments oldest-first
+    into the live fold, then tiered tenants) via ``merge_states``."""
+    folds = list(folds)
+    if not folds:
+        return metric.init_state()
+    return _reduce(metric.merge_states, folds)

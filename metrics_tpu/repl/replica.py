@@ -327,6 +327,19 @@ class ReplicaApplier:
             seqs_behind=seqs, seconds_behind=max(0.0, time.monotonic() - self._caught_up_mono)
         )
 
+    def watermark(self) -> "tuple[int, int]":
+        """``(epoch, applied_seq)`` — this follower's applied WAL position.
+
+        The pair is the generation-safe watermark the query plane's result
+        cache keys on: seq numbers are only comparable within one primary
+        lineage, so a failover (new epoch, fresh numbering) can never be
+        mistaken for "the seq has not advanced". Taken under the apply lock:
+        a torn read across an epoch flip could pair the old lineage's epoch
+        with the new lineage's seq numbering and alias a fresh position onto
+        a cached one."""
+        with self._apply_lock:
+            return (int(self.epoch), int(self.applied_seq))
+
     def await_seq(self, seq: int, timeout_s: float = 10.0) -> bool:
         """Test/ops helper: block until ``applied_seq >= seq`` (True) or timeout."""
         deadline = time.monotonic() + timeout_s

@@ -26,7 +26,7 @@ item above its threshold share for adequate ``width``/``k``.
 
 from __future__ import annotations
 
-from typing import Any, Sequence, Tuple, Union
+from typing import Any, Optional, Sequence, Tuple, Union
 
 import jax.numpy as jnp
 from jax import Array
@@ -139,6 +139,30 @@ class QuantileSketch(Metric):
             offset=self._offset,
         )
 
+    def quantile_from(self, state: Any, q: Union[float, Sequence[float]]) -> Array:
+        """Estimate arbitrary quantile(s) ``q`` from a state pytree.
+
+        The query-plane read: ``compute_from`` is pinned to the constructor's
+        ``quantiles``, but a merged global state answers ANY quantile — the
+        buckets don't care which ranks are asked. A scalar ``q`` returns a
+        scalar, a sequence returns one estimate per entry.
+        """
+        scalar = isinstance(q, (int, float))
+        qs = (float(q),) if scalar else tuple(float(v) for v in q)
+        if not qs or any(not 0.0 <= v <= 1.0 for v in qs):
+            raise ValueError(f"`q` must be value(s) in [0, 1], got {q!r}")
+        out = kernels.ddsketch_quantiles(
+            state["pos_buckets"],
+            state["neg_buckets"],
+            state["zero_count"],
+            state["min_value"],
+            state["max_value"],
+            qs,
+            gamma=self._gamma,
+            offset=self._offset,
+        )
+        return out[0] if scalar else out
+
 
 class CardinalitySketch(Metric):
     """HyperLogLog distinct-count estimator over ``m = 2^p`` dense registers.
@@ -229,3 +253,18 @@ class HeavyHittersSketch(Metric):
         their count-min estimates, sorted by count descending (key-id ties
         broken deterministically)."""
         return kernels.hh_rank(self.counts, self.ledger)
+
+    def topk_from(self, state: Any, k: Optional[int] = None) -> Tuple[Array, Array]:
+        """Ranked ``(keys, counts)`` from a state pytree, truncated to ``k``.
+
+        The query-plane read: rank a merged global ledger against its exactly
+        merged count-min table, then keep the first ``k`` rows (defaults to the
+        ledger's full ``k``). Asking for more candidates than the ledger holds
+        is a configuration error, not a silent pad.
+        """
+        if k is None:
+            k = self.k
+        if not 1 <= int(k) <= self.k:
+            raise ValueError(f"`k` must be in [1, {self.k}] (the ledger size), got {k}")
+        keys, counts = kernels.hh_rank(state["counts"], state["ledger"])
+        return keys[: int(k)], counts[: int(k)]

@@ -335,22 +335,13 @@ class TestChaosGate:
                 t = StallTransport(t, stall_s=1.2, stalls=1)
             transports[r] = t
         reports = {}
-        gate = threading.Barrier(WORLD)
 
-        def run_rank(r):
-            out = {}
+        def run_r1(r):
             cfg1 = replace(base, on_report=lambda rep, r=r: reports.__setitem__(("r1", r), rep))
-            cfg2 = replace(base, on_report=lambda rep, r=r: reports.__setitem__(("r2", r), rep))
-            if r != DEAD:
-                out["r1"] = sync_pytree(states[r], reds, transport=transports[r], config=cfg1, site="chaos")
-            gate.wait(timeout=15)
-            if r == DEAD:
-                view_for(transports[r]).suspect_all()
-            out["r2"] = sync_pytree(states[r], reds, transport=transports[r], config=cfg2, site="chaos")
-            return out
+            return sync_pytree(states[r], reds, transport=transports[r], config=cfg1, site="chaos")
 
         t0 = time.monotonic()
-        results, errors = _run_ranks({r: (lambda r=r: run_rank(r)) for r in range(WORLD)})
+        r1, errors = _run_ranks({r: (lambda r=r: run_r1(r)) for r in range(WORLD) if r != DEAD})
         elapsed = time.monotonic() - t0
         assert not errors, errors
         # within one deadline + retry budget (with generous CI headroom)
@@ -361,20 +352,46 @@ class TestChaosGate:
             rep = reports[("r1", r)]
             assert rep.degraded_step == "live_subset", rep
             assert rep.peers_lost == (2, 3) and rep.world_live == 2 and not rep.stale
-            np.testing.assert_array_equal(np.asarray(results[r]["r1"]["s"]), np.full(3, 3.0))
-            assert int(results[r]["r1"]["_update_count"]) == 2
+            np.testing.assert_array_equal(np.asarray(r1[r]["s"]), np.full(3, 3.0))
+            assert int(r1[r]["_update_count"]) == 2
         # the stalled rank itself ends the round below quorum: local, stale —
-        # never a wrong aggregate, and never a deadlock
+        # never a wrong aggregate, and never a deadlock. Its local_state exit
+        # poisoned its view (plane.py), so round 2 re-agrees deterministically
+        # even when every one of its round-1 failures was an unattributed
+        # timeout (the attribution race that used to flake this test).
         rep2 = reports[("r1", STALL)]
         assert rep2.degraded_step == "local_state" and rep2.stale
+        assert view_for(transports[STALL]).has_lost()
 
-        # round 2: healed — full world, oracle-equal, degradation cleared
+        # round 2: healed. The dead rank rejoins via suspect_all (the
+        # restarted-process contract); like test_rejoin_round_equals_full_world
+        # _oracle, admission is guaranteed at a round BOUNDARY — under a load
+        # stall a deposit can miss one collect window — so run bounded round
+        # boundaries until every rank reports clean, then hold that round to
+        # the full-world oracle (re-syncing the same cumulative state is
+        # idempotent by contract).
+        view_for(transports[DEAD]).suspect_all()
+
+        def run_r2(r):
+            cfg2 = replace(base, on_report=lambda rep, r=r: reports.__setitem__(("r2", r), rep))
+            return sync_pytree(states[r], reds, transport=transports[r], config=cfg2, site="chaos")
+
+        for _ in range(3):
+            r2, errors = _run_ranks({r: (lambda r=r: run_r2(r)) for r in range(WORLD)})
+            assert not errors, errors
+            if all(
+                ("r2", r) in reports
+                and reports[("r2", r)].degraded_step == "none"
+                and not reports[("r2", r)].stale
+                for r in range(WORLD)
+            ):
+                break
         for r in range(WORLD):
             rep = reports[("r2", r)]
             assert rep.degraded_step == "none" and rep.world_live == WORLD and not rep.stale
             assert rep.peers_lost == ()
-            np.testing.assert_array_equal(np.asarray(results[r]["r2"]["s"]), np.full(3, 10.0))
-            assert int(results[r]["r2"]["_update_count"]) == 4
+            np.testing.assert_array_equal(np.asarray(r2[r]["s"]), np.full(3, 10.0))
+            assert int(r2[r]["_update_count"]) == 4
 
         from metrics_tpu.obs.instrument import COMM_DEGRADATIONS, COMM_PARTIAL_SYNCS, COMM_PEER_LIVE
 

@@ -1,0 +1,144 @@
+"""Query-plane rig: P=4 partitions, each a primary on node "a" (journaled WAL)
+shipping to a follower on node "b", routed by a PartitionedClient over a
+FakeCoordStore whose ManualClock never advances — leases pre-acquired for "a"
+never expire, so routing is deterministic and every failure in a test is one
+the test itself injected."""
+
+import functools
+
+import numpy as np
+import pytest
+
+from metrics_tpu.cluster import FakeCoordStore, ManualClock
+from metrics_tpu.engine import CheckpointConfig, ReplConfig, StreamingEngine
+from metrics_tpu.part import PartitionMap, PartitionedClient, partition_name
+from metrics_tpu.repl import FanoutTransport, LoopbackLink
+
+P = 4
+LEADER, FOLLOWER = "a", "b"
+
+
+class QueryCluster:
+    def __init__(self, tmp_path, metric_factory, *, max_staleness_seqs=None, window=None):
+        self.clock = ManualClock(0.0)
+        self.store = FakeCoordStore(clock=self.clock)
+        self.pmap = PartitionMap(P, seed=7)
+        self.metric_factory = metric_factory
+        self.engines = {LEADER: {}, FOLLOWER: {}}
+        self.batches = {}  # (pid, key) -> list of submitted batches (the oracle's replay log)
+        for pid in range(P):
+            pname = partition_name(pid)
+            link = LoopbackLink()
+            self.engines[LEADER][pid] = StreamingEngine(
+                metric_factory(),
+                window=window,
+                checkpoint=CheckpointConfig(
+                    directory=str(tmp_path / LEADER / pname),
+                    interval_s=0.05,
+                    wal_flush="fsync",
+                ),
+                replication=ReplConfig(
+                    role="primary",
+                    transport=FanoutTransport([link]),
+                    ship_interval_s=0.01,
+                    heartbeat_interval_s=0.05,
+                    epoch=1,
+                ),
+            )
+            self.engines[FOLLOWER][pid] = StreamingEngine(
+                metric_factory(),
+                window=window,
+                replication=ReplConfig(
+                    role="follower",
+                    transport=link,
+                    poll_interval_s=0.01,
+                    max_staleness_seqs=max_staleness_seqs,
+                ),
+            )
+            assert self.store.acquire_lease(LEADER, 3.0, name=pname) is not None
+        self.client = PartitionedClient(
+            self.store,
+            self.engines,
+            pmap=self.pmap,
+            retries=2,
+            backoff_s=0.001,
+            backoff_cap_s=0.002,
+            sleep=lambda s: None,
+            rng_seed=11,
+        )
+
+    # ------------------------------------------------------------------ traffic
+
+    def feed(self, key, batch):
+        """Submit one batch for tenant ``key`` at its ring-routed partition."""
+        pid = self.pmap.partition_of(key)
+        self.engines[LEADER][pid].submit(key, np.asarray(batch))
+        self.batches.setdefault((pid, key), []).append(np.asarray(batch))
+        return pid
+
+    def flush_all(self):
+        for pid in range(P):
+            self.engines[LEADER][pid].flush()
+
+    def wait_all_caught_up(self, timeout=8.0):
+        import time
+
+        self.flush_all()
+        for pid in range(P):
+            target = self.engines[LEADER][pid]._wal_seq
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                applier = self.engines[FOLLOWER][pid]._applier
+                if applier is not None and applier.bootstrapped and applier.applied_seq >= target:
+                    break
+                time.sleep(0.02)
+            else:
+                raise AssertionError(f"follower of p{pid} never reached seq {target}")
+
+    # ------------------------------------------------------------------ oracle
+
+    def oracle_state(self, pids=None):
+        """Centralized ground truth: every submitted batch replayed through
+        ``update_state`` per tenant, tenant states pairwise-merged in an
+        arbitrary-but-fixed order — the merge the global plane must match
+        bit-for-bit for partitions in ``pids`` (default: all)."""
+        metric = self.metric_factory()
+        states = []
+        for (pid, key), batches in sorted(self.batches.items(), key=lambda kv: repr(kv[0])):
+            if pids is not None and pid not in pids:
+                continue
+            s = metric.init_state()
+            for batch in batches:
+                s = metric.update_state(s, batch)
+            states.append(s)
+        if not states:
+            return metric.init_state()
+        return functools.reduce(metric.merge_states, states)
+
+    def close(self):
+        for per_pid in self.engines.values():
+            for engine in per_pid.values():
+                engine.close()
+
+
+@pytest.fixture
+def qc_factory(tmp_path):
+    clusters = []
+
+    def make(metric_factory, **kwargs):
+        # one subdir per cluster: two clusters sharing a WAL directory would
+        # silently journal into each other's lineage
+        cluster = QueryCluster(tmp_path / f"c{len(clusters)}", metric_factory, **kwargs)
+        clusters.append(cluster)
+        return cluster
+
+    yield make
+    for cluster in clusters:
+        cluster.close()
+
+
+def assert_states_equal(a, b, msg=""):
+    assert set(a) == set(b), (set(a), set(b))
+    for name in a:
+        av, bv = np.asarray(a[name]), np.asarray(b[name])
+        assert np.array_equal(av, bv, equal_nan=True), f"{msg} leaf {name!r}: {av} != {bv}"

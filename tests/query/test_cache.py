@@ -1,0 +1,61 @@
+"""Watermark compare semantics + the LRU that stores the merges."""
+
+import pytest
+
+from metrics_tpu.query import CachedGlobal, QueryReport, WatermarkCache, watermark_compatible
+
+
+def _entry(tag):
+    return CachedGlobal(
+        state={"x": tag}, watermarks={"p0": (1, tag)}, missing=(), report=QueryReport(op="compute"), tenants=1
+    )
+
+
+class TestWatermarkCompare:
+    @pytest.mark.parametrize(
+        ("cached", "probe", "valid"),
+        [
+            ((1, 5), (1, 5), True),  # unchanged
+            ((1, 5), (1, 3), True),  # probe behind (lagging replica): cached is fresher evidence
+            ((1, 5), (1, 6), False),  # journal advanced past the stamp
+            ((1, 5), (2, 0), False),  # failover: new lineage invalidates
+            ((2, 5), (1, 9), False),  # "older" epoch is a DIFFERENT lineage, not a valid one
+            ((1, 0), (1, 0), True),  # first journaled write is a real position
+            ((0, -1), (0, -1), False),  # never-journaled stamp never validates
+            ((0, -1), (0, 7), False),
+        ],
+    )
+    def test_truth_table(self, cached, probe, valid):
+        assert watermark_compatible(cached, probe) is valid
+
+
+class TestWatermarkCache:
+    def test_lru_evicts_oldest(self):
+        cache = WatermarkCache(capacity=2)
+        cache.put("a", _entry(1))
+        cache.put("b", _entry(2))
+        assert cache.get("a") is not None  # refresh "a": "b" is now the LRU victim
+        cache.put("c", _entry(3))
+        assert cache.get("b") is None
+        assert cache.get("a") is not None and cache.get("c") is not None
+        assert len(cache) == 2
+
+    def test_put_overwrites(self):
+        cache = WatermarkCache(capacity=4)
+        cache.put("k", _entry(1))
+        cache.put("k", _entry(2))
+        assert cache.get("k").state["x"] == 2
+        assert len(cache) == 1
+
+    def test_invalidate_one_and_all(self):
+        cache = WatermarkCache(capacity=4)
+        cache.put("a", _entry(1))
+        cache.put("b", _entry(2))
+        cache.invalidate("a")
+        assert cache.get("a") is None and cache.get("b") is not None
+        cache.invalidate()
+        assert len(cache) == 0
+
+    def test_capacity_validated(self):
+        with pytest.raises(ValueError, match="capacity"):
+            WatermarkCache(capacity=0)

@@ -1,0 +1,197 @@
+"""GlobalQuery over a replicated partitioned fleet: exactness, honesty, caching."""
+
+import numpy as np
+import pytest
+
+from metrics_tpu import obs
+from metrics_tpu.aggregation import SumMetric
+from metrics_tpu.query import (
+    GlobalQuery,
+    NoLivePartitionsError,
+    PartialResultError,
+)
+from metrics_tpu.sketch import CardinalitySketch, HeavyHittersSketch, QuantileSketch
+
+from tests.query.conftest import P, FOLLOWER, LEADER, assert_states_equal
+
+
+@pytest.fixture(autouse=True)
+def _obs_reset():
+    obs.reset()
+    yield
+    obs.disable()
+    obs.reset()
+
+
+def _counter_total(counter, **labels):
+    total = 0
+    for key, value in counter.collect().items():
+        kd = dict(key)
+        if all(kd.get(k) == v for k, v in labels.items()):
+            total += value
+    return total
+
+
+def _feed_fleet(qc, rng, tenants=24):
+    for t in range(tenants):
+        qc.feed(f"tenant-{t}", rng.lognormal(0.0, 1.0, 16).astype(np.float32))
+    qc.wait_all_caught_up()
+
+
+class TestExactness:
+    def test_quantile_matches_centralized_oracle(self, qc_factory):
+        qc = qc_factory(lambda: QuantileSketch(quantiles=(0.5,)))
+        _feed_fleet(qc, np.random.default_rng(0))
+        metric = QuantileSketch(quantiles=(0.5,))
+        gq = GlobalQuery(qc.client)
+        value, report = gq.quantile(metric, 0.99)
+        oracle = metric.quantile_from(qc.oracle_state(), 0.99)
+        assert float(value) == float(oracle)
+        assert report.partitions_missing == ()
+        assert len(report.partitions) == P
+        assert report.tenants == 24
+        assert not report.cache_hit
+        assert report.follower_served  # prefer="replica" + both replicas caught up
+
+    @pytest.mark.slow
+    def test_cardinality_and_topk_and_compute(self, qc_factory):
+        rng = np.random.default_rng(1)
+        qc_hll = qc_factory(lambda: CardinalitySketch(p=8))
+        qc_hh = qc_factory(lambda: HeavyHittersSketch(k=16, depth=3, width=64))
+        qc_sum = qc_factory(SumMetric)
+        for t in range(12):
+            qc_hll.feed(f"tenant-{t}", rng.integers(0, 300, 40))
+            qc_hh.feed(f"tenant-{t}", rng.integers(0, 10, 30).astype(np.int32))
+            qc_sum.feed(f"tenant-{t}", np.asarray([float(t), float(t)], np.float32))
+        for qc in (qc_hll, qc_hh, qc_sum):
+            qc.wait_all_caught_up()
+
+        hll = CardinalitySketch(p=8)
+        value, _ = GlobalQuery(qc_hll.client).cardinality(hll)
+        assert float(value) == float(hll.compute_from(qc_hll.oracle_state()))
+
+        hh = HeavyHittersSketch(k=16, depth=3, width=64)
+        (keys, counts), _ = GlobalQuery(qc_hh.client).top_k(hh, 5)
+        okeys, ocounts = hh.topk_from(qc_hh.oracle_state(), 5)
+        assert np.array_equal(np.asarray(keys), np.asarray(okeys))
+        assert np.array_equal(np.asarray(counts), np.asarray(ocounts))
+
+        sm = SumMetric()
+        value, _ = GlobalQuery(qc_sum.client).compute(sm)
+        assert float(value) == float(sm.compute_from(qc_sum.oracle_state()))
+
+
+class TestCache:
+    @pytest.mark.slow
+    def test_hit_until_a_watermark_advances(self, qc_factory):
+        obs.enable()
+        qc = qc_factory(lambda: QuantileSketch(quantiles=(0.5,)))
+        _feed_fleet(qc, np.random.default_rng(2))
+        metric = QuantileSketch(quantiles=(0.5,))
+        gq = GlobalQuery(qc.client)
+        from metrics_tpu.obs.instrument import QUERY_CACHE_HITS, QUERY_LEADER_READS
+
+        v1, r1 = gq.quantile(metric, 0.9)
+        assert not r1.cache_hit
+        v2, r2 = gq.quantile(metric, 0.9)
+        assert r2.cache_hit
+        assert float(v2) == float(v1)
+        # a DIFFERENT op over the same state family shares the cached merge
+        _v3, r3 = gq.compute(metric)
+        assert r3.cache_hit
+        assert _counter_total(QUERY_CACHE_HITS) == 2
+        # the entire hit flow — probes included — stayed off the write leaders
+        assert _counter_total(QUERY_LEADER_READS) == 0
+
+        # one partition's journal advances: the next query re-merges and sees
+        # the new data (no stale value, no mixed generations)
+        qc.feed("tenant-0", np.full((8,), 1000.0, np.float32))
+        qc.wait_all_caught_up()
+        v4, r4 = gq.quantile(metric, 0.9)
+        assert not r4.cache_hit
+        assert float(v4) == float(metric.quantile_from(qc.oracle_state(), 0.9))
+
+    def test_degraded_entry_revalidates_against_recovery(self, qc_factory):
+        qc = qc_factory(SumMetric)
+        rng = np.random.default_rng(3)
+        for t in range(12):
+            qc.feed(f"tenant-{t}", np.asarray([float(t + 1)], np.float32))
+        qc.wait_all_caught_up()
+        dead_pid = qc.pmap.partition_of("tenant-0")
+        metric = SumMetric()
+        gq = GlobalQuery(qc.client)
+        qc.engines[LEADER][dead_pid].close()
+        qc.engines[FOLLOWER][dead_pid].close()
+        v1, r1 = gq.compute(metric)
+        assert qc.pmap.name_of(dead_pid) in r1.partitions_missing
+        v2, r2 = gq.compute(metric)
+        # the degraded subset is itself cacheable: same named subset, same value
+        assert r2.cache_hit
+        assert r2.partitions_missing == r1.partitions_missing
+        assert float(v2) == float(v1)
+
+
+class TestHonesty:
+    def test_missing_partition_is_named_and_value_covers_live_subset(self, qc_factory):
+        qc = qc_factory(SumMetric)
+        for t in range(16):
+            qc.feed(f"tenant-{t}", np.asarray([float(t + 1)], np.float32))
+        qc.wait_all_caught_up()
+        dead_pid = qc.pmap.partition_of("tenant-3")
+        qc.engines[LEADER][dead_pid].close()
+        qc.engines[FOLLOWER][dead_pid].close()
+        metric = SumMetric()
+        value, report = GlobalQuery(qc.client).compute(metric)
+        assert report.degraded
+        assert report.partitions_missing == (qc.pmap.name_of(dead_pid),)
+        live = [pid for pid in range(P) if pid != dead_pid]
+        assert float(value) == float(metric.compute_from(qc.oracle_state(pids=live)))
+        missing_row = next(p for p in report.partitions if p.missing)
+        assert missing_row.partition == qc.pmap.name_of(dead_pid)
+        assert missing_row.error  # the refusal that excluded it is recorded
+
+    def test_require_full_raises_instead_of_degrading(self, qc_factory):
+        qc = qc_factory(SumMetric)
+        for t in range(8):
+            qc.feed(f"tenant-{t}", np.asarray([1.0], np.float32))
+        qc.wait_all_caught_up()
+        dead_pid = qc.pmap.partition_of("tenant-1")
+        qc.engines[LEADER][dead_pid].close()
+        qc.engines[FOLLOWER][dead_pid].close()
+        with pytest.raises(PartialResultError, match=qc.pmap.name_of(dead_pid)):
+            GlobalQuery(qc.client, require_full=True).compute(SumMetric())
+
+    def test_no_live_partitions_raises(self, qc_factory):
+        qc = qc_factory(SumMetric)
+        qc.close()
+        with pytest.raises(NoLivePartitionsError):
+            GlobalQuery(qc.client).compute(SumMetric())
+
+    def test_prefer_leader_reads_leaders(self, qc_factory):
+        obs.enable()
+        from metrics_tpu.obs.instrument import QUERY_LEADER_READS
+
+        qc = qc_factory(SumMetric)
+        for t in range(8):
+            qc.feed(f"tenant-{t}", np.asarray([2.0], np.float32))
+        metric = SumMetric()
+        value, report = GlobalQuery(qc.client, prefer="leader").compute(metric)
+        assert float(value) == float(metric.compute_from(qc.oracle_state()))
+        assert not report.follower_served
+        assert _counter_total(QUERY_LEADER_READS, op="compute") == P
+
+
+class TestGuards:
+    def test_quantile_requires_quantile_sketch(self, qc_factory):
+        from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+        qc = qc_factory(SumMetric)
+        with pytest.raises(MetricsTPUUserError, match="quantile"):
+            GlobalQuery(qc.client).quantile(SumMetric(), 0.5)
+        with pytest.raises(MetricsTPUUserError, match="top_k"):
+            GlobalQuery(qc.client).top_k(SumMetric())
+
+    def test_prefer_validated(self, qc_factory):
+        qc = qc_factory(SumMetric)
+        with pytest.raises(ValueError, match="prefer"):
+            GlobalQuery(qc.client, prefer="nearest")

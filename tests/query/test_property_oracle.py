@@ -1,0 +1,97 @@
+"""Property suite: global merge == centralized oracle, bit for bit.
+
+For random tenant→partition splits, random per-tenant streams, and random
+live-subset draws (a missing partition leader degrades the answer to a named
+subset), the plane's answer — per-partition ``fold_states`` rollups reduced
+through ``merge_tree`` — must equal the centralized oracle that merges every
+live tenant's state pairwise, bit-identically, across all four mergeable
+state families: DDSketch buckets, HLL registers, CMS table + top-k ledger,
+and a sum-reduced scalar.
+"""
+
+import functools
+
+import numpy as np
+import pytest
+
+from metrics_tpu.aggregation import SumMetric
+from metrics_tpu.query import fold_states, merge_tree
+from metrics_tpu.sketch import CardinalitySketch, HeavyHittersSketch, QuantileSketch
+
+from tests.query.conftest import assert_states_equal
+
+# distinct HH keys stay <= k: topk_merge is exactly associative only while
+# the global candidate union fits the ledger (the documented exactness regime)
+FAMILIES = [
+    (
+        "ddsketch",
+        lambda: QuantileSketch(quantiles=(0.5, 0.99)),
+        lambda rng: rng.lognormal(0.0, 2.0, int(rng.integers(1, 10))).astype(np.float32),
+    ),
+    (
+        "hll",
+        lambda: CardinalitySketch(p=5),
+        lambda rng: rng.integers(0, 10_000, int(rng.integers(1, 16))),
+    ),
+    (
+        "cms",
+        lambda: HeavyHittersSketch(k=24, depth=2, width=32),
+        lambda rng: rng.integers(0, 24, int(rng.integers(1, 12))).astype(np.int32),
+    ),
+    (
+        "sum",
+        SumMetric,
+        lambda rng: rng.integers(-50, 50, int(rng.integers(1, 8))).astype(np.float32),
+    ),
+]
+
+
+@pytest.mark.parametrize(("family", "metric_factory", "draw"), FAMILIES, ids=[f[0] for f in FAMILIES])
+@pytest.mark.parametrize(
+    "seed",
+    [0] + [pytest.param(s, marks=pytest.mark.slow) for s in range(1, 4)],
+)
+def test_global_merge_equals_centralized_oracle(family, metric_factory, draw, seed):
+    # zlib.crc32, not hash(): string hashing is salted per process, and a
+    # property suite must replay its failures
+    import zlib
+
+    rng = np.random.default_rng(zlib.crc32(family.encode()) + seed)
+    metric = metric_factory()
+    partitions = int(rng.integers(2, 7))
+    tenants = int(rng.integers(partitions, 3 * partitions))
+
+    # random split: every tenant lands on a random partition (some partitions
+    # may be empty — an empty partition's rollup must be the merge identity)
+    homes = rng.integers(0, partitions, tenants)
+    states = []
+    for _ in range(tenants):
+        s = metric.init_state()
+        for _batch in range(int(rng.integers(1, 3))):
+            s = metric.update_state(s, draw(rng))
+        states.append(s)
+
+    # random live-subset draw: at least one partition survives, the rest are
+    # "missing" — named, and excluded from BOTH the plane and the oracle
+    live = sorted(rng.choice(partitions, size=int(rng.integers(1, partitions + 1)), replace=False))
+    missing = sorted(set(range(partitions)) - set(live))
+    assert len(live) + len(missing) == partitions  # every partition accounted for, none silent
+
+    # empty live partitions are skipped, mirroring GlobalQuery._merge: their
+    # rollup is the reduction identity, and folding identities through
+    # topk_merge would canonicalize a singleton ledger's representation
+    rollups = [
+        fold_states(metric, group)
+        for pid in live
+        if (group := [s for s, home in zip(states, homes) if home == pid])
+    ]
+    fan_in = int(rng.integers(2, 5))
+    merged, _hops = merge_tree(metric, rollups, fan_in=fan_in)
+
+    live_states = [s for s, home in zip(states, homes) if home in live]
+    oracle = (
+        functools.reduce(metric.merge_states, live_states)
+        if live_states
+        else metric.init_state()
+    )
+    assert_states_equal(merged, oracle, f"{family} seed={seed} live={live} fan_in={fan_in}")

@@ -1,0 +1,65 @@
+"""Hierarchical reduce: deterministic topology, exact-state shape independence."""
+
+import functools
+
+import numpy as np
+import pytest
+
+from metrics_tpu.query import merge_tree
+from metrics_tpu.sketch import CardinalitySketch, HeavyHittersSketch, QuantileSketch
+
+from tests.query.conftest import assert_states_equal
+
+
+def _states(metric, n, seed=0):
+    # key universe of 16 <= every ledger k in play: topk_merge is exactly
+    # associative only while the candidate union fits the ledger, and that is
+    # the regime the exactness contract (and this suite) covers
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n):
+        s = metric.init_state()
+        s = metric.update_state(s, rng.integers(0, 16, 20).astype(np.int32))
+        out.append(s)
+    return out
+
+
+class TestTopology:
+    @pytest.mark.parametrize(
+        ("n", "fan_in", "hops"),
+        [(1, 2, 0), (2, 2, 1), (8, 2, 3), (8, 4, 2), (8, 8, 1), (9, 4, 2), (17, 4, 3)],
+    )
+    def test_hop_count(self, n, fan_in, hops):
+        m = CardinalitySketch(p=5)
+        _merged, got = merge_tree(m, _states(m, n), fan_in=fan_in)
+        assert got == hops
+
+    def test_empty_is_identity(self):
+        m = CardinalitySketch(p=5)
+        merged, hops = merge_tree(m, [])
+        assert hops == 0
+        assert_states_equal(merged, m.init_state(), "empty tree")
+
+    def test_fan_in_validated(self):
+        m = CardinalitySketch(p=5)
+        with pytest.raises(ValueError, match="fan_in"):
+            merge_tree(m, _states(m, 3), fan_in=1)
+
+
+class TestShapeIndependence:
+    @pytest.mark.parametrize(
+        "fan_in",
+        [2] + [pytest.param(f, marks=pytest.mark.slow) for f in (3, 4, 7, 16)],
+    )
+    def test_bit_identical_across_fan_ins(self, fan_in):
+        # the tree exists to bound hop width; for exact reductions its shape
+        # must be unobservable in the answer
+        for metric in (
+            QuantileSketch(quantiles=(0.9,)),
+            CardinalitySketch(p=6),
+            HeavyHittersSketch(k=32, depth=3, width=64),
+        ):
+            states = _states(metric, 13, seed=fan_in)
+            oracle = functools.reduce(metric.merge_states, states)
+            merged, _hops = merge_tree(metric, states, fan_in=fan_in)
+            assert_states_equal(merged, oracle, f"{type(metric).__name__} fan_in={fan_in}")

@@ -2692,6 +2692,416 @@ def soak_part(seeds) -> None:
                         engine.close(checkpoint=False)
 
 
+# ------------------------------------------------------------------ query surface
+
+_QUERY_P = 4
+
+
+def _query_node_cfg(name, dirpath, link, seed):
+    from metrics_tpu.cluster import DirectoryCoordStore
+    from metrics_tpu.part import PartConfig
+
+    return PartConfig(
+        node_id=name,
+        peers=tuple(p for p in ("a", "b", "c") if p != name),
+        store=DirectoryCoordStore(os.path.join(dirpath, "coord"), durable=False),
+        partitions=_QUERY_P,
+        link_factory=link,
+        manifest_directory=os.path.join(dirpath, "manifest"),
+        # generous TTL (the pilot surface's lesson): the child's submit storm
+        # can starve its renewal thread past a second, and a hair-trigger
+        # lease would depose the leader while it is still alive — the surface
+        # would then measure an election, not the SIGKILL it meant to inject
+        lease_ttl_s=3.0,
+        heartbeat_interval_s=0.2,
+        suspect_after_s=1.5,
+        confirm_after_s=2.5,
+        tick_interval_s=0.05,
+        election_backoff_s=0.1,
+        rng_seed=seed + ord(name),
+    )
+
+
+def _query_stream(seed, pid, n=300):
+    """Deterministic per-partition tenant stream for the query surface: three
+    tenants per partition, variable-length lognormal batches (each submit is
+    exactly one ``update_state`` row — the prefix-twin unit)."""
+    rng = np.random.default_rng((seed << 6) ^ 0x5E3D ^ pid)
+    return [
+        (f"p{pid}t{int(rng.integers(0, 3))}",
+         rng.lognormal(0.0, 1.5, int(rng.integers(1, 6))).astype(np.float32))
+        for _ in range(n)
+    ]
+
+
+def query_crash_child(dirpath, seed):
+    """Child half of the query SIGKILL surface: node 'a' leads all partitions
+    and streams every partition's deterministic tenant batches until killed —
+    the parent's global plane reads follower rollups the whole time, so the
+    kill lands while queries are in flight."""
+    import time as _time
+
+    from metrics_tpu.engine import CheckpointConfig, ReplConfig, StreamingEngine
+    from metrics_tpu.part import PartitionedNode, partition_name
+    from metrics_tpu.repl import FanoutTransport
+    from metrics_tpu.sketch import QuantileSketch
+
+    link = _part_links(dirpath)
+    engines = {}
+    for pid in range(_QUERY_P):
+        pname = partition_name(pid)
+        # buffered WAL, not fsync: the child never restarts from its own disk
+        # (failover is follower promotion), and a per-submit fsync across four
+        # engines would starve the shippers the surface depends on
+        engines[pid] = StreamingEngine(
+            QuantileSketch(quantiles=(0.5, 0.99)),
+            checkpoint=CheckpointConfig(directory=os.path.join(dirpath, f"ckpt-a-{pname}"),
+                                        interval_s=0.05, retain=3),
+            replication=ReplConfig(role="primary",
+                                   transport=FanoutTransport([link("a", "b", pname),
+                                                              link("a", "c", pname)]),
+                                   ship_interval_s=0.01, heartbeat_interval_s=0.1),
+        )
+    node = PartitionedNode(engines, _query_node_cfg("a", dirpath, link, seed))
+    deadline = _time.monotonic() + 60.0
+    while len(node.owned()) < _QUERY_P and _time.monotonic() < deadline:
+        _time.sleep(0.02)
+    print("READY" if len(node.owned()) == _QUERY_P else "NOLEASE", flush=True)
+    streams = [_query_stream(seed, pid) for pid in range(_QUERY_P)]
+    i = 0
+    while True:  # cycle every partition until killed
+        for pid in range(_QUERY_P):
+            key, batch = streams[pid][i % len(streams[pid])]
+            engines[pid].submit(key, jnp.asarray(batch))
+        i += 1
+        _time.sleep(0.001)  # let the ship threads breathe between cycles
+
+
+def soak_query(seeds) -> None:
+    """Global-query-plane soak (ISSUE 18): the leader of ALL partitions is
+    SIGKILLed while the parent's GlobalQuery is mid-flight over its followers.
+    Invariants, in kill order:
+
+    - every answer (before, during, after the kill) covers the full partition
+      set: each partition appears in ``watermarks`` or is NAMED in
+      ``partitions_missing`` — never a silent undercount;
+    - a cache hit re-serves the EXACT per-partition stamps of the miss that
+      populated it — one watermark generation, never a blend;
+    - during the failover window, leader-preferred answers name the dead
+      partitions until each one's election seats a new leader;
+    - after failover converges and the losers re-follow the winners, the
+      global answer is bit-identical to the uninterrupted twin: each winner's
+      tenants replayed per-key through a fresh metric for exactly the
+      ``_update_count`` prefix the winner retained (DDSketch states are
+      int-count sums plus exact min/max, so every merge order agrees);
+    - the pre-kill cache CANNOT survive the epoch bump: the first post-failover
+      answer re-merges, and every stamp it carries is at its partition's new
+      lease epoch (no old-generation stamp mixed in).
+
+    Self-oracled — needs no reference checkout."""
+    import signal
+    import subprocess
+    import tempfile
+    import threading
+    import time as _time
+
+    from metrics_tpu.cluster import DirectoryCoordStore
+    from metrics_tpu.engine import CheckpointConfig, EngineClosed, ReplConfig, StreamingEngine
+    from metrics_tpu.part import PartitionMap, PartitionedClient, PartitionedNode, partition_name
+    from metrics_tpu.query import GlobalQuery, NoLivePartitionsError
+    from metrics_tpu.sketch import QuantileSketch
+
+    class _DeadHandle:
+        """The killed leader's in-process stand-in: every call fails the way a
+        connection to a dead host does — the router treats it as a redirect."""
+
+        def __getattr__(self, name):
+            def _raise(*args, **kwargs):
+                raise EngineClosed("node 'a' is gone")
+
+            return _raise
+
+    for seed in seeds:
+        tag = f"query/failover seed={seed}"
+        with tempfile.TemporaryDirectory() as d:
+            link = _part_links(d)
+            child = subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__), "--query-child", d, str(seed)],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            )
+            engines: dict = {}
+            nodes: dict = {}
+            try:
+                line = child.stdout.readline()
+                if "READY" not in line:
+                    err = child.stderr.read()[:200]
+                    FAILS.append((seed, tag, f"child failed to lead all partitions: {line!r} {err!r}"))
+                    continue
+                for name in ("b", "c"):
+                    engines[name] = {}
+                    for pid in range(_QUERY_P):
+                        pname = partition_name(pid)
+                        engines[name][pid] = StreamingEngine(
+                            QuantileSketch(quantiles=(0.5, 0.99)),
+                            replication=ReplConfig(
+                                role="follower", transport=link("a", name, pname),
+                                poll_interval_s=0.01,
+                                promote_checkpoint=CheckpointConfig(
+                                    directory=os.path.join(d, f"promoted-{name}-{pname}"),
+                                    interval_s=0.1, durable=False),
+                            ),
+                        )
+                    nodes[name] = PartitionedNode(
+                        engines[name], _query_node_cfg(name, d, link, seed))
+
+                def bootstrapped(name, pid):
+                    applier = engines[name][pid]._applier
+                    return applier is not None and applier.bootstrapped
+
+                deadline = _time.monotonic() + 60.0
+                while _time.monotonic() < deadline and not all(
+                    bootstrapped(n, pid) for n in ("b", "c") for pid in range(_QUERY_P)
+                ):
+                    _time.sleep(0.05)
+                if not all(bootstrapped(n, pid) for n in ("b", "c") for pid in range(_QUERY_P)):
+                    FAILS.append((seed, tag, "survivors never bootstrapped"))
+                    continue
+
+                store = DirectoryCoordStore(os.path.join(d, "coord"), durable=False)
+                pmap = PartitionMap(_QUERY_P)
+                client = PartitionedClient(
+                    store,
+                    {"a": {pid: _DeadHandle() for pid in range(_QUERY_P)},
+                     "b": engines["b"], "c": engines["c"]},
+                    pmap=pmap, retries=6, backoff_s=0.005, backoff_cap_s=0.02,
+                    rng_seed=seed,
+                )
+                names = set(pmap.names())
+                metric = QuantileSketch(quantiles=(0.5, 0.99))
+                gq = GlobalQuery(client, prefer="replica")
+
+                def coverage_ok(report):
+                    served = set(report.watermarks) | set(report.partitions_missing)
+                    if served != names:
+                        FAILS.append((seed, tag, f"silent undercount: answer covers "
+                                      f"{sorted(served)} of {sorted(names)}"))
+                        return False
+                    return True
+
+                # straddle the kill: follower-served replica reads run in a
+                # loop the whole time; once every partition serves real
+                # tenants, a timer SIGKILLs the leader mid-loop so the kill
+                # interrupts genuine data flow, not an idle fleet
+                rng = np.random.default_rng(seed ^ 0x9E11)
+                killer = None
+                last_miss = None
+                broken = False
+                deadline = _time.monotonic() + 120.0
+                while child.poll() is None and _time.monotonic() < deadline:
+                    try:
+                        _value, report = gq.quantile(metric, 0.99)
+                    except NoLivePartitionsError:
+                        _time.sleep(0.02)
+                        continue  # every probe lost a race — allowed, and never silent
+                    if not coverage_ok(report):
+                        broken = True
+                        break
+                    if report.cache_hit:
+                        if last_miss is None or report.watermarks != last_miss.watermarks:
+                            FAILS.append((seed, tag, "cache hit blended stamps: served "
+                                          f"{report.watermarks} after miss "
+                                          f"{None if last_miss is None else last_miss.watermarks}"))
+                            broken = True
+                            break
+                    else:
+                        last_miss = report
+                    if killer is None and not report.partitions_missing and all(
+                        p.tenants > 0 for p in report.partitions
+                    ):
+                        killer = threading.Timer(
+                            float(rng.uniform(0.2, 0.8)),
+                            lambda: child.send_signal(signal.SIGKILL))
+                        killer.start()
+                    _time.sleep(0.01)
+                if killer is not None:
+                    killer.cancel()
+                if broken:
+                    continue
+                if killer is None:
+                    diag = None if last_miss is None else [
+                        (p.partition, p.node, p.tenants) for p in last_miss.partitions]
+                    FAILS.append((seed, tag, "fleet never warmed up: some partition "
+                                  f"never served a tenant within the deadline {diag}"))
+                    if child.poll() is None:
+                        child.send_signal(signal.SIGKILL)
+                    child.wait(timeout=30)
+                    continue
+                if child.poll() is None:
+                    child.send_signal(signal.SIGKILL)
+                child.wait(timeout=30)
+
+                # failover window: leader-preferred answers must NAME what they
+                # cannot serve, until every partition seats a new leader
+                gq_leader = GlobalQuery(client, prefer="leader", probe_retries=0)
+                all_served = False
+                deadline = _time.monotonic() + 60.0
+                while _time.monotonic() < deadline:
+                    try:
+                        _value, report = gq_leader.quantile(metric, 0.99)
+                    except NoLivePartitionsError:
+                        _time.sleep(0.05)
+                        continue
+                    if not coverage_ok(report):
+                        broken = True
+                        break
+                    if not report.partitions_missing:
+                        all_served = True
+                        break
+                    _time.sleep(0.05)
+                if broken:
+                    continue
+                if not all_served:
+                    FAILS.append((seed, tag, "some partition never seated a servable "
+                                  "leader after the kill"))
+                    continue
+
+                # convergence: one writable winner per partition, the loser
+                # re-follows it and catches up to its final WAL seq
+                winners: dict = {}
+                for pid in range(_QUERY_P):
+                    writable = [n for n in ("b", "c") if not engines[n][pid]._repl_follower]
+                    if len(writable) != 1:
+                        FAILS.append((seed, tag, f"p{pid}: writable set {writable} after failover"))
+                        broken = True
+                        break
+                    winners[pid] = writable[0]
+                if broken:
+                    continue
+                deadline = _time.monotonic() + 30.0
+                caught_up = set()
+                while _time.monotonic() < deadline and len(caught_up) < _QUERY_P:
+                    for pid in range(_QUERY_P):
+                        loser = "c" if winners[pid] == "b" else "b"
+                        applier = engines[loser][pid]._applier
+                        if (nodes[loser]._slots[pid].following == winners[pid]
+                                and applier is not None and applier.bootstrapped
+                                and applier.applied_seq >= engines[winners[pid]][pid]._wal_seq):
+                            caught_up.add(pid)
+                    _time.sleep(0.05)
+                if len(caught_up) < _QUERY_P:
+                    FAILS.append((seed, tag, "losers never re-followed + caught up: "
+                                  f"missing {sorted(set(range(_QUERY_P)) - caught_up)}"))
+                    continue
+
+                # uninterrupted twin: per winner tenant, replay exactly the
+                # first `_update_count` ROWS of that key's (cycled) stream —
+                # submits are atomic per batch, so the applied prefix must
+                # land exactly on a batch boundary
+                twin_metric = QuantileSketch(quantiles=(0.5, 0.99))
+                twin = None
+                for pid in range(_QUERY_P):
+                    per_key: dict = {}
+                    for key, batch in _query_stream(seed, pid):
+                        per_key.setdefault(key, []).append(batch)
+                    keyed = engines[winners[pid]][pid]._keyed
+                    for key in keyed.keys:
+                        state = jax.device_get(keyed.state_of(key))
+                        applied = int(np.asarray(state["_update_count"]))
+                        batches = per_key.get(key, [])
+                        if not batches:
+                            if applied:
+                                FAILS.append((seed, tag, f"p{pid} key {key}: {applied} "
+                                              "rows but key never streamed"))
+                                broken = True
+                            continue
+                        while applied > sum(len(b) for b in batches):  # the child cycles
+                            batches = batches + per_key[key]
+                        tenant = twin_metric.init_state()
+                        rows = 0
+                        for batch in batches:
+                            if rows >= applied:
+                                break
+                            if rows + len(batch) > applied:
+                                FAILS.append((seed, tag, f"p{pid} key {key}: applied prefix "
+                                              f"{applied} tears a {len(batch)}-row batch at {rows}"))
+                                broken = True
+                                break
+                            tenant = twin_metric.update_state(tenant, jnp.asarray(batch))
+                            rows += len(batch)
+                        if broken:
+                            break
+                        twin = tenant if twin is None else twin_metric.merge_states(twin, tenant)
+                    if broken:
+                        break
+                if broken or twin is None:
+                    if twin is None:
+                        diag = {pid: list(engines[winners[pid]][pid]._keyed.keys)
+                                for pid in range(_QUERY_P)}
+                        FAILS.append((seed, tag, f"no winner retained any tenant state {diag}"))
+                    continue
+                expect = np.asarray(twin_metric.quantile_from(twin, (0.5, 0.99)))
+
+                # post-failover leader truth == twin, bit for bit (retry past
+                # dead-handle dice rolls: a named miss here is honest, but the
+                # surface needs the full answer to compare)
+                final = None
+                deadline = _time.monotonic() + 30.0
+                while final is None and _time.monotonic() < deadline:
+                    value, report = GlobalQuery(client, prefer="leader").quantile(
+                        metric, (0.5, 0.99))
+                    if not coverage_ok(report):
+                        broken = True
+                        break
+                    if not report.partitions_missing:
+                        final = np.asarray(value)
+                if broken:
+                    continue
+                if final is None:
+                    FAILS.append((seed, tag, "post-failover leader read never served all partitions"))
+                    continue
+                if not np.array_equal(final, expect):
+                    FAILS.append((seed, tag, f"post-failover answer {final} != "
+                                  f"uninterrupted twin {expect}"))
+
+                # the pre-kill cache must not cross the epoch bump: the stale
+                # generation re-merges, and every stamp comes out at its
+                # partition's NEW epoch — no mixed generations, twin value
+                fresh = None
+                deadline = _time.monotonic() + 30.0
+                while fresh is None and _time.monotonic() < deadline:
+                    value, report = gq.quantile(metric, (0.5, 0.99))
+                    if not report.partitions_missing:
+                        fresh = (np.asarray(value), report)
+                if fresh is None:
+                    FAILS.append((seed, tag, "post-failover replica read never served all partitions"))
+                    continue
+                value, report = fresh
+                if last_miss is not None and report.cache_hit \
+                        and report.watermarks == last_miss.watermarks:
+                    FAILS.append((seed, tag, "pre-kill cache entry served across the failover"))
+                for pid in range(_QUERY_P):
+                    pname = pmap.name_of(pid)
+                    epoch = report.watermarks[pname][0]
+                    want = engines[winners[pid]][pid]._repl_epoch
+                    if epoch != want:
+                        FAILS.append((seed, tag, f"{pname}: stamp epoch {epoch} mixed into a "
+                                      f"generation at epoch {want}"))
+                if not np.array_equal(value, expect):
+                    FAILS.append((seed, tag, f"post-failover replica answer {value} != "
+                                  f"uninterrupted twin {expect}"))
+            except Exception as exc:  # noqa: BLE001 — record crash seeds, keep soaking
+                FAILS.append((seed, tag, "surface raised: " + repr(exc)[:160]))
+            finally:
+                if child.poll() is None:
+                    child.kill()
+                    child.wait(timeout=30)
+                for node in nodes.values():
+                    node.close(release=False)
+                for per_pid in engines.values():
+                    for engine in per_pid.values():
+                        engine.close(checkpoint=False)
+
+
 # ---------------------------------------------------------------------------
 # autopilot surface (ISSUE 16)
 
@@ -3019,15 +3429,16 @@ SURFACES = {
     "tier": soak_tier,
     "part": soak_part,
     "pilot": soak_pilot,
+    "query": soak_query,
 }
 
 # surfaces that execute the reference as their oracle (everything except the
 # self-oracled engine, ckpt crash-recovery, guard chaos, repl, sketch,
-# cluster, shard, comm, tier, part and pilot surfaces)
+# cluster, shard, comm, tier, part, pilot and query surfaces)
 _NEEDS_REF = {
     name for name in SURFACES
     if name not in ("engine", "ckpt", "guard", "repl", "sketch", "cluster", "shard",
-                    "comm", "tier", "part", "pilot")
+                    "comm", "tier", "part", "pilot", "query")
 }
 
 
@@ -3049,6 +3460,8 @@ def main() -> None:
                         help="internal: run the all-partitions leader child (killed by the parent)")
     parser.add_argument("--pilot-child", nargs=2, metavar=("DIR", "SEED"),
                         help="internal: run the autopilot-holder child (killed by the parent)")
+    parser.add_argument("--query-child", nargs=2, metavar=("DIR", "SEED"),
+                        help="internal: run the all-partitions query-leader child (killed by the parent)")
     parser.add_argument("--flight-dir", default=None, metavar="DIR",
                         help="dump a flight-recorder post-mortem bundle here if any "
                              "surface fails (CI uploads it as an artifact)")
@@ -3081,6 +3494,10 @@ def main() -> None:
     if args.pilot_child is not None:
         dirpath, seed = args.pilot_child
         pilot_crash_child(dirpath, int(seed))
+        return
+    if args.query_child is not None:
+        dirpath, seed = args.query_child
+        query_crash_child(dirpath, int(seed))
         return
 
     start, stop = (int(x) for x in args.seeds.split(":"))
